@@ -1,0 +1,137 @@
+"""Cloud = fixed TPU device mesh + thin host control plane.
+
+The reference forms a "cloud" of JVMs by gossip consensus over UDP heartbeats
+(water/Paxos.java:15-132, water/HeartBeatThread.java:24) and *locks* membership
+at the first distributed write (Paxos.java:145-166).  A TPU slice is already a
+fixed, hardware-discovered set of chips, so the TPU-native cloud is simply a
+``jax.sharding.Mesh`` built once at boot — the same "fixed membership"
+semantics the reference converges to, without the consensus machinery.  Multi-
+host pods join via ``jax.distributed.initialize`` (the flatfile/multicast
+discovery analog, reference water/init/NetworkInit.java:166-186).
+
+Mesh axes:
+- ``nodes``  — the data axis.  Frame rows shard over it; MRTask reduces psum
+  over it.  This is the analog of chunk home-nodes (water/Key.java:91-182).
+- ``model``  — optional second axis for tensor parallelism inside an algorithm
+  (e.g. wide GLM Gram blocks, DL layer sharding).  The reference has no model
+  parallelism (SURVEY §2.4); this axis defaults to size 1.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from h2o_tpu.core.config import OptArgs
+from h2o_tpu.core.log import get_logger
+
+log = get_logger("cloud")
+
+DATA_AXIS = "nodes"
+MODEL_AXIS = "model"
+
+
+class Cloud:
+    """Singleton runtime: device mesh + config + store + job registry."""
+
+    _instance: Optional["Cloud"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, args: OptArgs, devices=None):
+        self.args = args
+        devs = list(devices if devices is not None else jax.devices())
+        n = args.nodes or (len(devs) // args.model_axis)
+        m = args.model_axis
+        if n * m > len(devs):
+            raise ValueError(
+                f"requested mesh {n}x{m} exceeds {len(devs)} devices")
+        devs = devs[: n * m]
+        self.mesh = Mesh(
+            np.asarray(devs).reshape(n, m), (DATA_AXIS, MODEL_AXIS))
+        self.n_nodes = n
+        # host control plane
+        from h2o_tpu.core.store import DKV
+        from h2o_tpu.core.job import JobRegistry
+        self.dkv = DKV()
+        self.jobs = JobRegistry()
+        self.session_counter = 0
+        log.info("Cloud '%s' of size %d formed (mesh %dx%d, platform=%s)",
+                 args.name, n, n, m, devs[0].platform)
+
+    # -- singleton management (the reference's H2O.CLOUD / H2O.SELF statics) --
+
+    @classmethod
+    def get(cls) -> "Cloud":
+        if cls._instance is None:
+            with cls._lock:
+                if cls._instance is None:
+                    cls._instance = Cloud(OptArgs.from_env())
+        return cls._instance
+
+    @classmethod
+    def boot(cls, **flags) -> "Cloud":
+        """(Re)boot the cloud with explicit flags.  Replaces any prior cloud —
+        tests use this to get differently-shaped meshes."""
+        with cls._lock:
+            cls._instance = Cloud(OptArgs.from_env(**flags))
+        return cls._instance
+
+    @classmethod
+    def boot_multihost(cls, coordinator: str, num_processes: int,
+                       process_id: int, **flags) -> "Cloud":
+        """Multi-host boot: the flatfile-discovery analog.  Each host calls
+        this with the same coordinator address; jax.distributed performs the
+        barriered rendezvous that Paxos gossip performs in the reference."""
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+        return cls.boot(**flags)
+
+    # -- sharding helpers ---------------------------------------------------
+
+    @property
+    def row_sharding(self) -> NamedSharding:
+        """Rows sharded over the data axis (chunk-homing analog)."""
+        return NamedSharding(self.mesh, P(DATA_AXIS))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def matrix_sharding(self) -> NamedSharding:
+        """(rows, cols) matrices: rows over nodes, cols replicated."""
+        return NamedSharding(self.mesh, P(DATA_AXIS, None))
+
+    def row_multiple(self) -> int:
+        """Row counts are padded to a multiple of this so every device holds
+        an identical-shape, lane-aligned shard (the fixed-shape analog of the
+        reference's ~4 MiB chunk quantum, water/fvec/FileVec.java:33-38)."""
+        return self.n_nodes * self.args.row_align
+
+    def device_put_rows(self, host_array) -> jax.Array:
+        """Pad host rows to the shard quantum and scatter over the mesh."""
+        arr = np.asarray(host_array)
+        q = self.row_multiple()
+        pad = (-arr.shape[0]) % q
+        if pad:
+            pad_width = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+            fill = np.nan if np.issubdtype(arr.dtype, np.floating) else 0
+            arr = np.pad(arr, pad_width, constant_values=fill)
+        sh = self.row_sharding if arr.ndim == 1 else NamedSharding(
+            self.mesh, P(DATA_AXIS, *([None] * (arr.ndim - 1))))
+        return jax.device_put(arr, sh)
+
+
+def cloud() -> Cloud:
+    """The current cloud (boots a default local one on first use)."""
+    return Cloud.get()
+
+
+def is_virtual_cpu_mesh() -> bool:
+    return jax.devices()[0].platform == "cpu" and (
+        "host_platform_device_count" in os.environ.get("XLA_FLAGS", ""))

@@ -1,0 +1,71 @@
+"""Cluster/runtime configuration flags.
+
+TPU-native analog of H2O's single ``OptArgs`` POJO parsed from argv with an
+``ai.h2o.*`` system-property overlay (reference: water/H2O.java:233-466,
+2355-2366).  Here flags come from constructor kwargs with an ``H2O_TPU_*``
+environment-variable overlay, and the parsed config seeds the Cloud singleton.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env(name: str, default, cast):
+    raw = os.environ.get("H2O_TPU_" + name.upper())
+    if raw is None:
+        return default
+    if cast is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return cast(raw)
+
+
+@dataclasses.dataclass
+class OptArgs:
+    """Runtime flags.  Mirrors the semantics (not the transport) of the
+    reference's CLI surface: cluster name, ports, log level, recovery dir."""
+
+    # -name: cluster identity (used in REST /3/Cloud responses)
+    name: str = "h2o-tpu"
+    # -baseport / port for the REST server
+    port: int = 54321
+    ip: str = "127.0.0.1"
+    # data-axis size override: number of mesh "nodes" (None = all local devices)
+    nodes: Optional[int] = None
+    # second mesh axis for model/tensor parallelism inside an algorithm
+    model_axis: int = 1
+    # -log_level
+    log_level: str = "INFO"
+    # -ice_root equivalent: spill/checkpoint directory
+    ice_root: str = "/tmp/h2o_tpu"
+    # -auto_recovery_dir equivalent (job-level fault tolerance, SURVEY §5.3)
+    auto_recovery_dir: Optional[str] = None
+    # default compute dtype for frame matrices fed to the MXU
+    compute_dtype: str = "float32"
+    # deterministic reductions (reference: _reproducibleHistos)
+    reproducible: bool = True
+    # row-shard padding multiple per device (TPU lane friendliness)
+    row_align: int = 128
+
+    @classmethod
+    def from_env(cls, **overrides) -> "OptArgs":
+        args = cls()
+        for f in dataclasses.fields(cls):
+            setattr(args, f.name, _env(f.name, getattr(args, f.name),
+                                       _cast_for(f.type)))
+        for k, v in overrides.items():
+            if not hasattr(args, k):
+                raise ValueError(f"unknown flag: {k}")
+            setattr(args, k, v)
+        return args
+
+
+def _cast_for(tp) -> type:
+    tp = str(tp)
+    if "int" in tp:
+        return int
+    if "bool" in tp:
+        return bool
+    return str

@@ -1,0 +1,323 @@
+"""Frame / Vec — the distributed columnar data plane, TPU-native edition.
+
+Reference design (water/fvec/*, SURVEY §2.1): a Frame is a list of Vecs; each
+Vec is one column split into ~4 MiB compressed Chunks homed across nodes, with
+a VectorGroup keeping all columns of a frame chunk-aligned so a row's cells
+are co-located (Vec.java:120-135).  Types are T_NUM/T_CAT/T_TIME/T_STR/T_UUID
+/T_BAD (Vec.java:207-212); categorical domains are String[] on the Vec; lazy
+``RollupStats`` (min/max/mean/sigma/nacnt/histogram) are computed by an MRTask
+and cached (RollupStats.java).
+
+TPU-native redesign:
+- a Vec's numeric payload is ONE ``jax.Array`` row-sharded over the mesh's
+  ``nodes`` axis — the shard is the "chunk", HBM is the heap, and
+  ``NamedSharding`` is the VectorGroup (all Vecs of a Frame share the same
+  row partitioning by construction, so cells of a row are on the same chip);
+- rows are padded to a fixed per-device quantum (lane-aligned static shapes —
+  XLA's analog of the chunk size constant, FileVec.java:33-38) and masked with
+  a row-validity predicate derived from ``iota < nrows``;
+- NAs are NaN in the float payload (numeric/time) and -1 in int payloads
+  (categorical), mirroring the reference's per-type NA sentinels
+  (water/fvec/C8Chunk.java NAs / DHistogram NA bucket);
+- chunk compression codecs (C1Chunk..C16Chunk, SURVEY §2.1) are replaced by
+  dtype selection: float32 payloads by default, bfloat16 matrices for MXU
+  consumption; XLA fuses any decompression-like widening into consumers;
+- strings/UUIDs stay host-side (SURVEY §7 "strings stay host-side");
+- rollups are one fused jit reduction, cached on the Vec, invalidated on
+  mutation — same contract as RollupStats' lazy compute-once.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o_tpu.core.cloud import cloud
+from h2o_tpu.core.store import Key
+
+# Vec types (reference: water/fvec/Vec.java:207-212)
+T_BAD = "bad"      # all-NA
+T_NUM = "real"     # numeric (int or float — device f32)
+T_CAT = "enum"     # categorical: int32 codes + host domain
+T_TIME = "time"    # ms since epoch (device f32; precision caveat documented)
+T_STR = "string"   # host-side list of str
+T_UUID = "uuid"    # host-side
+
+
+def _row_pad(n: int) -> int:
+    q = cloud().row_multiple()
+    return ((n + q - 1) // q) * q
+
+
+@functools.partial(jax.jit, static_argnames=("nbins",))
+def _rollups_kernel(data: jax.Array, nrows: jax.Array, nbins: int = 64):
+    """Fused single-pass rollup stats over one padded, sharded column.
+
+    Equivalent of the RollupStats MRTask (water/fvec/RollupStats.java): the
+    row-sharded input makes every reduction below an ICI psum inserted by XLA.
+    """
+    idx = jnp.arange(data.shape[0])
+    valid = idx < nrows
+    isna = jnp.isnan(data) & valid
+    ok = valid & ~isna
+    x = jnp.where(ok, data, 0.0)
+    cnt = jnp.sum(ok)
+    nacnt = jnp.sum(isna)
+    s = jnp.sum(x)
+    mean = s / jnp.maximum(cnt, 1)
+    var = jnp.sum(jnp.where(ok, (data - mean) ** 2, 0.0)) / jnp.maximum(
+        cnt - 1, 1)
+    big = jnp.asarray(jnp.inf, data.dtype)
+    vmin = jnp.min(jnp.where(ok, data, big))
+    vmax = jnp.max(jnp.where(ok, data, -big))
+    zeros = jnp.sum(ok & (data == 0))
+    isint = jnp.all(jnp.where(ok, data == jnp.round(data), True))
+    # fixed-width histogram between min and max (for quantiles/binning)
+    span = jnp.maximum(vmax - vmin, 1e-30)
+    b = jnp.clip(((data - vmin) / span * nbins).astype(jnp.int32), 0,
+                 nbins - 1)
+    hist = jnp.zeros((nbins,), jnp.int32).at[b].add(ok.astype(jnp.int32))
+    return dict(cnt=cnt, nacnt=nacnt, mean=mean, sigma=jnp.sqrt(var),
+                min=vmin, max=vmax, zeros=zeros, isint=isint, hist=hist)
+
+
+class RollupStats:
+    """Materialized rollups for one Vec."""
+
+    __slots__ = ("cnt", "nacnt", "mean", "sigma", "min", "max", "zeros",
+                 "isint", "hist")
+
+    def __init__(self, d: dict):
+        for k in self.__slots__:
+            v = np.asarray(d[k])
+            setattr(self, k, v if k == "hist" else v.item())
+
+
+class Vec:
+    """One column.  Numeric/categorical/time payloads live on-device."""
+
+    def __init__(self, data, vtype: str = T_NUM, nrows: Optional[int] = None,
+                 domain: Optional[List[str]] = None):
+        self.type = vtype
+        self.domain = domain
+        self._rollups: Optional[RollupStats] = None
+        if vtype in (T_STR, T_UUID):
+            self.host_data: List = list(data)
+            self.nrows = len(self.host_data)
+            self.data = None
+            return
+        self.host_data = None
+        if isinstance(data, jax.Array):
+            assert nrows is not None, "device data requires explicit nrows"
+            self.data = data
+            self.nrows = nrows
+        else:
+            arr = np.asarray(data)
+            self.nrows = nrows if nrows is not None else arr.shape[0]
+            if vtype == T_CAT:
+                arr = arr.astype(np.int32)
+                # NA code -1 → represent as float NaN? no: keep int + sentinel
+                self.data = cloud().device_put_rows(arr)
+            else:
+                self.data = cloud().device_put_rows(
+                    arr.astype(np.float32, copy=False))
+
+    # -- basics ------------------------------------------------------------
+
+    def __len__(self) -> int:
+        return self.nrows
+
+    @property
+    def is_categorical(self) -> bool:
+        return self.type == T_CAT
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.type in (T_NUM, T_TIME)
+
+    @property
+    def cardinality(self) -> int:
+        return len(self.domain) if self.domain is not None else -1
+
+    def as_float(self) -> jax.Array:
+        """Device payload as float32 with NaN NAs (cat codes -1 → NaN)."""
+        if self.type == T_CAT:
+            f = self.data.astype(jnp.float32)
+            return jnp.where(self.data < 0, jnp.nan, f)
+        return self.data
+
+    def to_numpy(self) -> np.ndarray:
+        """Unpadded host copy (NA = NaN for numeric, -1 for categorical)."""
+        if self.host_data is not None:
+            return np.asarray(self.host_data, dtype=object)
+        return np.asarray(self.data)[: self.nrows]
+
+    # -- rollups -----------------------------------------------------------
+
+    @property
+    def rollups(self) -> RollupStats:
+        if self._rollups is None:
+            self._rollups = RollupStats(
+                jax.tree.map(np.asarray,
+                             _rollups_kernel(self.as_float(),
+                                             jnp.int32(self.nrows))))
+        return self._rollups
+
+    def mean(self) -> float:
+        return self.rollups.mean
+
+    def sigma(self) -> float:
+        return self.rollups.sigma
+
+    def min(self) -> float:
+        return self.rollups.min
+
+    def max(self) -> float:
+        return self.rollups.max
+
+    def nacnt(self) -> int:
+        if self.type == T_CAT:
+            # categorical NA is the -1 code, invisible to the NaN-based kernel
+            idx_valid = np.asarray(self.data)[: self.nrows]
+            return int((idx_valid < 0).sum())
+        return int(self.rollups.nacnt)
+
+    def invalidate(self) -> None:
+        self._rollups = None
+
+
+class Frame:
+    """An ordered collection of equally-long, identically-sharded Vecs."""
+
+    def __init__(self, names: Sequence[str] = (), vecs: Sequence[Vec] = (),
+                 key: Optional[str] = None):
+        assert len(names) == len(vecs)
+        self.names: List[str] = list(names)
+        self.vecs: List[Vec] = list(vecs)
+        for v in self.vecs[1:]:
+            assert v.nrows == self.vecs[0].nrows, "ragged frame"
+        self.key = Key(key) if key else Key.make("frame")
+        self._matrix_cache: Dict = {}
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_numpy(cls, array: np.ndarray, names: Optional[Sequence[str]] = None,
+                   key: Optional[str] = None) -> "Frame":
+        array = np.asarray(array, dtype=np.float32)
+        if array.ndim == 1:
+            array = array[:, None]
+        names = list(names) if names else [f"C{i+1}" for i in
+                                           range(array.shape[1])]
+        vecs = [Vec(array[:, j]) for j in range(array.shape[1])]
+        return cls(names, vecs, key=key)
+
+    @classmethod
+    def from_dict(cls, cols: Dict[str, Union[np.ndarray, list]],
+                  key: Optional[str] = None) -> "Frame":
+        names, vecs = [], []
+        for name, col in cols.items():
+            names.append(name)
+            arr = np.asarray(col)
+            if arr.dtype.kind in "OUS":  # strings → categorical
+                domain, codes = np.unique(arr.astype(str), return_inverse=True)
+                vecs.append(Vec(codes.astype(np.int32), T_CAT,
+                                domain=[str(d) for d in domain]))
+            else:
+                vecs.append(Vec(arr.astype(np.float32)))
+        return cls(names, vecs, key=key)
+
+    # -- shape / access ----------------------------------------------------
+
+    @property
+    def nrows(self) -> int:
+        return self.vecs[0].nrows if self.vecs else 0
+
+    @property
+    def ncols(self) -> int:
+        return len(self.vecs)
+
+    @property
+    def padded_rows(self) -> int:
+        return _row_pad(self.nrows)
+
+    def vec(self, name: str) -> Vec:
+        return self.vecs[self.names.index(name)]
+
+    def __getitem__(self, name):
+        if isinstance(name, str):
+            return self.vec(name)
+        if isinstance(name, (list, tuple)):
+            return self.subframe(name)
+        raise TypeError(name)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.names
+
+    def subframe(self, names: Sequence[str]) -> "Frame":
+        return Frame(list(names), [self.vec(n) for n in names])
+
+    def drop(self, names: Sequence[str]) -> "Frame":
+        if isinstance(names, str):
+            names = [names]
+        keep = [n for n in self.names if n not in names]
+        return self.subframe(keep)
+
+    def add(self, name: str, vec: Vec) -> "Frame":
+        assert vec.nrows == self.nrows or not self.vecs
+        self.names.append(name)
+        self.vecs.append(vec)
+        self._matrix_cache.clear()
+        return self
+
+    def cbind(self, other: "Frame") -> "Frame":
+        return Frame(self.names + other.names, self.vecs + other.vecs)
+
+    # -- device views ------------------------------------------------------
+
+    def as_matrix(self, names: Optional[Sequence[str]] = None,
+                  dtype=jnp.float32) -> jax.Array:
+        """(padded_rows, ncols) row-sharded matrix of the named columns.
+
+        Categoricals appear as their float codes (NA → NaN).  Cached — the
+        fused "decompress chunks into a dense row block" analog of
+        DataInfo row extraction (hex/DataInfo.java), but done once.
+        """
+        names = tuple(names) if names is not None else tuple(self.names)
+        ck = (names, jnp.dtype(dtype).name)
+        m = self._matrix_cache.get(ck)
+        if m is None:
+            cols = [self.vec(n).as_float() for n in names]
+            m = jnp.stack(cols, axis=1).astype(dtype)
+            m = jax.device_put(m, cloud().matrix_sharding())
+            self._matrix_cache[ck] = m
+        return m
+
+    def row_mask(self) -> jax.Array:
+        """Validity predicate over padded rows."""
+        return jnp.arange(self.padded_rows) < self.nrows
+
+    # -- misc --------------------------------------------------------------
+
+    def types(self) -> List[str]:
+        return [v.type for v in self.vecs]
+
+    def to_pandas(self):
+        import pandas as pd
+        cols = {}
+        for n, v in zip(self.names, self.vecs):
+            arr = v.to_numpy()
+            if v.is_categorical:
+                dom = np.asarray(v.domain + ["NaN"], dtype=object)
+                cols[n] = dom[np.where(arr < 0, len(v.domain), arr)]
+            else:
+                cols[n] = arr
+        return pd.DataFrame(cols)
+
+    def __repr__(self) -> str:
+        return (f"<Frame {self.key} {self.nrows}x{self.ncols} "
+                f"[{', '.join(self.names[:8])}{'...' if self.ncols > 8 else ''}]>")

@@ -1,0 +1,142 @@
+"""Async job tracking (reference: water/Job.java, water/api/JobsHandler.java).
+
+Jobs run on a host thread pool (the FJ-pool analog for *control* work — the
+actual compute is dispatched to the TPU mesh inside the job body).  Progress,
+cancellation, exception propagation, and DKV visibility match the reference's
+Job<T> semantics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, Optional
+
+from h2o_tpu.core.log import get_logger
+from h2o_tpu.core.store import Key
+
+log = get_logger("job")
+
+CREATED = "CREATED"
+RUNNING = "RUNNING"
+DONE = "DONE"
+CANCELLED = "CANCELLED"
+FAILED = "FAILED"
+
+
+class JobCancelledException(Exception):
+    pass
+
+
+class Job:
+    """A tracked unit of async work producing a DKV-visible result."""
+
+    def __init__(self, dest: Optional[str] = None, description: str = ""):
+        self.key = Key.make("job")
+        self.dest = Key(dest) if dest else Key.make("result")
+        self.description = description
+        self.status = CREATED
+        self.progress = 0.0
+        self.progress_msg = ""
+        self.exception: Optional[BaseException] = None
+        self.start_time = 0.0
+        self.end_time = 0.0
+        self._cancel_requested = threading.Event()
+        self._done = threading.Event()
+        self.result: Any = None
+
+    # -- body-side API ------------------------------------------------------
+
+    def update(self, progress: float, msg: str = "") -> None:
+        """Called from inside the job body; raises if cancel was requested
+        (cooperative cancellation, like the reference's Job.stop_requested)."""
+        self.progress = float(progress)
+        if msg:
+            self.progress_msg = msg
+        if self._cancel_requested.is_set():
+            raise JobCancelledException(self.description)
+
+    @property
+    def stop_requested(self) -> bool:
+        return self._cancel_requested.is_set()
+
+    # -- control-side API ---------------------------------------------------
+
+    def cancel(self) -> None:
+        self._cancel_requested.set()
+
+    def join(self, timeout: Optional[float] = None) -> Any:
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"job {self.key} still running")
+        if self.status == FAILED:
+            raise self.exception
+        if self.status == CANCELLED:
+            raise JobCancelledException(self.description)
+        return self.result
+
+    @property
+    def is_running(self) -> bool:
+        return self.status in (CREATED, RUNNING)
+
+    def to_dict(self) -> Dict[str, Any]:
+        """REST /3/Jobs schema-shaped summary."""
+        ms = lambda t: int(t * 1000) if t else 0
+        return {
+            "key": {"name": str(self.key), "type": "Key<Job>"},
+            "dest": {"name": str(self.dest), "type": "Key"},
+            "description": self.description,
+            "status": self.status,
+            "progress": self.progress,
+            "progress_msg": self.progress_msg,
+            "start_time": ms(self.start_time),
+            "msec": ms((self.end_time or time.time()) - self.start_time)
+            if self.start_time else 0,
+            "exception": repr(self.exception) if self.exception else None,
+        }
+
+
+class JobRegistry:
+    def __init__(self, max_workers: int = 8):
+        self._jobs: Dict[Key, Job] = {}
+        self._pool = ThreadPoolExecutor(max_workers=max_workers,
+                                        thread_name_prefix="h2o-job")
+        self._lock = threading.Lock()
+
+    def start(self, job: Job, body: Callable[[Job], Any]) -> Job:
+        with self._lock:
+            self._jobs[job.key] = job
+
+        def run():
+            job.status = RUNNING
+            job.start_time = time.time()
+            try:
+                job.result = body(job)
+                job.status = DONE
+                job.progress = 1.0
+            except JobCancelledException:
+                job.status = CANCELLED
+            except BaseException as e:  # noqa: BLE001 — propagate to joiner
+                job.status = FAILED
+                job.exception = e
+                log.error("job %s failed: %s\n%s", job.key, e,
+                          traceback.format_exc())
+            finally:
+                job.end_time = time.time()
+                job._done.set()
+
+        self._pool.submit(run)
+        return job
+
+    def run_sync(self, job: Job, body: Callable[[Job], Any]) -> Any:
+        self.start(job, body)
+        return job.join()
+
+    def get(self, key: str) -> Optional[Job]:
+        with self._lock:
+            return self._jobs.get(Key(key))
+
+    def list(self) -> list:
+        with self._lock:
+            return list(self._jobs.values())

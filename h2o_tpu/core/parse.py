@@ -1,0 +1,223 @@
+"""Ingest: CSV/ARFF/SVMLight → row-sharded Frame.
+
+Reference design (water/parser/*, SURVEY §3.2): a two-pass distributed parse —
+``ParseSetup`` sniffs separator/header/types from a sample, then
+``MultiFileParseTask`` (an MRTask over 4 MiB file chunks) tokenizes bytes into
+NewChunks with cross-chunk line stitching and a cluster barrier to merge
+categorical domains (ParseDataset.java:127,356-535,623).
+
+TPU-native redesign: files are tokenized on the HOST (columns never start on
+the device), then each column is padded + scattered into HBM in one
+``device_put`` per column.  The type-inference contract of ParseSetup and the
+sorted-domain merge of ParseDataset are preserved; the byte-level tokenizer is
+delegated to a native (C) CSV reader — currently pandas' C engine, with a
+first-party C++ tokenizer planned (see h2o_tpu/native/).  SVMLight and ARFF
+get small host parsers.
+"""
+
+from __future__ import annotations
+
+import gzip
+import io
+import os
+import re
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from h2o_tpu.core.frame import Frame, T_CAT, T_NUM, T_STR, T_TIME, Vec
+from h2o_tpu.core.log import get_logger
+
+log = get_logger("parse")
+
+_TIME_RE = re.compile(
+    r"^\d{4}-\d{2}-\d{2}([ T]\d{2}:\d{2}(:\d{2}(\.\d+)?)?)?$")
+_NA_STRINGS = ("", "NA", "N/A", "na", "NaN", "nan", "null", "NULL", "?")
+
+
+class ParseSetupResult:
+    """Sniffed parse configuration (reference: water/parser/ParseSetup.java)."""
+
+    def __init__(self, separator: str, header: bool,
+                 column_names: List[str], column_types: List[str],
+                 na_strings: Sequence[str] = _NA_STRINGS):
+        self.separator = separator
+        self.header = header
+        self.column_names = column_names
+        self.column_types = column_types
+        self.na_strings = list(na_strings)
+
+    def to_dict(self) -> dict:
+        return {
+            "separator": ord(self.separator),
+            "check_header": 1 if self.header else -1,
+            "column_names": self.column_names,
+            "column_types": [{"real": "Numeric", "enum": "Enum",
+                              "time": "Time", "string": "String"}.get(t, t)
+                             for t in self.column_types],
+        }
+
+
+def _open(path: str) -> io.TextIOBase:
+    if path.endswith(".gz"):
+        return io.TextIOWrapper(gzip.open(path, "rb"))
+    return open(path, "r")
+
+
+def _sniff_sep(sample_lines: List[str]) -> str:
+    best, best_score = ",", -1
+    for sep in (",", "\t", ";", "|", " "):
+        counts = [ln.count(sep) for ln in sample_lines if ln.strip()]
+        if not counts or min(counts) == 0:
+            continue
+        # prefer the separator with consistent, maximal column counts
+        score = min(counts) - (max(counts) - min(counts)) * 10
+        if score > best_score:
+            best, best_score = sep, score
+    return best
+
+
+def _cell_type(tok: str) -> str:
+    tok = tok.strip()
+    if tok in _NA_STRINGS:
+        return "na"
+    try:
+        float(tok)
+        return T_NUM
+    except ValueError:
+        pass
+    if _TIME_RE.match(tok):
+        return T_TIME
+    return T_CAT
+
+
+def parse_setup(paths: Sequence[str], sample_lines: int = 200
+                ) -> ParseSetupResult:
+    """Type/separator/header inference from a sample of the first file."""
+    with _open(paths[0]) as f:
+        lines = []
+        for _ in range(sample_lines):
+            ln = f.readline()
+            if not ln:
+                break
+            lines.append(ln.rstrip("\r\n"))
+    if not lines:
+        raise ValueError(f"empty file: {paths[0]}")
+    sep = _sniff_sep(lines[:50])
+    first = lines[0].split(sep)
+    rest = [ln.split(sep) for ln in lines[1:] if ln.strip()]
+    ncols = len(first)
+    # header detection: first row all-non-numeric while body has numerics
+    body_types = [[_cell_type(r[j]) for r in rest if len(r) == ncols]
+                  for j in range(ncols)]
+    first_types = [_cell_type(c) for c in first]
+    has_header = (any(t == T_CAT for t in first_types) and all(
+        t in (T_CAT, "na") for t in first_types) and any(
+        T_NUM in col for col in body_types))
+    names = ([c.strip().strip('"') for c in first] if has_header
+             else [f"C{j+1}" for j in range(ncols)])
+    types = []
+    for j in range(ncols):
+        col = body_types[j] if rest else [first_types[j]]
+        nonna = [t for t in col if t != "na"]
+        if not nonna:
+            types.append(T_NUM)
+        elif all(t == T_NUM for t in nonna):
+            types.append(T_NUM)
+        elif all(t == T_TIME for t in nonna):
+            types.append(T_TIME)
+        else:
+            types.append(T_CAT)
+    return ParseSetupResult(sep, has_header, names, types)
+
+
+def parse_file(path: str, setup: Optional[ParseSetupResult] = None,
+               dest: Optional[str] = None,
+               column_types: Optional[Dict[str, str]] = None) -> Frame:
+    return parse_files([path], setup, dest, column_types)
+
+
+def parse_files(paths: Sequence[str], setup: Optional[ParseSetupResult] = None,
+                dest: Optional[str] = None,
+                column_types: Optional[Dict[str, str]] = None) -> Frame:
+    """Parse one or more delimited files into a single sharded Frame.
+
+    Multi-file parse concatenates rows (the reference's multi-file ingest);
+    categorical domains are merged sorted across all files, matching the
+    reference's distributed domain merge (ParseDataset.java:356-535).
+    """
+    setup = setup or parse_setup(paths)
+    if column_types:
+        for name, t in column_types.items():
+            setup.column_types[setup.column_names.index(name)] = t
+    import pandas as pd
+    frames = []
+    for p in paths:
+        df = pd.read_csv(
+            p, sep=setup.separator,
+            header=0 if setup.header else None,
+            names=setup.column_names,
+            na_values=list(setup.na_strings),
+            keep_default_na=False,
+            skipinitialspace=True,
+            engine="c", dtype=object)
+        frames.append(df)
+    df = frames[0] if len(frames) == 1 else pd.concat(
+        frames, ignore_index=True)
+
+    names, vecs = [], []
+    for j, name in enumerate(setup.column_names):
+        col = df[name]
+        t = setup.column_types[j]
+        names.append(name)
+        if t == T_NUM:
+            vals = pd.to_numeric(col, errors="coerce").to_numpy(np.float32)
+            vecs.append(Vec(vals, T_NUM))
+        elif t == T_TIME:
+            ms = pd.to_datetime(col, errors="coerce").astype("int64")
+            vals = np.where(ms == np.iinfo(np.int64).min, np.nan,
+                            ms / 1e6).astype(np.float32)
+            vecs.append(Vec(vals, T_TIME))
+        elif t == T_STR:
+            vecs.append(Vec([None if v is None else str(v) for v in col],
+                            T_STR))
+        else:  # categorical: sorted global domain, -1 NA code
+            svals = col.astype("string")
+            mask = svals.isna().to_numpy()
+            arr = svals.fillna("").to_numpy(dtype=object)
+            domain = sorted(set(arr[~mask].tolist()))
+            lut = {d: i for i, d in enumerate(domain)}
+            codes = np.fromiter((lut.get(v, -1) for v in arr), np.int32,
+                                len(arr))
+            codes[mask] = -1
+            vecs.append(Vec(codes, T_CAT, domain=domain))
+    fr = Frame(names, vecs, key=dest or os.path.basename(paths[0]))
+    log.info("parsed %s: %d rows, %d cols", paths, fr.nrows, fr.ncols)
+    return fr
+
+
+def parse_svmlight(path: str, dest: Optional[str] = None) -> Frame:
+    """SVMLight sparse format (reference: water/parser/SVMLightParser)."""
+    targets, rows, max_idx = [], [], 0
+    with _open(path) as f:
+        for ln in f:
+            parts = ln.strip().split()
+            if not parts or parts[0].startswith("#"):
+                continue
+            targets.append(float(parts[0]))
+            kv = {}
+            for item in parts[1:]:
+                if item.startswith("#"):
+                    break
+                k, v = item.split(":")
+                kv[int(k)] = float(v)
+                max_idx = max(max_idx, int(k))
+            rows.append(kv)
+    dense = np.zeros((len(rows), max_idx + 1), np.float32)
+    for i, kv in enumerate(rows):
+        for k, v in kv.items():
+            dense[i, k] = v
+    names = ["target"] + [f"C{j+1}" for j in range(max_idx + 1)]
+    vecs = [Vec(np.asarray(targets, np.float32))] + [
+        Vec(dense[:, j]) for j in range(max_idx + 1)]
+    return Frame(names, vecs, key=dest or os.path.basename(path))

@@ -1,0 +1,41 @@
+"""Test harness: a virtual 8-device CPU mesh.
+
+The reference tests multi-node semantics by launching 4 extra local JVMs to
+form a real 5-node cloud on loopback (multiNodeUtils.sh:21-27, SURVEY §4).
+The TPU-native analog: force the host platform to expose 8 virtual CPU
+devices, so every sharding/collective path compiles and executes exactly as
+it would on an 8-chip slice — multi-host semantics tested on one box.
+
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8")
+# small row alignment so tiny test frames still spread over all 8 devices
+os.environ.setdefault("H2O_TPU_ROW_ALIGN", "8")
+
+# The container presets JAX_PLATFORMS=axon and a sitecustomize registers the
+# axon TPU backend at interpreter start; the env var is latched there, so the
+# only effective override is the config API — must happen before any backend
+# is initialized.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cl():
+    from h2o_tpu.core.cloud import Cloud
+    return Cloud.boot()
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)
