@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Benchmark entry point (driver contract).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Benchmark: GBM training throughput on a synthetic HIGGS-shaped dataset
+(28 numeric features, binary response) — the reference's north-star config
+(BASELINE.md: GBM rows/sec on HIGGS).  Throughput counts total row-scans:
+nrows * ntrees / wall_s, the convention used for H2O GBM benchmarks.
+
+The reference repo publishes no absolute numbers (BASELINE.json
+published: {}), so vs_baseline is reported against the recorded result of
+the previous round when available (bench_baseline.json), else 1.0.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
+    cols = int(os.environ.get("BENCH_COLS", 28))
+    trees = int(os.environ.get("BENCH_TREES", 20))
+    depth = int(os.environ.get("BENCH_DEPTH", 5))
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(rows, cols)).astype(np.float32)
+    # HIGGS-like signal: nonlinear combination of a few features
+    logits = (1.2 * X[:, 0] - 0.8 * X[:, 1] + X[:, 2] * X[:, 3]
+              + 0.5 * np.sin(3 * X[:, 4]))
+    y = (rng.uniform(size=rows) < 1 / (1 + np.exp(-logits))).astype(np.int32)
+
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT
+    from h2o_tpu.models.tree.gbm import GBM
+
+    names = [f"x{j}" for j in range(cols)] + ["y"]
+    vecs = [Vec(X[:, j]) for j in range(cols)] + \
+        [Vec(y, T_CAT, domain=["b", "s"])]
+    fr = Frame(names, vecs)
+
+    # warm-up: compile the full train program on a small slice shape-wise
+    # identical per-level jits are cached by (L, B, C) so the timed run below
+    # reuses them for levels it shares
+    t0 = time.time()
+    model = GBM(ntrees=trees, max_depth=depth, learn_rate=0.1, seed=1,
+                nbins=64).train(y="y", training_frame=fr)
+    wall = time.time() - t0
+
+    value = rows * trees / wall
+    auc = model.output["training_metrics"]["AUC"]
+
+    base_path = os.path.join(os.path.dirname(__file__),
+                             "bench_baseline.json")
+    vs = 1.0
+    if os.path.exists(base_path):
+        with open(base_path) as f:
+            prev = json.load(f)
+        if prev.get("value"):
+            vs = value / prev["value"]
+
+    print(json.dumps({
+        "metric": "gbm_higgs_like_train_throughput",
+        "value": round(value, 1),
+        "unit": "rows*trees/sec",
+        "vs_baseline": round(vs, 3),
+        "detail": {"rows": rows, "cols": cols, "ntrees": trees,
+                   "max_depth": depth, "wall_s": round(wall, 2),
+                   "train_auc": round(float(auc), 4)},
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,5 +21,21 @@ TPU hardware:
 
 __version__ = "0.1.0"
 
-from h2o_tpu.core.cloud import Cloud, cloud  # noqa: F401
-from h2o_tpu.core.frame import Frame, Vec  # noqa: F401
+import os as _os
+
+import jax as _jax
+
+# Persistent XLA compilation cache: tree building compiles one program per
+# (level, shape) and re-runs them across trees/models/processes; caching them
+# on disk removes the dominant cold-start cost (first TPU compile is ~20-40s).
+_cache_dir = _os.environ.get("H2O_TPU_COMPILE_CACHE",
+                             _os.path.expanduser("~/.h2o_tpu_jax_cache"))
+if _cache_dir and _cache_dir != "0":
+    try:
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # older jax without these flags
+        pass
+
+from h2o_tpu.core.cloud import Cloud, cloud  # noqa: F401,E402
+from h2o_tpu.core.frame import Frame, Vec  # noqa: F401,E402

@@ -1,0 +1,485 @@
+"""REST v3 route handlers (reference: water/api/*Handler.java + schemas3/).
+
+Response shapes follow the v3 schemas (keys wrapped as {"name": ...},
+__meta.schema_type, frames/models/jobs arrays) closely enough for
+schema-driven clients; field coverage grows with the framework.
+"""
+
+from __future__ import annotations
+
+import glob as globmod
+import json
+import os
+import time
+from typing import Dict, List
+
+import numpy as np
+
+from h2o_tpu import __version__
+from h2o_tpu.core.cloud import cloud
+from h2o_tpu.core.frame import Frame
+from h2o_tpu.core.job import Job
+from h2o_tpu.core.log import recent_lines
+from h2o_tpu.core.parse import parse_files, parse_setup
+from h2o_tpu.models.model import Model
+from h2o_tpu.models.registry import builder_class, builders
+from h2o_tpu.api.server import H2OError, route
+from h2o_tpu.rapids import Session, rapids_exec
+
+_SESSIONS: Dict[str, Session] = {}
+_START_TIME = time.time()
+
+
+def _key(name, tpe="Key"):
+    return {"name": str(name), "type": tpe, "URL": None}
+
+
+# ---------------------------------------------------------------------------
+# cloud / admin
+# ---------------------------------------------------------------------------
+
+@route("GET", r"/(?:3|4)/Cloud(?:\.json)?")
+def cloud_status(params):
+    c = cloud()
+    return {
+        "__meta": {"schema_version": 3, "schema_name": "CloudV3",
+                   "schema_type": "Iced"},
+        "version": __version__,
+        "branch_name": "tpu",
+        "build_number": "0",
+        "build_age": "0 days",
+        "build_too_old": False,
+        "cloud_name": c.args.name,
+        "cloud_size": c.n_nodes,
+        "cloud_uptime_millis": int((time.time() - _START_TIME) * 1000),
+        "cloud_healthy": True,
+        "consensus": True,
+        "locked": True,
+        "is_client": False,
+        "internal_security_enabled": False,
+        "nodes": [{
+            "h2o": f"tpu-{i}", "ip_port": f"device:{i}", "healthy": True,
+            "last_ping": int(time.time() * 1000), "pid": os.getpid(),
+            "num_cpus": 1, "cpus_allowed": 1, "nthreads": 1,
+            "my_cpu_pct": -1, "sys_cpu_pct": -1,
+            "mem_value_size": 0, "free_mem": 0, "pojo_mem": 0, "swap_mem": 0,
+            "num_keys": len(c.dkv.keys()),
+            "max_mem": 0, "sys_load": -1.0,
+        } for i in range(c.n_nodes)],
+        "bad_nodes": 0,
+        "skip_ticks": False,
+    }
+
+
+@route("GET", r"/3/About")
+def about(params):
+    return {"entries": [
+        {"name": "Build project version", "value": __version__},
+        {"name": "Backend", "value": "jax/XLA TPU"},
+    ]}
+
+
+@route("GET", r"/3/Logs/nodes/(?P<node>[^/]+)/files/(?P<file>[^/]+)")
+def logs(params, node, file):
+    return {"log": "\n".join(recent_lines())}
+
+
+@route("POST", r"/3/Shutdown")
+def shutdown(params):
+    return {}
+
+
+@route("GET", r"/3/Metadata/endpoints")
+def endpoints(params):
+    from h2o_tpu.api.server import _ROUTES
+    return {"routes": [{"http_method": m, "url_pattern": rx.pattern,
+                        "handler": fn.__name__} for m, rx, fn in _ROUTES]}
+
+
+@route("POST", r"/3/InitID")
+@route("GET", r"/3/InitID")
+def init_id(params):
+    sid = f"_sid{len(_SESSIONS) + 1:04d}"
+    _SESSIONS[sid] = Session(sid)
+    return {"session_key": sid}
+
+
+@route("DELETE", r"/3/InitID")
+def end_session(params):
+    return {}
+
+
+# ---------------------------------------------------------------------------
+# ingest
+# ---------------------------------------------------------------------------
+
+@route("GET", r"/3/ImportFiles")
+@route("POST", r"/3/ImportFiles")
+def import_files(params):
+    path = params.get("path")
+    if not path:
+        raise H2OError(400, "path is required")
+    matches = sorted(globmod.glob(path)) if any(ch in path for ch in "*?") \
+        else ([path] if os.path.exists(path) else [])
+    if not matches:
+        raise H2OError(404, f"no files at {path}")
+    for p in matches:
+        cloud().dkv.put(f"nfs://{p}", p)
+    return {"files": matches, "destination_frames":
+            [f"nfs://{p}" for p in matches], "fails": [], "dels": []}
+
+
+@route("POST", r"/3/ParseSetup")
+def parse_setup_route(params):
+    src = params.get("source_frames")
+    if isinstance(src, str):
+        src = json.loads(src.replace("'", '"')) if src.startswith("[") \
+            else [src]
+    paths = [cloud().dkv.get(s) or s.replace("nfs://", "") for s in src]
+    setup = parse_setup(paths)
+    d = setup.to_dict()
+    d.update({
+        "source_frames": [_key(s, "Key<Frame>") for s in src],
+        "destination_frame": os.path.basename(paths[0]).replace(".", "_")
+        + ".hex",
+        "number_columns": len(setup.column_names),
+        "parse_type": "CSV",
+        "chunk_size": 4 * 1024 * 1024,
+    })
+    return d
+
+
+@route("POST", r"/3/Parse")
+def parse_route(params):
+    src = params.get("source_frames")
+    if isinstance(src, str):
+        src = json.loads(src.replace("'", '"')) if src.startswith("[") \
+            else [src]
+    paths = [cloud().dkv.get(s) or s.replace("nfs://", "") for s in src]
+    dest = params.get("destination_frame") or \
+        os.path.basename(paths[0]) + ".hex"
+    job = Job(dest=dest, description=f"Parse {paths}")
+
+    def body(j):
+        fr = parse_files(paths, dest=dest)
+        cloud().dkv.put(dest, fr)
+        return fr
+
+    cloud().jobs.start(job, body)
+    job.join()  # parse is fast enough to be synchronous under the hood
+    return {"job": job.to_dict(), "destination_frame": _key(dest,
+                                                            "Key<Frame>")}
+
+
+# ---------------------------------------------------------------------------
+# frames
+# ---------------------------------------------------------------------------
+
+def _frame_schema(fr: Frame, rows: int = 10, column_offset: int = 0,
+                  column_count: int = -1) -> dict:
+    ncols = fr.ncols
+    if column_count <= 0:
+        column_count = ncols
+    cols = []
+    for j in range(column_offset, min(column_offset + column_count, ncols)):
+        v = fr.vecs[j]
+        n_head = min(rows, v.nrows)
+        # slice ON DEVICE before the host transfer — a preview must not pull
+        # the whole sharded column to host
+        head = (np.asarray(v.data[:n_head]) if v.data is not None
+                else np.asarray(v.host_data[:n_head], dtype=object))
+        if v.is_categorical:
+            data = [None if x < 0 else int(x) for x in head]
+        else:
+            data = [None if (isinstance(x, float) and np.isnan(x))
+                    else float(x) for x in head.astype(float)]
+        r = v.rollups if (v.is_numeric or v.is_categorical) else None
+        cols.append({
+            "__meta": {"schema_type": "Vec"},
+            "label": fr.names[j],
+            "type": {"enum": "enum", "real": "real", "time": "time",
+                     "string": "string"}.get(v.type, v.type),
+            "missing_count": v.nacnt() if r else 0,
+            "zero_count": int(r.zeros) if r else 0,
+            "positive_infinity_count": 0, "negative_infinity_count": 0,
+            "mins": [float(r.min)] if r else [],
+            "maxs": [float(r.max)] if r else [],
+            "mean": float(r.mean) if r else None,
+            "sigma": float(r.sigma) if r else None,
+            "domain": v.domain, "domain_cardinality": v.cardinality,
+            "data": data, "string_data": [], "precision": -1,
+            "histogram_bins": r.hist.tolist() if r else [],
+            "histogram_base": float(r.min) if r else 0,
+            "histogram_stride": float((r.max - r.min) / max(len(r.hist), 1))
+            if r else 0,
+        })
+    return {
+        "__meta": {"schema_version": 3, "schema_name": "FrameV3",
+                   "schema_type": "Frame"},
+        "frame_id": _key(fr.key, "Key<Frame>"),
+        "byte_size": int(fr.nrows * fr.ncols * 4),
+        "is_text": False,
+        "row_offset": 0, "row_count": min(rows, fr.nrows),
+        "column_offset": column_offset, "column_count": len(cols),
+        "total_column_count": ncols,
+        "checksum": 0,
+        "rows": fr.nrows, "num_columns": ncols,
+        "default_percentiles": [0.01, 0.1, 0.25, 0.333, 0.5, 0.667, 0.75,
+                                0.9, 0.99],
+        "columns": cols,
+        "compatible_models": [],
+        "chunk_summary": {}, "distribution_summary": {},
+    }
+
+
+@route("GET", r"/3/Frames")
+def list_frames(params):
+    dkv = cloud().dkv
+    frames = [dkv.get(k) for k in dkv.keys()
+              if isinstance(dkv.get(k), Frame)]
+    return {"frames": [_frame_schema(f, rows=0) for f in frames]}
+
+
+@route("GET", r"/3/Frames/(?P<frame_id>[^/]+)")
+def get_frame(params, frame_id):
+    fr = cloud().dkv.get(frame_id)
+    if not isinstance(fr, Frame):
+        raise H2OError(404, f"frame {frame_id} not found")
+    rows = int(params.get("row_count", 10) or 10)
+    return {"frames": [_frame_schema(
+        fr, rows=rows, column_offset=int(params.get("column_offset", 0)),
+        column_count=int(params.get("column_count", -1)))]}
+
+
+@route("GET", r"/3/Frames/(?P<frame_id>[^/]+)/summary")
+def frame_summary(params, frame_id):
+    return get_frame(params, frame_id)
+
+
+@route("DELETE", r"/3/Frames/(?P<frame_id>[^/]+)")
+def delete_frame(params, frame_id):
+    cloud().dkv.remove(frame_id)
+    return {}
+
+
+@route("DELETE", r"/3/DKV/(?P<key>[^/]+)")
+def delete_key(params, key):
+    cloud().dkv.remove(key)
+    return {}
+
+
+@route("POST", r"/99/Rapids")
+@route("POST", r"/3/Rapids")
+def rapids_route(params):
+    ast = params.get("ast")
+    sid = params.get("session_id", "_default")
+    sess = _SESSIONS.setdefault(sid, Session(sid))
+    result = rapids_exec(ast, sess)
+    if result is None:
+        return {"key": None}
+    if isinstance(result, Frame):
+        return {"key": _key(result.key, "Key<Frame>"),
+                "num_rows": result.nrows, "num_cols": result.ncols}
+    if isinstance(result, (int, float)):
+        return {"scalar": float(result)}
+    if isinstance(result, list):
+        if result and isinstance(result[0], tuple):
+            return {"string": str([x[1] for x in result])}
+        return {"scalar": None, "funstr": None,
+                "numlist": [float(x) for x in result]}
+    return {"string": str(result)}
+
+
+# ---------------------------------------------------------------------------
+# model builders / models / predictions
+# ---------------------------------------------------------------------------
+
+@route("GET", r"/3/ModelBuilders")
+def list_builders(params):
+    out = {}
+    for name, cls in builders().items():
+        out[name] = {"algo": name, "algo_full_name": cls.algo,
+                     "can_build": ["ALL"], "visibility": "Stable"}
+    return {"model_builders": out}
+
+
+def _coerce(val, default):
+    if default is None:
+        # untyped param (e.g. lambda_/alpha default None): numbers parse,
+        # everything else passes through
+        try:
+            return float(val)
+        except (TypeError, ValueError):
+            return val
+    if isinstance(default, bool):
+        return str(val).lower() in ("1", "true", "yes")
+    if isinstance(default, (int, float)) and not isinstance(default, bool):
+        return type(default)(float(val))
+    if isinstance(default, (list, tuple)):
+        if isinstance(val, str):
+            v = val.strip("[]")
+            return [float(x) if x.strip().replace(".", "").replace(
+                "-", "").isdigit() else x.strip().strip("'\"")
+                for x in v.split(",") if x.strip()]
+        return val
+    return val
+
+
+@route("POST", r"/3/ModelBuilders/(?P<algo>[^/]+)")
+def build_model(params, algo):
+    try:
+        cls = builder_class(algo)
+    except KeyError:
+        raise H2OError(404, f"unknown algorithm {algo}")
+    train_key = params.get("training_frame")
+    fr = cloud().dkv.get(train_key)
+    if not isinstance(fr, Frame):
+        raise H2OError(404, f"training_frame {train_key} not found")
+    valid = cloud().dkv.get(params.get("validation_frame")) \
+        if params.get("validation_frame") else None
+    b = cls()
+    # REST schema names that differ from builder keys (v3 'lambda' is a
+    # Python keyword on our side)
+    aliases = {"lambda": "lambda_"}
+    for k, v in params.items():
+        if k in ("training_frame", "validation_frame", "model_id",
+                 "response_column", "ignored_columns"):
+            continue
+        k = aliases.get(k, k)
+        if k in b.params:
+            b.params[k] = _coerce(v, b.params[k])
+    if params.get("model_id"):
+        b.model_id = params["model_id"]
+    y = params.get("response_column")
+    x = None
+    if params.get("ignored_columns"):
+        ign = _coerce(params["ignored_columns"], [])
+        x = [c for c in fr.names if c not in ign and c != y]
+    job = b.train_async(x=x, y=y, training_frame=fr,
+                        validation_frame=valid)
+    return {"job": job.to_dict(),
+            "messages": [], "error_count": 0,
+            "parameters": {k: v for k, v in b.params.items()
+                           if not str(k).startswith("_")}}
+
+
+def _metrics_dict(m):
+    if m is None:
+        return None
+    d = {"__meta": {"schema_type": "ModelMetrics"},
+         "model_category": m.kind.capitalize()}
+    for k, v in m.data.items():
+        if isinstance(v, np.ndarray):
+            d[k] = v.tolist()
+        elif isinstance(v, dict):
+            d[k] = v
+        else:
+            d[k] = v
+    return d
+
+
+def _model_schema(m: Model) -> dict:
+    out = m.output
+    return {
+        "__meta": {"schema_version": 3, "schema_name": "ModelSchemaV3"},
+        "model_id": _key(m.key, "Key<Model>"),
+        "algo": m.algo, "algo_full_name": m.algo,
+        "response_column_name": m.params.get("response_column"),
+        "data_frame": _key(m.params.get("training_frame", ""),
+                           "Key<Frame>"),
+        "timestamp": 0,
+        "parameters": [{"name": k, "actual_value": v if not isinstance(
+            v, np.ndarray) else v.tolist()}
+            for k, v in m.params.items() if not str(k).startswith("_")],
+        "output": {
+            "model_category": ("Binomial" if out.get("response_domain") and
+                               len(out["response_domain"]) == 2 else
+                               "Multinomial" if out.get("response_domain")
+                               else "Regression"),
+            "training_metrics": _metrics_dict(
+                out.get("training_metrics")),
+            "validation_metrics": _metrics_dict(
+                out.get("validation_metrics")),
+            "variable_importances": None,
+            "names": out.get("x", []),
+            "domains": [],
+            "status": "DONE",
+            "run_time": m.run_time_ms,
+        },
+    }
+
+
+@route("GET", r"/3/Models")
+def list_models(params):
+    dkv = cloud().dkv
+    models = [dkv.get(k) for k in dkv.keys()
+              if isinstance(dkv.get(k), Model)]
+    return {"models": [_model_schema(m) for m in models]}
+
+
+@route("GET", r"/3/Models/(?P<model_id>[^/]+)")
+def get_model(params, model_id):
+    m = cloud().dkv.get(model_id)
+    if not isinstance(m, Model):
+        raise H2OError(404, f"model {model_id} not found")
+    return {"models": [_model_schema(m)]}
+
+
+@route("DELETE", r"/3/Models/(?P<model_id>[^/]+)")
+def delete_model(params, model_id):
+    cloud().dkv.remove(model_id)
+    return {}
+
+
+@route("POST", r"/3/Predictions/models/(?P<model_id>[^/]+)/frames/"
+               r"(?P<frame_id>[^/]+)")
+def predict(params, model_id, frame_id):
+    m = cloud().dkv.get(model_id)
+    fr = cloud().dkv.get(frame_id)
+    if not isinstance(m, Model):
+        raise H2OError(404, f"model {model_id} not found")
+    if not isinstance(fr, Frame):
+        raise H2OError(404, f"frame {frame_id} not found")
+    dest = params.get("predictions_frame") or f"predictions_{model_id}" \
+        f"_{frame_id}"
+    pf = m.predict(fr)
+    pf.key = dest
+    cloud().dkv.put(dest, pf)
+    return {"predictions_frame": _key(dest, "Key<Frame>"),
+            "model_metrics": []}
+
+
+@route("POST", r"/3/ModelMetrics/models/(?P<model_id>[^/]+)/frames/"
+               r"(?P<frame_id>[^/]+)")
+def model_metrics(params, model_id, frame_id):
+    m = cloud().dkv.get(model_id)
+    fr = cloud().dkv.get(frame_id)
+    if not isinstance(m, Model) or not isinstance(fr, Frame):
+        raise H2OError(404, "model or frame not found")
+    return {"model_metrics": [_metrics_dict(m.model_metrics(fr))]}
+
+
+# ---------------------------------------------------------------------------
+# jobs
+# ---------------------------------------------------------------------------
+
+@route("GET", r"/3/Jobs")
+def list_jobs(params):
+    return {"jobs": [j.to_dict() for j in cloud().jobs.list()]}
+
+
+@route("GET", r"/3/Jobs/(?P<job_id>[^/]+)")
+def get_job(params, job_id):
+    j = cloud().jobs.get(job_id)
+    if j is None:
+        raise H2OError(404, f"job {job_id} not found")
+    return {"jobs": [j.to_dict()]}
+
+
+@route("POST", r"/3/Jobs/(?P<job_id>[^/]+)/cancel")
+def cancel_job(params, job_id):
+    j = cloud().jobs.get(job_id)
+    if j is None:
+        raise H2OError(404, f"job {job_id} not found")
+    j.cancel()
+    return {}

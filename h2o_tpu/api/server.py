@@ -1,0 +1,143 @@
+"""REST v3 API server.
+
+Reference (water/api/*, SURVEY §2.1): RequestServer.java:23-80 dispatches a
+route tree to Handler subclasses with Schema <-> impl translation, versioned
+v3/v4/v99, ~150 routes, served by an embedded Jetty.
+
+TPU-native: a stdlib ThreadingHTTPServer (no external deps) with the same
+route shapes and JSON schema field names, so REST-level clients (curl,
+Flow-style UIs, and eventually unmodified h2o-py) talk to the TPU cloud the
+way they talk to an H2O node.  Handlers live in h2o_tpu/api/handlers.py.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, unquote, urlparse
+
+from h2o_tpu.core.cloud import cloud
+from h2o_tpu.core.log import get_logger
+
+log = get_logger("api")
+
+# route table: (method, regex, handler_name)
+_ROUTES: List[Tuple[str, re.Pattern, Callable]] = []
+
+
+def route(method: str, pattern: str):
+    """Register a handler for e.g. ("GET", r"/3/Frames/(?P<frame_id>[^/]+)")."""
+    rx = re.compile("^" + pattern + "$")
+
+    def deco(fn):
+        _ROUTES.append((method, rx, fn))
+        return fn
+    return deco
+
+
+class H2OError(Exception):
+    def __init__(self, status: int, msg: str):
+        super().__init__(msg)
+        self.status = status
+        self.msg = msg
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "h2o-tpu"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet; route through our logger
+        log.debug("%s %s", self.address_string(), fmt % args)
+
+    def _params(self) -> Dict[str, str]:
+        q = parse_qs(urlparse(self.path).query)
+        out = {k: v[0] for k, v in q.items()}
+        length = int(self.headers.get("Content-Length") or 0)
+        if length:
+            body = self.rfile.read(length).decode()
+            ctype = self.headers.get("Content-Type", "")
+            if "json" in ctype:
+                try:
+                    out.update(json.loads(body))
+                except json.JSONDecodeError:
+                    pass
+            else:
+                out.update({k: v[0] for k, v in parse_qs(body).items()})
+        return out
+
+    def _dispatch(self, method: str):
+        path = unquote(urlparse(self.path).path)
+        for m, rx, fn in _ROUTES:
+            if m != method:
+                continue
+            match = rx.match(path)
+            if match:
+                try:
+                    result = fn(self._params(), **match.groupdict())
+                    self._send(200, result if result is not None else {})
+                except H2OError as e:
+                    self._send(e.status, {
+                        "__meta": {"schema_type": "H2OError"},
+                        "error_url": path, "msg": e.msg,
+                        "dev_msg": e.msg, "http_status": e.status,
+                        "exception_msg": e.msg, "values": {}})
+                except Exception as e:  # noqa: BLE001 — REST surface
+                    log.error("handler error on %s: %s\n%s", path, e,
+                              traceback.format_exc())
+                    self._send(500, {
+                        "__meta": {"schema_type": "H2OError"},
+                        "msg": str(e), "dev_msg": traceback.format_exc(),
+                        "http_status": 500, "exception_msg": str(e),
+                        "values": {}})
+                return
+        self._send(404, {"msg": f"no route for {method} {path}",
+                         "http_status": 404})
+
+    def _send(self, status: int, payload: dict):
+        blob = json.dumps(payload, allow_nan=True).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(blob)))
+        self.end_headers()
+        self.wfile.write(blob)
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+    def do_HEAD(self):
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class RestServer:
+    """The embedded web server (H2O.startNetworkServices analog)."""
+
+    def __init__(self, port: Optional[int] = None, ip: str = "127.0.0.1"):
+        import h2o_tpu.api.handlers  # noqa: F401 — registers routes
+        self.port = port if port is not None else cloud().args.port
+        self.ip = ip
+        self.httpd = ThreadingHTTPServer((ip, self.port), _Handler)
+        self.port = self.httpd.server_port
+        self.thread: Optional[threading.Thread] = None
+
+    def start(self) -> "RestServer":
+        self.thread = threading.Thread(target=self.httpd.serve_forever,
+                                       name="h2o-rest", daemon=True)
+        self.thread.start()
+        log.info("REST server on http://%s:%d", self.ip, self.port)
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

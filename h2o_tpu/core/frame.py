@@ -53,48 +53,64 @@ def _row_pad(n: int) -> int:
     return ((n + q - 1) // q) * q
 
 
-@functools.partial(jax.jit, static_argnames=("nbins",))
-def _rollups_kernel(data: jax.Array, nrows: jax.Array, nbins: int = 64):
-    """Fused single-pass rollup stats over one padded, sharded column.
+@jax.jit
+def _rollups_matrix_kernel(matrix: jax.Array, nrows: jax.Array):
+    """Fused single-pass rollup stats over ALL columns of a padded, sharded
+    (rows, cols) matrix at once.
 
-    Equivalent of the RollupStats MRTask (water/fvec/RollupStats.java): the
-    row-sharded input makes every reduction below an ICI psum inserted by XLA.
+    Equivalent of the RollupStats MRTask (water/fvec/RollupStats.java), but
+    batched column-wise: the reference computes rollups one Vec at a time
+    (one MRTask each); here one XLA program covers the whole frame, and the
+    row sharding makes every axis-0 reduction an ICI psum.
     """
-    idx = jnp.arange(data.shape[0])
+    idx = jnp.arange(matrix.shape[0])[:, None]
     valid = idx < nrows
-    isna = jnp.isnan(data) & valid
+    isna = jnp.isnan(matrix) & valid
     ok = valid & ~isna
-    x = jnp.where(ok, data, 0.0)
-    cnt = jnp.sum(ok)
-    nacnt = jnp.sum(isna)
-    s = jnp.sum(x)
-    mean = s / jnp.maximum(cnt, 1)
-    var = jnp.sum(jnp.where(ok, (data - mean) ** 2, 0.0)) / jnp.maximum(
-        cnt - 1, 1)
-    big = jnp.asarray(jnp.inf, data.dtype)
-    vmin = jnp.min(jnp.where(ok, data, big))
-    vmax = jnp.max(jnp.where(ok, data, -big))
-    zeros = jnp.sum(ok & (data == 0))
-    isint = jnp.all(jnp.where(ok, data == jnp.round(data), True))
-    # fixed-width histogram between min and max (for quantiles/binning)
+    x = jnp.where(ok, matrix, 0.0)
+    cnt = jnp.sum(ok, axis=0)
+    nacnt = jnp.sum(isna, axis=0)
+    mean = jnp.sum(x, axis=0) / jnp.maximum(cnt, 1)
+    var = jnp.sum(jnp.where(ok, (matrix - mean[None, :]) ** 2, 0.0),
+                  axis=0) / jnp.maximum(cnt - 1, 1)
+    big = jnp.asarray(jnp.inf, matrix.dtype)
+    vmin = jnp.min(jnp.where(ok, matrix, big), axis=0)
+    vmax = jnp.max(jnp.where(ok, matrix, -big), axis=0)
+    zeros = jnp.sum(ok & (matrix == 0), axis=0)
+    isint = jnp.all(jnp.where(ok, matrix == jnp.round(matrix), True),
+                    axis=0)
+    return dict(cnt=cnt, nacnt=nacnt, mean=mean, sigma=jnp.sqrt(var),
+                min=vmin, max=vmax, zeros=zeros, isint=isint)
+
+
+@functools.partial(jax.jit, static_argnames=("nbins",))
+def _hist_kernel(data: jax.Array, nrows: jax.Array, vmin, vmax,
+                 nbins: int = 64):
+    """Lazy fixed-width histogram for one column (REST frame summaries)."""
+    idx = jnp.arange(data.shape[0])
+    ok = (idx < nrows) & ~jnp.isnan(data)
     span = jnp.maximum(vmax - vmin, 1e-30)
     b = jnp.clip(((data - vmin) / span * nbins).astype(jnp.int32), 0,
                  nbins - 1)
-    hist = jnp.zeros((nbins,), jnp.int32).at[b].add(ok.astype(jnp.int32))
-    return dict(cnt=cnt, nacnt=nacnt, mean=mean, sigma=jnp.sqrt(var),
-                min=vmin, max=vmax, zeros=zeros, isint=isint, hist=hist)
+    return jnp.zeros((nbins,), jnp.int32).at[b].add(ok.astype(jnp.int32))
 
 
 class RollupStats:
-    """Materialized rollups for one Vec."""
+    """Materialized rollups for one Vec (histogram computed lazily)."""
 
     __slots__ = ("cnt", "nacnt", "mean", "sigma", "min", "max", "zeros",
-                 "isint", "hist")
+                 "isint", "_vec")
 
-    def __init__(self, d: dict):
+    def __init__(self, d: dict, vec: "Vec" = None):
         for k in self.__slots__:
-            v = np.asarray(d[k])
-            setattr(self, k, v if k == "hist" else v.item())
+            if k == "_vec":
+                continue
+            setattr(self, k, np.asarray(d[k]).item())
+        self._vec = vec
+
+    @property
+    def hist(self) -> np.ndarray:
+        return self._vec.histogram()
 
 
 class Vec:
@@ -105,6 +121,7 @@ class Vec:
         self.type = vtype
         self.domain = domain
         self._rollups: Optional[RollupStats] = None
+        self._hist: Optional[np.ndarray] = None
         if vtype in (T_STR, T_UUID):
             self.host_data: List = list(data)
             self.nrows = len(self.host_data)
@@ -161,11 +178,19 @@ class Vec:
     @property
     def rollups(self) -> RollupStats:
         if self._rollups is None:
+            d = _rollups_matrix_kernel(self.as_float()[:, None],
+                                       jnp.int32(self.nrows))
             self._rollups = RollupStats(
-                jax.tree.map(np.asarray,
-                             _rollups_kernel(self.as_float(),
-                                             jnp.int32(self.nrows))))
+                {k: np.asarray(v)[0] for k, v in d.items()}, vec=self)
         return self._rollups
+
+    def histogram(self, nbins: int = 64) -> np.ndarray:
+        r = self.rollups
+        if self._hist is None or len(self._hist) != nbins:
+            self._hist = np.asarray(_hist_kernel(
+                self.as_float(), jnp.int32(self.nrows),
+                jnp.float32(r.min), jnp.float32(r.max), nbins))
+        return self._hist
 
     def mean(self) -> float:
         return self.rollups.mean
@@ -188,6 +213,7 @@ class Vec:
 
     def invalidate(self) -> None:
         self._rollups = None
+        self._hist = None
 
 
 class Frame:
@@ -300,6 +326,23 @@ class Frame:
     def row_mask(self) -> jax.Array:
         """Validity predicate over padded rows."""
         return jnp.arange(self.padded_rows) < self.nrows
+
+    def fill_rollups(self, names: Optional[Sequence[str]] = None) -> None:
+        """Batch-compute rollups for all (named) device columns in ONE
+        kernel call and populate each Vec's cache — the fast path DataInfo
+        uses instead of 1 dispatch per column."""
+        names = list(names) if names is not None else self.names
+        todo = [n for n in names
+                if self.vec(n)._rollups is None and
+                self.vec(n).data is not None]
+        if not todo:
+            return
+        m = self.as_matrix(todo)
+        d = jax.tree.map(np.asarray,
+                         _rollups_matrix_kernel(m, jnp.int32(self.nrows)))
+        for j, n in enumerate(todo):
+            v = self.vec(n)
+            v._rollups = RollupStats({k: d[k][j] for k in d}, vec=v)
 
     # -- misc --------------------------------------------------------------
 

@@ -1,0 +1,269 @@
+"""DeepLearning — multi-layer perceptron (+ autoencoder), H2O semantics.
+
+Reference (hex/deeplearning/**, SURVEY §3.4): per-node Hogwild SGD over local
+chunks with cross-node model averaging each iteration
+(DeepLearningTask.java:17-70); Neurons subclasses implement fprop/bprop with
+Rectifier/Tanh/Maxout (+Dropout) activations, ADADELTA (rho/epsilon) or
+rate/momentum updates, L1/L2, input dropout (Neurons.java:184-430).
+
+TPU-native redesign: fprop/bprop is ``jax.grad`` over a batched MLP — the MXU
+gets full GEMMs instead of per-row gemv (HOT LOOP #2) — and the Hogwild +
+averaging scheme becomes synchronous data-parallel mean gradients (psum over
+the row sharding), a behavioral superset with the same convergence contract
+(SURVEY §7 translation table).  ADADELTA state and update semantics follow
+the reference (rho=0.99, epsilon=1e-8 defaults).  Weights can shard over the
+mesh's ``model`` axis for tensor parallelism on wide layers (the reference
+has no TP; DL weights are replicated per node there).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o_tpu.core.cloud import cloud
+from h2o_tpu.core.frame import Frame
+from h2o_tpu.models.distributions import get_distribution
+from h2o_tpu.models.glm import expand_for_scoring, expansion_spec
+from h2o_tpu.models.model import DataInfo, Model, ModelBuilder
+
+EPS = 1e-10
+
+
+def _act(name: str):
+    name = name.lower().replace("withdropout", "")
+    return {"rectifier": jax.nn.relu, "tanh": jnp.tanh,
+            "maxout": jax.nn.relu}[name]  # maxout approximated by relu
+
+
+def init_params(key, layer_sizes: List[int], dist: str = "uniform_adaptive"):
+    """UniformAdaptive init (reference Neurons.java randomize): U(+-sqrt(6/(fan_in+fan_out)))."""
+    params = []
+    for i in range(len(layer_sizes) - 1):
+        key, sub = jax.random.split(key)
+        fan_in, fan_out = layer_sizes[i], layer_sizes[i + 1]
+        lim = np.sqrt(6.0 / (fan_in + fan_out))
+        W = jax.random.uniform(sub, (fan_in, fan_out), jnp.float32,
+                               -lim, lim)
+        b = jnp.zeros((fan_out,), jnp.float32)
+        params.append({"W": W, "b": b})
+    return params
+
+
+def mlp_forward(params, X, activation, dropout_key=None,
+                input_dropout=0.0, hidden_dropout=0.0):
+    h = X
+    if dropout_key is not None and input_dropout > 0:
+        dropout_key, sub = jax.random.split(dropout_key)
+        keep = jax.random.bernoulli(sub, 1 - input_dropout, h.shape)
+        h = jnp.where(keep, h / (1 - input_dropout), 0.0)
+    act = _act(activation)
+    for i, layer in enumerate(params):
+        h = h @ layer["W"] + layer["b"]
+        if i < len(params) - 1:
+            h = act(h)
+            if dropout_key is not None and hidden_dropout > 0:
+                dropout_key, sub = jax.random.split(dropout_key)
+                keep = jax.random.bernoulli(sub, 1 - hidden_dropout, h.shape)
+                h = jnp.where(keep, h / (1 - hidden_dropout), 0.0)
+    return h
+
+
+def _loss_fn(params, X, y, w, activation, nclass: int, dist_name: str,
+             l1: float, l2: float, dropout_key, input_dropout,
+             hidden_dropout):
+    out = mlp_forward(params, X, activation, dropout_key, input_dropout,
+                      hidden_dropout)
+    wsum = jnp.maximum(jnp.sum(w), EPS)
+    if nclass >= 2:
+        logp = jax.nn.log_softmax(out, axis=1)
+        yi = jnp.clip(y.astype(jnp.int32), 0, nclass - 1)
+        ce = -jnp.take_along_axis(logp, yi[:, None], axis=1)[:, 0]
+        loss = jnp.sum(w * ce) / wsum
+    else:
+        dist = get_distribution(dist_name)
+        f = out[:, 0]
+        loss = jnp.sum(dist.deviance(w, y, f)) / wsum
+    if l1 > 0 or l2 > 0:
+        for layer in params:
+            loss = loss + l1 * jnp.sum(jnp.abs(layer["W"])) + \
+                0.5 * l2 * jnp.sum(layer["W"] ** 2)
+    return loss
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("activation", "nclass", "dist_name",
+                                    "rho", "epsilon", "l1", "l2",
+                                    "input_dropout", "hidden_dropout"))
+def train_step_adadelta(params, estate, X, y, w, key, activation: str,
+                        nclass: int, dist_name: str, rho: float = 0.99,
+                        epsilon: float = 1e-8, l1: float = 0.0,
+                        l2: float = 0.0, input_dropout: float = 0.0,
+                        hidden_dropout: float = 0.0):
+    """One ADADELTA step (reference Neurons.java:229-430 update rules)."""
+    loss, grads = jax.value_and_grad(_loss_fn)(
+        params, X, y, w, activation, nclass, dist_name, l1, l2, key,
+        input_dropout, hidden_dropout)
+
+    def upd(p, g, s):
+        eg2 = rho * s["eg2"] + (1 - rho) * g * g
+        dx = -jnp.sqrt(s["edx2"] + epsilon) / jnp.sqrt(eg2 + epsilon) * g
+        edx2 = rho * s["edx2"] + (1 - rho) * dx * dx
+        return p + dx, {"eg2": eg2, "edx2": edx2}
+
+    new_params, new_state = [], []
+    for p, g, s in zip(params, grads, estate):
+        W, sW = upd(p["W"], g["W"], s["W"])
+        b, sb = upd(p["b"], g["b"], s["b"])
+        new_params.append({"W": W, "b": b})
+        new_state.append({"W": sW, "b": sb})
+    return new_params, new_state, loss
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("activation", "nclass", "dist_name",
+                                    "l1", "l2", "input_dropout",
+                                    "hidden_dropout"))
+def train_step_sgd(params, mom, X, y, w, key, lr, momentum, activation: str,
+                   nclass: int, dist_name: str, l1: float = 0.0,
+                   l2: float = 0.0, input_dropout: float = 0.0,
+                   hidden_dropout: float = 0.0):
+    loss, grads = jax.value_and_grad(_loss_fn)(
+        params, X, y, w, activation, nclass, dist_name, l1, l2, key,
+        input_dropout, hidden_dropout)
+    new_params, new_mom = [], []
+    for p, g, m in zip(params, grads, mom):
+        vW = momentum * m["W"] - lr * g["W"]
+        vb = momentum * m["b"] - lr * g["b"]
+        new_params.append({"W": p["W"] + vW, "b": p["b"] + vb})
+        new_mom.append({"W": vW, "b": vb})
+    return new_params, new_mom, loss
+
+
+class DeepLearningModel(Model):
+    algo = "deeplearning"
+
+    def predict_raw(self, frame: Frame):
+        out = self.output
+        X = expand_for_scoring(frame, out["expansion_spec"])
+        params = [{"W": jnp.asarray(l["W"]), "b": jnp.asarray(l["b"])}
+                  for l in out["weights"]]
+        o = mlp_forward(params, X, out["activation"])
+        dom = out.get("response_domain")
+        if dom is None:
+            dist = get_distribution(out["distribution_resolved"])
+            return dist.link_inv(o[:, 0])
+        P = jax.nn.softmax(o, axis=1)
+        label = jnp.argmax(P, axis=1).astype(jnp.float32)
+        if len(dom) == 2:
+            return jnp.stack([(P[:, 1] >= 0.5).astype(jnp.float32),
+                              P[:, 0], P[:, 1]], axis=1)
+        return jnp.concatenate([label[:, None], P], axis=1)
+
+
+class DeepLearning(ModelBuilder):
+    algo = "deeplearning"
+    model_cls = DeepLearningModel
+
+    def default_params(self) -> Dict:
+        p = super().default_params()
+        p.update(hidden=[200, 200], epochs=10.0, activation="Rectifier",
+                 adaptive_rate=True, rho=0.99, epsilon=1e-8,
+                 rate=0.005, rate_annealing=1e-6, rate_decay=1.0,
+                 momentum_start=0.0, momentum_ramp=1e6, momentum_stable=0.0,
+                 nesterov_accelerated_gradient=True,
+                 input_dropout_ratio=0.0, hidden_dropout_ratios=None,
+                 l1=0.0, l2=0.0, max_w2=3.4e38, loss="Automatic",
+                 standardize=True, mini_batch_size=1,
+                 train_samples_per_iteration=-2, score_interval=5.0,
+                 use_all_factor_levels=True, autoencoder=False,
+                 stopping_rounds=5, stopping_metric="AUTO",
+                 stopping_tolerance=0.0, reproducible=False,
+                 export_weights_and_biases=False)
+        return p
+
+    def _fit(self, job, x, y, train: Frame, valid: Optional[Frame]):
+        p = self.params
+        di = DataInfo(train, x, y, mode="expanded",
+                      weights=p.get("weights_column"),
+                      standardize=bool(p["standardize"]),
+                      use_all_factor_levels=bool(p["use_all_factor_levels"]),
+                      impute_missing=True)
+        X = di.matrix()
+        yv = di.response()
+        w = di.weights()
+        active = di.valid_mask()
+        nclass = di.nclasses
+        dist_name = "gaussian" if nclass >= 2 else \
+            self.resolve_distribution(di)
+        n_in = X.shape[1]
+        n_out = nclass if nclass >= 2 else 1
+        hidden = [int(h) for h in p["hidden"]]
+        sizes = [n_in] + hidden + [n_out]
+        key = self.rng_key()
+        key, kinit = jax.random.split(key)
+        params = init_params(kinit, sizes)
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        estate = [{"W": {"eg2": z["W"], "edx2": z["W"]},
+                   "b": {"eg2": z["b"], "edx2": z["b"]}} for z in zeros]
+        mom = zeros
+
+        R = X.shape[0]
+        nrows = train.nrows
+        # device batch: H2O processes mini_batch_size rows per Hogwild update
+        # per thread; the TPU-native equivalent is a large synchronous batch
+        batch = int(min(max(1024, p["mini_batch_size"]), R))
+        epochs = float(p["epochs"])
+        steps = max(1, int(epochs * nrows / batch))
+        yv_f = jnp.where(active, jnp.nan_to_num(yv), 0.0)
+        w_act = jnp.where(active, w, 0.0)
+        activation = str(p["activation"])
+        hdr = p["hidden_dropout_ratios"]
+        hdrop = float(hdr[0]) if hdr else (
+            0.5 if "withdropout" in activation.lower() else 0.0)
+
+        loss = None
+        for step in range(steps):
+            key, kb, kd = jax.random.split(key, 3)
+            idx = jax.random.randint(kb, (batch,), 0, nrows)
+            Xb, yb, wb = X[idx], yv_f[idx], w_act[idx]
+            if bool(p["adaptive_rate"]):
+                params, estate, loss = train_step_adadelta(
+                    params, estate, Xb, yb, wb, kd, activation, nclass,
+                    dist_name, float(p["rho"]), float(p["epsilon"]),
+                    float(p["l1"]), float(p["l2"]),
+                    float(p["input_dropout_ratio"]), hdrop)
+            else:
+                t = step * batch
+                lr = float(p["rate"]) / (1 + float(p["rate_annealing"]) * t)
+                mstart, mstable = float(p["momentum_start"]), \
+                    float(p["momentum_stable"])
+                ramp = max(float(p["momentum_ramp"]), 1.0)
+                mo = mstable if t > ramp else \
+                    mstart + (mstable - mstart) * t / ramp
+                params, mom, loss = train_step_sgd(
+                    params, mom, Xb, yb, wb, kd, lr, mo, activation, nclass,
+                    dist_name, float(p["l1"]), float(p["l2"]),
+                    float(p["input_dropout_ratio"]), hdrop)
+            if step % 20 == 0:
+                job.update(step / steps, f"step {step}/{steps} "
+                                         f"loss={float(loss):.4f}")
+
+        out = dict(
+            x=list(di.x), expansion_spec=expansion_spec(di),
+            weights=[{"W": np.asarray(l["W"]), "b": np.asarray(l["b"])}
+                     for l in params],
+            activation=activation, hidden=hidden,
+            distribution_resolved=dist_name,
+            response_domain=di.response_domain if nclass >= 2 else None,
+            epochs_trained=steps * batch / max(nrows, 1))
+        model = self.model_cls(self.model_id, dict(p), out)
+        model.params["response_column"] = y
+        model.output["training_metrics"] = model.model_metrics(train)
+        if valid is not None:
+            model.output["validation_metrics"] = model.model_metrics(valid)
+        return model

@@ -1,0 +1,315 @@
+"""Model / ModelBuilder lifecycle.
+
+Reference: hex/ModelBuilder.java:25 (param validation → async Driver →
+train → metrics; n-fold CV at :535-690) and hex/Model.java (score() →
+BigScore MRTask → per-row score0 + MetricBuilder reduce, Model.java:1866,
+2189-2269).
+
+TPU-native: the Driver runs as a host Job; per-row score0 loops become one
+batched jit ``predict`` over the row-sharded matrix (BigScore ≡ the XLA
+program; the MetricBuilder reduce ≡ the fused metric kernels in metrics.py).
+Models are host objects in the DKV holding device parameter pytrees.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o_tpu.core.cloud import cloud
+from h2o_tpu.core.frame import Frame, T_CAT, Vec
+from h2o_tpu.core.job import Job
+from h2o_tpu.core.log import get_logger
+from h2o_tpu.core.store import Key
+from h2o_tpu.models import metrics as mm
+
+log = get_logger("model")
+
+
+class DataInfo:
+    """Feature extraction/encoding (reference: hex/DataInfo.java:23,112-115).
+
+    modes:
+    - "tree":     categoricals stay integer codes (one bin per category);
+                  NAs stay NaN (trees route them via the NA bucket).
+    - "expanded": one-hot categorical expansion + optional standardization +
+                  NA mean-imputation — the GLM/DL/KMeans input convention.
+    """
+
+    def __init__(self, frame: Frame, x: Sequence[str], y: Optional[str],
+                 mode: str = "tree", weights: Optional[str] = None,
+                 offset: Optional[str] = None, standardize: bool = False,
+                 use_all_factor_levels: bool = False,
+                 impute_missing: bool = False):
+        self.frame = frame
+        self.mode = mode
+        self.response_name = y
+        self.weights_name = weights
+        self.offset_name = offset
+        self.x = [c for c in x if c not in (y, weights, offset)]
+        # batch-fill rollups for every candidate column in one kernel call
+        frame.fill_rollups([c for c in self.x
+                            if frame.vec(c).data is not None])
+        # ignore constant cols (ignore_const_cols default, ModelBuilder)
+        kept = []
+        for c in self.x:
+            v = frame.vec(c)
+            if v.type in ("string", "uuid"):
+                continue
+            if v.is_categorical and v.cardinality <= 1:
+                continue
+            if v.is_numeric and v.rollups.sigma == 0:
+                continue
+            kept.append(c)
+        self.x = kept
+        self.cat_names = [c for c in self.x if frame.vec(c).is_categorical]
+        self.num_names = [c for c in self.x if not frame.vec(c).is_categorical]
+        # tree mode keeps frame column order; expanded puts cats first
+        # (reference DataInfo puts categoricals before numerics)
+        self.standardize = standardize
+        self.use_all_factor_levels = use_all_factor_levels
+        self.impute_missing = impute_missing
+        self._matrix = None
+        self._names_expanded: Optional[List[str]] = None
+
+    # -- response/weights ---------------------------------------------------
+
+    def response(self) -> jax.Array:
+        v = self.frame.vec(self.response_name)
+        if v.is_categorical:
+            return jnp.where(v.data < 0, jnp.nan,
+                             v.data.astype(jnp.float32))
+        return v.data
+
+    @property
+    def response_domain(self) -> Optional[List[str]]:
+        v = self.frame.vec(self.response_name)
+        return v.domain
+
+    @property
+    def nclasses(self) -> int:
+        d = self.response_domain
+        return len(d) if d else 1
+
+    def weights(self) -> jax.Array:
+        if self.weights_name:
+            return self.frame.vec(self.weights_name).data
+        return jnp.ones((self.frame.padded_rows,), jnp.float32)
+
+    def offset(self) -> Optional[jax.Array]:
+        return self.frame.vec(self.offset_name).data if self.offset_name \
+            else None
+
+    def valid_mask(self) -> jax.Array:
+        """Rows usable for training: in-range and response present."""
+        m = self.frame.row_mask()
+        if self.response_name:
+            m = m & ~jnp.isnan(self.response())
+        return m
+
+    # -- feature matrix -----------------------------------------------------
+
+    def matrix(self) -> jax.Array:
+        if self._matrix is not None:
+            return self._matrix
+        if self.mode == "tree":
+            self._matrix = self.frame.as_matrix(self.x)
+            self._names_expanded = list(self.x)
+        else:
+            cols, names = [], []
+            for c in self.cat_names:
+                v = self.frame.vec(c)
+                codes = v.data
+                lo = 0 if self.use_all_factor_levels else 1
+                for k in range(lo, v.cardinality):
+                    cols.append((codes == k).astype(jnp.float32))
+                    names.append(f"{c}.{v.domain[k]}")
+            for c in self.num_names:
+                v = self.frame.vec(c)
+                d = v.as_float()
+                if self.impute_missing:
+                    d = jnp.nan_to_num(d, nan=v.rollups.mean)
+                if self.standardize:
+                    sd = v.rollups.sigma or 1.0
+                    d = (d - v.rollups.mean) / sd
+                cols.append(d)
+                names.append(c)
+            m = jnp.stack(cols, axis=1) if cols else jnp.zeros(
+                (self.frame.padded_rows, 0), jnp.float32)
+            self._matrix = jax.device_put(m, cloud().matrix_sharding())
+            self._names_expanded = names
+        return self._matrix
+
+    @property
+    def expanded_names(self) -> List[str]:
+        if self._names_expanded is None:
+            self.matrix()
+        return self._names_expanded
+
+
+class Model:
+    """A trained model: params + output, DKV-visible, scoring capable."""
+
+    algo: str = "base"
+
+    def __init__(self, key: Optional[str], params: Dict[str, Any],
+                 output: Dict[str, Any]):
+        self.key = Key(key) if key else Key.make(self.algo)
+        self.params = params
+        self.output = output  # names, domains, training_metrics, ...
+        self.run_time_ms = 0
+
+    # -- scoring ------------------------------------------------------------
+
+    def predict_raw(self, frame: Frame) -> jax.Array:
+        """Device predictions over padded rows: (rows,) regression values or
+        (rows, 1+K) [label, p0..pK-1] for classification."""
+        raise NotImplementedError
+
+    def predict(self, frame: Frame) -> Frame:
+        """Public scoring: returns a Frame (the /3/Predictions surface)."""
+        raw = self.predict_raw(frame)
+        dom = self.output.get("response_domain")
+        if dom is None:
+            return Frame(["predict"],
+                         [Vec(raw, nrows=frame.nrows)])
+        names = ["predict"] + list(dom)
+        vecs = [Vec(raw[:, 0].astype(jnp.int32), T_CAT, nrows=frame.nrows,
+                    domain=list(dom))]
+        for k in range(len(dom)):
+            vecs.append(Vec(raw[:, 1 + k], nrows=frame.nrows))
+        return Frame(names, vecs)
+
+    def model_metrics(self, frame: Frame) -> mm.ModelMetrics:
+        """Score + metrics against a labeled frame."""
+        y_name = self.params.get("response_column")
+        yv = frame.vec(y_name)
+        raw = self.predict_raw(frame)
+        dom = self.output.get("response_domain")
+        valid = frame.row_mask()
+        y = yv.as_float() if not yv.is_categorical else jnp.where(
+            yv.data < 0, jnp.nan, yv.data.astype(jnp.float32))
+        w = frame.vec(self.params["weights_column"]).data \
+            if self.params.get("weights_column") else None
+        if dom is None:
+            from h2o_tpu.models.distributions import get_distribution
+            dist_name = self.params.get("distribution", "gaussian")
+            dist = None
+            if dist_name not in ("gaussian", "auto", None):
+                dist = get_distribution(
+                    dist_name,
+                    tweedie_power=self.params.get("tweedie_power", 1.5),
+                    quantile_alpha=self.params.get("quantile_alpha", 0.5),
+                    huber_alpha=self.params.get("huber_alpha", 1.0))
+            return mm.regression_metrics(raw, y, w=w, valid=valid,
+                                         distribution=dist)
+        if len(dom) == 2:
+            return mm.binomial_metrics(raw[:, 2], y, w=w, valid=valid,
+                                       domain=dom)
+        return mm.multinomial_metrics(raw[:, 1:], y, w=w, valid=valid,
+                                      domain=dom)
+
+    # -- persistence (binary save/load; MOJO-style export in io.py) --------
+
+    def save(self, path: str) -> str:
+        blob = {"algo": self.algo, "key": str(self.key),
+                "params": self.params,
+                "output": jax.tree.map(
+                    lambda v: np.asarray(v) if isinstance(v, jax.Array)
+                    else v, self.output)}
+        with open(path, "wb") as f:
+            pickle.dump(blob, f)
+        return path
+
+    @staticmethod
+    def load(path: str) -> "Model":
+        from h2o_tpu.models.registry import model_class
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        cls = model_class(blob["algo"])
+        m = cls.__new__(cls)
+        Model.__init__(m, blob["key"], blob["params"], blob["output"])
+        return m
+
+
+class ModelBuilder:
+    """Train lifecycle: validate → Job(Driver) → Model in DKV."""
+
+    algo: str = "base"
+    model_cls = Model
+    supervised = True
+
+    def __init__(self, **params):
+        self.params = self.default_params()
+        unknown = set(params) - set(self.params) - {"model_id"}
+        if unknown:
+            raise ValueError(f"{self.algo}: unknown params {sorted(unknown)}")
+        self.params.update(params)
+        self.model_id = params.get("model_id")
+
+    def default_params(self) -> Dict[str, Any]:
+        return dict(response_column=None, ignored_columns=None,
+                    weights_column=None, offset_column=None, seed=-1,
+                    max_runtime_secs=0.0, distribution="auto",
+                    tweedie_power=1.5, quantile_alpha=0.5, huber_alpha=0.9)
+
+    # -- public surface (mirrors h2o-py estimator.train) -------------------
+
+    def train(self, x: Optional[Sequence[str]] = None,
+              y: Optional[str] = None, training_frame: Frame = None,
+              validation_frame: Optional[Frame] = None) -> Model:
+        job = self.train_async(x, y, training_frame, validation_frame)
+        model = job.join()
+        return model
+
+    def train_async(self, x=None, y=None, training_frame=None,
+                    validation_frame=None) -> Job:
+        assert training_frame is not None, "training_frame is required"
+        y = y or self.params.get("response_column")
+        if self.supervised:
+            assert y, f"{self.algo} requires a response column"
+            self.params["response_column"] = y
+        ignored = set(self.params.get("ignored_columns") or ())
+        x = [c for c in (x or training_frame.names)
+             if c != y and c not in ignored]
+        t0 = time.time()
+        job = Job(dest=self.model_id or Key.make(self.algo),
+                  description=f"{self.algo} on {training_frame.key}")
+
+        def body(j: Job) -> Model:
+            model = self._fit(j, x, y, training_frame, validation_frame)
+            model.run_time_ms = int((time.time() - t0) * 1000)
+            cloud().dkv.put(model.key, model)
+            log.info("%s trained in %.2fs -> %s", self.algo,
+                     time.time() - t0, model.key)
+            return model
+
+        cloud().jobs.start(job, body)
+        return job
+
+    def _fit(self, job: Job, x: List[str], y: Optional[str],
+             train: Frame, valid: Optional[Frame]) -> Model:
+        raise NotImplementedError
+
+    # -- shared helpers -----------------------------------------------------
+
+    def resolve_distribution(self, di: DataInfo) -> str:
+        d = self.params.get("distribution", "auto")
+        if d and d != "auto":
+            return d
+        if di.nclasses == 2:
+            return "bernoulli"
+        if di.nclasses > 2:
+            return "multinomial"
+        return "gaussian"
+
+    def rng_key(self) -> jax.Array:
+        seed = int(self.params.get("seed") or -1)
+        if seed < 0:
+            seed = np.random.SeedSequence().entropy % (2 ** 31)
+        return jax.random.key(seed)

@@ -1,0 +1,99 @@
+"""PCA — principal components via the distributed Gram + eigendecomposition.
+
+Reference (hex/pca/PCA.java): methods GramSVD (default — distributed Gram
+MRTask then JAMA SVD on the driver), Power, Randomized, GLRM.
+
+TPU-native: the Gram X'X is one einsum over the row-sharded standardized
+matrix (ICI psum); the P x P eigh runs replicated.  That is exactly the
+GramSVD path with XLA collectives instead of the MRTask reduce.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o_tpu.core.frame import Frame
+from h2o_tpu.models import metrics as mm
+from h2o_tpu.models.model import DataInfo, Model, ModelBuilder
+from h2o_tpu.models.glm import expand_for_scoring, expansion_spec
+
+EPS = 1e-10
+
+
+@jax.jit
+def _gram(X, valid):
+    Xm = jnp.where(valid[:, None], X, 0.0)
+    return jnp.einsum("rp,rq->pq", Xm, Xm,
+                      preferred_element_type=jnp.float32), jnp.sum(valid)
+
+
+class PCAModel(Model):
+    algo = "pca"
+    supervised = False
+
+    def predict_raw(self, frame: Frame):
+        out = self.output
+        X = expand_for_scoring(frame, out["expansion_spec"])
+        return X @ jnp.asarray(out["eigenvectors"])
+
+    def predict(self, frame: Frame):
+        from h2o_tpu.core.frame import Vec
+        scores = self.predict_raw(frame)
+        k = scores.shape[1]
+        return Frame([f"PC{i+1}" for i in range(k)],
+                     [Vec(scores[:, i], nrows=frame.nrows)
+                      for i in range(k)])
+
+    def model_metrics(self, frame: Frame):
+        return mm.ModelMetrics("dimreduction", dict(
+            std_deviation=self.output["std_deviation"].tolist(),
+            pct_variance=self.output["pct_variance"].tolist()))
+
+
+class PCA(ModelBuilder):
+    algo = "pca"
+    model_cls = PCAModel
+    supervised = False
+
+    def default_params(self) -> Dict:
+        p = super().default_params()
+        p.update(k=1, transform="NONE", pca_method="GramSVD",
+                 use_all_factor_levels=False, compute_metrics=True)
+        return p
+
+    def _fit(self, job, x, y, train: Frame, valid: Optional[Frame]):
+        p = self.params
+        transform = p["transform"].upper()
+        di = DataInfo(train, x, None, mode="expanded",
+                      standardize=(transform == "STANDARDIZE"),
+                      use_all_factor_levels=bool(p["use_all_factor_levels"]),
+                      impute_missing=True)
+        X = di.matrix()
+        if transform == "DEMEAN":
+            mu = jnp.mean(jnp.where(train.row_mask()[:, None], X, 0.0),
+                          axis=0) * (X.shape[0] / max(train.nrows, 1))
+            X = X - mu[None, :]
+        valid_m = train.row_mask()
+        G, n = _gram(X, valid_m)
+        G = G / jnp.maximum(n - 1, 1)
+        evals, evecs = jnp.linalg.eigh(G)          # ascending
+        order = jnp.argsort(-evals)
+        evals = jnp.maximum(evals[order], 0.0)
+        evecs = evecs[:, order]
+        k = min(int(p["k"]), X.shape[1])
+        sd = np.sqrt(np.asarray(evals))
+        tot = max(float(np.sum(np.asarray(evals))), EPS)
+        out = dict(k=k, eigenvectors=np.asarray(evecs[:, :k]),
+                   std_deviation=sd[:k],
+                   pct_variance=np.asarray(evals)[:k] / tot,
+                   cum_variance=np.cumsum(np.asarray(evals)[:k]) / tot,
+                   expansion_spec=expansion_spec(di),
+                   coef_names=di.expanded_names)
+        model = self.model_cls(self.model_id, dict(p), out)
+        model.output["training_metrics"] = model.model_metrics(train)
+        job.update(1.0)
+        return model

@@ -1,0 +1,51 @@
+"""Algorithm registry (reference: hex/api/RegisterAlgos.java:17-42 — every
+builder registers itself so REST /3/ModelBuilders/{algo} can dispatch)."""
+
+from __future__ import annotations
+
+from typing import Dict, Type
+
+
+def builders() -> Dict[str, type]:
+    from h2o_tpu.models.tree.gbm import GBM
+    from h2o_tpu.models.tree.drf import DRF
+    reg = {"gbm": GBM, "drf": DRF}
+    try:
+        from h2o_tpu.models.glm import GLM
+        reg["glm"] = GLM
+    except ImportError:
+        pass
+    try:
+        from h2o_tpu.models.kmeans import KMeans
+        reg["kmeans"] = KMeans
+    except ImportError:
+        pass
+    try:
+        from h2o_tpu.models.deeplearning import DeepLearning
+        reg["deeplearning"] = DeepLearning
+    except ImportError:
+        pass
+    try:
+        from h2o_tpu.models.pca import PCA
+        reg["pca"] = PCA
+    except ImportError:
+        pass
+    try:
+        from h2o_tpu.models.naive_bayes import NaiveBayes
+        reg["naivebayes"] = NaiveBayes
+    except ImportError:
+        pass
+    try:
+        from h2o_tpu.models.tree.isofor import IsolationForest
+        reg["isolationforest"] = IsolationForest
+    except ImportError:
+        pass
+    return reg
+
+
+def builder_class(algo: str) -> type:
+    return builders()[algo.lower()]
+
+
+def model_class(algo: str) -> type:
+    return builder_class(algo).model_cls

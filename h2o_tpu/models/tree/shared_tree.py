@@ -1,0 +1,272 @@
+"""SharedTree — histogram-based distributed tree induction.
+
+Reference (hex/tree/**, SURVEY §2.2 + §3.3): the driver loop
+``scoreAndBuildTrees`` builds each tree level-by-level; the fused
+score+histogram MRTask ``ScoreBuildHistogram2`` re-assigns rows to leaves and
+accumulates per-(leaf,col,bin) DHistograms; ``DTree.findBestSplitPoint``
+(DTree.java:984) picks splits by squared-error reduction with NA-direction
+handling and min_rows constraints; categorical splits are bitsets; trees are
+stored compressed and walked by the scorer (CompressedTree.java).
+
+TPU-native redesign:
+- rows are pre-binned ONCE against global quantile split points (the
+  QuantilesGlobal histogram_type; reference GuidedSplitPoints) — binning is
+  a (R,C,B) comparison fused by XLA;
+- the per-level histogram is the MXU one-hot matmul kernel
+  (h2o_tpu/ops/histogram.py) with an ICI psum replacing the node tree-reduce;
+- split finding is vectorized over ALL (leaf, col, bin, na-dir) candidates at
+  once on replicated (L,C,B+1,4) histograms — the reference does this
+  serially per leaf on the driver (DTree.java:616);
+- EVERY split is a left-membership BITSET over bins: numeric splits are
+  prefix bitsets in value order, categorical splits are prefix bitsets in
+  target-mean order (the classic optimal-subset trick; reference enum splits
+  are bitsets too, DTree.Split), NA direction is the bitset's NA-bucket bit;
+- a tree is a fixed-shape heap array (split_col / bitset / value per node,
+  node i's children at 2i+1, 2i+2) — the CompressedTree analog that scoring
+  walks in D fixed descend steps, fully vectorized over rows;
+- leaf values come out of the SAME histogram (Newton numerator/denominator
+  slots), fusing the reference's separate GammaPass MRTask.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o_tpu.core.cloud import cloud
+from h2o_tpu.core.frame import Frame
+from h2o_tpu.models.model import DataInfo, Model, ModelBuilder
+from h2o_tpu.ops.histogram import histogram_build
+
+EPS = 1e-10
+
+
+class BinnedData(NamedTuple):
+    bins: jax.Array          # (R, C) int32 in [0, B]; B = NA bucket
+    split_points: np.ndarray  # (C, B-1) f32 host copy (model artifact)
+    split_points_dev: jax.Array
+    is_cat: np.ndarray       # (C,) bool
+    nbins: int
+
+
+@functools.partial(jax.jit, static_argnames=("nbins",))
+def _quantile_split_points(matrix, nrows, nbins: int):
+    """Per-column quantile split points via ONE batched sort.
+
+    Sorts every column at once (XLA fuses into a single program; NaNs sort
+    last so per-column valid counts index the true quantile ranks).  This is
+    the QuantilesGlobal strategy computed the TPU way — a sort is far
+    cheaper here than the reference's iterative histogram refinement per
+    column (Quantile.java), which remains available for the public
+    /3/Quantiles surface.
+    """
+    R, C = matrix.shape
+    rowmask = (jnp.arange(R) < nrows)[:, None]
+    mx = jnp.where(rowmask, matrix, jnp.nan)
+    xs = jnp.sort(mx, axis=0)                        # NaNs last
+    cnt = jnp.sum(rowmask & ~jnp.isnan(mx), axis=0)  # (C,)
+    probs = jnp.arange(1, nbins) / nbins             # (B-1,)
+    ranks = jnp.clip((probs[:, None] * (cnt[None, :] - 1)).astype(jnp.int32),
+                     0, jnp.maximum(cnt[None, :] - 1, 0))
+    sp = jnp.take_along_axis(xs, ranks, axis=0)      # (B-1, C)
+    return sp.T                                      # (C, B-1)
+
+
+def prepare_bins(di: DataInfo, nbins: int, nbins_cats: int) -> BinnedData:
+    """Global quantile binning (numeric) + code binning (categorical)."""
+    fr, xs = di.frame, di.x
+    C = len(xs)
+    max_card = max([fr.vec(c).cardinality for c in di.cat_names] or [0])
+    B = max(nbins, min(max_card, nbins_cats))
+    is_cat = np.array([fr.vec(c).is_categorical for c in xs], bool)
+    m = fr.as_matrix(xs)
+    sp_raw = np.asarray(_quantile_split_points(m, jnp.int32(fr.nrows), B))
+    # dedupe per column (repeated quantiles collapse to one threshold);
+    # categorical columns get no thresholds (code binning)
+    sp = np.full((C, B - 1), np.nan, np.float32)
+    for j in range(C):
+        if is_cat[j]:
+            continue
+        qs = np.unique(sp_raw[j][~np.isnan(sp_raw[j])])
+        sp[j, : len(qs)] = qs
+    sp_dev = jax.device_put(jnp.asarray(sp), cloud().replicated)
+    bins = _bin_all(m, sp_dev, jnp.asarray(is_cat), B)
+    return BinnedData(bins, sp, sp_dev, is_cat, B)
+
+
+@functools.partial(jax.jit, static_argnames=("nbins",))
+def _bin_all(matrix, split_points, is_cat, nbins: int):
+    v = matrix[:, :, None]
+    t = split_points[None, :, :]
+    num_bins = jnp.sum((v >= t) & ~jnp.isnan(t), axis=2).astype(jnp.int32)
+    cat_bins = jnp.clip(matrix, 0, nbins - 1).astype(jnp.int32)
+    b = jnp.where(is_cat[None, :], cat_bins, num_bins)
+    return jnp.where(jnp.isnan(matrix), nbins, b)
+
+
+# ---------------------------------------------------------------------------
+# split finding
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("min_rows",))
+def find_splits(hist, is_cat, col_allowed, min_rows: float = 10.0,
+                min_split_improvement: float = 1e-5):
+    """Best split per leaf from (L, C, B+1, 4) histograms.
+
+    Returns per-leaf: do_split, col, bitset (B+1 left-membership incl NA
+    bit), left/right Newton stats (wg, wh, w) for child values, and the
+    leaf's own (wg, wh, w) for terminal values.
+    """
+    L, C, B1, _ = hist.shape
+    B = B1 - 1
+    w, wg, wgg, wh = (hist[..., k] for k in range(4))
+
+    # order bins: numeric -> natural, categorical -> by mean gradient
+    mean = wg[..., :B] / jnp.maximum(w[..., :B], EPS)
+    empty = w[..., :B] <= 0
+    key = jnp.where(empty, jnp.inf, mean)
+    natural = jnp.broadcast_to(
+        jnp.arange(B, dtype=jnp.float32)[None, None, :], key.shape)
+    order = jnp.argsort(jnp.where(is_cat[None, :, None], key, natural),
+                        axis=2)                              # (L, C, B)
+
+    def sort_take(x):
+        return jnp.take_along_axis(x[..., :B], order, axis=2)
+
+    sw, swg, swgg, swh = map(sort_take, (w, wg, wgg, wh))
+    cw, cwg, cwgg, cwh = (jnp.cumsum(x, axis=2)
+                          for x in (sw, swg, swgg, swh))
+    naw, nawg, nawgg, nawh = (x[..., B] for x in (w, wg, wgg, wh))
+    tot_w = cw[..., -1] + naw
+    tot_wg = cwg[..., -1] + nawg
+    tot_wgg = cwgg[..., -1] + nawgg
+    tot_wh = cwh[..., -1] + nawh
+
+    def se(w_, wg_, wgg_):
+        return wgg_ - wg_ ** 2 / jnp.maximum(w_, EPS)
+
+    se_parent = se(tot_w, tot_wg, tot_wgg)                   # (L, C)
+
+    def side_gain(na_left):
+        lw = cw + (naw[..., None] if na_left else 0.0)
+        lwg = cwg + (nawg[..., None] if na_left else 0.0)
+        lwgg = cwgg + (nawgg[..., None] if na_left else 0.0)
+        rw = tot_w[..., None] - lw
+        rwg = tot_wg[..., None] - lwg
+        rwgg = tot_wgg[..., None] - lwgg
+        gain = se_parent[..., None] - se(lw, lwg, lwgg) - se(rw, rwg, rwgg)
+        ok = (lw >= min_rows) & (rw >= min_rows)
+        return jnp.where(ok, gain, -jnp.inf)
+
+    gains = jnp.stack([side_gain(False), side_gain(True)], axis=-1)
+    # candidate axis: (L, C, B, 2) — last split index B-1 sends everything
+    # left, which is never valid (rw=0 or < min_rows) so it self-eliminates
+    gains = jnp.where(col_allowed[..., None, None], gains, -jnp.inf)
+    flat = gains.reshape(L, -1)
+    best = jnp.argmax(flat, axis=1)
+    best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+    col = (best // (B * 2)).astype(jnp.int32)
+    rem = best % (B * 2)
+    split_b = (rem // 2).astype(jnp.int32)
+    na_left = (rem % 2).astype(jnp.bool_)
+
+    thresh = jnp.maximum(min_split_improvement *
+                         jnp.max(jnp.maximum(se_parent, 0.0), axis=1), EPS)
+    do_split = best_gain > thresh
+
+    # gather chosen column's per-leaf arrays
+    li = jnp.arange(L)
+    order_c = order[li, col]                                  # (L, B)
+    rank = jnp.argsort(order_c, axis=1)                       # inverse perm
+    bitset_bins = rank <= split_b[:, None]                    # (L, B)
+    bitset = jnp.concatenate([bitset_bins, na_left[:, None]], axis=1)
+
+    def pick(cum, na):
+        base = cum[li, col, split_b]
+        return base + jnp.where(na_left, na[li, col], 0.0)
+
+    lw, lwg, lwh = pick(cw, naw), pick(cwg, nawg), pick(cwh, nawh)
+    leaf_stats = dict(w=tot_w[li, col], wg=tot_wg[li, col],
+                      wh=tot_wh[li, col])
+    left_stats = dict(w=lw, wg=lwg, wh=lwh)
+    right_stats = dict(w=leaf_stats["w"] - lw, wg=leaf_stats["wg"] - lwg,
+                       wh=leaf_stats["wh"] - lwh)
+    return dict(do_split=do_split, gain=best_gain, col=col, bitset=bitset,
+                leaf=leaf_stats, left=left_stats, right=right_stats)
+
+
+@jax.jit
+def _advance_leaves(bins, leaf, do_split, col, bitset):
+    """Route active rows to children; deactivate rows in terminal leaves."""
+    active = leaf >= 0
+    lf = jnp.maximum(leaf, 0)
+    c = col[lf]
+    b = jnp.take_along_axis(bins, c[:, None], axis=1)[:, 0]
+    go_left = bitset[lf, b]
+    # level-LOCAL child index (heap index = level_offset + local)
+    child = 2 * lf + jnp.where(go_left, 0, 1)
+    splits = do_split[lf]
+    return jnp.where(active & splits, child, jnp.where(active, -1, leaf))
+
+
+# ---------------------------------------------------------------------------
+# tree storage + scoring
+# ---------------------------------------------------------------------------
+
+class Forest(NamedTuple):
+    """Stacked compressed trees: (T, K, H) heap arrays, H = 2^(D+1)-1."""
+    split_col: jax.Array   # int32, -1 = terminal
+    bitset: jax.Array      # bool (T, K, H, B+1) — left membership
+    value: jax.Array       # f32 node value (terminal prediction)
+    depth: int
+    nbins: int
+
+
+@functools.partial(jax.jit, static_argnames=("depth",))
+def forest_score(bins, split_col, bitset, value, depth: int):
+    """Sum of tree outputs per (row, k-slot): bins (R,C) -> (R, K).
+
+    Descends all T*K trees over D steps; terminal nodes self-loop (col=-1).
+    """
+    T, K, H = split_col.shape
+    R = bins.shape[0]
+
+    def one_tree(carry, tk):
+        sc, bs, vl = tk                       # (H,), (H,B+1), (H,)
+        node = jnp.zeros((R,), jnp.int32)
+        for _ in range(depth):
+            c = sc[node]
+            term = c < 0
+            b = jnp.take_along_axis(bins, jnp.maximum(c, 0)[:, None],
+                                    axis=1)[:, 0]
+            go_left = bs[node, b]
+            nxt = 2 * node + jnp.where(go_left, 1, 2)
+            node = jnp.where(term, node, nxt)
+        return carry, vl[node]
+
+    _, vals = jax.lax.scan(one_tree, 0,
+                           (split_col.reshape(T * K, H),
+                            bitset.reshape(T * K, H, -1),
+                            value.reshape(T * K, H)))
+    # vals: (T*K, R) -> sum per k slot
+    return jnp.sum(vals.reshape(T, K, R), axis=0).T        # (R, K)
+
+
+def forest_predict_frame(forest: Forest, binned_bins) -> jax.Array:
+    return forest_score(binned_bins, forest.split_col, forest.bitset,
+                        forest.value, forest.depth)
+
+
+# ---------------------------------------------------------------------------
+# single-tree build (host loop over levels, jitted steps)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("newton",))
+def _node_value(wg, wh, w, newton: bool):
+    """Leaf value: Newton wg/wh (GammaPass analog) or plain mean wg/w."""
+    denom = jnp.where(newton, jnp.maximum(wh, EPS), jnp.maximum(w, EPS))
+    return wg / denom

@@ -1,0 +1,1 @@
+from h2o_tpu.rapids.interp import Session, rapids_exec  # noqa: F401

@@ -1,0 +1,400 @@
+"""Rapids — the dataframe expression language behind POST /3/Rapids.
+
+Reference (water/rapids/**, SURVEY §3.6): clients build a lazy client-side
+AST (h2o-py expr.py) and flush Lisp-style strings like
+``(tmp= tmp_1 (mean (cols frame 'x')))`` to the server; ``Rapids.java:18-40``
+parses them, 227 AST prim classes execute over frames with a Session doing
+copy-on-write temp tracking.
+
+TPU-native: the interpreter lowers every elementwise prim to jnp ops over the
+row-sharded column arrays — one fused XLA program per expression tree (the
+reference runs one MRTask per prim; XLA fusion collapses the whole
+expression into a single pass).  Reducers ride the sharding's ICI psum.
+Strings stay host-side.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from h2o_tpu.core.cloud import cloud
+from h2o_tpu.core.frame import Frame, T_CAT, T_NUM, Vec
+
+# ---------------------------------------------------------------------------
+# parser (Rapids.java grammar: ( fun args... ), [num list], 'str', ids)
+# ---------------------------------------------------------------------------
+
+_TOKEN = re.compile(r"""\s*(,|\(|\)|\[|\]|"[^"]*"|'[^']*'|[^\s(),\[\]]+)""")
+
+
+def _tokenize(s: str) -> List[str]:
+    out, i = [], 0
+    while i < len(s):
+        m = _TOKEN.match(s, i)
+        if not m:
+            break
+        out.append(m.group(1))
+        i = m.end()
+    return out
+
+
+def _parse(tokens: List[str], pos: int = 0):
+    t = tokens[pos]
+    if t == "(":
+        lst = []
+        pos += 1
+        while tokens[pos] != ")":
+            node, pos = _parse(tokens, pos)
+            lst.append(node)
+        return lst, pos + 1
+    if t == "[":
+        lst = []
+        pos += 1
+        while tokens[pos] != "]":
+            if tokens[pos] == ",":
+                pos += 1
+                continue
+            node, pos = _parse(tokens, pos)
+            lst.append(node)
+        return ("numlist", lst), pos + 1
+    if t[0] in "\"'":
+        return ("str", t[1:-1]), pos + 1
+    try:
+        return float(t), pos + 1
+    except ValueError:
+        return ("id", t), pos + 1
+
+
+def parse(expr: str):
+    ast, _ = _parse(_tokenize(expr))
+    return ast
+
+
+# ---------------------------------------------------------------------------
+# session & evaluation
+# ---------------------------------------------------------------------------
+
+class Session:
+    """Temp-frame tracking (water/rapids/Session.java)."""
+
+    def __init__(self, session_id: str = "_default"):
+        self.id = session_id
+        self.temps: Dict[str, Frame] = {}
+
+    def lookup(self, name: str) -> Any:
+        if name in self.temps:
+            return self.temps[name]
+        v = cloud().dkv.get(name)
+        if v is None:
+            raise KeyError(f"rapids: unknown id {name!r}")
+        return v
+
+    def assign(self, name: str, fr: Frame) -> Frame:
+        fr.key = name
+        self.temps[name] = fr
+        cloud().dkv.put(name, fr)
+        return fr
+
+    def remove(self, name: str) -> None:
+        self.temps.pop(name, None)
+        cloud().dkv.remove(name)
+
+
+def _as_frame(v) -> Frame:
+    if isinstance(v, Frame):
+        return v
+    if isinstance(v, (int, float)):
+        raise TypeError("expected frame, got number")
+    raise TypeError(f"expected frame, got {type(v)}")
+
+
+def _elementwise(op, a, b=None):
+    """Apply a jnp op over frames/scalars, broadcasting column-wise."""
+    if b is None:
+        fr = _as_frame(a)
+        vecs = [Vec(op(v.as_float()), nrows=fr.nrows) for v in fr.vecs]
+        return Frame(list(fr.names), vecs)
+    af, bf = isinstance(a, Frame), isinstance(b, Frame)
+    if af and bf:
+        assert a.nrows == b.nrows, "frame row mismatch"
+        n = max(a.ncols, b.ncols)
+        vecs = []
+        for i in range(n):
+            va = a.vecs[i if a.ncols > 1 else 0].as_float()
+            vb = b.vecs[i if b.ncols > 1 else 0].as_float()
+            vecs.append(Vec(op(va, vb), nrows=a.nrows))
+        names = (a if a.ncols >= b.ncols else b).names
+        return Frame(list(names), vecs)
+    if af:
+        return Frame(list(a.names),
+                     [Vec(op(v.as_float(), b), nrows=a.nrows)
+                      for v in a.vecs])
+    if bf:
+        return Frame(list(b.names),
+                     [Vec(op(a, v.as_float()), nrows=b.nrows)
+                      for v in b.vecs])
+    return op(a, b)
+
+
+def _reduce_all(op_masked, fr: Frame):
+    """Reduce over all numeric cells of a frame -> python float."""
+    fr = _as_frame(fr)
+    vals = []
+    for v in fr.vecs:
+        if not (v.is_numeric or v.is_categorical):
+            continue
+        vals.append(op_masked(v))
+    if len(vals) == 1:
+        return vals[0]
+    return vals
+
+
+def _col_indices(fr: Frame, sel) -> List[int]:
+    if isinstance(sel, tuple) and sel[0] == "numlist":
+        out = []
+        for x in sel[1]:
+            out.append(int(x if isinstance(x, float) else _lit(x)))
+        return out
+    if isinstance(sel, tuple) and sel[0] == "str":
+        return [fr.names.index(sel[1])]
+    if isinstance(sel, float):
+        return [int(sel)]
+    raise TypeError(f"bad column selector {sel}")
+
+
+def _lit(node):
+    if isinstance(node, tuple) and node[0] in ("str", "id"):
+        return node[1]
+    return node
+
+
+def _row_select(fr: Frame, sel, sess) -> Frame:
+    if isinstance(sel, Frame):  # boolean mask frame
+        mask = np.asarray(sel.vecs[0].data)[: fr.nrows] > 0
+        idx = np.nonzero(mask)[0]
+    elif isinstance(sel, tuple) and sel[0] == "numlist":
+        lst = sel[1]
+        # [start:count] is encoded as (: start count) pairs by clients; a
+        # plain list is row indices
+        idx = np.asarray([int(x) for x in lst], np.int64)
+    else:
+        idx = np.asarray([int(sel)], np.int64)
+    vecs = []
+    for v in fr.vecs:
+        data = v.to_numpy()[idx]
+        vecs.append(Vec(data, v.type, domain=v.domain)
+                    if v.type != T_CAT else
+                    Vec(data.astype(np.int32), T_CAT, domain=v.domain))
+    return Frame(list(fr.names), vecs)
+
+
+def _masked(fn_np):
+    """Build a host reducer over one Vec using rollups when possible."""
+    return fn_np
+
+
+class _Env:
+    def __init__(self, session: Session):
+        self.s = session
+
+
+_BINOPS = {
+    "+": jnp.add, "-": jnp.subtract, "*": jnp.multiply, "/": jnp.divide,
+    "^": jnp.power, "%": jnp.mod, "%%": jnp.mod,
+    "intDiv": lambda a, b: jnp.floor_divide(a, b),
+    "<": lambda a, b: (a < b).astype(jnp.float32),
+    "<=": lambda a, b: (a <= b).astype(jnp.float32),
+    ">": lambda a, b: (a > b).astype(jnp.float32),
+    ">=": lambda a, b: (a >= b).astype(jnp.float32),
+    "==": lambda a, b: (a == b).astype(jnp.float32),
+    "!=": lambda a, b: (a != b).astype(jnp.float32),
+    "&": lambda a, b: ((a != 0) & (b != 0)).astype(jnp.float32),
+    "|": lambda a, b: ((a != 0) | (b != 0)).astype(jnp.float32),
+}
+
+_UNOPS = {
+    "abs": jnp.abs, "exp": jnp.exp, "log": jnp.log, "log10": jnp.log10,
+    "log2": jnp.log2, "log1p": jnp.log1p, "sqrt": jnp.sqrt,
+    "floor": jnp.floor, "ceiling": jnp.ceil, "round": jnp.round,
+    "trunc": jnp.trunc, "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "sign": jnp.sign, "signif": jnp.round,
+    "!": lambda a: (a == 0).astype(jnp.float32),
+    "is.na": lambda a: jnp.isnan(a).astype(jnp.float32),
+}
+
+
+def _eval(node, env: _Env):
+    s = env.s
+    if isinstance(node, float):
+        return node
+    if isinstance(node, tuple):
+        tag = node[0]
+        if tag == "str":
+            return node
+        if tag == "id":
+            return s.lookup(node[1])
+        if tag == "numlist":
+            return node
+    if not isinstance(node, list):
+        raise TypeError(f"bad node {node}")
+    head = node[0]
+    op = head[1] if isinstance(head, tuple) else head
+
+    if op == "tmp=":
+        name = _lit(node[1])
+        val = _eval(node[2], env)
+        return s.assign(name, _as_frame(val))
+    if op in ("rm", "rm_fr"):
+        s.remove(_lit(node[1]))
+        return None
+    if op in ("cols", "cols_py"):
+        fr = _as_frame(_eval(node[1], env))
+        sel = node[2] if isinstance(node[2], tuple) else _eval(node[2], env)
+        idxs = _col_indices(fr, sel)
+        return fr.subframe([fr.names[i] for i in idxs])
+    if op in ("rows", "rows_py"):
+        fr = _as_frame(_eval(node[1], env))
+        sel = node[2]
+        if isinstance(sel, list):
+            sel = _eval(sel, env)
+        return _row_select(fr, sel, s)
+    if op == "nrow":
+        return float(_as_frame(_eval(node[1], env)).nrows)
+    if op == "ncol":
+        return float(_as_frame(_eval(node[1], env)).ncols)
+    if op == "colnames":
+        return [("str", n) for n in _as_frame(_eval(node[1], env)).names]
+    if op == "colnames=":
+        fr = _as_frame(_eval(node[1], env))
+        names = [_lit(x) for x in node[3][1]] if isinstance(node[3], tuple) \
+            else [_lit(node[3])]
+        fr.names = list(names)
+        return fr
+    if op == "cbind":
+        frames = [_as_frame(_eval(a, env)) for a in node[1:]]
+        out = frames[0]
+        for f2 in frames[1:]:
+            out = out.cbind(f2)
+        return out
+    if op == "rbind":
+        frames = [_as_frame(_eval(a, env)) for a in node[1:]]
+        names = frames[0].names
+        vecs = []
+        for j, n in enumerate(names):
+            parts = [f.vecs[j].to_numpy() for f in frames]
+            v0 = frames[0].vecs[j]
+            data = np.concatenate(parts)
+            vecs.append(Vec(data if v0.type != T_CAT else
+                            data.astype(np.int32), v0.type,
+                            domain=v0.domain))
+        return Frame(list(names), vecs)
+    if op in _BINOPS:
+        a = _eval(node[1], env)
+        b = _eval(node[2], env)
+        return _elementwise(_BINOPS[op], a, b)
+    if op in _UNOPS:
+        return _elementwise(_UNOPS[op], _eval(node[1], env))
+    if op in ("mean", "sum", "min", "max", "sd", "var", "median"):
+        fr = _as_frame(_eval(node[1], env))
+        def red(v):
+            r = v.rollups
+            if op == "mean":
+                return float(r.mean)
+            if op == "sum":
+                return float(r.mean * r.cnt)
+            if op == "min":
+                return float(r.min)
+            if op == "max":
+                return float(r.max)
+            if op == "sd":
+                return float(r.sigma)
+            if op == "var":
+                return float(r.sigma ** 2)
+            from h2o_tpu.core.quantile import quantile_vec
+            return float(quantile_vec(v, 0.5))
+        return _reduce_all(red, fr)
+    if op == "quantile":
+        fr = _as_frame(_eval(node[1], env))
+        probs = [float(x) for x in node[2][1]]
+        from h2o_tpu.core.quantile import quantile
+        q = quantile(fr, probs)
+        cols = {"Probs": np.asarray(probs, np.float32)}
+        for c, vals in q.items():
+            cols[f"{c}Quantiles"] = np.asarray(vals, np.float32)
+        return Frame.from_dict(cols)
+    if op == "ifelse":
+        cond = _eval(node[1], env)
+        a = _eval(node[2], env)
+        b = _eval(node[3], env)
+        cf = _as_frame(cond)
+        cv = cf.vecs[0].as_float()
+        av = a.vecs[0].as_float() if isinstance(a, Frame) else a
+        bv = b.vecs[0].as_float() if isinstance(b, Frame) else b
+        return Frame(["ifelse"],
+                     [Vec(jnp.where(cv != 0, av, bv), nrows=cf.nrows)])
+    if op == "asfactor":
+        fr = _as_frame(_eval(node[1], env))
+        out = []
+        for v in fr.vecs:
+            if v.is_categorical:
+                out.append(v)
+            else:
+                data = v.to_numpy()
+                vals = np.unique(data[~np.isnan(data)])
+                lut = {x: i for i, x in enumerate(vals)}
+                codes = np.array([lut.get(x, -1) if not math.isnan(x)
+                                  else -1 for x in data], np.int32)
+                dom = [str(int(x)) if x == int(x) else str(x) for x in vals]
+                out.append(Vec(codes, T_CAT, domain=dom))
+        return Frame(list(fr.names), out)
+    if op in ("asnumeric", "as.numeric"):
+        fr = _as_frame(_eval(node[1], env))
+        out = []
+        for v in fr.vecs:
+            if v.is_categorical:
+                # numeric-looking domains convert by value, else by code
+                try:
+                    dom = np.asarray([float(d) for d in v.domain],
+                                     np.float32)
+                    codes = v.to_numpy()
+                    vals = np.where(codes < 0, np.nan,
+                                    dom[np.clip(codes, 0, None)])
+                except ValueError:
+                    codes = v.to_numpy()
+                    vals = np.where(codes < 0, np.nan,
+                                    codes.astype(np.float32))
+                out.append(Vec(vals.astype(np.float32), T_NUM))
+            else:
+                out.append(v)
+        return Frame(list(fr.names), out)
+    if op == "levels":
+        fr = _as_frame(_eval(node[1], env))
+        v = fr.vecs[0]
+        return [("str", d) for d in (v.domain or [])]
+    if op == "unique":
+        fr = _as_frame(_eval(node[1], env))
+        v = fr.vecs[0]
+        u = np.unique(v.to_numpy())
+        u = u[~np.isnan(u)] if u.dtype.kind == "f" else u
+        return Frame(["unique"], [Vec(u.astype(np.float32))])
+    if op == ":":  # range start:end inclusive -> numlist
+        a = int(_eval(node[1], env))
+        b = int(_eval(node[2], env))
+        return ("numlist", [float(i) for i in range(a, b + 1)])
+    if op == "assign":
+        name = _lit(node[1])
+        return s.assign(name, _as_frame(_eval(node[2], env)))
+    raise NotImplementedError(f"rapids op {op!r}")
+
+
+def rapids_exec(expr: str, session: Optional[Session] = None):
+    """Execute a Rapids expression string (the /3/Rapids POST body)."""
+    session = session or Session()
+    return _eval(parse(expr), _Env(session))

@@ -1,0 +1,202 @@
+"""GLM / KMeans / DeepLearning / PCA tests with sklearn golden oracles."""
+
+import numpy as np
+import pytest
+
+
+def _frame_from(X, y=None, y_domain=None):
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT
+    names = [f"x{j}" for j in range(X.shape[1])]
+    vecs = [Vec(X[:, j]) for j in range(X.shape[1])]
+    if y is not None:
+        names.append("y")
+        if y_domain:
+            vecs.append(Vec(y.astype(np.int32), T_CAT, domain=y_domain))
+        else:
+            vecs.append(Vec(y.astype(np.float32)))
+    return Frame(names, vecs)
+
+
+def test_glm_gaussian_matches_ols(cl, rng):
+    from h2o_tpu.models.glm import GLM
+    n = 2000
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    beta_true = np.array([1.5, -2.0, 0.5, 0.0], np.float32)
+    y = X @ beta_true + 3.0 + 0.1 * rng.normal(size=n).astype(np.float32)
+    fr = _frame_from(X, y)
+    m = GLM(family="gaussian", lambda_=0.0, standardize=False).train(
+        y="y", training_frame=fr)
+    coef = m.coef()
+    for j, b in enumerate(beta_true):
+        assert abs(coef[f"x{j}"] - b) < 0.02, coef
+    assert abs(coef["Intercept"] - 3.0) < 0.02
+    assert m.output["training_metrics"]["mse"] < 0.012
+
+
+def test_glm_binomial_matches_sklearn(cl, rng):
+    from sklearn.linear_model import LogisticRegression
+    from h2o_tpu.models.glm import GLM
+    n = 3000
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    logits = 2 * X[:, 0] - X[:, 1] + 0.5
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.int32)
+    fr = _frame_from(X, y, y_domain=["0", "1"])
+    m = GLM(family="binomial", lambda_=0.0, standardize=False).train(
+        y="y", training_frame=fr)
+    sk = LogisticRegression(penalty=None, max_iter=200).fit(X, y)
+    coef = m.coef()
+    for j in range(3):
+        assert abs(coef[f"x{j}"] - sk.coef_[0][j]) < 0.05, \
+            (coef, sk.coef_)
+    assert abs(coef["Intercept"] - sk.intercept_[0]) < 0.05
+    assert m.output["training_metrics"]["AUC"] > 0.8
+
+
+def test_glm_lasso_sparsifies(cl, rng):
+    from h2o_tpu.models.glm import GLM
+    n = 1500
+    X = rng.normal(size=(n, 8)).astype(np.float32)
+    y = (2 * X[:, 0] - X[:, 1] + 0.05 * rng.normal(size=n)).astype(
+        np.float32)
+    fr = _frame_from(X, y)
+    m = GLM(family="gaussian", alpha=1.0, lambda_=0.05,
+            standardize=True).train(y="y", training_frame=fr)
+    coef = np.array([m.coef()[f"x{j}"] for j in range(8)])
+    # noise coefficients must be (near-)zeroed by L1
+    assert np.abs(coef[2:]).max() < 0.02, coef
+    assert abs(coef[0]) > 0.5
+
+
+def test_glm_poisson(cl, rng):
+    from h2o_tpu.models.glm import GLM
+    n = 2000
+    X = rng.normal(size=(n, 2)).astype(np.float32)
+    mu = np.exp(0.5 * X[:, 0] - 0.3 * X[:, 1] + 1.0)
+    y = rng.poisson(mu).astype(np.float32)
+    fr = _frame_from(X, y)
+    m = GLM(family="poisson", lambda_=0.0, standardize=False).train(
+        y="y", training_frame=fr)
+    coef = m.coef()
+    assert abs(coef["x0"] - 0.5) < 0.05
+    assert abs(coef["x1"] + 0.3) < 0.05
+    assert abs(coef["Intercept"] - 1.0) < 0.05
+
+
+def test_glm_categorical_expansion(cl, rng):
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT
+    from h2o_tpu.models.glm import GLM
+    n = 1000
+    cat = rng.integers(0, 3, size=n).astype(np.int32)
+    x1 = rng.normal(size=n).astype(np.float32)
+    y = (np.array([0.0, 1.0, -1.0])[cat] + 0.5 * x1 +
+         0.05 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame(["c", "x1", "y"],
+               [Vec(cat, T_CAT, domain=["a", "b", "c"]), Vec(x1), Vec(y)])
+    m = GLM(family="gaussian", lambda_=0.0, standardize=False).train(
+        y="y", training_frame=fr)
+    coef = m.coef()
+    # reference level 'a' dropped; b ~ +1, c ~ -1
+    assert abs(coef["c.b"] - 1.0) < 0.05, coef
+    assert abs(coef["c.c"] + 1.0) < 0.05, coef
+    pred = m.predict(fr).vec("predict").to_numpy()
+    assert np.sqrt(np.mean((pred - y) ** 2)) < 0.1
+
+
+def test_glm_multinomial(cl, rng):
+    from h2o_tpu.models.glm import GLM
+    n = 2000
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    scores = np.stack([X[:, 0], X[:, 1], -X[:, 0] - X[:, 1]], axis=1)
+    yi = np.argmax(scores + 0.3 * rng.normal(size=(n, 3)), axis=1)
+    fr = _frame_from(X, yi, y_domain=["a", "b", "c"])
+    m = GLM(family="multinomial", lambda_=0.0).train(
+        y="y", training_frame=fr)
+    tm = m.output["training_metrics"]
+    assert tm["err"] < 0.25, tm.data
+
+
+def test_kmeans_recovers_clusters(cl, rng):
+    from h2o_tpu.models.kmeans import KMeans
+    centers_true = np.array([[0, 0], [10, 10], [-10, 10]], np.float32)
+    X = np.concatenate([c + rng.normal(size=(300, 2)).astype(np.float32)
+                        for c in centers_true])
+    fr = _frame_from(X)
+    m = KMeans(k=3, max_iterations=20, standardize=False, seed=5).train(
+        training_frame=fr)
+    got = np.sort(np.asarray(m.output["centers"]), axis=0)
+    want = np.sort(centers_true, axis=0)
+    np.testing.assert_allclose(got, want, atol=0.5)
+    tm = m.output["training_metrics"]
+    assert tm["betweenss"] / tm["totss"] > 0.95
+    # predict assigns each point to a cluster 0..2
+    pred = m.predict(fr).vec("predict").to_numpy()
+    assert set(np.unique(pred)) <= {0, 1, 2}
+
+
+def test_kmeans_standardized(cl, rng):
+    from h2o_tpu.models.kmeans import KMeans
+    X = np.concatenate([
+        np.array([0, 0], np.float32) + rng.normal(size=(200, 2), scale=(1, 100)).astype(np.float32),
+        np.array([8, 800], np.float32) + rng.normal(size=(200, 2), scale=(1, 100)).astype(np.float32)])
+    fr = _frame_from(X)
+    m = KMeans(k=2, max_iterations=20, standardize=True, seed=3).train(
+        training_frame=fr)
+    sizes = sorted(m.output["size"].tolist())
+    assert abs(sizes[0] - 200) < 40
+
+
+def test_pca_variance_split(cl, rng):
+    from h2o_tpu.models.pca import PCA
+    n = 2000
+    z = rng.normal(size=(n, 2)).astype(np.float32)
+    mix = np.array([[3, 1, 0.5], [0, 0.5, -1.0]], np.float32)
+    X = z @ mix + 0.01 * rng.normal(size=(n, 3)).astype(np.float32)
+    fr = _frame_from(X)
+    m = PCA(k=3, transform="DEMEAN").train(training_frame=fr)
+    pct = m.output["pct_variance"]
+    assert pct[0] > 0.5 and pct[0] + pct[1] > 0.99
+    scores = m.predict(fr)
+    assert scores.names == ["PC1", "PC2", "PC3"]
+
+
+def test_deeplearning_binomial(cl, rng):
+    from h2o_tpu.models.deeplearning import DeepLearning
+    n = 2000
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    # XOR-ish nonlinear boundary — a linear model cannot beat ~0.5 AUC
+    y = ((X[:, 0] * X[:, 1] > 0)).astype(np.int32)
+    fr = _frame_from(X, y, y_domain=["0", "1"])
+    m = DeepLearning(hidden=[32, 32], epochs=60, seed=7,
+                     standardize=True).train(y="y", training_frame=fr)
+    auc = m.output["training_metrics"]["AUC"]
+    assert auc > 0.9, f"DL AUC: {auc}"
+
+
+def test_deeplearning_regression(cl, rng):
+    from h2o_tpu.models.deeplearning import DeepLearning
+    n = 2000
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    y = (np.sin(X[:, 0]) + X[:, 1] ** 2).astype(np.float32)
+    fr = _frame_from(X, y)
+    m = DeepLearning(hidden=[32, 32], epochs=60, seed=2).train(
+        y="y", training_frame=fr)
+    assert m.output["training_metrics"]["mse"] < 0.3 * np.var(y)
+
+
+def test_deeplearning_sgd_momentum_path(cl, rng):
+    from h2o_tpu.models.deeplearning import DeepLearning
+    n = 1000
+    X = rng.normal(size=(n, 2)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int32)
+    fr = _frame_from(X, y, y_domain=["0", "1"])
+    m = DeepLearning(hidden=[16], epochs=100, adaptive_rate=False,
+                     rate=0.05, momentum_start=0.5, momentum_stable=0.9,
+                     seed=1).train(y="y", training_frame=fr)
+    assert m.output["training_metrics"]["AUC"] > 0.9
+
+
+def test_registry_lists_algos(cl):
+    from h2o_tpu.models.registry import builders
+    b = builders()
+    for algo in ("gbm", "drf", "glm", "kmeans", "deeplearning", "pca"):
+        assert algo in b, sorted(b)

@@ -1,0 +1,195 @@
+"""GBM/DRF tests — accuracy oracles via sklearn (golden-test strategy,
+SURVEY §4 testdir_golden) and invariants on synthetic data."""
+
+import numpy as np
+import pytest
+
+
+def _make_binomial(rng, n=2000, c=6):
+    X = rng.normal(size=(n, c)).astype(np.float32)
+    logits = 1.5 * X[:, 0] - 2.0 * X[:, 1] + X[:, 2] * X[:, 3]
+    p = 1 / (1 + np.exp(-logits))
+    y = (rng.uniform(size=n) < p).astype(np.int32)
+    return X, y
+
+
+def _frame_from(X, y=None, y_domain=None):
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT
+    names = [f"x{j}" for j in range(X.shape[1])]
+    vecs = [Vec(X[:, j]) for j in range(X.shape[1])]
+    if y is not None:
+        names.append("y")
+        if y_domain:
+            vecs.append(Vec(y.astype(np.int32), T_CAT, domain=y_domain))
+        else:
+            vecs.append(Vec(y.astype(np.float32)))
+    return Frame(names, vecs)
+
+
+def test_gbm_binomial_auc(cl, rng):
+    from h2o_tpu.models.tree.gbm import GBM
+    X, y = _make_binomial(rng)
+    fr = _frame_from(X, y, y_domain=["no", "yes"])
+    m = GBM(ntrees=30, max_depth=4, learn_rate=0.2, seed=7).train(
+        y="y", training_frame=fr)
+    tm = m.output["training_metrics"]
+    assert tm.kind == "binomial"
+    assert tm["AUC"] > 0.85, f"AUC too low: {tm['AUC']}"
+    assert tm["logloss"] < 0.55
+    # predictions frame shape: predict, p_no, p_yes
+    pf = m.predict(fr)
+    assert pf.names == ["predict", "no", "yes"]
+    p1 = pf.vec("yes").to_numpy()
+    assert p1.min() >= 0 and p1.max() <= 1
+
+
+def test_gbm_beats_sklearn_baseline_regression(cl, rng):
+    from h2o_tpu.models.tree.gbm import GBM
+    n = 3000
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = (2 * X[:, 0] + X[:, 1] ** 2 + 0.5 * rng.normal(size=n)).astype(
+        np.float32)
+    fr = _frame_from(X, y)
+    m = GBM(ntrees=40, max_depth=4, learn_rate=0.2, seed=1).train(
+        y="y", training_frame=fr)
+    mse = m.output["training_metrics"]["mse"]
+    # var(y) ~ 4 + 2 + .25; a working GBM must cut MSE far below variance
+    assert mse < 0.5 * np.var(y), f"mse={mse}, var={np.var(y)}"
+
+
+def test_gbm_sklearn_parity_holdout(cl, rng):
+    """Holdout AUC within a few points of sklearn's GBM — the golden oracle."""
+    from sklearn.ensemble import GradientBoostingClassifier
+    from sklearn.metrics import roc_auc_score
+    from h2o_tpu.models.tree.gbm import GBM
+    X, y = _make_binomial(rng, n=3000)
+    Xtr, ytr, Xte, yte = X[:2000], y[:2000], X[2000:], y[2000:]
+    fr = _frame_from(Xtr, ytr, y_domain=["0", "1"])
+    fte = _frame_from(Xte, yte, y_domain=["0", "1"])
+    m = GBM(ntrees=50, max_depth=3, learn_rate=0.1, seed=3).train(
+        y="y", training_frame=fr)
+    p1 = m.predict(fte).vec("1").to_numpy()
+    ours = roc_auc_score(yte, p1)
+    sk = GradientBoostingClassifier(n_estimators=50, max_depth=3,
+                                    learning_rate=0.1, random_state=3)
+    sk.fit(Xtr, ytr)
+    theirs = roc_auc_score(yte, sk.predict_proba(Xte)[:, 1])
+    assert ours > theirs - 0.03, f"ours={ours:.4f} sklearn={theirs:.4f}"
+
+
+def test_gbm_multinomial(cl, rng):
+    from h2o_tpu.models.tree.gbm import GBM
+    n = 2000
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    yi = (X[:, 0] + 0.5 * rng.normal(size=n) > 0.5).astype(int) + \
+         (X[:, 1] + 0.5 * rng.normal(size=n) > 0).astype(int)
+    fr = _frame_from(X, yi, y_domain=["a", "b", "c"])
+    m = GBM(ntrees=20, max_depth=4, learn_rate=0.2, seed=5).train(
+        y="y", training_frame=fr)
+    tm = m.output["training_metrics"]
+    assert tm.kind == "multinomial"
+    assert tm["err"] < 0.25, f"err={tm['err']}"
+    assert tm["logloss"] < 0.6
+    pf = m.predict(fr)
+    P = np.stack([pf.vec(c).to_numpy() for c in ["a", "b", "c"]], axis=1)
+    np.testing.assert_allclose(P.sum(axis=1), 1.0, atol=1e-4)
+
+
+def test_gbm_categorical_feature_split(cl, rng):
+    """Signal only in a categorical column — bitset splits must find it."""
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT
+    n = 1200
+    codes = rng.integers(0, 8, size=n).astype(np.int32)
+    # classes {1,3,5} are positive-ish — NOT a contiguous code range, so an
+    # ordinal split can't separate them but a mean-sorted bitset can
+    p = np.where(np.isin(codes, [1, 3, 5]), 0.9, 0.1)
+    y = (rng.uniform(size=n) < p).astype(np.int32)
+    noise = rng.normal(size=n).astype(np.float32)
+    fr = Frame(["c", "noise", "y"],
+               [Vec(codes, T_CAT, domain=[f"lv{i}" for i in range(8)]),
+                Vec(noise),
+                Vec(y, T_CAT, domain=["0", "1"])])
+    from h2o_tpu.models.tree.gbm import GBM
+    m = GBM(ntrees=10, max_depth=3, learn_rate=0.3, seed=2).train(
+        y="y", training_frame=fr)
+    assert m.output["training_metrics"]["AUC"] > 0.85
+
+
+def test_gbm_with_nas(cl, rng):
+    from h2o_tpu.models.tree.gbm import GBM
+    X, y = _make_binomial(rng, n=1500)
+    X[rng.uniform(size=X.shape) < 0.15] = np.nan  # 15% missing
+    fr = _frame_from(X, y, y_domain=["0", "1"])
+    m = GBM(ntrees=20, max_depth=4, seed=9).train(y="y", training_frame=fr)
+    auc = m.output["training_metrics"]["AUC"]
+    assert auc > 0.75, f"AUC with NAs: {auc}"
+    # scoring a frame with NAs must not produce NaN probs
+    p1 = m.predict(fr).vec("1").to_numpy()
+    assert not np.isnan(p1).any()
+
+
+def test_gbm_weights_column(cl, rng):
+    """Zero-weight rows must not influence the fit."""
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT
+    n = 1000
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int32)
+    # poison half the rows with flipped labels but zero weight
+    y2 = y.copy()
+    y2[:500] = 1 - y2[:500]
+    wcol = np.ones(n, np.float32)
+    wcol[:500] = 0.0
+    fr = Frame(["x0", "x1", "x2", "w", "y"],
+               [Vec(X[:, 0]), Vec(X[:, 1]), Vec(X[:, 2]), Vec(wcol),
+                Vec(y2, T_CAT, domain=["0", "1"])])
+    from h2o_tpu.models.tree.gbm import GBM
+    m = GBM(ntrees=15, max_depth=3, weights_column="w", seed=4).train(
+        y="y", training_frame=fr, x=["x0", "x1", "x2"])
+    p1 = m.predict(fr).vec("1").to_numpy()
+    from sklearn.metrics import roc_auc_score
+    auc_clean = roc_auc_score(y[500:], p1[500:])
+    assert auc_clean > 0.9, f"weighted fit polluted: {auc_clean}"
+
+
+def test_gbm_reproducible_with_seed(cl, rng):
+    from h2o_tpu.models.tree.gbm import GBM
+    X, y = _make_binomial(rng, n=800)
+    fr = _frame_from(X, y, y_domain=["0", "1"])
+    m1 = GBM(ntrees=5, max_depth=3, sample_rate=0.7, seed=42).train(
+        y="y", training_frame=fr)
+    m2 = GBM(ntrees=5, max_depth=3, sample_rate=0.7, seed=42).train(
+        y="y", training_frame=fr)
+    np.testing.assert_array_equal(m1.output["value"], m2.output["value"])
+
+
+def test_drf_binomial(cl, rng):
+    from h2o_tpu.models.tree.drf import DRF
+    X, y = _make_binomial(rng)
+    fr = _frame_from(X, y, y_domain=["0", "1"])
+    m = DRF(ntrees=30, max_depth=10, seed=11).train(y="y", training_frame=fr)
+    tm = m.output["training_metrics"]
+    assert tm["AUC"] > 0.85, f"DRF AUC: {tm['AUC']}"
+
+
+def test_drf_regression(cl, rng):
+    from h2o_tpu.models.tree.drf import DRF
+    n = 2000
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = (X[:, 0] * 3 + np.abs(X[:, 1]) + 0.3 * rng.normal(size=n)).astype(
+        np.float32)
+    fr = _frame_from(X, y)
+    m = DRF(ntrees=30, max_depth=12, seed=13).train(y="y", training_frame=fr)
+    assert m.output["training_metrics"]["mse"] < 0.45 * np.var(y)
+
+
+def test_model_save_load_roundtrip(cl, rng, tmp_path):
+    from h2o_tpu.models.model import Model
+    from h2o_tpu.models.tree.gbm import GBM
+    X, y = _make_binomial(rng, n=600)
+    fr = _frame_from(X, y, y_domain=["0", "1"])
+    m = GBM(ntrees=5, max_depth=3, seed=1).train(y="y", training_frame=fr)
+    p_before = m.predict(fr).vec("1").to_numpy()
+    path = m.save(str(tmp_path / "gbm.bin"))
+    m2 = Model.load(path)
+    p_after = m2.predict(fr).vec("1").to_numpy()
+    np.testing.assert_allclose(p_before, p_after, rtol=1e-6)
