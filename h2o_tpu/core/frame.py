@@ -303,6 +303,21 @@ class Frame:
     def cbind(self, other: "Frame") -> "Frame":
         return Frame(self.names + other.names, self.vecs + other.vecs)
 
+    def slice_rows(self, mask_or_idx) -> "Frame":
+        """New Frame of the selected rows (host gather + re-upload — the
+        deep-slice/row-filter path, reference rapids AstRowSlice)."""
+        sel = np.asarray(mask_or_idx)
+        idx = np.flatnonzero(sel) if sel.dtype == bool else sel
+        vecs = []
+        for v in self.vecs:
+            if v.host_data is not None:
+                vecs.append(Vec([v.host_data[i] for i in idx], v.type))
+            else:
+                arr = v.to_numpy()[idx]
+                vecs.append(Vec(arr, v.type,
+                                domain=list(v.domain) if v.domain else None))
+        return Frame(list(self.names), vecs)
+
     # -- device views ------------------------------------------------------
 
     def as_matrix(self, names: Optional[Sequence[str]] = None,

@@ -1,0 +1,236 @@
+"""Grid search over hyper-parameter spaces.
+
+Reference: hex/grid/GridSearch.java:70 (orchestration), HyperSpaceWalker.java
+:213-215 (CartesianWalker + RandomDiscreteValueWalker),
+HyperSpaceSearchCriteria.java (max_models / max_runtime_secs /
+stopping_{rounds,metric,tolerance}), hex/grid/Grid.java (collected models +
+failure tracking), api/GridSearchHandler.
+
+TPU note: models are trained sequentially — on a single mesh every model
+already saturates the chips, so the reference's parallel model building
+(ParallelModelBuilder.java) maps to sequential dispatches here; grids across
+multiple meshes are a deployment-level concern.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from h2o_tpu.core.cloud import cloud
+from h2o_tpu.core.job import Job
+from h2o_tpu.core.log import get_logger
+from h2o_tpu.core.store import Key
+from h2o_tpu.models.score_keeper import (is_maximizing, metric_value,
+                                         resolve_stopping_metric)
+
+log = get_logger("grid")
+
+
+def _model_kind(model) -> str:
+    mm = model.output.get("training_metrics")
+    if mm is not None and getattr(mm, "kind", None):
+        return mm.kind
+    dom = model.output.get("response_domain")
+    if dom is None:
+        return "regression"
+    return "binomial" if len(dom) == 2 else "multinomial"
+
+
+def _model_sort_metric(model, metric: str) -> float:
+    """Metric for ranking: CV metrics if present, else validation, else
+    training (Leaderboard's preference order)."""
+    mm = model.output.get("cross_validation_metrics") or \
+        model.output.get("validation_metrics") or \
+        model.output.get("training_metrics")
+    return metric_value(mm, metric)
+
+
+class Grid:
+    """A trained grid: hyper combos -> models, sortable summary."""
+
+    def __init__(self, key: str, algo: str, hyper_names: List[str]):
+        self.key = Key(key)
+        self.algo = algo
+        self.hyper_names = hyper_names
+        self.models: List = []            # Model objects (also in DKV)
+        self.hyper_values: List[Dict] = []
+        self.failures: List[Dict] = []
+        self.sort_metric: Optional[str] = None
+
+    @property
+    def model_ids(self) -> List[str]:
+        return [str(m.key) for m in self.models]
+
+    def sorted_models(self, metric: Optional[str] = None,
+                      decreasing: Optional[bool] = None) -> List:
+        metric = metric or self.sort_metric or "mse"
+        if decreasing is None:
+            decreasing = is_maximizing(metric)
+        return sorted(self.models,
+                      key=lambda m: _model_sort_metric(m, metric),
+                      reverse=decreasing)
+
+    def summary(self, metric: Optional[str] = None) -> Dict[str, Any]:
+        metric = metric or self.sort_metric or "mse"
+        ms = self.sorted_models(metric)
+        rows = []
+        for m in ms:
+            hv = self.hyper_values[self.models.index(m)]
+            rows.append({**{k: hv.get(k) for k in self.hyper_names},
+                         "model_id": str(m.key),
+                         metric: _model_sort_metric(m, metric)})
+        return {"grid_id": str(self.key), "hyper_names": self.hyper_names,
+                "sort_metric": metric, "summary_rows": rows,
+                "failure_count": len(self.failures)}
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = self.summary()
+        d["model_ids"] = [{"name": i, "type": "Key<Model>"}
+                          for i in self.model_ids]
+        d["failed_params"] = [f["params"] for f in self.failures]
+        d["failure_details"] = [f["error"] for f in self.failures]
+        return d
+
+
+class GridSearch:
+    """Cartesian or RandomDiscrete hyper-space walk over one builder."""
+
+    def __init__(self, builder_cls, hyper_params: Dict[str, Sequence],
+                 search_criteria: Optional[Dict] = None,
+                 grid_id: Optional[str] = None, **base_params):
+        if isinstance(builder_cls, str):
+            from h2o_tpu.models.registry import builder_class
+            builder_cls = builder_class(builder_cls)
+        self.builder_cls = builder_cls
+        self.hyper_params = {k: list(v) for k, v in hyper_params.items()}
+        sc = dict(search_criteria or {})
+        self.strategy = sc.pop("strategy", "Cartesian")
+        self.criteria = sc
+        self.base_params = base_params
+        self.grid_id = grid_id or str(Key.make(
+            f"grid_{builder_cls.algo}"))
+
+    # -- walkers (HyperSpaceWalker.java:213-215) ---------------------------
+
+    def _combos(self) -> List[Dict]:
+        names = list(self.hyper_params)
+        combos = [dict(zip(names, vs)) for vs in
+                  itertools.product(*(self.hyper_params[n] for n in names))]
+        if self.strategy.lower() in ("randomdiscrete", "random"):
+            seed = int(self.criteria.get("seed", -1))
+            rng = np.random.default_rng(seed if seed >= 0 else None)
+            rng.shuffle(combos)
+        return combos
+
+    # -- search ------------------------------------------------------------
+
+    def train(self, x=None, y=None, training_frame=None,
+              validation_frame=None) -> Grid:
+        job = Job(dest=self.grid_id,
+                  description=f"grid {self.grid_id} over "
+                              f"{list(self.hyper_params)}")
+        cloud().jobs.start(
+            job, lambda j: self._run(j, x, y, training_frame,
+                                     validation_frame))
+        return job.join()
+
+    def _run(self, job: Job, x, y, train, valid) -> Grid:
+        grid = cloud().dkv.get(self.grid_id)
+        if grid is None:
+            grid = Grid(self.grid_id, self.builder_cls.algo,
+                        list(self.hyper_params))
+        combos = self._combos()
+        # skip combos already trained (grid resume semantics)
+        done = {tuple(sorted(hv.items())) for hv in grid.hyper_values}
+        combos = [c for c in combos
+                  if tuple(sorted(c.items())) not in done]
+
+        max_models = int(self.criteria.get("max_models", 0) or 0)
+        max_rt = float(self.criteria.get("max_runtime_secs", 0.0) or 0.0)
+        rounds = int(self.criteria.get("stopping_rounds", 0) or 0)
+        tol = float(self.criteria.get("stopping_tolerance", 1e-3))
+        t0 = time.time()
+        best_so_far: List[float] = []
+        metric = None
+        maximize = False
+
+        for i, combo in enumerate(combos):
+            if max_models and len(grid.models) >= max_models:
+                break
+            if max_rt and time.time() - t0 > max_rt:
+                log.info("grid %s: max_runtime_secs reached", self.grid_id)
+                break
+            params = dict(self.base_params)
+            params.update(combo)
+            try:
+                b = self.builder_cls(**params)
+                m = b.train(x=x, y=y, training_frame=train,
+                            validation_frame=valid)
+                grid.models.append(m)
+                grid.hyper_values.append(dict(combo))
+                cloud().dkv.put(m.key, m)
+            except Exception as e:  # noqa: BLE001 — grid collects failures
+                log.warning("grid model failed (%s): %s", combo, e)
+                grid.failures.append({"params": dict(combo),
+                                      "error": repr(e)})
+                continue
+            if metric is None:
+                kind = _model_kind(m)
+                metric = resolve_stopping_metric(
+                    self.criteria.get("stopping_metric", "AUTO"), kind)
+                maximize = is_maximizing(metric)
+                grid.sort_metric = metric
+            v = _model_sort_metric(m, metric)
+            best = v if not best_so_far else (
+                max(best_so_far[-1], v) if maximize
+                else min(best_so_far[-1], v))
+            best_so_far.append(best)
+            # search-level early stopping: best-so-far hasn't moved by tol
+            # over the last `rounds` models (RandomDiscrete criteria)
+            if rounds and len(best_so_far) > rounds:
+                prev = best_so_far[-rounds - 1]
+                rel = abs(best - prev) / max(abs(prev), 1e-12)
+                if rel < tol:
+                    log.info("grid %s: early stop after %d models",
+                             self.grid_id, len(grid.models))
+                    break
+            job.update((i + 1) / max(len(combos), 1),
+                       f"{len(grid.models)} models, best {metric}="
+                       f"{best:.5g}")
+        cloud().dkv.put(grid.key, grid)
+        return grid
+
+
+def get_grid(grid_id: str) -> Optional[Grid]:
+    return cloud().dkv.get(grid_id)
+
+
+# -- grid export/import (api/GridImportExportHandler.java) ------------------
+
+def export_grid(grid: Grid, path: str) -> str:
+    """Binary grid snapshot (grid + all member models) to a directory."""
+    import os
+    import pickle
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, f"{grid.key}.grid"), "wb") as f:
+        pickle.dump(grid, f)
+    return path
+
+
+def import_grid(path: str, grid_id: Optional[str] = None) -> Grid:
+    import glob
+    import os
+    import pickle
+    files = glob.glob(os.path.join(path, f"{grid_id or '*'}.grid"))
+    if not files:
+        raise FileNotFoundError(f"no .grid file under {path}")
+    with open(files[0], "rb") as f:
+        grid = pickle.load(f)
+    cloud().dkv.put(grid.key, grid)
+    for m in grid.models:
+        cloud().dkv.put(m.key, m)
+    return grid

@@ -152,6 +152,19 @@ class DataInfo:
         return self._names_expanded
 
 
+def _raw_to_frame(raw, nrows: int, dom: Optional[List[str]]) -> Frame:
+    """raw predictions -> prediction Frame ([predict, p0..pK-1] layout)."""
+    raw = jnp.asarray(raw)
+    if dom is None:
+        return Frame(["predict"], [Vec(raw, nrows=nrows)])
+    names = ["predict"] + list(dom)
+    vecs = [Vec(raw[:, 0].astype(jnp.int32), T_CAT, nrows=nrows,
+                domain=list(dom))]
+    for k in range(len(dom)):
+        vecs.append(Vec(raw[:, 1 + k], nrows=nrows))
+    return Frame(names, vecs)
+
+
 class Model:
     """A trained model: params + output, DKV-visible, scoring capable."""
 
@@ -173,29 +186,26 @@ class Model:
 
     def predict(self, frame: Frame) -> Frame:
         """Public scoring: returns a Frame (the /3/Predictions surface)."""
-        raw = self.predict_raw(frame)
-        dom = self.output.get("response_domain")
-        if dom is None:
-            return Frame(["predict"],
-                         [Vec(raw, nrows=frame.nrows)])
-        names = ["predict"] + list(dom)
-        vecs = [Vec(raw[:, 0].astype(jnp.int32), T_CAT, nrows=frame.nrows,
-                    domain=list(dom))]
-        for k in range(len(dom)):
-            vecs.append(Vec(raw[:, 1 + k], nrows=frame.nrows))
-        return Frame(names, vecs)
+        return _raw_to_frame(self.predict_raw(frame), frame.nrows,
+                             self.output.get("response_domain"))
 
     def model_metrics(self, frame: Frame) -> mm.ModelMetrics:
         """Score + metrics against a labeled frame."""
+        return self.metrics_from_raw(self.predict_raw(frame), frame)
+
+    def metrics_from_raw(self, raw, frame: Frame,
+                         w=None) -> mm.ModelMetrics:
+        """Metrics from given raw predictions (the MetricBuilder reduce
+        decoupled from BigScore — used by CV holdout scoring)."""
         y_name = self.params.get("response_column")
         yv = frame.vec(y_name)
-        raw = self.predict_raw(frame)
         dom = self.output.get("response_domain")
         valid = frame.row_mask()
         y = yv.as_float() if not yv.is_categorical else jnp.where(
             yv.data < 0, jnp.nan, yv.data.astype(jnp.float32))
-        w = frame.vec(self.params["weights_column"]).data \
-            if self.params.get("weights_column") else None
+        if w is None:
+            wc = self.params.get("weights_column")
+            w = frame.vec(wc).data if wc and wc in frame else None
         if dom is None:
             from h2o_tpu.models.distributions import get_distribution
             dist_name = self.params.get("distribution", "gaussian")
@@ -256,7 +266,12 @@ class ModelBuilder:
         return dict(response_column=None, ignored_columns=None,
                     weights_column=None, offset_column=None, seed=-1,
                     max_runtime_secs=0.0, distribution="auto",
-                    tweedie_power=1.5, quantile_alpha=0.5, huber_alpha=0.9)
+                    tweedie_power=1.5, quantile_alpha=0.5, huber_alpha=0.9,
+                    nfolds=0, fold_assignment="AUTO", fold_column=None,
+                    keep_cross_validation_models=True,
+                    keep_cross_validation_predictions=False,
+                    keep_cross_validation_fold_assignment=False,
+                    checkpoint=None)
 
     # -- public surface (mirrors h2o-py estimator.train) -------------------
 
@@ -275,14 +290,22 @@ class ModelBuilder:
             assert y, f"{self.algo} requires a response column"
             self.params["response_column"] = y
         ignored = set(self.params.get("ignored_columns") or ())
+        if self.params.get("fold_column"):
+            ignored.add(self.params["fold_column"])
         x = [c for c in (x or training_frame.names)
              if c != y and c not in ignored]
         t0 = time.time()
         job = Job(dest=self.model_id or Key.make(self.algo),
                   description=f"{self.algo} on {training_frame.key}")
+        use_cv = int(self.params.get("nfolds") or 0) > 1 or \
+            self.params.get("fold_column")
 
         def body(j: Job) -> Model:
-            model = self._fit(j, x, y, training_frame, validation_frame)
+            if use_cv:
+                model = self._fit_cv(j, x, y, training_frame,
+                                     validation_frame)
+            else:
+                model = self._fit(j, x, y, training_frame, validation_frame)
             model.run_time_ms = int((time.time() - t0) * 1000)
             cloud().dkv.put(model.key, model)
             log.info("%s trained in %.2fs -> %s", self.algo,
@@ -296,7 +319,148 @@ class ModelBuilder:
              train: Frame, valid: Optional[Frame]) -> Model:
         raise NotImplementedError
 
+    # -- n-fold cross-validation orchestration -----------------------------
+    # Reference: hex/ModelBuilder.java:535-690 — N fold models trained with
+    # zero-weight holdout rows, combined holdout predictions scored once
+    # (cv_mainModelMetrics), optimal stopping params transferred to the main
+    # model (cv_computeAndSetOptimalParameters), then the main model trained
+    # on all rows.
+
+    def _fold_assignment(self, train: Frame, y: Optional[str]) -> np.ndarray:
+        p = self.params
+        nrows = train.nrows
+        if p.get("fold_column"):
+            fv = train.vec(p["fold_column"])
+            vals = np.asarray(fv.to_numpy(), np.float64)
+            if np.isnan(vals).any() or (fv.is_categorical and
+                                        (vals < 0).any()):
+                raise ValueError("fold_column contains missing values")
+            # remap to contiguous 0..n-1 (non-contiguous user fold ids
+            # would otherwise create empty phantom folds)
+            _, codes = np.unique(vals, return_inverse=True)
+            return codes
+        n = int(p["nfolds"])
+        scheme = (p.get("fold_assignment") or "AUTO").lower()
+        seed = int(p.get("seed") or -1)
+        rng = np.random.default_rng(seed if seed >= 0 else None)
+        if scheme == "modulo":
+            return np.arange(nrows) % n
+        if scheme == "stratified" and y and train.vec(y).is_categorical:
+            yv = np.asarray(train.vec(y).to_numpy())
+            fold = np.zeros(nrows, np.int64)
+            for k in np.unique(yv):
+                idx = np.flatnonzero(yv == k)
+                rng.shuffle(idx)
+                fold[idx] = np.arange(len(idx)) % n
+            return fold
+        return rng.integers(0, n, nrows)
+
+    def _fit_cv(self, job: Job, x: List[str], y: Optional[str],
+                train: Frame, valid: Optional[Frame]) -> Model:
+        p = self.params
+        fold = self._fold_assignment(train, y)
+        nfolds = int(fold.max()) + 1
+        user_w = np.asarray(train.vec(p["weights_column"]).to_numpy(),
+                            np.float32) if p.get("weights_column") \
+            else np.ones(train.nrows, np.float32)
+
+        cv_models, raw_combined = [], None
+        for i in range(nfolds):
+            hold = fold == i
+            w_i = np.where(hold, 0.0, user_w).astype(np.float32)
+            wname = f"__cv_weights_{i}"
+            fr_i = Frame(train.names + [wname],
+                         train.vecs + [Vec(w_i)])
+            # holdout rows as the fold's validation frame so early stopping
+            # watches out-of-fold metrics (cv_makeFoldValid analog)
+            fr_hold = train.slice_rows(hold)
+            fr_hold.add(wname, Vec(user_w[hold]))
+            sub_params = dict(p)
+            sub_params.update(nfolds=0, fold_column=None,
+                              weights_column=wname, checkpoint=None,
+                              model_id=None)
+            sub = self.__class__(**{k: v for k, v in sub_params.items()
+                                    if k in self.default_params()})
+            sub.params["response_column"] = y
+            job.update((i + 0.0) / (nfolds + 1.0),
+                       f"CV model {i + 1}/{nfolds}")
+            m_i = sub._fit(job, x, y, fr_i, fr_hold)
+            m_i.key = Key(f"{self.model_id or self.algo}_cv_{i + 1}")
+            cv_models.append(m_i)
+            raw_i = np.asarray(m_i.predict_raw(train))
+            mask = (fold == i)
+            pm = np.pad(mask, (0, raw_i.shape[0] - len(mask)))
+            if raw_combined is None:
+                raw_combined = np.zeros_like(raw_i)
+            raw_combined = np.where(
+                pm[:, None] if raw_i.ndim == 2 else pm, raw_i, raw_combined)
+
+        # optimal-params transfer: early stopping resolved by CV
+        if int(p.get("stopping_rounds") or 0) > 0 and \
+                all("ntrees_actual" in m.output for m in cv_models):
+            p = dict(p)
+            p["ntrees"] = max(1, int(round(np.mean(
+                [m.output["ntrees_actual"] for m in cv_models]))))
+            p["stopping_rounds"] = 0
+            self.params = p
+
+        job.update(nfolds / (nfolds + 1.0), "main model on full data")
+        model = self._fit(job, x, y, train, valid)
+
+        cvm = model.metrics_from_raw(jnp.asarray(raw_combined), train)
+        pad = raw_combined.shape[0] - train.nrows
+        fold_p = np.pad(fold, (0, pad), constant_values=-1)
+        user_w_p = np.pad(user_w, (0, pad))
+        fold_mms = [model.metrics_from_raw(
+            jnp.asarray(raw_combined), train,
+            w=jnp.asarray(np.where(fold_p == i, user_w_p, 0.0)))
+            for i in range(nfolds)]
+        summary: Dict[str, Any] = {}
+        for k, v in fold_mms[0].data.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                vals = [float(m.data[k]) for m in fold_mms
+                        if isinstance(m.data.get(k), (int, float))]
+                if vals:
+                    summary[k] = dict(
+                        mean=float(np.mean(vals)), sd=float(np.std(vals)),
+                        values=vals)
+        model.output["cross_validation_metrics"] = cvm
+        model.output["cross_validation_metrics_summary"] = summary
+        if p.get("keep_cross_validation_models", True):
+            for m_i in cv_models:
+                cloud().dkv.put(m_i.key, m_i)
+            model.output["cross_validation_models"] = \
+                [str(m.key) for m in cv_models]
+        if p.get("keep_cross_validation_predictions"):
+            pf = _raw_to_frame(raw_combined, train.nrows,
+                               model.output.get("response_domain"))
+            pf.key = Key(f"cv_holdout_prediction_{model.key}")
+            cloud().dkv.put(pf.key, pf)
+            model.output["cross_validation_holdout_predictions_frame_id"] = \
+                str(pf.key)
+        if p.get("keep_cross_validation_fold_assignment"):
+            ff = Frame(["fold_assignment"],
+                       [Vec(fold.astype(np.float32))])
+            ff.key = Key(f"cv_fold_assignment_{model.key}")
+            cloud().dkv.put(ff.key, ff)
+            model.output["cross_validation_fold_assignment_frame_id"] = \
+                str(ff.key)
+        return model
+
     # -- shared helpers -----------------------------------------------------
+
+    def checkpoint_model(self) -> Optional[Model]:
+        """Resolve the ``checkpoint`` param to a Model (SharedTree resume,
+        SharedTree.java:465-478; DL continuation, DeepLearning.java:348)."""
+        ck = self.params.get("checkpoint")
+        if not ck:
+            return None
+        if isinstance(ck, Model):
+            return ck
+        m = cloud().dkv.get(str(ck))
+        if m is None:
+            raise ValueError(f"checkpoint model {ck} not found")
+        return m
 
     def resolve_distribution(self, di: DataInfo) -> str:
         d = self.params.get("distribution", "auto")

@@ -24,6 +24,21 @@ from h2o_tpu.models.tree import shared_tree as st
 EPS = 1e-10
 
 
+def raw_from_votes(F, ntrees: int, dom):
+    """Accumulated per-tree votes -> raw predictions (mean over trees)."""
+    F = F / max(int(ntrees), 1)
+    if dom is None:
+        return F[:, 0]
+    if len(dom) == 2:
+        p1 = jnp.clip(F[:, 0], 0.0, 1.0)
+        label = (p1 >= 0.5).astype(jnp.float32)
+        return jnp.stack([label, 1 - p1, p1], axis=1)
+    P = jnp.maximum(F, 0.0)
+    P = P / jnp.maximum(jnp.sum(P, axis=1, keepdims=True), EPS)
+    label = jnp.argmax(P, axis=1).astype(jnp.float32)
+    return jnp.concatenate([label[:, None], P], axis=1)
+
+
 class DRFModel(Model):
     algo = "drf"
 
@@ -36,18 +51,8 @@ class DRFModel(Model):
                             jnp.asarray(out["bitset"]),
                             jnp.asarray(out["value"]),
                             int(out["max_depth"]))
-        F = F / max(int(out["ntrees_actual"]), 1)      # average the votes
-        dom = out.get("response_domain")
-        if dom is None:
-            return F[:, 0]
-        if len(dom) == 2:
-            p1 = jnp.clip(F[:, 0], 0.0, 1.0)
-            label = (p1 >= 0.5).astype(jnp.float32)
-            return jnp.stack([label, 1 - p1, p1], axis=1)
-        P = jnp.maximum(F, 0.0)
-        P = P / jnp.maximum(jnp.sum(P, axis=1, keepdims=True), EPS)
-        label = jnp.argmax(P, axis=1).astype(jnp.float32)
-        return jnp.concatenate([label[:, None], P], axis=1)
+        return raw_from_votes(F, int(out["ntrees_actual"]),
+                              out.get("response_domain"))
 
 
 class DRF(ModelBuilder):
@@ -67,12 +72,27 @@ class DRF(ModelBuilder):
 
     def _fit(self, job, x, y, train: Frame, valid: Optional[Frame]):
         p = self.params
+        ckpt = self.checkpoint_model()
         di = DataInfo(train, x, y, mode="tree",
                       weights=p.get("weights_column"))
+        if ckpt is not None:
+            co = ckpt.output
+            di.x = list(co["x"])
+            di.cat_names = [c for c in di.x if train.vec(c).is_categorical]
+            di.num_names = [c for c in di.x if c not in di.cat_names]
         nclass = di.nclasses
         K = nclass if nclass > 2 else 1
 
-        binned = st.prepare_bins(di, int(p["nbins"]), int(p["nbins_cats"]))
+        if ckpt is not None:
+            sp_dev = jnp.asarray(co["split_points"])
+            binned = st.BinnedData(
+                st._bin_all(train.as_matrix(di.x), sp_dev,
+                            jnp.asarray(co["is_cat"]), int(co["nbins"])),
+                np.asarray(co["split_points"]), sp_dev,
+                np.asarray(co["is_cat"]), int(co["nbins"]))
+        else:
+            binned = st.prepare_bins(di, int(p["nbins"]),
+                                     int(p["nbins_cats"]))
         bins = binned.bins
         yv = di.response()
         w = di.weights()
@@ -86,9 +106,7 @@ class DRF(ModelBuilder):
             mtries = max(1, int(np.sqrt(C))) if nclass >= 2 \
                 else max(1, C // 3)
 
-        from h2o_tpu.models.tree.jit_engine import train_forest
         from h2o_tpu.core.log import get_logger
-        ntrees = int(p["ntrees"])
         depth = int(p["max_depth"])
         if depth > 12:
             # dense level-wise layout is exponential in depth; deeper trees
@@ -97,30 +115,82 @@ class DRF(ModelBuilder):
                 "max_depth=%d clamped to 12 (dense tree layout)", depth)
             depth = 12
         F0 = jnp.zeros((R, K), jnp.float32)
-        job.update(0.05, f"training {ntrees} trees (one XLA program)")
-        tf = train_forest(
-            bins, jnp.nan_to_num(yv), w, active, F0,
-            jnp.asarray(binned.is_cat), self.rng_key(),
-            dist_name="gaussian", K=K, ntrees=ntrees,
-            max_depth=depth, nbins=binned.nbins,
+        prior = 0
+        if ckpt is not None:
+            prior = int(co["ntrees_actual"])
+            if int(co["max_depth"]) != depth:
+                raise ValueError("checkpoint max_depth mismatch")
+            F0 = F0 + st.forest_score(bins, jnp.asarray(co["split_col"]),
+                                      jnp.asarray(co["bitset"]),
+                                      jnp.asarray(co["value"]), depth)
+        sp_np = np.asarray(binned.split_points)
+        ic_np = np.asarray(binned.is_cat)
+
+        def make_model(sc, bs, vl, n_new, F_final):
+            if ckpt is not None:
+                sc = np.concatenate([co["split_col"], sc]) if n_new \
+                    else np.asarray(co["split_col"])
+                bs = np.concatenate([co["bitset"], bs]) if n_new \
+                    else np.asarray(co["bitset"])
+                vl = np.concatenate([co["value"], vl]) if n_new \
+                    else np.asarray(co["value"])
+            out = dict(
+                x=list(di.x), split_points=sp_np, is_cat=ic_np,
+                nbins=binned.nbins, split_col=sc, bitset=bs, value=vl,
+                max_depth=depth,
+                response_domain=di.response_domain if nclass >= 2 else None,
+                ntrees_actual=prior + n_new)
+            model = self.model_cls(self.model_id, dict(p), out)
+            model.params["response_column"] = y
+            return model
+
+        train_kwargs = dict(
+            bins=bins, yv=jnp.nan_to_num(yv), w=w, active=active,
+            is_cat=jnp.asarray(binned.is_cat),
+            dist_name="gaussian", K=K, max_depth=depth, nbins=binned.nbins,
             k_cols=mtries, newton=False,
             sample_rate=float(p["sample_rate"]),
             learn_rate=1.0, learn_rate_annealing=1.0,
             min_rows=float(p["min_rows"]),
             min_split_improvement=float(p["min_split_improvement"]),
             mode="drf")
-        job.update(0.9, "trees built")
+        kind = "binomial" if nclass == 2 else (
+            "multinomial" if nclass > 2 else "regression")
+        from h2o_tpu.models.tree.driver import (IncrementalScorer,
+                                                run_tree_driver)
+        scorer = None
+        want_scoring = int(p.get("stopping_rounds") or 0) > 0 or \
+            int(p.get("score_tree_interval") or 0) > 0 or \
+            p.get("score_each_iteration") or \
+            float(p.get("max_runtime_secs") or 0) > 0
+        if want_scoring:
+            score_frame = valid if valid is not None else train
+            bins_sc = bins if valid is None else st._bin_all(
+                valid.as_matrix(di.x), binned.split_points_dev,
+                jnp.asarray(binned.is_cat), binned.nbins)
+            F_sc = jnp.zeros((bins_sc.shape[0], K), jnp.float32)
+            if prior:
+                F_sc = F_sc + st.forest_score(
+                    bins_sc, jnp.asarray(co["split_col"]),
+                    jnp.asarray(co["bitset"]), jnp.asarray(co["value"]),
+                    depth)
+            H = 2 ** (depth + 1) - 1
+            proto = make_model(
+                np.zeros((0, K, H), np.int32),
+                np.zeros((0, K, H, binned.nbins + 1), bool),
+                np.zeros((0, K, H), np.float32), 0, None)
+            dom_sc = di.response_domain if nclass >= 2 else None
 
-        out = dict(
-            x=list(di.x), split_points=binned.split_points,
-            is_cat=binned.is_cat, nbins=binned.nbins,
-            split_col=np.asarray(tf.split_col),
-            bitset=np.asarray(tf.bitset),
-            value=np.asarray(tf.value), max_depth=depth,
-            response_domain=di.response_domain if nclass >= 2 else None,
-            ntrees_actual=ntrees)
-        model = self.model_cls(self.model_id, dict(p), out)
-        model.params["response_column"] = y
+            def to_metrics(Fv, ntot):
+                return proto.metrics_from_raw(
+                    raw_from_votes(Fv, ntot, dom_sc), score_frame)
+
+            scorer = IncrementalScorer(bins_sc, F_sc, depth, to_metrics,
+                                       valid is not None)
+        job.update(0.05, f"training {int(p['ntrees']) - prior} trees")
+        model = run_tree_driver(job, p, train_kwargs, F0, self.rng_key(),
+                                make_model, scorer, kind,
+                                prior_trees=prior)
         model.output["training_metrics"] = model.model_metrics(train)
         if valid is not None:
             model.output["validation_metrics"] = model.model_metrics(valid)

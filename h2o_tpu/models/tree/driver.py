@@ -1,0 +1,128 @@
+"""Shared tree-training driver: chunked XLA blocks + scoring + early stop.
+
+Reference: hex/tree/SharedTree.java ``scoreAndBuildTrees`` (:481-530) — the
+per-tree driver loop with periodic ``doScoringAndSaveModel`` and ScoreKeeper
+early stopping, and ``resumeFromCheckpoint`` (:465-478).
+
+TPU-native: trees are trained in BLOCKS of ``score_tree_interval`` trees,
+each block one fused XLA dispatch (jit_engine.train_forest with the F vector
+carried across blocks).  Scoring is INCREMENTAL: the scoring frame's
+link-scale predictions are a running F to which only the new block's trees
+are added (one forest_score over the block), so total scoring work is O(T) —
+the reference's per-scoring-round full-model rescore (BigScore over all
+trees) is avoided entirely.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o_tpu.models.score_keeper import ScoreKeeper
+
+
+class IncrementalScorer:
+    """Running link-scale predictions of the growing forest on one frame.
+
+    to_metrics(F, ntrees_total) -> ModelMetrics converts the accumulated F
+    (model-specific link/vote semantics) and runs the metric kernels.
+    """
+
+    def __init__(self, bins, F_init, depth: int,
+                 to_metrics: Callable, is_validation: bool):
+        self.bins = bins
+        self.F = F_init
+        self.depth = depth
+        self.to_metrics = to_metrics
+        self.is_validation = is_validation
+
+    def add(self, sc, bs, vl) -> None:
+        from h2o_tpu.models.tree.shared_tree import forest_score
+        self.F = self.F + forest_score(self.bins, jnp.asarray(sc),
+                                       jnp.asarray(bs), jnp.asarray(vl),
+                                       self.depth)
+
+    def metrics(self, ntrees_total: int):
+        return self.to_metrics(self.F, ntrees_total)
+
+
+def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
+                    make_model: Callable,
+                    scorer: Optional[IncrementalScorer],
+                    kind: str, prior_trees: int = 0,
+                    t_start: float = None) -> object:
+    """Train ``p['ntrees']`` total trees (``prior_trees`` of which already
+    exist on a checkpoint), scoring every ``score_tree_interval`` trees when
+    early stopping / periodic scoring / a runtime budget is requested.
+
+    make_model(sc, bs, vl, n_new, F_final) -> Model; arrays are the NEW
+    trees only (the builder prepends checkpoint trees itself).
+    """
+    from h2o_tpu.models.tree.jit_engine import train_forest
+
+    ntrees = int(p["ntrees"]) - prior_trees
+    if prior_trees and ntrees <= 0:
+        raise ValueError(
+            f"checkpoint already has {prior_trees} trees >= ntrees="
+            f"{p['ntrees']}; raise ntrees to continue training")
+    rounds = int(p.get("stopping_rounds") or 0)
+    interval = int(p.get("score_tree_interval") or 0)
+    if p.get("score_each_iteration"):
+        interval = 1
+    max_rt = float(p.get("max_runtime_secs") or 0.0)
+    t_start = t_start or time.time()
+
+    sk = ScoreKeeper(p.get("stopping_metric", "AUTO"), kind,
+                     stopping_rounds=rounds,
+                     tolerance=float(p.get("stopping_tolerance", 1e-3)))
+
+    want_scoring = (rounds > 0 or interval > 0 or max_rt > 0) and \
+        scorer is not None
+    if not want_scoring or ntrees <= 0:
+        tf = train_forest(F0=F0, key=key, ntrees=max(ntrees, 0),
+                          t0=prior_trees, **train_kwargs)
+        model = make_model(np.asarray(tf.split_col), np.asarray(tf.bitset),
+                           np.asarray(tf.value), max(ntrees, 0), tf.f_final)
+        model.output["scoring_history"] = []
+        return model
+
+    block = interval if interval > 0 else max(1, min(ntrees, 10))
+    scs, bss, vls = [], [], []
+    F = F0
+    done = 0
+    prefix = "validation_" if scorer.is_validation else "training_"
+    while done < ntrees:
+        n = min(block, ntrees - done)
+        key, sub = jax.random.split(key)
+        tf = train_forest(F0=F, key=sub, ntrees=n,
+                          t0=prior_trees + done, **train_kwargs)
+        F = tf.f_final
+        scs.append(np.asarray(tf.split_col))
+        bss.append(np.asarray(tf.bitset))
+        vls.append(np.asarray(tf.value))
+        done += n
+        scorer.add(tf.split_col, tf.bitset, tf.value)
+        mm = scorer.metrics(prior_trees + done)
+        row = {"number_of_trees": prior_trees + done,
+               "timestamp": time.time()}
+        for k in ("mse", "logloss", "AUC", "mean_residual_deviance", "err"):
+            if mm.get(k) is not None:
+                row[prefix + k.lower()] = mm.get(k)
+        sk.add(mm, row)
+        job.update(0.05 + 0.85 * done / ntrees,
+                   f"{prior_trees + done} trees, "
+                   f"{sk.metric_name}={sk.history[-1]:.5g}")
+        if sk.stop_early():
+            job.update(0.9, f"early stop at {prior_trees + done} trees")
+            break
+        if max_rt > 0 and time.time() - t_start > max_rt:
+            job.update(0.9, f"max_runtime_secs hit at {done} trees")
+            break
+    model = make_model(np.concatenate(scs), np.concatenate(bss),
+                       np.concatenate(vls), done, F)
+    model.output["scoring_history"] = sk.events
+    return model

@@ -28,6 +28,21 @@ from h2o_tpu.models.tree import shared_tree as st
 EPS = 1e-10
 
 
+def raw_from_F(F, dom, dist_name: str, tweedie_power: float = 1.5):
+    """Link-scale forest sum -> raw predictions (shared by BigScore-style
+    full scoring and the driver's incremental per-block scoring)."""
+    if dom is None:
+        dist = get_distribution(dist_name, tweedie_power=tweedie_power)
+        return dist.link_inv(F[:, 0])
+    if len(dom) == 2:
+        p1 = jax.nn.sigmoid(F[:, 0])
+        label = (p1 >= 0.5).astype(jnp.float32)
+        return jnp.stack([label, 1 - p1, p1], axis=1)
+    P = jax.nn.softmax(F, axis=1)
+    label = jnp.argmax(P, axis=1).astype(jnp.float32)
+    return jnp.concatenate([label[:, None], P], axis=1)
+
+
 class GBMModel(Model):
     algo = "gbm"
 
@@ -46,19 +61,9 @@ class GBMModel(Model):
         off_col = self.params.get("offset_column")
         if off_col and off_col in frame:
             F = F + frame.vec(off_col).data[:, None]
-        dom = out.get("response_domain")
-        if dom is None:
-            dist = get_distribution(out["distribution_resolved"],
-                                    tweedie_power=self.params.get(
-                                        "tweedie_power", 1.5))
-            return dist.link_inv(F[:, 0])
-        if len(dom) == 2:
-            p1 = jax.nn.sigmoid(F[:, 0])
-            label = (p1 >= 0.5).astype(jnp.float32)
-            return jnp.stack([label, 1 - p1, p1], axis=1)
-        P = jax.nn.softmax(F, axis=1)
-        label = jnp.argmax(P, axis=1).astype(jnp.float32)
-        return jnp.concatenate([label[:, None], P], axis=1)
+        return raw_from_F(F, out.get("response_domain"),
+                          out["distribution_resolved"],
+                          self.params.get("tweedie_power", 1.5))
 
 
 class GBM(ModelBuilder):
@@ -80,15 +85,34 @@ class GBM(ModelBuilder):
 
     def _fit(self, job, x, y, train: Frame, valid: Optional[Frame]):
         p = self.params
+        ckpt = self.checkpoint_model()
         di = DataInfo(train, x, y, mode="tree",
                       weights=p.get("weights_column"),
                       offset=p.get("offset_column"))
-        dist_name = self.resolve_distribution(di)
+        if ckpt is not None:
+            # resume: reuse the checkpoint's feature list + binning so new
+            # trees reference the same bin space (SharedTree.java:465-478)
+            co = ckpt.output
+            di.x = list(co["x"])
+            di.cat_names = [c for c in di.x if train.vec(c).is_categorical]
+            di.num_names = [c for c in di.x if c not in di.cat_names]
+            dist_name = co["distribution_resolved"]
+        else:
+            dist_name = self.resolve_distribution(di)
         nclass = di.nclasses if dist_name in ("bernoulli", "multinomial") \
             else 1
         K = nclass if dist_name == "multinomial" else 1
 
-        binned = st.prepare_bins(di, int(p["nbins"]), int(p["nbins_cats"]))
+        if ckpt is not None:
+            sp_dev = jnp.asarray(co["split_points"])
+            binned = st.BinnedData(
+                st._bin_all(train.as_matrix(di.x), sp_dev,
+                            jnp.asarray(co["is_cat"]), int(co["nbins"])),
+                np.asarray(co["split_points"]), sp_dev,
+                np.asarray(co["is_cat"]), int(co["nbins"]))
+        else:
+            binned = st.prepare_bins(di, int(p["nbins"]),
+                                     int(p["nbins_cats"]))
         bins = binned.bins
         yv = di.response()
         w = di.weights()
@@ -112,23 +136,57 @@ class GBM(ModelBuilder):
         else:
             f0 = dist.init_f0(jnp.where(active, jnp.nan_to_num(yv), 0.0),
                               wa)[None]
+        if ckpt is not None:
+            f0 = jnp.asarray(co["f0"]) if dist_name == "multinomial" \
+                else jnp.asarray(co["f0"][:1])
         F = jnp.broadcast_to(f0[None, :], (R, K)).astype(jnp.float32)
         offset = di.offset()
         if offset is not None:
             F = F + offset[:, None]
 
-        from h2o_tpu.models.tree.jit_engine import train_forest
+        prior = 0
+        if ckpt is not None:
+            prior = int(co["ntrees_actual"])
+            if int(co["max_depth"]) != int(p["max_depth"]):
+                raise ValueError("checkpoint max_depth mismatch")
+            F = F + st.forest_score(bins, jnp.asarray(co["split_col"]),
+                                    jnp.asarray(co["bitset"]),
+                                    jnp.asarray(co["value"]),
+                                    int(p["max_depth"]))
+
         C = len(di.x)
-        ntrees = int(p["ntrees"])
+        depth = int(p["max_depth"])
         newton = dist_name not in ("gaussian", "laplace", "quantile",
                                    "huber")
         k_cols = max(1, min(C, int(round(float(p["col_sample_rate"]) * C))))
-        job.update(0.05, f"training {ntrees} trees (one XLA program)")
-        tf = train_forest(
-            bins, jnp.nan_to_num(yv), w, active, F,
-            jnp.asarray(binned.is_cat), self.rng_key(),
-            dist_name=dist_name, K=K, ntrees=ntrees,
-            max_depth=int(p["max_depth"]), nbins=binned.nbins,
+        f0_out = np.asarray(f0 if dist_name == "multinomial"
+                            else jnp.broadcast_to(f0, (K,)))
+        sp_np = np.asarray(binned.split_points)
+        ic_np = np.asarray(binned.is_cat)
+
+        def make_model(sc, bs, vl, n_new, F_final):
+            if ckpt is not None:
+                sc = np.concatenate([co["split_col"], sc]) if n_new \
+                    else np.asarray(co["split_col"])
+                bs = np.concatenate([co["bitset"], bs]) if n_new \
+                    else np.asarray(co["bitset"])
+                vl = np.concatenate([co["value"], vl]) if n_new \
+                    else np.asarray(co["value"])
+            out = dict(
+                x=list(di.x), split_points=sp_np, is_cat=ic_np,
+                nbins=binned.nbins, split_col=sc, bitset=bs, value=vl,
+                max_depth=depth, f0=f0_out,
+                distribution_resolved=dist_name,
+                response_domain=di.response_domain if nclass >= 2 else None,
+                ntrees_actual=prior + n_new)
+            model = self.model_cls(self.model_id, dict(p), out)
+            model.params["response_column"] = y
+            return model
+
+        train_kwargs = dict(
+            bins=bins, yv=jnp.nan_to_num(yv), w=w, active=active,
+            is_cat=jnp.asarray(binned.is_cat),
+            dist_name=dist_name, K=K, max_depth=depth, nbins=binned.nbins,
             k_cols=k_cols, newton=newton,
             sample_rate=float(p["sample_rate"]),
             learn_rate=float(p["learn_rate"]),
@@ -139,21 +197,48 @@ class GBM(ModelBuilder):
             tweedie_power=float(p["tweedie_power"]),
             quantile_alpha=float(p["quantile_alpha"]),
             huber_alpha=float(p["huber_alpha"]))
-        job.update(0.9, "trees built")
+        kind = "binomial" if nclass == 2 else (
+            "multinomial" if nclass > 2 else "regression")
+        from h2o_tpu.models.tree.driver import (IncrementalScorer,
+                                                run_tree_driver)
+        scorer = None
+        want_scoring = int(p.get("stopping_rounds") or 0) > 0 or \
+            int(p.get("score_tree_interval") or 0) > 0 or \
+            p.get("score_each_iteration") or \
+            float(p.get("max_runtime_secs") or 0) > 0
+        if want_scoring:
+            score_frame = valid if valid is not None else train
+            bins_sc = bins if valid is None else st._bin_all(
+                valid.as_matrix(di.x), binned.split_points_dev,
+                jnp.asarray(binned.is_cat), binned.nbins)
+            F_sc = jnp.broadcast_to(
+                f0[None, :], (bins_sc.shape[0], K)).astype(jnp.float32)
+            off_col = p.get("offset_column")
+            if off_col and off_col in score_frame:
+                F_sc = F_sc + score_frame.vec(off_col).data[:, None]
+            if prior:
+                F_sc = F_sc + st.forest_score(
+                    bins_sc, jnp.asarray(co["split_col"]),
+                    jnp.asarray(co["bitset"]), jnp.asarray(co["value"]),
+                    depth)
+            H = 2 ** (depth + 1) - 1
+            proto = make_model(
+                np.zeros((0, K, H), np.int32),
+                np.zeros((0, K, H, binned.nbins + 1), bool),
+                np.zeros((0, K, H), np.float32), 0, None)
+            dom_sc = di.response_domain if nclass >= 2 else None
 
-        out = dict(
-            x=list(di.x), split_points=binned.split_points,
-            is_cat=binned.is_cat, nbins=binned.nbins,
-            split_col=np.asarray(tf.split_col),
-            bitset=np.asarray(tf.bitset),
-            value=np.asarray(tf.value), max_depth=int(p["max_depth"]),
-            f0=np.asarray(f0 if dist_name == "multinomial"
-                          else jnp.broadcast_to(f0, (K,))),
-            distribution_resolved=dist_name,
-            response_domain=di.response_domain if nclass >= 2 else None,
-            ntrees_actual=ntrees)
-        model = self.model_cls(self.model_id, dict(p), out)
-        model.params["response_column"] = y
+            def to_metrics(Fv, ntot):
+                raw = raw_from_F(Fv, dom_sc, dist_name,
+                                 float(p["tweedie_power"]))
+                return proto.metrics_from_raw(raw, score_frame)
+
+            scorer = IncrementalScorer(bins_sc, F_sc, depth, to_metrics,
+                                       valid is not None)
+        job.update(0.05, f"training {int(p['ntrees']) - prior} trees")
+        model = run_tree_driver(job, p, train_kwargs, F, self.rng_key(),
+                                make_model, scorer, kind,
+                                prior_trees=prior)
         model.output["training_metrics"] = model.model_metrics(train)
         if valid is not None:
             model.output["validation_metrics"] = model.model_metrics(valid)

@@ -141,7 +141,7 @@ def train_forest(bins, yv, w, active, F0, is_cat, key, *, dist_name: str,
                  block_rows: int = 8192, bf16: bool = False,
                  mode: str = "gbm", tweedie_power: float = 1.5,
                  quantile_alpha: float = 0.5,
-                 huber_alpha: float = 0.9) -> TrainedForest:
+                 huber_alpha: float = 0.9, t0: int = 0) -> TrainedForest:
     """The WHOLE forest training loop as one XLA program.
 
     mode="gbm": boosting — stats from distribution gradients at current F,
@@ -202,6 +202,8 @@ def train_forest(bins, yv, w, active, F0, is_cat, key, *, dist_name: str,
         return F, (jnp.stack(scs), jnp.stack(bss), jnp.stack(vls))
 
     keys = jax.random.split(key, ntrees)
-    ts = jnp.arange(ntrees, dtype=jnp.float32)
+    # t0 is a TRACED scalar (not static): per-block calls with varying tree
+    # offsets reuse one compiled program
+    ts = jnp.arange(ntrees, dtype=jnp.float32) + jnp.float32(t0)
     F_final, (sc, bs, vl) = jax.lax.scan(tree_step, F0, (ts, keys))
     return TrainedForest(sc, bs, vl, F_final)

@@ -1,0 +1,59 @@
+"""Grid search (reference: hex/grid/GridSearch.java + walkers)."""
+
+import numpy as np
+
+from h2o_tpu.core.frame import Frame, Vec, T_CAT
+from h2o_tpu.models.grid import GridSearch, export_grid, get_grid, import_grid
+
+
+def _frame(rng, n=1500, c=4):
+    X = rng.normal(size=(n, c)).astype(np.float32)
+    y = (rng.uniform(size=n) <
+         1 / (1 + np.exp(-(1.5 * X[:, 0] - X[:, 1])))).astype(np.int32)
+    names = [f"x{j}" for j in range(c)] + ["y"]
+    return Frame(names, [Vec(X[:, j]) for j in range(c)] +
+                 [Vec(y, T_CAT, domain=["n", "p"])])
+
+
+def test_cartesian_grid(cl, rng):
+    fr = _frame(rng)
+    g = GridSearch("gbm", {"max_depth": [2, 3], "learn_rate": [0.1, 0.3]},
+                   ntrees=5, seed=42).train(y="y", training_frame=fr)
+    assert len(g.models) == 4
+    s = g.summary()
+    assert s["sort_metric"] == "logloss"
+    vals = [r["logloss"] for r in s["summary_rows"]]
+    assert vals == sorted(vals, reverse=True) or \
+        vals == sorted(vals)  # sorted per direction
+    best = g.sorted_models()[0]
+    assert best.output["training_metrics"]["AUC"] > 0.6
+
+
+def test_random_discrete_max_models(cl, rng):
+    fr = _frame(rng)
+    g = GridSearch("gbm", {"max_depth": [1, 2, 3, 4],
+                           "learn_rate": [0.05, 0.1, 0.2, 0.3]},
+                   search_criteria={"strategy": "RandomDiscrete",
+                                    "max_models": 3, "seed": 7},
+                   ntrees=3, seed=42).train(y="y", training_frame=fr)
+    assert len(g.models) == 3
+
+
+def test_grid_failures_collected(cl, rng):
+    fr = _frame(rng)
+    g = GridSearch("gbm", {"max_depth": [2, -5]},  # -5 must fail
+                   ntrees=3, seed=1).train(y="y", training_frame=fr)
+    assert len(g.models) == 1
+    assert len(g.failures) == 1
+
+
+def test_grid_export_import(cl, rng, tmp_path):
+    fr = _frame(rng)
+    g = GridSearch("glm", {"alpha": [0.0, 0.5]}, family="binomial").train(
+        y="y", training_frame=fr)
+    export_grid(g, str(tmp_path))
+    from h2o_tpu.core.cloud import cloud
+    cloud().dkv.remove(g.key)
+    g2 = import_grid(str(tmp_path), str(g.key))
+    assert get_grid(str(g.key)) is not None
+    assert len(g2.models) == 2

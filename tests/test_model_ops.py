@@ -1,0 +1,130 @@
+"""Model-ops: early stopping, checkpoint/resume, n-fold CV.
+
+Reference behaviors: hex/ScoreKeeper.java (moving-average early stop),
+hex/tree/SharedTree.java:465-530 (checkpoint resume + periodic scoring),
+hex/ModelBuilder.java:535-690 (CV orchestration).
+"""
+
+import numpy as np
+import pytest
+
+from h2o_tpu.core.frame import Frame, Vec, T_CAT
+from h2o_tpu.models.score_keeper import ScoreKeeper
+from h2o_tpu.models.metrics import ModelMetrics
+
+
+def _toy_binomial(rng, n=4000, c=6):
+    X = rng.normal(size=(n, c)).astype(np.float32)
+    logits = 2.0 * X[:, 0] - 1.5 * X[:, 1] + X[:, 2]
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.int32)
+    names = [f"x{j}" for j in range(c)] + ["y"]
+    vecs = [Vec(X[:, j]) for j in range(c)] + \
+        [Vec(y, T_CAT, domain=["no", "yes"])]
+    return Frame(names, vecs)
+
+
+def test_score_keeper_stops_on_plateau():
+    sk = ScoreKeeper("logloss", "binomial", stopping_rounds=2,
+                     tolerance=1e-3)
+    for v in [0.6, 0.5, 0.4, 0.3]:       # improving: no stop
+        sk.add(ModelMetrics("binomial", {"logloss": v}))
+        assert not sk.stop_early()
+    for v in [0.3, 0.3, 0.3, 0.3]:       # plateau: stop
+        sk.add(ModelMetrics("binomial", {"logloss": v}))
+    assert sk.stop_early()
+
+
+def test_score_keeper_maximizing_auc():
+    sk = ScoreKeeper("AUC", "binomial", stopping_rounds=2, tolerance=1e-3)
+    assert sk.maximize
+    for v in [0.6, 0.7, 0.8, 0.9]:
+        sk.add(ModelMetrics("binomial", {"AUC": v}))
+        assert not sk.stop_early()
+
+
+def test_gbm_early_stopping(cl, rng):
+    from h2o_tpu.models.tree.gbm import GBM
+    # weak signal + high learn rate → validation logloss plateaus/overfits
+    n = 2000
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (rng.uniform(size=n) <
+         1 / (1 + np.exp(-0.3 * X[:, 0]))).astype(np.int32)
+    names = [f"x{j}" for j in range(4)] + ["y"]
+
+    def mk(sl):
+        return Frame(names, [Vec(X[sl, j]) for j in range(4)] +
+                     [Vec(y[sl], T_CAT, domain=["a", "b"])])
+    tr, va = mk(slice(0, 1500)), mk(slice(1500, n))
+    m = GBM(ntrees=100, max_depth=3, learn_rate=0.5, seed=7,
+            stopping_rounds=2, stopping_tolerance=1e-3,
+            score_tree_interval=5).train(y="y", training_frame=tr,
+                                         validation_frame=va)
+    assert m.output["ntrees_actual"] < 100         # stopped early
+    assert len(m.output["scoring_history"]) >= 4
+
+
+def test_gbm_checkpoint_resume(cl, rng):
+    from h2o_tpu.models.tree.gbm import GBM
+    fr = _toy_binomial(rng)
+    m10 = GBM(ntrees=10, max_depth=3, learn_rate=0.3, seed=5).train(
+        y="y", training_frame=fr)
+    assert m10.output["ntrees_actual"] == 10
+    m30 = GBM(ntrees=30, max_depth=3, learn_rate=0.3, seed=5,
+              checkpoint=m10).train(y="y", training_frame=fr)
+    assert m30.output["ntrees_actual"] == 30
+    # resumed model must not be worse than the checkpoint
+    assert m30.output["training_metrics"]["logloss"] <= \
+        m10.output["training_metrics"]["logloss"] + 1e-6
+    # first 10 trees are the checkpoint's trees verbatim
+    np.testing.assert_array_equal(m30.output["split_col"][:10],
+                                  m10.output["split_col"])
+
+
+def test_drf_checkpoint_resume(cl, rng):
+    from h2o_tpu.models.tree.drf import DRF
+    fr = _toy_binomial(rng, n=2000)
+    m5 = DRF(ntrees=5, max_depth=4, seed=3).train(y="y", training_frame=fr)
+    m12 = DRF(ntrees=12, max_depth=4, seed=3, checkpoint=m5).train(
+        y="y", training_frame=fr)
+    assert m12.output["ntrees_actual"] == 12
+    np.testing.assert_array_equal(m12.output["split_col"][:5],
+                                  m5.output["split_col"])
+
+
+def test_gbm_cv(cl, rng):
+    from h2o_tpu.models.tree.gbm import GBM
+    fr = _toy_binomial(rng, n=3000)
+    m = GBM(ntrees=10, max_depth=3, learn_rate=0.3, seed=11,
+            nfolds=3, keep_cross_validation_predictions=True).train(
+        y="y", training_frame=fr)
+    cvm = m.output["cross_validation_metrics"]
+    assert 0.7 < cvm["AUC"] <= 1.0
+    # CV (holdout) AUC must be <= training AUC (almost surely)
+    assert cvm["AUC"] <= m.output["training_metrics"]["AUC"] + 0.02
+    summ = m.output["cross_validation_metrics_summary"]
+    assert "logloss" in summ and len(summ["logloss"]["values"]) == 3
+    assert len(m.output["cross_validation_models"]) == 3
+    from h2o_tpu.core.cloud import cloud
+    pf = cloud().dkv.get(
+        m.output["cross_validation_holdout_predictions_frame_id"])
+    assert pf is not None and pf.nrows == fr.nrows
+
+
+def test_cv_fold_column_and_modulo(cl, rng):
+    from h2o_tpu.models.glm import GLM
+    fr = _toy_binomial(rng, n=1500)
+    fr.add("fold", Vec(rng.integers(0, 3, 1500).astype(np.float32)))
+    m = GLM(family="binomial", fold_column="fold").train(
+        y="y", training_frame=fr)
+    assert len(m.output["cross_validation_models"]) == 3
+    # fold column must not be used as a predictor
+    assert "fold" not in m.output["x" if "x" in m.output else "names"] \
+        if ("x" in m.output or "names" in m.output) else True
+
+
+def test_gbm_max_runtime(cl, rng):
+    from h2o_tpu.models.tree.gbm import GBM
+    fr = _toy_binomial(rng, n=2000)
+    m = GBM(ntrees=500, max_depth=3, seed=1, max_runtime_secs=3.0,
+            score_tree_interval=5).train(y="y", training_frame=fr)
+    assert m.output["ntrees_actual"] <= 500
