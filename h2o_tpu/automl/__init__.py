@@ -1,0 +1,1 @@
+from h2o_tpu.automl.automl import AutoML  # noqa: F401

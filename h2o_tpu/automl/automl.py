@@ -1,0 +1,265 @@
+"""AutoML — automatic model selection + ensembling.
+
+Reference: h2o-automl/src/main/java/ai/h2o/automl/AutoML.java:49 — executes a
+modeling plan (ModelingPlans.java) of ModelingSteps from per-algo providers
+(modeling/{GLM,DRF,GBM,DeepLearning,StackedEnsemble}StepsProvider.java):
+default models → random-search grids → stacked ensembles ("best of family",
+"all"); time/model budget via WorkAllocations.java; ranked Leaderboard;
+EventLog (events/EventLog.java); resumable (it is a Recoverable).
+
+TPU note: all models share ONE fold assignment (an explicit fold column) so
+every base model's CV holdout predictions are alignable into the level-one
+frame without re-scoring — the same invariant the reference enforces by
+fixing fold_assignment=Modulo for AutoML.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from h2o_tpu.core.cloud import cloud
+from h2o_tpu.core.frame import Frame, Vec
+from h2o_tpu.core.job import Job
+from h2o_tpu.core.log import get_logger
+from h2o_tpu.core.store import Key
+from h2o_tpu.models.leaderboard import Leaderboard
+
+log = get_logger("automl")
+
+
+class EventLog:
+    """Timestamped AutoML event journal (events/EventLog.java)."""
+
+    def __init__(self):
+        self.events: List[Dict] = []
+
+    def info(self, stage: str, message: str) -> None:
+        self.events.append({"timestamp": time.time(), "level": "Info",
+                            "stage": stage, "message": message})
+        log.info("[%s] %s", stage, message)
+
+    def to_dict(self) -> List[Dict]:
+        return list(self.events)
+
+
+class _Budget:
+    """Work allocation: per-step time budget from max_runtime_secs
+    (WorkAllocations.java)."""
+
+    def __init__(self, max_runtime_secs: float, max_models: int):
+        self.t0 = time.time()
+        self.max_runtime = max_runtime_secs
+        self.max_models = max_models
+        self.n_models = 0
+
+    def exhausted(self) -> bool:
+        if self.max_models and self.n_models >= self.max_models:
+            return True
+        if self.max_runtime and time.time() - self.t0 > self.max_runtime:
+            return True
+        return False
+
+    def remaining(self) -> float:
+        if not self.max_runtime:
+            return 0.0
+        return max(self.max_runtime - (time.time() - self.t0), 0.0)
+
+
+# The modeling plan: (step name, algo, params) in execution order
+# (ModelingPlans.defaultPlan: defaults → grids → ensembles).
+def _default_plan(seed: int) -> List[Dict]:
+    return [
+        dict(step="def_glm", algo="glm", params={}),
+        dict(step="def_gbm_1", algo="gbm",
+             params=dict(ntrees=50, max_depth=6, learn_rate=0.1)),
+        dict(step="def_gbm_2", algo="gbm",
+             params=dict(ntrees=50, max_depth=3, learn_rate=0.1)),
+        dict(step="def_drf", algo="drf", params=dict(ntrees=50)),
+        dict(step="def_dl", algo="deeplearning",
+             params=dict(hidden=[32, 32], epochs=5)),
+        dict(step="grid_gbm", algo="gbm", grid=dict(
+            max_depth=[3, 5, 7], learn_rate=[0.05, 0.1, 0.2],
+            sample_rate=[0.8, 1.0]),
+            params=dict(ntrees=50), max_grid_models=4),
+        dict(step="grid_dl", algo="deeplearning", grid=dict(
+            hidden=[[16], [32, 32], [64]],
+            input_dropout_ratio=[0.0, 0.1]),
+            params=dict(epochs=5), max_grid_models=2),
+    ]
+
+
+class AutoML:
+    """The h2o.automl.H2OAutoML surface: train many models, rank, ensemble."""
+
+    def __init__(self, max_models: int = 0, max_runtime_secs: float = 0.0,
+                 seed: int = -1, nfolds: int = 5,
+                 include_algos: Optional[List[str]] = None,
+                 exclude_algos: Optional[List[str]] = None,
+                 stopping_rounds: int = 3, stopping_metric: str = "AUTO",
+                 stopping_tolerance: float = -1.0,
+                 sort_metric: Optional[str] = None,
+                 project_name: str = ""):
+        if not max_models and not max_runtime_secs:
+            max_runtime_secs = 3600.0   # reference default budget
+        self.params = dict(max_models=max_models,
+                           max_runtime_secs=max_runtime_secs, seed=seed,
+                           nfolds=nfolds, include_algos=include_algos,
+                           exclude_algos=exclude_algos,
+                           stopping_rounds=stopping_rounds,
+                           stopping_metric=stopping_metric,
+                           stopping_tolerance=stopping_tolerance,
+                           project_name=project_name)
+        self.project_name = project_name or f"automl_{int(time.time())}"
+        self.leaderboard = Leaderboard(self.project_name,
+                                       sort_metric=sort_metric)
+        self.event_log = EventLog()
+        self.key = Key.make(f"automl_{self.project_name}")
+        self._job: Optional[Job] = None
+
+    # -- public surface -----------------------------------------------------
+
+    @property
+    def leader(self):
+        return self.leaderboard.leader
+
+    def train(self, x=None, y=None, training_frame=None,
+              validation_frame=None, leaderboard_frame=None):
+        job = Job(dest=self.key,
+                  description=f"AutoML {self.project_name}")
+        self._job = job
+        cloud().jobs.start(
+            job, lambda j: self._run(j, x, y, training_frame,
+                                     validation_frame, leaderboard_frame))
+        job.join()
+        cloud().dkv.put(self.key, self)
+        return self
+
+    # -- plan execution -----------------------------------------------------
+
+    def _allowed(self, algo: str) -> bool:
+        inc = self.params.get("include_algos")
+        exc = self.params.get("exclude_algos") or []
+        if inc is not None:
+            return algo.lower() in [a.lower() for a in inc]
+        return algo.lower() not in [a.lower() for a in exc]
+
+    def _run(self, job: Job, x, y, train: Frame, valid, lb_frame):
+        p = self.params
+        seed = int(p["seed"] if p["seed"] is not None else -1)
+        ev = self.event_log
+        ev.info("init", f"project {self.project_name}: AutoML build started")
+        budget = _Budget(float(p["max_runtime_secs"] or 0),
+                         int(p["max_models"] or 0))
+
+        # one shared fold assignment for every model (Modulo on a fold col)
+        nfolds = int(p["nfolds"])
+        fold_name = "__automl_fold__"
+        fold = (np.arange(train.nrows) % nfolds).astype(np.float32)
+        work = Frame(list(train.names) + [fold_name],
+                     list(train.vecs) + [Vec(fold)])
+        ev.info("init", f"{nfolds}-fold Modulo CV on a shared fold column")
+
+        from h2o_tpu.models.registry import builder_class
+        common = dict(fold_column=fold_name,
+                      keep_cross_validation_predictions=True, seed=seed)
+        x_cols = [c for c in (x or train.names) if c != y]
+
+        def train_one(algo: str, prm: Dict, step: str):
+            if budget.exhausted():
+                return None
+            prm = dict(prm)
+            prm.update(common)
+            if budget.max_runtime:
+                prm["max_runtime_secs"] = budget.remaining()
+            try:
+                t = time.time()
+                m = builder_class(algo)(**prm).train(
+                    x=x_cols, y=y, training_frame=work,
+                    validation_frame=valid)
+                cloud().dkv.put(m.key, m)
+                budget.n_models += 1
+                self.leaderboard.add(m)
+                ev.info(step, f"{algo} trained in {time.time() - t:.1f}s "
+                              f"-> {m.key}")
+                return m
+            except Exception as e:  # noqa: BLE001 — log + continue the plan
+                ev.info(step, f"{algo} FAILED: {e!r}")
+                return None
+
+        plan = _default_plan(seed)
+        n_steps = len(plan) + 1
+        for i, item in enumerate(plan):
+            job.update(i / n_steps, item["step"])
+            if not self._allowed(item["algo"]) or budget.exhausted():
+                continue
+            if "grid" in item:
+                self._run_grid(item, train_one, seed)
+            else:
+                train_one(item["algo"], item["params"], item["step"])
+
+        # stacked ensembles (best-of-family + all) — skip for regression
+        # only when no CV preds exist
+        job.update(len(plan) / n_steps, "stacked ensembles")
+        if self._allowed("stackedensemble") and \
+                len(self.leaderboard.models) >= 2:
+            self._build_ensembles(train_one, work, y, valid, seed)
+
+        ev.info("done", f"AutoML build done: {budget.n_models} models")
+        return self
+
+    def _run_grid(self, item: Dict, train_one, seed: int) -> None:
+        """Random-discrete mini-grid inside the plan (grids phase)."""
+        names = list(item["grid"])
+        rng = np.random.default_rng(None if seed < 0 else seed)
+        combos = []
+        import itertools
+        for vs in itertools.product(*(item["grid"][n] for n in names)):
+            combos.append(dict(zip(names, vs)))
+        rng.shuffle(combos)
+        for combo in combos[: int(item.get("max_grid_models", 3))]:
+            prm = dict(item["params"])
+            prm.update(combo)
+            train_one(item["algo"], prm, item["step"])
+
+    def _build_ensembles(self, train_one, work: Frame, y: str, valid,
+                         seed: int) -> None:
+        from h2o_tpu.models.ensemble import StackedEnsemble
+        ranked = self.leaderboard.sorted_models()
+        with_cv = [m for m in ranked if m.output.get(
+            "cross_validation_holdout_predictions_frame_id")]
+        if len(with_cv) < 2:
+            return
+        # best of family: best model per algo
+        bof, seen = [], set()
+        for m in with_cv:
+            if m.algo not in seen:
+                bof.append(m)
+                seen.add(m.algo)
+        for name, base in (("BestOfFamily", bof), ("AllModels", with_cv)):
+            if len(base) < 2:
+                continue
+            try:
+                t = time.time()
+                se = StackedEnsemble(
+                    base_models=[str(m.key) for m in base],
+                    seed=seed,
+                    model_id=f"StackedEnsemble_{name}_"
+                             f"{self.project_name}").train(
+                    y=y, training_frame=work, validation_frame=valid)
+                cloud().dkv.put(se.key, se)
+                self.leaderboard.add(se)
+                self.event_log.info(
+                    "ensemble", f"StackedEnsemble {name} trained in "
+                                f"{time.time() - t:.1f}s -> {se.key}")
+            except Exception as e:  # noqa: BLE001
+                self.event_log.info("ensemble",
+                                    f"StackedEnsemble {name} FAILED: {e!r}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"project_name": self.project_name,
+                "leaderboard": self.leaderboard.to_dict(),
+                "event_log": self.event_log.to_dict(),
+                "leader": str(self.leader.key) if self.leader else None}

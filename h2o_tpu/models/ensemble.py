@@ -1,0 +1,157 @@
+"""StackedEnsemble — super learner over base models.
+
+Reference: hex/ensemble/StackedEnsemble.java:38 + Metalearners.java —
+collect base-model cross-validation holdout predictions into a "level-one"
+frame, train a metalearner (GLM default, any algo allowed) on it; scoring
+runs every base model then the metalearner on their predictions.
+
+TPU note: the level-one frame assembly is pure column concatenation of
+already-computed CV holdout prediction frames (each a row-sharded device
+array), so building it costs no recompute; base-model scoring at predict
+time batches through each model's fused predict program.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from h2o_tpu.core.cloud import cloud
+from h2o_tpu.core.frame import Frame, Vec
+from h2o_tpu.models.model import Model, ModelBuilder
+
+
+def _resolve_model(m):
+    if isinstance(m, Model):
+        return m
+    mdl = cloud().dkv.get(str(m))
+    if mdl is None:
+        raise ValueError(f"base model {m} not found in DKV")
+    return mdl
+
+
+def _base_pred_columns(model: Model, raw, nrows: int) -> Dict[str, Vec]:
+    """Level-one columns contributed by one base model's predictions.
+
+    binomial: p(class1); multinomial: all K probs; regression: value
+    (StackedEnsemble.addModelPredictionsToLevelOneFrame)."""
+    name = str(model.key)
+    raw = jnp.asarray(raw)
+    dom = model.output.get("response_domain")
+    if dom is None:
+        return {name: Vec(raw, nrows=nrows)}
+    if len(dom) == 2:
+        return {name: Vec(raw[:, 2], nrows=nrows)}
+    return {f"{name}/{dom[k]}": Vec(raw[:, 1 + k], nrows=nrows)
+            for k in range(len(dom))}
+
+
+class StackedEnsembleModel(Model):
+    algo = "stackedensemble"
+
+    def predict_raw(self, frame: Frame):
+        base_keys = self.output["base_models"]
+        meta = cloud().dkv.get(self.output["metalearner_key"])
+        cols: Dict[str, Vec] = {}
+        for bk in base_keys:
+            bm = _resolve_model(bk)
+            cols.update(_base_pred_columns(bm, bm.predict_raw(frame),
+                                           frame.nrows))
+        l1 = Frame(list(cols), list(cols.values()))
+        return meta.predict_raw(l1)
+
+
+class StackedEnsemble(ModelBuilder):
+    algo = "stackedensemble"
+    model_cls = StackedEnsembleModel
+
+    def default_params(self) -> Dict:
+        p = super().default_params()
+        p.update(base_models=[], metalearner_algorithm="AUTO",
+                 metalearner_params=None, metalearner_nfolds=0,
+                 blending_frame=None)
+        return p
+
+    def _level_one_frame(self, base_models: List[Model], y: str,
+                         train: Frame,
+                         blending: Optional[Frame]) -> Frame:
+        cols: Dict[str, Vec] = {}
+        if blending is not None:
+            # blending (holdout-frame) mode: score base models on it
+            for bm in base_models:
+                cols.update(_base_pred_columns(
+                    bm, bm.predict_raw(blending), blending.nrows))
+            src = blending
+        else:
+            for bm in base_models:
+                fid = bm.output.get(
+                    "cross_validation_holdout_predictions_frame_id")
+                if fid is None:
+                    raise ValueError(
+                        f"base model {bm.key} lacks CV holdout predictions; "
+                        "train with keep_cross_validation_predictions=True "
+                        "or pass a blending_frame")
+                pf = cloud().dkv.get(fid)
+                dom = bm.output.get("response_domain")
+                if dom is None:
+                    cols[str(bm.key)] = pf.vec("predict")
+                elif len(dom) == 2:
+                    cols[str(bm.key)] = pf.vec(dom[1])
+                else:
+                    for d in dom:
+                        cols[f"{bm.key}/{d}"] = pf.vec(d)
+            src = train
+        l1 = Frame(list(cols), list(cols.values()))
+        l1.add(y, src.vec(y))
+        wc = self.params.get("weights_column")
+        if wc and wc in src:
+            l1.add(wc, src.vec(wc))
+        return l1
+
+    def _fit(self, job, x, y, train: Frame, valid: Optional[Frame]):
+        p = self.params
+        base_models = [_resolve_model(m) for m in p["base_models"]]
+        if not base_models:
+            raise ValueError("StackedEnsemble requires base_models")
+        blending = p.get("blending_frame")
+        if isinstance(blending, str):
+            blending = cloud().dkv.get(blending)
+        l1 = self._level_one_frame(base_models, y, train, blending)
+        job.update(0.3, "level-one frame assembled")
+
+        algo = (p.get("metalearner_algorithm") or "AUTO").lower()
+        mp = dict(p.get("metalearner_params") or {})
+        mp.setdefault("seed", p.get("seed", -1))
+        nf = int(p.get("metalearner_nfolds") or 0)
+        if nf:
+            mp["nfolds"] = nf
+        if algo in ("auto", "glm"):
+            from h2o_tpu.models.glm import GLM
+            dom = base_models[0].output.get("response_domain")
+            if dom is not None:
+                mp.setdefault("family",
+                              "binomial" if len(dom) == 2 else "multinomial")
+            # AUTO metalearner: non-negative GLM (Metalearners.java AUTO)
+            mp.setdefault("non_negative", True)
+            builder = GLM(**mp)
+        else:
+            from h2o_tpu.models.registry import builder_class
+            builder = builder_class(algo)(**mp)
+        meta_model = builder.train(y=y, training_frame=l1)
+        cloud().dkv.put(meta_model.key, meta_model)
+        job.update(0.9, "metalearner trained")
+
+        out = dict(
+            base_models=[str(m.key) for m in base_models],
+            metalearner_key=str(meta_model.key),
+            metalearner_algo=builder.algo,
+            response_domain=base_models[0].output.get("response_domain"),
+            x=list(x))
+        model = self.model_cls(self.model_id, dict(p), out)
+        model.params["response_column"] = y
+        model.output["training_metrics"] = model.model_metrics(train)
+        if valid is not None:
+            model.output["validation_metrics"] = model.model_metrics(valid)
+        return model

@@ -1,0 +1,75 @@
+"""Generic — import an external scoring artifact as a first-class Model.
+
+Reference: hex/generic/Generic.java + GenericModel.java (1.3k LoC) — wraps a
+MOJO so it can live in the DKV, serve /3/Predictions, and join ensembles/
+leaderboards like any trained model.
+
+Scoring here routes the frame through the MOJO's pure-numpy scorer on the
+host (artifacts may come from other builds and carry no device program) and
+re-uploads predictions; metrics reuse the standard metric kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from h2o_tpu.core.frame import Frame
+from h2o_tpu.models.model import Model, ModelBuilder
+
+
+class GenericModel(Model):
+    algo = "generic"
+
+    @classmethod
+    def from_mojo(cls, mojo, key: Optional[str] = None) -> "GenericModel":
+        params = dict(mojo.params)
+        out = dict(mojo.meta)
+        out["__arrays__"] = {k: np.asarray(v)
+                             for k, v in mojo.arrays.items()}
+        out["source_algo"] = mojo.algo
+        m = cls(key, params, out)
+        from h2o_tpu.core.cloud import cloud
+        cloud().dkv.put(m.key, m)
+        return m
+
+    def _mojo(self):
+        from h2o_tpu.mojo import MojoModel
+        return MojoModel(self.output["source_algo"], self.params,
+                         {k: v for k, v in self.output.items()
+                          if k != "__arrays__"},
+                         self.output["__arrays__"])
+
+    def predict_raw(self, frame: Frame):
+        mojo = self._mojo()
+        cols = mojo.columns
+        X = np.full((frame.nrows, len(cols)), np.nan, np.float64)
+        for j, c in enumerate(cols):
+            if c in frame:
+                X[:, j] = np.asarray(frame.vec(c).to_numpy(), np.float64)
+        raw = mojo.score_matrix(X)
+        # pad back to the frame's padded shape for the metric kernels
+        pad = frame.padded_rows - frame.nrows
+        raw = np.pad(np.asarray(raw, np.float32),
+                     ((0, pad),) + ((0, 0),) * (raw.ndim - 1))
+        return jnp.asarray(raw)
+
+
+class Generic(ModelBuilder):
+    algo = "generic"
+    model_cls = GenericModel
+    supervised = False
+
+    def default_params(self) -> Dict:
+        p = super().default_params()
+        p.update(path=None)
+        return p
+
+    def train(self, x=None, y=None, training_frame=None,
+              validation_frame=None):
+        from h2o_tpu.mojo import load_mojo
+        assert self.params.get("path"), "Generic requires path to a MOJO"
+        return GenericModel.from_mojo(load_mojo(self.params["path"]),
+                                      key=self.model_id)

@@ -188,13 +188,16 @@ def _irlsm_pass(X, y, w, valid, beta, fam_name: str, tweedie_power=1.5):
     return G, q, dev
 
 
-@functools.partial(jax.jit, static_argnames=("n_sweeps", "intercept_pen"))
+@functools.partial(jax.jit, static_argnames=("n_sweeps", "intercept_pen",
+                                             "non_negative"))
 def _cod_solve(G, q, beta0, lam_l1, lam_l2, n_sweeps: int = 50,
-               intercept_pen: bool = False):
+               intercept_pen: bool = False, non_negative: bool = False):
     """Cyclic coordinate descent on the Gram (elastic net; ADMM/COD analog).
 
     Solves argmin 1/2 b'Gb - q'b + lam_l1|b| + lam_l2/2 |b|^2 with the
-    intercept (last coef) unpenalized.
+    intercept (last coef) unpenalized.  non_negative clamps every
+    non-intercept coefficient at 0 (GLM.java betaConstraints lower bound —
+    the AUTO metalearner's setting).
     """
     P = G.shape[0]
     diag = jnp.diagonal(G)
@@ -208,6 +211,8 @@ def _cod_solve(G, q, beta0, lam_l1, lam_l2, n_sweeps: int = 50,
             l2 = lam_l2 * pen_mask[j]
             bj = jnp.sign(r) * jnp.maximum(jnp.abs(r) - l1, 0.0) / \
                 jnp.maximum(diag[j] + l2, EPS)
+            if non_negative:
+                bj = jnp.where(pen_mask[j] > 0, jnp.maximum(bj, 0.0), bj)
             return b.at[j].set(bj)
         beta = jax.lax.fori_loop(0, P, upd, beta)
         return beta, None
@@ -250,6 +255,8 @@ def expansion_spec(di: DataInfo) -> Dict:
     return dict(
         cat_names=list(di.cat_names),
         cat_cards=[di.frame.vec(c).cardinality for c in di.cat_names],
+        cat_domains=[list(di.frame.vec(c).domain)
+                     for c in di.cat_names],
         num_names=list(di.num_names),
         means=[float(di.frame.vec(c).rollups.mean) for c in di.num_names],
         sigmas=[float(di.frame.vec(c).rollups.sigma) for c in di.num_names],
@@ -385,8 +392,10 @@ class GLM(ModelBuilder):
             n_obs = jnp.maximum(jnp.sum(wa), 1.0)
             l1 = lam * alpha * n_obs
             l2 = lam * (1 - alpha) * n_obs
-            if l1 > 0:
-                beta_new = _cod_solve(G, q, beta, l1, l2)
+            nonneg = bool(p.get("non_negative"))
+            if l1 > 0 or nonneg:
+                beta_new = _cod_solve(G, q, beta, l1, l2,
+                                      non_negative=nonneg)
             else:
                 beta_new = _chol_solve(G, q, l2)
             delta = float(jnp.max(jnp.abs(beta_new - beta)))
@@ -421,8 +430,10 @@ class GLM(ModelBuilder):
                                       "binomial")
                 l1 = lam * alpha * n_obs
                 l2 = lam * (1 - alpha) * n_obs
-                bk = _cod_solve(G, q, betas[k], l1, l2) if l1 > 0 else \
-                    _chol_solve(G, q, l2)
+                nonneg = bool(p.get("non_negative"))
+                bk = _cod_solve(G, q, betas[k], l1, l2,
+                                non_negative=nonneg) \
+                    if (l1 > 0 or nonneg) else _chol_solve(G, q, l2)
                 max_delta = max(max_delta,
                                 float(jnp.max(jnp.abs(bk - betas[k]))))
                 betas = betas.at[k].set(bk)

@@ -1,0 +1,100 @@
+"""Leaderboard — ranked model comparison table.
+
+Reference: h2o-core/src/main/java/hex/leaderboard/Leaderboard.java (ranked by
+CV metric, preference order xval > valid > train) with AutoML extension
+columns (training_time_ms, predict_time_per_row_ms) in
+ai/h2o/automl/leaderboard/.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from h2o_tpu.core.cloud import cloud
+from h2o_tpu.core.store import Key
+from h2o_tpu.models.score_keeper import (is_maximizing, metric_value,
+                                         resolve_stopping_metric)
+
+_EXTRA_BINOMIAL = ("AUC", "logloss", "pr_auc", "mean_per_class_error",
+                   "rmse", "mse")
+_EXTRA_MULTI = ("mean_per_class_error", "logloss", "rmse", "mse")
+_EXTRA_REG = ("mean_residual_deviance", "rmse", "mse", "mae", "rmsle")
+
+
+def _ranking_metrics(model) -> "tuple[object, str]":
+    mm = model.output.get("cross_validation_metrics") or \
+        model.output.get("validation_metrics") or \
+        model.output.get("training_metrics")
+    return mm, mm.kind if mm is not None else "regression"
+
+
+class Leaderboard:
+    """Sorted model table; sort metric resolved from the problem type
+    (AUC for binomial, mean_per_class_error for multinomial, deviance for
+    regression — Leaderboard.java defaults)."""
+
+    def __init__(self, project_name: str = "",
+                 sort_metric: Optional[str] = None):
+        self.key = Key.make(f"leaderboard_{project_name or 'default'}")
+        self.project_name = project_name
+        self.sort_metric = sort_metric
+        self.models: List = []
+
+    def add(self, *models) -> None:
+        seen = {str(m.key) for m in self.models}
+        for m in models:
+            if str(m.key) not in seen:
+                self.models.append(m)
+                seen.add(str(m.key))
+
+    def _resolve_sort(self) -> str:
+        if self.sort_metric:
+            return self.sort_metric
+        if not self.models:
+            return "mse"
+        _, kind = _ranking_metrics(self.models[0])
+        if kind == "binomial":
+            return "auc"
+        if kind == "multinomial":
+            return "mean_per_class_error"
+        return resolve_stopping_metric("AUTO", kind)
+
+    def sorted_models(self) -> List:
+        metric = self._resolve_sort()
+        return sorted(
+            self.models,
+            key=lambda m: metric_value(_ranking_metrics(m)[0], metric),
+            reverse=is_maximizing(metric))
+
+    @property
+    def leader(self):
+        ms = self.sorted_models()
+        return ms[0] if ms else None
+
+    def rows(self) -> List[Dict]:
+        metric = self._resolve_sort()
+        out = []
+        for m in self.sorted_models():
+            mm, kind = _ranking_metrics(m)
+            extras = {"binomial": _EXTRA_BINOMIAL,
+                      "multinomial": _EXTRA_MULTI}.get(kind, _EXTRA_REG)
+            row = {"model_id": str(m.key), "algo": m.algo}
+            for e in extras:
+                row[e.lower()] = metric_value(mm, e)
+            row["training_time_ms"] = getattr(m, "run_time_ms", 0)
+            out.append(row)
+        return out
+
+    def to_dict(self) -> Dict:
+        return {"project_name": self.project_name,
+                "sort_metric": self._resolve_sort(),
+                "models": self.rows()}
+
+    def __repr__(self) -> str:
+        lines = [f"<Leaderboard {self.project_name} "
+                 f"sort={self._resolve_sort()}>"]
+        for r in self.rows():
+            lines.append("  " + "  ".join(
+                f"{k}={v:.5g}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in r.items()))
+        return "\n".join(lines)

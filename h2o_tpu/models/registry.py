@@ -40,6 +40,10 @@ def builders() -> Dict[str, type]:
         reg["isolationforest"] = IsolationForest
     except ImportError:
         pass
+    from h2o_tpu.models.generic import Generic
+    reg["generic"] = Generic
+    from h2o_tpu.models.ensemble import StackedEnsemble
+    reg["stackedensemble"] = StackedEnsemble
     return reg
 
 

@@ -139,6 +139,8 @@ class DRF(ModelBuilder):
                 nbins=binned.nbins, split_col=sc, bitset=bs, value=vl,
                 max_depth=depth,
                 response_domain=di.response_domain if nclass >= 2 else None,
+                domains={c: list(train.vec(c).domain)
+                         for c in di.cat_names},
                 ntrees_actual=prior + n_new)
             model = self.model_cls(self.model_id, dict(p), out)
             model.params["response_column"] = y

@@ -178,6 +178,8 @@ class GBM(ModelBuilder):
                 max_depth=depth, f0=f0_out,
                 distribution_resolved=dist_name,
                 response_domain=di.response_domain if nclass >= 2 else None,
+                domains={c: list(train.vec(c).domain)
+                         for c in di.cat_names},
                 ntrees_actual=prior + n_new)
             model = self.model_cls(self.model_id, dict(p), out)
             model.params["response_column"] = y

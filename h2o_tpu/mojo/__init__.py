@@ -1,0 +1,209 @@
+"""MOJO — Model Object, Optimized: standalone scoring artifacts.
+
+Reference: h2o-genmodel (MojoModel.java, ModelMojoReader, per-algo readers in
+genmodel/algos/{gbm,drf,glm,kmeans,deeplearning,pca}, and
+EasyPredictModelWrapper.java:65) — a zip artifact scoreable WITHOUT a running
+cluster.
+
+This implementation keeps the reference's contract (zip with a ``model.ini``
+manifest + binary payload; standalone scoring with no cluster and no device
+runtime) but stores the payload as ``arrays.npz`` + ``meta.json`` rather than
+the reference's hand-rolled binary sections — the scorers in
+``h2o_tpu.mojo.scorers`` are pure numpy, so a MOJO scores anywhere numpy
+imports (the genmodel-JAR analog).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from h2o_tpu.mojo import scorers
+
+_FORMAT_VERSION = "1.00"
+
+
+def _flatten_arrays(output: Dict) -> (Dict[str, np.ndarray], Dict):
+    """Split model output into npz-able arrays and JSON-able metadata."""
+    arrays: Dict[str, np.ndarray] = {}
+    meta: Dict[str, Any] = {}
+    for k, v in output.items():
+        if k in ("training_metrics", "validation_metrics",
+                 "cross_validation_metrics",
+                 "cross_validation_metrics_summary", "scoring_history"):
+            continue
+        if isinstance(v, np.ndarray):
+            arrays[k] = v
+        elif k == "weights" and isinstance(v, list):     # DL layer list
+            meta["n_layers"] = len(v)
+            for i, layer in enumerate(v):
+                arrays[f"W{i}"] = np.asarray(layer["W"])
+                arrays[f"b{i}"] = np.asarray(layer["b"])
+        else:
+            try:
+                json.dumps(v)
+                meta[k] = v
+            except TypeError:
+                pass
+    return arrays, meta
+
+
+def export_mojo(model, path: str) -> str:
+    """Write a model as a standalone MOJO zip (ModelMojoWriter analog)."""
+    arrays, meta = _flatten_arrays(model.output)
+    params = {}
+    for k, v in model.params.items():
+        try:
+            json.dumps(v)
+            params[k] = v
+        except TypeError:
+            params[k] = str(v)
+    info = {
+        "algorithm": model.algo,
+        "mojo_version": _FORMAT_VERSION,
+        "model_id": str(model.key),
+        "supervised": model.output.get("response_domain") is not None or
+        model.params.get("response_column") is not None,
+    }
+    ini = io.StringIO()
+    ini.write("[info]\n")
+    for k, v in info.items():
+        ini.write(f"{k} = {v}\n")
+    ini.write("\n[columns]\n")
+    for c in meta.get("x", []):
+        ini.write(f"{c}\n")
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("model.ini", ini.getvalue())
+        z.writestr("meta.json", json.dumps(
+            {"info": info, "params": params, "output": meta}, default=str))
+        buf = io.BytesIO()
+        np.savez_compressed(buf, **arrays)
+        z.writestr("arrays.npz", buf.getvalue())
+    return path
+
+
+class MojoModel:
+    """A loaded MOJO: pure-numpy scoring, no cluster required
+    (genmodel MojoModel analog)."""
+
+    def __init__(self, algo: str, params: Dict, meta: Dict,
+                 arrays: Dict[str, np.ndarray]):
+        self.algo = algo
+        self.params = params
+        self.meta = meta
+        self.arrays = arrays
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def columns(self) -> List[str]:
+        return list(self.meta.get("x") or
+                    self._spec_columns())
+
+    def _spec_columns(self) -> List[str]:
+        spec = self.meta.get("expansion_spec") or {}
+        return list(spec.get("cat_names", [])) + \
+            list(spec.get("num_names", []))
+
+    @property
+    def response_domain(self) -> Optional[List[str]]:
+        return self.meta.get("response_domain")
+
+    @property
+    def nclasses(self) -> int:
+        d = self.response_domain
+        return len(d) if d else 1
+
+    def domain_of(self, col: str) -> Optional[List[str]]:
+        doms = self.meta.get("domains") or {}
+        if col in doms:
+            return doms[col]
+        spec = self.meta.get("expansion_spec") or {}
+        for c, d in zip(spec.get("cat_names", []),
+                        spec.get("cat_domains", [])):
+            if c == col:
+                return d
+        return None
+
+    # -- scoring ------------------------------------------------------------
+
+    def score_matrix(self, X: np.ndarray) -> np.ndarray:
+        """Score a (rows, len(columns)) float matrix of raw column values
+        (categoricals as domain codes, NA as NaN).  Returns regression
+        values (rows,) or [label, p0..pK-1] (rows, 1+K)."""
+        fn = getattr(scorers, f"score_{self.algo}", None)
+        if fn is None:
+            raise NotImplementedError(
+                f"no MOJO scorer for algo '{self.algo}'")
+        return fn(self.arrays, self.meta, np.asarray(X, np.float64))
+
+    def predict(self, data) -> np.ndarray:
+        """Score raw tabular data (pandas DataFrame / dict of columns)."""
+        X = _encode(self, data)
+        return self.score_matrix(X)
+
+
+def load_mojo(path: str) -> MojoModel:
+    """Read a MOJO zip (ModelMojoReader analog)."""
+    with zipfile.ZipFile(path) as z:
+        meta_all = json.loads(z.read("meta.json"))
+        with z.open("arrays.npz") as f:
+            npz = np.load(io.BytesIO(f.read()), allow_pickle=False)
+            arrays = {k: npz[k] for k in npz.files}
+    return MojoModel(meta_all["info"]["algorithm"], meta_all["params"],
+                     meta_all["output"], arrays)
+
+
+def import_mojo(path: str):
+    """Import a MOJO as a first-class in-cluster Model (the `generic` algo,
+    reference hex/generic/Generic.java)."""
+    from h2o_tpu.models.generic import GenericModel
+    return GenericModel.from_mojo(load_mojo(path))
+
+
+def _encode(mojo: MojoModel, data) -> np.ndarray:
+    """Raw columns -> codes/float matrix in mojo.columns order.  Unseen
+    categorical levels -> NaN (scored as NA, the EasyPredict
+    convertUnknownCategoricalLevelsToNa behavior)."""
+    cols = {}
+    if hasattr(data, "to_dict") and hasattr(data, "columns"):  # DataFrame
+        cols = {c: np.asarray(data[c]) for c in data.columns}
+    elif isinstance(data, dict):
+        cols = {c: np.atleast_1d(np.asarray(v)) for c, v in data.items()}
+    else:
+        raise TypeError("predict() wants a DataFrame or dict of columns")
+    n = len(next(iter(cols.values()))) if cols else 0
+    X = np.full((n, len(mojo.columns)), np.nan, np.float64)
+    for j, c in enumerate(mojo.columns):
+        if c not in cols:
+            continue                      # missing column -> all NA
+        v = cols[c]
+        dom = mojo.domain_of(c)
+        if dom is not None and v.dtype.kind in "OUS":
+            lut = {s: i for i, s in enumerate(dom)}
+            X[:, j] = [lut.get(str(s), np.nan) for s in v]
+        else:
+            X[:, j] = np.asarray(v, np.float64)
+    return X
+
+
+class EasyPredictModelWrapper:
+    """Row-oriented convenience scorer (EasyPredictModelWrapper.java:65)."""
+
+    def __init__(self, model: MojoModel):
+        self.model = model
+
+    def predict(self, row: Dict[str, Any]) -> Dict[str, Any]:
+        data = {k: [v] for k, v in row.items()}
+        raw = self.model.predict(data)
+        dom = self.model.response_domain
+        if dom is None:
+            return {"value": float(np.ravel(raw)[0])}
+        r = np.atleast_2d(raw)[0]
+        label_idx = int(r[0])
+        return {"label": dom[label_idx],
+                "classProbabilities": [float(p) for p in r[1:]]}

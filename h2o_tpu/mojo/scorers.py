@@ -1,0 +1,189 @@
+"""Pure-numpy MOJO scorers — one per algo.
+
+Reference: h2o-genmodel/src/main/java/hex/genmodel/algos/{gbm,drf,glm,
+kmeans,deeplearning,pca}/*.java — standalone score0 implementations that
+walk the serialized model with no cluster.  Here each scorer replays the
+in-cluster XLA scoring math in numpy so artifacts score on any host.
+
+Input convention: X is (rows, C) float64 of raw column values in training
+order — categoricals as domain codes, NAs as NaN.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+EPS = 1e-15
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _softmax(x):
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    return e / e.sum(axis=1, keepdims=True)
+
+
+def _link_inv(dist: str, f):
+    if dist in ("bernoulli", "quasibinomial", "modified_huber"):
+        return _sigmoid(f)
+    if dist in ("poisson", "gamma", "tweedie"):
+        return np.exp(f)
+    return f
+
+
+# -- trees ------------------------------------------------------------------
+
+def _bin_matrix(X, split_points, is_cat, nbins: int) -> np.ndarray:
+    """Raw values -> bin ids (shared_tree._bin_all in numpy)."""
+    valid_t = ~np.isnan(split_points)                       # (C, B-1)
+    num_bins = ((X[:, :, None] >= split_points[None, :, :]) &
+                valid_t[None, :, :]).sum(axis=2)
+    cat_bins = np.clip(np.nan_to_num(X), 0, nbins - 1).astype(np.int64)
+    b = np.where(is_cat[None, :], cat_bins, num_bins).astype(np.int64)
+    return np.where(np.isnan(X), nbins, b)
+
+
+def _forest_score(bins, split_col, bitset, value, depth: int) -> np.ndarray:
+    """Sum of per-tree leaf values (shared_tree.forest_score in numpy)."""
+    T, K, H = split_col.shape
+    R = bins.shape[0]
+    out = np.zeros((R, K), np.float64)
+    rows = np.arange(R)
+    for t in range(T):
+        for k in range(K):
+            sc, bs, vl = split_col[t, k], bitset[t, k], value[t, k]
+            node = np.zeros(R, np.int64)
+            for _ in range(depth):
+                c = sc[node]
+                term = c < 0
+                b = bins[rows, np.maximum(c, 0)]
+                go_left = bs[node, b]
+                nxt = 2 * node + np.where(go_left, 1, 2)
+                node = np.where(term, node, nxt)
+            out[:, k] += vl[node]
+    return out
+
+
+def _tree_F(arrays: Dict, meta: Dict, X) -> np.ndarray:
+    bins = _bin_matrix(X, arrays["split_points"],
+                       arrays["is_cat"].astype(bool), int(meta["nbins"]))
+    return _forest_score(bins, arrays["split_col"], arrays["bitset"],
+                         arrays["value"], int(meta["max_depth"]))
+
+
+def _classify(F, dom):
+    if dom is None:
+        return F[:, 0]
+    if len(dom) == 2:
+        p1 = F[:, 0]
+        return np.stack([(p1 >= 0.5).astype(np.float64), 1 - p1, p1],
+                        axis=1)
+    label = np.argmax(F, axis=1).astype(np.float64)
+    return np.concatenate([label[:, None], F], axis=1)
+
+
+def score_gbm(arrays, meta, X):
+    F = _tree_F(arrays, meta, X) + arrays["f0"][None, :]
+    dom = meta.get("response_domain")
+    if dom is None:
+        return _link_inv(meta["distribution_resolved"], F[:, 0])
+    if len(dom) == 2:
+        return _classify(_sigmoid(F), dom)
+    return _classify(_softmax(F), dom)
+
+
+def score_drf(arrays, meta, X):
+    F = _tree_F(arrays, meta, X) / max(int(meta["ntrees_actual"]), 1)
+    dom = meta.get("response_domain")
+    if dom is None:
+        return F[:, 0]
+    if len(dom) == 2:
+        p1 = np.clip(F[:, 0], 0.0, 1.0)
+        return np.stack([(p1 >= 0.5).astype(np.float64), 1 - p1, p1],
+                        axis=1)
+    P = np.maximum(F, 0.0)
+    P = P / np.maximum(P.sum(axis=1, keepdims=True), EPS)
+    return _classify(P, dom)
+
+
+# -- expanded-matrix models -------------------------------------------------
+
+def _expand(meta: Dict, X) -> np.ndarray:
+    """Apply the training expansion spec (one-hot + impute + standardize)
+    to raw columns (glm.expand_for_scoring in numpy)."""
+    spec = meta["expansion_spec"]
+    cols = []
+    # X columns arrive in MojoModel.columns order: meta["x"] when the model
+    # recorded it, else spec order (cats first) — must match the encoder
+    order = list(meta.get("x") or
+                 (list(spec["cat_names"]) + list(spec["num_names"])))
+    pos = {c: i for i, c in enumerate(order)}
+    for c, card in zip(spec["cat_names"], spec["cat_cards"]):
+        codes = X[:, pos[c]]
+        lo = 0 if spec["use_all_factor_levels"] else 1
+        for k in range(lo, card):
+            cols.append((codes == k).astype(np.float64))
+    for c, mean, sigma in zip(spec["num_names"], spec["means"],
+                              spec["sigmas"]):
+        d = np.nan_to_num(X[:, pos[c]], nan=float(mean))
+        if spec["standardize"]:
+            d = (d - mean) / (sigma or 1.0)
+        cols.append(d)
+    return np.stack(cols, axis=1) if cols else np.zeros((X.shape[0], 0))
+
+
+def score_glm(arrays, meta, X):
+    Xe = _expand(meta, X)
+    dom = meta.get("response_domain")
+    if meta.get("is_multinomial"):
+        B = arrays["beta_multinomial"]                   # (K, P+1)
+        eta = Xe @ B[:, :-1].T + B[:, -1][None, :]
+        return _classify(_softmax(eta), dom)
+    beta = arrays["beta"]
+    eta = Xe @ beta[:-1] + beta[-1]
+    fam = meta["family_resolved"]
+    mu = _sigmoid(eta) if fam in ("binomial", "quasibinomial") else \
+        (np.exp(eta) if fam in ("poisson", "gamma", "tweedie") else eta)
+    if dom is not None:
+        return np.stack([(mu >= 0.5).astype(np.float64), 1 - mu, mu],
+                        axis=1)
+    return mu
+
+
+def score_kmeans(arrays, meta, X):
+    Xe = _expand(meta, X)
+    centers = arrays["centers_std"]
+    d2 = (Xe * Xe).sum(1, keepdims=True) - 2 * Xe @ centers.T + \
+        (centers * centers).sum(1)[None, :]
+    return np.argmin(d2, axis=1).astype(np.float64)
+
+
+def score_deeplearning(arrays, meta, X):
+    Xe = _expand(meta, X)
+    n = int(meta["n_layers"])
+    act = meta["activation"].lower()
+    h = Xe
+    for i in range(n):
+        h = h @ arrays[f"W{i}"] + arrays[f"b{i}"]
+        if i < n - 1:
+            if "tanh" in act:
+                h = np.tanh(h)
+            else:                       # rectifier / maxout fallback
+                h = np.maximum(h, 0.0)
+    dom = meta.get("response_domain")
+    if dom is None:
+        return _link_inv(meta["distribution_resolved"], h[:, 0])
+    P = _softmax(h)
+    if len(dom) == 2:
+        return np.stack([(P[:, 1] >= 0.5).astype(np.float64),
+                         P[:, 0], P[:, 1]], axis=1)
+    return _classify(P, dom)
+
+
+def score_pca(arrays, meta, X):
+    Xe = _expand(meta, X)
+    return Xe @ arrays["eigenvectors"]

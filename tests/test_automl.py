@@ -1,0 +1,95 @@
+"""StackedEnsemble + Leaderboard + AutoML (reference: hex/ensemble/*,
+hex/leaderboard/Leaderboard.java, ai/h2o/automl/AutoML.java)."""
+
+import numpy as np
+import pytest
+
+from h2o_tpu.core.frame import Frame, Vec, T_CAT
+
+
+@pytest.fixture()
+def bin_frame(rng):
+    n = 2000
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    logits = 1.5 * X[:, 0] - X[:, 1] + X[:, 2] * X[:, 3]
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.int32)
+    return Frame([f"x{j}" for j in range(5)] + ["y"],
+                 [Vec(X[:, j]) for j in range(5)] +
+                 [Vec(y, T_CAT, domain=["no", "yes"])])
+
+
+def test_stacked_ensemble_cv_mode(cl, bin_frame):
+    from h2o_tpu.models.ensemble import StackedEnsemble
+    from h2o_tpu.models.glm import GLM
+    from h2o_tpu.models.tree.gbm import GBM
+    fr = bin_frame
+    fold = Vec((np.arange(fr.nrows) % 3).astype(np.float32))
+    fr.add("fold", fold)
+    common = dict(fold_column="fold", keep_cross_validation_predictions=True,
+                  seed=1)
+    gbm = GBM(ntrees=10, max_depth=3, **common).train(
+        y="y", training_frame=fr)
+    glm = GLM(family="binomial", **common).train(y="y", training_frame=fr)
+    se = StackedEnsemble(base_models=[gbm, glm], seed=1).train(
+        y="y", training_frame=fr)
+    auc_se = se.output["training_metrics"]["AUC"]
+    auc_glm = glm.output["training_metrics"]["AUC"]
+    assert auc_se >= auc_glm - 0.01     # ensemble >= weakest-ish base
+    assert se.output["metalearner_algo"] == "glm"
+    raw = np.asarray(se.predict_raw(fr))
+    assert raw.shape[1] == 3
+
+
+def test_stacked_ensemble_blending_mode(cl, bin_frame, rng):
+    from h2o_tpu.models.ensemble import StackedEnsemble
+    from h2o_tpu.models.tree.drf import DRF
+    from h2o_tpu.models.tree.gbm import GBM
+    fr = bin_frame
+    gbm = GBM(ntrees=8, max_depth=3, seed=2).train(y="y", training_frame=fr)
+    drf = DRF(ntrees=8, max_depth=4, seed=2).train(y="y", training_frame=fr)
+    se = StackedEnsemble(base_models=[gbm, drf], blending_frame=fr,
+                         seed=2).train(y="y", training_frame=fr)
+    assert se.output["training_metrics"]["AUC"] > 0.7
+
+
+def test_leaderboard_ranking(cl, bin_frame):
+    from h2o_tpu.models.glm import GLM
+    from h2o_tpu.models.leaderboard import Leaderboard
+    from h2o_tpu.models.tree.gbm import GBM
+    fr = bin_frame
+    m1 = GBM(ntrees=10, max_depth=3, seed=1).train(y="y", training_frame=fr)
+    m2 = GLM(family="binomial").train(y="y", training_frame=fr)
+    lb = Leaderboard("t")
+    lb.add(m1, m2)
+    rows = lb.rows()
+    assert len(rows) == 2
+    assert rows[0]["auc"] >= rows[1]["auc"]   # binomial sorts by AUC desc
+    assert lb.leader is not None
+
+
+def test_automl_end_to_end(cl, bin_frame):
+    from h2o_tpu.automl import AutoML
+    aml = AutoML(max_models=4, seed=42, nfolds=3,
+                 include_algos=["glm", "gbm", "stackedensemble"],
+                 project_name="t1")
+    aml.train(y="y", training_frame=bin_frame)
+    assert aml.leader is not None
+    rows = aml.leaderboard.rows()
+    assert len(rows) >= 2
+    # CV metric ordering respected
+    aucs = [r["auc"] for r in rows]
+    assert aucs == sorted(aucs, reverse=True)
+    # events recorded
+    stages = {e["stage"] for e in aml.event_log.events}
+    assert "init" in stages and "done" in stages
+    d = aml.to_dict()
+    assert d["leader"] == str(aml.leader.key)
+
+
+def test_glm_non_negative(cl, bin_frame):
+    from h2o_tpu.models.glm import GLM
+    m = GLM(family="binomial", non_negative=True).train(
+        y="y", training_frame=bin_frame)
+    coefs = m.coef()
+    non_int = [v for k, v in coefs.items() if k != "Intercept"]
+    assert all(v >= -1e-8 for v in non_int)
