@@ -151,6 +151,9 @@ class AutoML:
         seed = int(p["seed"] if p["seed"] is not None else -1)
         ev = self.event_log
         ev.info("init", f"project {self.project_name}: AutoML build started")
+        if lb_frame is not None:
+            self.leaderboard.leaderboard_frame = lb_frame
+            ev.info("init", f"ranking on leaderboard frame {lb_frame.key}")
         budget = _Budget(float(p["max_runtime_secs"] or 0),
                          int(p["max_models"] or 0))
 
@@ -205,7 +208,7 @@ class AutoML:
         job.update(len(plan) / n_steps, "stacked ensembles")
         if self._allowed("stackedensemble") and \
                 len(self.leaderboard.models) >= 2:
-            self._build_ensembles(train_one, work, y, valid, seed)
+            self._build_ensembles(budget, work, y, valid, seed)
 
         ev.info("done", f"AutoML build done: {budget.n_models} models")
         return self
@@ -224,7 +227,7 @@ class AutoML:
             prm.update(combo)
             train_one(item["algo"], prm, item["step"])
 
-    def _build_ensembles(self, train_one, work: Frame, y: str, valid,
+    def _build_ensembles(self, budget: _Budget, work: Frame, y: str, valid,
                          seed: int) -> None:
         from h2o_tpu.models.ensemble import StackedEnsemble
         ranked = self.leaderboard.sorted_models()
@@ -241,15 +244,22 @@ class AutoML:
         for name, base in (("BestOfFamily", bof), ("AllModels", with_cv)):
             if len(base) < 2:
                 continue
+            if budget.max_runtime and budget.remaining() <= 0:
+                self.event_log.info(
+                    "ensemble", f"StackedEnsemble {name} skipped: "
+                                "runtime budget exhausted")
+                continue
             try:
                 t = time.time()
                 se = StackedEnsemble(
                     base_models=[str(m.key) for m in base],
                     seed=seed,
+                    max_runtime_secs=budget.remaining(),
                     model_id=f"StackedEnsemble_{name}_"
                              f"{self.project_name}").train(
                     y=y, training_frame=work, validation_frame=valid)
                 cloud().dkv.put(se.key, se)
+                budget.n_models += 1
                 self.leaderboard.add(se)
                 self.event_log.info(
                     "ensemble", f"StackedEnsemble {name} trained in "

@@ -154,4 +154,13 @@ class StackedEnsemble(ModelBuilder):
         model.output["training_metrics"] = model.model_metrics(train)
         if valid is not None:
             model.output["validation_metrics"] = model.model_metrics(valid)
+        # honest metrics: metalearner scored on the level-one frame, whose
+        # base columns are out-of-fold (CV holdout) or out-of-sample
+        # (blending) predictions — comparable to base models' CV metrics on
+        # a leaderboard, unlike the optimistic in-sample training_metrics
+        honest = model.metrics_from_raw(meta_model.predict_raw(l1), l1)
+        if blending is None:
+            model.output["cross_validation_metrics"] = honest
+        elif "validation_metrics" not in model.output:
+            model.output["validation_metrics"] = honest
         return model

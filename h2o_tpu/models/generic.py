@@ -48,7 +48,13 @@ class GenericModel(Model):
         X = np.full((frame.nrows, len(cols)), np.nan, np.float64)
         for j, c in enumerate(cols):
             if c in frame:
-                X[:, j] = np.asarray(frame.vec(c).to_numpy(), np.float64)
+                v = frame.vec(c)
+                col = np.asarray(v.to_numpy(), np.float64)
+                if v.is_categorical:
+                    # score_matrix's NA convention is NaN; the frame's
+                    # categorical NA sentinel is code -1
+                    col = np.where(col < 0, np.nan, col)
+                X[:, j] = col
         raw = mojo.score_matrix(X)
         # pad back to the frame's padded shape for the metric kernels
         pad = frame.padded_rows - frame.nrows

@@ -34,11 +34,27 @@ class Leaderboard:
     regression — Leaderboard.java defaults)."""
 
     def __init__(self, project_name: str = "",
-                 sort_metric: Optional[str] = None):
+                 sort_metric: Optional[str] = None,
+                 leaderboard_frame=None):
         self.key = Key.make(f"leaderboard_{project_name or 'default'}")
         self.project_name = project_name
         self.sort_metric = sort_metric
+        self.leaderboard_frame = leaderboard_frame
+        self._lb_metrics: Dict[str, object] = {}
         self.models: List = []
+
+    def _metrics_for(self, model) -> "tuple[object, str]":
+        """Ranking metrics: scored on the dedicated leaderboard frame when
+        one is set (Leaderboard.java leaderboardFrame), else the usual
+        xval > valid > train preference."""
+        if self.leaderboard_frame is None:
+            return _ranking_metrics(model)
+        k = str(model.key)
+        if k not in self._lb_metrics:
+            self._lb_metrics[k] = model.model_metrics(
+                self.leaderboard_frame)
+        mm = self._lb_metrics[k]
+        return mm, mm.kind
 
     def add(self, *models) -> None:
         seen = {str(m.key) for m in self.models}
@@ -52,7 +68,7 @@ class Leaderboard:
             return self.sort_metric
         if not self.models:
             return "mse"
-        _, kind = _ranking_metrics(self.models[0])
+        _, kind = self._metrics_for(self.models[0])
         if kind == "binomial":
             return "auc"
         if kind == "multinomial":
@@ -63,7 +79,7 @@ class Leaderboard:
         metric = self._resolve_sort()
         return sorted(
             self.models,
-            key=lambda m: metric_value(_ranking_metrics(m)[0], metric),
+            key=lambda m: metric_value(self._metrics_for(m)[0], metric),
             reverse=is_maximizing(metric))
 
     @property
@@ -75,7 +91,7 @@ class Leaderboard:
         metric = self._resolve_sort()
         out = []
         for m in self.sorted_models():
-            mm, kind = _ranking_metrics(m)
+            mm, kind = self._metrics_for(m)
             extras = {"binomial": _EXTRA_BINOMIAL,
                       "multinomial": _EXTRA_MULTI}.get(kind, _EXTRA_REG)
             row = {"model_id": str(m.key), "algo": m.algo}

@@ -1,0 +1,152 @@
+"""NaiveBayes — per-class conditional probability tables.
+
+Reference (hex/naivebayes/NaiveBayes.java, NaiveBayesModel.java): one MRTask
+accumulates, per response class, counts for every categorical predictor level
+and (sum, sum-of-squares) for every numeric predictor; the model stores the
+class priors (``apriori``) and per-predictor conditional tables (``pcond``):
+categorical → Laplace-smoothed level frequencies, numeric → Gaussian
+(mean, sd) with a ``min_sdev``/``eps_sdev`` floor.  Scoring sums log priors
+and log conditionals, skipping NA predictor values, and floors each
+conditional probability at ``min_prob``/``eps_prob``.
+
+TPU-native: the count MRTask becomes two one-hot matmuls on the MXU —
+``Y_onehot.T @ X_onehot`` for categorical levels and ``Y_onehot.T @ [X, X²]``
+for numeric moments — reduced over row shards by the implicit psum of the
+row sharding.  Scoring is one fused gather + logsumexp program.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o_tpu.core.frame import Frame
+from h2o_tpu.models.model import DataInfo, Model, ModelBuilder
+
+EPS = 1e-30
+SQRT_2PI = float(np.sqrt(2.0 * np.pi))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "card"))
+def _cat_counts(codes, y, w, k: int, card: int):
+    """(k, card) weighted level counts for one categorical predictor."""
+    yh = ((y[:, None] == jnp.arange(k)[None, :]) * w[:, None]).astype(
+        jnp.float32)                                        # (R, k)
+    xh = (codes[:, None] == jnp.arange(card)[None, :]).astype(jnp.float32)
+    return yh.T @ xh                                        # MXU
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _num_moments(X, y, w, k: int):
+    """Per-class (count, sum, sum-of-squares) for all numeric predictors
+    at once: returns (k, C) each.  NA cells contribute nothing."""
+    ok = ~jnp.isnan(X)
+    x0 = jnp.where(ok, X, 0.0)
+    yh = ((y[:, None] == jnp.arange(k)[None, :]) * w[:, None]).astype(
+        jnp.float32)                                        # (R, k)
+    cnt = yh.T @ ok.astype(jnp.float32)
+    s1 = yh.T @ x0
+    s2 = yh.T @ (x0 * x0)
+    return cnt, s1, s2
+
+
+class NaiveBayesModel(Model):
+    algo = "naivebayes"
+
+    def predict_raw(self, frame: Frame):
+        out = self.output
+        p = self.params
+        k = len(out["response_domain"])
+        log_prior = jnp.log(jnp.asarray(out["apriori"], jnp.float32) + EPS)
+        R = frame.padded_rows
+        ll = jnp.broadcast_to(log_prior[None, :], (R, k))
+        min_prob = float(p.get("min_prob") or 1e-3)
+        eps_prob = float(p.get("eps_prob") or 0.0)
+        floor_p = min_prob if eps_prob <= 0 else eps_prob
+        for name, tab in out["pcond_cat"].items():
+            codes = frame.vec(name).data
+            t = jnp.asarray(tab, jnp.float32)               # (k, card)
+            t = jnp.maximum(t, floor_p)
+            safe = jnp.clip(codes, 0, t.shape[1] - 1)
+            contrib = jnp.log(t[:, safe]).T                 # (R, k)
+            ll = ll + jnp.where((codes >= 0)[:, None], contrib, 0.0)
+        if out["num_names"]:
+            X = frame.as_matrix(out["num_names"])
+            mu = jnp.asarray(out["num_mean"], jnp.float32)  # (k, C)
+            sd = jnp.asarray(out["num_sd"], jnp.float32)
+            z = (X[:, None, :] - mu[None, :, :]) / sd[None, :, :]
+            pdf = jnp.exp(-0.5 * z * z) / (SQRT_2PI * sd[None, :, :])
+            pdf = jnp.maximum(pdf, floor_p)
+            ll = ll + jnp.sum(jnp.where(jnp.isnan(X)[:, None, :], 0.0,
+                                        jnp.log(pdf)), axis=2)
+        probs = jax.nn.softmax(ll, axis=1)
+        label = jnp.argmax(probs, axis=1).astype(jnp.float32)
+        return jnp.concatenate([label[:, None], probs], axis=1)
+
+
+class NaiveBayes(ModelBuilder):
+    algo = "naivebayes"
+    model_cls = NaiveBayesModel
+
+    def default_params(self) -> Dict:
+        p = super().default_params()
+        p.update(laplace=0.0, min_sdev=1e-3, eps_sdev=0.0, min_prob=1e-3,
+                 eps_prob=0.0, compute_metrics=True)
+        return p
+
+    def _fit(self, job, x, y, train: Frame, valid: Optional[Frame]):
+        p = self.params
+        di = DataInfo(train, x, y, mode="tree",
+                      weights=p.get("weights_column"))
+        if di.nclasses < 2:
+            raise ValueError("NaiveBayes requires a categorical response")
+        k = di.nclasses
+        yv = di.response()
+        w = jnp.where(di.valid_mask(), di.weights(), 0.0)
+        yz = jnp.nan_to_num(yv)
+        laplace = float(p["laplace"])
+        min_sdev = float(p["min_sdev"])
+        sdev_floor = float(p["eps_sdev"]) if float(p["eps_sdev"]) > 0 \
+            else min_sdev
+
+        # class priors (relative frequencies, NaiveBayes.java apriori)
+        cls_w = np.asarray(jnp.sum(
+            (yz[:, None] == jnp.arange(k)[None, :]) * w[:, None], axis=0))
+        apriori = cls_w / max(cls_w.sum(), EPS)
+
+        pcond_cat: Dict[str, np.ndarray] = {}
+        for name in di.cat_names:
+            v = train.vec(name)
+            cnt = np.asarray(_cat_counts(v.data, yz, w, k, v.cardinality))
+            tab = (cnt + laplace) / np.maximum(
+                cnt.sum(axis=1, keepdims=True) + laplace * v.cardinality,
+                EPS)
+            pcond_cat[name] = tab.astype(np.float32)
+
+        num_mean = num_sd = None
+        if di.num_names:
+            X = train.as_matrix(di.num_names)
+            cnt, s1, s2 = map(np.asarray, _num_moments(X, yz, w, k))
+            num_mean = s1 / np.maximum(cnt, EPS)
+            var = s2 / np.maximum(cnt, EPS) - num_mean ** 2
+            var = var * cnt / np.maximum(cnt - 1, 1)  # sample variance
+            num_sd = np.maximum(np.sqrt(np.maximum(var, 0.0)), sdev_floor)
+
+        out = dict(x=list(di.x), response_domain=di.response_domain,
+                   apriori=apriori.astype(np.float32),
+                   pcond_cat=pcond_cat, num_names=list(di.num_names),
+                   num_mean=num_mean, num_sd=num_sd,
+                   domains={c: list(train.vec(c).domain)
+                            for c in di.cat_names})
+        model = self.model_cls(self.model_id, dict(p), out)
+        model.params["response_column"] = y
+        if p.get("compute_metrics", True):
+            model.output["training_metrics"] = model.model_metrics(train)
+            if valid is not None:
+                model.output["validation_metrics"] = \
+                    model.model_metrics(valid)
+        return model

@@ -36,8 +36,10 @@ def builders() -> Dict[str, type]:
     except ImportError:
         pass
     try:
-        from h2o_tpu.models.tree.isofor import IsolationForest
+        from h2o_tpu.models.tree.isofor import (ExtendedIsolationForest,
+                                                IsolationForest)
         reg["isolationforest"] = IsolationForest
+        reg["extendedisolationforest"] = ExtendedIsolationForest
     except ImportError:
         pass
     from h2o_tpu.models.generic import Generic

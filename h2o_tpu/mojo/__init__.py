@@ -53,7 +53,14 @@ def _flatten_arrays(output: Dict) -> (Dict[str, np.ndarray], Dict):
 
 
 def export_mojo(model, path: str) -> str:
-    """Write a model as a standalone MOJO zip (ModelMojoWriter analog)."""
+    """Write a model as a standalone MOJO zip (ModelMojoWriter analog).
+
+    Fails fast for algos without a standalone scorer — exporting would
+    produce an artifact that load_mojo can open but never score."""
+    if getattr(scorers, f"score_{model.algo}", None) is None:
+        raise NotImplementedError(
+            f"algo '{model.algo}' has no MOJO scorer; supported: "
+            f"{sorted(n[6:] for n in dir(scorers) if n.startswith('score_'))}")
     arrays, meta = _flatten_arrays(model.output)
     params = {}
     for k, v in model.params.items():

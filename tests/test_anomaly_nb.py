@@ -1,0 +1,110 @@
+"""NaiveBayes + IsolationForest / ExtendedIsolationForest tests."""
+
+import numpy as np
+
+from tests.test_algos import _frame_from
+
+
+def test_naive_bayes_gaussian_separation(cl, rng):
+    from h2o_tpu.models.naive_bayes import NaiveBayes
+    n = 2000
+    y = rng.integers(0, 2, n)
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    X[:, 0] += 3.0 * y          # informative feature
+    fr = _frame_from(X, y, y_domain=["a", "b"])
+    m = NaiveBayes().train(y="y", training_frame=fr)
+    mm = m.output["training_metrics"]
+    assert mm["AUC"] > 0.95
+    # per-class means of x0 should straddle the shift
+    mu = np.asarray(m.output["num_mean"])
+    assert mu[1, 0] - mu[0, 0] > 2.5
+
+
+def test_naive_bayes_categorical_tables(cl, rng):
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT
+    from h2o_tpu.models.naive_bayes import NaiveBayes
+    n = 3000
+    y = rng.integers(0, 2, n)
+    # categorical predictor correlated with y
+    c = np.where(rng.uniform(size=n) < 0.8, y, rng.integers(0, 2, n))
+    fr = Frame(["c1", "y"],
+               [Vec(c.astype(np.int32), T_CAT, domain=["u", "v"]),
+                Vec(y.astype(np.int32), T_CAT, domain=["n", "p"])])
+    m = NaiveBayes(laplace=1.0).train(y="y", training_frame=fr)
+    tab = m.output["pcond_cat"]["c1"]
+    assert tab.shape == (2, 2)
+    # P(c1=v | y=p) should be ~0.9 ((0.8 + 0.2*0.5))
+    assert 0.8 < tab[1, 1] < 1.0
+    np.testing.assert_allclose(tab.sum(axis=1), 1.0, atol=1e-5)
+    raw = np.asarray(m.predict_raw(fr))[:n]
+    acc = float((raw[:, 0] == y).mean())
+    assert acc > 0.75
+
+
+def test_naive_bayes_sklearn_parity(cl, rng):
+    from sklearn.naive_bayes import GaussianNB
+    from h2o_tpu.models.naive_bayes import NaiveBayes
+    n = 1500
+    y = rng.integers(0, 3, n)
+    X = (rng.normal(size=(n, 4)) + y[:, None]).astype(np.float32)
+    fr = _frame_from(X, y, y_domain=["a", "b", "c"])
+    m = NaiveBayes(min_prob=1e-10, min_sdev=1e-10).train(
+        y="y", training_frame=fr)
+    sk = GaussianNB().fit(X, y)
+    ours = np.asarray(m.predict_raw(fr))[:n, 1:]
+    theirs = sk.predict_proba(X)
+    agree = float((ours.argmax(1) == theirs.argmax(1)).mean())
+    assert agree > 0.98
+
+
+def test_isolation_forest_finds_outliers(cl, rng):
+    from h2o_tpu.models.tree.isofor import IsolationForest
+    n = 1000
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    X[:20] += 8.0               # planted anomalies
+    fr = _frame_from(X)
+    m = IsolationForest(ntrees=60, seed=7).train(training_frame=fr)
+    pred = m.predict(fr)
+    score = pred.vec("predict").to_numpy()
+    assert len(score) == n
+    # anomalies should rank in the top tail
+    top = np.argsort(-score)[:40]
+    hits = len(set(top) & set(range(20)))
+    assert hits >= 15, f"only {hits}/20 planted outliers in top-40"
+    assert 0 <= score.min() and score.max() <= 1.0 + 1e-6
+    assert m.output["max_path_length"] > m.output["min_path_length"]
+
+
+def test_isolation_forest_mean_length_semantics(cl, rng):
+    from h2o_tpu.models.tree.isofor import IsolationForest
+    X = rng.normal(size=(500, 3)).astype(np.float32)
+    fr = _frame_from(X)
+    T = 30
+    m = IsolationForest(ntrees=T, seed=1).train(training_frame=fr)
+    pred = m.predict(fr)
+    ml = pred.vec("mean_length").to_numpy()
+    assert (ml >= 0).all() and (ml <= m.output["max_depth"]).all()
+
+
+def test_extended_isolation_forest(cl, rng):
+    from h2o_tpu.models.tree.isofor import ExtendedIsolationForest
+    n = 800
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    X[:15] += 7.0
+    fr = _frame_from(X)
+    m = ExtendedIsolationForest(ntrees=80, extension_level=2, seed=3).train(
+        training_frame=fr)
+    pred = m.predict(fr)
+    score = pred.vec("anomaly_score").to_numpy()
+    assert (score > 0).all() and (score < 1).all()
+    top = np.argsort(-score)[:30]
+    hits = len(set(top) & set(range(15)))
+    assert hits >= 11, f"only {hits}/15 planted outliers in top-30"
+
+
+def test_registry_has_anomaly_and_nb(cl):
+    from h2o_tpu.models.registry import builders
+    b = builders()
+    for algo in ("naivebayes", "isolationforest",
+                 "extendedisolationforest"):
+        assert algo in b
