@@ -1,0 +1,267 @@
+"""GLRM — Generalized Low Rank Models via alternating proximal gradient.
+
+Reference (hex/glrm/GLRM.java:52,398,874): A ≈ X·Y with per-column losses
+(GlrmLoss.java: Quadratic/Absolute/Huber/Poisson/Periodic/Logistic/Hinge/
+Categorical/Ordinal) and X/Y regularizers (GlrmRegularizer.java: None/
+Quadratic/L1/NonNegative/OneSparse/UnitOneSparse/Simplex); updates alternate
+X (an MRTask over row chunks) and Y (reduced across nodes) with an adaptive
+step size (grow on success, halve + revert on failure).
+
+TPU-native: X is a row-sharded (R, k) device array (the per-chunk X blocks),
+Y a replicated (k, P) array; one jitted program computes the masked loss,
+both gradients and the prox updates — the X-update's row parallelism and the
+Y-update's cross-node reduce both come from the row sharding's implicit psum.
+Categorical columns enter through one-hot expansion with quadratic loss
+(the reference's multi-loss Categorical is a one-vs-all hinge on the same
+expansion; quadratic keeps the objective smooth and the MXU busy).
+Missing cells contribute zero loss — exactly the reference's NA handling.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o_tpu.core.frame import Frame, Vec
+from h2o_tpu.models import metrics as mm
+from h2o_tpu.models.model import DataInfo, Model, ModelBuilder
+from h2o_tpu.models.glm import expand_for_scoring, expansion_spec
+
+EPS = 1e-10
+
+
+def _loss_fn(name: str):
+    name = (name or "Quadratic").lower()
+
+    def quadratic(u, a):
+        return (u - a) ** 2
+
+    def absolute(u, a):
+        return jnp.abs(u - a)
+
+    def huber(u, a):
+        d = u - a
+        return jnp.where(jnp.abs(d) <= 1.0, 0.5 * d * d,
+                         jnp.abs(d) - 0.5)
+
+    def poisson(u, a):
+        # L(u, a) = exp(u) - a*u (+ const); u is the log-rate
+        return jnp.exp(u) - a * u
+
+    def logistic(u, a):
+        return jnp.log1p(jnp.exp(-(2 * a - 1) * u))
+
+    def hinge(u, a):
+        return jnp.maximum(1.0 - (2 * a - 1) * u, 0.0)
+
+    return dict(quadratic=quadratic, absolute=absolute, huber=huber,
+                poisson=poisson, logistic=logistic, hinge=hinge)[name]
+
+
+def _prox(name: str):
+    """Proximal operator of step * gamma * r(.)  (GlrmRegularizer.rproxgrad)."""
+    name = (name or "None").lower()
+
+    def none(u, sg):
+        return u
+
+    def quadratic(u, sg):
+        return u / (1.0 + 2.0 * sg)
+
+    def l1(u, sg):
+        return jnp.sign(u) * jnp.maximum(jnp.abs(u) - sg, 0.0)
+
+    def non_negative(u, sg):
+        return jnp.maximum(u, 0.0)
+
+    return dict(none=none, quadratic=quadratic, l1=l1,
+                nonnegative=non_negative, non_negative=non_negative)[name]
+
+
+def _make_step(loss_name: str, rx: str, ry: str):
+    loss = _loss_fn(loss_name)
+    prox_x, prox_y = _prox(rx), _prox(ry)
+
+    @jax.jit
+    def objective(X, Y, A, mask, gx, gy):
+        U = X @ Y
+        lo = jnp.sum(jnp.where(mask, loss(U, jnp.nan_to_num(A)), 0.0))
+        reg_term = jnp.float32(0.0)
+        if rx.lower() == "quadratic":
+            reg_term += gx * jnp.sum(X * X)
+        elif rx.lower() == "l1":
+            reg_term += gx * jnp.sum(jnp.abs(X))
+        if ry.lower() == "quadratic":
+            reg_term += gy * jnp.sum(Y * Y)
+        elif ry.lower() == "l1":
+            reg_term += gy * jnp.sum(jnp.abs(Y))
+        return lo + reg_term
+
+    def smooth(X, Y, A, mask):
+        U = X @ Y
+        return jnp.sum(jnp.where(mask, loss(U, jnp.nan_to_num(A)), 0.0))
+
+    @jax.jit
+    def step(X, Y, A, mask, alpha, gx, gy):
+        gX = jax.grad(smooth, argnums=0)(X, Y, A, mask)
+        Xn = prox_x(X - alpha * gX, alpha * gx)
+        gY = jax.grad(smooth, argnums=1)(Xn, Y, A, mask)
+        Yn = prox_y(Y - alpha * gY, alpha * gy)
+        return Xn, Yn
+
+    return objective, step
+
+
+class GLRMModel(Model):
+    algo = "glrm"
+    supervised = False
+
+    def _solve_x(self, A, mask, iters: int = 30):
+        """Fit X for new rows with Y fixed (GLRMGenX scoring analog)."""
+        out = self.output
+        Y = jnp.asarray(out["archetypes"])
+        _, step = _make_step(out["loss"], out["regularization_x"], "none")
+        X = jnp.zeros((A.shape[0], Y.shape[0]), jnp.float32)
+        alpha = 1.0 / max(float(np.asarray(
+            jnp.sum(Y * Y))), 1.0)
+        gx = jnp.float32(out["gamma_x"])
+        for _ in range(iters):
+            gX = jax.grad(lambda X_: jnp.sum(jnp.where(
+                mask, _loss_fn(out["loss"])(X_ @ Y, jnp.nan_to_num(A)),
+                0.0)))(X)
+            X = _prox(out["regularization_x"])(X - alpha * gX, alpha * gx)
+        return X
+
+    def predict_raw(self, frame: Frame):
+        out = self.output
+        A = expand_for_scoring(frame, out["expansion_spec"])
+        mask = frame.row_mask()[:, None] & jnp.ones(
+            (1, A.shape[1]), bool)
+        X = self._solve_x(A, mask)
+        return X @ jnp.asarray(out["archetypes"])   # reconstruction
+
+    def predict(self, frame: Frame) -> Frame:
+        """Reconstructed columns (reconstr_ prefix, GLRMModel scoring)."""
+        recon = self.predict_raw(frame)
+        names = self.output["feature_names"]
+        return Frame([f"reconstr_{n}" for n in names],
+                     [Vec(recon[:, j], nrows=frame.nrows)
+                      for j in range(len(names))])
+
+    def transform(self, frame: Frame) -> Frame:
+        """Rows -> archetype space (the representation / x frame)."""
+        out = self.output
+        A = expand_for_scoring(frame, out["expansion_spec"])
+        mask = frame.row_mask()[:, None] & jnp.ones((1, A.shape[1]), bool)
+        X = self._solve_x(A, mask)
+        k = X.shape[1]
+        return Frame([f"Arch{i+1}" for i in range(k)],
+                     [Vec(X[:, i], nrows=frame.nrows) for i in range(k)])
+
+    def model_metrics(self, frame: Frame):
+        return mm.ModelMetrics("glrm", dict(
+            objective=float(self.output["objective"]),
+            numerr=float(self.output["numerr"]),
+            iterations=int(self.output["iterations"])))
+
+
+class GLRM(ModelBuilder):
+    algo = "glrm"
+    model_cls = GLRMModel
+    supervised = False
+
+    def default_params(self) -> Dict:
+        p = super().default_params()
+        p.update(k=1, loss="Quadratic", multi_loss="Categorical",
+                 regularization_x="None", regularization_y="None",
+                 gamma_x=0.0, gamma_y=0.0, max_iterations=500,
+                 init_step_size=1.0, min_step_size=1e-4, transform="NONE",
+                 init="SVD", recover_svd=False)
+        return p
+
+    def _fit(self, job, x, y, train: Frame, valid: Optional[Frame]):
+        p = self.params
+        transform = (p["transform"] or "NONE").upper()
+        di = DataInfo(train, x, None, mode="expanded",
+                      standardize=(transform == "STANDARDIZE"),
+                      use_all_factor_levels=True, impute_missing=False)
+        A = di.matrix()
+        R, P = A.shape
+        k = min(int(p["k"]), P)
+        mask = train.row_mask()[:, None] & ~jnp.isnan(A)
+        gx, gy = jnp.float32(p["gamma_x"]), jnp.float32(p["gamma_y"])
+
+        objective, step = _make_step(p["loss"], p["regularization_x"],
+                                     p["regularization_y"])
+
+        # init: SVD of the mean-imputed matrix (reference init=SVD option;
+        # PlusPlus init exists for k-means-style archetypes)
+        if (p["init"] or "SVD").upper() == "SVD":
+            from h2o_tpu.models.svd import _randomized_range
+            A0 = jnp.where(mask, jnp.nan_to_num(A), 0.0)
+            V, _ = _randomized_range(A0, train.row_mask(), self.rng_key(),
+                                     k, iters=2)
+            Y = V.T[:k]
+            X = A0 @ V[:, :k]
+        else:
+            key = self.rng_key()
+            X = jax.random.normal(key, (R, k)) * 0.01
+            Y = jax.random.normal(jax.random.split(key)[0], (k, P)) * 0.01
+        # project init onto the regularizers' feasible sets
+        # (GlrmRegularizer.project on init)
+        X = _prox(p["regularization_x"])(X, jnp.float32(0.0))
+        Y = _prox(p["regularization_y"])(Y, jnp.float32(0.0))
+
+        alpha = float(p["init_step_size"]) / max(1.0, float(
+            np.asarray(jnp.sum(mask))) / R)
+        min_alpha = float(p["min_step_size"])
+        obj = float(np.asarray(objective(X, Y, A, mask, gx, gy)))
+        history = [obj]
+        it = 0
+        for it in range(1, int(p["max_iterations"]) + 1):
+            Xn, Yn = step(X, Y, A, mask, jnp.float32(alpha), gx, gy)
+            new_obj = float(np.asarray(objective(Xn, Yn, A, mask, gx, gy)))
+            if np.isfinite(new_obj) and new_obj < obj:
+                X, Y, obj = Xn, Yn, new_obj
+                alpha *= 1.05                   # reference: grow on success
+                history.append(obj)
+            else:
+                alpha /= 2.0                    # halve + revert on failure
+                if alpha < min_alpha:
+                    break
+            if it % 20 == 0:
+                job.update(min(0.9, it / int(p["max_iterations"])),
+                           f"iter {it} objective {obj:.5g}")
+            if len(history) > 2 and \
+                    abs(history[-2] - history[-1]) < 1e-8 * (1 + obj):
+                break
+
+        recon = X @ Y
+        numerr = float(np.asarray(jnp.sum(jnp.where(
+            mask, (jnp.nan_to_num(A) - recon) ** 2, 0.0))))
+        out = dict(k=k, archetypes=np.asarray(Y), loss=p["loss"],
+                   regularization_x=p["regularization_x"],
+                   regularization_y=p["regularization_y"],
+                   gamma_x=float(p["gamma_x"]), gamma_y=float(p["gamma_y"]),
+                   objective=obj, numerr=numerr, iterations=it,
+                   step_size=alpha, history=history,
+                   feature_names=di.expanded_names,
+                   expansion_spec=expansion_spec(di))
+        model = self.model_cls(self.model_id, dict(p), out)
+        # the representation (X) frame, DKV-published like the reference's
+        # loading_key frame
+        from h2o_tpu.core.cloud import cloud
+        from h2o_tpu.core.store import Key
+        Xh = np.asarray(X)[: train.nrows]
+        xf = Frame([f"Arch{i+1}" for i in range(k)],
+                   [Vec(Xh[:, i]) for i in range(k)])
+        xf.key = Key(f"glrm_rep_{model.key}")
+        cloud().dkv.put(xf.key, xf)
+        model.output["representation_key"] = str(xf.key)
+        model.output["training_metrics"] = model.model_metrics(train)
+        job.update(1.0)
+        return model

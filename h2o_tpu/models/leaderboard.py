@@ -49,7 +49,7 @@ class Leaderboard:
         xval > valid > train preference."""
         if self.leaderboard_frame is None:
             return _ranking_metrics(model)
-        k = str(model.key)
+        k = (str(model.key), str(self.leaderboard_frame.key))
         if k not in self._lb_metrics:
             self._lb_metrics[k] = model.model_metrics(
                 self.leaderboard_frame)

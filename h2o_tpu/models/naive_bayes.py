@@ -73,7 +73,9 @@ class NaiveBayesModel(Model):
             t = jnp.maximum(t, floor_p)
             safe = jnp.clip(codes, 0, t.shape[1] - 1)
             contrib = jnp.log(t[:, safe]).T                 # (R, k)
-            ll = ll + jnp.where((codes >= 0)[:, None], contrib, 0.0)
+            # NA codes (-1) and unseen levels (>= card) skip the predictor
+            known = (codes >= 0) & (codes < t.shape[1])
+            ll = ll + jnp.where(known[:, None], contrib, 0.0)
         if out["num_names"]:
             X = frame.as_matrix(out["num_names"])
             mu = jnp.asarray(out["num_mean"], jnp.float32)  # (k, C)

@@ -42,6 +42,21 @@ def builders() -> Dict[str, type]:
         reg["extendedisolationforest"] = ExtendedIsolationForest
     except ImportError:
         pass
+    try:
+        from h2o_tpu.models.svd import SVD
+        reg["svd"] = SVD
+    except ImportError:
+        pass
+    try:
+        from h2o_tpu.models.glrm import GLRM
+        reg["glrm"] = GLRM
+    except ImportError:
+        pass
+    try:
+        from h2o_tpu.models.word2vec import Word2Vec
+        reg["word2vec"] = Word2Vec
+    except ImportError:
+        pass
     from h2o_tpu.models.generic import Generic
     reg["generic"] = Generic
     from h2o_tpu.models.ensemble import StackedEnsemble

@@ -32,7 +32,7 @@ import numpy as np
 
 from h2o_tpu.core.frame import Frame, Vec
 from h2o_tpu.models.metrics import ModelMetrics
-from h2o_tpu.models.model import DataInfo, Model, ModelBuilder, _raw_to_frame
+from h2o_tpu.models.model import DataInfo, Model, ModelBuilder
 
 EULER = 0.5772156649015329
 INF = jnp.inf
@@ -128,9 +128,31 @@ def _if_path_lengths(X, split_col, thresh, D: int):
     return total
 
 
-class IsolationForestModel(Model):
-    algo = "isolationforest"
+class AnomalyModel(Model):
+    """Shared anomaly-model surface: [score, mean_length] predictions."""
+
     supervised = False
+    pred_names = ("predict", "mean_length")
+
+    def predict(self, frame: Frame) -> Frame:
+        raw = self.predict_raw(frame)
+        n = frame.nrows
+        return Frame(list(self.pred_names),
+                     [Vec(raw[:, 0], nrows=n), Vec(raw[:, 1], nrows=n)])
+
+    def model_metrics(self, frame: Frame):
+        raw = np.asarray(self.predict_raw(frame))[: frame.nrows]
+        return self._metrics_from(raw)
+
+    @staticmethod
+    def _metrics_from(raw: np.ndarray) -> ModelMetrics:
+        return ModelMetrics("anomaly", dict(
+            mean_score=float(raw[:, 0].mean()),
+            mean_length=float(raw[:, 1].mean())))
+
+
+class IsolationForestModel(AnomalyModel):
+    algo = "isolationforest"
 
     def _total_path(self, frame: Frame):
         out = self.output
@@ -147,18 +169,6 @@ class IsolationForestModel(Model):
             jnp.ones_like(total)
         mean_len = total / max(int(out["ntrees_actual"]), 1)
         return jnp.stack([score, mean_len], axis=1)
-
-    def predict(self, frame: Frame) -> Frame:
-        raw = self.predict_raw(frame)
-        n = frame.nrows
-        return Frame(["predict", "mean_length"],
-                     [Vec(raw[:, 0], nrows=n), Vec(raw[:, 1], nrows=n)])
-
-    def model_metrics(self, frame: Frame):
-        raw = np.asarray(self.predict_raw(frame))[: frame.nrows]
-        return ModelMetrics("anomaly", dict(
-            mean_score=float(raw[:, 0].mean()),
-            mean_length=float(raw[:, 1].mean())))
 
 
 class IsolationForest(ModelBuilder):
@@ -189,15 +199,20 @@ class IsolationForest(ModelBuilder):
         job.update(0.1, f"growing {T} isolation trees (sample={S})")
         sc, th = _build_if_trees(X, keys, S, D, train.nrows)
         total = np.asarray(_if_path_lengths(X, sc, th, D))[: train.nrows]
+        lo, hi = int(total.min()), int(total.max())
         out = dict(x=list(di.x), split_col=np.asarray(sc),
                    thresh=np.asarray(th), max_depth=D, ntrees_actual=T,
                    sample_size=S,
-                   min_path_length=int(total.min()),
-                   max_path_length=int(total.max()),
+                   min_path_length=lo, max_path_length=hi,
                    domains={c: list(train.vec(c).domain)
                             for c in di.cat_names})
         model = self.model_cls(self.model_id, dict(p), out)
-        model.output["training_metrics"] = model.model_metrics(train)
+        # training metrics from the path lengths already in hand (no second
+        # full-frame scoring pass)
+        score = (hi - total) / (hi - lo) if hi > lo else \
+            np.ones_like(total, np.float32)
+        raw = np.stack([score, total / max(T, 1)], axis=1)
+        model.output["training_metrics"] = AnomalyModel._metrics_from(raw)
         return model
 
 
@@ -289,9 +304,9 @@ def _eif_mean_path(X, normals, points, value, is_split, D: int):
     return total / normals.shape[0]
 
 
-class ExtendedIsolationForestModel(Model):
+class ExtendedIsolationForestModel(AnomalyModel):
     algo = "extendedisolationforest"
-    supervised = False
+    pred_names = ("anomaly_score", "mean_length")
 
     def predict_raw(self, frame: Frame):
         out = self.output
@@ -303,18 +318,6 @@ class ExtendedIsolationForestModel(Model):
         cn = float(np.asarray(avg_path_length(out["sample_size"])))
         score = jnp.power(2.0, -mean_len / max(cn, 1e-12))
         return jnp.stack([score, mean_len], axis=1)
-
-    def predict(self, frame: Frame) -> Frame:
-        raw = self.predict_raw(frame)
-        n = frame.nrows
-        return Frame(["anomaly_score", "mean_length"],
-                     [Vec(raw[:, 0], nrows=n), Vec(raw[:, 1], nrows=n)])
-
-    def model_metrics(self, frame: Frame):
-        raw = np.asarray(self.predict_raw(frame))[: frame.nrows]
-        return ModelMetrics("anomaly", dict(
-            mean_score=float(raw[:, 0].mean()),
-            mean_length=float(raw[:, 1].mean())))
 
 
 class ExtendedIsolationForest(ModelBuilder):

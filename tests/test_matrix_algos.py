@@ -1,0 +1,134 @@
+"""SVD / GLRM / Word2Vec tests."""
+
+import numpy as np
+
+from tests.test_algos import _frame_from
+
+
+def test_svd_matches_numpy(cl, rng):
+    from h2o_tpu.models.svd import SVD
+    n, p = 500, 6
+    X = rng.normal(size=(n, p)).astype(np.float32)
+    X[:, 1] = 2 * X[:, 0] + 0.1 * X[:, 1]      # correlated structure
+    fr = _frame_from(X)
+    m = SVD(nv=3, svd_method="GramSVD").train(training_frame=fr)
+    d = np.asarray(m.output["d"])
+    _, s_np, _ = np.linalg.svd(X, full_matrices=False)
+    np.testing.assert_allclose(d, s_np[:3], rtol=2e-3)
+    # projections have nv columns
+    pred = m.predict(fr)
+    assert pred.ncols == 3 and pred.nrows == n
+
+
+def test_svd_randomized_close_to_exact(cl, rng):
+    from h2o_tpu.models.svd import SVD
+    X = rng.normal(size=(400, 8)).astype(np.float32)
+    fr = _frame_from(X)
+    m = SVD(nv=2, svd_method="Randomized", seed=0,
+            max_iterations=8).train(training_frame=fr)
+    d = np.asarray(m.output["d"])
+    _, s_np, _ = np.linalg.svd(X, full_matrices=False)
+    np.testing.assert_allclose(d, s_np[:2], rtol=5e-2)
+
+
+def test_svd_keeps_u_frame(cl, rng):
+    from h2o_tpu.core.cloud import cloud
+    from h2o_tpu.models.svd import SVD
+    X = rng.normal(size=(300, 4)).astype(np.float32)
+    fr = _frame_from(X)
+    m = SVD(nv=2, keep_u=True).train(training_frame=fr)
+    uf = cloud().dkv.get(m.output["u_key"])
+    assert uf is not None and uf.ncols == 2 and uf.nrows == 300
+    # U columns orthonormal-ish
+    U = np.stack([uf.vec(c).to_numpy() for c in uf.names], axis=1)
+    G = U.T @ U
+    np.testing.assert_allclose(G, np.eye(2), atol=1e-2)
+
+
+def test_glrm_low_rank_recovery(cl, rng):
+    from h2o_tpu.models.glrm import GLRM
+    n, p, k = 400, 8, 3
+    Xt = rng.normal(size=(n, k)).astype(np.float32)
+    Yt = rng.normal(size=(k, p)).astype(np.float32)
+    A = Xt @ Yt + 0.01 * rng.normal(size=(n, p)).astype(np.float32)
+    fr = _frame_from(A)
+    m = GLRM(k=k, max_iterations=300, seed=1).train(training_frame=fr)
+    # reconstruction error should be near the noise floor
+    rel = m.output["numerr"] / np.sum(A ** 2)
+    assert rel < 0.02, rel
+    arch = m.output["archetypes"]
+    assert arch.shape == (k, p)
+    # transform gives the representation
+    xf = m.transform(fr)
+    assert xf.ncols == k and xf.nrows == n
+
+
+def test_glrm_handles_missing_cells(cl, rng):
+    from h2o_tpu.models.glrm import GLRM
+    n, p, k = 300, 6, 2
+    A = (rng.normal(size=(n, k)) @ rng.normal(size=(k, p))).astype(
+        np.float32)
+    A_obs = A.copy()
+    holes = rng.uniform(size=A.shape) < 0.2
+    A_obs[holes] = np.nan
+    fr = _frame_from(A_obs)
+    m = GLRM(k=k, max_iterations=300, seed=2).train(training_frame=fr)
+    recon = np.stack([m.predict(fr).vec(c).to_numpy()
+                      for c in m.predict(fr).names], axis=1)
+    # imputation: held-out cells should be recovered reasonably
+    err = np.abs(recon[holes] - A[holes])
+    assert np.median(err) < 0.35, np.median(err)
+
+
+def test_glrm_nonneg_regularizer(cl, rng):
+    from h2o_tpu.models.glrm import GLRM
+    A = np.abs(rng.normal(size=(200, 5))).astype(np.float32)
+    fr = _frame_from(A)
+    m = GLRM(k=2, regularization_x="NonNegative",
+             regularization_y="NonNegative", max_iterations=150,
+             seed=3).train(training_frame=fr)
+    assert (m.output["archetypes"] >= 0).all()
+
+
+def test_word2vec_synonyms(cl, rng):
+    from h2o_tpu.core.frame import Frame, Vec, T_STR
+    from h2o_tpu.models.word2vec import Word2Vec
+    # synthetic corpus with two topic clusters
+    animals = ["cat", "dog", "horse", "cow"]
+    tools = ["hammer", "wrench", "drill", "saw"]
+    toks = []
+    for _ in range(400):
+        group = animals if rng.uniform() < 0.5 else tools
+        sent = [group[rng.integers(len(group))] for _ in range(6)]
+        toks.extend(sent)
+        toks.append(None)
+    fr = Frame(["tokens"], [Vec(toks, T_STR)])
+    m = Word2Vec(vec_size=16, epochs=8, min_word_freq=2, window_size=3,
+                 seed=5).train(training_frame=fr)
+    assert len(m.output["words"]) == 8
+    syn = m.find_synonyms("cat", 3)
+    assert len(syn) == 3
+    # the nearest neighbors of an animal should be animals
+    top2 = list(syn)[:2]
+    assert sum(w in animals for w in top2) >= 1, syn
+
+
+def test_word2vec_transform(cl, rng):
+    from h2o_tpu.core.frame import Frame, Vec, T_STR
+    from h2o_tpu.models.word2vec import Word2Vec
+    toks = (["a", "b", "c", None] * 50)
+    fr = Frame(["tokens"], [Vec(toks, T_STR)])
+    m = Word2Vec(vec_size=8, epochs=2, min_word_freq=1,
+                 window_size=2, seed=1).train(training_frame=fr)
+    t = m.transform(fr, aggregate_method="NONE")
+    assert t.nrows == len(toks) and t.ncols == 8
+    avg = m.transform(fr, aggregate_method="AVERAGE")
+    assert avg.nrows == 50          # one row per NA-delimited sequence
+    assert np.isfinite(avg.vec("C1").to_numpy()).all()
+
+
+def test_registry_has_matrix_algos(cl):
+    from h2o_tpu.models.registry import builders
+    b = builders()
+    for algo in ("svd", "glrm", "word2vec"):
+        assert algo in b
