@@ -1,0 +1,270 @@
+"""CoxPH — Cox proportional hazards survival regression.
+
+Reference (hex/coxph/CoxPH.java:28): Newton-Raphson on the partial
+log-likelihood with Efron (default) or Breslow tie handling
+(CoxPHModel.java:41-43); inputs are ``stop_column`` (time, plus optional
+``start_column`` for interval data) and an event response; outputs
+coefficients, hazard ratios (exp_coef), se/z stats, loglik and concordance
+(ModelMetricsRegressionCoxPH).
+
+TPU-native: rows are sorted by time once on the host; the partial
+log-likelihood is then a pure cumsum/segment-sum program over the sorted
+risk sets, and Newton steps use jax.grad/jax.hessian of that scalar — the
+reference's CoxPHTask MRTask accumulators (sumLogRiskEvents, rcumsumRisk,
+…) become one differentiated XLA program.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o_tpu.core.frame import Frame
+from h2o_tpu.models import metrics as mm
+from h2o_tpu.models.model import DataInfo, Model, ModelBuilder
+from h2o_tpu.models.glm import expand_for_scoring, expansion_spec
+
+EPS = 1e-10
+
+
+def _neg_partial_loglik(beta, X, event, w, group, start_perm, start_cnt,
+                        n_groups: int, efron: bool, max_ties: int):
+    """Negative partial log-likelihood over rows sorted by DESCENDING stop
+    time.
+
+    group: tie-group id per row (same stop time ⇒ same group), increasing
+    with the sort order (0 = largest time).  Left truncation (interval
+    data): ``start_perm`` reorders rows by descending start time and
+    ``start_cnt[g]`` counts rows whose start >= t_g — those are NOT yet at
+    risk at t_g and their hazard mass is subtracted from the risk set.
+    """
+    eta = X @ beta
+    r = w * jnp.exp(eta)
+    # risk set sum for group g = cumsum of r over all rows with stop >= t_g
+    cum_r = jnp.cumsum(r)
+    grp_last = jax.ops.segment_max(jnp.arange(r.shape[0]), group,
+                                   num_segments=n_groups)
+    risk = cum_r[grp_last]                        # (G,) sum over risk set
+    if start_perm is not None:
+        cum_s = jnp.cumsum(r[start_perm])
+        not_entered = jnp.where(start_cnt > 0,
+                                cum_s[jnp.maximum(start_cnt - 1, 0)], 0.0)
+        risk = risk - not_entered
+    ev = w * event
+    d = jax.ops.segment_sum(ev, group, num_segments=n_groups)       # events
+    s_eta = jax.ops.segment_sum(ev * eta, group, num_segments=n_groups)
+    if not efron:                                 # Breslow
+        ll = s_eta - d * jnp.log(jnp.maximum(risk, EPS))
+        return -jnp.sum(ll)
+    # Efron: sum_{l=0..d-1} log(risk - (l/d) * tie_sum); weighted version
+    # follows the reference's mean-subtraction per tied event
+    tie_r = jax.ops.segment_sum(ev * jnp.exp(eta), group,
+                                num_segments=n_groups)
+    # integer tie counts bound the l-loop; max_ties is the true maximum
+    # (computed by the caller), so no tie term is ever truncated
+    cnt = jax.ops.segment_sum(event, group, num_segments=n_groups)
+
+    def tie_term(g_risk, g_tie, g_d, g_cnt):
+        ls = jnp.arange(max_ties, dtype=jnp.float32)
+        act = ls < g_cnt
+        frac = jnp.where(act, ls / jnp.maximum(g_cnt, 1.0), 0.0)
+        t = jnp.log(jnp.maximum(g_risk - frac * g_tie, EPS))
+        # scale to weighted event mass: mean log-term times weighted d
+        return jnp.sum(jnp.where(act, t, 0.0)) / \
+            jnp.maximum(g_cnt, 1.0) * g_d
+
+    terms = jax.vmap(tie_term)(risk, tie_r, d, cnt)
+    return -jnp.sum(s_eta - terms)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_groups", "efron", "max_ties",
+                                    "truncated"))
+def _newton_state(beta, X, event, w, group, start_perm, start_cnt,
+                  n_groups: int, efron: bool, max_ties: int,
+                  truncated: bool):
+    f = lambda b: _neg_partial_loglik(  # noqa: E731
+        b, X, event, w, group, start_perm if truncated else None,
+        start_cnt, n_groups, efron, max_ties)
+    nll = f(beta)
+    g = jax.grad(f)(beta)
+    H = jax.hessian(f)(beta)
+    return nll, g, H
+
+
+class CoxPHModel(Model):
+    algo = "coxph"
+
+    def predict_raw(self, frame: Frame):
+        """Linear predictor eta (the reference scores lp; hazard ratios
+        are exp(lp))."""
+        out = self.output
+        X = expand_for_scoring(frame, out["expansion_spec"])
+        return (X - jnp.asarray(out["x_mean"])[None, :]) @ \
+            jnp.asarray(out["coef"])
+
+    def model_metrics(self, frame: Frame):
+        out = self.output
+        return mm.ModelMetrics("coxph", dict(
+            loglik=out["loglik"], null_loglik=out["null_loglik"],
+            concordance=out["concordance"],
+            n_events=out["n_events"], iterations=out["iterations"]))
+
+
+def _concordance(time, event, lp, cap: int = 4000) -> float:
+    """Pairwise concordance (Harrell's C); subsampled beyond ``cap`` rows."""
+    n = len(time)
+    if n > cap:
+        idx = np.random.default_rng(0).choice(n, cap, replace=False)
+        time, event, lp = time[idx], event[idx], lp[idx]
+    conc = ties = disc = 0
+    order = np.argsort(time)
+    time, event, lp = time[order], event[order], lp[order]
+    for i in range(len(time)):
+        if not event[i]:
+            continue
+        later = time > time[i]
+        if not later.any():
+            continue
+        d = lp[later]
+        conc += int((lp[i] > d).sum())
+        ties += int((lp[i] == d).sum())
+        disc += int((lp[i] < d).sum())
+    tot = conc + ties + disc
+    return (conc + 0.5 * ties) / tot if tot else 0.5
+
+
+class CoxPH(ModelBuilder):
+    algo = "coxph"
+    model_cls = CoxPHModel
+
+    def default_params(self) -> Dict:
+        p = super().default_params()
+        p.update(start_column=None, stop_column=None, ties="efron",
+                 max_iterations=20, lre=9.0, use_all_factor_levels=False)
+        return p
+
+    def _fit(self, job, x, y, train: Frame, valid: Optional[Frame]):
+        p = self.params
+        stop_col = p.get("stop_column")
+        assert stop_col, "CoxPH requires stop_column (event time)"
+        x = [c for c in x if c not in (stop_col, p.get("start_column"))]
+        di = DataInfo(train, x, y, mode="expanded",
+                      weights=p.get("weights_column"),
+                      use_all_factor_levels=bool(p["use_all_factor_levels"]),
+                      impute_missing=True)
+        X_full = di.matrix()
+        yv = di.response()
+        event = jnp.nan_to_num(yv)               # 1 = event, 0 = censored
+        w_full = di.weights()
+        time = train.vec(stop_col).as_float()
+        valid_m = di.valid_mask() & ~jnp.isnan(time)
+
+        # host: order rows by DESCENDING stop time, build tie groups
+        t_np = np.asarray(time)[: train.nrows]
+        v_np = np.asarray(valid_m)[: train.nrows]
+        order = np.argsort(-t_np, kind="stable")
+        order = order[v_np[order]]
+        t_s = t_np[order]
+        group = np.zeros(len(order), np.int32)
+        if len(order) > 1:
+            group[1:] = np.cumsum(t_s[1:] != t_s[:-1]).astype(np.int32)
+        n_groups = int(group[-1]) + 1 if len(group) else 1
+
+        Xh = np.asarray(X_full)[: train.nrows][order]
+        x_mean = Xh.mean(axis=0)
+        Xc = jnp.asarray(Xh - x_mean[None, :])    # centered (reference does)
+        ev_np = np.asarray(event)[: train.nrows][order]
+        ev = jnp.asarray(ev_np)
+        ws = jnp.asarray(np.asarray(w_full)[: train.nrows][order])
+        grp = jnp.asarray(group)
+        efron = (p.get("ties") or "efron").lower() == "efron"
+        P = Xc.shape[1]
+
+        # exact tie-loop bound: the largest number of tied events; rounded
+        # up to a power of two so re-fits with similar data reuse the jit
+        max_cnt = int(np.bincount(group, weights=ev_np).max()) \
+            if len(group) else 1
+        max_ties = 1 << max(int(np.ceil(np.log2(max(max_cnt, 1)))), 0)
+
+        # left truncation (start_column interval mode): rows enter the risk
+        # set only after their start time
+        truncated = bool(p.get("start_column"))
+        if truncated:
+            s_np = np.asarray(
+                train.vec(p["start_column"]).as_float())[: train.nrows]
+            s_s = s_np[order]                    # start times, stop-sorted
+            start_perm = np.argsort(-s_s, kind="stable").astype(np.int32)
+            s_sorted = s_s[start_perm]
+            # t_g = the stop time of each tie group (first occurrence)
+            _, first_idx = np.unique(group, return_index=True)
+            t_g = t_s[first_idx]
+            # rows with start >= t_g have not entered the risk set at t_g;
+            # s_sorted is descending, so count via searchsorted on -s
+            start_cnt = np.searchsorted(-s_sorted, -t_g,
+                                        side="right").astype(np.int32)
+            start_perm_j = jnp.asarray(start_perm)
+            start_cnt_j = jnp.asarray(start_cnt)
+        else:
+            start_perm_j = jnp.zeros((Xc.shape[0],), jnp.int32)
+            start_cnt_j = jnp.zeros((n_groups,), jnp.int32)
+
+        beta = jnp.zeros((P,), jnp.float32)
+
+        def state(b):
+            return _newton_state(b, Xc, ev, ws, grp, start_perm_j,
+                                 start_cnt_j, n_groups, efron, max_ties,
+                                 truncated)
+
+        nll0, _, _ = state(beta)
+        nll_prev = float(nll0)
+        it = 0
+        for it in range(1, int(p["max_iterations"]) + 1):
+            nll, g, H = state(beta)
+            H = H + jnp.eye(P) * 1e-6
+            step = jnp.linalg.solve(H, g)
+            beta_new = beta - step
+            nll_new, _, _ = state(beta_new)
+            # step halving on divergence (reference does the same)
+            halvings = 0
+            while not np.isfinite(float(nll_new)) or \
+                    float(nll_new) > float(nll) + 1e-9:
+                step = step / 2
+                beta_new = beta - step
+                nll_new, _, _ = state(beta_new)
+                halvings += 1
+                if halvings > 20:
+                    break
+            beta = beta_new
+            job.update(min(0.9, it / int(p["max_iterations"])),
+                       f"iter {it} loglik {-float(nll_new):.5g}")
+            if abs(nll_prev - float(nll_new)) < 10.0 ** (-float(p["lre"])) \
+                    * max(1.0, abs(nll_prev)):
+                nll_prev = float(nll_new)
+                break
+            nll_prev = float(nll_new)
+
+        nll_f, g_f, H_f = state(beta)
+        cov = np.linalg.inv(np.asarray(H_f) + np.eye(P) * 1e-8)
+        se = np.sqrt(np.maximum(np.diag(cov), 0.0))
+        coef = np.asarray(beta)
+        lp = Xh @ coef - x_mean @ coef
+        conc = _concordance(t_s[::-1], np.asarray(ev)[::-1].astype(bool),
+                            lp[::-1])
+        names = di.expanded_names
+        out = dict(
+            coef=coef, exp_coef=np.exp(coef), se_coef=se,
+            z_coef=coef / np.maximum(se, EPS), coef_names=names,
+            x_mean=x_mean, loglik=-float(nll_f),
+            null_loglik=-float(nll0), iterations=it,
+            n_events=int(np.asarray(ev).sum()),
+            concordance=float(conc), ties=p["ties"],
+            expansion_spec=expansion_spec(di))
+        model = self.model_cls(self.model_id, dict(p), out)
+        model.params["response_column"] = y
+        model.output["training_metrics"] = model.model_metrics(train)
+        return model

@@ -116,32 +116,64 @@ def _make_step(loss_name: str, rx: str, ry: str):
     return objective, step
 
 
+@functools.lru_cache(maxsize=32)
+def _x_solver(loss_name: str, rx: str, iters: int):
+    """Jitted fixed-Y X-fit (GLRMGenX scoring analog), cached per config."""
+    loss = _loss_fn(loss_name)
+    prox = _prox(rx)
+
+    @jax.jit
+    def solve(A, mask, Y, gx, alpha):
+        Az = jnp.nan_to_num(A)
+
+        def smooth(X):
+            return jnp.sum(jnp.where(mask, loss(X @ Y, Az), 0.0))
+
+        def body(_, X):
+            gX = jax.grad(smooth)(X)
+            return prox(X - alpha * gX, alpha * gx)
+
+        X0 = jnp.zeros((A.shape[0], Y.shape[0]), jnp.float32)
+        return jax.lax.fori_loop(0, iters, body, X0)
+
+    return solve
+
+
+def _observed_mask(frame: Frame, spec) -> jnp.ndarray:
+    """(rows, expanded_cols) mask of cells backed by OBSERVED raw values —
+    NaN numerics and NA categorical codes mask out their expanded columns
+    (training's `mask = ~isnan(A)` contract, applied pre-imputation)."""
+    cols = []
+    lo = 0 if spec["use_all_factor_levels"] else 1
+    for c, card in zip(spec["cat_names"], spec["cat_cards"]):
+        ok = frame.vec(c).data >= 0
+        cols.extend([ok] * (card - lo))
+    for c in spec["num_names"]:
+        cols.append(~jnp.isnan(frame.vec(c).as_float()))
+    return jnp.stack(cols, axis=1) if cols else jnp.zeros(
+        (frame.padded_rows, 0), bool)
+
+
 class GLRMModel(Model):
     algo = "glrm"
     supervised = False
 
-    def _solve_x(self, A, mask, iters: int = 30):
-        """Fit X for new rows with Y fixed (GLRMGenX scoring analog)."""
+    def _solve_x(self, frame: Frame, A, iters: int = 30):
+        """Fit X for new rows with Y fixed; missing cells carry no loss."""
         out = self.output
         Y = jnp.asarray(out["archetypes"])
-        _, step = _make_step(out["loss"], out["regularization_x"], "none")
-        X = jnp.zeros((A.shape[0], Y.shape[0]), jnp.float32)
-        alpha = 1.0 / max(float(np.asarray(
-            jnp.sum(Y * Y))), 1.0)
-        gx = jnp.float32(out["gamma_x"])
-        for _ in range(iters):
-            gX = jax.grad(lambda X_: jnp.sum(jnp.where(
-                mask, _loss_fn(out["loss"])(X_ @ Y, jnp.nan_to_num(A)),
-                0.0)))(X)
-            X = _prox(out["regularization_x"])(X - alpha * gX, alpha * gx)
-        return X
+        mask = frame.row_mask()[:, None] & \
+            _observed_mask(frame, out["expansion_spec"])
+        alpha = 1.0 / max(float(np.asarray(jnp.sum(Y * Y))), 1.0)
+        solve = _x_solver(out["loss"].lower(),
+                          out["regularization_x"].lower(), iters)
+        return solve(A, mask, Y, jnp.float32(out["gamma_x"]),
+                     jnp.float32(alpha))
 
     def predict_raw(self, frame: Frame):
         out = self.output
         A = expand_for_scoring(frame, out["expansion_spec"])
-        mask = frame.row_mask()[:, None] & jnp.ones(
-            (1, A.shape[1]), bool)
-        X = self._solve_x(A, mask)
+        X = self._solve_x(frame, A)
         return X @ jnp.asarray(out["archetypes"])   # reconstruction
 
     def predict(self, frame: Frame) -> Frame:
@@ -156,8 +188,7 @@ class GLRMModel(Model):
         """Rows -> archetype space (the representation / x frame)."""
         out = self.output
         A = expand_for_scoring(frame, out["expansion_spec"])
-        mask = frame.row_mask()[:, None] & jnp.ones((1, A.shape[1]), bool)
-        X = self._solve_x(A, mask)
+        X = self._solve_x(frame, A)
         k = X.shape[1]
         return Frame([f"Arch{i+1}" for i in range(k)],
                      [Vec(X[:, i], nrows=frame.nrows) for i in range(k)])

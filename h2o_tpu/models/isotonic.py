@@ -1,0 +1,102 @@
+"""IsotonicRegression — monotone least-squares fit.
+
+Reference (hex/isotonic/IsotonicRegression.java + genmodel
+IsotonicCalibrator): distributed pool-adjacent-violators — per-chunk PAV
+then a merge pass — producing piecewise-linear thresholds; scoring clips to
+the training x-range (``out_of_bounds="clip"``) or yields NA.
+
+TPU-native note: PAV is an inherently sequential stack algorithm, and the
+pooled threshold count is tiny — it runs on the host over the (sorted)
+aggregated pairs, exactly like the reference's final merge step.  Scoring —
+the hot path — is a vectorized device searchsorted + lerp.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from h2o_tpu.core.frame import Frame
+from h2o_tpu.models.model import DataInfo, Model, ModelBuilder
+
+
+def _pav(x: np.ndarray, y: np.ndarray, w: np.ndarray):
+    """Pool-adjacent-violators on sorted x.  Returns threshold (x, y)."""
+    order = np.argsort(x, kind="stable")
+    x, y, w = x[order], y[order], w[order]
+    # merge duplicate x values first (weighted means)
+    ux, inv = np.unique(x, return_inverse=True)
+    wy = np.bincount(inv, weights=w * y)
+    ww = np.bincount(inv, weights=w)
+    my = wy / np.maximum(ww, 1e-30)
+    # PAV stack
+    vals, wts, lo = [], [], []
+    for i in range(len(ux)):
+        v, wt, l = my[i], ww[i], i
+        while vals and vals[-1] > v + 1e-15:
+            pv, pw = vals.pop(), wts.pop()
+            l = lo.pop()
+            v = (pv * pw + v * wt) / (pw + wt)
+            wt = pw + wt
+        vals.append(v)
+        wts.append(wt)
+        lo.append(l)
+    # emit thresholds: block boundaries (first and last x of each block)
+    tx, ty = [], []
+    starts = lo + [len(ux)]
+    for b in range(len(vals)):
+        i0, i1 = starts[b], starts[b + 1] - 1
+        tx.append(ux[i0])
+        ty.append(vals[b])
+        if i1 > i0:
+            tx.append(ux[i1])
+            ty.append(vals[b])
+    return np.asarray(tx, np.float64), np.asarray(ty, np.float64)
+
+
+class IsotonicRegressionModel(Model):
+    algo = "isotonicregression"
+
+    def predict_raw(self, frame: Frame):
+        out = self.output
+        x = frame.vec(out["x"][0]).as_float()
+        tx = jnp.asarray(out["thresholds_x"], jnp.float32)
+        ty = jnp.asarray(out["thresholds_y"], jnp.float32)
+        clip = out.get("out_of_bounds", "clip") == "clip"
+        xi = jnp.clip(x, tx[0], tx[-1])
+        yi = jnp.interp(xi, tx, ty)
+        if not clip:
+            yi = jnp.where((x < tx[0]) | (x > tx[-1]), jnp.nan, yi)
+        return yi
+
+
+class IsotonicRegression(ModelBuilder):
+    algo = "isotonicregression"
+    model_cls = IsotonicRegressionModel
+
+    def default_params(self) -> Dict:
+        p = super().default_params()
+        p.update(out_of_bounds="clip")
+        return p
+
+    def _fit(self, job, x, y, train: Frame, valid: Optional[Frame]):
+        p = self.params
+        di = DataInfo(train, x, y, mode="tree",
+                      weights=p.get("weights_column"))
+        if len(di.x) != 1:
+            raise ValueError("IsotonicRegression wants exactly one "
+                             f"predictor, got {di.x}")
+        xv = np.asarray(train.vec(di.x[0]).as_float())[: train.nrows]
+        yv = np.asarray(di.response())[: train.nrows]
+        wv = np.asarray(di.weights())[: train.nrows]
+        ok = ~np.isnan(xv) & ~np.isnan(yv) & (wv > 0)
+        tx, ty = _pav(xv[ok], yv[ok], wv[ok])
+        out = dict(x=list(di.x), thresholds_x=tx, thresholds_y=ty,
+                   out_of_bounds=p.get("out_of_bounds", "clip"),
+                   nobs=int(ok.sum()))
+        model = self.model_cls(self.model_id, dict(p), out)
+        model.params["response_column"] = y
+        model.output["training_metrics"] = model.model_metrics(train)
+        return model

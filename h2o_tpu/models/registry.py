@@ -57,6 +57,26 @@ def builders() -> Dict[str, type]:
         reg["word2vec"] = Word2Vec
     except ImportError:
         pass
+    try:
+        from h2o_tpu.models.coxph import CoxPH
+        reg["coxph"] = CoxPH
+    except ImportError:
+        pass
+    try:
+        from h2o_tpu.models.isotonic import IsotonicRegression
+        reg["isotonicregression"] = IsotonicRegression
+    except ImportError:
+        pass
+    try:
+        from h2o_tpu.models.aggregator import Aggregator
+        reg["aggregator"] = Aggregator
+    except ImportError:
+        pass
+    try:
+        from h2o_tpu.models.gam import GAM
+        reg["gam"] = GAM
+    except ImportError:
+        pass
     from h2o_tpu.models.generic import Generic
     reg["generic"] = Generic
     from h2o_tpu.models.ensemble import StackedEnsemble

@@ -1,0 +1,171 @@
+"""CoxPH / IsotonicRegression / Aggregator / GAM tests."""
+
+import numpy as np
+import pytest
+
+from tests.test_algos import _frame_from
+
+
+def _cox_frame(rng, n=600, beta=(0.8, -0.5)):
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT
+    X = rng.normal(size=(n, len(beta))).astype(np.float32)
+    lam = np.exp(X @ np.asarray(beta))
+    t_event = rng.exponential(1.0 / lam)
+    t_cens = rng.exponential(2.0, size=n)
+    time = np.minimum(t_event, t_cens).astype(np.float32)
+    event = (t_event <= t_cens).astype(np.int32)
+    names = [f"x{j}" for j in range(len(beta))] + ["time", "event"]
+    vecs = [Vec(X[:, j]) for j in range(len(beta))] + \
+        [Vec(time), Vec(event, T_CAT, domain=["0", "1"])]
+    return Frame(names, vecs), X, time, event
+
+
+def test_coxph_recovers_coefficients(cl, rng):
+    from h2o_tpu.models.coxph import CoxPH
+    fr, X, time, event = _cox_frame(rng)
+    m = CoxPH(stop_column="time", ties="efron").train(
+        x=["x0", "x1"], y="event", training_frame=fr)
+    coef = np.asarray(m.output["coef"])
+    assert abs(coef[0] - 0.8) < 0.2, coef
+    assert abs(coef[1] + 0.5) < 0.2, coef
+    assert m.output["loglik"] > m.output["null_loglik"]
+    assert m.output["concordance"] > 0.6
+    # hazard ratios
+    np.testing.assert_allclose(m.output["exp_coef"], np.exp(coef),
+                               rtol=1e-6)
+
+
+def test_coxph_breslow_close_to_efron(cl, rng):
+    from h2o_tpu.models.coxph import CoxPH
+    fr, *_ = _cox_frame(rng, n=400)
+    me = CoxPH(stop_column="time", ties="efron").train(
+        x=["x0", "x1"], y="event", training_frame=fr)
+    mb = CoxPH(stop_column="time", ties="breslow").train(
+        x=["x0", "x1"], y="event", training_frame=fr)
+    # continuous times -> few ties -> methods nearly agree
+    np.testing.assert_allclose(me.output["coef"], mb.output["coef"],
+                               atol=0.05)
+
+
+def test_coxph_lifelines_or_sklearn_oracle(cl, rng):
+    """Golden oracle: compare against statsmodels PHReg if available."""
+    try:
+        from statsmodels.duration.hazard_regression import PHReg
+    except ImportError:
+        pytest.skip("statsmodels not available")
+    from h2o_tpu.models.coxph import CoxPH
+    fr, X, time, event = _cox_frame(rng, n=500)
+    m = CoxPH(stop_column="time", ties="efron").train(
+        x=["x0", "x1"], y="event", training_frame=fr)
+    res = PHReg(time, X, status=event, ties="efron").fit()
+    np.testing.assert_allclose(np.asarray(m.output["coef"]),
+                               res.params, atol=0.03)
+
+
+def test_isotonic_matches_sklearn(cl, rng):
+    from sklearn.isotonic import IsotonicRegression as SkIso
+    from h2o_tpu.models.isotonic import IsotonicRegression
+    n = 500
+    x = rng.uniform(0, 10, n).astype(np.float32)
+    y = (np.sqrt(x) + 0.3 * rng.normal(size=n)).astype(np.float32)
+    fr = _frame_from(x[:, None], y)
+    m = IsotonicRegression().train(x=["x0"], y="y", training_frame=fr)
+    pred = np.asarray(m.predict_raw(fr))[:n]
+    sk = SkIso(out_of_bounds="clip").fit(x, y)
+    np.testing.assert_allclose(pred, sk.predict(x), atol=1e-4)
+    # monotone
+    order = np.argsort(x)
+    assert (np.diff(pred[order]) >= -1e-6).all()
+
+
+def test_aggregator_reduces_rows(cl, rng):
+    from h2o_tpu.models.aggregator import Aggregator
+    n = 3000
+    centers = rng.normal(size=(5, 3)) * 6
+    X = (centers[rng.integers(0, 5, n)] +
+         rng.normal(size=(n, 3)) * 0.3).astype(np.float32)
+    fr = _frame_from(X)
+    m = Aggregator(target_num_exemplars=100,
+                   rel_tol_num_exemplars=0.7).train(training_frame=fr)
+    ne = m.output["num_exemplars"]
+    assert 10 <= ne <= 1000, ne
+    agg = m.aggregated_frame()
+    assert agg.nrows == ne
+    assert "counts" in agg.names
+    assert int(agg.vec("counts").to_numpy().sum()) == n
+
+
+def test_gam_fits_nonlinear_signal(cl, rng):
+    from h2o_tpu.models.gam import GAM
+    from h2o_tpu.models.glm import GLM
+    n = 1500
+    X = rng.uniform(-3, 3, size=(n, 2)).astype(np.float32)
+    y = (np.sin(X[:, 0]) * 2 + 0.5 * X[:, 1] +
+         0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = _frame_from(X, y)
+    glm = GLM(family="gaussian").train(y="y", training_frame=fr)
+    gam = GAM(gam_columns=["x0"], num_knots=8,
+              family="gaussian").train(x=["x0", "x1"], y="y",
+                                       training_frame=fr)
+    mse_glm = glm.output["training_metrics"]["mse"]
+    mse_gam = gam.output["training_metrics"]["mse"]
+    assert mse_gam < mse_glm * 0.25, (mse_gam, mse_glm)
+    # scoring a fresh frame re-expands with stored knots
+    pred = np.asarray(gam.predict_raw(fr))[:n]
+    assert np.corrcoef(pred, y)[0, 1] > 0.97
+
+
+def test_gam_binomial(cl, rng):
+    from h2o_tpu.models.gam import GAM
+    n = 1200
+    X = rng.uniform(-3, 3, size=(n, 1)).astype(np.float32)
+    logits = np.sin(X[:, 0]) * 3
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.int32)
+    fr = _frame_from(X, y, y_domain=["0", "1"])
+    m = GAM(gam_columns=["x0"], num_knots=8, family="binomial").train(
+        x=["x0"], y="y", training_frame=fr)
+    assert m.output["training_metrics"]["AUC"] > 0.75
+
+
+def test_registry_has_survival_misc(cl):
+    from h2o_tpu.models.registry import builders
+    b = builders()
+    for algo in ("coxph", "isotonicregression", "aggregator", "gam"):
+        assert algo in b
+
+
+def test_coxph_tied_times(cl, rng):
+    """Coarse integer times produce heavy ties; Efron must handle >32."""
+    from h2o_tpu.models.coxph import CoxPH
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT
+    n = 400
+    X = rng.normal(size=(n, 1)).astype(np.float32)
+    lam = np.exp(0.9 * X[:, 0])
+    t = np.ceil(rng.exponential(1.0 / lam) * 3).clip(1, 5)  # 5 levels
+    event = np.ones(n, np.int32)
+    fr = Frame(["x0", "time", "event"],
+               [Vec(X[:, 0]), Vec(t.astype(np.float32)),
+                Vec(event, T_CAT, domain=["0", "1"])])
+    m = CoxPH(stop_column="time", ties="efron").train(
+        x=["x0"], y="event", training_frame=fr)
+    coef = float(m.output["coef"][0])
+    assert 0.4 < coef < 1.6, coef
+    assert np.isfinite(m.output["loglik"])
+
+
+def test_coxph_start_column_left_truncation(cl, rng):
+    from h2o_tpu.models.coxph import CoxPH
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT
+    n = 500
+    X = rng.normal(size=(n, 1)).astype(np.float32)
+    lam = np.exp(0.7 * X[:, 0])
+    stop = rng.exponential(1.0 / lam).astype(np.float32) + 0.01
+    start = (stop * rng.uniform(0, 0.5, n)).astype(np.float32)
+    event = np.ones(n, np.int32)
+    fr = Frame(["x0", "start", "stop", "event"],
+               [Vec(X[:, 0]), Vec(start), Vec(stop),
+                Vec(event, T_CAT, domain=["0", "1"])])
+    m = CoxPH(start_column="start", stop_column="stop").train(
+        x=["x0"], y="event", training_frame=fr)
+    coef = float(m.output["coef"][0])
+    assert 0.3 < coef < 1.2, coef
