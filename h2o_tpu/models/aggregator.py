@@ -111,19 +111,29 @@ class Aggregator(ModelBuilder):
         # per-dimension domain span); adapt by doubling/halving
         span = float(np.mean(np.var(X, axis=0))) * X.shape[1]
         radius2 = span / max(target, 1)
-        best = None
+        best, best_dist = None, np.inf
+        r_lo = r_hi = None          # bracketing radii (lo: too many ex.)
         for trial in range(12):
             ex, counts, assign = _aggregate(X, radius2)
             n = len(ex)
             job.update(0.1 + 0.07 * trial,
                        f"radius²={radius2:.4g} -> {n} exemplars")
-            best = (ex, counts, assign, radius2)
-            if n > target:
-                radius2 *= 2.0          # too many exemplars: grow radius
-            elif n < lo_ok:
-                radius2 /= 2.0
-            else:
+            dist = abs(n - target)
+            if dist < best_dist:
+                best, best_dist = (ex, counts, assign, radius2), dist
+            if lo_ok <= n <= target:
                 break
+            if n > target:
+                r_lo = radius2
+            else:
+                r_hi = radius2
+            # geometric bisection once bracketed, else double/halve
+            if r_lo is not None and r_hi is not None:
+                radius2 = float(np.sqrt(r_lo * r_hi))
+            elif n > target:
+                radius2 *= 2.0
+            else:
+                radius2 /= 2.0
         ex, counts, assign, radius2 = best
 
         # exemplar rows in ORIGINAL column space: first occurrence of each

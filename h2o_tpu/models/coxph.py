@@ -151,7 +151,8 @@ class CoxPH(ModelBuilder):
     def _fit(self, job, x, y, train: Frame, valid: Optional[Frame]):
         p = self.params
         stop_col = p.get("stop_column")
-        assert stop_col, "CoxPH requires stop_column (event time)"
+        if not stop_col:
+            raise ValueError("CoxPH requires stop_column (event time)")
         x = [c for c in x if c not in (stop_col, p.get("start_column"))]
         di = DataInfo(train, x, y, mode="expanded",
                       weights=p.get("weights_column"),

@@ -1,0 +1,126 @@
+"""Infogram — admissible machine learning feature diagnostics.
+
+Reference (h2o-admissibleml, 2.7k LoC — InfoGram.java): for each predictor,
+compute a RELEVANCE index (normalized varimp of a supervised model on all
+predictors) and an INFORMATION index — core infogram: normalized mutual
+information I(y; x_j); fair infogram (``protected_columns`` set): normalized
+CONDITIONAL mutual information I(y; x_j | protected) — then flag features
+whose both indices clear ``net_information_threshold``/
+``total_information_threshold`` as admissible.
+
+TPU-native: relevance re-uses the tree engine's fused varimp; the
+(conditional) information indices are model-based MI estimates — the
+logloss reduction of a small GBM with vs without the feature (conditioning
+set = protected columns), each fit being one fused-XLA forest build.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from h2o_tpu.core.frame import Frame
+from h2o_tpu.models import metrics as mm
+from h2o_tpu.models.model import DataInfo, Model, ModelBuilder
+
+EPS = 1e-12
+
+
+def _model_logloss(x_cols: List[str], y: str, train: Frame, seed,
+                   job) -> float:
+    """Cross-entropy of a small GBM using x_cols (∅ -> prior logloss)."""
+    from h2o_tpu.models.tree.gbm import GBM
+    if not x_cols:
+        yv = np.asarray(train.vec(y).to_numpy(), np.float64)
+        yv = yv[yv >= 0]
+        k = int(yv.max()) + 1 if len(yv) else 2
+        ll = 0.0
+        for c in range(k):
+            pc = max(float((yv == c).mean()), EPS)
+            ll -= pc * np.log(pc)
+        return ll
+    m = GBM(ntrees=10, max_depth=3, learn_rate=0.3, seed=seed)._fit(
+        job, list(x_cols), y, train, None)
+    return float(m.output["training_metrics"].get("logloss")
+                 or m.output["training_metrics"]["mse"])
+
+
+class InfogramModel(Model):
+    algo = "infogram"
+
+    def admissible_features(self) -> List[str]:
+        return list(self.output["admissible_features"])
+
+    def result(self, use_pandas: bool = False):
+        rows = self.output["infogram_table"]
+        if use_pandas:
+            import pandas as pd
+            return pd.DataFrame(rows, columns=[
+                "column", "relevance_index", "information_index",
+                "admissible"])
+        return rows
+
+    def predict_raw(self, frame: Frame):
+        raise NotImplementedError("Infogram is a diagnostic, not a scorer")
+
+    def model_metrics(self, frame: Frame = None):
+        return mm.ModelMetrics("infogram", dict(
+            admissible_features=self.output["admissible_features"]))
+
+
+class Infogram(ModelBuilder):
+    algo = "infogram"
+    model_cls = InfogramModel
+
+    def default_params(self) -> Dict:
+        p = super().default_params()
+        p.update(protected_columns=None, net_information_threshold=0.1,
+                 total_information_threshold=0.1, top_n_features=50)
+        return p
+
+    def _fit(self, job, x, y, train: Frame, valid: Optional[Frame]):
+        p = self.params
+        protected = list(p.get("protected_columns") or [])
+        x = [c for c in x if c not in protected]
+        di = DataInfo(train, x, y, mode="tree")
+        preds = list(di.x)[: int(p.get("top_n_features") or 50)]
+        seed = p.get("seed", -1)
+
+        # relevance: varimp of a GBM on all candidate predictors
+        from h2o_tpu.models.tree.gbm import GBM
+        job.update(0.1, "relevance model")
+        rel_model = GBM(ntrees=20, max_depth=5, seed=seed)._fit(
+            job, preds, y, train, None)
+        vi = np.asarray(rel_model.output.get("varimp"))
+        rel = vi / max(vi.max(), EPS)
+        rel_map = dict(zip(rel_model.output["x"], rel))
+
+        # information: logloss reduction of [conditioning + x_j] over
+        # [conditioning]; conditioning = protected columns (fair) or ∅
+        base_ll = _model_logloss(protected, y, train, seed, job)
+        info = []
+        for i, c in enumerate(preds):
+            job.update(0.2 + 0.7 * i / len(preds), f"CMI {c}")
+            ll = _model_logloss(protected + [c], y, train, seed, job)
+            info.append(max(base_ll - ll, 0.0))
+        info = np.asarray(info)
+        info_idx = info / max(info.max(), EPS)
+
+        net_thr = float(p["net_information_threshold"])
+        tot_thr = float(p["total_information_threshold"])
+        table, admissible = [], []
+        for c, ii in zip(preds, info_idx):
+            ri = float(rel_map.get(c, 0.0))
+            ok = bool(ri >= net_thr and ii >= tot_thr)
+            table.append((c, ri, float(ii), ok))
+            if ok:
+                admissible.append(c)
+        table.sort(key=lambda r: -(r[1] + r[2]))
+
+        out = dict(infogram_table=table, admissible_features=admissible,
+                   protected_columns=protected, x=preds)
+        model = self.model_cls(self.model_id, dict(p), out)
+        model.params["response_column"] = y
+        model.output["training_metrics"] = model.model_metrics()
+        return model

@@ -224,6 +224,28 @@ class Model:
         return mm.multinomial_metrics(raw[:, 1:], y, w=w, valid=valid,
                                       domain=dom)
 
+    def varimp(self, use_pandas: bool = False):
+        """Relative/scaled/percentage variable importance (the reference's
+        SharedTreeModel varimp convention: max-scaled + share-of-total)."""
+        vi = self.output.get("varimp")
+        if vi is None:
+            return None
+        vi = np.asarray(vi, np.float64)
+        names = list(self.output.get("x") or
+                     [f"C{i}" for i in range(len(vi))])
+        order = np.argsort(-vi)
+        rel = vi[order]
+        scaled = rel / rel[0] if len(rel) and rel[0] > 0 else rel
+        pct = rel / rel.sum() if rel.sum() > 0 else rel
+        rows = [(names[i], float(r), float(s), float(p))
+                for i, r, s, p in zip(order, rel, scaled, pct)]
+        if use_pandas:
+            import pandas as pd
+            return pd.DataFrame(rows, columns=[
+                "variable", "relative_importance", "scaled_importance",
+                "percentage"])
+        return rows
+
     # -- persistence (binary save/load; MOJO-style export in io.py) --------
 
     def save(self, path: str) -> str:
@@ -253,6 +275,9 @@ class ModelBuilder:
     algo: str = "base"
     model_cls = Model
     supervised = True
+    # builders whose nfolds param means something other than CV model
+    # orchestration (e.g. TargetEncoder's encoding folds) set this False
+    supports_cv = True
 
     def __init__(self, **params):
         self.params = self.default_params()
@@ -297,8 +322,9 @@ class ModelBuilder:
         t0 = time.time()
         job = Job(dest=self.model_id or Key.make(self.algo),
                   description=f"{self.algo} on {training_frame.key}")
-        use_cv = int(self.params.get("nfolds") or 0) > 1 or \
-            self.params.get("fold_column")
+        use_cv = self.supports_cv and (
+            int(self.params.get("nfolds") or 0) > 1 or
+            self.params.get("fold_column"))
 
         def body(j: Job) -> Model:
             if use_cv:
@@ -473,7 +499,8 @@ class ModelBuilder:
         return "gaussian"
 
     def rng_key(self) -> jax.Array:
-        seed = int(self.params.get("seed") or -1)
+        seed = self.params.get("seed")
+        seed = int(seed) if seed is not None else -1
         if seed < 0:
             seed = np.random.SeedSequence().entropy % (2 ** 31)
         return jax.random.key(seed)

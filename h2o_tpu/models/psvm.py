@@ -1,0 +1,146 @@
+"""PSVM — primal support vector machine with low-rank kernel approximation.
+
+Reference (hex/psvm/*, 2.1k LoC): binary SVM solved in the primal with an
+Incomplete Cholesky Factorization (ICF) low-rank approximation of the
+gaussian kernel matrix (``rank_ratio``), hinge loss with per-class weights
+(``positive_weight``/``negative_weight``), hyper_param C.
+
+TPU-native: the low-rank kernel map is RANDOM FOURIER FEATURES instead of
+ICF — the same k(x,y) ≈ φ(x)·φ(y) contract, but φ is a dense matmul + cos
+(MXU-friendly, no sequential pivot selection); the primal hinge objective is
+then minimized by a jitted gradient loop over the row-sharded feature map.
+Decision values are exact under the approximation; class probabilities are
+a Platt-style sigmoid on the margin (the reference emits labels only).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o_tpu.core.frame import Frame
+from h2o_tpu.models.model import DataInfo, Model, ModelBuilder
+from h2o_tpu.models.glm import expand_for_scoring, expansion_spec
+
+EPS = 1e-10
+
+
+@functools.partial(jax.jit, static_argnames=("iters",))
+def _svm_fit(Z, ysign, w, valid, C, iters: int):
+    """Primal hinge: min 0.5|w|^2 + C Σ w_i max(0, 1 - y f); subgradient
+    descent with 1/sqrt(t) steps, averaged iterate (Pegasos-style)."""
+    Rn, D = Z.shape
+    beta0 = jnp.zeros((D + 1,), jnp.float32)
+
+    def f(beta):
+        return Z @ beta[:-1] + beta[-1]
+
+    def body(t, carry):
+        beta, avg = carry
+        marg = ysign * f(beta)
+        g_mask = jnp.where(valid & (marg < 1.0), w, 0.0)
+        gw = beta[:-1] - C * (Z.T @ (g_mask * ysign))
+        gb = -C * jnp.sum(g_mask * ysign)
+        g = jnp.concatenate([gw, jnp.array([gb])])
+        step = 0.5 / jnp.sqrt(t + 1.0)
+        beta = beta - step * g / (1.0 + C * jnp.sum(w * valid) / Rn)
+        return beta, avg + beta
+
+    beta, avg = jax.lax.fori_loop(0, iters, body, (beta0, beta0))
+    return avg / iters
+
+
+class PSVMModel(Model):
+    algo = "psvm"
+
+    def _phi(self, X):
+        out = self.output
+        W = jnp.asarray(out["rff_w"])
+        b = jnp.asarray(out["rff_b"])
+        D = W.shape[1]
+        return jnp.sqrt(2.0 / D) * jnp.cos(X @ W + b[None, :])
+
+    def predict_raw(self, frame: Frame):
+        out = self.output
+        X = expand_for_scoring(frame, out["expansion_spec"])
+        beta = jnp.asarray(out["beta"])
+        fdec = self._phi(X) @ beta[:-1] + beta[-1]
+        p1 = jax.nn.sigmoid(out["platt_a"] * fdec + out["platt_b"])
+        label = (fdec >= 0).astype(jnp.float32)
+        return jnp.stack([label, 1 - p1, p1], axis=1)
+
+
+class PSVM(ModelBuilder):
+    algo = "psvm"
+    model_cls = PSVMModel
+
+    def default_params(self) -> Dict:
+        p = super().default_params()
+        p.update(hyper_param=1.0, kernel_type="gaussian", gamma=-1.0,
+                 rank_ratio=-1.0, positive_weight=1.0, negative_weight=1.0,
+                 max_iterations=200, feature_dim=256)
+        return p
+
+    def _fit(self, job, x, y, train: Frame, valid: Optional[Frame]):
+        p = self.params
+        di = DataInfo(train, x, y, mode="expanded", standardize=True,
+                      weights=p.get("weights_column"), impute_missing=True)
+        if di.nclasses != 2:
+            raise ValueError("PSVM requires a binary response")
+        X = di.matrix()
+        P = X.shape[1]
+        gamma = float(p["gamma"])
+        if gamma <= 0:
+            gamma = 1.0 / max(P, 1)
+        D = int(p.get("feature_dim") or 256)
+        rr = float(p.get("rank_ratio") or -1.0)
+        if rr > 0:
+            D = max(16, int(rr * train.nrows))
+        key = self.rng_key()
+        kw, kb = jax.random.split(key)
+        # RFF for exp(-gamma ||x-y||^2): w ~ N(0, 2 gamma I)
+        W = jax.random.normal(kw, (P, D)) * jnp.sqrt(2.0 * gamma)
+        b = jax.random.uniform(kb, (D,), maxval=2 * jnp.pi)
+        Z = jnp.sqrt(2.0 / D) * jnp.cos(X @ W + b[None, :])
+
+        yv = di.response()
+        ysign = jnp.where(jnp.nan_to_num(yv) > 0, 1.0, -1.0)
+        cls_w = jnp.where(ysign > 0, float(p["positive_weight"]),
+                          float(p["negative_weight"]))
+        w = di.weights() * cls_w
+        valid_m = di.valid_mask()
+        C = jnp.float32(p["hyper_param"])
+        job.update(0.2, f"primal SVM on {D} Fourier features")
+        beta = _svm_fit(Z, ysign, w, valid_m, C,
+                        int(p["max_iterations"]))
+
+        # Platt scaling on the training margins (host 1-d logistic fit)
+        fdec = np.asarray(Z @ beta[:-1] + beta[-1])[: train.nrows]
+        yy = np.asarray(ysign)[: train.nrows] > 0
+        a_, b_ = -1.0, 0.0
+        for _ in range(50):
+            z = np.clip(a_ * fdec + b_, -30, 30)
+            pr = 1 / (1 + np.exp(-z))
+            g_a = np.sum((pr - yy) * fdec)
+            g_b = np.sum(pr - yy)
+            h_aa = np.sum(pr * (1 - pr) * fdec * fdec) + 1e-6
+            h_bb = np.sum(pr * (1 - pr)) + 1e-6
+            a_ -= g_a / h_aa
+            b_ -= g_b / h_bb
+        out = dict(x=list(di.x), beta=np.asarray(beta),
+                   rff_w=np.asarray(W), rff_b=np.asarray(b),
+                   gamma=gamma, feature_dim=D,
+                   platt_a=float(a_), platt_b=float(b_),
+                   response_domain=di.response_domain,
+                   svs_count=int(np.sum(np.abs(1 - yy * fdec) < 1)),
+                   expansion_spec=expansion_spec(di))
+        model = self.model_cls(self.model_id, dict(p), out)
+        model.params["response_column"] = y
+        model.output["training_metrics"] = model.model_metrics(train)
+        if valid is not None:
+            model.output["validation_metrics"] = model.model_metrics(valid)
+        return model

@@ -3,89 +3,65 @@ builder registers itself so REST /3/ModelBuilders/{algo} can dispatch)."""
 
 from __future__ import annotations
 
-from typing import Dict, Type
+import importlib
+from typing import Dict
+
+from h2o_tpu.core.log import get_logger
+
+log = get_logger("registry")
+
+# (algo key, module, class) — order mirrors RegisterAlgos registration
+_ALGOS = [
+    ("gbm", "h2o_tpu.models.tree.gbm", "GBM"),
+    ("drf", "h2o_tpu.models.tree.drf", "DRF"),
+    ("xgboost", "h2o_tpu.models.tree.xgboost", "XGBoost"),
+    ("dt", "h2o_tpu.models.tree.dt", "DT"),
+    ("isolationforest", "h2o_tpu.models.tree.isofor", "IsolationForest"),
+    ("extendedisolationforest", "h2o_tpu.models.tree.isofor",
+     "ExtendedIsolationForest"),
+    ("upliftdrf", "h2o_tpu.models.tree.uplift", "UpliftDRF"),
+    ("glm", "h2o_tpu.models.glm", "GLM"),
+    ("gam", "h2o_tpu.models.gam", "GAM"),
+    ("kmeans", "h2o_tpu.models.kmeans", "KMeans"),
+    ("deeplearning", "h2o_tpu.models.deeplearning", "DeepLearning"),
+    ("pca", "h2o_tpu.models.pca", "PCA"),
+    ("svd", "h2o_tpu.models.svd", "SVD"),
+    ("glrm", "h2o_tpu.models.glrm", "GLRM"),
+    ("word2vec", "h2o_tpu.models.word2vec", "Word2Vec"),
+    ("naivebayes", "h2o_tpu.models.naive_bayes", "NaiveBayes"),
+    ("coxph", "h2o_tpu.models.coxph", "CoxPH"),
+    ("isotonicregression", "h2o_tpu.models.isotonic",
+     "IsotonicRegression"),
+    ("aggregator", "h2o_tpu.models.aggregator", "Aggregator"),
+    ("targetencoder", "h2o_tpu.models.target_encoder", "TargetEncoder"),
+    ("rulefit", "h2o_tpu.models.rulefit", "RuleFit"),
+    ("modelselection", "h2o_tpu.models.modelselection", "ModelSelection"),
+    ("anovaglm", "h2o_tpu.models.anovaglm", "AnovaGLM"),
+    ("psvm", "h2o_tpu.models.psvm", "PSVM"),
+    ("infogram", "h2o_tpu.models.infogram", "Infogram"),
+    ("generic", "h2o_tpu.models.generic", "Generic"),
+    ("stackedensemble", "h2o_tpu.models.ensemble", "StackedEnsemble"),
+]
+
+_cache: Dict[str, type] = {}
 
 
 def builders() -> Dict[str, type]:
-    from h2o_tpu.models.tree.gbm import GBM
-    from h2o_tpu.models.tree.drf import DRF
-    reg = {"gbm": GBM, "drf": DRF}
-    try:
-        from h2o_tpu.models.glm import GLM
-        reg["glm"] = GLM
-    except ImportError:
-        pass
-    try:
-        from h2o_tpu.models.kmeans import KMeans
-        reg["kmeans"] = KMeans
-    except ImportError:
-        pass
-    try:
-        from h2o_tpu.models.deeplearning import DeepLearning
-        reg["deeplearning"] = DeepLearning
-    except ImportError:
-        pass
-    try:
-        from h2o_tpu.models.pca import PCA
-        reg["pca"] = PCA
-    except ImportError:
-        pass
-    try:
-        from h2o_tpu.models.naive_bayes import NaiveBayes
-        reg["naivebayes"] = NaiveBayes
-    except ImportError:
-        pass
-    try:
-        from h2o_tpu.models.tree.isofor import (ExtendedIsolationForest,
-                                                IsolationForest)
-        reg["isolationforest"] = IsolationForest
-        reg["extendedisolationforest"] = ExtendedIsolationForest
-    except ImportError:
-        pass
-    try:
-        from h2o_tpu.models.svd import SVD
-        reg["svd"] = SVD
-    except ImportError:
-        pass
-    try:
-        from h2o_tpu.models.glrm import GLRM
-        reg["glrm"] = GLRM
-    except ImportError:
-        pass
-    try:
-        from h2o_tpu.models.word2vec import Word2Vec
-        reg["word2vec"] = Word2Vec
-    except ImportError:
-        pass
-    try:
-        from h2o_tpu.models.coxph import CoxPH
-        reg["coxph"] = CoxPH
-    except ImportError:
-        pass
-    try:
-        from h2o_tpu.models.isotonic import IsotonicRegression
-        reg["isotonicregression"] = IsotonicRegression
-    except ImportError:
-        pass
-    try:
-        from h2o_tpu.models.aggregator import Aggregator
-        reg["aggregator"] = Aggregator
-    except ImportError:
-        pass
-    try:
-        from h2o_tpu.models.gam import GAM
-        reg["gam"] = GAM
-    except ImportError:
-        pass
-    from h2o_tpu.models.generic import Generic
-    reg["generic"] = Generic
-    from h2o_tpu.models.ensemble import StackedEnsemble
-    reg["stackedensemble"] = StackedEnsemble
-    return reg
+    if not _cache:
+        for algo, module, cls in _ALGOS:
+            try:
+                _cache[algo] = getattr(importlib.import_module(module), cls)
+            except Exception as e:  # noqa: BLE001 — registry must survive
+                log.warning("algo %s unavailable: %r", algo, e)
+    return dict(_cache)
 
 
 def builder_class(algo: str) -> type:
-    return builders()[algo.lower()]
+    reg = builders()
+    key = algo.lower()
+    if key not in reg:
+        raise KeyError(f"unknown algo '{algo}'; have {sorted(reg)}")
+    return reg[key]
 
 
 def model_class(algo: str) -> type:

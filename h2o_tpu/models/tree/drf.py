@@ -142,6 +142,10 @@ class DRF(ModelBuilder):
                 domains={c: list(train.vec(c).domain)
                          for c in di.cat_names},
                 ntrees_actual=prior + n_new)
+            if ckpt is not None and co.get("varimp") is not None:
+                # carry the checkpoint trees' importance; the driver adds
+                # the new trees' gains on top
+                out["varimp"] = np.asarray(co["varimp"])
             model = self.model_cls(self.model_id, dict(p), out)
             model.params["response_column"] = y
             return model
@@ -155,6 +159,8 @@ class DRF(ModelBuilder):
             learn_rate=1.0, learn_rate_annealing=1.0,
             min_rows=float(p["min_rows"]),
             min_split_improvement=float(p["min_split_improvement"]),
+            col_sample_rate_per_tree=float(
+                p.get("col_sample_rate_per_tree") or 1.0),
             mode="drf")
         kind = "binomial" if nclass == 2 else (
             "multinomial" if nclass > 2 else "regression")

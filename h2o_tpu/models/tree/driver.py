@@ -88,10 +88,14 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
         model = make_model(np.asarray(tf.split_col), np.asarray(tf.bitset),
                            np.asarray(tf.value), max(ntrees, 0), tf.f_final)
         model.output["scoring_history"] = []
+        prior_vi = model.output.get("varimp")
+        vi = np.asarray(tf.varimp)
+        model.output["varimp"] = vi if prior_vi is None else prior_vi + vi
         return model
 
     block = interval if interval > 0 else max(1, min(ntrees, 10))
     scs, bss, vls = [], [], []
+    vi_total = None
     F = F0
     done = 0
     prefix = "validation_" if scorer.is_validation else "training_"
@@ -104,6 +108,8 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
         scs.append(np.asarray(tf.split_col))
         bss.append(np.asarray(tf.bitset))
         vls.append(np.asarray(tf.value))
+        vi = np.asarray(tf.varimp)
+        vi_total = vi if vi_total is None else vi_total + vi
         done += n
         scorer.add(tf.split_col, tf.bitset, tf.value)
         mm = scorer.metrics(prior_trees + done)
@@ -125,4 +131,8 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
     model = make_model(np.concatenate(scs), np.concatenate(bss),
                        np.concatenate(vls), done, F)
     model.output["scoring_history"] = sk.events
+    prior_vi = model.output.get("varimp")
+    if vi_total is not None:
+        model.output["varimp"] = vi_total if prior_vi is None \
+            else prior_vi + vi_total
     return model

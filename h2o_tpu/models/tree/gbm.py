@@ -158,6 +158,10 @@ class GBM(ModelBuilder):
         depth = int(p["max_depth"])
         newton = dist_name not in ("gaussian", "laplace", "quantile",
                                    "huber")
+        if p.get("force_newton"):
+            # XGBoost semantics: Newton leaf values for every objective
+            # (squared error has unit hessian, so wg/(wh+reg_lambda))
+            newton = True
         k_cols = max(1, min(C, int(round(float(p["col_sample_rate"]) * C))))
         f0_out = np.asarray(f0 if dist_name == "multinomial"
                             else jnp.broadcast_to(f0, (K,)))
@@ -181,6 +185,10 @@ class GBM(ModelBuilder):
                 domains={c: list(train.vec(c).domain)
                          for c in di.cat_names},
                 ntrees_actual=prior + n_new)
+            if ckpt is not None and co.get("varimp") is not None:
+                # carry the checkpoint trees' importance; the driver adds
+                # the new trees' gains on top
+                out["varimp"] = np.asarray(co["varimp"])
             model = self.model_cls(self.model_id, dict(p), out)
             model.params["response_column"] = y
             return model
@@ -198,6 +206,9 @@ class GBM(ModelBuilder):
             bf16=bool(p.get("bf16_histograms", False)), mode="gbm",
             tweedie_power=float(p["tweedie_power"]),
             quantile_alpha=float(p["quantile_alpha"]),
+            reg_lambda=float(p.get("reg_lambda") or 0.0),
+            col_sample_rate_per_tree=float(
+                p.get("col_sample_rate_per_tree") or 1.0),
             huber_alpha=float(p["huber_alpha"]))
         kind = "binomial" if nclass == 2 else (
             "multinomial" if nclass > 2 else "regression")

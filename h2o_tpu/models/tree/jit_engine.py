@@ -31,24 +31,31 @@ from h2o_tpu.ops.histogram import histogram_build_traced as _shard_histogram
 EPS = 1e-10
 
 
-def _node_val(wg, wh, w, newton: bool):
-    denom = jnp.maximum(wh, EPS) if newton else jnp.maximum(w, EPS)
+def _node_val(wg, wh, w, newton: bool, reg_lambda: float = 0.0):
+    denom = jnp.maximum(wh + reg_lambda, EPS) if newton \
+        else jnp.maximum(w, EPS)
     return wg / denom
 
 
-def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict):
-    """Traceable single-tree build.  Returns (split_col, bitset, value),
-    shapes (H,), (H, B+1), (H,) with H = 2^(D+1)-1."""
+def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
+                      tree_col_mask=None):
+    """Traceable single-tree build.  Returns (split_col, bitset, value,
+    varimp), shapes (H,), (H, B+1), (H,), (C,) with H = 2^(D+1)-1.
+    varimp accumulates each split's SE-reduction gain into its column —
+    the reference's relative-importance convention (SharedTreeModel
+    varimp from squared-error improvements)."""
     D = cfg["max_depth"]
     B = cfg["nbins"]
     C = bins.shape[1]
     H = 2 ** (D + 1) - 1
     k_cols = cfg["k_cols"]
     newton = cfg["newton"]
+    reg_lambda = cfg.get("reg_lambda", 0.0)
 
     split_col = jnp.full((H,), -1, jnp.int32)
     bitset = jnp.zeros((H, B + 1), bool)
     value = jnp.zeros((H,), jnp.float32)
+    varimp = jnp.zeros((C,), jnp.float32)
     leaf = leaf0
 
     for d in range(D):                       # static unroll — exact L per level
@@ -63,6 +70,8 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict):
             col_allowed = r <= kth
         else:
             col_allowed = jnp.ones((L, C), bool)
+        if tree_col_mask is not None:
+            col_allowed = col_allowed & tree_col_mask[None, :]
         s = find_splits(hist, is_cat, col_allowed,
                         min_rows=cfg["min_rows"],
                         min_split_improvement=cfg["min_split_improvement"])
@@ -70,12 +79,14 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict):
         do_split = s["do_split"] & live
         term = live & ~do_split
         leaf_vals = _node_val(s["leaf"]["wg"], s["leaf"]["wh"],
-                              s["leaf"]["w"], newton)
+                              s["leaf"]["w"], newton, reg_lambda)
         lvals = _node_val(s["left"]["wg"], s["left"]["wh"],
-                          s["left"]["w"], newton)
+                          s["left"]["w"], newton, reg_lambda)
         rvals = _node_val(s["right"]["wg"], s["right"]["wh"],
-                          s["right"]["w"], newton)
+                          s["right"]["w"], newton, reg_lambda)
 
+        varimp = varimp.at[s["col"]].add(
+            jnp.where(do_split, jnp.maximum(s["gain"], 0.0), 0.0))
         # record splits + terminal values at this level's heap slots
         split_col = jax.lax.dynamic_update_slice(
             split_col, jnp.where(do_split, s["col"], -1), (off,))
@@ -100,7 +111,7 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict):
         child = 2 * lf + jnp.where(go_left, 0, 1)
         leaf = jnp.where(active & do_split[lf], child,
                          jnp.where(active, -1, leaf))
-    return split_col, bitset, value
+    return split_col, bitset, value, varimp
 
 
 def _tree_predict(bins, split_col, bitset, value, D: int):
@@ -123,6 +134,7 @@ class TrainedForest(NamedTuple):
     bitset: jax.Array      # (T, K, H, B+1)
     value: jax.Array       # (T, K, H)
     f_final: jax.Array     # (R, K) link-scale training predictions
+    varimp: jax.Array      # (C,) summed split-gain importance
 
 
 @functools.partial(
@@ -132,7 +144,8 @@ class TrainedForest(NamedTuple):
                      "learn_rate_annealing", "min_rows",
                      "min_split_improvement", "block_rows", "bf16",
                      "mode", "tweedie_power", "quantile_alpha",
-                     "huber_alpha"))
+                     "huber_alpha", "reg_lambda",
+                     "col_sample_rate_per_tree"))
 def train_forest(bins, yv, w, active, F0, is_cat, key, *, dist_name: str,
                  K: int, ntrees: int, max_depth: int, nbins: int,
                  k_cols: int, newton: bool, sample_rate: float,
@@ -141,7 +154,9 @@ def train_forest(bins, yv, w, active, F0, is_cat, key, *, dist_name: str,
                  block_rows: int = 8192, bf16: bool = False,
                  mode: str = "gbm", tweedie_power: float = 1.5,
                  quantile_alpha: float = 0.5,
-                 huber_alpha: float = 0.9, t0: int = 0) -> TrainedForest:
+                 huber_alpha: float = 0.9, reg_lambda: float = 0.0,
+                 col_sample_rate_per_tree: float = 1.0,
+                 t0: int = 0) -> TrainedForest:
     """The WHOLE forest training loop as one XLA program.
 
     mode="gbm": boosting — stats from distribution gradients at current F,
@@ -152,7 +167,7 @@ def train_forest(bins, yv, w, active, F0, is_cat, key, *, dist_name: str,
     cfg = dict(max_depth=max_depth, nbins=nbins, k_cols=k_cols,
                newton=newton, min_rows=min_rows,
                min_split_improvement=min_split_improvement,
-               block_rows=block_rows, bf16=bf16)
+               block_rows=block_rows, bf16=bf16, reg_lambda=reg_lambda)
     R = bins.shape[0]
 
     def stats_for(kcls, F):
@@ -176,9 +191,19 @@ def train_forest(bins, yv, w, active, F0, is_cat, key, *, dist_name: str,
             h = jnp.nan_to_num(dist.hessian(yv, F[:, 0]))
         return jnp.stack([wa, wa * g, wa * g * g, wa * h], axis=1)
 
+    C = bins.shape[1]
+
     def tree_step(F, xs):
         t_idx, key_t = xs
-        ks, kc = jax.random.split(key_t)
+        ks, kc, kcol = jax.random.split(key_t, 3)
+        if col_sample_rate_per_tree < 1.0:
+            # per-TREE column subsample (colsample_bytree); keep >= 1 col
+            rc = jax.random.uniform(kcol, (C,))
+            kth = jnp.sort(rc)[max(
+                1, int(round(col_sample_rate_per_tree * C))) - 1]
+            tree_cols = rc <= kth
+        else:
+            tree_cols = None
         samp = jnp.where(
             jax.random.uniform(ks, (R,)) < sample_rate, True, False) \
             if sample_rate < 1.0 else jnp.ones((R,), bool)
@@ -187,23 +212,25 @@ def train_forest(bins, yv, w, active, F0, is_cat, key, *, dist_name: str,
             if mode == "gbm" else 1.0
         if mode == "gbm" and dist_name == "multinomial":
             scale = scale * (K - 1) / K
-        scs, bss, vls, preds = [], [], [], []
+        scs, bss, vls, preds, vis = [], [], [], [], []
         for kcls in range(K):                    # static unroll over classes
             kc, kk = jax.random.split(kc)
             stats = stats_for(kcls, F)
-            sc, bs, vl = build_tree_traced(bins, stats, leaf0, kk, is_cat,
-                                           cfg)
+            sc, bs, vl, vi = build_tree_traced(bins, stats, leaf0, kk,
+                                               is_cat, cfg, tree_cols)
             vl = vl * scale
             scs.append(sc)
             bss.append(bs)
             vls.append(vl)
+            vis.append(vi)
             preds.append(_tree_predict(bins, sc, bs, vl, max_depth))
         F = F + jnp.stack(preds, axis=1)
-        return F, (jnp.stack(scs), jnp.stack(bss), jnp.stack(vls))
+        return F, (jnp.stack(scs), jnp.stack(bss), jnp.stack(vls),
+                   sum(vis))
 
     keys = jax.random.split(key, ntrees)
     # t0 is a TRACED scalar (not static): per-block calls with varying tree
     # offsets reuse one compiled program
     ts = jnp.arange(ntrees, dtype=jnp.float32) + jnp.float32(t0)
-    F_final, (sc, bs, vl) = jax.lax.scan(tree_step, F0, (ts, keys))
-    return TrainedForest(sc, bs, vl, F_final)
+    F_final, (sc, bs, vl, vi) = jax.lax.scan(tree_step, F0, (ts, keys))
+    return TrainedForest(sc, bs, vl, F_final, jnp.sum(vi, axis=0))

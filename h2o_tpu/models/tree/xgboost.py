@@ -1,0 +1,83 @@
+"""XGBoost — parameter-compatible histogram gradient boosting.
+
+Reference (h2o-extensions/xgboost, 17.1k Java glue + native libxgboost):
+H2O frames convert to DMatrix, one native updater thread per node drives
+``tree_method=hist/gpu_hist/approx`` boosters with Rabit allreduce
+(RabitTrackerH2O.java:14).  SURVEY §2.3 marks this the ``gpu_hist`` → TPU
+path: the same histogram engine as GBM, XGBoost-compatible params.
+
+TPU-native: this builder IS the fused-XLA histogram engine (jit_engine.py)
+— the Pallas/MXU histogram replaces gpu_hist's shared-memory bins and the
+row-shard psum replaces Rabit's ring allreduce.  XGBoost naming is mapped
+onto the engine (eta→learn_rate, subsample→sample_rate, colsample_bytree→
+col_sample_rate_per_tree, min_child_weight→min_rows, max_bins→nbins);
+``reg_lambda`` enters the Newton leaf denominator; ``min_split_loss``
+(gamma) maps to the split-improvement threshold.  ``booster=dart/gblinear``
+and monotone constraints are not implemented (tracked follow-ups).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from h2o_tpu.core.frame import Frame
+from h2o_tpu.models.tree.gbm import GBM, GBMModel
+
+
+class XGBoostModel(GBMModel):
+    algo = "xgboost"
+
+
+_PARAM_MAP = {
+    "eta": "learn_rate",
+    "learn_rate": "learn_rate",
+    "subsample": "sample_rate",
+    "sample_rate": "sample_rate",
+    "colsample_bytree": "col_sample_rate_per_tree",
+    "col_sample_rate_per_tree": "col_sample_rate_per_tree",
+    "colsample_bylevel": "col_sample_rate",
+    "col_sample_rate": "col_sample_rate",
+    "min_child_weight": "min_rows",
+    "min_rows": "min_rows",
+    "max_bins": "nbins",
+    "min_split_loss": "min_split_improvement",
+    "gamma": "min_split_improvement",
+}
+
+_XGB_DEFAULTS = dict(
+    ntrees=50, max_depth=6, eta=0.3, subsample=1.0, colsample_bytree=1.0,
+    colsample_bylevel=1.0, min_child_weight=1.0, max_bins=256,
+    reg_lambda=1.0, reg_alpha=0.0, min_split_loss=0.0,
+    tree_method="hist", booster="gbtree", grow_policy="depthwise",
+    backend="auto", force_newton=True)
+
+
+class XGBoost(GBM):
+    algo = "xgboost"
+    model_cls = XGBoostModel
+
+    def default_params(self) -> Dict:
+        p = super().default_params()
+        p.update(_XGB_DEFAULTS)
+        # GBM defaults that differ under XGBoost naming
+        p["learn_rate"] = 0.3
+        p["min_rows"] = 1.0
+        p["nbins"] = 256
+        return p
+
+    def __init__(self, **params):
+        super().__init__(**params)
+        # translate xgboost names onto the engine's (explicit user values
+        # win over both defaults)
+        for xgb_name, engine_name in _PARAM_MAP.items():
+            if xgb_name in params and xgb_name != engine_name:
+                self.params[engine_name] = params[xgb_name]
+        booster = self.params.get("booster", "gbtree")
+        if booster not in ("gbtree",):
+            raise ValueError(f"booster='{booster}' not supported "
+                             "(gbtree only)")
+
+    def _fit(self, job, x, y, train: Frame, valid: Optional[Frame]):
+        # reg_lambda flows into the Newton denominator via the engine's
+        # reg_lambda kwarg (jit_engine._node_val)
+        return super()._fit(job, x, y, train, valid)

@@ -1,0 +1,122 @@
+"""RuleFit / ModelSelection / AnovaGLM / varimp tests."""
+
+import numpy as np
+
+from tests.test_algos import _frame_from
+
+
+def test_gbm_varimp_ranks_signal_features(cl, rng):
+    from h2o_tpu.models.tree.gbm import GBM
+    n = 2000
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    y = (3 * X[:, 0] + X[:, 1] + 0.05 * rng.normal(size=n)).astype(
+        np.float32)
+    fr = _frame_from(X, y)
+    m = GBM(ntrees=20, max_depth=4, seed=1).train(y="y", training_frame=fr)
+    vi = m.varimp()
+    assert vi is not None and len(vi) == 6
+    names = [r[0] for r in vi]
+    assert names[0] == "x0" and names[1] == "x1", names
+    # percentages sum to 1
+    assert abs(sum(r[3] for r in vi) - 1.0) < 1e-6
+
+
+def test_rulefit_finds_interpretable_rules(cl, rng):
+    from h2o_tpu.models.rulefit import RuleFit
+    n = 2500
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = ((X[:, 0] > 0.5) & (X[:, 1] < 0)).astype(np.int32)
+    fr = _frame_from(X, y, y_domain=["0", "1"])
+    m = RuleFit(min_rule_length=2, max_rule_length=3,
+                rule_generation_ntrees=20, seed=2).train(
+        y="y", training_frame=fr)
+    rules = m.rule_importance()
+    assert len(rules) > 0
+    # top rules reference the true signal columns
+    top_desc = " ".join(r[3] for r in rules[:5])
+    assert "x0" in top_desc or "x1" in top_desc, rules[:5]
+    raw = np.asarray(m.predict_raw(fr))[:n]
+    auc_proxy = float((raw[:, 0] == y).mean())
+    assert auc_proxy > 0.9, auc_proxy
+
+
+def test_modelselection_maxr_orders_subsets(cl, rng):
+    from h2o_tpu.models.modelselection import ModelSelection
+    n = 1500
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = (2 * X[:, 0] + 1 * X[:, 1] + 0.5 * X[:, 2] +
+         0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = _frame_from(X, y)
+    m = ModelSelection(mode="maxr", max_predictor_number=3).train(
+        y="y", training_frame=fr)
+    best = m.best_model_per_size()
+    assert set(best) == {1, 2, 3}
+    assert best[1]["predictors"] == ["x0"]
+    assert set(best[2]["predictors"]) == {"x0", "x1"}
+    assert set(best[3]["predictors"]) == {"x0", "x1", "x2"}
+    # scores improve with size
+    assert best[1]["score"] < best[2]["score"] < best[3]["score"]
+
+
+def test_modelselection_backward(cl, rng):
+    from h2o_tpu.models.modelselection import ModelSelection
+    n = 1000
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (X[:, 0] - 2 * X[:, 3] + 0.1 * rng.normal(size=n)).astype(
+        np.float32)
+    fr = _frame_from(X, y)
+    m = ModelSelection(mode="backward", max_predictor_number=4,
+                       min_predictor_number=1).train(
+        y="y", training_frame=fr)
+    best = m.best_model_per_size()
+    assert set(best[2]["predictors"]) == {"x0", "x3"}
+
+
+def test_anovaglm_flags_significant_terms(cl, rng):
+    from h2o_tpu.models.anovaglm import AnovaGLM
+    n = 1200
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    y = (1.5 * X[:, 0] + 0.0 * X[:, 1] + 0.1 * rng.normal(size=n)).astype(
+        np.float32)
+    fr = _frame_from(X, y)
+    m = AnovaGLM(family="gaussian").train(y="y", training_frame=fr)
+    table = {r[0]: r for r in m.result()}
+    assert table["x0"][3] < 1e-6          # strongly significant
+    assert table["x1"][3] > 0.01          # noise term not significant
+
+
+def test_registry_has_rules_selection(cl):
+    from h2o_tpu.models.registry import builders
+    b = builders()
+    for algo in ("rulefit", "modelselection", "anovaglm"):
+        assert algo in b
+
+
+def test_psvm_separates_classes(cl, rng):
+    from h2o_tpu.models.psvm import PSVM
+    n = 1500
+    X = rng.normal(size=(n, 2)).astype(np.float32)
+    # circular boundary (linear models fail, RBF succeeds)
+    y = ((X[:, 0] ** 2 + X[:, 1] ** 2) < 1.0).astype(np.int32)
+    fr = _frame_from(X, y, y_domain=["0", "1"])
+    m = PSVM(hyper_param=1.0, max_iterations=300, seed=1).train(
+        y="y", training_frame=fr)
+    raw = np.asarray(m.predict_raw(fr))[:n]
+    acc = float((raw[:, 0] == y).mean())
+    assert acc > 0.9, acc
+    assert m.output["training_metrics"]["AUC"] > 0.95
+
+
+def test_infogram_flags_relevant_safe_features(cl, rng):
+    from h2o_tpu.models.infogram import Infogram
+    n = 1500
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    logits = 2.5 * X[:, 0] + 2.0 * X[:, 1]       # x2, x3 are noise
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.int32)
+    fr = _frame_from(X, y, y_domain=["0", "1"])
+    m = Infogram(seed=3).train(y="y", training_frame=fr)
+    adm = m.admissible_features()
+    assert "x0" in adm and "x1" in adm, adm
+    table = {r[0]: r for r in m.result()}
+    assert table["x0"][1] > table["x2"][1]       # relevance ordering
+    assert table["x0"][2] > table["x2"][2]       # information ordering

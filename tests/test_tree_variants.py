@@ -1,0 +1,167 @@
+"""XGBoost-compat / DT / UpliftDRF / TargetEncoder tests."""
+
+import numpy as np
+
+from tests.test_algos import _frame_from
+
+
+def test_xgboost_binomial(cl, rng):
+    from h2o_tpu.models.tree.xgboost import XGBoost
+    n = 2000
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    logits = 2 * X[:, 0] - X[:, 1] + X[:, 2] * X[:, 3]
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.int32)
+    fr = _frame_from(X, y, y_domain=["0", "1"])
+    m = XGBoost(ntrees=30, max_depth=4, eta=0.3, reg_lambda=1.0,
+                subsample=0.9, colsample_bytree=0.9, seed=1).train(
+        y="y", training_frame=fr)
+    assert m.output["training_metrics"]["AUC"] > 0.85
+    # xgboost names landed on the engine
+    assert m.params["learn_rate"] == 0.3
+    assert m.params["sample_rate"] == 0.9
+
+
+def test_xgboost_reg_lambda_shrinks(cl, rng):
+    from h2o_tpu.models.tree.xgboost import XGBoost
+    n = 800
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    y = (X[:, 0] + 0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = _frame_from(X, y)
+    m0 = XGBoost(ntrees=5, max_depth=3, reg_lambda=0.0, seed=2).train(
+        y="y", training_frame=fr)
+    m1 = XGBoost(ntrees=5, max_depth=3, reg_lambda=100.0, seed=2).train(
+        y="y", training_frame=fr)
+    # heavy L2 on leaves shrinks predictions toward the prior
+    v0 = np.var(np.asarray(m0.predict_raw(fr))[:n])
+    v1 = np.var(np.asarray(m1.predict_raw(fr))[:n])
+    assert v1 < v0 * 0.8, (v0, v1)
+
+
+def test_dt_single_tree(cl, rng):
+    from h2o_tpu.models.tree.dt import DT
+    n = 1200
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = ((X[:, 0] > 0.3) ^ (X[:, 1] < -0.2)).astype(np.int32)
+    fr = _frame_from(X, y, y_domain=["0", "1"])
+    m = DT(max_depth=6, seed=3).train(y="y", training_frame=fr)
+    assert m.output["ntrees_actual"] == 1
+    assert m.output["training_metrics"]["AUC"] > 0.9
+    raw = np.asarray(m.predict_raw(fr))[:n]
+    acc = float((raw[:, 0] == y).mean())
+    assert acc > 0.9, acc
+
+
+def test_uplift_drf_detects_treatment_effect(cl, rng):
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT
+    from h2o_tpu.models.tree.uplift import UpliftDRF
+    n = 3000
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    treat = rng.integers(0, 2, n)
+    # uplift only where x0 > 0: treated units respond more
+    base = 1 / (1 + np.exp(-X[:, 1]))
+    lift = 0.4 * (X[:, 0] > 0)
+    py = np.clip(base * 0.4 + treat * lift, 0, 1)
+    y = (rng.uniform(size=n) < py).astype(np.int32)
+    fr = Frame(["x0", "x1", "x2", "treatment", "y"],
+               [Vec(X[:, 0]), Vec(X[:, 1]), Vec(X[:, 2]),
+                Vec(treat.astype(np.int32), T_CAT, domain=["0", "1"]),
+                Vec(y, T_CAT, domain=["0", "1"])])
+    m = UpliftDRF(treatment_column="treatment", ntrees=30, max_depth=5,
+                  seed=4).train(x=["x0", "x1", "x2"], y="y",
+                                training_frame=fr)
+    pred = m.predict(fr)
+    assert pred.names == ["uplift_predict", "p_y1_ct1", "p_y1_ct0"]
+    u = pred.vec("uplift_predict").to_numpy()
+    # estimated uplift should be materially higher where x0 > 0
+    hi = u[X[:, 0] > 0.5].mean()
+    lo = u[X[:, 0] < -0.5].mean()
+    assert hi - lo > 0.15, (hi, lo)
+    assert m.output["training_metrics"]["auuc"] > 0
+
+
+def test_uplift_metrics_variants(cl, rng):
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT
+    from h2o_tpu.models.tree.uplift import UpliftDRF
+    n = 800
+    X = rng.normal(size=(n, 2)).astype(np.float32)
+    treat = rng.integers(0, 2, n)
+    y = (rng.uniform(size=n) < 0.3 + 0.2 * treat * (X[:, 0] > 0)).astype(
+        np.int32)
+    fr = Frame(["x0", "x1", "treatment", "y"],
+               [Vec(X[:, 0]), Vec(X[:, 1]),
+                Vec(treat.astype(np.int32), T_CAT, domain=["0", "1"]),
+                Vec(y, T_CAT, domain=["0", "1"])])
+    for metric in ("KL", "Euclidean", "ChiSquared"):
+        m = UpliftDRF(treatment_column="treatment", ntrees=10,
+                      max_depth=4, uplift_metric=metric, seed=5).train(
+            x=["x0", "x1"], y="y", training_frame=fr)
+        assert np.isfinite(m.output["training_metrics"]["ate"])
+
+
+def test_target_encoder_basic(cl, rng):
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT
+    from h2o_tpu.models.target_encoder import TargetEncoder
+    n = 2000
+    c = rng.integers(0, 4, n)
+    level_means = np.array([0.1, 0.4, 0.6, 0.9])
+    y = (rng.uniform(size=n) < level_means[c]).astype(np.int32)
+    fr = Frame(["cat", "y"],
+               [Vec(c.astype(np.int32), T_CAT, domain=list("abcd")),
+                Vec(y, T_CAT, domain=["0", "1"])])
+    m = TargetEncoder(noise=0.0).train(x=["cat"], y="y",
+                                       training_frame=fr)
+    t = m.transform(fr)
+    assert "cat_te" in t.names
+    enc = t.vec("cat_te").to_numpy()
+    for k in range(4):
+        emp = y[c == k].mean()
+        assert abs(enc[c == k][0] - emp) < 1e-5, (k, emp)
+
+
+def test_target_encoder_kfold_leakage_handling(cl, rng):
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT
+    from h2o_tpu.models.target_encoder import TargetEncoder
+    n = 1000
+    c = rng.integers(0, 3, n)
+    y = rng.integers(0, 2, n)
+    fr = Frame(["cat", "y"],
+               [Vec(c.astype(np.int32), T_CAT, domain=list("xyz")),
+                Vec(y.astype(np.int32), T_CAT, domain=["0", "1"])])
+    m = TargetEncoder(data_leakage_handling="KFold", nfolds=5,
+                      noise=0.0).train(x=["cat"], y="y", training_frame=fr)
+    t_train = m.transform(fr, as_training=True)
+    t_score = m.transform(fr, as_training=False)
+    e1 = t_train.vec("cat_te").to_numpy()
+    e2 = t_score.vec("cat_te").to_numpy()
+    # out-of-fold encodings differ from full-data encodings
+    assert not np.allclose(e1, e2)
+    # but both approximate the level means
+    assert abs(e1.mean() - y.mean()) < 0.05
+
+
+def test_target_encoder_blending_pulls_rare_levels_to_prior(cl, rng):
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT
+    from h2o_tpu.models.target_encoder import TargetEncoder
+    n = 500
+    c = np.where(rng.uniform(size=n) < 0.02, 1, 0)   # level b is rare
+    y = np.where(c == 1, 1, rng.integers(0, 2, n))
+    fr = Frame(["cat", "y"],
+               [Vec(c.astype(np.int32), T_CAT, domain=["a", "b"]),
+                Vec(y.astype(np.int32), T_CAT, domain=["0", "1"])])
+    prior = y.mean()
+    mb = TargetEncoder(blending=True, inflection_point=20.0,
+                       smoothing=10.0, noise=0.0).train(
+        x=["cat"], y="y", training_frame=fr)
+    enc_b = mb.transform(fr).vec("cat_te").to_numpy()[c == 1][0]
+    m0 = TargetEncoder(blending=False, noise=0.0).train(
+        x=["cat"], y="y", training_frame=fr)
+    enc0_b = m0.transform(fr).vec("cat_te").to_numpy()[c == 1][0]
+    # blending pulls the rare level's encoding toward the prior
+    assert abs(enc_b - prior) < abs(enc0_b - prior)
+
+
+def test_registry_has_tree_variants(cl):
+    from h2o_tpu.models.registry import builders
+    b = builders()
+    for algo in ("xgboost", "dt", "upliftdrf", "targetencoder"):
+        assert algo in b
