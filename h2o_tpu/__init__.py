@@ -39,3 +39,5 @@ if _cache_dir and _cache_dir != "0":
 
 from h2o_tpu.core.cloud import Cloud, cloud  # noqa: F401,E402
 from h2o_tpu.core.frame import Frame, Vec  # noqa: F401,E402
+from h2o_tpu.core.parse import (parse_file, parse_files,  # noqa: F401,E402
+                                parse_setup, parse_svmlight)

@@ -10,9 +10,10 @@ TPU-native redesign: files are tokenized on the HOST (columns never start on
 the device), then each column is padded + scattered into HBM in one
 ``device_put`` per column.  The type-inference contract of ParseSetup and the
 sorted-domain merge of ParseDataset are preserved; the byte-level tokenizer is
-delegated to a native (C) CSV reader — currently pandas' C engine, with a
-first-party C++ tokenizer planned (see h2o_tpu/native/).  SVMLight and ARFF
-get small host parsers.
+the first-party C++ loop in h2o_tpu/native/csv_tokenizer.cpp (chunk-
+parallel, quote-aware; built on first use), with pandas' C engine as the
+fallback (``use_native=False`` or ``H2O_TPU_NATIVE_PARSE=0``).  SVMLight
+and ARFF get small host parsers.
 """
 
 from __future__ import annotations
@@ -133,23 +134,115 @@ def parse_setup(paths: Sequence[str], sample_lines: int = 200
 
 def parse_file(path: str, setup: Optional[ParseSetupResult] = None,
                dest: Optional[str] = None,
-               column_types: Optional[Dict[str, str]] = None) -> Frame:
-    return parse_files([path], setup, dest, column_types)
+               column_types: Optional[Dict[str, str]] = None,
+               use_native: bool = True) -> Frame:
+    return parse_files([path], setup, dest, column_types,
+                       use_native=use_native)
+
+
+def _read_bytes(path: str) -> bytes:
+    if path.endswith(".gz"):
+        with gzip.open(path, "rb") as f:
+            return f.read()
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _parse_native(paths: Sequence[str], setup: ParseSetupResult,
+                  dest: Optional[str]) -> Optional[Frame]:
+    """First-party C++ tokenizer path (h2o_tpu/native/csv_tokenizer.cpp);
+    None when the native library is unavailable."""
+    from h2o_tpu import native
+    if not native.available():
+        return None
+    ncols = len(setup.column_names)
+    is_num = np.asarray([t in (T_NUM,) for t in setup.column_types],
+                        np.uint8)
+    num_parts, byte_parts, quo_parts = [], [], []
+    for p in paths:
+        data = _read_bytes(p)
+        nrows, num, soff, slen, squo = native.tokenize_csv(
+            data, setup.separator, ncols, is_num, setup.na_strings)
+        lo = 1 if setup.header else 0
+        data_np = np.frombuffer(data, np.uint8)
+        num_parts.append(num[lo:])
+        cells = [native.spans_to_fixed_bytes(
+            data_np, soff[lo:, j], slen[lo:, j])
+            for j in range(soff.shape[1])]
+        byte_parts.append(cells)
+        quo_parts.append(squo[lo:])
+    num_all = np.concatenate(num_parts) if num_parts else None
+    n_str = len(byte_parts[0]) if byte_parts else 0
+    str_all = [np.concatenate([bp[j] for bp in byte_parts])
+               for j in range(n_str)]
+    quo_all = np.concatenate(quo_parts) if quo_parts and n_str else None
+
+    na_bytes = {s.encode() for s in setup.na_strings}
+    names, vecs = [], []
+    ni = si = 0
+    for j, name in enumerate(setup.column_names):
+        t = setup.column_types[j]
+        names.append(name)
+        if t == T_NUM:
+            vecs.append(Vec(num_all[:, ni].astype(np.float32), T_NUM))
+            ni += 1
+            continue
+        col = str_all[si]
+        quoted = quo_all[:, si].astype(bool)
+        si += 1
+        # whitespace-strip only unquoted tokens (quotes protect spaces,
+        # matching the pandas path's skipinitialspace semantics)
+        col = np.where(quoted, col, np.char.strip(col))
+        na_mask = np.isin(col, list(na_bytes)) & ~quoted
+        if t == T_TIME:
+            import pandas as pd
+            ms = pd.to_datetime(
+                pd.Series(col.astype("U")), errors="coerce").astype("int64")
+            vals = np.where(ms == np.iinfo(np.int64).min, np.nan,
+                            ms / 1e6).astype(np.float32)
+            vals[na_mask] = np.nan
+            vecs.append(Vec(vals, T_TIME))
+        elif t == T_STR:
+            vecs.append(Vec(
+                [None if na else
+                 v.decode("utf-8", "replace").replace('""', '"')
+                 for v, na in zip(col, na_mask)], T_STR))
+        else:
+            # sorted global domain via one vectorized unique over bytes
+            domain_b, codes = np.unique(col, return_inverse=True)
+            keep = ~np.isin(domain_b, list(na_bytes))
+            remap = np.full(len(domain_b), -1, np.int32)
+            remap[keep] = np.arange(int(keep.sum()), dtype=np.int32)
+            codes = remap[codes]
+            domain = [d.decode("utf-8", "replace").replace('""', '"')
+                      for d in domain_b[keep]]
+            vecs.append(Vec(codes.astype(np.int32), T_CAT, domain=domain))
+    fr = Frame(names, vecs, key=dest or os.path.basename(paths[0]))
+    log.info("parsed %s (native): %d rows, %d cols", paths, fr.nrows,
+             fr.ncols)
+    return fr
 
 
 def parse_files(paths: Sequence[str], setup: Optional[ParseSetupResult] = None,
                 dest: Optional[str] = None,
-                column_types: Optional[Dict[str, str]] = None) -> Frame:
+                column_types: Optional[Dict[str, str]] = None,
+                use_native: bool = True) -> Frame:
     """Parse one or more delimited files into a single sharded Frame.
 
     Multi-file parse concatenates rows (the reference's multi-file ingest);
     categorical domains are merged sorted across all files, matching the
     reference's distributed domain merge (ParseDataset.java:356-535).
+    The byte tokenizer is the native C++ loop when available
+    (h2o_tpu/native/), else pandas' C engine.
     """
     setup = setup or parse_setup(paths)
     if column_types:
         for name, t in column_types.items():
             setup.column_types[setup.column_names.index(name)] = t
+    if use_native and os.environ.get("H2O_TPU_NATIVE_PARSE", "1") != "0":
+        fr = _parse_native(paths, setup, dest)
+        if fr is not None:
+            return fr
     import pandas as pd
     frames = []
     for p in paths:

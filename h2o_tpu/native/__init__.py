@@ -1,0 +1,158 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference keeps its hot runtime loops native (SURVEY §2.3): the CSV
+tokenizer byte loop, the ForkJoin scheduler, the lock-free DKV map.  Here
+the compute hot path is XLA; the HOST hot paths that remain — the parse
+tokenizer first among them — are C++ in this package, compiled on first
+use with the toolchain g++ (cached as a .so next to the sources), with a
+pure-Python fallback when no compiler is available.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+from ctypes import (CDLL, POINTER, c_char, c_char_p, c_double, c_int,
+                    c_long, c_ubyte)
+from typing import Optional
+
+import numpy as np
+
+from h2o_tpu.core.log import get_logger
+
+log = get_logger("native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "csv_tokenizer.cpp")
+_SO = os.path.join(_DIR, "_csv_tokenizer.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> Optional[str]:
+    """Compile the tokenizer if the .so is missing or stale."""
+    try:
+        if os.path.exists(_SO) and \
+                os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+            return _SO
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
+               _SRC, "-o", _SO + ".tmp"]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(_SO + ".tmp", _SO)
+        log.info("built native csv tokenizer -> %s", _SO)
+        return _SO
+    except Exception as e:  # noqa: BLE001 — fall back to pure Python
+        log.warning("native csv tokenizer unavailable: %r", e)
+        return None
+
+
+def lib() -> Optional[CDLL]:
+    """The loaded native library, building it on first use."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        so = _build()
+        if so is None:
+            return None
+        L = CDLL(so)
+        L.csv_index_lines.restype = c_long
+        L.csv_index_lines.argtypes = [c_char_p, c_long, POINTER(c_long),
+                                      c_long, c_int]
+        L.csv_parse.restype = c_int
+        L.csv_parse.argtypes = [c_char_p, c_long, POINTER(c_long), c_long,
+                                c_long, c_char, c_int, POINTER(c_ubyte),
+                                c_char_p, POINTER(c_int), c_int,
+                                POINTER(c_double), POINTER(c_long),
+                                POINTER(c_int), POINTER(c_ubyte), c_int]
+        _lib = L
+        return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+def _nthreads() -> int:
+    return max(1, min(os.cpu_count() or 1, 16))
+
+
+def tokenize_csv(data: bytes, sep: str, ncols: int,
+                 is_numeric: np.ndarray, na_strings=()):
+    """Tokenize a CSV byte buffer.
+
+    Returns (nrows, num (rows, n_num) float64, str_off (rows, n_str) int64,
+    str_len (rows, n_str) int32, str_quoted (rows, n_str) uint8).  Rows
+    include any header line — the caller slices it off.  ``na_strings``
+    mark numeric-column NA sentinels (NaN in the output).
+    """
+    L = lib()
+    assert L is not None
+    n = len(data)
+    # upper bound on rows = newline count + 1
+    max_rows = data.count(b"\n") + 2
+    offsets = np.empty(max_rows + 1, np.int64)
+    nrows = L.csv_index_lines(
+        data, n, offsets.ctypes.data_as(POINTER(c_long)), max_rows,
+        _nthreads())
+    # drop a trailing empty line (file ends with \n)
+    while nrows > 0 and offsets[nrows - 1] >= n:
+        nrows -= 1
+    is_numeric = np.ascontiguousarray(is_numeric, np.uint8)
+    n_num = int(is_numeric.sum())
+    n_str = ncols - n_num
+    na_list = [s.encode() if isinstance(s, str) else s for s in na_strings]
+    na_blob = b"".join(na_list)
+    na_offs = np.zeros(len(na_list) + 1, np.int32)
+    np.cumsum([len(s) for s in na_list], out=na_offs[1:])
+    num = np.empty((nrows, n_num), np.float64)
+    soff = np.empty((nrows, max(n_str, 1)), np.int64)
+    slen = np.empty((nrows, max(n_str, 1)), np.int32)
+    squo = np.empty((nrows, max(n_str, 1)), np.uint8)
+    rc = L.csv_parse(
+        data, n, offsets.ctypes.data_as(POINTER(c_long)), 0, nrows,
+        c_char(sep.encode()), ncols,
+        is_numeric.ctypes.data_as(POINTER(c_ubyte)),
+        na_blob, na_offs.ctypes.data_as(POINTER(c_int)), len(na_list),
+        num.ctypes.data_as(POINTER(c_double)),
+        soff.ctypes.data_as(POINTER(c_long)),
+        slen.ctypes.data_as(POINTER(c_int)),
+        squo.ctypes.data_as(POINTER(c_ubyte)), _nthreads())
+    if rc != 0:
+        raise RuntimeError(f"csv_parse failed rc={rc}")
+    return (nrows, num, soff[:, :n_str], slen[:, :n_str],
+            squo[:, :n_str])
+
+
+def spans_to_fixed_bytes(data_np: np.ndarray, off: np.ndarray,
+                         length: np.ndarray,
+                         budget_bytes: int = 1 << 26) -> np.ndarray:
+    """Token spans -> (rows,) fixed-width |S bytes array, vectorized in
+    row batches so one long outlier cell cannot inflate the transient
+    (rows, maxlen) gather beyond ``budget_bytes``."""
+    rows = len(off)
+    if rows == 0:
+        return np.empty((0,), "S1")
+    global_max = max(int(length.max()), 1)
+
+    def convert(off_b, len_b):
+        maxlen = max(int(len_b.max()), 1)
+        idx = off_b[:, None] + np.arange(maxlen)[None, :]
+        np.clip(idx, 0, len(data_np) - 1, out=idx)
+        chars = data_np[idx]                    # (batch, maxlen) uint8
+        mask = np.arange(maxlen)[None, :] < len_b[:, None]
+        chars = np.where(mask, chars, 0)
+        # widen to the global max so batches concatenate losslessly
+        return chars.view(f"S{maxlen}")[:, 0].astype(f"S{global_max}")
+
+    batch = max(1, budget_bytes // global_max)
+    if rows <= batch:
+        return convert(off, length)
+    parts = [convert(off[i: i + batch], length[i: i + batch])
+             for i in range(0, rows, batch)]
+    return np.concatenate(parts)

@@ -1,0 +1,135 @@
+"""Native C++ CSV tokenizer tests (parity vs the pandas fallback path)."""
+
+import gzip
+import os
+
+import numpy as np
+import pytest
+
+
+CSV = ('id,age,income,city,joined,note\n'
+       '1,34,55000.5,NYC,2020-01-02,"hello, world"\n'
+       '2,NA,62000,SF,2021-07-15,plain\n'
+       '3,45,,LA,2019-12-31,"quoted ""x"""\n'
+       '4,29,48000,NYC,2022-03-01,\n'
+       '5,51,71000,?,2020-06-30,last\n')
+
+
+@pytest.fixture()
+def csv_path(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text(CSV)
+    return str(p)
+
+
+def test_native_lib_builds(cl):
+    from h2o_tpu import native
+    assert native.available(), "g++ toolchain is baked in; must build"
+
+
+def test_native_tokenizer_raw(cl):
+    from h2o_tpu import native
+    data = b"a,b,c\n1,x,2.5\n,y,NA\n"
+    nrows, num, soff, slen, squo = native.tokenize_csv(
+        data, ",", 3, np.array([1, 0, 1], np.uint8), ["", "NA"])
+    assert nrows == 3
+    # row 1 (after header): a=1, c=2.5 ; row 2: a=NA, c=NA
+    np.testing.assert_allclose(num[1], [1.0, 2.5])
+    assert np.isnan(num[2]).all()
+    data_np = np.frombuffer(data, np.uint8)
+    toks = native.spans_to_fixed_bytes(data_np, soff[:, 0], slen[:, 0])
+    assert toks.tolist() == [b"b", b"x", b"y"]
+    assert not squo.any()
+
+
+def test_native_quoted_newline_in_field(cl, tmp_path):
+    """RFC-4180 newlines inside quoted fields are data, not row breaks."""
+    p = tmp_path / "nl.csv"
+    p.write_text('id,note\n1,"a\nb"\n2,plain\n')
+    from h2o_tpu.core.parse import parse_file, parse_setup
+    setup = parse_setup([str(p)])
+    fr = parse_file(str(p), setup=setup, use_native=True)
+    assert fr.nrows == 2
+    dom = fr.vec("note").domain
+    assert any("a\nb" in d for d in dom), dom
+
+
+def test_native_custom_na_strings_numeric(cl, tmp_path):
+    from h2o_tpu.core.parse import (ParseSetupResult, parse_file)
+    p = tmp_path / "na.csv"
+    p.write_text("x\n1\n-999\n3\n")
+    setup = ParseSetupResult(",", True, ["x"], ["real"],
+                             na_strings=["-999"])
+    fr = parse_file(str(p), setup=setup, use_native=True)
+    vals = fr.vec("x").to_numpy()
+    assert np.isnan(vals[1]) and vals[0] == 1 and vals[2] == 3
+
+
+def test_native_quoted_padding_preserved(cl, tmp_path):
+    """Quoted whitespace survives; unquoted leading space is stripped."""
+    p = tmp_path / "pad.csv"
+    p.write_text('c,n\n" padded ",1\nplain,2\n')
+    from h2o_tpu.core.parse import parse_file, parse_setup
+    setup = parse_setup([str(p)])
+    fr = parse_file(str(p), setup=setup, use_native=True)
+    assert " padded " in fr.vec("c").domain
+
+
+def test_native_parse_matches_pandas(cl, csv_path):
+    from h2o_tpu.core.parse import parse_files, parse_setup
+    setup = parse_setup([csv_path])
+    fr_nat = parse_files([csv_path], setup=setup, use_native=True)
+    fr_pd = parse_files([csv_path], setup=setup, use_native=False)
+    assert fr_nat.nrows == fr_pd.nrows == 5
+    assert fr_nat.names == fr_pd.names
+    for name in fr_nat.names:
+        vn, vp = fr_nat.vec(name), fr_pd.vec(name)
+        assert vn.type == vp.type, name
+        if vn.is_categorical:
+            assert vn.domain == vp.domain, name
+            np.testing.assert_array_equal(vn.to_numpy(), vp.to_numpy())
+        elif vn.data is not None:
+            np.testing.assert_allclose(vn.to_numpy(), vp.to_numpy(),
+                                       rtol=1e-6, equal_nan=True)
+
+
+def test_native_parse_quoted_separator(cl, csv_path):
+    from h2o_tpu.core.parse import parse_file
+    fr = parse_file(csv_path)
+    note = fr.vec("note")
+    dom = note.domain
+    assert any("hello, world" in d for d in dom), dom
+    # NA handling: '?' city is NA, empty note is NA
+    assert fr.vec("city").to_numpy()[4] == -1
+    assert fr.vec("age").to_numpy()[1] != fr.vec("age").to_numpy()[1]  # NaN
+
+
+def test_native_parse_gzip(cl, tmp_path):
+    p = tmp_path / "t.csv.gz"
+    with gzip.open(p, "wt") as f:
+        f.write("x,y\n1,a\n2,b\n")
+    from h2o_tpu.core.parse import parse_file
+    fr = parse_file(str(p))
+    assert fr.nrows == 2
+    np.testing.assert_allclose(fr.vec("x").to_numpy(), [1, 2])
+
+
+def test_native_parse_large_roundtrip(cl, tmp_path, rng):
+    """Bigger file: numeric fidelity + categorical domain correctness."""
+    n = 20000
+    xs = rng.normal(size=n)
+    cats = np.array(["aa", "bb", "cc", "dd"])[rng.integers(0, 4, n)]
+    p = tmp_path / "big.csv"
+    with open(p, "w") as f:
+        f.write("v,c\n")
+        for i in range(n):
+            f.write(f"{xs[i]:.9g},{cats[i]}\n")
+    from h2o_tpu.core.parse import parse_file
+    fr = parse_file(str(p))
+    assert fr.nrows == n
+    np.testing.assert_allclose(fr.vec("v").to_numpy(),
+                               xs.astype(np.float32), rtol=1e-5)
+    dom = fr.vec("c").domain
+    assert dom == ["aa", "bb", "cc", "dd"]
+    codes = fr.vec("c").to_numpy()
+    assert (np.array(dom, dtype=object)[codes] == cats).all()
