@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from h2o_tpu.core.cloud import cloud
-from h2o_tpu.core.frame import Frame, T_CAT, T_NUM, Vec
+from h2o_tpu.core.frame import Frame, T_CAT, T_NUM, T_STR, Vec
 
 # ---------------------------------------------------------------------------
 # parser (Rapids.java grammar: ( fun args... ), [num list], 'str', ids)
@@ -391,7 +391,544 @@ def _eval(node, env: _Env):
     if op == "assign":
         name = _lit(node[1])
         return s.assign(name, _as_frame(_eval(node[2], env)))
+    if op == "sort":
+        return _sort(node, env)
+    if op == "merge":
+        return _merge(node, env)
+    if op in ("GB", "groupby"):
+        return _groupby(node, env)
+    if op == "table":
+        return _table(node, env)
+    if op in _CUMOPS:
+        fr = _as_frame(_eval(node[1], env))
+        fn = _CUMOPS[op]
+        vecs = []
+        for v in fr.vecs:
+            x = v.as_float()
+            mask = jnp.arange(x.shape[0]) < fr.nrows
+            x = jnp.where(mask & ~jnp.isnan(x), x, _CUM_IDENT[op])
+            vecs.append(Vec(fn(x), nrows=fr.nrows))
+        return Frame(list(fr.names), vecs)
+    if op in _STROPS:
+        return _string_op(op, node, env)
+    if op in ("year", "month", "day", "dayOfWeek", "hour", "minute",
+              "second", "week"):
+        return _time_part(op, node, env)
+    if op == "na.omit":
+        fr = _as_frame(_eval(node[1], env))
+        keep = np.ones(fr.nrows, bool)
+        for v in fr.vecs:
+            if v.data is None:
+                continue
+            d = v.to_numpy()
+            keep &= (d >= 0) if v.is_categorical else ~np.isnan(d)
+        return fr.slice_rows(keep)
+    if op == "which":
+        fr = _as_frame(_eval(node[1], env))
+        d = np.asarray(fr.vecs[0].to_numpy())
+        hits = np.flatnonzero((d != 0) & ~np.isnan(d))
+        return Frame(["which"], [Vec(hits.astype(np.float64))])
+    if op in ("is.factor", "anyfactor"):
+        fr = _as_frame(_eval(node[1], env))
+        flags = [v.is_categorical for v in fr.vecs]
+        return float(any(flags) if op == "anyfactor" else flags[0])
+    if op == "is.numeric":
+        fr = _as_frame(_eval(node[1], env))
+        return float(fr.vecs[0].is_numeric)
+    if op == ":=":
+        return _update(node, env)
+    if op == "append":
+        fr = _as_frame(_eval(node[1], env))
+        col = _as_frame(_eval(node[2], env))
+        name = _lit(node[3])
+        out = Frame(list(fr.names), list(fr.vecs))
+        out.add(name, col.vecs[0])
+        return out
+    if op == "h2o.impute":
+        return _impute(node, env)
+    if op == "setLevel" or op == "relevel":
+        pass  # fallthrough to error for now
     raise NotImplementedError(f"rapids op {op!r}")
+
+
+# ---------------------------------------------------------------------------
+# sort / merge / groupby / strings (reference: rapids/Merge.java,
+# RadixOrder.java, ast/prims/mungers/AstGroup.java, ast/prims/string/*)
+# ---------------------------------------------------------------------------
+
+_CUMOPS = {"cumsum": jnp.cumsum, "cumprod": jnp.cumprod,
+           "cummin": jnp.minimum.accumulate, "cummax": jnp.maximum.accumulate}
+_CUM_IDENT = {"cumsum": 0.0, "cumprod": 1.0, "cummin": jnp.inf,
+              "cummax": -jnp.inf}
+
+
+def _sort_keys(fr: Frame, idxs, ascending) -> np.ndarray:
+    keys = []
+    for j, asc in zip(reversed(idxs), reversed(ascending)):
+        k = np.asarray(fr.vecs[j].to_numpy(), np.float64)
+        keys.append(k if asc else -k)
+    return np.lexsort(keys)
+
+
+def _sort(node, env):
+    """(sort fr [col_idxs] [ascending]) — RadixOrder.java analog; the sort
+    itself is numpy lexsort on host key copies, the reorder is a gather."""
+    fr = _as_frame(_eval(node[1], env))
+    idxs = [int(x) for x in node[2][1]]
+    asc = [bool(int(x)) for x in node[3][1]] if len(node) > 3 \
+        else [True] * len(idxs)
+    order = _sort_keys(fr, idxs, asc)
+    return fr.slice_rows(order)
+
+
+def _key_codes(fr: Frame, cols: List[int]):
+    """Rows -> dense group codes over the named key columns."""
+    mats = []
+    for j in cols:
+        v = fr.vecs[j]
+        d = np.asarray(v.to_numpy(), np.float64)
+        mats.append(d)
+    stacked = np.stack(mats, axis=1)
+    uniq, inv = np.unique(stacked, axis=0, return_inverse=True)
+    return uniq, inv.ravel()
+
+
+def _merge(node, env):
+    """(merge left right all_x all_y [by_x] [by_y] method) — the radix
+    join (rapids/Merge.java, BinaryMerge.java).  Key matching is a host
+    sort-merge over dense key codes."""
+    L = _as_frame(_eval(node[1], env))
+    R = _as_frame(_eval(node[2], env))
+    all_x = bool(int(_eval(node[3], env)))
+    all_y = bool(int(_eval(node[4], env)))
+    by_x = [int(x) for x in node[5][1]] if len(node) > 5 and node[5][1] \
+        else None
+    by_y = [int(x) for x in node[6][1]] if len(node) > 6 and node[6][1] \
+        else None
+    if by_x is None:
+        common = [n for n in L.names if n in R.names]
+        by_x = [L.names.index(n) for n in common]
+        by_y = [R.names.index(n) for n in common]
+    # unify key space: categorical keys match by LABEL, numeric by value
+    def key_matrix(fr, cols):
+        out = []
+        for j in cols:
+            v = fr.vecs[j]
+            if v.is_categorical:
+                out.append(np.asarray(
+                    [v.domain[c] if c >= 0 else "\0NA" for c in
+                     v.to_numpy()], object))
+            else:
+                out.append(np.asarray(v.to_numpy(), object))
+        return np.stack(out, axis=1)
+
+    lk = key_matrix(L, by_x)
+    rk = key_matrix(R, by_y)
+    both = np.concatenate([lk, rk])
+    # factorize rows of the combined key matrix
+    flat = np.asarray(["\1".join(map(str, row)) for row in both])
+    uniq, inv = np.unique(flat, return_inverse=True)
+    lcode, rcode = inv[: len(lk)], inv[len(lk):]
+    # build right-side lookup: code -> row indices
+    r_order = np.argsort(rcode, kind="stable")
+    r_sorted = rcode[r_order]
+    starts = np.searchsorted(r_sorted, np.arange(len(uniq)), side="left")
+    ends = np.searchsorted(r_sorted, np.arange(len(uniq)), side="right")
+    li, ri = [], []
+    matched_r = np.zeros(len(rk), bool)
+    for i, c in enumerate(lcode):
+        lo, hi = starts[c], ends[c]
+        if hi > lo:
+            for r in r_order[lo:hi]:
+                li.append(i)
+                ri.append(r)
+                matched_r[r] = True
+        elif all_x:                      # left outer: keep unmatched left
+            li.append(i)
+            ri.append(-1)
+    if all_y:
+        for r in np.flatnonzero(~matched_r):
+            li.append(-1)
+            ri.append(int(r))
+    li = np.asarray(li, np.int64)
+    ri = np.asarray(ri, np.int64)
+
+    names, vecs = [], []
+    r_by = set(by_y)
+    for j, n in enumerate(L.names):
+        v = L.vecs[j]
+        d = v.to_numpy()
+        take = np.where(li >= 0, li, 0)
+        out = d[take]
+        if v.is_categorical:
+            out = np.where(li >= 0, out, -1).astype(np.int32)
+            # right-only rows: pull key values from the right frame
+            if j in by_x and (li < 0).any():
+                jr = by_y[by_x.index(j)]
+                rv = R.vecs[jr]
+                rd = rv.to_numpy()
+                remap = _domain_remap(rv.domain, v.domain)
+                out = np.where(li >= 0, out,
+                               remap[np.clip(rd[np.where(ri >= 0, ri, 0)],
+                                             -1, None)]).astype(np.int32)
+            vecs.append(Vec(out, T_CAT, domain=list(v.domain)))
+        else:
+            out = np.where(li >= 0, out, np.nan)
+            if j in by_x and (li < 0).any():
+                jr = by_y[by_x.index(j)]
+                rd = np.asarray(R.vecs[jr].to_numpy(), np.float64)
+                out = np.where(li >= 0, out,
+                               rd[np.where(ri >= 0, ri, 0)])
+            vecs.append(Vec(out.astype(np.float32), v.type))
+        names.append(n)
+    for j, n in enumerate(R.names):
+        if j in r_by:
+            continue
+        v = R.vecs[j]
+        d = v.to_numpy()
+        take = np.where(ri >= 0, ri, 0)
+        out = d[take]
+        if v.is_categorical:
+            out = np.where(ri >= 0, out, -1).astype(np.int32)
+            vecs.append(Vec(out, T_CAT, domain=list(v.domain)))
+        else:
+            out = np.where(ri >= 0, out, np.nan)
+            vecs.append(Vec(out.astype(np.float32), v.type))
+        names.append(n if n not in names else f"{n}_y")
+    return Frame(names, vecs)
+
+
+def _domain_remap(src_dom, dst_dom):
+    """Map src categorical codes into dst's domain (-1 for unseen);
+    index -1 (NA) maps to -1 via the last slot."""
+    lut = {d: i for i, d in enumerate(dst_dom)}
+    remap = np.full(len(src_dom) + 1, -1, np.int32)
+    for i, d in enumerate(src_dom):
+        remap[i] = lut.get(d, -1)
+    return remap
+
+
+_GB_AGGS = ("min", "max", "mean", "sum", "sd", "var", "nrow", "count",
+            "median", "mode")
+
+
+def _groupby(node, env):
+    """(GB fr [group_idxs] agg col na_method ...) — AstGroup.java."""
+    fr = _as_frame(_eval(node[1], env))
+    gcols = [int(x) for x in node[2][1]]
+    aggs = []
+    i = 3
+    while i < len(node):
+        a = _lit(node[i])
+        if a not in _GB_AGGS:
+            break
+        if i + 1 >= len(node):
+            raise ValueError(f"groupby agg {a!r} is missing its column")
+        col = node[i + 1]
+        col_i = int(col) if isinstance(col, float) else \
+            fr.names.index(_lit(col))
+        na = _lit(node[i + 2]) if i + 2 < len(node) else "all"
+        aggs.append((a, col_i, na))
+        i += 3
+    uniq, inv = _key_codes(fr, gcols)
+    G = len(uniq)
+    names, vecs = [], []
+    for k, j in enumerate(gcols):
+        v = fr.vecs[j]
+        col = uniq[:, k]
+        if v.is_categorical:
+            vecs.append(Vec(col.astype(np.int32), T_CAT,
+                            domain=list(v.domain)))
+        else:
+            vecs.append(Vec(col.astype(np.float32), v.type))
+        names.append(fr.names[j])
+    counts = np.bincount(inv, minlength=G)
+    for a, col_i, na in aggs:
+        v_agg = fr.vecs[col_i]
+        d = np.asarray(v_agg.to_numpy(), np.float64)
+        ok = (d >= 0) if v_agg.is_categorical else ~np.isnan(d)
+        di = np.where(ok, d, 0.0)
+        cnt_ok = np.bincount(inv, weights=ok.astype(np.float64),
+                             minlength=G)
+        if a in ("nrow", "count"):
+            out = counts.astype(np.float64)
+        elif a == "sum":
+            out = np.bincount(inv, weights=di, minlength=G)
+        elif a == "mean":
+            out = np.bincount(inv, weights=di, minlength=G) / \
+                np.maximum(cnt_ok, 1)
+        elif a in ("sd", "var"):
+            m = np.bincount(inv, weights=di, minlength=G) / \
+                np.maximum(cnt_ok, 1)
+            ss = np.bincount(inv, weights=di * di, minlength=G)
+            var = ss / np.maximum(cnt_ok, 1) - m * m
+            var = var * cnt_ok / np.maximum(cnt_ok - 1, 1)
+            out = np.sqrt(np.maximum(var, 0)) if a == "sd" else \
+                np.maximum(var, 0)
+        elif a in ("min", "max"):
+            out = np.full(G, np.inf if a == "min" else -np.inf)
+            ufunc = np.minimum if a == "min" else np.maximum
+            ufunc.at(out, inv[ok], d[ok])
+            out[~np.isfinite(out)] = np.nan
+        elif a in ("median", "mode"):
+            out = np.full(G, np.nan)
+            dd = np.where(ok, d, np.nan)      # NA codes filter out too
+            order = np.argsort(inv, kind="stable")
+            bounds = np.searchsorted(inv[order], np.arange(G + 1))
+            for g in range(G):
+                seg = dd[order[bounds[g]: bounds[g + 1]]]
+                seg = seg[~np.isnan(seg)]
+                if len(seg):
+                    out[g] = np.median(seg) if a == "median" else \
+                        np.bincount(seg.astype(np.int64)).argmax()
+        names.append(f"{a}_{fr.names[col_i]}")
+        vecs.append(Vec(out.astype(np.float32)))
+    return Frame(names, vecs)
+
+
+def _table(node, env):
+    """(table fr) / (table fr1 fr2) — level cross-tabulation."""
+    fr = _as_frame(_eval(node[1], env))
+    v1 = fr.vecs[0]
+    d1 = v1.to_numpy()
+    if fr.ncols == 1 and len(node) <= 2:
+        vals, cnts = np.unique(d1[d1 >= 0] if v1.is_categorical else
+                               d1[~np.isnan(d1)], return_counts=True)
+        if v1.is_categorical:
+            c1 = Vec(vals.astype(np.int32), T_CAT, domain=list(v1.domain))
+        else:
+            c1 = Vec(vals.astype(np.float32))
+        return Frame([fr.names[0], "Count"],
+                     [c1, Vec(cnts.astype(np.float32))])
+    v2 = fr.vecs[1] if fr.ncols > 1 else \
+        _as_frame(_eval(node[2], env)).vecs[0]
+    d2 = v2.to_numpy()
+    ok = ((d1 >= 0) if v1.is_categorical else ~np.isnan(d1)) & \
+        ((d2 >= 0) if v2.is_categorical else ~np.isnan(d2))
+    pairs = np.stack([d1[ok], d2[ok]], axis=1)
+    uniq, cnts = np.unique(pairs, axis=0, return_counts=True)
+    c1 = Vec(uniq[:, 0].astype(np.int32), T_CAT,
+             domain=list(v1.domain)) if v1.is_categorical else \
+        Vec(uniq[:, 0].astype(np.float32))
+    c2 = Vec(uniq[:, 1].astype(np.int32), T_CAT,
+             domain=list(v2.domain)) if v2.is_categorical else \
+        Vec(uniq[:, 1].astype(np.float32))
+    return Frame([fr.names[0], "col2", "Counts"],
+                 [c1, c2, Vec(cnts.astype(np.float32))])
+
+
+_STROPS = ("toupper", "tolower", "trim", "nchar", "length", "substring",
+           "replacefirst", "replaceall", "sub", "gsub", "strsplit",
+           "countmatches", "lstrip", "rstrip")
+
+
+def _map_strings(v: Vec, fn):
+    """Apply a str->str fn: T_STR maps values, T_CAT maps the DOMAIN
+    (the reference's in-place domain rewrite, ast/prims/string)."""
+    if v.type == T_STR:
+        return Vec([None if x is None else fn(str(x))
+                    for x in v.host_data], T_STR)
+    if v.is_categorical:
+        new_dom = [fn(d) for d in v.domain]
+        # domains must stay unique: re-map codes if the fn collides labels
+        uniq = sorted(set(new_dom))
+        lut = {d: i for i, d in enumerate(uniq)}
+        remap = np.asarray([lut[d] for d in new_dom], np.int32)
+        codes = v.to_numpy()
+        new_codes = np.where(codes >= 0, remap[np.clip(codes, 0, None)],
+                             -1)
+        return Vec(new_codes.astype(np.int32), T_CAT, domain=uniq)
+    raise TypeError("string op on a numeric column")
+
+
+def _string_op(op, node, env):
+    fr = _as_frame(_eval(node[1], env))
+    args = [_lit(x) if isinstance(x, tuple) else x for x in node[2:]]
+
+    if op in ("nchar", "length"):
+        def count_chars(v):
+            if v.type == T_STR:
+                return Vec(np.asarray(
+                    [np.nan if x is None else len(str(x))
+                     for x in v.host_data], np.float32))
+            lens = np.asarray([len(d) for d in v.domain], np.float32)
+            codes = v.to_numpy()
+            return Vec(np.where(codes >= 0,
+                                lens[np.clip(codes, 0, None)],
+                                np.nan).astype(np.float32))
+        return Frame(list(fr.names), [count_chars(v) for v in fr.vecs])
+
+    if op == "substring":
+        lo = int(args[0])
+        hi = int(args[1]) if len(args) > 1 and args[1] is not None else None
+        fn = lambda s: s[lo:hi]  # noqa: E731
+    elif op in ("replacefirst", "sub"):
+        pat, rep = str(args[0]), str(args[1])
+        fn = lambda s: re.sub(pat, rep, s, count=1)  # noqa: E731
+    elif op in ("replaceall", "gsub"):
+        pat, rep = str(args[0]), str(args[1])
+        fn = lambda s: re.sub(pat, rep, s)  # noqa: E731
+    elif op == "trim":
+        fn = str.strip
+    elif op == "lstrip":
+        chars = str(args[0]) if args else None
+        fn = lambda s: s.lstrip(chars)  # noqa: E731
+    elif op == "rstrip":
+        chars = str(args[0]) if args else None
+        fn = lambda s: s.rstrip(chars)  # noqa: E731
+    elif op == "toupper":
+        fn = str.upper
+    elif op == "tolower":
+        fn = str.lower
+    elif op == "countmatches":
+        pat = str(args[0])
+
+        def count_matches(v):
+            def cm(s):
+                return s.count(pat)
+            if v.type == T_STR:
+                return Vec(np.asarray(
+                    [np.nan if x is None else cm(str(x))
+                     for x in v.host_data], np.float32))
+            per_level = np.asarray([cm(d) for d in v.domain], np.float32)
+            codes = v.to_numpy()
+            return Vec(np.where(codes >= 0,
+                                per_level[np.clip(codes, 0, None)],
+                                np.nan).astype(np.float32))
+        return Frame(list(fr.names), [count_matches(v) for v in fr.vecs])
+    elif op == "strsplit":
+        pat = str(args[0])
+        v = fr.vecs[0]
+        vals = [None if x is None else re.split(pat, str(x))
+                for x in (v.host_data if v.type == T_STR else
+                          [v.domain[c] if c >= 0 else None
+                           for c in v.to_numpy()])]
+        width = max((len(x) for x in vals if x), default=1)
+        cols = []
+        for j in range(width):
+            col = [x[j] if x and j < len(x) else None for x in vals]
+            dom = sorted({c for c in col if c is not None})
+            lut = {d: i for i, d in enumerate(dom)}
+            cols.append(Vec(np.asarray(
+                [lut.get(c, -1) if c is not None else -1 for c in col],
+                np.int32), T_CAT, domain=dom))
+        return Frame([f"C{j+1}" for j in range(width)], cols)
+    else:
+        raise NotImplementedError(op)
+    return Frame(list(fr.names), [_map_strings(v, fn) for v in fr.vecs])
+
+
+def _time_part(op, node, env):
+    """Time extractors over T_TIME ms-since-epoch columns."""
+    fr = _as_frame(_eval(node[1], env))
+    out = []
+    for v in fr.vecs:
+        ms = np.asarray(v.to_numpy(), np.float64)
+        ok = ~np.isnan(ms)
+        dt = np.full(len(ms), np.datetime64("NaT"), "datetime64[ms]")
+        dt[ok] = np.asarray(ms[ok], "int64").view("datetime64[ms]")
+        Y = dt.astype("datetime64[Y]")
+        M = dt.astype("datetime64[M]")
+        D = dt.astype("datetime64[D]")
+        if op == "year":
+            vals = Y.astype(int) + 1970
+        elif op == "month":
+            vals = (M - Y).astype(int) + 1
+        elif op == "day":
+            vals = (D - M).astype(int) + 1
+        elif op == "dayOfWeek":
+            vals = (D.astype(int) + 3) % 7          # 1970-01-01 = Thursday
+        elif op == "hour":
+            vals = (dt - D).astype("timedelta64[h]").astype(int)
+        elif op == "minute":
+            vals = ((dt - D).astype("timedelta64[m]").astype(int)) % 60
+        elif op == "second":
+            vals = ((dt - D).astype("timedelta64[s]").astype(int)) % 60
+        else:                                       # week of year
+            vals = (D - Y).astype(int) // 7 + 1
+        out.append(Vec(np.where(ok, vals, np.nan).astype(np.float32)))
+    return Frame(list(fr.names), out)
+
+
+def _update(node, env):
+    """(:= fr rhs col_idxs row_sel) — in-place column/cell update."""
+    fr = _as_frame(_eval(node[1], env))
+    rhs = _eval(node[2], env)
+    cols = _col_indices(fr, node[3] if isinstance(node[3], tuple)
+                        else _eval(node[3], env))
+    row_sel = node[4] if len(node) > 4 else None
+    out = Frame(list(fr.names), list(fr.vecs))
+    for k, j in enumerate(cols):
+        old_vec = out.vecs[j]
+        if isinstance(rhs, Frame):
+            newv = rhs.vecs[k if rhs.ncols > 1 else 0]
+        elif old_vec.is_categorical:
+            newv = Vec(np.full(fr.nrows, int(rhs), np.int32), T_CAT,
+                       domain=list(old_vec.domain))
+        else:
+            newv = Vec(np.full(fr.nrows, float(rhs), np.float32))
+        if row_sel is not None and not (
+                isinstance(row_sel, tuple) and row_sel[1] == "all"):
+            sel = _eval(row_sel, env) if isinstance(row_sel, list) \
+                else row_sel
+            old = old_vec.to_numpy().astype(np.float64)
+            if isinstance(sel, Frame):
+                mask = np.asarray(sel.vecs[0].data)[: fr.nrows] > 0
+            else:
+                idx = [int(x) for x in sel[1]] if isinstance(sel, tuple) \
+                    else [int(sel)]
+                mask = np.zeros(fr.nrows, bool)
+                mask[idx] = True
+            nv = np.asarray(newv.to_numpy(), np.float64)
+            merged = old.copy()
+            n_sel = int(mask.sum())
+            if len(nv) == fr.nrows:
+                merged[mask] = nv[mask]
+            elif len(nv) == n_sel:
+                # rhs sized to the selection: scatter in selection order
+                merged[np.flatnonzero(mask)] = nv
+            else:
+                merged[mask] = nv[0] if len(nv) else np.nan
+            if old_vec.is_categorical:
+                newv = Vec(merged.astype(np.int32), T_CAT,
+                           domain=list(old_vec.domain))
+            else:
+                newv = Vec(merged.astype(np.float32), old_vec.type)
+        out.vecs[j] = newv
+    return out
+
+
+def _impute(node, env):
+    """(h2o.impute fr col method combine_method [gb_cols] ...) — mean/
+    median/mode imputation (ast/prims/advmath/AstImpute)."""
+    fr = _as_frame(_eval(node[1], env))
+    col = int(_eval(node[2], env))
+    method = _lit(node[3]) if len(node) > 3 else "mean"
+    v = fr.vecs[col]
+    d = np.asarray(v.to_numpy(), np.float64)
+    if v.is_categorical:
+        vals = d[d >= 0]
+        fill = float(np.bincount(vals.astype(np.int64)).argmax()) \
+            if len(vals) else -1
+        filled = np.where(d < 0, fill, d)
+        newv = Vec(filled.astype(np.int32), T_CAT, domain=list(v.domain))
+    else:
+        vals = d[~np.isnan(d)]
+        if method == "median":
+            fill = float(np.median(vals)) if len(vals) else np.nan
+        elif method == "mode":
+            if len(vals):
+                uq, cn = np.unique(vals, return_counts=True)
+                fill = float(uq[cn.argmax()])
+            else:
+                fill = np.nan
+        else:
+            fill = float(vals.mean()) if len(vals) else np.nan
+        filled = np.where(np.isnan(d), fill, d)
+        newv = Vec(filled.astype(np.float32), v.type)
+    out = Frame(list(fr.names), list(fr.vecs))
+    out.vecs[col] = newv
+    return out
 
 
 def rapids_exec(expr: str, session: Optional[Session] = None):

@@ -1,0 +1,171 @@
+"""Rapids mungers: sort / merge / groupby / strings / time / update."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def sess(cl):
+    from h2o_tpu.rapids.interp import Session
+    return Session("test_munge")
+
+
+def _put(sess, name, frame):
+    from h2o_tpu.core.cloud import cloud
+    frame.key = name
+    cloud().dkv.put(name, frame)
+    return frame
+
+
+def _exec(sess, expr):
+    from h2o_tpu.rapids.interp import rapids_exec
+    return rapids_exec(expr, sess)
+
+
+def test_rapids_sort(cl, sess):
+    from h2o_tpu.core.frame import Frame, Vec
+    _put(sess, "fs", Frame(["a", "b"],
+                           [Vec(np.array([3., 1., 2.], np.float32)),
+                            Vec(np.array([10., 20., 30.], np.float32))]))
+    out = _exec(sess, "(sort fs [0] [1])")
+    np.testing.assert_allclose(out.vec("a").to_numpy(), [1, 2, 3])
+    np.testing.assert_allclose(out.vec("b").to_numpy(), [20, 30, 10])
+    out = _exec(sess, "(sort fs [0] [0])")
+    np.testing.assert_allclose(out.vec("a").to_numpy(), [3, 2, 1])
+
+
+def test_rapids_merge_inner_and_left(cl, sess):
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT
+    _put(sess, "L", Frame(
+        ["k", "x"],
+        [Vec(np.array([0, 1, 2], np.int32), T_CAT, domain=["a", "b", "c"]),
+         Vec(np.array([1., 2., 3.], np.float32))]))
+    _put(sess, "R", Frame(
+        ["k", "y"],
+        [Vec(np.array([0, 1], np.int32), T_CAT, domain=["b", "c"]),
+         Vec(np.array([20., 30.], np.float32))]))
+    inner = _exec(sess, "(merge L R 0 0 [0] [0] 'auto')")
+    assert inner.nrows == 2
+    got = {inner.vec("k").domain[int(c)]: (x, y) for c, x, y in zip(
+        inner.vec("k").to_numpy(), inner.vec("x").to_numpy(),
+        inner.vec("y").to_numpy())}
+    assert got == {"b": (2.0, 20.0), "c": (3.0, 30.0)}
+    left = _exec(sess, "(merge L R 1 0 [0] [0] 'auto')")
+    assert left.nrows == 3
+    ya = left.vec("y").to_numpy()
+    assert np.isnan(ya).sum() == 1              # unmatched 'a' row
+
+
+def test_rapids_groupby(cl, sess):
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT
+    g = np.array([0, 0, 1, 1, 1], np.int32)
+    x = np.array([1., 2., 3., 4., 5.], np.float32)
+    _put(sess, "G", Frame(
+        ["g", "x"], [Vec(g, T_CAT, domain=["u", "v"]), Vec(x)]))
+    out = _exec(sess, "(GB G [0] mean 1 'all' sum 1 'all' nrow 1 'all')")
+    assert out.nrows == 2
+    np.testing.assert_allclose(out.vec("mean_x").to_numpy(), [1.5, 4.0])
+    np.testing.assert_allclose(out.vec("sum_x").to_numpy(), [3.0, 12.0])
+    np.testing.assert_allclose(out.vec("nrow_x").to_numpy(), [2, 3])
+
+
+def test_rapids_string_ops(cl, sess):
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT
+    _put(sess, "S", Frame(["s"], [Vec(
+        np.array([0, 1, 2, -1], np.int32), T_CAT,
+        domain=["  hey ", "world", "hey"])]))
+    up = _exec(sess, "(toupper S)")
+    assert "WORLD" in up.vec("s").domain
+    tr = _exec(sess, "(trim S)")
+    # trimming collides '  hey ' with 'hey' -> domain merges
+    assert tr.vec("s").domain == ["hey", "world"]
+    codes = tr.vec("s").to_numpy()
+    assert codes[0] == codes[2] == 0 and codes[3] == -1
+    nc = _exec(sess, "(nchar S)")
+    np.testing.assert_allclose(nc.vec("s").to_numpy()[:3], [6, 5, 3])
+    sub = _exec(sess, "(gsub S 'e' '3')")
+    assert any("h3y" in d for d in sub.vec("s").domain)
+
+
+def test_rapids_cumsum_and_table(cl, sess):
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT
+    _put(sess, "C", Frame(["x"], [Vec(np.array([1., 2., 3.],
+                                               np.float32))]))
+    out = _exec(sess, "(cumsum C)")
+    np.testing.assert_allclose(out.vec("x").to_numpy(), [1, 3, 6])
+    _put(sess, "T", Frame(["c"], [Vec(
+        np.array([0, 1, 0, 0], np.int32), T_CAT, domain=["p", "q"])]))
+    tab = _exec(sess, "(table T)")
+    np.testing.assert_allclose(tab.vec("Count").to_numpy(), [3, 1])
+
+
+def test_rapids_time_parts(cl, sess):
+    from h2o_tpu.core.frame import Frame, Vec, T_TIME
+    # 2021-03-04 05:06:07 UTC in ms
+    ms = np.array([np.datetime64("2021-03-04T05:06:07").astype(
+        "datetime64[ms]").astype("int64")], np.float64)
+    _put(sess, "D", Frame(["t"], [Vec(ms.astype(np.float32), T_TIME)]))
+    assert _exec(sess, "(year D)").vec("t").to_numpy()[0] == 2021
+    assert _exec(sess, "(month D)").vec("t").to_numpy()[0] == 3
+    assert _exec(sess, "(day D)").vec("t").to_numpy()[0] == 4
+    assert _exec(sess, "(hour D)").vec("t").to_numpy()[0] == 5
+
+
+def test_rapids_update_and_impute(cl, sess):
+    from h2o_tpu.core.frame import Frame, Vec
+    _put(sess, "U", Frame(
+        ["x", "y"], [Vec(np.array([1., np.nan, 3.], np.float32)),
+                     Vec(np.array([9., 9., 9.], np.float32))]))
+    imp = _exec(sess, "(h2o.impute U 0 'mean')")
+    np.testing.assert_allclose(imp.vec("x").to_numpy(), [1, 2, 3])
+    upd = _exec(sess, "(:= U 7 [1] 'all')")
+    np.testing.assert_allclose(upd.vec("y").to_numpy(), [7, 7, 7])
+
+
+def test_rapids_na_omit_which(cl, sess):
+    from h2o_tpu.core.frame import Frame, Vec
+    _put(sess, "N", Frame(["x"], [Vec(np.array([1., np.nan, 0., 2.],
+                                               np.float32))]))
+    out = _exec(sess, "(na.omit N)")
+    assert out.nrows == 3
+    w = _exec(sess, "(which N)")
+    np.testing.assert_allclose(w.vec("which").to_numpy(), [0, 3])
+
+
+def test_rapids_cumprod_na_identity(cl, sess):
+    from h2o_tpu.core.frame import Frame, Vec
+    _put(sess, "CP", Frame(["x"], [Vec(np.array([2., np.nan, 3.],
+                                                np.float32))]))
+    out = _exec(sess, "(cumprod CP)")
+    np.testing.assert_allclose(out.vec("x").to_numpy(), [2, 2, 6])
+
+
+def test_rapids_groupby_cat_na_and_mode(cl, sess):
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT
+    g = np.array([0, 0, 1], np.int32)
+    c = np.array([1, -1, 0], np.int32)        # one NA code
+    _put(sess, "GN", Frame(
+        ["g", "c"], [Vec(g, T_CAT, domain=["u", "v"]),
+                     Vec(c, T_CAT, domain=["p", "q"])]))
+    out = _exec(sess, "(GB GN [0] mode 1 'all')")
+    np.testing.assert_allclose(out.vec("mode_c").to_numpy(), [1, 0])
+
+
+def test_rapids_update_scatter_selection(cl, sess):
+    from h2o_tpu.core.frame import Frame, Vec
+    _put(sess, "SC", Frame(["x"], [Vec(np.array([0., 0., 0., 0.],
+                                               np.float32))]))
+    _put(sess, "VALS", Frame(["v"], [Vec(np.array([10., 20.],
+                                                  np.float32))]))
+    out = _exec(sess, "(:= SC VALS [0] [1 3])")
+    np.testing.assert_allclose(out.vec("x").to_numpy(), [0, 10, 0, 20])
+
+
+def test_rapids_update_keeps_categorical(cl, sess):
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT
+    _put(sess, "KC", Frame(["c"], [Vec(np.array([0, 1], np.int32), T_CAT,
+                                       domain=["a", "b"])]))
+    out = _exec(sess, "(:= KC 0 [0] 'all')")
+    v = out.vec("c")
+    assert v.is_categorical and v.domain == ["a", "b"]
+    np.testing.assert_array_equal(v.to_numpy(), [0, 0])
