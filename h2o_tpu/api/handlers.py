@@ -483,3 +483,92 @@ def cancel_job(params, job_id):
         raise H2OError(404, f"job {job_id} not found")
     j.cancel()
     return {}
+
+
+# -- diagnostics + recovery routes (SURVEY §5.1, §5.3) ----------------------
+
+@route("GET", r"/3/Timeline")
+def timeline(params):
+    from h2o_tpu.core.diag import TimeLine
+    return {"events": TimeLine.snapshot()}
+
+
+@route("GET", r"/3/WaterMeterCpuTicks/(?P<node>[^/]+)")
+@route("GET", r"/3/WaterMeterCpuTicks")
+def water_meter_cpu(params, node=None):
+    from h2o_tpu.core.diag import water_meter_cpu_ticks
+    return water_meter_cpu_ticks()
+
+
+@route("GET", r"/3/WaterMeterIo")
+def water_meter_io_route(params):
+    from h2o_tpu.core.diag import water_meter_io
+    return water_meter_io()
+
+
+@route("GET", r"/3/JStack")
+def jstack_route(params):
+    from h2o_tpu.core.diag import jstack
+    return {"traces": jstack()}
+
+
+@route("POST", r"/3/Profiler")
+@route("GET", r"/3/Profiler")
+def profiler_route(params):
+    from h2o_tpu.core.diag import Profiler
+    secs = float(params.get("duration_secs", 0.5))
+    p = Profiler().start()
+    time.sleep(min(secs, 10.0))
+    counts = p.stop()
+    top = [{"frame": k, "hits": v}
+           for k, v in list(counts.items())[:100]]
+    return {"profile": top}
+
+
+@route("GET", r"/3/DeviceMemory")
+def device_memory_route(params):
+    from h2o_tpu.core.diag import device_memory
+    return {"devices": device_memory()}
+
+
+@route("POST", r"/3/Recovery/resume")
+def recovery_resume(params):
+    """Asynchronous resume: returns a job key immediately, the recovery
+    trains in the background (the reference returns the resumed job)."""
+    from h2o_tpu.core.job import Job
+    from h2o_tpu.core.recovery import auto_recover, pending_recoveries
+    from h2o_tpu.core.store import Key
+    d = params.get("recovery_dir")
+    if not d:
+        raise H2OError(400, "recovery_dir required")
+    pending = pending_recoveries(d)
+    job = Job(dest=Key.make("recovery"),
+              description=f"auto-recover {len(pending)} job(s) from {d}")
+    cloud().jobs.start(job, lambda j: auto_recover(d))
+    return {"job": {"key": {"name": str(job.key)}},
+            "pending": len(pending)}
+
+
+@route("POST", r"/3/Frames/(?P<frame_id>[^/]+)/export")
+def frame_export(params, frame_id):
+    from h2o_tpu.core.persist import save_frame
+    fr = cloud().dkv.get(frame_id)
+    if fr is None:
+        raise H2OError(404, f"frame {frame_id} not found")
+    path = params.get("path")
+    if not path:
+        raise H2OError(400, "path required")
+    save_frame(fr, path)
+    return {"path": path}
+
+
+@route("POST", r"/3/Frames/load")
+def frame_load(params):
+    from h2o_tpu.core.persist import load_frame
+    path = params.get("dir")
+    if not path:
+        raise H2OError(400, "dir required")
+    fr = load_frame(path)
+    cloud().dkv.put(fr.key, fr)
+    return {"frame_id": str(fr.key), "rows": fr.nrows,
+            "columns": fr.ncols}

@@ -1,0 +1,149 @@
+"""Diagnostics — Timeline event ring, WaterMeter counters, profiling.
+
+Reference (SURVEY §5.1):
+- water/TimeLine.java:12-80 — a lock-free per-node ring of the last 2,048
+  network events (send/recv, timestamp, task id), snapshotted cluster-wide
+  and served at GET /3/Timeline;
+- water/util/WaterMeterCpuTicks / WaterMeterIo — /proc-backed CPU and IO
+  counters per node;
+- ProfileCollectorTask / JStackCollectorTask — stack-sample profiler and
+  thread dumps at /3/Profiler and /3/JStack.
+
+TPU-native: the "network events" of this runtime are DKV traffic, job
+transitions and device dispatches — recorded into the same fixed-size ring
+(a deque under the GIL is the managed-runtime analog of the Unsafe CAS
+ring); WaterMeter reads the same /proc files; the profiler snapshots
+Python thread stacks (sys._current_frames — the JStack analog) and defers
+device-side tracing to jax.profiler.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+MAX_EVENTS = 2048
+
+
+class TimeLine:
+    """Fixed-size event ring (water/TimeLine.java)."""
+
+    _events: deque = deque(maxlen=MAX_EVENTS)
+    _lock = threading.Lock()
+    _enabled = True
+
+    @classmethod
+    def record(cls, kind: str, what: str, **info) -> None:
+        if not cls._enabled:
+            return
+        ev = {"ns": time.time_ns(), "kind": kind, "what": what,
+              "thread": threading.get_ident(), **info}
+        with cls._lock:
+            cls._events.append(ev)
+
+    @classmethod
+    def snapshot(cls) -> List[Dict[str, Any]]:
+        """Consistent copy of the ring (TimelineSnapshot analog)."""
+        with cls._lock:
+            return list(cls._events)
+
+    @classmethod
+    def clear(cls) -> None:
+        with cls._lock:
+            cls._events.clear()
+
+
+def water_meter_cpu_ticks() -> Dict[str, Any]:
+    """Per-CPU (user, sys, other, idle) ticks (WaterMeterCpuTicks)."""
+    cpus = []
+    try:
+        with open("/proc/stat") as f:
+            for ln in f:
+                if ln.startswith("cpu") and ln[3:4].isdigit():
+                    parts = ln.split()
+                    user, nice, system, idle = (int(x)
+                                                for x in parts[1:5])
+                    other = sum(int(x) for x in parts[5:8])
+                    cpus.append([user + nice, system, other, idle])
+    except OSError:
+        pass
+    return {"cpu_ticks": cpus}
+
+
+def water_meter_io() -> Dict[str, Any]:
+    """Process IO byte counters (WaterMeterIo)."""
+    out = {"read_bytes": 0, "write_bytes": 0}
+    try:
+        with open("/proc/self/io") as f:
+            for ln in f:
+                k, _, v = ln.partition(":")
+                if k in ("read_bytes", "write_bytes"):
+                    out[k] = int(v)
+    except OSError:
+        pass
+    return out
+
+
+def jstack() -> List[Dict[str, Any]]:
+    """All-thread stack dump (JStackCollectorTask analog)."""
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in frames.items():
+        out.append({"thread_id": tid,
+                    "name": names.get(tid, f"thread-{tid}"),
+                    "stack": traceback.format_stack(frame)})
+    return out
+
+
+class Profiler:
+    """Stack-sampling profiler (ProfileCollectorTask analog): sample all
+    thread stacks at an interval, report frame hit counts."""
+
+    def __init__(self, interval_s: float = 0.01):
+        self.interval = interval_s
+        self.counts: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Profiler":
+        def run():
+            while not self._stop.wait(self.interval):
+                for frame in sys._current_frames().values():
+                    f = frame
+                    while f is not None:
+                        key = (f"{f.f_code.co_filename}:"
+                               f"{f.f_code.co_name}:{f.f_lineno}")
+                        self.counts[key] = self.counts.get(key, 0) + 1
+                        f = f.f_back
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="h2o-tpu-profiler")
+        self._thread.start()
+        return self
+
+    def stop(self) -> Dict[str, int]:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=1.0)
+        return dict(sorted(self.counts.items(), key=lambda kv: -kv[1]))
+
+
+def device_memory() -> List[Dict[str, Any]]:
+    """Per-device memory stats (the Cloud-status heap columns analog)."""
+    import jax
+    out = []
+    for d in jax.devices():
+        stats = {}
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:  # noqa: BLE001 — not all backends expose stats
+            pass
+        out.append({"device": str(d), "platform": d.platform,
+                    "bytes_in_use": stats.get("bytes_in_use"),
+                    "bytes_limit": stats.get("bytes_limit")})
+    return out

@@ -122,6 +122,7 @@ class Vec:
         self.domain = domain
         self._rollups: Optional[RollupStats] = None
         self._hist: Optional[np.ndarray] = None
+        self._host_f64: Optional[np.ndarray] = None
         if vtype in (T_STR, T_UUID):
             self.host_data: List = list(data)
             self.nrows = len(self.host_data)
@@ -140,6 +141,12 @@ class Vec:
                 # NA code -1 → represent as float NaN? no: keep int + sentinel
                 self.data = cloud().device_put_rows(arr)
             else:
+                if vtype == T_TIME:
+                    # ms-since-epoch exceeds f32 precision (~131 s ulp at
+                    # current epochs); keep an exact host copy for
+                    # time-part extraction while the device payload stays
+                    # f32 for arithmetic/binning
+                    self._host_f64 = arr.astype(np.float64, copy=True)
                 self.data = cloud().device_put_rows(
                     arr.astype(np.float32, copy=False))
 
@@ -168,9 +175,12 @@ class Vec:
         return self.data
 
     def to_numpy(self) -> np.ndarray:
-        """Unpadded host copy (NA = NaN for numeric, -1 for categorical)."""
+        """Unpadded host copy (NA = NaN for numeric, -1 for categorical).
+        T_TIME returns the exact float64 epoch-ms copy when available."""
         if self.host_data is not None:
             return np.asarray(self.host_data, dtype=object)
+        if self._host_f64 is not None:
+            return self._host_f64[: self.nrows]
         return np.asarray(self.data)[: self.nrows]
 
     # -- rollups -----------------------------------------------------------

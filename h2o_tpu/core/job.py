@@ -109,6 +109,9 @@ class JobRegistry:
             self._jobs[job.key] = job
 
         def run():
+            from h2o_tpu.core.diag import TimeLine
+            TimeLine.record("job", "start", key=str(job.key),
+                            description=job.description)
             job.status = RUNNING
             job.start_time = time.time()
             try:
@@ -124,6 +127,8 @@ class JobRegistry:
                           traceback.format_exc())
             finally:
                 job.end_time = time.time()
+                TimeLine.record("job", "end", key=str(job.key),
+                                status=job.status)
                 job._done.set()
 
         self._pool.submit(run)

@@ -199,7 +199,7 @@ def _parse_native(paths: Sequence[str], setup: ParseSetupResult,
             ms = pd.to_datetime(
                 pd.Series(col.astype("U")), errors="coerce").astype("int64")
             vals = np.where(ms == np.iinfo(np.int64).min, np.nan,
-                            ms / 1e6).astype(np.float32)
+                            ms / 1e6).astype(np.float64)
             vals[na_mask] = np.nan
             vecs.append(Vec(vals, T_TIME))
         elif t == T_STR:
@@ -269,7 +269,7 @@ def parse_files(paths: Sequence[str], setup: Optional[ParseSetupResult] = None,
         elif t == T_TIME:
             ms = pd.to_datetime(col, errors="coerce").astype("int64")
             vals = np.where(ms == np.iinfo(np.int64).min, np.nan,
-                            ms / 1e6).astype(np.float32)
+                            ms / 1e6).astype(np.float64)
             vecs.append(Vec(vals, T_TIME))
         elif t == T_STR:
             vecs.append(Vec([None if v is None else str(v) for v in col],

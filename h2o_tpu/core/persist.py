@@ -1,0 +1,113 @@
+"""Persist — frame/model binary snapshots + URI-scheme byte stores.
+
+Reference:
+- water/fvec/persist/FramePersist.java — distributed per-chunk frame
+  snapshot files + a metadata record, reloadable into the same key;
+- water/persist/PersistManager.java:33,45,813 — URI-scheme-dispatched
+  byte stores (file, NFS, HDFS, S3, GCS, HTTP).
+
+TPU-native: a frame snapshot is one ``columns.npz`` (every device shard is
+already host-addressable, so columns dump as whole arrays — the analog of
+writing all chunks) + ``frame.json`` metadata; byte-store dispatch keeps
+the scheme registry shape with local-file backends implemented and cloud
+schemes pluggable (register_scheme), matching the reference's plug-in
+persist modules.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from h2o_tpu.core.frame import Frame, T_CAT, T_STR, T_UUID, Vec
+from h2o_tpu.core.log import get_logger
+
+log = get_logger("persist")
+
+# -- byte stores (PersistManager scheme dispatch) ---------------------------
+
+_SCHEMES: Dict[str, Dict[str, Callable]] = {}
+
+
+def register_scheme(scheme: str, reader: Callable[[str], bytes],
+                    writer: Callable[[str, bytes], None]) -> None:
+    """Plug in a byte store (the h2o-persist-{s3,gcs,hdfs} analog)."""
+    _SCHEMES[scheme] = {"read": reader, "write": writer}
+
+
+def _split(uri: str):
+    if "://" in uri:
+        scheme, rest = uri.split("://", 1)
+        return scheme, rest
+    return "file", uri
+
+
+def read_bytes(uri: str) -> bytes:
+    scheme, rest = _split(uri)
+    if scheme in _SCHEMES:
+        return _SCHEMES[scheme]["read"](uri)
+    if scheme in ("file", "nfs"):
+        with open(rest, "rb") as f:
+            return f.read()
+    raise NotImplementedError(
+        f"no persist backend for scheme '{scheme}' — register one with "
+        "h2o_tpu.core.persist.register_scheme")
+
+
+def write_bytes(uri: str, data: bytes) -> None:
+    scheme, rest = _split(uri)
+    if scheme in _SCHEMES:
+        _SCHEMES[scheme]["write"](uri, data)
+        return
+    if scheme in ("file", "nfs"):
+        os.makedirs(os.path.dirname(rest) or ".", exist_ok=True)
+        with open(rest, "wb") as f:
+            f.write(data)
+        return
+    raise NotImplementedError(
+        f"no persist backend for scheme '{scheme}' — register one with "
+        "h2o_tpu.core.persist.register_scheme")
+
+
+# -- frame snapshots (FramePersist) -----------------------------------------
+
+def save_frame(frame: Frame, dir_uri: str) -> str:
+    """Snapshot a frame to ``<dir>/frame.json`` + ``<dir>/columns.npz``."""
+    meta = {"key": str(frame.key), "names": frame.names,
+            "types": frame.types(), "nrows": frame.nrows,
+            "domains": [v.domain for v in frame.vecs]}
+    arrays: Dict[str, np.ndarray] = {}
+    strings: Dict[str, list] = {}
+    for n, v in zip(frame.names, frame.vecs):
+        if v.host_data is not None:
+            strings[n] = [None if x is None else str(x)
+                          for x in v.host_data]
+        else:
+            arrays[f"c_{n}"] = v.to_numpy()
+    meta["strings"] = strings
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    write_bytes(f"{dir_uri}/columns.npz", buf.getvalue())
+    write_bytes(f"{dir_uri}/frame.json",
+                json.dumps(meta).encode())
+    log.info("frame %s saved to %s", frame.key, dir_uri)
+    return dir_uri
+
+
+def load_frame(dir_uri: str, key: Optional[str] = None) -> Frame:
+    meta = json.loads(read_bytes(f"{dir_uri}/frame.json"))
+    npz = np.load(io.BytesIO(read_bytes(f"{dir_uri}/columns.npz")),
+                  allow_pickle=False)
+    vecs = []
+    for n, t, dom in zip(meta["names"], meta["types"], meta["domains"]):
+        if t in (T_STR, T_UUID):
+            vecs.append(Vec(meta["strings"][n], t))
+        elif t == T_CAT:
+            vecs.append(Vec(npz[f"c_{n}"].astype(np.int32), t, domain=dom))
+        else:
+            vecs.append(Vec(npz[f"c_{n}"].astype(np.float32), t))
+    return Frame(meta["names"], vecs, key=key or meta["key"])

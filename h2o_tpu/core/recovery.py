@@ -1,0 +1,132 @@
+"""Recovery — job-level fault tolerance snapshots + auto-resume.
+
+Reference (hex/faulttolerance/{Recoverable,Recovery}.java:21-86): a
+``Recovery<T>`` attached to a Grid/AutoML job writes the job's params, its
+frame references (via FramePersist) and EVERY completed model to
+``-auto_recovery_dir``; on node restart ``Recovery.autoRecover()`` finds
+the newest snapshot and resumes the job where it stopped (REST
+``POST /3/Recovery/resume``, client h2o-py/h2o/h2o.py:308).  The cloud
+itself cannot survive member loss (Paxos locks membership) — recovery is
+deliberately job-level, and the TPU runtime has the same fixed-mesh
+constraint (SURVEY §5.3), so the design carries over unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, List, Optional
+
+from h2o_tpu.core import persist
+from h2o_tpu.core.frame import Frame
+from h2o_tpu.core.log import get_logger
+
+log = get_logger("recovery")
+
+
+class Recovery:
+    """Snapshot writer/reader for one recoverable job."""
+
+    def __init__(self, recovery_dir: str, job_kind: str, job_id: str):
+        self.dir = os.path.join(recovery_dir, f"{job_kind}_{job_id}")
+        self.kind = job_kind
+        self.job_id = job_id
+        os.makedirs(self.dir, exist_ok=True)
+
+    # -- writing (called by the running job) -------------------------------
+
+    def begin(self, params: Dict[str, Any], train: Frame,
+              extra: Optional[Dict] = None) -> None:
+        """Persist job params + the training frame before work starts
+        (Recovery.onStart analog)."""
+        persist.save_frame(train, os.path.join(self.dir, "train"))
+        info = {"kind": self.kind, "job_id": self.job_id,
+                "started": time.time(),
+                "params": _jsonable(params), "extra": extra or {},
+                "done": False, "models": []}
+        self._write_info(info)
+
+    def model_done(self, model) -> None:
+        """Persist one completed model (Recovery.onModel analog)."""
+        path = os.path.join(self.dir, f"model_{len(self._info()['models'])}"
+                            ".bin")
+        model.save(path)
+        info = self._info()
+        info["models"].append({"key": str(model.key), "path": path})
+        self._write_info(info)
+
+    def done(self) -> None:
+        """Mark complete and clean up (reference deletes the snapshot)."""
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+    # -- reading (auto-recover on restart) ----------------------------------
+
+    def _info(self) -> Dict:
+        with open(os.path.join(self.dir, "info.json")) as f:
+            return json.load(f)
+
+    def _write_info(self, info: Dict) -> None:
+        tmp = os.path.join(self.dir, "info.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(info, f)
+        os.replace(tmp, os.path.join(self.dir, "info.json"))
+
+
+def _jsonable(params: Dict) -> Dict:
+    out = {}
+    for k, v in params.items():
+        try:
+            json.dumps(v)
+            out[k] = v
+        except TypeError:
+            out[k] = str(v)
+    return out
+
+
+def pending_recoveries(recovery_dir: str) -> List[Dict]:
+    """Unfinished snapshots in the recovery dir (newest first)."""
+    out = []
+    if not os.path.isdir(recovery_dir):
+        return out
+    for d in os.listdir(recovery_dir):
+        info_p = os.path.join(recovery_dir, d, "info.json")
+        if os.path.exists(info_p):
+            with open(info_p) as f:
+                info = json.load(f)
+            if not info.get("done"):
+                info["dir"] = os.path.join(recovery_dir, d)
+                out.append(info)
+    out.sort(key=lambda i: -i.get("started", 0))
+    return out
+
+
+def auto_recover(recovery_dir: str) -> List[Any]:
+    """Resume every unfinished Grid job found in ``recovery_dir`` (the
+    Recovery.autoRecover / POST /3/Recovery/resume path).
+
+    Completed models are reloaded into the DKV; only the REMAINING hyper
+    combos are trained.  Returns the resumed result objects.
+    """
+    from h2o_tpu.core.cloud import cloud
+    from h2o_tpu.models.model import Model
+
+    results = []
+    for info in pending_recoveries(recovery_dir):
+        kind = info["kind"]
+        log.info("auto-recovering %s job %s (%d models already done)",
+                 kind, info["job_id"], len(info["models"]))
+        train = persist.load_frame(os.path.join(info["dir"], "train"))
+        done_models = []
+        for m in info["models"]:
+            mdl = Model.load(m["path"])
+            cloud().dkv.put(mdl.key, mdl)
+            done_models.append(mdl)
+        if kind == "grid":
+            from h2o_tpu.models.grid import GridSearch
+            results.append(GridSearch.resume_from_recovery(
+                info, train, done_models))
+        else:
+            log.warning("unknown recoverable kind %r", kind)
+    return results

@@ -63,6 +63,9 @@ class DKV:
             if e is not None and e.write_locked:
                 raise LockedException(f"{key} is write-locked")
             self._store[key] = _Entry(value)
+        from h2o_tpu.core.diag import TimeLine
+        TimeLine.record("dkv", "put", key=str(key),
+                        type=type(value).__name__)
         return key
 
     def get(self, key: str, default=None) -> Any:

@@ -101,7 +101,8 @@ class GridSearch:
 
     def __init__(self, builder_cls, hyper_params: Dict[str, Sequence],
                  search_criteria: Optional[Dict] = None,
-                 grid_id: Optional[str] = None, **base_params):
+                 grid_id: Optional[str] = None,
+                 recovery_dir: Optional[str] = None, **base_params):
         if isinstance(builder_cls, str):
             from h2o_tpu.models.registry import builder_class
             builder_cls = builder_class(builder_cls)
@@ -111,6 +112,8 @@ class GridSearch:
         self.strategy = sc.pop("strategy", "Cartesian")
         self.criteria = sc
         self.base_params = base_params
+        self.recovery_dir = recovery_dir
+        self._resuming = False
         self.grid_id = grid_id or str(Key.make(
             f"grid_{builder_cls.algo}"))
 
@@ -143,6 +146,19 @@ class GridSearch:
         if grid is None:
             grid = Grid(self.grid_id, self.builder_cls.algo,
                         list(self.hyper_params))
+        rec = None
+        if self.recovery_dir:
+            from h2o_tpu.core.recovery import Recovery, _jsonable
+            rec = Recovery(self.recovery_dir, "grid", self.grid_id)
+            if not self._resuming:
+                rec.begin(dict(self.base_params), train, extra=_jsonable(
+                    dict(algo=self.builder_cls.algo,
+                         hyper_params={k: [_py(v) for v in vs] for k, vs
+                                       in self.hyper_params.items()},
+                         strategy=self.strategy,
+                         criteria=_jsonable(self.criteria),
+                         base_params=_jsonable(self.base_params),
+                         x=list(x) if x is not None else None, y=y)))
         combos = self._combos()
         # skip combos already trained (grid resume semantics)
         done = {tuple(sorted(hv.items())) for hv in grid.hyper_values}
@@ -173,6 +189,8 @@ class GridSearch:
                 grid.models.append(m)
                 grid.hyper_values.append(dict(combo))
                 cloud().dkv.put(m.key, m)
+                if rec is not None:
+                    rec.model_done(m)
             except Exception as e:  # noqa: BLE001 — grid collects failures
                 log.warning("grid model failed (%s): %s", combo, e)
                 grid.failures.append({"params": dict(combo),
@@ -202,7 +220,37 @@ class GridSearch:
                        f"{len(grid.models)} models, best {metric}="
                        f"{best:.5g}")
         cloud().dkv.put(grid.key, grid)
+        if rec is not None:
+            rec.done()
         return grid
+
+    # -- recovery resume (Recovery.autoRecover target) ---------------------
+
+    @classmethod
+    def resume_from_recovery(cls, info: Dict, train, done_models) -> Grid:
+        """Rebuild the search from a Recovery snapshot and train only the
+        remaining combos (hex/faulttolerance/Recovery.java:21-86)."""
+        import os
+        extra = info["extra"]
+        gs = cls(extra["algo"], extra["hyper_params"],
+                 dict(extra["criteria"], strategy=extra["strategy"]),
+                 grid_id=info["job_id"],
+                 recovery_dir=os.path.dirname(info["dir"]),
+                 **extra["base_params"])
+        gs._resuming = True
+        hyper = list(extra["hyper_params"])
+        grid = Grid(gs.grid_id, extra["algo"], hyper)
+        grid.models = list(done_models)
+        grid.hyper_values = [
+            {k: m.params.get(k) for k in hyper} for m in done_models]
+        cloud().dkv.put(grid.key, grid)
+        return gs.train(x=extra.get("x"), y=extra.get("y"),
+                        training_frame=train)
+
+
+def _py(v):
+    """numpy scalar -> python scalar for recovery JSON."""
+    return v.item() if hasattr(v, "item") else v
 
 
 def get_grid(grid_id: str) -> Optional[Grid]:

@@ -562,16 +562,19 @@ def _merge(node, env):
         out = d[take]
         if v.is_categorical:
             out = np.where(li >= 0, out, -1).astype(np.int32)
-            # right-only rows: pull key values from the right frame
+            dom = list(v.domain)
+            # right-only rows: pull key values from the right frame; the
+            # output domain is the UNION so unseen right labels survive
             if j in by_x and (li < 0).any():
                 jr = by_y[by_x.index(j)]
                 rv = R.vecs[jr]
                 rd = rv.to_numpy()
-                remap = _domain_remap(rv.domain, v.domain)
+                dom = dom + [x for x in rv.domain if x not in set(dom)]
+                remap = _domain_remap(rv.domain, dom)
                 out = np.where(li >= 0, out,
                                remap[np.clip(rd[np.where(ri >= 0, ri, 0)],
                                              -1, None)]).astype(np.int32)
-            vecs.append(Vec(out, T_CAT, domain=list(v.domain)))
+            vecs.append(Vec(out, T_CAT, domain=dom))
         else:
             out = np.where(li >= 0, out, np.nan)
             if j in by_x and (li < 0).any():

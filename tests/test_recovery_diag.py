@@ -1,0 +1,183 @@
+"""FramePersist / Recovery auto-resume / Timeline / WaterMeter tests."""
+
+import os
+
+import numpy as np
+
+
+def _mk_frame(rng, n=300):
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT, T_STR
+    X = rng.normal(size=(n, 2)).astype(np.float32)
+    X[0, 0] = np.nan
+    y = rng.integers(0, 2, n).astype(np.int32)
+    return Frame(
+        ["x0", "x1", "c", "s", "y"],
+        [Vec(X[:, 0]), Vec(X[:, 1]),
+         Vec(rng.integers(0, 3, n).astype(np.int32), T_CAT,
+             domain=["a", "b", "c"]),
+         Vec([f"s{i}" if i % 7 else None for i in range(n)], T_STR),
+         Vec(y, T_CAT, domain=["no", "yes"])])
+
+
+def test_frame_persist_roundtrip(cl, rng, tmp_path):
+    from h2o_tpu.core.persist import load_frame, save_frame
+    fr = _mk_frame(rng)
+    save_frame(fr, str(tmp_path / "snap"))
+    fr2 = load_frame(str(tmp_path / "snap"))
+    assert fr2.names == fr.names
+    assert fr2.nrows == fr.nrows
+    np.testing.assert_allclose(fr2.vec("x0").to_numpy(),
+                               fr.vec("x0").to_numpy(), equal_nan=True)
+    assert fr2.vec("c").domain == ["a", "b", "c"]
+    np.testing.assert_array_equal(fr2.vec("c").to_numpy(),
+                                  fr.vec("c").to_numpy())
+    assert fr2.vec("s").to_numpy()[1] == "s1"
+    assert fr2.vec("s").to_numpy()[0] is None
+
+
+def test_persist_scheme_registry(cl, tmp_path):
+    from h2o_tpu.core import persist
+    blobs = {}
+    persist.register_scheme(
+        "mem", lambda uri: blobs[uri], lambda uri, b: blobs.__setitem__(
+            uri, b))
+    persist.write_bytes("mem://x/y", b"hello")
+    assert persist.read_bytes("mem://x/y") == b"hello"
+    import pytest
+    with pytest.raises(NotImplementedError):
+        persist.read_bytes("s3://bucket/key")
+
+
+def test_grid_recovery_resume(cl, rng, tmp_path):
+    """Kill a grid 'mid-flight' (simulated by a partial snapshot) and
+    auto-recover: only the remaining combos are trained."""
+    from h2o_tpu.core.recovery import auto_recover, pending_recoveries
+    from h2o_tpu.models.grid import GridSearch
+    rec_dir = str(tmp_path / "rec")
+    fr = _mk_frame(rng)
+    gs = GridSearch("gbm", {"max_depth": [2, 3, 4]},
+                    grid_id="recov_grid", recovery_dir=rec_dir,
+                    ntrees=3, seed=1)
+    grid = gs.train(y="y", training_frame=fr)
+    assert len(grid.models) == 3
+    # completed run cleans its snapshot
+    assert pending_recoveries(rec_dir) == []
+
+    # now fabricate an interrupted run: snapshot with only 1 model done
+    from h2o_tpu.core.recovery import Recovery
+    rec = Recovery(rec_dir, "grid", "recov_grid2")
+    rec.begin(dict(ntrees=3, seed=1), fr, extra=dict(
+        algo="gbm", hyper_params={"max_depth": [2, 3, 4]},
+        strategy="Cartesian", criteria={},
+        base_params=dict(ntrees=3, seed=1), x=None, y="y"))
+    from h2o_tpu.models.tree.gbm import GBM
+    m0 = GBM(ntrees=3, max_depth=2, seed=1).train(y="y",
+                                                  training_frame=fr)
+    rec.model_done(m0)
+    pend = pending_recoveries(rec_dir)
+    assert len(pend) == 1 and len(pend[0]["models"]) == 1
+
+    results = auto_recover(rec_dir)
+    assert len(results) == 1
+    grid2 = results[0]
+    assert len(grid2.models) == 3
+    depths = sorted(int(m.params["max_depth"]) for m in grid2.models)
+    assert depths == [2, 3, 4]
+    # resumed run cleans up too
+    assert pending_recoveries(rec_dir) == []
+
+
+def test_timeline_records_dkv_and_jobs(cl, rng):
+    from h2o_tpu.core.cloud import cloud
+    from h2o_tpu.core.diag import TimeLine
+    TimeLine.clear()
+    fr = _mk_frame(rng, n=50)
+    cloud().dkv.put("tl_probe", fr)
+    ev = TimeLine.snapshot()
+    assert any(e["kind"] == "dkv" and e["what"] == "put" and
+               e["key"] == "tl_probe" for e in ev)
+    from h2o_tpu.models.glm import GLM
+    GLM(family="binomial").train(y="y", training_frame=fr)
+    ev = TimeLine.snapshot()
+    assert any(e["kind"] == "job" and e["what"] == "start" for e in ev)
+    assert any(e["kind"] == "job" and e["what"] == "end" for e in ev)
+
+
+def test_water_meter_and_jstack(cl):
+    from h2o_tpu.core.diag import (jstack, water_meter_cpu_ticks,
+                                   water_meter_io)
+    cpu = water_meter_cpu_ticks()
+    assert "cpu_ticks" in cpu and len(cpu["cpu_ticks"]) >= 1
+    io_c = water_meter_io()
+    assert io_c["read_bytes"] >= 0
+    traces = jstack()
+    assert any("MainThread" in t["name"] for t in traces)
+
+
+def test_profiler_samples(cl):
+    import time
+    from h2o_tpu.core.diag import Profiler
+    p = Profiler(interval_s=0.002).start()
+    t0 = time.time()
+    x = 0
+    while time.time() - t0 < 0.1:
+        x += sum(range(1000))
+    counts = p.stop()
+    assert len(counts) > 0
+
+
+def test_rest_diag_routes(cl):
+    import json
+    import urllib.request
+    from h2o_tpu.api.server import RestServer
+    srv = RestServer(port=0).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+
+        def get(path):
+            with urllib.request.urlopen(base + path) as r:
+                return json.loads(r.read())
+
+        assert "events" in get("/3/Timeline")
+        assert "cpu_ticks" in get("/3/WaterMeterCpuTicks")
+        assert get("/3/JStack")["traces"]
+        assert len(get("/3/DeviceMemory")["devices"]) >= 1
+        assert get("/3/WaterMeterIo")["read_bytes"] >= 0
+    finally:
+        srv.stop()
+
+
+def test_time_parts_exact_seconds(cl):
+    """float64 host copy preserves second-level precision (T_TIME)."""
+    from h2o_tpu.core.frame import Frame, Vec, T_TIME
+    from h2o_tpu.core.cloud import cloud
+    from h2o_tpu.rapids.interp import Session, rapids_exec
+    ms = np.array([np.datetime64("2021-03-04T05:06:07").astype(
+        "datetime64[ms]").astype("int64")], np.float64)
+    fr = Frame(["t"], [Vec(ms, T_TIME)])
+    fr.key = "TSEC"
+    cloud().dkv.put("TSEC", fr)
+    s = Session("tsec")
+    assert rapids_exec("(minute TSEC)", s).vec("t").to_numpy()[0] == 6
+    assert rapids_exec("(second TSEC)", s).vec("t").to_numpy()[0] == 7
+
+
+def test_merge_right_outer_union_domain(cl):
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT
+    from h2o_tpu.core.cloud import cloud
+    from h2o_tpu.rapids.interp import Session, rapids_exec
+    L = Frame(["k", "x"],
+              [Vec(np.array([0], np.int32), T_CAT, domain=["a"]),
+               Vec(np.array([1.], np.float32))])
+    R = Frame(["k", "y"],
+              [Vec(np.array([0, 1], np.int32), T_CAT, domain=["a", "d"]),
+               Vec(np.array([5., 6.], np.float32))])
+    L.key, R.key = "MUL", "MUR"
+    cloud().dkv.put("MUL", L)
+    cloud().dkv.put("MUR", R)
+    s = Session("mu")
+    out = rapids_exec("(merge MUL MUR 0 1 [0] [0] 'auto')", s)
+    assert out.nrows == 2
+    labels = [out.vec("k").domain[int(c)] if c >= 0 else None
+              for c in out.vec("k").to_numpy()]
+    assert set(labels) == {"a", "d"}      # 'd' key survives the join
