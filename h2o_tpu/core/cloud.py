@@ -19,7 +19,6 @@ Mesh axes:
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Optional
 
@@ -132,6 +131,3 @@ def cloud() -> Cloud:
     return Cloud.get()
 
 
-def is_virtual_cpu_mesh() -> bool:
-    return jax.devices()[0].platform == "cpu" and (
-        "host_platform_device_count" in os.environ.get("XLA_FLAGS", ""))

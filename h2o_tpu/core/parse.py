@@ -208,12 +208,17 @@ def _parse_native(paths: Sequence[str], setup: ParseSetupResult,
                  v.decode("utf-8", "replace").replace('""', '"')
                  for v, na in zip(col, na_mask)], T_STR))
         else:
-            # sorted global domain via one vectorized unique over bytes
+            # sorted global domain via one vectorized unique over bytes.
+            # Only unquoted NA tokens are missing — a quoted "NA" is a real
+            # level (same semantics as the T_STR path's na_mask & ~quoted).
             domain_b, codes = np.unique(col, return_inverse=True)
-            keep = ~np.isin(domain_b, list(na_bytes))
+            codes = codes.ravel()
+            keep = np.bincount(codes[~na_mask],
+                               minlength=len(domain_b)) > 0
             remap = np.full(len(domain_b), -1, np.int32)
             remap[keep] = np.arange(int(keep.sum()), dtype=np.int32)
             codes = remap[codes]
+            codes[na_mask] = -1
             domain = [d.decode("utf-8", "replace").replace('""', '"')
                       for d in domain_b[keep]]
             vecs.append(Vec(codes.astype(np.int32), T_CAT, domain=domain))

@@ -109,5 +109,8 @@ def load_frame(dir_uri: str, key: Optional[str] = None) -> Frame:
         elif t == T_CAT:
             vecs.append(Vec(npz[f"c_{n}"].astype(np.int32), t, domain=dom))
         else:
-            vecs.append(Vec(npz[f"c_{n}"].astype(np.float32), t))
+            # keep the saved dtype: T_TIME epoch-ms (and any float64 numeric
+            # host copy) exceeds f32 precision (~131 s ulp at epoch scale);
+            # a round trip must not corrupt timestamps.
+            vecs.append(Vec(npz[f"c_{n}"], t))
     return Frame(meta["names"], vecs, key=key or meta["key"])

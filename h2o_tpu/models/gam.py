@@ -45,15 +45,23 @@ def _ncs_basis(x, knots: np.ndarray):
 
 def _expand_gam(frame: Frame, gam_cols: List[str],
                 knots_map: Dict[str, np.ndarray],
-                means: Dict[str, float]) -> Frame:
+                means: Dict[str, float],
+                plain_x: Optional[List[str]] = None) -> Frame:
     """Append spline basis vecs for each gam column (host-visible names
     ``col_gam_0..``; the reference names them col_0, col_1, …).  NaNs are
-    imputed with the TRAINING mean (train/serve consistency)."""
+    imputed with the TRAINING mean (train/serve consistency).
+
+    The linear basis element (index 0, x itself) is skipped only when the
+    gam column already appears among the plain predictors ``plain_x`` —
+    otherwise the natural-cubic-spline space would lose its linear term
+    (the reference's cr smoother always carries the full basis).
+    """
+    plain = set(plain_x or [])
     out = Frame(list(frame.names), list(frame.vecs))
     for c in gam_cols:
         x = jnp.nan_to_num(frame.vec(c).as_float(), nan=means[c])
         for i, b in enumerate(_ncs_basis(x, knots_map[c])):
-            if i == 0:
+            if i == 0 and c in plain:
                 continue            # x itself is already a predictor
             out.add(f"{c}_gam_{i}", Vec(b, nrows=frame.nrows))
     return out
@@ -74,7 +82,8 @@ class GAMModel(Model):
         expanded = _expand_gam(frame, out["gam_columns"],
                                {c: out["knots"][c]
                                 for c in out["gam_columns"]},
-                               out["gam_col_means"])
+                               out["gam_col_means"],
+                               plain_x=out.get("x"))
         return self._inner().predict_raw(expanded)
 
     def coef(self) -> Dict[str, float]:
@@ -112,8 +121,10 @@ class GAM(ModelBuilder):
             knots_map[c] = np.unique(qs)
             means[c] = float(vals.mean()) if len(vals) else 0.0
 
-        expanded = _expand_gam(train, gam_cols, knots_map, means)
-        exp_valid = _expand_gam(valid, gam_cols, knots_map, means) \
+        expanded = _expand_gam(train, gam_cols, knots_map, means,
+                               plain_x=list(x))
+        exp_valid = _expand_gam(valid, gam_cols, knots_map, means,
+                                plain_x=list(x)) \
             if valid is not None else None
         basis_names = [n for n in expanded.names if n not in train.names]
         job.update(0.2, f"spline basis: {len(basis_names)} columns")

@@ -61,9 +61,8 @@ def _describe_rule(node: int, sc, bs, xs, split_points, is_cat,
         went_left = (n == 2 * parent + 1)
         c = int(sc[parent])
         if c >= 0:
-            bits = bs[parent]                     # (B+1,) left-membership
-            if not went_left:
-                bits = ~bits
+            bits_left = bs[parent]                # (B+1,) left-membership
+            bits = bits_left if went_left else ~bits_left
             col = xs[c]
             if is_cat[c]:
                 dom = domains.get(col, [])
@@ -72,7 +71,9 @@ def _describe_rule(node: int, sc, bs, xs, split_points, is_cat,
                 cond = f"{col} in {{{', '.join(levels)}}}"
             else:
                 sp = split_points[c]
-                k = int(bits[:-1].sum()) - 1
+                # split index comes from the un-flipped prefix bitset: the
+                # right branch's complement would otherwise yield B-k-2.
+                k = int(bits_left[:-1].sum()) - 1
                 thr = sp[k] if 0 <= k < len(sp) and np.isfinite(sp[k]) \
                     else None
                 op = "<" if went_left else ">="

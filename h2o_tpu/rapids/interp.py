@@ -465,8 +465,16 @@ _CUM_IDENT = {"cumsum": 0.0, "cumprod": 1.0, "cummin": jnp.inf,
 def _sort_keys(fr: Frame, idxs, ascending) -> np.ndarray:
     keys = []
     for j, asc in zip(reversed(idxs), reversed(ascending)):
-        k = np.asarray(fr.vecs[j].to_numpy(), np.float64)
-        keys.append(k if asc else -k)
+        v = fr.vecs[j]
+        k = np.asarray(v.to_numpy(), np.float64)
+        na = np.isnan(k)
+        if v.type == T_CAT:
+            na = na | (k < 0)       # categorical NA code is -1
+        k = k if asc else -k
+        # NAs group first in both directions (RadixOrder's consistent NA
+        # placement) — a plain negation would sort cat-NA (-1 -> +1)
+        # between levels, and lexsort always puts NaN last.
+        keys.append(np.where(na, -np.inf, k))
     return np.lexsort(keys)
 
 
