@@ -89,15 +89,73 @@ def shutdown(params):
     return {}
 
 
+def _routes_json():
+    from h2o_tpu.api.server import _ROUTES
+    return [{"http_method": m,
+             "url_pattern": rx.pattern.strip("^$"),
+             "summary": fn.__doc__ or fn.__name__,
+             "input_schema": "RequestSchemaV3",
+             "output_schema": "SchemaV3",
+             "handler": fn.__name__} for m, rx, fn, _raw in _ROUTES]
+
+
 @route("GET", r"/3/Metadata/endpoints")
 def endpoints(params):
-    from h2o_tpu.api.server import _ROUTES
-    return {"routes": [{"http_method": m, "url_pattern": rx.pattern,
-                        "handler": fn.__name__} for m, rx, fn in _ROUTES]}
+    return {"__meta": {"schema_version": 3, "schema_name": "MetadataV3",
+                       "schema_type": "Metadata"},
+            "routes": _routes_json()}
+
+
+@route("GET", r"/3/Metadata/schemas/(?P<name>[^/]+)")
+def metadata_schema(params, name):
+    """Schema field metadata (water/api/SchemaMetadataV3); the h2o-py client
+    defines CloudV3/H2OErrorV3/... properties from this at connect time."""
+    from h2o_tpu.api import schemas
+    if schemas.schema_json(name) is None:
+        raise H2OError(404, f"schema {name} not found")
+    return schemas.metadata_response([name])
+
+
+@route("GET", r"/3/Metadata/schemas")
+def metadata_schemas(params):
+    from h2o_tpu.api import schemas
+    return schemas.metadata_response(list(schemas.SCHEMAS),
+                                     routes=_routes_json())
+
+
+@route("GET", r"/3/Capabilities")
+@route("GET", r"/3/Capabilities/Core")
+@route("GET", r"/3/Capabilities/API")
+def capabilities(params):
+    """Registered extensions (water/api/CapabilitiesHandler).  The rebuild
+    has no pluggable extensions; core algo surface is reported."""
+    return {"__meta": {"schema_version": 3, "schema_name": "CapabilitiesV3",
+                       "schema_type": "Iced"},
+            "capabilities": []}
+
+
+@route("GET", r"/3/Typeahead/files")
+def typeahead_files(params):
+    """File-path completion for import (water/api/TypeaheadHandler)."""
+    src = params.get("src") or ""
+    limit = int(params.get("limit", 100) or 100)
+    base = os.path.expanduser(src)
+    try:
+        if os.path.isdir(base):
+            entries = [os.path.join(base, e) for e in sorted(
+                os.listdir(base))]
+        else:
+            d, prefix = os.path.split(base)
+            entries = [os.path.join(d, e) for e in sorted(os.listdir(d or "."))
+                       if e.startswith(prefix)]
+    except OSError:
+        entries = []
+    return {"matches": entries[:limit]}
 
 
 @route("POST", r"/3/InitID")
 @route("GET", r"/3/InitID")
+@route("POST", r"/4/sessions")
 def init_id(params):
     sid = f"_sid{len(_SESSIONS) + 1:04d}"
     _SESSIONS[sid] = Session(sid)
@@ -112,6 +170,28 @@ def end_session(params):
 # ---------------------------------------------------------------------------
 # ingest
 # ---------------------------------------------------------------------------
+
+@route("POST", r"/3/PostFile(?:\.bin)?", raw=True)
+def post_file(params, body=None):
+    """Single-threaded file push (water/api/PostFileHandler): the client
+    sends the file contents as the raw request body
+    (h2o-py/h2o/backend/connection.py _prepare_file_payload); the stream is
+    spooled into ice_root and the key resolves like an imported file."""
+    import shutil
+    import uuid
+    c = cloud()
+    dest = params.get("destination_frame") or \
+        f"upload_{uuid.uuid4().hex[:12]}.bin"
+    updir = os.path.join(c.args.ice_root, "uploads")
+    os.makedirs(updir, exist_ok=True)
+    path = os.path.join(updir, dest.replace("/", "_"))
+    with open(path, "wb") as f:
+        shutil.copyfileobj(body, f)
+    key = f"nfs://{path}"
+    c.dkv.put(key, path)
+    return {"destination_frame": key,
+            "total_bytes": os.path.getsize(path)}
+
 
 @route("GET", r"/3/ImportFiles")
 @route("POST", r"/3/ImportFiles")
@@ -139,12 +219,23 @@ def parse_setup_route(params):
     setup = parse_setup(paths)
     d = setup.to_dict()
     d.update({
+        "__meta": {"schema_version": 3, "schema_name": "ParseSetupV3",
+                   "schema_type": "ParseSetup"},
         "source_frames": [_key(s, "Key<Frame>") for s in src],
         "destination_frame": os.path.basename(paths[0]).replace(".", "_")
         + ".hex",
         "number_columns": len(setup.column_names),
         "parse_type": "CSV",
         "chunk_size": 4 * 1024 * 1024,
+        "na_strings": [list(setup.na_strings)
+                       for _ in setup.column_names],
+        "single_quotes": False,
+        "escapechar": None,
+        "custom_non_data_line_markers": None,
+        "partition_by": None,
+        "skipped_columns": None,
+        "warnings": [],
+        "total_filtered_column_count": len(setup.column_names),
     })
     return d
 
@@ -194,11 +285,15 @@ def _frame_schema(fr: Frame, rows: int = 10, column_offset: int = 0,
             data = [None if (isinstance(x, float) and np.isnan(x))
                     else float(x) for x in head.astype(float)]
         r = v.rollups if (v.is_numeric or v.is_categorical) else None
+        vtype = {"enum": "enum", "real": "real", "time": "time",
+                 "string": "string"}.get(v.type, v.type)
+        if vtype == "real" and r is not None and bool(r.isint):
+            vtype = "int"           # H2O reports integral numerics as 'int'
         cols.append({
-            "__meta": {"schema_type": "Vec"},
+            "__meta": {"schema_version": 3, "schema_name": "ColV3",
+                       "schema_type": "Vec"},
             "label": fr.names[j],
-            "type": {"enum": "enum", "real": "real", "time": "time",
-                     "string": "string"}.get(v.type, v.type),
+            "type": vtype,
             "missing_count": v.nacnt() if r else 0,
             "zero_count": int(r.zeros) if r else 0,
             "positive_infinity_count": 0, "negative_infinity_count": 0,
@@ -252,8 +347,45 @@ def get_frame(params, frame_id):
 
 
 @route("GET", r"/3/Frames/(?P<frame_id>[^/]+)/summary")
+@route("GET", r"/3/Frames/(?P<frame_id>[^/]+)/light")
 def frame_summary(params, frame_id):
     return get_frame(params, frame_id)
+
+
+@route("GET", r"/3/DownloadDataset(?:\.bin)?")
+def download_dataset(params):
+    """Frame -> CSV export (water/api/DownloadDataHandler); backs the
+    client's as_data_frame / h2o.export_file local path."""
+    import csv as csvmod
+    import io as iomod
+    frame_id = params.get("frame_id")
+    fr = cloud().dkv.get(frame_id)
+    if not isinstance(fr, Frame):
+        raise H2OError(404, f"frame {frame_id} not found")
+    buf = iomod.StringIO()
+    w = csvmod.writer(buf, quoting=csvmod.QUOTE_MINIMAL)
+    w.writerow(fr.names)
+    cols = []
+    for v in fr.vecs:
+        if v.host_data is not None:
+            cols.append([("" if x is None else str(x))
+                         for x in v.host_data[: fr.nrows]])
+        elif v.is_categorical:
+            codes = np.asarray(v.to_numpy())[: fr.nrows]
+            dom = v.domain or []
+            cols.append(["" if c < 0 else dom[int(c)] for c in codes])
+        else:
+            vals = np.asarray(v.to_numpy())[: fr.nrows]
+            if v.type == "time":
+                cols.append(["" if np.isnan(x) else str(int(x))
+                             for x in vals])
+            else:
+                cols.append(["" if np.isnan(x) else
+                             (str(int(x)) if float(x).is_integer()
+                              else repr(float(x))) for x in vals])
+    for row in zip(*cols):
+        w.writerow(row)
+    return ("text/csv", buf.getvalue().encode())
 
 
 @route("DELETE", r"/3/Frames/(?P<frame_id>[^/]+)")
@@ -363,11 +495,23 @@ def build_model(params, algo):
                            if not str(k).startswith("_")}}
 
 
-def _metrics_dict(m):
+def _metrics_dict(m, frame_id=None, model_id=None):
     if m is None:
         return None
-    d = {"__meta": {"schema_type": "ModelMetrics"},
-         "model_category": m.kind.capitalize()}
+    kind_schema = {"binomial": "ModelMetricsBinomialV3",
+                   "multinomial": "ModelMetricsMultinomialV3",
+                   "regression": "ModelMetricsRegressionV3",
+                   "clustering": "ModelMetricsClusteringV3",
+                   "ordinal": "ModelMetricsOrdinalV3",
+                   "anomaly": "ModelMetricsAnomalyV3",
+                   }.get(m.kind, "ModelMetricsBaseV3")
+    d = {"__meta": {"schema_version": 3, "schema_name": kind_schema,
+                    "schema_type": "ModelMetrics"},
+         "model_category": m.kind.capitalize(),
+         "frame": _key(frame_id, "Key<Frame>") if frame_id else None,
+         "model": _key(model_id, "Key<Model>") if model_id else None,
+         "description": None, "scoring_time": 0,
+         "custom_metric_name": None, "custom_metric_value": 0.0}
     for k, v in m.data.items():
         if isinstance(v, np.ndarray):
             d[k] = v.tolist()
@@ -433,7 +577,12 @@ def delete_model(params, model_id):
 
 @route("POST", r"/3/Predictions/models/(?P<model_id>[^/]+)/frames/"
                r"(?P<frame_id>[^/]+)")
+@route("POST", r"/4/Predictions/models/(?P<model_id>[^/]+)/frames/"
+               r"(?P<frame_id>[^/]+)")
 def predict(params, model_id, frame_id):
+    """BigScore (hex/Model.java:1866): v3 scores synchronously and returns
+    the predictions frame; v4 returns a Job the client polls (the h2o-py
+    model_base.predict path)."""
     m = cloud().dkv.get(model_id)
     fr = cloud().dkv.get(frame_id)
     if not isinstance(m, Model):
@@ -442,10 +591,18 @@ def predict(params, model_id, frame_id):
         raise H2OError(404, f"frame {frame_id} not found")
     dest = params.get("predictions_frame") or f"predictions_{model_id}" \
         f"_{frame_id}"
-    pf = m.predict(fr)
-    pf.key = dest
-    cloud().dkv.put(dest, pf)
-    return {"predictions_frame": _key(dest, "Key<Frame>"),
+    job = Job(dest=dest, description=f"predict {model_id} on {frame_id}")
+
+    def body(j):
+        pf = m.predict(fr)
+        pf.key = dest
+        cloud().dkv.put(dest, pf)
+        return pf
+
+    cloud().jobs.start(job, body)
+    job.join()  # raises on FAILED
+    return {"job": job.to_dict(),
+            "predictions_frame": _key(dest, "Key<Frame>"),
             "model_metrics": []}
 
 
@@ -456,7 +613,9 @@ def model_metrics(params, model_id, frame_id):
     fr = cloud().dkv.get(frame_id)
     if not isinstance(m, Model) or not isinstance(fr, Frame):
         raise H2OError(404, "model or frame not found")
-    return {"model_metrics": [_metrics_dict(m.model_metrics(fr))]}
+    return {"model_metrics": [_metrics_dict(m.model_metrics(fr),
+                                            frame_id=frame_id,
+                                            model_id=model_id)]}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,189 @@
+"""REST schema metadata registry — the /3/Metadata/schemas surface.
+
+Reference: water/api/Schema.java + water/api/SchemaMetadata.java serve
+field-level metadata for every registered schema class; clients bootstrap
+themselves from it (h2o-py/h2o/schemas/schema.py:27 ``define_from_schema``
+fetches ``GET /3/Metadata/schemas/{name}`` on connect and turns each field
+into a Python property; h2o-bindings/bin/gen_python.py does codegen from the
+same routes).
+
+TPU-native: schemas here are declarative dicts — (name, type, help) triples
+per field — kept next to the handlers that emit the matching JSON.  The
+registry serves both the per-schema route the client needs at connect time
+(CloudV3, H2OErrorV3, H2OModelBuilderErrorV3) and the full listing.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+# name -> (type, help).  Field order preserved (the reference lists fields
+# in declaration order).
+_FieldSpec = Tuple[str, str, str]
+
+SCHEMAS: Dict[str, dict] = {}
+
+
+def register_schema(name: str, superclass: str,
+                    fields: List[_FieldSpec], version: int = 3) -> None:
+    SCHEMAS[name] = {"name": name, "superclass": superclass,
+                     "version": version, "fields": fields}
+
+
+def _field_json(name: str, ftype: str, help_: str) -> dict:
+    return {
+        "__meta": {"schema_version": 3, "schema_name": "FieldMetadataV3",
+                   "schema_type": "FieldMetadata"},
+        "name": name,
+        "type": ftype,
+        "schema_name": ftype if ftype[:1].isupper() else None,
+        "is_schema": ftype[:1].isupper(),
+        "value": None,
+        "help": help_,
+        "label": name,
+        "required": False,
+        "level": "critical",
+        "direction": "OUTPUT",
+        "is_inherited": False,
+        "is_gridable": False,
+        "is_mutually_exclusive_with": [],
+        "values": [],
+        "json": True,
+    }
+
+
+def schema_json(name: str) -> Optional[dict]:
+    s = SCHEMAS.get(name)
+    if s is None:
+        return None
+    return {
+        "__meta": {"schema_version": 3, "schema_name": "SchemaMetadataV3",
+                   "schema_type": "SchemaMetadata"},
+        "version": s["version"],
+        "name": s["name"],
+        "superclass": s["superclass"],
+        "type": "Iced",
+        "fields": [_field_json(*f) for f in s["fields"]],
+        "markdown": None,
+    }
+
+
+def metadata_response(names: List[str], routes: Optional[list] = None) -> dict:
+    """The MetadataV3 envelope the client's H2OMetadataV3.make expects:
+    ``schemas`` is a list (client reads schemas[0].fields), ``routes``
+    optional."""
+    return {
+        "__meta": {"schema_version": 3, "schema_name": "MetadataV3",
+                   "schema_type": "Metadata"},
+        "schemas": [schema_json(n) for n in names if n in SCHEMAS],
+        "routes": routes or [],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Schema definitions.  Fields mirror the JSON the handlers actually emit
+# (and therefore the subset of water/api/schemas3/*.java the rebuild
+# supports); client-side property definition only needs name+help, typed
+# entries keep codegen viable.
+# ---------------------------------------------------------------------------
+
+register_schema("CloudV3", "RequestSchemaV3", [
+    ("version", "string", "H2O build version"),
+    ("branch_name", "string", "Branch of the build"),
+    ("build_number", "string", "Build number"),
+    ("build_age", "string", "Age of the build"),
+    ("build_too_old", "boolean", "Whether the build is too old"),
+    ("cloud_name", "string", "Cloud (cluster) name"),
+    ("cloud_size", "int", "Number of nodes (TPU mesh data-axis size)"),
+    ("cloud_uptime_millis", "long", "Cloud uptime in ms"),
+    ("cloud_internal_timezone", "string", "Cloud timezone"),
+    ("datafile_parser_timezone", "string", "Timezone used for parsing"),
+    ("cloud_healthy", "boolean", "Healthiness of the cloud"),
+    ("consensus", "boolean", "Cloud membership consensus reached"),
+    ("locked", "boolean", "Cloud is locked (membership frozen)"),
+    ("is_client", "boolean", "Node is a client node"),
+    ("internal_security_enabled", "boolean", "Internal security enabled"),
+    ("nodes", "Iced[]", "Per-node status"),
+    ("bad_nodes", "int", "Nodes failing heartbeats"),
+    ("skip_ticks", "boolean", "Skip CPU tick collection"),
+    ("web_ip", "string", "IP the REST server binds"),
+])
+
+_ERROR_FIELDS: List[_FieldSpec] = [
+    ("timestamp", "long", "Error time (ms since epoch)"),
+    ("error_url", "string", "Error url"),
+    ("msg", "string", "Message intended for the end user"),
+    ("dev_msg", "string", "Potentially more detailed message for developers"),
+    ("http_status", "int", "HTTP status code for this error"),
+    ("values", "Map", "Any values associated with the error"),
+    ("exception_type", "string", "Exception type, if any"),
+    ("exception_msg", "string", "Raw exception message, if any"),
+    ("stacktrace", "string[]", "Stacktrace, if any"),
+]
+
+register_schema("H2OErrorV3", "SchemaV3", list(_ERROR_FIELDS))
+register_schema("H2OModelBuilderErrorV3", "H2OErrorV3", _ERROR_FIELDS + [
+    ("parameters", "ModelParametersSchemaV3", "Model builder parameters"),
+    ("messages", "ValidationMessageV3[]", "Per-field validation messages"),
+    ("error_count", "int", "Count of validation errors"),
+])
+
+register_schema("TwoDimTableV3", "SchemaV3", [
+    ("name", "string", "Table name"),
+    ("description", "string", "Table description"),
+    ("columns", "Iced[]", "Column specifications"),
+    ("rowcount", "int", "Number of rows"),
+    ("data", "Polymorphic[][]", "Table data (col-major)"),
+])
+
+register_schema("KeyV3", "SchemaV3", [
+    ("name", "string", "Name (string representation) for this Key"),
+    ("type", "string", "Type (Key<Frame>, Key<Model>, ...)"),
+    ("URL", "string", "URL for the resource"),
+])
+
+register_schema("JobV3", "SchemaV3", [
+    ("key", "KeyV3", "Job key"),
+    ("description", "string", "Job description"),
+    ("status", "string", "CREATED/RUNNING/CANCELLED/FAILED/DONE"),
+    ("progress", "float", "Progress in [0,1]"),
+    ("progress_msg", "string", "Current progress status description"),
+    ("start_time", "long", "Start time (ms since epoch)"),
+    ("msec", "long", "Runtime in ms"),
+    ("dest", "KeyV3", "Destination key"),
+    ("warnings", "string[]", "Warnings"),
+    ("exception", "string", "Exception message, if any"),
+    ("stacktrace", "string", "Stacktrace, if any"),
+    ("ready_for_view", "boolean", "Job result can be fetched"),
+    ("auto_recoverable", "boolean", "Job is auto-recoverable"),
+])
+
+register_schema("FrameV3", "RequestSchemaV3", [
+    ("frame_id", "KeyV3", "Frame key"),
+    ("byte_size", "long", "Total data size in bytes"),
+    ("is_text", "boolean", "Raw unparsed text"),
+    ("row_offset", "long", "Offset of the first displayed row"),
+    ("row_count", "int", "Number of displayed rows"),
+    ("column_offset", "int", "Offset of the first displayed column"),
+    ("column_count", "int", "Number of displayed columns"),
+    ("total_column_count", "int", "Total number of columns"),
+    ("checksum", "long", "Checksum"),
+    ("rows", "long", "Number of rows"),
+    ("num_columns", "long", "Number of columns"),
+    ("default_percentiles", "double[]", "Default percentiles"),
+    ("columns", "ColV3[]", "Columns"),
+    ("compatible_models", "string[]", "Compatible models"),
+    ("chunk_summary", "TwoDimTableV3", "Chunk summary"),
+    ("distribution_summary", "TwoDimTableV3", "Distribution summary"),
+])
+
+register_schema("ModelSchemaV3", "SchemaV3", [
+    ("model_id", "KeyV3", "Model key"),
+    ("algo", "string", "Algo name"),
+    ("algo_full_name", "string", "Algo full name"),
+    ("response_column_name", "string", "Response column"),
+    ("parameters", "ModelParameterSchemaV3[]", "Parameters"),
+    ("output", "ModelOutputSchemaV3", "Output"),
+    ("compatible_frames", "string[]", "Compatible frames"),
+    ("checksum", "long", "Checksum"),
+])

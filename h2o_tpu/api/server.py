@@ -25,16 +25,21 @@ from h2o_tpu.core.log import get_logger
 
 log = get_logger("api")
 
-# route table: (method, regex, handler_name)
-_ROUTES: List[Tuple[str, re.Pattern, Callable]] = []
+# route table: (method, regex, handler, raw_body)
+_ROUTES: List[Tuple[str, re.Pattern, Callable, bool]] = []
 
 
-def route(method: str, pattern: str):
-    """Register a handler for e.g. ("GET", r"/3/Frames/(?P<frame_id>[^/]+)")."""
+def route(method: str, pattern: str, raw: bool = False):
+    """Register a handler for e.g. ("GET", r"/3/Frames/(?P<frame_id>[^/]+)").
+
+    ``raw=True`` routes receive the request body as a ``body=`` bytes kwarg
+    instead of having it form/JSON-decoded into params (file uploads: the
+    h2o-py client POSTs the file contents as the raw request body,
+    connection.py _prepare_file_payload)."""
     rx = re.compile("^" + pattern + "$")
 
     def deco(fn):
-        _ROUTES.append((method, rx, fn))
+        _ROUTES.append((method, rx, fn, raw))
         return fn
     return deco
 
@@ -44,6 +49,44 @@ class H2OError(Exception):
         super().__init__(msg)
         self.status = status
         self.msg = msg
+
+
+def _sanitize(x):
+    """JSON-safe payloads: H2O serializes non-finite doubles as the string
+    literals "NaN"/"Infinity"/"-Infinity" (the client's ExprNode cache
+    converts them back, h2o-py/h2o/expr.py _fill_data); strict client-side
+    simplejson rejects bare NaN tokens.  Copy-on-change: untouched subtrees
+    are returned as-is so large finite frame payloads aren't rebuilt."""
+    if isinstance(x, dict):
+        out = None
+        for k, v in x.items():
+            sv = _sanitize(v)
+            if out is not None:
+                out[k] = sv
+            elif sv is not v:
+                out = dict(x)
+                out[k] = sv
+        return out if out is not None else x
+    if isinstance(x, tuple):
+        return [_sanitize(v) for v in x]
+    if isinstance(x, list):
+        out = None
+        for i, v in enumerate(x):
+            sv = _sanitize(v)
+            if out is not None:
+                out[i] = sv
+            elif sv is not v:
+                out = list(x)
+                out[i] = sv
+        return out if out is not None else x
+    if isinstance(x, float):
+        if x != x:
+            return "NaN"
+        if x == float("inf"):
+            return "Infinity"
+        if x == float("-inf"):
+            return "-Infinity"
+    return x
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -69,38 +112,81 @@ class _Handler(BaseHTTPRequestHandler):
                 out.update({k: v[0] for k, v in parse_qs(body).items()})
         return out
 
+    def _query_params(self) -> Dict[str, str]:
+        q = parse_qs(urlparse(self.path).query)
+        return {k: v[0] for k, v in q.items()}
+
+    def _error_json(self, path: str, status: int, msg: str,
+                    dev_msg: str, exc_type: str = "") -> dict:
+        """Full H2OErrorV3 envelope — the client's H2OResponse dispatches on
+        __meta.schema_name and raises H2OResponseError with these fields."""
+        import time as _t
+        return {
+            "__meta": {"schema_version": 3, "schema_name": "H2OErrorV3",
+                       "schema_type": "H2OError"},
+            "timestamp": int(_t.time() * 1000),
+            "error_url": path, "msg": msg, "dev_msg": dev_msg,
+            "http_status": status, "values": {},
+            "exception_type": exc_type, "exception_msg": msg,
+            "stacktrace": dev_msg.splitlines(),
+        }
+
     def _dispatch(self, method: str):
         path = unquote(urlparse(self.path).path)
-        for m, rx, fn in _ROUTES:
+        for m, rx, fn, raw in _ROUTES:
             if m != method:
                 continue
             match = rx.match(path)
             if match:
                 try:
-                    result = fn(self._params(), **match.groupdict())
-                    self._send(200, result if result is not None else {})
+                    if raw:
+                        # spool the body to disk in chunks: uploads can be
+                        # multi-GB and must not be buffered in RSS
+                        import tempfile
+                        length = int(self.headers.get("Content-Length") or 0)
+                        spool = tempfile.SpooledTemporaryFile(
+                            max_size=1 << 20)
+                        remaining = length
+                        while remaining > 0:
+                            chunk = self.rfile.read(min(remaining, 1 << 20))
+                            if not chunk:
+                                break
+                            spool.write(chunk)
+                            remaining -= len(chunk)
+                        spool.seek(0)
+                        with spool:
+                            result = fn(self._query_params(), body=spool,
+                                        **match.groupdict())
+                    else:
+                        result = fn(self._params(), **match.groupdict())
+                    if isinstance(result, tuple) and len(result) == 2 \
+                            and isinstance(result[1], (bytes, bytearray)):
+                        self._send_bytes(200, result[0], bytes(result[1]))
+                    else:
+                        self._send(200,
+                                   result if result is not None else {})
                 except H2OError as e:
-                    self._send(e.status, {
-                        "__meta": {"schema_type": "H2OError"},
-                        "error_url": path, "msg": e.msg,
-                        "dev_msg": e.msg, "http_status": e.status,
-                        "exception_msg": e.msg, "values": {}})
+                    self._send(e.status, self._error_json(
+                        path, e.status, e.msg, e.msg,
+                        "water.exceptions.H2OIllegalArgumentException"))
                 except Exception as e:  # noqa: BLE001 — REST surface
                     log.error("handler error on %s: %s\n%s", path, e,
                               traceback.format_exc())
-                    self._send(500, {
-                        "__meta": {"schema_type": "H2OError"},
-                        "msg": str(e), "dev_msg": traceback.format_exc(),
-                        "http_status": 500, "exception_msg": str(e),
-                        "values": {}})
+                    self._send(500, self._error_json(
+                        path, 500, str(e), traceback.format_exc(),
+                        type(e).__name__))
                 return
-        self._send(404, {"msg": f"no route for {method} {path}",
-                         "http_status": 404})
+        self._send(404, self._error_json(path, 404,
+                                         f"no route for {method} {path}",
+                                         f"no route for {method} {path}"))
 
     def _send(self, status: int, payload: dict):
-        blob = json.dumps(payload, allow_nan=True).encode()
+        self._send_bytes(status, "application/json",
+                         json.dumps(_sanitize(payload)).encode())
+
+    def _send_bytes(self, status: int, ctype: str, blob: bytes):
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(blob)))
         self.end_headers()
         self.wfile.write(blob)

@@ -33,9 +33,11 @@ class JobCancelledException(Exception):
 class Job:
     """A tracked unit of async work producing a DKV-visible result."""
 
-    def __init__(self, dest: Optional[str] = None, description: str = ""):
+    def __init__(self, dest: Optional[str] = None, description: str = "",
+                 dest_type: str = "Key<Frame>"):
         self.key = Key.make("job")
         self.dest = Key(dest) if dest else Key.make("result")
+        self.dest_type = dest_type
         self.description = description
         self.status = CREATED
         self.progress = 0.0
@@ -84,8 +86,14 @@ class Job:
         """REST /3/Jobs schema-shaped summary."""
         ms = lambda t: int(t * 1000) if t else 0
         return {
-            "key": {"name": str(self.key), "type": "Key<Job>"},
-            "dest": {"name": str(self.dest), "type": "Key"},
+            "__meta": {"schema_version": 3, "schema_name": "JobV3",
+                       "schema_type": "Job"},
+            "key": {"name": str(self.key), "type": "Key<Job>",
+                    "URL": f"/3/Jobs/{self.key}"},
+            "dest": {"name": str(self.dest), "type": self.dest_type,
+                     "URL": f"/3/Models/{self.dest}"
+                     if "Model" in self.dest_type
+                     else f"/3/Frames/{self.dest}"},
             "description": self.description,
             "status": self.status,
             "progress": self.progress,
@@ -93,7 +101,11 @@ class Job:
             "start_time": ms(self.start_time),
             "msec": ms((self.end_time or time.time()) - self.start_time)
             if self.start_time else 0,
+            "warnings": [],
             "exception": repr(self.exception) if self.exception else None,
+            "stacktrace": None,
+            "ready_for_view": self.status == "DONE",
+            "auto_recoverable": False,
         }
 
 

@@ -31,8 +31,11 @@ def _binomial_kernel(p, y, w, valid, nbins: int = _NBINS_AUC):
     y = jnp.where(valid, y, 0.0)
     p = jnp.where(valid, p, 0.5)   # NaN-proof padded rows (0*NaN = NaN)
     wsum = jnp.maximum(jnp.sum(w), EPS)
-    pc = jnp.clip(p, EPS, 1 - EPS)
-    logloss = jnp.sum(-w * (y * jnp.log(pc) + (1 - y) * jnp.log(1 - pc)))
+    # where-form, not y*log(p)+(1-y)*log(1-p): p can round to exactly 0/1
+    # in f32 and 0*log(0) would poison the sum with NaN
+    logloss = jnp.sum(-w * jnp.where(y > 0.5,
+                                     jnp.log(jnp.maximum(p, EPS)),
+                                     jnp.log(jnp.maximum(1.0 - p, EPS))))
     mse = jnp.sum(w * (y - p) ** 2)
     b = jnp.clip((p * nbins).astype(jnp.int32), 0, nbins - 1)
     pos = jnp.zeros((nbins,), jnp.float32).at[b].add(w * y)

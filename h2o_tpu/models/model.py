@@ -320,7 +320,11 @@ class ModelBuilder:
         x = [c for c in (x or training_frame.names)
              if c != y and c not in ignored]
         t0 = time.time()
-        job = Job(dest=self.model_id or Key.make(self.algo),
+        # pin the model key now so the job's dest and the stored model agree
+        # (clients fetch GET /3/Models/{job.dest} after polling)
+        if not self.model_id:
+            self.model_id = str(Key.make(self.algo))
+        job = Job(dest=self.model_id, dest_type="Key<Model>",
                   description=f"{self.algo} on {training_frame.key}")
         use_cv = self.supports_cv and (
             int(self.params.get("nfolds") or 0) > 1 or

@@ -30,6 +30,20 @@ from h2o_tpu.core.frame import Frame, T_CAT, T_NUM, T_STR, Vec
 # ---------------------------------------------------------------------------
 
 _TOKEN = re.compile(r"""\s*(,|\(|\)|\[|\]|"[^"]*"|'[^']*'|[^\s(),\[\]]+)""")
+_SPAN = re.compile(r"^(-?\d+(?:\.\d+)?):(\d+(?:\.\d+)?)"
+                   r"(?::(-?\d+(?:\.\d+)?))?$")
+
+
+def _expand_numlist(lst) -> List[int]:
+    """Flatten numlist entries (plain numbers + spans) to int indices."""
+    out: List[int] = []
+    for x in lst:
+        if isinstance(x, tuple) and x[0] == "span":
+            _, lo, cnt, stride = x
+            out.extend(int(lo + i * stride) for i in range(int(cnt)))
+        else:
+            out.append(int(x if isinstance(x, float) else _lit(x)))
+    return out
 
 
 def _tokenize(s: str) -> List[str]:
@@ -64,6 +78,13 @@ def _parse(tokens: List[str], pos: int = 0):
         return ("numlist", lst), pos + 1
     if t[0] in "\"'":
         return ("str", t[1:-1]), pos + 1
+    m = _SPAN.match(t)
+    if m:
+        # AstNumList span `lo:cnt[:stride]` (e.g. head() sends [0:5]):
+        # expands to lo, lo+stride, ... cnt entries
+        lo, cnt, stride = (float(m.group(1)), float(m.group(2)),
+                           float(m.group(3) or 1))
+        return ("span", lo, cnt, stride), pos + 1
     try:
         return float(t), pos + 1
     except ValueError:
@@ -156,10 +177,11 @@ def _reduce_all(op_masked, fr: Frame):
 
 def _col_indices(fr: Frame, sel) -> List[int]:
     if isinstance(sel, tuple) and sel[0] == "numlist":
-        out = []
-        for x in sel[1]:
-            out.append(int(x if isinstance(x, float) else _lit(x)))
-        return out
+        try:
+            return _expand_numlist(sel[1])
+        except (TypeError, ValueError):
+            # string names in the list
+            return [fr.names.index(_lit(x)) for x in sel[1]]
     if isinstance(sel, tuple) and sel[0] == "str":
         return [fr.names.index(sel[1])]
     if isinstance(sel, float):
@@ -178,10 +200,9 @@ def _row_select(fr: Frame, sel, sess) -> Frame:
         mask = np.asarray(sel.vecs[0].data)[: fr.nrows] > 0
         idx = np.nonzero(mask)[0]
     elif isinstance(sel, tuple) and sel[0] == "numlist":
-        lst = sel[1]
-        # [start:count] is encoded as (: start count) pairs by clients; a
-        # plain list is row indices
-        idx = np.asarray([int(x) for x in lst], np.int64)
+        idx = np.asarray(_expand_numlist(sel[1]), np.int64)
+    elif isinstance(sel, tuple) and sel[0] == "span":
+        idx = np.asarray(_expand_numlist([sel]), np.int64)
     else:
         idx = np.asarray([int(sel)], np.int64)
     vecs = []
@@ -339,7 +360,7 @@ def _eval(node, env: _Env):
         bv = b.vecs[0].as_float() if isinstance(b, Frame) else b
         return Frame(["ifelse"],
                      [Vec(jnp.where(cv != 0, av, bv), nrows=cf.nrows)])
-    if op == "asfactor":
+    if op in ("asfactor", "as.factor"):
         fr = _as_frame(_eval(node[1], env))
         out = []
         for v in fr.vecs:
@@ -878,16 +899,21 @@ def _update(node, env):
                        domain=list(old_vec.domain))
         else:
             newv = Vec(np.full(fr.nrows, float(rhs), np.float32))
-        if row_sel is not None and not (
-                isinstance(row_sel, tuple) and row_sel[1] == "all"):
+        all_rows = row_sel is None or (
+            isinstance(row_sel, tuple) and
+            (row_sel[1] == "all" or row_sel[1] == []))  # [] = every row
+        if not all_rows:
             sel = _eval(row_sel, env) if isinstance(row_sel, list) \
                 else row_sel
             old = old_vec.to_numpy().astype(np.float64)
             if isinstance(sel, Frame):
                 mask = np.asarray(sel.vecs[0].data)[: fr.nrows] > 0
             else:
-                idx = [int(x) for x in sel[1]] if isinstance(sel, tuple) \
-                    else [int(sel)]
+                if isinstance(sel, tuple):
+                    idx = _expand_numlist(
+                        sel[1] if sel[0] == "numlist" else [sel])
+                else:
+                    idx = [int(sel)]
                 mask = np.zeros(fr.nrows, bool)
                 mask[idx] = True
             nv = np.asarray(newv.to_numpy(), np.float64)

@@ -1,0 +1,121 @@
+"""Unmodified h2o-py client attach — the explicit compatibility bar.
+
+SURVEY §7 / BASELINE north star: *unmodified* Python clients attach via
+``h2o.connect()`` and drive the cluster over REST v3 exactly as they drive a
+JVM-backed H2O node (reference client: h2o-py/h2o/backend/connection.py,
+h2o-py/h2o/h2o.py).  The reference client source tree is used as the test
+client, unmodified, straight off sys.path.
+
+Covers: connect handshake (Metadata/schemas bootstrap + /3/Cloud), file
+upload (PostFile) -> ParseSetup -> Parse -> job poll -> frame fill, rapids
+(asfactor / := / head spans), GBM + GLM train via /3/ModelBuilders, v4
+Predictions job, ModelMetrics scoring, get_model / get_frame, and frame
+removal.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+_H2O_PY = "/root/reference/h2o-py"
+
+pytestmark = pytest.mark.skipif(not os.path.isdir(_H2O_PY),
+                                reason="reference h2o-py client not present")
+
+
+@pytest.fixture(scope="module")
+def h2o_client(cl, tmp_path_factory):
+    """A live REST server + the stock h2o-py client connected to it."""
+    from h2o_tpu.api.server import RestServer
+    srv = RestServer(port=0).start()
+    if _H2O_PY not in sys.path:
+        sys.path.insert(0, _H2O_PY)
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # reference tree has SyntaxWarnings
+        import h2o
+    h2o.connect(url=f"http://127.0.0.1:{srv.port}", verbose=False,
+                strict_version_check=False)
+    yield h2o
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def uploaded(h2o_client, tmp_path_factory):
+    h2o = h2o_client
+    rng = np.random.default_rng(7)
+    n = 300
+    csv = tmp_path_factory.mktemp("attach") / "train.csv"
+    a, b = rng.normal(size=n), rng.normal(size=n)
+    y = (a + 0.5 * b + rng.normal(size=n) * 0.3 > 0).astype(int)
+    with open(csv, "w") as f:
+        f.write("a,b,c,y\n")
+        for i in range(n):
+            f.write(f"{a[i]:.5f},{b[i]:.5f},"
+                    f"{'red' if i % 3 else 'blue'},{y[i]}\n")
+    fr = h2o.upload_file(str(csv))
+    fr["y"] = fr["y"].asfactor()
+    return fr
+
+
+def test_connect_cluster_status(h2o_client):
+    h2o = h2o_client
+    cl_info = h2o.cluster()
+    assert cl_info.cloud_healthy
+    assert cl_info.consensus
+    assert int(cl_info.cloud_size) >= 1
+
+
+def test_upload_and_frame_fill(h2o_client, uploaded):
+    fr = uploaded
+    assert fr.dim == [300, 4]
+    assert fr.names == ["a", "b", "c", "y"]
+    assert fr.types["c"] == "enum"
+    assert fr.types["y"] == "enum"
+
+
+def test_head_and_rapids_spans(h2o_client, uploaded):
+    hd = uploaded.head(5)
+    assert hd.dim == [5, 4]
+
+
+def test_gbm_train_predict_perf(h2o_client, uploaded):
+    h2o = h2o_client
+    from h2o.estimators import H2OGradientBoostingEstimator
+    gbm = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=42)
+    gbm.train(x=["a", "b", "c"], y="y", training_frame=uploaded)
+    assert gbm.model_id
+
+    pred = gbm.predict(uploaded)
+    assert pred.dim == [300, 3]          # predict, p0, p1
+    assert pred.names[0] == "predict"
+
+    perf = gbm.model_performance(uploaded)
+    auc = perf.auc()
+    assert 0.5 < auc <= 1.0
+
+    again = h2o.get_model(gbm.model_id)
+    assert again.model_id == gbm.model_id
+
+
+def test_glm_train_via_rest(h2o_client, uploaded):
+    from h2o.estimators import H2OGeneralizedLinearEstimator
+    glm = H2OGeneralizedLinearEstimator(family="binomial")
+    glm.train(x=["a", "b"], y="y", training_frame=uploaded)
+    perf = glm.model_performance(uploaded)
+    assert 0.5 < perf.auc() <= 1.0
+
+
+def test_frame_remove(h2o_client):
+    h2o = h2o_client
+    fr = h2o.H2OFrame({"x": [1.0, 2.0, 3.0]})
+    key = fr.frame_id
+    h2o.remove(fr)
+    from h2o.exceptions import H2OResponseError, H2OServerError
+    try:
+        gone = h2o.get_frame(key)
+    except (H2OResponseError, H2OServerError, KeyError):
+        gone = None
+    assert gone is None
