@@ -211,12 +211,9 @@ def import_files(params):
 
 @route("POST", r"/3/ParseSetup")
 def parse_setup_route(params):
-    src = params.get("source_frames")
-    if isinstance(src, str):
-        src = json.loads(src.replace("'", '"')) if src.startswith("[") \
-            else [src]
+    src = _json_list(params.get("source_frames"))
     paths = [cloud().dkv.get(s) or s.replace("nfs://", "") for s in src]
-    setup = parse_setup(paths)
+    setup = parse_setup(paths, force_header=_header_directive(params))
     d = setup.to_dict()
     d.update({
         "__meta": {"schema_version": 3, "schema_name": "ParseSetupV3",
@@ -240,19 +237,58 @@ def parse_setup_route(params):
     return d
 
 
+_H2O_COLTYPES = {"numeric": "real", "enum": "enum", "string": "string",
+                 "time": "time", "uuid": "uuid", "int": "real",
+                 "real": "real", "double": "real", "float": "real",
+                 "long": "real", "categorical": "enum", "factor": "enum"}
+
+
+def _json_list(v):
+    if isinstance(v, str):
+        return json.loads(v.replace("'", '"')) if v.startswith("[") else [v]
+    return v
+
+
+def _header_directive(params):
+    """check_header: 1 = first line is header, -1 = data, 0/None = guess."""
+    ch = params.get("check_header")
+    if ch is None:
+        return None
+    ch = int(ch)
+    return True if ch == 1 else False if ch == -1 else None
+
+
 @route("POST", r"/3/Parse")
 def parse_route(params):
-    src = params.get("source_frames")
-    if isinstance(src, str):
-        src = json.loads(src.replace("'", '"')) if src.startswith("[") \
-            else [src]
+    src = _json_list(params.get("source_frames"))
     paths = [cloud().dkv.get(s) or s.replace("nfs://", "") for s in src]
     dest = params.get("destination_frame") or \
         os.path.basename(paths[0]) + ".hex"
     job = Job(dest=dest, description=f"Parse {paths}")
 
+    # client-side overrides (h2o-py _parse_raw re-sends the possibly-edited
+    # setup: column names/types, header directive, separator)
+    setup = parse_setup(paths, force_header=_header_directive(params))
+    if params.get("separator"):
+        setup.separator = chr(int(params["separator"]))
+    if params.get("column_names"):
+        names = [str(n) for n in _json_list(params["column_names"])]
+        if len(names) != len(setup.column_names):
+            raise H2OError(400, f"column_names has {len(names)} entries, "
+                                f"file has {len(setup.column_names)} "
+                                "columns")
+        setup.column_names = names
+    if params.get("column_types"):
+        types = [_H2O_COLTYPES.get(str(t).lower(), "real")
+                 for t in _json_list(params["column_types"])]
+        if len(types) != len(setup.column_types):
+            raise H2OError(400, f"column_types has {len(types)} entries, "
+                                f"file has {len(setup.column_types)} "
+                                "columns")
+        setup.column_types = types
+
     def body(j):
-        fr = parse_files(paths, dest=dest)
+        fr = parse_files(paths, setup=setup, dest=dest)
         cloud().dkv.put(dest, fr)
         return fr
 
@@ -279,8 +315,12 @@ def _frame_schema(fr: Frame, rows: int = 10, column_offset: int = 0,
         # the whole sharded column to host
         head = (np.asarray(v.data[:n_head]) if v.data is not None
                 else np.asarray(v.host_data[:n_head], dtype=object))
+        string_data = []
         if v.is_categorical:
             data = [None if x < 0 else int(x) for x in head]
+        elif v.data is None:          # string/uuid columns live host-side
+            data = []
+            string_data = [None if x is None else str(x) for x in head]
         else:
             data = [None if (isinstance(x, float) and np.isnan(x))
                     else float(x) for x in head.astype(float)]
@@ -302,7 +342,7 @@ def _frame_schema(fr: Frame, rows: int = 10, column_offset: int = 0,
             "mean": float(r.mean) if r else None,
             "sigma": float(r.sigma) if r else None,
             "domain": v.domain, "domain_cardinality": v.cardinality,
-            "data": data, "string_data": [], "precision": -1,
+            "data": data, "string_data": string_data, "precision": -1,
             "histogram_bins": r.hist.tolist() if r else [],
             "histogram_base": float(r.min) if r else 0,
             "histogram_stride": float((r.max - r.min) / max(len(r.hist), 1))
@@ -731,3 +771,8 @@ def frame_load(params):
     cloud().dkv.put(fr.key, fr)
     return {"frame_id": str(fr.key), "rows": fr.nrows,
             "columns": fr.ncols}
+
+
+# v99 ML orchestration routes (Grid / AutoML / Leaderboards) live in their
+# own module; importing registers them on the shared route table.
+from h2o_tpu.api import handlers_ml  # noqa: E402,F401

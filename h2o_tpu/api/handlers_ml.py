@@ -1,0 +1,302 @@
+"""REST v99 surface: Grid search, AutoML, Leaderboards.
+
+Reference: water/api/GridSearchHandler.java (POST /99/Grid/{algo} semantics:
+flat builder params + ``hyper_parameters`` JSON + ``search_criteria`` JSON),
+h2o-automl REST registration (POST /99/AutoMLBuilder with
+build_control/build_models/input_spec, GET /99/AutoML/{id},
+GET /99/Leaderboards/{project}).  The driving clients are h2o-py's
+H2OGridSearch (grid/grid_search.py:412-424) and H2OAutoML
+(automl/_estimator.py:671, automl/_base.py:313-332) — unmodified.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from h2o_tpu.core.cloud import cloud
+from h2o_tpu.core.frame import Frame
+from h2o_tpu.api.server import H2OError, route
+
+# handlers.py owns the generic helpers; imported lazily to avoid a cycle at
+# module load (server imports handlers which imports this module last).
+
+
+def _h():
+    from h2o_tpu.api import handlers
+    return handlers
+
+
+def twodim(name: str, col_header: List[str], col_types: List[str],
+           rows: List[List], description: str = "") -> dict:
+    """TwoDimTableV3 JSON (client parse: h2o-py/h2o/two_dim_table.py:46-62
+    reads columns[].name/type + column-major ``data``)."""
+    ncol = len(col_header)
+    data = [[r[j] for r in rows] for j in range(ncol)]
+    return {
+        "__meta": {"schema_version": 3, "schema_name": "TwoDimTableV3",
+                   "schema_type": "TwoDimTable"},
+        "name": name, "description": description,
+        "columns": [{"__meta": {"schema_version": -1,
+                                "schema_name": "ColumnSpecsBase",
+                                "schema_type": "Iced"},
+                     "name": n, "type": t, "format": "%s", "description": n}
+                    for n, t in zip(col_header, col_types)],
+        "rowcount": len(rows),
+        "data": data,
+    }
+
+
+def _parse_json_param(params: Dict, key: str) -> Dict:
+    v = params.get(key)
+    if not v:
+        return {}
+    if isinstance(v, dict):
+        return v
+    try:
+        return json.loads(v)
+    except json.JSONDecodeError:
+        raise H2OError(400, f"bad JSON in {key}: {v!r}")
+
+
+def _frame_or_404(key: Optional[str], what: str,
+                  required: bool = True) -> Optional[Frame]:
+    if not key:
+        if required:
+            raise H2OError(400, f"{what} is required")
+        return None
+    fr = cloud().dkv.get(key)
+    if not isinstance(fr, Frame):
+        raise H2OError(404, f"{what} {key} not found")
+    return fr
+
+
+# ---------------------------------------------------------------------------
+# Grid search
+# ---------------------------------------------------------------------------
+
+_GRID_META_PARAMS = ("training_frame", "validation_frame", "model_id",
+                     "response_column", "ignored_columns",
+                     "hyper_parameters", "search_criteria", "grid_id",
+                     "parallelism", "export_checkpoints_dir",
+                     "recovery_dir")
+
+
+@route("POST", r"/99/Grid/(?P<algo>[^/]+)")
+def grid_build(params, algo):
+    """GridSearchHandler.handle: launch an async hyper-space walk."""
+    from h2o_tpu.models.registry import builder_class
+    from h2o_tpu.models.grid import GridSearch
+    h = _h()
+    try:
+        cls = builder_class(algo)
+    except KeyError:
+        raise H2OError(404, f"unknown algorithm {algo}")
+    fr = _frame_or_404(params.get("training_frame"), "training_frame")
+    valid = _frame_or_404(params.get("validation_frame"),
+                          "validation_frame", required=False)
+    hyper = _parse_json_param(params, "hyper_parameters")
+    if not hyper:
+        raise H2OError(400, "hyper_parameters is required")
+    criteria = _parse_json_param(params, "search_criteria")
+
+    proto = cls()
+    aliases = {"lambda": "lambda_"}
+    base = {}
+    for k, v in params.items():
+        if k in _GRID_META_PARAMS:
+            continue
+        k = aliases.get(k, k)
+        if k in proto.params:
+            base[k] = h._coerce(v, proto.params[k])
+    unknown = [k for k in hyper if aliases.get(k, k) not in proto.params]
+    if unknown:
+        raise H2OError(400, f"unknown hyper-parameters for {algo}: "
+                            f"{sorted(unknown)}")
+    hyper = {aliases.get(k, k): list(v) for k, v in hyper.items()}
+
+    y = params.get("response_column")
+    x = None
+    if params.get("ignored_columns"):
+        ign = h._coerce(params["ignored_columns"], [])
+        x = [c for c in fr.names if c not in ign and c != y]
+
+    gs = GridSearch(cls, hyper, search_criteria=criteria,
+                    grid_id=params.get("grid_id"), **base)
+    job = gs.train_async(x=x, y=y, training_frame=fr,
+                         validation_frame=valid)
+    return {"job": job.to_dict()}
+
+
+def _grid_json(grid, sort_by: Optional[str] = None,
+               decreasing: Optional[bool] = None) -> dict:
+    models = grid.sorted_models(sort_by, decreasing) if sort_by \
+        else grid.sorted_models()
+    metric = sort_by or grid.sort_metric or "mse"
+    from h2o_tpu.models.grid import _model_sort_metric
+    rows = []
+    for m in models:
+        hv = grid.hyper_values[grid.models.index(m)]
+        rows.append([str(hv.get(k)) for k in grid.hyper_names]
+                    + [str(m.key), float(_model_sort_metric(m, metric))])
+    return {
+        "__meta": {"schema_version": 99, "schema_name": "GridSchemaV99",
+                   "schema_type": "Grid"},
+        "grid_id": {"name": str(grid.key), "type": "Key<Grid>",
+                    "URL": None},
+        "model_ids": [{"name": str(m.key), "type": "Key<Model>",
+                       "URL": None} for m in models],
+        "hyper_names": list(grid.hyper_names),
+        "failed_params": [f.get("params") for f in grid.failures],
+        "failure_details": [f.get("error", "") for f in grid.failures],
+        "failure_stack_traces": [f.get("stacktrace", f.get("error", ""))
+                                 for f in grid.failures],
+        "warning_details": [],
+        "export_checkpoints_dir": None,
+        "sort_metric": metric,
+        "summary_table": twodim(
+            "Hyper-Parameter Search Summary",
+            list(grid.hyper_names) + ["model_ids", metric],
+            ["string"] * len(grid.hyper_names) + ["string", "double"],
+            rows),
+    }
+
+
+@route("GET", r"/99/Grids")
+def list_grids(params):
+    from h2o_tpu.models.grid import Grid
+    dkv = cloud().dkv
+    grids = [v for k in dkv.keys()
+             if isinstance((v := dkv.get(k)), Grid)]
+    return {"grids": [_grid_json(g) for g in grids]}
+
+
+@route("GET", r"/99/Grids/(?P<grid_id>[^/]+)")
+def get_grid(params, grid_id):
+    from h2o_tpu.models.grid import Grid
+    g = cloud().dkv.get(grid_id)
+    if not isinstance(g, Grid):
+        raise H2OError(404, f"grid {grid_id} not found")
+    dec = params.get("decreasing")
+    return _grid_json(g, sort_by=params.get("sort_by"),
+                      decreasing=None if dec is None
+                      else str(dec).lower() == "true")
+
+
+@route("GET", r"/99/Models/(?P<model_id>[^/]+)")
+def get_model_v99(params, model_id):
+    return _h().get_model(params, model_id)
+
+
+# ---------------------------------------------------------------------------
+# AutoML
+# ---------------------------------------------------------------------------
+
+def _automl_or_404(aml_id: str):
+    from h2o_tpu.automl.automl import AutoML
+    a = cloud().dkv.get(aml_id)
+    if a is None:
+        a = cloud().dkv.get(f"automl_{aml_id}")
+    if not isinstance(a, AutoML):
+        raise H2OError(404, f"AutoML {aml_id} not found")
+    return a
+
+
+@route("POST", r"/99/AutoMLBuilder")
+def automl_build(params):
+    """AutoMLBuildSpec: build_control + build_models + input_spec
+    (ai/h2o/automl/AutoMLBuildSpec.java); launched async."""
+    from h2o_tpu.automl.automl import AutoML
+    bc = params.get("build_control") or {}
+    bm = params.get("build_models") or {}
+    ins = params.get("input_spec") or {}
+    sc = bc.get("stopping_criteria") or {}
+
+    fr = _frame_or_404(ins.get("training_frame"), "training_frame")
+    valid = _frame_or_404(ins.get("validation_frame"),
+                          "validation_frame", required=False)
+    lb_fr = _frame_or_404(ins.get("leaderboard_frame"),
+                          "leaderboard_frame", required=False)
+    y = ins.get("response_column")
+    if isinstance(y, dict):
+        y = y.get("column_name") or y.get("name")
+    if not y:
+        raise H2OError(400, "response_column is required")
+    x = None
+    if ins.get("ignored_columns"):
+        ign = [str(c).strip('"') for c in ins["ignored_columns"]]
+        x = [c for c in fr.names if c not in ign and c != y]
+
+    aml = AutoML(
+        max_models=int(sc.get("max_models") or 0),
+        max_runtime_secs=float(sc.get("max_runtime_secs") or 0.0),
+        seed=int(sc["seed"]) if sc.get("seed") is not None else -1,
+        nfolds=int(bc.get("nfolds", 5)),
+        include_algos=bm.get("include_algos"),
+        exclude_algos=bm.get("exclude_algos"),
+        stopping_rounds=int(sc.get("stopping_rounds", 3)),
+        stopping_metric=sc.get("stopping_metric", "AUTO"),
+        stopping_tolerance=float(sc.get("stopping_tolerance", -1.0)),
+        sort_metric=ins.get("sort_metric"),
+        project_name=bc.get("project_name") or "")
+    job = aml.train_async(x=x, y=y, training_frame=fr,
+                          validation_frame=valid, leaderboard_frame=lb_fr)
+    return {"job": job.to_dict(),
+            "build_control": {"project_name": aml.project_name},
+            "build_models": bm, "input_spec": ins}
+
+
+_LB_METRIC_TYPES = {"model_id": "string", "algo": "string",
+                    "training_time_ms": "long"}
+
+
+def _leaderboard_table(lb) -> dict:
+    rows = lb.rows()
+    if not rows:
+        return twodim("Leaderboard", ["model_id"], ["string"], [])
+    cols = list(rows[0].keys())
+    types = [_LB_METRIC_TYPES.get(c, "double") for c in cols]
+    data = [[r.get(c) for c in cols] for r in rows]
+    return twodim(f"Leaderboard for {lb.project_name}", cols, types, data)
+
+
+def _event_log_table(ev) -> dict:
+    # name/value carry training_info entries the client extracts with
+    # el[el['name'] != '', ['name','value']] (automl/_estimator.py:720)
+    rows = [[time.strftime("%H:%M:%S", time.localtime(e["timestamp"])),
+             e["level"], e["stage"], e["message"],
+             e.get("name", ""), e.get("value", "")] for e in ev.events]
+    return twodim("Event Log",
+                  ["timestamp", "level", "stage", "message",
+                   "name", "value"],
+                  ["string"] * 6, rows)
+
+
+@route("GET", r"/99/AutoML/(?P<aml_id>[^/]+)")
+def automl_state(params, aml_id):
+    a = _automl_or_404(aml_id)
+    lb = a.leaderboard
+    return {
+        "__meta": {"schema_version": 99, "schema_name": "AutoMLV99",
+                   "schema_type": "AutoML"},
+        "automl_id": {"name": str(a.key), "type": "Key<AutoML>",
+                      "URL": None},
+        "project_name": a.project_name,
+        "leaderboard": {"models": [{"name": str(m.key),
+                                    "type": "Key<Model>", "URL": None}
+                                   for m in lb.sorted_models()]},
+        "leaderboard_table": _leaderboard_table(lb),
+        "event_log": {"events": a.event_log.to_dict()},
+        "event_log_table": _event_log_table(a.event_log),
+        "training_info": {"start_epoch": 0, "duration_secs": 0},
+    }
+
+
+@route("GET", r"/99/Leaderboards/(?P<project>[^/]+)")
+def leaderboard_route(params, project):
+    a = _automl_or_404(project)
+    return {"project_name": a.project_name,
+            "table": _leaderboard_table(a.leaderboard)}

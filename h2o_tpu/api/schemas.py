@@ -187,3 +187,27 @@ register_schema("ModelSchemaV3", "SchemaV3", [
     ("compatible_frames", "string[]", "Compatible frames"),
     ("checksum", "long", "Checksum"),
 ])
+
+
+register_schema("GridSchemaV99", "SchemaV3", [
+    ("grid_id", "KeyV3", "Grid key"),
+    ("model_ids", "KeyV3[]", "Model keys, sorted by sort_metric"),
+    ("hyper_names", "string[]", "Searched hyper-parameter names"),
+    ("failed_params", "Map[]", "Failed hyper combos"),
+    ("failure_details", "string[]", "Failure messages"),
+    ("failure_stack_traces", "string[]", "Failure stack traces"),
+    ("warning_details", "string[]", "Warnings"),
+    ("sort_metric", "string", "Ranking metric"),
+    ("summary_table", "TwoDimTableV3", "Search summary"),
+    ("export_checkpoints_dir", "string", "Checkpoint export dir"),
+], version=99)
+
+register_schema("AutoMLV99", "SchemaV3", [
+    ("automl_id", "KeyV3", "AutoML key"),
+    ("project_name", "string", "Project name"),
+    ("leaderboard", "Iced", "Ranked model keys"),
+    ("leaderboard_table", "TwoDimTableV3", "Leaderboard table"),
+    ("event_log", "Iced", "Event log"),
+    ("event_log_table", "TwoDimTableV3", "Event log table"),
+    ("training_info", "Map", "Training telemetry"),
+], version=99)

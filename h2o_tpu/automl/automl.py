@@ -125,16 +125,25 @@ class AutoML:
     def leader(self):
         return self.leaderboard.leader
 
-    def train(self, x=None, y=None, training_frame=None,
-              validation_frame=None, leaderboard_frame=None):
-        job = Job(dest=self.key,
+    def train_async(self, x=None, y=None, training_frame=None,
+                    validation_frame=None, leaderboard_frame=None) -> Job:
+        # DKV-visible up front (keyed by project name — the id clients use
+        # for GET /99/AutoML/{id} and /99/Leaderboards/{id} mid-run)
+        job = Job(dest=self.project_name, dest_type="Key<AutoML>",
                   description=f"AutoML {self.project_name}")
         self._job = job
+        cloud().dkv.put(self.project_name, self)
+        cloud().dkv.put(self.key, self)
         cloud().jobs.start(
             job, lambda j: self._run(j, x, y, training_frame,
                                      validation_frame, leaderboard_frame))
-        job.join()
-        cloud().dkv.put(self.key, self)
+        return job
+
+    def train(self, x=None, y=None, training_frame=None,
+              validation_frame=None, leaderboard_frame=None):
+        self.train_async(x=x, y=y, training_frame=training_frame,
+                         validation_frame=validation_frame,
+                         leaderboard_frame=leaderboard_frame).join()
         return self
 
     # -- plan execution -----------------------------------------------------

@@ -92,9 +92,12 @@ def _cell_type(tok: str) -> str:
     return T_CAT
 
 
-def parse_setup(paths: Sequence[str], sample_lines: int = 200
-                ) -> ParseSetupResult:
-    """Type/separator/header inference from a sample of the first file."""
+def parse_setup(paths: Sequence[str], sample_lines: int = 200,
+                force_header: Optional[bool] = None) -> ParseSetupResult:
+    """Type/separator/header inference from a sample of the first file.
+
+    ``force_header`` overrides detection (the REST check_header directive:
+    1 = first line is a header, -1 = first line is data)."""
     with _open(paths[0]) as f:
         lines = []
         for _ in range(sample_lines):
@@ -112,14 +115,19 @@ def parse_setup(paths: Sequence[str], sample_lines: int = 200
     body_types = [[_cell_type(r[j]) for r in rest if len(r) == ncols]
                   for j in range(ncols)]
     first_types = [_cell_type(c) for c in first]
-    has_header = (any(t == T_CAT for t in first_types) and all(
-        t in (T_CAT, "na") for t in first_types) and any(
-        T_NUM in col for col in body_types))
+    if force_header is not None:
+        has_header = force_header
+    else:
+        has_header = (any(t == T_CAT for t in first_types) and all(
+            t in (T_CAT, "na") for t in first_types) and any(
+            T_NUM in col for col in body_types))
     names = ([c.strip().strip('"') for c in first] if has_header
              else [f"C{j+1}" for j in range(ncols)])
     types = []
     for j in range(ncols):
-        col = body_types[j] if rest else [first_types[j]]
+        col = body_types[j] if has_header else \
+            [first_types[j]] + body_types[j]
+        col = col or [first_types[j]]
         nonna = [t for t in col if t != "na"]
         if not nonna:
             types.append(T_NUM)

@@ -131,15 +131,26 @@ class GridSearch:
 
     # -- search ------------------------------------------------------------
 
-    def train(self, x=None, y=None, training_frame=None,
-              validation_frame=None) -> Grid:
-        job = Job(dest=self.grid_id,
+    def train_async(self, x=None, y=None, training_frame=None,
+                    validation_frame=None) -> Job:
+        # DKV-visible before any model trains, so clients can poll
+        # GET /99/Grids/{id} mid-run and cancelled runs keep their models
+        if cloud().dkv.get(self.grid_id) is None:
+            cloud().dkv.put(self.grid_id,
+                            Grid(self.grid_id, self.builder_cls.algo,
+                                 list(self.hyper_params)))
+        job = Job(dest=self.grid_id, dest_type="Key<Grid>",
                   description=f"grid {self.grid_id} over "
                               f"{list(self.hyper_params)}")
         cloud().jobs.start(
             job, lambda j: self._run(j, x, y, training_frame,
                                      validation_frame))
-        return job.join()
+        return job
+
+    def train(self, x=None, y=None, training_frame=None,
+              validation_frame=None) -> Grid:
+        return self.train_async(x=x, y=y, training_frame=training_frame,
+                                validation_frame=validation_frame).join()
 
     def _run(self, job: Job, x, y, train, valid) -> Grid:
         grid = cloud().dkv.get(self.grid_id)

@@ -134,6 +134,36 @@ def _as_frame(v) -> Frame:
     raise TypeError(f"expected frame, got {type(v)}")
 
 
+def _string_compare(op, a, b):
+    """==/!= against a string literal over str/categorical columns (the
+    reference compares level names, not codes — AstEq string semantics).
+    Returns None when not a string comparison."""
+    lit = None
+    fr = None
+    if isinstance(a, Frame) and isinstance(b, str):
+        fr, lit = a, b
+    elif isinstance(b, Frame) and isinstance(a, str):
+        fr, lit = b, a
+    elif isinstance(a, Frame) and any(v.type == T_STR for v in a.vecs) \
+            and isinstance(b, (int, float)):
+        fr, lit = a, str(b)
+    if fr is None or not any(v.type in (T_STR, T_CAT) for v in fr.vecs):
+        return None
+    vecs = []
+    for v in fr.vecs:
+        if v.type == T_STR:
+            eq = np.array([x == lit for x in v.host_data], np.float32)
+        elif v.type == T_CAT:
+            dom = v.domain or []
+            code = dom.index(lit) if lit in dom else -2
+            eq = (np.asarray(v.to_numpy())[: v.nrows] == code).astype(
+                np.float32)
+        else:
+            eq = np.zeros(v.nrows, np.float32)
+        vecs.append(Vec(eq if op == "==" else 1.0 - eq))
+    return Frame(list(fr.names), vecs)
+
+
 def _elementwise(op, a, b=None):
     """Apply a jnp op over frames/scalars, broadcasting column-wise."""
     if b is None:
@@ -319,6 +349,14 @@ def _eval(node, env: _Env):
     if op in _BINOPS:
         a = _eval(node[1], env)
         b = _eval(node[2], env)
+        if op in ("==", "!="):
+            sc = _string_compare(op,
+                                 a[1] if isinstance(a, tuple) and
+                                 a[0] == "str" else a,
+                                 b[1] if isinstance(b, tuple) and
+                                 b[0] == "str" else b)
+            if sc is not None:
+                return sc
         return _elementwise(_BINOPS[op], a, b)
     if op in _UNOPS:
         return _elementwise(_UNOPS[op], _eval(node[1], env))

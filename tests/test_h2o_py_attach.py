@@ -108,6 +108,38 @@ def test_glm_train_via_rest(h2o_client, uploaded):
     assert 0.5 < perf.auc() <= 1.0
 
 
+def test_grid_search_via_rest(h2o_client, uploaded):
+    """H2OGridSearch drives POST /99/Grid/{algo} + GET /99/Grids/{id}
+    (reference handler: water/api/GridSearchHandler.java)."""
+    from h2o.grid.grid_search import H2OGridSearch
+    from h2o.estimators import H2OGradientBoostingEstimator
+    grid = H2OGridSearch(H2OGradientBoostingEstimator(ntrees=2, seed=1),
+                         hyper_params={"max_depth": [2, 3]})
+    grid.train(x=["a", "b"], y="y", training_frame=uploaded)
+    assert len(grid.models) == 2
+    sorted_grid = grid.get_grid(sort_by="auc", decreasing=True)
+    aucs = [m.model_performance(uploaded).auc()
+            for m in sorted_grid.models]
+    assert all(a > 0.5 for a in aucs)
+
+
+def test_automl_via_rest(h2o_client, uploaded):
+    """H2OAutoML drives POST /99/AutoMLBuilder + GET /99/AutoML/{id} +
+    GET /99/Leaderboards/{project} (reference: h2o-automl REST surface)."""
+    import h2o as h2o_mod
+    from h2o.automl import H2OAutoML
+    aml = H2OAutoML(max_models=2, seed=1, project_name="attach_aml",
+                    include_algos=["GLM", "GBM"], nfolds=3)
+    aml.train(x=["a", "b"], y="y", training_frame=uploaded)
+    assert aml.leader is not None
+    lb = aml.leaderboard
+    assert lb.nrows >= 2
+    lb2 = h2o_mod.automl.get_leaderboard(aml)
+    assert lb2.nrows == lb.nrows
+    pred = aml.leader.predict(uploaded)
+    assert pred.nrows == 300
+
+
 def test_frame_remove(h2o_client):
     h2o = h2o_client
     fr = h2o.H2OFrame({"x": [1.0, 2.0, 3.0]})
