@@ -1,16 +1,24 @@
 #!/usr/bin/env python
 """Benchmark entry point (driver contract).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 
-Benchmark: GBM training throughput on a synthetic HIGGS-shaped dataset
-(28 numeric features, binary response) — the reference's north-star config
-(BASELINE.md: GBM rows/sec on HIGGS).  Throughput counts total row-scans:
-nrows * ntrees / wall_s, the convention used for H2O GBM benchmarks.
+The ladder follows BASELINE.md's config list:
+  1. GBM binomial, HIGGS-shaped 1M x 28          (rows*trees/sec)
+  2. DRF + GLM on the same 1M rows               (rows*trees/sec, rows/sec)
+  3. DeepLearning MLP                            (samples/sec)
+  4. histogram kernel MFU (the XGBoost gpu_hist -> TPU analog)
+
+Methodology (single-decision-tree-benchmark.ipynb convention: time AFTER a
+warm build): every timed number is STEADY-STATE — an identical untimed
+warm-up run first pays XLA compilation, then the timed run re-uses the
+compiled programs.  Wall-with-compile is reported alongside in detail.
 
 The reference repo publishes no absolute numbers (BASELINE.json
-published: {}), so vs_baseline is reported against the recorded result of
-the previous round when available (bench_baseline.json), else 1.0.
+published: {}), so vs_baseline compares the headline GBM throughput against
+the recorded result of the previous round (bench_baseline.json), else 1.0.
+NOTE: rounds 1-2 timed compile inside the window; from round 3 the headline
+is steady-state, so part of the jump vs prior rounds is methodology.
 """
 
 import json
@@ -20,38 +28,159 @@ import time
 
 import numpy as np
 
+# v5 lite = v5e.  Dense bf16 peak per chip; override: BENCH_PEAK_TFLOPS.
+_TPU_PEAK_BF16_TFLOPS = {
+    "TPU v4": 275.0, "TPU v5 lite": 197.0, "TPU v5e": 197.0,
+    "TPU v5": 459.0, "TPU v5p": 459.0, "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
+
+
+def _make_data(rows, cols, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(rows, cols)).astype(np.float32)
+    # HIGGS-like signal: nonlinear combination of a few features
+    logits = (1.2 * X[:, 0] - 0.8 * X[:, 1] + X[:, 2] * X[:, 3]
+              + 0.5 * np.sin(3 * X[:, 4]))
+    y = (rng.uniform(size=rows) < 1 / (1 + np.exp(-logits))).astype(np.int32)
+    return X, y
+
+
+def _frame(X, y):
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT
+    cols = X.shape[1]
+    names = [f"x{j}" for j in range(cols)] + ["y"]
+    vecs = [Vec(X[:, j]) for j in range(cols)] + \
+        [Vec(y, T_CAT, domain=["b", "s"])]
+    return Frame(names, vecs)
+
+
+def _timed_train(make_builder, fr, warmup=True):
+    """Train twice with identical shapes: run 1 compiles (untimed unless
+    warmup=False), run 2 is steady-state."""
+    wall_compile = None
+    if warmup:
+        t0 = time.time()
+        make_builder().train(y="y", training_frame=fr)
+        wall_compile = time.time() - t0
+    t0 = time.time()
+    model = make_builder().train(y="y", training_frame=fr)
+    return model, time.time() - t0, wall_compile
+
+
+def bench_gbm(fr, rows, trees, depth):
+    from h2o_tpu.models.tree.gbm import GBM
+    m, wall, wall_c = _timed_train(
+        lambda: GBM(ntrees=trees, max_depth=depth, learn_rate=0.1, seed=1,
+                    nbins=64), fr)
+    return {"value": round(rows * trees / wall, 1),
+            "unit": "rows*trees/sec", "wall_s": round(wall, 2),
+            "wall_with_compile_s": round(wall_c, 2),
+            "ntrees": trees, "max_depth": depth,
+            "train_auc": round(float(m.output["training_metrics"]["AUC"]),
+                               4)}
+
+
+def bench_drf(fr, rows, trees, depth):
+    from h2o_tpu.models.tree.drf import DRF
+    m, wall, wall_c = _timed_train(
+        lambda: DRF(ntrees=trees, max_depth=depth, seed=1, nbins=64), fr)
+    return {"value": round(rows * trees / wall, 1),
+            "unit": "rows*trees/sec", "wall_s": round(wall, 2),
+            "wall_with_compile_s": round(wall_c, 2),
+            "ntrees": trees, "max_depth": depth,
+            "train_auc": round(float(m.output["training_metrics"]["AUC"]),
+                               4)}
+
+
+def bench_glm(fr, rows):
+    from h2o_tpu.models.glm import GLM
+    m, wall, wall_c = _timed_train(
+        lambda: GLM(family="binomial", lambda_=0.0, seed=1), fr)
+    iters = int(m.output.get("iterations", 1) or 1)
+    return {"value": round(rows / wall, 1), "unit": "rows/sec",
+            "wall_s": round(wall, 2),
+            "wall_with_compile_s": round(wall_c, 2),
+            "iterations": iters,
+            "train_auc": round(float(m.output["training_metrics"]["AUC"]),
+                               4)}
+
+
+def bench_dl(fr, rows, epochs=1.0):
+    from h2o_tpu.models.deeplearning import DeepLearning
+    m, wall, wall_c = _timed_train(
+        lambda: DeepLearning(hidden=[200, 200], epochs=epochs, seed=1), fr)
+    samples = rows * epochs
+    return {"value": round(samples / wall, 1), "unit": "samples/sec",
+            "wall_s": round(wall, 2),
+            "wall_with_compile_s": round(wall_c, 2),
+            "hidden": [200, 200], "epochs": epochs}
+
+
+def bench_hist_mfu(rows, cols, nbins=64, leaves=32, reps=10):
+    """Steady-state MFU of the histogram one-hot matmul (ops/histogram.py)
+    in bf16 — the hot kernel of the XGBoost gpu_hist -> TPU path.
+
+    FLOPs counted for the MXU matmul only: (C*(B+1), R) @ (R, L*S)
+    = 2 * R * C*(B+1) * L*S per call (one-hot construction is VPU/bandwidth
+    work, excluded by standard MFU convention)."""
+    import jax
+    import jax.numpy as jnp
+    from h2o_tpu.ops.histogram import histogram_build
+
+    rng = np.random.default_rng(0)
+    bins = jnp.asarray(rng.integers(0, nbins, size=(rows, cols)),
+                       jnp.int32)
+    leaf = jnp.asarray(rng.integers(0, leaves, size=(rows,)), jnp.int32)
+    stats = jnp.asarray(rng.normal(size=(rows, 4)), jnp.float32)
+
+    def run():
+        return histogram_build(bins, leaf, stats, n_leaves=leaves,
+                               nbins=nbins, bf16=True)
+    run().block_until_ready()                      # compile
+    t0 = time.time()
+    for _ in range(reps):
+        out = run()
+    out.block_until_ready()
+    wall = (time.time() - t0) / reps
+    flops = 2.0 * rows * (cols * (nbins + 1)) * (leaves * 4)
+    achieved_tflops = flops / wall / 1e12
+    import jax as _j
+    kind = _j.devices()[0].device_kind
+    peak = float(os.environ.get(
+        "BENCH_PEAK_TFLOPS",
+        _TPU_PEAK_BF16_TFLOPS.get(kind, 0) or 0))
+    return {"value": round(achieved_tflops, 2), "unit": "TFLOP/s (bf16)",
+            "mfu": round(achieved_tflops / peak, 4) if peak else None,
+            "peak_tflops": peak or None, "device": kind,
+            "rows": rows, "cols": cols, "nbins": nbins, "leaves": leaves,
+            "kernel_ms": round(wall * 1e3, 3)}
+
 
 def main():
     rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
     cols = int(os.environ.get("BENCH_COLS", 28))
     trees = int(os.environ.get("BENCH_TREES", 20))
     depth = int(os.environ.get("BENCH_DEPTH", 5))
+    configs = os.environ.get("BENCH_CONFIG", "gbm,drf,glm,dl,hist").split(",")
 
-    rng = np.random.default_rng(0)
-    X = rng.normal(size=(rows, cols)).astype(np.float32)
-    # HIGGS-like signal: nonlinear combination of a few features
-    logits = (1.2 * X[:, 0] - 0.8 * X[:, 1] + X[:, 2] * X[:, 3]
-              + 0.5 * np.sin(3 * X[:, 4]))
-    y = (rng.uniform(size=rows) < 1 / (1 + np.exp(-logits))).astype(np.int32)
+    X, y = _make_data(rows, cols)
+    fr = _frame(X, y)
 
-    from h2o_tpu.core.frame import Frame, Vec, T_CAT
-    from h2o_tpu.models.tree.gbm import GBM
+    detail = {"rows": rows, "cols": cols}
+    if "gbm" in configs:
+        detail["gbm"] = bench_gbm(fr, rows, trees, depth)
+    if "drf" in configs:
+        detail["drf"] = bench_drf(fr, rows, trees, depth)
+    if "glm" in configs:
+        detail["glm"] = bench_glm(fr, rows)
+    if "dl" in configs:
+        detail["dl"] = bench_dl(fr, rows)
+    if "hist" in configs:
+        detail["hist_kernel"] = bench_hist_mfu(rows, cols)
 
-    names = [f"x{j}" for j in range(cols)] + ["y"]
-    vecs = [Vec(X[:, j]) for j in range(cols)] + \
-        [Vec(y, T_CAT, domain=["b", "s"])]
-    fr = Frame(names, vecs)
-
-    # warm-up: compile the full train program on a small slice shape-wise
-    # identical per-level jits are cached by (L, B, C) so the timed run below
-    # reuses them for levels it shares
-    t0 = time.time()
-    model = GBM(ntrees=trees, max_depth=depth, learn_rate=0.1, seed=1,
-                nbins=64).train(y="y", training_frame=fr)
-    wall = time.time() - t0
-
-    value = rows * trees / wall
-    auc = model.output["training_metrics"]["AUC"]
+    head = detail.get("gbm", {})
+    value = head.get("value", 0.0)
 
     base_path = os.path.join(os.path.dirname(__file__),
                              "bench_baseline.json")
@@ -59,17 +188,15 @@ def main():
     if os.path.exists(base_path):
         with open(base_path) as f:
             prev = json.load(f)
-        if prev.get("value"):
+        if prev.get("value") and value:
             vs = value / prev["value"]
 
     print(json.dumps({
-        "metric": "gbm_higgs_like_train_throughput",
-        "value": round(value, 1),
+        "metric": "gbm_higgs_like_train_throughput_steady",
+        "value": value,
         "unit": "rows*trees/sec",
         "vs_baseline": round(vs, 3),
-        "detail": {"rows": rows, "cols": cols, "ntrees": trees,
-                   "max_depth": depth, "wall_s": round(wall, 2),
-                   "train_auc": round(float(auc), 4)},
+        "detail": detail,
     }))
     return 0
 

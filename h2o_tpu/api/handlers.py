@@ -402,30 +402,46 @@ def download_dataset(params):
     fr = cloud().dkv.get(frame_id)
     if not isinstance(fr, Frame):
         raise H2OError(404, f"frame {frame_id} not found")
-    buf = iomod.StringIO()
-    w = csvmod.writer(buf, quoting=csvmod.QUOTE_MINIMAL)
-    w.writerow(fr.names)
+    # per-column raw data + formatter; string conversion happens per batch
+    # inside the generator so a multi-GB export never lives in RSS at once
+    def _fmt_host(x):
+        return "" if x is None else str(x)
+
+    def _fmt_time(x):
+        return "" if np.isnan(x) else str(int(x))
+
+    def _fmt_num(x):
+        return "" if np.isnan(x) else (
+            str(int(x)) if float(x).is_integer() else repr(float(x)))
+
     cols = []
     for v in fr.vecs:
         if v.host_data is not None:
-            cols.append([("" if x is None else str(x))
-                         for x in v.host_data[: fr.nrows]])
+            cols.append((v.host_data, _fmt_host))
         elif v.is_categorical:
             codes = np.asarray(v.to_numpy())[: fr.nrows]
             dom = v.domain or []
-            cols.append(["" if c < 0 else dom[int(c)] for c in codes])
+            cols.append((codes,
+                         lambda c, dom=dom: "" if c < 0 else dom[int(c)]))
         else:
             vals = np.asarray(v.to_numpy())[: fr.nrows]
-            if v.type == "time":
-                cols.append(["" if np.isnan(x) else str(int(x))
-                             for x in vals])
-            else:
-                cols.append(["" if np.isnan(x) else
-                             (str(int(x)) if float(x).is_integer()
-                              else repr(float(x))) for x in vals])
-    for row in zip(*cols):
-        w.writerow(row)
-    return ("text/csv", buf.getvalue().encode())
+            cols.append((vals, _fmt_time if v.type == "time" else _fmt_num))
+
+    def rows_csv(batch=8192):
+        buf = iomod.StringIO()
+        w = csvmod.writer(buf, quoting=csvmod.QUOTE_MINIMAL)
+        w.writerow(fr.names)
+        yield buf.getvalue()
+        buf.seek(0)
+        buf.truncate(0)
+        for lo in range(0, fr.nrows, batch):
+            hi = min(lo + batch, fr.nrows)
+            strcols = [[fmt(x) for x in data[lo:hi]] for data, fmt in cols]
+            w.writerows(zip(*strcols))
+            yield buf.getvalue()
+            buf.seek(0)
+            buf.truncate(0)
+    return ("text/csv", rows_csv())
 
 
 @route("DELETE", r"/3/Frames/(?P<frame_id>[^/]+)")
@@ -544,6 +560,7 @@ def _metrics_dict(m, frame_id=None, model_id=None):
                    "clustering": "ModelMetricsClusteringV3",
                    "ordinal": "ModelMetricsOrdinalV3",
                    "anomaly": "ModelMetricsAnomalyV3",
+                   "autoencoder": "ModelMetricsAutoEncoderV3",
                    }.get(m.kind, "ModelMetricsBaseV3")
     d = {"__meta": {"schema_version": 3, "schema_name": kind_schema,
                     "schema_type": "ModelMetrics"},
@@ -552,14 +569,39 @@ def _metrics_dict(m, frame_id=None, model_id=None):
          "model": _key(model_id, "Key<Model>") if model_id else None,
          "description": None, "scoring_time": 0,
          "custom_metric_name": None, "custom_metric_value": 0.0}
+    # H2O wire casing (client metrics_base.py accessors index these
+    # literally: 'MSE', 'RMSE', 'Gini', ...)
+    rename = {"mse": "MSE", "rmse": "RMSE", "gini": "Gini"}
     for k, v in m.data.items():
+        k = rename.get(k, k)
         if isinstance(v, np.ndarray):
             d[k] = v.tolist()
-        elif isinstance(v, dict):
-            d[k] = v
         else:
             d[k] = v
+    # keys the client's printer reads unconditionally per category
+    if m.kind == "multinomial":
+        d.setdefault("AUC", float("nan"))
+        d.setdefault("pr_auc", float("nan"))
     return d
+
+
+def _cv_summary_table(summary):
+    """cross_validation_metrics_summary as a TwoDimTableV3 (the client's
+    ModelBase._str_items appends it verbatim; H2O renders metric rows x
+    [mean, sd, cv_i_valid...] columns)."""
+    if not summary:
+        return None
+    from h2o_tpu.api.handlers_ml import twodim
+    nfold = max((len(v.get("values", [])) for v in summary.values()),
+                default=0)
+    cols = ["", "mean", "sd"] + [f"cv_{i+1}_valid" for i in range(nfold)]
+    rows = []
+    for name, v in sorted(summary.items()):
+        vals = list(v.get("values", []))
+        vals += [None] * (nfold - len(vals))
+        rows.append([name, v.get("mean"), v.get("sd")] + vals)
+    return twodim("Cross-Validation Metrics Summary", cols,
+                  ["string"] + ["double"] * (len(cols) - 1), rows)
 
 
 def _model_schema(m: Model) -> dict:
@@ -576,14 +618,38 @@ def _model_schema(m: Model) -> dict:
             v, np.ndarray) else v.tolist()}
             for k, v in m.params.items() if not str(k).startswith("_")],
         "output": {
-            "model_category": ("Binomial" if out.get("response_domain") and
-                               len(out["response_domain"]) == 2 else
-                               "Multinomial" if out.get("response_domain")
-                               else "Regression"),
+            "model_category": out.get("model_category") or (
+                "Binomial" if out.get("response_domain") and
+                len(out["response_domain"]) == 2 else
+                "Multinomial" if out.get("response_domain")
+                else "Regression"),
             "training_metrics": _metrics_dict(
                 out.get("training_metrics")),
             "validation_metrics": _metrics_dict(
                 out.get("validation_metrics")),
+            # the client's ModelBase._str_items indexes these two keys
+            # unconditionally (model_base.py:1978-1981)
+            "cross_validation_metrics": _metrics_dict(
+                out.get("cross_validation_metrics")),
+            "cross_validation_metrics_summary": _cv_summary_table(
+                out.get("cross_validation_metrics_summary")),
+            # when CV metrics are present the client dereferences this key
+            # (estimator_base.py:383) — a Key list or None
+            "cross_validation_models": (
+                [_key(k, "Key<Model>")
+                 for k in out["cross_validation_models"]]
+                if out.get("cross_validation_models") else None),
+            "cross_validation_predictions": None,
+            "cross_validation_holdout_predictions_frame_id": (
+                _key(out["cross_validation_holdout_predictions_frame_id"],
+                     "Key<Frame>")
+                if out.get("cross_validation_holdout_predictions_frame_id")
+                else None),
+            "cross_validation_fold_assignment_frame_id": (
+                _key(out["cross_validation_fold_assignment_frame_id"],
+                     "Key<Frame>")
+                if out.get("cross_validation_fold_assignment_frame_id")
+                else None),
             "variable_importances": None,
             "names": out.get("x", []),
             "domains": [],
@@ -591,6 +657,29 @@ def _model_schema(m: Model) -> dict:
             "run_time": m.run_time_ms,
         },
     }
+
+
+@route("GET", r"/3/GetGLMRegPath")
+def glm_reg_path(params):
+    """Regularization path of a lambda-search GLM (client:
+    H2OGeneralizedLinearEstimator.getGLMRegularizationPath,
+    h2o-py/h2o/estimators/glm.py:2526)."""
+    m = cloud().dkv.get(params.get("model"))
+    if not isinstance(m, Model):
+        raise H2OError(404, f"model {params.get('model')} not found")
+    rp = m.output.get("reg_path")
+    if rp is None:
+        raise H2OError(400, f"model {m.key} was not built with "
+                            "lambda_search")
+    names = list(m.output.get("coef_names", [])) + ["Intercept"]
+    return {"model": _key(str(m.key), "Key<Model>"),
+            "lambdas": rp["lambdas"], "alphas": rp["alphas"],
+            "explained_deviance_train": rp["explained_deviance_train"],
+            "explained_deviance_valid": rp["explained_deviance_valid"],
+            "coefficients": rp["coefficients"],
+            "coefficient_names": names,
+            "coefficients_std": None, "z_values": None,
+            "p_values": None, "std_errs": None}
 
 
 @route("GET", r"/3/Models")
@@ -631,10 +720,21 @@ def predict(params, model_id, frame_id):
         raise H2OError(404, f"frame {frame_id} not found")
     dest = params.get("predictions_frame") or f"predictions_{model_id}" \
         f"_{frame_id}"
+    recon = str(params.get("reconstruction_error", "")).lower() == "true"
+    per_feature = str(params.get("reconstruction_error_per_feature",
+                                 "")).lower() == "true"
     job = Job(dest=dest, description=f"predict {model_id} on {frame_id}")
 
     def body(j):
-        pf = m.predict(fr)
+        if recon:
+            # autoencoder anomaly scoring (DeepLearningModel.anomaly;
+            # client: h2o-py/h2o/model/models/autoencoder.py:42)
+            if not m.output.get("autoencoder"):
+                raise H2OError(400, f"model {model_id} is not an "
+                                    "autoencoder")
+            pf = m.anomaly(fr, per_feature=per_feature)
+        else:
+            pf = m.predict(fr)
         pf.key = dest
         cloud().dkv.put(dest, pf)
         return pf
@@ -643,7 +743,8 @@ def predict(params, model_id, frame_id):
     job.join()  # raises on FAILED
     return {"job": job.to_dict(),
             "predictions_frame": _key(dest, "Key<Frame>"),
-            "model_metrics": []}
+            "model_metrics": [{"predictions":
+                               {"frame_id": _key(dest, "Key<Frame>")}}]}
 
 
 @route("POST", r"/3/ModelMetrics/models/(?P<model_id>[^/]+)/frames/"

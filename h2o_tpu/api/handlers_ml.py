@@ -33,21 +33,10 @@ def _h():
 def twodim(name: str, col_header: List[str], col_types: List[str],
            rows: List[List], description: str = "") -> dict:
     """TwoDimTableV3 JSON (client parse: h2o-py/h2o/two_dim_table.py:46-62
-    reads columns[].name/type + column-major ``data``)."""
-    ncol = len(col_header)
-    data = [[r[j] for r in rows] for j in range(ncol)]
-    return {
-        "__meta": {"schema_version": 3, "schema_name": "TwoDimTableV3",
-                   "schema_type": "TwoDimTable"},
-        "name": name, "description": description,
-        "columns": [{"__meta": {"schema_version": -1,
-                                "schema_name": "ColumnSpecsBase",
-                                "schema_type": "Iced"},
-                     "name": n, "type": t, "format": "%s", "description": n}
-                    for n, t in zip(col_header, col_types)],
-        "rowcount": len(rows),
-        "data": data,
-    }
+    reads columns[].name/type + column-major ``data``); the single
+    serializer lives in models/metrics.py (twodim_json)."""
+    from h2o_tpu.models.metrics import twodim_json
+    return twodim_json(name, col_header, col_types, rows, description)
 
 
 def _parse_json_param(params: Dict, key: str) -> Dict:
@@ -137,9 +126,15 @@ def _grid_json(grid, sort_by: Optional[str] = None,
         else grid.sorted_models()
     metric = sort_by or grid.sort_metric or "mse"
     from h2o_tpu.models.grid import _model_sort_metric
+    # tolerate a concurrent mid-run append: only rows with both the model
+    # and its hyper_values entry published are rendered
+    n_ok = min(len(grid.models), len(grid.hyper_values))
     rows = []
     for m in models:
-        hv = grid.hyper_values[grid.models.index(m)]
+        idx = grid.models.index(m)
+        if idx >= n_ok:
+            continue
+        hv = grid.hyper_values[idx]
         rows.append([str(hv.get(k)) for k in grid.hyper_names]
                     + [str(m.key), float(_model_sort_metric(m, metric))])
     return {
@@ -230,11 +225,21 @@ def automl_build(params):
         ign = [str(c).strip('"') for c in ins["ignored_columns"]]
         x = [c for c in fr.names if c not in ign and c != y]
 
+    # h2o-py sends its H2OAutoML default nfolds=-1 meaning "auto" (5);
+    # 0/1 mean CV off (AutoML.nFoldsOrDefault semantics)
+    nfolds = int(bc.get("nfolds", -1))
+    if nfolds == -1:
+        nfolds = 5
+    elif nfolds == 1:
+        nfolds = 0
+    elif nfolds < 0:
+        raise H2OError(400, f"nfolds must be -1 (auto), 0 (off) or >= 2; "
+                            f"got {nfolds}")
     aml = AutoML(
         max_models=int(sc.get("max_models") or 0),
         max_runtime_secs=float(sc.get("max_runtime_secs") or 0.0),
         seed=int(sc["seed"]) if sc.get("seed") is not None else -1,
-        nfolds=int(bc.get("nfolds", 5)),
+        nfolds=nfolds,
         include_algos=bm.get("include_algos"),
         exclude_algos=bm.get("exclude_algos"),
         stopping_rounds=int(sc.get("stopping_rounds", 3)),

@@ -162,6 +162,10 @@ class _Handler(BaseHTTPRequestHandler):
                     if isinstance(result, tuple) and len(result) == 2 \
                             and isinstance(result[1], (bytes, bytearray)):
                         self._send_bytes(200, result[0], bytes(result[1]))
+                    elif isinstance(result, tuple) and len(result) == 2 \
+                            and hasattr(result[1], "__iter__") \
+                            and not isinstance(result[1], (str, dict, list)):
+                        self._send_stream(200, result[0], result[1])
                     else:
                         self._send(200,
                                    result if result is not None else {})
@@ -183,6 +187,30 @@ class _Handler(BaseHTTPRequestHandler):
     def _send(self, status: int, payload: dict):
         self._send_bytes(status, "application/json",
                          json.dumps(_sanitize(payload)).encode())
+
+    def _send_stream(self, status: int, ctype: str, chunks):
+        """Chunked transfer for large exports (DownloadDataHandler streams
+        in the reference too) — never materializes the payload in RSS."""
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            for chunk in chunks:
+                if isinstance(chunk, str):
+                    chunk = chunk.encode()
+                if not chunk:
+                    continue
+                self.wfile.write(b"%x\r\n" % len(chunk))
+                self.wfile.write(chunk)
+                self.wfile.write(b"\r\n")
+        except Exception as e:  # noqa: BLE001 — headers are already sent:
+            # never write a second HTTP response into the chunked body;
+            # drop the connection so the client sees a truncated transfer
+            log.error("stream aborted mid-response: %s", e)
+            self.close_connection = True
+            return
+        self.wfile.write(b"0\r\n\r\n")
 
     def _send_bytes(self, status: int, ctype: str, blob: bytes):
         self.send_response(status)

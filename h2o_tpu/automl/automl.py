@@ -166,17 +166,26 @@ class AutoML:
         budget = _Budget(float(p["max_runtime_secs"] or 0),
                          int(p["max_models"] or 0))
 
-        # one shared fold assignment for every model (Modulo on a fold col)
+        # one shared fold assignment for every model (Modulo on a fold col);
+        # nfolds==0 disables CV (and with it the stacked-ensemble phase)
         nfolds = int(p["nfolds"])
-        fold_name = "__automl_fold__"
-        fold = (np.arange(train.nrows) % nfolds).astype(np.float32)
-        work = Frame(list(train.names) + [fold_name],
-                     list(train.vecs) + [Vec(fold)])
-        ev.info("init", f"{nfolds}-fold Modulo CV on a shared fold column")
-
+        if nfolds != 0 and nfolds < 2:
+            raise ValueError(f"AutoML nfolds must be 0 (CV off) or >= 2; "
+                             f"got {nfolds}")
         from h2o_tpu.models.registry import builder_class
-        common = dict(fold_column=fold_name,
-                      keep_cross_validation_predictions=True, seed=seed)
+        if nfolds == 0:
+            work = train
+            common = dict(seed=seed)
+            ev.info("init", "cross-validation disabled (nfolds=0)")
+        else:
+            fold_name = "__automl_fold__"
+            fold = (np.arange(train.nrows) % nfolds).astype(np.float32)
+            work = Frame(list(train.names) + [fold_name],
+                         list(train.vecs) + [Vec(fold)])
+            ev.info("init",
+                    f"{nfolds}-fold Modulo CV on a shared fold column")
+            common = dict(fold_column=fold_name,
+                          keep_cross_validation_predictions=True, seed=seed)
         x_cols = [c for c in (x or train.names) if c != y]
 
         def train_one(algo: str, prm: Dict, step: str):

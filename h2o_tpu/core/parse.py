@@ -127,7 +127,10 @@ def parse_setup(paths: Sequence[str], sample_lines: int = 200,
     for j in range(ncols):
         col = body_types[j] if has_header else \
             [first_types[j]] + body_types[j]
-        col = col or [first_types[j]]
+        # header-only sample: never type a column from its header token
+        # (would turn every column into enum); fall through to the na-only
+        # default (numeric)
+        col = col or ["na"]
         nonna = [t for t in col if t != "na"]
         if not nonna:
             types.append(T_NUM)

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from h2o_tpu.core.cloud import cloud
-from h2o_tpu.core.frame import Frame
+from h2o_tpu.core.frame import Frame, Vec
 from h2o_tpu.models.distributions import get_distribution
 from h2o_tpu.models.glm import expand_for_scoring, expansion_spec
 from h2o_tpu.models.model import DataInfo, Model, ModelBuilder
@@ -76,9 +76,20 @@ def mlp_forward(params, X, activation, dropout_key=None,
 def _loss_fn(params, X, y, w, activation, nclass: int, dist_name: str,
              l1: float, l2: float, dropout_key, input_dropout,
              hidden_dropout):
+    """nclass semantics: >=2 classification CE, 1 regression deviance,
+    0 AUTOENCODER (target is X itself, weighted reconstruction MSE —
+    hex/deeplearning/DeepLearningTask autoencoder objective)."""
     out = mlp_forward(params, X, activation, dropout_key, input_dropout,
                       hidden_dropout)
     wsum = jnp.maximum(jnp.sum(w), EPS)
+    if nclass == 0:
+        se = jnp.sum((out - X) ** 2, axis=1)
+        loss = jnp.sum(w * se) / wsum
+        if l1 > 0 or l2 > 0:
+            for layer in params:
+                loss = loss + l1 * jnp.sum(jnp.abs(layer["W"])) + \
+                    0.5 * l2 * jnp.sum(layer["W"] ** 2)
+        return loss
     if nclass >= 2:
         logp = jax.nn.log_softmax(out, axis=1)
         yi = jnp.clip(y.astype(jnp.int32), 0, nclass - 1)
@@ -147,8 +158,57 @@ def train_step_sgd(params, mom, X, y, w, key, lr, momentum, activation: str,
 class DeepLearningModel(Model):
     algo = "deeplearning"
 
+    def _reconstruct(self, frame: Frame):
+        """Autoencoder forward pass: (R, P) reconstruction in the
+        standardized/expanded input space, plus the input matrix."""
+        out = self.output
+        X = expand_for_scoring(frame, out["expansion_spec"])
+        params = [{"W": jnp.asarray(l["W"]), "b": jnp.asarray(l["b"])}
+                  for l in out["weights"]]
+        return mlp_forward(params, X, out["activation"]), X
+
+    def anomaly(self, frame: Frame, per_feature: bool = False) -> Frame:
+        """Reconstruction error (H2OAutoEncoderModel.anomaly,
+        h2o-py/h2o/model/models/autoencoder.py:42): mean square error per
+        row, or per-feature squared errors."""
+        recon, X = self._reconstruct(frame)
+        names = self.output["expansion_spec_names"]
+        if per_feature:
+            se = (recon - X) ** 2
+            return Frame([f"reconstr_{n}.SE" for n in names],
+                         [Vec(se[:, j], nrows=frame.nrows)
+                          for j in range(se.shape[1])])
+        mse = jnp.mean((recon - X) ** 2, axis=1)
+        return Frame(["Reconstruction.MSE"], [Vec(mse, nrows=frame.nrows)])
+
+    def reconstruction_mse(self, frame: Frame) -> float:
+        recon, X = self._reconstruct(frame)
+        valid = frame.row_mask()
+        se = jnp.mean((recon - X) ** 2, axis=1)
+        return float(jnp.sum(jnp.where(valid, se, 0.0)) /
+                     jnp.maximum(jnp.sum(valid), 1))
+
+    def model_metrics(self, frame: Frame):
+        if self.output.get("autoencoder"):
+            from h2o_tpu.models import metrics as mm
+            mse = self.reconstruction_mse(frame)
+            return mm.ModelMetrics("autoencoder",
+                                   {"MSE": mse, "RMSE": float(mse) ** 0.5})
+        return super().model_metrics(frame)
+
+    def predict(self, frame: Frame) -> Frame:
+        if self.output.get("autoencoder"):
+            recon, _ = self._reconstruct(frame)
+            names = self.output["expansion_spec_names"]
+            return Frame([f"reconstr_{n}" for n in names],
+                         [Vec(recon[:, j], nrows=frame.nrows)
+                          for j in range(recon.shape[1])])
+        return super().predict(frame)
+
     def predict_raw(self, frame: Frame):
         out = self.output
+        if out.get("autoencoder"):
+            return self._reconstruct(frame)[0]
         X = expand_for_scoring(frame, out["expansion_spec"])
         params = [{"W": jnp.asarray(l["W"]), "b": jnp.asarray(l["b"])}
                   for l in out["weights"]]
@@ -169,6 +229,16 @@ class DeepLearning(ModelBuilder):
     algo = "deeplearning"
     model_cls = DeepLearningModel
 
+    # autoencoder mode is unsupervised (no response) and has no CV
+    # orchestration (the reference trains it as plain reconstruction)
+    @property
+    def supervised(self):
+        return not bool(self.params.get("autoencoder"))
+
+    @property
+    def supports_cv(self):
+        return not bool(self.params.get("autoencoder"))
+
     def default_params(self) -> Dict:
         p = super().default_params()
         p.update(hidden=[200, 200], epochs=10.0, activation="Rectifier",
@@ -188,20 +258,26 @@ class DeepLearning(ModelBuilder):
 
     def _fit(self, job, x, y, train: Frame, valid: Optional[Frame]):
         p = self.params
-        di = DataInfo(train, x, y, mode="expanded",
+        ae = bool(p.get("autoencoder"))
+        di = DataInfo(train, x, None if ae else y, mode="expanded",
                       weights=p.get("weights_column"),
                       standardize=bool(p["standardize"]),
                       use_all_factor_levels=bool(p["use_all_factor_levels"]),
                       impute_missing=True)
         X = di.matrix()
-        yv = di.response()
-        w = di.weights()
         active = di.valid_mask()
-        nclass = di.nclasses
-        dist_name = "gaussian" if nclass >= 2 else \
-            self.resolve_distribution(di)
+        w = di.weights()
+        if ae:
+            yv = jnp.zeros((X.shape[0],), jnp.float32)
+            nclass = 0                      # _loss_fn autoencoder sentinel
+            dist_name = "gaussian"
+        else:
+            yv = di.response()
+            nclass = di.nclasses
+            dist_name = "gaussian" if nclass >= 2 else \
+                self.resolve_distribution(di)
         n_in = X.shape[1]
-        n_out = nclass if nclass >= 2 else 1
+        n_out = n_in if ae else (nclass if nclass >= 2 else 1)
         hidden = [int(h) for h in p["hidden"]]
         sizes = [n_in] + hidden + [n_out]
         key = self.rng_key()
@@ -255,12 +331,16 @@ class DeepLearning(ModelBuilder):
 
         out = dict(
             x=list(di.x), expansion_spec=expansion_spec(di),
+            expansion_spec_names=list(di.expanded_names),
             weights=[{"W": np.asarray(l["W"]), "b": np.asarray(l["b"])}
                      for l in params],
-            activation=activation, hidden=hidden,
+            activation=activation, hidden=hidden, autoencoder=ae,
             distribution_resolved=dist_name,
-            response_domain=di.response_domain if nclass >= 2 else None,
+            response_domain=di.response_domain
+            if (not ae and nclass >= 2) else None,
             epochs_trained=steps * batch / max(nrows, 1))
+        if ae:
+            out["model_category"] = "AutoEncoder"
         model = self.model_cls(self.model_id, dict(p), out)
         model.params["response_column"] = y
         model.output["training_metrics"] = model.model_metrics(train)

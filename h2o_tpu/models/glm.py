@@ -221,6 +221,17 @@ def _cod_solve(G, q, beta0, lam_l1, lam_l2, n_sweeps: int = 50,
     return beta
 
 
+@functools.partial(jax.jit, static_argnames=("fam_name",))
+def _deviance_at(X, y, w, valid, beta, fam_name: str, tweedie_power=1.5):
+    """Deviance of a fixed beta on a (possibly held-out) data split — the
+    lambda-path selection criterion (GLM.java lambda search scoring)."""
+    fam = _family(fam_name, tweedie_power)
+    y = jnp.where(valid, y, 0.0)
+    w = jnp.where(valid, w, 0.0)
+    eta = X @ beta[:-1] + beta[-1]
+    return fam.deviance(y, fam.link_inv(eta), w)
+
+
 @jax.jit
 def _chol_solve(G, q, lam_l2):
     P = G.shape[0]
@@ -347,17 +358,32 @@ class GLM(ModelBuilder):
                 lam = lam[0]
             if lam is not None:
                 lam = float(lam)
-            beta, lambda_used, dev = self._fit_binomial_ish(
-                X, yv, w, valid_m, fam_name, p, alpha, lam, max_iter, job)
+            # validation split drives lambda selection when searching
+            vdata = None
+            if p.get("lambda_search") and valid is not None:
+                Xv = expand_for_scoring(valid, spec)
+                yvv = valid.vec(y)
+                yval = jnp.where(yvv.data < 0, jnp.nan,
+                                 yvv.data.astype(jnp.float32)) \
+                    if yvv.is_categorical else yvv.as_float()
+                wv = valid.vec(p["weights_column"]).data \
+                    if p.get("weights_column") and \
+                    p["weights_column"] in valid \
+                    else jnp.ones((valid.padded_rows,), jnp.float32)
+                vmask = valid.row_mask() & ~jnp.isnan(yval)
+                vdata = (Xv, jnp.nan_to_num(yval), wv, vmask)
+            beta, lambda_used, dev, extra = self._fit_binomial_ish(
+                X, yv, w, valid_m, fam_name, p, alpha, lam, max_iter, job,
+                vdata=vdata)
             out = dict(x=x, beta=np.asarray(beta), is_multinomial=False,
                        expansion_spec=spec,
                        family_resolved=fam_name,
                        coef_names=di.expanded_names,
                        lambda_used=float(lambda_used),
-                       null_deviance=None, residual_deviance=float(dev),
+                       residual_deviance=float(dev),
                        response_domain=di.response_domain
                        if fam_name in ("binomial", "quasibinomial")
-                       else None)
+                       else None, **extra)
         model = self.model_cls(self.model_id, dict(p), out)
         model.params["response_column"] = y
         model.output["training_metrics"] = model.model_metrics(train)
@@ -367,32 +393,23 @@ class GLM(ModelBuilder):
 
     # -- solvers ------------------------------------------------------------
 
-    def _fit_binomial_ish(self, X, yv, w, valid_m, fam_name, p, alpha, lam,
-                          max_iter, job):
-        P = X.shape[1]
-        beta = jnp.zeros((P + 1,))
-        fam = _family(fam_name, p["tweedie_power"])
-        # initialize intercept at the null model
-        wa = jnp.where(valid_m, w, 0.0)
-        mu0 = fam.null_mu(jnp.where(valid_m, jnp.nan_to_num(yv), 0.0), wa)
-        beta = beta.at[-1].set(fam.link(mu0))
-        lam_given = lam is not None
+    def _irlsm_at_lambda(self, X, yv, w, valid_m, fam_name, p, alpha, lam,
+                         beta, max_iter, n_obs, first_pass=None):
+        """IRLSM to convergence at one fixed lambda (warm-started beta).
+        ``first_pass``: an already-computed (G, q, dev) at the current beta
+        (reuses the lambda_max pass instead of recomputing it)."""
+        nonneg = bool(p.get("non_negative"))
         dev_prev, dev = None, None
+        self._last_iters = 0
         for it in range(max_iter):
-            G, q, dev = _irlsm_pass(X, yv, w, valid_m, beta, fam_name,
-                                    p["tweedie_power"])
-            if not lam_given and it == 0:
-                # lambda_max from the gradient at the null model (GLM.java
-                # lambda search); default single lambda = 1e-3 * lambda_max
-                grad = q - G @ beta
-                lam_max = float(jnp.max(jnp.abs(grad[:-1])) /
-                                max(alpha, 1e-3) /
-                                max(float(jnp.sum(wa)), 1.0))
-                lam = 1e-3 * lam_max
-            n_obs = jnp.maximum(jnp.sum(wa), 1.0)
+            if it == 0 and first_pass is not None:
+                G, q, dev = first_pass
+            else:
+                G, q, dev = _irlsm_pass(X, yv, w, valid_m, beta, fam_name,
+                                        p["tweedie_power"])
+            self._last_iters = it + 1
             l1 = lam * alpha * n_obs
             l2 = lam * (1 - alpha) * n_obs
-            nonneg = bool(p.get("non_negative"))
             if l1 > 0 or nonneg:
                 beta_new = _cod_solve(G, q, beta, l1, l2,
                                       non_negative=nonneg)
@@ -400,13 +417,124 @@ class GLM(ModelBuilder):
                 beta_new = _chol_solve(G, q, l2)
             delta = float(jnp.max(jnp.abs(beta_new - beta)))
             beta = beta_new
-            job.update((it + 1) / max_iter, f"IRLSM iter {it + 1}")
             if dev_prev is not None and fam_name == "gaussian":
                 break  # gaussian converges in one weighted solve
             if delta < float(p["beta_epsilon"]):
                 break
             dev_prev = dev
-        return beta, lam or 0.0, float(dev)
+        return beta, float(dev)
+
+    def _fit_binomial_ish(self, X, yv, w, valid_m, fam_name, p, alpha, lam,
+                          max_iter, job, vdata=None):
+        """Single-lambda IRLSM or the full lambda-search path.
+
+        Lambda search (GLM.java:987-988,1236-1254): geometric path of
+        ``nlambdas`` values from lambda_max (null-model gradient) down to
+        lambda_min_ratio * lambda_max, warm-starting each lambda from the
+        previous solution; the returned model is the best-by-deviance on
+        the validation split when given, else on training with an
+        early-stop when explained deviance plateaus."""
+        P = X.shape[1]
+        beta = jnp.zeros((P + 1,))
+        fam = _family(fam_name, p["tweedie_power"])
+        # initialize intercept at the null model
+        wa = jnp.where(valid_m, w, 0.0)
+        mu0 = fam.null_mu(jnp.where(valid_m, jnp.nan_to_num(yv), 0.0), wa)
+        beta = beta.at[-1].set(fam.link(mu0))
+        n_obs = float(jnp.maximum(jnp.sum(wa), 1.0))
+        null_dev = float(fam.deviance(
+            jnp.where(valid_m, jnp.nan_to_num(yv), 0.0),
+            jnp.full_like(yv, mu0), wa))
+        extra = dict(null_deviance=null_dev)
+
+        search = bool(p.get("lambda_search"))
+        first_pass = None
+        if lam is None or search:
+            # lambda_max from the gradient at the null model; the pass is
+            # reused as iteration 0 of the first solve (same beta) — no
+            # duplicate Gram computation
+            G0, q0, dev0 = _irlsm_pass(X, yv, w, valid_m, beta, fam_name,
+                                       p["tweedie_power"])
+            grad = q0 - G0 @ beta
+            lam_max = float(jnp.max(jnp.abs(grad[:-1])) /
+                            max(alpha, 1e-3) / n_obs)
+            first_pass = (G0, q0, dev0)
+
+        if not search:
+            if lam is None:
+                lam = 1e-3 * lam_max   # default single lambda
+            beta, dev = self._irlsm_at_lambda(
+                X, yv, w, valid_m, fam_name, p, alpha, lam, beta,
+                max_iter, n_obs, first_pass=first_pass)
+            extra["iterations"] = self._last_iters
+            job.update(1.0, "IRLSM converged")
+            return beta, lam, dev, extra
+
+        # ---- lambda search path ----
+        nlam = int(p.get("nlambdas") or -1)
+        if nlam <= 0:
+            nlam = 30 if alpha == 0 else 100   # GLM.java:988
+        lmr = float(p.get("lambda_min_ratio") or -1.0)
+        if lmr <= 0:
+            lmr = 1e-4 if (n_obs / 16.0) > P else 1e-2  # GLM.java:1237
+            if alpha == 0:
+                lmr *= 1e-2                              # GLM.java:1239
+        lams = lam_max * lmr ** (np.arange(nlam) / max(nlam - 1, 1))
+        inner = min(max_iter, 10)
+        null_dev_v = None
+        if vdata is not None:
+            Xv, yval, wv, vmask = vdata
+            beta_null = jnp.zeros((P + 1,)).at[-1].set(fam.link(mu0))
+            null_dev_v = float(_deviance_at(Xv, yval, wv, vmask, beta_null,
+                                            fam_name, p["tweedie_power"]))
+        path_lams, path_dev_t, path_dev_v, path_coefs = [], [], [], []
+        best = None                          # (crit, beta, lam, dev_train)
+        total_iters = 0
+        worse_streak = 0
+        for k, lam_k in enumerate(lams):
+            beta, dev = self._irlsm_at_lambda(
+                X, yv, w, valid_m, fam_name, p, alpha, float(lam_k), beta,
+                inner, n_obs, first_pass=first_pass if k == 0 else None)
+            total_iters += self._last_iters
+            dev_v = None
+            if vdata is not None:
+                Xv, yval, wv, vmask = vdata
+                dev_v = float(_deviance_at(Xv, yval, wv, vmask, beta,
+                                           fam_name, p["tweedie_power"]))
+            crit = dev_v if dev_v is not None else dev
+            path_lams.append(float(lam_k))
+            path_dev_t.append(dev)
+            path_dev_v.append(dev_v)
+            path_coefs.append(np.asarray(beta))
+            job.update((k + 1) / nlam,
+                       f"lambda {k + 1}/{nlam} = {lam_k:.4g}")
+            # NaN-safe: the first path point always seeds best so a
+            # NaN-deviance family still yields a model
+            if best is None or crit < best[0] - 1e-12:
+                best = (crit, beta, float(lam_k), dev)
+                worse_streak = 0
+            else:
+                worse_streak += 1
+            dev_explained = 1.0 - dev / max(null_dev, EPS)
+            if dev_explained > 0.999:       # GLM early stop: nothing left
+                break
+            if vdata is not None and worse_streak >= 3:
+                break                        # validation deviance rising
+        _, beta_best, lam_best, dev_best = best
+        extra.update(
+            iterations=total_iters,
+            lambda_best=lam_best, lambda_max=float(lam_max),
+            lambda_min=float(lams[-1]), alpha_best=float(alpha),
+            reg_path=dict(
+                lambdas=path_lams, alphas=[float(alpha)] * len(path_lams),
+                explained_deviance_train=[
+                    1.0 - d / max(null_dev, EPS) for d in path_dev_t],
+                explained_deviance_valid=(
+                    None if vdata is None else
+                    [None if d is None else
+                     1.0 - d / max(null_dev_v, EPS) for d in path_dev_v]),
+                coefficients=[c.tolist() for c in path_coefs]))
+        return beta_best, lam_best, dev_best, extra
 
     def _fit_multinomial(self, X, yv, w, valid_m, di, p, alpha, max_iter,
                          job):

@@ -197,8 +197,11 @@ class GridSearch:
                 b = self.builder_cls(**params)
                 m = b.train(x=x, y=y, training_frame=train,
                             validation_frame=valid)
-                grid.models.append(m)
+                # hyper_values first: the grid is DKV-published mid-run and
+                # _grid_json indexes hyper_values[models.index(m)] — a
+                # concurrent poll must never see models longer than values
                 grid.hyper_values.append(dict(combo))
+                grid.models.append(m)
                 cloud().dkv.put(m.key, m)
                 if rec is not None:
                     rec.model_done(m)

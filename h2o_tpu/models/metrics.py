@@ -166,6 +166,95 @@ def regression_metrics(pred, y, w=None, valid=None, distribution=None,
     return ModelMetrics("regression", data)
 
 
+def twodim_json(name, col_header, col_types, rows, description=""):
+    """TwoDimTableV3 wire JSON (h2o-py/h2o/two_dim_table.py parses
+    columns[].name/type + column-major data)."""
+    ncol = len(col_header)
+    data = [[r[j] for r in rows] for j in range(ncol)]
+    return {
+        "__meta": {"schema_version": 3, "schema_name": "TwoDimTableV3",
+                   "schema_type": "TwoDimTable"},
+        "name": name, "description": description,
+        "columns": [{"__meta": {"schema_version": -1,
+                                "schema_name": "ColumnSpecsBase",
+                                "schema_type": "Iced"},
+                     "name": n, "type": t, "format": "%s", "description": n}
+                    for n, t in zip(col_header, col_types)],
+        "rowcount": len(rows),
+        "data": data,
+    }
+
+
+# AUC2.ThresholdCriterion.VALUES order (hex/AUC2.java:43-95) — the client
+# indexes thresholds_and_metric_scores rows positionally (row[11]=tns ..
+# row[14]=tps, h2o-py/h2o/model/metrics/binomial.py:783-786)
+_THRESHOLD_CRITERIA = (
+    "f1", "f2", "f0point5", "accuracy", "precision", "recall",
+    "specificity", "absolute_mcc", "min_per_class_accuracy",
+    "mean_per_class_accuracy", "tns", "fns", "fps", "tps",
+    "tnr", "fnr", "fpr", "tpr")
+
+
+def _threshold_tables(pos: np.ndarray, neg: np.ndarray):
+    """thresholds_and_metric_scores + max_criteria_and_metric_scores from
+    the AUC score histograms (ModelMetricsBinomialV3.java:70-120)."""
+    nb = len(pos)
+    pos_d, neg_d = pos[::-1], neg[::-1]          # descending thresholds
+    keep = (pos_d + neg_d) > 0                   # real thresholds only
+    tp = np.cumsum(pos_d)[keep]
+    fp = np.cumsum(neg_d)[keep]
+    ths = (1.0 - (np.arange(nb) + 1.0) / nb)[keep]
+    n = len(tp)
+    if n == 0:
+        return None, None
+    P = max(tp[-1], EPS)
+    N = max(fp[-1], EPS)
+    fn, tn = P - tp, N - fp
+    with np.errstate(divide="ignore", invalid="ignore"):
+        prec = tp / np.maximum(tp + fp, EPS)
+        tpr = tp / P
+        tnr = tn / N
+        vals = {
+            "f1": 2 * prec * tpr / np.maximum(prec + tpr, EPS),
+            "f2": 5 * prec * tpr / np.maximum(4 * prec + tpr, EPS),
+            "f0point5": 1.25 * prec * tpr / np.maximum(
+                0.25 * prec + tpr, EPS),
+            "accuracy": (tp + tn) / (P + N),
+            "precision": prec, "recall": tpr, "specificity": tnr,
+            "absolute_mcc": np.abs(
+                (tp * tn - fp * fn) / np.sqrt(np.maximum(
+                    (tp + fp) * (tp + fn) * (tn + fp) * (tn + fn), EPS))),
+            "min_per_class_accuracy": np.minimum(tpr, tnr),
+            "mean_per_class_accuracy": 0.5 * (tpr + tnr),
+            "tns": tn, "fns": fn, "fps": fp, "tps": tp,
+            "tnr": tnr, "fnr": fn / P, "fpr": fp / N, "tpr": tpr,
+        }
+    int_crits = {"tns", "fns", "fps", "tps"}
+    rows = []
+    for i in range(n):
+        row = [float(ths[i])]
+        for c in _THRESHOLD_CRITERIA:
+            v = vals[c][i]
+            row.append(int(v) if c in int_crits else float(v))
+        row.append(i)
+        rows.append(row)
+    thresh_tbl = twodim_json(
+        "Metrics for Thresholds",
+        ["threshold"] + list(_THRESHOLD_CRITERIA) + ["idx"],
+        ["double"] + ["long" if c in int_crits else "double"
+                      for c in _THRESHOLD_CRITERIA] + ["int"],
+        rows, "Binomial metrics as a function of classification thresholds")
+    max_rows = []
+    for c in _THRESHOLD_CRITERIA:
+        k = int(np.argmax(vals[c]))
+        max_rows.append([f"max {c}", float(ths[k]), float(vals[c][k]), k])
+    max_tbl = twodim_json(
+        "Maximum Metrics", ["metric", "threshold", "value", "idx"],
+        ["string", "double", "double", "long"], max_rows,
+        "Maximum metrics at their respective thresholds")
+    return thresh_tbl, max_tbl
+
+
 def binomial_metrics(p1, y, w=None, valid=None,
                      domain=None, nrows: Optional[int] = None) -> ModelMetrics:
     p1 = jnp.asarray(p1)
@@ -185,6 +274,9 @@ def binomial_metrics(p1, y, w=None, valid=None,
                            sweep["cm"]["fp"] / max(sweep["cm"]["fp"] +
                                                    sweep["cm"]["tn"], EPS))),
                 domain=list(domain) if domain else ["0", "1"], **sweep)
+    thresh_tbl, max_tbl = _threshold_tables(r["pos"], r["neg"])
+    data["thresholds_and_metric_scores"] = thresh_tbl
+    data["max_criteria_and_metric_scores"] = max_tbl
     return ModelMetrics("binomial", data)
 
 
@@ -200,8 +292,14 @@ def multinomial_metrics(probs, y, w=None, valid=None, domain=None,
     K = probs.shape[1]
     r = jax.tree.map(np.asarray,
                      _multinomial_kernel(probs, y, w, valid, K))
+    cmat = r["cm"]
+    row_tot = cmat.sum(axis=1)
+    per_class_err = np.where(row_tot > 0,
+                             1.0 - np.diagonal(cmat) /
+                             np.maximum(row_tot, 1e-12), 0.0)
     data = dict(logloss=float(r["logloss"]), err=float(r["err"]),
                 mse=float(r["mse"]), rmse=float(np.sqrt(r["mse"])),
+                mean_per_class_error=float(per_class_err.mean()),
                 cm=r["cm"], hit_ratios=r["hit_ratios"].tolist(),
                 nobs=float(r["wsum"]),
                 domain=list(domain) if domain else

@@ -1,0 +1,123 @@
+"""GLM lambda_search + DeepLearning autoencoder — the round-2 verdict's
+"silent no-op params" (glm.py lambda_search/nlambdas/lambda_min_ratio,
+deeplearning.py autoencoder) must actually work.
+
+Reference: hex/glm/GLM.java:987-988,1236-1254 (lambda path);
+hex/deeplearning autoencoder objective + H2OAutoEncoderModel.anomaly
+(h2o-py/h2o/model/models/autoencoder.py:42).
+"""
+
+import numpy as np
+import pytest
+
+from h2o_tpu.core.frame import Frame, Vec, T_CAT
+
+
+def _sparse_binomial(rng, n=4000, p=20, informative=3):
+    X = rng.normal(size=(n, p)).astype(np.float32)
+    beta = np.zeros(p)
+    beta[:informative] = [2.0, -1.5, 1.0]
+    logits = X @ beta
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.int32)
+    names = [f"x{j}" for j in range(p)] + ["y"]
+    vecs = [Vec(X[:, j]) for j in range(p)] + \
+        [Vec(y, T_CAT, domain=["n", "p"])]
+    return Frame(names, vecs)
+
+
+def test_glm_lambda_search_path(cl, rng):
+    from h2o_tpu.models.glm import GLM
+    tr = _sparse_binomial(rng)
+    va = _sparse_binomial(rng)
+    m = GLM(family="binomial", lambda_search=True, nlambdas=20,
+            alpha=1.0, seed=7).train(y="y", training_frame=tr,
+                                     validation_frame=va)
+    out = m.output
+    assert out["lambda_best"] is not None
+    rp = out["reg_path"]
+    assert 1 < len(rp["lambdas"]) <= 20
+    # path is geometric-descending from lambda_max
+    assert rp["lambdas"][0] == pytest.approx(out["lambda_max"])
+    assert all(a > b for a, b in zip(rp["lambdas"], rp["lambdas"][1:]))
+    # explained deviance improves along the path (less regularization)
+    edt = rp["explained_deviance_train"]
+    assert edt[-1] > edt[0]
+    assert rp["explained_deviance_valid"] is not None
+    assert len(rp["coefficients"]) == len(rp["lambdas"])
+    # the selected model is predictive
+    auc = m.output["training_metrics"]["AUC"]
+    assert auc > 0.75
+    # L1 at high lambda kills noise coefficients: first path entry sparser
+    first = np.array(rp["coefficients"][0][:-1])
+    last = np.array(rp["coefficients"][-1][:-1])
+    assert (np.abs(first) > 1e-6).sum() <= (np.abs(last) > 1e-6).sum()
+
+
+def test_glm_lambda_search_no_validation(cl, rng):
+    from h2o_tpu.models.glm import GLM
+    tr = _sparse_binomial(rng, n=1000)
+    m = GLM(family="binomial", lambda_search=True, nlambdas=8,
+            alpha=0.5, seed=7).train(y="y", training_frame=tr)
+    assert m.output["reg_path"]["explained_deviance_valid"] is None
+    assert m.output["lambda_best"] in m.output["reg_path"]["lambdas"]
+    assert m.output["null_deviance"] > m.output["residual_deviance"]
+
+
+def test_glm_lambda_min_ratio_honored(cl, rng):
+    from h2o_tpu.models.glm import GLM
+    tr = _sparse_binomial(rng, n=1000)
+    m = GLM(family="binomial", lambda_search=True, nlambdas=5,
+            lambda_min_ratio=0.1, alpha=1.0, seed=7).train(
+        y="y", training_frame=tr)
+    out = m.output
+    assert out["lambda_min"] == pytest.approx(0.1 * out["lambda_max"])
+
+
+def test_autoencoder_trains_and_scores_anomalies(cl, rng):
+    from h2o_tpu.models.deeplearning import DeepLearning
+    n, p = 2000, 8
+    X = rng.normal(size=(n, p)).astype(np.float32)
+    # inliers live on a 2D manifold; columns are correlated
+    X[:, 2:] = X[:, :2] @ rng.normal(size=(2, p - 2)).astype(np.float32) \
+        + 0.05 * X[:, 2:]
+    fr = Frame([f"x{j}" for j in range(p)],
+               [Vec(X[:, j]) for j in range(p)])
+    ae = DeepLearning(autoencoder=True, hidden=[4], epochs=40,
+                      seed=3).train(training_frame=fr)
+    assert ae.output["autoencoder"] is True
+    assert ae.output["model_category"] == "AutoEncoder"
+    assert "MSE" in ae.output["training_metrics"].data
+
+    # outliers off the manifold reconstruct worse
+    Xo = rng.normal(size=(200, p)).astype(np.float32) * 3.0
+    fro = Frame([f"x{j}" for j in range(p)],
+                [Vec(Xo[:, j]) for j in range(p)])
+    mse_in = ae.anomaly(fr)
+    mse_out = ae.anomaly(fro)
+    mi = float(np.nanmean(np.asarray(mse_in.vecs[0].to_numpy())))
+    mo = float(np.nanmean(np.asarray(mse_out.vecs[0].to_numpy())))
+    assert mse_in.names == ["Reconstruction.MSE"]
+    assert mo > mi * 1.5
+
+    # per-feature errors: one column per expanded input
+    pf = ae.anomaly(fr, per_feature=True)
+    assert len(pf.names) == p
+    assert all(nm.startswith("reconstr_") and nm.endswith(".SE")
+               for nm in pf.names)
+
+    # reconstruction predict surface
+    rec = ae.predict(fr)
+    assert len(rec.names) == p
+    assert all(nm.startswith("reconstr_") for nm in rec.names)
+
+
+def test_autoencoder_no_response_required(cl, rng):
+    from h2o_tpu.models.deeplearning import DeepLearning
+    X = rng.normal(size=(200, 4)).astype(np.float32)
+    fr = Frame([f"x{j}" for j in range(4)],
+               [Vec(X[:, j]) for j in range(4)])
+    b = DeepLearning(autoencoder=True, hidden=[2], epochs=2, seed=1)
+    assert b.supervised is False
+    m = b.train(training_frame=fr)
+    assert m.output["weights"][0]["W"].shape[0] == 4
+    assert m.output["weights"][-1]["W"].shape[1] == 4
