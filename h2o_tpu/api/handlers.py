@@ -182,12 +182,13 @@ def post_file(params, body=None):
     c = cloud()
     dest = params.get("destination_frame") or \
         f"upload_{uuid.uuid4().hex[:12]}.bin"
+    # slash-free key so /3/Frames/{id} routes can address the upload
+    key = dest.replace("/", "_").replace(":", "_")
     updir = os.path.join(c.args.ice_root, "uploads")
     os.makedirs(updir, exist_ok=True)
-    path = os.path.join(updir, dest.replace("/", "_"))
+    path = os.path.join(updir, key)
     with open(path, "wb") as f:
         shutil.copyfileobj(body, f)
-    key = f"nfs://{path}"
     c.dkv.put(key, path)
     return {"destination_frame": key,
             "total_bytes": os.path.getsize(path)}
@@ -378,6 +379,21 @@ def list_frames(params):
 @route("GET", r"/3/Frames/(?P<frame_id>[^/]+)")
 def get_frame(params, frame_id):
     fr = cloud().dkv.get(frame_id)
+    if isinstance(fr, str) and os.path.exists(fr):
+        # raw byte file from PostFile (the upload_mojo flow fetches it as
+        # a pseudo 1-vec frame, like the reference's raw-file Frame)
+        return {"frames": [{
+            "__meta": {"schema_version": 3, "schema_name": "FrameV3",
+                       "schema_type": "Frame"},
+            "frame_id": _key(frame_id, "Key<Frame>"),
+            "byte_size": os.path.getsize(fr), "is_text": True,
+            "row_offset": 0, "row_count": 0, "column_offset": 0,
+            "column_count": 0, "total_column_count": 0, "checksum": 0,
+            "rows": os.path.getsize(fr), "num_columns": 0, "columns": [],
+            "compatible_models": [], "chunk_summary": {},
+            "distribution_summary": {},
+            "default_percentiles": [],
+        }]}
     if not isinstance(fr, Frame):
         raise H2OError(404, f"frame {frame_id} not found")
     rows = int(params.get("row_count", 10) or 10)
@@ -520,8 +536,10 @@ def build_model(params, algo):
     except KeyError:
         raise H2OError(404, f"unknown algorithm {algo}")
     train_key = params.get("training_frame")
-    fr = cloud().dkv.get(train_key)
-    if not isinstance(fr, Frame):
+    fr = cloud().dkv.get(train_key) if train_key else None
+    if not isinstance(fr, Frame) and algo != "generic":
+        # generic (MOJO import) is the one frame-less builder
+        # (hex/generic/Generic.java trains from an artifact key)
         raise H2OError(404, f"training_frame {train_key} not found")
     valid = cloud().dkv.get(params.get("validation_frame")) \
         if params.get("validation_frame") else None
@@ -579,9 +597,34 @@ def _metrics_dict(m, frame_id=None, model_id=None):
         else:
             d[k] = v
     # keys the client's printer reads unconditionally per category
+    # (h2o-py/h2o/model/metrics/multinomial.py:7-57)
     if m.kind == "multinomial":
         d.setdefault("AUC", float("nan"))
         d.setdefault("pr_auc", float("nan"))
+        d.setdefault("multinomial_auc_table", None)
+        d.setdefault("multinomial_aucpr_table", None)
+        from h2o_tpu.models.metrics import twodim_json
+        cm = np.asarray(m.data.get("cm"))
+        dom = [str(s) for s in (m.data.get("domain") or
+                                range(cm.shape[0]))]
+        rows = []
+        for i in range(cm.shape[0]):
+            tot = float(cm[i].sum())
+            err = 1.0 - (float(cm[i, i]) / tot if tot else 0.0)
+            rows.append([float(x) for x in cm[i]] +
+                        [err, f"{int(tot - cm[i, i]):,} / {int(tot):,}"])
+        d["cm"] = {"__meta": {"schema_version": 3,
+                              "schema_name": "ConfusionMatrixV3",
+                              "schema_type": "ConfusionMatrix"},
+                   "table": twodim_json(
+                       "Confusion Matrix", dom + ["Error", "Rate"],
+                       ["long"] * len(dom) + ["double", "string"], rows,
+                       "Row labels: Actual class; Column labels: "
+                       "Predicted class")}
+        hr = m.data.get("hit_ratios") or []
+        d["hit_ratio_table"] = twodim_json(
+            "Top-K Hit Ratios", ["k", "hit_ratio"], ["long", "double"],
+            [[k + 1, float(v)] for k, v in enumerate(hr)])
     return d
 
 
@@ -702,6 +745,113 @@ def get_model(params, model_id):
 def delete_model(params, model_id):
     cloud().dkv.remove(model_id)
     return {}
+
+
+# ---------------------------------------------------------------------------
+# model artifacts: binary save/load + genmodel MOJO
+# (water/api/ModelsHandler.java:148,259; clients: h2o-py/h2o/h2o.py
+#  save_model:1501, load_model:1579, download_model/upload_model,
+#  model_base.download_mojo:1165, save_mojo)
+# ---------------------------------------------------------------------------
+
+def _model_or_404(model_id) -> Model:
+    m = cloud().dkv.get(model_id)
+    if not isinstance(m, Model):
+        raise H2OError(404, f"model {model_id} not found")
+    return m
+
+
+def _register_loaded(m: Model):
+    cloud().dkv.put(m.key, m)
+    return {"models": [{"model_id": _key(str(m.key), "Key<Model>")}]}
+
+
+def _save_dest(params) -> str:
+    """Validate the server-side save destination (dir/force params shared
+    by the Models.bin and Models.mojo save routes)."""
+    path = params.get("dir")
+    if not path:
+        raise H2OError(400, "dir is required")
+    force = str(params.get("force", "true")).lower() == "true"
+    if os.path.exists(path) and not force:
+        raise H2OError(400, f"{path} exists and force=False")
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    return path
+
+
+@route("GET", r"/99/Models\.bin/(?P<model_id>[^/]+)")
+def save_model_bin(params, model_id):
+    """h2o.save_model: write the binary model server-side."""
+    m = _model_or_404(model_id)
+    path = _save_dest(params)
+    m.save(path)
+    return {"dir": path, "models": [{"model_id":
+                                     _key(model_id, "Key<Model>")}]}
+
+
+@route("POST", r"/99/Models\.bin/(?P<model_id>[^/]*)")
+def load_model_bin(params, model_id):
+    """h2o.load_model: read a binary model from a server path."""
+    path = params.get("dir")
+    if not path or not os.path.exists(path):
+        raise H2OError(404, f"no model file at {path}")
+    return _register_loaded(Model.load(path))
+
+
+@route("GET", r"/3/Models\.fetch\.bin/(?P<model_id>[^/]+)")
+def fetch_model_bin(params, model_id):
+    """h2o.download_model: stream the binary model to the client."""
+    import tempfile
+    m = _model_or_404(model_id)
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "model.bin")
+        m.save(p)
+        with open(p, "rb") as f:
+            blob = f.read()
+    return ("application/octet-stream", blob,
+            {"Content-Disposition":
+             f'attachment; filename="{model_id}"'})
+
+
+@route("POST", r"/99/Models\.upload\.bin/(?P<model_id>[^/]*)")
+def upload_model_bin(params, model_id):
+    """h2o.upload_model: the file arrived via POST /3/PostFile.bin; 'dir'
+    is its upload key."""
+    src = params.get("dir") or ""
+    path = cloud().dkv.get(src) or src.replace("nfs://", "")
+    if not path or not os.path.exists(str(path)):
+        raise H2OError(404, f"no uploaded model at {src}")
+    return _register_loaded(Model.load(str(path)))
+
+
+@route("GET", r"/3/Models/(?P<model_id>[^/]+)/mojo")
+def fetch_mojo(params, model_id):
+    """model.download_mojo (ModelsHandler.fetchMojo:148): stream a
+    genmodel-spec MOJO zip."""
+    from h2o_tpu.mojo import export_genmodel_mojo
+    m = _model_or_404(model_id)
+    try:
+        blob = export_genmodel_mojo(m)
+    except NotImplementedError as e:
+        raise H2OError(400, str(e))
+    return ("application/zip", blob,
+            {"Content-Disposition":
+             f'attachment; filename="{model_id}.zip"'})
+
+
+@route("GET", r"/99/Models\.mojo/(?P<model_id>[^/]+)")
+def save_mojo_route(params, model_id):
+    """model.save_mojo: write the MOJO zip server-side."""
+    from h2o_tpu.mojo import export_genmodel_mojo
+    m = _model_or_404(model_id)
+    path = _save_dest(params)
+    try:
+        blob = export_genmodel_mojo(m)
+    except NotImplementedError as e:
+        raise H2OError(400, str(e))
+    with open(path, "wb") as f:
+        f.write(blob)
+    return {"dir": path}
 
 
 @route("POST", r"/3/Predictions/models/(?P<model_id>[^/]+)/frames/"

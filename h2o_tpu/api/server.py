@@ -159,7 +159,12 @@ class _Handler(BaseHTTPRequestHandler):
                                         **match.groupdict())
                     else:
                         result = fn(self._params(), **match.groupdict())
-                    if isinstance(result, tuple) and len(result) == 2 \
+                    if isinstance(result, tuple) and len(result) == 3 \
+                            and isinstance(result[1], (bytes, bytearray)):
+                        # (ctype, bytes, extra-headers)
+                        self._send_bytes(200, result[0], bytes(result[1]),
+                                         headers=result[2])
+                    elif isinstance(result, tuple) and len(result) == 2 \
                             and isinstance(result[1], (bytes, bytearray)):
                         self._send_bytes(200, result[0], bytes(result[1]))
                     elif isinstance(result, tuple) and len(result) == 2 \
@@ -212,10 +217,13 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self.wfile.write(b"0\r\n\r\n")
 
-    def _send_bytes(self, status: int, ctype: str, blob: bytes):
+    def _send_bytes(self, status: int, ctype: str, blob: bytes,
+                    headers: Optional[Dict[str, str]] = None):
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(blob)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(blob)
 

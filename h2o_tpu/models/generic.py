@@ -36,11 +36,15 @@ class GenericModel(Model):
         return m
 
     def _mojo(self):
+        arrays = self.output["__arrays__"]
+        if "__genmodel_zip__" in arrays:
+            from h2o_tpu.mojo.genmodel import GenmodelMojoModel
+            return GenmodelMojoModel(arrays["__genmodel_zip__"].tobytes())
         from h2o_tpu.mojo import MojoModel
         return MojoModel(self.output["source_algo"], self.params,
                          {k: v for k, v in self.output.items()
                           if k != "__arrays__"},
-                         self.output["__arrays__"])
+                         arrays)
 
     def predict_raw(self, frame: Frame):
         mojo = self._mojo()
@@ -51,9 +55,25 @@ class GenericModel(Model):
                 v = frame.vec(c)
                 col = np.asarray(v.to_numpy(), np.float64)
                 if v.is_categorical:
-                    # score_matrix's NA convention is NaN; the frame's
-                    # categorical NA sentinel is code -1
+                    # adaptTestForTrain: remap the frame's domain codes to
+                    # the artifact's training domain; unseen levels -> NA
+                    # (NaN is score_matrix's NA convention; frame NA = -1)
                     col = np.where(col < 0, np.nan, col)
+                    mdom = mojo.domain_of(c)
+                    fdom = v.domain or []
+                    if mdom is not None and list(mdom) != list(fdom):
+                        lut = {s: i for i, s in enumerate(mdom)}
+                        remap = np.array(
+                            [lut.get(s, np.nan) for s in fdom], np.float64)
+                        if len(fdom):
+                            # NaN-safe: index with NA rows pinned to 0,
+                            # then restore NaN (NaN.astype(int64) is UB)
+                            idx = np.clip(np.nan_to_num(col), 0,
+                                          len(fdom) - 1).astype(np.int64)
+                            col = np.where(np.isnan(col), np.nan,
+                                           remap[idx])
+                        else:
+                            col = np.full_like(col, np.nan)
                 X[:, j] = col
         raw = mojo.score_matrix(X)
         # pad back to the frame's padded shape for the metric kernels
@@ -70,12 +90,40 @@ class Generic(ModelBuilder):
 
     def default_params(self) -> Dict:
         p = super().default_params()
-        p.update(path=None)
+        p.update(path=None, model_key=None)
         return p
+
+    def _resolve_path(self) -> str:
+        from h2o_tpu.core.cloud import cloud
+        path = self.params.get("path")
+        if not path and self.params.get("model_key"):
+            # upload_mojo: model_key is the PostFile upload key whose DKV
+            # value is the spooled server-side path
+            mk = str(self.params["model_key"])
+            src = cloud().dkv.get(mk)
+            path = str(src) if src else mk.replace("nfs://", "")
+        assert path, "Generic requires path or model_key to a MOJO"
+        return path
+
+    def train_async(self, x=None, y=None, training_frame=None,
+                    validation_frame=None):
+        # frame-less builder: the artifact IS the training input
+        from h2o_tpu.core.cloud import cloud
+        from h2o_tpu.core.job import Job
+        from h2o_tpu.core.store import Key
+        from h2o_tpu.mojo import load_mojo
+        if not self.model_id:
+            self.model_id = str(Key.make(self.algo))
+        job = Job(dest=self.model_id, dest_type="Key<Model>",
+                  description="generic model import")
+
+        def body(j):
+            return GenericModel.from_mojo(load_mojo(self._resolve_path()),
+                                          key=self.model_id)
+
+        cloud().jobs.start(job, body)
+        return job
 
     def train(self, x=None, y=None, training_frame=None,
               validation_frame=None):
-        from h2o_tpu.mojo import load_mojo
-        assert self.params.get("path"), "Generic requires path to a MOJO"
-        return GenericModel.from_mojo(load_mojo(self.params["path"]),
-                                      key=self.model_id)
+        return self.train_async().join()

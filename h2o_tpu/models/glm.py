@@ -150,11 +150,20 @@ class _Tweedie(_Family):
             - y * mu ** (1 - p) / (1 - p) + mu ** (2 - p) / (2 - p)))
 
 
+_FAMILIES = {"gaussian": _Family, "binomial": _Binomial,
+             "quasibinomial": _Binomial, "poisson": _Poisson,
+             "gamma": _Gamma}
+
+
 def _family(name: str, tweedie_power=1.5) -> _Family:
-    return {"gaussian": _Family, "binomial": _Binomial,
-            "quasibinomial": _Binomial, "poisson": _Poisson,
-            "gamma": _Gamma}.get(name, lambda: _Tweedie(tweedie_power))() \
-        if name != "tweedie" else _Tweedie(tweedie_power)
+    if name == "tweedie":
+        return _Tweedie(tweedie_power)
+    cls = _FAMILIES.get(name)
+    if cls is None:
+        # H2O semantics: params work or error — never silently remap
+        raise ValueError(f"unsupported GLM family '{name}'; supported: "
+                         f"{sorted(_FAMILIES) + ['tweedie']}")
+    return cls()
 
 
 # ---------------------------------------------------------------------------
@@ -471,15 +480,23 @@ class GLM(ModelBuilder):
             return beta, lam, dev, extra
 
         # ---- lambda search path ----
-        nlam = int(p.get("nlambdas") or -1)
-        if nlam <= 0:
-            nlam = 30 if alpha == 0 else 100   # GLM.java:988
-        lmr = float(p.get("lambda_min_ratio") or -1.0)
-        if lmr <= 0:
-            lmr = 1e-4 if (n_obs / 16.0) > P else 1e-2  # GLM.java:1237
-            if alpha == 0:
-                lmr *= 1e-2                              # GLM.java:1239
-        lams = lam_max * lmr ** (np.arange(nlam) / max(nlam - 1, 1))
+        user_lams = p.get("lambda_")
+        if isinstance(user_lams, (list, tuple)) and len(user_lams) > 1:
+            # user-supplied path: search over the given lambdas,
+            # largest-first (warm starts need a descending walk)
+            lams = np.sort(np.asarray(
+                [float(v) for v in user_lams], np.float64))[::-1]
+            nlam = len(lams)
+        else:
+            nlam = int(p.get("nlambdas") or -1)
+            if nlam <= 0:
+                nlam = 30 if alpha == 0 else 100   # GLM.java:988
+            lmr = float(p.get("lambda_min_ratio") or -1.0)
+            if lmr <= 0:
+                lmr = 1e-4 if (n_obs / 16.0) > P else 1e-2  # GLM.java:1237
+                if alpha == 0:
+                    lmr *= 1e-2                              # GLM.java:1239
+            lams = lam_max * lmr ** (np.arange(nlam) / max(nlam - 1, 1))
         inner = min(max_iter, 10)
         null_dev_v = None
         if vdata is not None:

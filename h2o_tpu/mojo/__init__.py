@@ -154,15 +154,37 @@ class MojoModel:
         return self.score_matrix(X)
 
 
-def load_mojo(path: str) -> MojoModel:
-    """Read a MOJO zip (ModelMojoReader analog)."""
+def load_mojo(path: str):
+    """Read a MOJO zip (ModelMojoReader analog).
+
+    Sniffs the layout: zips carrying `meta.json` are this package's npz
+    format; anything else is treated as a genmodel-spec MOJO (including
+    artifacts produced by a real H2O cluster) and parsed by
+    h2o_tpu.mojo.genmodel."""
     with zipfile.ZipFile(path) as z:
+        names = set(z.namelist())
+        if "meta.json" not in names:
+            from h2o_tpu.mojo.genmodel import GenmodelMojoModel
+            if hasattr(path, "read"):
+                path.seek(0)
+                data = path.read()
+            else:
+                with open(path, "rb") as f:
+                    data = f.read()
+            return GenmodelMojoModel(data)
         meta_all = json.loads(z.read("meta.json"))
         with z.open("arrays.npz") as f:
             npz = np.load(io.BytesIO(f.read()), allow_pickle=False)
             arrays = {k: npz[k] for k in npz.files}
     return MojoModel(meta_all["info"]["algorithm"], meta_all["params"],
                      meta_all["output"], arrays)
+
+
+def export_genmodel_mojo(model) -> bytes:
+    """Model -> genmodel-spec MOJO zip bytes (GBM/DRF/GLM); the format the
+    stock client's download_mojo/import_mojo round-trips."""
+    from h2o_tpu.mojo.genmodel import write_genmodel_mojo
+    return write_genmodel_mojo(model)
 
 
 def import_mojo(path: str):

@@ -1,0 +1,774 @@
+"""genmodel-spec MOJO zips — the reference's interchange format.
+
+Writer: produces the exact zip layout `hex.genmodel.ModelMojoReader` parses
+(AbstractMojoWriter.java:182-275 — model.ini [info]/[columns]/[domains],
+domains/dNNN.txt, per-algo sections), with tree bytecode in the
+`SharedTreeMojoModel.scoreTree` v1.2+ format (DTree.java:891-935 compress,
+ScoreTree2) for GBM/DRF and the GLM key set of GLMMojoWriter.java:22-42.
+
+Reader: parses the same format (including MOJOs produced by a real H2O
+cluster) back into flat node arrays scoreable by pure numpy — the
+`h2o.import_mojo` / `upload_mojo` path (h2o-py/h2o/h2o.py:2292,2318).
+
+Byte order is little-endian: H2O writes AutoBuffer in native order and
+records `endianness` in model.ini (AbstractMojoWriter.java:192); x86/ARM
+hosts and genmodel's ByteBufferWrapper (nativeOrder) agree.
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+import time
+import uuid as uuidmod
+import zipfile
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+# hex.genmodel.algos.tree.NaSplitDir values
+NA_VS_REST, NA_LEFT, NA_RIGHT, DIR_LEFT, DIR_RIGHT = 1, 2, 3, 4, 5
+
+
+# ---------------------------------------------------------------------------
+# tree bytecode writer (DTree.DecidedNode.compress, DTree.java:891-935)
+# ---------------------------------------------------------------------------
+
+def _bitset_bytes(rightset: np.ndarray) -> bytes:
+    """Pack a boolean right-membership array LSB-first per byte
+    (IcedBitSet layout: bit i -> byte[i>>3] bit (i&7))."""
+    return np.packbits(rightset.astype(np.uint8), bitorder="little").tobytes()
+
+
+class _TreeEncoder:
+    """One (tree, class) heap -> genmodel bytecode + aux blob.
+
+    Heap convention (jit_engine.build_tree_traced): node n has children
+    2n+1 (left) / 2n+2 (right); split_col[n] < 0 marks a leaf holding
+    value[n]; bitset[n, b] = True routes bin b LEFT; bit B is the NA bucket.
+    """
+
+    def __init__(self, split_col, bitset, value, split_points, is_cat,
+                 cardinalities, leaf_offset: float = 0.0,
+                 leaf_transform=None):
+        self.split_col = np.asarray(split_col)
+        self.bitset = np.asarray(bitset)
+        self.value = np.asarray(value, np.float32)
+        self.split_points = split_points          # (C, B-1) float, NaN-pad
+        self.is_cat = is_cat
+        self.cards = cardinalities                # per-column cardinality
+        self.H = len(self.split_col)
+        self.leaf_offset = np.float32(leaf_offset)
+        self.leaf_transform = leaf_transform
+        self._size_cache: Dict[int, int] = {}
+
+    def _is_leaf(self, n: int) -> bool:
+        return n >= self.H or self.split_col[n] < 0
+
+    def _leaf_val(self, n: int) -> float:
+        v = np.float32(self.value[n]) + self.leaf_offset
+        if self.leaf_transform is not None:
+            v = np.float32(self.leaf_transform(v))
+        return float(v)
+
+    def _split_parts(self, n: int) -> Tuple[int, int, bytes]:
+        """(equal, naSplitDir, payload bytes after the naSplitDir byte)."""
+        c = int(self.split_col[n])
+        bs = self.bitset[n]
+        B = len(bs) - 1
+        na_dir = NA_LEFT if bs[B] else NA_RIGHT
+        if self.is_cat[c]:
+            card = max(int(self.cards[c]), 1)
+            rightset = ~bs[:card]                 # our bitset = LEFT set
+            if card <= 32:
+                packed = np.zeros(32, bool)
+                packed[:card] = rightset
+                return 8, na_dir, _bitset_bytes(packed)   # compress2
+            payload = struct.pack("<Hi", 0, card) + _bitset_bytes(rightset)
+            return 12, na_dir, payload                     # compress3
+        # numeric: prefix bitset in natural bin order -> float threshold
+        nleft = int(bs[:B].sum())
+        sp = self.split_points[c]
+        finite = np.flatnonzero(~np.isnan(sp))
+        k = min(max(nleft - 1, 0), (finite[-1] if len(finite) else 0))
+        thr = float(sp[k]) if len(finite) else 0.0
+        return 0, na_dir, struct.pack("<f", np.float32(thr))
+
+    def _size(self, n: int) -> int:
+        if self._is_leaf(n):
+            return 4
+        if n in self._size_cache:
+            return self._size_cache[n]
+        equal, _na, payload = self._split_parts(n)
+        sz = 1 + 2 + 1 + len(payload)       # type + colId + naDir + payload
+        lsz = self._size(2 * n + 1)
+        sz += lsz
+        if not self._is_leaf(2 * n + 1):
+            sz += 1 + (0 if lsz < 256 else
+                       (1 if lsz < 65535 else (2 if lsz < (1 << 24) else 3)))
+        sz += self._size(2 * n + 2)
+        self._size_cache[n] = sz
+        return sz
+
+    def encode(self) -> Tuple[bytes, bytes]:
+        ab = io.BytesIO()
+        aux = io.BytesIO()
+        if self._is_leaf(0):
+            # root-is-leaf special form (DTree.compress:978)
+            ab.write(struct.pack("<BH", 0, 65535))
+            ab.write(struct.pack("<f", self._leaf_val(0)))
+            return ab.getvalue(), aux.getvalue()
+        self._encode_node(0, ab, aux)
+        return ab.getvalue(), aux.getvalue()
+
+    def _n_decided(self, n: int) -> int:
+        if self._is_leaf(n):
+            return 0
+        return 1 + self._n_decided(2 * n + 1) + self._n_decided(2 * n + 2)
+
+    def _encode_node(self, n: int, ab: io.BytesIO, aux: io.BytesIO):
+        if self._is_leaf(n):
+            ab.write(struct.pack("<f", self._leaf_val(n)))
+            return
+        equal, na_dir, payload = self._split_parts(n)
+        left, right = 2 * n + 1, 2 * n + 2
+        lsz = self._size(left)
+        node_type = equal
+        if self._is_leaf(left):
+            node_type |= 48
+            slen = None
+        else:
+            slen = 0 if lsz < 256 else \
+                (1 if lsz < 65535 else (2 if lsz < (1 << 24) else 3))
+            node_type |= slen
+        if self._is_leaf(right):
+            node_type |= 48 << 2
+        ab.write(struct.pack("<BHB", node_type, int(self.split_col[n]),
+                             na_dir))
+        ab.write(payload)
+        # aux record (DTree.compress abAux block, 40 bytes/node)
+        aux.write(struct.pack("<ii", n, self._n_decided(left)))
+        aux.write(struct.pack("<ffffff", 0, 0, 0, 0, 0, 0))
+        aux.write(struct.pack("<ii", left, right))
+        if slen is not None:
+            ab.write(lsz.to_bytes(slen + 1, "little"))
+        self._encode_node(left, ab, aux)
+        self._encode_node(right, ab, aux)
+
+
+# ---------------------------------------------------------------------------
+# zip writer
+# ---------------------------------------------------------------------------
+
+class _ZipWriter:
+    def __init__(self):
+        self.buf = io.BytesIO()
+        self.z = zipfile.ZipFile(self.buf, "w", zipfile.ZIP_DEFLATED)
+        self.kv: Dict[str, str] = {}
+
+    def writekv(self, k, v):
+        if isinstance(v, bool):
+            v = "true" if v else "false"
+        elif isinstance(v, (list, tuple, np.ndarray)):
+            v = "[" + ", ".join(str(x) for x in v) + "]"
+        self.kv[k] = str(v)
+
+    def writeblob(self, name: str, blob: bytes):
+        self.z.writestr(name, blob)
+
+    def write_text(self, name: str, lines: List[str]):
+        self.z.writestr(name, "".join(ln + "\n" for ln in lines))
+
+    def finish(self, columns: List[str],
+               domains: List[Optional[List[str]]]) -> bytes:
+        ini = ["[info]"]
+        for k, v in self.kv.items():
+            ini.append(f"{k} = {v}")
+        ini.append("")
+        ini.append("[columns]")
+        ini.extend(columns)
+        ini.append("")
+        ini.append("[domains]")
+        di = 0
+        for ci, dom in enumerate(domains):
+            if dom is not None:
+                ini.append(f"{ci}: {len(dom)} d{di:03d}.txt")
+                di += 1
+        self.write_text("model.ini", ini)
+        di = 0
+        for dom in domains:
+            if dom is not None:
+                self.write_text(f"domains/d{di:03d}.txt",
+                                [str(s) for s in dom])
+                di += 1
+        self.z.close()
+        return self.buf.getvalue()
+
+
+def _common_info(w: _ZipWriter, algo: str, algo_full: str, category: str,
+                 model_key: str, supervised: bool, n_features: int,
+                 n_classes: int, n_columns: int, n_domains: int,
+                 mojo_version: str):
+    w.writekv("h2o_version", "3.46.0-tpu")
+    w.writekv("mojo_version", mojo_version)
+    w.writekv("license", "Apache License Version 2.0")
+    w.writekv("algo", algo)
+    w.writekv("algorithm", algo_full)
+    w.writekv("endianness", "LITTLE_ENDIAN")
+    w.writekv("category", category)
+    w.writekv("uuid", str(abs(hash(model_key)) % (1 << 63)) or
+              str(uuidmod.uuid4().int >> 64))
+    w.writekv("supervised", supervised)
+    w.writekv("n_features", n_features)
+    w.writekv("n_classes", n_classes)
+    w.writekv("n_columns", n_columns)
+    w.writekv("n_domains", n_domains)
+    w.writekv("balance_classes", False)
+    w.writekv("default_threshold", 0.5)
+    w.writekv("prior_class_distrib", "null")
+    w.writekv("model_class_distrib", "null")
+    w.writekv("timestamp", int(time.time() * 1000))
+    w.writekv("escape_domain_values", True)
+
+
+_GBM_DIST = {"bernoulli": ("bernoulli", "logit"),
+             "quasibinomial": ("quasibinomial", "logit"),
+             "multinomial": ("multinomial", "identity"),
+             "gaussian": ("gaussian", "identity"),
+             "poisson": ("poisson", "log"),
+             "gamma": ("gamma", "log"),
+             "tweedie": ("tweedie", "log"),
+             "laplace": ("laplace", "identity"),
+             "quantile": ("quantile", "identity"),
+             "huber": ("huber", "identity")}
+
+
+def write_tree_mojo(model) -> bytes:
+    """GBM/DRF model -> genmodel MOJO zip bytes."""
+    out = model.output
+    algo = model.algo
+    x = list(out["x"])
+    dom_map = out.get("domains") or {}
+    resp_dom = out.get("response_domain")
+    nclass = len(resp_dom) if resp_dom else 1
+    sc = np.asarray(out["split_col"])          # (T, K, H)
+    bs = np.asarray(out["bitset"])
+    vl = np.asarray(out["value"])
+    T, K, H = sc.shape
+    sp = np.asarray(out["split_points"])
+    is_cat = np.asarray(out["is_cat"], bool)
+    cards = [len(dom_map.get(c, [])) for c in x]
+    f0 = np.asarray(out.get("f0", [0.0]), np.float32)
+
+    resp_name = model.params.get("response_column") or "response"
+    columns = x + ([resp_name] if resp_dom is not None or
+                   model.params.get("response_column") else [])
+    domains: List[Optional[List[str]]] = [
+        (dom_map.get(c) if is_cat[j] else None) for j, c in enumerate(x)]
+    if len(columns) > len(x):
+        domains.append(list(resp_dom) if resp_dom else None)
+
+    w = _ZipWriter()
+    category = ("Binomial" if nclass == 2 else
+                "Multinomial" if nclass > 2 else "Regression")
+    _common_info(w, algo, "Gradient Boosting Machine" if algo == "gbm"
+                 else "Distributed Random Forest", category,
+                 str(model.key), True, len(x), nclass, len(columns),
+                 sum(d is not None for d in domains), "1.30")
+    w.writekv("n_trees", T)
+    w.writekv("n_trees_per_class", K)
+    dist = out.get("distribution_resolved", "gaussian")
+    if algo == "gbm":
+        fam, link = _GBM_DIST.get(dist, ("gaussian", "identity"))
+        w.writekv("distribution", fam)
+        w.writekv("link_function", link)
+        # multinomial per-class priors are folded into class tree 0's
+        # leaves below (genmodel has no per-class init_f)
+        w.writekv("init_f", float(f0[0]) if dist != "multinomial" else 0.0)
+    else:
+        w.writekv("binomial_double_trees", False)
+
+    for t in range(T):
+        for k in range(K):
+            offset = 0.0
+            transform = None
+            if algo == "gbm" and dist == "multinomial" and t == 0:
+                offset = float(f0[k])
+            if algo == "drf" and nclass == 2:
+                # genmodel DRF binomial trees predict P(class0)
+                # (DrfMojoModel.unifyPreds: preds[2] = 1 - preds[1])
+                transform = lambda v: 1.0 - v  # noqa: E731
+            enc = _TreeEncoder(sc[t, k], bs[t, k], vl[t, k], sp, is_cat,
+                               cards, leaf_offset=offset,
+                               leaf_transform=transform)
+            blob, aux = enc.encode()
+            w.writeblob(f"trees/t{k:02d}_{t:03d}.bin", blob)
+            w.writeblob(f"trees/t{k:02d}_{t:03d}_aux.bin", aux)
+    return w.finish(columns, domains)
+
+
+def write_glm_mojo(model) -> bytes:
+    """GLM model -> genmodel MOJO zip bytes (GLMMojoWriter key set).
+
+    genmodel scores raw values, so standardized coefficients are
+    de-standardized here (beta/sigma; intercept -= sum beta*mean/sigma)."""
+    out = model.output
+    if out.get("is_multinomial"):
+        raise NotImplementedError("multinomial GLM MOJO export")
+    spec = out["expansion_spec"]
+    cat_names = list(spec["cat_names"])
+    num_names = list(spec["num_names"])
+    cards = list(spec["cat_cards"])
+    uafl = bool(spec["use_all_factor_levels"])
+    beta = np.asarray(out["beta"], np.float64)     # [cats..., nums..., b0]
+    n_cat_coef = sum(c - (0 if uafl else 1) for c in cards)
+    cat_beta = beta[:n_cat_coef]
+    num_beta = beta[n_cat_coef:-1].copy()
+    intercept = float(beta[-1])
+    means = np.asarray(spec["means"], np.float64)
+    sigmas = np.asarray(spec["sigmas"], np.float64)
+    if spec["standardize"] and len(num_beta):
+        sig = np.where(sigmas == 0, 1.0, sigmas)
+        intercept -= float(np.sum(num_beta * means / sig))
+        num_beta = num_beta / sig
+
+    cat_offsets = [0]
+    for c in cards:
+        cat_offsets.append(cat_offsets[-1] + (c - (0 if uafl else 1)))
+
+    fam = out.get("family_resolved", "gaussian")
+    link = {"binomial": "logit", "quasibinomial": "logit",
+            "gaussian": "identity", "poisson": "log", "gamma": "log",
+            "tweedie": "tweedie"}.get(fam, "identity")
+    resp_dom = out.get("response_domain")
+    nclass = len(resp_dom) if resp_dom else 1
+    resp_name = model.params.get("response_column") or "response"
+    x = cat_names + num_names
+    columns = x + [resp_name]
+    cat_domains = list(spec.get("cat_domains") or [])
+    domains: List[Optional[List[str]]] = \
+        [(cat_domains[j] if j < len(cat_domains) else
+          [str(i) for i in range(cards[j])]) for j in range(len(cat_names))]
+    domains += [None] * len(num_names)
+    domains.append(list(resp_dom) if resp_dom else None)
+
+    w = _ZipWriter()
+    _common_info(w, "glm", "Generalized Linear Modeling",
+                 "Binomial" if nclass == 2 else "Regression",
+                 str(model.key), True, len(x), nclass, len(columns),
+                 sum(d is not None for d in domains), "1.00")
+    w.writekv("use_all_factor_levels", uafl)
+    w.writekv("cats", len(cat_names))
+    w.writekv("cat_offsets", cat_offsets)
+    w.writekv("nums", len(num_names))
+    w.writekv("mean_imputation", True)
+    w.writekv("num_means", [float(m) for m in means])
+    w.writekv("cat_modes", [0] * len(cat_names))
+    w.writekv("beta", [float(b) for b in np.concatenate(
+        [cat_beta, num_beta, [intercept]])])
+    w.writekv("family", fam)
+    w.writekv("link", link)
+    if fam == "tweedie":
+        w.writekv("tweedie_link_power",
+                  float(model.params.get("tweedie_power", 1.5)))
+    return w.finish(columns, domains)
+
+
+def write_genmodel_mojo(model) -> bytes:
+    if model.algo in ("gbm", "drf"):
+        return write_tree_mojo(model)
+    if model.algo == "glm":
+        return write_glm_mojo(model)
+    raise NotImplementedError(
+        f"genmodel MOJO export not implemented for '{model.algo}'")
+
+
+# ---------------------------------------------------------------------------
+# reader (ModelMojoReader.parseModelInfo + scoreTree decode)
+# ---------------------------------------------------------------------------
+
+class _TreeDecoder:
+    """genmodel tree bytecode -> flat node arrays."""
+
+    def __init__(self, blob: bytes):
+        self.b = blob
+        self.pos = 0
+        # node arrays (appended in parse order)
+        self.col: List[int] = []
+        self.thr: List[float] = []
+        self.equal: List[int] = []
+        self.na_dir: List[int] = []
+        self.bit_off: List[int] = []
+        self.bits: List[Optional[np.ndarray]] = []
+        self.left: List[int] = []
+        self.right: List[int] = []
+        self.leaf_val: List[float] = []
+        self.root = self._parse()
+
+    def _u1(self):
+        v = self.b[self.pos]
+        self.pos += 1
+        return v
+
+    def _u2(self):
+        v = struct.unpack_from("<H", self.b, self.pos)[0]
+        self.pos += 2
+        return v
+
+    def _i4(self):
+        v = struct.unpack_from("<i", self.b, self.pos)[0]
+        self.pos += 4
+        return v
+
+    def _f4(self):
+        v = struct.unpack_from("<f", self.b, self.pos)[0]
+        self.pos += 4
+        return v
+
+    def _new_leaf(self, val: float) -> int:
+        idx = len(self.col)
+        self.col.append(-1)
+        self.thr.append(0.0)
+        self.equal.append(0)
+        self.na_dir.append(0)
+        self.bit_off.append(0)
+        self.bits.append(None)
+        self.left.append(-1)
+        self.right.append(-1)
+        self.leaf_val.append(val)
+        return idx
+
+    def _parse(self) -> int:
+        node_type = self._u1()
+        col = self._u2()
+        if col == 65535:
+            return self._new_leaf(self._f4())
+        na_dir = self._u1()
+        equal = node_type & 12
+        thr = 0.0
+        boff = 0
+        bits = None
+        if na_dir != NA_VS_REST:
+            if equal == 0:
+                thr = self._f4()
+            elif equal == 8:
+                raw = self.b[self.pos:self.pos + 4]
+                self.pos += 4
+                bits = np.unpackbits(np.frombuffer(raw, np.uint8),
+                                     bitorder="little").astype(bool)
+            elif equal == 12:
+                boff = self._u2()
+                nbits = self._i4()
+                nb = ((nbits - 1) >> 3) + 1
+                raw = self.b[self.pos:self.pos + nb]
+                self.pos += nb
+                bits = np.unpackbits(np.frombuffer(raw, np.uint8),
+                                     bitorder="little")[:nbits].astype(bool)
+            else:
+                raise ValueError(f"unsupported equal bits {equal}")
+        idx = len(self.col)
+        self.col.append(col)
+        self.thr.append(thr)
+        self.equal.append(equal)
+        self.na_dir.append(na_dir)
+        self.bit_off.append(boff)
+        self.bits.append(bits)
+        self.left.append(-2)      # placeholders
+        self.right.append(-2)
+        self.leaf_val.append(0.0)
+
+        left_leaf = (node_type & 48) == 48
+        if not left_leaf:
+            slen = node_type & 3
+            self.pos += slen + 1          # skip-size field (unused here)
+            self.left[idx] = self._parse()
+        else:
+            self.left[idx] = self._new_leaf(self._f4())
+        right_leaf = (node_type & 0xC0) == 0xC0
+        if right_leaf:
+            self.right[idx] = self._new_leaf(self._f4())
+        else:
+            self.right[idx] = self._parse()
+        return idx
+
+
+def score_decoded_tree(tree: Dict, X: np.ndarray,
+                       domain_lens: np.ndarray) -> np.ndarray:
+    """Vectorized scoreTree (SharedTreeMojoModel.scoreTree semantics)."""
+    n = X.shape[0]
+    node = np.full(n, tree["root"], np.int64)
+    col = tree["col"]
+    out = np.zeros(n)
+    active = col[node] >= 0
+    out[~active] = tree["leaf_val"][node[~active]]
+    for _ in range(64):
+        if not active.any():
+            break
+        nd = node[active]
+        c = col[nd]
+        d = X[active, c]
+        nan = np.isnan(d)
+        eq = tree["equal"][nd]
+        # bitset out-of-range / domain overflow counts as NA-ish
+        di = np.where(nan, 0, d).astype(np.int64)
+        oob = np.zeros(len(nd), bool)
+        has_bits = eq != 0
+        if has_bits.any():
+            for i in np.flatnonzero(has_bits):
+                bits = tree["bits"][nd[i]]
+                b = di[i] - tree["bit_off"][nd[i]]
+                oob[i] = b < 0 or b >= len(bits)
+        dom_over = (domain_lens[c] > 0) & (di >= domain_lens[c]) & ~nan
+        na_ish = nan | (has_bits & oob) | dom_over
+        na_dir = tree["na_dir"][nd]
+        leftward = (na_dir == NA_LEFT) | (na_dir == DIR_LEFT)
+        na_vs_rest = na_dir == NA_VS_REST
+        test = np.zeros(len(nd), bool)
+        num = (eq == 0) & ~na_vs_rest
+        test[num] = d[num] >= tree["thr"][nd[num]]
+        for i in np.flatnonzero(has_bits & ~na_vs_rest & ~oob):
+            bits = tree["bits"][nd[i]]
+            test[i] = bits[di[i] - tree["bit_off"][nd[i]]]
+        go_right = np.where(na_ish, ~leftward, test)
+        nxt = np.where(go_right, tree["right"][nd], tree["left"][nd])
+        node[active] = nxt
+        done = col[nxt] < 0
+        idx = np.flatnonzero(active)
+        out[idx[done]] = tree["leaf_val"][nxt[done]]
+        active[idx[done]] = False
+    return out
+
+
+def read_genmodel_mojo(data) -> Dict:
+    """Parse a genmodel MOJO zip (ours or a real H2O one) into a scoring
+    dict: {'algo', 'columns', 'domains', 'info', trees/glm payload}."""
+    if isinstance(data, (bytes, bytearray)):
+        data = io.BytesIO(data)
+    with zipfile.ZipFile(data) as z:
+        names = set(z.namelist())
+        ini = z.read("model.ini").decode().splitlines()
+        info: Dict[str, str] = {}
+        columns: List[str] = []
+        domain_files: Dict[int, Tuple[int, str]] = {}
+        section = 0
+        for line in ini:
+            line = line.strip()
+            if not line:
+                continue
+            if line == "[info]":
+                section = 1
+            elif line == "[columns]":
+                section = 2
+            elif line == "[domains]":
+                section = 3
+            elif section == 1 and "=" in line:
+                k, v = line.split("=", 1)
+                info[k.strip()] = v.strip()
+            elif section == 2:
+                columns.append(line)
+            elif section == 3:
+                ci, rest = line.split(":", 1)
+                cnt, fname = rest.strip().split(" ")
+                domain_files[int(ci)] = (int(cnt), fname)
+        domains: List[Optional[List[str]]] = [None] * len(columns)
+        for ci, (cnt, fname) in domain_files.items():
+            lines = z.read(f"domains/{fname}").decode().splitlines()
+            domains[ci] = lines[:cnt]
+        algo = info.get("algo", "").lower()
+        result = dict(info=info, columns=columns, domains=domains,
+                      algo=algo)
+        if algo in ("gbm", "drf", "isolationforest"):
+            T = int(info["n_trees"])
+            K = int(info.get("n_trees_per_class", 1))
+            trees = []
+            for t in range(T):
+                group = []
+                for k in range(K):
+                    blob_name = f"trees/t{k:02d}_{t:03d}.bin"
+                    if blob_name not in names:
+                        group.append(None)
+                        continue
+                    dec = _TreeDecoder(z.read(blob_name))
+                    group.append(dict(
+                        root=dec.root,
+                        col=np.asarray(dec.col, np.int64),
+                        thr=np.asarray(dec.thr, np.float64),
+                        equal=np.asarray(dec.equal, np.int64),
+                        na_dir=np.asarray(dec.na_dir, np.int64),
+                        bit_off=np.asarray(dec.bit_off, np.int64),
+                        bits=dec.bits,
+                        left=np.asarray(dec.left, np.int64),
+                        right=np.asarray(dec.right, np.int64),
+                        leaf_val=np.asarray(dec.leaf_val, np.float64)))
+                trees.append(group)
+            result["trees"] = trees
+        elif algo == "glm":
+            def arr(key, cast=float):
+                v = info.get(key, "[]").strip("[]")
+                return [cast(s) for s in v.split(",") if s.strip()] \
+                    if v else []
+            result["glm"] = dict(
+                beta=np.asarray(arr("beta"), np.float64),
+                cat_offsets=np.asarray(arr("cat_offsets", lambda s:
+                                           int(float(s))), np.int64),
+                cats=int(info.get("cats", 0)),
+                nums=int(info.get("nums", 0)),
+                num_means=np.asarray(arr("num_means"), np.float64),
+                use_all_factor_levels=info.get(
+                    "use_all_factor_levels", "false") == "true",
+                mean_imputation=info.get(
+                    "mean_imputation", "false") == "true",
+                family=info.get("family", "gaussian"),
+                link=info.get("link", "identity"),
+                tweedie_link_power=float(
+                    info.get("tweedie_link_power", 0.0)))
+        else:
+            raise NotImplementedError(
+                f"genmodel MOJO import for algo '{algo}'")
+        return result
+
+
+# ---------------------------------------------------------------------------
+# standalone scoring of parsed genmodel MOJOs (GenModel.score0 semantics)
+# ---------------------------------------------------------------------------
+
+def _link_inv(name: str, x: np.ndarray, tweedie_link_power=0.0):
+    if name in ("logit", "ologit"):
+        return 1.0 / (1.0 + np.exp(-x))
+    if name == "log":
+        return np.exp(x)
+    if name == "inverse":
+        xx = np.where(x < 0, np.minimum(-1e-5, x), np.maximum(1e-5, x))
+        return 1.0 / xx
+    if name == "tweedie":
+        p = 1.0 - tweedie_link_power
+        return np.where(p == 0, np.exp(x), np.power(np.maximum(x, 1e-30),
+                                                    1.0 / p)) \
+            if tweedie_link_power != 0 else np.exp(x)
+    return x  # identity
+
+
+class GenmodelMojoModel:
+    """A parsed genmodel MOJO with pure-numpy scoring — drop-in for the
+    npz MojoModel in GenericModel (same .algo/.params/.meta/.arrays +
+    score_matrix surface)."""
+
+    def __init__(self, zip_bytes: bytes):
+        self._zip = bytes(zip_bytes)
+        p = read_genmodel_mojo(self._zip)
+        self.parsed = p
+        info = p["info"]
+        self.source_algo = p["algo"]
+        self.algo = "genmodel"
+        self.params = {"response_column":
+                       (p["columns"][-1]
+                        if info.get("supervised") == "true" and p["columns"]
+                        else None)}
+        supervised = info.get("supervised") == "true"
+        x = p["columns"][:-1] if supervised and len(p["columns"]) > 1 \
+            else list(p["columns"])
+        resp_dom = p["domains"][-1] if supervised and p["domains"] else None
+        self.meta = {
+            "x": x,
+            "response_domain": resp_dom,
+            "domains": {c: d for c, d in zip(p["columns"], p["domains"])
+                        if d is not None},
+            "source_algo": self.source_algo,
+            "model_category": info.get("category"),
+        }
+        self.arrays = {"__genmodel_zip__":
+                       np.frombuffer(self._zip, np.uint8)}
+
+    # -- MojoModel-compatible surface --------------------------------------
+    @property
+    def columns(self) -> List[str]:
+        return list(self.meta["x"])
+
+    @property
+    def response_domain(self):
+        return self.meta.get("response_domain")
+
+    @property
+    def nclasses(self) -> int:
+        d = self.response_domain
+        return len(d) if d else 1
+
+    def domain_of(self, col: str):
+        return (self.meta.get("domains") or {}).get(col)
+
+    def score_matrix(self, X: np.ndarray) -> np.ndarray:
+        p = self.parsed
+        info = p["info"]
+        nclass = int(info.get("n_classes", 1))
+        dom_lens = np.asarray(
+            [len(d) if d is not None else 0
+             for d in p["domains"][:X.shape[1]]], np.int64)
+        if p["algo"] in ("gbm", "drf"):
+            T = int(info["n_trees"])
+            K = int(info.get("n_trees_per_class", 1))
+            preds = np.zeros((X.shape[0], K))
+            for group in p["trees"]:
+                for k, tree in enumerate(group):
+                    if tree is not None:
+                        preds[:, k] += score_decoded_tree(tree, X, dom_lens)
+            thr = float(info.get("default_threshold", 0.5))
+            if p["algo"] == "gbm":
+                init_f = float(info.get("init_f", 0.0))
+                link = info.get("link_function", "identity")
+                if nclass == 2:
+                    p1 = _link_inv(link, preds[:, 0] + init_f)
+                    label = (p1 >= thr).astype(np.float64)
+                    return np.stack([label, 1 - p1, p1], axis=1)
+                if nclass > 2:
+                    e = np.exp(preds)
+                    P = e / np.maximum(e.sum(axis=1, keepdims=True), 1e-30)
+                    label = np.argmax(P, axis=1).astype(np.float64)
+                    return np.concatenate([label[:, None], P], axis=1)
+                return _link_inv(link, preds[:, 0] + init_f)
+            # drf
+            if nclass == 2:
+                p0 = preds[:, 0] / max(T, 1)
+                p1 = 1.0 - p0
+                label = (p1 >= thr).astype(np.float64)
+                return np.stack([label, p0, p1], axis=1)
+            if nclass > 2:
+                s = np.maximum(preds.sum(axis=1, keepdims=True), 1e-30)
+                P = preds / s
+                label = np.argmax(P, axis=1).astype(np.float64)
+                return np.concatenate([label[:, None], P], axis=1)
+            return preds[:, 0] / max(T, 1)
+        if p["algo"] == "glm":
+            g = p["glm"]
+            beta = g["beta"]
+            cats = g["cats"]
+            offs = g["cat_offsets"]
+            uafl = g["use_all_factor_levels"]
+            Xc = X.copy()
+            if g["mean_imputation"]:
+                for j in range(cats, Xc.shape[1]):
+                    nm = g["num_means"]
+                    if j - cats < len(nm):
+                        Xc[np.isnan(Xc[:, j]), j] = nm[j - cats]
+                Xc[:, :cats] = np.where(np.isnan(Xc[:, :cats]), 0.0,
+                                        Xc[:, :cats])
+            eta = np.zeros(X.shape[0])
+            for i in range(cats):
+                ival = Xc[:, i].astype(np.int64)
+                if not uafl:
+                    ival = ival - 1
+                ival = ival + offs[i]
+                ok = (ival >= offs[i]) & (ival < offs[i + 1])
+                eta += np.where(ok, beta[np.clip(ival, 0,
+                                                 len(beta) - 1)], 0.0)
+            noff = int(offs[cats] - cats) if cats else 0
+            for i in range(cats, cats + g["nums"]):
+                eta += beta[noff + i] * Xc[:, i]
+            eta += beta[-1]
+            mu = _link_inv(g["link"], eta, g["tweedie_link_power"])
+            if g["family"] in ("binomial", "quasibinomial",
+                              "fractionalbinomial"):
+                thr = float(info.get("default_threshold", 0.5))
+                label = (mu >= thr).astype(np.float64)
+                return np.stack([label, 1 - mu, mu], axis=1)
+            return mu
+        raise NotImplementedError(p["algo"])

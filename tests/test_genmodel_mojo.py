@@ -1,0 +1,134 @@
+"""genmodel-spec MOJO export/import + artifact-vs-cluster cross-scoring.
+
+Reference: hex/genmodel ModelMojoReader zip layout, SharedTreeMojoModel
+scoreTree bytecode, GLMMojoWriter key set; testdir_javapredict is the
+consistency-oracle pattern (cluster predict == artifact predict).
+"""
+
+import io
+import zipfile
+
+import numpy as np
+import pytest
+
+from h2o_tpu.core.frame import Frame, Vec, T_CAT
+
+
+def _mixed_frame(rng, n=800):
+    x0 = rng.normal(size=n).astype(np.float32)
+    x1 = rng.normal(size=n).astype(np.float32)
+    cat = rng.integers(0, 5, size=n)
+    x0[rng.integers(0, n, 30)] = np.nan          # NAs route via NA bucket
+    logits = 1.5 * x0 - x1 + 0.7 * (cat % 2)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-np.nan_to_num(logits)))
+         ).astype(np.int32)
+    return Frame(
+        ["x0", "x1", "c", "y"],
+        [Vec(x0), Vec(x1),
+         Vec(cat, T_CAT, domain=["a", "b", "cc", "d", "e"]),
+         Vec(y, T_CAT, domain=["no", "yes"])])
+
+
+def _cross_score(model, fr, tol=1e-5):
+    """Export genmodel MOJO -> parse -> score -> compare to in-cluster."""
+    from h2o_tpu.mojo import export_genmodel_mojo
+    from h2o_tpu.mojo.genmodel import GenmodelMojoModel
+    blob = export_genmodel_mojo(model)
+    gm = GenmodelMojoModel(blob)
+    cols = gm.columns
+    X = np.full((fr.nrows, len(cols)), np.nan)
+    for j, c in enumerate(cols):
+        v = fr.vec(c)
+        col = np.asarray(v.to_numpy(), np.float64)
+        if v.is_categorical:
+            col = np.where(col < 0, np.nan, col)
+        X[:, j] = col
+    raw_mojo = np.atleast_2d(np.asarray(gm.score_matrix(X)))
+    raw_cluster = np.asarray(model.predict_raw(fr))[: fr.nrows]
+    raw_cluster = np.atleast_2d(raw_cluster.T).T \
+        if raw_cluster.ndim == 1 else raw_cluster
+    if raw_mojo.shape != raw_cluster.shape:
+        raw_mojo = raw_mojo.reshape(raw_cluster.shape)
+    np.testing.assert_allclose(raw_mojo, raw_cluster, atol=tol, rtol=1e-4)
+    return blob
+
+
+def test_gbm_mojo_cross_scoring(cl, rng):
+    from h2o_tpu.models.tree.gbm import GBM
+    fr = _mixed_frame(rng)
+    m = GBM(ntrees=8, max_depth=4, seed=3, nbins=16).train(
+        y="y", training_frame=fr)
+    blob = _cross_score(m, fr)
+    # layout sanity: genmodel reader requirements
+    with zipfile.ZipFile(io.BytesIO(blob)) as z:
+        names = z.namelist()
+        assert "model.ini" in names
+        assert "trees/t00_000.bin" in names
+        assert "trees/t00_000_aux.bin" in names
+        assert any(n.startswith("domains/d") for n in names)
+        ini = z.read("model.ini").decode()
+        assert "algo = gbm" in ini
+        assert "n_trees = 8" in ini
+        assert "distribution = bernoulli" in ini
+        assert "[columns]" in ini and "[domains]" in ini
+
+
+def test_gbm_regression_mojo(cl, rng):
+    from h2o_tpu.models.tree.gbm import GBM
+    n = 500
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    y = (2 * X[:, 0] - X[:, 1] ** 2).astype(np.float32)
+    fr = Frame(["a", "b", "c", "y"],
+               [Vec(X[:, 0]), Vec(X[:, 1]), Vec(X[:, 2]), Vec(y)])
+    m = GBM(ntrees=5, max_depth=3, seed=1).train(y="y", training_frame=fr)
+    _cross_score(m, fr)
+
+
+def test_gbm_multinomial_mojo(cl, rng):
+    from h2o_tpu.models.tree.gbm import GBM
+    n = 600
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (np.abs(X[:, 0]) + X[:, 1] > 1).astype(int) + \
+        (X[:, 2] > 0.5).astype(int)
+    fr = Frame([f"x{j}" for j in range(4)] + ["y"],
+               [Vec(X[:, j]) for j in range(4)] +
+               [Vec(y, T_CAT, domain=["r", "g", "b"])])
+    m = GBM(ntrees=4, max_depth=3, seed=1).train(y="y", training_frame=fr)
+    _cross_score(m, fr)
+
+
+def test_drf_mojo_cross_scoring(cl, rng):
+    from h2o_tpu.models.tree.drf import DRF
+    fr = _mixed_frame(rng)
+    m = DRF(ntrees=6, max_depth=5, seed=3, nbins=16).train(
+        y="y", training_frame=fr)
+    _cross_score(m, fr)
+
+
+def test_glm_mojo_cross_scoring(cl, rng):
+    from h2o_tpu.models.glm import GLM
+    fr = _mixed_frame(rng)
+    m = GLM(family="binomial", lambda_=0.0, seed=1).train(
+        y="y", training_frame=fr)
+    _cross_score(m, fr, tol=1e-4)
+
+
+def test_mojo_roundtrip_as_generic(cl, rng, tmp_path):
+    """import_mojo path: written zip loads as a Generic model that scores
+    identically to the source model through the Frame surface."""
+    from h2o_tpu.models.tree.gbm import GBM
+    from h2o_tpu.mojo import export_genmodel_mojo, import_mojo
+    fr = _mixed_frame(rng)
+    m = GBM(ntrees=5, max_depth=3, seed=7, nbins=16).train(
+        y="y", training_frame=fr)
+    p = tmp_path / "model.zip"
+    p.write_bytes(export_genmodel_mojo(m))
+    gen = import_mojo(str(p))
+    pf_src = m.predict(fr)
+    pf_gen = gen.predict(fr)
+    a = np.asarray(pf_src.vecs[2].to_numpy())[: fr.nrows]
+    b = np.asarray(pf_gen.vecs[2].to_numpy())[: fr.nrows]
+    np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-4)
+    # and its metrics flow through the standard surface
+    mm = gen.model_metrics(fr)
+    assert mm.data["AUC"] > 0.6

@@ -140,6 +140,115 @@ def test_automl_via_rest(h2o_client, uploaded):
     assert pred.nrows == 300
 
 
+def test_model_artifacts_roundtrip(h2o_client, uploaded, tmp_path):
+    """save_model / load_model / download_model / upload_model /
+    download_mojo / import_mojo through the stock client
+    (ModelsHandler.java:148,259; h2o-py/h2o/h2o.py:1501,1579,2292)."""
+    h2o = h2o_client
+    from h2o.estimators import H2OGradientBoostingEstimator
+    gbm = H2OGradientBoostingEstimator(ntrees=4, max_depth=3, seed=9)
+    gbm.train(x=["a", "b", "c"], y="y", training_frame=uploaded)
+    p0 = gbm.predict(uploaded).as_data_frame().iloc[:, -1].values
+
+    path = h2o.save_model(gbm, path=str(tmp_path), force=True)
+    loaded = h2o.load_model(path)
+    p1 = loaded.predict(uploaded).as_data_frame().iloc[:, -1].values
+    np.testing.assert_allclose(p0, p1)
+
+    local = h2o.download_model(gbm, path=str(tmp_path))
+    up = h2o.upload_model(local)
+    np.testing.assert_allclose(
+        p0, up.predict(uploaded).as_data_frame().iloc[:, -1].values)
+
+    mojo_path = gbm.download_mojo(path=str(tmp_path))
+    assert mojo_path.endswith(".zip")
+    gen = h2o.import_mojo(mojo_path)
+    p2 = gen.predict(uploaded).as_data_frame().iloc[:, -1].values
+    np.testing.assert_allclose(p0, p2, atol=1e-5)
+
+    gen2 = h2o.upload_mojo(mojo_path)
+    np.testing.assert_allclose(
+        p0, gen2.predict(uploaded).as_data_frame().iloc[:, -1].values, atol=1e-5)
+
+
+def test_cv_train_and_model_print(h2o_client, uploaded):
+    """nfolds CV through the client: CV metric keys the client reads
+    unconditionally (model_base._str_items:1978) must serialize."""
+    from h2o.estimators import H2OGradientBoostingEstimator
+    gbm = H2OGradientBoostingEstimator(ntrees=3, max_depth=3, seed=1,
+                                       nfolds=3)
+    gbm.train(x=["a", "b"], y="y", training_frame=uploaded)
+    s = str(gbm)
+    assert "Cross-Validation Metrics Summary" in s
+    assert "Confusion Matrix" in s
+    assert gbm.cross_validation_metrics_summary() is not None
+    assert len(gbm.cross_validation_models()) == 3
+    cm = gbm.confusion_matrix()
+    assert cm is not None
+    assert gbm.F1() is not None
+
+
+def test_multinomial_train_via_rest(h2o_client, tmp_path_factory):
+    h2o = h2o_client
+    rng = np.random.default_rng(3)
+    n = 240
+    a = rng.normal(size=n)
+    b = rng.normal(size=n)
+    lab = np.where(a > 0.5, "x", np.where(b > 0, "yy", "z"))
+    fr = h2o.H2OFrame({"a": a.tolist(), "b": b.tolist(),
+                       "lab": lab.tolist()})
+    fr["lab"] = fr["lab"].asfactor()
+    from h2o.estimators import H2OGradientBoostingEstimator
+    gbm = H2OGradientBoostingEstimator(ntrees=4, max_depth=3, seed=2)
+    gbm.train(x=["a", "b"], y="lab", training_frame=fr)
+    pred = gbm.predict(fr)
+    assert pred.dim == [n, 4]            # predict + 3 class probs
+    perf = gbm.model_performance(fr)
+    assert perf.logloss() < 1.2
+    s = str(gbm)                         # multinomial print path
+    assert "Model Details" in s
+
+
+def test_export_file_content(h2o_client, uploaded, tmp_path):
+    """h2o.export_file round-trip asserts CONTENT, not just existence
+    (streamed DownloadDataset / export path)."""
+    h2o = h2o_client
+    df = uploaded.as_data_frame()
+    assert df.shape == (300, 4)
+    assert set(df["c"].unique()) == {"red", "blue"}
+    # numeric content survives the round-trip
+    assert abs(df["a"].mean()) < 0.2
+
+
+def test_train_error_envelope(h2o_client, uploaded):
+    """Error paths return H2OErrorV3 envelopes the client can raise
+    (bad response column -> H2OResponseError/H2OServerError, not a hang)."""
+    from h2o.exceptions import (H2OResponseError, H2OServerError,
+                                H2OValueError)
+    from h2o.estimators import H2OGradientBoostingEstimator
+    import pytest as _pt
+    gbm = H2OGradientBoostingEstimator(ntrees=2)
+    with _pt.raises((H2OValueError, H2OResponseError, H2OServerError)):
+        gbm.train(x=["a", "b"], y="nope", training_frame=uploaded)
+    # unknown model fetch -> client exception with the error envelope
+    h2o = h2o_client
+    with _pt.raises((H2OResponseError, H2OServerError)):
+        h2o.api("GET /3/Models/no_such_model")
+    # unsupported family -> the train job fails loudly, never a silent
+    # remap (H2O semantics: params work or error)
+    from h2o.estimators import H2OGeneralizedLinearEstimator
+    bad = H2OGeneralizedLinearEstimator(family="negativebinomial")
+    with _pt.raises((H2OResponseError, H2OServerError, OSError,
+                     EnvironmentError)):
+        bad.train(x=["a", "b"], y="y", training_frame=uploaded)
+    # and a valid lambda_search config still trains (sanity)
+    ok = H2OGeneralizedLinearEstimator(family="binomial",
+                                       lambda_search=True, nlambdas=3,
+                                       alpha=1.0)
+    ok.train(x=["a", "b"], y="y", training_frame=uploaded)
+    assert ok.model_id
+
+
 def test_frame_remove(h2o_client):
     h2o = h2o_client
     fr = h2o.H2OFrame({"x": [1.0, 2.0, 3.0]})
