@@ -86,6 +86,23 @@ def logs(params, node, file):
 
 @route("POST", r"/3/Shutdown")
 def shutdown(params):
+    """h2o.cluster().shutdown(): cancel running jobs, clear the store, and
+    stop the REST server (after this response flushes) — the reference
+    exits the JVM; here the cloud process may host other work, so the
+    cluster's serving surface dies but the process survives."""
+    import threading as _t
+    from h2o_tpu.api.server import RestServer, request_context
+    c = cloud()
+    for job in c.jobs.list():
+        if job.is_running:
+            job.cancel()
+    for k in list(c.dkv.keys()):
+        c.dkv.remove(k)
+    # stop the server that RECEIVED this request (not a process-global):
+    # multiple live servers each shut down only themselves
+    srv = getattr(request_context, "server", None) or RestServer.current
+    if srv is not None:
+        _t.Timer(0.5, srv.stop).start()
     return {}
 
 
@@ -482,6 +499,10 @@ def rapids_route(params):
     if result is None:
         return {"key": None}
     if isinstance(result, Frame):
+        # un-assigned frame results must still resolve by key afterwards
+        # (h2o.rapids() callers get_frame the returned key)
+        if cloud().dkv.get(str(result.key)) is not result:
+            cloud().dkv.put(result.key, result)
         return {"key": _key(result.key, "Key<Frame>"),
                 "num_rows": result.nrows, "num_cols": result.ncols}
     if isinstance(result, (int, float)):
@@ -489,8 +510,9 @@ def rapids_route(params):
     if isinstance(result, list):
         if result and isinstance(result[0], tuple):
             return {"string": str([x[1] for x in result])}
-        return {"scalar": None, "funstr": None,
-                "numlist": [float(x) for x in result]}
+        # per-column numeric results (ValNums): the client accepts a list
+        # in the 'scalar' slot (h2o-py/h2o/expr.py:116-117)
+        return {"scalar": [float(x) for x in result]}
     return {"string": str(result)}
 
 
@@ -558,7 +580,7 @@ def build_model(params, algo):
         b.model_id = params["model_id"]
     y = params.get("response_column")
     x = None
-    if params.get("ignored_columns"):
+    if params.get("ignored_columns") and fr is not None:
         ign = _coerce(params["ignored_columns"], [])
         x = [c for c in fr.names if c not in ign and c != y]
     job = b.train_async(x=x, y=y, training_frame=fr,

@@ -28,6 +28,11 @@ log = get_logger("api")
 # route table: (method, regex, handler, raw_body)
 _ROUTES: List[Tuple[str, re.Pattern, Callable, bool]] = []
 
+# the RestServer owning the request on THIS thread (handlers that act on
+# their own server — e.g. POST /3/Shutdown — resolve it here, so multiple
+# live servers in one process each shut down the right instance)
+request_context = threading.local()
+
 
 def route(method: str, pattern: str, raw: bool = False):
     """Register a handler for e.g. ("GET", r"/3/Frames/(?P<frame_id>[^/]+)").
@@ -132,6 +137,8 @@ class _Handler(BaseHTTPRequestHandler):
         }
 
     def _dispatch(self, method: str):
+        request_context.server = getattr(self.server, "_rest_server",
+                                         None)
         path = unquote(urlparse(self.path).path)
         for m, rx, fn, raw in _ROUTES:
             if m != method:
@@ -245,11 +252,14 @@ class _Handler(BaseHTTPRequestHandler):
 class RestServer:
     """The embedded web server (H2O.startNetworkServices analog)."""
 
+    current: Optional["RestServer"] = None   # POST /3/Shutdown target
+
     def __init__(self, port: Optional[int] = None, ip: str = "127.0.0.1"):
         import h2o_tpu.api.handlers  # noqa: F401 — registers routes
         self.port = port if port is not None else cloud().args.port
         self.ip = ip
         self.httpd = ThreadingHTTPServer((ip, self.port), _Handler)
+        self.httpd._rest_server = self
         self.port = self.httpd.server_port
         self.thread: Optional[threading.Thread] = None
 
@@ -257,9 +267,12 @@ class RestServer:
         self.thread = threading.Thread(target=self.httpd.serve_forever,
                                        name="h2o-rest", daemon=True)
         self.thread.start()
+        RestServer.current = self
         log.info("REST server on http://%s:%d", self.ip, self.port)
         return self
 
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+        if RestServer.current is self:
+            RestServer.current = None

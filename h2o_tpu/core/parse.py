@@ -80,6 +80,10 @@ def _sniff_sep(sample_lines: List[str]) -> str:
 
 def _cell_type(tok: str) -> str:
     tok = tok.strip()
+    # unquote: clients may quote EVERY cell (h2o-py H2OFrame(dict) upload
+    # CSV uses QUOTE_ALL); '"1.0"' types numeric, '""' is NA
+    if len(tok) >= 2 and tok[0] == tok[-1] and tok[0] in "\"'":
+        tok = tok[1:-1].strip()
     if tok in _NA_STRINGS:
         return "na"
     try:
@@ -207,10 +211,13 @@ def _parse_native(paths: Sequence[str], setup: ParseSetupResult,
         na_mask = np.isin(col, list(na_bytes)) & ~quoted
         if t == T_TIME:
             import pandas as pd
-            ms = pd.to_datetime(
-                pd.Series(col.astype("U")), errors="coerce").astype("int64")
-            vals = np.where(ms == np.iinfo(np.int64).min, np.nan,
-                            ms / 1e6).astype(np.float64)
+            # pin ms resolution: pandas>=2 infers s/us/ns per input, so
+            # a bare astype(int64) is resolution-dependent
+            dt = pd.to_datetime(pd.Series(col.astype("U")),
+                                errors="coerce")
+            ms = dt.to_numpy().astype("datetime64[ms]").astype("int64")
+            vals = np.where(pd.isna(dt).to_numpy(), np.nan,
+                            ms.astype(np.float64))
             vals[na_mask] = np.nan
             vecs.append(Vec(vals, T_TIME))
         elif t == T_STR:
@@ -283,9 +290,10 @@ def parse_files(paths: Sequence[str], setup: Optional[ParseSetupResult] = None,
             vals = pd.to_numeric(col, errors="coerce").to_numpy(np.float32)
             vecs.append(Vec(vals, T_NUM))
         elif t == T_TIME:
-            ms = pd.to_datetime(col, errors="coerce").astype("int64")
-            vals = np.where(ms == np.iinfo(np.int64).min, np.nan,
-                            ms / 1e6).astype(np.float64)
+            dt = pd.to_datetime(col, errors="coerce")
+            ms = dt.to_numpy().astype("datetime64[ms]").astype("int64")
+            vals = np.where(pd.isna(dt).to_numpy(), np.nan,
+                            ms.astype(np.float64))
             vecs.append(Vec(vals, T_TIME))
         elif t == T_STR:
             vecs.append(Vec([None if v is None else str(v) for v in col],

@@ -20,7 +20,6 @@ from __future__ import annotations
 import io
 import struct
 import time
-import uuid as uuidmod
 import zipfile
 from typing import Dict, List, Optional, Tuple
 
@@ -216,8 +215,10 @@ def _common_info(w: _ZipWriter, algo: str, algo_full: str, category: str,
     w.writekv("algorithm", algo_full)
     w.writekv("endianness", "LITTLE_ENDIAN")
     w.writekv("category", category)
-    w.writekv("uuid", str(abs(hash(model_key)) % (1 << 63)) or
-              str(uuidmod.uuid4().int >> 64))
+    # deterministic per model key (hash() varies with PYTHONHASHSEED)
+    import hashlib
+    w.writekv("uuid", str(int.from_bytes(
+        hashlib.md5(model_key.encode()).digest()[:8], "big")))
     w.writekv("supervised", supervised)
     w.writekv("n_features", n_features)
     w.writekv("n_classes", n_classes)
@@ -501,7 +502,8 @@ def score_decoded_tree(tree: Dict, X: np.ndarray,
     out = np.zeros(n)
     active = col[node] >= 0
     out[~active] = tree["leaf_val"][node[~active]]
-    for _ in range(64):
+    max_depth = len(tree["col"]) + 1    # every step consumes a node
+    for _ in range(max_depth):
         if not active.any():
             break
         nd = node[active]
@@ -536,6 +538,9 @@ def score_decoded_tree(tree: Dict, X: np.ndarray,
         idx = np.flatnonzero(active)
         out[idx[done]] = tree["leaf_val"][nxt[done]]
         active[idx[done]] = False
+    if active.any():
+        raise RuntimeError("MOJO tree traversal did not terminate "
+                           "(corrupt tree bytecode?)")
     return out
 
 

@@ -252,6 +252,7 @@ def _masked(fn_np):
 class _Env:
     def __init__(self, session: Session):
         self.s = session
+        self.locals: Dict[str, Any] = {}   # apply-lambda arg bindings
 
 
 _BINOPS = {
@@ -290,7 +291,18 @@ def _eval(node, env: _Env):
         if tag == "str":
             return node
         if tag == "id":
-            return s.lookup(node[1])
+            name = node[1]
+            # Rapids boolean/NA literals (Rapids.java grammar; the client
+            # serializes python bools as bare True/False ids)
+            if name in ("TRUE", "True", "true"):
+                return 1.0
+            if name in ("FALSE", "False", "false"):
+                return 0.0
+            if name in ("NA", "NaN", "nan"):
+                return float("nan")
+            if name in env.locals:
+                return env.locals[name]
+            return s.lookup(name)
         if tag == "numlist":
             return node
     if not isinstance(node, list):
@@ -360,6 +372,9 @@ def _eval(node, env: _Env):
         return _elementwise(_BINOPS[op], a, b)
     if op in _UNOPS:
         return _elementwise(_UNOPS[op], _eval(node[1], env))
+    if op in ("sumNA", "minNA", "maxNA", "meanNA", "medianNA", "sdNA",
+              "varNA"):
+        op = op[:-2]    # NA-skipping variants; rollups already skip NAs
     if op in ("mean", "sum", "min", "max", "sd", "var", "median"):
         fr = _as_frame(_eval(node[1], env))
         def red(v):
@@ -434,9 +449,17 @@ def _eval(node, env: _Env):
                 out.append(v)
         return Frame(list(fr.names), out)
     if op == "levels":
+        # AstLevels returns a FRAME: one column per input column, rows =
+        # level labels NA-padded (client: frame.py levels() zips columns)
         fr = _as_frame(_eval(node[1], env))
-        v = fr.vecs[0]
-        return [("str", d) for d in (v.domain or [])]
+        doms = [list(v.domain or []) for v in fr.vecs]
+        width = max((len(d) for d in doms), default=0) or 1
+        vecs = []
+        for d in doms:
+            codes = np.asarray(list(range(len(d))) +
+                               [-1] * (width - len(d)), np.int32)
+            vecs.append(Vec(codes, T_CAT, domain=d or ["_"]))
+        return Frame(list(fr.names), vecs)
     if op == "unique":
         fr = _as_frame(_eval(node[1], env))
         v = fr.vecs[0]
@@ -490,10 +513,12 @@ def _eval(node, env: _Env):
     if op in ("is.factor", "anyfactor"):
         fr = _as_frame(_eval(node[1], env))
         flags = [v.is_categorical for v in fr.vecs]
-        return float(any(flags) if op == "anyfactor" else flags[0])
+        if op == "anyfactor":
+            return float(any(flags))
+        return [float(f) for f in flags]   # ValNums: one per column
     if op == "is.numeric":
         fr = _as_frame(_eval(node[1], env))
-        return float(fr.vecs[0].is_numeric)
+        return [float(v.is_numeric) for v in fr.vecs]
     if op == ":=":
         return _update(node, env)
     if op == "append":
@@ -505,8 +530,8 @@ def _eval(node, env: _Env):
         return out
     if op == "h2o.impute":
         return _impute(node, env)
-    if op == "setLevel" or op == "relevel":
-        pass  # fallthrough to error for now
+    if op in _EXTRA_OPS:
+        return _EXTRA_OPS[op](node, env)
     raise NotImplementedError(f"rapids op {op!r}")
 
 
@@ -1004,6 +1029,671 @@ def _impute(node, env):
     out = Frame(list(fr.names), list(fr.vecs))
     out.vecs[col] = newv
     return out
+
+
+# ---------------------------------------------------------------------------
+# extended prim set — closes the client-emittable op inventory
+# (reference: water/rapids/ast/prims/**; client call sites cited per op)
+# ---------------------------------------------------------------------------
+
+def _strlist(sel) -> List[str]:
+    """A numlist of string literals (client-sent column-name lists)."""
+    if sel is None:
+        return []
+    if isinstance(sel, tuple) and sel[0] == "numlist":
+        return [_lit(x) for x in sel[1]]
+    if isinstance(sel, tuple) and sel[0] == "str":
+        return [sel[1]]
+    return [sel]
+
+
+def _col_sel_indices(fr: Frame, sel) -> List[int]:
+    """Column selector that accepts indices OR names."""
+    if isinstance(sel, tuple) and sel[0] == "numlist":
+        items = sel[1]
+        if items and isinstance(items[0], tuple) and items[0][0] == "str":
+            return [fr.names.index(_lit(x)) for x in items]
+        return _expand_numlist(items)
+    if isinstance(sel, tuple) and sel[0] == "str":
+        return [fr.names.index(sel[1])]
+    if isinstance(sel, float):
+        return [int(sel)]
+    raise TypeError(f"bad column selector {sel}")
+
+
+def _labels_of(v: Vec) -> List[Optional[str]]:
+    """Row label strings of a str/categorical column."""
+    if v.type == T_STR:
+        return [None if x is None else str(x) for x in v.host_data]
+    if v.is_categorical:
+        dom = v.domain or []
+        return [dom[int(c)] if c >= 0 else None
+                for c in np.asarray(v.to_numpy())[: v.nrows]]
+    raise TypeError("expected a string/categorical column")
+
+
+def _op_scale(node, env):
+    """(scale fr center scale) — AstScale; center/scale are bools or
+    per-column numlists (h2o-py frame.py:4260)."""
+    fr = _as_frame(_eval(node[1], env))
+
+    def spec(arg, defaults):
+        if isinstance(arg, tuple) and arg[0] == "numlist":
+            return [float(x) for x in arg[1]]
+        # evaluate so bare True/False id literals resolve to 1.0/0.0
+        flag = _eval(arg, env)
+        return defaults if flag else None
+    num_idx = [j for j, v in enumerate(fr.vecs) if v.is_numeric]
+    means = [float(fr.vecs[j].rollups.mean) for j in num_idx]
+    sds = [float(fr.vecs[j].rollups.sigma) or 1.0 for j in num_idx]
+    centers = spec(node[2], means)
+    scales = spec(node[3], sds)
+    out_vecs = list(fr.vecs)
+    for k, j in enumerate(num_idx):
+        x = fr.vecs[j].as_float()
+        if centers is not None:
+            x = x - centers[k]
+        if scales is not None:
+            x = x / (scales[k] or 1.0)
+        out_vecs[j] = Vec(x, nrows=fr.nrows)
+    return Frame(list(fr.names), out_vecs)
+
+
+def _op_hist(node, env):
+    """(hist x breaks) — AstHist.java:38-123; output frame
+    [breaks, counts, mids_true, mids] with a leading NA row."""
+    fr = _as_frame(_eval(node[1], env))
+    v = fr.vecs[0]
+    if not v.is_numeric:
+        raise ValueError("hist only applies to single numeric columns")
+    d = np.asarray(v.to_numpy(), np.float64)[: fr.nrows]
+    d = d[~np.isnan(d)]
+    a = node[2]
+    if isinstance(a, tuple) and a[0] == "numlist":
+        brks = np.asarray([float(x) for x in a[1]], np.float64)
+    else:
+        if isinstance(a, tuple) and a[0] == "str":
+            algo = a[1].lower()
+            n = len(d)
+            if algo == "rice":
+                nb = int(np.ceil(2 * n ** (1.0 / 3)))
+            elif algo == "sqrt":
+                nb = int(np.ceil(np.sqrt(n)))
+            else:                      # sturges default
+                nb = int(np.ceil(np.log2(n) + 1))
+        else:
+            nb = int(a)
+        brks = np.linspace(d.min(), d.max(), nb + 1)
+    counts, _ = np.histogram(d, bins=brks)
+    mids = 0.5 * (brks[:-1] + brks[1:])
+    mids_true = np.array([
+        d[(d >= brks[i]) & (d <= brks[i + 1] if i == len(brks) - 2
+                            else d < brks[i + 1])].mean()
+        if counts[i] else np.nan for i in range(len(brks) - 1)])
+    pad = np.nan
+    return Frame(
+        ["breaks", "counts", "mids_true", "mids"],
+        [Vec(brks.astype(np.float32)),
+         Vec(np.concatenate([[pad], counts]).astype(np.float32)),
+         Vec(np.concatenate([[pad], mids_true]).astype(np.float32)),
+         Vec(np.concatenate([[pad], mids]).astype(np.float32))])
+
+
+def _op_runif(node, env):
+    """(h2o.runif fr seed) — AstRunif (h2o-py frame.py:4612)."""
+    fr = _as_frame(_eval(node[1], env))
+    seed = int(_eval(node[2], env))
+    rng = np.random.default_rng(None if seed < 0 else seed)
+    return Frame(["rnd"], [Vec(rng.uniform(size=fr.nrows)
+                               .astype(np.float32))])
+
+
+def _op_kfold(node, env):
+    """(kfold_column fr n seed) — random fold assignment 0..n-1."""
+    fr = _as_frame(_eval(node[1], env))
+    n = int(_eval(node[2], env))
+    seed = int(_eval(node[3], env)) if len(node) > 3 else -1
+    rng = np.random.default_rng(None if seed < 0 else seed)
+    return Frame(["fold"], [Vec(rng.integers(0, n, fr.nrows)
+                                .astype(np.float32))])
+
+
+def _op_modulo_kfold(node, env):
+    fr = _as_frame(_eval(node[1], env))
+    n = int(_eval(node[2], env))
+    return Frame(["fold"], [Vec((np.arange(fr.nrows) % n)
+                                .astype(np.float32))])
+
+
+def _op_stratified_kfold(node, env):
+    """(stratified_kfold_column y n seed) — per-class round-robin so every
+    fold sees every level (AstStratifiedKFold)."""
+    fr = _as_frame(_eval(node[1], env))
+    n = int(_eval(node[2], env))
+    seed = int(_eval(node[3], env)) if len(node) > 3 else -1
+    y = np.asarray(fr.vecs[0].to_numpy())[: fr.nrows]
+    rng = np.random.default_rng(None if seed < 0 else seed)
+    fold = np.zeros(fr.nrows, np.float32)
+    for k in np.unique(y[~np.isnan(y.astype(np.float64))]):
+        idx = np.flatnonzero(y == k)
+        rng.shuffle(idx)
+        fold[idx] = np.arange(len(idx)) % n
+    return Frame(["fold"], [Vec(fold)])
+
+
+def _op_as_date(node, env):
+    """(as.Date fr format) — string/factor column -> ms since epoch
+    (T_TIME), java SimpleDateFormat-ish patterns mapped to strptime."""
+    from h2o_tpu.core.frame import T_TIME
+    import datetime as _dt
+    fr = _as_frame(_eval(node[1], env))
+    fmt = _lit(node[2])
+    py_fmt = (str(fmt).replace("yyyy", "%Y").replace("yy", "%y")
+              .replace("MM", "%m").replace("dd", "%d")
+              .replace("HH", "%H").replace("mm", "%M").replace("ss", "%S"))
+    out = []
+    for v in fr.vecs:
+        if v.type == T_TIME or (v.is_numeric and not v.is_categorical):
+            # already epoch-ms (the parser types ISO dates as T_TIME):
+            # truncate to the day boundary
+            ms_arr = np.asarray(v.to_numpy(), np.float64)[: fr.nrows]
+            day = np.floor(ms_arr / 86400000.0) * 86400000.0
+            out.append(Vec(np.where(np.isnan(ms_arr), np.nan, day),
+                           T_TIME))
+            continue
+        ms = []
+        for s in _labels_of(v):
+            if s is None:
+                ms.append(np.nan)
+                continue
+            try:
+                dt = _dt.datetime.strptime(s, py_fmt)
+                ms.append(dt.replace(tzinfo=_dt.timezone.utc)
+                          .timestamp() * 1000.0)
+            except ValueError:
+                ms.append(np.nan)
+        out.append(Vec(np.asarray(ms, np.float64), T_TIME))
+    return Frame(list(fr.names), out)
+
+
+def _mktime_like(node, env, zero_based: bool):
+    """(mktime y M d H m s ms) / (moment ...) — args are scalars or
+    1-col frames; returns ms since epoch.  mktime's month/day are 0-based
+    (AstMktime.java), moment's are 1-based (AstMoment / h2o-py
+    frame.py:1385 passes calendar values raw)."""
+    import datetime as _dt
+    args = [_eval(a, env) for a in node[1:8]]
+    while len(args) < 7:
+        args.append(0.0)
+    nrows = max([a.nrows for a in args if isinstance(a, Frame)],
+                default=1)
+
+    def col(a, default=0.0):
+        if isinstance(a, Frame):
+            return np.asarray(a.vecs[0].to_numpy(), np.float64)[:nrows]
+        return np.full(nrows, float(a) if a is not None else default)
+    y, mo, d, h, mi, s, ms = (col(a) for a in args)
+    off = 1 if zero_based else 0
+    out = np.full(nrows, np.nan)
+    for i in range(nrows):
+        try:
+            dt = _dt.datetime(int(y[i]), int(mo[i]) + off,
+                              int(d[i]) + off, int(h[i]), int(mi[i]),
+                              int(s[i]), tzinfo=_dt.timezone.utc)
+            out[i] = dt.timestamp() * 1000.0 + float(ms[i])
+        except (ValueError, OverflowError):
+            out[i] = np.nan
+    from h2o_tpu.core.frame import T_TIME
+    return Frame(["mktime"], [Vec(out, T_TIME)])
+
+
+def _op_which_extreme(op, node, env):
+    """(which.max fr skipna axis) — AstWhichMax/Min: axis 0 = per-column
+    row index (1-row frame), axis 1 = per-row column index (1-col frame);
+    skipna=False lets NAs poison the result (h2o-py frame.py:4712-4756)."""
+    fr = _as_frame(_eval(node[1], env))
+    skipna = bool(_eval(node[2], env)) if len(node) > 2 else True
+    axis = int(_eval(node[3], env)) if len(node) > 3 else 0
+    arg = np.nanargmax if op == "which.max" else np.nanargmin
+    arg_strict = np.argmax if op == "which.max" else np.argmin
+    if axis == 1:
+        num = [j for j, v in enumerate(fr.vecs) if v.is_numeric]
+        mat = np.stack([np.asarray(fr.vecs[j].to_numpy(),
+                                   np.float64)[: fr.nrows]
+                        for j in num], axis=1)
+        nanrow = np.isnan(mat).all(axis=1) if skipna \
+            else np.isnan(mat).any(axis=1)
+        safe = np.where(np.isnan(mat), -np.inf if op == "which.max"
+                        else np.inf, mat) if skipna else mat
+        idx = arg_strict(safe, axis=1).astype(np.float64)
+        return Frame(["which.max" if op == "which.max" else "which.min"],
+                     [Vec(np.where(nanrow, np.nan, idx))])
+    vecs, names = [], []
+    for n, v in zip(fr.names, fr.vecs):
+        if not v.is_numeric:
+            continue
+        names.append(n)
+        d = np.asarray(v.to_numpy(), np.float64)[: fr.nrows]
+        if np.isnan(d).all() or (not skipna and np.isnan(d).any()):
+            vecs.append(Vec(np.asarray([np.nan], np.float32)))
+            continue
+        idx = arg(d)
+        vecs.append(Vec(np.asarray([float(idx)], np.float32)))
+    return Frame(names, vecs)
+
+
+def _op_topn(node, env):
+    """(topn fr col nPercent grabTopN) — AstTopN: [row index, value] of
+    the top/bottom nPercent of a column (grabTopN: 1 top, -1 bottom)."""
+    fr = _as_frame(_eval(node[1], env))
+    col = int(_eval(node[2], env))
+    npct = float(_eval(node[3], env))
+    top = int(_eval(node[4], env)) >= 0
+    d = np.asarray(fr.vecs[col].to_numpy(), np.float64)[: fr.nrows]
+    ok = np.flatnonzero(~np.isnan(d))
+    k = max(1, int(round(npct / 100.0 * len(ok))))
+    order = ok[np.argsort(d[ok], kind="stable")]
+    chosen = order[-k:][::-1] if top else order[:k]
+    return Frame(
+        ["Row Indices", fr.names[col]],
+        [Vec(chosen.astype(np.float64)),
+         Vec(d[chosen].astype(np.float32))])
+
+
+def _op_grep(node, env):
+    """(grep fr regex ignore_case invert output_logical) —
+    ast/prims/string/AstGrep (h2o-py frame.py:4195)."""
+    fr = _as_frame(_eval(node[1], env))
+    pat = _lit(node[2])
+    icase = bool(int(_eval(node[3], env))) if len(node) > 3 else False
+    invert = bool(int(_eval(node[4], env))) if len(node) > 4 else False
+    logical = bool(int(_eval(node[5], env))) if len(node) > 5 else False
+    rx = re.compile(str(pat), re.IGNORECASE if icase else 0)
+    labels = _labels_of(fr.vecs[0])
+    hit = np.asarray([bool(rx.search(s)) if s is not None else False
+                      for s in labels])
+    if invert:
+        hit = ~hit
+    if logical:
+        return Frame([fr.names[0]], [Vec(hit.astype(np.float32))])
+    return Frame([fr.names[0]],
+                 [Vec(np.flatnonzero(hit).astype(np.float64))])
+
+
+def _op_strlen(node, env):
+    fr = _as_frame(_eval(node[1], env))
+    out = []
+    for v in fr.vecs:
+        out.append(Vec(np.asarray(
+            [np.nan if s is None else len(s) for s in _labels_of(v)],
+            np.float32)))
+    return Frame(list(fr.names), out)
+
+
+def _op_fillna(node, env):
+    """(h2o.fillna fr method axis maxlen) — AstFillNA forward/backward
+    fill along rows (axis 0) or columns (axis 1)."""
+    fr = _as_frame(_eval(node[1], env))
+    method = str(_lit(node[2])).lower()
+    axis = int(_eval(node[3], env))
+    maxlen = int(_eval(node[4], env))
+    mat = np.stack([np.asarray(v.to_numpy(), np.float64)[: fr.nrows]
+                    for v in fr.vecs], axis=1)
+    if axis == 1:
+        mat = mat.T
+    if method.startswith("back"):
+        mat = mat[::-1]
+    n, c = mat.shape
+    run = np.zeros(c)
+    last = np.full(c, np.nan)
+    for i in range(n):
+        row = mat[i]
+        nan = np.isnan(row)
+        run = np.where(nan, run + 1, 0)
+        fill = nan & (run <= maxlen) & ~np.isnan(last)
+        mat[i] = np.where(fill, last, row)
+        last = np.where(np.isnan(mat[i]), last, mat[i])
+    if method.startswith("back"):
+        mat = mat[::-1]
+    if axis == 1:
+        mat = mat.T
+    return Frame(list(fr.names),
+                 [Vec(mat[:, j].astype(np.float32)) for j in
+                  range(mat.shape[1])])
+
+
+def _moments_reduce(op, node, env):
+    """(skewness fr na_rm) / (kurtosis fr na_rm) — AstSkewness/AstKurtosis
+    (sample skewness; kurtosis NOT excess, matches reference)."""
+    fr = _as_frame(_eval(node[1], env))
+
+    def red(v):
+        d = np.asarray(v.to_numpy(), np.float64)[: v.nrows]
+        d = d[~np.isnan(d)]
+        n = len(d)
+        if n < 2:
+            return float("nan")
+        m = d.mean()
+        s2 = ((d - m) ** 2).sum() / (n - 1)
+        if op == "skewness":
+            return float(((d - m) ** 3).mean() / s2 ** 1.5)
+        return float(((d - m) ** 4).mean() / s2 ** 2)
+    return _reduce_all(red, fr)
+
+
+def _op_dropdup(node, env):
+    """(dropdup fr [cols] keep) — AstDropDuplicates
+    (h2o-py frame.py:3234)."""
+    fr = _as_frame(_eval(node[1], env))
+    cols = _col_sel_indices(fr, node[2])
+    keep = str(_lit(node[3])).strip().lower() if len(node) > 3 else "first"
+    _, inv = _key_codes(fr, cols)
+    keep_mask = np.zeros(fr.nrows, bool)
+    if keep == "last":
+        seen = {}
+        for i, c in enumerate(inv):
+            seen[c] = i
+        keep_mask[list(seen.values())] = True
+    else:
+        seen = set()
+        for i, c in enumerate(inv):
+            if c not in seen:
+                seen.add(c)
+                keep_mask[i] = True
+    return fr.slice_rows(keep_mask)
+
+
+def _op_distance(node, env):
+    """(distance x y measure) — AstDistance: pairwise distances, output
+    (nrow_x, nrow_y) frame (h2o-py frame.py:3219)."""
+    X = _as_frame(_eval(node[1], env))
+    Y = _as_frame(_eval(node[2], env))
+    measure = str(_lit(node[3])).lower()
+    A = np.stack([np.asarray(v.to_numpy(), np.float64)[: X.nrows]
+                  for v in X.vecs], axis=1)
+    B = np.stack([np.asarray(v.to_numpy(), np.float64)[: Y.nrows]
+                  for v in Y.vecs], axis=1)
+    if measure in ("l2", "euclidean"):
+        D = np.sqrt(np.maximum(
+            (A * A).sum(1)[:, None] + (B * B).sum(1)[None, :]
+            - 2 * A @ B.T, 0.0))
+    elif measure in ("l1", "manhattan"):
+        D = np.abs(A[:, None, :] - B[None, :, :]).sum(axis=2)
+    elif measure in ("cosine", "cosine_sq"):
+        na = np.linalg.norm(A, axis=1)
+        nb = np.linalg.norm(B, axis=1)
+        C = (A @ B.T) / np.maximum(na[:, None] * nb[None, :], 1e-12)
+        D = C * C if measure == "cosine_sq" else C
+    else:
+        raise ValueError(f"unknown distance measure {measure!r}")
+    return Frame([f"C{j+1}" for j in range(D.shape[1])],
+                 [Vec(D[:, j].astype(np.float32))
+                  for j in range(D.shape[1])])
+
+
+def _op_melt(node, env):
+    """(melt fr id_vars value_vars var_name value_name skipna) —
+    AstMelt (h2o-py frame.py:3923)."""
+    fr = _as_frame(_eval(node[1], env))
+    id_idx = _col_sel_indices(fr, node[2])
+    val_idx = _col_sel_indices(fr, node[3]) if not (
+        isinstance(node[3], tuple) and node[3][0] == "numlist" and
+        not node[3][1]) else \
+        [j for j in range(fr.ncols) if j not in id_idx]
+    var_name = _lit(node[4]) if len(node) > 4 else "variable"
+    value_name = _lit(node[5]) if len(node) > 5 else "value"
+    skipna = bool(int(_eval(node[6], env))) if len(node) > 6 else False
+    n = fr.nrows
+    var_dom = [fr.names[j] for j in val_idx]
+    ids, var_col, val_col = [], [], []
+    for k, j in enumerate(val_idx):
+        d = np.asarray(fr.vecs[j].to_numpy(), np.float64)[:n]
+        keep = ~np.isnan(d) if skipna else np.ones(n, bool)
+        ids.append(np.flatnonzero(keep))
+        var_col.append(np.full(int(keep.sum()), k, np.int32))
+        val_col.append(d[keep])
+    row_idx = np.concatenate(ids) if ids else np.zeros(0, np.int64)
+    names, vecs = [], []
+    for j in id_idx:
+        v = fr.vecs[j]
+        d = v.to_numpy()[row_idx]
+        vecs.append(Vec(d.astype(np.int32), T_CAT, domain=list(v.domain))
+                    if v.is_categorical else Vec(d, v.type))
+        names.append(fr.names[j])
+    names += [var_name, value_name]
+    vecs += [Vec(np.concatenate(var_col) if var_col else
+                 np.zeros(0, np.int32), T_CAT, domain=var_dom),
+             Vec((np.concatenate(val_col) if val_col else
+                  np.zeros(0)).astype(np.float32))]
+    return Frame(names, vecs)
+
+
+def _op_pivot(node, env):
+    """(pivot fr index column value) — AstPivot
+    (h2o-py frame.py:3891)."""
+    fr = _as_frame(_eval(node[1], env))
+    i_j = _col_sel_indices(fr, node[2])[0]
+    c_j = _col_sel_indices(fr, node[3])[0]
+    v_j = _col_sel_indices(fr, node[4])[0]
+    iv, cv, vv = fr.vecs[i_j], fr.vecs[c_j], fr.vecs[v_j]
+    ivals = np.asarray(iv.to_numpy(), np.float64)[: fr.nrows]
+    cvals = np.asarray(cv.to_numpy(), np.float64)[: fr.nrows]
+    vvals = np.asarray(vv.to_numpy(), np.float64)[: fr.nrows]
+    uniq_i, inv_i = np.unique(ivals, return_inverse=True)
+    uniq_c = np.unique(cvals[~np.isnan(cvals)])
+    out = np.full((len(uniq_i), len(uniq_c)), np.nan)
+    for r in range(fr.nrows):
+        if np.isnan(cvals[r]):
+            continue
+        ci = np.searchsorted(uniq_c, cvals[r])
+        out[inv_i[r], ci] = vvals[r]
+    col_labels = ([cv.domain[int(c)] for c in uniq_c]
+                  if cv.is_categorical else
+                  [str(int(c)) if c == int(c) else str(c)
+                   for c in uniq_c])
+    names = [fr.names[i_j]] + list(col_labels)
+    first = Vec(uniq_i.astype(np.int32), T_CAT, domain=list(iv.domain)) \
+        if iv.is_categorical else Vec(uniq_i.astype(np.float32), iv.type)
+    vecs = [first] + [Vec(out[:, k].astype(np.float32))
+                      for k in range(len(uniq_c))]
+    return Frame(names, vecs)
+
+
+def _op_rank_within_gb(node, env):
+    """(rank_within_groupby fr gb_cols sort_cols ascending new_col
+    final_sort) — AstRankWithinGroupBy (h2o-py frame.py:3988): dense
+    1-based rank within each group in sort order; NAs rank last."""
+    fr = _as_frame(_eval(node[1], env))
+    gcols = _col_sel_indices(fr, node[2])
+    scols = _col_sel_indices(fr, node[3])
+    asc = [bool(int(x)) for x in node[4][1]] if isinstance(node[4], tuple) \
+        else [True] * len(scols)
+    new_col = _lit(node[5]) if len(node) > 5 else "rank"
+    final_sort = bool(int(_eval(node[6], env))) if len(node) > 6 else False
+    _, ginv = _key_codes(fr, gcols)
+    order = _sort_keys(fr, scols, asc)
+    rank = np.zeros(fr.nrows, np.float32)
+    counters: Dict[int, int] = {}
+    for i in order:
+        g = int(ginv[i])
+        counters[g] = counters.get(g, 0) + 1
+        rank[i] = counters[g]
+    out = Frame(list(fr.names), list(fr.vecs))
+    out.add(new_col, Vec(rank))
+    if final_sort:
+        out = out.slice_rows(_sort_keys(out, gcols + scols,
+                                        [True] * len(gcols) + asc))
+    return out
+
+
+def _op_apply(node, env):
+    """(apply fr margin { args . body }) — AstApply with the client's
+    lambda AST (h2o-py frame.py:4806, astfun.lambda_to_expr)."""
+    fr = _as_frame(_eval(node[1], env))
+    margin = int(_eval(node[2], env))      # 1 = rows, 2 = columns
+    rest = node[3:]
+    if not (isinstance(rest[0], tuple) and _lit(rest[0]) == "{"):
+        raise ValueError("apply expects a lambda { args . body }")
+    i = 1
+    args = []
+    while _lit(rest[i]) != ".":
+        args.append(_lit(rest[i]))
+        i += 1
+    body = rest[i + 1]
+    if margin == 2:          # per column
+        cols = []
+        for j, v in enumerate(fr.vecs):
+            sub = Frame([fr.names[j]], [v])
+            env.locals[args[0]] = sub
+            try:
+                r = _eval(body, env)
+            finally:
+                env.locals.pop(args[0], None)
+            if isinstance(r, Frame):
+                cols.append((fr.names[j], r.vecs[0]))
+            else:
+                cols.append((fr.names[j],
+                             Vec(np.asarray([float(r)], np.float32))))
+        nr = max(v.nrows for _, v in cols)
+        return Frame([n for n, _ in cols],
+                     [v if v.nrows == nr else
+                      Vec(np.resize(np.asarray(v.to_numpy()), nr))
+                      for _, v in cols])
+    # margin == 1: per row.  Fast path: a simple reducer body
+    # "(reducer x)" computes along axis=1 directly — no transposition
+    mat = np.stack([np.asarray(v.to_numpy(), np.float64)[: fr.nrows]
+                    for v in fr.vecs], axis=1)
+    _ROW_REDUCERS = {"mean": np.nanmean, "sum": np.nansum,
+                     "min": np.nanmin, "max": np.nanmax,
+                     "median": np.nanmedian,
+                     "sd": lambda m, axis: np.nanstd(m, axis=axis,
+                                                     ddof=1)}
+    if (isinstance(body, list) and len(body) == 2 and
+            _lit(body[0]) in _ROW_REDUCERS and
+            isinstance(body[1], tuple) and _lit(body[1]) == args[0]):
+        vals = _ROW_REDUCERS[_lit(body[0])](mat, axis=1)
+        return Frame(["apply"], [Vec(vals.astype(np.float32))])
+    if fr.nrows > 100_000:
+        raise ValueError(
+            "apply(axis=1) with a non-reducer lambda materializes one "
+            "column per row; limit is 100k rows — rewrite with "
+            "column-wise ops or a supported row reducer "
+            "(mean/sum/min/max/median/sd)")
+    row_fr = Frame([f"C{i+1}" for i in range(mat.shape[0])],
+                   [Vec(mat[i].astype(np.float32))
+                    for i in range(mat.shape[0])])
+    env.locals[args[0]] = row_fr
+    try:
+        r = _eval(body, env)
+    finally:
+        env.locals.pop(args[0], None)
+    if isinstance(r, Frame):     # (nrows(fr) columns of len ncol) -> 1 col
+        vals = [float(np.asarray(v.to_numpy())[0]) if v.nrows == 1 else
+                np.nan for v in r.vecs]
+        return Frame(["apply"], [Vec(np.asarray(vals, np.float32))])
+    if isinstance(r, list):
+        return Frame(["apply"], [Vec(np.asarray(r, np.float32))])
+    return Frame(["apply"],
+                 [Vec(np.full(fr.nrows, float(r), np.float32))])
+
+
+def _op_set_level(node, env):
+    """(setLevel fr 'level') — every row set to the given level
+    (AstSetLevel; h2o-py frame.py:1466)."""
+    fr = _as_frame(_eval(node[1], env))
+    level = _lit(node[2])
+    v = fr.vecs[0]
+    if not v.is_categorical:
+        raise ValueError("setLevel requires a categorical column")
+    if level not in (v.domain or []):
+        raise ValueError(f"level {level!r} not in domain")
+    code = v.domain.index(level)
+    return Frame(list(fr.names),
+                 [Vec(np.full(fr.nrows, code, np.int32), T_CAT,
+                      domain=list(v.domain))])
+
+
+def _op_relevel(node, env):
+    """(relevel fr 'y') — move level y to the front (AstRelevel)."""
+    fr = _as_frame(_eval(node[1], env))
+    level = _lit(node[2])
+    v = fr.vecs[0]
+    if not v.is_categorical or level not in (v.domain or []):
+        raise ValueError(f"relevel: {level!r} not a level")
+    new_dom = [level] + [d for d in v.domain if d != level]
+    remap = _domain_remap(v.domain, new_dom)
+    codes = np.asarray(v.to_numpy())
+    new_codes = np.where(codes >= 0, remap[np.clip(codes, 0, None)], -1)
+    return Frame(list(fr.names),
+                 [Vec(new_codes.astype(np.int32), T_CAT, domain=new_dom)])
+
+
+def _op_difflag1(node, env):
+    """(difflag1 fr) — AstDiffLag1: x[i] - x[i-1]; first row NA."""
+    fr = _as_frame(_eval(node[1], env))
+    out = []
+    for v in fr.vecs:
+        d = np.asarray(v.to_numpy(), np.float64)[: fr.nrows]
+        diff = np.concatenate([[np.nan], np.diff(d)])
+        out.append(Vec(diff.astype(np.float32)))
+    return Frame(list(fr.names), out)
+
+
+def _op_prod(node, env, na_rm: bool):
+    fr = _as_frame(_eval(node[1], env))
+
+    def red(v):
+        d = np.asarray(v.to_numpy(), np.float64)[: v.nrows]
+        if na_rm:
+            d = d[~np.isnan(d)]
+        return float(np.prod(d))
+    return _reduce_all(red, fr)
+
+
+def _op_any_all(node, env, which: str):
+    fr = _as_frame(_eval(node[1], env))
+    vals = []
+    for v in fr.vecs:
+        d = np.asarray(v.to_numpy(), np.float64)[: v.nrows]
+        d = d[~np.isnan(d)]
+        vals.append(bool((d != 0).any() if which == "any"
+                         else (d != 0).all()))
+    return float(any(vals) if which == "any" else all(vals))
+
+
+_EXTRA_OPS = {
+    "scale": _op_scale,
+    "hist": _op_hist,
+    "h2o.runif": _op_runif,
+    "kfold_column": _op_kfold,
+    "modulo_kfold_column": _op_modulo_kfold,
+    "stratified_kfold_column": _op_stratified_kfold,
+    "as.Date": _op_as_date,
+    "mktime": lambda n, e: _mktime_like(n, e, zero_based=True),
+    "moment": lambda n, e: _mktime_like(n, e, zero_based=False),
+    "which.max": lambda n, e: _op_which_extreme("which.max", n, e),
+    "which.min": lambda n, e: _op_which_extreme("which.min", n, e),
+    "topn": _op_topn,
+    "grep": _op_grep,
+    "strlen": _op_strlen,
+    "h2o.fillna": _op_fillna,
+    "fillna": _op_fillna,
+    "skewness": lambda n, e: _moments_reduce("skewness", n, e),
+    "kurtosis": lambda n, e: _moments_reduce("kurtosis", n, e),
+    "dropdup": _op_dropdup,
+    "distance": _op_distance,
+    "melt": _op_melt,
+    "pivot": _op_pivot,
+    "rank_within_groupby": _op_rank_within_gb,
+    "apply": _op_apply,
+    "setLevel": _op_set_level,
+    "relevel": _op_relevel,
+    "difflag1": _op_difflag1,
+    "prod": lambda n, e: _op_prod(n, e, na_rm=False),
+    "prod.na": lambda n, e: _op_prod(n, e, na_rm=True),
+    "any": lambda n, e: _op_any_all(n, e, "any"),
+    "all": lambda n, e: _op_any_all(n, e, "all"),
+}
 
 
 def rapids_exec(expr: str, session: Optional[Session] = None):

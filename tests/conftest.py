@@ -39,3 +39,37 @@ def cl():
 @pytest.fixture()
 def rng():
     return np.random.default_rng(42)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "shared_dkv: module keeps DKV state across tests "
+        "(module-scoped fixtures); per-test leak purge disabled")
+
+
+@pytest.fixture(autouse=True)
+def _dkv_leak_check(request):
+    """Per-test key-leak enforcement (water/runner/CheckKeysTask analog:
+    H2ORunner checks for leaked keys after EVERY test, SURVEY §4).
+
+    Keys a test adds to the DKV and does not remove are leaks: they are
+    reported, purged (so tests stay isolated), and — with
+    H2O_TPU_STRICT_LEAKS=1 — fail the test.  Modules whose tests share
+    DKV state through module-scoped fixtures opt out with the
+    ``shared_dkv`` marker."""
+    if request.node.get_closest_marker("shared_dkv") is not None:
+        yield
+        return
+    from h2o_tpu.core.cloud import Cloud
+    inst = Cloud._instance
+    before = set(map(str, inst.dkv.keys())) if inst is not None else set()
+    yield
+    inst = Cloud._instance
+    if inst is None:
+        return
+    leaked = sorted(set(map(str, inst.dkv.keys())) - before)
+    for k in leaked:
+        inst.dkv.remove(k)
+    if leaked and os.environ.get("H2O_TPU_STRICT_LEAKS") == "1":
+        pytest.fail(f"leaked {len(leaked)} DKV keys: {leaked[:20]}")

@@ -1,0 +1,66 @@
+"""Worker process for the multi-host cloud test (multiNodeUtils.sh analog).
+
+Each worker is one "host": 4 virtual CPU devices, joined into one 8-device
+cloud via Cloud.boot_multihost (jax.distributed rendezvous — the flatfile
+discovery analog, NetworkInit.java:166-186).  Run as:
+
+    python multihost_worker.py <coordinator> <num_processes> <process_id>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))            # repo root -> import h2o_tpu
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=4")
+os.environ["H2O_TPU_ROW_ALIGN"] = "8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def main():
+    coordinator, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    from h2o_tpu.core.cloud import Cloud
+
+    cl = Cloud.boot_multihost(coordinator, nproc, pid)
+    assert jax.process_count() == nproc, jax.process_count()
+    assert cl.n_nodes == 4 * nproc, cl.n_nodes
+    print(f"[p{pid}] cloud formed: {cl.n_nodes} nodes over "
+          f"{jax.process_count()} processes", flush=True)
+
+    # cross-process collective: an MRTask-style psum over the global mesh
+    from jax.sharding import PartitionSpec as P
+    ones = jax.jit(lambda: jnp.ones((cl.row_multiple(),)),
+                   out_shardings=cl.row_sharding)()
+    total = float(jax.jit(jnp.sum)(ones))
+    assert total == cl.row_multiple(), total
+    print(f"[p{pid}] global psum ok: {total}", flush=True)
+
+    # train a small GBM across both processes (same data everywhere — SPMD)
+    from h2o_tpu.core.frame import Frame, Vec, T_CAT
+    from h2o_tpu.models.tree.gbm import GBM
+
+    rng = np.random.default_rng(0)
+    n = 512
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.int32)
+    fr = Frame([f"x{j}" for j in range(4)] + ["y"],
+               [Vec(X[:, j]) for j in range(4)] +
+               [Vec(y, T_CAT, domain=["n", "p"])])
+    m = GBM(ntrees=3, max_depth=3, seed=1, nbins=16).train(
+        y="y", training_frame=fr)
+    auc = float(m.output["training_metrics"]["AUC"])
+    assert auc > 0.8, auc
+    print(f"[p{pid}] distributed GBM ok: auc={auc:.3f}", flush=True)
+    print(f"[p{pid}] MULTIHOST_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

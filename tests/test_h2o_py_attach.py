@@ -21,8 +21,11 @@ import pytest
 
 _H2O_PY = "/root/reference/h2o-py"
 
-pytestmark = pytest.mark.skipif(not os.path.isdir(_H2O_PY),
-                                reason="reference h2o-py client not present")
+pytestmark = [
+    pytest.mark.skipif(not os.path.isdir(_H2O_PY),
+                       reason="reference h2o-py client not present"),
+    pytest.mark.shared_dkv,   # module-scoped server/frame fixtures
+]
 
 
 @pytest.fixture(scope="module")

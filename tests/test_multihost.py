@@ -1,0 +1,49 @@
+"""Multi-process cloud: Cloud.boot_multihost over 2 jax.distributed
+processes — the reference's testMultiNode trick (multiNodeUtils.sh:21-27
+launches 4 extra local JVMs to form a real cloud on loopback; here 2 extra
+local Python processes form a real 8-device cloud on loopback).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_boot_multihost_two_processes():
+    port = _free_port()
+    coordinator = f"127.0.0.1:{port}"
+    worker = os.path.join(os.path.dirname(__file__),
+                          "multihost_worker.py")
+    env = dict(os.environ)
+    # children must not inherit the parent's latched single-TPU platform
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [subprocess.Popen(
+        [sys.executable, worker, coordinator, "2", str(pid)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=os.path.dirname(os.path.dirname(worker)))
+        for pid in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=540)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, \
+            f"worker {pid} failed (rc={p.returncode}):\n{out[-4000:]}"
+        assert f"[p{pid}] MULTIHOST_OK" in out, out[-4000:]
+        assert f"[p{pid}] cloud formed: 8 nodes over 2 processes" in out
+        assert f"[p{pid}] distributed GBM ok" in out

@@ -11,6 +11,8 @@ import urllib.parse
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.shared_dkv  # module-scoped fixtures share DKV state
+
 
 # ---------------------------------------------------------------------------
 # rapids

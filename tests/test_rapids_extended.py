@@ -1,0 +1,210 @@
+"""Extended Rapids prims driven by the UNMODIFIED h2o-py client — closing
+the round-2 verdict's 59-op client-emittable gap (reference:
+water/rapids/ast/prims/**; client call sites in h2o-py/h2o/frame.py).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+_H2O_PY = "/root/reference/h2o-py"
+
+pytestmark = [
+    pytest.mark.skipif(not os.path.isdir(_H2O_PY),
+                       reason="reference h2o-py client not present"),
+    pytest.mark.shared_dkv,   # module-scoped server/frame fixtures
+]
+
+
+@pytest.fixture(scope="module")
+def h2o_client(cl):
+    from h2o_tpu.api.server import RestServer
+    srv = RestServer(port=0).start()
+    if _H2O_PY not in sys.path:
+        sys.path.insert(0, _H2O_PY)
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        import h2o
+    h2o.connect(url=f"http://127.0.0.1:{srv.port}", verbose=False,
+                strict_version_check=False)
+    yield h2o
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def fr(h2o_client):
+    h2o = h2o_client
+    rng = np.random.default_rng(11)
+    n = 120
+    df = {
+        "num": rng.normal(loc=2.0, scale=3.0, size=n).tolist(),
+        "pos": np.abs(rng.normal(size=n) + 2).tolist(),
+        "grp": [["a", "b", "c"][i % 3] for i in range(n)],
+        "txt": [f"item_{i % 7}" for i in range(n)],
+    }
+    f = h2o.H2OFrame(df)
+    f["grp"] = f["grp"].asfactor()
+    f["txt"] = f["txt"].asfactor()
+    return f
+
+
+def test_scale(h2o_client, fr):
+    sc = fr[["num", "pos"]].scale()
+    df = sc.as_data_frame()
+    assert abs(df["num"].mean()) < 1e-5
+    assert abs(df["num"].std(ddof=1) - 1.0) < 1e-2
+
+
+def test_hist(h2o_client, fr):
+    h = fr["num"].hist(breaks=5, plot=False)
+    df = h.as_data_frame()
+    assert "breaks" in df.columns and "counts" in df.columns
+    assert np.nansum(df["counts"].values) == 120
+
+
+def test_runif_and_kfold(h2o_client, fr):
+    r = fr.runif(seed=42)
+    vals = r.as_data_frame().iloc[:, 0].values
+    assert ((vals >= 0) & (vals <= 1)).all()
+    kf = fr.kfold_column(n_folds=4, seed=1)
+    folds = kf.as_data_frame().iloc[:, 0].values
+    assert set(np.unique(folds)) <= {0, 1, 2, 3}
+    mk = fr.modulo_kfold_column(n_folds=3)
+    m = mk.as_data_frame().iloc[:, 0].values
+    assert (m == np.arange(120) % 3).all()
+    sk = fr["grp"].stratified_kfold_column(n_folds=3, seed=2)
+    s = sk.as_data_frame().iloc[:, 0].values
+    assert set(np.unique(s)) <= {0, 1, 2}
+
+
+def test_which_max_min(h2o_client, fr):
+    wm = fr[["num", "pos"]].idxmax()
+    df = wm.as_data_frame()
+    assert df.shape[0] == 1
+    num = fr["num"].as_data_frame().iloc[:, 0].values
+    assert int(df.iloc[0, 0]) == int(np.nanargmax(num))
+    wn = fr[["num"]].idxmin()
+    assert int(wn.as_data_frame().iloc[0, 0]) == int(np.nanargmin(num))
+
+
+def test_topn(h2o_client, fr):
+    t = fr.topN(column="num", nPercent=10)
+    df = t.as_data_frame()
+    num = fr["num"].as_data_frame().iloc[:, 0].values
+    k = df.shape[0]
+    top_vals = np.sort(num)[-k:]
+    assert np.allclose(np.sort(df.iloc[:, 1].values), top_vals,
+                       atol=1e-5)
+
+
+def test_grep_and_strlen(h2o_client, fr):
+    g = fr["txt"].grep("item_[0-3]", output_logical=True)
+    flags = g.as_data_frame().iloc[:, 0].values
+    assert flags.sum() > 0
+    sl = fr["txt"].nchar()            # client name for (strlen fr)
+    lens = sl.as_data_frame().iloc[:, 0].values
+    assert (lens == 6).all()          # "item_N"
+
+
+def test_fillna(h2o_client):
+    import h2o
+    f = h2o.H2OFrame({"x": [1.0, None, None, 4.0, None]})
+    filled = f.fillna(method="forward", axis=0, maxlen=1)
+    vals = filled.as_data_frame()["x"].values
+    assert vals[1] == 1.0             # filled (run 1 <= maxlen)
+    assert np.isnan(vals[2])          # run 2 > maxlen stays NA
+    assert vals[3] == 4.0
+
+
+def test_skewness_kurtosis(h2o_client, fr):
+    sk = np.atleast_1d(fr["num"].skewness())
+    ku = np.atleast_1d(fr["num"].kurtosis())
+    num = fr["num"].as_data_frame().iloc[:, 0].values
+    m = num.mean()
+    s2 = ((num - m) ** 2).sum() / (len(num) - 1)
+    exp_sk = ((num - m) ** 3).mean() / s2 ** 1.5
+    assert abs(float(sk[0]) - exp_sk) < 1e-4
+    assert float(ku[0]) > 0
+
+
+def test_dropdup(h2o_client):
+    import h2o
+    f = h2o.H2OFrame({"a": [1, 1, 2, 2, 3], "b": [9, 9, 8, 7, 6]})
+    d = f.drop_duplicates(columns=["a"], keep="first")
+    assert d.nrows == 3
+
+
+def test_distance(h2o_client):
+    import h2o
+    x = h2o.H2OFrame({"c1": [0.0, 1.0], "c2": [0.0, 0.0]})
+    y = h2o.H2OFrame({"c1": [0.0, 3.0], "c2": [0.0, 4.0]})
+    d = x.distance(y, measure="l2")
+    df = d.as_data_frame()
+    assert abs(df.iloc[0, 0] - 0.0) < 1e-6
+    assert abs(df.iloc[0, 1] - 5.0) < 1e-5
+
+
+def test_melt_pivot(h2o_client):
+    import h2o
+    f = h2o.H2OFrame({"id": [1, 2], "p": [10.0, 20.0],
+                      "q": [30.0, 40.0]})
+    m = f.melt(id_vars=["id"], value_vars=["p", "q"])
+    dfm = m.as_data_frame()
+    assert dfm.shape[0] == 4
+    assert set(dfm["variable"]) == {"p", "q"}
+    pv = m.pivot(index="id", column="variable", value="value")
+    dfp = pv.as_data_frame()
+    assert dfp.shape == (2, 3)
+    assert dfp.loc[dfp["id"] == 1, "p"].iloc[0] == 10.0
+    assert dfp.loc[dfp["id"] == 2, "q"].iloc[0] == 40.0
+
+
+def test_rank_within_groupby(h2o_client):
+    import h2o
+    f = h2o.H2OFrame({"g": [0, 0, 0, 1, 1], "v": [3.0, 1.0, 2.0,
+                                                  5.0, 4.0]})
+    r = f.rank_within_group_by(group_by_cols=["g"], sort_cols=["v"],
+                               new_col_name="rk")
+    df = r.as_data_frame().sort_values(["g", "v"])
+    assert df["rk"].tolist() == [1, 2, 3, 1, 2]
+
+
+def test_apply_columns(h2o_client, fr):
+    """The wire form (apply fr 2 { x . (mean x) }) — the stock client's
+    astfun lambda decompiler predates py3.12 bytecode, so the rapids
+    expression is POSTed directly (same wire bytes the client would
+    send on an older python)."""
+    import h2o
+    sub = fr[["num", "pos"]]
+    res = h2o.rapids(f"(apply {sub.frame_id} 2 {{ x . (mean x) }})")
+    key = res["key"]["name"]
+    df = h2o.get_frame(key).as_data_frame()
+    num = fr["num"].as_data_frame().iloc[:, 0].values
+    assert abs(df.iloc[0, 0] - num.mean()) < 1e-4
+
+
+def test_mktime_as_date(h2o_client):
+    import h2o
+    # moment is 1-based calendar values (AstMoment ISOChronology);
+    # mktime is 0-based (AstMktime.java:55-56 adds +1)
+    ms = h2o.H2OFrame.moment(2020, 1, 1, 0, 0, 0, 0)
+    v = float(ms.as_data_frame().iloc[0, 0])
+    assert v == 1577836800000.0
+    mk = h2o.rapids("(mktime 2020 0 0 0 0 0 0)")
+    key = mk["key"]["name"]
+    v2 = float(h2o.get_frame(key).as_data_frame().iloc[0, 0])
+    assert v2 == 1577836800000.0
+    f = h2o.H2OFrame({"d": ["2020-01-01", "2021-06-15"]})
+    dd = f["d"].as_date("yyyy-MM-dd")
+    vals = dd.as_data_frame().iloc[:, 0].values
+    assert float(vals[0]) == 1577836800000.0
+
+
+def test_set_level_relevel(h2o_client, fr):
+    lv = fr["grp"].set_level("b")
+    assert set(lv.as_data_frame().iloc[:, 0]) == {"b"}
+    rl = fr["grp"].relevel("c")
+    assert rl.levels()[0][0] == "c"
