@@ -28,9 +28,41 @@ import jax as _jax
 # Persistent XLA compilation cache: tree building compiles one program per
 # (level, shape) and re-runs them across trees/models/processes; caching them
 # on disk removes the dominant cold-start cost (first TPU compile is ~20-40s).
+#
+# CPU caveat: the cache is enabled only for accelerator platforms.
+# XLA:CPU AOT entries embed the compile machine's feature set (loading a
+# foreign entry risks SIGILL — XLA itself warns), and serializing some
+# CPU executables segfaults inside put_executable_and_time; both were
+# observed as intermittent test-suite crashes on the virtual CPU mesh.
+# Tests/dryruns select the CPU platform BEFORE importing this package
+# (tests/conftest.py, __graft_entry__), so the check below sees it.
+
+
+def _machine_fingerprint() -> str:
+    import hashlib
+    import platform
+    tag = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for ln in f:
+                if ln.startswith("flags"):
+                    tag += "-" + hashlib.md5(
+                        ln.encode()).hexdigest()[:12]
+                    break
+    except OSError:
+        pass
+    return tag
+
+
 _cache_dir = _os.environ.get("H2O_TPU_COMPILE_CACHE",
                              _os.path.expanduser("~/.h2o_tpu_jax_cache"))
-if _cache_dir and _cache_dir != "0":
+# primary platform = first entry ("axon,cpu" means TPU with cpu fallback;
+# tests/dryruns set exactly "cpu")
+_plat = str(getattr(_jax.config, "jax_platforms", None) or
+            _os.environ.get("JAX_PLATFORMS") or "")
+_primary_cpu = _plat.split(",")[0].strip() == "cpu"
+if _cache_dir and _cache_dir != "0" and not _primary_cpu:
+    _cache_dir = _os.path.join(_cache_dir, _machine_fingerprint())
     try:
         _jax.config.update("jax_compilation_cache_dir", _cache_dir)
         _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

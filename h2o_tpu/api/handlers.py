@@ -41,6 +41,8 @@ def _key(name, tpe="Key"):
 @route("GET", r"/(?:3|4)/Cloud(?:\.json)?")
 def cloud_status(params):
     c = cloud()
+    from h2o_tpu.core.memory import manager
+    mem = manager().stats()
     return {
         "__meta": {"schema_version": 3, "schema_name": "CloudV3",
                    "schema_type": "Iced"},
@@ -62,7 +64,12 @@ def cloud_status(params):
             "last_ping": int(time.time() * 1000), "pid": os.getpid(),
             "num_cpus": 1, "cpus_allowed": 1, "nthreads": 1,
             "my_cpu_pct": -1, "sys_cpu_pct": -1,
-            "mem_value_size": 0, "free_mem": 0, "pojo_mem": 0, "swap_mem": 0,
+            # HBM accounting (core/memory.py Cleaner analog): value size =
+            # resident frame bytes; swap = columns spilled to host
+            "mem_value_size": mem["resident_bytes"] // c.n_nodes,
+            "free_mem": max(mem["budget"] - mem["resident_bytes"], 0)
+            // c.n_nodes if mem["budget"] else 0,
+            "pojo_mem": 0, "swap_mem": mem["spills"],
             "num_keys": len(c.dkv.keys()),
             "max_mem": 0, "sys_load": -1.0,
         } for i in range(c.n_nodes)],
@@ -211,20 +218,73 @@ def post_file(params, body=None):
             "total_bytes": os.path.getsize(path)}
 
 
+def _import_one(path):
+    """Resolve a path/glob and register nfs:// keys; (files, dests)."""
+    matches = sorted(globmod.glob(path)) if any(ch in path for ch in "*?") \
+        else ([path] if os.path.exists(path) else [])
+    for p in matches:
+        cloud().dkv.put(f"nfs://{p}", p)
+    return matches, [f"nfs://{p}" for p in matches]
+
+
+@route("POST", r"/3/ImportFilesMulti")
+def import_files_multi(params):
+    """h2o.lazy_import sends paths as '[p1,p2,...]'
+    (water/api/ImportFilesMultiHandler)."""
+    raw = params.get("paths") or ""
+    paths = [p.strip() for p in str(raw).strip("[]").split(",")
+             if p.strip()]
+    if not paths:
+        raise H2OError(400, "paths is required")
+    files, dests, fails = [], [], []
+    for path in paths:
+        m, d = _import_one(path)
+        if not m:
+            fails.append(path)
+        files += m
+        dests += d
+    if not files:
+        raise H2OError(404, f"no files at {raw}")
+    return {"files": files, "destination_frames": dests,
+            "fails": fails, "dels": []}
+
+
+@route("POST", r"/3/PutKey", raw=True)
+def put_key(params, body=None):
+    """Raw byte upload under an explicit key (water/api/PutKeyHandler —
+    the h2o.upload_custom_metric / _put_key flow)."""
+    import shutil
+    c = cloud()
+    dest = params.get("destination_key")
+    if not dest:
+        raise H2OError(400, "destination_key is required")
+    overwrite = str(params.get("overwrite", "true")).lower() == "true"
+    if not overwrite and c.dkv.get(dest) is not None:
+        raise H2OError(400, f"key {dest} exists and overwrite=False")
+    updir = os.path.join(c.args.ice_root, "uploads")
+    os.makedirs(updir, exist_ok=True)
+    path = os.path.join(updir,
+                        dest.replace("/", "_").replace(":", "_"))
+    with open(path, "wb") as f:
+        shutil.copyfileobj(body, f)
+    c.dkv.put(dest, path)
+    # plain string (the client formats it into the 'python:key=Class'
+    # custom-func reference, h2o-py/h2o/h2o.py:2226)
+    return {"destination_key": dest,
+            "total_bytes": os.path.getsize(path)}
+
+
 @route("GET", r"/3/ImportFiles")
 @route("POST", r"/3/ImportFiles")
 def import_files(params):
     path = params.get("path")
     if not path:
         raise H2OError(400, "path is required")
-    matches = sorted(globmod.glob(path)) if any(ch in path for ch in "*?") \
-        else ([path] if os.path.exists(path) else [])
+    matches, dests = _import_one(path)
     if not matches:
         raise H2OError(404, f"no files at {path}")
-    for p in matches:
-        cloud().dkv.put(f"nfs://{p}", p)
-    return {"files": matches, "destination_frames":
-            [f"nfs://{p}" for p in matches], "fails": [], "dels": []}
+    return {"files": matches, "destination_frames": dests,
+            "fails": [], "dels": []}
 
 
 @route("POST", r"/3/ParseSetup")
@@ -569,13 +629,19 @@ def build_model(params, algo):
     # REST schema names that differ from builder keys (v3 'lambda' is a
     # Python keyword on our side)
     aliases = {"lambda": "lambda_"}
+    coerced = {}
     for k, v in params.items():
         if k in ("training_frame", "validation_frame", "model_id",
                  "response_column", "ignored_columns"):
             continue
         k = aliases.get(k, k)
         if k in b.params:
-            b.params[k] = _coerce(v, b.params[k])
+            coerced[k] = _coerce(v, b.params[k])
+    try:
+        b._validate_fixed(coerced)   # no silently-ignored settings
+    except ValueError as e:
+        raise H2OError(400, str(e))
+    b.params.update(coerced)
     if params.get("model_id"):
         b.model_id = params["model_id"]
     y = params.get("response_column")
@@ -608,7 +674,8 @@ def _metrics_dict(m, frame_id=None, model_id=None):
          "frame": _key(frame_id, "Key<Frame>") if frame_id else None,
          "model": _key(model_id, "Key<Model>") if model_id else None,
          "description": None, "scoring_time": 0,
-         "custom_metric_name": None, "custom_metric_value": 0.0}
+         "custom_metric_name": m.data.get("custom_metric_name"),
+         "custom_metric_value": m.data.get("custom_metric_value", 0.0)}
     # H2O wire casing (client metrics_base.py accessors index these
     # literally: 'MSE', 'RMSE', 'Gini', ...)
     rename = {"mse": "MSE", "rmse": "RMSE", "gini": "Gini"}
@@ -1049,3 +1116,4 @@ def frame_load(params):
 # v99 ML orchestration routes (Grid / AutoML / Leaderboards) live in their
 # own module; importing registers them on the shared route table.
 from h2o_tpu.api import handlers_ml  # noqa: E402,F401
+from h2o_tpu.api import handlers_frames  # noqa: E402,F401

@@ -1,0 +1,302 @@
+"""Frame-utility REST routes: CreateFrame, Interaction, PartialDependence.
+
+Reference: water/api/CreateFrameHandler (hex/CreateFrame.java),
+water/api/InteractionHandler (hex/Interaction.java),
+hex/PartialDependence.java:223-286 (TwoDimTable output per column).
+Clients: h2o.create_frame (h2o-py/h2o/h2o.py:1832), h2o.interaction
+(:1889), model.partial_plot (model/model_base.py:1316-1320).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from h2o_tpu.core.cloud import cloud
+from h2o_tpu.core.frame import Frame, T_CAT, T_TIME, Vec
+from h2o_tpu.core.job import Job
+from h2o_tpu.api.server import H2OError, route
+from h2o_tpu.models.metrics import twodim_json
+from h2o_tpu.models.model import Model
+
+
+def _h():
+    from h2o_tpu.api import handlers
+    return handlers
+
+
+def _f(params, key, default):
+    v = params.get(key)
+    if v is None or v == "":
+        return default
+    return float(v)
+
+
+def _i(params, key, default):
+    return int(_f(params, key, default))
+
+
+def _b(params, key, default=False):
+    v = params.get(key)
+    if v is None:
+        return default
+    return str(v).lower() in ("1", "true", "yes")
+
+
+@route("POST", r"/3/CreateFrame")
+def create_frame(params):
+    """Synthesize a random frame (hex/CreateFrame.java semantics: column
+    type mix by fraction, real_fraction = remainder)."""
+    dest = params.get("dest") or "createframe"
+    rows = _i(params, "rows", 10000)
+    cols = _i(params, "cols", 10)
+    seed = _i(params, "seed", -1)
+    rng = np.random.default_rng(None if seed < 0 else seed)
+    cat_f = _f(params, "categorical_fraction", 0.2)
+    int_f = _f(params, "integer_fraction", 0.2)
+    bin_f = _f(params, "binary_fraction", 0.1)
+    time_f = _f(params, "time_fraction", 0.0)
+    str_f = _f(params, "string_fraction", 0.0)
+    real_f = max(0.0, 1.0 - cat_f - int_f - bin_f - time_f - str_f)
+    randomize = _b(params, "randomize", True)
+    value = _f(params, "value", 0.0)
+    real_range = _f(params, "real_range", 100.0)
+    int_range = _i(params, "integer_range", 100)
+    factors = max(_i(params, "factors", 2), 1)
+    bin_ones = _f(params, "binary_ones_fraction", 0.02)
+    miss = _f(params, "missing_fraction", 0.01)
+    has_response = _b(params, "has_response")
+    response_factors = _i(params, "response_factors", 2)
+
+    counts = [int(round(f * cols)) for f in
+              (cat_f, int_f, bin_f, time_f, str_f)]
+    counts.append(cols - sum(counts))          # reals take the remainder
+    if counts[-1] < 0:
+        raise H2OError(400, "column-type fractions exceed 1")
+    job = Job(dest=dest, description="Create Frame")
+
+    def body(j):
+        names, vecs = [], []
+        ci = 0
+
+        def missing_mask():
+            return rng.uniform(size=rows) < miss if miss > 0 else None
+
+        def put_num(vals):
+            m = missing_mask()
+            if m is not None:
+                vals = np.where(m, np.nan, vals)
+            vecs.append(Vec(vals.astype(np.float32)))
+
+        for _ in range(counts[0]):             # categorical
+            names.append(f"C{(ci := ci + 1)}")
+            codes = rng.integers(0, factors, rows).astype(np.int32)
+            m = missing_mask()
+            if m is not None:
+                codes = np.where(m, -1, codes).astype(np.int32)
+            vecs.append(Vec(codes, T_CAT,
+                            domain=[f"c{ci}.l{k}" for k in
+                                    range(factors)]))
+        for _ in range(counts[1]):             # integer
+            names.append(f"C{(ci := ci + 1)}")
+            put_num(rng.integers(-int_range, int_range + 1, rows)
+                    .astype(np.float64)
+                    if randomize else np.full(rows, value))
+        for _ in range(counts[2]):             # binary
+            names.append(f"C{(ci := ci + 1)}")
+            put_num((rng.uniform(size=rows) < bin_ones)
+                    .astype(np.float64))
+        for _ in range(counts[3]):             # time
+            names.append(f"C{(ci := ci + 1)}")
+            ms = rng.integers(0, 2_000_000_000_000, rows).astype(
+                np.float64)
+            m = missing_mask()
+            if m is not None:
+                ms = np.where(m, np.nan, ms)
+            vecs.append(Vec(ms, T_TIME))
+        for _ in range(counts[4]):             # string
+            names.append(f"C{(ci := ci + 1)}")
+            vecs.append(Vec([f"s{int(x)}" for x in
+                             rng.integers(0, 1 << 30, rows)], "string"))
+        for _ in range(counts[5]):             # real
+            names.append(f"C{(ci := ci + 1)}")
+            put_num(rng.uniform(-real_range, real_range, rows)
+                    if randomize else np.full(rows, value))
+        if has_response:
+            if response_factors > 1:
+                codes = rng.integers(0, response_factors, rows).astype(
+                    np.int32)
+                rvec = Vec(codes, T_CAT,
+                           domain=[f"resp.l{k}" for k in
+                                   range(response_factors)])
+            else:
+                rvec = Vec(rng.normal(size=rows).astype(np.float32))
+            names.insert(0, "response")
+            vecs.insert(0, rvec)
+        fr = Frame(names, vecs, key=dest)
+        cloud().dkv.put(dest, fr)
+        return fr
+
+    cloud().jobs.start(job, body)
+    return {"job": job.to_dict()}
+
+
+@route("POST", r"/3/Interaction")
+def interaction(params):
+    """Categorical interaction features (hex/Interaction.java): combined
+    levels 'a_b', top max_factors levels kept (others -> 'other'),
+    min_occurrence filter; pairwise or one n-way interaction."""
+    h = _h()
+    src = params.get("source_frame")
+    fr = cloud().dkv.get(src)
+    if not isinstance(fr, Frame):
+        raise H2OError(404, f"source_frame {src} not found")
+    factor_cols = [c.strip().strip('"').strip("'") for c in
+                   str(params.get("factor_columns") or "")
+                   .strip("[]").split(",") if c.strip()]
+    if len(factor_cols) < 2:
+        raise H2OError(400, "need >= 2 factor_columns")
+    for c in factor_cols:
+        if c not in fr.names or not fr.vec(c).is_categorical:
+            raise H2OError(400, f"column {c!r} is not categorical")
+    pairwise = _b(params, "pairwise")
+    max_factors = max(_i(params, "max_factors", 100), 1)
+    min_occ = max(_i(params, "min_occurrence", 1), 1)
+    dest = params.get("dest") or f"interaction_{src}"
+    job = Job(dest=dest, description="Interactions")
+
+    def combine(cols: List[str]):
+        labels = None
+        for c in cols:
+            v = fr.vec(c)
+            codes = np.asarray(v.to_numpy())[: fr.nrows]
+            dom = v.domain or []
+            part = np.asarray([dom[int(x)] if x >= 0 else "NA"
+                               for x in codes], object)
+            labels = part if labels is None else \
+                np.asarray([f"{a}_{b}" for a, b in zip(labels, part)],
+                           object)
+        lvls, counts = np.unique(labels, return_counts=True)
+        keep = [lv for lv, ct in sorted(
+            zip(lvls, counts), key=lambda t: -t[1])
+            if ct >= min_occ][:max_factors]
+        keepset = set(keep)
+        dom = keep + (["other"] if len(keepset) < len(lvls) else [])
+        lut = {d: i for i, d in enumerate(dom)}
+        other = lut.get("other", -1)
+        out_codes = np.asarray(
+            [lut.get(s, other) for s in labels], np.int32)
+        return Vec(out_codes, T_CAT, domain=dom), "_".join(cols)
+
+    def body(j):
+        names, vecs = [], []
+        if pairwise:
+            for a in range(len(factor_cols)):
+                for b in range(a + 1, len(factor_cols)):
+                    v, nm = combine([factor_cols[a], factor_cols[b]])
+                    names.append(nm)
+                    vecs.append(v)
+        else:
+            v, nm = combine(factor_cols)
+            names.append(nm)
+            vecs.append(v)
+        out = Frame(names, vecs, key=dest)
+        cloud().dkv.put(dest, out)
+        return out
+
+    cloud().jobs.start(job, body)
+    return {"job": job.to_dict()}
+
+
+def _pdp_values(v: Vec, nbins: int):
+    if v.is_categorical:
+        dom = v.domain or []
+        return list(range(len(dom))), [str(d) for d in dom]
+    r = v.rollups
+    vals = np.linspace(float(r.min), float(r.max), nbins)
+    return list(vals), [float(x) for x in vals]
+
+
+@route("POST", r"/3/PartialDependence/")
+@route("POST", r"/3/PartialDependence")
+def partial_dependence(params):
+    """PDP tables (hex/PartialDependence.java:223-286): per column, sweep
+    a value grid, overwrite the column frame-wide, and record the mean /
+    stddev / stderr of the model's response."""
+    m = cloud().dkv.get(params.get("model_id"))
+    fr = cloud().dkv.get(params.get("frame_id"))
+    if not isinstance(m, Model):
+        raise H2OError(404, f"model {params.get('model_id')} not found")
+    if not isinstance(fr, Frame):
+        raise H2OError(404, f"frame {params.get('frame_id')} not found")
+    cols = [c.strip().strip('"').strip("'") for c in
+            str(params.get("cols") or "").strip("[]").split(",")
+            if c.strip()]
+    if not cols:
+        cols = [c for c in m.output.get("x", []) if c in fr.names]
+    nbins = _i(params, "nbins", 20)
+    dest = params.get("destination_key") or \
+        f"pdp_{params.get('model_id')}"
+    job = Job(dest=dest, description="PartialDependencePlot")
+
+    def mean_response(work: Frame) -> np.ndarray:
+        raw = np.asarray(m.predict_raw(work))[: fr.nrows]
+        if raw.ndim == 2 and raw.shape[1] >= 3:
+            return raw[:, 2]                  # P(class 1), binomial PDP
+        if raw.ndim == 2:
+            return raw[:, -1]
+        return raw
+
+    def body(j):
+        tables = []
+        for k, col in enumerate(cols):
+            if col not in fr.names:
+                raise ValueError(f"column {col!r} not in frame")
+            v = fr.vec(col)
+            grid, labels = _pdp_values(v, nbins)
+            rows = []
+            for val, lab in zip(grid, labels):
+                if v.is_categorical:
+                    nv = Vec(np.full(fr.nrows, int(val), np.int32),
+                             T_CAT, domain=list(v.domain))
+                else:
+                    nv = Vec(np.full(fr.nrows, float(val), np.float32))
+                work = Frame(list(fr.names), list(fr.vecs))
+                work.vecs[fr.names.index(col)] = nv
+                resp = mean_response(work)
+                ok = ~np.isnan(resp)
+                mean = float(resp[ok].mean()) if ok.any() else float("nan")
+                sd = float(resp[ok].std(ddof=1)) if ok.sum() > 1 else 0.0
+                rows.append([lab, mean, sd,
+                             sd / max(np.sqrt(ok.sum()), 1.0)])
+                j.update((k + 1) / max(len(cols), 1), col)
+            tables.append(twodim_json(
+                "PartialDependence",
+                [col, "mean_response", "stddev_response",
+                 "std_error_mean_response"],
+                ["string" if v.is_categorical else "double",
+                 "double", "double", "double"], rows,
+                f"Partial Dependence Plot of model {m.key} on column "
+                f"'{col}'"))
+        result = {"__meta": {"schema_version": 3,
+                             "schema_name": "PartialDependenceV3",
+                             "schema_type": "PartialDependence"},
+                  "model_id": h_key(str(m.key), "Key<Model>"),
+                  "frame_id": h_key(str(fr.key), "Key<Frame>"),
+                  "partial_dependence_data": tables}
+        cloud().dkv.put(dest, result)
+        return result
+
+    h_key = _h()._key
+    cloud().jobs.start(job, body)
+    return {"job": job.to_dict(), "key": {"name": dest}}
+
+
+@route("GET", r"/3/PartialDependence/(?P<key>[^/]+)")
+def get_partial_dependence(params, key):
+    result = cloud().dkv.get(key)
+    if not isinstance(result, dict) or \
+            "partial_dependence_data" not in result:
+        raise H2OError(404, f"no PDP result {key}")
+    return result

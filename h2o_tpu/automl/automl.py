@@ -68,27 +68,49 @@ class _Budget:
         return max(self.max_runtime - (time.time() - self.t0), 0.0)
 
 
-# The modeling plan: (step name, algo, params) in execution order
-# (ModelingPlans.defaultPlan: defaults → grids → ensembles).
+# The modeling plan: (step name, algo, params, work weight) in execution
+# order (ModelingPlans.defaultPlan: defaults → grids → exploitation →
+# ensembles; work weights follow WorkAllocations.java's per-step units).
 def _default_plan(seed: int) -> List[Dict]:
     return [
-        dict(step="def_glm", algo="glm", params={}),
+        dict(step="def_glm", algo="glm", params={}, work=10),
+        # xgboost steps use engine-friendly shapes: the fixed-shape tree
+        # heap is dense (2^(D+1) slots/tree) and each distinct depth is a
+        # separate XLA program, so the reference's depth-10/15/20 XGBoost
+        # entries are remapped to shallower-but-more-trees settings with
+        # the engine's histogram width (documented redesign,
+        # models/tree/jit_engine.py)
+        dict(step="def_xgb_1", algo="xgboost",
+             params=dict(ntrees=60, max_depth=6, min_rows=5, nbins=64,
+                         sample_rate=0.6, col_sample_rate_per_tree=0.8),
+             work=10),
         dict(step="def_gbm_1", algo="gbm",
-             params=dict(ntrees=50, max_depth=6, learn_rate=0.1)),
+             params=dict(ntrees=50, max_depth=6, learn_rate=0.1),
+             work=10),
         dict(step="def_gbm_2", algo="gbm",
-             params=dict(ntrees=50, max_depth=3, learn_rate=0.1)),
-        dict(step="def_drf", algo="drf", params=dict(ntrees=50)),
+             params=dict(ntrees=50, max_depth=3, learn_rate=0.1),
+             work=10),
+        dict(step="def_drf", algo="drf", params=dict(ntrees=50), work=10),
         dict(step="def_dl", algo="deeplearning",
-             params=dict(hidden=[32, 32], epochs=5)),
+             params=dict(hidden=[32, 32], epochs=5), work=10),
+        dict(step="grid_xgb", algo="xgboost", grid=dict(
+            max_depth=[4, 6, 8], learn_rate=[0.05, 0.1, 0.3],
+            sample_rate=[0.6, 0.8, 1.0]),
+            params=dict(ntrees=60, nbins=64), max_grid_models=3, work=90),
         dict(step="grid_gbm", algo="gbm", grid=dict(
             max_depth=[3, 5, 7], learn_rate=[0.05, 0.1, 0.2],
             sample_rate=[0.8, 1.0]),
-            params=dict(ntrees=50), max_grid_models=4),
+            params=dict(ntrees=50), max_grid_models=4, work=60),
         dict(step="grid_dl", algo="deeplearning", grid=dict(
             hidden=[[16], [32, 32], [64]],
             input_dropout_ratio=[0.0, 0.1]),
-            params=dict(epochs=5), max_grid_models=2),
+            params=dict(epochs=5), max_grid_models=2, work=30),
     ]
+
+
+# exploration:exploitation budget split (AutoML.java:346 — by default 0.1
+# of the remaining budget refines the incumbent best GBM/XGBoost)
+_EXPLOITATION_RATIO = 0.1
 
 
 class AutoML:
@@ -188,13 +210,17 @@ class AutoML:
                           keep_cross_validation_predictions=True, seed=seed)
         x_cols = [c for c in (x or train.names) if c != y]
 
-        def train_one(algo: str, prm: Dict, step: str):
+        def train_one(algo: str, prm: Dict, step: str, work_share=None):
             if budget.exhausted():
                 return None
             prm = dict(prm)
             prm.update(common)
             if budget.max_runtime:
-                prm["max_runtime_secs"] = budget.remaining()
+                # WorkAllocations: a step gets its weighted share of the
+                # remaining clock, never more than what is left
+                prm["max_runtime_secs"] = min(
+                    budget.remaining(),
+                    work_share or budget.remaining())
             try:
                 t = time.time()
                 m = builder_class(algo)(**prm).train(
@@ -211,19 +237,32 @@ class AutoML:
                 return None
 
         plan = _default_plan(seed)
-        n_steps = len(plan) + 1
+        allowed = [it for it in plan if self._allowed(it["algo"])]
+        total_work = sum(it.get("work", 10) for it in allowed) or 1
+        explore_budget = budget.remaining() * (1 - _EXPLOITATION_RATIO) \
+            if budget.max_runtime else 0.0
+        n_steps = len(plan) + 2
         for i, item in enumerate(plan):
             job.update(i / n_steps, item["step"])
             if not self._allowed(item["algo"]) or budget.exhausted():
                 continue
+            share = explore_budget * item.get("work", 10) / total_work \
+                if budget.max_runtime else None
             if "grid" in item:
-                self._run_grid(item, train_one, seed)
+                self._run_grid(item, train_one, seed, share)
             else:
-                train_one(item["algo"], item["params"], item["step"])
+                train_one(item["algo"], item["params"], item["step"],
+                          share)
+
+        # exploitation phase (AutoML.java:457-460): refine the incumbent
+        # best GBM/XGBoost with its own hyper-neighborhood
+        job.update((n_steps - 2) / n_steps, "exploitation")
+        if not budget.exhausted():
+            self._exploitation(train_one, budget)
 
         # stacked ensembles (best-of-family + all) — skip for regression
         # only when no CV preds exist
-        job.update(len(plan) / n_steps, "stacked ensembles")
+        job.update((n_steps - 1) / n_steps, "stacked ensembles")
         if self._allowed("stackedensemble") and \
                 len(self.leaderboard.models) >= 2:
             self._build_ensembles(budget, work, y, valid, seed)
@@ -231,7 +270,8 @@ class AutoML:
         ev.info("done", f"AutoML build done: {budget.n_models} models")
         return self
 
-    def _run_grid(self, item: Dict, train_one, seed: int) -> None:
+    def _run_grid(self, item: Dict, train_one, seed: int,
+                  work_share=None) -> None:
         """Random-discrete mini-grid inside the plan (grids phase)."""
         names = list(item["grid"])
         rng = np.random.default_rng(None if seed < 0 else seed)
@@ -240,10 +280,41 @@ class AutoML:
         for vs in itertools.product(*(item["grid"][n] for n in names)):
             combos.append(dict(zip(names, vs)))
         rng.shuffle(combos)
-        for combo in combos[: int(item.get("max_grid_models", 3))]:
+        n = max(1, int(item.get("max_grid_models", 3)))
+        per_model = work_share / n if work_share else None
+        for combo in combos[:n]:
             prm = dict(item["params"])
             prm.update(combo)
-            train_one(item["algo"], prm, item["step"])
+            train_one(item["algo"], prm, item["step"], per_model)
+
+    def _exploitation(self, train_one, budget: _Budget) -> None:
+        """Refine the incumbent best tree model (the reference's
+        exploitation steps: GBM lr_annealing, XGBoost lr search —
+        modeling/{GBM,XGBoost}StepsProvider exploitation groups)."""
+        ranked = self.leaderboard.sorted_models()
+        best_tree = next((m for m in ranked
+                          if m.algo in ("gbm", "xgboost", "drf")), None)
+        if best_tree is None or best_tree.algo == "drf":
+            return
+        base = {k: v for k, v in best_tree.params.items()
+                if k in ("ntrees", "max_depth", "learn_rate",
+                         "sample_rate", "min_rows",
+                         "col_sample_rate_per_tree") and v is not None}
+        share = budget.remaining() * 0.5 if budget.max_runtime else None
+        # lr annealing: same depth, slower schedule, more trees
+        from h2o_tpu.models.registry import builder_class
+        accepted = builder_class(best_tree.algo)().params
+        prm = dict(base)
+        prm.update(ntrees=int(base.get("ntrees", 50) * 2),
+                   learn_rate=float(base.get("learn_rate", 0.1)) / 2)
+        if "learn_rate_annealing" in accepted:
+            prm["learn_rate_annealing"] = 0.99
+        train_one(best_tree.algo, prm, "exploit_lr_annealing", share)
+        # sample-rate neighborhood
+        prm2 = dict(base)
+        prm2["sample_rate"] = min(
+            1.0, float(base.get("sample_rate", 1.0)) * 0.8 + 0.2)
+        train_one(best_tree.algo, prm2, "exploit_sample_rate", share)
 
     def _build_ensembles(self, budget: _Budget, work: Frame, y: str, valid,
                          seed: int) -> None:

@@ -59,6 +59,9 @@ class Cloud:
         self.dkv = DKV()
         self.jobs = JobRegistry()
         self.session_counter = 0
+        if args.hbm_budget:
+            from h2o_tpu.core.memory import set_budget
+            set_budget(args.hbm_budget)
         log.info("Cloud '%s' of size %d formed (mesh %dx%d, platform=%s)",
                  args.name, n, n, m, devs[0].platform)
 

@@ -48,6 +48,10 @@ class OptArgs:
     reproducible: bool = True
     # row-shard padding multiple per device (TPU lane friendliness)
     row_align: int = 128
+    # HBM budget in bytes for the frame data plane (0 = unlimited);
+    # the Cleaner-analog spills LRU columns to host above it
+    # (core/memory.py; reference water/Cleaner.java:10-12)
+    hbm_budget: int = 0
 
     @classmethod
     def from_env(cls, **overrides) -> "OptArgs":

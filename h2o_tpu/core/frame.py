@@ -123,23 +123,27 @@ class Vec:
         self._rollups: Optional[RollupStats] = None
         self._hist: Optional[np.ndarray] = None
         self._host_f64: Optional[np.ndarray] = None
+        self._spill_np: Optional[np.ndarray] = None   # parked host copy
+        import threading as _th
+        self._spill_lock = _th.Lock()   # guards _data <-> _spill_np swaps
         if vtype in (T_STR, T_UUID):
             self.host_data: List = list(data)
             self.nrows = len(self.host_data)
-            self.data = None
+            self._data = None
             return
         self.host_data = None
         if isinstance(data, jax.Array):
             assert nrows is not None, "device data requires explicit nrows"
-            self.data = data
+            self._data = data
             self.nrows = nrows
+            self._account()
         else:
             arr = np.asarray(data)
             self.nrows = nrows if nrows is not None else arr.shape[0]
             if vtype == T_CAT:
                 arr = arr.astype(np.int32)
                 # NA code -1 → represent as float NaN? no: keep int + sentinel
-                self.data = cloud().device_put_rows(arr)
+                self._data = cloud().device_put_rows(arr)
             else:
                 if vtype == T_TIME:
                     # ms-since-epoch exceeds f32 precision (~131 s ulp at
@@ -147,8 +151,65 @@ class Vec:
                     # time-part extraction while the device payload stays
                     # f32 for arithmetic/binning
                     self._host_f64 = arr.astype(np.float64, copy=True)
-                self.data = cloud().device_put_rows(
+                self._data = cloud().device_put_rows(
                     arr.astype(np.float32, copy=False))
+            self._account()
+
+    # -- HBM budget integration (core/memory.py, the Cleaner analog) -------
+
+    def _device_nbytes(self) -> int:
+        d = self._data
+        return int(d.size * d.dtype.itemsize) if d is not None else 0
+
+    def _account(self) -> None:
+        if self._data is not None:
+            from h2o_tpu.core.memory import manager
+            manager().register(self, self._device_nbytes())
+
+    def _spill(self) -> bool:
+        """Drop the device payload after parking a host copy (called by
+        the MemoryManager under budget pressure).  Returns False when
+        there is nothing to spill."""
+        with self._spill_lock:
+            if self._data is None:
+                return False
+            self._spill_np = np.asarray(self._data)
+            self._data = None
+            return True
+
+    @property
+    def data(self) -> Optional[jax.Array]:
+        """The device payload; spilled columns reload transparently.
+        The lock makes reload/spill atomic: a concurrent Cleaner sweep
+        can never hand a reader None mid-swap."""
+        from h2o_tpu.core.memory import manager
+        with self._spill_lock:
+            if self._data is None and self._spill_np is not None:
+                arr = self._spill_np
+                self._data = cloud().device_put_rows(arr)
+                self._spill_np = None
+                manager().note_reload()
+                reloaded = True
+            else:
+                reloaded = False
+            out = self._data
+        # manager calls outside the vec lock (it takes its own lock; a
+        # register may spill OTHER vecs, which grab their own locks)
+        if reloaded:
+            self._account()
+        elif out is not None:
+            manager().touch(self)
+        return out
+
+    @data.setter
+    def data(self, value) -> None:
+        from h2o_tpu.core.memory import manager
+        manager().unregister(self)
+        with self._spill_lock:
+            self._data = value
+            self._spill_np = None
+        if value is not None:
+            self._account()
 
     # -- basics ------------------------------------------------------------
 
@@ -181,6 +242,10 @@ class Vec:
             return np.asarray(self.host_data, dtype=object)
         if self._host_f64 is not None:
             return self._host_f64[: self.nrows]
+        with self._spill_lock:
+            if self._data is None and self._spill_np is not None:
+                # host reads of spilled columns never touch the device
+                return self._spill_np[: self.nrows]
         return np.asarray(self.data)[: self.nrows]
 
     # -- rollups -----------------------------------------------------------

@@ -1,0 +1,149 @@
+"""HBM memory manager — the user-mode swap of the reference.
+
+Reference: water/Cleaner.java:10-12 ("user-mode swap-to-disk": tracks the
+heap budget and swaps cold Values to ice_root under pressure) +
+water/MemoryManager.java (malloc with OOM callbacks).
+
+TPU-native: the managed heap is HBM and the managed unit is a Vec's device
+payload.  Every frame column registers its device bytes here; when a new
+allocation would exceed the configured budget (``H2O_TPU_HBM_BUDGET``
+bytes, or ``OptArgs.hbm_budget``; 0 = unlimited), the least-recently-used
+resident columns are spilled: the device array is dropped (XLA frees the
+HBM) after a host copy is parked on the Vec.  The next access reloads the
+shard transparently through the same accounting — the Value.isPersisted /
+reload-on-touch cycle of the reference, with host RAM playing ice_root.
+
+Transient compute buffers (binned matrices, histograms, model state) are
+XLA's to manage; the data plane — the part that scales with row count —
+is what lives here, exactly as the reference's Cleaner only swaps DKV
+Values, not call stacks.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from typing import Optional
+
+from h2o_tpu.core.log import get_logger
+
+log = get_logger("memory")
+
+
+class MemoryManager:
+    """Budgeted HBM accounting + LRU spill for Vec device payloads."""
+
+    def __init__(self, budget_bytes: int = 0):
+        self.budget = int(budget_bytes)
+        self._lock = threading.RLock()
+        # insertion-ordered dict of weakref -> nbytes; order = LRU
+        self._resident: "dict[weakref.ref, int]" = {}
+        self.spill_count = 0
+        self.reload_count = 0
+
+    # -- accounting --------------------------------------------------------
+
+    def _prune(self) -> None:
+        dead = [r for r in self._resident if r() is None]
+        for r in dead:
+            self._resident.pop(r, None)
+
+    @property
+    def resident_bytes(self) -> int:
+        with self._lock:
+            self._prune()
+            return sum(self._resident.values())
+
+    def register(self, vec, nbytes: int) -> None:
+        """A Vec's device payload came alive; evict LRU columns first if
+        the budget would be exceeded (Cleaner sweep)."""
+        with self._lock:
+            self._prune()
+            if self.budget > 0:
+                need = self.resident_bytes + nbytes - self.budget
+                if need > 0:
+                    self._spill_lru(need, exclude=vec)
+            r = weakref.ref(vec)
+            vec._mm_ref = r              # O(1) touch/unregister handle
+            self._resident[r] = int(nbytes)
+
+    def touch(self, vec) -> None:
+        """Mark recently used (moves to the MRU end)."""
+        r = getattr(vec, "_mm_ref", None)
+        if r is None:
+            return
+        with self._lock:
+            if r in self._resident:
+                self._resident[r] = self._resident.pop(r)
+
+    def unregister(self, vec) -> None:
+        r = getattr(vec, "_mm_ref", None)
+        if r is None:
+            return
+        with self._lock:
+            self._resident.pop(r, None)
+
+    def _spill_lru(self, need_bytes: int, exclude=None) -> int:
+        freed = 0
+        for r in list(self._resident):          # LRU order
+            if freed >= need_bytes:
+                break
+            v = r()
+            if v is None or v is exclude:
+                continue
+            nb = self._resident[r]
+            if v._spill():                      # drops the device array
+                self._resident.pop(r, None)
+                freed += nb
+                self.spill_count += 1
+        if freed:
+            log.info("spilled %d bytes of cold columns to host "
+                     "(budget %d)", freed, self.budget)
+        return freed
+
+    def note_reload(self) -> None:
+        self.reload_count += 1
+
+    def stats(self) -> dict:
+        return {"budget": self.budget,
+                "resident_bytes": self.resident_bytes,
+                "resident_vecs": len(self._resident),
+                "spills": self.spill_count,
+                "reloads": self.reload_count}
+
+
+_manager: Optional[MemoryManager] = None
+_manager_lock = threading.Lock()
+
+
+def manager() -> MemoryManager:
+    global _manager
+    if _manager is None:
+        with _manager_lock:
+            if _manager is None:
+                _manager = MemoryManager(
+                    int(os.environ.get("H2O_TPU_HBM_BUDGET", "0") or 0))
+    return _manager
+
+
+def set_budget(budget_bytes: int) -> MemoryManager:
+    """(Re)configure the budget — tests and boot flags use this.
+
+    Existing Vec registrations carry over (their _mm_ref handles stay
+    valid) and the new budget is enforced immediately with an LRU sweep,
+    so already-resident columns remain accounted and spillable."""
+    global _manager
+    with _manager_lock:
+        new = MemoryManager(int(budget_bytes))
+        if _manager is not None:
+            new._resident = dict(_manager._resident)
+            new.spill_count = _manager.spill_count
+            new.reload_count = _manager.reload_count
+        _manager = new
+    if new.budget > 0:
+        with new._lock:
+            over = new.resident_bytes - new.budget
+            if over > 0:
+                new._spill_lru(over)
+    return new

@@ -102,6 +102,24 @@ def parse_setup(paths: Sequence[str], sample_lines: int = 200,
 
     ``force_header`` overrides detection (the REST check_header directive:
     1 = first line is a header, -1 = first line is data)."""
+    if paths[0].endswith((".parquet", ".pq")) or _is_parquet(paths[0]):
+        import pyarrow.parquet as pq
+        import pyarrow as pa
+        sch = pq.read_schema(paths[0])
+        types = []
+        for f in sch:
+            if pa.types.is_dictionary(f.type) or \
+                    pa.types.is_string(f.type) or \
+                    pa.types.is_large_string(f.type):
+                types.append(T_CAT)
+            elif pa.types.is_timestamp(f.type) or pa.types.is_date(f.type):
+                types.append(T_TIME)
+            else:
+                types.append(T_NUM)
+        return ParseSetupResult(",", True, list(sch.names), types)
+    if paths[0].endswith(".arff") or _looks_like_arff(paths[0]):
+        names_a, types_a, _doms = _arff_schema(paths[0])
+        return ParseSetupResult(",", True, names_a, types_a)
     with _open(paths[0]) as f:
         lines = []
         for _ in range(sample_lines):
@@ -258,6 +276,37 @@ def parse_files(paths: Sequence[str], setup: Optional[ParseSetupResult] = None,
     The byte tokenizer is the native C++ loop when available
     (h2o_tpu/native/), else pandas' C engine.
     """
+    # format dispatch (the reference's plug-in parser providers): parquet
+    # by magic/extension, ARFF by @relation header, SVMLight by extension.
+    # Client-edited setup (names/types from /3/ParseSetup) applies AFTER
+    # the format parser via _apply_setup_overrides.
+    first = paths[0]
+    if first.endswith((".parquet", ".pq")) or _is_parquet(first):
+        fr = parse_parquet(paths, dest)
+        return _apply_setup_overrides(fr, setup, column_types)
+    if first.endswith(".arff") or _looks_like_arff(first):
+        fr = parse_arff(first, dest) if len(paths) == 1 else \
+            _rbind_frames([parse_arff(p) for p in paths], dest)
+        return _apply_setup_overrides(fr, setup, column_types)
+    if first.endswith((".svm", ".svmlight")):
+        if len(paths) == 1:
+            fr = parse_svmlight(first, dest)
+        else:
+            frames = [parse_svmlight(p) for p in paths]
+            # per-file max feature index varies: pad narrower frames with
+            # zero columns to the union width before concatenating
+            width = max(f.ncols for f in frames)
+            names = max((f.names for f in frames), key=len)
+            padded = []
+            for f in frames:
+                if f.ncols < width:
+                    vecs = list(f.vecs) + [
+                        Vec(np.zeros(f.nrows, np.float32))
+                        for _ in range(width - f.ncols)]
+                    f = Frame(list(names), vecs)
+                padded.append(f)
+            fr = _rbind_frames(padded, dest)
+        return _apply_setup_overrides(fr, setup, column_types)
     setup = setup or parse_setup(paths)
     if column_types:
         for name, t in column_types.items():
@@ -310,6 +359,249 @@ def parse_files(paths: Sequence[str], setup: Optional[ParseSetupResult] = None,
             vecs.append(Vec(codes, T_CAT, domain=domain))
     fr = Frame(names, vecs, key=dest or os.path.basename(paths[0]))
     log.info("parsed %s: %d rows, %d cols", paths, fr.nrows, fr.ncols)
+    return fr
+
+
+def _is_parquet(path: str) -> bool:
+    try:
+        with open(path, "rb") as f:
+            return f.read(4) == b"PAR1"
+    except OSError:
+        return False
+
+
+def _rbind_frames(frames: List[Frame], dest: Optional[str]) -> Frame:
+    out = frames[0]
+    if len(frames) > 1:
+        names = out.names
+        vecs = []
+        for j in range(out.ncols):
+            v0 = out.vecs[j]
+            if v0.type == T_CAT:
+                # per-file domains may differ in content/order: remap
+                # every file's codes into the UNION domain (the
+                # distributed domain-merge contract,
+                # ParseDataset.java:356-535)
+                union: List[str] = []
+                seen = set()
+                for f in frames:
+                    for d in (f.vecs[j].domain or []):
+                        if d not in seen:
+                            seen.add(d)
+                            union.append(d)
+                lut = {d: i for i, d in enumerate(union)}
+                parts = []
+                for f in frames:
+                    codes = np.asarray(f.vecs[j].to_numpy())[: f.nrows]
+                    dom = f.vecs[j].domain or []
+                    remap = np.asarray(
+                        [lut[d] for d in dom] + [-1], np.int32)
+                    parts.append(np.where(
+                        codes >= 0, remap[np.clip(codes, 0, None)], -1))
+                vecs.append(Vec(np.concatenate(parts).astype(np.int32),
+                                T_CAT, domain=union))
+            else:
+                parts = [np.asarray(f.vecs[j].to_numpy())[: f.nrows]
+                         for f in frames]
+                vecs.append(Vec(np.concatenate(parts), v0.type))
+        out = Frame(list(names), vecs)
+    if dest:
+        out.key = dest
+    return out
+
+
+def _apply_setup_overrides(fr: Frame, setup: Optional[ParseSetupResult],
+                           column_types: Optional[Dict[str, str]]) -> Frame:
+    """Client-edited parse setup applied to a format-parsed frame: column
+    renames + num<->enum type overrides (the /3/ParseSetup edit flow)."""
+    if setup is not None and len(setup.column_names) == fr.ncols and \
+            list(setup.column_names) != list(fr.names):
+        fr.names = list(setup.column_names)
+    overrides = dict(column_types or {})
+    if setup is not None and len(setup.column_types) == fr.ncols:
+        for n, t in zip(fr.names, setup.column_types):
+            overrides.setdefault(n, t)
+    for name, want in overrides.items():
+        if name not in fr.names:
+            continue
+        j = fr.names.index(name)
+        v = fr.vecs[j]
+        if want == v.type:
+            continue
+        if want == T_CAT and v.type in (T_NUM, T_TIME):
+            d = np.asarray(v.to_numpy(), np.float64)[: fr.nrows]
+            vals = np.unique(d[~np.isnan(d)])
+            lut = {x: i for i, x in enumerate(vals)}
+            codes = np.asarray(
+                [lut.get(x, -1) if not np.isnan(x) else -1 for x in d],
+                np.int32)
+            dom = [str(int(x)) if x == int(x) else str(x) for x in vals]
+            fr.vecs[j] = Vec(codes, T_CAT, domain=dom)
+        elif want in (T_NUM, T_TIME) and v.type == T_CAT:
+            codes = np.asarray(v.to_numpy())[: fr.nrows]
+            dom = v.domain or []
+            try:
+                dv = np.asarray([float(x) for x in dom], np.float64)
+            except ValueError:
+                continue             # non-numeric labels: keep enum
+            vals = np.where(codes >= 0, dv[np.clip(codes, 0, None)],
+                            np.nan)
+            fr.vecs[j] = Vec(vals.astype(np.float32), T_NUM)
+    return fr
+
+
+def _looks_like_arff(path: str) -> bool:
+    try:
+        with _open(path) as f:
+            for _ in range(50):
+                ln = f.readline()
+                if not ln:
+                    return False
+                s = ln.strip()
+                if not s or s.startswith("%"):
+                    continue
+                return s.lower().startswith("@relation")
+    except OSError:
+        return False
+    return False
+
+
+_ARFF_ATTR_RE = re.compile(r"@attribute\s+('(?:[^']*)'|\"(?:[^\"]*)\"|\S+)"
+                           r"\s+(.+)$", re.IGNORECASE)
+
+
+def _arff_schema(path: str, with_data: bool = False):
+    """@attribute declarations (header-only unless with_data): names,
+    types, declared domains [, data lines]."""
+    names: List[str] = []
+    types: List[str] = []
+    domains: List[Optional[List[str]]] = []
+    data_lines: List[str] = []
+    in_data = False
+    with _open(path) as f:
+        for ln in f:
+            s = ln.strip()
+            if not s or s.startswith("%"):
+                continue
+            low = s.lower()
+            if in_data:
+                data_lines.append(s)
+            elif low.startswith("@attribute"):
+                m = _ARFF_ATTR_RE.match(s)
+                if not m:
+                    raise ValueError(f"bad @attribute line: {s}")
+                nm = m.group(1).strip("'\"")
+                ty = m.group(2).strip()
+                names.append(nm)
+                if ty.startswith("{"):
+                    dom = [t.strip().strip("'\"")
+                           for t in ty.strip("{} ").split(",")]
+                    types.append(T_CAT)
+                    domains.append(dom)
+                elif ty.lower().split()[0] in ("numeric", "real",
+                                               "integer"):
+                    types.append(T_NUM)
+                    domains.append(None)
+                elif ty.lower().startswith("date"):
+                    types.append(T_TIME)
+                    domains.append(None)
+                else:                       # string / relational
+                    types.append(T_STR)
+                    domains.append(None)
+            elif low.startswith("@data"):
+                if not with_data:
+                    break
+                in_data = True
+    if not names:
+        raise ValueError(f"no @attribute declarations in {path}")
+    if with_data:
+        return names, types, domains, data_lines
+    return names, types, domains
+
+
+def parse_arff(path: str, dest: Optional[str] = None) -> Frame:
+    """ARFF (reference: water/parser/ARFFParser.java): @attribute headers
+    declare names + types — numeric/real/integer -> num, {a,b,c} -> enum
+    with the DECLARED level order, string -> str, date -> time; '?' = NA.
+    """
+    import pandas as pd
+    names, types, domains, data_lines = _arff_schema(path, with_data=True)
+    # @data body is CSV with '?' NA
+    import csv as csvmod
+    rows = list(csvmod.reader(data_lines, skipinitialspace=True))
+    cols = list(zip(*rows)) if rows else [[] for _ in names]
+    vecs = []
+    for j, (nm, ty, dom) in enumerate(zip(names, types, domains)):
+        raw = [c.strip().strip("'\"") if isinstance(c, str) else c
+               for c in (cols[j] if j < len(cols) else [])]
+        na = [c in ("?", "") for c in raw]
+        if ty == T_NUM:
+            vals = np.asarray(
+                [np.nan if n else float(c) for c, n in zip(raw, na)],
+                np.float32)
+            vecs.append(Vec(vals, T_NUM))
+        elif ty == T_CAT:
+            lut = {d: i for i, d in enumerate(dom)}
+            codes = np.asarray(
+                [-1 if n else lut.get(c, -1) for c, n in zip(raw, na)],
+                np.int32)
+            vecs.append(Vec(codes, T_CAT, domain=list(dom)))
+        elif ty == T_TIME:
+            ser = pd.to_datetime(
+                pd.Series([None if n else c for c, n in zip(raw, na)]),
+                errors="coerce")
+            ms = ser.to_numpy().astype("datetime64[ms]").astype("int64")
+            vals = np.where(pd.isna(ser).to_numpy(), np.nan,
+                            ms.astype(np.float64))
+            vecs.append(Vec(vals, T_TIME))
+        else:
+            vecs.append(Vec([None if n else c
+                             for c, n in zip(raw, na)], T_STR))
+    fr = Frame(names, vecs, key=dest or os.path.basename(path))
+    log.info("parsed ARFF %s: %d rows, %d cols", path, fr.nrows, fr.ncols)
+    return fr
+
+
+def parse_parquet(paths: Sequence[str],
+                  dest: Optional[str] = None) -> Frame:
+    """Parquet via pyarrow (reference: h2o-parsers/h2o-parquet-parser)
+    feeding the standard column path."""
+    import pyarrow.parquet as pq
+    tables = [pq.read_table(p) for p in paths]
+    import pyarrow as pa
+    table = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+    names, vecs = [], []
+    for name in table.column_names:
+        col = table.column(name)
+        names.append(name)
+        pa_t = col.type
+        if pa.types.is_dictionary(pa_t) or pa.types.is_string(pa_t) or \
+                pa.types.is_large_string(pa_t):
+            vals = col.to_pylist()
+            dom = sorted({v for v in vals if v is not None})
+            lut = {d: i for i, d in enumerate(dom)}
+            codes = np.asarray([lut.get(v, -1) if v is not None else -1
+                                for v in vals], np.int32)
+            vecs.append(Vec(codes, T_CAT, domain=dom))
+        elif pa.types.is_timestamp(pa_t) or pa.types.is_date(pa_t):
+            arr = col.cast(pa.timestamp("ms")).to_numpy(
+                zero_copy_only=False)
+            ms = arr.astype("datetime64[ms]").astype("int64")
+            nat = np.isnat(arr)
+            vecs.append(Vec(np.where(nat, np.nan,
+                                     ms.astype(np.float64)),
+                            T_TIME))
+        elif pa.types.is_boolean(pa_t):
+            vecs.append(Vec(np.asarray(
+                [np.nan if v is None else float(v) for v in
+                 col.to_pylist()], np.float32), T_NUM))
+        else:
+            vals = col.to_numpy(zero_copy_only=False)
+            vecs.append(Vec(np.asarray(vals, np.float32), T_NUM))
+    fr = Frame(names, vecs,
+               key=dest or os.path.basename(paths[0]))
+    log.info("parsed parquet %s: %d rows, %d cols", paths, fr.nrows,
+             fr.ncols)
     return fr
 
 

@@ -114,3 +114,58 @@ def load_frame(dir_uri: str, key: Optional[str] = None) -> Frame:
             # a round trip must not corrupt timestamps.
             vecs.append(Vec(npz[f"c_{n}"], t))
     return Frame(meta["names"], vecs, key=key or meta["key"])
+
+
+# -- cloud object-store backends (h2o-persist-s3 / -gcs analogs) ------------
+
+def register_s3(endpoint_url: Optional[str] = None,
+                access_key: Optional[str] = None,
+                secret_key: Optional[str] = None,
+                scheme: str = "s3") -> None:
+    """Register an ``s3://bucket/key`` byte store against an S3-compatible
+    HTTP endpoint (reference: h2o-persist-s3 / PersistS3.java; the
+    reference likewise reads credentials + endpoint overrides from config).
+
+    boto3 is not in the image, so objects move over the S3 REST surface
+    directly (GET/PUT object).  ``endpoint_url`` (or the
+    ``AWS_ENDPOINT_URL`` env var) points at the store — a real
+    S3-compatible service (minio, GCS interop, on-prem) or a test stub.
+    SigV4 signing is intentionally out of scope: deployments front the
+    store with instance-profile proxies or presigned endpoints; anonymous
+    + header-token access is what the direct path supports
+    (``access_key``/``secret_key`` go out as AWS_ACCESS_KEY_ID /
+    x-api-key headers for stores that accept static credentials)."""
+    import urllib.request
+
+    endpoint = (endpoint_url or os.environ.get("AWS_ENDPOINT_URL") or
+                "").rstrip("/")
+    if not endpoint:
+        raise ValueError("register_s3 needs endpoint_url (or "
+                         "AWS_ENDPOINT_URL)")
+
+    def _url(uri: str) -> str:
+        _, rest = uri.split("://", 1)          # bucket/key...
+        return f"{endpoint}/{rest}"
+
+    def _headers() -> Dict[str, str]:
+        h = {}
+        if access_key:
+            h["AWS_ACCESS_KEY_ID"] = access_key
+            h["x-api-key"] = access_key
+        if secret_key:
+            h["AWS_SECRET_ACCESS_KEY"] = secret_key
+        return h
+
+    def reader(uri: str) -> bytes:
+        req = urllib.request.Request(_url(uri), headers=_headers())
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.read()
+
+    def writer(uri: str, data: bytes) -> None:
+        req = urllib.request.Request(_url(uri), data=data,
+                                     headers=_headers(), method="PUT")
+        with urllib.request.urlopen(req, timeout=60) as r:
+            r.read()
+
+    register_scheme(scheme, reader, writer)
+    log.info("registered %s:// persist backend -> %s", scheme, endpoint)

@@ -1,0 +1,135 @@
+"""User-defined functions: custom model metrics.
+
+Reference (water/udf/*, 1.9k LoC): metric/distribution functions uploaded
+as archives, loaded from a DKV-backed classloader, evaluated inside
+MRTasks via jython (CMetricFunc: map/reduce/metric).  The stock client's
+``h2o.upload_custom_metric`` (h2o-py/h2o/h2o.py:2128-2227) zips generated
+python source into ``func.jar``, uploads it via PostFile, and passes a
+``python:<key>=<module>.<Class>Wrapper`` reference as the builder's
+``custom_metric_func``.
+
+TPU-native: the SAME wire flow, evaluated natively — the uploaded source
+is real python, so no jython bridge is needed.  The generated code does
+``import water.udf.CMetricFunc``; a stub module satisfies it.  The
+map/reduce/metric contract runs on the host over the scored rows (custom
+metrics are O(rows) scalar reductions; the heavy scoring stays on
+device)."""
+
+from __future__ import annotations
+
+import io
+import sys
+import types
+import zipfile
+from typing import Optional
+
+import numpy as np
+
+from h2o_tpu.core.log import get_logger
+
+log = get_logger("udf")
+
+
+def _install_water_stub() -> None:
+    """Satisfy ``import water.udf.CMetricFunc`` in uploaded sources."""
+    if "water.udf.CMetricFunc" in sys.modules:
+        return
+    water = sys.modules.setdefault("water", types.ModuleType("water"))
+    udf = types.ModuleType("water.udf")
+    cmf = types.ModuleType("water.udf.CMetricFunc")
+
+    class CMetricFunc:  # the interface marker (map/reduce/metric)
+        pass
+
+    cmf.CMetricFunc = CMetricFunc
+    # `import water.udf.CMetricFunc as MetricFunc` then uses MetricFunc
+    # as a BASE CLASS (jython lets the java interface through); CPython
+    # binds the alias via getattr(water.udf, "CMetricFunc"), so point the
+    # attribute at the class while sys.modules satisfies the import
+    udf.CMetricFunc = CMetricFunc
+    water.udf = udf
+    sys.modules["water.udf"] = udf
+    sys.modules["water.udf.CMetricFunc"] = cmf
+
+
+def load_custom_func(ref: str):
+    """Resolve 'python:<key>=<module>.<Class>' to an instance.
+
+    <key> is the PostFile upload key whose DKV value is the server-side
+    path of the uploaded zip; <module>.py inside it holds the source."""
+    from h2o_tpu.core.cloud import cloud
+    if not ref:
+        return None
+    spec = ref.split(":", 1)[1] if ref.startswith("python:") else ref
+    key, _, target = spec.partition("=")
+    module_name, _, class_name = target.rpartition(".")
+    path = cloud().dkv.get(key)
+    if path is None:
+        raise ValueError(f"custom func upload {key!r} not found")
+    with open(str(path), "rb") as f:
+        blob = f.read()
+    with zipfile.ZipFile(io.BytesIO(blob)) as z:
+        names = z.namelist()
+        want = f"{module_name}.py"
+        src_name = want if want in names else next(
+            (n for n in names if n.endswith(".py")), None)
+        if src_name is None:
+            raise ValueError(f"no python source in custom func {key!r}")
+        source = z.read(src_name).decode()
+    _install_water_stub()
+    mod = types.ModuleType(module_name or "custom_metric")
+    # the uploaded source uses `import water.udf.CMetricFunc as ...`
+    exec(compile(source, src_name, "exec"), mod.__dict__)
+    cls = mod.__dict__.get(class_name)
+    if cls is None:
+        raise ValueError(f"class {class_name!r} not found in {src_name}")
+    return cls()
+
+
+def compute_custom_metric(func, preds: np.ndarray, actual: np.ndarray,
+                          weights: Optional[np.ndarray] = None,
+                          offsets: Optional[np.ndarray] = None,
+                          model=None) -> float:
+    """Run the CMetricFunc contract: per-row map -> pairwise reduce ->
+    final metric (water/udf/CMetricFunc semantics; preds row layout is
+    the H2O preds array [label, p0, p1...] / [value])."""
+    preds = np.atleast_2d(np.asarray(preds, np.float64))
+    if preds.shape[0] == 1 and preds.shape[1] == len(actual):
+        preds = preds.T
+    n = len(actual)
+    w = np.ones(n) if weights is None else np.asarray(weights, np.float64)
+    o = np.zeros(n) if offsets is None else np.asarray(offsets, np.float64)
+    acc = None
+    for i in range(n):
+        a = actual[i]
+        if a is None or (isinstance(a, float) and np.isnan(a)):
+            continue
+        l = func.map(preds[i].tolist(), [float(a)], float(w[i]),
+                     float(o[i]), model)
+        acc = l if acc is None else func.reduce(acc, l)
+    if acc is None:
+        return float("nan")
+    return float(func.metric(acc))
+
+
+def attach_custom_metric(model, metrics, frame, ref: str,
+                         name: Optional[str] = None) -> None:
+    """Compute + record the custom metric on a ModelMetrics object."""
+    try:
+        func = load_custom_func(ref)
+        raw = np.asarray(model.predict_raw(frame))[: frame.nrows]
+        y_name = model.params.get("response_column")
+        yv = frame.vec(y_name)
+        act = np.asarray(yv.to_numpy(), np.float64)[: frame.nrows]
+        wc = model.params.get("weights_column")
+        w = np.asarray(frame.vec(wc).to_numpy(),
+                       np.float64)[: frame.nrows] \
+            if wc and wc in frame else None
+        value = compute_custom_metric(func, raw, act, w, model=model)
+        metrics.data["custom_metric_name"] = \
+            name or ref.split("=")[0].split(":")[-1]
+        metrics.data["custom_metric_value"] = value
+    except Exception as e:  # noqa: BLE001 — metric failure must not kill
+        log.warning("custom metric %r failed: %s", ref, e)
+        metrics.data["custom_metric_name"] = ref
+        metrics.data["custom_metric_value"] = float("nan")

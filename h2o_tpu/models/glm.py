@@ -316,6 +316,17 @@ class GLM(ModelBuilder):
     algo = "glm"
     model_cls = GLMModel
 
+    # engine-fixed: IRLSM/COD is the solver (L-BFGS absent), links are
+    # family-default, NAs mean-impute, p-values/collinear-removal absent
+    ENGINE_FIXED = {
+        "solver": ("AUTO", "IRLSM", "COORDINATE_DESCENT"),
+        "link": ("family_default",),
+        "missing_values_handling": ("MeanImputation",),
+        "compute_p_values": (False,),
+        "remove_collinear_columns": (False,),
+        "intercept": (True,),
+    }
+
     def default_params(self) -> Dict:
         p = super().default_params()
         p.update(family="AUTO", solver="AUTO", alpha=None, lambda_=None,

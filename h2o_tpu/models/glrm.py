@@ -203,6 +203,11 @@ class GLRMModel(Model):
 class GLRM(ModelBuilder):
     algo = "glrm"
     model_cls = GLRMModel
+
+    ENGINE_FIXED = {
+        "multi_loss": ("Categorical",),
+        "recover_svd": (False,),
+    }
     supervised = False
 
     def default_params(self) -> Dict:

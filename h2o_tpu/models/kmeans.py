@@ -100,6 +100,11 @@ class KMeansModel(Model):
 class KMeans(ModelBuilder):
     algo = "kmeans"
     model_cls = KMeansModel
+
+    ENGINE_FIXED = {
+        "estimate_k": (False,),           # not implemented: k is explicit
+        "categorical_encoding": ("AUTO", "Enum"),
+    }
     supervised = False
 
     def default_params(self) -> Dict:

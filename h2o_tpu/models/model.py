@@ -279,11 +279,37 @@ class ModelBuilder:
     # orchestration (e.g. TargetEncoder's encoding folds) set this False
     supports_cv = True
 
+    # Params the engine supports only at specific values (H2O semantics:
+    # params work or error — never a silent no-op).  Maps param ->
+    # iterable of accepted values; strings compare case-insensitively
+    # with -_ collapsed.  Subclasses extend ENGINE_FIXED.
+    ENGINE_FIXED: Dict[str, tuple] = {}
+
+    @staticmethod
+    def _norm(v):
+        if isinstance(v, str):
+            return v.lower().replace("_", "").replace("-", "")
+        return v
+
+    def _validate_fixed(self, user_params: Dict) -> None:
+        for k, accepted in self.ENGINE_FIXED.items():
+            if k not in user_params:
+                continue
+            v = self._norm(user_params[k])
+            ok = any(v == self._norm(a) for a in accepted)
+            if not ok:
+                raise ValueError(
+                    f"{self.algo}: param '{k}'={user_params[k]!r} is not "
+                    f"supported by this engine (accepted: "
+                    f"{sorted(map(str, accepted))}); refusing to train "
+                    "with a silently-ignored setting")
+
     def __init__(self, **params):
         self.params = self.default_params()
         unknown = set(params) - set(self.params) - {"model_id"}
         if unknown:
             raise ValueError(f"{self.algo}: unknown params {sorted(unknown)}")
+        self._validate_fixed(params)
         self.params.update(params)
         self.model_id = params.get("model_id")
 
@@ -296,7 +322,7 @@ class ModelBuilder:
                     keep_cross_validation_models=True,
                     keep_cross_validation_predictions=False,
                     keep_cross_validation_fold_assignment=False,
-                    checkpoint=None)
+                    checkpoint=None, custom_metric_func=None)
 
     # -- public surface (mirrors h2o-py estimator.train) -------------------
 
@@ -336,6 +362,16 @@ class ModelBuilder:
                                      validation_frame)
             else:
                 model = self._fit(j, x, y, training_frame, validation_frame)
+            cmf = self.params.get("custom_metric_func")
+            if cmf:
+                # UDF metric (water/udf CMetricFunc flow, core/udf.py)
+                from h2o_tpu.core.udf import attach_custom_metric
+                for mkey, fr_m in (("training_metrics", training_frame),
+                                   ("validation_metrics",
+                                    validation_frame)):
+                    mm_obj = model.output.get(mkey)
+                    if mm_obj is not None and fr_m is not None:
+                        attach_custom_metric(model, mm_obj, fr_m, cmf)
             model.run_time_ms = int((time.time() - t0) * 1000)
             cloud().dkv.put(model.key, model)
             log.info("%s trained in %.2fs -> %s", self.algo,

@@ -39,6 +39,7 @@ def _score(model) -> float:
 class ModelSelectionModel(Model):
     algo = "modelselection"
 
+
     def best_model_per_size(self) -> Dict[int, Dict]:
         return self.output["best_models"]
 
@@ -58,6 +59,8 @@ class ModelSelectionModel(Model):
 
 
 class ModelSelection(ModelBuilder):
+    ENGINE_FIXED = {"p_values_threshold": (0.0,)}
+
     algo = "modelselection"
     model_cls = ModelSelectionModel
 

@@ -57,6 +57,11 @@ class PCAModel(Model):
 class PCA(ModelBuilder):
     algo = "pca"
     model_cls = PCAModel
+
+    ENGINE_FIXED = {
+        # one method: full Gram + eigendecomposition
+        "pca_method": ("AUTO", "GramSVD"),
+    }
     supervised = False
 
     def default_params(self) -> Dict:

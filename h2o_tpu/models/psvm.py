@@ -78,6 +78,8 @@ class PSVM(ModelBuilder):
     algo = "psvm"
     model_cls = PSVMModel
 
+    ENGINE_FIXED = {"kernel_type": ("gaussian",)}
+
     def default_params(self) -> Dict:
         p = super().default_params()
         p.update(hyper_param=1.0, kernel_type="gaussian", gamma=-1.0,

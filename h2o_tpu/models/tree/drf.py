@@ -59,6 +59,11 @@ class DRF(ModelBuilder):
     algo = "drf"
     model_cls = DRFModel
 
+    ENGINE_FIXED = {
+        "histogram_type": ("AUTO", "QuantilesGlobal"),
+        "binomial_double_trees": (False,),
+    }
+
     def default_params(self) -> Dict:
         p = super().default_params()
         p.update(ntrees=50, max_depth=20, min_rows=1.0, nbins=20,
@@ -107,13 +112,8 @@ class DRF(ModelBuilder):
                 else max(1, C // 3)
 
         from h2o_tpu.core.log import get_logger
-        depth = int(p["max_depth"])
-        if depth > 12:
-            # dense level-wise layout is exponential in depth; deeper trees
-            # need the sparse node-budget layout (tracked follow-up)
-            get_logger("drf").warning(
-                "max_depth=%d clamped to 12 (dense tree layout)", depth)
-            depth = 12
+        from h2o_tpu.models.tree.jit_engine import clamp_depth
+        depth = clamp_depth(int(p["max_depth"]), get_logger("drf"))
         F0 = jnp.zeros((R, K), jnp.float32)
         prior = 0
         if ckpt is not None:
@@ -137,7 +137,7 @@ class DRF(ModelBuilder):
             out = dict(
                 x=list(di.x), split_points=sp_np, is_cat=ic_np,
                 nbins=binned.nbins, split_col=sc, bitset=bs, value=vl,
-                max_depth=depth,
+                max_depth=depth, effective_max_depth=depth,
                 response_domain=di.response_domain if nclass >= 2 else None,
                 domains={c: list(train.vec(c).domain)
                          for c in di.cat_names},

@@ -70,6 +70,14 @@ class GBM(ModelBuilder):
     algo = "gbm"
     model_cls = GBMModel
 
+    # engine-fixed params (ModelBuilder._validate_fixed: accepted values
+    # only — anything else errors instead of silently no-opping)
+    ENGINE_FIXED = {
+        "histogram_type": ("AUTO", "QuantilesGlobal"),
+        "categorical_encoding": ("AUTO", "Enum"),
+        "calibrate_model": (False,),
+    }
+
     def default_params(self) -> Dict:
         p = super().default_params()
         p.update(ntrees=50, max_depth=5, min_rows=10.0, nbins=20,
@@ -155,7 +163,9 @@ class GBM(ModelBuilder):
                                     int(p["max_depth"]))
 
         C = len(di.x)
-        depth = int(p["max_depth"])
+        from h2o_tpu.core.log import get_logger
+        from h2o_tpu.models.tree.jit_engine import clamp_depth
+        depth = clamp_depth(int(p["max_depth"]), get_logger("gbm"))
         newton = dist_name not in ("gaussian", "laplace", "quantile",
                                    "huber")
         if p.get("force_newton"):
@@ -179,7 +189,7 @@ class GBM(ModelBuilder):
             out = dict(
                 x=list(di.x), split_points=sp_np, is_cat=ic_np,
                 nbins=binned.nbins, split_col=sc, bitset=bs, value=vl,
-                max_depth=depth, f0=f0_out,
+                max_depth=depth, f0=f0_out, effective_max_depth=depth,
                 distribution_resolved=dist_name,
                 response_domain=di.response_domain if nclass >= 2 else None,
                 domains={c: list(train.vec(c).domain)

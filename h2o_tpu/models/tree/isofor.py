@@ -154,6 +154,7 @@ class AnomalyModel(Model):
 class IsolationForestModel(AnomalyModel):
     algo = "isolationforest"
 
+
     def _total_path(self, frame: Frame):
         out = self.output
         X = frame.as_matrix(out["x"])
@@ -172,6 +173,8 @@ class IsolationForestModel(AnomalyModel):
 
 
 class IsolationForest(ModelBuilder):
+    ENGINE_FIXED = {"mtries": (-1, -2), "contamination": (-1.0,)}
+
     algo = "isolationforest"
     model_cls = IsolationForestModel
     supervised = False

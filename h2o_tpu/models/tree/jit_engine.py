@@ -14,6 +14,19 @@ TPU-native answer is to move the ENTIRE loop into XLA:
 
 One dispatch trains the whole model.  The host only sees the final
 (T, K, H) tree arrays.
+
+DESIGN LIMIT — dense tree heaps: trees live in fixed-shape heap arrays
+with H = 2^(D+1)-1 slots (split_col (H,), bitset (H, B+1), value (H,)).
+The reference stores sparse CompressedTree bytecode, so its depth-20 DRF
+default costs only the nodes that exist; here level d always allocates
+2^d histogram rows and heap slots.  Above depth ~14 the (L, C, B+1, 4)
+histograms and (T, K, H, B+1) bitsets grow to GB scale, so builders CLAMP
+requested depth to ``H2O_TPU_MAX_TREE_DEPTH`` (default 12, see
+``clamp_depth``) with a logged warning and an ``effective_max_depth``
+output field — shallow-and-more-trees is the efficient operating point on
+this engine (the boosted setting the TPU's static shapes favor).  A
+sparse-frontier redesign (cap live leaves per level, LightGBM-style)
+is the planned lift of this limit.
 """
 
 from __future__ import annotations
@@ -29,6 +42,26 @@ from h2o_tpu.models.tree.shared_tree import find_splits
 from h2o_tpu.ops.histogram import histogram_build_traced as _shard_histogram
 
 EPS = 1e-10
+
+
+def max_supported_depth() -> int:
+    import os
+    return int(os.environ.get("H2O_TPU_MAX_TREE_DEPTH", "12"))
+
+
+def clamp_depth(requested: int, log=None) -> int:
+    """Clamp a requested max_depth to the dense-heap engine limit (module
+    docstring).  Never silent: logs a warning; builders also record
+    ``effective_max_depth`` in the model output."""
+    cap = max_supported_depth()
+    if requested > cap:
+        if log is not None:
+            log.warning(
+                "max_depth=%d exceeds the dense tree-heap limit; clamped "
+                "to %d (H2O_TPU_MAX_TREE_DEPTH; see "
+                "models/tree/jit_engine.py design note)", requested, cap)
+        return cap
+    return int(requested)
 
 
 def _node_val(wg, wh, w, newton: bool, reg_lambda: float = 0.0):

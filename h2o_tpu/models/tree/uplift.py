@@ -172,6 +172,7 @@ def _train_uplift_forest(bins, treat, yv, w, active, key, *, ntrees: int,
 class UpliftDRFModel(Model):
     algo = "upliftdrf"
 
+
     def predict_raw(self, frame: Frame):
         out = self.output
         m = frame.as_matrix(out["x"])
@@ -217,6 +218,8 @@ class UpliftDRFModel(Model):
 
 
 class UpliftDRF(ModelBuilder):
+    ENGINE_FIXED = {"auuc_type": ("AUTO", "qini"), "auuc_nbins": (-1,)}
+
     algo = "upliftdrf"
     model_cls = UpliftDRFModel
 

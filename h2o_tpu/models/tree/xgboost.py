@@ -56,6 +56,14 @@ class XGBoost(GBM):
     algo = "xgboost"
     model_cls = XGBoostModel
 
+    ENGINE_FIXED = {
+        **GBM.ENGINE_FIXED,
+        "reg_alpha": (0.0,),              # L1 leaf reg not implemented
+        "tree_method": ("auto", "hist"),  # this engine IS hist
+        "grow_policy": ("depthwise",),
+        "booster": ("gbtree",),
+    }
+
     def default_params(self) -> Dict:
         p = super().default_params()
         p.update(_XGB_DEFAULTS)

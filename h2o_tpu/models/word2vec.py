@@ -141,6 +141,13 @@ class Word2VecModel(Model):
 class Word2Vec(ModelBuilder):
     algo = "word2vec"
     model_cls = Word2VecModel
+
+    # the HSM tree is redesigned as negative sampling (module docstring);
+    # SkipGram is the one architecture implemented
+    ENGINE_FIXED = {
+        "word_model": ("SkipGram",),
+        "norm_model": ("HSM",),
+    }
     supervised = False
 
     def default_params(self) -> Dict:

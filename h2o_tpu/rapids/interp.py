@@ -505,6 +505,18 @@ def _eval(node, env: _Env):
             d = v.to_numpy()
             keep &= (d >= 0) if v.is_categorical else ~np.isnan(d)
         return fr.slice_rows(keep)
+    if op == "naCnt":
+        fr = _as_frame(_eval(node[1], env))
+        out = []
+        for v in fr.vecs:
+            if v.host_data is not None:
+                out.append(float(sum(x is None for x in v.host_data)))
+            elif v.is_categorical:
+                out.append(float((np.asarray(v.to_numpy()) < 0).sum()))
+            else:
+                out.append(float(np.isnan(
+                    np.asarray(v.to_numpy(), np.float64)).sum()))
+        return out
     if op == "which":
         fr = _as_frame(_eval(node[1], env))
         d = np.asarray(fr.vecs[0].to_numpy())

@@ -48,6 +48,23 @@ def pytest_configure(config):
         "(module-scoped fixtures); per-test leak purge disabled")
 
 
+_TEST_COUNTER = {"n": 0}
+
+
+@pytest.fixture(autouse=True)
+def _xla_cache_hygiene():
+    """Periodically drop jitted-executable caches.  A full-suite run
+    compiles many hundreds of XLA:CPU programs in one process; the
+    accumulated native state has produced intermittent segfaults in
+    late-suite compiles (observed at the uplift forest build).  Bounding
+    the live-executable population keeps the compiler's working set in
+    the regime every smaller run exercises."""
+    yield
+    _TEST_COUNTER["n"] += 1
+    if _TEST_COUNTER["n"] % 40 == 0:
+        jax.clear_caches()
+
+
 @pytest.fixture(autouse=True)
 def _dkv_leak_check(request):
     """Per-test key-leak enforcement (water/runner/CheckKeysTask analog:

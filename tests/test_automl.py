@@ -93,3 +93,40 @@ def test_glm_non_negative(cl, bin_frame):
     coefs = m.coef()
     non_int = [v for k, v in coefs.items() if k != "Intercept"]
     assert all(v >= -1e-8 for v in non_int)
+
+
+def test_automl_plan_parity(cl, bin_frame):
+    """Round-3 plan depth (AutoML.java:346,457-460): XGBoost steps,
+    exploitation phase refining the incumbent, BOTH ensemble variants,
+    and WorkAllocations time budgeting."""
+    from h2o_tpu.automl import AutoML
+    # model-count budget sized so the whole plan completes and the
+    # exploitation phase still has room (3 defaults + 3+4 grid + 2 exploit)
+    aml = AutoML(max_models=12, seed=7, nfolds=3,
+                 include_algos=["xgboost", "gbm", "stackedensemble"],
+                 project_name="parity")
+    aml.train(y="y", training_frame=bin_frame)
+    rows = aml.leaderboard.rows()
+    algos = {r["algo"] for r in rows}
+    assert "xgboost" in algos          # XGBoost step present
+    exploit = [e for e in aml.event_log.events
+               if e["stage"].startswith("exploit")]
+    assert exploit, "no exploitation step attempted"
+    se_names = [r["model_id"] for r in rows
+                if "StackedEnsemble" in r["model_id"]]
+    assert any("BestOfFamily" in s for s in se_names)
+    assert any("AllModels" in s for s in se_names)
+
+
+def test_automl_respects_max_runtime(cl, bin_frame):
+    import time
+    from h2o_tpu.automl import AutoML
+    t0 = time.time()
+    aml = AutoML(max_models=0, max_runtime_secs=25.0, seed=1, nfolds=0,
+                 include_algos=["gbm", "glm"], project_name="budgeted")
+    aml.train(y="y", training_frame=bin_frame)
+    wall = time.time() - t0
+    # per-model compiles can overshoot a step boundary, but the loop must
+    # stop promptly after the budget — generous 4x bound
+    assert wall < 100.0
+    assert len(aml.leaderboard.rows()) >= 1

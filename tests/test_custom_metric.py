@@ -1,0 +1,67 @@
+"""Custom metric functions (water/udf CMetricFunc) via the UNMODIFIED
+client's h2o.upload_custom_metric flow (h2o-py/h2o/h2o.py:2128)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+_H2O_PY = "/root/reference/h2o-py"
+
+pytestmark = [
+    pytest.mark.skipif(not os.path.isdir(_H2O_PY),
+                       reason="reference h2o-py client not present"),
+    pytest.mark.shared_dkv,
+]
+
+
+@pytest.fixture(scope="module")
+def h2o_client(cl):
+    from h2o_tpu.api.server import RestServer
+    srv = RestServer(port=0).start()
+    if _H2O_PY not in sys.path:
+        sys.path.insert(0, _H2O_PY)
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        import h2o
+    h2o.connect(url=f"http://127.0.0.1:{srv.port}", verbose=False,
+                strict_version_check=False)
+    yield h2o
+    srv.stop()
+
+
+CUSTOM_MAE = """class CustomMaeFunc:
+    def map(self, pred, act, w, o, model):
+        return [w * abs(act[0] - pred[0]), w]
+
+    def reduce(self, l, r):
+        return [l[0] + r[0], l[1] + r[1]]
+
+    def metric(self, l):
+        return l[0] / l[1]
+"""
+
+
+def test_custom_metric_through_client(h2o_client):
+    h2o = h2o_client
+    rng = np.random.default_rng(4)
+    n = 200
+    x = rng.normal(size=n)
+    y = 2 * x + rng.normal(size=n) * 0.1
+    hf = h2o.H2OFrame({"x": x.tolist(), "y": y.tolist()})
+
+    ref = h2o.upload_custom_metric(CUSTOM_MAE, class_name="CustomMaeFunc",
+                                   func_name="mae")
+    assert ref.startswith("python:")
+
+    from h2o.estimators import H2OGradientBoostingEstimator
+    gbm = H2OGradientBoostingEstimator(ntrees=10, max_depth=3, seed=1,
+                                       custom_metric_func=ref)
+    gbm.train(x=["x"], y="y", training_frame=hf)
+    tm = gbm._model_json["output"]["training_metrics"]
+    assert tm["custom_metric_name"] == "mae"
+    cval = tm["custom_metric_value"]
+    # the custom MAE must agree with the engine's own MAE
+    assert abs(cval - gbm.mae()) < 1e-5

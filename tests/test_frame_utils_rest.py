@@ -1,0 +1,87 @@
+"""CreateFrame / Interaction / PartialDependence REST routes via the
+stock client (hex/CreateFrame.java, hex/Interaction.java,
+hex/PartialDependence.java)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+_H2O_PY = "/root/reference/h2o-py"
+
+pytestmark = [
+    pytest.mark.skipif(not os.path.isdir(_H2O_PY),
+                       reason="reference h2o-py client not present"),
+    pytest.mark.shared_dkv,
+]
+
+
+@pytest.fixture(scope="module")
+def h2o_client(cl):
+    from h2o_tpu.api.server import RestServer
+    srv = RestServer(port=0).start()
+    if _H2O_PY not in sys.path:
+        sys.path.insert(0, _H2O_PY)
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        import h2o
+    h2o.connect(url=f"http://127.0.0.1:{srv.port}", verbose=False,
+                strict_version_check=False)
+    yield h2o
+    srv.stop()
+
+
+def test_create_frame(h2o_client):
+    h2o = h2o_client
+    cf = h2o.create_frame(rows=300, cols=5, categorical_fraction=0.4,
+                          integer_fraction=0.2, factors=3, seed=11,
+                          missing_fraction=0.1, has_response=True)
+    assert cf.dim == [300, 6]
+    types = set(cf.types.values())
+    assert "enum" in types
+    # missing_fraction produced NAs somewhere
+    assert sum(cf.nacnt()) > 0
+
+
+def test_interaction(h2o_client):
+    h2o = h2o_client
+    df = {"a": ["x", "y", "x", "z"] * 30, "b": ["p", "q", "p", "q"] * 30}
+    hf = h2o.H2OFrame(df)
+    hf["a"] = hf["a"].asfactor()
+    hf["b"] = hf["b"].asfactor()
+    it = h2o.interaction(hf, factors=["a", "b"], pairwise=True,
+                         max_factors=2, min_occurrence=1)
+    assert it.dim == [120, 1]
+    lv = it.levels()[0]
+    # top-2 combined levels + 'other' bucket (max_factors cap)
+    assert len(lv) == 3 and "other" in lv
+
+
+def test_partial_dependence(h2o_client):
+    h2o = h2o_client
+    rng = np.random.default_rng(5)
+    n = 240
+    x = rng.normal(size=n)
+    g = np.where(rng.uniform(size=n) > 0.5, "u", "v")
+    y = np.where(x + (g == "u") * 0.8 + rng.normal(size=n) * 0.3 > 0.4,
+                 "t", "f")
+    hf = h2o.H2OFrame({"x": x.tolist(), "g": g.tolist(),
+                       "y": y.tolist()})
+    hf["g"] = hf["g"].asfactor()
+    hf["y"] = hf["y"].asfactor()
+    from h2o.estimators import H2OGradientBoostingEstimator
+    gbm = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=1)
+    gbm.train(x=["x", "g"], y="y", training_frame=hf)
+    pdp = gbm.partial_plot(hf, cols=["x", "g"], plot=False, nbins=6)
+    assert len(pdp) == 2
+    tbl = pdp[0]
+    assert tbl.col_header == ["x", "mean_response", "stddev_response",
+                              "std_error_mean_response"]
+    means = [r[1] for r in tbl.cell_values]
+    # monotone-ish: high x -> higher P(t)
+    assert means[-1] > means[0]
+    cat_tbl = pdp[1]
+    labels = [r[0] for r in cat_tbl.cell_values]
+    assert set(labels) == {"u", "v"}
