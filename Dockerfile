@@ -1,0 +1,34 @@
+# h2o-tpu serving/compute image.
+#
+# Reference deployment surface (SURVEY §2.7): the JVM reference ships
+# `java -jar h2o.jar` standalone, h2o-hadoop-* YARN drivers, and h2o-k8s
+# DNS-based clustering.  The TPU rebuild deploys as one container per TPU
+# host; multi-host pods rendezvous through jax.distributed (see
+# deploy/k8s/h2o-tpu.yaml for the headless-service analog of the
+# reference's flatfile discovery).
+#
+# Build:  docker build -t h2o-tpu .
+# Run  :  docker run -p 54321:54321 h2o-tpu
+FROM python:3.12-slim
+
+# libtpu comes from the TPU VM host runtime; jax[tpu] wheels pull the
+# matching release when building on a Cloud TPU VM image.
+RUN pip install --no-cache-dir \
+    "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    numpy pandas pyarrow
+
+WORKDIR /opt/h2o-tpu
+COPY h2o_tpu/ h2o_tpu/
+COPY setup.py README.md ./
+RUN pip install --no-cache-dir -e .
+
+# REST API port (same default as the reference's :54321)
+EXPOSE 54321
+
+ENV H2O_TPU_IP=0.0.0.0 \
+    H2O_TPU_PORT=54321 \
+    H2O_TPU_ICE_ROOT=/var/lib/h2o-tpu
+
+VOLUME ["/var/lib/h2o-tpu"]
+
+ENTRYPOINT ["python", "-m", "h2o_tpu"]

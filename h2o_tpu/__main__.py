@@ -1,0 +1,82 @@
+"""`python -m h2o_tpu` — standalone node entry point.
+
+The analog of `java -jar h2o.jar` (reference H2OApp.main ->
+water/H2O.java:2340): boot the cloud from H2O_TPU_* env flags / argv,
+start the REST server, and serve until shut down (POST /3/Shutdown or
+SIGTERM).
+
+Multi-host: set H2O_TPU_COORDINATOR (host:port of process 0),
+H2O_TPU_NUM_PROCESSES and H2O_TPU_PROCESS_ID — the jax.distributed
+rendezvous is the flatfile-discovery analog (SURVEY §3.1; reference
+water/init/NetworkInit.java:166-186).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import threading
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="h2o_tpu", description="h2o-tpu standalone node")
+    ap.add_argument("--name", default=None, help="cloud name (-name)")
+    ap.add_argument("--port", type=int, default=None,
+                    help="REST port (-baseport)")
+    ap.add_argument("--ip", default=None, help="bind address")
+    ap.add_argument("--ice-root", default=None,
+                    help="spill/checkpoint dir (-ice_root)")
+    ap.add_argument("--ssl-cert", default=None, help="PEM cert -> https")
+    ap.add_argument("--ssl-key", default=None, help="PEM key -> https")
+    ap.add_argument("--basic-auth", default=None,
+                    help="user:password Basic auth")
+    ap.add_argument("--client", action="store_true",
+                    help="client mode: no data homing (-client)")
+    ap.add_argument("--auto-recovery-dir", default=None,
+                    help="job recovery snapshots (-auto_recovery_dir)")
+    ns = ap.parse_args(argv)
+
+    flags = {k: v for k, v in dict(
+        name=ns.name, port=ns.port, ip=ns.ip, ice_root=ns.ice_root,
+        ssl_cert=ns.ssl_cert, ssl_key=ns.ssl_key,
+        basic_auth=ns.basic_auth, client=ns.client or None,
+        auto_recovery_dir=ns.auto_recovery_dir).items() if v is not None}
+
+    from h2o_tpu.core.cloud import Cloud
+    coord = os.environ.get("H2O_TPU_COORDINATOR")
+    if coord:
+        cl = Cloud.boot_multihost(
+            coordinator=coord,
+            num_processes=int(os.environ["H2O_TPU_NUM_PROCESSES"]),
+            process_id=int(os.environ["H2O_TPU_PROCESS_ID"]), **flags)
+    else:
+        cl = Cloud.boot(**flags)
+
+    from h2o_tpu.api.server import RestServer
+    srv = RestServer(ip=cl.args.ip).start()
+
+    if cl.args.auto_recovery_dir:
+        from h2o_tpu.core.recovery import auto_recover
+        threading.Thread(target=auto_recover,
+                         args=(cl.args.auto_recovery_dir,),
+                         daemon=True).start()
+
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    try:
+        while srv.thread.is_alive() and not stop.wait(1.0):
+            pass
+    finally:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

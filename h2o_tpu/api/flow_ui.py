@@ -1,0 +1,124 @@
+"""Built-in web UI served at / — the Flow analog.
+
+The reference serves the prebuilt h2o-flow notebook JS at :54321
+(h2o-web, SURVEY §2.3 "serve any static UI").  The TPU rebuild ships a
+self-contained single-file dashboard over the same REST v3 surface:
+cluster status, frames/models/jobs browsing, and a Rapids console —
+no external assets (works in air-gapped TPU pods).
+"""
+
+FLOW_HTML = """<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>h2o-tpu</title>
+<style>
+  body { font-family: -apple-system, 'Segoe UI', Roboto, sans-serif;
+         margin: 0; background: #f4f6f8; color: #1a1a2e; }
+  header { background: #16213e; color: #fff; padding: 10px 24px;
+           display: flex; align-items: baseline; gap: 16px; }
+  header h1 { font-size: 18px; margin: 0; }
+  header span { color: #9fb3c8; font-size: 13px; }
+  main { padding: 16px 24px; display: grid; gap: 16px;
+         grid-template-columns: 1fr 1fr; }
+  section { background: #fff; border-radius: 8px; padding: 12px 16px;
+            box-shadow: 0 1px 3px rgba(0,0,0,.08); }
+  section.wide { grid-column: 1 / -1; }
+  h2 { font-size: 14px; margin: 0 0 8px; color: #0f3460;
+       text-transform: uppercase; letter-spacing: .05em; }
+  table { border-collapse: collapse; width: 100%; font-size: 13px; }
+  th, td { text-align: left; padding: 4px 8px;
+           border-bottom: 1px solid #e8ecf1; }
+  th { color: #5a6a7a; font-weight: 600; }
+  tr:hover td { background: #f0f4ff; }
+  input[type=text] { width: 70%; padding: 6px 8px; font: 13px monospace;
+           border: 1px solid #cbd5e1; border-radius: 4px; }
+  button { padding: 6px 14px; border: 0; border-radius: 4px;
+           background: #0f3460; color: #fff; cursor: pointer; }
+  pre { background: #0b132b; color: #d7e3f4; padding: 10px;
+        border-radius: 6px; font-size: 12px; overflow: auto;
+        max-height: 220px; }
+  .pill { display: inline-block; padding: 1px 8px; border-radius: 10px;
+          font-size: 11px; background: #e0f2e9; color: #14532d; }
+  .pill.run { background: #fef3c7; color: #92400e; }
+  .pill.fail { background: #fee2e2; color: #991b1b; }
+</style>
+</head>
+<body>
+<header>
+  <h1>h2o-tpu</h1><span id="cloud">connecting…</span>
+</header>
+<main>
+  <section class="wide">
+    <h2>Rapids console</h2>
+    <input type="text" id="rap" placeholder="(mean (cols frame 'col'))"
+           onkeydown="if(event.key==='Enter')runRapids()">
+    <button onclick="runRapids()">Run</button>
+    <pre id="rapout">&gt; results appear here</pre>
+  </section>
+  <section><h2>Frames</h2><table id="frames"></table></section>
+  <section><h2>Models</h2><table id="models"></table></section>
+  <section class="wide"><h2>Jobs</h2><table id="jobs"></table></section>
+</main>
+<script>
+const J = p => fetch(p).then(r => r.json());
+function rows(el, head, data) {
+  el.innerHTML = '<tr>' + head.map(h => `<th>${h}</th>`).join('') +
+    '</tr>' + data.map(r => '<tr>' +
+      r.map(c => `<td>${c ?? ''}</td>`).join('') + '</tr>').join('');
+}
+async function refresh() {
+  try {
+    const c = await J('/3/Cloud');
+    document.getElementById('cloud').textContent =
+      `${c.cloud_name} — ${c.cloud_size} nodes — v${c.version}`;
+    const fr = await J('/3/Frames');
+    rows(document.getElementById('frames'), ['key', 'rows', 'cols'],
+      fr.frames.map(f => [f.frame_id.name, f.row_count ?? f.rows,
+                          f.column_count]));
+    const mo = await J('/3/Models');
+    rows(document.getElementById('models'), ['key', 'algo', 'category'],
+      mo.models.map(m => [m.model_id.name, m.algo,
+                          m.output?.model_category]));
+    const jb = await J('/3/Jobs');
+    rows(document.getElementById('jobs'),
+      ['key', 'description', 'status', 'progress'],
+      jb.jobs.map(j => [j.key?.name, j.description,
+        `<span class="pill ${j.status === 'RUNNING' ? 'run' :
+           j.status === 'FAILED' ? 'fail' : ''}">${j.status}</span>`,
+        Math.round((j.progress ?? 0) * 100) + '%']));
+  } catch (e) {
+    document.getElementById('cloud').textContent = 'error: ' + e;
+  }
+}
+async function runRapids() {
+  const ast = document.getElementById('rap').value;
+  const out = document.getElementById('rapout');
+  try {
+    const r = await fetch('/99/Rapids', {method: 'POST',
+      headers: {'Content-Type': 'application/x-www-form-urlencoded'},
+      body: 'ast=' + encodeURIComponent(ast) + '&session_id=_flow'});
+    out.textContent = '> ' + ast + '\\n' +
+      JSON.stringify(await r.json(), null, 2);
+    refresh();
+  } catch (e) { out.textContent = 'error: ' + e; }
+}
+refresh();
+setInterval(refresh, 4000);
+</script>
+</body>
+</html>
+"""
+
+
+def register_routes():
+    from h2o_tpu.api.server import route
+
+    @route("GET", r"/(?:flow/?(?:index\.html)?)?")
+    def flow_index(params):
+        return ("text/html; charset=utf-8", FLOW_HTML.encode())
+
+    @route("GET", r"/3/")
+    def api_index(params):
+        from h2o_tpu.api.handlers import endpoints
+        return endpoints(params)

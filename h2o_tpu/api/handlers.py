@@ -57,8 +57,8 @@ def cloud_status(params):
         "cloud_healthy": True,
         "consensus": True,
         "locked": True,
-        "is_client": False,
-        "internal_security_enabled": False,
+        "is_client": bool(c.args.client),
+        "internal_security_enabled": bool(c.args.ssl_cert),
         "nodes": [{
             "h2o": f"tpu-{i}", "ip_port": f"device:{i}", "healthy": True,
             "last_ping": int(time.time() * 1000), "pid": os.getpid(),
@@ -993,8 +993,10 @@ def model_metrics(params, model_id, frame_id):
     fr = cloud().dkv.get(frame_id)
     if not isinstance(m, Model) or not isinstance(fr, Frame):
         raise H2OError(404, "model or frame not found")
-    return {"model_metrics": [_metrics_dict(m.model_metrics(fr),
-                                            frame_id=frame_id,
+    mm = m.model_metrics(fr)
+    from h2o_tpu.api.handlers_models import record_metrics
+    record_metrics(model_id, frame_id, mm)
+    return {"model_metrics": [_metrics_dict(mm, frame_id=frame_id,
                                             model_id=model_id)]}
 
 
@@ -1117,3 +1119,9 @@ def frame_load(params):
 # own module; importing registers them on the shared route table.
 from h2o_tpu.api import handlers_ml  # noqa: E402,F401
 from h2o_tpu.api import handlers_frames  # noqa: E402,F401
+from h2o_tpu.api import handlers_ext  # noqa: E402,F401
+from h2o_tpu.api import handlers_models  # noqa: E402,F401
+from h2o_tpu.api import handlers_transforms  # noqa: E402,F401
+from h2o_tpu.api import handlers_analysis  # noqa: E402,F401
+from h2o_tpu.api import flow_ui  # noqa: E402
+flow_ui.register_routes()

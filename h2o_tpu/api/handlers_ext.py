@@ -1,0 +1,475 @@
+"""Extended REST v3/v4/v99 surface: admin, diagnostics, per-column frame
+routes, node-persistent storage, and the v4 metadata endpoints.
+
+Reference handlers (all under /root/reference/h2o-core/src/main/java/water/api
+unless noted): PingHandler, LogAndEchoHandler, LogsHandler (download),
+NetworkTestHandler (water/init/NetworkTest.java), GarbageCollectHandler,
+UnlockKeysHandler, CloudLockHandler, FindHandler, FrameChunksHandler,
+FramesHandler (columns/summary/domain sub-routes), NPSHandler
+(water/init/NodePersistentStorage.java), SteamMetricsHandler,
+water/api/RapidsHelpHandler, and the /4 endpoints in
+water/api/{EndpointsHandler4,ModelsInfoHandler4,JobsHandler4}.
+
+Clients: h2o.cluster().network_test() (h2o-py/h2o/backend/cluster.py),
+h2o.download_all_logs (h2o.py), h2o.log_and_echo, Flow's NPS notebook store.
+"""
+
+from __future__ import annotations
+
+import gc
+import io
+import json
+import os
+import time
+import zipfile
+from typing import Dict
+
+import numpy as np
+
+from h2o_tpu import __version__
+from h2o_tpu.core.cloud import cloud
+from h2o_tpu.core.frame import Frame
+from h2o_tpu.core.log import get_logger, recent_lines
+from h2o_tpu.api.server import H2OError, route
+
+log = get_logger("api.ext")
+
+_SESSION_PROPERTIES: Dict[str, str] = {}
+_CLOUD_LOCK = {"locked": True, "reason": "cloud locks at boot (fixed mesh)"}
+
+
+def _key(name, tpe="Key"):
+    return {"name": str(name), "type": tpe, "URL": None}
+
+
+def _frame_or_404(frame_id) -> Frame:
+    fr = cloud().dkv.get(frame_id)
+    if not isinstance(fr, Frame):
+        raise H2OError(404, f"frame {frame_id} not found")
+    return fr
+
+
+# ---------------------------------------------------------------------------
+# liveness / admin
+# ---------------------------------------------------------------------------
+
+@route("GET", r"/3/Ping")
+def ping(params):
+    """Cluster liveness beacon (water/api/PingHandler): refreshes the
+    client-activity clock and reports basic node health."""
+    c = cloud()
+    return {"__meta": {"schema_version": 3, "schema_name": "PingV3",
+                       "schema_type": "Ping"},
+            "cloud_healthy": True, "cloud_uptime_millis": 0,
+            "nodes": [{"ip_port": f"device:{i}", "last_ping":
+                       int(time.time() * 1000)} for i in range(c.n_nodes)]}
+
+
+@route("POST", r"/3/LogAndEcho")
+def log_and_echo(params):
+    """Write a client-supplied marker line into the server log and echo it
+    back (water/api/LogAndEchoHandler; client h2o.log_and_echo)."""
+    msg = params.get("message") or ""
+    log.info("LogAndEcho: %s", msg)
+    return {"message": msg}
+
+
+@route("GET", r"/3/Logs/download(?:/(?P<container>[^/]+))?")
+def logs_download(params, container=None):
+    """Zip archive of per-node logs (water/api/LogsHandler.fetch;
+    client h2o.download_all_logs)."""
+    buf = io.BytesIO()
+    text = "\n".join(recent_lines())
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for i in range(cloud().n_nodes):
+            z.writestr(f"node{i}_tpu/h2o_tpu.log", text)
+    return ("application/octet-stream", buf.getvalue(),
+            {"Content-Disposition":
+             'attachment; filename="h2ologs_tpu.zip"'})
+
+
+@route("POST", r"/3/GarbageCollect")
+def garbage_collect(params):
+    """Host GC + report device-buffer pressure (water/api/
+    GarbageCollectHandler triggers System.gc() on every node)."""
+    collected = gc.collect()
+    from h2o_tpu.core.memory import manager
+    stats = manager().stats()
+    log.info("GarbageCollect: host gc freed %d objects; HBM resident %d B",
+             collected, stats["resident_bytes"])
+    return {"collected_objects": collected,
+            "hbm_resident_bytes": stats["resident_bytes"]}
+
+
+@route("GET", r"/3/KillMinus3")
+def kill_minus_3(params):
+    """Thread-dump-to-log (water/api/UDPRebooted 'kill -3' analog): dump
+    every Python thread's stack into the server log."""
+    import faulthandler
+    import tempfile
+    with tempfile.TemporaryFile(mode="w+") as f:
+        faulthandler.dump_traceback(file=f, all_threads=True)
+        f.seek(0)
+        dump = f.read()
+    for line in dump.splitlines():
+        log.info("kill -3: %s", line)
+    return {}
+
+
+@route("POST", r"/3/CloudLock")
+def cloud_lock(params):
+    """Explicitly lock the cloud (water/api/CloudLockHandler).  The TPU
+    mesh is fixed from boot, so this only records the caller's reason."""
+    _CLOUD_LOCK["locked"] = True
+    _CLOUD_LOCK["reason"] = params.get("reason") or "locked via REST"
+    return {"locked": True, "reason": _CLOUD_LOCK["reason"]}
+
+
+@route("DELETE", r"/3/DKV")
+def remove_all(params):
+    """h2o.remove_all (water/api/RemoveAllHandler): purge every key,
+    cancelling running jobs first; honors `retained_keys`."""
+    retained = {k.strip() for k in
+                str(params.get("retained_keys") or "").strip("[]")
+                .split(",") if k.strip()}
+    c = cloud()
+    for job in c.jobs.list():
+        if job.is_running:
+            job.cancel()
+    # retain models' training frames alive transitively? the reference
+    # retains exactly the listed keys (ModelBase/Frame)
+    for k in list(c.dkv.keys()):
+        if str(k) not in retained:
+            c.dkv.remove(k)
+    return {}
+
+
+@route("POST", r"/3/UnlockKeys")
+def unlock_keys(params):
+    """Force-unlock every write-locked key (water/api/UnlockKeysHandler,
+    backed by UnlockTask) — the escape hatch after a crashed builder."""
+    n = 0
+    dkv = cloud().dkv
+    with dkv._lock:
+        for e in dkv._store.values():
+            if e.write_locked or e.read_locks:
+                e.write_locked = False
+                e.read_locks = 0
+                n += 1
+    return {"unlocked": n}
+
+
+@route("GET", r"/3/SessionProperties")
+def get_session_properties(params):
+    key = params.get("session_properties_key") or ""
+    return {"session_properties_key": key,
+            "properties": dict(_SESSION_PROPERTIES)}
+
+
+@route("POST", r"/3/SessionProperties")
+def set_session_properties(params):
+    for k, v in params.items():
+        if k not in ("session_properties_key", "_exclude_fields"):
+            _SESSION_PROPERTIES[str(k)] = str(v)
+    return get_session_properties(params)
+
+
+@route("GET", r"/3/SteamMetrics")
+def steam_metrics(params):
+    """Idle/busy telemetry polled by Enterprise Steam
+    (water/api/SteamMetricsHandler)."""
+    c = cloud()
+    running = any(j.is_running for j in c.jobs.list())
+    return {"idle": not running,
+            "idle_millis": 0 if running else
+            int((time.time() - _START) * 1000)}
+
+
+_START = time.time()
+
+
+# ---------------------------------------------------------------------------
+# network test — TPU-native: time actual mesh collectives
+# ---------------------------------------------------------------------------
+
+@route("GET", r"/3/NetworkTest")
+def network_test(params):
+    """Collective microbenchmark (water/init/NetworkTest.java measured
+    UDP/TCP round-trips between nodes; the TPU-native rebuild measures the
+    fabric that replaced them: psum over the mesh's ``nodes`` axis at
+    several payload sizes)."""
+    import jax
+    import jax.numpy as jnp
+
+    c = cloud()
+    sizes = [1 << 10, 1 << 16, 1 << 20]   # bytes of f32 payload
+    names, micros, bandwidths, rows = [], [], [], []
+    for size in sizes:
+        n = max(size // 4, 1)
+        x = c.device_put_rows(np.ones(
+            ((n + c.n_nodes - 1) // c.n_nodes) * c.n_nodes, np.float32))
+
+        @jax.jit
+        def allreduce(x):
+            return x.sum()
+
+        allreduce(x).block_until_ready()          # compile untimed
+        reps = 5
+        t0 = time.time()
+        for _ in range(reps):
+            out = allreduce(x)
+        out.block_until_ready()
+        us = (time.time() - t0) / reps * 1e6
+        mbs = size / (us / 1e6) / 1e6
+        names.append(f"allreduce {size} B")
+        micros.append(round(us, 1))
+        bandwidths.append(round(mbs, 1))
+        rows.append([f"{size} B", f"{us:.1f} us", f"{mbs:.1f} MB/s"])
+    from h2o_tpu.models.metrics import twodim_json
+    return {"__meta": {"schema_version": 3, "schema_name": "NetworkTestV3",
+                       "schema_type": "NetworkTest"},
+            "request_names": names, "micros": micros,
+            "bandwidths_mbs": bandwidths,
+            "table": twodim_json(
+                "Network Test (mesh collectives)",
+                ["payload", "latency", "bandwidth"],
+                ["string", "string", "string"], rows,
+                f"psum allreduce over {c.n_nodes}-way nodes axis")}
+
+
+# ---------------------------------------------------------------------------
+# frame sub-routes
+# ---------------------------------------------------------------------------
+
+@route("GET", r"/3/Find")
+def find(params):
+    """Scan a column for the next (or previous) row matching a value
+    (water/api/FindHandler; Flow's data search)."""
+    key = params.get("key")
+    fr = _frame_or_404(key)
+    col = params.get("column")
+    row = int(params.get("row", 0) or 0)
+    match = params.get("match")
+    cols = [col] if col else fr.names
+    best_prev, best_next = -1, -1
+    for name in cols:
+        if name not in fr.names:
+            raise H2OError(404, f"column {name} not in frame {key}")
+        v = fr.vec(name)
+        arr = v.to_numpy()
+        if v.is_categorical:
+            dom = v.domain or []
+            want = dom.index(match) if match in dom else None
+            hits = np.flatnonzero(arr == want) if want is not None else \
+                np.array([], np.int64)
+        elif match is None or match == "":
+            hits = np.flatnonzero(np.isnan(arr.astype(np.float64)))
+        else:
+            try:
+                hits = np.flatnonzero(arr.astype(np.float64) ==
+                                      float(match))
+            except ValueError:
+                hits = np.array([], np.int64)
+        nxt = hits[hits >= row]
+        prv = hits[hits < row]
+        if nxt.size and (best_next < 0 or nxt[0] < best_next):
+            best_next = int(nxt[0])
+        if prv.size and prv[-1] > best_prev:
+            best_prev = int(prv[-1])
+    return {"key": _key(key, "Key<Frame>"), "column": col, "row": row,
+            "match": match, "prev": best_prev, "next": best_next}
+
+
+@route("GET", r"/3/FrameChunks/(?P<frame_id>[^/]+)")
+def frame_chunks(params, frame_id):
+    """Chunk (= device shard) distribution of a frame
+    (water/api/FrameChunksHandler) — one 'chunk' per mesh node here, all
+    equal by construction of the row-sharded layout."""
+    fr = _frame_or_404(frame_id)
+    c = cloud()
+    per = fr.padded_rows // c.n_nodes
+    rows_left = fr.nrows
+    chunks = []
+    for i in range(c.n_nodes):
+        n = min(per, max(rows_left, 0))
+        chunks.append({"chunk_id": i, "row_count": int(n),
+                       "node_idx": i})
+        rows_left -= per
+    return {"__meta": {"schema_version": 3, "schema_name": "FrameChunksV3",
+                       "schema_type": "FrameChunks"},
+            "frame_id": _key(frame_id, "Key<Frame>"), "chunks": chunks}
+
+
+def _column_schema(fr: Frame, name: str, with_data: bool = True) -> dict:
+    from h2o_tpu.api.handlers import _frame_schema
+    sch = _frame_schema(fr.subframe([name]), rows=10 if with_data else 0)
+    col = sch["columns"][0]
+    col["label"] = name
+    return col
+
+
+@route("GET", r"/3/Frames/(?P<frame_id>[^/]+)/columns")
+def frame_columns(params, frame_id):
+    fr = _frame_or_404(frame_id)
+    return {"frames": [{
+        "frame_id": _key(frame_id, "Key<Frame>"),
+        "row_count": fr.nrows, "column_count": fr.ncols,
+        "columns": [_column_schema(fr, n, with_data=False)
+                    for n in fr.names]}]}
+
+
+@route("GET",
+       r"/3/Frames/(?P<frame_id>[^/]+)/columns/(?P<column>[^/]+)/summary")
+@route("GET", r"/3/Frames/(?P<frame_id>[^/]+)/columns/(?P<column>[^/]+)")
+def frame_column(params, frame_id, column):
+    fr = _frame_or_404(frame_id)
+    if column not in fr.names:
+        raise H2OError(404, f"column {column} not in frame {frame_id}")
+    return {"frames": [{
+        "frame_id": _key(frame_id, "Key<Frame>"),
+        "row_count": fr.nrows, "column_count": 1,
+        "columns": [_column_schema(fr, column)]}]}
+
+
+@route("GET",
+       r"/3/Frames/(?P<frame_id>[^/]+)/columns/(?P<column>[^/]+)/domain")
+def frame_column_domain(params, frame_id, column):
+    fr = _frame_or_404(frame_id)
+    if column not in fr.names:
+        raise H2OError(404, f"column {column} not in frame {frame_id}")
+    v = fr.vec(column)
+    if not v.is_categorical:
+        raise H2OError(400, f"column {column} is not categorical")
+    codes = v.to_numpy()
+    counts = np.bincount(codes[codes >= 0],
+                         minlength=len(v.domain or [])).tolist()
+    return {"domain": [list(v.domain or [])], "map": [counts]}
+
+
+# ---------------------------------------------------------------------------
+# node-persistent storage (Flow notebook store)
+# ---------------------------------------------------------------------------
+
+def _nps_dir(category: str = "") -> str:
+    d = os.path.join(cloud().args.ice_root, "nps", category)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+@route("GET", r"/3/NodePersistentStorage/configured")
+def nps_configured(params):
+    return {"configured": True}
+
+
+@route("GET",
+       r"/3/NodePersistentStorage/categories/(?P<category>[^/]+)/exists")
+def nps_category_exists(params, category):
+    return {"exists": os.path.isdir(
+        os.path.join(cloud().args.ice_root, "nps", category))}
+
+
+@route("GET", r"/3/NodePersistentStorage/categories/(?P<category>[^/]+)"
+       r"/names/(?P<name>[^/]+)/exists")
+def nps_name_exists(params, category, name):
+    return {"exists": os.path.exists(os.path.join(_nps_dir(category),
+                                                  name))}
+
+
+@route("GET", r"/3/NodePersistentStorage/(?P<category>[^/]+)"
+       r"/(?P<name>[^/]+)")
+def nps_get(params, category, name):
+    path = os.path.join(_nps_dir(category), name)
+    if not os.path.exists(path):
+        raise H2OError(404, f"NPS entry {category}/{name} not found")
+    with open(path, "rb") as f:
+        return ("application/octet-stream", f.read())
+
+
+@route("GET", r"/3/NodePersistentStorage/(?P<category>[^/]+)")
+def nps_list(params, category):
+    d = _nps_dir(category)
+    entries = []
+    for e in sorted(os.listdir(d)):
+        st = os.stat(os.path.join(d, e))
+        entries.append({"name": e, "size": st.st_size,
+                        "timestamp_millis": int(st.st_mtime * 1000)})
+    return {"category": category, "entries": entries}
+
+
+@route("POST", r"/3/NodePersistentStorage/(?P<category>[^/]+)"
+       r"/(?P<name>[^/]+)", raw=True)
+def nps_put(params, category, name, body=None):
+    import shutil
+    path = os.path.join(_nps_dir(category), name)
+    with open(path, "wb") as f:
+        shutil.copyfileobj(body, f)
+    return {"category": category, "name": name,
+            "total_bytes": os.path.getsize(path)}
+
+
+@route("POST", r"/3/NodePersistentStorage/(?P<category>[^/]+)")
+def nps_put_value(params, category):
+    name = params.get("name") or f"entry_{int(time.time() * 1000)}"
+    path = os.path.join(_nps_dir(category), name)
+    with open(path, "w") as f:
+        f.write(params.get("value") or "")
+    return {"category": category, "name": name,
+            "total_bytes": os.path.getsize(path)}
+
+
+@route("DELETE", r"/3/NodePersistentStorage/(?P<category>[^/]+)"
+       r"/(?P<name>[^/]+)")
+def nps_delete(params, category, name):
+    path = os.path.join(_nps_dir(category), name)
+    if os.path.exists(path):
+        os.remove(path)
+    return {}
+
+
+# ---------------------------------------------------------------------------
+# v4 metadata + misc
+# ---------------------------------------------------------------------------
+
+@route("GET", r"/4/endpoints")
+def v4_endpoints(params):
+    from h2o_tpu.api.handlers import _routes_json
+    return {"__meta": {"schema_version": 4,
+                       "schema_name": "EndpointsListV4"},
+            "endpoints": _routes_json()}
+
+
+@route("GET", r"/4/modelsinfo")
+def v4_modelsinfo(params):
+    from h2o_tpu.models.registry import builders
+    return {"models": [{"algo": name, "algo_full_name": cls.algo,
+                        "have_mojo": True, "have_pojo": name in
+                        ("gbm", "drf", "glm")}
+                       for name, cls in builders().items()]}
+
+
+@route("GET", r"/4/jobs/(?P<job_id>[^/]+)")
+def v4_job(params, job_id):
+    from h2o_tpu.api.handlers import get_job
+    return get_job(params, job_id)
+
+
+@route("GET", r"/99/Rapids/help")
+def rapids_help(params):
+    from h2o_tpu.rapids.interp import op_names
+    return {"syntax": "(op arg...)", "ops": op_names()}
+
+
+@route("GET", r"/99/Sample")
+def sample_99(params):
+    return {"value": "this is a sample endpoint"}
+
+
+@route("GET", r"/3/h2o-genmodel.jar")
+def genmodel_jar(params):
+    """The reference ships a Java scoring jar; the TPU rebuild's standalone
+    scorer is Python/JAX (h2o_tpu.mojo.scorers) and no JVM artifact exists
+    to serve — fail loudly rather than hand back a fake jar."""
+    raise H2OError(
+        501, "h2o-genmodel.jar is a JVM artifact the TPU-native rebuild "
+        "does not ship; use h2o_tpu.mojo.scorers (import_mojo / "
+        "upload_mojo round-trips are supported) for standalone scoring")

@@ -1,0 +1,278 @@
+"""Model-utility REST routes: make_metrics, ModelMetrics listing, POJO
+codegen, model JSON dump, grid export/import.
+
+Reference: water/api/ModelMetricsHandler.java (make + list + delete),
+water/api/ModelsHandler.java (fetchJavaCode), water/api/
+GridImportExportHandler.java; clients h2o.make_metrics (h2o-py/h2o/
+h2o.py:1971), h2o.download_pojo (:1868), h2o.save_grid/load_grid
+(:569,524).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from h2o_tpu.core.cloud import cloud
+from h2o_tpu.core.frame import Frame
+from h2o_tpu.models.model import Model
+from h2o_tpu.api.server import H2OError, route
+
+# (model_id, frame_id) -> ModelMetrics computed via the scoring routes;
+# the reference caches these in DKV keyed by model/frame checksums
+# (ModelMetrics.buildKey) and lists them via GET /3/ModelMetrics.
+_METRICS_CACHE: dict = {}
+
+
+def _key(name, tpe="Key"):
+    return {"name": str(name), "type": tpe, "URL": None}
+
+
+def _model_or_404(model_id) -> Model:
+    m = cloud().dkv.get(model_id)
+    if not isinstance(m, Model):
+        raise H2OError(404, f"model {model_id} not found")
+    return m
+
+
+def _frame_or_404(frame_id) -> Frame:
+    fr = cloud().dkv.get(frame_id)
+    if not isinstance(fr, Frame):
+        raise H2OError(404, f"frame {frame_id} not found")
+    return fr
+
+
+def record_metrics(model_id: str, frame_id: str, metrics) -> None:
+    _METRICS_CACHE[(str(model_id), str(frame_id))] = metrics
+
+
+# ---------------------------------------------------------------------------
+# make_metrics: predictions frame + actuals frame -> ModelMetrics
+# ---------------------------------------------------------------------------
+
+def _parse_domain(raw) -> Optional[List[str]]:
+    if raw is None or raw == "":
+        return None
+    if isinstance(raw, list):
+        return [str(d) for d in raw]
+    s = str(raw).strip()
+    if s.lower() in ("none", "null"):
+        return None
+    return [d.strip().strip("'\"") for d in s.strip("[]").split(",")
+            if d.strip()]
+
+
+@route("POST", r"/3/ModelMetrics/predictions_frame/(?P<pred_id>[^/]+)"
+       r"/actuals_frame/(?P<act_id>[^/]+)")
+def make_metrics(params, pred_id, act_id):
+    """h2o.make_metrics (ModelMetricsHandler.make): compute metrics from a
+    detached predictions frame against actuals — no model required."""
+    pf = _frame_or_404(pred_id)
+    af = _frame_or_404(act_id)
+    if pf.nrows != af.nrows:
+        raise H2OError(400, f"predictions ({pf.nrows} rows) and actuals "
+                            f"({af.nrows} rows) differ in length")
+    from h2o_tpu.models import metrics as mm
+    domain = _parse_domain(params.get("domain"))
+    av = af.vecs[0]
+    if domain is None and av.is_categorical:
+        domain = list(av.domain or [])
+    w = None
+    if params.get("weights_frame"):
+        wf = _frame_or_404(params["weights_frame"])
+        w = wf.vecs[0].as_float()[: pf.nrows]
+
+    y = av.as_float()[: af.nrows] if av.is_categorical else \
+        np.asarray(av.to_numpy(), np.float32)
+    y = np.asarray(y)
+
+    if domain is not None and len(domain) == 2:
+        # predictions: [predict, p0, p1] or a single p1 column
+        p1 = np.asarray(pf.vecs[-1].to_numpy(), np.float32)
+        m = mm.binomial_metrics(p1, y, w=w, domain=domain)
+    elif domain is not None and len(domain) > 2:
+        K = len(domain)
+        if pf.ncols == K + 1:
+            probs = np.stack([np.asarray(v.to_numpy(), np.float32)
+                              for v in pf.vecs[1:]], axis=1)
+        elif pf.ncols == K:
+            probs = np.stack([np.asarray(v.to_numpy(), np.float32)
+                              for v in pf.vecs], axis=1)
+        else:
+            raise H2OError(400, f"predictions frame has {pf.ncols} "
+                                f"columns; expected {K} or {K + 1}")
+        m = mm.multinomial_metrics(probs, y, w=w, domain=domain)
+    else:
+        from h2o_tpu.models.distributions import get_distribution
+        dist = None
+        if params.get("distribution"):
+            dist = get_distribution(str(params["distribution"]).lower())
+        pred = np.asarray(pf.vecs[0].to_numpy(), np.float32)
+        m = mm.regression_metrics(pred, y, w=w, distribution=dist)
+    record_metrics("", act_id, m)
+    from h2o_tpu.api.handlers import _metrics_dict
+    return {"model_metrics": [_metrics_dict(m, frame_id=act_id)]}
+
+
+# ---------------------------------------------------------------------------
+# ModelMetrics listing / deletion (ModelMetricsHandler.fetch/delete)
+# ---------------------------------------------------------------------------
+
+def _mm_entries(model=None, frame=None):
+    from h2o_tpu.api.handlers import _metrics_dict
+    out = []
+    for (mid, fid), m in _METRICS_CACHE.items():
+        if model and mid != model:
+            continue
+        if frame and fid != frame:
+            continue
+        out.append(_metrics_dict(m, frame_id=fid or None,
+                                 model_id=mid or None))
+    return out
+
+
+@route("GET", r"/3/ModelMetrics")
+def list_model_metrics(params):
+    return {"model_metrics": _mm_entries()}
+
+
+@route("GET", r"/3/ModelMetrics/models/(?P<model_id>[^/]+)")
+def list_model_metrics_model(params, model_id):
+    _model_or_404(model_id)
+    return {"model_metrics": _mm_entries(model=model_id)}
+
+
+@route("GET", r"/3/ModelMetrics/frames/(?P<frame_id>[^/]+)")
+def list_model_metrics_frame(params, frame_id):
+    _frame_or_404(frame_id)
+    return {"model_metrics": _mm_entries(frame=frame_id)}
+
+
+@route("GET", r"/3/ModelMetrics/models/(?P<model_id>[^/]+)"
+       r"/frames/(?P<frame_id>[^/]+)")
+def get_model_metrics_pair(params, model_id, frame_id):
+    return {"model_metrics": _mm_entries(model=model_id, frame=frame_id)}
+
+
+@route("DELETE", r"/3/ModelMetrics/models/(?P<model_id>[^/]+)"
+       r"/frames/(?P<frame_id>[^/]+)")
+@route("DELETE", r"/3/ModelMetrics/frames/(?P<frame_id>[^/]+)"
+       r"/models/(?P<model_id>[^/]+)")
+def delete_model_metrics_pair(params, model_id, frame_id):
+    _METRICS_CACHE.pop((str(model_id), str(frame_id)), None)
+    return {}
+
+
+@route("DELETE", r"/3/ModelMetrics/models/(?P<model_id>[^/]+)")
+def delete_model_metrics_model(params, model_id):
+    for k in [k for k in _METRICS_CACHE if k[0] == str(model_id)]:
+        _METRICS_CACHE.pop(k, None)
+    return {}
+
+
+@route("DELETE", r"/3/ModelMetrics")
+def delete_model_metrics_all(params):
+    _METRICS_CACHE.clear()
+    return {}
+
+
+# ---------------------------------------------------------------------------
+# POJO codegen + model JSON
+# ---------------------------------------------------------------------------
+
+@route("GET", r"/3/Models\.java/(?P<model_id>[^/]+)/preview")
+@route("GET", r"/3/Models\.java/(?P<model_id>[^/]+)")
+def fetch_java(params, model_id):
+    """h2o.download_pojo (ModelsHandler.fetchJavaCode): standalone Java
+    scoring source generated from the model."""
+    from h2o_tpu.mojo.pojo import pojo_source
+    m = _model_or_404(model_id)
+    try:
+        src = pojo_source(m)
+    except NotImplementedError as e:
+        raise H2OError(400, str(e))
+    return ("text/x-java-source", src.encode(),
+            {"Content-Disposition":
+             f'attachment; filename="{model_id}.java"'})
+
+
+@route("GET", r"/99/Models/(?P<model_id>[^/]+)/json")
+def model_json(params, model_id):
+    from h2o_tpu.api.handlers import _model_schema
+    m = _model_or_404(model_id)
+    return {"models": [_model_schema(m)]}
+
+
+@route("GET", r"/3/ModelBuilders/(?P<algo>[^/]+)")
+def builder_detail(params, algo):
+    from h2o_tpu.models.registry import builder_class
+    try:
+        cls = builder_class(algo)
+    except KeyError:
+        raise H2OError(404, f"unknown algorithm {algo}")
+    b = cls()
+    parameters = [{"name": ("lambda" if k == "lambda_" else k),
+                   "label": k, "type": type(v).__name__,
+                   "default_value": v if not isinstance(v, np.ndarray)
+                   else v.tolist(),
+                   "actual_value": v if not isinstance(v, np.ndarray)
+                   else v.tolist(),
+                   "required": False, "level": "critical"}
+                  for k, v in b.params.items()
+                  if not str(k).startswith("_")]
+    return {"model_builders": {algo: {
+        "algo": algo, "algo_full_name": cls.algo,
+        "can_build": ["ALL"], "visibility": "Stable",
+        "parameters": parameters}}}
+
+
+# ---------------------------------------------------------------------------
+# grid export / import (GridImportExportHandler; h2o.save_grid/load_grid)
+# ---------------------------------------------------------------------------
+
+@route("POST", r"/3/Grid\.bin/(?P<grid_id>[^/]+)/export")
+def grid_export(params, grid_id):
+    import json as jsonmod
+    from h2o_tpu.models.grid import Grid
+    g = cloud().dkv.get(grid_id)
+    if not isinstance(g, Grid):
+        raise H2OError(404, f"grid {grid_id} not found")
+    d = params.get("grid_directory")
+    if not d:
+        raise H2OError(400, "grid_directory is required")
+    gdir = os.path.join(d, str(grid_id))
+    os.makedirs(gdir, exist_ok=True)
+    manifest = {"grid_id": str(grid_id), "algo": g.algo,
+                "hyper_values": g.hyper_values,
+                "model_ids": [str(m.key) for m in g.models]}
+    for m in g.models:
+        m.save(os.path.join(gdir, str(m.key)))
+    with open(os.path.join(gdir, "grid.json"), "w") as f:
+        jsonmod.dump(manifest, f)
+    return {"name": str(grid_id), "dir": gdir}
+
+
+@route("POST", r"/3/Grid\.bin/import")
+def grid_import(params):
+    import json as jsonmod
+    from h2o_tpu.models.grid import Grid
+    path = params.get("grid_path")
+    if not path:
+        raise H2OError(400, "grid_path is required")
+    mpath = os.path.join(path, "grid.json")
+    if not os.path.exists(mpath):
+        raise H2OError(404, f"no exported grid at {path}")
+    with open(mpath) as f:
+        manifest = jsonmod.load(f)
+    hyper_names = list(manifest["hyper_values"][0].keys()) \
+        if manifest["hyper_values"] else []
+    g = Grid(manifest["grid_id"], manifest["algo"], hyper_names)
+    g.hyper_values = list(manifest["hyper_values"])
+    for mid in manifest["model_ids"]:
+        m = Model.load(os.path.join(path, mid))
+        cloud().dkv.put(m.key, m)
+        g.models.append(m)
+    cloud().dkv.put(manifest["grid_id"], g)
+    return {"name": manifest["grid_id"]}

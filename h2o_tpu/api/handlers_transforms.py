@@ -1,0 +1,365 @@
+"""Model-transform and frame-utility REST routes.
+
+Reference: water/api/{Word2VecHandler (hex/word2vec/Word2VecModel
+findSynonyms/transform), TargetEncoderHandler (ext target-encoder),
+SplitFrameHandler (hex/splitframe/SplitFrame.java), MissingInserterHandler
+(hex/CreateInteractions? no — hex/MissingInserter MRTask),
+TabulateHandler (water/util/Tabulate.java), DCTTransformer
+(hex/DCTTransformer.java), PersistS3Handler (h2o-persist-s3)}.
+
+Clients: w2v_model.find_synonyms / .transform (h2o-py word_embedding.py:
+38,70), TargetEncoder.transform (targetencoder.py:453), frame.
+insert_missing_values (frame.py:2906), h2o.persist_s3? (persist handlers),
+Flow's Tabulate.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from h2o_tpu.core.cloud import cloud
+from h2o_tpu.core.frame import Frame, Vec, T_CAT
+from h2o_tpu.core.job import Job
+from h2o_tpu.api.server import H2OError, route
+
+
+def _key(name, tpe="Key"):
+    return {"name": str(name), "type": tpe, "URL": None}
+
+
+def _frame_or_404(frame_id) -> Frame:
+    fr = cloud().dkv.get(frame_id)
+    if not isinstance(fr, Frame):
+        raise H2OError(404, f"frame {frame_id} not found")
+    return fr
+
+
+def _b(params, key, default=False):
+    v = params.get(key)
+    if v is None:
+        return default
+    return str(v).lower() in ("1", "true", "yes")
+
+
+# ---------------------------------------------------------------------------
+# Word2Vec model transforms
+# ---------------------------------------------------------------------------
+
+@route("GET", r"/3/Word2VecSynonyms")
+def w2v_synonyms(params):
+    from h2o_tpu.models.word2vec import Word2VecModel
+    m = cloud().dkv.get(params.get("model"))
+    if not isinstance(m, Word2VecModel):
+        raise H2OError(404, f"word2vec model {params.get('model')} "
+                            "not found")
+    word = params.get("word") or ""
+    count = int(params.get("count", 20) or 20)
+    syns = m.find_synonyms(word, count)
+    return {"model": _key(str(m.key), "Key<Model>"), "word": word,
+            "count": count,
+            "synonyms": list(syns.keys()),
+            "scores": [float(v) for v in syns.values()]}
+
+
+@route("GET", r"/3/Word2VecTransform")
+def w2v_transform(params):
+    from h2o_tpu.models.word2vec import Word2VecModel
+    m = cloud().dkv.get(params.get("model"))
+    if not isinstance(m, Word2VecModel):
+        raise H2OError(404, f"word2vec model {params.get('model')} "
+                            "not found")
+    fr = _frame_or_404(params.get("words_frame"))
+    agg = params.get("aggregate_method") or "NONE"
+    out = m.transform(fr, aggregate_method=agg)
+    cloud().dkv.put(out.key, out)
+    return {"vectors_frame": _key(str(out.key), "Key<Frame>")}
+
+
+@route("GET", r"/3/TargetEncoderTransform")
+def te_transform(params):
+    from h2o_tpu.models.target_encoder import TargetEncoderModel
+    m = cloud().dkv.get(params.get("model"))
+    if not isinstance(m, TargetEncoderModel):
+        raise H2OError(404, f"target-encoder model "
+                            f"{params.get('model')} not found")
+    fr = _frame_or_404(params.get("frame"))
+    # per-call overrides ride on a transient param overlay (the reference
+    # passes them straight to the transform task)
+    overlay = {}
+    for k in ("blending", "inflection_point", "smoothing"):
+        if params.get(k) not in (None, "", "None"):
+            overlay[k] = (_b(params, k) if k == "blending"
+                          else float(params[k]))
+    noise = None
+    if params.get("noise") not in (None, "", "None"):
+        noise = float(params["noise"])
+        if noise < 0:          # client sends -1 for "auto"
+            noise = None
+    saved = dict(m.params)
+    try:
+        m.params.update(overlay)
+        out = m.transform(fr, as_training=_b(params, "as_training"),
+                          noise=noise)
+    finally:
+        m.params = saved
+    cloud().dkv.put(out.key, out)
+    return {"name": str(out.key)}
+
+
+# ---------------------------------------------------------------------------
+# SplitFrame / MissingInserter
+# ---------------------------------------------------------------------------
+
+@route("POST", r"/3/SplitFrame")
+def split_frame(params):
+    """hex/splitframe/SplitFrame.java: split rows into contiguous pieces
+    by ratio (the non-shuffling splitter; h2o-py's split_frame shuffles
+    via Rapids h2o.runif instead)."""
+    fr = _frame_or_404(params.get("dataset"))
+    raw = str(params.get("ratios") or "").strip("[]")
+    ratios = [float(r) for r in raw.split(",") if r.strip()]
+    if not ratios:
+        raise H2OError(400, "ratios is required")
+    if sum(ratios) > 1.0 + 1e-9:
+        raise H2OError(400, f"ratios sum to {sum(ratios)} > 1")
+    dests = [d.strip() for d in
+             str(params.get("destination_frames") or "").strip("[]")
+             .split(",") if d.strip()]
+    n_parts = len(ratios) + (1 if sum(ratios) < 1.0 - 1e-9 else 0)
+    if not dests:
+        dests = [f"{fr.key}_part{i}" for i in range(n_parts)]
+    if len(dests) != n_parts:
+        raise H2OError(400, f"{n_parts} destination_frames required, "
+                            f"got {len(dests)}")
+    job = Job(dest=dests[0], description="SplitFrame")
+
+    def body(j):
+        n = fr.nrows
+        bounds = np.cumsum([0.0] + ratios)
+        cuts = [int(round(b * n)) for b in bounds] + [n]
+        keys = []
+        for i, dest in enumerate(dests):
+            lo, hi = cuts[i], cuts[i + 1]
+            part = fr.slice_rows(np.arange(lo, hi))
+            part.key = dest
+            cloud().dkv.put(dest, part)
+            keys.append(dest)
+        return keys
+
+    cloud().jobs.start(job, body)
+    job.join()
+    return {"job": job.to_dict(),
+            "destination_frames": [_key(d, "Key<Frame>") for d in dests]}
+
+
+@route("POST", r"/3/MissingInserter")
+def missing_inserter(params):
+    """frame.insert_missing_values (water/api/MissingInserterHandler):
+    replace a random fraction of cells with NAs, in place."""
+    fr = _frame_or_404(params.get("dataset"))
+    fraction = float(params.get("fraction", 0.1) or 0.1)
+    if not 0.0 <= fraction <= 1.0:
+        raise H2OError(400, f"fraction must be in [0,1], got {fraction}")
+    seed = params.get("seed")
+    rng = np.random.default_rng(int(seed) if seed not in
+                                (None, "", "None", "-1") else None)
+    job = Job(dest=str(fr.key), description="Insert Missing Values")
+
+    def body(j):
+        for i, v in enumerate(fr.vecs):
+            mask = rng.uniform(size=fr.nrows) < fraction
+            if v.host_data is not None:
+                v.host_data = [None if m else x
+                               for x, m in zip(v.host_data, mask)]
+                continue
+            arr = v.to_numpy().copy()
+            if v.is_categorical:
+                arr[mask] = -1
+                fr.vecs[i] = Vec(arr.astype(np.int32), T_CAT,
+                                 domain=list(v.domain or []))
+            else:
+                arr = arr.astype(np.float64)
+                arr[mask] = np.nan
+                fr.vecs[i] = Vec(arr.astype(np.float32), v.type)
+            fr.vecs[i].invalidate()
+        fr._matrix_cache.clear()
+        return fr
+
+    cloud().jobs.start(job, body)
+    job.join()
+    # the client wraps this response as the job dict itself
+    # (h2o-py/h2o/frame.py:2906 H2OJob({"job": <response>}))
+    return job.to_dict()
+
+
+# ---------------------------------------------------------------------------
+# Tabulate / DCT
+# ---------------------------------------------------------------------------
+
+@route("POST", r"/99/Tabulate")
+def tabulate(params):
+    """water/util/Tabulate.java: co-occurrence count table + mean-response
+    table of predictor x response (Flow's visual crosstab)."""
+    from h2o_tpu.models.metrics import twodim_json
+    fr = _frame_or_404(params.get("dataset"))
+
+    def colname(key):
+        raw = params.get(key)
+        if isinstance(raw, dict):
+            raw = raw.get("column_name")
+        return raw
+
+    pred, resp = colname("predictor"), colname("response")
+    for c in (pred, resp):
+        if c not in fr.names:
+            raise H2OError(404, f"column {c} not in frame")
+    wname = colname("weight")
+    w = np.asarray(fr.vec(wname).to_numpy(), np.float64) \
+        if wname and wname in fr.names else np.ones(fr.nrows)
+    nb_p = int(params.get("nbins_predictor", 20) or 20)
+    nb_r = int(params.get("nbins_response", 10) or 10)
+
+    def binify(v, nbins):
+        if v.is_categorical:
+            codes = np.asarray(v.to_numpy(), np.int64)
+            labels = [str(d) for d in (v.domain or [])]
+            return codes, labels
+        x = np.asarray(v.to_numpy(), np.float64)
+        r = v.rollups
+        span = max(r.max - r.min, 1e-30)
+        b = np.clip(((x - r.min) / span * nbins).astype(np.int64), 0,
+                    nbins - 1)
+        b = np.where(np.isnan(x), -1, b)
+        edges = np.linspace(r.min, r.max, nbins + 1)
+        labels = [f"{edges[i]:.4g}" for i in range(nbins)]
+        return b, labels
+
+    pb, plabels = binify(fr.vec(pred), nb_p)
+    rb, rlabels = binify(fr.vec(resp), nb_r)
+    P, R = len(plabels), len(rlabels)
+    ok = (pb >= 0) & (rb >= 0)
+    counts = np.zeros((P, R))
+    np.add.at(counts, (pb[ok], rb[ok]), w[ok])
+    rv = np.asarray(fr.vec(resp).as_float() if fr.vec(resp).is_categorical
+                    else fr.vec(resp).to_numpy(), np.float64)[: fr.nrows]
+    wsum = np.zeros(P)
+    wr = np.zeros(P)
+    okr = (pb >= 0) & ~np.isnan(rv)
+    np.add.at(wsum, pb[okr], w[okr])
+    np.add.at(wr, pb[okr], w[okr] * rv[okr])
+    count_rows = [[plabels[i]] + [float(c) for c in counts[i]]
+                  for i in range(P)]
+    resp_rows = [[plabels[i],
+                  float(wr[i] / wsum[i]) if wsum[i] > 0 else float("nan")]
+                 for i in range(P)]
+    return {"__meta": {"schema_version": 3, "schema_name": "TabulateV3",
+                       "schema_type": "Tabulate"},
+            "count_table": twodim_json(
+                f"(Weighted) co-occurrence counts of {pred} and {resp}",
+                [pred] + rlabels,
+                ["string"] + ["double"] * R, count_rows),
+            "response_table": twodim_json(
+                f"(Weighted) mean {resp} by {pred}",
+                [pred, "mean " + resp], ["string", "double"], resp_rows)}
+
+
+@route("POST", r"/99/DCTTransformer")
+def dct_transformer(params):
+    """hex/DCTTransformer.java: orthonormal DCT-II of each row, treated as
+    a [height x width x depth] tensor — lowered to MXU matmuls (one DCT
+    basis matrix per axis), the canonically TPU-friendly formulation."""
+    fr = _frame_or_404(params.get("dataset"))
+    raw = str(params.get("dimensions") or "").strip("[]")
+    dims = [int(float(d)) for d in raw.split(",") if d.strip()]
+    if len(dims) != 3:
+        raise H2OError(400, "dimensions must be [height, width, depth]")
+    h, wd, dp = dims
+    if h * wd * dp != fr.ncols:
+        raise H2OError(400, f"dimensions {dims} do not multiply to "
+                            f"ncols={fr.ncols}")
+    inverse = _b(params, "inverse")
+    import jax.numpy as jnp
+
+    def dct_mat(n):
+        k = np.arange(n)[:, None]
+        i = np.arange(n)[None, :]
+        M = np.sqrt(2.0 / n) * np.cos(np.pi * (2 * i + 1) * k / (2 * n))
+        M[0] *= 1.0 / np.sqrt(2.0)
+        return jnp.asarray(M, jnp.float32)
+
+    X = fr.as_matrix()[: fr.nrows].reshape(fr.nrows, h, wd, dp)
+    for axis, n in ((1, h), (2, wd), (3, dp)):
+        if n == 1:
+            continue
+        M = dct_mat(n)
+        if inverse:
+            M = M.T
+        X = jnp.moveaxis(
+            jnp.tensordot(X, M, axes=[[axis], [1]]), -1, axis)
+    flat = np.asarray(X.reshape(fr.nrows, -1))
+    dest = params.get("destination_frame") or f"{fr.key}_dct"
+    out = Frame.from_numpy(flat, names=[f"C{i+1}" for i in
+                                        range(flat.shape[1])], key=dest)
+    cloud().dkv.put(dest, out)
+    return {"destination_frame": _key(dest, "Key<Frame>")}
+
+
+# ---------------------------------------------------------------------------
+# persist backends + honest 501s for absent integrations
+# ---------------------------------------------------------------------------
+
+@route("POST", r"/3/PersistS3")
+def persist_s3(params):
+    """h2o.set_s3_credentials (water/api/PersistS3Handler): wire client
+    credentials into the s3:// byte-store scheme (core/persist.py
+    register_s3)."""
+    key_id = params.get("secret_key_id")
+    secret = params.get("secret_access_key")
+    if not key_id or not secret:
+        raise H2OError(400, "secret_key_id and secret_access_key are "
+                            "required")
+    from h2o_tpu.core.persist import register_s3
+    try:
+        register_s3(endpoint_url=params.get("endpoint_url"),
+                    access_key=key_id,
+                    secret_key=secret)
+    except (TypeError, ValueError) as e:
+        raise H2OError(400, str(e))
+    return {"secret_key_id": key_id}
+
+
+@route("DELETE", r"/3/PersistS3")
+def persist_s3_remove(params):
+    from h2o_tpu.core.persist import unregister_scheme
+    unregister_scheme("s3")
+    return {}
+
+
+def _not_shipped(feature: str, why: str):
+    raise H2OError(501, f"{feature} is not available in the TPU-native "
+                        f"rebuild: {why}")
+
+
+@route("POST", r"/3/ImportHiveTable")
+def import_hive(params):
+    _not_shipped("ImportHiveTable", "no Hive/JDBC driver in the runtime "
+                 "image; export the table to CSV/Parquet and use "
+                 "ImportFiles + Parse")
+
+
+@route("POST", r"/3/SaveToHiveTable")
+def save_hive(params):
+    _not_shipped("SaveToHiveTable", "no Hive/JDBC driver in the runtime "
+                 "image; use /3/Frames/{id}/export to Parquet/CSV")
+
+
+@route("POST", r"/99/ImportSQLTable")
+def import_sql(params):
+    _not_shipped("ImportSQLTable", "no JDBC driver in the runtime image; "
+                 "export the table to CSV/Parquet and use ImportFiles")
+
+
+@route("POST", r"/3/DecryptionSetup")
+def decryption_setup(params):
+    _not_shipped("DecryptionSetup", "encrypted-file ingest (AES ZIP) is "
+                 "not implemented; decrypt before import")

@@ -136,9 +136,40 @@ class _Handler(BaseHTTPRequestHandler):
             "stacktrace": dev_msg.splitlines(),
         }
 
+    def _check_auth(self) -> bool:
+        """HTTP Basic auth when the server was configured with credentials
+        (reference: water/webserver JAAS Basic login; client
+        h2o.connect(auth=(user, password)))."""
+        srv = getattr(self.server, "_rest_server", None)
+        expected = getattr(srv, "basic_auth", None)
+        if not expected:
+            return True
+        import base64
+        import hmac
+        hdr = self.headers.get("Authorization") or ""
+        if hdr.startswith("Basic "):
+            try:
+                got = base64.b64decode(hdr[6:]).decode()
+            except Exception:  # noqa: BLE001 — malformed header
+                got = ""
+            if hmac.compare_digest(got, expected):
+                return True
+        # the request body was never read — close the connection rather
+        # than let keep-alive parse leftover body bytes as a request line
+        self.close_connection = True
+        self.send_response(401)
+        self.send_header("WWW-Authenticate",
+                         'Basic realm="h2o-tpu"')
+        self.send_header("Content-Length", "0")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        return False
+
     def _dispatch(self, method: str):
         request_context.server = getattr(self.server, "_rest_server",
                                          None)
+        if not self._check_auth():
+            return
         path = unquote(urlparse(self.path).path)
         for m, rx, fn, raw in _ROUTES:
             if m != method:
@@ -254,12 +285,30 @@ class RestServer:
 
     current: Optional["RestServer"] = None   # POST /3/Shutdown target
 
-    def __init__(self, port: Optional[int] = None, ip: str = "127.0.0.1"):
+    def __init__(self, port: Optional[int] = None, ip: str = "127.0.0.1",
+                 ssl_cert: Optional[str] = None,
+                 ssl_key: Optional[str] = None,
+                 basic_auth: Optional[str] = None):
         import h2o_tpu.api.handlers  # noqa: F401 — registers routes
-        self.port = port if port is not None else cloud().args.port
+        args = cloud().args
+        self.port = port if port is not None else args.port
         self.ip = ip
         self.httpd = ThreadingHTTPServer((ip, self.port), _Handler)
         self.httpd._rest_server = self
+        # TLS (reference: water/webserver SSL / -jks): PEM cert+key wrap
+        # the listening socket; h2o-py connects with https:// +
+        # verify_ssl_certificates=False for self-signed deployments
+        cert = ssl_cert or args.ssl_cert
+        key = ssl_key or args.ssl_key
+        self.tls = bool(cert and key)
+        if self.tls:
+            import ssl as sslmod
+            ctx = sslmod.SSLContext(sslmod.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile=cert, keyfile=key)
+            self.httpd.socket = ctx.wrap_socket(self.httpd.socket,
+                                                server_side=True)
+        # "user:password" (reference -hash_login Basic auth)
+        self.basic_auth = basic_auth or args.basic_auth
         self.port = self.httpd.server_port
         self.thread: Optional[threading.Thread] = None
 
@@ -268,7 +317,9 @@ class RestServer:
                                        name="h2o-rest", daemon=True)
         self.thread.start()
         RestServer.current = self
-        log.info("REST server on http://%s:%d", self.ip, self.port)
+        log.info("REST server on %s://%s:%d%s",
+                 "https" if self.tls else "http", self.ip, self.port,
+                 " (basic auth)" if self.basic_auth else "")
         return self
 
     def stop(self) -> None:

@@ -117,6 +117,13 @@ class Cloud:
 
     def device_put_rows(self, host_array) -> jax.Array:
         """Pad host rows to the shard quantum and scatter over the mesh."""
+        if self.args.client:
+            # -client mode (water/H2O.java:391-394): the node participates
+            # in the control plane (DKV metadata, jobs, REST) but never
+            # homes data — exactly the reference's "join without keys"
+            raise RuntimeError(
+                "client-mode cloud cannot home frame data "
+                "(boot with client=False to shard rows here)")
         arr = np.asarray(host_array)
         q = self.row_multiple()
         pad = (-arr.shape[0]) % q

@@ -52,6 +52,16 @@ class OptArgs:
     # the Cleaner-analog spills LRU columns to host above it
     # (core/memory.py; reference water/Cleaner.java:10-12)
     hbm_budget: int = 0
+    # TLS for the REST server (reference -jks/-ssl flags, water/webserver):
+    # PEM cert + key paths; both set => REST serves https
+    ssl_cert: Optional[str] = None
+    ssl_key: Optional[str] = None
+    # Basic auth (reference -hash_login/JAAS modules): "user:password".
+    # One pair — the reference's hash-file multi-user store can layer on.
+    basic_auth: Optional[str] = None
+    # -client mode: join the control plane without homing data
+    # (water/H2O.java:391-394); client nodes never shard frame rows
+    client: bool = False
 
     @classmethod
     def from_env(cls, **overrides) -> "OptArgs":

@@ -39,6 +39,11 @@ def register_scheme(scheme: str, reader: Callable[[str], bytes],
     _SCHEMES[scheme] = {"read": reader, "write": writer}
 
 
+def unregister_scheme(scheme: str) -> None:
+    """Remove a byte store (DELETE /3/PersistS3 credential removal)."""
+    _SCHEMES.pop(scheme, None)
+
+
 def _split(uri: str):
     if "://" in uri:
         scheme, rest = uri.split("://", 1)

@@ -298,6 +298,7 @@ class GLRM(ModelBuilder):
         xf.key = Key(f"glrm_rep_{model.key}")
         cloud().dkv.put(xf.key, xf)
         model.output["representation_key"] = str(xf.key)
+        model.output.setdefault("model_category", "DimReduction")
         model.output["training_metrics"] = model.model_metrics(train)
         job.update(1.0)
         return model

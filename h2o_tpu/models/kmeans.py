@@ -169,5 +169,6 @@ class KMeans(ModelBuilder):
                    tot_withinss=float(jnp.sum(wss)), totss=totss,
                    iterations=it + 1)
         model = self.model_cls(self.model_id, dict(p), out)
+        model.output.setdefault("model_category", "Clustering")
         model.output["training_metrics"] = model.model_metrics(train)
         return model

@@ -99,6 +99,7 @@ class PCA(ModelBuilder):
                    expansion_spec=expansion_spec(di),
                    coef_names=di.expanded_names)
         model = self.model_cls(self.model_id, dict(p), out)
+        model.output.setdefault("model_category", "DimReduction")
         model.output["training_metrics"] = model.model_metrics(train)
         job.update(1.0)
         return model

@@ -135,6 +135,7 @@ class SVD(ModelBuilder):
             uf.key = Key(f"svd_u_{model.key}")
             cloud().dkv.put(uf.key, uf)
             model.output["u_key"] = str(uf.key)
+        model.output.setdefault("model_category", "DimReduction")
         model.output["training_metrics"] = model.model_metrics(train)
         job.update(1.0)
         return model

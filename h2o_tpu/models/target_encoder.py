@@ -154,5 +154,6 @@ class TargetEncoder(ModelBuilder):
                    domains={c: list(train.vec(c).domain) for c in cols})
         model = self.model_cls(self.model_id, dict(p), out)
         model.params["response_column"] = y
+        model.output.setdefault("model_category", "TargetEncoder")
         model.output["training_metrics"] = model.model_metrics()
         return model

@@ -146,6 +146,9 @@ class DRF(ModelBuilder):
                 # carry the checkpoint trees' importance; the driver adds
                 # the new trees' gains on top
                 out["varimp"] = np.asarray(co["varimp"])
+            if ckpt is not None and co.get("node_gain") is not None:
+                # checkpoint per-node gains; driver appends new trees'
+                out["node_gain"] = np.asarray(co["node_gain"])
             model = self.model_cls(self.model_id, dict(p), out)
             model.params["response_column"] = y
             return model

@@ -25,6 +25,23 @@ import numpy as np
 from h2o_tpu.models.score_keeper import ScoreKeeper
 
 
+def _set_node_gain(model, new_gain: np.ndarray) -> None:
+    """Store per-node gains covering ALL trees in the model (checkpoint
+    resume prepends the checkpoint's gains; checkpoints trained before
+    gains existed get a zero prefix so FeatureInteraction indexing stays
+    aligned with split_col)."""
+    sc_all = np.asarray(model.output["split_col"])
+    prior = model.output.get("node_gain")
+    if prior is not None and \
+            prior.shape[0] + new_gain.shape[0] == sc_all.shape[0]:
+        new_gain = np.concatenate([np.asarray(prior), new_gain])
+    elif new_gain.shape[0] != sc_all.shape[0]:
+        pad = np.zeros((sc_all.shape[0] - new_gain.shape[0],) +
+                       new_gain.shape[1:], new_gain.dtype)
+        new_gain = np.concatenate([pad, new_gain])
+    model.output["node_gain"] = new_gain
+
+
 class IncrementalScorer:
     """Running link-scale predictions of the growing forest on one frame.
 
@@ -91,10 +108,11 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
         prior_vi = model.output.get("varimp")
         vi = np.asarray(tf.varimp)
         model.output["varimp"] = vi if prior_vi is None else prior_vi + vi
+        _set_node_gain(model, np.asarray(tf.node_gain))
         return model
 
     block = interval if interval > 0 else max(1, min(ntrees, 10))
-    scs, bss, vls = [], [], []
+    scs, bss, vls, gns = [], [], [], []
     vi_total = None
     F = F0
     done = 0
@@ -108,6 +126,7 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
         scs.append(np.asarray(tf.split_col))
         bss.append(np.asarray(tf.bitset))
         vls.append(np.asarray(tf.value))
+        gns.append(np.asarray(tf.node_gain))
         vi = np.asarray(tf.varimp)
         vi_total = vi if vi_total is None else vi_total + vi
         done += n
@@ -131,6 +150,7 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
     model = make_model(np.concatenate(scs), np.concatenate(bss),
                        np.concatenate(vls), done, F)
     model.output["scoring_history"] = sk.events
+    _set_node_gain(model, np.concatenate(gns))
     prior_vi = model.output.get("varimp")
     if vi_total is not None:
         model.output["varimp"] = vi_total if prior_vi is None \

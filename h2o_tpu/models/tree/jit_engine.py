@@ -89,6 +89,7 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
     bitset = jnp.zeros((H, B + 1), bool)
     value = jnp.zeros((H,), jnp.float32)
     varimp = jnp.zeros((C,), jnp.float32)
+    node_gain = jnp.zeros((H,), jnp.float32)   # per-split SE reduction
     leaf = leaf0
 
     for d in range(D):                       # static unroll — exact L per level
@@ -121,6 +122,9 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
         varimp = varimp.at[s["col"]].add(
             jnp.where(do_split, jnp.maximum(s["gain"], 0.0), 0.0))
         # record splits + terminal values at this level's heap slots
+        node_gain = jax.lax.dynamic_update_slice(
+            node_gain,
+            jnp.where(do_split, jnp.maximum(s["gain"], 0.0), 0.0), (off,))
         split_col = jax.lax.dynamic_update_slice(
             split_col, jnp.where(do_split, s["col"], -1), (off,))
         bitset = jax.lax.dynamic_update_slice(
@@ -144,7 +148,7 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
         child = 2 * lf + jnp.where(go_left, 0, 1)
         leaf = jnp.where(active & do_split[lf], child,
                          jnp.where(active, -1, leaf))
-    return split_col, bitset, value, varimp
+    return split_col, bitset, value, varimp, node_gain
 
 
 def _tree_predict(bins, split_col, bitset, value, D: int):
@@ -168,6 +172,7 @@ class TrainedForest(NamedTuple):
     value: jax.Array       # (T, K, H)
     f_final: jax.Array     # (R, K) link-scale training predictions
     varimp: jax.Array      # (C,) summed split-gain importance
+    node_gain: jax.Array   # (T, K, H) per-split gain (FeatureInteraction)
 
 
 @functools.partial(
@@ -245,25 +250,26 @@ def train_forest(bins, yv, w, active, F0, is_cat, key, *, dist_name: str,
             if mode == "gbm" else 1.0
         if mode == "gbm" and dist_name == "multinomial":
             scale = scale * (K - 1) / K
-        scs, bss, vls, preds, vis = [], [], [], [], []
+        scs, bss, vls, preds, vis, gns = [], [], [], [], [], []
         for kcls in range(K):                    # static unroll over classes
             kc, kk = jax.random.split(kc)
             stats = stats_for(kcls, F)
-            sc, bs, vl, vi = build_tree_traced(bins, stats, leaf0, kk,
-                                               is_cat, cfg, tree_cols)
+            sc, bs, vl, vi, gn = build_tree_traced(bins, stats, leaf0, kk,
+                                                   is_cat, cfg, tree_cols)
             vl = vl * scale
             scs.append(sc)
             bss.append(bs)
             vls.append(vl)
             vis.append(vi)
+            gns.append(gn)
             preds.append(_tree_predict(bins, sc, bs, vl, max_depth))
         F = F + jnp.stack(preds, axis=1)
         return F, (jnp.stack(scs), jnp.stack(bss), jnp.stack(vls),
-                   sum(vis))
+                   sum(vis), jnp.stack(gns))
 
     keys = jax.random.split(key, ntrees)
     # t0 is a TRACED scalar (not static): per-block calls with varying tree
     # offsets reuse one compiled program
     ts = jnp.arange(ntrees, dtype=jnp.float32) + jnp.float32(t0)
-    F_final, (sc, bs, vl, vi) = jax.lax.scan(tree_step, F0, (ts, keys))
-    return TrainedForest(sc, bs, vl, F_final, jnp.sum(vi, axis=0))
+    F_final, (sc, bs, vl, vi, gn) = jax.lax.scan(tree_step, F0, (ts, keys))
+    return TrainedForest(sc, bs, vl, F_final, jnp.sum(vi, axis=0), gn)

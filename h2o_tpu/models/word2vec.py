@@ -253,7 +253,11 @@ class Word2Vec(ModelBuilder):
 
         out = dict(words=words, vocab=vocab,
                    vectors=np.asarray(Win), vec_size=D,
-                   epochs_run=epochs)
+                   epochs_run=epochs,
+                   # the client picks H2OWordEmbeddingModel (find_synonyms
+                   # / transform surface) from this category
+                   # (h2o-py estimator_base.py:485)
+                   model_category="WordEmbedding")
         model = self.model_cls(self.model_id, dict(p), out)
         model.output["training_metrics"] = model.model_metrics()
         return model

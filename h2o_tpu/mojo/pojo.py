@@ -1,0 +1,223 @@
+"""POJO (plain-old-Java-object) scoring source generation.
+
+Reference: the h2o-3 POJO codegen emits a standalone Java class per model
+(`hex/tree/TreeJCodeGen.java`, `hex/glm/GLMModel.toJavaPredictBody`,
+`water/util/JCodeGen.java`); clients fetch it via GET /3/Models.java/{id}
+(`water/api/ModelsHandler.java` fetchJavaCode; h2o-py h2o.download_pojo,
+h2o.py:1868).
+
+The TPU rebuild stores trees as fixed-shape heap arrays (split_col /
+bitset / value per node, models/tree/jit_engine.py) rather than
+CompressedTree bytecode, so the generator walks the heap directly: node n
+has children 2n+1 / 2n+2, split_col[n] < 0 is a leaf, bitset[n, b] routes
+bin b LEFT with bit B the NA bucket, and numeric prefix-bitsets lower to
+float thresholds exactly like the MOJO encoder (mojo/genmodel.py
+_TreeEncoder._split_parts).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+
+def _j(name: str) -> str:
+    """Java-identifier-safe name."""
+    return "".join(ch if ch.isalnum() or ch == "_" else "_" for ch in name)
+
+
+def _tree_node_java(sc, bs, vl, sp, is_cat, cards, n: int, depth: int,
+                    lines: List[str]) -> None:
+    ind = "    " * (depth + 2)
+    H = len(sc)
+    if n >= H or sc[n] < 0:
+        v = float(vl[n]) if n < H else 0.0
+        lines.append(f"{ind}pred = {v!r}f;")
+        return
+    c = int(sc[n])
+    b = bs[n]
+    B = len(b) - 1
+    na_left = bool(b[B])
+    if is_cat[c]:
+        card = max(int(cards[c]), 1)
+        leftset = [bool(x) for x in b[:card]]
+        arr = ", ".join("true" if x else "false" for x in leftset)
+        cond = (f"!Double.isNaN(data[{c}]) && (int) data[{c}] < {card} && "
+                f"new boolean[]{{{arr}}}[(int) data[{c}]]")
+        if na_left:
+            cond = f"Double.isNaN(data[{c}]) || ({cond})"
+    else:
+        nleft = int(np.sum(b[:B]))
+        spc = np.asarray(sp[c], np.float64)
+        finite = np.flatnonzero(~np.isnan(spc))
+        k = min(max(nleft - 1, 0), (finite[-1] if len(finite) else 0))
+        thr = float(spc[k]) if len(finite) else 0.0
+        cond = f"data[{c}] < {thr!r}"
+        if na_left:
+            cond = f"Double.isNaN(data[{c}]) || ({cond})"
+        else:
+            cond = f"!Double.isNaN(data[{c}]) && ({cond})"
+    lines.append(f"{ind}if ({cond}) {{")
+    _tree_node_java(sc, bs, vl, sp, is_cat, cards, 2 * n + 1, depth + 1,
+                    lines)
+    lines.append(f"{ind}}} else {{")
+    _tree_node_java(sc, bs, vl, sp, is_cat, cards, 2 * n + 2, depth + 1,
+                    lines)
+    lines.append(f"{ind}}}")
+
+
+def tree_pojo(model) -> str:
+    """GBM/DRF model -> standalone Java scoring class source."""
+    out = model.output
+    x = list(out["x"])
+    dom_map = out.get("domains") or {}
+    resp_dom = out.get("response_domain")
+    nclass = len(resp_dom) if resp_dom else 1
+    sc = np.asarray(out["split_col"])
+    bs = np.asarray(out["bitset"])
+    vl = np.asarray(out["value"])
+    sp = np.asarray(out["split_points"])
+    is_cat = np.asarray(out["is_cat"], bool)
+    cards = [len(dom_map.get(c, [])) for c in x]
+    f0 = np.asarray(out.get("f0", [0.0]), np.float64)
+    T, K, _H = sc.shape
+    dist = out.get("distribution_resolved", "gaussian")
+    cls = _j(str(model.key))
+
+    lines = [
+        "// Generated POJO scorer - h2o-tpu "
+        "(reference format: hex/tree/TreeJCodeGen.java)",
+        f"// Model: {model.key}  algo={model.algo}  ntrees={T} "
+        f"nclasses={nclass}",
+        f"public class {cls} {{",
+        f"  public static final String[] NAMES = {{{', '.join('"%s"' % n for n in x)}}};",  # noqa: E501
+    ]
+    if resp_dom:
+        doms = ", ".join(f'"{d}"' for d in resp_dom)
+        lines.append(f"  public static final String[] DOMAIN = {{{doms}}};")
+    for t in range(T):
+        for k in range(K):
+            lines.append(
+                f"  static double tree_{t}_{k}(double[] data) {{")
+            lines.append("    double pred;")
+            _tree_node_java(sc[t, k], bs[t, k], vl[t, k], sp, is_cat,
+                            cards, 0, 0, lines)
+            lines.append("    return pred;")
+            lines.append("  }")
+    lines.append("  public static double[] score0(double[] data) {")
+    lines.append(f"    double[] f = new double[{K}];")
+    if model.algo == "gbm" and dist != "multinomial":
+        lines.append(f"    f[0] = {float(f0[0])!r};")
+    elif model.algo == "gbm":
+        for k in range(K):
+            lines.append(f"    f[{k}] = {float(f0[k])!r};")
+    for t in range(T):
+        for k in range(K):
+            lines.append(f"    f[{k}] += tree_{t}_{k}(data);")
+    if model.algo == "drf":
+        lines.append(f"    for (int k = 0; k < {K}; k++) "
+                     f"f[k] /= {float(T)!r};")
+    if nclass == 2 and K == 1:
+        if model.algo == "gbm":
+            lines.append("    double p1 = 1.0 / (1.0 + Math.exp(-f[0]));")
+        else:
+            lines.append("    double p1 = f[0];")
+        lines.append("    return new double[]{p1 > 0.5 ? 1 : 0, "
+                     "1.0 - p1, p1};")
+    elif nclass > 2:
+        lines.append("    double mx = f[0]; "
+                     f"for (int k = 1; k < {K}; k++) "
+                     "if (f[k] > mx) mx = f[k];")
+        lines.append("    double s = 0; "
+                     f"double[] p = new double[{K} + 1];")
+        lines.append(f"    for (int k = 0; k < {K}; k++) "
+                     "{ p[k + 1] = Math.exp(f[k] - mx); s += p[k + 1]; }")
+        lines.append(f"    int best = 0; for (int k = 0; k < {K}; k++) "
+                     "{ p[k + 1] /= s; if (p[k + 1] > p[best + 1]) "
+                     "best = k; }")
+        lines.append("    p[0] = best; return p;")
+    else:
+        inv = {"poisson": "Math.exp(f[0])", "gamma": "Math.exp(f[0])",
+               "tweedie": "Math.exp(f[0])"}.get(dist, "f[0]")
+        lines.append(f"    return new double[]{{{inv}}};")
+    lines.append("  }")
+    lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+def glm_pojo(model) -> str:
+    """GLM model -> standalone Java scoring class source (raw-value
+    scoring; standardized coefficients are de-standardized exactly as in
+    mojo/genmodel.py write_glm_mojo)."""
+    out = model.output
+    if out.get("is_multinomial"):
+        raise NotImplementedError("multinomial GLM POJO export")
+    spec = out["expansion_spec"]
+    cat_names = list(spec["cat_names"])
+    num_names = list(spec["num_names"])
+    cards = list(spec["cat_cards"])
+    uafl = bool(spec["use_all_factor_levels"])
+    beta = np.asarray(out["beta"], np.float64)
+    n_cat_coef = sum(c - (0 if uafl else 1) for c in cards)
+    cat_beta = beta[:n_cat_coef]
+    num_beta = beta[n_cat_coef:-1].copy()
+    intercept = float(beta[-1])
+    means = np.asarray(spec["means"], np.float64)
+    sigmas = np.asarray(spec["sigmas"], np.float64)
+    if spec["standardize"] and len(num_beta):
+        sig = np.where(sigmas == 0, 1.0, sigmas)
+        intercept -= float(np.sum(num_beta * means / sig))
+        num_beta = num_beta / sig
+    fam = out.get("family_resolved", "gaussian")
+    cls = _j(str(model.key))
+    x = cat_names + num_names
+    lines = [
+        "// Generated POJO scorer - h2o-tpu "
+        "(reference format: hex/glm/GLMModel.toJavaPredictBody)",
+        f"public class {cls} {{",
+        f"  public static final String[] NAMES = {{{', '.join('"%s"' % n for n in x)}}};",  # noqa: E501
+        "  public static double[] score0(double[] data) {",
+        f"    double eta = {intercept!r};",
+    ]
+    off = 0
+    for j, (name, card) in enumerate(zip(cat_names, cards)):
+        ncoef = card - (0 if uafl else 1)
+        coefs = ", ".join(repr(float(c)) for c in
+                          cat_beta[off:off + ncoef])
+        base = 0 if uafl else 1
+        lines.append(f"    // categorical {name}")
+        lines.append(f"    if (!Double.isNaN(data[{j}])) {{")
+        lines.append(f"      int lvl = (int) data[{j}] - {base};")
+        lines.append(f"      double[] cb = {{{coefs}}};")
+        lines.append("      if (lvl >= 0 && lvl < cb.length) "
+                     "eta += cb[lvl];")
+        lines.append("    }")
+        off += ncoef
+    for j, name in enumerate(num_names):
+        col = len(cat_names) + j
+        b = float(num_beta[j]) if j < len(num_beta) else 0.0
+        m = float(means[j]) if j < len(means) else 0.0
+        lines.append(f"    eta += {b!r} * (Double.isNaN(data[{col}]) "
+                     f"? {m!r} : data[{col}]);")
+    if fam in ("binomial", "quasibinomial"):
+        lines.append("    double p1 = 1.0 / (1.0 + Math.exp(-eta));")
+        lines.append("    return new double[]{p1 > 0.5 ? 1 : 0, "
+                     "1.0 - p1, p1};")
+    elif fam in ("poisson", "gamma", "tweedie"):
+        lines.append("    return new double[]{Math.exp(eta)};")
+    else:
+        lines.append("    return new double[]{eta};")
+    lines.append("  }")
+    lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+def pojo_source(model) -> str:
+    if model.algo in ("gbm", "drf"):
+        return tree_pojo(model)
+    if model.algo == "glm":
+        return glm_pojo(model)
+    raise NotImplementedError(
+        f"POJO export not implemented for '{model.algo}' — the reference "
+        "also gates POJO support per algo (Model.havePojo)")

@@ -1673,7 +1673,21 @@ def _op_any_all(node, env, which: str):
     return float(any(vals) if which == "any" else all(vals))
 
 
+def _op_segment_models_as_frame(node, env):
+    """(segment_models_as_frame sm_id) — AstSegmentModelsAsFrame
+    (h2o-py segment_models.py:48): tabular view of a SegmentModels
+    collection."""
+    from h2o_tpu.core.cloud import cloud
+    from h2o_tpu.models.segment import SegmentModels
+    key = _lit(node[1])
+    sm = cloud().dkv.get(key)
+    if not isinstance(sm, SegmentModels):
+        raise ValueError(f"no segment models under key {key}")
+    return sm.to_frame()
+
+
 _EXTRA_OPS = {
+    "segment_models_as_frame": _op_segment_models_as_frame,
     "scale": _op_scale,
     "hist": _op_hist,
     "h2o.runif": _op_runif,
@@ -1706,6 +1720,29 @@ _EXTRA_OPS = {
     "any": lambda n, e: _op_any_all(n, e, "any"),
     "all": lambda n, e: _op_any_all(n, e, "all"),
 }
+
+
+_OP_NAMES_CACHE: Optional[List[str]] = None
+
+
+def op_names() -> List[str]:
+    """Every op name the interpreter dispatches (GET /99/Rapids/help;
+    reference water/api/RapidsHelpHandler lists rapids/ast/prims/**).
+    Computed once; falls back to the table-registered ops when source
+    isn't available (bytecode-only install)."""
+    global _OP_NAMES_CACHE
+    if _OP_NAMES_CACHE is not None:
+        return _OP_NAMES_CACHE
+    names = set(_BINOPS) | set(_UNOPS) | set(_CUMOPS) | set(_STROPS) | \
+        set(_EXTRA_OPS)
+    try:
+        import re as _re
+        with open(__file__) as f:
+            names.update(_re.findall(r'op == "([^"]+)"', f.read()))
+    except OSError:
+        pass
+    _OP_NAMES_CACHE = sorted(names)
+    return _OP_NAMES_CACHE
 
 
 def rapids_exec(expr: str, session: Optional[Session] = None):

@@ -1,0 +1,121 @@
+"""Model-analysis REST routes via the stock client: FeatureInteraction,
+Friedman-Popescu H, SignificantRules, Assembly, SegmentModelsBuilders.
+
+Reference: hex/tree FeatureInteractions + FriedmanPopescusH,
+hex/rulefit RuleFitUtils significant rules, water/rapids/Assembly.java,
+hex/segments/SegmentModelsBuilder.java.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+_H2O_PY = "/root/reference/h2o-py"
+
+pytestmark = [
+    pytest.mark.skipif(not os.path.isdir(_H2O_PY),
+                       reason="reference h2o-py client not present"),
+    pytest.mark.shared_dkv,
+]
+
+
+@pytest.fixture(scope="module")
+def h2o_client(cl):
+    from h2o_tpu.api.server import RestServer
+    srv = RestServer(port=0).start()
+    if _H2O_PY not in sys.path:
+        sys.path.insert(0, _H2O_PY)
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        import h2o
+    h2o.connect(url=f"http://127.0.0.1:{srv.port}", verbose=False,
+                strict_version_check=False)
+    yield h2o
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def train_frame(h2o_client):
+    h2o = h2o_client
+    rng = np.random.default_rng(7)
+    n = 400
+    a = rng.normal(size=n)
+    b = rng.normal(size=n)
+    seg = rng.choice(["s1", "s2"], size=n)
+    y = np.where(a + b * (seg == "s1") + rng.normal(size=n) * 0.3 > 0,
+                 "t", "f")
+    hf = h2o.H2OFrame({"a": a.tolist(), "b": b.tolist(),
+                       "seg": seg.tolist(), "y": y.tolist()})
+    hf["seg"] = hf["seg"].asfactor()
+    hf["y"] = hf["y"].asfactor()
+    return hf
+
+
+@pytest.fixture(scope="module")
+def gbm_model(h2o_client, train_frame):
+    from h2o.estimators import H2OGradientBoostingEstimator
+    gbm = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=1)
+    gbm.train(x=["a", "b", "seg"], y="y", training_frame=train_frame)
+    return gbm
+
+
+def test_feature_interaction(h2o_client, gbm_model):
+    tables = gbm_model.feature_interaction()
+    assert tables, "expected at least the depth-0 table"
+    t0 = tables[0]
+    names = [r[0] for r in t0.cell_values]
+    assert set(names) <= {"a", "b", "seg"}
+    gains = [r[1] for r in t0.cell_values]
+    assert all(g >= 0 for g in gains) and sum(gains) > 0
+    # gains sorted descending (most important feature first)
+    assert gains == sorted(gains, reverse=True)
+
+
+def test_friedmans_h(h2o_client, gbm_model, train_frame):
+    h = gbm_model.h(train_frame, ["a", "b"])
+    assert 0.0 <= h <= 1.0
+
+
+def test_significant_rules(h2o_client, train_frame):
+    from h2o.estimators import H2ORuleFitEstimator
+    rf = H2ORuleFitEstimator(max_num_rules=10, seed=1)
+    rf.train(x=["a", "b"], y="y", training_frame=train_frame)
+    tbl = rf.rule_importance()
+    assert tbl is not None
+
+
+def test_assembly_fit(h2o_client, train_frame):
+    h2o = h2o_client
+    from h2o.assembly import H2OAssembly
+    from h2o.transforms.preprocessing import H2OColSelect, H2OColOp
+    from h2o.frame import H2OFrame
+    assembly = H2OAssembly(steps=[
+        ("select", H2OColSelect(["a", "b"])),
+        ("cos_a", H2OColOp(op=H2OFrame.cos, col="a", inplace=True)),
+        ("abs_b", H2OColOp(op=H2OFrame.abs, col="b", inplace=False,
+                           new_col_name="abs_b"))])
+    result = assembly.fit(train_frame)
+    assert result.columns == ["a", "b", "abs_b"]
+    got = result.as_data_frame()
+    src = train_frame.as_data_frame()
+    assert np.allclose(got["a"], np.cos(src["a"]), atol=1e-5)
+    assert np.allclose(got["abs_b"], np.abs(src["b"]), atol=1e-5)
+
+
+def test_train_segments(h2o_client, train_frame):
+    from h2o.estimators import H2OGradientBoostingEstimator
+    gbm = H2OGradientBoostingEstimator(ntrees=3, max_depth=2, seed=1)
+    sms = gbm.train_segments(x=["a", "b"], y="y",
+                             training_frame=train_frame,
+                             segments=["seg"], parallelism=2)
+    fr = sms.as_frame()
+    df = fr.as_data_frame()
+    assert set(df["seg"]) == {"s1", "s2"}
+    assert (df["status"] == "SUCCEEDED").all()
+    # each segment's model exists and is fetchable
+    h2o = h2o_client
+    for mid in df["model"]:
+        assert h2o.get_model(mid) is not None

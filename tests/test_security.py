@@ -1,0 +1,109 @@
+"""Webserver security surface: TLS, Basic auth, client mode.
+
+Reference: water/webserver SSL support (-jks), JAAS Basic login
+(-hash_login), client nodes (water/H2O.java:391-394).
+"""
+
+import os
+import ssl
+import subprocess
+import urllib.error
+import urllib.request
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def certpair(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tls")
+    cert, key = str(d / "cert.pem"), str(d / "key.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "1",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True)
+    return cert, key
+
+
+def test_tls_server(cl, certpair):
+    from h2o_tpu.api.server import RestServer
+    cert, key = certpair
+    srv = RestServer(port=0, ssl_cert=cert, ssl_key=key).start()
+    try:
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        with urllib.request.urlopen(
+                f"https://127.0.0.1:{srv.port}/3/Ping", context=ctx) as r:
+            assert r.status == 200
+        # plaintext against the TLS port must fail
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/3/Ping", timeout=3)
+    finally:
+        srv.stop()
+
+
+def test_basic_auth(cl):
+    import base64
+    from h2o_tpu.api.server import RestServer
+    srv = RestServer(port=0, basic_auth="ops:sekret").start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/3/Ping"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url)
+        assert ei.value.code == 401
+        assert ei.value.headers["WWW-Authenticate"].startswith("Basic")
+        req = urllib.request.Request(url, headers={
+            "Authorization": "Basic " +
+            base64.b64encode(b"ops:sekret").decode()})
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 200
+        bad = urllib.request.Request(url, headers={
+            "Authorization": "Basic " +
+            base64.b64encode(b"ops:wrong").decode()})
+        with pytest.raises(urllib.error.HTTPError) as ei2:
+            urllib.request.urlopen(bad)
+        assert ei2.value.code == 401
+    finally:
+        srv.stop()
+
+
+def test_auth_via_stock_client(cl):
+    _H2O_PY = "/root/reference/h2o-py"
+    if not os.path.isdir(_H2O_PY):
+        pytest.skip("reference h2o-py client not present")
+    import sys
+    if _H2O_PY not in sys.path:
+        sys.path.insert(0, _H2O_PY)
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        import h2o
+    from h2o_tpu.api.server import RestServer
+    srv = RestServer(port=0, basic_auth="ops:sekret").start()
+    try:
+        h2o.connect(url=f"http://127.0.0.1:{srv.port}",
+                    auth=("ops", "sekret"), verbose=False,
+                    strict_version_check=False)
+        assert h2o.cluster().cloud_size >= 1
+    finally:
+        srv.stop()
+
+
+def test_client_mode():
+    import numpy as np
+    from h2o_tpu.core.cloud import Cloud
+    from h2o_tpu.core.store import Key
+    cl = Cloud.boot(client=True)
+    try:
+        # control plane works: DKV metadata, jobs registry
+        cl.dkv.put("meta", {"a": 1})
+        assert cl.dkv.get("meta") == {"a": 1}
+        cl.dkv.remove("meta")
+        assert isinstance(Key.make("x"), Key)
+        # data homing refused
+        with pytest.raises(RuntimeError, match="client-mode"):
+            cl.device_put_rows(np.zeros(16, np.float32))
+    finally:
+        Cloud.boot(client=False)

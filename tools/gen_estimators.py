@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Client-binding codegen — the gen_python analog.
+
+The reference keeps its Python/R estimator classes mechanically in sync
+with the server by generating them from live REST schema metadata
+(h2o-bindings/bin/gen_python.py:440, SURVEY §2.6).  This tool does the
+same against an h2o-tpu server: it reads GET /3/ModelBuilders +
+GET /3/ModelBuilders/{algo} and emits one estimator class per algorithm
+with typed keyword arguments and docstrings.
+
+Usage:
+    python tools/gen_estimators.py --url http://127.0.0.1:54321 \
+        --out generated_estimators.py
+    python tools/gen_estimators.py --local --out generated_estimators.py
+
+`--local` generates from the in-process builder registry (no server),
+which is what the test suite uses.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import keyword
+import urllib.request
+
+HEADER = '''"""Generated estimator bindings — do not edit by hand.
+
+Regenerate with tools/gen_estimators.py (the gen_python.py analog).
+Each class wraps POST /3/ModelBuilders/{algo} with the parameter surface
+advertised by the server's builder metadata.
+"""
+
+from typing import Any, Dict, Optional
+
+
+class _GeneratedEstimator:
+    """Minimal REST-backed estimator (works against any h2o-tpu server).
+
+    For the full client experience use the stock h2o-py package — it
+    attaches unchanged; these bindings cover scripted/raw-REST use."""
+
+    algo: str = ""
+
+    def __init__(self, **params):
+        bad = set(params) - set(self._defaults)
+        if bad:
+            raise TypeError(f"unknown parameters for {self.algo}: "
+                            f"{sorted(bad)}")
+        self.params: Dict[str, Any] = {**self._defaults, **params}
+        self.model_id: Optional[str] = None
+
+    def train(self, y=None, training_frame=None, x=None,
+              connection=None, **kw):
+        """POST the build and poll the job to completion."""
+        import time
+        conn = connection or _default_connection()
+        body = {k: v for k, v in self.params.items() if v is not None}
+        body.update(kw)
+        if y is not None:
+            body["response_column"] = y
+        if training_frame is not None:
+            body["training_frame"] = str(training_frame)
+        resp = conn.post(f"/3/ModelBuilders/{self.algo}", body)
+        job = resp["job"]
+        key = job["key"]["name"]
+        while job["status"] in ("CREATED", "RUNNING"):
+            time.sleep(0.2)
+            job = conn.get(f"/3/Jobs/{key}")["jobs"][0]
+        if job["status"] != "DONE":
+            raise RuntimeError(f"build failed: {job}")
+        self.model_id = job["dest"]["name"]
+        return self
+
+
+class _Connection:
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+
+    def get(self, path):
+        import json as j
+        import urllib.request as u
+        with u.urlopen(self.url + path) as r:
+            return j.loads(r.read())
+
+    def post(self, path, body):
+        import json as j
+        import urllib.parse as p
+        import urllib.request as u
+        data = p.urlencode({k: v for k, v in body.items()}).encode()
+        with u.urlopen(u.Request(self.url + path, data=data)) as r:
+            return j.loads(r.read())
+
+
+_CONN = None
+
+
+def connect(url: str) -> None:
+    global _CONN
+    _CONN = _Connection(url)
+
+
+def _default_connection() -> _Connection:
+    if _CONN is None:
+        raise RuntimeError("call connect(url) first")
+    return _CONN
+
+'''
+
+
+def _class_name(algo: str) -> str:
+    special = {"gbm": "GBM", "drf": "DRF", "glm": "GLM", "pca": "PCA",
+               "svd": "SVD", "glrm": "GLRM", "gam": "GAM",
+               "psvm": "PSVM", "coxph": "CoxPH", "dt": "DT",
+               "xgboost": "XGBoost", "deeplearning": "DeepLearning",
+               "kmeans": "KMeans", "naivebayes": "NaiveBayes",
+               "isolationforest": "IsolationForest",
+               "extendedisolationforest": "ExtendedIsolationForest",
+               "stackedensemble": "StackedEnsemble",
+               "targetencoder": "TargetEncoder",
+               "word2vec": "Word2Vec", "rulefit": "RuleFit",
+               "isotonicregression": "IsotonicRegression",
+               "upliftdrf": "UpliftDRF", "infogram": "Infogram",
+               "anovaglm": "ANOVAGLM", "modelselection": "ModelSelection",
+               "aggregator": "Aggregator", "generic": "Generic",
+               "grep": "Grep", "tfidf": "TfIdf",
+               "naive_bayes": "NaiveBayes"}
+    return "H2O" + special.get(
+        algo, algo.replace("_", " ").title().replace(" ", "")) + \
+        "Estimator"
+
+
+def _params_from_server(url: str):
+    with urllib.request.urlopen(url.rstrip("/") + "/3/ModelBuilders") as r:
+        builders = json.loads(r.read())["model_builders"]
+    out = {}
+    for algo in sorted(builders):
+        with urllib.request.urlopen(
+                url.rstrip("/") + f"/3/ModelBuilders/{algo}") as r:
+            meta = json.loads(r.read())["model_builders"][algo]
+        out[algo] = [(p["label"], p["default_value"])
+                     for p in meta.get("parameters", [])]
+    return out
+
+
+def _params_local():
+    import os
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from h2o_tpu.models.registry import builders
+    out = {}
+    for algo, cls in sorted(builders().items()):
+        b = cls()
+        out[algo] = [(k, v) for k, v in b.params.items()
+                     if not str(k).startswith("_")]
+    return out
+
+
+def generate(params_by_algo) -> str:
+    chunks = [HEADER]
+    for algo, params in params_by_algo.items():
+        cls = _class_name(algo)
+        lines = [f"class {cls}(_GeneratedEstimator):",
+                 f'    """{algo} builder binding '
+                 f'(POST /3/ModelBuilders/{algo})."""',
+                 f"    algo = {algo!r}",
+                 "    _defaults = {"]
+        for name, default in params:
+            if keyword.iskeyword(name):
+                name += "_"
+            try:
+                rep = repr(default)
+                json.dumps(default)        # keep defaults literal-safe
+            except (TypeError, ValueError):
+                rep = "None"
+            lines.append(f"        {name!r}: {rep},")
+        lines.append("    }")
+        chunks.append("\n".join(lines) + "\n\n")
+    chunks.append("__all__ = [\n" + "\n".join(
+        f"    {_class_name(a)!r}," for a in params_by_algo) +
+        "\n    'connect',\n]\n")
+    return "\n".join(chunks)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--url", default=None,
+                    help="server URL (reads live metadata)")
+    ap.add_argument("--local", action="store_true",
+                    help="generate from the in-process registry")
+    ap.add_argument("--out", required=True)
+    ns = ap.parse_args(argv)
+    params = _params_local() if ns.local or not ns.url else \
+        _params_from_server(ns.url)
+    src = generate(params)
+    with open(ns.out, "w") as f:
+        f.write(src)
+    print(f"wrote {ns.out}: {len(params)} estimators")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
