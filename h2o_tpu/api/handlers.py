@@ -619,6 +619,14 @@ def build_model(params, algo):
         raise H2OError(404, f"unknown algorithm {algo}")
     train_key = params.get("training_frame")
     fr = cloud().dkv.get(train_key) if train_key else None
+    if algo == "grep" and isinstance(fr, str) and os.path.exists(
+            fr.replace("nfs://", "")):
+        # grep accepts a raw imported text file (hex/grep runs over
+        # ByteVecs): lift the bytes into a 1-string-column frame
+        from h2o_tpu.core.frame import Vec, T_STR
+        with open(fr.replace("nfs://", ""), errors="replace") as f:
+            lines = f.read().splitlines()
+        fr = Frame(["text"], [Vec(lines, T_STR)], key=f"{train_key}_text")
     if not isinstance(fr, Frame) and algo != "generic":
         # generic (MOJO import) is the one frame-less builder
         # (hex/generic/Generic.java trains from an artifact key)
@@ -1084,7 +1092,8 @@ def recovery_resume(params):
         raise H2OError(400, "recovery_dir required")
     pending = pending_recoveries(d)
     job = Job(dest=Key.make("recovery"),
-              description=f"auto-recover {len(pending)} job(s) from {d}")
+              description=f"auto-recover {len(pending)} job(s) from {d}",
+              priority=Job.SYSTEM_PRIORITY)
     cloud().jobs.start(job, lambda j: auto_recover(d))
     return {"job": {"key": {"name": str(job.key)}},
             "pending": len(pending)}

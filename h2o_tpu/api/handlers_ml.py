@@ -114,7 +114,9 @@ def grid_build(params, algo):
         x = [c for c in fr.names if c not in ign and c != y]
 
     gs = GridSearch(cls, hyper, search_criteria=criteria,
-                    grid_id=params.get("grid_id"), **base)
+                    grid_id=params.get("grid_id"),
+                    parallelism=int(params.get("parallelism") or 1),
+                    **base)
     job = gs.train_async(x=x, y=y, training_frame=fr,
                          validation_frame=valid)
     return {"job": job.to_dict()}

@@ -1,0 +1,96 @@
+"""Fault injection — the `-random_udp_drop` analog (SURVEY §4/§5.3).
+
+The reference exercises its retry/dedup machinery by randomly dropping
+UDP packets (water/H2O.java:446) and by a client-disconnect attack
+thread.  The TPU rebuild's failure surface is different — XLA collectives
+either complete or the program faults — so the injectable faults live at
+the HOST layer the framework owns:
+
+- job-body faults: a configured probability that any job body raises
+  mid-run (exercises Job FAILED propagation, grid failure collection,
+  AutoML skip-and-continue, and Recovery resume);
+- device-put faults: a probability that a host->HBM transfer raises
+  (exercises ingest/training error paths without corrupting state).
+
+Enable with ``H2O_TPU_CHAOS_JOB=0.3`` / ``H2O_TPU_CHAOS_DEVICE_PUT=0.1``
+(probabilities) and optional ``H2O_TPU_CHAOS_SEED``; or programmatically
+via ``configure()``.  Off by default; zero overhead when off.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+from h2o_tpu.core.log import get_logger
+
+log = get_logger("chaos")
+
+
+class ChaosError(RuntimeError):
+    """Injected failure (never raised unless chaos is enabled)."""
+
+
+class _Chaos:
+    def __init__(self):
+        self.job_p = float(os.environ.get("H2O_TPU_CHAOS_JOB", 0) or 0)
+        self.device_put_p = float(
+            os.environ.get("H2O_TPU_CHAOS_DEVICE_PUT", 0) or 0)
+        seed = os.environ.get("H2O_TPU_CHAOS_SEED")
+        self._rng = np.random.default_rng(
+            int(seed) if seed is not None else None)
+        self._lock = threading.Lock()
+        self.injected = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.job_p > 0 or self.device_put_p > 0
+
+    def _roll(self, p: float) -> bool:
+        if p <= 0:
+            return False
+        with self._lock:
+            hit = bool(self._rng.uniform() < p)
+            if hit:
+                self.injected += 1
+        return hit
+
+    def maybe_fail_job(self, what: str) -> None:
+        if self._roll(self.job_p):
+            log.warning("chaos: injecting job failure into %s", what)
+            raise ChaosError(f"injected job fault ({what})")
+
+    def maybe_fail_device_put(self) -> None:
+        if self._roll(self.device_put_p):
+            log.warning("chaos: injecting device_put failure")
+            raise ChaosError("injected device_put fault")
+
+
+_instance: Optional[_Chaos] = None
+
+
+def chaos() -> _Chaos:
+    global _instance
+    if _instance is None:
+        _instance = _Chaos()
+    return _instance
+
+
+def configure(job_p: float = 0.0, device_put_p: float = 0.0,
+              seed: Optional[int] = None) -> _Chaos:
+    """Programmatic enable (tests); returns the active instance."""
+    global _instance
+    _instance = _Chaos()
+    _instance.job_p = float(job_p)
+    _instance.device_put_p = float(device_put_p)
+    if seed is not None:
+        _instance._rng = np.random.default_rng(seed)
+    return _instance
+
+
+def reset() -> None:
+    global _instance
+    _instance = None

@@ -124,6 +124,9 @@ class Cloud:
             raise RuntimeError(
                 "client-mode cloud cannot home frame data "
                 "(boot with client=False to shard rows here)")
+        from h2o_tpu.core.chaos import chaos
+        if chaos().enabled:
+            chaos().maybe_fail_device_put()
         arr = np.asarray(host_array)
         q = self.row_multiple()
         pad = (-arr.shape[0]) % q

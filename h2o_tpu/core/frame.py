@@ -291,6 +291,94 @@ class Vec:
         self._hist = None
 
 
+class SparseVec(Vec):
+    """Sparse numeric column codec — the CXIChunk/CXFChunk analog
+    (reference water/fvec/CXIChunk.java: store only non-default values).
+
+    TPU-native role: sparse is the AT-REST codec, dense the COMPUTE form.
+    The MXU wants dense tiles, so decompression happens once at the HBM
+    boundary (first device access materializes the dense payload) instead
+    of per-op; under memory pressure the Cleaner drops the dense copy and
+    the column collapses back to its (indices, values) pairs — spilling
+    is free because the sparse source is authoritative.
+    """
+
+    def __init__(self, idx, vals, nrows: int, default: float = 0.0,
+                 vtype: str = T_NUM):
+        import threading as _th
+        idx = np.asarray(idx, np.int64)
+        vals = np.asarray(vals, np.float32)
+        assert idx.shape == vals.shape
+        assert vtype in (T_NUM, T_TIME)
+        self.type = vtype
+        self.domain = None
+        self.nrows = int(nrows)
+        self.host_data = None
+        self._rollups = None
+        self._hist = None
+        self._host_f64 = None
+        self._spill_np = None
+        self._spill_lock = _th.Lock()
+        self._sparse = (idx, vals, np.float32(default))
+        self._data = None                    # dense device form, lazy
+
+    @property
+    def nnz(self) -> int:
+        return len(self._sparse[0])
+
+    def _densify_host(self) -> np.ndarray:
+        idx, vals, default = self._sparse
+        dense = np.full(self.nrows, default, np.float32)
+        dense[idx] = vals
+        return dense
+
+    @property
+    def data(self):
+        if self._sparse is None:             # graduated to dense (mutated)
+            return Vec.data.fget(self)
+        from h2o_tpu.core.memory import manager
+        with self._spill_lock:
+            if self._data is None:
+                self._data = cloud().device_put_rows(self._densify_host())
+                out = self._data
+                materialized = True
+            else:
+                out = self._data
+                materialized = False
+        if materialized:
+            self._account()
+        else:
+            manager().touch(self)
+        return out
+
+    @data.setter
+    def data(self, value) -> None:
+        # dense mutation graduates the column out of the sparse codec
+        # (the reference likewise re-compresses to a different chunk type
+        # on NewChunk close); from here on base-class spill semantics
+        # (park a dense host copy) apply
+        self._sparse = None
+        Vec.data.fset(self, value)
+
+    def _spill(self) -> bool:
+        if self._sparse is None:
+            return Vec._spill(self)
+        # drop the dense device payload; the sparse pairs stay
+        with self._spill_lock:
+            if self._data is None:
+                return False
+            self._data = None
+            return True
+
+    def to_numpy(self) -> np.ndarray:
+        if self._sparse is None:
+            return Vec.to_numpy(self)
+        with self._spill_lock:
+            if self._data is not None:
+                return np.asarray(self._data)[: self.nrows]
+        return self._densify_host()
+
+
 class Frame:
     """An ordered collection of equally-long, identically-sharded Vecs."""
 

@@ -33,8 +33,15 @@ class JobCancelledException(Exception):
 class Job:
     """A tracked unit of async work producing a DKV-visible result."""
 
+    # priority bands (reference: water/H2O.java:1470-1560 FJPS[0..126] —
+    # user MR work 0-118, system work 119+ can never be starved by it)
+    USER_PRIORITY = 50
+    SYSTEM_PRIORITY = 119
+
     def __init__(self, dest: Optional[str] = None, description: str = "",
-                 dest_type: str = "Key<Frame>"):
+                 dest_type: str = "Key<Frame>",
+                 priority: int = USER_PRIORITY):
+        self.priority = int(priority)
         self.key = Key.make("job")
         self.dest = Key(dest) if dest else Key.make("result")
         self.dest_type = dest_type
@@ -110,10 +117,19 @@ class Job:
 
 
 class JobRegistry:
-    def __init__(self, max_workers: int = 8):
+    """Two-band priority scheduler (the FJPS[0..126] analog, water/
+    H2O.java:1470-1560): user jobs (model builds, parses) share a bounded
+    pool; jobs at SYSTEM_PRIORITY and above run on a reserved pool so
+    control work (recovery resume, exports, admin) is never starved
+    behind long model builds — the same non-starvation invariant the
+    reference's leveled ForkJoin pools provide."""
+
+    def __init__(self, max_workers: int = 8, system_workers: int = 2):
         self._jobs: Dict[Key, Job] = {}
         self._pool = ThreadPoolExecutor(max_workers=max_workers,
                                         thread_name_prefix="h2o-job")
+        self._sys_pool = ThreadPoolExecutor(
+            max_workers=system_workers, thread_name_prefix="h2o-sysjob")
         self._lock = threading.Lock()
 
     def start(self, job: Job, body: Callable[[Job], Any]) -> Job:
@@ -127,6 +143,9 @@ class JobRegistry:
             job.status = RUNNING
             job.start_time = time.time()
             try:
+                from h2o_tpu.core.chaos import chaos
+                if chaos().enabled:
+                    chaos().maybe_fail_job(job.description)
                 job.result = body(job)
                 job.status = DONE
                 job.progress = 1.0
@@ -143,7 +162,9 @@ class JobRegistry:
                                 status=job.status)
                 job._done.set()
 
-        self._pool.submit(run)
+        pool = self._sys_pool if job.priority >= Job.SYSTEM_PRIORITY \
+            else self._pool
+        pool.submit(run)
         return job
 
     def run_sync(self, job: Job, body: Callable[[Job], Any]) -> Any:

@@ -284,6 +284,9 @@ def parse_files(paths: Sequence[str], setup: Optional[ParseSetupResult] = None,
     if first.endswith((".parquet", ".pq")) or _is_parquet(first):
         fr = parse_parquet(paths, dest)
         return _apply_setup_overrides(fr, setup, column_types)
+    if first.endswith(".orc") or _is_orc(first):
+        fr = parse_orc(paths, dest)
+        return _apply_setup_overrides(fr, setup, column_types)
     if first.endswith(".arff") or _looks_like_arff(first):
         fr = parse_arff(first, dest) if len(paths) == 1 else \
             _rbind_frames([parse_arff(p) for p in paths], dest)
@@ -360,6 +363,14 @@ def parse_files(paths: Sequence[str], setup: Optional[ParseSetupResult] = None,
     fr = Frame(names, vecs, key=dest or os.path.basename(paths[0]))
     log.info("parsed %s: %d rows, %d cols", paths, fr.nrows, fr.ncols)
     return fr
+
+
+def _is_orc(path: str) -> bool:
+    try:
+        with open(path, "rb") as f:
+            return f.read(3) == b"ORC"
+    except OSError:
+        return False
 
 
 def _is_parquet(path: str) -> bool:
@@ -568,6 +579,19 @@ def parse_parquet(paths: Sequence[str],
     feeding the standard column path."""
     import pyarrow.parquet as pq
     tables = [pq.read_table(p) for p in paths]
+    return _arrow_to_frame(tables, paths, dest, "parquet")
+
+
+def parse_orc(paths: Sequence[str],
+              dest: Optional[str] = None) -> Frame:
+    """ORC via pyarrow.orc (reference: h2o-parsers/h2o-orc-parser) —
+    same arrow-column lowering as parquet."""
+    from pyarrow import orc as _orc
+    tables = [_orc.read_table(p) for p in paths]
+    return _arrow_to_frame(tables, paths, dest, "orc")
+
+
+def _arrow_to_frame(tables, paths, dest, fmt: str) -> Frame:
     import pyarrow as pa
     table = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
     names, vecs = [], []
@@ -600,7 +624,7 @@ def parse_parquet(paths: Sequence[str],
             vecs.append(Vec(np.asarray(vals, np.float32), T_NUM))
     fr = Frame(names, vecs,
                key=dest or os.path.basename(paths[0]))
-    log.info("parsed parquet %s: %d rows, %d cols", paths, fr.nrows,
+    log.info("parsed %s %s: %d rows, %d cols", fmt, paths, fr.nrows,
              fr.ncols)
     return fr
 
@@ -622,11 +646,26 @@ def parse_svmlight(path: str, dest: Optional[str] = None) -> Frame:
                 kv[int(k)] = float(v)
                 max_idx = max(max_idx, int(k))
             rows.append(kv)
-    dense = np.zeros((len(rows), max_idx + 1), np.float32)
+    n = len(rows)
+    ncols = max_idx + 1
+    # per-column sparse (row, value) pairs — kept in the SparseVec codec
+    # (CXIChunk analog) when the column is mostly default-zero, so wide
+    # sparse data never materializes dense host/HBM copies up front
+    col_rows: list = [[] for _ in range(ncols)]
+    col_vals: list = [[] for _ in range(ncols)]
     for i, kv in enumerate(rows):
         for k, v in kv.items():
-            dense[i, k] = v
-    names = ["target"] + [f"C{j+1}" for j in range(max_idx + 1)]
-    vecs = [Vec(np.asarray(targets, np.float32))] + [
-        Vec(dense[:, j]) for j in range(max_idx + 1)]
+            col_rows[k].append(i)
+            col_vals[k].append(v)
+    from h2o_tpu.core.frame import SparseVec
+    names = ["target"] + [f"C{j+1}" for j in range(ncols)]
+    vecs = [Vec(np.asarray(targets, np.float32))]
+    for j in range(ncols):
+        nnz = len(col_rows[j])
+        if nnz < 0.5 * n:
+            vecs.append(SparseVec(col_rows[j], col_vals[j], n))
+        else:
+            dense = np.zeros(n, np.float32)
+            dense[col_rows[j]] = col_vals[j]
+            vecs.append(Vec(dense))
     return Frame(names, vecs, key=dest or os.path.basename(path))

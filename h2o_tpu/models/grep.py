@@ -1,0 +1,86 @@
+"""Grep — regex search over raw text as a model builder.
+
+Reference: hex/grep/Grep.java (+ GrepModel.java:21-22 `_matches/_offsets`)
+— an Experimental builder that runs a regex over a raw-text ByteVec and
+produces a trivial model holding the matches and their byte offsets.
+
+TPU-native note: regex scanning is host-side string work (SURVEY §7
+"strings stay host-side"); the value of keeping it a ModelBuilder is API
+parity — REST /3/ModelBuilders/grep, Jobs, and the model registry all
+work unchanged.  Accepts either a raw imported/uploaded file key or a
+1-string-column Frame.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional
+
+from h2o_tpu.core.frame import Frame
+from h2o_tpu.models import metrics as mm
+from h2o_tpu.models.model import Model, ModelBuilder
+
+
+class GrepModel(Model):
+    algo = "grep"
+    supervised = False
+
+    def predict_raw(self, frame: Frame):
+        raise NotImplementedError("Grep models report matches; they do "
+                                  "not score rows (GrepModel.score0 "
+                                  "throws in the reference too)")
+
+    def model_metrics(self, frame: Frame = None):
+        return mm.ModelMetrics("grep", dict(
+            n_matches=len(self.output.get("matches", []))))
+
+
+class Grep(ModelBuilder):
+    algo = "grep"
+    model_cls = GrepModel
+    supervised = False
+    supports_cv = False
+
+    def default_params(self) -> Dict:
+        p = super().default_params()
+        p.update(regex=None)
+        return p
+
+    def _text_of(self, train) -> str:
+        if isinstance(train, Frame):
+            col = next((v for v in train.vecs
+                        if v.host_data is not None), None)
+            if col is None or train.ncols != 1:
+                raise ValueError("Grep wants exactly 1 raw-text column "
+                                 "(reference: a single ByteVec)")
+            return "\n".join("" if s is None else str(s)
+                             for s in col.host_data)
+        path = str(train)
+        if os.path.exists(path):
+            with open(path, "r", errors="replace") as f:
+                return f.read()
+        raise ValueError(f"no text source at {train!r}")
+
+    def _fit(self, job, x, y, train, valid: Optional[Frame]):
+        p = self.params
+        if not p.get("regex"):
+            raise ValueError("regex is missing")
+        try:
+            pattern = re.compile(str(p["regex"]))
+        except re.error as e:
+            raise ValueError(f"bad regex: {e}")
+        text = self._text_of(train)
+        matches: List[str] = []
+        offsets: List[int] = []
+        n = max(len(text), 1)
+        for i, m in enumerate(pattern.finditer(text)):
+            matches.append(m.group(0))
+            offsets.append(m.start())
+            if i % 4096 == 0:
+                job.update(m.start() / n, f"{len(matches)} matches")
+        out = dict(matches=matches, offsets=offsets,
+                   model_category="Unknown")
+        model = self.model_cls(self.model_id, dict(p), out)
+        model.output["training_metrics"] = model.model_metrics()
+        return model

@@ -102,10 +102,18 @@ class GridSearch:
     def __init__(self, builder_cls, hyper_params: Dict[str, Sequence],
                  search_criteria: Optional[Dict] = None,
                  grid_id: Optional[str] = None,
-                 recovery_dir: Optional[str] = None, **base_params):
+                 recovery_dir: Optional[str] = None,
+                 parallelism: int = 1, **base_params):
         if isinstance(builder_cls, str):
             from h2o_tpu.models.registry import builder_class
             builder_cls = builder_class(builder_cls)
+        # parallel model building (hex/grid ParallelModelBuilder.java):
+        # up to `parallelism` builders run concurrently per batch; stop
+        # criteria are evaluated at batch boundaries.  0 = adaptive
+        # (reference: #cores) -> host CPU count.
+        import os as _os
+        p = int(parallelism if parallelism is not None else 1)
+        self.parallelism = (_os.cpu_count() or 4) if p == 0 else max(p, 1)
         self.builder_cls = builder_cls
         self.hyper_params = {k: list(v) for k, v in hyper_params.items()}
         sc = dict(search_criteria or {})
@@ -185,12 +193,10 @@ class GridSearch:
         metric = None
         maximize = False
 
-        for i, combo in enumerate(combos):
-            if max_models and len(grid.models) >= max_models:
-                break
-            if max_rt and time.time() - t0 > max_rt:
-                log.info("grid %s: max_runtime_secs reached", self.grid_id)
-                break
+        import threading
+        append_lock = threading.Lock()
+
+        def train_one(combo):
             params = dict(self.base_params)
             params.update(combo)
             try:
@@ -200,16 +206,23 @@ class GridSearch:
                 # hyper_values first: the grid is DKV-published mid-run and
                 # _grid_json indexes hyper_values[models.index(m)] — a
                 # concurrent poll must never see models longer than values
-                grid.hyper_values.append(dict(combo))
-                grid.models.append(m)
+                with append_lock:
+                    grid.hyper_values.append(dict(combo))
+                    grid.models.append(m)
                 cloud().dkv.put(m.key, m)
                 if rec is not None:
                     rec.model_done(m)
+                return m
             except Exception as e:  # noqa: BLE001 — grid collects failures
                 log.warning("grid model failed (%s): %s", combo, e)
-                grid.failures.append({"params": dict(combo),
-                                      "error": repr(e)})
-                continue
+                with append_lock:
+                    grid.failures.append({"params": dict(combo),
+                                          "error": repr(e)})
+                return None
+
+        def note_trained(m) -> bool:
+            """Update best-so-far; True => early-stop the search."""
+            nonlocal metric, maximize
             if metric is None:
                 kind = _model_kind(m)
                 metric = resolve_stopping_metric(
@@ -229,10 +242,46 @@ class GridSearch:
                 if rel < tol:
                     log.info("grid %s: early stop after %d models",
                              self.grid_id, len(grid.models))
+                    return True
+            return False
+
+        # parallel model building (ParallelModelBuilder.java): batches of
+        # `parallelism` concurrent builders; stop criteria at batch ends
+        # (sequential == batch size 1, identical semantics)
+        P = self.parallelism
+        i = 0
+        stop = False
+        from concurrent.futures import ThreadPoolExecutor
+        pool = ThreadPoolExecutor(max_workers=P) if P > 1 else None
+        try:
+            while i < len(combos) and not stop:
+                if max_models and len(grid.models) >= max_models:
                     break
-            job.update((i + 1) / max(len(combos), 1),
-                       f"{len(grid.models)} models, best {metric}="
-                       f"{best:.5g}")
+                if max_rt and time.time() - t0 > max_rt:
+                    log.info("grid %s: max_runtime_secs reached",
+                             self.grid_id)
+                    break
+                n = 1 if P == 1 else min(
+                    P, len(combos) - i,
+                    (max_models - len(grid.models)) if max_models
+                    else len(combos))
+                batch = combos[i: i + n]
+                i += n
+                if pool is None:
+                    trained = [train_one(batch[0])]
+                else:
+                    trained = list(pool.map(train_one, batch))
+                for m in trained:
+                    if m is not None and note_trained(m):
+                        stop = True
+                        break
+                best = best_so_far[-1] if best_so_far else float("nan")
+                job.update(i / max(len(combos), 1),
+                           f"{len(grid.models)} models, best "
+                           f"{metric}={best:.5g}")
+        finally:
+            if pool is not None:
+                pool.shutdown(wait=True)
         cloud().dkv.put(grid.key, grid)
         if rec is not None:
             rec.done()

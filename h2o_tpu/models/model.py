@@ -247,14 +247,35 @@ class Model:
         return rows
 
     # -- persistence (binary save/load; MOJO-style export in io.py) --------
+    #
+    # Versioned envelope (the TypeMap/Icer-version analog, reference
+    # water/AutoBuffer.java + Weaver serialization ids): a magic tag +
+    # format version + JSON descriptor precede the payload, so readers
+    # reject incompatible or foreign files instead of unpickling them
+    # blind.  Like the reference's binary models, the payload itself is
+    # a trusted same-framework artifact (h2o.load_model docs carry the
+    # same caveat for Iced blobs).
+
+    BIN_MAGIC = b"H2OTPUBIN\x00"
+    BIN_VERSION = 1
 
     def save(self, path: str) -> str:
+        import json as _json
+        from h2o_tpu import __version__
         blob = {"algo": self.algo, "key": str(self.key),
                 "params": self.params,
                 "output": jax.tree.map(
                     lambda v: np.asarray(v) if isinstance(v, jax.Array)
                     else v, self.output)}
+        desc = _json.dumps({"format_version": self.BIN_VERSION,
+                            "framework": "h2o-tpu",
+                            "framework_version": __version__,
+                            "algo": self.algo}).encode()
         with open(path, "wb") as f:
+            f.write(self.BIN_MAGIC)
+            f.write(self.BIN_VERSION.to_bytes(2, "little"))
+            f.write(len(desc).to_bytes(4, "little"))
+            f.write(desc)
             pickle.dump(blob, f)
         return path
 
@@ -262,7 +283,21 @@ class Model:
     def load(path: str) -> "Model":
         from h2o_tpu.models.registry import model_class
         with open(path, "rb") as f:
-            blob = pickle.load(f)
+            head = f.read(len(Model.BIN_MAGIC))
+            if head == Model.BIN_MAGIC:
+                version = int.from_bytes(f.read(2), "little")
+                if version > Model.BIN_VERSION:
+                    raise ValueError(
+                        f"model file {path} has format version {version}; "
+                        f"this build reads <= {Model.BIN_VERSION} — "
+                        "upgrade h2o-tpu to load it")
+                dlen = int.from_bytes(f.read(4), "little")
+                f.read(dlen)                      # JSON descriptor
+                blob = pickle.load(f)
+            else:
+                # legacy pre-versioning artifact (round <= 2): plain pickle
+                f.seek(0)
+                blob = pickle.load(f)
         cls = model_class(blob["algo"])
         m = cls.__new__(cls)
         Model.__init__(m, blob["key"], blob["params"], blob["output"])

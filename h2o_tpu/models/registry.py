@@ -41,6 +41,7 @@ _ALGOS = [
     ("infogram", "h2o_tpu.models.infogram", "Infogram"),
     ("generic", "h2o_tpu.models.generic", "Generic"),
     ("stackedensemble", "h2o_tpu.models.ensemble", "StackedEnsemble"),
+    ("grep", "h2o_tpu.models.grep", "Grep"),
 ]
 
 _cache: Dict[str, type] = {}

@@ -88,8 +88,40 @@ class GBM(ModelBuilder):
                  score_each_iteration=False, score_tree_interval=0,
                  stopping_rounds=0, stopping_metric="AUTO",
                  stopping_tolerance=1e-3, build_tree_one_node=False,
-                 calibrate_model=False, bf16_histograms=False)
+                 calibrate_model=False, bf16_histograms=False,
+                 monotone_constraints=None)
         return p
+
+    @staticmethod
+    def _mono_array(p, di):
+        """monotone_constraints {'col': ±1} -> (C,) int array (reference
+        hex/tree monotone handling; only numeric columns constrainable).
+        Returns None when unconstrained."""
+        mc = p.get("monotone_constraints")
+        if not mc:
+            return None
+        if isinstance(mc, str):
+            import json as _json
+            try:
+                mc = _json.loads(mc.replace("'", '"'))
+            except _json.JSONDecodeError:
+                raise ValueError(
+                    f"bad monotone_constraints: {mc!r}")
+        import numpy as _np
+        mono = _np.zeros(len(di.x), _np.int32)
+        for name, d in dict(mc).items():
+            if name not in di.x:
+                raise ValueError(f"monotone_constraints column {name!r} "
+                                 "is not a predictor")
+            if name in di.cat_names:
+                raise ValueError(f"monotone_constraints on categorical "
+                                 f"column {name!r} is not supported")
+            d = int(d)
+            if d not in (-1, 0, 1):
+                raise ValueError(f"monotone_constraints[{name!r}]={d}; "
+                                 "must be -1, 0 or 1")
+            mono[di.x.index(name)] = d
+        return mono if mono.any() else None
 
     def _fit(self, job, x, y, train: Frame, valid: Optional[Frame]):
         p = self.params
@@ -223,6 +255,10 @@ class GBM(ModelBuilder):
             col_sample_rate_per_tree=float(
                 p.get("col_sample_rate_per_tree") or 1.0),
             huber_alpha=float(p["huber_alpha"]))
+        mono = self._mono_array(p, di)
+        if mono is not None:
+            train_kwargs["mono"] = jnp.asarray(mono)
+            train_kwargs["use_mono"] = True
         kind = "binomial" if nclass == 2 else (
             "multinomial" if nclass > 2 else "regression")
         from h2o_tpu.models.tree.driver import (IncrementalScorer,
@@ -265,6 +301,10 @@ class GBM(ModelBuilder):
         model = run_tree_driver(job, p, train_kwargs, F, self.rng_key(),
                                 make_model, scorer, kind,
                                 prior_trees=prior)
+        if p.get("_skip_final_metrics"):
+            # per-tree inner fits (DART driver) discard these; the outer
+            # loop scores the final concatenated forest once
+            return model
         model.output["training_metrics"] = model.model_metrics(train)
         if valid is not None:
             model.output["validation_metrics"] = model.model_metrics(valid)

@@ -71,7 +71,7 @@ def _node_val(wg, wh, w, newton: bool, reg_lambda: float = 0.0):
 
 
 def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
-                      tree_col_mask=None):
+                      tree_col_mask=None, mono=None):
     """Traceable single-tree build.  Returns (split_col, bitset, value,
     varimp), shapes (H,), (H, B+1), (H,), (C,) with H = 2^(D+1)-1.
     varimp accumulates each split's SE-reduction gain into its column —
@@ -91,6 +91,11 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
     varimp = jnp.zeros((C,), jnp.float32)
     node_gain = jnp.zeros((H,), jnp.float32)   # per-split SE reduction
     leaf = leaf0
+    use_mono = bool(cfg.get("use_mono")) and mono is not None
+    # monotone value bounds per live leaf (XGBoost-style two-part scheme:
+    # find_splits rejects violating splits, these clamp child values)
+    lo_b = jnp.full((1,), -jnp.inf, jnp.float32)
+    hi_b = jnp.full((1,), jnp.inf, jnp.float32)
 
     for d in range(D):                       # static unroll — exact L per level
         L = 2 ** d
@@ -108,7 +113,9 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
             col_allowed = col_allowed & tree_col_mask[None, :]
         s = find_splits(hist, is_cat, col_allowed,
                         min_rows=cfg["min_rows"],
-                        min_split_improvement=cfg["min_split_improvement"])
+                        min_split_improvement=cfg["min_split_improvement"],
+                        mono=mono, use_mono=use_mono, newton=newton,
+                        reg_lambda=reg_lambda)
         live = s["leaf"]["w"] > 0
         do_split = s["do_split"] & live
         term = live & ~do_split
@@ -118,6 +125,18 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
                           s["left"]["w"], newton, reg_lambda)
         rvals = _node_val(s["right"]["wg"], s["right"]["wh"],
                           s["right"]["w"], newton, reg_lambda)
+        if use_mono:
+            leaf_vals = jnp.clip(leaf_vals, lo_b, hi_b)
+            lvals = jnp.clip(lvals, lo_b, hi_b)
+            rvals = jnp.clip(rvals, lo_b, hi_b)
+            m = mono[s["col"]].astype(jnp.float32)         # (L,)
+            mid = 0.5 * (lvals + rvals)
+            l_hi = jnp.where(m > 0, jnp.minimum(hi_b, mid), hi_b)
+            r_lo = jnp.where(m > 0, jnp.maximum(lo_b, mid), lo_b)
+            l_lo = jnp.where(m < 0, jnp.maximum(lo_b, mid), lo_b)
+            r_hi = jnp.where(m < 0, jnp.minimum(hi_b, mid), hi_b)
+            lo_b = jnp.stack([l_lo, r_lo], axis=1).reshape(2 * L)
+            hi_b = jnp.stack([l_hi, r_hi], axis=1).reshape(2 * L)
 
         varimp = varimp.at[s["col"]].add(
             jnp.where(do_split, jnp.maximum(s["gain"], 0.0), 0.0))
@@ -183,7 +202,7 @@ class TrainedForest(NamedTuple):
                      "min_split_improvement", "block_rows", "bf16",
                      "mode", "tweedie_power", "quantile_alpha",
                      "huber_alpha", "reg_lambda",
-                     "col_sample_rate_per_tree"))
+                     "col_sample_rate_per_tree", "use_mono"))
 def train_forest(bins, yv, w, active, F0, is_cat, key, *, dist_name: str,
                  K: int, ntrees: int, max_depth: int, nbins: int,
                  k_cols: int, newton: bool, sample_rate: float,
@@ -194,6 +213,7 @@ def train_forest(bins, yv, w, active, F0, is_cat, key, *, dist_name: str,
                  quantile_alpha: float = 0.5,
                  huber_alpha: float = 0.9, reg_lambda: float = 0.0,
                  col_sample_rate_per_tree: float = 1.0,
+                 mono=None, use_mono: bool = False,
                  t0: int = 0) -> TrainedForest:
     """The WHOLE forest training loop as one XLA program.
 
@@ -205,7 +225,8 @@ def train_forest(bins, yv, w, active, F0, is_cat, key, *, dist_name: str,
     cfg = dict(max_depth=max_depth, nbins=nbins, k_cols=k_cols,
                newton=newton, min_rows=min_rows,
                min_split_improvement=min_split_improvement,
-               block_rows=block_rows, bf16=bf16, reg_lambda=reg_lambda)
+               block_rows=block_rows, bf16=bf16, reg_lambda=reg_lambda,
+               use_mono=use_mono)
     R = bins.shape[0]
 
     def stats_for(kcls, F):
@@ -255,7 +276,8 @@ def train_forest(bins, yv, w, active, F0, is_cat, key, *, dist_name: str,
             kc, kk = jax.random.split(kc)
             stats = stats_for(kcls, F)
             sc, bs, vl, vi, gn = build_tree_traced(bins, stats, leaf0, kk,
-                                                   is_cat, cfg, tree_cols)
+                                                   is_cat, cfg, tree_cols,
+                                                   mono=mono)
             vl = vl * scale
             scs.append(sc)
             bss.append(bs)

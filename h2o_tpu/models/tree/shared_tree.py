@@ -112,14 +112,24 @@ def _bin_all(matrix, split_points, is_cat, nbins: int):
 # split finding
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("min_rows",))
+@functools.partial(jax.jit, static_argnames=("min_rows", "use_mono",
+                                             "newton", "reg_lambda"))
 def find_splits(hist, is_cat, col_allowed, min_rows: float = 10.0,
-                min_split_improvement: float = 1e-5):
+                min_split_improvement: float = 1e-5, mono=None,
+                use_mono: bool = False, newton: bool = False,
+                reg_lambda: float = 0.0):
     """Best split per leaf from (L, C, B+1, 4) histograms.
 
     Returns per-leaf: do_split, col, bitset (B+1 left-membership incl NA
     bit), left/right Newton stats (wg, wh, w) for child values, and the
     leaf's own (wg, wh, w) for terminal values.
+
+    ``mono`` ((C,) int, ±1/0) + ``use_mono`` enable monotone constraints
+    (reference hex/tree/DTree.java:984 findBestSplitPoint monotone
+    handling): candidate splits whose child values violate the declared
+    direction are rejected; the builder additionally clamps child values
+    to parent bounds (the XGBoost two-part scheme this engine's
+    force_newton path matches).
     """
     L, C, B1, _ = hist.shape
     B = B1 - 1
@@ -155,11 +165,24 @@ def find_splits(hist, is_cat, col_allowed, min_rows: float = 10.0,
         lw = cw + (naw[..., None] if na_left else 0.0)
         lwg = cwg + (nawg[..., None] if na_left else 0.0)
         lwgg = cwgg + (nawgg[..., None] if na_left else 0.0)
+        lwh = cwh + (nawh[..., None] if na_left else 0.0)
         rw = tot_w[..., None] - lw
         rwg = tot_wg[..., None] - lwg
         rwgg = tot_wgg[..., None] - lwgg
+        rwh = tot_wh[..., None] - lwh
         gain = se_parent[..., None] - se(lw, lwg, lwgg) - se(rw, rwg, rwgg)
         ok = (lw >= min_rows) & (rw >= min_rows)
+        if use_mono:
+            # reject splits whose child values violate the declared
+            # direction (increasing: right >= left)
+            if newton:
+                lv = lwg / jnp.maximum(lwh + reg_lambda, EPS)
+                rv = rwg / jnp.maximum(rwh + reg_lambda, EPS)
+            else:
+                lv = lwg / jnp.maximum(lw, EPS)
+                rv = rwg / jnp.maximum(rw, EPS)
+            m = mono[None, :, None].astype(jnp.float32)
+            ok = ok & ((m == 0) | (m * (rv - lv) >= 0))
         return jnp.where(ok, gain, -jnp.inf)
 
     gains = jnp.stack([side_gain(False), side_gain(True)], axis=-1)

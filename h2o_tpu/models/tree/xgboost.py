@@ -12,13 +12,24 @@ row-shard psum replaces Rabit's ring allreduce.  XGBoost naming is mapped
 onto the engine (eta→learn_rate, subsample→sample_rate, colsample_bytree→
 col_sample_rate_per_tree, min_child_weight→min_rows, max_bins→nbins);
 ``reg_lambda`` enters the Newton leaf denominator; ``min_split_loss``
-(gamma) maps to the split-improvement threshold.  ``booster=dart/gblinear``
-and monotone constraints are not implemented (tracked follow-ups).
+(gamma) maps to the split-improvement threshold.
+
+Booster coverage:
+- ``gbtree``   — the fused engine (default);
+- ``dart``     — host-driven per-tree loop with tree dropout
+  (rate_drop/skip_drop; "tree" sample_type, "tree" normalize_type) —
+  inherently sequential, so each tree is one engine dispatch;
+- ``gblinear`` — delegates to the GLM elastic-net path (reg_alpha/
+  reg_lambda map onto alpha/lambda), scored as a linear model.
+``monotone_constraints`` flow into the split finder + child-value
+clamping (shared_tree.find_splits / jit_engine monotone bounds).
 """
 
 from __future__ import annotations
 
 from typing import Dict, Optional
+
+import numpy as np
 
 from h2o_tpu.core.frame import Frame
 from h2o_tpu.models.tree.gbm import GBM, GBMModel
@@ -49,7 +60,21 @@ _XGB_DEFAULTS = dict(
     colsample_bylevel=1.0, min_child_weight=1.0, max_bins=256,
     reg_lambda=1.0, reg_alpha=0.0, min_split_loss=0.0,
     tree_method="hist", booster="gbtree", grow_policy="depthwise",
-    backend="auto", force_newton=True)
+    backend="auto", force_newton=True,
+    rate_drop=0.0, skip_drop=0.0, sample_type="uniform",
+    normalize_type="tree")
+
+
+class XGBoostLinearModel(XGBoostModel):
+    """booster=gblinear result: scored via the GLM linear predictor."""
+
+    def predict_raw(self, frame: Frame):
+        from h2o_tpu.models.glm import GLMModel
+        return GLMModel.predict_raw(self, frame)
+
+    def model_metrics(self, frame: Frame = None):
+        from h2o_tpu.models.glm import GLMModel
+        return GLMModel.model_metrics(self, frame)
 
 
 class XGBoost(GBM):
@@ -58,10 +83,11 @@ class XGBoost(GBM):
 
     ENGINE_FIXED = {
         **GBM.ENGINE_FIXED,
-        "reg_alpha": (0.0,),              # L1 leaf reg not implemented
         "tree_method": ("auto", "hist"),  # this engine IS hist
         "grow_policy": ("depthwise",),
-        "booster": ("gbtree",),
+        "booster": ("gbtree", "dart", "gblinear"),
+        "sample_type": ("uniform",),
+        "normalize_type": ("tree",),
     }
 
     def default_params(self) -> Dict:
@@ -81,11 +107,155 @@ class XGBoost(GBM):
             if xgb_name in params and xgb_name != engine_name:
                 self.params[engine_name] = params[xgb_name]
         booster = self.params.get("booster", "gbtree")
-        if booster not in ("gbtree",):
-            raise ValueError(f"booster='{booster}' not supported "
-                             "(gbtree only)")
+        if booster != "gblinear" and float(
+                self.params.get("reg_alpha") or 0.0) != 0.0:
+            raise ValueError(
+                "reg_alpha (L1 leaf regularization) is only honored by "
+                "booster='gblinear' on this engine; refusing to train "
+                "with a silently-ignored setting")
 
     def _fit(self, job, x, y, train: Frame, valid: Optional[Frame]):
-        # reg_lambda flows into the Newton denominator via the engine's
-        # reg_lambda kwarg (jit_engine._node_val)
+        booster = self.params.get("booster", "gbtree")
+        if booster == "gblinear":
+            return self._fit_gblinear(job, x, y, train, valid)
+        if booster == "dart":
+            return self._fit_dart(job, x, y, train, valid)
+        # gbtree: reg_lambda flows into the Newton denominator via the
+        # engine's reg_lambda kwarg (jit_engine._node_val)
         return super()._fit(job, x, y, train, valid)
+
+    # -- booster=gblinear --------------------------------------------------
+
+    def _fit_gblinear(self, job, x, y, train, valid):
+        """XGBoost gblinear == elastic-net linear model; delegate to the
+        GLM coordinate-descent path (reg_alpha -> L1, reg_lambda -> L2;
+        alpha = a/(a+l), lambda = (a+l)/n in GLM's per-row convention)."""
+        from h2o_tpu.models.glm import GLM
+        a = float(self.params.get("reg_alpha") or 0.0)
+        l2 = float(self.params.get("reg_lambda") or 0.0)
+        tot = a + l2
+        fam = "binomial" if train.vec(y).is_categorical and \
+            len(train.vec(y).domain or []) == 2 else \
+            ("multinomial" if train.vec(y).is_categorical else "gaussian")
+        g = GLM(family=fam,
+                alpha=(a / tot) if tot > 0 else 0.0,
+                lambda_=tot / max(train.nrows, 1),
+                seed=self.params.get("seed", -1))
+        g.model_id = self.model_id
+        g.model_cls = XGBoostLinearModel
+        m = g._fit(job, x, y, train, valid)
+        m.params.update(booster="gblinear",
+                        reg_alpha=a, reg_lambda=l2)
+        return m
+
+    # -- booster=dart ------------------------------------------------------
+
+    def _fit_dart(self, job, x, y, train, valid):
+        """DART (Dropouts meet Multiple Additive Regression Trees): each
+        iteration drops a random subset of prior trees, fits the new tree
+        against the remaining ensemble, and rescales (normalize_type=
+        "tree": new tree 1/(k+1), dropped trees k/(k+1)).
+
+        Sequential by construction, so each tree is one GBM._fit call with
+        the running (minus-dropped) ensemble injected through the engine's
+        existing offset-column path — F0 = f0 + offset is exactly the DART
+        "score without the dropped trees" state.  f0 depends only on
+        (y, w, distribution) so every per-tree model shares it and the
+        final concatenated forest scores as f0 + sum of rescaled trees.
+        """
+        import jax.numpy as jnp
+        from h2o_tpu.core.frame import Frame as _Frame, Vec as _Vec
+        from h2o_tpu.models.tree import shared_tree as st
+
+        yv = train.vec(y)
+        if yv.is_categorical and len(yv.domain or []) > 2:
+            raise ValueError(
+                "booster='dart' supports regression/binomial on this "
+                "engine (multinomial K>1 has no offset path); use "
+                "booster='gbtree' for multinomial")
+        if self.params.get("offset_column"):
+            raise ValueError("booster='dart' uses the offset path "
+                             "internally; offset_column is unsupported")
+        if self.params.get("checkpoint"):
+            raise ValueError("booster='dart' does not support checkpoint "
+                             "resume (per-tree weights are rescaled "
+                             "during training)")
+        p_all = dict(self.params)
+        ntrees = int(p_all["ntrees"])
+        rate_drop = float(p_all.get("rate_drop") or 0.0)
+        skip_drop = float(p_all.get("skip_drop") or 0.0)
+        seed = int(p_all.get("seed") or -1)
+        rng = np.random.default_rng(seed if seed >= 0 else None)
+
+        x_cols = [c for c in (x or train.names)
+                  if c != y and c != "__dart_offset__"]
+        R = train.nrows
+        scs, bss, vls, preds = [], [], [], []
+        scale: list = []
+        base_out = None
+        bins = None
+        self.params["ntrees"] = 1
+        self.params["score_tree_interval"] = 0
+        self.params["stopping_rounds"] = 0
+        # inner fits skip their (discarded) full-frame scoring pass; the
+        # final concatenated forest is scored once below
+        self.params["_skip_final_metrics"] = True
+        try:
+            for t in range(ntrees):
+                k_idx = np.array([], np.int64)
+                if t > 0 and rate_drop > 0 and rng.uniform() >= skip_drop:
+                    k_idx = np.flatnonzero(
+                        rng.uniform(size=t) < rate_drop)
+                keep = [i for i in range(t) if i not in set(k_idx)]
+                off = np.zeros(R, np.float32)
+                for i in keep:
+                    off += preds[i] * np.float32(scale[i])
+                work = _Frame(list(train.names) + ["__dart_offset__"],
+                              list(train.vecs) + [_Vec(off)])
+                self.params["offset_column"] = "__dart_offset__"
+                m = super()._fit(job, x_cols, y, work, None)
+                sc = np.asarray(m.output["split_col"])   # (1, K, H)
+                bs = np.asarray(m.output["bitset"])
+                vl = np.asarray(m.output["value"])
+                if base_out is None:
+                    base_out = m.output
+                    bins = st._bin_all(
+                        train.as_matrix(m.output["x"]),
+                        jnp.asarray(m.output["split_points"]),
+                        jnp.asarray(m.output["is_cat"]),
+                        int(m.output["nbins"]))
+                Fnew = np.asarray(st.forest_score(
+                    bins, jnp.asarray(sc), jnp.asarray(bs),
+                    jnp.asarray(vl),
+                    int(m.output["max_depth"])))[: R, 0]
+                k = len(k_idx)
+                if k:
+                    # normalize_type="tree": new tree 1/(k+1); dropped
+                    # trees shrink to k/(k+1) of their current weight
+                    vl = vl / (k + 1)
+                    Fnew = Fnew / (k + 1)
+                    for i in k_idx:
+                        scale[i] *= k / (k + 1)
+                scs.append(sc)
+                bss.append(bs)
+                vls.append(vl)
+                preds.append(Fnew)
+                scale.append(1.0)
+                job.update(0.05 + 0.9 * (t + 1) / ntrees,
+                           f"dart tree {t + 1}/{ntrees} "
+                           f"(dropped {k})")
+        finally:
+            self.params = p_all
+        out = dict(base_out)
+        out["split_col"] = np.concatenate(scs)
+        out["bitset"] = np.concatenate(bss)
+        out["value"] = np.concatenate(
+            [v * np.float32(s) for v, s in zip(vls, scale)])
+        out["node_gain"] = None
+        out["ntrees_actual"] = ntrees
+        model = self.model_cls(self.model_id, dict(p_all), out)
+        model.params["response_column"] = y
+        model.output["training_metrics"] = model.model_metrics(train)
+        if valid is not None:
+            model.output["validation_metrics"] = model.model_metrics(valid)
+        return model

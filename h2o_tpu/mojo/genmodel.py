@@ -375,11 +375,116 @@ def write_glm_mojo(model) -> bytes:
     return w.finish(columns, domains)
 
 
+def write_kmeans_mojo(model) -> bytes:
+    """KMeans -> genmodel MOJO (KMeansMojoWriter key set: standardize +
+    standardize_means/mults + center_num/center_i).
+
+    The genmodel layout keeps centers in ORIGINAL column space with
+    per-column standardization; categorical clustering centers have no
+    faithful representation there for our one-hot training path, so
+    export is numeric-columns-only (fail loudly otherwise)."""
+    out = model.output
+    spec = out["expansion_spec"]
+    if spec["cat_names"]:
+        raise NotImplementedError(
+            "KMeans MOJO export supports numeric predictors only (the "
+            "genmodel layout cannot carry one-hot cluster centers)")
+    num_names = list(spec["num_names"])
+    centers_std = np.asarray(out["centers_std"], np.float64)
+    means = np.asarray(spec["means"], np.float64)
+    sigmas = np.where(np.asarray(spec["sigmas"], np.float64) == 0, 1.0,
+                      np.asarray(spec["sigmas"], np.float64))
+    standardize = bool(spec["standardize"])
+    w = _ZipWriter()
+    _common_info(w, "kmeans", "K-means", "Clustering", str(model.key),
+                 False, len(num_names), 1, len(num_names), 0, "1.00")
+    w.writekv("standardize", standardize)
+    if standardize:
+        w.writekv("standardize_means", [float(m) for m in means])
+        w.writekv("standardize_mults", [float(1.0 / s) for s in sigmas])
+        w.writekv("standardize_modes", [0] * 0)
+    w.writekv("center_num", centers_std.shape[0])
+    for i in range(centers_std.shape[0]):
+        w.writekv(f"center_{i}", [float(v) for v in centers_std[i]])
+    return w.finish(num_names, [None] * len(num_names))
+
+
+def write_deeplearning_mojo(model) -> bytes:
+    """DeepLearning MLP -> genmodel MOJO (DeepLearningMojoWriter key set:
+    nums/cats/cat_offsets/norm_mul/norm_sub, neural_network_sizes,
+    weight_layer{i}/bias_layer{i} row-major, activation).
+
+    Weights are stored transposed relative to our (in, out) layout —
+    genmodel's DenseRowMatrix is (units[i+1] x units[i]) row-major."""
+    out = model.output
+    if out.get("autoencoder"):
+        raise NotImplementedError("autoencoder MOJO export (the anomaly "
+                                  "scorer is served by the binary model)")
+    spec = out["expansion_spec"]
+    cat_names = list(spec["cat_names"])
+    num_names = list(spec["num_names"])
+    cards = list(spec["cat_cards"])
+    uafl = bool(spec["use_all_factor_levels"])
+    means = np.asarray(spec["means"], np.float64)
+    sigmas = np.where(np.asarray(spec["sigmas"], np.float64) == 0, 1.0,
+                      np.asarray(spec["sigmas"], np.float64))
+    weights = out["weights"]
+    units = [int(weights[0]["W"].shape[0])] + \
+        [int(l["W"].shape[1]) for l in weights]
+    resp_dom = out.get("response_domain")
+    nclass = len(resp_dom) if resp_dom else 1
+    cat_offsets = [0]
+    for c in cards:
+        cat_offsets.append(cat_offsets[-1] + (c - (0 if uafl else 1)))
+    resp_name = model.params.get("response_column") or "response"
+    x = cat_names + num_names
+    columns = x + [resp_name]
+    cat_domains = list(spec.get("cat_domains") or [])
+    domains: List[Optional[List[str]]] = \
+        [(cat_domains[j] if j < len(cat_domains) else
+          [str(i) for i in range(cards[j])]) for j in range(len(cat_names))]
+    domains += [None] * len(num_names)
+    domains.append(list(resp_dom) if resp_dom else None)
+
+    w = _ZipWriter()
+    _common_info(w, "deeplearning", "Deep Learning",
+                 "Binomial" if nclass == 2 else
+                 ("Multinomial" if nclass > 2 else "Regression"),
+                 str(model.key), True, len(x), nclass, len(columns),
+                 sum(d is not None for d in domains), "1.10")
+    w.writekv("mini_batch_size", 1)
+    w.writekv("nums", len(num_names))
+    w.writekv("cats", len(cat_names))
+    w.writekv("cat_offsets", cat_offsets)
+    if spec["standardize"] and num_names:
+        w.writekv("norm_mul", [float(1.0 / s) for s in sigmas])
+        w.writekv("norm_sub", [float(m) for m in means])
+    w.writekv("use_all_factor_levels", uafl)
+    w.writekv("activation", out.get("activation", "Rectifier"))
+    w.writekv("distribution", out.get("distribution_resolved", "AUTO"))
+    w.writekv("mean_imputation", True)
+    w.writekv("cat_modes", [0] * len(cat_names))
+    w.writekv("neural_network_sizes", units)
+    for i, layer in enumerate(weights):
+        W = np.asarray(layer["W"], np.float64)          # (in, out)
+        b = np.asarray(layer["b"], np.float64)
+        w.writekv(f"weight_layer{i}",
+                  [float(v) for v in W.T.reshape(-1)])  # row-major out×in
+        w.writekv(f"bias_layer{i}", [float(v) for v in b])
+    w.writekv("hidden_dropout_ratios",
+              [0.0] * (len(units) - 2))
+    return w.finish(columns, domains)
+
+
 def write_genmodel_mojo(model) -> bytes:
     if model.algo in ("gbm", "drf"):
         return write_tree_mojo(model)
     if model.algo == "glm":
         return write_glm_mojo(model)
+    if model.algo == "kmeans":
+        return write_kmeans_mojo(model)
+    if model.algo == "deeplearning":
+        return write_deeplearning_mojo(model)
     raise NotImplementedError(
         f"genmodel MOJO export not implemented for '{model.algo}'")
 
@@ -627,6 +732,45 @@ def read_genmodel_mojo(data) -> Dict:
                 link=info.get("link", "identity"),
                 tweedie_link_power=float(
                     info.get("tweedie_link_power", 0.0)))
+        elif algo == "kmeans":
+            def karr(key):
+                v = info.get(key, "[]").strip("[]")
+                return np.asarray([float(s) for s in v.split(",")
+                                   if s.strip()], np.float64)
+            k = int(info.get("center_num", 0))
+            result["kmeans"] = dict(
+                standardize=info.get("standardize", "false") == "true",
+                means=karr("standardize_means"),
+                mults=karr("standardize_mults"),
+                centers=np.stack([karr(f"center_{i}")
+                                  for i in range(k)]) if k else
+                np.zeros((0, 0)))
+        elif algo == "deeplearning":
+            def darr(key):
+                v = info.get(key, "[]").strip("[]")
+                return np.asarray([float(s) for s in v.split(",")
+                                   if s.strip()], np.float64)
+            units = [int(float(s)) for s in
+                     info.get("neural_network_sizes", "[]")
+                     .strip("[]").split(",") if s.strip()]
+            layers = []
+            for i in range(len(units) - 1):
+                Wt = darr(f"weight_layer{i}").reshape(
+                    units[i + 1], units[i])          # row-major out×in
+                layers.append(dict(W=Wt.T, b=darr(f"bias_layer{i}")))
+            result["deeplearning"] = dict(
+                units=units, layers=layers,
+                activation=info.get("activation", "Rectifier"),
+                cats=int(info.get("cats", 0)),
+                nums=int(info.get("nums", 0)),
+                cat_offsets=np.asarray(
+                    [int(float(s)) for s in
+                     info.get("cat_offsets", "[0]").strip("[]")
+                     .split(",") if s.strip()], np.int64),
+                use_all_factor_levels=info.get(
+                    "use_all_factor_levels", "false") == "true",
+                norm_sub=darr("norm_sub"), norm_mul=darr("norm_mul"),
+                distribution=info.get("distribution", "AUTO"))
         else:
             raise NotImplementedError(
                 f"genmodel MOJO import for algo '{algo}'")
@@ -776,4 +920,61 @@ class GenmodelMojoModel:
                 label = (mu >= thr).astype(np.float64)
                 return np.stack([label, 1 - mu, mu], axis=1)
             return mu
+        if p["algo"] == "kmeans":
+            km = p["kmeans"]
+            Xc = X.astype(np.float64).copy()
+            if km["standardize"] and len(km["means"]):
+                Xc = (Xc - km["means"][None, :]) * km["mults"][None, :]
+            Xc = np.nan_to_num(Xc)
+            c = km["centers"]
+            d2 = (Xc * Xc).sum(1, keepdims=True) - 2 * Xc @ c.T + \
+                (c * c).sum(1)[None, :]
+            return np.argmin(d2, axis=1).astype(np.float64)
+        if p["algo"] == "deeplearning":
+            dl = p["deeplearning"]
+            cats, nums = dl["cats"], dl["nums"]
+            offs = dl["cat_offsets"]
+            uafl = dl["use_all_factor_levels"]
+            n_in = dl["units"][0]
+            R = X.shape[0]
+            A = np.zeros((R, n_in))
+            # one-hot expand cats (NA/out-of-range -> all-zero block)
+            for i in range(cats):
+                ival = X[:, i].astype(np.float64)
+                iv = np.where(np.isnan(ival), -1, ival).astype(np.int64)
+                if not uafl:
+                    iv = iv - 1
+                iv = iv + offs[i]
+                ok = (iv >= offs[i]) & (iv < offs[i + 1])
+                rows = np.flatnonzero(ok)
+                A[rows, iv[rows]] = 1.0
+            noff = int(offs[cats]) if cats else 0
+            num_block = X[:, cats: cats + nums].astype(np.float64)
+            if len(dl["norm_sub"]):
+                # mean imputation == 0 in standardized space
+                # (expand_for_scoring's adaptTestForTrain contract)
+                num_block = np.where(np.isnan(num_block),
+                                     dl["norm_sub"][None, :], num_block)
+                num_block = (num_block - dl["norm_sub"][None, :]) * \
+                    dl["norm_mul"][None, :]
+            else:
+                num_block = np.nan_to_num(num_block)
+            A[:, noff: noff + nums] = num_block
+            act = dl["activation"].lower()
+            h = A
+            for li, layer in enumerate(dl["layers"]):
+                h = h @ layer["W"] + layer["b"][None, :]
+                if li < len(dl["layers"]) - 1:
+                    if "tanh" in act:
+                        h = np.tanh(h)
+                    elif "maxout" in act:
+                        h = np.maximum(h, 0.0)   # maxout(k=1) degenerate
+                    else:
+                        h = np.maximum(h, 0.0)   # rectifier
+            if nclass >= 2:
+                e = np.exp(h - h.max(axis=1, keepdims=True))
+                P = e / e.sum(axis=1, keepdims=True)
+                label = np.argmax(P, axis=1).astype(np.float64)
+                return np.concatenate([label[:, None], P], axis=1)
+            return h[:, 0]
         raise NotImplementedError(p["algo"])

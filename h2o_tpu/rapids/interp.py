@@ -1673,6 +1673,630 @@ def _op_any_all(node, env, which: str):
     return float(any(vals) if which == "any" else all(vals))
 
 
+def _numlist_vals(node, env):
+    if isinstance(node, tuple) and node[0] == "numlist":
+        out = []
+        for item in node[1]:
+            if isinstance(item, tuple) and item[0] == "span":
+                lo, hi = item[1], item[2]
+                out.extend(float(x) for x in range(int(lo), int(hi) + 1))
+            elif isinstance(item, tuple):
+                out.append(float(item[1]))
+            else:
+                out.append(float(item))
+        return out
+    ev = _eval(node, env)
+    if isinstance(ev, (int, float)):
+        return [float(ev)]
+    return [float(x) for x in ev]
+
+
+def _mixed_list(node, env):
+    """Bracket-list AST -> python values (strings kept as strings)."""
+    if isinstance(node, tuple) and node[0] == "numlist":
+        out = []
+        for item in node[1]:
+            if isinstance(item, tuple) and item[0] == "span":
+                lo, hi = item[1], item[2]
+                out.extend(float(x) for x in range(int(lo), int(hi) + 1))
+            elif isinstance(item, tuple):
+                out.append(item[1])
+            else:
+                out.append(item)
+        return out
+    if isinstance(node, tuple) and node[0] == "str":
+        return [node[1]]
+    ev = _eval(node, env)
+    if ev is None:
+        return []
+    if isinstance(ev, (int, float, str)):
+        return [ev]
+    return list(ev)
+
+
+def _op_not(node, env):
+    fr = _eval(node[1], env)
+    if isinstance(fr, (int, float)):
+        return float(not fr)
+    fr = _as_frame(fr)
+    vecs = []
+    for v in fr.vecs:
+        d = v.as_float()
+        vecs.append(Vec(jnp.where(jnp.isnan(d), jnp.nan,
+                                  (d == 0).astype(jnp.float32)),
+                        nrows=v.nrows))
+    return Frame(list(fr.names), vecs)
+
+
+def _op_as_character(node, env):
+    """(as.character fr) — categorical/numeric -> string column."""
+    fr = _as_frame(_eval(node[1], env))
+    vecs = []
+    for v in fr.vecs:
+        if v.type == T_STR:
+            vecs.append(v)
+        elif v.is_categorical:
+            vecs.append(Vec(_labels_of(v), T_STR))
+        else:
+            arr = v.to_numpy()
+            vecs.append(Vec([None if np.isnan(x) else
+                             (str(int(x)) if float(x).is_integer()
+                              else repr(float(x))) for x in arr], T_STR))
+    return Frame(list(fr.names), vecs)
+
+
+def _op_is_character(node, env):
+    fr = _as_frame(_eval(node[1], env))
+    return [float(v.type == T_STR) for v in fr.vecs]
+
+
+def _op_any_factor(node, env):
+    fr = _as_frame(_eval(node[1], env))
+    return float(any(v.is_categorical for v in fr.vecs))
+
+
+def _op_any_na(node, env):
+    fr = _as_frame(_eval(node[1], env))
+    for v in fr.vecs:
+        if v.host_data is not None:
+            if any(x is None for x in v.host_data):
+                return 1.0
+        elif v.nacnt() > 0:
+            return 1.0
+    return 0.0
+
+
+def _op_match(node, env):
+    """(match fr table nomatch None) — AstMatch: position of each value
+    in `table` (1-based like R), nomatch fill."""
+    fr = _as_frame(_eval(node[1], env))
+    table = _mixed_list(node[2], env)
+    nomatch = _eval(node[3], env)
+    nomatch = float("nan") if nomatch is None else float(nomatch)
+    v = fr.vecs[0]
+    if v.is_categorical or v.type == T_STR:
+        labels = _labels_of(v)
+        lut = {}
+        for i, t in enumerate(table):      # first occurrence wins (R)
+            lut.setdefault(str(t), i + 1)
+        out = np.asarray([lut.get(s, nomatch) if s is not None
+                          else nomatch for s in labels], np.float32)
+    else:
+        arr = v.to_numpy()
+        lut = {}
+        for i, t in enumerate(table):      # first occurrence wins (R)
+            lut.setdefault(float(t), i + 1)
+        out = np.asarray([lut.get(float(x), nomatch)
+                          if not np.isnan(x) else nomatch
+                          for x in arr], np.float32)
+    return Frame(["match"], [Vec(out)])
+
+
+def _rank_avg(col: np.ndarray) -> np.ndarray:
+    """Average ranks (R ties.method="average"); NaNs stay NaN."""
+    out = np.full(col.shape, np.nan)
+    ok = ~np.isnan(col)
+    v = col[ok]
+    order = np.argsort(v, kind="stable")
+    ranks = np.empty(len(v))
+    ranks[order] = np.arange(1, len(v) + 1, dtype=np.float64)
+    # average ranks over ties
+    sv = v[order]
+    i = 0
+    while i < len(sv):
+        j = i
+        while j + 1 < len(sv) and sv[j + 1] == sv[i]:
+            j += 1
+        if j > i:
+            ranks[order[i: j + 1]] = ranks[order[i: j + 1]].mean()
+        i = j + 1
+    out[ok] = ranks
+    return out
+
+
+def _op_cor(node, env):
+    """(cor fr y use method) — AstCorrelation: Pearson or Spearman;
+    use="everything" propagates NAs (NaN result), "complete.obs" drops
+    NA rows (the R semantics the client forwards)."""
+    fx = _as_frame(_eval(node[1], env))
+    fy = _as_frame(_eval(node[2], env))
+    use = str(_lit(node[3])).lower()
+    method = str(_lit(node[4])).lower()
+    X = np.asarray(fx.as_matrix())[: fx.nrows].astype(np.float64)
+    Y = np.asarray(fy.as_matrix())[: fy.nrows].astype(np.float64)
+    if use in ("complete.obs", "na.or.complete"):
+        ok = ~(np.isnan(X).any(axis=1) | np.isnan(Y).any(axis=1))
+        X, Y = X[ok], Y[ok]
+    elif use != "everything":
+        raise ValueError(f"cor: use={use!r} not supported "
+                         "(everything, complete.obs)")
+    if method == "spearman":
+        X = np.stack([_rank_avg(X[:, j]) for j in range(X.shape[1])], 1)
+        Y = np.stack([_rank_avg(Y[:, j]) for j in range(Y.shape[1])], 1)
+    elif method != "pearson":
+        raise ValueError(f"cor: method={method!r} not supported "
+                         "(Pearson, Spearman)")
+    # with use="everything", any NaN poisons the pairwise sums -> NaN,
+    # matching R
+    Xc = X - X.mean(axis=0)
+    Yc = Y - Y.mean(axis=0)
+    denom = np.outer(np.sqrt((Xc ** 2).sum(axis=0)),
+                     np.sqrt((Yc ** 2).sum(axis=0)))
+    C = (Xc.T @ Yc) / np.maximum(denom, 1e-300)
+    if C.size == 1:
+        return float(C[0, 0])
+    return Frame(list(fy.names),
+                 [Vec(C[:, j].astype(np.float32)) for j in
+                  range(C.shape[1])])
+
+
+def _op_cut(node, env):
+    """(cut fr breaks labels include_lowest right dig_lab) — AstCut."""
+    fr = _as_frame(_eval(node[1], env))
+    breaks = _numlist_vals(node[2], env)
+    labels = [str(s) for s in _mixed_list(node[3], env)] or None
+    include_lowest = bool(_eval(node[4], env))
+    right = bool(_eval(node[5], env))
+    dig = int(_eval(node[6], env))
+    v = fr.vecs[0]
+    x = np.asarray(v.to_numpy(), np.float64)
+    b = np.asarray(breaks, np.float64)
+    if right:
+        codes = np.searchsorted(b, x, side="left") - 1
+        if include_lowest:
+            codes = np.where(x == b[0], 0, codes)
+    else:
+        codes = np.searchsorted(b, x, side="right") - 1
+        codes = np.where(x == b[-1], len(b) - 2, codes)
+    codes = np.where(np.isnan(x) | (codes < 0) | (codes > len(b) - 2),
+                     -1, codes).astype(np.int32)
+    n_bins_expected = len(b) - 1
+    if labels and len(labels) != n_bins_expected:
+        raise ValueError(
+            f"cut: {len(labels)} labels for {n_bins_expected} bins")
+    if not labels:
+        fmt = f"%.{dig}g"
+        lb, rb = ("(", "]") if right else ("[", ")")
+        labels = [f"{lb}{fmt % b[i]},{fmt % b[i+1]}{rb}"
+                  for i in range(len(b) - 1)]
+        if include_lowest and right:
+            labels[0] = "[" + labels[0][1:]
+    return Frame(list(fr.names),
+                 [Vec(codes, T_CAT, domain=[str(s) for s in labels])])
+
+
+def _op_entropy(node, env):
+    """(entropy fr) — per-string Shannon entropy over characters."""
+    import math as _m
+    fr = _as_frame(_eval(node[1], env))
+    out = []
+    for v in fr.vecs:
+        vals = []
+        for s in _labels_of(v):
+            if s is None or not s:
+                vals.append(np.nan if s is None else 0.0)
+                continue
+            counts = {}
+            for ch in s:
+                counts[ch] = counts.get(ch, 0) + 1
+            n = len(s)
+            vals.append(-sum(c / n * _m.log2(c / n)
+                             for c in counts.values()))
+        out.append(Vec(np.asarray(vals, np.float32)))
+    return Frame(list(fr.names), out)
+
+
+def _op_columns_by_type(node, env):
+    """(columnsByType fr coltype) — 1-based column indices by kind."""
+    fr = _as_frame(_eval(node[1], env))
+    want = str(_lit(node[2])).lower()
+    idx = []
+    for j, v in enumerate(fr.vecs):
+        ok = {"numeric": v.is_numeric, "categorical": v.is_categorical,
+              "string": v.type == T_STR, "time": v.type == "time",
+              "uuid": v.type == "uuid",
+              "bad": v.type == "bad"}.get(want, False)
+        if ok:
+            idx.append(float(j))
+    return idx
+
+
+def _op_filter_na_cols(node, env):
+    """(filterNACols fr frac) — 1-based indices of columns with <= frac
+    NAs (AstFilterNaCols)."""
+    fr = _as_frame(_eval(node[1], env))
+    frac = float(_eval(node[2], env))
+    out = []
+    for j, v in enumerate(fr.vecs):
+        nac = (sum(x is None for x in v.host_data)
+               if v.host_data is not None else v.nacnt())
+        if nac <= frac * fr.nrows:
+            out.append(float(j + 1))
+    return out
+
+
+def _op_ls(node, env):
+    from h2o_tpu.core.cloud import cloud
+    keys = sorted(str(k) for k in cloud().dkv.keys())
+    dom = keys or ["<empty>"]
+    return Frame(["key"], [Vec(np.arange(len(dom), dtype=np.int32),
+                               T_CAT, domain=dom)])
+
+
+def _op_getrow(node, env):
+    fr = _as_frame(_eval(node[1], env))
+    if fr.nrows != 1:
+        raise ValueError("getrow works on single-row frames only")
+    return float(np.asarray(fr.vecs[0].as_float())[0])
+
+
+def _op_flatten(node, env):
+    fr = _eval(node[1], env)
+    if isinstance(fr, (int, float)):
+        return float(fr)
+    fr = _as_frame(fr)
+    v = fr.vecs[0]
+    if v.type == T_STR:
+        return str(v.host_data[0])
+    if v.is_categorical:
+        lab = _labels_of(v)[0]
+        return str(lab) if lab is not None else float("nan")
+    return float(np.asarray(v.as_float())[0])
+
+
+def _op_rep_len(node, env):
+    """(rep_len x length) — recycle values to a target length."""
+    src = _eval(node[1], env)
+    length = int(_eval(node[2], env))
+    if isinstance(src, (int, float)):
+        vals = np.full(length, float(src), np.float32)
+        return Frame(["C1"], [Vec(vals)])
+    fr = _as_frame(src)
+    arr = np.asarray(fr.vecs[0].to_numpy())
+    reps = int(np.ceil(length / max(len(arr), 1)))
+    out = np.tile(arr, reps)[:length].astype(np.float32)
+    v = fr.vecs[0]
+    if v.is_categorical:
+        return Frame([fr.names[0]], [Vec(out.astype(np.int32), T_CAT,
+                                         domain=list(v.domain))])
+    return Frame([fr.names[0]], [Vec(out)])
+
+
+def _op_transpose(node, env):
+    fr = _as_frame(_eval(node[1], env))
+    X = np.asarray(fr.as_matrix())[: fr.nrows].T
+    return Frame([f"C{i+1}" for i in range(X.shape[1])],
+                 [Vec(X[:, j].astype(np.float32))
+                  for j in range(X.shape[1])])
+
+
+def _op_sumaxis(node, env):
+    """(sumaxis fr skipna axis) — axis 0: per-col sums; 1: per-row."""
+    fr = _as_frame(_eval(node[1], env))
+    skipna = bool(_eval(node[2], env))
+    axis = int(_eval(node[3], env))
+    X = np.asarray(fr.as_matrix())[: fr.nrows].astype(np.float64)
+    f = np.nansum if skipna else np.sum
+    if axis == 0:
+        return [float(f(X[:, j])) for j in range(X.shape[1])]
+    return Frame(["sum"], [Vec(f(X, axis=1).astype(np.float32))])
+
+
+def _op_str_distance(node, env):
+    """(strDistance fr y measure compare_empty) — Levenshtein / lcs /
+    jaccard string distances (AstStrDistance)."""
+    fx = _as_frame(_eval(node[1], env))
+    fy = _as_frame(_eval(node[2], env))
+    measure = str(_lit(node[3])).lower()
+    cmp_empty = bool(_eval(node[4], env))
+
+    def lev(a, b):
+        if a == b:
+            return 0.0
+        prev = list(range(len(b) + 1))
+        for i, ca in enumerate(a, 1):
+            cur = [i]
+            for j, cb in enumerate(b, 1):
+                cur.append(min(prev[j] + 1, cur[j - 1] + 1,
+                               prev[j - 1] + (ca != cb)))
+            prev = cur
+        return float(prev[-1])
+
+    def lcs_len(a, b):
+        prev = [0] * (len(b) + 1)
+        for ca in a:
+            cur = [0]
+            for j, cb in enumerate(b, 1):
+                cur.append(prev[j - 1] + 1 if ca == cb
+                           else max(prev[j], cur[j - 1]))
+            prev = cur
+        return prev[-1]
+
+    def dist(a, b):
+        if a is None or b is None:
+            return np.nan
+        if (not a or not b) and not cmp_empty:
+            return np.nan
+        if measure in ("lv", "levenshtein"):
+            return lev(a, b)
+        if measure == "lcs":
+            return float(len(a) + len(b) - 2 * lcs_len(a, b))
+        if measure == "jaccard":
+            sa, sb = set(a), set(b)
+            u = sa | sb
+            return 1.0 - (len(sa & sb) / len(u) if u else 1.0)
+        raise ValueError(f"strDistance measure {measure!r} not "
+                         "supported (lv, lcs, jaccard)")
+
+    la = _labels_of(fx.vecs[0])
+    lb = _labels_of(fy.vecs[0])
+    vals = np.asarray([dist(a, b) for a, b in zip(la, lb)], np.float32)
+    return Frame(["distance"], [Vec(vals)])
+
+
+def _op_tokenize(node, env):
+    """(tokenize fr split) — AstTokenize: split every string cell into
+    one long word column with an NA row after each source row (the
+    word2vec ingest shape)."""
+    fr = _as_frame(_eval(node[1], env))
+    split = str(_lit(node[2]))
+    import re as _re
+    rx = _re.compile(split)
+    col_labels = [_labels_of(v) if v.type == T_STR or v.is_categorical
+                  else None for v in fr.vecs]
+    out: List[Optional[str]] = []
+    for i in range(fr.nrows):
+        for labels in col_labels:
+            s = labels[i] if labels is not None else None
+            if s:
+                out.extend(t for t in rx.split(s) if t)
+        out.append(None)
+    return Frame(["C1"], [Vec(out, T_STR)])
+
+
+def _op_list_timezones(node, env):
+    import zoneinfo
+    zones = sorted(zoneinfo.available_timezones())
+    return Frame(["Timezones"],
+                 [Vec(np.arange(len(zones), dtype=np.int32), T_CAT,
+                      domain=zones)])
+
+
+def _op_set_domain(node, env):
+    """(setDomain fr in_place [labels]) — replace a cat column's levels."""
+    fr = _as_frame(_eval(node[1], env))
+    labels = _mixed_list(node[3], env)
+    v = fr.vecs[0]
+    if not v.is_categorical:
+        raise ValueError("setDomain needs a categorical column")
+    if len(labels) < len(v.domain or []):
+        raise ValueError(f"setDomain: {len(labels)} labels for "
+                         f"{len(v.domain or [])} levels")
+    nv = Vec(np.asarray(v.to_numpy(), np.int32), T_CAT,
+             domain=[str(s) for s in labels])
+    return Frame(list(fr.names), [nv])
+
+
+def _op_append_levels(node, env):
+    fr = _as_frame(_eval(node[1], env))
+    labels = _mixed_list(node[3], env)
+    v = fr.vecs[0]
+    if not v.is_categorical:
+        raise ValueError("appendLevels needs a categorical column")
+    dom = list(v.domain or [])
+    for lab in labels:
+        if str(lab) not in dom:
+            dom.append(str(lab))
+    return Frame(list(fr.names),
+                 [Vec(np.asarray(v.to_numpy(), np.int32), T_CAT,
+                      domain=dom)])
+
+
+def _op_relevel_by_freq(node, env):
+    """(relevel.by.freq fr weights top_n) — reorder levels most-frequent
+    first (top_n = -1: all)."""
+    fr = _as_frame(_eval(node[1], env))
+    wcol = _lit(node[2])
+    top_n = int(_eval(node[3], env))
+    out_vecs = []
+    for v in fr.vecs:
+        if not v.is_categorical:
+            out_vecs.append(v)
+            continue
+        codes = np.asarray(v.to_numpy(), np.int64)
+        w = np.ones(len(codes))
+        if wcol and wcol in fr.names:
+            w = np.asarray(fr.vec(wcol).to_numpy(), np.float64)
+        card = len(v.domain or [])
+        freq = np.zeros(card)
+        ok = codes >= 0
+        np.add.at(freq, codes[ok], w[ok])
+        order = np.argsort(-freq, kind="stable")
+        if top_n > 0:
+            head = order[:top_n]
+            tail = np.asarray([i for i in np.arange(card)
+                               if i not in set(head.tolist())])
+            order = np.concatenate([head, tail]) if len(tail) else head
+        remap = np.empty(card, np.int64)
+        remap[order] = np.arange(card)
+        new_codes = np.where(ok, remap[np.clip(codes, 0, card - 1)],
+                             -1).astype(np.int32)
+        out_vecs.append(Vec(new_codes, T_CAT,
+                            domain=[str(v.domain[i]) for i in order]))
+    return Frame(list(fr.names), out_vecs)
+
+
+def _op_week(node, env):
+    fr = _as_frame(_eval(node[1], env))
+    import datetime as _dt
+    out = []
+    for v in fr.vecs:
+        ms = np.asarray(v.to_numpy(), np.float64)
+        vals = [np.nan if np.isnan(x) else float(
+            _dt.datetime.utcfromtimestamp(x / 1000.0)
+            .isocalendar()[1]) for x in ms]
+        out.append(Vec(np.asarray(vals, np.float32)))
+    return Frame(list(fr.names), out)
+
+
+def _op_num_valid_substrings(node, env):
+    """(num_valid_substrings fr path) — count substrings present in the
+    words file (AstCountSubstringsWords)."""
+    fr = _as_frame(_eval(node[1], env))
+    path = str(_lit(node[2]))
+    with open(path) as f:
+        words = {ln.strip() for ln in f if ln.strip()}
+    out = []
+    for v in fr.vecs:
+        vals = []
+        for s in _labels_of(v):
+            if s is None:
+                vals.append(np.nan)
+                continue
+            cnt = 0
+            for i in range(len(s)):
+                for j in range(i + 1, len(s) + 1):
+                    if s[i:j] in words:
+                        cnt += 1
+            vals.append(float(cnt))
+        out.append(Vec(np.asarray(vals, np.float32)))
+    return Frame(list(fr.names), out)
+
+
+def _op_w2v_to_frame(node, env):
+    """(word2vec.to.frame model) — embeddings as [Word, V1..VD]."""
+    from h2o_tpu.core.cloud import cloud
+    from h2o_tpu.models.word2vec import Word2VecModel
+    m = cloud().dkv.get(_lit(node[1]))
+    if not isinstance(m, Word2VecModel):
+        raise ValueError(f"no word2vec model {_lit(node[1])!r}")
+    words = list(m.output["words"])
+    W = np.asarray(m.output["vectors"], np.float32)
+    vecs = [Vec(np.arange(len(words), dtype=np.int32), T_CAT,
+                domain=[str(w) for w in words])]
+    names = ["Word"] + [f"V{i+1}" for i in range(W.shape[1])]
+    vecs += [Vec(W[:, j]) for j in range(W.shape[1])]
+    return Frame(names, vecs)
+
+
+def _op_rulefit_predict_rules(node, env):
+    """(rulefit.predict.rules model fr [rule_ids]) — per-row rule
+    validity flags (RuleFit predict_rules)."""
+    from h2o_tpu.core.cloud import cloud
+    m = cloud().dkv.get(_lit(node[1]))
+    fr = _as_frame(_eval(node[2], env))
+    rule_ids = [str(s) for s in _mixed_list(node[3], env)]
+    if m is None or m.output.get("rule_importance") is None:
+        raise ValueError("rulefit.predict.rules needs a RuleFit model")
+    rows = {str(r[0]): r for r in m.output["rule_importance"]}
+    out_names, out_vecs = [], []
+    for rid in rule_ids:
+        if rid not in rows:
+            raise ValueError(f"unknown rule id {rid!r}")
+        rule_txt = str(rows[rid][3])
+        mask = _eval_rule_text(rule_txt, fr)
+        out_names.append(rid)
+        out_vecs.append(Vec(mask.astype(np.float32)))
+    return Frame(out_names, out_vecs)
+
+
+def _eval_rule_text(rule: str, fr: Frame) -> np.ndarray:
+    """Evaluate a rendered RuleFit rule ('a < 1.5 & b >= 2' style
+    conjunctions, 'x in {l1, l2}' for categoricals) over a frame."""
+    mask = np.ones(fr.nrows, bool)
+    for cond in rule.split("&"):
+        cond = cond.strip()
+        if not cond or cond.lower() == "linear":
+            continue
+        m_in = re.match(r"(\S+)\s+in\s+\{([^}]*)\}", cond)
+        if m_in:
+            col, levels = m_in.group(1), [s.strip() for s in
+                                          m_in.group(2).split(",")]
+            v = fr.vec(col)
+            labs = _labels_of(v)
+            mask &= np.asarray([s in levels if s is not None else False
+                                for s in labs])
+            continue
+        m_cmp = re.match(r"(\S+)\s*(<=|>=|<|>)\s*(-?[\d.eE+]+)", cond)
+        if m_cmp:
+            col, op_s, thr = m_cmp.groups()
+            x = np.asarray(fr.vec(col).to_numpy(), np.float64)
+            thr = float(thr)
+            cmp = {"<": x < thr, "<=": x <= thr, ">": x > thr,
+                   ">=": x >= thr}[op_s]
+            mask &= np.where(np.isnan(x), False, cmp)
+            continue
+        raise ValueError(f"cannot evaluate rule fragment {cond!r}")
+    return mask
+
+
+def _op_tf_idf(node, env):
+    """(tf-idf fr doc_id_idx text_idx preprocess case_sensitive) —
+    hex/tfidf/{TermFrequency,InverseDocumentFrequency}Task; client
+    h2o.information_retrieval.tf_idf.  Output rows:
+    [DocID, Word, TF, IDF, TF-IDF] with IDF = log((N+1)/(df+1))."""
+    import math
+    from collections import Counter
+    fr = _as_frame(_eval(node[1], env))
+    doc_i = int(_eval(node[2], env))
+    text_i = int(_eval(node[3], env))
+    preprocess = bool(_eval(node[4], env))
+    case_sensitive = bool(_eval(node[5], env))
+    dv, tv = fr.vecs[doc_i], fr.vecs[text_i]
+    doc_ids = dv.to_numpy()
+    if tv.host_data is not None:
+        texts = ["" if s is None else str(s) for s in tv.host_data]
+    elif tv.is_categorical:
+        dom = tv.domain or []
+        texts = [dom[int(c)] if c >= 0 else "" for c in tv.to_numpy()]
+    else:
+        raise ValueError("tf-idf wants a string/categorical text column")
+    tf: Counter = Counter()
+    doc_words: Dict = {}
+    for d, t in zip(doc_ids, texts):
+        d = float(d)
+        if not case_sensitive:
+            t = t.lower()
+        words = t.split() if preprocess else ([t] if t else [])
+        for w in words:
+            tf[(d, w)] += 1
+            doc_words.setdefault(w, set()).add(d)
+    n_docs = len(set(float(d) for d in doc_ids))
+    rows = sorted(tf.items())
+    out_doc = np.asarray([d for (d, _w), _c in rows], np.float32)
+    words_dom = sorted(doc_words)
+    widx = {w: i for i, w in enumerate(words_dom)}
+    out_word = np.asarray([widx[w] for (_d, w), _c in rows], np.int32)
+    out_tf = np.asarray([c for _k, c in rows], np.float32)
+    out_idf = np.asarray(
+        [math.log((n_docs + 1.0) / (len(doc_words[w]) + 1.0))
+         for (_d, w), _c in rows], np.float32)
+    return Frame(
+        ["DocID", "Word", "TF", "IDF", "TF_IDF"],
+        [Vec(out_doc), Vec(out_word, T_CAT, domain=words_dom),
+         Vec(out_tf), Vec(out_idf), Vec(out_tf * out_idf)])
+
+
 def _op_segment_models_as_frame(node, env):
     """(segment_models_as_frame sm_id) — AstSegmentModelsAsFrame
     (h2o-py segment_models.py:48): tabular view of a SegmentModels
@@ -1687,7 +2311,35 @@ def _op_segment_models_as_frame(node, env):
 
 
 _EXTRA_OPS = {
+    "tf-idf": _op_tf_idf,
     "segment_models_as_frame": _op_segment_models_as_frame,
+    "not": _op_not,
+    "as.character": _op_as_character,
+    "is.character": _op_is_character,
+    "any.factor": _op_any_factor,
+    "any.na": _op_any_na,
+    "match": _op_match,
+    "cor": _op_cor,
+    "cut": _op_cut,
+    "entropy": _op_entropy,
+    "columnsByType": _op_columns_by_type,
+    "filterNACols": _op_filter_na_cols,
+    "ls": _op_ls,
+    "getrow": _op_getrow,
+    "flatten": _op_flatten,
+    "rep_len": _op_rep_len,
+    "t": _op_transpose,
+    "sumaxis": _op_sumaxis,
+    "strDistance": _op_str_distance,
+    "tokenize": _op_tokenize,
+    "listTimeZones": _op_list_timezones,
+    "setDomain": _op_set_domain,
+    "appendLevels": _op_append_levels,
+    "relevel.by.freq": _op_relevel_by_freq,
+    "week": _op_week,
+    "num_valid_substrings": _op_num_valid_substrings,
+    "word2vec.to.frame": _op_w2v_to_frame,
+    "rulefit.predict.rules": _op_rulefit_predict_rules,
     "scale": _op_scale,
     "hist": _op_hist,
     "h2o.runif": _op_runif,
@@ -1738,7 +2390,10 @@ def op_names() -> List[str]:
     try:
         import re as _re
         with open(__file__) as f:
-            names.update(_re.findall(r'op == "([^"]+)"', f.read()))
+            src = f.read()
+        names.update(_re.findall(r'op == "([^"]+)"', src))
+        for grp in _re.findall(r'op in \(([^)]*)\)', src):
+            names.update(_re.findall(r'"([^"]+)"', grp))
     except OSError:
         pass
     _OP_NAMES_CACHE = sorted(names)

@@ -132,3 +132,58 @@ def test_mojo_roundtrip_as_generic(cl, rng, tmp_path):
     # and its metrics flow through the standard surface
     mm = gen.model_metrics(fr)
     assert mm.data["AUC"] > 0.6
+
+
+def test_kmeans_mojo_cross_scoring(cl, rng):
+    """KMeansMojoWriter key set: cluster assignment parity."""
+    from h2o_tpu.models.kmeans import KMeans
+    n = 400
+    X = np.concatenate([rng.normal(-2, 0.5, size=(n // 2, 3)),
+                        rng.normal(2, 0.5, size=(n // 2, 3))]).astype(
+                            np.float32)
+    fr = Frame(["a", "b", "c"], [Vec(X[:, j]) for j in range(3)])
+    m = KMeans(k=2, seed=1).train(training_frame=fr)
+    blob = _cross_score(m, fr)
+    with zipfile.ZipFile(io.BytesIO(blob)) as z:
+        ini = z.read("model.ini").decode()
+        assert "algo = kmeans" in ini and "center_num = 2" in ini
+
+
+def test_kmeans_mojo_categorical_refused(cl, rng):
+    from h2o_tpu.mojo import export_genmodel_mojo
+    from h2o_tpu.models.kmeans import KMeans
+    fr = Frame(["a", "g"],
+               [Vec(rng.normal(size=60).astype(np.float32)),
+                Vec(rng.integers(0, 3, size=60).astype(np.int32), T_CAT,
+                    domain=["p", "q", "r"])])
+    m = KMeans(k=2, seed=1).train(training_frame=fr)
+    with pytest.raises(NotImplementedError, match="numeric"):
+        export_genmodel_mojo(m)
+
+
+def test_deeplearning_mojo_cross_scoring(cl, rng):
+    """DeepLearningMojoWriter key set (weight_layer{i} row-major,
+    cat_offsets one-hot layout): probability parity."""
+    from h2o_tpu.models.deeplearning import DeepLearning
+    fr = _mixed_frame(rng, n=400)
+    m = DeepLearning(hidden=[8, 8], epochs=2, seed=1,
+                     activation="Rectifier").train(
+        y="y", training_frame=fr)
+    blob = _cross_score(m, fr, tol=1e-4)
+    with zipfile.ZipFile(io.BytesIO(blob)) as z:
+        ini = z.read("model.ini").decode()
+        assert "algo = deeplearning" in ini
+        assert "neural_network_sizes" in ini
+        assert "weight_layer0" in ini
+
+
+def test_deeplearning_mojo_regression(cl, rng):
+    from h2o_tpu.models.deeplearning import DeepLearning
+    n = 300
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    y = (x[:, 0] * 2 - x[:, 1]).astype(np.float32)
+    fr = Frame(["a", "b", "c", "y"],
+               [Vec(x[:, 0]), Vec(x[:, 1]), Vec(x[:, 2]), Vec(y)])
+    m = DeepLearning(hidden=[8], epochs=2, seed=1).train(
+        y="y", training_frame=fr)
+    _cross_score(m, fr, tol=1e-4)

@@ -136,3 +136,25 @@ def test_s3_scheme_roundtrip(cl, tmp_path):
         srv.shutdown()
         srv.server_close()
         persist._SCHEMES.pop("s3", None)
+
+
+def test_orc_ingest(cl, tmp_path):
+    """ORC via pyarrow.orc (reference: h2o-parsers/h2o-orc-parser)."""
+    pa = pytest.importorskip("pyarrow")
+    from pyarrow import orc
+    t = pa.table({"a": [1.0, 2.0, None, 4.0],
+                  "cat": ["x", "y", "x", "z"],
+                  "n": [10, 20, 30, 40]})
+    p = str(tmp_path / "t.orc")
+    orc.write_table(t, p)
+    from h2o_tpu.core.parse import parse_files
+    fr = parse_files([p])
+    assert fr.nrows == 4 and fr.names == ["a", "cat", "n"]
+    assert fr.vec("cat").domain == ["x", "y", "z"]
+    assert fr.vec("a").nacnt() == 1
+    # magic-based dispatch without the extension
+    p2 = str(tmp_path / "noext")
+    import shutil
+    shutil.copy(p, p2)
+    fr2 = parse_files([p2])
+    assert fr2.nrows == 4

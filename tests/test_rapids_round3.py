@@ -1,0 +1,206 @@
+"""Round-3 rapids ops: not/as.character/match/cor/cut/entropy/tokenize/
+strDistance/t/sumaxis/rep_len/cut/setDomain/appendLevels/relevel.by.freq/
+week/columnsByType/filterNACols/ls/getrow/flatten/num_valid_substrings/
+word2vec.to.frame (reference: water/rapids/ast/prims/**)."""
+
+import numpy as np
+import pytest
+
+from h2o_tpu.core.cloud import cloud
+from h2o_tpu.core.frame import Frame, Vec, T_CAT, T_STR, T_TIME
+from h2o_tpu.rapids import Session, rapids_exec
+
+
+@pytest.fixture()
+def sess():
+    return Session("_r3")
+
+
+def _put(fr, key):
+    fr.key = key
+    cloud().dkv.put(key, fr)
+    return key
+
+
+def _ex(ast, sess):
+    return rapids_exec(ast, sess)
+
+
+def test_not_and_flags(cl, sess):
+    fr = Frame(["a"], [Vec(np.asarray([0, 1, 2, np.nan], np.float32))])
+    _put(fr, "r3a")
+    out = _ex("(not r3a)", sess)
+    got = out.vecs[0].to_numpy()
+    assert got[0] == 1 and got[1] == 0 and got[2] == 0 and np.isnan(got[3])
+    assert _ex("(any.na r3a)", sess) == 1.0
+    assert _ex("(any.factor r3a)", sess) == 0.0
+    cloud().dkv.remove("r3a")
+
+
+def test_as_character_is_character(cl, sess):
+    fr = Frame(["g"], [Vec(np.asarray([0, 1, 0], np.int32), T_CAT,
+                           domain=["lo", "hi"])])
+    _put(fr, "r3b")
+    out = _ex("(as.character r3b)", sess)
+    assert out.vecs[0].type == T_STR
+    assert out.vecs[0].host_data == ["lo", "hi", "lo"]
+    assert _ex("(is.character r3b)", sess) == [0.0]
+    cloud().dkv.remove("r3b")
+
+
+def test_match(cl, sess):
+    fr = Frame(["g"], [Vec(np.asarray([0, 1, 2, -1], np.int32), T_CAT,
+                           domain=["a", "b", "c"])])
+    _put(fr, "r3c")
+    out = _ex('(match r3c ["b", "c"] NaN None)', sess)
+    got = out.vecs[0].to_numpy()
+    assert np.isnan(got[0]) and got[1] == 1 and got[2] == 2
+    cloud().dkv.remove("r3c")
+
+
+def test_cor(cl, sess):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=200).astype(np.float32)
+    y = (2 * x + rng.normal(size=200).astype(np.float32) * 0.01)
+    _put(Frame(["x"], [Vec(x)]), "r3x")
+    _put(Frame(["y"], [Vec(y)]), "r3y")
+    r = _ex('(cor r3x r3y "everything" "Pearson")', sess)
+    assert 0.99 < float(r) <= 1.0
+    cloud().dkv.remove("r3x")
+    cloud().dkv.remove("r3y")
+
+
+def test_cut(cl, sess):
+    fr = Frame(["v"], [Vec(np.asarray([0.5, 1.5, 2.5, np.nan],
+                                      np.float32))])
+    _put(fr, "r3d")
+    out = _ex("(cut r3d [0, 1, 2, 3] [] True True 3)", sess)
+    v = out.vecs[0]
+    assert v.is_categorical and len(v.domain) == 3
+    codes = v.to_numpy()
+    assert list(codes[:3]) == [0, 1, 2] and codes[3] == -1
+    cloud().dkv.remove("r3d")
+
+
+def test_entropy_strdistance_tokenize(cl, sess):
+    fr = Frame(["s"], [Vec(["aaaa", "abab", None], T_STR)])
+    _put(fr, "r3e")
+    ent = _ex("(entropy r3e)", sess).vecs[0].to_numpy()
+    assert ent[0] == 0.0 and abs(ent[1] - 1.0) < 1e-6 and np.isnan(ent[2])
+    _put(Frame(["t"], [Vec(["aaba", "abab", "x"], T_STR)]), "r3f")
+    d = _ex('(strDistance r3e r3f "lv" False)', sess).vecs[0].to_numpy()
+    assert d[0] == 1.0 and d[1] == 0.0
+    toks = _ex('(tokenize r3e "a")', sess).vecs[0].host_data
+    # "abab" splits on 'a' -> ['b','b']; rows end with None separators
+    assert "b" in toks and toks.count(None) == 3
+    cloud().dkv.remove("r3e")
+    cloud().dkv.remove("r3f")
+
+
+def test_transpose_sumaxis_repl(cl, sess):
+    fr = Frame(["a", "b"], [Vec(np.asarray([1, 2], np.float32)),
+                            Vec(np.asarray([3, 4], np.float32))])
+    _put(fr, "r3g")
+    t = _ex("(t r3g)", sess)
+    assert t.nrows == 2 and t.ncols == 2
+    assert float(t.vecs[0].to_numpy()[1]) == 3.0
+    sums = _ex("(sumaxis r3g True 0)", sess)
+    assert sums == [3.0, 7.0]
+    rows = _ex("(sumaxis r3g True 1)", sess).vecs[0].to_numpy()
+    assert list(rows) == [4.0, 6.0]
+    rep = _ex("(rep_len r3g 5)", sess)
+    assert list(rep.vecs[0].to_numpy()) == [1, 2, 1, 2, 1]
+    cloud().dkv.remove("r3g")
+
+
+def test_domain_ops(cl, sess):
+    fr = Frame(["g"], [Vec(np.asarray([0, 1, 1, 1], np.int32), T_CAT,
+                           domain=["x", "y"])])
+    _put(fr, "r3h")
+    out = _ex('(setDomain r3h False ["XX", "YY"])', sess)
+    assert out.vecs[0].domain == ["XX", "YY"]
+    out2 = _ex('(appendLevels r3h False ["z"])', sess)
+    assert out2.vecs[0].domain == ["x", "y", "z"]
+    out3 = _ex('(relevel.by.freq r3h None -1)', sess)
+    # 'y' is most frequent -> becomes level 0
+    assert out3.vecs[0].domain[0] == "y"
+    assert list(out3.vecs[0].to_numpy()) == [1, 0, 0, 0]
+    cloud().dkv.remove("r3h")
+
+
+def test_misc_introspection(cl, sess):
+    fr = Frame(["n", "g"],
+               [Vec(np.asarray([1.0, np.nan], np.float32)),
+                Vec(np.asarray([0, 1], np.int32), T_CAT,
+                    domain=["u", "v"])])
+    _put(fr, "r3i")
+    assert _ex('(columnsByType r3i "numeric")', sess) == [0.0]
+    assert _ex('(columnsByType r3i "categorical")', sess) == [1.0]
+    assert _ex("(filterNACols r3i 0.4)", sess) == [2.0]
+    keys = _ex("(ls)", sess)
+    assert "r3i" in (keys.vecs[0].domain or [])
+    one = Frame(["z"], [Vec(np.asarray([42.0], np.float32))])
+    _put(one, "r3j")
+    assert _ex("(getrow r3j)", sess) == 42.0
+    assert _ex("(flatten r3j)", sess) == 42.0
+    cloud().dkv.remove("r3i")
+    cloud().dkv.remove("r3j")
+
+
+def test_week_and_timezones(cl, sess):
+    # 2020-01-15 is ISO week 3
+    import datetime
+    ms = datetime.datetime(2020, 1, 15).timestamp() * 1000
+    fr = Frame(["t"], [Vec(np.asarray([ms], np.float64), T_TIME)])
+    _put(fr, "r3k")
+    wk = _ex("(week r3k)", sess).vecs[0].to_numpy()
+    assert wk[0] == 3.0
+    tz = _ex("(listTimeZones)", sess)
+    assert "UTC" in (tz.vecs[0].domain or [])
+    cloud().dkv.remove("r3k")
+
+
+def test_num_valid_substrings(cl, sess, tmp_path):
+    words = tmp_path / "words.txt"
+    words.write_text("cat\nat\n")
+    fr = Frame(["s"], [Vec(["cat"], T_STR)])
+    _put(fr, "r3l")
+    out = _ex(f'(num_valid_substrings r3l "{words}")', sess)
+    # substrings of 'cat': c,a,t,ca,at,cat -> 'at' and 'cat' match
+    assert float(out.vecs[0].to_numpy()[0]) == 2.0
+    cloud().dkv.remove("r3l")
+
+
+def test_word2vec_to_frame(cl, sess):
+    from h2o_tpu.models.word2vec import Word2Vec
+    toks = (["apple", "pie", None] * 30)
+    fr = Frame(["txt"], [Vec(toks, T_STR)])
+    m = Word2Vec(vec_size=4, epochs=1, min_word_freq=1).train(
+        training_frame=fr)
+    out = _ex(f"(word2vec.to.frame {m.key})", sess)
+    assert out.names[0] == "Word" and out.ncols == 5
+    assert set(out.vecs[0].domain) == {"apple", "pie"}
+    cloud().dkv.remove(str(m.key))
+
+
+def test_rulefit_predict_rules(cl, sess, rng):
+    from h2o_tpu.models.rulefit import RuleFit
+    n = 300
+    x = rng.normal(size=n).astype(np.float32)
+    y = (x > 0.2).astype(np.int32)
+    fr = Frame(["x", "y"], [Vec(x), Vec(y, T_CAT, domain=["n", "p"])])
+    m = RuleFit(max_num_rules=8, seed=1).train(y="y", training_frame=fr)
+    _put(fr, "r3m")
+    rows = m.output["rule_importance"]
+    rid = str(rows[0][0])
+    if rid.startswith("linear"):
+        rid = next((str(r[0]) for r in rows
+                    if not str(r[0]).startswith("linear")), None)
+    if rid is None:
+        pytest.skip("rulefit produced only linear terms")
+    out = _ex(f'(rulefit.predict.rules {m.key} r3m ["{rid}"])', sess)
+    vals = out.vecs[0].to_numpy()
+    assert set(np.unique(vals)) <= {0.0, 1.0}
+    assert 0 < vals.sum() < n
+    cloud().dkv.remove("r3m")
+    cloud().dkv.remove(str(m.key))

@@ -1,0 +1,159 @@
+"""XGBoost-compat extras: monotone constraints, dart, gblinear; Grep
+builder; tf-idf rapids op; parallel grid building.
+
+Reference: hex/tree monotone handling (DTree.findBestSplitPoint),
+XGBoost dart/gblinear boosters, hex/grep/Grep.java, hex/tfidf/*,
+hex/ParallelModelBuilder.java.
+"""
+
+import numpy as np
+import pytest
+
+from h2o_tpu.core.frame import Frame, Vec, T_CAT, T_STR
+
+
+@pytest.fixture()
+def mono_data(rng):
+    n = 2000
+    x1 = rng.uniform(-2, 2, size=n)
+    x2 = rng.normal(size=n)
+    # y increasing in x1 on average, plus noise strong enough that an
+    # unconstrained fit wiggles
+    y = 0.8 * x1 + np.sin(4 * x1) * 0.4 + x2 * 0.5 + \
+        rng.normal(size=n) * 0.3
+    fr = Frame(["x1", "x2", "y"],
+               [Vec(x1.astype(np.float32)), Vec(x2.astype(np.float32)),
+                Vec(y.astype(np.float32))])
+    return fr, x1
+
+
+def _pdp_monotone(model, fr, col, n_grid=24):
+    """Mean prediction over a value sweep of `col` — must be monotone."""
+    lo, hi = fr.vec(col).min(), fr.vec(col).max()
+    means = []
+    for v in np.linspace(lo, hi, n_grid):
+        work = Frame(list(fr.names), list(fr.vecs))
+        work.vecs[fr.names.index(col)] = Vec(
+            np.full(fr.nrows, v, np.float32))
+        means.append(float(np.nanmean(np.asarray(
+            model.predict_raw(work))[: fr.nrows])))
+    return np.asarray(means)
+
+
+def test_gbm_monotone_constraints(cl, mono_data):
+    from h2o_tpu.models.tree.gbm import GBM
+    fr, x1 = mono_data
+    m = GBM(ntrees=20, max_depth=4, learn_rate=0.3, seed=1,
+            monotone_constraints={"x1": 1}).train(
+                y="y", training_frame=fr)
+    sweep = _pdp_monotone(m, fr, "x1")
+    diffs = np.diff(sweep)
+    assert (diffs >= -1e-5).all(), f"not monotone: {diffs.min()}"
+    # constraint costs accuracy but not much: model still learns x1
+    assert sweep[-1] - sweep[0] > 1.0
+
+
+def test_gbm_monotone_validation(cl, mono_data):
+    from h2o_tpu.models.tree.gbm import GBM
+    fr, _ = mono_data
+    with pytest.raises(ValueError, match="not a predictor"):
+        GBM(ntrees=2, monotone_constraints={"nope": 1}).train(
+            y="y", training_frame=fr)
+    with pytest.raises(ValueError, match="must be -1, 0 or 1"):
+        GBM(ntrees=2, monotone_constraints={"x1": 5}).train(
+            y="y", training_frame=fr)
+
+
+def test_xgboost_dart(cl, rng):
+    from h2o_tpu.models.tree.xgboost import XGBoost
+    n = 600
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    logits = x[:, 0] - 0.7 * x[:, 1]
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.int32)
+    fr = Frame([f"x{i}" for i in range(4)] + ["y"],
+               [Vec(x[:, i]) for i in range(4)] +
+               [Vec(y, T_CAT, domain=["n", "p"])])
+    m = XGBoost(booster="dart", ntrees=8, max_depth=3, rate_drop=0.3,
+                seed=7).train(y="y", training_frame=fr)
+    auc = float(m.output["training_metrics"]["AUC"])
+    assert auc > 0.75, auc
+    assert m.output["split_col"].shape[0] == 8
+    # scores are sane probabilities
+    raw = np.asarray(m.predict_raw(fr))[:n]
+    assert ((raw[:, 2] >= 0) & (raw[:, 2] <= 1)).all()
+
+
+def test_xgboost_gblinear(cl, rng):
+    from h2o_tpu.models.tree.xgboost import XGBoost
+    n = 800
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    logits = 1.5 * x[:, 0] - x[:, 1]
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.int32)
+    fr = Frame(["a", "b", "c", "y"],
+               [Vec(x[:, 0]), Vec(x[:, 1]), Vec(x[:, 2]),
+                Vec(y, T_CAT, domain=["n", "p"])])
+    m = XGBoost(booster="gblinear", reg_lambda=1.0, seed=1).train(
+        y="y", training_frame=fr)
+    assert m.params["booster"] == "gblinear"
+    auc = float(m.output["training_metrics"]["AUC"])
+    assert auc > 0.8, auc
+    # linear model: beta exists and strongest coefficient is 'a'
+    beta = np.asarray(m.output["beta"])
+    assert abs(beta[0]) > abs(beta[2])
+
+
+def test_xgboost_reg_alpha_guard(cl):
+    from h2o_tpu.models.tree.xgboost import XGBoost
+    with pytest.raises(ValueError, match="reg_alpha"):
+        XGBoost(booster="gbtree", reg_alpha=0.5)
+
+
+def test_grep_builder(cl):
+    from h2o_tpu.models.grep import Grep
+    lines = ["error: disk full", "all fine", "error: oom",
+             "warn: slow", "error: disk full"]
+    fr = Frame(["text"], [Vec(lines, T_STR)])
+    m = Grep(regex=r"error: \w+").train(training_frame=fr)
+    assert len(m.output["matches"]) == 3
+    assert m.output["matches"][0] == "error: disk"
+    assert m.output["offsets"][0] == 0
+    with pytest.raises(ValueError, match="regex"):
+        Grep().train(training_frame=fr)
+
+
+def test_tf_idf_rapids(cl):
+    from h2o_tpu.rapids import Session, rapids_exec
+    from h2o_tpu.core.cloud import cloud
+    docs = Frame(
+        ["doc", "text"],
+        [Vec(np.asarray([0, 1, 2], np.float32)),
+         Vec(["cat dog cat", "dog fish", "cat"], T_STR)],
+        key="tfidf_in")
+    cloud().dkv.put("tfidf_in", docs)
+    out = rapids_exec("(tf-idf tfidf_in 0 1 True True)", Session("_t"))
+    assert out.names == ["DocID", "Word", "TF", "IDF", "TF_IDF"]
+    rows = {(int(d), out.vec("Word").domain[int(w)]): (tf, idf)
+            for d, w, tf, idf in zip(
+                out.vec("DocID").to_numpy(), out.vec("Word").to_numpy(),
+                out.vec("TF").to_numpy(), out.vec("IDF").to_numpy())}
+    assert rows[(0, "cat")][0] == 2.0          # TF of cat in doc 0
+    # idf("cat") = log(4/3) (3 docs, df=2); idf("fish") = log(4/2)
+    assert np.isclose(rows[(1, "fish")][1], np.log(2.0), atol=1e-5)
+    cloud().dkv.remove("tfidf_in")
+
+
+def test_grid_parallelism(cl, rng):
+    from h2o_tpu.models.grid import GridSearch
+    from h2o_tpu.models.tree.gbm import GBM
+    n = 300
+    x = rng.normal(size=n).astype(np.float32)
+    y = (x + rng.normal(size=n) * 0.4 > 0).astype(np.int32)
+    fr = Frame(["x", "y"],
+               [Vec(x), Vec(y, T_CAT, domain=["a", "b"])])
+    gs = GridSearch(GBM, {"ntrees": [2, 3, 4, 5]},
+                    parallelism=2, max_depth=2, seed=1)
+    grid = gs.train(y="y", training_frame=fr)
+    assert len(grid.models) == 4
+    assert len(grid.hyper_values) == 4
+    got = sorted(hv["ntrees"] for hv in grid.hyper_values)
+    assert got == [2, 3, 4, 5]
