@@ -464,6 +464,111 @@ def sample_99(params):
     return {"value": "this is a sample endpoint"}
 
 
+@route("GET", r"/3/Frames/(?P<frame_id>[^/]+)/export/(?P<path>.+)"
+       r"/overwrite/(?P<force>[^/]+)")
+def frame_export_get(params, frame_id, path, force):
+    """GET-style export (water/api/FramesHandler.export legacy route)."""
+    from h2o_tpu.core.persist import save_frame
+    fr = _frame_or_404(frame_id)
+    p = "/" + path if not path.startswith("/") else path
+    if os.path.exists(p) and str(force).lower() != "true":
+        raise H2OError(400, f"{p} exists and overwrite=false")
+    save_frame(fr, p)
+    return {"frames": [{"frame_id": _key(frame_id, "Key<Frame>")}]}
+
+
+@route("POST", r"/3/Frames/(?P<frame_id>[^/]+)/save")
+def frame_save(params, frame_id):
+    """Binary frame snapshot (water/fvec/persist/FramePersist.save;
+    client h2o.save_frame? — the /3/Frames/load counterpart)."""
+    from h2o_tpu.core.persist import save_frame
+    fr = _frame_or_404(frame_id)
+    d = params.get("dir")
+    if not d:
+        raise H2OError(400, "dir is required")
+    from h2o_tpu.core.job import Job
+    job = Job(dest=frame_id, description=f"save {frame_id}")
+    cloud().jobs.start(
+        job, lambda j: save_frame(fr, os.path.join(d, str(frame_id))))
+    job.join()
+    return {"job": job.to_dict()}
+
+
+@route("DELETE", r"/3/Frames")
+def delete_all_frames(params):
+    """water/api/FramesHandler.deleteAll."""
+    dkv = cloud().dkv
+    for k in list(dkv.keys()):
+        if isinstance(dkv.get(k), Frame):
+            dkv.remove(k)
+    return {}
+
+
+@route("DELETE", r"/4/sessions/(?P<session_key>[^/]+)")
+def end_session_v4(params, session_key):
+    from h2o_tpu.api.handlers import _SESSIONS
+    _SESSIONS.pop(session_key, None)
+    return {"session_key": session_key}
+
+
+@route("GET", r"/3/Metadata/endpoints/(?P<path>.+)")
+def endpoint_detail(params, path):
+    from h2o_tpu.api.handlers import _routes_json
+    routes = _routes_json()
+    for r in routes:
+        if path in r["url_pattern"]:
+            return {"routes": [r]}
+    raise H2OError(404, f"no endpoint matching {path!r}")
+
+
+@route("GET", r"/3/Metadata/schemaclasses/(?P<classname>[^/]+)")
+def schema_class(params, classname):
+    from h2o_tpu.api import schemas
+    name = classname.rsplit(".", 1)[-1]
+    if schemas.schema_json(name) is None:
+        raise H2OError(404, f"schema class {classname} not found")
+    return schemas.metadata_response([name])
+
+
+@route("POST", r"/3/ModelBuilders/(?P<algo>[^/]+)/model_id")
+def calc_model_id(params, algo):
+    """Default model-key calculation (water/api/ModelBuilderHandler
+    calcModelId)."""
+    from h2o_tpu.core.store import Key
+    return {"model_id": _key(str(Key.make(algo)), "Key<Model>")}
+
+
+@route("GET", r"/99/Assembly\.fetch_mojo_pipeline"
+       r"/(?P<assembly_id>[^/]+)/(?P<file_name>[^/]+)")
+def assembly_mojo_pipeline(params, assembly_id, file_name):
+    raise H2OError(
+        501, "MOJO2 pipeline artifacts are a closed-spec format the "
+        "TPU rebuild does not emit; use the fitted Assembly's rapids "
+        "steps (GET /99/Assembly.java) or re-apply the pipeline "
+        "server-side")
+
+
+@route("POST", r"/3/ParseSVMLight")
+def parse_svmlight_route(params):
+    """h2o.import_file(..., parse_type='svmlight') /
+    water/api/ParseHandler.parseSVMLight."""
+    from h2o_tpu.core.parse import parse_svmlight
+    raw = params.get("source_frames") or params.get("source_keys") or ""
+    paths = [p.strip().strip('"').replace("nfs://", "")
+             for p in str(raw).strip("[]").split(",") if p.strip()]
+    if not paths:
+        raise H2OError(400, "source_frames is required")
+    dest = params.get("destination_frame")
+    fr = parse_svmlight(paths[0], dest)
+    cloud().dkv.put(str(fr.key), fr)
+    from h2o_tpu.core.job import Job
+    job = Job(dest=str(fr.key), description="ParseSVMLight")
+    cloud().jobs.start(job, lambda j: fr)
+    job.join()
+    return {"job": job.to_dict(),
+            "destination_frame": _key(str(fr.key), "Key<Frame>")}
+
+
 @route("GET", r"/3/h2o-genmodel.jar")
 def genmodel_jar(params):
     """The reference ships a Java scoring jar; the TPU rebuild's standalone

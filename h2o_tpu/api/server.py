@@ -216,6 +216,12 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send(e.status, self._error_json(
                         path, e.status, e.msg, e.msg,
                         "water.exceptions.H2OIllegalArgumentException"))
+                except NotImplementedError as e:
+                    # unimplemented surface (e.g. a rapids op): a clear
+                    # 501 naming the feature, not a stacktrace 500
+                    self._send(501, self._error_json(
+                        path, 501, str(e), str(e),
+                        "water.exceptions.H2ONotImplementedException"))
                 except Exception as e:  # noqa: BLE001 — REST surface
                     log.error("handler error on %s: %s\n%s", path, e,
                               traceback.format_exc())

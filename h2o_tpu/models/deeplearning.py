@@ -252,7 +252,8 @@ class DeepLearningModel(Model):
         P = jax.nn.softmax(o, axis=1)
         label = jnp.argmax(P, axis=1).astype(jnp.float32)
         if len(dom) == 2:
-            return jnp.stack([(P[:, 1] >= 0.5).astype(jnp.float32),
+            thr = float(out.get("default_threshold", 0.5))
+            return jnp.stack([(P[:, 1] >= thr).astype(jnp.float32),
                               P[:, 0], P[:, 1]], axis=1)
         return jnp.concatenate([label[:, None], P], axis=1)
 

@@ -303,7 +303,8 @@ class GLMModel(Model):
                       self.params.get("tweedie_power", 1.5))
         mu = fam.link_inv(eta)
         if dom is not None:
-            label = (mu >= 0.5).astype(jnp.float32)
+            thr = float(out.get("default_threshold", 0.5))
+            label = (mu >= thr).astype(jnp.float32)
             return jnp.stack([label, 1 - mu, mu], axis=1)
         return mu
 

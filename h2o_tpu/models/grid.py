@@ -6,10 +6,12 @@ HyperSpaceSearchCriteria.java (max_models / max_runtime_secs /
 stopping_{rounds,metric,tolerance}), hex/grid/Grid.java (collected models +
 failure tracking), api/GridSearchHandler.
 
-TPU note: models are trained sequentially — on a single mesh every model
-already saturates the chips, so the reference's parallel model building
-(ParallelModelBuilder.java) maps to sequential dispatches here; grids across
-multiple meshes are a deployment-level concern.
+TPU note: by default models train sequentially — on a single mesh every
+model already saturates the chips.  ``parallelism=N`` enables the
+reference's parallel model building (ParallelModelBuilder.java): N
+builders run concurrently per batch (useful when individual models are
+small and dispatch/host work dominates, or across a multi-mesh
+deployment); stop criteria are evaluated at batch boundaries.
 """
 
 from __future__ import annotations
@@ -211,7 +213,10 @@ class GridSearch:
                     grid.models.append(m)
                 cloud().dkv.put(m.key, m)
                 if rec is not None:
-                    rec.model_done(m)
+                    # Recovery.model_done read-modify-writes info.json;
+                    # serialize it across parallel workers
+                    with append_lock:
+                        rec.model_done(m)
                 return m
             except Exception as e:  # noqa: BLE001 — grid collects failures
                 log.warning("grid model failed (%s): %s", combo, e)

@@ -24,14 +24,14 @@ from h2o_tpu.models.tree import shared_tree as st
 EPS = 1e-10
 
 
-def raw_from_votes(F, ntrees: int, dom):
+def raw_from_votes(F, ntrees: int, dom, threshold: float = 0.5):
     """Accumulated per-tree votes -> raw predictions (mean over trees)."""
     F = F / max(int(ntrees), 1)
     if dom is None:
         return F[:, 0]
     if len(dom) == 2:
         p1 = jnp.clip(F[:, 0], 0.0, 1.0)
-        label = (p1 >= 0.5).astype(jnp.float32)
+        label = (p1 >= threshold).astype(jnp.float32)
         return jnp.stack([label, 1 - p1, p1], axis=1)
     P = jnp.maximum(F, 0.0)
     P = P / jnp.maximum(jnp.sum(P, axis=1, keepdims=True), EPS)
@@ -52,7 +52,9 @@ class DRFModel(Model):
                             jnp.asarray(out["value"]),
                             int(out["max_depth"]))
         return raw_from_votes(F, int(out["ntrees_actual"]),
-                              out.get("response_domain"))
+                              out.get("response_domain"),
+                              threshold=float(out.get(
+                                  "default_threshold", 0.5)))
 
 
 class DRF(ModelBuilder):

@@ -28,7 +28,8 @@ from h2o_tpu.models.tree import shared_tree as st
 EPS = 1e-10
 
 
-def raw_from_F(F, dom, dist_name: str, tweedie_power: float = 1.5):
+def raw_from_F(F, dom, dist_name: str, tweedie_power: float = 1.5,
+               threshold: float = 0.5):
     """Link-scale forest sum -> raw predictions (shared by BigScore-style
     full scoring and the driver's incremental per-block scoring)."""
     if dom is None:
@@ -36,7 +37,7 @@ def raw_from_F(F, dom, dist_name: str, tweedie_power: float = 1.5):
         return dist.link_inv(F[:, 0])
     if len(dom) == 2:
         p1 = jax.nn.sigmoid(F[:, 0])
-        label = (p1 >= 0.5).astype(jnp.float32)
+        label = (p1 >= threshold).astype(jnp.float32)
         return jnp.stack([label, 1 - p1, p1], axis=1)
     P = jax.nn.softmax(F, axis=1)
     label = jnp.argmax(P, axis=1).astype(jnp.float32)
@@ -63,7 +64,9 @@ class GBMModel(Model):
             F = F + frame.vec(off_col).data[:, None]
         return raw_from_F(F, out.get("response_domain"),
                           out["distribution_resolved"],
-                          self.params.get("tweedie_power", 1.5))
+                          self.params.get("tweedie_power", 1.5),
+                          threshold=float(out.get("default_threshold",
+                                                  0.5)))
 
 
 class GBM(ModelBuilder):

@@ -375,6 +375,57 @@ def write_glm_mojo(model) -> bytes:
     return w.finish(columns, domains)
 
 
+class _IFTreeEncoder(_TreeEncoder):
+    """Isolation-forest heap (split_col + raw thresholds, no bins) ->
+    genmodel bytecode.  Leaf value = leaf depth (the PathTracker
+    contribution); NA routes right (our `x < th` comparison is False for
+    NaN), numeric splits only."""
+
+    def __init__(self, split_col, thresh):
+        self.split_col = np.asarray(split_col)
+        self.thresh = np.asarray(thresh, np.float32)
+        self.H = len(self.split_col)
+        # value[n] = depth of node n in the heap (leaf contribution)
+        depths = np.floor(np.log2(np.arange(self.H) + 1)).astype(
+            np.float32)
+        self.value = depths
+        self.leaf_offset = np.float32(0.0)
+        self.leaf_transform = None
+        self._size_cache: Dict[int, int] = {}
+
+    def _split_parts(self, n: int):
+        return 0, NA_RIGHT, struct.pack(
+            "<f", np.float32(self.thresh[n]))
+
+
+def write_isofor_mojo(model) -> bytes:
+    """IsolationForest -> genmodel MOJO (IsolationForestMojoWriter key
+    set: n_trees + min/max path length; trees score total path length)."""
+    out = model.output
+    x = list(out["x"])
+    dom_map = out.get("domains") or {}
+    sc = np.asarray(out["split_col"])          # (T, H)
+    th = np.asarray(out["thresh"])
+    T = sc.shape[0]
+    domains: List[Optional[List[str]]] = [
+        (dom_map.get(c) if c in dom_map else None) for c in x]
+    w = _ZipWriter()
+    _common_info(w, "isolationforest", "Isolation Forest",
+                 "AnomalyDetection", str(model.key), False, len(x), 1,
+                 len(x), sum(d is not None for d in domains), "1.40")
+    w.writekv("n_trees", T)
+    w.writekv("n_trees_per_class", 1)
+    w.writekv("min_path_length", int(out["min_path_length"]))
+    w.writekv("max_path_length", int(out["max_path_length"]))
+    w.writekv("sample_size", int(out.get("sample_size", 0)))
+    for t in range(T):
+        enc = _IFTreeEncoder(sc[t], th[t])
+        blob, aux = enc.encode()
+        w.writeblob(f"trees/t00_{t:03d}.bin", blob)
+        w.writeblob(f"trees/t00_{t:03d}_aux.bin", aux)
+    return w.finish(x, domains)
+
+
 def write_kmeans_mojo(model) -> bytes:
     """KMeans -> genmodel MOJO (KMeansMojoWriter key set: standardize +
     standardize_means/mults + center_num/center_i).
@@ -483,6 +534,8 @@ def write_genmodel_mojo(model) -> bytes:
         return write_glm_mojo(model)
     if model.algo == "kmeans":
         return write_kmeans_mojo(model)
+    if model.algo == "isolationforest":
+        return write_isofor_mojo(model)
     if model.algo == "deeplearning":
         return write_deeplearning_mojo(model)
     raise NotImplementedError(
@@ -852,7 +905,7 @@ class GenmodelMojoModel:
         dom_lens = np.asarray(
             [len(d) if d is not None else 0
              for d in p["domains"][:X.shape[1]]], np.int64)
-        if p["algo"] in ("gbm", "drf"):
+        if p["algo"] in ("gbm", "drf", "isolationforest"):
             T = int(info["n_trees"])
             K = int(info.get("n_trees_per_class", 1))
             preds = np.zeros((X.shape[0], K))
@@ -860,6 +913,15 @@ class GenmodelMojoModel:
                 for k, tree in enumerate(group):
                     if tree is not None:
                         preds[:, k] += score_decoded_tree(tree, X, dom_lens)
+            if p["algo"] == "isolationforest":
+                # total path length -> normalized anomaly score
+                # (IsolationForestMojoModel.unifyPreds)
+                lo = float(info.get("min_path_length", 0))
+                hi = float(info.get("max_path_length", 1))
+                total = preds[:, 0]
+                score = (hi - total) / (hi - lo) if hi > lo else \
+                    np.ones_like(total)
+                return np.stack([score, total / max(T, 1)], axis=1)
             thr = float(info.get("default_threshold", 0.5))
             if p["algo"] == "gbm":
                 init_f = float(info.get("init_f", 0.0))

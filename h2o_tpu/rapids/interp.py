@@ -1674,21 +1674,7 @@ def _op_any_all(node, env, which: str):
 
 
 def _numlist_vals(node, env):
-    if isinstance(node, tuple) and node[0] == "numlist":
-        out = []
-        for item in node[1]:
-            if isinstance(item, tuple) and item[0] == "span":
-                lo, hi = item[1], item[2]
-                out.extend(float(x) for x in range(int(lo), int(hi) + 1))
-            elif isinstance(item, tuple):
-                out.append(float(item[1]))
-            else:
-                out.append(float(item))
-        return out
-    ev = _eval(node, env)
-    if isinstance(ev, (int, float)):
-        return [float(ev)]
-    return [float(x) for x in ev]
+    return [float(x) for x in _mixed_list(node, env)]
 
 
 def _mixed_list(node, env):
@@ -1944,10 +1930,12 @@ def _op_ls(node, env):
 
 
 def _op_getrow(node, env):
+    """(getrow fr) — 1xN frame -> N-element value list."""
     fr = _as_frame(_eval(node[1], env))
     if fr.nrows != 1:
         raise ValueError("getrow works on single-row frames only")
-    return float(np.asarray(fr.vecs[0].as_float())[0])
+    vals = [float(np.asarray(v.as_float())[0]) for v in fr.vecs]
+    return vals if len(vals) > 1 else vals[0]
 
 
 def _op_flatten(node, env):
@@ -2297,6 +2285,303 @@ def _op_tf_idf(node, env):
          Vec(out_tf), Vec(out_idf), Vec(out_tf * out_idf)])
 
 
+def _model_arg(node, env):
+    from h2o_tpu.core.cloud import cloud
+    mid = str(_lit(node))
+    m = cloud().dkv.get(mid)
+    if m is None:
+        raise ValueError(f"model {mid!r} not found")
+    return m
+
+
+def _op_reset_threshold(node, env):
+    """(model.reset.threshold model thr) — set the binomial label
+    threshold, return the old one (AstModelResetThreshold; client
+    h2o.utils.model_utils.reset_model_threshold)."""
+    m = _model_arg(node[1], env)
+    thr = float(_eval(node[2], env))
+    old = float(m.output.get("default_threshold", 0.5))
+    if not 0.0 < thr < 1.0:
+        raise ValueError(f"threshold must be in (0,1), got {thr}")
+    m.output["default_threshold"] = thr
+    return Frame(["threshold"],
+                 [Vec(np.asarray([old], np.float32))])
+
+
+def _op_permutation_varimp(node, env):
+    """(PermutationVarImp model fr metric n_samples n_repeats features
+    seed) — permutation importance (ref hex/PermutationVarImp.java):
+    metric degradation when one column is shuffled."""
+    from h2o_tpu.models.score_keeper import (is_maximizing,
+                                             resolve_stopping_metric)
+    m = _model_arg(node[1], env)
+    fr = _as_frame(_eval(node[2], env))
+    metric = str(_lit(node[3]) or "AUTO")
+    n_samples = int(_eval(node[4], env) or -1)
+    n_repeats = max(int(_eval(node[5], env) or 1), 1)
+    feats_node = node[6]
+    features = [str(s) for s in _mixed_list(feats_node, env)] \
+        if not (isinstance(feats_node, tuple) and
+                feats_node[0] == "id" and feats_node[1] == "None") else []
+    seed = int(_eval(node[7], env) or -1)
+    rng = np.random.default_rng(seed if seed >= 0 else None)
+
+    work = fr
+    if 0 < n_samples < fr.nrows:
+        idx = rng.choice(fr.nrows, size=n_samples, replace=False)
+        work = fr.slice_rows(np.sort(idx))
+    x = [c for c in m.output.get("x", []) if c in work.names]
+    features = features or x
+
+    def metric_of(frame) -> float:
+        mm = m.model_metrics(frame)
+        name = metric
+        if name.upper() == "AUTO":
+            name = resolve_stopping_metric("AUTO", mm.kind)
+        v = mm.get(name)
+        if v is None:
+            v = mm.get(name.upper())
+        if v is None:
+            v = mm.get(name.lower())
+        if v is None:
+            raise ValueError(f"metric {metric!r} not available")
+        return float(v), name
+
+    base, name = metric_of(work)
+    maximize = is_maximizing(name)
+    rows = []
+    for c in features:
+        drops = []
+        for _ in range(n_repeats):
+            shuf = Frame(list(work.names), list(work.vecs))
+            v = work.vec(c)
+            perm = rng.permutation(work.nrows)
+            arr = v.to_numpy()[perm]
+            shuf.vecs[work.names.index(c)] = Vec(
+                np.asarray(arr, np.int32), T_CAT,
+                domain=list(v.domain)) if v.is_categorical else Vec(
+                np.asarray(arr, np.float32), v.type)
+            pv, _ = metric_of(shuf)
+            drops.append((base - pv) if maximize else (pv - base))
+        rows.append((c, [max(d, 0.0) for d in drops]))
+    if n_repeats > 1:
+        names = ["Variable"] + [f"Run {i+1}" for i in range(n_repeats)]
+        dom = [r[0] for r in rows]
+        vecs = [Vec(np.arange(len(dom), dtype=np.int32), T_CAT,
+                    domain=dom)]
+        for i in range(n_repeats):
+            vecs.append(Vec(np.asarray([r[1][i] for r in rows],
+                                       np.float32)))
+        return Frame(names, vecs)
+    rel = np.asarray([r[1][0] for r in rows], np.float64)
+    mx, tot = max(rel.max(), 1e-30), max(rel.sum(), 1e-30)
+    dom = [r[0] for r in rows]
+    return Frame(
+        ["Variable", "Relative Importance", "Scaled Importance",
+         "Percentage"],
+        [Vec(np.arange(len(dom), dtype=np.int32), T_CAT, domain=dom),
+         Vec(rel.astype(np.float32)),
+         Vec((rel / mx).astype(np.float32)),
+         Vec((rel / tot).astype(np.float32))])
+
+
+def _op_pred_vs_actual_by_var(node, env):
+    """(predicted.vs.actual.by.var model fr variable predicted) — mean
+    predicted vs actual per level of `variable`."""
+    m = _model_arg(node[1], env)
+    fr = _as_frame(_eval(node[2], env))
+    var = str(_lit(node[3]))
+    pf = _as_frame(_eval(node[4], env))
+    if var not in fr.names:
+        raise ValueError(f"column {var!r} not in frame")
+    y = m.params.get("response_column")
+    v = fr.vec(var)
+    if not v.is_categorical:
+        raise ValueError("predicted.vs.actual.by.var wants a "
+                         "categorical variable")
+    codes = np.asarray(v.to_numpy(), np.int64)
+    pred = np.asarray(pf.vecs[-1].to_numpy(), np.float64)[: fr.nrows]
+    yv = fr.vec(y)
+    act = np.asarray(yv.as_float() if yv.is_categorical
+                     else yv.to_numpy(), np.float64)[: fr.nrows]
+    card = len(v.domain or [])
+    rows_p, rows_a = [], []
+    for k in range(card):
+        sel = codes == k
+        okp = sel & ~np.isnan(pred)
+        oka = sel & ~np.isnan(act)
+        rows_p.append(float(pred[okp].mean()) if okp.any()
+                      else float("nan"))
+        rows_a.append(float(act[oka].mean()) if oka.any()
+                      else float("nan"))
+    return Frame(
+        [var, "predicted", "actual"],
+        [Vec(np.arange(card, dtype=np.int32), T_CAT,
+             domain=list(v.domain)),
+         Vec(np.asarray(rows_p, np.float32)),
+         Vec(np.asarray(rows_a, np.float32))])
+
+
+def _op_fairness_metrics(node, env):
+    """(fairnessMetrics model fr protected_cols reference
+    favorable_class) — per-group confusion/rate metrics plus adverse-
+    impact ratios vs the reference group (ref hex/AstFairnessMetrics)."""
+    m = _model_arg(node[1], env)
+    fr = _as_frame(_eval(node[2], env))
+    prot = [str(s) for s in _mixed_list(node[3], env)]
+    ref_levels = [str(s) for s in _mixed_list(node[4], env)]
+    favorable = str(_lit(node[5]))
+    y = m.params.get("response_column")
+    yv = fr.vec(y)
+    dom = list(yv.domain or [])
+    if len(dom) != 2:
+        raise ValueError("fairnessMetrics supports binomial models "
+                         f"(response has {len(dom)} levels)")
+    if favorable not in dom:
+        raise ValueError(f"favorable_class {favorable!r} not in response "
+                         f"domain {dom}")
+    fav = dom.index(favorable)
+    raw = np.asarray(m.predict_raw(fr))[: fr.nrows]
+    thr = float(m.output.get("default_threshold", 0.5))
+    p_fav = raw[:, 2] if fav == 1 else raw[:, 1]
+    pred_fav = p_fav >= thr
+    act = np.asarray(yv.to_numpy(), np.int64)
+    act_fav = act == fav
+
+    groups = [np.asarray(fr.vec(c).to_numpy(), np.int64) for c in prot]
+    doms = [list(fr.vec(c).domain or []) for c in prot]
+    import itertools as _it
+    combos = list(_it.product(*[range(len(d)) for d in doms]))
+
+    def rates(sel):
+        n = int(sel.sum())
+        if n == 0:
+            return None
+        tp = int((sel & pred_fav & act_fav).sum())
+        fp = int((sel & pred_fav & ~act_fav).sum())
+        fn = int((sel & ~pred_fav & act_fav).sum())
+        tn = int((sel & ~pred_fav & ~act_fav).sum())
+        return dict(total=n, tp=tp, fp=fp, fn=fn, tn=tn,
+                    selected=(tp + fp) / n,
+                    tpr=tp / max(tp + fn, 1), fpr=fp / max(fp + tn, 1),
+                    accuracy=(tp + tn) / n)
+    ref_sel = np.ones(fr.nrows, bool)
+    if ref_levels:
+        for g, d, lev in zip(groups, doms, ref_levels):
+            ref_sel &= g == (d.index(lev) if lev in d else -2)
+    ref_r = rates(ref_sel) or dict(selected=1.0, tpr=1.0, fpr=1.0,
+                                   accuracy=1.0, total=0, tp=0, fp=0,
+                                   fn=0, tn=0)
+    cols: Dict[str, list] = {c: [] for c in prot}
+    met: Dict[str, list] = {k: [] for k in
+                            ("total", "tp", "fp", "fn", "tn",
+                             "selectedRatio", "tpr", "fpr", "accuracy",
+                             "AIR_selectedRatio")}
+    for combo in combos:
+        sel = np.ones(fr.nrows, bool)
+        for g, k in zip(groups, combo):
+            sel &= g == k
+        r = rates(sel)
+        if r is None:
+            continue
+        for c, k, d in zip(prot, combo, doms):
+            cols[c].append(d[k])
+        met["total"].append(r["total"])
+        for k in ("tp", "fp", "fn", "tn"):
+            met[k].append(r[k])
+        met["selectedRatio"].append(r["selected"])
+        met["tpr"].append(r["tpr"])
+        met["fpr"].append(r["fpr"])
+        met["accuracy"].append(r["accuracy"])
+        met["AIR_selectedRatio"].append(
+            r["selected"] / max(ref_r["selected"], 1e-12))
+    names, vecs = [], []
+    for c in prot:
+        d = sorted(set(cols[c]))
+        vecs.append(Vec(np.asarray([d.index(v) for v in cols[c]],
+                                   np.int32), T_CAT, domain=d))
+        names.append(c)
+    for k, vals in met.items():
+        names.append(k)
+        vecs.append(Vec(np.asarray(vals, np.float32)))
+    return Frame(names, vecs)
+
+
+def _op_isax(node, env):
+    """(isax fr num_words max_cardinality optimize_card) — AstIsax:
+    per-row z-normalize, PAA into num_words segments, symbolize against
+    gaussian breakpoints; emits the iSAX word string per row."""
+    from scipy.special import ndtri  # inverse normal CDF (scipy is baked)
+    fr = _as_frame(_eval(node[1], env))
+    num_words = int(_eval(node[2], env))
+    card = int(_eval(node[3], env))
+    optimize_card = bool(_eval(node[4], env))
+    if optimize_card:
+        raise NotImplementedError(
+            "isax optimize_card=True (per-word cardinality reduction) is "
+            "not implemented; pass optimize_card=False")
+    if num_words <= 0 or card <= 1:
+        raise ValueError("isax: num_words > 0 and max_cardinality > 1")
+    X = np.asarray(fr.as_matrix())[: fr.nrows].astype(np.float64)
+    R, C = X.shape
+    mu = np.nanmean(X, axis=1, keepdims=True)
+    sd = np.nanstd(X, axis=1, keepdims=True)
+    Z = (X - mu) / np.where(sd == 0, 1.0, sd)
+    # PAA: average over num_words equal segments
+    edges = np.linspace(0, C, num_words + 1).astype(int)
+    paa = np.stack([np.nanmean(Z[:, edges[i]: max(edges[i + 1],
+                                                  edges[i] + 1)], axis=1)
+                    for i in range(num_words)], axis=1)
+    brk = ndtri(np.arange(1, card) / card)     # card-1 breakpoints
+    sym = np.searchsorted(brk, np.nan_to_num(paa))     # (R, W) in [0,card)
+    words = ["_".join(f"{int(s)}^{card}" for s in row) for row in sym]
+    dom = sorted(set(words))
+    codes = np.asarray([dom.index(w) for w in words], np.int32)
+    return Frame(["iSAX_index"], [Vec(codes, T_CAT, domain=dom)])
+
+
+def _op_make_leaderboard(node, env):
+    """(makeLeaderboard [model_ids] lb_frame_key sort_metric
+    extra_columns scoring_data) — h2o.make_leaderboard (client
+    scoring.py:62; ref water/rapids/prims/AstMakeLeaderboard)."""
+    from h2o_tpu.core.cloud import cloud
+    from h2o_tpu.models.leaderboard import Leaderboard
+    ids = [str(s) for s in _mixed_list(node[1], env)]
+    lb_key = str(_lit(node[2]) or "")
+    sort_metric = str(_lit(node[3]) or "AUTO")
+    lb_frame = cloud().dkv.get(lb_key) if lb_key else None
+    models = []
+    for mid in ids:
+        m = cloud().dkv.get(mid)
+        if m is None:
+            raise ValueError(f"makeLeaderboard: model {mid!r} not found")
+        models.append(m)
+    lb = Leaderboard(sort_metric=None if sort_metric.upper() == "AUTO"
+                     else sort_metric.lower(),
+                     leaderboard_frame=lb_frame)
+    lb.add(*models)
+    rows = lb.rows()
+    if not rows:
+        raise ValueError("makeLeaderboard: no models")
+    names = [k for k in rows[0] if k != "algo"]
+    vecs = []
+    out_names = []
+    for nname in names:
+        vals = [r[nname] for r in rows]
+        if nname == "model_id":
+            dom = [str(v) for v in vals]
+            # domains must be unique-sorted; codes map row -> label
+            uniq = sorted(set(dom))
+            codes = np.asarray([uniq.index(v) for v in dom], np.int32)
+            vecs.append(Vec(codes, T_CAT, domain=uniq))
+        else:
+            vecs.append(Vec(np.asarray(
+                [np.nan if v is None else float(v) for v in vals],
+                np.float32)))
+        out_names.append(nname)
+    return Frame(out_names, vecs)
+
+
 def _op_segment_models_as_frame(node, env):
     """(segment_models_as_frame sm_id) — AstSegmentModelsAsFrame
     (h2o-py segment_models.py:48): tabular view of a SegmentModels
@@ -2313,6 +2598,12 @@ def _op_segment_models_as_frame(node, env):
 _EXTRA_OPS = {
     "tf-idf": _op_tf_idf,
     "segment_models_as_frame": _op_segment_models_as_frame,
+    "makeLeaderboard": _op_make_leaderboard,
+    "model.reset.threshold": _op_reset_threshold,
+    "PermutationVarImp": _op_permutation_varimp,
+    "predicted.vs.actual.by.var": _op_pred_vs_actual_by_var,
+    "fairnessMetrics": _op_fairness_metrics,
+    "isax": _op_isax,
     "not": _op_not,
     "as.character": _op_as_character,
     "is.character": _op_is_character,

@@ -187,3 +187,20 @@ def test_deeplearning_mojo_regression(cl, rng):
     m = DeepLearning(hidden=[8], epochs=2, seed=1).train(
         y="y", training_frame=fr)
     _cross_score(m, fr, tol=1e-4)
+
+
+def test_isofor_mojo_cross_scoring(cl, rng):
+    """IsolationForest MOJO: anomaly-score parity (threshold trees,
+    leaf value = path depth, min/max path normalization)."""
+    from h2o_tpu.models.tree.isofor import IsolationForest
+    n = 300
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    X[:5] += 6.0                                  # planted outliers
+    fr = Frame([f"x{j}" for j in range(4)],
+               [Vec(X[:, j]) for j in range(4)])
+    m = IsolationForest(ntrees=20, seed=1).train(training_frame=fr)
+    blob = _cross_score(m, fr, tol=1e-5)
+    with zipfile.ZipFile(io.BytesIO(blob)) as z:
+        ini = z.read("model.ini").decode()
+        assert "algo = isolationforest" in ini
+        assert "max_path_length" in ini

@@ -204,3 +204,104 @@ def test_rulefit_predict_rules(cl, sess, rng):
     assert 0 < vals.sum() < n
     cloud().dkv.remove("r3m")
     cloud().dkv.remove(str(m.key))
+
+
+def test_make_leaderboard(cl, sess, rng):
+    from h2o_tpu.models.tree.gbm import GBM
+    from h2o_tpu.models.glm import GLM
+    n = 300
+    x = rng.normal(size=n).astype(np.float32)
+    y = (x + rng.normal(size=n) * 0.3 > 0).astype(np.int32)
+    fr = Frame(["x", "y"], [Vec(x), Vec(y, T_CAT, domain=["a", "b"])])
+    m1 = GBM(ntrees=5, max_depth=2, seed=1).train(y="y",
+                                                  training_frame=fr)
+    m2 = GLM(family="binomial", lambda_=0.0).train(y="y",
+                                                   training_frame=fr)
+    out = _ex(f'(makeLeaderboard ["{m1.key}", "{m2.key}"] "" "AUTO" '
+              f'"ALL" "AUTO")', sess)
+    assert "model_id" in out.names and "auc" in out.names
+    assert out.nrows == 2
+    aucs = out.vec("auc").to_numpy()
+    assert (aucs[0] >= aucs[1] - 1e-12)       # sorted best-first
+    for k in (str(m1.key), str(m2.key)):
+        cloud().dkv.remove(k)
+
+
+def test_reset_threshold_and_permutation_varimp(cl, sess, rng):
+    from h2o_tpu.models.tree.gbm import GBM
+    n = 400
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    y = (x1 + rng.normal(size=n) * 0.3 > 0).astype(np.int32)
+    fr = Frame(["x1", "x2", "y"],
+               [Vec(x1), Vec(x2), Vec(y, T_CAT, domain=["a", "b"])])
+    _put(fr, "r3t")
+    m = GBM(ntrees=8, max_depth=3, seed=1).train(y="y",
+                                                 training_frame=fr)
+    # threshold: labels move when the threshold moves
+    lab_before = np.asarray(m.predict_raw(fr))[:n, 0]
+    out = _ex(f"(model.reset.threshold {m.key} 0.9)", sess)
+    assert float(out.vecs[0].to_numpy()[0]) == 0.5      # old value
+    lab_after = np.asarray(m.predict_raw(fr))[:n, 0]
+    assert lab_after.sum() < lab_before.sum()
+    # permutation varimp: signal column dominates
+    pv = _ex(f'(PermutationVarImp {m.key} r3t "AUTO" -1 1 None 42)',
+             sess)
+    dom = pv.vecs[0].domain
+    rel = pv.vec("Relative Importance").to_numpy()
+    by = {dom[int(c)]: float(v) for c, v in
+          zip(pv.vecs[0].to_numpy(), rel)}
+    assert by["x1"] > by["x2"]
+    cloud().dkv.remove("r3t")
+    cloud().dkv.remove(str(m.key))
+
+
+def test_pred_vs_actual_and_fairness(cl, sess, rng):
+    from h2o_tpu.models.tree.gbm import GBM
+    n = 600
+    g = rng.integers(0, 2, size=n)
+    x = rng.normal(size=n).astype(np.float32)
+    y = (x + g * 0.8 + rng.normal(size=n) * 0.3 > 0.4).astype(np.int32)
+    fr = Frame(["x", "grp", "y"],
+               [Vec(x), Vec(g.astype(np.int32), T_CAT,
+                            domain=["g0", "g1"]),
+                Vec(y, T_CAT, domain=["no", "yes"])])
+    _put(fr, "r3u")
+    m = GBM(ntrees=8, max_depth=3, seed=1).train(y="y",
+                                                 training_frame=fr)
+    pf = m.predict(fr)
+    _put(pf, "r3up")
+    pa = _ex(f'(predicted.vs.actual.by.var {m.key} r3u "grp" r3up)',
+             sess)
+    assert pa.names == ["grp", "predicted", "actual"]
+    acts = pa.vec("actual").to_numpy()
+    assert acts[1] > acts[0]            # g1 has higher positive rate
+    fm = _ex(f'(fairnessMetrics {m.key} r3u ["grp"] ["g0"] "yes")', sess)
+    assert "AIR_selectedRatio" in fm.names
+    air = {fm.vecs[0].domain[int(c)]: float(v) for c, v in
+           zip(fm.vecs[0].to_numpy(),
+               fm.vec("AIR_selectedRatio").to_numpy())}
+    assert abs(air["g0"] - 1.0) < 1e-6       # reference group AIR == 1
+    assert air["g1"] > 1.0                   # favored group selects more
+    cloud().dkv.remove("r3u")
+    cloud().dkv.remove("r3up")
+    cloud().dkv.remove(str(m.key))
+
+
+def test_isax(cl, sess, rng):
+    n, C = 20, 32
+    base = np.sin(np.linspace(0, 4 * np.pi, C))
+    X = np.stack([base + rng.normal(size=C) * 0.05 for _ in range(n)]
+                 + [-base + rng.normal(size=C) * 0.05 for _ in range(n)])
+    fr = Frame([f"c{j}" for j in range(C)],
+               [Vec(X[:, j].astype(np.float32)) for j in range(C)])
+    _put(fr, "r3s")
+    out = _ex("(isax r3s 4 8 False)", sess)
+    assert out.names == ["iSAX_index"]
+    codes = out.vecs[0].to_numpy()
+    # the two shape families symbolize differently
+    assert len(set(codes[:n])) < len(set(codes))
+    assert set(codes[:n]).isdisjoint(set(codes[n:]))
+    w = out.vecs[0].domain[int(codes[0])]
+    assert "^8" in w and w.count("_") == 3
+    cloud().dkv.remove("r3s")

@@ -376,3 +376,18 @@ def test_honest_501s(h2o_client):
         with pytest.raises(urllib.error.HTTPError) as ei:
             _post(srv, path)
         assert ei.value.code == 501
+
+
+def test_small_routes(h2o_client, small_frame, tmp_path):
+    h2o, srv = h2o_client
+    fid = small_frame.frame_id
+    # frame binary save + metadata detail + model_id calc + session end
+    _post(srv, f"/3/Frames/{fid}/save?dir={tmp_path}")
+    assert (tmp_path / fid / "frame.json").exists()
+    r = _get(srv, "/3/Metadata/endpoints/Frames")
+    assert r["routes"]
+    mid = _post(srv, "/3/ModelBuilders/gbm/model_id")["model_id"]["name"]
+    assert mid.startswith("gbm")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(srv, "/99/Assembly.fetch_mojo_pipeline/x/y")
+    assert ei.value.code == 501
