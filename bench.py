@@ -182,18 +182,21 @@ def main():
     fr = _frame(X, y)
 
     detail = {"rows": rows, "cols": cols}
-    if "gbm" in configs:
-        detail["gbm"] = bench_gbm(fr, rows, trees, depth)
-    if "drf" in configs:
-        detail["drf"] = bench_drf(fr, rows, trees, depth)
-    if "glm" in configs:
-        detail["glm"] = bench_glm(fr, rows)
-    if "dl" in configs:
-        detail["dl"] = bench_dl(fr, rows)
-    if "hist" in configs:
-        detail["hist_kernel"] = bench_hist_mfu(rows, cols)
-    if "gbm10m" in configs:
-        detail["gbm_10m"] = bench_gbm10m(cols, depth)
+    runs = [("gbm", lambda: bench_gbm(fr, rows, trees, depth)),
+            ("drf", lambda: bench_drf(fr, rows, trees, depth)),
+            ("glm", lambda: bench_glm(fr, rows)),
+            ("dl", lambda: bench_dl(fr, rows)),
+            ("hist", lambda: bench_hist_mfu(rows, cols)),
+            ("gbm10m", lambda: bench_gbm10m(cols, depth))]
+    names = {"hist": "hist_kernel", "gbm10m": "gbm_10m"}
+    for cfg, fn in runs:
+        if cfg not in configs:
+            continue
+        try:
+            detail[names.get(cfg, cfg)] = fn()
+        except Exception as e:  # noqa: BLE001 — one failed config must
+            # not lose the rest of the ladder's measurements
+            detail[names.get(cfg, cfg)] = {"error": repr(e)}
 
     head = detail.get("gbm") or detail.get("gbm_10m") or \
         next((v for v in detail.values() if isinstance(v, dict)), {})

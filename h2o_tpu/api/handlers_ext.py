@@ -552,14 +552,14 @@ def assembly_mojo_pipeline(params, assembly_id, file_name):
 def parse_svmlight_route(params):
     """h2o.import_file(..., parse_type='svmlight') /
     water/api/ParseHandler.parseSVMLight."""
-    from h2o_tpu.core.parse import parse_svmlight
+    from h2o_tpu.core.parse import parse_svmlight_multi
     raw = params.get("source_frames") or params.get("source_keys") or ""
     paths = [p.strip().strip('"').replace("nfs://", "")
              for p in str(raw).strip("[]").split(",") if p.strip()]
     if not paths:
         raise H2OError(400, "source_frames is required")
     dest = params.get("destination_frame")
-    fr = parse_svmlight(paths[0], dest)
+    fr = parse_svmlight_multi(paths, dest)
     cloud().dkv.put(str(fr.key), fr)
     from h2o_tpu.core.job import Job
     job = Job(dest=str(fr.key), description="ParseSVMLight")

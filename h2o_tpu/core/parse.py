@@ -117,6 +117,32 @@ def parse_setup(paths: Sequence[str], sample_lines: int = 200,
             else:
                 types.append(T_NUM)
         return ParseSetupResult(",", True, list(sch.names), types)
+    if paths[0].endswith((".xls", ".xlsx")):
+        raise NotImplementedError(
+            "XLS/XLSX ingest needs a spreadsheet reader this image does "
+            "not ship (reference: water/parser/XlsParser); export the "
+            "sheet to CSV/Parquet and re-import")
+    if paths[0].endswith(".orc") or _is_orc(paths[0]):
+        from pyarrow import orc as _orc
+        import pyarrow as pa
+        sch = _orc.ORCFile(paths[0]).schema
+        types = []
+        for f in sch:
+            if pa.types.is_dictionary(f.type) or \
+                    pa.types.is_string(f.type) or \
+                    pa.types.is_large_string(f.type):
+                types.append(T_CAT)
+            elif pa.types.is_timestamp(f.type) or pa.types.is_date(f.type):
+                types.append(T_TIME)
+            else:
+                types.append(T_NUM)
+        return ParseSetupResult(",", True, list(sch.names), types)
+    if paths[0].endswith(".avro") or _is_avro(paths[0]):
+        from h2o_tpu.core.avro import read_avro
+        names_v, kinds_v, _cols = read_avro(paths[0])
+        return ParseSetupResult(
+            ",", True, names_v,
+            [T_NUM if k == "num" else T_CAT for k in kinds_v])
     if paths[0].endswith(".arff") or _looks_like_arff(paths[0]):
         names_a, types_a, _doms = _arff_schema(paths[0])
         return ParseSetupResult(",", True, names_a, types_a)
@@ -287,28 +313,20 @@ def parse_files(paths: Sequence[str], setup: Optional[ParseSetupResult] = None,
     if first.endswith(".orc") or _is_orc(first):
         fr = parse_orc(paths, dest)
         return _apply_setup_overrides(fr, setup, column_types)
+    if first.endswith(".avro") or _is_avro(first):
+        fr = parse_avro(paths, dest)
+        return _apply_setup_overrides(fr, setup, column_types)
+    if first.endswith((".xls", ".xlsx")):
+        raise NotImplementedError(
+            "XLS/XLSX ingest needs a spreadsheet reader this image does "
+            "not ship (reference: water/parser/XlsParser); export the "
+            "sheet to CSV/Parquet and re-import")
     if first.endswith(".arff") or _looks_like_arff(first):
         fr = parse_arff(first, dest) if len(paths) == 1 else \
             _rbind_frames([parse_arff(p) for p in paths], dest)
         return _apply_setup_overrides(fr, setup, column_types)
     if first.endswith((".svm", ".svmlight")):
-        if len(paths) == 1:
-            fr = parse_svmlight(first, dest)
-        else:
-            frames = [parse_svmlight(p) for p in paths]
-            # per-file max feature index varies: pad narrower frames with
-            # zero columns to the union width before concatenating
-            width = max(f.ncols for f in frames)
-            names = max((f.names for f in frames), key=len)
-            padded = []
-            for f in frames:
-                if f.ncols < width:
-                    vecs = list(f.vecs) + [
-                        Vec(np.zeros(f.nrows, np.float32))
-                        for _ in range(width - f.ncols)]
-                    f = Frame(list(names), vecs)
-                padded.append(f)
-            fr = _rbind_frames(padded, dest)
+        fr = parse_svmlight_multi(paths, dest)
         return _apply_setup_overrides(fr, setup, column_types)
     setup = setup or parse_setup(paths)
     if column_types:
@@ -363,6 +381,14 @@ def parse_files(paths: Sequence[str], setup: Optional[ParseSetupResult] = None,
     fr = Frame(names, vecs, key=dest or os.path.basename(paths[0]))
     log.info("parsed %s: %d rows, %d cols", paths, fr.nrows, fr.ncols)
     return fr
+
+
+def _is_avro(path: str) -> bool:
+    try:
+        with open(path, "rb") as f:
+            return f.read(4) == b"Obj\x01"
+    except (OSError, UnicodeDecodeError):
+        return False
 
 
 def _is_orc(path: str) -> bool:
@@ -627,6 +653,64 @@ def _arrow_to_frame(tables, paths, dest, fmt: str) -> Frame:
     log.info("parsed %s %s: %d rows, %d cols", fmt, paths, fr.nrows,
              fr.ncols)
     return fr
+
+
+def parse_avro(paths: Sequence[str],
+               dest: Optional[str] = None) -> Frame:
+    """Avro containers via the first-party from-spec reader
+    (core/avro.py; reference h2o-parsers/h2o-avro-parser)."""
+    from h2o_tpu.core.avro import read_avro
+    all_names, all_kinds, cols = None, None, None
+    for p in paths:
+        names, kinds, columns = read_avro(p)
+        if all_names is None:
+            all_names, all_kinds, cols = names, kinds, columns
+        else:
+            if names != all_names or kinds != all_kinds:
+                raise ValueError(
+                    f"avro schema mismatch in {p}: "
+                    f"{list(zip(names, kinds))} vs "
+                    f"{list(zip(all_names, all_kinds))}")
+            for acc, c in zip(cols, columns):
+                acc.extend(c)
+    vecs = []
+    for kind, col in zip(all_kinds, cols):
+        if kind == "num":
+            vecs.append(Vec(np.asarray(
+                [np.nan if v is None else float(v) for v in col],
+                np.float32)))
+        else:
+            dom = sorted({str(v) for v in col if v is not None})
+            lut = {d: i for i, d in enumerate(dom)}
+            codes = np.asarray([lut[str(v)] if v is not None else -1
+                                for v in col], np.int32)
+            vecs.append(Vec(codes, T_CAT, domain=dom))
+    fr = Frame(list(all_names), vecs,
+               key=dest or os.path.basename(paths[0]))
+    log.info("parsed avro %s: %d rows, %d cols", paths, fr.nrows,
+             fr.ncols)
+    return fr
+
+
+def parse_svmlight_multi(paths: Sequence[str],
+                         dest: Optional[str] = None) -> Frame:
+    """Multi-file SVMLight: per-file max feature index varies, so
+    narrower frames pad with zero columns to the union width before
+    concatenating (the reference's SVMLight chunk-union semantics)."""
+    if len(paths) == 1:
+        return parse_svmlight(paths[0], dest)
+    frames = [parse_svmlight(p) for p in paths]
+    width = max(f.ncols for f in frames)
+    names = max((f.names for f in frames), key=len)
+    padded = []
+    for f in frames:
+        if f.ncols < width:
+            vecs = list(f.vecs) + [
+                Vec(np.zeros(f.nrows, np.float32))
+                for _ in range(width - f.ncols)]
+            f = Frame(list(names), vecs)
+        padded.append(f)
+    return _rbind_frames(padded, dest)
 
 
 def parse_svmlight(path: str, dest: Optional[str] = None) -> Frame:

@@ -278,6 +278,8 @@ def write_tree_mojo(model) -> bytes:
                  sum(d is not None for d in domains), "1.30")
     w.writekv("n_trees", T)
     w.writekv("n_trees_per_class", K)
+    w.writekv("default_threshold",
+              float(out.get("default_threshold", 0.5)))
     dist = out.get("distribution_resolved", "gaussian")
     if algo == "gbm":
         fam, link = _GBM_DIST.get(dist, ("gaussian", "identity"))
@@ -362,6 +364,8 @@ def write_glm_mojo(model) -> bytes:
     w.writekv("cats", len(cat_names))
     w.writekv("cat_offsets", cat_offsets)
     w.writekv("nums", len(num_names))
+    w.writekv("default_threshold",
+              float(out.get("default_threshold", 0.5)))
     w.writekv("mean_imputation", True)
     w.writekv("num_means", [float(m) for m in means])
     w.writekv("cat_modes", [0] * len(cat_names))
@@ -503,6 +507,8 @@ def write_deeplearning_mojo(model) -> bytes:
                  ("Multinomial" if nclass > 2 else "Regression"),
                  str(model.key), True, len(x), nclass, len(columns),
                  sum(d is not None for d in domains), "1.10")
+    w.writekv("default_threshold",
+              float(out.get("default_threshold", 0.5)))
     w.writekv("mini_batch_size", 1)
     w.writekv("nums", len(num_names))
     w.writekv("cats", len(cat_names))
@@ -1036,7 +1042,11 @@ class GenmodelMojoModel:
             if nclass >= 2:
                 e = np.exp(h - h.max(axis=1, keepdims=True))
                 P = e / e.sum(axis=1, keepdims=True)
-                label = np.argmax(P, axis=1).astype(np.float64)
+                if nclass == 2:
+                    thr = float(info.get("default_threshold", 0.5))
+                    label = (P[:, 1] >= thr).astype(np.float64)
+                else:
+                    label = np.argmax(P, axis=1).astype(np.float64)
                 return np.concatenate([label[:, None], P], axis=1)
             return h[:, 0]
         raise NotImplementedError(p["algo"])

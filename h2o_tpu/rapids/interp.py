@@ -2442,9 +2442,9 @@ def _op_fairness_metrics(node, env):
                          f"domain {dom}")
     fav = dom.index(favorable)
     raw = np.asarray(m.predict_raw(fr))[: fr.nrows]
-    thr = float(m.output.get("default_threshold", 0.5))
-    p_fav = raw[:, 2] if fav == 1 else raw[:, 1]
-    pred_fav = p_fav >= thr
+    # raw[:, 0] is the model's own thresholded label — selection must
+    # agree with what the model predicts, whichever class is favorable
+    pred_fav = raw[:, 0].astype(np.int64) == fav
     act = np.asarray(yv.to_numpy(), np.int64)
     act_fav = act == fav
 
@@ -2549,6 +2549,8 @@ def _op_make_leaderboard(node, env):
     ids = [str(s) for s in _mixed_list(node[1], env)]
     lb_key = str(_lit(node[2]) or "")
     sort_metric = str(_lit(node[3]) or "AUTO")
+    extra_cols = [str(s).lower() for s in _mixed_list(node[4], env)]
+    scoring_data = str(_lit(node[5]) or "AUTO").lower()
     lb_frame = cloud().dkv.get(lb_key) if lb_key else None
     models = []
     for mid in ids:
@@ -2559,16 +2561,49 @@ def _op_make_leaderboard(node, env):
     lb = Leaderboard(sort_metric=None if sort_metric.upper() == "AUTO"
                      else sort_metric.lower(),
                      leaderboard_frame=lb_frame)
+    if lb_frame is None and scoring_data in ("train", "valid", "xval"):
+        # pin the ranking metrics source (AstMakeLeaderboard scoringData)
+        src_key = {"train": "training_metrics",
+                   "valid": "validation_metrics",
+                   "xval": "cross_validation_metrics"}[scoring_data]
+
+        def _pinned(model, _key=src_key):
+            mm = model.output.get(_key)
+            if mm is None:
+                raise ValueError(
+                    f"makeLeaderboard: model {model.key} has no "
+                    f"{scoring_data} metrics")
+            return mm, mm.kind
+        lb._metrics_for = _pinned
     lb.add(*models)
     rows = lb.rows()
     if not rows:
         raise ValueError("makeLeaderboard: no models")
-    names = [k for k in rows[0] if k != "algo"]
+    if "all" in extra_cols:
+        extra_cols = ["training_time_ms", "predict_time_per_row_ms",
+                      "algo"]
+    if "predict_time_per_row_ms" in extra_cols:
+        import time as _time
+        for r_, m in zip(rows, lb.sorted_models()):
+            score_fr = lb_frame or cloud().dkv.get(
+                str(m.params.get("training_frame") or ""))
+            if score_fr is None:
+                r_["predict_time_per_row_ms"] = float("nan")
+                continue
+            t0 = _time.perf_counter()
+            np.asarray(m.predict_raw(score_fr))
+            r_["predict_time_per_row_ms"] = (
+                (_time.perf_counter() - t0) * 1000.0 /
+                max(score_fr.nrows, 1))
+    drop = {"algo"} - set(extra_cols)
+    if "training_time_ms" not in extra_cols:
+        drop.add("training_time_ms")
+    names = [k for k in rows[0] if k not in drop]
     vecs = []
     out_names = []
     for nname in names:
         vals = [r[nname] for r in rows]
-        if nname == "model_id":
+        if nname in ("model_id", "algo"):
             dom = [str(v) for v in vals]
             # domains must be unique-sorted; codes map row -> label
             uniq = sorted(set(dom))

@@ -378,9 +378,12 @@ def test_honest_501s(h2o_client):
         assert ei.value.code == 501
 
 
-def test_small_routes(h2o_client, small_frame, tmp_path):
+def test_small_routes(h2o_client, tmp_path):
     h2o, srv = h2o_client
-    fid = small_frame.frame_id
+    # own frame: earlier tests in this module call h2o.remove_all(),
+    # which (correctly) purges module-scoped fixtures
+    hf = h2o.H2OFrame({"v": [1.0, 2.0, 3.0]})
+    fid = hf.frame_id
     # frame binary save + metadata detail + model_id calc + session end
     _post(srv, f"/3/Frames/{fid}/save?dir={tmp_path}")
     assert (tmp_path / fid / "frame.json").exists()
