@@ -85,6 +85,7 @@ def _field_decoder(ftype, name: str):
     kind in {'num', 'str', 'enum:<symbols json>'}."""
     if isinstance(ftype, dict):
         t = ftype.get("type")
+        logical = ftype.get("logicalType")
         if t == "enum":
             symbols = list(ftype.get("symbols") or [])
 
@@ -94,7 +95,21 @@ def _field_decoder(ftype, name: str):
                     raise AvroError(f"{name}: enum index {i} out of range")
                 return symbols[i]
             return "enum", dec_enum
-        # logical types ride on primitives (e.g. timestamp-millis on long)
+        if logical == "decimal":
+            # two's-complement big-endian payloads are NOT text; decoding
+            # them as UTF-8 would silently corrupt the column
+            raise AvroError(f"field {name!r}: decimal logical type is "
+                            "not supported (fixed-point bytes)")
+        if logical in ("timestamp-millis", "timestamp-micros",
+                       "date", "time-millis", "time-micros") and \
+                t in ("int", "long"):
+            scale = {"timestamp-millis": 1.0,
+                     "timestamp-micros": 1e-3,
+                     "date": 86400000.0,            # days -> ms
+                     "time-millis": 1.0,
+                     "time-micros": 1e-3}[logical]
+            return "time", lambda r: float(r.long()) * scale
+        # other logical types ride their primitive (uuid on string, ...)
         if isinstance(t, str):
             return _field_decoder(t, name)
         raise AvroError(f"field {name!r}: unsupported complex type "
@@ -131,10 +146,42 @@ def _field_decoder(ftype, name: str):
     return prim[ftype]
 
 
+def read_avro_schema(path: str) -> Tuple[List[str], List[str]]:
+    """Header-only parse -> (names, kinds); reads a few hundred bytes,
+    never the data blocks (the ParseSetup path)."""
+    with open(path, "rb") as f:
+        data = f.read(1 << 20)          # metadata fits well within 1 MiB
+    r = _Reader(data)
+    if r.read(4) != MAGIC:
+        raise AvroError(f"{path} is not an Avro container (bad magic)")
+    meta: Dict[str, bytes] = {}
+    while True:
+        n = r.long()
+        if n == 0:
+            break
+        if n < 0:
+            r.long()
+            n = -n
+        for _ in range(n):
+            k = r.string()
+            meta[k] = r.bytes_()
+    schema = json.loads(meta["avro.schema"])
+    if schema.get("type") != "record":
+        raise AvroError("top-level schema must be a record")
+    names, kinds = [], []
+    for f in schema.get("fields") or []:
+        kind, _dec = _field_decoder(f["type"], f["name"])
+        names.append(f["name"])
+        kinds.append(kind)
+    return names, kinds
+
+
 def read_avro(path: str) -> Tuple[List[str], List[str],
                                   List[List[Any]]]:
     """Parse an Avro container -> (names, kinds, columns) with kinds in
-    {'num','str','enum'} and columns as python lists (None = NA)."""
+    {'num','str','enum','time'} and columns as python lists (None = NA).
+    'time' values are epoch milliseconds (timestamp/date logical
+    types)."""
     with open(path, "rb") as f:
         data = f.read()
     r = _Reader(data)

@@ -138,11 +138,12 @@ def parse_setup(paths: Sequence[str], sample_lines: int = 200,
                 types.append(T_NUM)
         return ParseSetupResult(",", True, list(sch.names), types)
     if paths[0].endswith(".avro") or _is_avro(paths[0]):
-        from h2o_tpu.core.avro import read_avro
-        names_v, kinds_v, _cols = read_avro(paths[0])
+        from h2o_tpu.core.avro import read_avro_schema
+        names_v, kinds_v = read_avro_schema(paths[0])
+        kmap = {"num": T_NUM, "time": T_TIME}
         return ParseSetupResult(
             ",", True, names_v,
-            [T_NUM if k == "num" else T_CAT for k in kinds_v])
+            [kmap.get(k, T_CAT) for k in kinds_v])
     if paths[0].endswith(".arff") or _looks_like_arff(paths[0]):
         names_a, types_a, _doms = _arff_schema(paths[0])
         return ParseSetupResult(",", True, names_a, types_a)
@@ -675,10 +676,11 @@ def parse_avro(paths: Sequence[str],
                 acc.extend(c)
     vecs = []
     for kind, col in zip(all_kinds, cols):
-        if kind == "num":
-            vecs.append(Vec(np.asarray(
+        if kind in ("num", "time"):
+            arr = np.asarray(
                 [np.nan if v is None else float(v) for v in col],
-                np.float32)))
+                np.float64 if kind == "time" else np.float32)
+            vecs.append(Vec(arr, T_TIME if kind == "time" else T_NUM))
         else:
             dom = sorted({str(v) for v in col if v is not None})
             lut = {d: i for i, d in enumerate(dom)}

@@ -35,19 +35,35 @@ class Leaderboard:
 
     def __init__(self, project_name: str = "",
                  sort_metric: Optional[str] = None,
-                 leaderboard_frame=None):
+                 leaderboard_frame=None,
+                 scoring_data: str = "auto"):
         self.key = Key.make(f"leaderboard_{project_name or 'default'}")
         self.project_name = project_name
         self.sort_metric = sort_metric
         self.leaderboard_frame = leaderboard_frame
+        # 'auto' = xval > valid > train preference; 'train'/'valid'/
+        # 'xval' pin the source (AstMakeLeaderboard scoringData)
+        self.scoring_data = str(scoring_data or "auto").lower()
         self._lb_metrics: Dict[str, object] = {}
         self.models: List = []
 
     def _metrics_for(self, model) -> "tuple[object, str]":
         """Ranking metrics: scored on the dedicated leaderboard frame when
-        one is set (Leaderboard.java leaderboardFrame), else the usual
-        xval > valid > train preference."""
+        one is set (Leaderboard.java leaderboardFrame), else the pinned
+        scoring_data source, else the usual xval > valid > train
+        preference."""
         if self.leaderboard_frame is None:
+            if self.scoring_data in ("train", "valid", "xval"):
+                key = {"train": "training_metrics",
+                       "valid": "validation_metrics",
+                       "xval": "cross_validation_metrics"}[
+                    self.scoring_data]
+                mm = model.output.get(key)
+                if mm is None:
+                    raise ValueError(
+                        f"model {model.key} has no {self.scoring_data} "
+                        "metrics")
+                return mm, mm.kind
             return _ranking_metrics(model)
         k = (str(model.key), str(self.leaderboard_frame.key))
         if k not in self._lb_metrics:

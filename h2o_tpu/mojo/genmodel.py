@@ -533,6 +533,24 @@ def write_deeplearning_mojo(model) -> bytes:
     return w.finish(columns, domains)
 
 
+def write_word2vec_mojo(model) -> bytes:
+    """Word2Vec -> genmodel MOJO (Word2VecMojoWriter: vec_size +
+    vocab_size kv, 'vocabulary' text file, 'vectors' blob of
+    BIG-endian float32s — ByteBuffer's default order, unlike the
+    native-order tree buffers)."""
+    out = model.output
+    words = [str(w) for w in out["words"]]
+    W = np.asarray(out["vectors"], np.float32)
+    w = _ZipWriter()
+    _common_info(w, "word2vec", "Word2Vec", "WordEmbedding",
+                 str(model.key), False, 0, 1, 0, 0, "1.00")
+    w.writekv("vec_size", int(W.shape[1]))
+    w.writekv("vocab_size", len(words))
+    w.write_text("vocabulary", words)
+    w.writeblob("vectors", W.astype(">f4").tobytes())
+    return w.finish([], [])
+
+
 def write_genmodel_mojo(model) -> bytes:
     if model.algo in ("gbm", "drf"):
         return write_tree_mojo(model)
@@ -542,6 +560,8 @@ def write_genmodel_mojo(model) -> bytes:
         return write_kmeans_mojo(model)
     if model.algo == "isolationforest":
         return write_isofor_mojo(model)
+    if model.algo == "word2vec":
+        return write_word2vec_mojo(model)
     if model.algo == "deeplearning":
         return write_deeplearning_mojo(model)
     raise NotImplementedError(
@@ -791,6 +811,15 @@ def read_genmodel_mojo(data) -> Dict:
                 link=info.get("link", "identity"),
                 tweedie_link_power=float(
                     info.get("tweedie_link_power", 0.0)))
+        elif algo == "word2vec":
+            vocab = z.read("vocabulary").decode().splitlines()
+            vec_size = int(info.get("vec_size", 0))
+            vecs = np.frombuffer(z.read("vectors"),
+                                 dtype=">f4").astype(np.float32)
+            result["word2vec"] = dict(
+                words=vocab[: int(info.get("vocab_size", len(vocab)))],
+                vectors=vecs.reshape(-1, vec_size) if vec_size else
+                vecs.reshape(len(vocab), -1))
         elif algo == "kmeans":
             def karr(key):
                 v = info.get(key, "[]").strip("[]")

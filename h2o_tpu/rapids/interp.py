@@ -2560,21 +2560,8 @@ def _op_make_leaderboard(node, env):
         models.append(m)
     lb = Leaderboard(sort_metric=None if sort_metric.upper() == "AUTO"
                      else sort_metric.lower(),
-                     leaderboard_frame=lb_frame)
-    if lb_frame is None and scoring_data in ("train", "valid", "xval"):
-        # pin the ranking metrics source (AstMakeLeaderboard scoringData)
-        src_key = {"train": "training_metrics",
-                   "valid": "validation_metrics",
-                   "xval": "cross_validation_metrics"}[scoring_data]
-
-        def _pinned(model, _key=src_key):
-            mm = model.output.get(_key)
-            if mm is None:
-                raise ValueError(
-                    f"makeLeaderboard: model {model.key} has no "
-                    f"{scoring_data} metrics")
-            return mm, mm.kind
-        lb._metrics_for = _pinned
+                     leaderboard_frame=lb_frame,
+                     scoring_data=scoring_data)
     lb.add(*models)
     rows = lb.rows()
     if not rows:

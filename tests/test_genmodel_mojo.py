@@ -204,3 +204,24 @@ def test_isofor_mojo_cross_scoring(cl, rng):
         ini = z.read("model.ini").decode()
         assert "algo = isolationforest" in ini
         assert "max_path_length" in ini
+
+
+def test_word2vec_mojo_roundtrip(cl):
+    """Word2VecMojoWriter layout: vocabulary text + big-endian vectors
+    blob; embeddings survive the round trip exactly."""
+    from h2o_tpu.core.frame import T_STR
+    from h2o_tpu.models.word2vec import Word2Vec
+    from h2o_tpu.mojo import export_genmodel_mojo
+    from h2o_tpu.mojo.genmodel import read_genmodel_mojo
+    toks = (["alpha", "beta", "gamma", None] * 40)
+    fr = Frame(["txt"], [Vec(toks, T_STR)])
+    m = Word2Vec(vec_size=6, epochs=1, min_word_freq=1).train(
+        training_frame=fr)
+    blob = export_genmodel_mojo(m)
+    parsed = read_genmodel_mojo(blob)
+    assert parsed["algo"] == "word2vec"
+    got = parsed["word2vec"]
+    assert got["words"] == list(m.output["words"])
+    np.testing.assert_allclose(got["vectors"],
+                               np.asarray(m.output["vectors"]),
+                               rtol=1e-6)

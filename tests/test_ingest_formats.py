@@ -242,3 +242,48 @@ def test_avro_unsupported_fails_loudly(cl, tmp_path):
     p.write_bytes(blob)
     with pytest.raises(AvroError, match="'a'"):
         read_avro(str(p))
+
+
+def test_avro_time_and_decimal(cl, tmp_path):
+    """timestamp-millis -> T_TIME; decimal logical type fails loudly."""
+    import struct as _struct
+
+    def zig(n):
+        u = (n << 1) ^ (n >> 63)
+        out = b""
+        while True:
+            b7 = u & 0x7F
+            u >>= 7
+            if u:
+                out += bytes([b7 | 0x80])
+            else:
+                return out + bytes([b7])
+
+    schema = (b'{"type":"record","name":"r","fields":['
+              b'{"name":"ts","type":{"type":"long",'
+              b'"logicalType":"timestamp-millis"}}]}')
+    sync = bytes(16)
+    body = zig(1579046400000)
+    blob = (b"Obj\x01" + zig(1) +
+            zig(11) + b"avro.schema" + zig(len(schema)) + schema +
+            zig(0) + sync + zig(1) + zig(len(body)) + body + sync)
+    p = tmp_path / "ts.avro"
+    p.write_bytes(blob)
+    from h2o_tpu.core.parse import parse_files, parse_setup
+    setup = parse_setup([str(p)])
+    assert setup.column_types == ["time"]
+    fr = parse_files([str(p)])
+    assert fr.vec("ts").type == "time"
+    assert float(fr.vec("ts").to_numpy()[0]) == 1579046400000.0
+
+    from h2o_tpu.core.avro import AvroError, read_avro_schema
+    dec_schema = (b'{"type":"record","name":"r","fields":['
+                  b'{"name":"d","type":{"type":"bytes",'
+                  b'"logicalType":"decimal","precision":9,"scale":2}}]}')
+    blob2 = (b"Obj\x01" + zig(1) +
+             zig(11) + b"avro.schema" + zig(len(dec_schema)) +
+             dec_schema + zig(0) + sync)
+    p2 = tmp_path / "dec.avro"
+    p2.write_bytes(blob2)
+    with pytest.raises(AvroError, match="decimal"):
+        read_avro_schema(str(p2))
