@@ -146,12 +146,9 @@ def _field_decoder(ftype, name: str):
     return prim[ftype]
 
 
-def read_avro_schema(path: str) -> Tuple[List[str], List[str]]:
-    """Header-only parse -> (names, kinds); reads a few hundred bytes,
-    never the data blocks (the ParseSetup path)."""
-    with open(path, "rb") as f:
-        data = f.read(1 << 20)          # metadata fits well within 1 MiB
-    r = _Reader(data)
+def _read_header(r: _Reader, path: str) -> Dict[str, bytes]:
+    """Magic + zero-terminated metadata map (shared by the header-only
+    and full readers)."""
     if r.read(4) != MAGIC:
         raise AvroError(f"{path} is not an Avro container (bad magic)")
     meta: Dict[str, bytes] = {}
@@ -159,12 +156,34 @@ def read_avro_schema(path: str) -> Tuple[List[str], List[str]]:
         n = r.long()
         if n == 0:
             break
-        if n < 0:
+        if n < 0:                       # negative count => byte size follows
             r.long()
             n = -n
         for _ in range(n):
             k = r.string()
             meta[k] = r.bytes_()
+    if "avro.schema" not in meta:
+        raise AvroError(f"{path}: header has no avro.schema")
+    return meta
+
+
+def read_avro_schema(path: str) -> Tuple[List[str], List[str]]:
+    """Header-only parse -> (names, kinds); reads the header bytes,
+    never the data blocks (the ParseSetup path)."""
+    cap = 1 << 20
+    while True:
+        with open(path, "rb") as f:
+            data = f.read(cap)
+        try:
+            meta = _read_header(_Reader(data), path)
+            break
+        except AvroError:
+            # pathological >cap metadata (huge embedded schema): widen
+            # until the whole file is in, then let the error stand
+            import os as _os
+            if cap >= _os.path.getsize(path):
+                raise
+            cap *= 8
     schema = json.loads(meta["avro.schema"])
     if schema.get("type") != "record":
         raise AvroError("top-level schema must be a record")
@@ -185,20 +204,7 @@ def read_avro(path: str) -> Tuple[List[str], List[str],
     with open(path, "rb") as f:
         data = f.read()
     r = _Reader(data)
-    if r.read(4) != MAGIC:
-        raise AvroError(f"{path} is not an Avro container (bad magic)")
-    # file metadata map: blocks of (count, k/v pairs), 0-terminated
-    meta: Dict[str, bytes] = {}
-    while True:
-        n = r.long()
-        if n == 0:
-            break
-        if n < 0:                       # negative count => byte size follows
-            r.long()
-            n = -n
-        for _ in range(n):
-            k = r.string()
-            meta[k] = r.bytes_()
+    meta = _read_header(r, path)
     sync = r.read(16)
     schema = json.loads(meta["avro.schema"])
     codec = (meta.get("avro.codec") or b"null").decode()

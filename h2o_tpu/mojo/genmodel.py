@@ -29,6 +29,29 @@ import numpy as np
 NA_VS_REST, NA_LEFT, NA_RIGHT, DIR_LEFT, DIR_RIGHT = 1, 2, 3, 4, 5
 
 
+def _escape_newlines(s: str) -> str:
+    """genmodel StringEscapeUtils.escapeNewlines: backslash-escape so
+    multi-line tokens survive line-oriented text files."""
+    return s.replace("\\", "\\\\").replace("\n", "\\n").replace(
+        "\r", "\\r")
+
+
+def _unescape_newlines(s: str) -> str:
+    out = []
+    i = 0
+    while i < len(s):
+        c = s[i]
+        if c == "\\" and i + 1 < len(s):
+            nxt = s[i + 1]
+            out.append({"n": "\n", "r": "\r",
+                        "\\": "\\"}.get(nxt, "\\" + nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
 # ---------------------------------------------------------------------------
 # tree bytecode writer (DTree.DecidedNode.compress, DTree.java:891-935)
 # ---------------------------------------------------------------------------
@@ -310,6 +333,58 @@ def write_tree_mojo(model) -> bytes:
     return w.finish(columns, domains)
 
 
+def _glm_mojo_prep(model):
+    """Shared GLM writer prep: spec unpacking, de-standardization of one
+    beta vector, cat offsets, column/domain assembly, common kv."""
+    out = model.output
+    spec = out["expansion_spec"]
+    cat_names = list(spec["cat_names"])
+    num_names = list(spec["num_names"])
+    cards = list(spec["cat_cards"])
+    uafl = bool(spec["use_all_factor_levels"])
+    means = np.asarray(spec["means"], np.float64)
+    sigmas = np.asarray(spec["sigmas"], np.float64)
+    n_cat_coef = sum(c - (0 if uafl else 1) for c in cards)
+
+    def destandardize(beta_row):
+        """[cats..., nums..., b0] standardized -> raw-space flat list."""
+        beta_row = np.asarray(beta_row, np.float64)
+        cat_beta = beta_row[:n_cat_coef]
+        num_beta = beta_row[n_cat_coef:-1].copy()
+        intercept = float(beta_row[-1])
+        if spec["standardize"] and len(num_beta):
+            sig = np.where(sigmas == 0, 1.0, sigmas)
+            intercept -= float(np.sum(num_beta * means / sig))
+            num_beta = num_beta / sig
+        return ([float(v) for v in cat_beta] +
+                [float(v) for v in num_beta] + [intercept])
+
+    cat_offsets = [0]
+    for c in cards:
+        cat_offsets.append(cat_offsets[-1] + (c - (0 if uafl else 1)))
+    resp_name = model.params.get("response_column") or "response"
+    x = cat_names + num_names
+    columns = x + [resp_name]
+    cat_domains = list(spec.get("cat_domains") or [])
+    domains = [(cat_domains[j] if j < len(cat_domains) else
+                [str(i) for i in range(cards[j])])
+               for j in range(len(cat_names))]
+    domains += [None] * len(num_names)
+
+    def common_kv(w):
+        w.writekv("use_all_factor_levels", uafl)
+        w.writekv("cats", len(cat_names))
+        w.writekv("cat_offsets", cat_offsets)
+        w.writekv("nums", len(num_names))
+        w.writekv("mean_imputation", True)
+        w.writekv("num_means", [float(m) for m in means])
+        w.writekv("cat_modes", [0] * len(cat_names))
+
+    return dict(out=out, spec=spec, x=x, columns=columns,
+                domains=domains, destandardize=destandardize,
+                common_kv=common_kv)
+
+
 def write_glm_mojo(model) -> bytes:
     """GLM model -> genmodel MOJO zip bytes (GLMMojoWriter key set).
 
@@ -317,66 +392,31 @@ def write_glm_mojo(model) -> bytes:
     de-standardized here (beta/sigma; intercept -= sum beta*mean/sigma)."""
     out = model.output
     if out.get("is_multinomial"):
-        raise NotImplementedError("multinomial GLM MOJO export")
-    spec = out["expansion_spec"]
-    cat_names = list(spec["cat_names"])
-    num_names = list(spec["num_names"])
-    cards = list(spec["cat_cards"])
-    uafl = bool(spec["use_all_factor_levels"])
-    beta = np.asarray(out["beta"], np.float64)     # [cats..., nums..., b0]
-    n_cat_coef = sum(c - (0 if uafl else 1) for c in cards)
-    cat_beta = beta[:n_cat_coef]
-    num_beta = beta[n_cat_coef:-1].copy()
-    intercept = float(beta[-1])
-    means = np.asarray(spec["means"], np.float64)
-    sigmas = np.asarray(spec["sigmas"], np.float64)
-    if spec["standardize"] and len(num_beta):
-        sig = np.where(sigmas == 0, 1.0, sigmas)
-        intercept -= float(np.sum(num_beta * means / sig))
-        num_beta = num_beta / sig
-
-    cat_offsets = [0]
-    for c in cards:
-        cat_offsets.append(cat_offsets[-1] + (c - (0 if uafl else 1)))
-
+        return _write_glm_multinomial_mojo(model)
+    p = _glm_mojo_prep(model)
     fam = out.get("family_resolved", "gaussian")
     link = {"binomial": "logit", "quasibinomial": "logit",
             "gaussian": "identity", "poisson": "log", "gamma": "log",
             "tweedie": "tweedie"}.get(fam, "identity")
     resp_dom = out.get("response_domain")
     nclass = len(resp_dom) if resp_dom else 1
-    resp_name = model.params.get("response_column") or "response"
-    x = cat_names + num_names
-    columns = x + [resp_name]
-    cat_domains = list(spec.get("cat_domains") or [])
-    domains: List[Optional[List[str]]] = \
-        [(cat_domains[j] if j < len(cat_domains) else
-          [str(i) for i in range(cards[j])]) for j in range(len(cat_names))]
-    domains += [None] * len(num_names)
-    domains.append(list(resp_dom) if resp_dom else None)
-
+    domains = list(p["domains"]) + [list(resp_dom) if resp_dom else None]
     w = _ZipWriter()
     _common_info(w, "glm", "Generalized Linear Modeling",
                  "Binomial" if nclass == 2 else "Regression",
-                 str(model.key), True, len(x), nclass, len(columns),
-                 sum(d is not None for d in domains), "1.00")
-    w.writekv("use_all_factor_levels", uafl)
-    w.writekv("cats", len(cat_names))
-    w.writekv("cat_offsets", cat_offsets)
-    w.writekv("nums", len(num_names))
+                 str(model.key), True, len(p["x"]), nclass,
+                 len(p["columns"]), sum(d is not None for d in domains),
+                 "1.00")
+    p["common_kv"](w)
     w.writekv("default_threshold",
               float(out.get("default_threshold", 0.5)))
-    w.writekv("mean_imputation", True)
-    w.writekv("num_means", [float(m) for m in means])
-    w.writekv("cat_modes", [0] * len(cat_names))
-    w.writekv("beta", [float(b) for b in np.concatenate(
-        [cat_beta, num_beta, [intercept]])])
+    w.writekv("beta", p["destandardize"](out["beta"]))
     w.writekv("family", fam)
     w.writekv("link", link)
     if fam == "tweedie":
         w.writekv("tweedie_link_power",
                   float(model.params.get("tweedie_power", 1.5)))
-    return w.finish(columns, domains)
+    return w.finish(p["columns"], domains)
 
 
 class _IFTreeEncoder(_TreeEncoder):
@@ -428,6 +468,30 @@ def write_isofor_mojo(model) -> bytes:
         w.writeblob(f"trees/t00_{t:03d}.bin", blob)
         w.writeblob(f"trees/t00_{t:03d}_aux.bin", aux)
     return w.finish(x, domains)
+
+
+def _write_glm_multinomial_mojo(model) -> bytes:
+    """Multinomial GLM -> genmodel MOJO (GlmMultinomialMojoModel layout:
+    flat beta of length K*P, per class c the block [coefs..., intercept]
+    at offset c*P — GlmMultinomialMojoModel.java:38-52)."""
+    out = model.output
+    p = _glm_mojo_prep(model)
+    B = np.asarray(out["beta_multinomial"], np.float64)   # (K, P+1)
+    K = B.shape[0]
+    flat = []
+    for c in range(K):
+        flat.extend(p["destandardize"](B[c]))
+    resp_dom = out.get("response_domain") or [str(i) for i in range(K)]
+    domains = list(p["domains"]) + [list(resp_dom)]
+    w = _ZipWriter()
+    _common_info(w, "glm", "Generalized Linear Modeling", "Multinomial",
+                 str(model.key), True, len(p["x"]), K, len(p["columns"]),
+                 sum(d is not None for d in domains), "1.00")
+    p["common_kv"](w)
+    w.writekv("beta", flat)
+    w.writekv("family", "multinomial")
+    w.writekv("link", "multinomial")
+    return w.finish(p["columns"], domains)
 
 
 def write_kmeans_mojo(model) -> bytes:
@@ -546,7 +610,7 @@ def write_word2vec_mojo(model) -> bytes:
                  str(model.key), False, 0, 1, 0, 0, "1.00")
     w.writekv("vec_size", int(W.shape[1]))
     w.writekv("vocab_size", len(words))
-    w.write_text("vocabulary", words)
+    w.write_text("vocabulary", [_escape_newlines(s) for s in words])
     w.writeblob("vectors", W.astype(">f4").tobytes())
     return w.finish([], [])
 
@@ -812,7 +876,8 @@ def read_genmodel_mojo(data) -> Dict:
                 tweedie_link_power=float(
                     info.get("tweedie_link_power", 0.0)))
         elif algo == "word2vec":
-            vocab = z.read("vocabulary").decode().splitlines()
+            vocab = [_unescape_newlines(s) for s in
+                     z.read("vocabulary").decode().splitlines()]
             vec_size = int(info.get("vec_size", 0))
             vecs = np.frombuffer(z.read("vectors"),
                                  dtype=">f4").astype(np.float32)
@@ -997,6 +1062,32 @@ class GenmodelMojoModel:
                         Xc[np.isnan(Xc[:, j]), j] = nm[j - cats]
                 Xc[:, :cats] = np.where(np.isnan(Xc[:, :cats]), 0.0,
                                         Xc[:, :cats])
+            if g["family"] == "multinomial":
+                # flat beta of K blocks [coefs..., intercept]
+                # (GlmMultinomialMojoModel.java:38-52)
+                K = nclass
+                P = len(beta) // K
+                noff = int(offs[cats] - cats) if cats else 0
+                etas = np.zeros((X.shape[0], K))
+                for c in range(K):
+                    bc = beta[c * P: (c + 1) * P]
+                    eta_c = np.zeros(X.shape[0])
+                    for i in range(cats):
+                        ival = Xc[:, i].astype(np.int64)
+                        if not uafl:
+                            ival = ival - 1
+                        ival = ival + offs[i]
+                        ok = (ival >= offs[i]) & (ival < offs[i + 1])
+                        eta_c += np.where(
+                            ok, bc[np.clip(ival, 0, P - 1)], 0.0)
+                    for i in range(cats, cats + g["nums"]):
+                        eta_c += bc[noff + i] * Xc[:, i]
+                    eta_c += bc[P - 1]
+                    etas[:, c] = eta_c
+                e = np.exp(etas - etas.max(axis=1, keepdims=True))
+                Pm = e / e.sum(axis=1, keepdims=True)
+                label = np.argmax(Pm, axis=1).astype(np.float64)
+                return np.concatenate([label[:, None], Pm], axis=1)
             eta = np.zeros(X.shape[0])
             for i in range(cats):
                 ival = Xc[:, i].astype(np.int64)

@@ -225,3 +225,24 @@ def test_word2vec_mojo_roundtrip(cl):
     np.testing.assert_allclose(got["vectors"],
                                np.asarray(m.output["vectors"]),
                                rtol=1e-6)
+
+
+def test_glm_multinomial_mojo_cross_scoring(cl, rng):
+    """GlmMultinomialMojoModel layout: flat per-class beta blocks;
+    probability parity with in-cluster predict."""
+    from h2o_tpu.models.glm import GLM
+    n = 600
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    cls = np.argmax(
+        np.stack([x[:, 0], x[:, 1], -x[:, 0] - x[:, 1]], 1) +
+        rng.normal(size=(n, 3)) * 0.3, axis=1)
+    fr = Frame(["a", "b", "c", "y"],
+               [Vec(x[:, 0]), Vec(x[:, 1]), Vec(x[:, 2]),
+                Vec(cls.astype(np.int32), T_CAT,
+                    domain=["r", "g", "bl"])])
+    m = GLM(family="multinomial", lambda_=0.0, seed=1).train(
+        y="y", training_frame=fr)
+    blob = _cross_score(m, fr, tol=1e-4)
+    with zipfile.ZipFile(io.BytesIO(blob)) as z:
+        ini = z.read("model.ini").decode()
+        assert "family = multinomial" in ini
