@@ -177,11 +177,12 @@ def read_avro_schema(path: str) -> Tuple[List[str], List[str]]:
         try:
             meta = _read_header(_Reader(data), path)
             break
-        except AvroError:
-            # pathological >cap metadata (huge embedded schema): widen
-            # until the whole file is in, then let the error stand
+        except AvroError as e:
+            # only truncation is fixable by reading more (pathological
+            # >cap metadata); bad magic / missing schema are final
             import os as _os
-            if cap >= _os.path.getsize(path):
+            if "truncated" not in str(e) or \
+                    cap >= _os.path.getsize(path):
                 raise
             cap *= 8
     schema = json.loads(meta["avro.schema"])

@@ -615,6 +615,98 @@ def write_word2vec_mojo(model) -> bytes:
     return w.finish([], [])
 
 
+def write_isotonic_mojo(model) -> bytes:
+    """IsotonicRegression -> genmodel MOJO (IsotonicCalibrator layout:
+    min_x/max_x + thresholds_x/thresholds_y kv)."""
+    out = model.output
+    tx = np.asarray(out["thresholds_x"], np.float64)
+    ty = np.asarray(out["thresholds_y"], np.float64)
+    x = list(out["x"])
+    resp = model.params.get("response_column") or "response"
+    columns = x + [resp]
+    w = _ZipWriter()
+    _common_info(w, "isotonicregression", "Isotonic Regression",
+                 "Regression", str(model.key), True, len(x), 1,
+                 len(columns), 0, "1.00")
+    w.writekv("min_x", float(tx[0]) if len(tx) else 0.0)
+    w.writekv("max_x", float(tx[-1]) if len(tx) else 0.0)
+    w.writekv("out_of_bounds", out.get("out_of_bounds", "clip"))
+    w.writekv("thresholds_x", [float(v) for v in tx])
+    w.writekv("thresholds_y", [float(v) for v in ty])
+    return w.finish(columns, [None] * len(columns))
+
+
+def write_pca_mojo(model) -> bytes:
+    """PCA -> genmodel MOJO (PCAMojoWriter key set: k, norm sub/mul,
+    catOffsets, eigenvectors_raw as BIG-endian doubles row-major)."""
+    out = model.output
+    spec = out["expansion_spec"]
+    if spec["cat_names"]:
+        # genmodel PCA keeps categorical levels + catOffsets; our one-hot
+        # expansion matches only for the numeric case — fail loudly
+        raise NotImplementedError(
+            "PCA MOJO export supports numeric predictors only")
+    num_names = list(spec["num_names"])
+    V = np.asarray(out["eigenvectors"], np.float64)   # (P, k)
+    means = np.asarray(spec["means"], np.float64)
+    sigmas = np.where(np.asarray(spec["sigmas"], np.float64) == 0, 1.0,
+                      np.asarray(spec["sigmas"], np.float64))
+    w = _ZipWriter()
+    _common_info(w, "pca", "Principal Components Analysis",
+                 "DimReduction", str(model.key), False, len(num_names),
+                 1, len(num_names), 0, "1.00")
+    w.writekv("k", int(V.shape[1]))
+    w.writekv("use_all_factor_levels", bool(spec["use_all_factor_levels"]))
+    w.writekv("permutation", list(range(len(num_names))))
+    w.writekv("ncats", 0)
+    w.writekv("nnums", len(num_names))
+    if spec["standardize"]:
+        w.writekv("normSub", [float(m) for m in means])
+        w.writekv("normMul", [float(1.0 / s) for s in sigmas])
+    else:
+        w.writekv("normSub", [0.0] * len(num_names))
+        w.writekv("normMul", [1.0] * len(num_names))
+    # training means for NaN imputation (expand_for_scoring contract)
+    w.writekv("num_means", [float(m) for m in means])
+    w.writekv("catOffsets", [0])
+    w.writekv("eigenvector_size", int(V.shape[0]))
+    w.writeblob("eigenvectors_raw", V.astype(">f8").tobytes())
+    return w.finish(num_names, [None] * len(num_names))
+
+
+def write_target_encoder_mojo(model) -> bytes:
+    """TargetEncoder -> genmodel MOJO (TargetEncoderMojoWriter: blending
+    kv + 'feature_engineering/target_encoding/encoding_map.ini' with
+    [column] sections of 'level_index = num den' lines)."""
+    out = model.output
+    p = model.params
+    w = _ZipWriter()
+    cols = list(out["columns"])
+    columns = cols + [p.get("response_column") or "response"]
+    dom_map = out.get("domains") or {}
+    domains: List[Optional[List[str]]] = [
+        dom_map.get(c) for c in cols] + [None]
+    _common_info(w, "targetencoder", "TargetEncoder", "TargetEncoder",
+                 str(model.key), True, len(cols), 1, len(columns),
+                 sum(d is not None for d in domains), "1.00")
+    w.writekv("with_blending", bool(p.get("blending")))
+    if p.get("blending"):
+        w.writekv("inflection_point",
+                  float(p.get("inflection_point", 10.0)))
+        w.writekv("smoothing", float(p.get("smoothing", 20.0)))
+    w.writekv("priorMean", float(out["prior"]))
+    lines = []
+    for col in cols:
+        lines.append(f"[{col}]")
+        cnt = np.asarray(out["enc"][col]["cnt"]).sum(axis=0)
+        s = np.asarray(out["enc"][col]["sum"]).sum(axis=0)
+        for lvl in range(len(cnt)):
+            lines.append(f"{lvl} = {float(s[lvl])} {float(cnt[lvl])}")
+    w.write_text(
+        "feature_engineering/target_encoding/encoding_map.ini", lines)
+    return w.finish(columns, domains)
+
+
 def write_genmodel_mojo(model) -> bytes:
     if model.algo in ("gbm", "drf"):
         return write_tree_mojo(model)
@@ -626,6 +718,12 @@ def write_genmodel_mojo(model) -> bytes:
         return write_isofor_mojo(model)
     if model.algo == "word2vec":
         return write_word2vec_mojo(model)
+    if model.algo == "isotonicregression":
+        return write_isotonic_mojo(model)
+    if model.algo == "pca":
+        return write_pca_mojo(model)
+    if model.algo == "targetencoder":
+        return write_target_encoder_mojo(model)
     if model.algo == "deeplearning":
         return write_deeplearning_mojo(model)
     raise NotImplementedError(
@@ -792,6 +890,13 @@ def score_decoded_tree(tree: Dict, X: np.ndarray,
     return out
 
 
+def _parse_float_arr(info: Dict[str, str], key: str) -> np.ndarray:
+    """'[a, b, c]' kv -> float64 array (shared by all algo readers)."""
+    v = info.get(key, "[]").strip("[]")
+    return np.asarray([float(s) for s in v.split(",") if s.strip()],
+                      np.float64)
+
+
 def read_genmodel_mojo(data) -> Dict:
     """Parse a genmodel MOJO zip (ours or a real H2O one) into a scoring
     dict: {'algo', 'columns', 'domains', 'info', trees/glm payload}."""
@@ -876,8 +981,10 @@ def read_genmodel_mojo(data) -> Dict:
                 tweedie_link_power=float(
                     info.get("tweedie_link_power", 0.0)))
         elif algo == "word2vec":
-            vocab = [_unescape_newlines(s) for s in
-                     z.read("vocabulary").decode().splitlines()]
+            raw_vocab = z.read("vocabulary").decode().split("\n")
+            if raw_vocab and raw_vocab[-1] == "":
+                raw_vocab.pop()          # trailing writer newline
+            vocab = [_unescape_newlines(s) for s in raw_vocab]
             vec_size = int(info.get("vec_size", 0))
             vecs = np.frombuffer(z.read("vectors"),
                                  dtype=">f4").astype(np.float32)
@@ -885,11 +992,51 @@ def read_genmodel_mojo(data) -> Dict:
                 words=vocab[: int(info.get("vocab_size", len(vocab)))],
                 vectors=vecs.reshape(-1, vec_size) if vec_size else
                 vecs.reshape(len(vocab), -1))
+        elif algo == "isotonicregression":
+            iarr = lambda key: _parse_float_arr(info, key)  # noqa: E731
+            result["isotonic"] = dict(
+                min_x=float(info.get("min_x", 0)),
+                max_x=float(info.get("max_x", 0)),
+                out_of_bounds=info.get("out_of_bounds", "clip"),
+                thresholds_x=iarr("thresholds_x"),
+                thresholds_y=iarr("thresholds_y"))
+        elif algo == "pca":
+            parr = lambda key: _parse_float_arr(info, key)  # noqa: E731
+            k = int(info.get("k", 0))
+            raw = np.frombuffer(z.read("eigenvectors_raw"),
+                                dtype=">f8").astype(np.float64)
+            P = int(info.get("eigenvector_size", 0)) or                 (len(raw) // max(k, 1))
+            result["pca"] = dict(
+                k=k, norm_sub=parr("normSub"), norm_mul=parr("normMul"),
+                num_means=parr("num_means"),
+                eigenvectors=raw.reshape(P, k))
+        elif algo == "targetencoder":
+            ini_enc = z.read(
+                "feature_engineering/target_encoding/encoding_map.ini"
+            ).decode().splitlines()
+            enc: Dict[str, Dict[int, Tuple[float, float]]] = {}
+            cur = None
+            for line in ini_enc:
+                line = line.strip()
+                if not line:
+                    continue
+                if line.startswith("[") and line.endswith("]"):
+                    cur = line[1:-1]
+                    enc[cur] = {}
+                elif "=" in line and cur is not None:
+                    lvl, rest = line.split("=", 1)
+                    num, den = rest.split()
+                    enc[cur][int(lvl)] = (float(num), float(den))
+            result["targetencoder"] = dict(
+                encoding_map=enc,
+                prior=float(info.get("priorMean", 0.0)),
+                with_blending=info.get("with_blending",
+                                       "false") == "true",
+                inflection_point=float(info.get("inflection_point",
+                                                10.0)),
+                smoothing=float(info.get("smoothing", 20.0)))
         elif algo == "kmeans":
-            def karr(key):
-                v = info.get(key, "[]").strip("[]")
-                return np.asarray([float(s) for s in v.split(",")
-                                   if s.strip()], np.float64)
+            karr = lambda key: _parse_float_arr(info, key)  # noqa: E731
             k = int(info.get("center_num", 0))
             result["kmeans"] = dict(
                 standardize=info.get("standardize", "false") == "true",
@@ -899,10 +1046,7 @@ def read_genmodel_mojo(data) -> Dict:
                                   for i in range(k)]) if k else
                 np.zeros((0, 0)))
         elif algo == "deeplearning":
-            def darr(key):
-                v = info.get(key, "[]").strip("[]")
-                return np.asarray([float(s) for s in v.split(",")
-                                   if s.strip()], np.float64)
+            darr = lambda key: _parse_float_arr(info, key)  # noqa: E731
             units = [int(float(s)) for s in
                      info.get("neural_network_sizes", "[]")
                      .strip("[]").split(",") if s.strip()]
@@ -1108,6 +1252,50 @@ class GenmodelMojoModel:
                 label = (mu >= thr).astype(np.float64)
                 return np.stack([label, 1 - mu, mu], axis=1)
             return mu
+        if p["algo"] == "isotonicregression":
+            iso = p["isotonic"]
+            tx, ty = iso["thresholds_x"], iso["thresholds_y"]
+            raw_x = X[:, 0].astype(np.float64)
+            x = np.clip(raw_x, iso["min_x"], iso["max_x"])
+            y = np.interp(x, tx, ty)
+            if iso.get("out_of_bounds", "clip").lower() == "na":
+                y = np.where((raw_x < iso["min_x"]) |
+                             (raw_x > iso["max_x"]), np.nan, y)
+            return y
+        if p["algo"] == "pca":
+            pc = p["pca"]
+            Xc = X.astype(np.float64).copy()
+            if len(pc["num_means"]):
+                # mean imputation (matches expand_for_scoring)
+                Xc = np.where(np.isnan(Xc), pc["num_means"][None, :], Xc)
+            else:
+                Xc = np.nan_to_num(Xc)
+            if len(pc["norm_sub"]):
+                Xc = (Xc - pc["norm_sub"][None, :]) * \
+                    pc["norm_mul"][None, :]
+            return Xc @ pc["eigenvectors"]
+        if p["algo"] == "targetencoder":
+            te = p["targetencoder"]
+            cols = [c for c in p["columns"][:-1]]
+            out_cols = []
+            for j, col in enumerate(cols):
+                emap = te["encoding_map"].get(col, {})
+                card = (max(emap) + 1) if emap else 0
+                table = np.full(card + 1, te["prior"])
+                for lvl, (num, den) in emap.items():
+                    mean = num / den if den > 0 else te["prior"]
+                    if te["with_blending"]:
+                        lam = 1.0 / (1.0 + np.exp(
+                            -(den - te["inflection_point"]) /
+                            max(te["smoothing"], 1e-6)))
+                        mean = lam * mean + (1 - lam) * te["prior"]
+                    table[lvl] = mean
+                codes = X[:, j].astype(np.float64)
+                idx = np.where(np.isnan(codes) | (codes < 0) |
+                               (codes >= card), card,
+                               codes).astype(np.int64)
+                out_cols.append(table[idx])
+            return np.stack(out_cols, axis=1)
         if p["algo"] == "kmeans":
             km = p["kmeans"]
             Xc = X.astype(np.float64).copy()

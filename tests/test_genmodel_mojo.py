@@ -246,3 +246,49 @@ def test_glm_multinomial_mojo_cross_scoring(cl, rng):
     with zipfile.ZipFile(io.BytesIO(blob)) as z:
         ini = z.read("model.ini").decode()
         assert "family = multinomial" in ini
+
+
+def test_isotonic_pca_te_mojo_cross_scoring(cl, rng):
+    """Isotonic / PCA / TargetEncoder genmodel MOJO exports score
+    identically to the in-cluster models."""
+    from h2o_tpu.mojo import export_genmodel_mojo
+    from h2o_tpu.mojo.genmodel import GenmodelMojoModel
+
+    # isotonic
+    from h2o_tpu.models.isotonic import IsotonicRegression
+    n = 300
+    x = rng.uniform(-2, 2, size=n).astype(np.float32)
+    y = (x + rng.normal(size=n) * 0.2).astype(np.float32)
+    fr = Frame(["x", "y"], [Vec(x), Vec(y)])
+    m = IsotonicRegression().train(y="y", training_frame=fr)
+    gm = GenmodelMojoModel(export_genmodel_mojo(m))
+    got = gm.score_matrix(x.astype(np.float64)[:, None])
+    want = np.asarray(m.predict_raw(fr))[:n]
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+    # pca (numeric only)
+    from h2o_tpu.models.pca import PCA
+    Xp = rng.normal(size=(200, 4)).astype(np.float32)
+    frp = Frame([f"c{i}" for i in range(4)],
+                [Vec(Xp[:, i]) for i in range(4)])
+    mp = PCA(k=2, seed=1).train(training_frame=frp)
+    gmp = GenmodelMojoModel(export_genmodel_mojo(mp))
+    gotp = gmp.score_matrix(Xp.astype(np.float64))
+    wantp = np.asarray(mp.predict_raw(frp))[:200]
+    np.testing.assert_allclose(gotp, wantp, atol=1e-4)
+
+    # target encoder (no folds, no blending, no noise)
+    from h2o_tpu.models.target_encoder import TargetEncoder
+    g = rng.integers(0, 3, size=400)
+    yy = (rng.uniform(size=400) < (0.2 + 0.3 * g)).astype(np.int32)
+    frt = Frame(["g", "y"],
+                [Vec(g.astype(np.int32), T_CAT, domain=["a", "b", "c"]),
+                 Vec(yy, T_CAT, domain=["n", "p"])])
+    mt = TargetEncoder(noise=0.0).train(x=["g"], y="y",
+                                        training_frame=frt)
+    gmt = GenmodelMojoModel(export_genmodel_mojo(mt))
+    gott = gmt.score_matrix(g.astype(np.float64)[:, None])[:, 0]
+    wantt = np.asarray(
+        mt.transform(frt, as_training=False, noise=0.0)
+        .vec("g_te").to_numpy())
+    np.testing.assert_allclose(gott, wantt, atol=1e-5)
