@@ -137,11 +137,15 @@ def bench_hist_mfu(rows, cols, nbins=64, leaves=32, reps=10):
     def run():
         return histogram_build(bins, leaf, stats, n_leaves=leaves,
                                nbins=nbins, bf16=True)
-    run().block_until_ready()                      # compile
+    # host-fetch barrier rather than block_until_ready: a tunneled/async
+    # PJRT backend can resolve the ready-future at enqueue time, which
+    # would fake the timing; a device->host scalar fetch cannot complete
+    # until the whole dependency chain has executed
+    float(run().sum())                             # compile + complete
     t0 = time.time()
     for _ in range(reps):
         out = run()
-    out.block_until_ready()
+    float(out.sum())
     wall = (time.time() - t0) / reps
     flops = 2.0 * rows * (cols * (nbins + 1)) * (leaves * 4)
     achieved_tflops = flops / wall / 1e12

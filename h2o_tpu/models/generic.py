@@ -38,8 +38,15 @@ class GenericModel(Model):
     def _mojo(self):
         arrays = self.output["__arrays__"]
         if "__genmodel_zip__" in arrays:
+            # parse once per model: nested artifacts (StackedEnsemble)
+            # are expensive to re-decode on every predict
+            cached = getattr(self, "_mojo_cache", None)
+            if cached is not None:
+                return cached
             from h2o_tpu.mojo.genmodel import GenmodelMojoModel
-            return GenmodelMojoModel(arrays["__genmodel_zip__"].tobytes())
+            self._mojo_cache = GenmodelMojoModel(
+                arrays["__genmodel_zip__"].tobytes())
+            return self._mojo_cache
         from h2o_tpu.mojo import MojoModel
         return MojoModel(self.output["source_algo"], self.params,
                          {k: v for k, v in self.output.items()

@@ -707,6 +707,73 @@ def write_target_encoder_mojo(model) -> bytes:
     return w.finish(columns, domains)
 
 
+def write_stackedensemble_mojo(model) -> bytes:
+    """StackedEnsemble -> genmodel MOJO (MultiModelMojoReader layout:
+    submodel_count/key_i/dir_i kv, each sub-model's complete mojo nested
+    under models/<key>/; parent kv base_models_num + metalearner —
+    StackedEnsembleMojoWriter.java:49-55)."""
+    from h2o_tpu.core.cloud import cloud
+    out = model.output
+    base_keys = list(out["base_models"])
+    meta = cloud().dkv.get(out["metalearner_key"])
+    if meta is None:
+        raise NotImplementedError("metalearner model missing from DKV")
+    subs = [(str(meta.key), meta)] + \
+        [(bk, cloud().dkv.get(bk)) for bk in base_keys]
+    for k, m in subs:
+        if m is None:
+            raise NotImplementedError(f"base model {k} missing from DKV")
+    # parent columns: the UNION of base-model predictor columns (sub
+    # scorers select their features from the parent column space, so
+    # every base feature must exist there even if outside the SE's x)
+    x: List[str] = []
+    for _k, m in subs[1:]:
+        for c in m.output.get("x", []):
+            if c not in x:
+                x.append(c)
+    for c in out["x"]:
+        if c not in x:
+            x.append(c)
+    resp = model.params.get("response_column") or "response"
+    resp_dom = out.get("response_domain")
+    columns = x + [resp]
+    # domains for categorical parent columns, harvested from every
+    # sub-model's view: tree-family models carry output['domains'],
+    # GLM/DL carry them in expansion_spec.cat_domains
+    dom_map = {}
+    for _k, m in subs:
+        dom_map.update(m.output.get("domains") or {})
+        spec = m.output.get("expansion_spec")
+        if spec:
+            for cn, cd in zip(spec.get("cat_names") or [],
+                              spec.get("cat_domains") or []):
+                dom_map.setdefault(cn, list(cd))
+    domains: List[Optional[List[str]]] = [dom_map.get(c) for c in x]
+    domains.append(list(resp_dom) if resp_dom else None)
+    w = _ZipWriter()
+    nclass = len(resp_dom) if resp_dom else 1
+    _common_info(w, "stackedensemble", "Stacked Ensemble",
+                 "Binomial" if nclass == 2 else
+                 ("Multinomial" if nclass > 2 else "Regression"),
+                 str(model.key), True, len(x), nclass, len(columns),
+                 sum(d is not None for d in domains), "1.01")
+    w.writekv("submodel_count", len(subs))
+    for i, (k, m) in enumerate(subs):
+        w.writekv(f"submodel_key_{i}", k)
+        w.writekv(f"submodel_dir_{i}", f"models/{k}/")
+        # nest the sub-model's complete mojo under its directory
+        sub_blob = write_genmodel_mojo(m)
+        with zipfile.ZipFile(io.BytesIO(sub_blob)) as sz:
+            for entry in sz.namelist():
+                w.writeblob(f"models/{k}/{entry}", sz.read(entry))
+    w.writekv("base_models_num", len(base_keys))
+    w.writekv("metalearner", str(meta.key))
+    w.writekv("metalearner_transform", "NONE")
+    for i, bk in enumerate(base_keys):
+        w.writekv(f"base_model{i}", bk)
+    return w.finish(columns, domains)
+
+
 def write_genmodel_mojo(model) -> bytes:
     if model.algo in ("gbm", "drf"):
         return write_tree_mojo(model)
@@ -724,6 +791,8 @@ def write_genmodel_mojo(model) -> bytes:
         return write_pca_mojo(model)
     if model.algo == "targetencoder":
         return write_target_encoder_mojo(model)
+    if model.algo == "stackedensemble":
+        return write_stackedensemble_mojo(model)
     if model.algo == "deeplearning":
         return write_deeplearning_mojo(model)
     raise NotImplementedError(
@@ -992,6 +1061,26 @@ def read_genmodel_mojo(data) -> Dict:
                 words=vocab[: int(info.get("vocab_size", len(vocab)))],
                 vectors=vecs.reshape(-1, vec_size) if vec_size else
                 vecs.reshape(len(vocab), -1))
+        elif algo == "stackedensemble":
+            n_sub = int(info.get("submodel_count", 0))
+            submodels: Dict[str, Dict] = {}
+            for i in range(n_sub):
+                key = info[f"submodel_key_{i}"]
+                d = info[f"submodel_dir_{i}"]
+                buf = io.BytesIO()
+                with zipfile.ZipFile(buf, "w") as oz:
+                    for entry in names:
+                        if entry.startswith(d):
+                            oz.writestr(entry[len(d):], z.read(entry))
+                submodels[key] = buf.getvalue()
+            base = []
+            for i in range(int(info.get("base_models_num", 0))):
+                bk = info.get(f"base_model{i}")
+                if bk is not None:
+                    base.append(bk)
+            result["stackedensemble"] = dict(
+                submodels=submodels, base_models=base,
+                metalearner=info.get("metalearner"))
         elif algo == "isotonicregression":
             iarr = lambda key: _parse_float_arr(info, key)  # noqa: E731
             result["isotonic"] = dict(
@@ -1252,6 +1341,38 @@ class GenmodelMojoModel:
                 label = (mu >= thr).astype(np.float64)
                 return np.stack([label, 1 - mu, mu], axis=1)
             return mu
+        if p["algo"] == "stackedensemble":
+            se = p["stackedensemble"]
+            cache = getattr(self, "_se_cache", None)
+            if cache is None:
+                cache = {k: GenmodelMojoModel(b)
+                         for k, b in se["submodels"].items()}
+                self._se_cache = cache
+            parent_cols = list(self.meta["x"])
+            col_idx = {c: i for i, c in enumerate(parent_cols)}
+
+            def sub_score(key):
+                sub = cache[key]
+                sel = [col_idx[c] for c in sub.columns]
+                return sub, np.atleast_2d(
+                    np.asarray(sub.score_matrix(X[:, sel])))
+
+            # level-one features named the way the metalearner was
+            # trained (models/ensemble.py _base_pred_columns)
+            l1: Dict[str, np.ndarray] = {}
+            for bk in se["base_models"]:
+                sub, raw = sub_score(bk)
+                bdom = sub.response_domain
+                if bdom is None:
+                    l1[bk] = raw.reshape(X.shape[0])
+                elif len(bdom) == 2:
+                    l1[bk] = raw[:, 2]
+                else:
+                    for kk, lvl in enumerate(bdom):
+                        l1[f"{bk}/{lvl}"] = raw[:, 1 + kk]
+            meta = cache[se["metalearner"]]
+            Xm = np.stack([l1[c] for c in meta.columns], axis=1)
+            return meta.score_matrix(Xm)
         if p["algo"] == "isotonicregression":
             iso = p["isotonic"]
             tx, ty = iso["thresholds_x"], iso["thresholds_y"]

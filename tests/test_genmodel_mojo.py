@@ -292,3 +292,77 @@ def test_isotonic_pca_te_mojo_cross_scoring(cl, rng):
         mt.transform(frt, as_training=False, noise=0.0)
         .vec("g_te").to_numpy())
     np.testing.assert_allclose(gott, wantt, atol=1e-5)
+
+
+def test_stackedensemble_mojo_cross_scoring(cl, rng):
+    """MultiModelMojoReader layout: nested sub-mojos under models/<key>/,
+    metalearner + base refs in the parent kv; ensemble probability
+    parity with in-cluster predict."""
+    from h2o_tpu.models.ensemble import StackedEnsemble
+    from h2o_tpu.models.tree.gbm import GBM
+    from h2o_tpu.models.glm import GLM
+    from h2o_tpu.mojo import export_genmodel_mojo
+    from h2o_tpu.mojo.genmodel import GenmodelMojoModel
+    n = 500
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    logits = 1.2 * x[:, 0] - x[:, 1] + 0.5 * x[:, 2]
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.int32)
+    fr = Frame(["a", "b", "c", "y"],
+               [Vec(x[:, 0]), Vec(x[:, 1]), Vec(x[:, 2]),
+                Vec(y, T_CAT, domain=["no", "yes"])])
+    gbm = GBM(ntrees=5, max_depth=3, seed=1, nfolds=3,
+              keep_cross_validation_predictions=True).train(
+        y="y", training_frame=fr)
+    glm = GLM(family="binomial", lambda_=0.0, seed=1, nfolds=3,
+              keep_cross_validation_predictions=True).train(
+        y="y", training_frame=fr)
+    se = StackedEnsemble(base_models=[str(gbm.key), str(glm.key)]).train(
+        y="y", training_frame=fr)
+    blob = export_genmodel_mojo(se)
+    with zipfile.ZipFile(io.BytesIO(blob)) as z:
+        names = z.namelist()
+        assert any(n_.startswith(f"models/{gbm.key}/") for n_ in names)
+        ini = z.read("model.ini").decode()
+        assert "submodel_count = 3" in ini
+    gm = GenmodelMojoModel(blob)
+    X = x.astype(np.float64)
+    got = gm.score_matrix(X)
+    want = np.asarray(se.predict_raw(fr))[:n]
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-3)
+
+
+def test_stackedensemble_mojo_glm_cat_base(cl, rng):
+    """SE with GLM-only base models over a categorical predictor: the
+    parent artifact must still carry the cat domain (from
+    expansion_spec), and base features outside the SE's x stay
+    scoreable."""
+    from h2o_tpu.models.ensemble import StackedEnsemble
+    from h2o_tpu.models.glm import GLM
+    from h2o_tpu.mojo import export_genmodel_mojo
+    from h2o_tpu.mojo.genmodel import GenmodelMojoModel
+    n = 400
+    g = rng.integers(0, 3, size=n)
+    x = rng.normal(size=n).astype(np.float32)
+    yv = (x + 0.6 * (g == 1) + rng.normal(size=n) * 0.3 > 0.3)
+    fr = Frame(["x", "g", "y"],
+               [Vec(x),
+                Vec(g.astype(np.int32), T_CAT, domain=["u", "v", "w"]),
+                Vec(yv.astype(np.int32), T_CAT, domain=["f", "t"])])
+    m1 = GLM(family="binomial", lambda_=0.0, seed=1, nfolds=3,
+             keep_cross_validation_predictions=True).train(
+        y="y", training_frame=fr)
+    m2 = GLM(family="binomial", lambda_=1e-4, seed=2, nfolds=3,
+             keep_cross_validation_predictions=True).train(
+        y="y", training_frame=fr)
+    se = StackedEnsemble(base_models=[str(m1.key), str(m2.key)]).train(
+        y="y", training_frame=fr)
+    blob = export_genmodel_mojo(se)
+    gm = GenmodelMojoModel(blob)
+    assert gm.domain_of("g") == ["u", "v", "w"]
+    X = np.stack([x.astype(np.float64), g.astype(np.float64)], axis=1)
+    # order scorer input by the artifact's own columns
+    sel = {c: i for i, c in enumerate(["x", "g"])}
+    Xo = X[:, [sel[c] for c in gm.columns]]
+    got = gm.score_matrix(Xo)
+    want = np.asarray(se.predict_raw(fr))[:n]
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-3)
