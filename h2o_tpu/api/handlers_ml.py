@@ -202,6 +202,20 @@ def _automl_or_404(aml_id: str):
     return a
 
 
+def _normalize_preprocessing(raw):
+    """h2o-py sends preprocessing=['target_encoding'] as
+    [{'type': 'targetencoding'}] (automl/_estimator.py:433); normalize
+    both spellings to the step-name list AutoML validates."""
+    if not raw:
+        return None
+    out = []
+    for step in raw:
+        name = step.get("type") if isinstance(step, dict) else step
+        name = str(name).replace("targetencoding", "target_encoding")
+        out.append(name)
+    return out
+
+
 @route("POST", r"/99/AutoMLBuilder")
 def automl_build(params):
     """AutoMLBuildSpec: build_control + build_models + input_spec
@@ -248,6 +262,8 @@ def automl_build(params):
         stopping_metric=sc.get("stopping_metric", "AUTO"),
         stopping_tolerance=float(sc.get("stopping_tolerance", -1.0)),
         sort_metric=ins.get("sort_metric"),
+        preprocessing=_normalize_preprocessing(
+            bm.get("preprocessing") or ins.get("preprocessing")),
         project_name=bc.get("project_name") or "")
     job = aml.train_async(x=x, y=y, training_frame=fr,
                           validation_frame=valid, leaderboard_frame=lb_fr)

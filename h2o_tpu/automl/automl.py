@@ -26,6 +26,7 @@ from h2o_tpu.core.job import Job
 from h2o_tpu.core.log import get_logger
 from h2o_tpu.core.store import Key
 from h2o_tpu.models.leaderboard import Leaderboard
+from h2o_tpu.models.model import Model
 
 log = get_logger("automl")
 
@@ -113,6 +114,46 @@ def _default_plan(seed: int) -> List[Dict]:
 _EXPLOITATION_RATIO = 0.1
 
 
+class TEPipelineModel(Model):
+    """A trained model plus its target-encoding step: any scoring frame
+    missing the ``_te`` columns is transformed first, then delegated
+    (the reference's AutoML TE preprocessing embeds the encoder into the
+    model's scoring pipeline).  Shares the inner model's output dict and
+    key so leaderboards/REST serialization see the real model."""
+
+    def __init__(self, inner: Model, te_model, te_cols: List[str]):
+        self.inner = inner
+        self.te = te_model
+        self.te_cols = list(te_cols)
+        self.algo = inner.algo
+        self.key = inner.key
+        self.params = inner.params
+        self.output = inner.output
+        self.run_time_ms = getattr(inner, "run_time_ms", 0)
+        # MOJO exporters must refuse: the artifact would lack the encoder
+        self.output["preprocessing_te_key"] = str(te_model.key)
+
+    def _augment(self, frame: Frame) -> Frame:
+        if all(c in frame.names for c in self.te_cols):
+            return frame
+        enc = self.te.transform(frame, as_training=False, noise=0.0)
+        out = Frame(list(frame.names), list(frame.vecs))
+        for c in self.te_cols:
+            if c not in out.names:
+                out.add(c, enc.vec(c))
+        return out
+
+    def predict_raw(self, frame: Frame):
+        return self.inner.predict_raw(self._augment(frame))
+
+    def predict(self, frame: Frame) -> Frame:
+        return self.inner.predict(self._augment(frame))
+
+    def model_metrics(self, frame: Frame = None):
+        return self.inner.model_metrics(
+            self._augment(frame) if frame is not None else None)
+
+
 class AutoML:
     """The h2o.automl.H2OAutoML surface: train many models, rank, ensemble."""
 
@@ -123,7 +164,15 @@ class AutoML:
                  stopping_rounds: int = 3, stopping_metric: str = "AUTO",
                  stopping_tolerance: float = -1.0,
                  sort_metric: Optional[str] = None,
+                 preprocessing: Optional[List[str]] = None,
                  project_name: str = ""):
+        preprocessing = list(preprocessing or [])
+        bad = [s for s in preprocessing if s != "target_encoding"]
+        if bad:
+            raise ValueError(f"unsupported preprocessing steps {bad}; "
+                             "only ['target_encoding'] is supported "
+                             "(matches the reference's experimental "
+                             "surface)")
         if not max_models and not max_runtime_secs:
             max_runtime_secs = 3600.0   # reference default budget
         self.params = dict(max_models=max_models,
@@ -133,6 +182,7 @@ class AutoML:
                            stopping_rounds=stopping_rounds,
                            stopping_metric=stopping_metric,
                            stopping_tolerance=stopping_tolerance,
+                           preprocessing=preprocessing,
                            project_name=project_name)
         self.project_name = project_name or f"automl_{int(time.time())}"
         self.leaderboard = Leaderboard(self.project_name,
@@ -210,6 +260,45 @@ class AutoML:
                           keep_cross_validation_predictions=True, seed=seed)
         x_cols = [c for c in (x or train.names) if c != y]
 
+        # preprocessing: target encoding (ai/h2o/automl/preprocessing/
+        # TargetEncoding.java) — CV-safe encodings on the shared fold
+        # column, appended for the tree-family steps (originals kept,
+        # keep_original_categorical_columns default)
+        te_cols: List[str] = []
+        if "target_encoding" in (p.get("preprocessing") or []):
+            cat_x = [c for c in x_cols
+                     if c in work.names and work.vec(c).is_categorical]
+            if cat_x:
+                from h2o_tpu.models.target_encoder import TargetEncoder
+                te_p = dict(noise=0.0, seed=seed)
+                if nfolds:
+                    te_p.update(data_leakage_handling="KFold",
+                                fold_column=fold_name)
+                te = TargetEncoder(**te_p).train(
+                    x=cat_x, y=y, training_frame=work)
+                cloud().dkv.put(te.key, te)
+                enc = te.transform(work, as_training=bool(nfolds),
+                                   noise=0.0)
+                for c in cat_x:
+                    nm = f"{c}_te"
+                    work = Frame(list(work.names) + [nm],
+                                 list(work.vecs) + [enc.vec(nm)])
+                    te_cols.append(nm)
+                ev.info("init", f"target encoding applied to {cat_x} "
+                                f"({'KFold' if nfolds else 'simple'})")
+            else:
+                ev.info("init", "target_encoding requested but no "
+                                "categorical predictors; skipped")
+
+        _TREE_FAMILY = {"gbm", "drf", "xgboost",
+                        "extendedisolationforest", "isolationforest"}
+        valid_te = None
+        if te_cols and valid is not None:
+            enc_v = te.transform(valid, as_training=False, noise=0.0)
+            valid_te = Frame(list(valid.names), list(valid.vecs))
+            for c in te_cols:
+                valid_te.add(c, enc_v.vec(c))
+
         def train_one(algo: str, prm: Dict, step: str, work_share=None):
             if budget.exhausted():
                 return None
@@ -223,9 +312,17 @@ class AutoML:
                     work_share or budget.remaining())
             try:
                 t = time.time()
+                use_te = bool(te_cols) and algo in _TREE_FAMILY
+                x_step = x_cols + te_cols if use_te else x_cols
                 m = builder_class(algo)(**prm).train(
-                    x=x_cols, y=y, training_frame=work,
-                    validation_frame=valid)
+                    x=x_step, y=y, training_frame=work,
+                    validation_frame=valid_te if use_te else valid)
+                if use_te:
+                    # scoring-time parity: wrap so any frame WITHOUT the
+                    # _te columns is transformed before delegation (the
+                    # reference embeds the TE step into the model's
+                    # scoring pipeline)
+                    m = TEPipelineModel(m, te, te_cols)
                 cloud().dkv.put(m.key, m)
                 budget.n_models += 1
                 self.leaderboard.add(m)

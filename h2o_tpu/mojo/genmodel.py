@@ -775,6 +775,12 @@ def write_stackedensemble_mojo(model) -> bytes:
 
 
 def write_genmodel_mojo(model) -> bytes:
+    if model.output.get("preprocessing_te_key"):
+        raise NotImplementedError(
+            "model was trained with AutoML target-encoding "
+            "preprocessing; the genmodel artifact cannot carry the "
+            "encoder step — score through the cluster, or retrain "
+            "without preprocessing for a standalone MOJO")
     if model.algo in ("gbm", "drf"):
         return write_tree_mojo(model)
     if model.algo == "glm":

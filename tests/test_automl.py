@@ -130,3 +130,64 @@ def test_automl_respects_max_runtime(cl, bin_frame):
     # stop promptly after the budget — generous 4x bound
     assert wall < 100.0
     assert len(aml.leaderboard.rows()) >= 1
+
+
+def test_automl_target_encoding_preprocessing(cl, rng):
+    """preprocessing=['target_encoding'] (ai/h2o/automl/preprocessing/
+    TargetEncoding.java): CV-safe _te columns feed the tree steps."""
+    from h2o_tpu.automl.automl import AutoML
+    n = 300
+    g = rng.integers(0, 4, size=n)
+    x = rng.normal(size=n).astype(np.float32)
+    y = (x + 0.5 * (g % 2) + rng.normal(size=n) * 0.3 > 0.4)
+    fr = Frame(["x", "g", "y"],
+               [Vec(x),
+                Vec(g.astype(np.int32), T_CAT,
+                    domain=["a", "b", "c", "d"]),
+                Vec(y.astype(np.int32), T_CAT, domain=["n", "p"])])
+    aml = AutoML(max_models=2, nfolds=3, seed=1,
+                 include_algos=["GBM"],
+                 preprocessing=["target_encoding"])
+    aml.train(y="y", training_frame=fr)
+    msgs = " ".join(e["message"] for e in aml.event_log.events)
+    assert "target encoding applied" in msgs
+    lead = aml.leaderboard.leader
+    assert lead is not None
+    assert "g_te" in lead.output["x"]
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="unsupported preprocessing"):
+        AutoML(preprocessing=["pca"])
+
+
+def test_automl_te_models_score_raw_frames(cl, rng):
+    """TE-trained models score frames WITHOUT _te columns (the pipeline
+    wrapper applies the encoder), incl. a leaderboard frame and new
+    data; MOJO export refuses with a clear message."""
+    from h2o_tpu.automl.automl import AutoML
+    n = 300
+    g = rng.integers(0, 3, size=n)
+    x = rng.normal(size=n).astype(np.float32)
+    y = (x + 0.6 * (g == 1) + rng.normal(size=n) * 0.3 > 0.4)
+
+    def mk(lo, hi):
+        return Frame(["x", "g", "y"],
+                     [Vec(x[lo:hi]),
+                      Vec(g[lo:hi].astype(np.int32), T_CAT,
+                          domain=["a", "b", "c"]),
+                      Vec(y[lo:hi].astype(np.int32), T_CAT,
+                          domain=["n", "p"])])
+    tr, lb = mk(0, 200), mk(200, 300)
+    aml = AutoML(max_models=1, nfolds=3, seed=1, include_algos=["GBM"],
+                 preprocessing=["target_encoding"])
+    aml.train(y="y", training_frame=tr, leaderboard_frame=lb)
+    lead = aml.leaderboard.leader
+    assert lead is not None
+    # raw frame (no _te columns) scores through the wrapper
+    raw = np.asarray(lead.predict_raw(lb))[: lb.nrows]
+    assert raw.shape[1] == 3 and np.isfinite(raw[:, 2]).all()
+    # leaderboard ranking on the raw lb frame worked
+    assert aml.leaderboard.rows()[0]["auc"] > 0.5
+    # mojo export refuses clearly
+    from h2o_tpu.mojo import export_genmodel_mojo
+    with pytest.raises(NotImplementedError, match="target-encoding"):
+        export_genmodel_mojo(lead)
