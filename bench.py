@@ -174,6 +174,32 @@ def bench_gbm10m(cols, depth):
     return out
 
 
+def _arm_watchdog(detail_ref):
+    """Emit a partial JSON line and hard-exit if the device hangs
+    (a wedged TPU tunnel otherwise hangs the whole bench forever).
+    BENCH_WATCHDOG_SECS=0 disables; default 2700s leaves ample room for
+    the full ladder's compiles on healthy hardware."""
+    import threading
+
+    secs = float(os.environ.get("BENCH_WATCHDOG_SECS", 2700))
+    if secs <= 0:
+        return
+
+    def fire():
+        detail = dict(detail_ref[0] or {})
+        detail["watchdog"] = f"bench exceeded {secs:.0f}s; device hang " \
+                             "suspected — partial results emitted"
+        print(json.dumps({
+            "metric": "gbm_higgs_like_train_throughput_steady",
+            "value": 0.0, "unit": "rows*trees/sec",
+            "vs_baseline": 0.0, "detail": detail}), flush=True)
+        os._exit(2)
+
+    t = threading.Timer(secs, fire)
+    t.daemon = True
+    t.start()
+
+
 def main():
     rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
     cols = int(os.environ.get("BENCH_COLS", 28))
@@ -182,10 +208,11 @@ def main():
     configs = os.environ.get("BENCH_CONFIG",
                              "gbm,drf,glm,dl,hist,gbm10m").split(",")
 
+    detail = {"rows": rows, "cols": cols}
+    _arm_watchdog([detail])
+
     X, y = _make_data(rows, cols)
     fr = _frame(X, y)
-
-    detail = {"rows": rows, "cols": cols}
     runs = [("gbm", lambda: bench_gbm(fr, rows, trees, depth)),
             ("drf", lambda: bench_drf(fr, rows, trees, depth)),
             ("glm", lambda: bench_glm(fr, rows)),

@@ -774,6 +774,57 @@ def write_stackedensemble_mojo(model) -> bytes:
     return w.finish(columns, domains)
 
 
+def write_coxph_mojo(model) -> bytes:
+    """CoxPH -> genmodel MOJO (CoxPHMojoWriter key set: coef +
+    cat/num offsets + x_mean_cat/x_mean_num rectangular blobs of
+    big-endian doubles with _size1/_size2 kv; no strata/interactions —
+    this builder has neither)."""
+    out = model.output
+    spec = out["expansion_spec"]
+    cat_names = list(spec["cat_names"])
+    num_names = list(spec["num_names"])
+    cards = list(spec["cat_cards"])
+    uafl = bool(spec["use_all_factor_levels"])
+    coef = np.asarray(out["coef"], np.float64)
+    x_mean = np.asarray(out["x_mean"], np.float64)
+    n_cat_coef = sum(c - (0 if uafl else 1) for c in cards)
+    cat_offsets = [0]
+    for c in cards:
+        cat_offsets.append(cat_offsets[-1] + (c - (0 if uafl else 1)))
+    num_offsets = [n_cat_coef + i for i in range(len(num_names))]
+    x = cat_names + num_names
+    resp = model.params.get("response_column") or "event"
+    columns = x + [resp]
+    cat_domains = list(spec.get("cat_domains") or [])
+    domains: List[Optional[List[str]]] =         [(cat_domains[j] if j < len(cat_domains) else
+          [str(i) for i in range(cards[j])]) for j in range(len(cat_names))]
+    domains += [None] * (len(num_names) + 1)
+    w = _ZipWriter()
+    _common_info(w, "coxph", "Cox Proportional Hazards", "CoxPH",
+                 str(model.key), True, len(x), 1, len(columns),
+                 sum(d is not None for d in domains), "1.00")
+    w.writekv("coef", [float(v) for v in coef])
+    w.writekv("cats", len(cat_names))
+    w.writekv("cat_offsets", cat_offsets)
+    w.writekv("use_all_factor_levels", uafl)
+    w.writekv("num_numerical_columns", len(num_names))
+    w.writekv("num_offsets", num_offsets)
+    w.writekv("strata_count", 0)
+    # training rollup means for NA imputation (expand_for_scoring
+    # contract; x_mean is the response-valid-row mean used for centering
+    # and can differ when rows were dropped for invalid responses)
+    w.writekv("num_means", [float(m) for m in spec["means"]])
+    w.writekv("x_mean_cat_size1", 1)
+    w.writekv("x_mean_cat_size2", n_cat_coef)
+    w.writeblob("x_mean_cat",
+                x_mean[:n_cat_coef].astype(">f8").tobytes())
+    w.writekv("x_mean_num_size1", 1)
+    w.writekv("x_mean_num_size2", len(num_names))
+    w.writeblob("x_mean_num",
+                x_mean[n_cat_coef:].astype(">f8").tobytes())
+    return w.finish(columns, domains)
+
+
 def write_genmodel_mojo(model) -> bytes:
     if model.output.get("preprocessing_te_key"):
         raise NotImplementedError(
@@ -799,6 +850,8 @@ def write_genmodel_mojo(model) -> bytes:
         return write_target_encoder_mojo(model)
     if model.algo == "stackedensemble":
         return write_stackedensemble_mojo(model)
+    if model.algo == "coxph":
+        return write_coxph_mojo(model)
     if model.algo == "deeplearning":
         return write_deeplearning_mojo(model)
     raise NotImplementedError(
@@ -1087,6 +1140,27 @@ def read_genmodel_mojo(data) -> Dict:
             result["stackedensemble"] = dict(
                 submodels=submodels, base_models=base,
                 metalearner=info.get("metalearner"))
+        elif algo == "coxph":
+            if int(info.get("strata_count", 0) or 0) != 0:
+                raise NotImplementedError(
+                    "CoxPH MOJO with strata is not supported by this "
+                    "reader (per-stratum x_mean blocks)")
+            carr = lambda key: _parse_float_arr(info, key)  # noqa: E731
+            result["coxph"] = dict(
+                coef=carr("coef"),
+                cats=int(info.get("cats", 0)),
+                cat_offsets=np.asarray(
+                    [int(float(s)) for s in
+                     info.get("cat_offsets", "[0]").strip("[]")
+                     .split(",") if s.strip()], np.int64),
+                use_all_factor_levels=info.get(
+                    "use_all_factor_levels", "false") == "true",
+                nums=int(info.get("num_numerical_columns", 0)),
+                num_means=carr("num_means"),
+                x_mean_cat=np.frombuffer(z.read("x_mean_cat"),
+                                         dtype=">f8").astype(np.float64),
+                x_mean_num=np.frombuffer(z.read("x_mean_num"),
+                                         dtype=">f8").astype(np.float64))
         elif algo == "isotonicregression":
             iarr = lambda key: _parse_float_arr(info, key)  # noqa: E731
             result["isotonic"] = dict(
@@ -1379,6 +1453,36 @@ class GenmodelMojoModel:
             meta = cache[se["metalearner"]]
             Xm = np.stack([l1[c] for c in meta.columns], axis=1)
             return meta.score_matrix(Xm)
+        if p["algo"] == "coxph":
+            cx = p["coxph"]
+            coef = cx["coef"]
+            cats, nums = cx["cats"], cx["nums"]
+            offs = cx["cat_offsets"]
+            uafl = cx["use_all_factor_levels"]
+            x_mean = np.concatenate([cx["x_mean_cat"],
+                                     cx["x_mean_num"]])
+            lp_base = float(coef @ x_mean)
+            lp = np.zeros(X.shape[0])
+            for i in range(cats):
+                ival = X[:, i].astype(np.float64)
+                iv = np.where(np.isnan(ival), -1, ival).astype(np.int64)
+                if not uafl:
+                    iv = iv - 1
+                iv = iv + offs[i]
+                ok = (iv >= offs[i]) & (iv < offs[i + 1])
+                lp += np.where(ok, coef[np.clip(iv, 0,
+                                                len(coef) - 1)], 0.0)
+            n_cat_coef = int(offs[cats]) if cats else 0
+            num_block = X[:, cats: cats + nums].astype(np.float64)
+            # impute_missing contract: NA numerics take the training
+            # ROLLUP mean (expand_for_scoring), which differs from the
+            # centering mean when response-invalid rows were dropped
+            imp = cx["num_means"] if len(cx["num_means"]) == nums \
+                else cx["x_mean_num"]
+            num_block = np.where(np.isnan(num_block),
+                                 imp[None, :], num_block)
+            lp += num_block @ coef[n_cat_coef: n_cat_coef + nums]
+            return lp - lp_base
         if p["algo"] == "isotonicregression":
             iso = p["isotonic"]
             tx, ty = iso["thresholds_x"], iso["thresholds_y"]

@@ -214,6 +214,12 @@ def glm_pojo(model) -> str:
 
 
 def pojo_source(model) -> str:
+    if model.output.get("preprocessing_te_key"):
+        raise NotImplementedError(
+            "model was trained with AutoML target-encoding "
+            "preprocessing; the POJO cannot carry the encoder step — "
+            "score through the cluster, or retrain without "
+            "preprocessing for a standalone artifact")
     if model.algo in ("gbm", "drf"):
         return tree_pojo(model)
     if model.algo == "glm":

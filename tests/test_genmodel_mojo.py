@@ -366,3 +366,37 @@ def test_stackedensemble_mojo_glm_cat_base(cl, rng):
     got = gm.score_matrix(Xo)
     want = np.asarray(se.predict_raw(fr))[:n]
     np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-3)
+
+
+def test_coxph_mojo_cross_scoring(cl, rng):
+    """CoxPHMojoWriter layout: coef + offsets + x_mean rectangular
+    blobs; linear-predictor parity."""
+    from h2o_tpu.models.coxph import CoxPH
+    from h2o_tpu.mojo import export_genmodel_mojo
+    from h2o_tpu.mojo.genmodel import GenmodelMojoModel
+    n = 300
+    age = rng.uniform(40, 80, size=n).astype(np.float32)
+    grp = rng.integers(0, 2, size=n)
+    hazard = 0.02 * np.exp(0.03 * (age - 60) + 0.5 * grp)
+    t = rng.exponential(1.0 / hazard).astype(np.float32)
+    event = (t < 30).astype(np.int32)
+    t = np.minimum(t, 30)
+    fr = Frame(["age", "grp", "time", "event"],
+               [Vec(age),
+                Vec(grp.astype(np.int32), T_CAT, domain=["ctl", "trt"]),
+                Vec(t), Vec(event.astype(np.float32))])
+    m = CoxPH(stop_column="time").train(
+        x=["age", "grp"], y="event", training_frame=fr)
+    blob = export_genmodel_mojo(m)
+    gm = GenmodelMojoModel(blob)
+    cols = gm.columns
+    Xo = np.zeros((n, len(cols)))
+    for j, c in enumerate(cols):
+        v = fr.vec(c)
+        Xo[:, j] = np.asarray(v.to_numpy(), np.float64)
+    got = gm.score_matrix(Xo)
+    want = np.asarray(m.predict_raw(fr))[:n]
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+    with zipfile.ZipFile(io.BytesIO(blob)) as z:
+        ini = z.read("model.ini").decode()
+        assert "algo = coxph" in ini and "strata_count = 0" in ini
