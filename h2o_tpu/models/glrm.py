@@ -116,6 +116,11 @@ def _make_step(loss_name: str, rx: str, ry: str):
     return objective, step
 
 
+# fixed-Y scoring solve iterations — exported into MOJOs (x_iters) so
+# the artifact scorer reproduces this solve exactly
+GLRM_X_ITERS = 30
+
+
 @functools.lru_cache(maxsize=32)
 def _x_solver(loss_name: str, rx: str, iters: int):
     """Jitted fixed-Y X-fit (GLRMGenX scoring analog), cached per config."""
@@ -158,7 +163,7 @@ class GLRMModel(Model):
     algo = "glrm"
     supervised = False
 
-    def _solve_x(self, frame: Frame, A, iters: int = 30):
+    def _solve_x(self, frame: Frame, A, iters: int = GLRM_X_ITERS):
         """Fit X for new rows with Y fixed; missing cells carry no loss."""
         out = self.output
         Y = jnp.asarray(out["archetypes"])

@@ -825,6 +825,65 @@ def write_coxph_mojo(model) -> bytes:
     return w.finish(columns, domains)
 
 
+def write_glrm_mojo(model) -> bytes:
+    """GLRM -> genmodel MOJO (GlrmMojoWriter key set: regularization /
+    gamma / ncolX / norm sub-mul + archetypes blob).  Scoring is the
+    fixed-Y X-fit (GlrmMojoModel's iterative solve); this writer also
+    records the deterministic solve config (x_iters, loss, prox) and the
+    expansion spec so the numpy scorer reproduces the cluster solve
+    exactly (our solve starts from X0=0 — no RNG, unlike the
+    reference's seeded random init)."""
+    out = model.output
+    spec = out["expansion_spec"]
+    loss = str(out.get("loss", "Quadratic"))
+    rx = str(out.get("regularization_x", "None"))
+    if (loss.lower() not in ("quadratic", "absolute", "huber") or
+            rx.lower() not in ("none", "quadratic", "l1",
+                               "nonnegative", "non_negative")):
+        raise NotImplementedError(
+            f"GLRM MOJO export supports quadratic/absolute/huber loss "
+            f"and none/quadratic/l1/nonnegative x-regularization; got "
+            f"loss={loss!r} regularization_x={rx!r}")
+    Y = np.asarray(out["archetypes"], np.float64)     # (k, P)
+    cat_names = list(spec["cat_names"])
+    num_names = list(spec["num_names"])
+    x = cat_names + num_names
+    cat_domains = list(spec.get("cat_domains") or [])
+    domains: List[Optional[List[str]]] = (
+        [(cat_domains[j] if j < len(cat_domains) else None)
+         for j in range(len(cat_names))] + [None] * len(num_names))
+    w = _ZipWriter()
+    _common_info(w, "glrm", "Generalized Low Rank Modeling",
+                 "DimReduction", str(model.key), False, len(x), 1,
+                 len(x), sum(d is not None for d in domains), "1.10")
+    w.writekv("initialization",
+              str(model.params.get("init", "SVD")))
+    w.writekv("regularizationX", rx)
+    w.writekv("regularizationY", str(out.get("regularization_y", "None")))
+    w.writekv("gammaX", float(out.get("gamma_x", 0.0)))
+    w.writekv("gammaY", float(out.get("gamma_y", 0.0)))
+    w.writekv("ncolX", int(Y.shape[0]))
+    seed_p = model.params.get("seed")
+    w.writekv("seed", int(-1 if seed_p is None else seed_p))
+    w.writekv("transposed", False)
+    w.writekv("num_categories", len(cat_names))
+    w.writekv("num_numeric", len(num_names))
+    w.writekv("norm_sub", [float(m) for m in spec["means"]])
+    w.writekv("norm_mul",
+              [float(1.0 / (s or 1.0)) for s in spec["sigmas"]])
+    # deterministic-scoring extensions (this implementation's solve)
+    w.writekv("loss", loss)
+    from h2o_tpu.models.glrm import GLRM_X_ITERS
+    w.writekv("x_iters", GLRM_X_ITERS)
+    w.writekv("standardize", bool(spec["standardize"]))
+    w.writekv("use_all_factor_levels", bool(spec["use_all_factor_levels"]))
+    w.writekv("cat_cards", [int(c) for c in spec["cat_cards"]])
+    w.writekv("archetypes_size1", int(Y.shape[0]))
+    w.writekv("archetypes_size2", int(Y.shape[1]))
+    w.writeblob("archetypes", Y.astype(">f8").tobytes())
+    return w.finish(x, domains)
+
+
 def write_genmodel_mojo(model) -> bytes:
     if model.output.get("preprocessing_te_key"):
         raise NotImplementedError(
@@ -852,6 +911,8 @@ def write_genmodel_mojo(model) -> bytes:
         return write_stackedensemble_mojo(model)
     if model.algo == "coxph":
         return write_coxph_mojo(model)
+    if model.algo == "glrm":
+        return write_glrm_mojo(model)
     if model.algo == "deeplearning":
         return write_deeplearning_mojo(model)
     raise NotImplementedError(
@@ -1140,6 +1201,25 @@ def read_genmodel_mojo(data) -> Dict:
             result["stackedensemble"] = dict(
                 submodels=submodels, base_models=base,
                 metalearner=info.get("metalearner"))
+        elif algo == "glrm":
+            garr = lambda key: _parse_float_arr(info, key)  # noqa: E731
+            k = int(info.get("archetypes_size1", 0))
+            P = int(info.get("archetypes_size2", 0))
+            result["glrm"] = dict(
+                archetypes=np.frombuffer(z.read("archetypes"),
+                                         dtype=">f8").astype(
+                    np.float64).reshape(k, P),
+                loss=info.get("loss", "Quadratic").lower(),
+                rx=info.get("regularizationX", "None").lower(),
+                gamma_x=float(info.get("gammaX", 0.0)),
+                x_iters=int(info.get("x_iters", 30)),
+                standardize=info.get("standardize", "false") == "true",
+                uafl=info.get("use_all_factor_levels",
+                              "false") == "true",
+                cat_cards=[int(v) for v in garr("cat_cards")],
+                norm_sub=garr("norm_sub"), norm_mul=garr("norm_mul"),
+                cats=int(info.get("num_categories", 0)),
+                nums=int(info.get("num_numeric", 0)))
         elif algo == "coxph":
             if int(info.get("strata_count", 0) or 0) != 0:
                 raise NotImplementedError(
@@ -1453,6 +1533,57 @@ class GenmodelMojoModel:
             meta = cache[se["metalearner"]]
             Xm = np.stack([l1[c] for c in meta.columns], axis=1)
             return meta.score_matrix(Xm)
+        if p["algo"] == "glrm":
+            gl = p["glrm"]
+            Y = gl["archetypes"]
+            cats, nums = gl["cats"], gl["nums"]
+            lo = 0 if gl["uafl"] else 1
+            blocks, masks = [], []
+            for i, card in enumerate(gl["cat_cards"]):
+                codes = X[:, i].astype(np.float64)
+                ok = ~np.isnan(codes) & (codes >= 0)
+                iv = np.where(ok, codes, 0).astype(np.int64)
+                onehot = np.zeros((X.shape[0], card - lo))
+                for lvl in range(lo, card):
+                    onehot[:, lvl - lo] = (iv == lvl) & ok
+                blocks.append(onehot)
+                masks.append(np.repeat(ok[:, None], card - lo, axis=1))
+            num_block = X[:, cats: cats + nums].astype(np.float64)
+            num_ok = ~np.isnan(num_block)
+            filled = np.where(num_ok, num_block,
+                              gl["norm_sub"][None, :])
+            if gl["standardize"]:
+                filled = (filled - gl["norm_sub"][None, :]) * \
+                    gl["norm_mul"][None, :]
+            blocks.append(filled)
+            masks.append(num_ok)
+            A = np.concatenate(blocks, axis=1)
+            mask = np.concatenate(masks, axis=1)
+            # deterministic prox-gradient X solve (models/glrm.py
+            # _x_solver: X0 = 0, alpha = 1/||Y||^2, x_iters steps)
+            alpha = 1.0 / max(float((Y * Y).sum()), 1.0)
+            Az = np.nan_to_num(A)
+            Xs = np.zeros((A.shape[0], Y.shape[0]))
+            loss, rx, gx = gl["loss"], gl["rx"], gl["gamma_x"]
+            for _ in range(gl["x_iters"]):
+                U = Xs @ Y
+                if loss == "quadratic":
+                    dU = 2.0 * (U - Az)
+                elif loss == "absolute":
+                    dU = np.sign(U - Az)
+                else:                                  # huber
+                    d = U - Az
+                    dU = np.where(np.abs(d) <= 1.0, d, np.sign(d))
+                g = (np.where(mask, dU, 0.0)) @ Y.T
+                Xs = Xs - alpha * g
+                sg = alpha * gx
+                if rx == "quadratic":
+                    Xs = Xs / (1.0 + 2.0 * sg)
+                elif rx == "l1":
+                    Xs = np.sign(Xs) * np.maximum(np.abs(Xs) - sg, 0.0)
+                elif rx in ("nonnegative", "non_negative"):
+                    Xs = np.maximum(Xs, 0.0)
+            return Xs @ Y
         if p["algo"] == "coxph":
             cx = p["coxph"]
             coef = cx["coef"]

@@ -400,3 +400,27 @@ def test_coxph_mojo_cross_scoring(cl, rng):
     with zipfile.ZipFile(io.BytesIO(blob)) as z:
         ini = z.read("model.ini").decode()
         assert "algo = coxph" in ini and "strata_count = 0" in ini
+
+
+def test_glrm_mojo_cross_scoring(cl, rng):
+    """GlrmMojoWriter layout + deterministic fixed-Y X-solve:
+    reconstruction parity (incl. NA cells masked from the loss)."""
+    from h2o_tpu.models.glrm import GLRM
+    from h2o_tpu.mojo import export_genmodel_mojo
+    from h2o_tpu.mojo.genmodel import GenmodelMojoModel
+    n = 150
+    W = rng.normal(size=(n, 2))
+    H = rng.normal(size=(2, 4))
+    X = (W @ H + rng.normal(size=(n, 4)) * 0.05).astype(np.float32)
+    X[4, 1] = np.nan
+    fr = Frame([f"c{i}" for i in range(4)],
+               [Vec(X[:, i]) for i in range(4)])
+    m = GLRM(k=2, seed=1, max_iterations=30).train(training_frame=fr)
+    blob = export_genmodel_mojo(m)
+    gm = GenmodelMojoModel(blob)
+    got = gm.score_matrix(X.astype(np.float64))
+    want = np.asarray(m.predict_raw(fr))[:n]
+    np.testing.assert_allclose(got, want, atol=1e-3, rtol=1e-3)
+    with zipfile.ZipFile(io.BytesIO(blob)) as z:
+        ini = z.read("model.ini").decode()
+        assert "algo = glrm" in ini and "ncolX = 2" in ini
