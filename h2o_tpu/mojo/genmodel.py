@@ -1212,7 +1212,10 @@ def read_genmodel_mojo(data) -> Dict:
                 loss=info.get("loss", "Quadratic").lower(),
                 rx=info.get("regularizationX", "None").lower(),
                 gamma_x=float(info.get("gammaX", 0.0)),
-                x_iters=int(info.get("x_iters", 30)),
+                x_iters=int(info.get(
+                    "x_iters",
+                    __import__("h2o_tpu.models.glrm",
+                               fromlist=["GLRM_X_ITERS"]).GLRM_X_ITERS)),
                 standardize=info.get("standardize", "false") == "true",
                 uafl=info.get("use_all_factor_levels",
                               "false") == "true",

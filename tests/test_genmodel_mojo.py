@@ -424,3 +424,30 @@ def test_glrm_mojo_cross_scoring(cl, rng):
     with zipfile.ZipFile(io.BytesIO(blob)) as z:
         ini = z.read("model.ini").decode()
         assert "algo = glrm" in ini and "ncolX = 2" in ini
+
+
+def test_glrm_mojo_cat_standardize_losses(cl, rng):
+    """GLRM MOJO scorer branch coverage: categorical one-hot blocks,
+    STANDARDIZE transform, huber loss + l1 x-regularization."""
+    from h2o_tpu.models.glrm import GLRM
+    from h2o_tpu.mojo import export_genmodel_mojo
+    from h2o_tpu.mojo.genmodel import GenmodelMojoModel
+    n = 120
+    g = rng.integers(0, 3, size=n)
+    g[7] = -1                                      # NA categorical code
+    x1 = (g * 1.5 + rng.normal(size=n) * 0.1).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    x2[3] = np.nan
+    fr = Frame(["g", "a", "b"],
+               [Vec(g.astype(np.int32), T_CAT, domain=["u", "v", "w"]),
+                Vec(x1), Vec(x2)])
+    m = GLRM(k=2, seed=1, max_iterations=25, transform="STANDARDIZE",
+             loss="Huber", regularization_x="L1", gamma_x=0.01).train(
+        training_frame=fr)
+    blob = export_genmodel_mojo(m)
+    gm = GenmodelMojoModel(blob)
+    X = np.stack([np.where(g < 0, np.nan, g).astype(np.float64),
+                  x1.astype(np.float64), x2.astype(np.float64)], axis=1)
+    got = gm.score_matrix(X)
+    want = np.asarray(m.predict_raw(fr))[:n]
+    np.testing.assert_allclose(got, want, atol=1e-3, rtol=1e-3)
