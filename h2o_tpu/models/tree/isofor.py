@@ -238,6 +238,7 @@ def _build_eif_trees(X, keys, S: int, D: int, nrows: int, ext: int):
         normals = jnp.zeros((H, C), jnp.float32)
         points = jnp.zeros((H, C), jnp.float32)
         value = jnp.zeros((H,), jnp.float32)
+        counts = jnp.zeros((H,), jnp.int32)   # rows reaching the node
         is_split = jnp.zeros((H,), bool)
         leaf = jnp.zeros((S,), jnp.int32)
         alive = jnp.ones((S,), bool)
@@ -266,6 +267,8 @@ def _build_eif_trees(X, keys, S: int, D: int, nrows: int, ext: int):
                 points, jnp.nan_to_num(pvec), (off, 0))
             value = jax.lax.dynamic_update_slice(
                 value, d + avg_path_length(cnt), (off,))
+            counts = jax.lax.dynamic_update_slice(
+                counts, cnt.astype(jnp.int32), (off,))
             is_split = jax.lax.dynamic_update_slice(is_split, can, (off,))
             proj = jnp.sum((jnp.nan_to_num(Xs)[:, None, :] - pvec[None]) *
                            nvec[None], axis=2)           # (S, L)
@@ -281,7 +284,9 @@ def _build_eif_trees(X, keys, S: int, D: int, nrows: int, ext: int):
         cnt = jnp.sum(hot, axis=0)
         value = jax.lax.dynamic_update_slice(
             value, D + avg_path_length(cnt), (L - 1,))
-        return carry, (normals, points, value, is_split)
+        counts = jax.lax.dynamic_update_slice(
+            counts, cnt.astype(jnp.int32), (L - 1,))
+        return carry, (normals, points, value, is_split, counts)
 
     _, trees = jax.lax.scan(one_tree, 0, keys)
     return trees
@@ -348,11 +353,12 @@ class ExtendedIsolationForest(ModelBuilder):
         T = int(p["ntrees"])
         keys = jax.random.split(self.rng_key(), T)
         job.update(0.1, f"growing {T} extended isolation trees")
-        normals, points, value, is_split = _build_eif_trees(
+        normals, points, value, is_split, counts = _build_eif_trees(
             X, keys, S, D, train.nrows, ext)
         out = dict(x=list(di.x), normals=np.asarray(normals),
                    points=np.asarray(points), value=np.asarray(value),
-                   is_split=np.asarray(is_split), max_depth=D,
+                   is_split=np.asarray(is_split),
+                   counts=np.asarray(counts), max_depth=D,
                    ntrees_actual=T, sample_size=S,
                    domains={c: list(train.vec(c).domain)
                             for c in di.cat_names})

@@ -884,6 +884,55 @@ def write_glrm_mojo(model) -> bytes:
     return w.finish(x, domains)
 
 
+def write_extiso_mojo(model) -> bytes:
+    """ExtendedIsolationForest -> genmodel MOJO
+    (ExtendedIsolationForestMojoModel byte format: per tree, int32
+    sizeOfBranchingArrays then a level-ordered stream of
+    [int32 node_number, byte 'N'|'L', NODE: n[] + p[] native-order
+    doubles | LEAF: int32 num_rows]; anomaly = 2^(-pathLen/c(sample)))."""
+    out = model.output
+    if out.get("counts") is None:
+        raise NotImplementedError(
+            "this ExtendedIsolationForest model predates per-node row "
+            "counts; retrain to export a MOJO")
+    x = list(out["x"])
+    nv = np.asarray(out["normals"], np.float64)    # (T, H, C)
+    pv = np.asarray(out["points"], np.float64)
+    sp = np.asarray(out["is_split"], bool)
+    cnts = np.asarray(out["counts"], np.int64)
+    T, H, C = nv.shape
+    dom_map = out.get("domains") or {}
+    domains: List[Optional[List[str]]] = [dom_map.get(c) for c in x]
+    w = _ZipWriter()
+    _common_info(w, "isoforextended", "Extended Isolation Forest",
+                 "AnomalyDetection", str(model.key), False, len(x), 1,
+                 len(x), sum(d is not None for d in domains), "1.00")
+    w.writekv("ntrees", T)
+    w.writekv("sample_size", int(out["sample_size"]))
+    for t in range(T):
+        buf = io.BytesIO()
+        buf.write(struct.pack("<i", C))
+        # only REACHABLE nodes (BFS stopping at leaves): the dense heap
+        # is mostly zero-filled subtrees under early leaves, and the
+        # stream format skips by node number anyway
+        frontier = [0]
+        while frontier:
+            n = frontier.pop(0)
+            buf.write(struct.pack("<i", n))
+            if n < H and sp[t, n]:
+                buf.write(b"N")
+                buf.write(nv[t, n].astype("<f8").tobytes())
+                buf.write(pv[t, n].astype("<f8").tobytes())
+                frontier.append(2 * n + 1)
+                frontier.append(2 * n + 2)
+            else:
+                buf.write(b"L")
+                buf.write(struct.pack(
+                    "<i", int(cnts[t, n]) if n < H else 0))
+        w.writeblob(f"trees/t{t:02d}.bin", buf.getvalue())
+    return w.finish(x, domains)
+
+
 def write_genmodel_mojo(model) -> bytes:
     if model.output.get("preprocessing_te_key"):
         raise NotImplementedError(
@@ -913,6 +962,8 @@ def write_genmodel_mojo(model) -> bytes:
         return write_coxph_mojo(model)
     if model.algo == "glrm":
         return write_glrm_mojo(model)
+    if model.algo == "extendedisolationforest":
+        return write_extiso_mojo(model)
     if model.algo == "deeplearning":
         return write_deeplearning_mojo(model)
     raise NotImplementedError(
@@ -1201,6 +1252,32 @@ def read_genmodel_mojo(data) -> Dict:
             result["stackedensemble"] = dict(
                 submodels=submodels, base_models=base,
                 metalearner=info.get("metalearner"))
+        elif algo == "isoforextended":
+            T = int(info.get("ntrees", 0))
+            trees_eif = []
+            for t in range(T):
+                blob = z.read(f"trees/t{t:02d}.bin")
+                pos = 0
+                C_b = struct.unpack_from("<i", blob, pos)[0]; pos += 4
+                nodes = {}
+                while pos < len(blob):
+                    num = struct.unpack_from("<i", blob, pos)[0]
+                    pos += 4
+                    typ = blob[pos: pos + 1]; pos += 1
+                    if typ == b"N":
+                        nvec = np.frombuffer(blob, "<f8", C_b, pos)
+                        pos += 8 * C_b
+                        pvec = np.frombuffer(blob, "<f8", C_b, pos)
+                        pos += 8 * C_b
+                        nodes[num] = ("N", nvec, pvec)
+                    else:
+                        rows_ = struct.unpack_from("<i", blob, pos)[0]
+                        pos += 4
+                        nodes[num] = ("L", rows_)
+                trees_eif.append(nodes)
+            result["isoforextended"] = dict(
+                trees=trees_eif, ntrees=T,
+                sample_size=int(info.get("sample_size", 0)))
         elif algo == "glrm":
             garr = lambda key: _parse_float_arr(info, key)  # noqa: E731
             k = int(info.get("archetypes_size1", 0))
@@ -1536,6 +1613,53 @@ class GenmodelMojoModel:
             meta = cache[se["metalearner"]]
             Xm = np.stack([l1[c] for c in meta.columns], axis=1)
             return meta.score_matrix(Xm)
+        if p["algo"] == "isoforextended":
+            ei = p["isoforextended"]
+
+            def c_n(n):
+                if n > 2:
+                    return 2.0 * (np.log(n - 1.0) + 0.5772156649015329) \
+                        - 2.0 * (n - 1.0) / n
+                return 1.0 if n == 2 else 0.0
+
+            R = X.shape[0]
+            # float32 projections: the builder and the native scorer
+            # route in f32; f64 here could flip rows that sit within
+            # rounding error of a hyperplane
+            Xz = np.nan_to_num(X.astype(np.float32))
+            C_b = X.shape[1]
+            total = np.zeros(R)
+            for nodes in ei["trees"]:
+                # dense per-heap reconstruction -> vectorized descent
+                # (the parsed dict is sparse; node numbers are heap ids)
+                Ht = max(nodes) + 1
+                nvs = np.zeros((Ht, C_b), np.float32)
+                pvs = np.zeros((Ht, C_b), np.float32)
+                split = np.zeros(Ht, bool)
+                leafc = np.zeros(Ht, np.float64)
+                for num, kind in nodes.items():
+                    if kind[0] == "N":
+                        split[num] = True
+                        nvs[num] = kind[1]
+                        pvs[num] = kind[2]
+                    else:
+                        leafc[num] = c_n(kind[1])
+                depth = max(int(np.ceil(np.log2(Ht + 1))), 1)
+                node = np.zeros(R, np.int64)
+                height = np.zeros(R)
+                for _ in range(depth):
+                    is_n = split[node]
+                    proj = np.einsum(
+                        "rc,rc->r", Xz - pvs[node], nvs[node])
+                    nxt = np.where(proj <= 0, 2 * node + 1,
+                                   2 * node + 2)
+                    node = np.where(is_n, nxt, node)
+                    height += is_n
+                total += height + leafc[node]
+            mean_len = total / max(ei["ntrees"], 1)
+            denom = max(c_n(ei["sample_size"]), 1e-12)
+            score = np.power(2.0, -mean_len / denom)
+            return np.stack([score, mean_len], axis=1)
         if p["algo"] == "glrm":
             gl = p["glrm"]
             Y = gl["archetypes"]

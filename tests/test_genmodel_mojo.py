@@ -451,3 +451,28 @@ def test_glrm_mojo_cat_standardize_losses(cl, rng):
     got = gm.score_matrix(X)
     want = np.asarray(m.predict_raw(fr))[:n]
     np.testing.assert_allclose(got, want, atol=1e-3, rtol=1e-3)
+
+
+def test_extiso_mojo_cross_scoring(cl, rng):
+    """ExtendedIsolationForestMojoModel byte format: level-ordered node
+    stream with hyperplane (n, p) doubles; anomaly-score parity."""
+    from h2o_tpu.models.tree.isofor import ExtendedIsolationForest
+    from h2o_tpu.mojo import export_genmodel_mojo
+    from h2o_tpu.mojo.genmodel import GenmodelMojoModel
+    n = 256
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    X[:6] += 5.0
+    fr = Frame([f"x{j}" for j in range(4)],
+               [Vec(X[:, j]) for j in range(4)])
+    m = ExtendedIsolationForest(ntrees=15, sample_size=64,
+                                extension_level=1, seed=1).train(
+        training_frame=fr)
+    blob = export_genmodel_mojo(m)
+    gm = GenmodelMojoModel(blob)
+    got = gm.score_matrix(X.astype(np.float64))
+    want = np.asarray(m.predict_raw(fr))[:n]
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+    with zipfile.ZipFile(io.BytesIO(blob)) as z:
+        ini = z.read("model.ini").decode()
+        assert "algo = isoforextended" in ini
+        assert "trees/t00.bin" in z.namelist()
