@@ -239,8 +239,16 @@ def main():
     if os.path.exists(base_path):
         with open(base_path) as f:
             prev = json.load(f)
-        if prev.get("value") and value:
-            vs = value / prev["value"]
+        cmp_value = value
+        if prev.get("methodology") == "wall_with_compile" and \
+                isinstance(head, dict) and \
+                head.get("wall_with_compile_s") and head.get("wall_s"):
+            # apples-to-apples against a compile-inclusive baseline
+            cmp_value = value * head["wall_s"] / \
+                head["wall_with_compile_s"]
+            detail["vs_baseline_methodology"] = "wall_with_compile"
+        if prev.get("value") and cmp_value:
+            vs = cmp_value / prev["value"]
 
     print(json.dumps({
         "metric": "gbm_higgs_like_train_throughput_steady",

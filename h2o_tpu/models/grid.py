@@ -219,10 +219,13 @@ class GridSearch:
                         rec.model_done(m)
                 return m
             except Exception as e:  # noqa: BLE001 — grid collects failures
+                import traceback as _tb
                 log.warning("grid model failed (%s): %s", combo, e)
                 with append_lock:
                     grid.failures.append({"params": dict(combo),
-                                          "error": repr(e)})
+                                          "error": repr(e),
+                                          "stacktrace":
+                                          _tb.format_exc()})
                 return None
 
         def note_trained(m) -> bool:

@@ -394,3 +394,37 @@ def test_small_routes(h2o_client, tmp_path):
     with pytest.raises(urllib.error.HTTPError) as ei:
         _get(srv, "/99/Assembly.fetch_mojo_pipeline/x/y")
     assert ei.value.code == 501
+
+
+def test_grid_failure_surface_over_rest(h2o_client):
+    """A failing hyper-combo must surface in the grid's failure fields
+    (GridSearchHandler failure_details/failure_stack_traces) while the
+    good combos still train — driven through the stock client."""
+    h2o, srv = h2o_client
+    rng = np.random.default_rng(9)
+    hf = h2o.H2OFrame({
+        "x": rng.normal(size=150).tolist(),
+        "y": np.where(rng.uniform(size=150) > 0.5, "t", "f").tolist()})
+    hf["y"] = hf["y"].asfactor()
+    from h2o.estimators import H2OGradientBoostingEstimator
+    from h2o.grid.grid_search import H2OGridSearch
+    gs = H2OGridSearch(
+        H2OGradientBoostingEstimator(seed=1, max_depth=2),
+        hyper_params={"ntrees": [2, 3], "nbins": [16, -4]})
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        try:
+            gs.train(x=["x"], y="y", training_frame=hf)
+        except ValueError:
+            pass          # client raises when some combos fail; fine
+    assert gs.grid_id, "grid submission itself failed"
+    g = _get(srv, f"/99/Grids/{gs.grid_id}")
+    assert len(g["model_ids"]) == 2          # the nbins=16 combos
+    assert len(g["failure_details"]) == 2    # the nbins=-4 combos
+    assert len(g["failure_stack_traces"]) == 2
+    assert all(d for d in g["failure_details"])
+    # REAL stack traces, not an error-repr fallback
+    assert all("Traceback" in t for t in g["failure_stack_traces"])
+    assert g["failed_params"] and \
+        all(p_.get("nbins") == -4 for p_ in g["failed_params"])
