@@ -1,4 +1,4 @@
-"""Ingest: CSV/ARFF/SVMLight → row-sharded Frame.
+"""Ingest: CSV/ARFF/SVMLight/Parquet/ORC/Avro → row-sharded Frame.
 
 Reference design (water/parser/*, SURVEY §3.2): a two-pass distributed parse —
 ``ParseSetup`` sniffs separator/header/types from a sample, then
@@ -13,7 +13,8 @@ sorted-domain merge of ParseDataset are preserved; the byte-level tokenizer is
 the first-party C++ loop in h2o_tpu/native/csv_tokenizer.cpp (chunk-
 parallel, quote-aware; built on first use), with pandas' C engine as the
 fallback (``use_native=False`` or ``H2O_TPU_NATIVE_PARSE=0``).  SVMLight
-and ARFF get small host parsers.
+and ARFF get small host parsers; Parquet/ORC ride pyarrow and Avro a
+first-party from-spec reader (core/avro.py); XLS is rejected loudly.
 """
 
 from __future__ import annotations
