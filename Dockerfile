@@ -15,7 +15,7 @@ FROM python:3.12-slim
 # matching release when building on a Cloud TPU VM image.
 RUN pip install --no-cache-dir \
     "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
-    numpy pandas pyarrow
+    numpy scipy optax pandas pyarrow
 
 WORKDIR /opt/h2o-tpu
 COPY h2o_tpu/ h2o_tpu/

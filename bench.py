@@ -161,6 +161,47 @@ def bench_hist_mfu(rows, cols, nbins=64, leaves=32, reps=10):
             "kernel_ms": round(wall * 1e3, 3)}
 
 
+def bench_cpu_reference(X, y, rows, trees, depth):
+    """External CPU baseline for the north-star ratio (VERDICT r3 item 3):
+    the same GBM workload through a widely-accepted CPU hist
+    implementation — xgboost `hist` when importable, else sklearn
+    HistGradientBoosting — timed the same steady-state way (fit is
+    single-shot; sklearn/xgboost pay no JIT, so one timed fit IS
+    steady-state).  Not an H2O cluster, but it turns "vs my own last
+    round" into a defensible external ratio."""
+    t_load = time.time()
+    try:
+        import xgboost as xgb  # noqa: F401
+        impl = f"xgboost-{xgb.__version__} tree_method=hist"
+
+        def fit():
+            clf = xgb.XGBClassifier(
+                n_estimators=trees, max_depth=depth, learning_rate=0.1,
+                tree_method="hist", max_bin=64, n_jobs=-1,
+                eval_metric="logloss")
+            clf.fit(X, y)
+    except ImportError:
+        from sklearn.ensemble import HistGradientBoostingClassifier
+        import sklearn
+        impl = (f"sklearn-{sklearn.__version__} "
+                "HistGradientBoostingClassifier")
+
+        def fit():
+            clf = HistGradientBoostingClassifier(
+                max_iter=trees, max_depth=depth, learning_rate=0.1,
+                max_bins=63, early_stopping=False)
+            clf.fit(X, y)
+    t0 = time.time()
+    fit()
+    wall = time.time() - t0
+    import os as _os
+    return {"value": round(rows * trees / wall, 1),
+            "unit": "rows*trees/sec", "wall_s": round(wall, 2),
+            "impl": impl, "ntrees": trees, "max_depth": depth,
+            "nthreads": _os.cpu_count(),
+            "import_s": round(t0 - t_load, 2)}
+
+
 def bench_gbm10m(cols, depth):
     """BASELINE.md config 4: the XGBoost gpu_hist -> TPU path at 10M rows
     (the row count the north-star names).  Fewer trees keep the driver's
@@ -283,7 +324,7 @@ def _main_ladder(detail):
     trees = int(os.environ.get("BENCH_TREES", 20))
     depth = int(os.environ.get("BENCH_DEPTH", 5))
     configs = os.environ.get("BENCH_CONFIG",
-                             "gbm,drf,glm,dl,hist,gbm10m").split(",")
+                             "gbm,drf,glm,dl,hist,gbm10m,cpuref").split(",")
 
     detail.update({"rows": rows, "cols": cols})
     _arm_watchdog([detail])
@@ -309,8 +350,11 @@ def _main_ladder(detail):
             ("glm", lambda: bench_glm(fr, rows)),
             ("dl", lambda: bench_dl(fr, rows)),
             ("hist", lambda: bench_hist_mfu(rows, cols)),
-            ("gbm10m", lambda: bench_gbm10m(cols, depth))]
-    names = {"hist": "hist_kernel", "gbm10m": "gbm_10m"}
+            ("gbm10m", lambda: bench_gbm10m(cols, depth)),
+            ("cpuref", lambda: bench_cpu_reference(X, y, rows, trees,
+                                                   depth))]
+    names = {"hist": "hist_kernel", "gbm10m": "gbm_10m",
+             "cpuref": "cpu_reference"}
     for cfg, fn in runs:
         if cfg not in configs:
             continue
@@ -323,11 +367,19 @@ def _main_ladder(detail):
     def _measured(v):
         return isinstance(v, dict) and "value" in v
 
-    # headline: gbm, else gbm_10m, else any config that actually measured
-    # (a config that FAILED holds {"error": ...} — never the headline)
+    cpuref = detail.get("cpu_reference")
+    if _measured(detail.get("gbm")) and _measured(cpuref):
+        detail["vs_cpu_reference"] = round(
+            detail["gbm"]["value"] / cpuref["value"], 3)
+
+    # headline: gbm, else gbm_10m, else any other TPU-engine config that
+    # actually measured (a FAILED config holds {"error": ...}; the CPU
+    # reference is a comparison point, NEVER the headline — an all-TPU-
+    # failed run must read as 0, not as the CPU throughput)
     head = next((detail[k] for k in ("gbm", "gbm_10m")
                  if _measured(detail.get(k))),
-                next((v for v in detail.values() if _measured(v)), {}))
+                next((v for k, v in detail.items()
+                      if k != "cpu_reference" and _measured(v)), {}))
     value = head.get("value", 0.0)
 
     base_path = os.path.join(os.path.dirname(__file__),

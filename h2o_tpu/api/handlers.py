@@ -795,6 +795,11 @@ def _model_schema(m: Model) -> dict:
             "domains": [],
             "status": "DONE",
             "run_time": m.run_time_ms,
+            # engine-substitution warnings (depth clamp, maxout~relu, ...)
+            # — reference ModelBuilder warnings -> ModelSchemaV3
+            "warnings": list(out.get("warnings") or []),
+            # GLM-family models: the client's m.coef()/summary indexes it
+            "coefficients_table": out.get("coefficients_table"),
         },
     }
 

@@ -54,7 +54,8 @@ def _tree_arrays(m: Model):
     sc = np.asarray(out["split_col"])
     gain = np.asarray(out.get("node_gain")) \
         if out.get("node_gain") is not None else None
-    return sc, gain, list(out["x"])
+    ch = np.asarray(out["child"]) if out.get("child") is not None else None
+    return sc, gain, ch, list(out["x"])
 
 
 # ---------------------------------------------------------------------------
@@ -71,13 +72,13 @@ def feature_interaction(params):
     XGBoost FeatureInteractions convention the reference wraps."""
     from h2o_tpu.models.metrics import twodim_json
     m = _model_or_404(params.get("model_id"))
-    sc, gain, x = _tree_arrays(m)
+    sc, gain, chp, x = _tree_arrays(m)
     max_depth_i = int(params.get("max_interaction_depth", 100) or 100)
     T, K, H = sc.shape
     # stats[varset tuple] = [gain_sum, fscore]
     stats: Dict[tuple, List[float]] = defaultdict(lambda: [0.0, 0])
 
-    def walk(sc_t, gn_t, n, path):
+    def walk(sc_t, gn_t, ch_t, n, path):
         c = int(sc_t[n])
         if c < 0:
             return
@@ -87,13 +88,17 @@ def feature_interaction(params):
         if len(varset) <= max_depth_i + 1:
             stats[varset][0] += g
             stats[varset][1] += 1
-        for child in (2 * n + 1, 2 * n + 2):
+        left = 2 * n + 1 if ch_t is None else int(ch_t[n])
+        if left < 0:
+            return
+        for child in (left, left + 1 if ch_t is not None else 2 * n + 2):
             if child < H:
-                walk(sc_t, gn_t, child, new_path)
+                walk(sc_t, gn_t, ch_t, child, new_path)
 
     for t in range(T):
         for k in range(K):
-            walk(sc[t, k], gain[t, k] if gain is not None else None, 0, ())
+            walk(sc[t, k], gain[t, k] if gain is not None else None,
+                 chp[t, k] if chp is not None else None, 0, ())
 
     by_depth: Dict[int, List] = defaultdict(list)
     for varset, (g, f) in stats.items():

@@ -49,6 +49,7 @@ class Job:
         self.status = CREATED
         self.progress = 0.0
         self.progress_msg = ""
+        self.warnings: list = []
         self.exception: Optional[BaseException] = None
         self.start_time = 0.0
         self.end_time = 0.0
@@ -66,6 +67,13 @@ class Job:
             self.progress_msg = msg
         if self._cancel_requested.is_set():
             raise JobCancelledException(self.description)
+
+    def warn(self, msg: str) -> None:
+        """Attach a client-visible warning (reference Job.warn ->
+        JobV3.warnings; the stock h2o-py client re-raises each entry via
+        warnings.warn when the job finishes, h2o-py/h2o/job.py:79-81)."""
+        if msg not in self.warnings:
+            self.warnings.append(msg)
 
     @property
     def stop_requested(self) -> bool:
@@ -108,7 +116,7 @@ class Job:
             "start_time": ms(self.start_time),
             "msec": ms((self.end_time or time.time()) - self.start_time)
             if self.start_time else 0,
-            "warnings": [],
+            "warnings": list(self.warnings),
             "exception": repr(self.exception) if self.exception else None,
             "stacktrace": None,
             "ready_for_view": self.status == "DONE",

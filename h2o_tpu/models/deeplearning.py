@@ -299,6 +299,9 @@ class DeepLearning(ModelBuilder):
 
     def _fit(self, job, x, y, train: Frame, valid: Optional[Frame]):
         p = self.params
+        if "maxout" in str(p.get("activation", "")).lower():
+            job.warn("activation='Maxout' is approximated by Rectifier "
+                     "on this engine (models/deeplearning.py _act)")
         ae = bool(p.get("autoencoder"))
         di = DataInfo(train, x, None if ae else y, mode="expanded",
                       weights=p.get("weights_column"),

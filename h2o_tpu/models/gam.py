@@ -1,23 +1,26 @@
 """GAM — Generalized Additive Models via spline basis expansion + GLM.
 
 Reference (hex/gam/**, 4.7k LoC): per-``gam_columns`` smoother basis
-expansion (``bs``: 0 = cubic regression splines, 1/2/3 = thin-plate /
-monotone variants; knots at quantiles, ``num_knots``), the expanded columns
-are appended to the training frame and a penalized GLM runs over the whole
-thing (GAMModel._lambda etc.); scoring re-expands with the stored knots.
+expansion with per-column basis choice ``bs`` (0 = cubic regression
+splines, 1 = thin-plate, 2 = monotone I-splines, 3 = M-splines; knots at
+quantiles, ``num_knots``), a curvature penalty matrix S per smoother
+(GamSplines/*) scaled by ``scale`` and folded into the GLM gram, and the
+expanded columns appended to the training frame for a penalized GLM
+(GAMModel); scoring re-expands with the stored knots.
 
-TPU-native: the smoother here is the NATURAL CUBIC SPLINE basis (the same
-function space as the reference's cr smoother) computed as one vectorized
-device expression over the row-sharded column; the downstream solver is the
-framework's GLM (IRLSM/L-BFGS on einsum Grams).  Wiggliness control comes
-from the GLM's elastic-net ``lambda_`` applied to the spline coefficients
-rather than the reference's curvature-matrix penalty ``β'S β`` — same knob,
-diagonal metric.
+TPU-native: every basis is one vectorized device expression over the
+row-sharded column (B-splines by a statically-unrolled Cox-de-Boor
+recursion); the curvature penalty S = ∫ b''(x) b''(x)' dx is integrated
+numerically once on the host and passed to the GLM by coefficient NAME
+(glm.GLM._assemble_penalty folds it into the einsum Gram — the quadratic
+penalty is exactly a Gram shift); monotone I-splines constrain their
+coefficients non-negative through the same COD solver the elastic net
+uses.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
@@ -25,9 +28,18 @@ import numpy as np
 from h2o_tpu.core.frame import Frame, Vec
 from h2o_tpu.models.model import DataInfo, Model, ModelBuilder
 
+BS_CR, BS_TP, BS_IS, BS_MS = 0, 1, 2, 3
+_BS_NAMES = {BS_CR: "cr", BS_TP: "thin-plate", BS_IS: "monotone-I-spline",
+             BS_MS: "M-spline"}
+
+
+# ---------------------------------------------------------------------------
+# bases — each returns a list of per-row columns given x and the knots
+# ---------------------------------------------------------------------------
 
 def _ncs_basis(x, knots: np.ndarray):
-    """Natural cubic spline basis (ESL 5.2.1): [x, N_1..N_{K-2}]."""
+    """Natural cubic spline basis (ESL 5.2.1): [x, N_1..N_{K-2}] — the
+    reference's ``cr`` smoother function space."""
     K = len(knots)
     xk = jnp.asarray(knots, jnp.float32)
 
@@ -43,25 +55,118 @@ def _ncs_basis(x, knots: np.ndarray):
     return cols
 
 
+def _tp_basis(x, knots: np.ndarray):
+    """1-D thin-plate basis: [x, |x-k|^3 per knot] (the univariate TPRS
+    radial basis, reference ``bs=1``)."""
+    xk = jnp.asarray(knots, jnp.float32)
+    scale = max(float(knots[-1] - knots[0]), 1e-6)
+    return [x] + [jnp.abs(x - xk[k]) ** 3 / scale ** 3
+                  for k in range(len(knots))]
+
+
+def _bspline_cols(x, knots: np.ndarray, degree: int = 3):
+    """All B-spline basis functions on the padded knot vector, by the
+    Cox-de-Boor recursion unrolled statically (fixed knots => every
+    branch is a fused elementwise device expression)."""
+    t = np.concatenate([[knots[0]] * degree, knots, [knots[-1]] * degree])
+    t = t.astype(np.float64)
+    n_basis = len(t) - degree - 1
+    # clamp to the knot span: B-splines are zero outside it, which would
+    # turn extrapolation into a cliff back to the intercept — clamping
+    # extrapolates the boundary value instead (monotone-safe)
+    x = jnp.clip(x, float(t[0]), float(t[-1]))
+    # degree 0: indicator per span (right-closed at the last span)
+    B = []
+    for i in range(len(t) - 1):
+        if t[i + 1] > t[i]:
+            hi = (x <= t[i + 1]) if t[i + 1] >= t[-1] else (x < t[i + 1])
+            B.append(((x >= t[i]) & hi).astype(jnp.float32))
+        else:
+            B.append(jnp.zeros_like(x))
+    for d in range(1, degree + 1):
+        Bn = []
+        for i in range(len(t) - d - 1):
+            den1 = t[i + d] - t[i]
+            den2 = t[i + d + 1] - t[i + 1]
+            term = 0.0
+            if den1 > 0:
+                term = term + (x - t[i]) / den1 * B[i]
+            if den2 > 0:
+                term = term + (t[i + d + 1] - x) / den2 * B[i + 1]
+            Bn.append(term if not isinstance(term, float)
+                      else jnp.zeros_like(x))
+        B = Bn
+    return B[:n_basis]
+
+
+def _ms_basis(x, knots: np.ndarray):
+    """M-spline-family basis (reference ``bs=3``): cubic B-splines — the
+    normalization constant is absorbed by the coefficients.  The first
+    element is dropped: B-splines form a partition of unity, so the full
+    set is exactly collinear with the GLM intercept."""
+    return _bspline_cols(x, knots, degree=3)[1:]
+
+
+def _is_basis(x, knots: np.ndarray):
+    """I-splines (reference ``bs=2``): monotone non-decreasing basis via
+    the classic identity I_j = sum_{m>=j} B_m over one-degree-higher
+    B-splines; non-negative coefficients (enforced in the GLM solve)
+    give a monotone smooth."""
+    B = _bspline_cols(x, knots, degree=3)
+    cols = []
+    acc = jnp.zeros_like(x)
+    for b in reversed(B[1:]):        # drop the first: constant offset is
+        acc = acc + b                # the GLM intercept's job
+        cols.append(acc)
+    return list(reversed(cols))
+
+
+_BASES = {BS_CR: _ncs_basis, BS_TP: _tp_basis, BS_IS: _is_basis,
+          BS_MS: _ms_basis}
+
+
+def _curvature_penalty(basis_fn, knots: np.ndarray, npts: int = 512):
+    """S_jk = ∫ b_j''(x) b_k''(x) dx over the knot span, by trapezoid
+    quadrature of finite-difference second derivatives (host-side, once
+    per smoother).  Normalized by trace/P so scale=1 is a moderate
+    smoothing whatever the basis/knot units (reference GamSplines
+    penalty matrices are likewise normalized via gamma scaling)."""
+    lo, hi = float(knots[0]), float(knots[-1])
+    pad = (hi - lo) * 1e-6
+    g = np.linspace(lo + pad, hi - pad, npts)
+    cols = basis_fn(jnp.asarray(g, jnp.float32), knots)
+    Bm = np.stack([np.asarray(c, np.float64) for c in cols], axis=1)
+    h = g[1] - g[0]
+    d2 = (Bm[2:] - 2 * Bm[1:-1] + Bm[:-2]) / (h * h)
+    S = d2.T @ d2 * h
+    tr = np.trace(S)
+    if tr > 0:
+        S = S * (S.shape[0] / tr)
+    return S
+
+
 def _expand_gam(frame: Frame, gam_cols: List[str],
                 knots_map: Dict[str, np.ndarray],
                 means: Dict[str, float],
+                bs_map: Dict[str, int],
                 plain_x: Optional[List[str]] = None) -> Frame:
     """Append spline basis vecs for each gam column (host-visible names
     ``col_gam_0..``; the reference names them col_0, col_1, …).  NaNs are
     imputed with the TRAINING mean (train/serve consistency).
 
-    The linear basis element (index 0, x itself) is skipped only when the
-    gam column already appears among the plain predictors ``plain_x`` —
-    otherwise the natural-cubic-spline space would lose its linear term
-    (the reference's cr smoother always carries the full basis).
-    """
+    For the cr/thin-plate bases the linear element (index 0, x itself)
+    is skipped when the gam column already appears among the plain
+    predictors ``plain_x`` — otherwise the space would lose its linear
+    term.  The B-spline-family bases (bs 2/3) carry no separate linear
+    element."""
     plain = set(plain_x or [])
     out = Frame(list(frame.names), list(frame.vecs))
     for c in gam_cols:
         x = jnp.nan_to_num(frame.vec(c).as_float(), nan=means[c])
-        for i, b in enumerate(_ncs_basis(x, knots_map[c])):
-            if i == 0 and c in plain:
+        basis = _BASES[bs_map[c]]
+        linear_first = bs_map[c] in (BS_CR, BS_TP)
+        for i, b in enumerate(basis(x, knots_map[c])):
+            if linear_first and i == 0 and c in plain:
                 continue            # x itself is already a predictor
             out.add(f"{c}_gam_{i}", Vec(b, nrows=frame.nrows))
     return out
@@ -83,6 +188,7 @@ class GAMModel(Model):
                                {c: out["knots"][c]
                                 for c in out["gam_columns"]},
                                out["gam_col_means"],
+                               out["bs_map"],
                                plain_x=out.get("x"))
         return self._inner().predict_raw(expanded)
 
@@ -98,36 +204,90 @@ class GAM(ModelBuilder):
         p = super().default_params()
         p.update(gam_columns=None, num_knots=None, bs=None, scale=None,
                  family="AUTO", solver="AUTO", lambda_=0.0, alpha=0.0,
-                 standardize=False, keep_gam_cols=False)
+                 standardize=False, keep_gam_cols=False,
+                 splines_non_negative=None)
         return p
+
+    @staticmethod
+    def _per_col(val, gam_cols: Sequence[str], default):
+        if val is None:
+            return {c: default for c in gam_cols}
+        if isinstance(val, (int, float)):
+            return {c: val for c in gam_cols}
+        if len(val) != len(gam_cols):
+            raise ValueError("per-gam-column list length mismatch: "
+                             f"{val!r} vs {list(gam_cols)!r}")
+        return dict(zip(gam_cols, val))
 
     def _fit(self, job, x, y, train: Frame, valid: Optional[Frame]):
         p = self.params
         gam_cols = list(p.get("gam_columns") or [])
         if not gam_cols:
             raise ValueError("GAM requires gam_columns")
-        nk = p.get("num_knots")
-        if nk is None:
-            nk = [10] * len(gam_cols)
-        elif isinstance(nk, int):
-            nk = [nk] * len(gam_cols)
+        nk_map = {c: int(v) for c, v in
+                  self._per_col(p.get("num_knots"), gam_cols, 10).items()}
+        bs_map = {c: int(v) for c, v in
+                  self._per_col(p.get("bs"), gam_cols, BS_CR).items()}
+        for c, b in bs_map.items():
+            if b not in _BASES:
+                raise ValueError(f"bs={b} for {c!r}: supported bs values "
+                                 f"are {sorted(_BASES)} "
+                                 f"({_BS_NAMES})")
+        scale_map = {c: float(v) for c, v in
+                     self._per_col(p.get("scale"), gam_cols, 1.0).items()}
 
         knots_map: Dict[str, np.ndarray] = {}
         means: Dict[str, float] = {}
-        for c, k in zip(gam_cols, nk):
+        for c in gam_cols:
             vals = np.asarray(train.vec(c).as_float())[: train.nrows]
             vals = vals[~np.isnan(vals)]
-            qs = np.quantile(vals, np.linspace(0.0, 1.0, max(int(k), 3)))
+            qs = np.quantile(vals, np.linspace(0.0, 1.0,
+                                               max(nk_map[c], 3)))
             knots_map[c] = np.unique(qs)
+            if len(knots_map[c]) < 3:
+                # reference GAM requires >= 3 distinct knots; a constant
+                # column would make the curvature quadrature degenerate
+                raise ValueError(
+                    f"gam column {c!r} has only {len(knots_map[c])} "
+                    "distinct knot value(s); GAM smoothers need >= 3 — "
+                    "drop the column or use it as a plain predictor")
             means[c] = float(vals.mean()) if len(vals) else 0.0
 
-        expanded = _expand_gam(train, gam_cols, knots_map, means,
+        # monotone smoothers exclude their raw column from the plain
+        # predictors — a free-signed linear term would break the
+        # monotonicity the non-negative I-spline coefs guarantee
+        x = [c for c in x
+             if not (c in gam_cols and bs_map[c] == BS_IS)]
+        expanded = _expand_gam(train, gam_cols, knots_map, means, bs_map,
                                plain_x=list(x))
-        exp_valid = _expand_gam(valid, gam_cols, knots_map, means,
+        exp_valid = _expand_gam(valid, gam_cols, knots_map, means, bs_map,
                                 plain_x=list(x)) \
             if valid is not None else None
         basis_names = [n for n in expanded.names if n not in train.names]
         job.update(0.2, f"spline basis: {len(basis_names)} columns")
+
+        # per-smoother curvature penalty blocks + monotone coef masks,
+        # keyed by expanded-coefficient NAME (glm._assemble_penalty)
+        penalty_blocks = []
+        nonneg_names: List[str] = []
+        plain = set(x)
+        for c in gam_cols:
+            names_c = [n for n in basis_names
+                       if n.startswith(f"{c}_gam_")]
+            basis_fn = _BASES[bs_map[c]]
+            if bs_map[c] in (BS_CR, BS_TP) and c in plain:
+                # the skipped linear element has zero curvature: drop its
+                # row/col from S
+                S = _curvature_penalty(basis_fn, knots_map[c])[1:, 1:]
+            else:
+                S = _curvature_penalty(basis_fn, knots_map[c])
+            penalty_blocks.append((names_c, S, scale_map[c]))
+            snn = p.get("splines_non_negative")
+            nn_default = bs_map[c] == BS_IS
+            if self._per_col(snn, gam_cols,
+                             nn_default).get(c, nn_default) and \
+                    bs_map[c] == BS_IS:
+                nonneg_names.extend(names_c)
 
         from h2o_tpu.models.glm import GLM
         glm_params = dict(
@@ -136,16 +296,30 @@ class GAM(ModelBuilder):
             standardize=bool(p.get("standardize")), seed=p.get("seed", -1),
             weights_column=p.get("weights_column"))
         glm = GLM(**{k: v for k, v in glm_params.items() if v is not None})
+        glm.params["_penalty_blocks"] = penalty_blocks
+        if nonneg_names:
+            glm.params["_nonneg_names"] = nonneg_names
         inner = glm._fit(job, list(x) + basis_names, y, expanded, exp_valid)
 
         out = dict(gam_columns=gam_cols,
                    knots={c: knots_map[c] for c in gam_cols},
-                   gam_col_means=means,
-                   num_knots=nk, basis_names=basis_names,
+                   gam_col_means=means, bs_map=bs_map,
+                   scale_map=scale_map,
+                   num_knots=[nk_map[c] for c in gam_cols],
+                   basis_names=basis_names,
                    glm_key=str(inner.key), glm_params=inner.params,
                    glm_output=inner.output,
                    response_domain=inner.output.get("response_domain"),
                    x=list(x))
+        if p.get("keep_gam_cols"):
+            # reference keep_gam_cols: publish the expanded training
+            # frame (gam_transformed_center_key)
+            from h2o_tpu.core.cloud import cloud
+            from h2o_tpu.core.store import Key
+            key = f"{self.model_id}_gamified"
+            expanded.key = Key(key)
+            cloud().dkv.put(key, expanded)
+            out["gam_transformed_center_key"] = key
         model = self.model_cls(self.model_id, dict(p), out)
         model.params["response_column"] = y
         model.output["training_metrics"] = \

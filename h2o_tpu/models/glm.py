@@ -150,19 +150,62 @@ class _Tweedie(_Family):
             - y * mu ** (1 - p) / (1 - p) + mu ** (2 - p) / (2 - p)))
 
 
+class _FractionalBinomial(_Binomial):
+    """Fractional response in [0, 1] with binomial mechanics (reference
+    hex/glm GLMParameters.Family.fractionalbinomial): same logit link,
+    variance and deviance formulas — they are well-defined for
+    non-integer y."""
+    name = "fractionalbinomial"
+
+
+class _NegativeBinomial(_Family):
+    """Negative binomial with log link (reference hex/glm/GLM.java negbin
+    path): variance mu + theta*mu^2; theta -> 0 degenerates to Poisson."""
+    name = "negativebinomial"
+
+    def __init__(self, theta=1.0):
+        self.theta = max(float(theta), 1e-10)
+
+    def link_inv(self, eta):
+        return jnp.exp(jnp.clip(eta, -30, 30))
+
+    def mu_eta(self, eta):
+        return jnp.exp(jnp.clip(eta, -30, 30))
+
+    def variance(self, mu):
+        return jnp.maximum(mu + self.theta * mu * mu, EPS)
+
+    def link(self, mu):
+        return jnp.log(jnp.maximum(mu, EPS))
+
+    def deviance(self, y, mu, w):
+        t = self.theta
+        mu = jnp.maximum(mu, EPS)
+        ylogy = jnp.where(y > 0, y * jnp.log(jnp.maximum(y, EPS) / mu),
+                          0.0)
+        return 2 * jnp.sum(w * (
+            ylogy - (y + 1.0 / t) *
+            jnp.log((1.0 + t * y) / (1.0 + t * mu))))
+
+
 _FAMILIES = {"gaussian": _Family, "binomial": _Binomial,
              "quasibinomial": _Binomial, "poisson": _Poisson,
-             "gamma": _Gamma}
+             "gamma": _Gamma,
+             "fractionalbinomial": _FractionalBinomial}
 
 
-def _family(name: str, tweedie_power=1.5) -> _Family:
+def _family(name: str, tweedie_power=1.5, theta=1.0) -> _Family:
     if name == "tweedie":
         return _Tweedie(tweedie_power)
+    if name == "negativebinomial":
+        return _NegativeBinomial(theta)
     cls = _FAMILIES.get(name)
     if cls is None:
         # H2O semantics: params work or error — never silently remap
-        raise ValueError(f"unsupported GLM family '{name}'; supported: "
-                         f"{sorted(_FAMILIES) + ['tweedie']}")
+        # (ordinal is fit by _fit_ordinal, not the IRLS family machinery)
+        raise ValueError(
+            f"unsupported GLM family '{name}'; supported: "
+            f"{sorted(_FAMILIES) + ['tweedie', 'negativebinomial', 'ordinal']}")
     return cls()
 
 
@@ -170,14 +213,15 @@ def _family(name: str, tweedie_power=1.5) -> _Family:
 # distributed Gram + IRLSM working response (the GLMIterationTask)
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("fam_name",))
-def _irlsm_pass(X, y, w, valid, beta, fam_name: str, tweedie_power=1.5):
+@functools.partial(jax.jit, static_argnames=("fam_name", "theta"))
+def _irlsm_pass(X, y, w, valid, beta, fam_name: str, tweedie_power=1.5,
+                theta=1.0):
     """One data pass: weighted Gram [X,1]'W[X,1] and [X,1]'Wz.
 
     Returns (G, q) with the intercept folded in as the last column; XLA
     turns the einsums into MXU matmuls + ICI psum over the row sharding.
     """
-    fam = _family(fam_name, tweedie_power)
+    fam = _family(fam_name, tweedie_power, theta)
     y = jnp.where(valid, y, 0.0)
     w = jnp.where(valid, w, 0.0)
     eta = X @ beta[:-1] + beta[-1]
@@ -200,17 +244,20 @@ def _irlsm_pass(X, y, w, valid, beta, fam_name: str, tweedie_power=1.5):
 @functools.partial(jax.jit, static_argnames=("n_sweeps", "intercept_pen",
                                              "non_negative"))
 def _cod_solve(G, q, beta0, lam_l1, lam_l2, n_sweeps: int = 50,
-               intercept_pen: bool = False, non_negative: bool = False):
+               intercept_pen: bool = False, non_negative: bool = False,
+               nonneg_mask=None):
     """Cyclic coordinate descent on the Gram (elastic net; ADMM/COD analog).
 
     Solves argmin 1/2 b'Gb - q'b + lam_l1|b| + lam_l2/2 |b|^2 with the
-    intercept (last coef) unpenalized.  non_negative clamps every
-    non-intercept coefficient at 0 (GLM.java betaConstraints lower bound —
-    the AUTO metalearner's setting).
+    intercept (last coef) unpenalized.  non_negative clamps coefficients
+    at 0 (GLM.java betaConstraints lower bound — the AUTO metalearner's
+    setting): every non-intercept coef when ``nonneg_mask`` is None, else
+    exactly the coefs the mask selects (GAM monotone I-splines).
     """
     P = G.shape[0]
     diag = jnp.diagonal(G)
     pen_mask = jnp.ones((P,)).at[-1].set(1.0 if intercept_pen else 0.0)
+    clamp = pen_mask if nonneg_mask is None else nonneg_mask
 
     def sweep(beta, _):
         def upd(j, b):
@@ -221,7 +268,7 @@ def _cod_solve(G, q, beta0, lam_l1, lam_l2, n_sweeps: int = 50,
             bj = jnp.sign(r) * jnp.maximum(jnp.abs(r) - l1, 0.0) / \
                 jnp.maximum(diag[j] + l2, EPS)
             if non_negative:
-                bj = jnp.where(pen_mask[j] > 0, jnp.maximum(bj, 0.0), bj)
+                bj = jnp.where(clamp[j] > 0, jnp.maximum(bj, 0.0), bj)
             return b.at[j].set(bj)
         beta = jax.lax.fori_loop(0, P, upd, beta)
         return beta, None
@@ -230,11 +277,12 @@ def _cod_solve(G, q, beta0, lam_l1, lam_l2, n_sweeps: int = 50,
     return beta
 
 
-@functools.partial(jax.jit, static_argnames=("fam_name",))
-def _deviance_at(X, y, w, valid, beta, fam_name: str, tweedie_power=1.5):
+@functools.partial(jax.jit, static_argnames=("fam_name", "theta"))
+def _deviance_at(X, y, w, valid, beta, fam_name: str, tweedie_power=1.5,
+                 theta=1.0):
     """Deviance of a fixed beta on a (possibly held-out) data split — the
     lambda-path selection criterion (GLM.java lambda search scoring)."""
-    fam = _family(fam_name, tweedie_power)
+    fam = _family(fam_name, tweedie_power, theta)
     y = jnp.where(valid, y, 0.0)
     w = jnp.where(valid, w, 0.0)
     eta = X @ beta[:-1] + beta[-1]
@@ -284,6 +332,70 @@ def expansion_spec(di: DataInfo) -> Dict:
         use_all_factor_levels=di.use_all_factor_levels)
 
 
+def _destandardize(spec: Dict, beta_std: np.ndarray, cov_std=None):
+    """Standardized-space (beta, cov) -> raw-space via the affine map
+    [x_raw, 1] = [x_std, 1] @ A (A scales numerics by sigma and shifts by
+    mean): beta_raw = inv(A) beta_std, cov_raw = inv(A) cov inv(A)^T.
+    Exact for every coefficient including the intercept."""
+    P1 = len(beta_std)
+    if not spec.get("standardize"):
+        return beta_std, cov_std
+    A = np.eye(P1)
+    n_num = len(spec["num_names"])
+    num_off = P1 - 1 - n_num
+    for j in range(n_num):
+        sig = float(spec["sigmas"][j]) or 1.0
+        A[num_off + j, num_off + j] = sig
+        A[-1, num_off + j] = float(spec["means"][j])
+    Ainv = np.linalg.inv(A)
+    beta_raw = Ainv @ beta_std
+    cov_raw = Ainv @ cov_std @ Ainv.T if cov_std is not None else None
+    return beta_raw, cov_raw
+
+
+def build_coef_table(out: Dict) -> Optional[Dict]:
+    """GLM coefficients table (reference GLMModel coefficients_table ->
+    TwoDimTable; h2o-py m.coef() indexes it).  Columns follow the
+    reference: names, coefficients (de-standardized), std_error/z_value/
+    p_value when computed, standardized_coefficients."""
+    if out.get("is_multinomial") or out.get("beta") is None:
+        return None
+    from h2o_tpu.models.metrics import twodim_json
+    spec = out["expansion_spec"]
+    names = list(out["coef_names"]) + ["Intercept"]
+    beta_std = np.asarray(out["beta"], np.float64)
+    se = out.get("std_errs")
+    cov = None
+    if se is not None:
+        cov = np.asarray(out["coef_cov"], np.float64) \
+            if out.get("coef_cov") is not None \
+            else np.diag(np.asarray(se, np.float64) ** 2)
+    beta_raw, cov_raw = _destandardize(spec, beta_std, cov)
+    cols = ["names", "coefficients"]
+    types = ["string", "double"]
+    rows = [[n, float(b)] for n, b in zip(names, beta_raw)]
+    if se is not None:
+        se_raw = np.sqrt(np.maximum(np.diag(cov_raw), 0.0))
+        z = np.divide(beta_raw, se_raw, out=np.zeros_like(beta_raw),
+                      where=se_raw > 0)
+        from scipy import stats
+        if out.get("dispersion_df"):
+            pv = 2.0 * stats.t.sf(np.abs(z), out["dispersion_df"])
+        else:
+            pv = 2.0 * stats.norm.sf(np.abs(z))
+        cols += ["std_error", "z_value", "p_value"]
+        types += ["double", "double", "double"]
+        for r, s_, z_, p_ in zip(rows, se_raw, z, pv):
+            r.extend([float(s_), float(z_), float(p_)])
+    cols.append("standardized_coefficients")
+    types.append("double")
+    for r, b in zip(rows, beta_std):
+        r.append(float(b))
+    return twodim_json("Coefficients", cols, types, rows,
+                       "GLM coefficients" +
+                       (" (with inference)" if se is not None else ""))
+
+
 class GLMModel(Model):
     algo = "glm"
 
@@ -291,6 +403,17 @@ class GLMModel(Model):
         out = self.output
         X = expand_for_scoring(frame, out["expansion_spec"])
         dom = out.get("response_domain")
+        if out.get("is_ordinal"):
+            beta = jnp.asarray(out["beta"])
+            thr = jnp.asarray(out["ordinal_thresholds"])
+            eta = X @ beta[:-1] + beta[-1]
+            c = jax.nn.sigmoid(thr[None, :] - eta[:, None])
+            c = jnp.concatenate([jnp.zeros_like(c[:, :1]), c,
+                                 jnp.ones_like(c[:, :1])], axis=1)
+            P_ = jnp.maximum(jnp.diff(c, axis=1), 0.0)
+            P_ = P_ / jnp.maximum(jnp.sum(P_, axis=1, keepdims=True), EPS)
+            label = jnp.argmax(P_, axis=1).astype(jnp.float32)
+            return jnp.concatenate([label[:, None], P_], axis=1)
         if out.get("is_multinomial"):
             B = jnp.asarray(out["beta_multinomial"])   # (K, P+1)
             eta = X @ B[:, :-1].T + B[:, -1][None, :]
@@ -300,7 +423,8 @@ class GLMModel(Model):
         beta = jnp.asarray(out["beta"])
         eta = X @ beta[:-1] + beta[-1]
         fam = _family(out["family_resolved"],
-                      self.params.get("tweedie_power", 1.5))
+                      self.params.get("tweedie_power", 1.5),
+                      self.params.get("theta") or 1.0)
         mu = fam.link_inv(eta)
         if dom is not None:
             thr = float(out.get("default_threshold", 0.5))
@@ -309,6 +433,15 @@ class GLMModel(Model):
         return mu
 
     def coef(self) -> Dict[str, float]:
+        """De-standardized coefficients (the reference's coef(); the
+        standardized solution is coef_norm())."""
+        names = self.output["coef_names"] + ["Intercept"]
+        beta_raw, _ = _destandardize(
+            self.output["expansion_spec"],
+            np.asarray(self.output["beta"], np.float64))
+        return dict(zip(names, beta_raw.tolist()))
+
+    def coef_norm(self) -> Dict[str, float]:
         names = self.output["coef_names"] + ["Intercept"]
         return dict(zip(names, np.asarray(self.output["beta"]).tolist()))
 
@@ -317,13 +450,14 @@ class GLM(ModelBuilder):
     algo = "glm"
     model_cls = GLMModel
 
-    # engine-fixed: IRLSM/COD is the solver (L-BFGS absent), links are
-    # family-default, NAs mean-impute, p-values/collinear-removal absent
+    # engine-fixed: IRLSM/COD is the solver (L-BFGS absent; ordinal runs
+    # gradient descent like the reference's GRADIENT_DESCENT_LH), links
+    # are family-default, NAs mean-impute, collinear-removal absent
     ENGINE_FIXED = {
-        "solver": ("AUTO", "IRLSM", "COORDINATE_DESCENT"),
+        "solver": ("AUTO", "IRLSM", "COORDINATE_DESCENT",
+                   "GRADIENT_DESCENT_LH"),
         "link": ("family_default",),
         "missing_values_handling": ("MeanImputation",),
-        "compute_p_values": (False,),
         "remove_collinear_columns": (False,),
         "intercept": (True,),
     }
@@ -337,7 +471,7 @@ class GLM(ModelBuilder):
                  gradient_epsilon=-1.0, link="family_default",
                  missing_values_handling="MeanImputation",
                  compute_p_values=False, remove_collinear_columns=False,
-                 use_all_factor_levels=False)
+                 use_all_factor_levels=False, theta=1e-10)
         return p
 
     def _fit(self, job, x, y, train: Frame, valid: Optional[Frame]):
@@ -356,6 +490,32 @@ class GLM(ModelBuilder):
         yv = di.response()
         w = di.weights()
         valid_m = di.valid_mask()
+        if fam_name in ("fractionalbinomial", "negativebinomial") and \
+                di.response_domain:
+            raise ValueError(f"family='{fam_name}' needs a numeric "
+                             "response, not a categorical")
+        if fam_name == "fractionalbinomial":
+            ok = jnp.where(valid_m, (yv >= 0.0) & (yv <= 1.0), True)
+            if not bool(jnp.all(ok)):
+                raise ValueError("family='fractionalbinomial' needs a "
+                                 "numeric response in [0, 1]")
+        if fam_name == "negativebinomial":
+            ok = jnp.where(valid_m, yv >= 0.0, True)
+            if not bool(jnp.all(ok)):
+                raise ValueError("family='negativebinomial' needs a "
+                                 "non-negative response")
+        if bool(p.get("compute_p_values")):
+            lam_req = p.get("lambda_")
+            if isinstance(lam_req, (list, tuple)):
+                lam_req = lam_req[0] if lam_req else None
+            if p.get("lambda_search") or (lam_req or 0.0) != 0.0:
+                raise ValueError(
+                    "compute_p_values requires lambda=0 (no "
+                    "regularization), as in the reference GLM")
+            if fam_name in ("multinomial", "ordinal"):
+                raise ValueError("compute_p_values is not available for "
+                                 f"family='{fam_name}'")
+            p["lambda_"] = 0.0
         P = X.shape[1]
         alpha = p["alpha"]
         alpha = 0.5 if alpha is None else (
@@ -365,7 +525,20 @@ class GLM(ModelBuilder):
             max_iter = 50
 
         spec = expansion_spec(di)
-        if fam_name == "multinomial":
+        self._assemble_penalty(p, di, spec, X)
+        if fam_name == "ordinal":
+            if not di.response_domain or di.nclasses < 2:
+                raise ValueError("family='ordinal' needs a categorical "
+                                 "response with ordered levels")
+            beta, thresholds = self._fit_ordinal(X, yv, w, valid_m, di, p,
+                                                 alpha, max_iter, job)
+            out = dict(x=x, beta=np.asarray(beta), is_multinomial=False,
+                       is_ordinal=True,
+                       ordinal_thresholds=np.asarray(thresholds),
+                       expansion_spec=spec, family_resolved="ordinal",
+                       coef_names=di.expanded_names,
+                       response_domain=di.response_domain)
+        elif fam_name == "multinomial":
             betas = self._fit_multinomial(X, yv, w, valid_m, di, p, alpha,
                                           max_iter, job)
             out = dict(x=x, beta_multinomial=np.asarray(betas),
@@ -405,6 +578,7 @@ class GLM(ModelBuilder):
                        response_domain=di.response_domain
                        if fam_name in ("binomial", "quasibinomial")
                        else None, **extra)
+        out["coefficients_table"] = build_coef_table(out)
         model = self.model_cls(self.model_id, dict(p), out)
         model.params["response_column"] = y
         model.output["training_metrics"] = model.model_metrics(train)
@@ -412,14 +586,65 @@ class GLM(ModelBuilder):
             model.output["validation_metrics"] = model.model_metrics(valid)
         return model
 
+    @staticmethod
+    def _assemble_penalty(p, di, spec, X):
+        """Internal wiring for GAM: name-keyed quadratic-penalty blocks
+        (``_penalty_blocks``: [(coef_names, S)]) are assembled into one
+        (P+1, P+1) matrix aligned with the expanded coef layout, and
+        ``_nonneg_names`` into a per-coef clamp mask (monotone
+        I-splines).  Standardization transforms S into the solved space
+        (beta_std = sigma * beta_raw => S / (sigma sigma'))."""
+        blocks = p.get("_penalty_blocks")
+        names = list(di.expanded_names)
+        idx_of = {n: i for i, n in enumerate(names)}
+        if blocks:
+            P1 = X.shape[1] + 1
+            S = np.zeros((P1, P1))
+            sig = dict(zip(spec["num_names"], spec["sigmas"])) \
+                if spec["standardize"] else {}
+            # calibrate each block against its own data-Gram energy so
+            # the caller's scale knob is unit-free: scale=1 adds 0.1% of
+            # tr(G_block) worth of curvature penalty (mild smoothing /
+            # conditioning), scale ~1e2-1e3 visibly smooths
+            col_ss = np.asarray(jnp.sum(X * X, axis=0), np.float64)
+            RHO = 1e-3
+            for bnames, Sb, scale in blocks:
+                idx = [idx_of[n] for n in bnames]
+                Sb = np.asarray(Sb, np.float64)
+                if sig:
+                    d = np.array([1.0 / ((sig.get(n) or 1.0) or 1.0)
+                                  for n in bnames])
+                    Sb = Sb * d[:, None] * d[None, :]
+                tr_s = max(np.trace(Sb), 1e-12)
+                tr_g = max(float(col_ss[idx].sum()), 1e-12)
+                S[np.ix_(idx, idx)] += Sb * (scale * RHO * tr_g / tr_s)
+            p["_penalty"] = S
+        nn = p.get("_nonneg_names")
+        if nn:
+            mask = np.zeros((X.shape[1] + 1,), np.float32)
+            for n in nn:
+                mask[idx_of[n]] = 1.0
+            p["_nonneg_mask"] = mask
+
     # -- solvers ------------------------------------------------------------
 
     def _irlsm_at_lambda(self, X, yv, w, valid_m, fam_name, p, alpha, lam,
                          beta, max_iter, n_obs, first_pass=None):
         """IRLSM to convergence at one fixed lambda (warm-started beta).
         ``first_pass``: an already-computed (G, q, dev) at the current beta
-        (reuses the lambda_max pass instead of recomputing it)."""
+        (reuses the lambda_max pass instead of recomputing it).
+
+        Quadratic penalty matrices (GAM's curvature β'Sβ) fold directly
+        into the Gram before the solve: 1/2 β'Gβ − q'β + 1/2 β'Sβ =
+        1/2 β'(G+S)β − q'β, so COD and Cholesky work unchanged
+        (reference hex/gam: S added to the GLM gram)."""
         nonneg = bool(p.get("non_negative"))
+        pen = p.get("_penalty")
+        pen_dev = jnp.asarray(pen) if pen is not None else None
+        mask = p.get("_nonneg_mask")
+        if mask is not None:
+            nonneg = True
+            mask = jnp.asarray(mask, jnp.float32)
         dev_prev, dev = None, None
         self._last_iters = 0
         for it in range(max_iter):
@@ -427,13 +652,18 @@ class GLM(ModelBuilder):
                 G, q, dev = first_pass
             else:
                 G, q, dev = _irlsm_pass(X, yv, w, valid_m, beta, fam_name,
-                                        p["tweedie_power"])
+                                        p["tweedie_power"],
+                                        float(p.get("theta") or 1.0))
             self._last_iters = it + 1
+            if pen_dev is not None:
+                # pre-calibrated against the data Gram (_assemble_penalty)
+                G = G + pen_dev
             l1 = lam * alpha * n_obs
             l2 = lam * (1 - alpha) * n_obs
             if l1 > 0 or nonneg:
                 beta_new = _cod_solve(G, q, beta, l1, l2,
-                                      non_negative=nonneg)
+                                      non_negative=nonneg,
+                                      nonneg_mask=mask)
             else:
                 beta_new = _chol_solve(G, q, l2)
             delta = float(jnp.max(jnp.abs(beta_new - beta)))
@@ -457,7 +687,8 @@ class GLM(ModelBuilder):
         early-stop when explained deviance plateaus."""
         P = X.shape[1]
         beta = jnp.zeros((P + 1,))
-        fam = _family(fam_name, p["tweedie_power"])
+        fam = _family(fam_name, p["tweedie_power"],
+                      float(p.get("theta") or 1.0))
         # initialize intercept at the null model
         wa = jnp.where(valid_m, w, 0.0)
         mu0 = fam.null_mu(jnp.where(valid_m, jnp.nan_to_num(yv), 0.0), wa)
@@ -475,7 +706,8 @@ class GLM(ModelBuilder):
             # reused as iteration 0 of the first solve (same beta) — no
             # duplicate Gram computation
             G0, q0, dev0 = _irlsm_pass(X, yv, w, valid_m, beta, fam_name,
-                                       p["tweedie_power"])
+                                       p["tweedie_power"],
+                                       float(p.get("theta") or 1.0))
             grad = q0 - G0 @ beta
             lam_max = float(jnp.max(jnp.abs(grad[:-1])) /
                             max(alpha, 1e-3) / n_obs)
@@ -488,6 +720,9 @@ class GLM(ModelBuilder):
                 X, yv, w, valid_m, fam_name, p, alpha, lam, beta,
                 max_iter, n_obs, first_pass=first_pass)
             extra["iterations"] = self._last_iters
+            if bool(p.get("compute_p_values")):
+                extra.update(self._p_values(X, yv, w, valid_m, fam_name,
+                                            p, beta, dev, n_obs))
             job.update(1.0, "IRLSM converged")
             return beta, lam, dev, extra
 
@@ -515,7 +750,8 @@ class GLM(ModelBuilder):
             Xv, yval, wv, vmask = vdata
             beta_null = jnp.zeros((P + 1,)).at[-1].set(fam.link(mu0))
             null_dev_v = float(_deviance_at(Xv, yval, wv, vmask, beta_null,
-                                            fam_name, p["tweedie_power"]))
+                                            fam_name, p["tweedie_power"],
+                                            float(p.get("theta") or 1.0)))
         path_lams, path_dev_t, path_dev_v, path_coefs = [], [], [], []
         best = None                          # (crit, beta, lam, dev_train)
         total_iters = 0
@@ -529,7 +765,8 @@ class GLM(ModelBuilder):
             if vdata is not None:
                 Xv, yval, wv, vmask = vdata
                 dev_v = float(_deviance_at(Xv, yval, wv, vmask, beta,
-                                           fam_name, p["tweedie_power"]))
+                                           fam_name, p["tweedie_power"],
+                                           float(p.get("theta") or 1.0)))
             crit = dev_v if dev_v is not None else dev
             path_lams.append(float(lam_k))
             path_dev_t.append(dev)
@@ -565,6 +802,148 @@ class GLM(ModelBuilder):
                 coefficients=[c.tolist() for c in path_coefs]))
         return beta_best, lam_best, dev_best, extra
 
+    def _p_values(self, X, yv, w, valid_m, fam_name, p, beta, dev,
+                  n_obs) -> Dict:
+        """Std errors / z / p for an UNREGULARIZED fit: the covariance is
+        dispersion * inv(X'WX) at the converged beta — one extra Gram
+        pass + Cholesky inverse (reference hex/glm computePValues:
+        Gram.java inverse after the final IRLSM iteration).  Gaussian
+        (and other estimated-dispersion families) use Student-t tails;
+        binomial/poisson use the standard normal."""
+        G, _q, _d = _irlsm_pass(X, yv, w, valid_m, beta, fam_name,
+                                p["tweedie_power"],
+                                float(p.get("theta") or 1.0))
+        Gn = np.asarray(G, np.float64)
+        P1 = Gn.shape[0]
+        cov = np.linalg.inv(Gn + 1e-10 * np.eye(P1))
+        df = max(n_obs - P1, 1.0)
+        if fam_name in ("binomial", "quasibinomial", "fractionalbinomial",
+                        "poisson"):
+            disp, use_t = 1.0, False
+        else:
+            fam = _family(fam_name, p["tweedie_power"],
+                          float(p.get("theta") or 1.0))
+            eta = X @ beta[:-1] + beta[-1]
+            mu = fam.link_inv(eta)
+            wa = jnp.where(valid_m, w, 0.0)
+            pearson = float(jnp.sum(
+                wa * (jnp.nan_to_num(yv) - mu) ** 2 /
+                jnp.maximum(fam.variance(mu), EPS)))
+            disp, use_t = pearson / df, True
+        se = np.sqrt(np.maximum(np.diag(cov) * disp, 0.0))
+        b = np.asarray(beta, np.float64)
+        z = np.divide(b, se, out=np.zeros_like(b), where=se > 0)
+        from scipy import stats
+        pv = 2.0 * (stats.t.sf(np.abs(z), df) if use_t
+                    else stats.norm.sf(np.abs(z)))
+        return dict(std_errs=se, z_values=z, p_values=pv,
+                    dispersion=float(disp), coef_cov=cov * disp,
+                    dispersion_df=float(df) if use_t else None)
+
+    def _fit_ordinal(self, X, yv, w, valid_m, di, p, alpha, max_iter, job):
+        """Proportional-odds (cumulative logit) ordinal regression:
+        P(y <= k) = sigmoid(thr_k - x'beta), one shared beta and K-1
+        monotone thresholds.
+
+        The reference fits ordinal by gradient descent, not IRLSM
+        (hex/glm/GLM.java ordinal path, solver GRADIENT_DESCENT_LH); here
+        it is full-batch Adam on the exact likelihood — one fused XLA
+        program over the row-sharded X, monotone thresholds enforced by a
+        softplus-increment parametrization."""
+        K = di.nclasses
+        P = X.shape[1]
+        lam = p.get("lambda_")
+        if isinstance(lam, (list, tuple)):
+            lam = lam[0] if lam else None
+        lam = float(lam) if lam is not None else 0.0
+        l1 = lam * alpha
+        l2 = lam * (1 - alpha)
+        wa = jnp.where(valid_m, w, 0.0)
+        yk = jnp.where(valid_m, jnp.nan_to_num(yv), 0.0).astype(jnp.int32)
+        n_obs = jnp.maximum(jnp.sum(wa), 1.0)
+
+        # threshold init at the empirical cumulative-logit of class priors
+        pri = np.asarray(jnp.stack(
+            [jnp.sum(wa * (yk == k)) for k in range(K)]))
+        pri = np.maximum(pri / max(pri.sum(), 1e-12), 1e-6)
+        cum = np.clip(np.cumsum(pri)[:-1], 1e-6, 1 - 1e-6)
+        thr0 = np.log(cum / (1 - cum))
+        incr0 = np.maximum(np.diff(thr0), 1e-3)
+        # inverse softplus for the increment params
+        s0 = np.log(np.expm1(incr0)) if K > 2 else np.zeros((0,))
+        params0 = jnp.concatenate([
+            jnp.zeros((P,)), jnp.asarray([thr0[0]], jnp.float32),
+            jnp.asarray(s0, jnp.float32)]).astype(jnp.float32)
+
+        def unpack(params):
+            beta = params[:P]
+            t0 = params[P]
+            if K > 2:
+                thr = jnp.concatenate(
+                    [t0[None], t0 + jnp.cumsum(
+                        jax.nn.softplus(params[P + 1:]))])
+            else:
+                thr = t0[None]
+            return beta, thr
+
+        # GAM wiring: quadratic penalty (calibrated on the sum-scale Gram
+        # => divide by n_obs for this mean-scale objective) and the
+        # monotone non-negative coef mask, honored by projection
+        pen = p.get("_penalty")
+        pen_dev = jnp.asarray(pen) if pen is not None else None
+        mask = p.get("_nonneg_mask")
+        proj_mask = None
+        if mask is not None:
+            proj_mask = jnp.concatenate([
+                jnp.asarray(mask, jnp.float32)[:P],
+                jnp.zeros((params0.shape[0] - P,), jnp.float32)])
+
+        def nll(params):
+            beta, thr = unpack(params)
+            eta = X @ beta
+            c = jax.nn.sigmoid(thr[None, :] - eta[:, None])    # (R, K-1)
+            c = jnp.concatenate([jnp.zeros_like(c[:, :1]), c,
+                                 jnp.ones_like(c[:, :1])], axis=1)
+            idx = yk[:, None]
+            p_hi = jnp.take_along_axis(c, idx + 1, axis=1)[:, 0]
+            p_lo = jnp.take_along_axis(c, idx, axis=1)[:, 0]
+            pk = jnp.clip(p_hi - p_lo, EPS, 1.0)
+            obj = -jnp.sum(wa * jnp.log(pk)) / n_obs
+            if pen_dev is not None:
+                bf = jnp.concatenate([beta, jnp.zeros((1,))])
+                obj = obj + 0.5 * (bf @ pen_dev @ bf) / n_obs
+            return obj + 0.5 * l2 * jnp.sum(beta ** 2) + \
+                l1 * jnp.sum(jnp.abs(beta))
+
+        import optax
+        steps = 200 * max(max_iter, 10)        # full-batch; cheap per step
+        opt = optax.adam(optax.exponential_decay(0.5, steps // 4, 0.3))
+
+        @jax.jit
+        def run(params):
+            state = opt.init(params)
+
+            def step(carry, _):
+                prm, st = carry
+                loss, g = jax.value_and_grad(nll)(prm)
+                upd, st = opt.update(g, st, prm)
+                prm = optax.apply_updates(prm, upd)
+                if proj_mask is not None:
+                    prm = jnp.where(proj_mask > 0,
+                                    jnp.maximum(prm, 0.0), prm)
+                return (prm, st), loss
+
+            (params, _), losses = jax.lax.scan(
+                step, (params, state), None, length=steps)
+            return params, losses
+
+        params, losses = run(params0)
+        job.update(0.9, f"ordinal GD {steps} steps, "
+                        f"nll={float(losses[-1]):.5g}")
+        beta, thr = unpack(params)
+        beta_full = jnp.concatenate([beta, jnp.zeros((1,))])  # intercept
+        return beta_full, thr                                 # in thresholds
+
     def _fit_multinomial(self, X, yv, w, valid_m, di, p, alpha, max_iter,
                          job):
         K = di.nclasses
@@ -576,6 +955,10 @@ class GLM(ModelBuilder):
         lam = float(lam) if lam is not None else 0.0
         wa = jnp.where(valid_m, w, 0.0)
         n_obs = float(jnp.maximum(jnp.sum(wa), 1.0))
+        pen = p.get("_penalty")
+        pen_dev = jnp.asarray(pen) if pen is not None else None
+        mask = p.get("_nonneg_mask")
+        mask = jnp.asarray(mask, jnp.float32) if mask is not None else None
         for it in range(max_iter):
             max_delta = 0.0
             for k in range(K):
@@ -585,11 +968,13 @@ class GLM(ModelBuilder):
                 # GLM.java multinomial loop)
                 G, q, _ = _irlsm_pass(X, yk, w, valid_m, betas[k],
                                       "binomial")
+                if pen_dev is not None:
+                    G = G + pen_dev
                 l1 = lam * alpha * n_obs
                 l2 = lam * (1 - alpha) * n_obs
-                nonneg = bool(p.get("non_negative"))
+                nonneg = bool(p.get("non_negative")) or mask is not None
                 bk = _cod_solve(G, q, betas[k], l1, l2,
-                                non_negative=nonneg) \
+                                non_negative=nonneg, nonneg_mask=mask) \
                     if (l1 > 0 or nonneg) else _chol_solve(G, q, l2)
                 max_delta = max(max_delta,
                                 float(jnp.max(jnp.abs(bk - betas[k]))))

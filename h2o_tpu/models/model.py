@@ -397,6 +397,13 @@ class ModelBuilder:
                                      validation_frame)
             else:
                 model = self._fit(j, x, y, training_frame, validation_frame)
+            if j.warnings:
+                # engine-substitution warnings land on the model output
+                # too (reference ModelBuilder warning plumbing ->
+                # ModelSchemaV3; the job copy is what the stock client
+                # re-raises as Python warnings)
+                seen = model.output.setdefault("warnings", [])
+                seen.extend(w for w in j.warnings if w not in seen)
             cmf = self.params.get("custom_metric_func")
             if cmf:
                 # UDF metric (water/udf CMetricFunc flow, core/udf.py)

@@ -89,6 +89,9 @@ class PSVM(ModelBuilder):
 
     def _fit(self, job, x, y, train: Frame, valid: Optional[Frame]):
         p = self.params
+        job.warn("PSVM solves the primal with a random-Fourier-feature "
+                 "kernel map on this engine (the reference's ICF "
+                 "low-rank approximation is replaced)")
         di = DataInfo(train, x, y, mode="expanded", standardize=True,
                       weights=p.get("weights_column"), impute_missing=True)
         if di.nclasses != 2:

@@ -169,6 +169,15 @@ class RuleFit(ModelBuilder):
                              else {"learn_rate": 0.1}))
             tm_model = tm._fit(job, list(di.x), y, train, None)
             to = tm_model.output
+            if to.get("child") is not None:
+                # rule depths are bounded by max_rule_length; only the
+                # dense-heap layout reaches here unless the frontier cap
+                # (H2O_TPU_MAX_LIVE_LEAVES) was shrunk below 2^(depth-1)
+                raise ValueError(
+                    "RuleFit rule generation needs dense-heap trees; "
+                    f"max_rule_length={depth} exceeded the frontier cap — "
+                    "raise H2O_TPU_MAX_LIVE_LEAVES or lower "
+                    "max_rule_length")
             K = to["split_col"].shape[1]
             # collapse the K class-tree axis: every (t, k) tree is a tree
             sc = to["split_col"].reshape(-1, to["split_col"].shape[2])

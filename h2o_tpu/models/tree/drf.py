@@ -47,10 +47,7 @@ class DRFModel(Model):
         m = frame.as_matrix(out["x"])
         bins = st._bin_all(m, jnp.asarray(out["split_points"]),
                            jnp.asarray(out["is_cat"]), int(out["nbins"]))
-        F = st.forest_score(bins, jnp.asarray(out["split_col"]),
-                            jnp.asarray(out["bitset"]),
-                            jnp.asarray(out["value"]),
-                            int(out["max_depth"]))
+        F = st.forest_score_out(bins, out)
         return raw_from_votes(F, int(out["ntrees_actual"]),
                               out.get("response_domain"),
                               threshold=float(out.get(
@@ -114,21 +111,32 @@ class DRF(ModelBuilder):
                 else max(1, C // 3)
 
         from h2o_tpu.core.log import get_logger
-        from h2o_tpu.models.tree.jit_engine import clamp_depth
+        from h2o_tpu.models.tree.jit_engine import (clamp_depth,
+                                                    plan_engine, pool_size)
         depth = clamp_depth(int(p["max_depth"]), get_logger("drf"))
+        if depth != int(p["max_depth"]):
+            job.warn(f"max_depth={p['max_depth']} exceeds the engine "
+                     f"depth limit; trees were built to depth {depth} "
+                     "(H2O_TPU_MAX_TREE_DEPTH)")
+        kleaves = plan_engine(depth)
         F0 = jnp.zeros((R, K), jnp.float32)
         prior = 0
         if ckpt is not None:
             prior = int(co["ntrees_actual"])
             if int(co["max_depth"]) != depth:
                 raise ValueError("checkpoint max_depth mismatch")
-            F0 = F0 + st.forest_score(bins, jnp.asarray(co["split_col"]),
-                                      jnp.asarray(co["bitset"]),
-                                      jnp.asarray(co["value"]), depth)
+            if (co.get("child") is not None) != (kleaves > 0) or \
+                    co["split_col"].shape[2] != pool_size(depth, kleaves):
+                raise ValueError(
+                    "checkpoint tree engine/pool mismatch (dense vs "
+                    "sparse-frontier, or a different frontier width); "
+                    "set H2O_TPU_MAX_LIVE_LEAVES to match the "
+                    "checkpoint's engine")
+            F0 = F0 + st.forest_score_out(bins, co, depth)
         sp_np = np.asarray(binned.split_points)
         ic_np = np.asarray(binned.is_cat)
 
-        def make_model(sc, bs, vl, n_new, F_final):
+        def make_model(sc, bs, vl, ch, n_new, F_final):
             if ckpt is not None:
                 sc = np.concatenate([co["split_col"], sc]) if n_new \
                     else np.asarray(co["split_col"])
@@ -136,9 +144,13 @@ class DRF(ModelBuilder):
                     else np.asarray(co["bitset"])
                 vl = np.concatenate([co["value"], vl]) if n_new \
                     else np.asarray(co["value"])
+                if ch is not None:
+                    ch = np.concatenate([co["child"], ch]) if n_new \
+                        else np.asarray(co["child"])
             out = dict(
                 x=list(di.x), split_points=sp_np, is_cat=ic_np,
                 nbins=binned.nbins, split_col=sc, bitset=bs, value=vl,
+                child=ch,
                 max_depth=depth, effective_max_depth=depth,
                 response_domain=di.response_domain if nclass >= 2 else None,
                 domains={c: list(train.vec(c).domain)
@@ -166,7 +178,7 @@ class DRF(ModelBuilder):
             min_split_improvement=float(p["min_split_improvement"]),
             col_sample_rate_per_tree=float(
                 p.get("col_sample_rate_per_tree") or 1.0),
-            mode="drf")
+            mode="drf", kleaves=kleaves)
         kind = "binomial" if nclass == 2 else (
             "multinomial" if nclass > 2 else "regression")
         from h2o_tpu.models.tree.driver import (IncrementalScorer,
@@ -183,15 +195,14 @@ class DRF(ModelBuilder):
                 jnp.asarray(binned.is_cat), binned.nbins)
             F_sc = jnp.zeros((bins_sc.shape[0], K), jnp.float32)
             if prior:
-                F_sc = F_sc + st.forest_score(
-                    bins_sc, jnp.asarray(co["split_col"]),
-                    jnp.asarray(co["bitset"]), jnp.asarray(co["value"]),
-                    depth)
-            H = 2 ** (depth + 1) - 1
+                F_sc = F_sc + st.forest_score_out(bins_sc, co, depth)
+            H = pool_size(depth, kleaves)
             proto = make_model(
                 np.zeros((0, K, H), np.int32),
                 np.zeros((0, K, H, binned.nbins + 1), bool),
-                np.zeros((0, K, H), np.float32), 0, None)
+                np.zeros((0, K, H), np.float32),
+                np.zeros((0, K, H), np.int32) if kleaves else None,
+                0, None)
             dom_sc = di.response_domain if nclass >= 2 else None
 
             def to_metrics(Fv, ntot):

@@ -57,11 +57,12 @@ class IncrementalScorer:
         self.to_metrics = to_metrics
         self.is_validation = is_validation
 
-    def add(self, sc, bs, vl) -> None:
+    def add(self, sc, bs, vl, ch=None) -> None:
         from h2o_tpu.models.tree.shared_tree import forest_score
-        self.F = self.F + forest_score(self.bins, jnp.asarray(sc),
-                                       jnp.asarray(bs), jnp.asarray(vl),
-                                       self.depth)
+        self.F = self.F + forest_score(
+            self.bins, jnp.asarray(sc), jnp.asarray(bs), jnp.asarray(vl),
+            self.depth,
+            child=jnp.asarray(ch) if ch is not None else None)
 
     def metrics(self, ntrees_total: int):
         return self.to_metrics(self.F, ntrees_total)
@@ -76,8 +77,9 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
     exist on a checkpoint), scoring every ``score_tree_interval`` trees when
     early stopping / periodic scoring / a runtime budget is requested.
 
-    make_model(sc, bs, vl, n_new, F_final) -> Model; arrays are the NEW
-    trees only (the builder prepends checkpoint trees itself).
+    make_model(sc, bs, vl, ch, n_new, F_final) -> Model; arrays are the
+    NEW trees only (the builder prepends checkpoint trees itself); ch is
+    None for dense-heap trees.
     """
     from h2o_tpu.models.tree.jit_engine import train_forest
 
@@ -103,7 +105,10 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
         tf = train_forest(F0=F0, key=key, ntrees=max(ntrees, 0),
                           t0=prior_trees, **train_kwargs)
         model = make_model(np.asarray(tf.split_col), np.asarray(tf.bitset),
-                           np.asarray(tf.value), max(ntrees, 0), tf.f_final)
+                           np.asarray(tf.value),
+                           np.asarray(tf.child)
+                           if tf.child is not None else None,
+                           max(ntrees, 0), tf.f_final)
         model.output["scoring_history"] = []
         prior_vi = model.output.get("varimp")
         vi = np.asarray(tf.varimp)
@@ -112,7 +117,7 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
         return model
 
     block = interval if interval > 0 else max(1, min(ntrees, 10))
-    scs, bss, vls, gns = [], [], [], []
+    scs, bss, vls, chs, gns = [], [], [], [], []
     vi_total = None
     F = F0
     done = 0
@@ -126,11 +131,13 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
         scs.append(np.asarray(tf.split_col))
         bss.append(np.asarray(tf.bitset))
         vls.append(np.asarray(tf.value))
+        if tf.child is not None:
+            chs.append(np.asarray(tf.child))
         gns.append(np.asarray(tf.node_gain))
         vi = np.asarray(tf.varimp)
         vi_total = vi if vi_total is None else vi_total + vi
         done += n
-        scorer.add(tf.split_col, tf.bitset, tf.value)
+        scorer.add(tf.split_col, tf.bitset, tf.value, tf.child)
         mm = scorer.metrics(prior_trees + done)
         row = {"number_of_trees": prior_trees + done,
                "timestamp": time.time()}
@@ -148,7 +155,8 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
             job.update(0.9, f"max_runtime_secs hit at {done} trees")
             break
     model = make_model(np.concatenate(scs), np.concatenate(bss),
-                       np.concatenate(vls), done, F)
+                       np.concatenate(vls),
+                       np.concatenate(chs) if chs else None, done, F)
     model.output["scoring_history"] = sk.events
     _set_node_gain(model, np.concatenate(gns))
     prior_vi = model.output.get("varimp")

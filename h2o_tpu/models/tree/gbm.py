@@ -54,10 +54,7 @@ class GBMModel(Model):
         bins = st._bin_all(m, jnp.asarray(out["split_points"]),
                            jnp.asarray(out["is_cat"]),
                            int(out["nbins"]))
-        F = st.forest_score(bins, jnp.asarray(out["split_col"]),
-                            jnp.asarray(out["bitset"]),
-                            jnp.asarray(out["value"]),
-                            int(out["max_depth"]))
+        F = st.forest_score_out(bins, out)
         F = F + jnp.asarray(out["f0"])[None, :]
         off_col = self.params.get("offset_column")
         if off_col and off_col in frame:
@@ -192,15 +189,26 @@ class GBM(ModelBuilder):
             prior = int(co["ntrees_actual"])
             if int(co["max_depth"]) != int(p["max_depth"]):
                 raise ValueError("checkpoint max_depth mismatch")
-            F = F + st.forest_score(bins, jnp.asarray(co["split_col"]),
-                                    jnp.asarray(co["bitset"]),
-                                    jnp.asarray(co["value"]),
-                                    int(p["max_depth"]))
+            F = F + st.forest_score_out(bins, co, int(p["max_depth"]))
 
         C = len(di.x)
         from h2o_tpu.core.log import get_logger
-        from h2o_tpu.models.tree.jit_engine import clamp_depth
+        from h2o_tpu.models.tree.jit_engine import (clamp_depth,
+                                                    plan_engine, pool_size)
         depth = clamp_depth(int(p["max_depth"]), get_logger("gbm"))
+        if depth != int(p["max_depth"]):
+            job.warn(f"max_depth={p['max_depth']} exceeds the engine "
+                     f"depth limit; trees were built to depth {depth} "
+                     "(H2O_TPU_MAX_TREE_DEPTH)")
+        kleaves = plan_engine(depth)
+        if ckpt is not None:
+            if (co.get("child") is not None) != (kleaves > 0) or \
+                    co["split_col"].shape[2] != pool_size(depth, kleaves):
+                raise ValueError(
+                    "checkpoint tree engine/pool mismatch (dense vs "
+                    "sparse-frontier, or a different frontier width); "
+                    "set H2O_TPU_MAX_LIVE_LEAVES to match the "
+                    "checkpoint's engine")
         newton = dist_name not in ("gaussian", "laplace", "quantile",
                                    "huber")
         if p.get("force_newton"):
@@ -213,7 +221,7 @@ class GBM(ModelBuilder):
         sp_np = np.asarray(binned.split_points)
         ic_np = np.asarray(binned.is_cat)
 
-        def make_model(sc, bs, vl, n_new, F_final):
+        def make_model(sc, bs, vl, ch, n_new, F_final):
             if ckpt is not None:
                 sc = np.concatenate([co["split_col"], sc]) if n_new \
                     else np.asarray(co["split_col"])
@@ -221,9 +229,13 @@ class GBM(ModelBuilder):
                     else np.asarray(co["bitset"])
                 vl = np.concatenate([co["value"], vl]) if n_new \
                     else np.asarray(co["value"])
+                if ch is not None:
+                    ch = np.concatenate([co["child"], ch]) if n_new \
+                        else np.asarray(co["child"])
             out = dict(
                 x=list(di.x), split_points=sp_np, is_cat=ic_np,
                 nbins=binned.nbins, split_col=sc, bitset=bs, value=vl,
+                child=ch,
                 max_depth=depth, f0=f0_out, effective_max_depth=depth,
                 distribution_resolved=dist_name,
                 response_domain=di.response_domain if nclass >= 2 else None,
@@ -257,7 +269,7 @@ class GBM(ModelBuilder):
             reg_lambda=float(p.get("reg_lambda") or 0.0),
             col_sample_rate_per_tree=float(
                 p.get("col_sample_rate_per_tree") or 1.0),
-            huber_alpha=float(p["huber_alpha"]))
+            huber_alpha=float(p["huber_alpha"]), kleaves=kleaves)
         mono = self._mono_array(p, di)
         if mono is not None:
             train_kwargs["mono"] = jnp.asarray(mono)
@@ -282,15 +294,14 @@ class GBM(ModelBuilder):
             if off_col and off_col in score_frame:
                 F_sc = F_sc + score_frame.vec(off_col).data[:, None]
             if prior:
-                F_sc = F_sc + st.forest_score(
-                    bins_sc, jnp.asarray(co["split_col"]),
-                    jnp.asarray(co["bitset"]), jnp.asarray(co["value"]),
-                    depth)
-            H = 2 ** (depth + 1) - 1
+                F_sc = F_sc + st.forest_score_out(bins_sc, co, depth)
+            H = pool_size(depth, kleaves)
             proto = make_model(
                 np.zeros((0, K, H), np.int32),
                 np.zeros((0, K, H, binned.nbins + 1), bool),
-                np.zeros((0, K, H), np.float32), 0, None)
+                np.zeros((0, K, H), np.float32),
+                np.zeros((0, K, H), np.int32) if kleaves else None,
+                0, None)
             dom_sc = di.response_domain if nclass >= 2 else None
 
             def to_metrics(Fv, ntot):

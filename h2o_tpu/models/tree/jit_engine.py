@@ -15,18 +15,28 @@ TPU-native answer is to move the ENTIRE loop into XLA:
 One dispatch trains the whole model.  The host only sees the final
 (T, K, H) tree arrays.
 
-DESIGN LIMIT — dense tree heaps: trees live in fixed-shape heap arrays
-with H = 2^(D+1)-1 slots (split_col (H,), bitset (H, B+1), value (H,)).
-The reference stores sparse CompressedTree bytecode, so its depth-20 DRF
-default costs only the nodes that exist; here level d always allocates
-2^d histogram rows and heap slots.  Above depth ~14 the (L, C, B+1, 4)
-histograms and (T, K, H, B+1) bitsets grow to GB scale, so builders CLAMP
-requested depth to ``H2O_TPU_MAX_TREE_DEPTH`` (default 12, see
-``clamp_depth``) with a logged warning and an ``effective_max_depth``
-output field — shallow-and-more-trees is the efficient operating point on
-this engine (the boosted setting the TPU's static shapes favor).  A
-sparse-frontier redesign (cap live leaves per level, LightGBM-style)
-is the planned lift of this limit.
+TWO ENGINES, ONE OUTPUT CONTRACT:
+
+- **dense heap** (``build_tree_traced``): level d allocates exactly
+  L = 2^d histogram rows and heap slots; node n's children sit at
+  2n+1 / 2n+2 (``child`` is None in the output).  Optimal for shallow
+  trees — no scatter, purely static offsets.
+- **sparse frontier** (``build_tree_frontier``): the live frontier is
+  capped at ``max_live_leaves`` slots per level (LightGBM-style);
+  nodes live in a grows-with-splits pool with an explicit ``child``
+  pointer array (left child id; right = left+1).  When the frontier
+  overflows, the children with the largest residual impurity
+  (wgg − wg²/w) stay live and the rest become terminal leaves — a
+  best-first criterion.  This is the TPU answer to the reference's
+  sparse CompressedTree (hex/tree/DTree.java:891-935 compress():
+  cost scales with actual leaves, not 2^depth): histograms are
+  (K_live, C, B+1, 4) however deep the tree goes, so stock DRF's
+  default max_depth=20 trains unclamped with bounded memory.
+
+``train_forest`` picks the engine statically: dense when every level
+fits inside ``max_live_leaves`` (2^(D-1) <= cap — the two engines
+build IDENTICAL trees in that regime), frontier beyond.  Depth is
+still sanity-clamped at ``H2O_TPU_MAX_TREE_DEPTH`` (default 30).
 """
 
 from __future__ import annotations
@@ -46,22 +56,59 @@ EPS = 1e-10
 
 def max_supported_depth() -> int:
     import os
-    return int(os.environ.get("H2O_TPU_MAX_TREE_DEPTH", "12"))
+    return int(os.environ.get("H2O_TPU_MAX_TREE_DEPTH", "30"))
+
+
+def max_live_leaves() -> int:
+    """Frontier width cap (H2O_TPU_MAX_LIVE_LEAVES, default 4096): levels
+    wider than this run the sparse-frontier engine's best-first
+    selection; histogram memory is bounded by (cap, C, B+1, 4)."""
+    import os
+    return int(os.environ.get("H2O_TPU_MAX_LIVE_LEAVES", "4096"))
 
 
 def clamp_depth(requested: int, log=None) -> int:
-    """Clamp a requested max_depth to the dense-heap engine limit (module
-    docstring).  Never silent: logs a warning; builders also record
-    ``effective_max_depth`` in the model output."""
+    """Sanity-clamp a requested max_depth (module docstring).  Since the
+    sparse-frontier engine the cap defaults to 30 (cost grows linearly
+    with depth, so only absurd requests clamp).  Never silent: logs a
+    warning; builders also record ``effective_max_depth`` in the model
+    output and a client-visible warning."""
     cap = max_supported_depth()
     if requested > cap:
         if log is not None:
             log.warning(
-                "max_depth=%d exceeds the dense tree-heap limit; clamped "
+                "max_depth=%d exceeds the engine depth limit; clamped "
                 "to %d (H2O_TPU_MAX_TREE_DEPTH; see "
                 "models/tree/jit_engine.py design note)", requested, cap)
         return cap
     return int(requested)
+
+
+def plan_engine(depth: int) -> int:
+    """Static engine choice for a given tree depth: 0 = dense heap
+    (every level fits in the frontier cap — identical trees, cheaper
+    indexing), else the frontier width cap for the sparse engine."""
+    cap = max_live_leaves()
+    if depth < 1 or 2 ** (depth - 1) <= cap:
+        return 0
+    return cap
+
+
+def frontier_plan(depth: int, cap: int):
+    """Live-frontier width per level: doubles until the cap."""
+    widths, width = [], 1
+    for _ in range(depth):
+        widths.append(width)
+        width = min(2 * width, cap)
+    return widths
+
+
+def pool_size(depth: int, kleaves: int) -> int:
+    """Node-pool slots for one tree: dense heap when kleaves == 0, else
+    root + two child slots per possibly-split frontier node."""
+    if kleaves <= 0:
+        return 2 ** (depth + 1) - 1
+    return 1 + 2 * sum(frontier_plan(depth, kleaves))
 
 
 def _node_val(wg, wh, w, newton: bool, reg_lambda: float = 0.0):
@@ -170,8 +217,149 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
     return split_col, bitset, value, varimp, node_gain
 
 
-def _tree_predict(bins, split_col, bitset, value, D: int):
-    """Descend one tree for all rows (traceable)."""
+def build_tree_frontier(bins, stats, slot0, key, is_cat, cfg: Dict,
+                        tree_col_mask=None, mono=None):
+    """Traceable single-tree build with a CAPPED live frontier.
+
+    Like ``build_tree_traced`` but the per-level leaf set is bounded by
+    cfg["max_live_leaves"]: when a level's split children outnumber the
+    cap, the children with the largest residual impurity (wgg − wg²/w,
+    the upper bound on any further split's SE reduction) stay live and
+    the rest finalize as leaves.  Below the cap the two builders produce
+    identical trees (the selection is the identity there).
+
+    Nodes live in a pool of ``pool_size(D, cap)`` slots with an explicit
+    left-``child`` pointer (right = left+1) — the sparse-CompressedTree
+    analog (reference hex/tree/DTree.java:891-935).  Returns
+    (split_col (N,), bitset (N, B+1), value (N,), child (N,),
+    varimp (C,), node_gain (N,)).
+    """
+    D = cfg["max_depth"]
+    B = cfg["nbins"]
+    C = bins.shape[1]
+    cap = cfg["max_live_leaves"]
+    k_cols = cfg["k_cols"]
+    newton = cfg["newton"]
+    reg_lambda = cfg.get("reg_lambda", 0.0)
+    widths = frontier_plan(D, cap)
+    N = 1 + 2 * sum(widths)
+
+    # pool arrays + one trash slot at index N (empty frontier slots write
+    # there; duplicates all carry inert -1/0 payloads)
+    split_col = jnp.full((N + 1,), -1, jnp.int32)
+    bitset = jnp.zeros((N + 1, B + 1), bool)
+    value = jnp.zeros((N + 1,), jnp.float32)
+    child = jnp.full((N + 1,), -1, jnp.int32)
+    node_gain = jnp.zeros((N + 1,), jnp.float32)
+    varimp = jnp.zeros((C,), jnp.float32)
+
+    frontier = jnp.zeros((1,), jnp.int32)          # pool ids of live leaves
+    slot = slot0                                   # per-row frontier slot
+    use_mono = bool(cfg.get("use_mono")) and mono is not None
+    lo_b = jnp.full((1,), -jnp.inf, jnp.float32)
+    hi_b = jnp.full((1,), jnp.inf, jnp.float32)
+    base = 1                                       # next free pool slot
+
+    for d in range(D):                             # static unroll
+        L = widths[d]
+        hist = _shard_histogram(bins, slot, stats, L, B,
+                                cfg["block_rows"], cfg["bf16"])
+        if k_cols < C:
+            key, sub = jax.random.split(key)
+            r = jax.random.uniform(sub, (L, C))
+            kth = jnp.sort(r, axis=1)[:, k_cols - 1][:, None]
+            col_allowed = r <= kth
+        else:
+            col_allowed = jnp.ones((L, C), bool)
+        if tree_col_mask is not None:
+            col_allowed = col_allowed & tree_col_mask[None, :]
+        s = find_splits(hist, is_cat, col_allowed,
+                        min_rows=cfg["min_rows"],
+                        min_split_improvement=cfg["min_split_improvement"],
+                        mono=mono, use_mono=use_mono, newton=newton,
+                        reg_lambda=reg_lambda)
+        live = s["leaf"]["w"] > 0
+        do_split = s["do_split"] & live
+        term = live & ~do_split
+        leaf_vals = _node_val(s["leaf"]["wg"], s["leaf"]["wh"],
+                              s["leaf"]["w"], newton, reg_lambda)
+        lvals = _node_val(s["left"]["wg"], s["left"]["wh"],
+                          s["left"]["w"], newton, reg_lambda)
+        rvals = _node_val(s["right"]["wg"], s["right"]["wh"],
+                          s["right"]["w"], newton, reg_lambda)
+        if use_mono:
+            leaf_vals = jnp.clip(leaf_vals, lo_b, hi_b)
+            lvals = jnp.clip(lvals, lo_b, hi_b)
+            rvals = jnp.clip(rvals, lo_b, hi_b)
+            m = mono[s["col"]].astype(jnp.float32)
+            mid = 0.5 * (lvals + rvals)
+            l_hi = jnp.where(m > 0, jnp.minimum(hi_b, mid), hi_b)
+            r_lo = jnp.where(m > 0, jnp.maximum(lo_b, mid), lo_b)
+            l_lo = jnp.where(m < 0, jnp.maximum(lo_b, mid), lo_b)
+            r_hi = jnp.where(m < 0, jnp.minimum(hi_b, mid), hi_b)
+            lo_c = jnp.stack([l_lo, r_lo], axis=1).reshape(2 * L)
+            hi_c = jnp.stack([l_hi, r_hi], axis=1).reshape(2 * L)
+
+        varimp = varimp.at[s["col"]].add(
+            jnp.where(do_split, jnp.maximum(s["gain"], 0.0), 0.0))
+        # write this level's frontier nodes into the pool (scatter at
+        # traced pool ids; trash-slot writes are inert)
+        gain_pos = jnp.where(do_split, jnp.maximum(s["gain"], 0.0), 0.0)
+        child_ptr = base + 2 * jnp.arange(L, dtype=jnp.int32)
+        split_col = split_col.at[frontier].set(
+            jnp.where(do_split, s["col"], -1))
+        bitset = bitset.at[frontier].set(s["bitset"] & do_split[:, None])
+        value = value.at[frontier].set(jnp.where(term, leaf_vals, 0.0))
+        child = child.at[frontier].set(jnp.where(do_split, child_ptr, -1))
+        node_gain = node_gain.at[frontier].set(gain_pos)
+        # pre-write child values at their (fresh, contiguous) pool slots
+        cvals = jnp.stack([lvals, rvals], axis=1).reshape(2 * L)
+        cmask = jnp.repeat(do_split, 2)
+        value = jax.lax.dynamic_update_slice(
+            value, jnp.where(cmask, cvals, 0.0), (base,))
+
+        if d + 1 < D:
+            L_next = widths[d + 1]
+            # best-first frontier selection: keep the children with the
+            # most residual impurity; the rest are finished leaves
+            se_l = s["left"]["wgg"] - s["left"]["wg"] ** 2 / \
+                jnp.maximum(s["left"]["w"], EPS)
+            se_r = s["right"]["wgg"] - s["right"]["wg"] ** 2 / \
+                jnp.maximum(s["right"]["w"], EPS)
+            cse = jnp.stack([se_l, se_r], axis=1).reshape(2 * L)
+            ckey = jnp.where(cmask, jnp.maximum(cse, 0.0), -jnp.inf)
+            if 2 * L <= L_next:
+                sel = jnp.arange(2 * L, dtype=jnp.int32)  # identity: dense
+            else:
+                _, sel = jax.lax.top_k(ckey, L_next)
+                sel = sel.astype(jnp.int32)
+            sel_valid = jnp.take(ckey, sel) > -jnp.inf
+            frontier = jnp.where(sel_valid, base + sel, N)
+            inv = jnp.full((2 * L,), -1, jnp.int32).at[sel].set(
+                jnp.where(sel_valid,
+                          jnp.arange(L_next, dtype=jnp.int32), -1))
+            # route rows: split-parent rows follow the bitset to a child;
+            # rows whose child fell off the frontier finalize (-1)
+            active = slot >= 0
+            sl = jnp.maximum(slot, 0)
+            c = s["col"][sl]
+            b = jnp.take_along_axis(bins, c[:, None], axis=1)[:, 0]
+            go_left = s["bitset"][sl, b]
+            cand = 2 * sl + jnp.where(go_left, 0, 1)
+            new_slot = jnp.where(active & do_split[sl], inv[cand], -1)
+            slot = jnp.where(active, new_slot, slot)
+            if use_mono:
+                lo_b = jnp.take(lo_c, sel)
+                hi_b = jnp.take(hi_c, sel)
+        base += 2 * L
+
+    return (split_col[:N], bitset[:N], value[:N], child[:N], varimp,
+            node_gain[:N])
+
+
+def _tree_predict(bins, split_col, bitset, value, D: int, child=None):
+    """Descend one tree for all rows (traceable).  ``child`` None = dense
+    heap (children at 2n+1/2n+2), else explicit left-child pointers."""
     R = bins.shape[0]
     node = jnp.zeros((R,), jnp.int32)
     for _ in range(D):
@@ -180,18 +368,24 @@ def _tree_predict(bins, split_col, bitset, value, D: int):
         b = jnp.take_along_axis(bins, jnp.maximum(c, 0)[:, None],
                                 axis=1)[:, 0]
         go_left = bitset[node, b]
-        nxt = 2 * node + jnp.where(go_left, 1, 2)
+        if child is None:
+            nxt = 2 * node + jnp.where(go_left, 1, 2)
+        else:
+            left = child[node]
+            term = term | (left < 0)
+            nxt = left + jnp.where(go_left, 0, 1)
         node = jnp.where(term, node, nxt)
     return value[node]
 
 
 class TrainedForest(NamedTuple):
-    split_col: jax.Array   # (T, K, H)
-    bitset: jax.Array      # (T, K, H, B+1)
-    value: jax.Array       # (T, K, H)
+    split_col: jax.Array   # (T, K, N)
+    bitset: jax.Array      # (T, K, N, B+1)
+    value: jax.Array       # (T, K, N)
     f_final: jax.Array     # (R, K) link-scale training predictions
     varimp: jax.Array      # (C,) summed split-gain importance
-    node_gain: jax.Array   # (T, K, H) per-split gain (FeatureInteraction)
+    node_gain: jax.Array   # (T, K, N) per-split gain (FeatureInteraction)
+    child: object = None   # (T, K, N) left-child pool ptrs; None = dense
 
 
 @functools.partial(
@@ -202,7 +396,8 @@ class TrainedForest(NamedTuple):
                      "min_split_improvement", "block_rows", "bf16",
                      "mode", "tweedie_power", "quantile_alpha",
                      "huber_alpha", "reg_lambda",
-                     "col_sample_rate_per_tree", "use_mono"))
+                     "col_sample_rate_per_tree", "use_mono",
+                     "kleaves"))
 def train_forest(bins, yv, w, active, F0, is_cat, key, *, dist_name: str,
                  K: int, ntrees: int, max_depth: int, nbins: int,
                  k_cols: int, newton: bool, sample_rate: float,
@@ -214,19 +409,21 @@ def train_forest(bins, yv, w, active, F0, is_cat, key, *, dist_name: str,
                  huber_alpha: float = 0.9, reg_lambda: float = 0.0,
                  col_sample_rate_per_tree: float = 1.0,
                  mono=None, use_mono: bool = False,
-                 t0: int = 0) -> TrainedForest:
+                 t0: int = 0, kleaves: int = 0) -> TrainedForest:
     """The WHOLE forest training loop as one XLA program.
 
     mode="gbm": boosting — stats from distribution gradients at current F,
     f updated after each iteration, leaf values scaled by learn_rate.
     mode="drf": bagging — stats fixed on the response, no f update (F output
     accumulates raw votes; caller divides by ntrees).
+    kleaves=0: dense heap engine; >0: sparse-frontier engine with that
+    live-leaf cap (module docstring).
     """
     cfg = dict(max_depth=max_depth, nbins=nbins, k_cols=k_cols,
                newton=newton, min_rows=min_rows,
                min_split_improvement=min_split_improvement,
                block_rows=block_rows, bf16=bf16, reg_lambda=reg_lambda,
-               use_mono=use_mono)
+               use_mono=use_mono, max_live_leaves=kleaves)
     R = bins.shape[0]
 
     def stats_for(kcls, F):
@@ -271,27 +468,42 @@ def train_forest(bins, yv, w, active, F0, is_cat, key, *, dist_name: str,
             if mode == "gbm" else 1.0
         if mode == "gbm" and dist_name == "multinomial":
             scale = scale * (K - 1) / K
-        scs, bss, vls, preds, vis, gns = [], [], [], [], [], []
+        scs, bss, vls, chs, preds, vis, gns = [], [], [], [], [], [], []
         for kcls in range(K):                    # static unroll over classes
             kc, kk = jax.random.split(kc)
             stats = stats_for(kcls, F)
-            sc, bs, vl, vi, gn = build_tree_traced(bins, stats, leaf0, kk,
-                                                   is_cat, cfg, tree_cols,
-                                                   mono=mono)
+            if kleaves > 0:
+                sc, bs, vl, ch, vi, gn = build_tree_frontier(
+                    bins, stats, leaf0, kk, is_cat, cfg, tree_cols,
+                    mono=mono)
+            else:
+                sc, bs, vl, vi, gn = build_tree_traced(
+                    bins, stats, leaf0, kk, is_cat, cfg, tree_cols,
+                    mono=mono)
+                ch = None
             vl = vl * scale
             scs.append(sc)
             bss.append(bs)
             vls.append(vl)
+            chs.append(ch)
             vis.append(vi)
             gns.append(gn)
-            preds.append(_tree_predict(bins, sc, bs, vl, max_depth))
+            preds.append(_tree_predict(bins, sc, bs, vl, max_depth,
+                                       child=ch))
         F = F + jnp.stack(preds, axis=1)
-        return F, (jnp.stack(scs), jnp.stack(bss), jnp.stack(vls),
-                   sum(vis), jnp.stack(gns))
+        out = (jnp.stack(scs), jnp.stack(bss), jnp.stack(vls),
+               sum(vis), jnp.stack(gns))
+        if kleaves > 0:
+            out = out + (jnp.stack(chs),)
+        return F, out
 
     keys = jax.random.split(key, ntrees)
     # t0 is a TRACED scalar (not static): per-block calls with varying tree
     # offsets reuse one compiled program
     ts = jnp.arange(ntrees, dtype=jnp.float32) + jnp.float32(t0)
-    F_final, (sc, bs, vl, vi, gn) = jax.lax.scan(tree_step, F0, (ts, keys))
-    return TrainedForest(sc, bs, vl, F_final, jnp.sum(vi, axis=0), gn)
+    F_final, outs = jax.lax.scan(tree_step, F0, (ts, keys))
+    if kleaves > 0:
+        sc, bs, vl, vi, gn, ch = outs
+    else:
+        (sc, bs, vl, vi, gn), ch = outs, None
+    return TrainedForest(sc, bs, vl, F_final, jnp.sum(vi, axis=0), gn, ch)

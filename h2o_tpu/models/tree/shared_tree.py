@@ -213,11 +213,13 @@ def find_splits(hist, is_cat, col_allowed, min_rows: float = 10.0,
         return base + jnp.where(na_left, na[li, col], 0.0)
 
     lw, lwg, lwh = pick(cw, naw), pick(cwg, nawg), pick(cwh, nawh)
+    lwgg = pick(cwgg, nawgg)
     leaf_stats = dict(w=tot_w[li, col], wg=tot_wg[li, col],
-                      wh=tot_wh[li, col])
-    left_stats = dict(w=lw, wg=lwg, wh=lwh)
+                      wh=tot_wh[li, col], wgg=tot_wgg[li, col])
+    left_stats = dict(w=lw, wg=lwg, wh=lwh, wgg=lwgg)
     right_stats = dict(w=leaf_stats["w"] - lw, wg=leaf_stats["wg"] - lwg,
-                       wh=leaf_stats["wh"] - lwh)
+                       wh=leaf_stats["wh"] - lwh,
+                       wgg=leaf_stats["wgg"] - lwgg)
     return dict(do_split=do_split, gain=best_gain, col=col, bitset=bitset,
                 leaf=leaf_stats, left=left_stats, right=right_stats)
 
@@ -241,25 +243,33 @@ def _advance_leaves(bins, leaf, do_split, col, bitset):
 # ---------------------------------------------------------------------------
 
 class Forest(NamedTuple):
-    """Stacked compressed trees: (T, K, H) heap arrays, H = 2^(D+1)-1."""
+    """Stacked compressed trees: (T, K, N) node arrays.  ``child`` None =
+    dense heap (children at 2n+1/2n+2), else left-child pool pointers
+    (right = left+1) from the sparse-frontier engine."""
     split_col: jax.Array   # int32, -1 = terminal
-    bitset: jax.Array      # bool (T, K, H, B+1) — left membership
+    bitset: jax.Array      # bool (T, K, N, B+1) — left membership
     value: jax.Array       # f32 node value (terminal prediction)
     depth: int
     nbins: int
+    child: object = None   # int32 (T, K, N) or None
 
 
 @functools.partial(jax.jit, static_argnames=("depth",))
-def forest_score(bins, split_col, bitset, value, depth: int):
+def forest_score(bins, split_col, bitset, value, depth: int, child=None):
     """Sum of tree outputs per (row, k-slot): bins (R,C) -> (R, K).
 
     Descends all T*K trees over D steps; terminal nodes self-loop (col=-1).
+    ``child`` selects the node layout (Forest docstring).
     """
     T, K, H = split_col.shape
     R = bins.shape[0]
 
     def one_tree(carry, tk):
-        sc, bs, vl = tk                       # (H,), (H,B+1), (H,)
+        if child is None:
+            sc, bs, vl = tk                   # (H,), (H,B+1), (H,)
+            ch = None
+        else:
+            sc, bs, vl, ch = tk
         node = jnp.zeros((R,), jnp.int32)
         for _ in range(depth):
             c = sc[node]
@@ -267,21 +277,39 @@ def forest_score(bins, split_col, bitset, value, depth: int):
             b = jnp.take_along_axis(bins, jnp.maximum(c, 0)[:, None],
                                     axis=1)[:, 0]
             go_left = bs[node, b]
-            nxt = 2 * node + jnp.where(go_left, 1, 2)
+            if ch is None:
+                nxt = 2 * node + jnp.where(go_left, 1, 2)
+            else:
+                left = ch[node]
+                term = term | (left < 0)
+                nxt = left + jnp.where(go_left, 0, 1)
             node = jnp.where(term, node, nxt)
         return carry, vl[node]
 
-    _, vals = jax.lax.scan(one_tree, 0,
-                           (split_col.reshape(T * K, H),
-                            bitset.reshape(T * K, H, -1),
-                            value.reshape(T * K, H)))
+    xs = (split_col.reshape(T * K, H),
+          bitset.reshape(T * K, H, -1),
+          value.reshape(T * K, H))
+    if child is not None:
+        xs = xs + (child.reshape(T * K, H),)
+    _, vals = jax.lax.scan(one_tree, 0, xs)
     # vals: (T*K, R) -> sum per k slot
     return jnp.sum(vals.reshape(T, K, R), axis=0).T        # (R, K)
 
 
+def forest_score_out(bins, out: Dict, depth: int = None) -> jax.Array:
+    """forest_score over a model-output dict (handles both node layouts;
+    models saved before the frontier engine have no "child" key)."""
+    ch = out.get("child")
+    return forest_score(
+        bins, jnp.asarray(out["split_col"]), jnp.asarray(out["bitset"]),
+        jnp.asarray(out["value"]),
+        int(depth if depth is not None else out["max_depth"]),
+        child=jnp.asarray(ch) if ch is not None else None)
+
+
 def forest_predict_frame(forest: Forest, binned_bins) -> jax.Array:
     return forest_score(binned_bins, forest.split_col, forest.bitset,
-                        forest.value, forest.depth)
+                        forest.value, forest.depth, child=forest.child)
 
 
 # ---------------------------------------------------------------------------

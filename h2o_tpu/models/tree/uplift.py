@@ -254,6 +254,10 @@ class UpliftDRF(ModelBuilder):
         elif mtries <= 0:
             mtries = C
         depth = min(int(p["max_depth"]), 12)
+        if depth != int(p["max_depth"]):
+            job.warn(f"max_depth={p['max_depth']} exceeds the uplift "
+                     f"engine's dense-heap limit; trees were built to "
+                     f"depth {depth}")
         T = int(p["ntrees"])
         job.update(0.1, f"training {T} uplift trees")
         sc, bs, vt, vc = _train_uplift_forest(

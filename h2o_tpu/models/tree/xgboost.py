@@ -190,7 +190,7 @@ class XGBoost(GBM):
         x_cols = [c for c in (x or train.names)
                   if c != y and c != "__dart_offset__"]
         R = train.nrows
-        scs, bss, vls, preds = [], [], [], []
+        scs, bss, vls, chs, preds = [], [], [], [], []
         scale: list = []
         base_out = None
         bins = None
@@ -214,9 +214,10 @@ class XGBoost(GBM):
                               list(train.vecs) + [_Vec(off)])
                 self.params["offset_column"] = "__dart_offset__"
                 m = super()._fit(job, x_cols, y, work, None)
-                sc = np.asarray(m.output["split_col"])   # (1, K, H)
+                sc = np.asarray(m.output["split_col"])   # (1, K, N)
                 bs = np.asarray(m.output["bitset"])
                 vl = np.asarray(m.output["value"])
+                ch = m.output.get("child")
                 if base_out is None:
                     base_out = m.output
                     bins = st._bin_all(
@@ -227,7 +228,9 @@ class XGBoost(GBM):
                 Fnew = np.asarray(st.forest_score(
                     bins, jnp.asarray(sc), jnp.asarray(bs),
                     jnp.asarray(vl),
-                    int(m.output["max_depth"])))[: R, 0]
+                    int(m.output["max_depth"]),
+                    child=jnp.asarray(ch)
+                    if ch is not None else None))[: R, 0]
                 k = len(k_idx)
                 if k:
                     # normalize_type="tree": new tree 1/(k+1); dropped
@@ -239,6 +242,8 @@ class XGBoost(GBM):
                 scs.append(sc)
                 bss.append(bs)
                 vls.append(vl)
+                if ch is not None:
+                    chs.append(np.asarray(ch))
                 preds.append(Fnew)
                 scale.append(1.0)
                 job.update(0.05 + 0.9 * (t + 1) / ntrees,
@@ -251,6 +256,7 @@ class XGBoost(GBM):
         out["bitset"] = np.concatenate(bss)
         out["value"] = np.concatenate(
             [v * np.float32(s) for v, s in zip(vls, scale)])
+        out["child"] = np.concatenate(chs) if chs else None
         out["node_gain"] = None
         out["ntrees_actual"] = ntrees
         model = self.model_cls(self.model_id, dict(p_all), out)

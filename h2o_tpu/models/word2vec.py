@@ -160,6 +160,10 @@ class Word2Vec(ModelBuilder):
 
     def _fit(self, job, x, y, train: Frame, valid: Optional[Frame]):
         p = self.params
+        job.warn("word2vec trains skip-gram with negative sampling on "
+                 "this engine (the reference's hierarchical softmax is "
+                 "replaced; embeddings are equivalent quality, not "
+                 "bit-identical)")
         toks = _tokens_of(train)
         rng = np.random.default_rng(
             int(p.get("seed") or -1) if int(p.get("seed") or -1) >= 0

@@ -63,19 +63,24 @@ def _bitset_bytes(rightset: np.ndarray) -> bytes:
 
 
 class _TreeEncoder:
-    """One (tree, class) heap -> genmodel bytecode + aux blob.
+    """One (tree, class) node array -> genmodel bytecode + aux blob.
 
-    Heap convention (jit_engine.build_tree_traced): node n has children
-    2n+1 (left) / 2n+2 (right); split_col[n] < 0 marks a leaf holding
-    value[n]; bitset[n, b] = True routes bin b LEFT; bit B is the NA bucket.
+    Node layout: dense heap (jit_engine.build_tree_traced — node n has
+    children 2n+1/2n+2, ``child`` None) or sparse-frontier pool
+    (build_tree_frontier — ``child[n]`` is the left-child id, right =
+    left+1).  split_col[n] < 0 marks a leaf holding value[n];
+    bitset[n, b] = True routes bin b LEFT; bit B is the NA bucket.
     """
+
+    child = None     # dense heap unless an instance carries pool pointers
 
     def __init__(self, split_col, bitset, value, split_points, is_cat,
                  cardinalities, leaf_offset: float = 0.0,
-                 leaf_transform=None):
+                 leaf_transform=None, child=None):
         self.split_col = np.asarray(split_col)
         self.bitset = np.asarray(bitset)
         self.value = np.asarray(value, np.float32)
+        self.child = np.asarray(child) if child is not None else None
         self.split_points = split_points          # (C, B-1) float, NaN-pad
         self.is_cat = is_cat
         self.cards = cardinalities                # per-column cardinality
@@ -84,8 +89,16 @@ class _TreeEncoder:
         self.leaf_transform = leaf_transform
         self._size_cache: Dict[int, int] = {}
 
+    def _left(self, n: int) -> int:
+        return 2 * n + 1 if self.child is None else int(self.child[n])
+
+    def _right(self, n: int) -> int:
+        return 2 * n + 2 if self.child is None else int(self.child[n]) + 1
+
     def _is_leaf(self, n: int) -> bool:
-        return n >= self.H or self.split_col[n] < 0
+        if n < 0 or n >= self.H or self.split_col[n] < 0:
+            return True
+        return self.child is not None and self.child[n] < 0
 
     def _leaf_val(self, n: int) -> float:
         v = np.float32(self.value[n]) + self.leaf_offset
@@ -123,12 +136,12 @@ class _TreeEncoder:
             return self._size_cache[n]
         equal, _na, payload = self._split_parts(n)
         sz = 1 + 2 + 1 + len(payload)       # type + colId + naDir + payload
-        lsz = self._size(2 * n + 1)
+        lsz = self._size(self._left(n))
         sz += lsz
-        if not self._is_leaf(2 * n + 1):
+        if not self._is_leaf(self._left(n)):
             sz += 1 + (0 if lsz < 256 else
                        (1 if lsz < 65535 else (2 if lsz < (1 << 24) else 3)))
-        sz += self._size(2 * n + 2)
+        sz += self._size(self._right(n))
         self._size_cache[n] = sz
         return sz
 
@@ -146,14 +159,15 @@ class _TreeEncoder:
     def _n_decided(self, n: int) -> int:
         if self._is_leaf(n):
             return 0
-        return 1 + self._n_decided(2 * n + 1) + self._n_decided(2 * n + 2)
+        return 1 + self._n_decided(self._left(n)) + \
+            self._n_decided(self._right(n))
 
     def _encode_node(self, n: int, ab: io.BytesIO, aux: io.BytesIO):
         if self._is_leaf(n):
             ab.write(struct.pack("<f", self._leaf_val(n)))
             return
         equal, na_dir, payload = self._split_parts(n)
-        left, right = 2 * n + 1, 2 * n + 2
+        left, right = self._left(n), self._right(n)
         lsz = self._size(left)
         node_type = equal
         if self._is_leaf(left):
@@ -275,9 +289,10 @@ def write_tree_mojo(model) -> bytes:
     dom_map = out.get("domains") or {}
     resp_dom = out.get("response_domain")
     nclass = len(resp_dom) if resp_dom else 1
-    sc = np.asarray(out["split_col"])          # (T, K, H)
+    sc = np.asarray(out["split_col"])          # (T, K, N)
     bs = np.asarray(out["bitset"])
     vl = np.asarray(out["value"])
+    ch = np.asarray(out["child"]) if out.get("child") is not None else None
     T, K, H = sc.shape
     sp = np.asarray(out["split_points"])
     is_cat = np.asarray(out["is_cat"], bool)
@@ -326,7 +341,8 @@ def write_tree_mojo(model) -> bytes:
                 transform = lambda v: 1.0 - v  # noqa: E731
             enc = _TreeEncoder(sc[t, k], bs[t, k], vl[t, k], sp, is_cat,
                                cards, leaf_offset=offset,
-                               leaf_transform=transform)
+                               leaf_transform=transform,
+                               child=ch[t, k] if ch is not None else None)
             blob, aux = enc.encode()
             w.writeblob(f"trees/t{k:02d}_{t:03d}.bin", blob)
             w.writeblob(f"trees/t{k:02d}_{t:03d}_aux.bin", aux)
@@ -343,21 +359,13 @@ def _glm_mojo_prep(model):
     cards = list(spec["cat_cards"])
     uafl = bool(spec["use_all_factor_levels"])
     means = np.asarray(spec["means"], np.float64)
-    sigmas = np.asarray(spec["sigmas"], np.float64)
-    n_cat_coef = sum(c - (0 if uafl else 1) for c in cards)
 
     def destandardize(beta_row):
-        """[cats..., nums..., b0] standardized -> raw-space flat list."""
-        beta_row = np.asarray(beta_row, np.float64)
-        cat_beta = beta_row[:n_cat_coef]
-        num_beta = beta_row[n_cat_coef:-1].copy()
-        intercept = float(beta_row[-1])
-        if spec["standardize"] and len(num_beta):
-            sig = np.where(sigmas == 0, 1.0, sigmas)
-            intercept -= float(np.sum(num_beta * means / sig))
-            num_beta = num_beta / sig
-        return ([float(v) for v in cat_beta] +
-                [float(v) for v in num_beta] + [intercept])
+        """[cats..., nums..., b0] standardized -> raw-space flat list
+        (the same affine inverse the coefficient table uses)."""
+        from h2o_tpu.models.glm import _destandardize as _glm_destd
+        raw, _ = _glm_destd(spec, np.asarray(beta_row, np.float64))
+        return [float(v) for v in raw]
 
     cat_offsets = [0]
     for c in cards:
@@ -393,10 +401,18 @@ def write_glm_mojo(model) -> bytes:
     out = model.output
     if out.get("is_multinomial"):
         return _write_glm_multinomial_mojo(model)
+    if out.get("is_ordinal"):
+        # genmodel's ordinal byte format (GlmOrdinalMojoModel) is not
+        # implemented; the npz MOJO (mojo/__init__.py) covers ordinal
+        raise NotImplementedError(
+            "genmodel-spec MOJO export for family='ordinal' is not "
+            "implemented; use the npz MOJO (export_mojo) instead")
     p = _glm_mojo_prep(model)
     fam = out.get("family_resolved", "gaussian")
     link = {"binomial": "logit", "quasibinomial": "logit",
+            "fractionalbinomial": "logit",
             "gaussian": "identity", "poisson": "log", "gamma": "log",
+            "negativebinomial": "log",
             "tweedie": "tweedie"}.get(fam, "identity")
     resp_dom = out.get("response_domain")
     nclass = len(resp_dom) if resp_dom else 1

@@ -6,13 +6,13 @@ Reference: the h2o-3 POJO codegen emits a standalone Java class per model
 (`water/api/ModelsHandler.java` fetchJavaCode; h2o-py h2o.download_pojo,
 h2o.py:1868).
 
-The TPU rebuild stores trees as fixed-shape heap arrays (split_col /
-bitset / value per node, models/tree/jit_engine.py) rather than
-CompressedTree bytecode, so the generator walks the heap directly: node n
-has children 2n+1 / 2n+2, split_col[n] < 0 is a leaf, bitset[n, b] routes
-bin b LEFT with bit B the NA bucket, and numeric prefix-bitsets lower to
-float thresholds exactly like the MOJO encoder (mojo/genmodel.py
-_TreeEncoder._split_parts).
+The TPU rebuild stores trees as node arrays (split_col / bitset / value
+per node, models/tree/jit_engine.py) rather than CompressedTree bytecode,
+so the generator walks them directly: node n's children are 2n+1 / 2n+2
+(dense heap) or child[n] / child[n]+1 (sparse-frontier pool),
+split_col[n] < 0 is a leaf, bitset[n, b] routes bin b LEFT with bit B the
+NA bucket, and numeric prefix-bitsets lower to float thresholds exactly
+like the MOJO encoder (mojo/genmodel.py _TreeEncoder._split_parts).
 """
 
 from __future__ import annotations
@@ -28,11 +28,12 @@ def _j(name: str) -> str:
 
 
 def _tree_node_java(sc, bs, vl, sp, is_cat, cards, n: int, depth: int,
-                    lines: List[str]) -> None:
+                    lines: List[str], ch=None) -> None:
     ind = "    " * (depth + 2)
     H = len(sc)
-    if n >= H or sc[n] < 0:
-        v = float(vl[n]) if n < H else 0.0
+    if n < 0 or n >= H or sc[n] < 0 or \
+            (ch is not None and ch[n] < 0):
+        v = float(vl[n]) if 0 <= n < H else 0.0
         lines.append(f"{ind}pred = {v!r}f;")
         return
     c = int(sc[n])
@@ -58,12 +59,14 @@ def _tree_node_java(sc, bs, vl, sp, is_cat, cards, n: int, depth: int,
             cond = f"Double.isNaN(data[{c}]) || ({cond})"
         else:
             cond = f"!Double.isNaN(data[{c}]) && ({cond})"
+    left = 2 * n + 1 if ch is None else int(ch[n])
+    right = 2 * n + 2 if ch is None else int(ch[n]) + 1
     lines.append(f"{ind}if ({cond}) {{")
-    _tree_node_java(sc, bs, vl, sp, is_cat, cards, 2 * n + 1, depth + 1,
-                    lines)
+    _tree_node_java(sc, bs, vl, sp, is_cat, cards, left, depth + 1,
+                    lines, ch)
     lines.append(f"{ind}}} else {{")
-    _tree_node_java(sc, bs, vl, sp, is_cat, cards, 2 * n + 2, depth + 1,
-                    lines)
+    _tree_node_java(sc, bs, vl, sp, is_cat, cards, right, depth + 1,
+                    lines, ch)
     lines.append(f"{ind}}}")
 
 
@@ -77,6 +80,7 @@ def tree_pojo(model) -> str:
     sc = np.asarray(out["split_col"])
     bs = np.asarray(out["bitset"])
     vl = np.asarray(out["value"])
+    ch = np.asarray(out["child"]) if out.get("child") is not None else None
     sp = np.asarray(out["split_points"])
     is_cat = np.asarray(out["is_cat"], bool)
     cards = [len(dom_map.get(c, [])) for c in x]
@@ -102,7 +106,8 @@ def tree_pojo(model) -> str:
                 f"  static double tree_{t}_{k}(double[] data) {{")
             lines.append("    double pred;")
             _tree_node_java(sc[t, k], bs[t, k], vl[t, k], sp, is_cat,
-                            cards, 0, 0, lines)
+                            cards, 0, 0, lines,
+                            ch[t, k] if ch is not None else None)
             lines.append("    return pred;")
             lines.append("  }")
     lines.append("  public static double[] score0(double[] data) {")

@@ -47,8 +47,10 @@ def _bin_matrix(X, split_points, is_cat, nbins: int) -> np.ndarray:
     return np.where(np.isnan(X), nbins, b)
 
 
-def _forest_score(bins, split_col, bitset, value, depth: int) -> np.ndarray:
-    """Sum of per-tree leaf values (shared_tree.forest_score in numpy)."""
+def _forest_score(bins, split_col, bitset, value, depth: int,
+                  child=None) -> np.ndarray:
+    """Sum of per-tree leaf values (shared_tree.forest_score in numpy).
+    ``child`` None = dense heap (2n+1/2n+2), else left-child pointers."""
     T, K, H = split_col.shape
     R = bins.shape[0]
     out = np.zeros((R, K), np.float64)
@@ -56,13 +58,19 @@ def _forest_score(bins, split_col, bitset, value, depth: int) -> np.ndarray:
     for t in range(T):
         for k in range(K):
             sc, bs, vl = split_col[t, k], bitset[t, k], value[t, k]
+            ch = child[t, k] if child is not None else None
             node = np.zeros(R, np.int64)
             for _ in range(depth):
                 c = sc[node]
                 term = c < 0
                 b = bins[rows, np.maximum(c, 0)]
                 go_left = bs[node, b]
-                nxt = 2 * node + np.where(go_left, 1, 2)
+                if ch is None:
+                    nxt = 2 * node + np.where(go_left, 1, 2)
+                else:
+                    left = ch[node]
+                    term = term | (left < 0)
+                    nxt = left + np.where(go_left, 0, 1)
                 node = np.where(term, node, nxt)
             out[:, k] += vl[node]
     return out
@@ -72,7 +80,8 @@ def _tree_F(arrays: Dict, meta: Dict, X) -> np.ndarray:
     bins = _bin_matrix(X, arrays["split_points"],
                        arrays["is_cat"].astype(bool), int(meta["nbins"]))
     return _forest_score(bins, arrays["split_col"], arrays["bitset"],
-                         arrays["value"], int(meta["max_depth"]))
+                         arrays["value"], int(meta["max_depth"]),
+                         child=arrays.get("child"))
 
 
 def _classify(F, dom):
@@ -146,8 +155,20 @@ def score_glm(arrays, meta, X):
     beta = arrays["beta"]
     eta = Xe @ beta[:-1] + beta[-1]
     fam = meta["family_resolved"]
-    mu = _sigmoid(eta) if fam in ("binomial", "quasibinomial") else \
-        (np.exp(eta) if fam in ("poisson", "gamma", "tweedie") else eta)
+    if meta.get("is_ordinal"):
+        # cumulative logit: P(y<=k) = sigmoid(thr_k - eta)
+        thr = arrays["ordinal_thresholds"]
+        c = _sigmoid(thr[None, :] - eta[:, None])
+        c = np.concatenate([np.zeros_like(c[:, :1]), c,
+                            np.ones_like(c[:, :1])], axis=1)
+        P = np.maximum(np.diff(c, axis=1), 0.0)
+        P = P / np.maximum(P.sum(axis=1, keepdims=True), EPS)
+        label = np.argmax(P, axis=1).astype(np.float64)
+        return np.concatenate([label[:, None], P], axis=1)
+    mu = _sigmoid(eta) if fam in ("binomial", "quasibinomial",
+                                  "fractionalbinomial") else \
+        (np.exp(eta) if fam in ("poisson", "gamma", "tweedie",
+                                "negativebinomial") else eta)
     if dom is not None:
         return np.stack([(mu >= 0.5).astype(np.float64), 1 - mu, mu],
                         axis=1)

@@ -9,7 +9,7 @@ setup(
                 "capability surface (jax/XLA compute, REST v3 API)",
     packages=find_packages(include=["h2o_tpu", "h2o_tpu.*"]),
     python_requires=">=3.10",
-    install_requires=["jax", "numpy"],
+    install_requires=["jax", "numpy", "scipy", "optax"],
     extras_require={
         "io": ["pandas", "pyarrow"],
     },

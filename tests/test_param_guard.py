@@ -44,11 +44,7 @@ ALLOWED_PERF_KNOBS = {
         # metrics are always computed (a strict superset of False)
         "compute_metrics",
     },
-    "gam": {
-        # spline family/scale per column: the engine fits one spline
-        # family; declared here until per-column bases land
-        "bs", "scale", "keep_gam_cols",
-    },
+    "gam": set(),   # bs/scale/keep_gam_cols are real now (models/gam.py)
     "aggregator": {"categorical_encoding"},
     "kmeans": set(),
     "isolationforest": set(),
@@ -109,8 +105,8 @@ def test_engine_fixed_rejects_unsupported_values(cl):
     from h2o_tpu.models.deeplearning import DeepLearning
     with pytest.raises(ValueError, match="histogram_type"):
         GBM(histogram_type="UniformAdaptive")
-    with pytest.raises(ValueError, match="compute_p_values"):
-        GLM(compute_p_values=True)
+    with pytest.raises(ValueError, match="remove_collinear_columns"):
+        GLM(remove_collinear_columns=True)
     with pytest.raises(ValueError, match="rate_decay"):
         DeepLearning(rate_decay=0.5)
     # accepted spellings pass (case/sep-insensitive)

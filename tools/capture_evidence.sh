@@ -1,0 +1,44 @@
+#!/bin/bash
+# In-round TPU perf-evidence capture (VERDICT r3 item 1b).
+#
+# Round 3 published no perf number because the TPU tunnel was wedged at the
+# driver's end-of-round bench run.  This watcher closes that hole: it probes
+# the backend cheaply in a loop and, the moment the chip answers, runs the
+# FULL bench ladder once, teeing the contract JSON to BENCH_evidence.json so
+# the round carries committed evidence no matter what the end-of-round run
+# finds.
+#
+# Usage: nohup tools/capture_evidence.sh &   (idempotent; exits once captured)
+set -u
+cd "$(dirname "$0")/.."
+LOG=${EVIDENCE_LOG:-/tmp/capture_evidence.log}
+OUT=${EVIDENCE_OUT:-BENCH_evidence.json}
+DEADLINE=$(( $(date +%s) + ${EVIDENCE_DEADLINE_S:-39600} ))   # ~11h
+
+probe() {
+    timeout "${EVIDENCE_PROBE_TIMEOUT_S:-300}" python - <<'EOF' >/dev/null 2>&1
+import jax
+d = jax.devices()
+assert d and d[0].platform != "cpu"
+EOF
+}
+
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+    if probe; then
+        echo "$(date -Is) backend healthy; running full ladder" >> "$LOG"
+        BENCH_EVIDENCE_PATH="$OUT" BENCH_INIT_RETRIES=2 \
+            timeout 3600 python bench.py >> "$LOG" 2>&1
+        if [ -s "$OUT" ] && grep -q '"value"' "$OUT" && \
+           ! grep -q '"error"' "$OUT"; then
+            echo "$(date -Is) evidence captured -> $OUT" >> "$LOG"
+            exit 0
+        fi
+        echo "$(date -Is) ladder ran but evidence incomplete; retrying" \
+            >> "$LOG"
+    else
+        echo "$(date -Is) backend unreachable; sleeping" >> "$LOG"
+    fi
+    sleep "${EVIDENCE_RETRY_S:-600}"
+done
+echo "$(date -Is) deadline reached without evidence" >> "$LOG"
+exit 1
