@@ -219,7 +219,15 @@ def post_file(params, body=None):
 
 
 def _import_one(path):
-    """Resolve a path/glob and register nfs:// keys; (files, dests)."""
+    """Resolve a path/glob and register nfs:// keys; (files, dests).
+
+    Remote URIs (http/https/s3/gcs — PersistManager schemes) register
+    as-is; the parser fetches them through core.persist at Parse time
+    (core/parse.py localize)."""
+    from h2o_tpu.core.parse import _is_remote
+    if _is_remote(path):
+        cloud().dkv.put(path, path)
+        return [path], [path]
     matches = sorted(globmod.glob(path)) if any(ch in path for ch in "*?") \
         else ([path] if os.path.exists(path) else [])
     for p in matches:

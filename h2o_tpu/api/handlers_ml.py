@@ -122,6 +122,27 @@ def grid_build(params, algo):
     return {"job": job.to_dict()}
 
 
+@route("POST", r"/99/Grid/(?P<algo>[^/]+)/resume")
+def grid_resume(params, algo):
+    """h2o.resumeGrid (R client .h2o.__GRID_RESUME(algo); reference
+    GridSearchHandler resume): continue a recovered grid's remaining
+    hyper combos from its recovery_dir snapshot, returning the async
+    job the client polls."""
+    grid_id = params.get("grid_id")
+    if not grid_id:
+        raise H2OError(400, "grid_id is required")
+    rec_dir = params.get("recovery_dir")
+    if not rec_dir:
+        raise H2OError(400, "recovery_dir is required (the grid's "
+                            "recovery snapshot location)")
+    from h2o_tpu.core.recovery import resume_grid
+    try:
+        job = resume_grid(grid_id, rec_dir)
+    except KeyError as e:
+        raise H2OError(404, str(e))
+    return {"job": job.to_dict()}
+
+
 def _grid_json(grid, sort_by: Optional[str] = None,
                decreasing: Optional[bool] = None) -> dict:
     models = grid.sorted_models(sort_by, decreasing) if sort_by \

@@ -14,7 +14,8 @@ the first-party C++ loop in h2o_tpu/native/csv_tokenizer.cpp (chunk-
 parallel, quote-aware; built on first use), with pandas' C engine as the
 fallback (``use_native=False`` or ``H2O_TPU_NATIVE_PARSE=0``).  SVMLight
 and ARFF get small host parsers; Parquet/ORC ride pyarrow and Avro a
-first-party from-spec reader (core/avro.py); XLS is rejected loudly.
+first-party from-spec reader (core/avro.py); XLS/XLSX via the
+first-party OLE2-BIFF8 / SpreadsheetML readers (core/xls.py).
 """
 
 from __future__ import annotations
@@ -60,6 +61,54 @@ class ParseSetupResult:
         }
 
 
+def _is_remote(path: str) -> bool:
+    """URI with a non-local scheme: ingest fetches it through the persist
+    byte stores (http/https built-in, s3/gcs via their registrations —
+    reference water/persist/PersistManager scheme dispatch)."""
+    return "://" in path and \
+        path.split("://", 1)[0] not in ("file", "nfs")
+
+
+def localize(path: str, max_age_s: float = 120.0) -> str:
+    """Materialize a remote object into the download cache and return
+    the local path (local paths pass through).  The cache file is keyed
+    by URI hash so one ingest's ParseSetup + Parse — which both read the
+    source — download once; entries older than ``max_age_s`` re-fetch,
+    so a later import sees upstream changes (the reference re-reads the
+    source per import).  This single chokepoint gives EVERY ingest
+    format (CSV/ARFF/SVMLight, parquet/ORC/Avro via pyarrow, XLS, the
+    native C++ tokenizer) remote support."""
+    if not _is_remote(path):
+        return path[7:] if path.startswith("file://") else path
+    import hashlib
+    import tempfile
+    import time as _time
+    base = os.path.basename(path.split("?", 1)[0]) or "remote"
+    cdir = os.path.join(tempfile.gettempdir(), "h2o_tpu_remote")
+    os.makedirs(cdir, exist_ok=True)
+    local = os.path.join(
+        cdir, hashlib.sha1(path.encode()).hexdigest()[:16] + "_" + base)
+    try:
+        fresh = _time.time() - os.path.getmtime(local) < max_age_s
+    except OSError:
+        fresh = False
+    if not fresh:
+        from h2o_tpu.core.persist import read_bytes
+        data = read_bytes(path)
+        # per-call unique temp file: concurrent REST threads fetching the
+        # same URI must not interleave writes before the atomic replace
+        fd, tmp = tempfile.mkstemp(dir=cdir, suffix=".part")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, local)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        log.info("fetched %s -> %s (%d bytes)", path, local, len(data))
+    return local
+
+
 def _open(path: str) -> io.TextIOBase:
     if path.endswith(".gz"):
         return io.TextIOWrapper(gzip.open(path, "rb"))
@@ -103,6 +152,7 @@ def parse_setup(paths: Sequence[str], sample_lines: int = 200,
 
     ``force_header`` overrides detection (the REST check_header directive:
     1 = first line is a header, -1 = first line is data)."""
+    paths = [localize(p) for p in paths]
     if paths[0].endswith((".parquet", ".pq")) or _is_parquet(paths[0]):
         import pyarrow.parquet as pq
         import pyarrow as pa
@@ -119,10 +169,8 @@ def parse_setup(paths: Sequence[str], sample_lines: int = 200,
                 types.append(T_NUM)
         return ParseSetupResult(",", True, list(sch.names), types)
     if paths[0].endswith((".xls", ".xlsx")):
-        raise NotImplementedError(
-            "XLS/XLSX ingest needs a spreadsheet reader this image does "
-            "not ship (reference: water/parser/XlsParser); export the "
-            "sheet to CSV/Parquet and re-import")
+        return parse_setup([_xls_csv_path(paths[0])], sample_lines,
+                           force_header)
     if paths[0].endswith(".orc") or _is_orc(paths[0]):
         from pyarrow import orc as _orc
         import pyarrow as pa
@@ -308,6 +356,7 @@ def parse_files(paths: Sequence[str], setup: Optional[ParseSetupResult] = None,
     # by magic/extension, ARFF by @relation header, SVMLight by extension.
     # Client-edited setup (names/types from /3/ParseSetup) applies AFTER
     # the format parser via _apply_setup_overrides.
+    paths = [localize(p) for p in paths]
     first = paths[0]
     if first.endswith((".parquet", ".pq")) or _is_parquet(first):
         fr = parse_parquet(paths, dest)
@@ -319,10 +368,9 @@ def parse_files(paths: Sequence[str], setup: Optional[ParseSetupResult] = None,
         fr = parse_avro(paths, dest)
         return _apply_setup_overrides(fr, setup, column_types)
     if first.endswith((".xls", ".xlsx")):
-        raise NotImplementedError(
-            "XLS/XLSX ingest needs a spreadsheet reader this image does "
-            "not ship (reference: water/parser/XlsParser); export the "
-            "sheet to CSV/Parquet and re-import")
+        fr = _rbind_frames([parse_xls(p) for p in paths], dest) \
+            if len(paths) > 1 else parse_xls(first, dest)
+        return _apply_setup_overrides(fr, setup, column_types)
     if first.endswith(".arff") or _looks_like_arff(first):
         fr = parse_arff(first, dest) if len(paths) == 1 else \
             _rbind_frames([parse_arff(p) for p in paths], dest)
@@ -382,6 +430,43 @@ def parse_files(paths: Sequence[str], setup: Optional[ParseSetupResult] = None,
             vecs.append(Vec(codes, T_CAT, domain=domain))
     fr = Frame(names, vecs, key=dest or os.path.basename(paths[0]))
     log.info("parsed %s: %d rows, %d cols", paths, fr.nrows, fr.ncols)
+    return fr
+
+
+def _xls_csv_path(path: str) -> str:
+    """Decode a spreadsheet ONCE per (path, mtime) into a cached temp
+    CSV — ParseSetup and Parse both read the source, and unlike CSV's
+    ~200-line sample the spreadsheet decode is whole-file."""
+    import hashlib
+    import tempfile
+    mtime = int(os.path.getmtime(path))
+    key = hashlib.sha1(f"{path}:{mtime}".encode()).hexdigest()[:16]
+    cdir = os.path.join(tempfile.gettempdir(), "h2o_tpu_xls")
+    os.makedirs(cdir, exist_ok=True)
+    out = os.path.join(cdir, key + ".csv")
+    if os.path.exists(out):
+        return out
+    from h2o_tpu.core import xls as _xls
+    rows = _xls.read_xlsx(path) if path.endswith(".xlsx") \
+        else _xls.read_xls(path)
+    if not rows:
+        raise ValueError(f"{path}: no cells in the first sheet")
+    fd, tmp = tempfile.mkstemp(dir=cdir, suffix=".part")
+    with os.fdopen(fd, "w") as f:
+        f.write(_xls.rows_to_csv(rows))
+    os.replace(tmp, out)
+    return out
+
+
+def parse_xls(path: str, dest: Optional[str] = None) -> Frame:
+    """XLS/XLSX ingest (reference water/parser/XlsParser.java): the
+    first-party readers in core/xls.py decode the first sheet's grid,
+    which then flows through the CSV path for type inference / NA /
+    domain semantics."""
+    from h2o_tpu.core.store import Key
+    fr = parse_files([_xls_csv_path(path)], dest=dest)
+    if not dest:
+        fr.key = Key(os.path.basename(path))
     return fr
 
 

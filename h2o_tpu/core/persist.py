@@ -51,10 +51,25 @@ def _split(uri: str):
     return "file", uri
 
 
+def _http_read(uri: str) -> bytes:
+    """Built-in http(s) byte store, read side (reference
+    water/persist/PersistHTTP — likewise read-only)."""
+    import urllib.request
+    req = urllib.request.Request(uri, headers={
+        "User-Agent": "h2o-tpu/persist"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return r.read()
+
+
 def read_bytes(uri: str) -> bytes:
     scheme, rest = _split(uri)
     if scheme in _SCHEMES:
         return _SCHEMES[scheme]["read"](uri)
+    if scheme in ("http", "https"):
+        return _http_read(uri)
+    if scheme == "gcs":
+        register_gcs()                 # lazy default: env-credentialed
+        return _SCHEMES["gcs"]["read"](uri)
     if scheme in ("file", "nfs"):
         with open(rest, "rb") as f:
             return f.read()
@@ -67,6 +82,13 @@ def write_bytes(uri: str, data: bytes) -> None:
     scheme, rest = _split(uri)
     if scheme in _SCHEMES:
         _SCHEMES[scheme]["write"](uri, data)
+        return
+    if scheme in ("http", "https"):
+        raise NotImplementedError(
+            "http(s):// persist is read-only (reference PersistHTTP)")
+    if scheme == "gcs":
+        register_gcs()
+        _SCHEMES["gcs"]["write"](uri, data)
         return
     if scheme in ("file", "nfs"):
         os.makedirs(os.path.dirname(rest) or ".", exist_ok=True)
@@ -174,3 +196,53 @@ def register_s3(endpoint_url: Optional[str] = None,
 
     register_scheme(scheme, reader, writer)
     log.info("registered %s:// persist backend -> %s", scheme, endpoint)
+
+
+def register_gcs(token: Optional[str] = None,
+                 endpoint_url: Optional[str] = None) -> None:
+    """Register a ``gcs://bucket/object`` byte store over the GCS JSON
+    API (reference: h2o-persist-gcs / PersistGcs.java).
+
+    Credentials: a bearer token from ``token`` or the
+    ``GOOGLE_OAUTH_ACCESS_TOKEN`` env var (how short-lived tokens reach
+    containers); public buckets work anonymously.  ``endpoint_url``
+    overrides the API host (fake-gcs-server / tests)."""
+    import urllib.parse
+    import urllib.request
+
+    endpoint = (endpoint_url or
+                os.environ.get("GCS_ENDPOINT_URL") or
+                "https://storage.googleapis.com").rstrip("/")
+
+    def _headers() -> Dict[str, str]:
+        tok = token or os.environ.get("GOOGLE_OAUTH_ACCESS_TOKEN")
+        h = {"User-Agent": "h2o-tpu/persist"}
+        if tok:
+            h["Authorization"] = f"Bearer {tok}"
+        return h
+
+    def _parts(uri: str):
+        _, rest = uri.split("://", 1)
+        bucket, _, obj = rest.partition("/")
+        return bucket, urllib.parse.quote(obj, safe="")
+
+    def reader(uri: str) -> bytes:
+        bucket, obj = _parts(uri)
+        url = f"{endpoint}/storage/v1/b/{bucket}/o/{obj}?alt=media"
+        req = urllib.request.Request(url, headers=_headers())
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.read()
+
+    def writer(uri: str, data: bytes) -> None:
+        bucket, obj = _parts(uri)
+        url = (f"{endpoint}/upload/storage/v1/b/{bucket}/o"
+               f"?uploadType=media&name={obj}")
+        hdrs = _headers()
+        hdrs["Content-Type"] = "application/octet-stream"
+        req = urllib.request.Request(url, data=data, headers=hdrs,
+                                     method="POST")
+        with urllib.request.urlopen(req, timeout=120) as r:
+            r.read()
+
+    register_scheme("gcs", reader, writer)
+    log.info("registered gcs:// persist backend -> %s", endpoint)

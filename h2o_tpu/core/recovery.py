@@ -130,3 +130,27 @@ def auto_recover(recovery_dir: str) -> List[Any]:
         else:
             log.warning("unknown recoverable kind %r", kind)
     return results
+
+
+def resume_grid(grid_id: str, recovery_dir: str):
+    """Resume ONE grid by id from its recovery snapshot, asynchronously —
+    the /99/Grid/{algo}/resume surface (R client h2o.resumeGrid).
+    Returns the async Job."""
+    from h2o_tpu.core.cloud import cloud
+    from h2o_tpu.models.grid import GridSearch
+    from h2o_tpu.models.model import Model
+
+    for info in pending_recoveries(recovery_dir):
+        if info.get("kind") != "grid" or info["job_id"] != grid_id:
+            continue
+        train = persist.load_frame(os.path.join(info["dir"], "train"))
+        done_models = []
+        for m in info["models"]:
+            mdl = Model.load(m["path"])
+            cloud().dkv.put(mdl.key, mdl)
+            done_models.append(mdl)
+        return GridSearch.resume_from_recovery(info, train, done_models,
+                                               sync=False)
+    raise KeyError(
+        f"no unfinished recovery snapshot for grid {grid_id!r} in "
+        f"{recovery_dir!r}")

@@ -54,6 +54,42 @@ def init_params(key, layer_sizes: List[int], dist: str = "uniform_adaptive"):
     return params
 
 
+def shard_params_tp(params, mesh):
+    """Tensor parallelism for the MLP over the mesh's ``model`` axis
+    (a TPU-native extension — the reference replicates DL weights per
+    node, SURVEY §2.4; rows keep sharding over ``nodes`` so training is
+    DPxTP).  Megatron-style alternation: even hidden layers shard the
+    output dim (column-parallel), odd layers the input dim
+    (row-parallel) so activations ride one psum per pair; the output
+    layer stays replicated.  XLA inserts the collectives from these
+    shardings alone.  Identity when the mesh has no model axis."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from h2o_tpu.core.cloud import MODEL_AXIS
+    m = dict(mesh.shape).get(MODEL_AXIS, 1)
+    if m <= 1:
+        return params
+    for i, layer in enumerate(params[:-1]):
+        dim = layer["W"].shape[1] if i % 2 == 0 else layer["W"].shape[0]
+        if dim % m:
+            raise ValueError(
+                f"model_parallel: hidden layer {i} dim {dim} is not "
+                f"divisible by the model-axis size {m}; pick hidden "
+                "sizes divisible by the mesh's model axis")
+    out = []
+    last = len(params) - 1
+    for i, layer in enumerate(params):
+        if i == last:
+            spec_w, spec_b = P(), P()
+        elif i % 2 == 0:
+            spec_w, spec_b = P(None, MODEL_AXIS), P(MODEL_AXIS)
+        else:
+            spec_w, spec_b = P(MODEL_AXIS, None), P()
+        out.append({"W": jax.device_put(
+            layer["W"], NamedSharding(mesh, spec_w)),
+            "b": jax.device_put(layer["b"], NamedSharding(mesh, spec_b))})
+    return out
+
+
 def mlp_forward(params, X, activation, dropout_key=None,
                 input_dropout=0.0, hidden_dropout=0.0):
     h = X
@@ -294,7 +330,11 @@ class DeepLearning(ModelBuilder):
                  use_all_factor_levels=True, autoencoder=False,
                  stopping_rounds=5, stopping_metric="AUTO",
                  stopping_tolerance=0.0, reproducible=False,
-                 export_weights_and_biases=False)
+                 export_weights_and_biases=False,
+                 # TPU extension (no reference analog — H2O replicates DL
+                 # weights per node): shard hidden layers over the mesh's
+                 # `model` axis (shard_params_tp)
+                 model_parallel=False)
         return p
 
     def _fit(self, job, x, y, train: Frame, valid: Optional[Frame]):
@@ -327,6 +367,9 @@ class DeepLearning(ModelBuilder):
         key = self.rng_key()
         key, kinit = jax.random.split(key)
         params = init_params(kinit, sizes)
+        if p.get("model_parallel"):
+            from h2o_tpu.core.cloud import cloud
+            params = shard_params_tp(params, cloud().mesh)
         zeros = jax.tree.map(jnp.zeros_like, params)
         estate = [{"W": {"eg2": z["W"], "edx2": z["W"]},
                    "b": {"eg2": z["b"], "edx2": z["b"]}} for z in zeros]

@@ -142,7 +142,10 @@ def _curvature_penalty(basis_fn, knots: np.ndarray, npts: int = 512):
     tr = np.trace(S)
     if tr > 0:
         S = S * (S.shape[0] / tr)
-    return S
+    # identity floor: curvature has a null space (constants/linears) and
+    # B-spline blocks are near-collinear with the intercept — a 0.1%
+    # ridge keeps the penalized Gram positive-definite at any scale
+    return S + 1e-3 * np.eye(S.shape[0])
 
 
 def _expand_gam(frame: Frame, gam_cols: List[str],
@@ -253,6 +256,9 @@ class GAM(ModelBuilder):
                     "drop the column or use it as a plain predictor")
             means[c] = float(vals.mean()) if len(vals) else 0.0
 
+        # full raw-input list IN TRAINING ORDER (the artifact scoring
+        # contract) — captured before the monotone exclusion below
+        input_columns = list(dict.fromkeys(list(x) + gam_cols))
         # monotone smoothers exclude their raw column from the plain
         # predictors — a free-signed linear term would break the
         # monotonicity the non-negative I-spline coefs guarantee
@@ -302,6 +308,7 @@ class GAM(ModelBuilder):
         inner = glm._fit(job, list(x) + basis_names, y, expanded, exp_valid)
 
         out = dict(gam_columns=gam_cols,
+                   input_columns=input_columns,
                    knots={c: knots_map[c] for c in gam_cols},
                    gam_col_means=means, bs_map=bs_map,
                    scale_map=scale_map,

@@ -298,9 +298,12 @@ class GridSearch:
     # -- recovery resume (Recovery.autoRecover target) ---------------------
 
     @classmethod
-    def resume_from_recovery(cls, info: Dict, train, done_models) -> Grid:
+    def resume_from_recovery(cls, info: Dict, train, done_models,
+                             sync: bool = True):
         """Rebuild the search from a Recovery snapshot and train only the
-        remaining combos (hex/faulttolerance/Recovery.java:21-86)."""
+        remaining combos (hex/faulttolerance/Recovery.java:21-86).
+        sync=False returns the async Job (the /99/Grid/{algo}/resume
+        surface the R client's h2o.resumeGrid polls)."""
         import os
         extra = info["extra"]
         gs = cls(extra["algo"], extra["hyper_params"],
@@ -315,8 +318,11 @@ class GridSearch:
         grid.hyper_values = [
             {k: m.params.get(k) for k in hyper} for m in done_models]
         cloud().dkv.put(grid.key, grid)
-        return gs.train(x=extra.get("x"), y=extra.get("y"),
-                        training_frame=train)
+        if sync:
+            return gs.train(x=extra.get("x"), y=extra.get("y"),
+                            training_frame=train)
+        return gs.train_async(x=extra.get("x"), y=extra.get("y"),
+                              training_frame=train)
 
 
 def _py(v):

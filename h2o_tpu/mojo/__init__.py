@@ -27,29 +27,67 @@ from h2o_tpu.mojo import scorers
 _FORMAT_VERSION = "1.00"
 
 
-def _flatten_arrays(output: Dict) -> (Dict[str, np.ndarray], Dict):
-    """Split model output into npz-able arrays and JSON-able metadata."""
+_SKIP_KEYS = ("training_metrics", "validation_metrics",
+              "cross_validation_metrics",
+              "cross_validation_metrics_summary", "scoring_history")
+
+
+def _flatten_arrays(output: Dict, prefix: str = "") -> \
+        (Dict[str, np.ndarray], Dict):
+    """Split model output into npz-able arrays and JSON-able metadata.
+
+    Nested dicts flatten recursively with ``parent__child`` keys (GAM's
+    per-column knots, composite models carrying an inner model's output);
+    scorers reconstruct a sub-model view with ``sub_model``."""
     arrays: Dict[str, np.ndarray] = {}
     meta: Dict[str, Any] = {}
     for k, v in output.items():
-        if k in ("training_metrics", "validation_metrics",
-                 "cross_validation_metrics",
-                 "cross_validation_metrics_summary", "scoring_history"):
+        if k in _SKIP_KEYS:
             continue
+        fk = f"{prefix}{k}"
         if isinstance(v, np.ndarray):
-            arrays[k] = v
+            arrays[fk] = v
         elif k == "weights" and isinstance(v, list):     # DL layer list
-            meta["n_layers"] = len(v)
+            meta[f"{prefix}n_layers"] = len(v)
             for i, layer in enumerate(v):
-                arrays[f"W{i}"] = np.asarray(layer["W"])
-                arrays[f"b{i}"] = np.asarray(layer["b"])
+                arrays[f"{prefix}W{i}"] = np.asarray(layer["W"])
+                arrays[f"{prefix}b{i}"] = np.asarray(layer["b"])
+        elif isinstance(v, dict):
+            try:                       # keep json-able dicts as one value
+                json.dumps(v)
+                meta[fk] = v
+            except TypeError:
+                sub_a, sub_m = _flatten_arrays(v, prefix=f"{fk}__")
+                arrays.update(sub_a)
+                meta.update(sub_m)
+        elif isinstance(v, list) and v and \
+                all(isinstance(x, dict) for x in v):
+            try:                       # e.g. RuleFit forests
+                json.dumps(v)
+                meta[fk] = v
+            except TypeError:
+                meta[f"{fk}__len"] = len(v)
+                for i, item in enumerate(v):
+                    sub_a, sub_m = _flatten_arrays(
+                        item, prefix=f"{fk}__{i}__")
+                    arrays.update(sub_a)
+                    meta.update(sub_m)
         else:
             try:
                 json.dumps(v)
-                meta[k] = v
+                meta[fk] = v
             except TypeError:
                 pass
     return arrays, meta
+
+
+def sub_model(arrays: Dict, meta: Dict, prefix: str) -> (Dict, Dict):
+    """View of a nested model's flattened arrays/meta: strips
+    ``<prefix>__`` (scorers for composite models — GAM's inner GLM)."""
+    p = prefix + "__"
+    return ({k[len(p):]: v for k, v in arrays.items()
+             if k.startswith(p)},
+            {k[len(p):]: v for k, v in meta.items() if k.startswith(p)})
 
 
 def export_mojo(model, path: str) -> str:
@@ -108,7 +146,8 @@ class MojoModel:
 
     @property
     def columns(self) -> List[str]:
-        return list(self.meta.get("x") or
+        return list(self.meta.get("input_columns") or
+                    self.meta.get("x") or
                     self._spec_columns())
 
     def _spec_columns(self) -> List[str]:

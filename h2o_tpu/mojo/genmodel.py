@@ -282,9 +282,14 @@ _GBM_DIST = {"bernoulli": ("bernoulli", "logit"),
 
 
 def write_tree_mojo(model) -> bytes:
-    """GBM/DRF model -> genmodel MOJO zip bytes."""
+    """GBM/DRF model -> genmodel MOJO zip bytes.
+
+    XGBoost/DT models are mathematically this engine's GBM/DRF trees
+    (models/tree/{xgboost,dt}.py), so they export in those byte formats —
+    a real genmodel jar scores them as gbm/drf (the reference's xgboost
+    MOJO wraps a native booster blob that has no TPU analog)."""
     out = model.output
-    algo = model.algo
+    algo = {"xgboost": "gbm", "dt": "drf"}.get(model.algo, model.algo)
     x = list(out["x"])
     dom_map = out.get("domains") or {}
     resp_dom = out.get("response_domain")
@@ -956,7 +961,7 @@ def write_genmodel_mojo(model) -> bytes:
             "preprocessing; the genmodel artifact cannot carry the "
             "encoder step — score through the cluster, or retrain "
             "without preprocessing for a standalone MOJO")
-    if model.algo in ("gbm", "drf"):
+    if model.algo in ("gbm", "drf", "xgboost", "dt"):
         return write_tree_mojo(model)
     if model.algo == "glm":
         return write_glm_mojo(model)

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from h2o_tpu.core.cloud import cloud
-from h2o_tpu.core.frame import Frame, T_CAT, T_NUM, T_STR, Vec
+from h2o_tpu.core.frame import Frame, T_CAT, T_NUM, T_STR, T_TIME, Vec
 
 # ---------------------------------------------------------------------------
 # parser (Rapids.java grammar: ( fun args... ), [num list], 'str', ids)
@@ -164,8 +164,44 @@ def _string_compare(op, a, b):
     return Frame(list(fr.names), vecs)
 
 
-def _elementwise(op, a, b=None):
-    """Apply a jnp op over frames/scalars, broadcasting column-wise."""
+def _has_time(x) -> bool:
+    return isinstance(x, Frame) and any(v.type == T_TIME for v in x.vecs)
+
+
+def _elementwise(op, a, b=None, name=None):
+    """Apply a jnp op over frames/scalars, broadcasting column-wise.
+
+    Binary ops that involve a T_TIME column run on the exact float64
+    host copies instead of the f32 device payload (epoch-ms rounding,
+    see _NP_BINOPS note)."""
+    if b is not None and name in _NP_BINOPS and \
+            (_has_time(a) or _has_time(b)):
+        npop = _NP_BINOPS[name]
+
+        def col(x, i):
+            if not isinstance(x, Frame):
+                return x
+            v = x.vecs[i if x.ncols > 1 else 0]
+            return np.asarray(v.to_numpy(), np.float64)
+
+        def is_time(x, i):
+            return isinstance(x, Frame) and \
+                x.vecs[i if x.ncols > 1 else 0].type == T_TIME
+
+        af, bf = isinstance(a, Frame), isinstance(b, Frame)
+        base = a if (af and (not bf or a.ncols >= b.ncols)) else b
+        n = base.ncols
+        nrows = base.nrows
+        vecs = []
+        for i in range(n):
+            res = npop(col(a, i), col(b, i))
+            # time ± number stays a time (epoch-scale values must keep
+            # the exact f64 host copy); time - time is a duration
+            keep_time = name in ("+", "-") and \
+                (is_time(a, i) != is_time(b, i))
+            vecs.append(Vec(res, T_TIME, nrows=nrows) if keep_time
+                        else Vec(res, nrows=nrows))
+        return Frame(list(base.names), vecs)
     if b is None:
         fr = _as_frame(a)
         vecs = [Vec(op(v.as_float()), nrows=fr.nrows) for v in fr.vecs]
@@ -269,6 +305,23 @@ _BINOPS = {
     "|": lambda a, b: ((a != 0) | (b != 0)).astype(jnp.float32),
 }
 
+# numpy twins for the exact-f64 host path: T_TIME epoch-ms exceeds f32
+# precision (~4 min ulp at 2026 epochs), so arithmetic/comparisons that
+# touch a time column run on the exact host copy (Vec.to_numpy)
+_NP_BINOPS = {
+    "+": np.add, "-": np.subtract, "*": np.multiply, "/": np.divide,
+    "^": np.power, "%": np.mod, "%%": np.mod,
+    "intDiv": np.floor_divide,
+    "<": lambda a, b: (a < b).astype(np.float64),
+    "<=": lambda a, b: (a <= b).astype(np.float64),
+    ">": lambda a, b: (a > b).astype(np.float64),
+    ">=": lambda a, b: (a >= b).astype(np.float64),
+    "==": lambda a, b: (a == b).astype(np.float64),
+    "!=": lambda a, b: (a != b).astype(np.float64),
+    "&": lambda a, b: ((a != 0) & (b != 0)).astype(np.float64),
+    "|": lambda a, b: ((a != 0) | (b != 0)).astype(np.float64),
+}
+
 _UNOPS = {
     "abs": jnp.abs, "exp": jnp.exp, "log": jnp.log, "log10": jnp.log10,
     "log2": jnp.log2, "log1p": jnp.log1p, "sqrt": jnp.sqrt,
@@ -369,7 +422,7 @@ def _eval(node, env: _Env):
                                  b[0] == "str" else b)
             if sc is not None:
                 return sc
-        return _elementwise(_BINOPS[op], a, b)
+        return _elementwise(_BINOPS[op], a, b, name=op)
     if op in _UNOPS:
         return _elementwise(_UNOPS[op], _eval(node[1], env))
     if op in ("sumNA", "minNA", "maxNA", "meanNA", "medianNA", "sdNA",

@@ -46,6 +46,11 @@ def pytest_configure(config):
         "markers",
         "shared_dkv: module keeps DKV state across tests "
         "(module-scoped fixtures); per-test leak purge disabled")
+    config.addinivalue_line(
+        "markers",
+        "slow: compile-heavy suite (multi-minute on the 1-core CPU "
+        "mesh).  Fast tier: pytest -m 'not slow' (~minutes); the full "
+        "default run stays the release gate")
 
 
 _TEST_COUNTER = {"n": 0}

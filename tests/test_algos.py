@@ -4,6 +4,8 @@ import numpy as np
 import pytest
 
 
+pytestmark = pytest.mark.slow   # compile-heavy (conftest tier doc)
+
 def _frame_from(X, y=None, y_domain=None):
     from h2o_tpu.core.frame import Frame, Vec, T_CAT
     names = [f"x{j}" for j in range(X.shape[1])]

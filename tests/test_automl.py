@@ -7,6 +7,8 @@ import pytest
 from h2o_tpu.core.frame import Frame, Vec, T_CAT
 
 
+pytestmark = pytest.mark.slow   # compile-heavy (conftest tier doc)
+
 @pytest.fixture()
 def bin_frame(rng):
     n = 2000

@@ -8,6 +8,8 @@ import pytest
 from h2o_tpu.core.frame import Frame, Vec, T_CAT
 
 
+pytestmark = pytest.mark.slow   # compile-heavy (conftest tier doc)
+
 @pytest.fixture(autouse=True)
 def _reset_chaos():
     from h2o_tpu.core import chaos

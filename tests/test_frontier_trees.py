@@ -24,6 +24,8 @@ from h2o_tpu.models.tree.jit_engine import (frontier_plan, plan_engine,
                                             pool_size, train_forest)
 
 
+pytestmark = pytest.mark.slow   # compile-heavy (conftest tier doc)
+
 def _binned(R=2560, C=6, B=16, seed=0):   # R divisible by the 8-dev mesh
     rng = np.random.default_rng(seed)
     bins = jnp.asarray(rng.integers(0, B, size=(R, C)), jnp.int32)

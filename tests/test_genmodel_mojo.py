@@ -14,6 +14,8 @@ import pytest
 from h2o_tpu.core.frame import Frame, Vec, T_CAT
 
 
+pytestmark = pytest.mark.slow   # compile-heavy (conftest tier doc)
+
 def _mixed_frame(rng, n=800):
     x0 = rng.normal(size=n).astype(np.float32)
     x1 = rng.normal(size=n).astype(np.float32)

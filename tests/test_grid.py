@@ -1,10 +1,13 @@
 """Grid search (reference: hex/grid/GridSearch.java + walkers)."""
 
 import numpy as np
+import pytest
 
 from h2o_tpu.core.frame import Frame, Vec, T_CAT
 from h2o_tpu.models.grid import GridSearch, export_grid, get_grid, import_grid
 
+
+pytestmark = pytest.mark.slow   # compile-heavy (conftest tier doc)
 
 def _frame(rng, n=1500, c=4):
     X = rng.normal(size=(n, c)).astype(np.float32)

@@ -22,6 +22,7 @@ import pytest
 _H2O_PY = "/root/reference/h2o-py"
 
 pytestmark = [
+    pytest.mark.slow,   # compile-heavy (conftest tier doc)
     pytest.mark.skipif(not os.path.isdir(_H2O_PY),
                        reason="reference h2o-py client not present"),
     pytest.mark.shared_dkv,   # module-scoped server/frame fixtures

@@ -13,6 +13,8 @@ import pytest
 from h2o_tpu.core.frame import Frame, Vec, T_CAT
 
 
+pytestmark = pytest.mark.slow   # compile-heavy (conftest tier doc)
+
 def _sparse_binomial(rng, n=4000, p=20, informative=3):
     X = rng.normal(size=(n, p)).astype(np.float32)
     beta = np.zeros(p)

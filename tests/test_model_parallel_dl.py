@@ -1,0 +1,76 @@
+"""DeepLearning tensor parallelism through the PRODUCT builder
+(VERDICT r3 item 8: TP must be a user-reachable feature, not a demo).
+
+``model_parallel=True`` shards hidden layers over the mesh's ``model``
+axis inside DeepLearning._fit (models/deeplearning.py shard_params_tp);
+DP stays on the ``nodes`` axis, so training is DPxTP.  The reference has
+no model parallelism at all (SURVEY §2.4) — this is a TPU extension.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from h2o_tpu.core.cloud import Cloud, MODEL_AXIS
+from h2o_tpu.core.frame import Frame, Vec, T_CAT
+from h2o_tpu.models.deeplearning import (DeepLearning, init_params,
+                                         shard_params_tp)
+
+
+@pytest.fixture()
+def tp_cloud():
+    """4x2 mesh (DP over 4 nodes x TP over 2 model shards)."""
+    cl = Cloud.boot(nodes=4, model_axis=2, row_align=8)
+    yield cl
+    Cloud.boot()          # restore the default mesh for later tests
+
+
+def _frame(R=640, C=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(R, C)).astype(np.float32)
+    y = (rng.uniform(size=R) < 1 / (1 + np.exp(-2 * X[:, 0]))) \
+        .astype(np.int32)
+    return Frame([f"x{j}" for j in range(C)] + ["y"],
+                 [Vec(X[:, j]) for j in range(C)] +
+                 [Vec(y, T_CAT, domain=["a", "b"])])
+
+
+def test_shard_params_tp_layout(tp_cloud):
+    params = shard_params_tp(
+        init_params(jax.random.key(0), [8, 16, 16, 2]), tp_cloud.mesh)
+    # layer 0 column-parallel (output dim), layer 1 row-parallel (input
+    # dim), output layer replicated
+    assert params[0]["W"].sharding.spec == (None, MODEL_AXIS)
+    assert params[1]["W"].sharding.spec == (MODEL_AXIS, None)
+    assert not any(params[2]["W"].sharding.spec)
+
+
+def test_shard_params_tp_divisibility_guard(tp_cloud):
+    with pytest.raises(ValueError, match="divisible"):
+        shard_params_tp(init_params(jax.random.key(0), [8, 15, 2]),
+                        tp_cloud.mesh)
+
+
+def test_dl_trains_model_parallel(tp_cloud):
+    fr = _frame()
+    # batch is min(1024, R) = all 640 rows, so epochs == optimizer steps
+    m = DeepLearning(hidden=[16, 16], epochs=60.0, seed=1,
+                     model_parallel=True, stopping_rounds=0).train(
+        y="y", training_frame=fr)
+    mm = m.output["training_metrics"]
+    assert np.isfinite(mm.data["logloss"])
+    pred = m.predict(fr)
+    assert pred.nrows == fr.nrows
+    # a learned signal, not noise
+    assert mm.data["AUC"] > 0.6
+
+
+def test_dl_model_parallel_noop_without_model_axis(cl):
+    """On a mesh with model_axis=1 the param is an identity — training
+    still works (the default test cloud has no model axis)."""
+    fr = _frame(seed=1)
+    m = DeepLearning(hidden=[8], epochs=0.5, seed=1,
+                     model_parallel=True, stopping_rounds=0).train(
+        y="y", training_frame=fr)
+    assert np.isfinite(m.output["training_metrics"].data["logloss"])

@@ -1,0 +1,143 @@
+"""MOJO long tail (VERDICT r3 item 6): standalone artifacts for GAM,
+RuleFit, PSVM, NaiveBayes, SVD, XGBoost, DT.
+
+Reference: h2o-genmodel/algos/{gam,rulefit,psvm} readers exist but score
+the reference's exact basis/kernel math; this engine's GAM/PSVM/RuleFit
+are documented redesigns (NCS/B-spline bases, RFF kernel map), so those
+three ship the npz MOJO with pure-numpy scorers (mojo/scorers.py) —
+cluster-vs-artifact consistency is the oracle here (the reference's
+testdir_javapredict strategy).  XGBoost/DT export genmodel-spec gbm/drf
+bytes (their trees ARE gbm/drf trees).  NaiveBayes/SVD/Aggregator have
+no genmodel reader in the reference either; NaiveBayes/SVD get npz
+scorers beyond parity.
+"""
+
+import numpy as np
+import pytest
+
+from h2o_tpu import mojo as mj
+from h2o_tpu.core.frame import Frame, Vec, T_CAT
+
+
+pytestmark = pytest.mark.slow   # compile-heavy (conftest tier doc)
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(5)
+    R, C = 900, 5
+    X = rng.normal(size=(R, C)).astype(np.float32)
+    logit = 1.5 * X[:, 0] - X[:, 1] + np.sin(2 * X[:, 2])
+    y = (rng.uniform(size=R) < 1 / (1 + np.exp(-logit))).astype(np.int32)
+    fr = Frame([f"x{j}" for j in range(C)] + ["y"],
+               [Vec(X[:, j]) for j in range(C)] +
+               [Vec(y, T_CAT, domain=["n", "p"])])
+    return fr, X, y
+
+
+def _roundtrip(model, fr, X, tmp_path, prob_col=None, atol=2e-5):
+    clu = np.asarray(model.predict_raw(fr))[: fr.nrows]
+    p = mj.export_mojo(model, str(tmp_path / f"{model.algo}.zip"))
+    s = mj.load_mojo(p).score_matrix(X.astype(np.float64))
+    if prob_col is not None:
+        assert np.abs(np.asarray(s)[:, prob_col] -
+                      clu[:, prob_col]).max() < atol
+    else:
+        assert np.abs(np.asarray(s) - clu).max() < atol
+    return s
+
+
+def test_psvm_mojo(data, tmp_path, cl):
+    from h2o_tpu.models.psvm import PSVM
+    fr, X, _ = data
+    m = PSVM(seed=3, max_iterations=40).train(y="y", training_frame=fr)
+    _roundtrip(m, fr, X, tmp_path, prob_col=2)
+
+
+def test_naivebayes_mojo(data, tmp_path, cl):
+    from h2o_tpu.models.naive_bayes import NaiveBayes
+    fr, X, _ = data
+    m = NaiveBayes(seed=3).train(y="y", training_frame=fr)
+    _roundtrip(m, fr, X, tmp_path, prob_col=2)
+
+
+def test_svd_mojo(data, tmp_path, cl):
+    from h2o_tpu.models.svd import SVD
+    fr, X, _ = data
+    m = SVD(nv=3, seed=3).train(x=[f"x{j}" for j in range(5)],
+                                training_frame=fr)
+    _roundtrip(m, fr, X, tmp_path)
+
+
+def test_gam_mojo(data, tmp_path, cl):
+    from h2o_tpu.models.gam import GAM
+    fr, X, _ = data
+    for bs in (0, 2, 3):
+        m = GAM(gam_columns=["x2"], num_knots=8, bs=[bs], lambda_=0.0,
+                seed=3, family="binomial").train(
+            y="y", training_frame=fr)
+        _roundtrip(m, fr, X, tmp_path, prob_col=2)
+
+
+def test_gam_mojo_mixed_cat_num_order(tmp_path, cl):
+    """Regression: the scorer stacks the inner GLM's matrix in SPEC
+    order (cats first) even when the user listed numerics first — a
+    column-order mixup here scores silently wrong."""
+    from h2o_tpu.models.gam import GAM
+    rng = np.random.default_rng(9)
+    R = 800
+    xnum = rng.normal(size=R).astype(np.float32)
+    cat = rng.integers(0, 3, size=R)
+    z = rng.normal(size=R).astype(np.float32)
+    yv = (xnum * 1.2 + (cat - 1.0) + np.sin(2 * z) +
+          rng.normal(scale=0.3, size=R)).astype(np.float32)
+    fr = Frame(["xn", "c", "z", "y"],
+               [Vec(xnum), Vec(cat.astype(np.int32), T_CAT,
+                               domain=["p", "q", "r"]),
+                Vec(z), Vec(yv)])
+    m = GAM(gam_columns=["z"], num_knots=8, lambda_=0.0, seed=3,
+            family="gaussian").train(x=["xn", "c", "z"], y="y",
+                                     training_frame=fr)
+    clu = np.asarray(m.predict_raw(fr))[:R]
+    X = np.stack([xnum, cat.astype(np.float64), z], axis=1)
+    p = mj.export_mojo(m, str(tmp_path / "gam_mixed.zip"))
+    s = np.asarray(mj.load_mojo(p).score_matrix(X.astype(np.float64)))
+    assert np.abs(s - clu).max() < 2e-5
+
+
+def test_rulefit_mojo(data, tmp_path, cl):
+    from h2o_tpu.models.rulefit import RuleFit
+    fr, X, _ = data
+    m = RuleFit(seed=3, rule_generation_ntrees=6,
+                min_rule_length=2, max_rule_length=3).train(
+        y="y", training_frame=fr)
+    _roundtrip(m, fr, X, tmp_path, prob_col=2)
+
+
+def test_xgboost_genmodel_mojo(data, tmp_path, cl):
+    """XGBoost exports genmodel-spec GBM bytes; both the npz and the
+    genmodel artifact must match the cluster."""
+    from h2o_tpu.models.tree.xgboost import XGBoost
+    from h2o_tpu.mojo.genmodel import (GenmodelMojoModel,
+                                       write_genmodel_mojo)
+    fr, X, _ = data
+    m = XGBoost(ntrees=5, max_depth=4, seed=3).train(
+        y="y", training_frame=fr)
+    _roundtrip(m, fr, X, tmp_path, prob_col=2)
+    clu = np.asarray(m.predict_raw(fr))[: fr.nrows]
+    g = GenmodelMojoModel(write_genmodel_mojo(m))
+    sg = g.score_matrix(X.astype(np.float64))
+    assert np.abs(sg[:, 2] - clu[:, 2]).max() < 2e-5
+    assert g.parsed["algo"] == "gbm"     # real genmodel jars read it
+
+
+def test_dt_genmodel_mojo(data, tmp_path, cl):
+    from h2o_tpu.models.tree.dt import DT
+    from h2o_tpu.mojo.genmodel import (GenmodelMojoModel,
+                                       write_genmodel_mojo)
+    fr, X, _ = data
+    m = DT(max_depth=5, seed=3).train(y="y", training_frame=fr)
+    _roundtrip(m, fr, X, tmp_path, prob_col=2)
+    clu = np.asarray(m.predict_raw(fr))[: fr.nrows]
+    sg = GenmodelMojoModel(write_genmodel_mojo(m)) \
+        .score_matrix(X.astype(np.float64))
+    assert np.abs(sg[:, 2] - clu[:, 2]).max() < 2e-5

@@ -12,6 +12,8 @@ import sys
 import pytest
 
 
+pytestmark = pytest.mark.slow   # compile-heavy (conftest tier doc)
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))

@@ -1,0 +1,125 @@
+"""Remote persist backends (VERDICT r3 item 10).
+
+Reference: water/persist/PersistHTTP (read-only http(s) byte store) and
+h2o-persist-gcs (PersistGcs).  The ingest path localizes remote URIs
+through core.persist (core/parse.py localize), so EVERY format reader
+gets remote support, and h2o.import_file("https://...csv") works from
+the stock client.
+"""
+
+import gzip
+import http.server
+import sys
+import threading
+
+import pytest
+
+from h2o_tpu.core import persist
+from h2o_tpu.core.parse import localize, parse_file
+
+pytestmark = [pytest.mark.shared_dkv]   # module-scoped server fixtures
+
+CSV = b"a,b,y\n1,2.5,p\n2,0.5,n\n3,1.5,p\n4,,n\n"
+
+
+class _Srv(http.server.BaseHTTPRequestHandler):
+    store = {"/data.csv": CSV,
+             "/data.csv.gz": gzip.compress(CSV)}
+
+    def do_GET(self):
+        body = self.store.get(self.path.split("?")[0])
+        if body is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):           # fake-GCS upload endpoint
+        n = int(self.headers.get("Content-Length", 0))
+        data = self.rfile.read(n)
+        name = self.path.split("name=", 1)[-1]
+        self.store["/gcs-upload/" + name] = data
+        self.send_response(200)
+        self.send_header("Content-Length", "2")
+        self.end_headers()
+        self.wfile.write(b"{}")
+
+    def log_message(self, *a):   # keep pytest output clean
+        pass
+
+
+@pytest.fixture(scope="module")
+def http_base():
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Srv)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def test_http_read_bytes(http_base):
+    assert persist.read_bytes(f"{http_base}/data.csv") == CSV
+    with pytest.raises(NotImplementedError, match="read-only"):
+        persist.write_bytes(f"{http_base}/x", b"nope")
+
+
+def test_http_parse_file(cl, http_base):
+    fr = parse_file(f"{http_base}/data.csv")
+    assert fr.nrows == 4 and fr.ncols == 3
+    assert abs(float(fr.vec("b").mean()) - 1.5) < 1e-6
+    assert int(fr.vec("b").nacnt()) == 1
+    # gz over http decompresses through the same path
+    fr2 = parse_file(f"{http_base}/data.csv.gz")
+    assert fr2.nrows == 4
+
+
+def test_localize_caches(http_base):
+    p1 = localize(f"{http_base}/data.csv")
+    p2 = localize(f"{http_base}/data.csv")
+    assert p1 == p2
+    with open(p1, "rb") as f:
+        assert f.read() == CSV
+
+
+@pytest.fixture(scope="module")
+def h2o_rest(cl):
+    """A live REST server + the stock h2o-py client connected to it."""
+    from h2o_tpu.api.server import RestServer
+    srv = RestServer(port=0).start()
+    if "/root/reference/h2o-py" not in sys.path:
+        sys.path.insert(0, "/root/reference/h2o-py")
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        import h2o
+    h2o.connect(url=f"http://127.0.0.1:{srv.port}", verbose=False,
+                strict_version_check=False)
+    yield h2o
+    srv.stop()
+
+
+def test_import_file_stock_client_over_http(h2o_rest, http_base):
+    """The stock h2o-py client imports an http:// URI end to end."""
+    h2o = h2o_rest
+    fr = h2o.import_file(f"{http_base}/data.csv")
+    assert fr.nrow == 4
+    assert fr.ncol == 3
+
+
+def test_gcs_roundtrip_against_fake_endpoint(http_base, monkeypatch):
+    """gcs:// reads via the JSON API media path; writes via the upload
+    endpoint (fake-gcs-server-style stub)."""
+    monkeypatch.setenv("GCS_ENDPOINT_URL", http_base)
+    # seed an object where the media URL will look for it
+    _Srv.store["/storage/v1/b/bkt/o/data.csv"] = CSV
+    persist.register_gcs()
+    try:
+        data = persist.read_bytes("gcs://bkt/data.csv")
+        assert data == CSV
+        persist.write_bytes("gcs://bkt/out.bin", b"\x01\x02")
+        assert _Srv.store["/gcs-upload/out.bin"] == b"\x01\x02"
+    finally:
+        persist.unregister_scheme("gcs")

@@ -1,0 +1,92 @@
+"""R-client REST surface characterization (VERDICT r3 missing #3).
+
+The image has no R runtime, but the reference R package
+(h2o-r/h2o-package/R/{connection,kvstore,frame,models,grid,...}.R) is a
+pure REST+Rapids client: every call goes through .h2o.doSafeREST with a
+urlSuffix constant.  This test enumerates the endpoint constants the R
+package ships (extracted from the R sources) and pins that each resolves
+to a live route in our server — so an R client attaching over HTTP finds
+the same surface the Python client does.  A route may answer 400/501 for
+degenerate inputs; what it must never do is 404 (no such route).
+"""
+
+import re
+
+import pytest
+
+import h2o_tpu.api.server as srv
+# route modules register on import
+import h2o_tpu.api.handlers  # noqa: F401
+
+
+# endpoint constants from /root/reference/h2o-r/h2o-package/R/*.R
+# (.h2o.__XXX <- "..." plus literal urlSuffix= call sites), normalized to
+# the versioned paths .h2o.doSafeREST composes (default version 3)
+R_CLIENT_ENDPOINTS = [
+    ("GET", "/3/Cloud"),                       # .h2o.__CLOUD
+    ("POST", "/3/CreateFrame"),                # h2o.createFrame
+    ("DELETE", "/3/DKV"),                      # h2o.removeAll
+    ("DELETE", "/3/DKV/somekey"),              # h2o.rm
+    ("GET", "/3/Logs/download/1"),             # .h2o.__DOWNLOAD_LOGS
+    ("GET", "/3/Frames"),                      # .h2o.__FRAMES
+    ("GET", "/3/ImportFiles"),                 # .h2o.__IMPORT
+    ("GET", "/3/Jobs"),                        # .h2o.__JOBS
+    ("POST", "/3/Frames/load"),                # h2o.load_frame
+    ("POST", "/3/Frames/fr/save"),             # .h2o.__SAVE_FRAME(id)
+    ("POST", "/99/Models.bin/m"),              # h2o.loadModel
+    ("POST", "/3/LogAndEcho"),                 # .h2o.__LOGANDECHO
+    ("GET", "/3/Models"),                      # .h2o.__MODELS
+    ("POST", "/3/Parse"),                      # .h2o.__PARSE
+    ("POST", "/3/ParseSetup"),                 # .h2o.__PARSE_SETUP
+    ("POST", "/3/ParseSVMLight"),              # .h2o.__PARSE_SVMLIGHT
+    ("POST", "/99/Rapids"),                    # .h2o.__RAPIDS
+    ("POST", "/3/Recovery/resume"),            # .h2o.__RESUME
+    ("GET", "/3/SessionProperties"),           # session props
+    ("POST", "/3/Shutdown"),                   # .h2o.__SHUTDOWN
+    ("POST", "/99/Models.upload.bin/"),        # h2o.uploadModel
+    ("GET", "/3/Capabilities"),                # .h2o.__ALL_CAPABILITIES
+    ("GET", "/3/Capabilities/API"),
+    ("GET", "/3/Capabilities/Core"),
+    ("POST", "/3/DecryptionSetup"),            # h2o.decryptionSetup
+    ("GET", "/3/InitID"),                      # h2o.init session id
+    ("GET", "/3/Metadata/endpoints"),          # h2o.api docs
+    ("GET", "/3/NetworkTest"),                 # h2o.networkTest
+    ("GET", "/3/ModelBuilders/gbm"),           # .h2o.__MODEL_BUILDERS
+    ("POST", "/3/ModelBuilders/gbm"),
+    ("GET", "/99/Grids"),                      # .h2o.__GRIDS
+    ("GET", "/99/Grids/g1"),                   # .h2o.__GRID
+    ("POST", "/3/Grid.bin/g1/export"),         # h2o.saveGrid
+    ("POST", "/3/Grid.bin/import"),            # h2o.loadGrid
+    ("POST", "/99/Grid/gbm/resume"),           # .h2o.__GRID_RESUME(algo)
+    ("POST", "/3/Frames/fr/export"),           # .h2o.__EXPORT_FILES(fr)
+    ("POST", "/3/ModelMetrics/models/m/frames/f"),  # .h2o.__MODEL_METRICS
+    ("POST", "/3/FeatureInteraction"),         # h2o.feature_interaction
+    ("POST", "/3/FriedmansPopescusH"),         # h2o.h
+    ("POST", "/3/SignificantRules"),           # h2o.rule_importance
+    ("POST", "/3/SegmentModelsBuilders/gbm"),  # h2o.train_segments
+    ("GET", "/3/Frames/fr/summary"),           # h2o.describe
+    ("POST", "/3/Predictions/models/m/frames/f"),   # h2o.predict
+    ("POST", "/4/sessions"),                   # v4 session open
+]
+
+
+def _resolves(method: str, path: str) -> bool:
+    for m, rx, _fn, _raw in srv._ROUTES:
+        if m == method and rx.fullmatch(path.split("?")[0]):
+            return True
+    return False
+
+
+@pytest.mark.parametrize("method,path", R_CLIENT_ENDPOINTS,
+                         ids=[f"{m} {p}" for m, p in R_CLIENT_ENDPOINTS])
+def test_r_client_endpoint_resolves(method, path):
+    assert _resolves(method, path), (
+        f"{method} {path}: the reference R client calls this endpoint "
+        "and our route table has no match — an attached R session would "
+        "get a 404 (add the route, or a named 501)")
+
+
+def test_flow_static_surface():
+    """h2o.flow() opens <server>/flow/ in a browser."""
+    assert _resolves("GET", "/flow/index.html") or \
+        _resolves("GET", "/flow/") or _resolves("GET", "/")

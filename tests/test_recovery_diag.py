@@ -87,6 +87,42 @@ def test_grid_recovery_resume(cl, rng, tmp_path):
     assert pending_recoveries(rec_dir) == []
 
 
+def test_grid_resume_rest_route(cl, rng, tmp_path):
+    """POST /99/Grid/{algo}/resume — the R client's h2o.resumeGrid
+    surface (VERDICT r3 missing #3 characterization follow-up): resumes
+    one grid's snapshot asynchronously and returns a pollable job."""
+    from h2o_tpu.api.handlers_ml import grid_resume
+    from h2o_tpu.core.recovery import Recovery, pending_recoveries
+    from h2o_tpu.models.grid import get_grid
+    from h2o_tpu.models.tree.gbm import GBM
+    rec_dir = str(tmp_path / "rrec")
+    fr = _mk_frame(rng)
+    rec = Recovery(rec_dir, "grid", "r_resume_grid")
+    rec.begin(dict(ntrees=3, seed=1), fr, extra=dict(
+        algo="gbm", hyper_params={"max_depth": [2, 3]},
+        strategy="Cartesian", criteria={},
+        base_params=dict(ntrees=3, seed=1), x=None, y="y"))
+    m0 = GBM(ntrees=3, max_depth=2, seed=1).train(y="y",
+                                                  training_frame=fr)
+    rec.model_done(m0)
+
+    out = grid_resume({"grid_id": "r_resume_grid",
+                       "recovery_dir": rec_dir}, "gbm")
+    job_json = out["job"]
+    assert job_json["key"]["name"]
+    from h2o_tpu.core.cloud import cloud
+    job = cloud().jobs.get(job_json["key"]["name"])
+    grid = job.join()
+    assert len(grid.models) == 2
+    assert get_grid("r_resume_grid") is not None
+    assert pending_recoveries(rec_dir) == []
+    # unknown snapshot -> 404 envelope
+    import pytest
+    from h2o_tpu.api.server import H2OError
+    with pytest.raises(H2OError):
+        grid_resume({"grid_id": "nope", "recovery_dir": rec_dir}, "gbm")
+
+
 def test_timeline_records_dkv_and_jobs(cl, rng):
     from h2o_tpu.core.cloud import cloud
     from h2o_tpu.core.diag import TimeLine

@@ -1,0 +1,58 @@
+"""T_TIME precision (VERDICT r3 weak #8): epoch-ms exceeds f32
+(~4-minute ulp at 2026 epochs), so rapids arithmetic/comparisons that
+touch a time column must run on the exact float64 host copy
+(rapids/interp.py _elementwise host path), not the f32 device payload.
+"""
+
+import numpy as np
+import pytest
+
+from h2o_tpu.core.frame import Frame, T_TIME, Vec
+
+
+@pytest.fixture()
+def sess(cl):
+    from h2o_tpu.rapids.interp import Session
+    return Session("test_time_prec")
+
+
+def _put(name, frame):
+    from h2o_tpu.core.cloud import cloud
+    frame.key = name
+    cloud().dkv.put(name, frame)
+    return frame
+
+
+def _exec(sess, expr):
+    from h2o_tpu.rapids.interp import rapids_exec
+    return rapids_exec(expr, sess)
+
+
+def test_time_difference_is_exact(cl, sess):
+    # two timestamps 1500 ms apart in 2026 — f32 cannot represent either
+    t0 = 1_785_000_000_000
+    a = np.array([t0, t0 + 86_400_000, t0 + 2 * 86_400_000], np.float64)
+    b = a + 1500.0
+    _put("ftp", Frame(["ta", "tb"], [Vec(a, T_TIME), Vec(b, T_TIME)]))
+    out = _exec(sess, '(- (cols ftp "tb") (cols ftp "ta"))')
+    d = np.asarray(out.vecs[0].to_numpy(), np.float64)
+    assert np.allclose(d, 1500.0)                 # f32 would yield 0/2048
+
+    # comparisons at ms granularity are exact too
+    out = _exec(sess, '(> (cols ftp "tb") (cols ftp "ta"))')
+    assert np.all(np.asarray(out.vecs[0].to_numpy()) == 1.0)
+    out = _exec(sess, '(== (cols ftp "ta") (cols ftp "ta"))')
+    assert np.all(np.asarray(out.vecs[0].to_numpy()) == 1.0)
+    from h2o_tpu.core.cloud import cloud
+    cloud().dkv.remove("ftp")
+
+
+def test_time_scalar_shift_exact(cl, sess):
+    t0 = 1_785_000_000_000
+    a = np.array([t0, t0 + 1], np.float64)
+    _put("ftp2", Frame(["t"], [Vec(a, T_TIME)]))
+    out = _exec(sess, '(+ (cols ftp2 "t") 250)')
+    d = np.asarray(out.vecs[0].to_numpy(), np.float64)
+    assert np.array_equal(d, a + 250.0)
+    from h2o_tpu.core.cloud import cloud
+    cloud().dkv.remove("ftp2")

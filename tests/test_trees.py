@@ -5,6 +5,8 @@ import numpy as np
 import pytest
 
 
+pytestmark = pytest.mark.slow   # compile-heavy (conftest tier doc)
+
 def _make_binomial(rng, n=2000, c=6):
     X = rng.normal(size=(n, c)).astype(np.float32)
     logits = 1.5 * X[:, 0] - 2.0 * X[:, 1] + X[:, 2] * X[:, 3]

@@ -1,0 +1,137 @@
+"""XLS/XLSX ingest (VERDICT r3 missing #8; reference
+water/parser/XlsParser.java).  The test files are built by hand —
+a minimal SpreadsheetML zip and a minimal OLE2+BIFF8 workbook — so the
+first-party readers (core/xls.py) are exercised without any spreadsheet
+library in the image.
+"""
+
+import struct
+import zipfile
+
+import numpy as np
+import pytest
+
+from h2o_tpu.core.parse import parse_file
+
+_SHEET = """<?xml version="1.0"?>
+<worksheet xmlns="http://schemas.openxmlformats.org/spreadsheetml/2006/main">
+<sheetData>
+<row r="1"><c r="A1" t="s"><v>0</v></c><c r="B1" t="s"><v>1</v></c>
+<c r="C1" t="s"><v>2</v></c></row>
+<row r="2"><c r="A2"><v>1.5</v></c><c r="B2" t="s"><v>3</v></c>
+<c r="C2"><v>10</v></c></row>
+<row r="3"><c r="A3"><v>2.5</v></c><c r="B3" t="s"><v>4</v></c></row>
+<row r="4"><c r="A4"><v>4</v></c><c r="B4" t="s"><v>3</v></c>
+<c r="C4"><v>30</v></c></row>
+</sheetData></worksheet>"""
+
+_SST = """<?xml version="1.0"?>
+<sst xmlns="http://schemas.openxmlformats.org/spreadsheetml/2006/main">
+<si><t>num</t></si><si><t>color</t></si><si><t>y</t></si>
+<si><t>red</t></si><si><t>blue</t></si></sst>"""
+
+
+@pytest.fixture()
+def xlsx_path(tmp_path):
+    p = tmp_path / "t.xlsx"
+    with zipfile.ZipFile(p, "w") as z:
+        z.writestr("xl/sharedStrings.xml", _SST)
+        z.writestr("xl/worksheets/sheet1.xml", _SHEET)
+    return str(p)
+
+
+def test_xlsx_parse(cl, xlsx_path):
+    fr = parse_file(xlsx_path)
+    assert fr.names == ["num", "color", "y"]
+    assert fr.nrows == 3
+    assert abs(float(fr.vec("num").mean()) - (1.5 + 2.5 + 4) / 3) < 1e-6
+    assert fr.vec("color").is_categorical
+    assert int(fr.vec("y").nacnt()) == 1          # missing C3
+
+
+# --- minimal OLE2 + BIFF8 builder ------------------------------------------
+
+def _rec(op, body=b""):
+    return struct.pack("<HH", op, len(body)) + body
+
+
+def _bstr(s):
+    return struct.pack("<HB", len(s), 0) + s.encode("latin-1")
+
+
+def _biff_stream():
+    out = b""
+    out += _rec(0x0809, struct.pack("<HH12x", 0x0600, 0x0005))  # BOF glb
+    strings = ["num", "color", "y", "red", "blue"]
+    sst = struct.pack("<II", len(strings), len(strings))
+    for s in strings:
+        sst += _bstr(s)
+    out += _rec(0x00FC, sst)
+    out += _rec(0x000A)                                         # EOF
+    out += _rec(0x0809, struct.pack("<HH12x", 0x0600, 0x0010))  # BOF sht
+    for c, isst in enumerate((0, 1, 2)):                        # header
+        out += _rec(0x00FD, struct.pack("<HHHI", 0, c, 0, isst))
+    rows = [(1.5, 3, 10.0), (2.5, 4, None), (4.0, 3, 30.0)]
+    for r, (a, cc, yv) in enumerate(rows, start=1):
+        out += _rec(0x0203, struct.pack("<HHHd", r, 0, 0, a))   # NUMBER
+        out += _rec(0x00FD, struct.pack("<HHHI", r, 1, 0, cc))  # LABELSST
+        if yv is not None:
+            rk = (int(yv) << 2) | 2                             # int RK
+            out += _rec(0x027E, struct.pack("<HHHI", r, 2, 0, rk))
+    out += _rec(0x000A)                                         # EOF
+    return out
+
+
+def _ole2(stream: bytes) -> bytes:
+    stream = stream + b"\x00" * max(0, 4096 - len(stream))  # FAT-sized
+    n_data = (len(stream) + 511) // 512
+    stream = stream.ljust(n_data * 512, b"\x00")
+    END, FREE, FATSECT = 0xFFFFFFFE, 0xFFFFFFFF, 0xFFFFFFFD
+    fat = [FATSECT, END]                       # sector0 FAT, sector1 dir
+    for i in range(n_data):                    # workbook chain from 2
+        fat.append(2 + i + 1 if i + 1 < n_data else END)
+    fat += [FREE] * (128 - len(fat))
+    fat_sec = struct.pack("<128I", *fat)
+
+    def direntry(name, typ, start, size):
+        raw = name.encode("utf-16-le")
+        e = raw + b"\x00" * (64 - len(raw))
+        e += struct.pack("<H", len(raw) + 2)
+        e += bytes([typ, 1])                   # type, black
+        e += struct.pack("<III", FREE, FREE, FREE)   # left/right/child
+        e += b"\x00" * 36                      # clsid + state + times
+        e += struct.pack("<II", start, size)
+        e += b"\x00" * 4
+        assert len(e) == 128
+        return e
+
+    dirs = direntry("Root Entry", 5, END, 0)
+    dirs += direntry("Workbook", 2, 2, len(stream))
+    dirs += b"\x00" * 128 * 2
+    header = _OLE = b"\xd0\xcf\x11\xe0\xa1\xb1\x1a\xe1" + b"\x00" * 16
+    # minor, major, byte order, sector shift, mini shift; then
+    # nDirSect, nFAT, dirStart, transSig, miniCutoff, miniFATstart,
+    # nMiniFAT, DIFATstart, nDIFAT
+    header += struct.pack("<HHHHH6x9I", 0x3E, 0x0003, 0xFFFE, 9, 6,
+                          0, 1, 1, 0, 4096, END, 0, END, 0)
+    difat = [0] + [FREE] * 108
+    header += struct.pack("<109I", *difat)
+    assert len(header) == 512
+    return header + fat_sec + dirs + stream
+
+
+@pytest.fixture()
+def xls_path(tmp_path):
+    p = tmp_path / "t.xls"
+    p.write_bytes(_ole2(_biff_stream()))
+    return str(p)
+
+
+def test_xls_parse(cl, xls_path):
+    fr = parse_file(xls_path)
+    assert fr.names == ["num", "color", "y"]
+    assert fr.nrows == 3
+    assert abs(float(fr.vec("num").mean()) - (1.5 + 2.5 + 4) / 3) < 1e-6
+    assert list(fr.vec("color").domain) == ["blue", "red"]
+    assert int(fr.vec("y").nacnt()) == 1
+    assert abs(float(fr.vec("y").mean()) - 20.0) < 1e-6
