@@ -161,6 +161,34 @@ def bench_hist_mfu(rows, cols, nbins=64, leaves=32, reps=10):
             "kernel_ms": round(wall * 1e3, 3)}
 
 
+def bench_deep(fr, rows):
+    """Sparse-frontier engine at stock DRF depth (VERDICT r3 item 2's
+    "deep config"): max_depth=20 with a bounded live frontier — the
+    regime the dense heap could not reach."""
+    from h2o_tpu.models.tree.drf import DRF
+    trees = int(os.environ.get("BENCH_DEEP_TREES", 3))
+    cap = os.environ.get("BENCH_DEEP_LEAVES", "1024")
+    prev = os.environ.get("H2O_TPU_MAX_LIVE_LEAVES")
+    os.environ["H2O_TPU_MAX_LIVE_LEAVES"] = cap
+    try:
+        m, wall, wall_c = _timed_train(
+            lambda: DRF(ntrees=trees, max_depth=20, seed=1, nbins=64,
+                        min_rows=1.0), fr)
+    finally:
+        if prev is None:
+            os.environ.pop("H2O_TPU_MAX_LIVE_LEAVES", None)
+        else:
+            os.environ["H2O_TPU_MAX_LIVE_LEAVES"] = prev
+    return {"value": round(rows * trees / wall, 1),
+            "unit": "rows*trees/sec", "wall_s": round(wall, 2),
+            "wall_with_compile_s": round(wall_c, 2),
+            "ntrees": trees, "max_depth": 20,
+            "max_live_leaves": int(cap),
+            "effective_max_depth": int(m.output["effective_max_depth"]),
+            "train_auc": round(float(m.output["training_metrics"]["AUC"]),
+                               4)}
+
+
 def bench_cpu_reference(X, y, rows, trees, depth):
     """External CPU baseline for the north-star ratio (VERDICT r3 item 3):
     the same GBM workload through a widely-accepted CPU hist
@@ -323,8 +351,9 @@ def _main_ladder(detail):
     cols = int(os.environ.get("BENCH_COLS", 28))
     trees = int(os.environ.get("BENCH_TREES", 20))
     depth = int(os.environ.get("BENCH_DEPTH", 5))
-    configs = os.environ.get("BENCH_CONFIG",
-                             "gbm,drf,glm,dl,hist,gbm10m,cpuref").split(",")
+    configs = os.environ.get(
+        "BENCH_CONFIG",
+        "gbm,drf,glm,dl,hist,gbm10m,cpuref,deep").split(",")
 
     detail.update({"rows": rows, "cols": cols})
     _arm_watchdog([detail])
@@ -352,9 +381,10 @@ def _main_ladder(detail):
             ("hist", lambda: bench_hist_mfu(rows, cols)),
             ("gbm10m", lambda: bench_gbm10m(cols, depth)),
             ("cpuref", lambda: bench_cpu_reference(X, y, rows, trees,
-                                                   depth))]
+                                                   depth)),
+            ("deep", lambda: bench_deep(fr, rows))]
     names = {"hist": "hist_kernel", "gbm10m": "gbm_10m",
-             "cpuref": "cpu_reference"}
+             "cpuref": "cpu_reference", "deep": "drf_deep20"}
     for cfg, fn in runs:
         if cfg not in configs:
             continue

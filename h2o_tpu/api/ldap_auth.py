@@ -1,0 +1,112 @@
+"""LDAP simple-bind authentication for the REST server.
+
+Reference: the webserver's JAAS LdapLoginModule (-ldap_login +
+login.conf; water/webserver + h2o-jetty security handlers).  The TPU
+rebuild needs only the wire primitive the login module ultimately
+performs — an LDAPv3 simple BIND — so it is implemented directly on a
+socket with a minimal BER encoder (~none of python-ldap's surface is
+required, and the image ships no LDAP library).
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Tuple
+
+
+def _ber_len(n: int) -> bytes:
+    if n < 0x80:
+        return bytes([n])
+    body = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([0x80 | len(body)]) + body
+
+
+def _tlv(tag: int, payload: bytes) -> bytes:
+    return bytes([tag]) + _ber_len(len(payload)) + payload
+
+
+def _bind_request(dn: str, password: str, msg_id: int = 1) -> bytes:
+    """LDAPMessage{ messageID, [APPLICATION 0] BindRequest{ version=3,
+    name=dn, simple[0]=password } }."""
+    bind = (_tlv(0x02, bytes([3])) +                 # version INTEGER 3
+            _tlv(0x04, dn.encode()) +                # name OCTET STRING
+            _tlv(0x80, password.encode()))           # simple [0]
+    msg = _tlv(0x02, bytes([msg_id])) + _tlv(0x60, bind)
+    return _tlv(0x30, msg)
+
+
+def _read_tlv(buf: bytes, off: int) -> Tuple[int, bytes, int]:
+    tag = buf[off]
+    ln = buf[off + 1]
+    off += 2
+    if ln & 0x80:
+        n = ln & 0x7F
+        ln = int.from_bytes(buf[off: off + n], "big")
+        off += n
+    return tag, buf[off: off + ln], off + ln
+
+
+def ldap_bind(host: str, port: int, dn: str, password: str,
+              timeout: float = 10.0, use_tls: bool = False) -> bool:
+    """One LDAPv3 simple bind; True iff resultCode == success(0).
+
+    Anonymous binds are refused up front: an empty password would
+    'succeed' against most directories without proving anything (the
+    classic unauthenticated-bind pitfall the JAAS module also guards).
+    ``use_tls`` wraps the connection (ldaps://) with certificate
+    verification; LDAP_TLS_NOVERIFY=1 disables verification for
+    self-signed directories."""
+    if not password:
+        return False
+    import os as _os
+    raw = socket.create_connection((host, port), timeout=timeout)
+    if use_tls:
+        import ssl
+        if _os.environ.get("LDAP_TLS_NOVERIFY") == "1":
+            ctx = ssl._create_unverified_context()
+        else:
+            ctx = ssl.create_default_context()
+        raw = ctx.wrap_socket(raw, server_hostname=host)
+    with raw as s:
+        s.sendall(_bind_request(dn, password))
+        buf = b""
+        while True:
+            chunk = s.recv(4096)
+            if not chunk:
+                return False
+            buf += chunk
+            try:
+                tag, msg, end = _read_tlv(buf, 0)
+            except IndexError:
+                continue                   # header not complete yet
+            if end > len(buf):
+                continue                   # payload not complete yet
+            if tag != 0x30:
+                return False
+            # LDAPMessage: messageID, then BindResponse [APPLICATION 1]
+            _t, _mid, off = _read_tlv(msg, 0)
+            rtag, resp, _ = _read_tlv(msg, off)
+            if rtag != 0x61:
+                return False
+            # BindResponse: resultCode ENUMERATED, matchedDN, diag
+            ctag, code, _ = _read_tlv(resp, 0)
+            return ctag == 0x0A and code == b"\x00"
+
+
+def parse_ldap_url(url: str) -> Tuple[str, int, bool]:
+    """ldap[s]://host[:port] -> (host, port, use_tls); default ports
+    389/636.  Unknown schemes are refused — silently discarding an
+    'ldaps' would downgrade the bind to plaintext."""
+    scheme, sep, rest = url.partition("://")
+    if not sep:
+        scheme, rest = "ldap", url
+    scheme = scheme.lower()
+    if scheme not in ("ldap", "ldaps"):
+        raise ValueError(f"unsupported LDAP scheme {scheme!r} in {url!r}"
+                         " (use ldap:// or ldaps://)")
+    tls = scheme == "ldaps"
+    rest = rest.rstrip("/")
+    if ":" in rest:
+        host, port = rest.rsplit(":", 1)
+        return host, int(port), tls
+    return rest, (636 if tls else 389), tls

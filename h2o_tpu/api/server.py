@@ -139,10 +139,13 @@ class _Handler(BaseHTTPRequestHandler):
     def _check_auth(self) -> bool:
         """HTTP Basic auth when the server was configured with credentials
         (reference: water/webserver JAAS Basic login; client
-        h2o.connect(auth=(user, password)))."""
+        h2o.connect(auth=(user, password))).  With ldap_url configured,
+        the credentials are verified by an LDAPv3 simple bind instead of
+        the static pair (JAAS LdapLoginModule analog)."""
         srv = getattr(self.server, "_rest_server", None)
         expected = getattr(srv, "basic_auth", None)
-        if not expected:
+        ldap_url = getattr(srv, "ldap_url", None)
+        if not expected and not ldap_url:
             return True
         import base64
         import hmac
@@ -152,7 +155,19 @@ class _Handler(BaseHTTPRequestHandler):
                 got = base64.b64decode(hdr[6:]).decode()
             except Exception:  # noqa: BLE001 — malformed header
                 got = ""
-            if hmac.compare_digest(got, expected):
+            if ldap_url:
+                from h2o_tpu.api.ldap_auth import ldap_bind, parse_ldap_url
+                user, _, pw = got.partition(":")
+                tmpl = srv.ldap_dn_template or "{}"
+                host, lport, tls = parse_ldap_url(ldap_url)
+                try:
+                    if user and ldap_bind(host, lport,
+                                          tmpl.format(user), pw,
+                                          use_tls=tls):
+                        return True
+                except OSError:
+                    pass               # directory unreachable -> 401
+            elif hmac.compare_digest(got, expected):
                 return True
         # the request body was never read — close the connection rather
         # than let keep-alive parse leftover body bytes as a request line
@@ -315,6 +330,9 @@ class RestServer:
                                                 server_side=True)
         # "user:password" (reference -hash_login Basic auth)
         self.basic_auth = basic_auth or args.basic_auth
+        # LDAP simple-bind auth (reference -ldap_login; api/ldap_auth.py)
+        self.ldap_url = args.ldap_url
+        self.ldap_dn_template = args.ldap_dn_template
         self.port = self.httpd.server_port
         self.thread: Optional[threading.Thread] = None
 

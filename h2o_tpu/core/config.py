@@ -59,6 +59,12 @@ class OptArgs:
     # Basic auth (reference -hash_login/JAAS modules): "user:password".
     # One pair — the reference's hash-file multi-user store can layer on.
     basic_auth: Optional[str] = None
+    # LDAP auth (reference -ldap_login + JAAS LdapLoginModule): Basic
+    # credentials are verified by an LDAPv3 simple bind against
+    # ldap_url, with the DN formed from ldap_dn_template ("{}" is the
+    # username, e.g. "uid={},ou=people,dc=example,dc=com")
+    ldap_url: Optional[str] = None
+    ldap_dn_template: Optional[str] = None
     # -client mode: join the control plane without homing data
     # (water/H2O.java:391-394); client nodes never shard frame rows
     client: bool = False

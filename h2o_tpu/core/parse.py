@@ -69,6 +69,38 @@ def _is_remote(path: str) -> bool:
         path.split("://", 1)[0] not in ("file", "nfs")
 
 
+def _cached_file(subdir: str, key: str, suffix: str, producer,
+                 max_age_s: Optional[float] = None) -> str:
+    """Key-addressed temp-dir cache with an atomic, concurrency-safe
+    materialize: ``producer() -> bytes`` runs only on miss (or when the
+    entry is older than ``max_age_s``).  Writes go to a per-call unique
+    temp file before the atomic replace, so concurrent REST threads can
+    never interleave; the temp is unlinked on producer failure."""
+    import hashlib
+    import tempfile
+    import time as _time
+    cdir = os.path.join(tempfile.gettempdir(), subdir)
+    os.makedirs(cdir, exist_ok=True)
+    local = os.path.join(
+        cdir, hashlib.sha1(key.encode()).hexdigest()[:16] + suffix)
+    try:
+        age = _time.time() - os.path.getmtime(local)
+        if max_age_s is None or age < max_age_s:
+            return local
+    except OSError:
+        pass
+    data = producer()
+    fd, tmp = tempfile.mkstemp(dir=cdir, suffix=".part")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, local)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return local
+
+
 def localize(path: str, max_age_s: float = 120.0) -> str:
     """Materialize a remote object into the download cache and return
     the local path (local paths pass through).  The cache file is keyed
@@ -80,33 +112,16 @@ def localize(path: str, max_age_s: float = 120.0) -> str:
     native C++ tokenizer) remote support."""
     if not _is_remote(path):
         return path[7:] if path.startswith("file://") else path
-    import hashlib
-    import tempfile
-    import time as _time
     base = os.path.basename(path.split("?", 1)[0]) or "remote"
-    cdir = os.path.join(tempfile.gettempdir(), "h2o_tpu_remote")
-    os.makedirs(cdir, exist_ok=True)
-    local = os.path.join(
-        cdir, hashlib.sha1(path.encode()).hexdigest()[:16] + "_" + base)
-    try:
-        fresh = _time.time() - os.path.getmtime(local) < max_age_s
-    except OSError:
-        fresh = False
-    if not fresh:
+
+    def fetch() -> bytes:
         from h2o_tpu.core.persist import read_bytes
         data = read_bytes(path)
-        # per-call unique temp file: concurrent REST threads fetching the
-        # same URI must not interleave writes before the atomic replace
-        fd, tmp = tempfile.mkstemp(dir=cdir, suffix=".part")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                f.write(data)
-            os.replace(tmp, local)
-        finally:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-        log.info("fetched %s -> %s (%d bytes)", path, local, len(data))
-    return local
+        log.info("fetched %s (%d bytes)", path, len(data))
+        return data
+
+    return _cached_file("h2o_tpu_remote", path, "_" + base, fetch,
+                        max_age_s=max_age_s)
 
 
 def _open(path: str) -> io.TextIOBase:
@@ -437,25 +452,16 @@ def _xls_csv_path(path: str) -> str:
     """Decode a spreadsheet ONCE per (path, mtime) into a cached temp
     CSV — ParseSetup and Parse both read the source, and unlike CSV's
     ~200-line sample the spreadsheet decode is whole-file."""
-    import hashlib
-    import tempfile
-    mtime = int(os.path.getmtime(path))
-    key = hashlib.sha1(f"{path}:{mtime}".encode()).hexdigest()[:16]
-    cdir = os.path.join(tempfile.gettempdir(), "h2o_tpu_xls")
-    os.makedirs(cdir, exist_ok=True)
-    out = os.path.join(cdir, key + ".csv")
-    if os.path.exists(out):
-        return out
-    from h2o_tpu.core import xls as _xls
-    rows = _xls.read_xlsx(path) if path.endswith(".xlsx") \
-        else _xls.read_xls(path)
-    if not rows:
-        raise ValueError(f"{path}: no cells in the first sheet")
-    fd, tmp = tempfile.mkstemp(dir=cdir, suffix=".part")
-    with os.fdopen(fd, "w") as f:
-        f.write(_xls.rows_to_csv(rows))
-    os.replace(tmp, out)
-    return out
+    def decode() -> bytes:
+        from h2o_tpu.core import xls as _xls
+        rows = _xls.read_xlsx(path) if path.endswith(".xlsx") \
+            else _xls.read_xls(path)
+        if not rows:
+            raise ValueError(f"{path}: no cells in the first sheet")
+        return _xls.rows_to_csv(rows).encode()
+
+    key = f"{path}:{int(os.path.getmtime(path))}"
+    return _cached_file("h2o_tpu_xls", key, ".csv", decode)
 
 
 def parse_xls(path: str, dest: Optional[str] = None) -> Frame:

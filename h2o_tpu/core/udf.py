@@ -1,4 +1,4 @@
-"""User-defined functions: custom model metrics.
+"""User-defined functions: custom model metrics + custom distributions.
 
 Reference (water/udf/*, 1.9k LoC): metric/distribution functions uploaded
 as archives, loaded from a DKV-backed classloader, evaluated inside
@@ -31,25 +31,33 @@ log = get_logger("udf")
 
 
 def _install_water_stub() -> None:
-    """Satisfy ``import water.udf.CMetricFunc`` in uploaded sources."""
+    """Satisfy ``import water.udf.{CMetricFunc,CDistributionFunc}`` in
+    uploaded sources."""
     if "water.udf.CMetricFunc" in sys.modules:
         return
     water = sys.modules.setdefault("water", types.ModuleType("water"))
     udf = types.ModuleType("water.udf")
     cmf = types.ModuleType("water.udf.CMetricFunc")
+    cdf = types.ModuleType("water.udf.CDistributionFunc")
 
     class CMetricFunc:  # the interface marker (map/reduce/metric)
         pass
 
+    class CDistributionFunc:  # link/init/gradient/gammaNum/gammaDenom
+        pass
+
     cmf.CMetricFunc = CMetricFunc
+    cdf.CDistributionFunc = CDistributionFunc
     # `import water.udf.CMetricFunc as MetricFunc` then uses MetricFunc
     # as a BASE CLASS (jython lets the java interface through); CPython
     # binds the alias via getattr(water.udf, "CMetricFunc"), so point the
     # attribute at the class while sys.modules satisfies the import
     udf.CMetricFunc = CMetricFunc
+    udf.CDistributionFunc = CDistributionFunc
     water.udf = udf
     sys.modules["water.udf"] = udf
     sys.modules["water.udf.CMetricFunc"] = cmf
+    sys.modules["water.udf.CDistributionFunc"] = cdf
 
 
 def load_custom_func(ref: str):
@@ -84,6 +92,89 @@ def load_custom_func(ref: str):
     if cls is None:
         raise ValueError(f"class {class_name!r} not found in {src_name}")
     return cls()
+
+
+def custom_link_inv(link_name, f):
+    """Inverse link by name (the CDistributionFunc link() vocabulary:
+    identity/log/logit/inverse) — shared by training-time f0 and every
+    scoring path so they can never diverge."""
+    import jax
+    import jax.numpy as jnp
+    link = (link_name or "identity").lower()
+    if link == "log":
+        return jnp.exp(f)
+    if link == "logit":
+        return jax.nn.sigmoid(f)
+    if link == "inverse":
+        return 1.0 / jnp.where(jnp.abs(f) < 1e-5,
+                               jnp.where(f < 0, -1e-5, 1e-5), f)
+    return f
+
+
+class CustomDistribution:
+    """Adapter from the CDistributionFunc contract (water/udf
+    CDistributionFunc: link/init/gradient/gammaNum/gammaDenom) to the
+    fused tree engine's distribution interface.
+
+    The engine evaluates ``gradient`` on traced device arrays inside one
+    XLA program, so the uploaded ``gradient(y, f)`` must be written with
+    array-friendly arithmetic (the client-generated wrappers are).  Leaf
+    values use the engine's Newton ratio sum(w*g)/sum(w*h) with
+    ``h = hessian(y, f)`` when the class provides it, else the mean leaf
+    — a documented simplification of the reference's separate
+    gammaNum/gammaDenom GammaPass."""
+
+    def __init__(self, func):
+        self.func = func
+        link = "identity"
+        if hasattr(func, "link"):
+            link = str(func.link()).lower()
+        self.link_name = link
+
+    @property
+    def newton(self) -> bool:
+        return hasattr(self.func, "hessian")
+
+    def gradient(self, y, f):
+        return self.func.gradient(y, f)
+
+    def hessian(self, y, f):
+        if hasattr(self.func, "hessian"):
+            return self.func.hessian(y, f)
+        import jax.numpy as jnp
+        return jnp.ones_like(f)
+
+    def link_inv(self, f):
+        return custom_link_inv(self.link_name, f)
+
+    def link(self, mu):
+        if self.link_name == "log":
+            return float(np.log(max(mu, 1e-12)))
+        if self.link_name == "logit":
+            mu = min(max(mu, 1e-12), 1 - 1e-12)
+            return float(np.log(mu / (1 - mu)))
+        if self.link_name == "inverse":
+            return float(1.0 / mu) if mu else 0.0
+        return float(mu)
+
+    def init_f0(self, y, w) -> float:
+        """f0 = link(init-ratio): CDistributionFunc.init returns
+        [weighted numerator, weight sum]."""
+        ya = np.asarray(y, np.float64)
+        wa = np.asarray(w, np.float64)
+        if hasattr(self.func, "init"):
+            num, den = self.func.init(wa, np.zeros_like(wa), ya)
+            num, den = float(np.sum(num)), float(np.sum(den))
+        else:
+            num, den = float(np.sum(wa * ya)), float(np.sum(wa))
+        return self.link(num / max(den, 1e-12))
+
+
+def load_custom_distribution(ref: str) -> CustomDistribution:
+    """Resolve a custom_distribution_func reference (the stock client's
+    h2o.upload_custom_distribution flow — same zip + python:<key>=<cls>
+    wire format as custom metrics)."""
+    return CustomDistribution(load_custom_func(ref))
 
 
 def compute_custom_metric(func, preds: np.ndarray, actual: np.ndarray,

@@ -245,7 +245,7 @@ def _irlsm_pass(X, y, w, valid, beta, fam_name: str, tweedie_power=1.5,
                                              "non_negative"))
 def _cod_solve(G, q, beta0, lam_l1, lam_l2, n_sweeps: int = 50,
                intercept_pen: bool = False, non_negative: bool = False,
-               nonneg_mask=None):
+               nonneg_mask=None, lo=None, hi=None):
     """Cyclic coordinate descent on the Gram (elastic net; ADMM/COD analog).
 
     Solves argmin 1/2 b'Gb - q'b + lam_l1|b| + lam_l2/2 |b|^2 with the
@@ -253,6 +253,8 @@ def _cod_solve(G, q, beta0, lam_l1, lam_l2, n_sweeps: int = 50,
     at 0 (GLM.java betaConstraints lower bound — the AUTO metalearner's
     setting): every non-intercept coef when ``nonneg_mask`` is None, else
     exactly the coefs the mask selects (GAM monotone I-splines).
+    ``lo``/``hi`` are per-coef box bounds (user beta_constraints —
+    GLM.java betaConstraints lower/upper_bounds).
     """
     P = G.shape[0]
     diag = jnp.diagonal(G)
@@ -269,6 +271,9 @@ def _cod_solve(G, q, beta0, lam_l1, lam_l2, n_sweeps: int = 50,
                 jnp.maximum(diag[j] + l2, EPS)
             if non_negative:
                 bj = jnp.where(clamp[j] > 0, jnp.maximum(bj, 0.0), bj)
+            if lo is not None:
+                # box projection is exact inside coordinate descent
+                bj = jnp.clip(bj, lo[j], hi[j])
             return b.at[j].set(bj)
         beta = jax.lax.fori_loop(0, P, upd, beta)
         return beta, None
@@ -295,6 +300,41 @@ def _chol_solve(G, q, lam_l2):
     ridge = lam_l2 * jnp.eye(P).at[-1, -1].set(0.0)
     return jax.scipy.linalg.solve(G + ridge + 1e-8 * jnp.eye(P), q,
                                   assume_a="pos")
+
+
+def _beta_constraint_rows(bc):
+    """Normalize the beta_constraints input (dict, Frame, or DKV frame
+    key — the stock client uploads a frame and sends its id) into
+    (name, lower, upper) tuples."""
+    if isinstance(bc, dict):
+        out = []
+        for name, v in bc.items():
+            if isinstance(v, dict):
+                out.append((str(name), v.get("lower_bounds"),
+                            v.get("upper_bounds")))
+            else:
+                lb, ub = v
+                out.append((str(name), lb, ub))
+        return out
+    if isinstance(bc, str):
+        from h2o_tpu.core.cloud import cloud
+        fr = cloud().dkv.get(bc)
+        if fr is None:
+            raise ValueError(f"beta_constraints frame {bc!r} not found")
+        bc = fr
+    nv = bc.vec("names")
+    if nv.host_data is not None:
+        names = [str(s) for s in nv.host_data]
+    elif nv.is_categorical:
+        names = [nv.domain[int(float(c))] for c in
+                 np.asarray(nv.to_numpy())]
+    else:
+        names = [str(s) for s in nv.to_numpy()]
+    lbs = np.asarray(bc.vec("lower_bounds").to_numpy(), np.float64) \
+        if "lower_bounds" in bc.names else [None] * len(names)
+    ubs = np.asarray(bc.vec("upper_bounds").to_numpy(), np.float64) \
+        if "upper_bounds" in bc.names else [None] * len(names)
+    return list(zip(names, lbs, ubs))
 
 
 def expand_for_scoring(frame: Frame, spec: Dict):
@@ -471,7 +511,8 @@ class GLM(ModelBuilder):
                  gradient_epsilon=-1.0, link="family_default",
                  missing_values_handling="MeanImputation",
                  compute_p_values=False, remove_collinear_columns=False,
-                 use_all_factor_levels=False, theta=1e-10)
+                 use_all_factor_levels=False, theta=1e-10,
+                 beta_constraints=None)
         return p
 
     def _fit(self, job, x, y, train: Frame, valid: Optional[Frame]):
@@ -516,6 +557,11 @@ class GLM(ModelBuilder):
                 raise ValueError("compute_p_values is not available for "
                                  f"family='{fam_name}'")
             p["lambda_"] = 0.0
+        if p.get("beta_constraints") is not None and \
+                fam_name in ("multinomial", "ordinal"):
+            raise ValueError("beta_constraints are not supported for "
+                             f"family='{fam_name}' (reference GLM has "
+                             "the same restriction)")
         P = X.shape[1]
         alpha = p["alpha"]
         alpha = 0.5 if alpha is None else (
@@ -625,6 +671,36 @@ class GLM(ModelBuilder):
             for n in nn:
                 mask[idx_of[n]] = 1.0
             p["_nonneg_mask"] = mask
+        bc = p.get("beta_constraints")
+        if bc is not None:
+            # reference GLM.java betaConstraints: a frame/table of
+            # (names, lower_bounds, upper_bounds); bounds are given in
+            # RAW coefficient space and transform to the solved
+            # (standardized) space by *sigma (beta_std = beta_raw*sigma)
+            rows = _beta_constraint_rows(bc)
+            P1 = X.shape[1] + 1
+            lo = np.full((P1,), -np.inf, np.float64)
+            hi = np.full((P1,), np.inf, np.float64)
+            sig = dict(zip(spec["num_names"], spec["sigmas"])) \
+                if spec["standardize"] else {}
+            for name, lb, ub in rows:
+                if name == "Intercept":
+                    j, s = P1 - 1, 1.0
+                elif name in idx_of:
+                    j = idx_of[name]
+                    s = float(sig.get(name, 1.0) or 1.0)
+                else:
+                    raise ValueError(
+                        f"beta_constraints names unknown coefficient "
+                        f"{name!r}; valid: {names[:8]}... + Intercept")
+                if lb is not None and np.isfinite(lb):
+                    lo[j] = lb * s
+                if ub is not None and np.isfinite(ub):
+                    hi[j] = ub * s
+            if np.any(lo > hi):
+                raise ValueError("beta_constraints: lower_bound > "
+                                 "upper_bound for some coefficient")
+            p["_beta_lo"], p["_beta_hi"] = lo, hi
 
     # -- solvers ------------------------------------------------------------
 
@@ -645,6 +721,10 @@ class GLM(ModelBuilder):
         if mask is not None:
             nonneg = True
             mask = jnp.asarray(mask, jnp.float32)
+        lo = p.get("_beta_lo")
+        hi = p.get("_beta_hi")
+        lo = jnp.asarray(lo) if lo is not None else None
+        hi = jnp.asarray(hi) if hi is not None else None
         dev_prev, dev = None, None
         self._last_iters = 0
         for it in range(max_iter):
@@ -660,10 +740,10 @@ class GLM(ModelBuilder):
                 G = G + pen_dev
             l1 = lam * alpha * n_obs
             l2 = lam * (1 - alpha) * n_obs
-            if l1 > 0 or nonneg:
+            if l1 > 0 or nonneg or lo is not None:
                 beta_new = _cod_solve(G, q, beta, l1, l2,
                                       non_negative=nonneg,
-                                      nonneg_mask=mask)
+                                      nonneg_mask=mask, lo=lo, hi=hi)
             else:
                 beta_new = _chol_solve(G, q, l2)
             delta = float(jnp.max(jnp.abs(beta_new - beta)))

@@ -210,7 +210,9 @@ class Model:
             from h2o_tpu.models.distributions import get_distribution
             dist_name = self.params.get("distribution", "gaussian")
             dist = None
-            if dist_name not in ("gaussian", "auto", None):
+            # custom distributions report plain regression metrics (the
+            # deviance column needs a built-in family)
+            if dist_name not in ("gaussian", "auto", "custom", None):
                 dist = get_distribution(
                     dist_name,
                     tweedie_power=self.params.get("tweedie_power", 1.5),

@@ -29,10 +29,13 @@ EPS = 1e-10
 
 
 def raw_from_F(F, dom, dist_name: str, tweedie_power: float = 1.5,
-               threshold: float = 0.5):
+               threshold: float = 0.5, custom_link: str = None):
     """Link-scale forest sum -> raw predictions (shared by BigScore-style
     full scoring and the driver's incremental per-block scoring)."""
     if dom is None:
+        if dist_name == "custom":
+            from h2o_tpu.core.udf import custom_link_inv
+            return custom_link_inv(custom_link, F[:, 0])
         dist = get_distribution(dist_name, tweedie_power=tweedie_power)
         return dist.link_inv(F[:, 0])
     if len(dom) == 2:
@@ -63,7 +66,8 @@ class GBMModel(Model):
                           out["distribution_resolved"],
                           self.params.get("tweedie_power", 1.5),
                           threshold=float(out.get("default_threshold",
-                                                  0.5)))
+                                                  0.5)),
+                          custom_link=out.get("custom_link"))
 
 
 class GBM(ModelBuilder):
@@ -89,7 +93,8 @@ class GBM(ModelBuilder):
                  stopping_rounds=0, stopping_metric="AUTO",
                  stopping_tolerance=1e-3, build_tree_one_node=False,
                  calibrate_model=False, bf16_histograms=False,
-                 monotone_constraints=None)
+                 monotone_constraints=None,
+                 custom_distribution_func=None)
         return p
 
     @staticmethod
@@ -159,13 +164,25 @@ class GBM(ModelBuilder):
         active = di.valid_mask()
         R = bins.shape[0]
 
+        # custom distribution (water/udf CDistributionFunc; the stock
+        # client's h2o.upload_custom_distribution flow)
+        custom = None
+        if dist_name == "custom":
+            ref = p.get("custom_distribution_func")
+            if not ref:
+                raise ValueError("distribution='custom' requires "
+                                 "custom_distribution_func")
+            from h2o_tpu.core.udf import load_custom_distribution
+            custom = load_custom_distribution(ref)
+
         # f0 on link scale
-        dist = get_distribution(dist_name if dist_name != "multinomial"
-                                else "gaussian",
-                                tweedie_power=p["tweedie_power"],
-                                quantile_alpha=p["quantile_alpha"],
-                                huber_alpha=p["huber_alpha"])
         wa = jnp.where(active, w, 0.0)
+        if dist_name != "custom":
+            dist = get_distribution(
+                dist_name if dist_name != "multinomial" else "gaussian",
+                tweedie_power=p["tweedie_power"],
+                quantile_alpha=p["quantile_alpha"],
+                huber_alpha=p["huber_alpha"])
         if dist_name == "multinomial":
             pri = jnp.stack([jnp.sum(wa * (yv == k)) for k in range(K)])
             pri = pri / jnp.maximum(jnp.sum(pri), EPS)
@@ -173,6 +190,11 @@ class GBM(ModelBuilder):
         elif dist_name == "bernoulli":
             dist = get_distribution("bernoulli")
             f0 = dist.init_f0(jnp.where(active, yv, 0.0), wa)[None]
+        elif dist_name == "custom":
+            mask = np.asarray(active)
+            f0 = jnp.asarray([custom.init_f0(
+                np.nan_to_num(np.asarray(yv))[mask],
+                np.asarray(w)[mask])], jnp.float32)
         else:
             f0 = dist.init_f0(jnp.where(active, jnp.nan_to_num(yv), 0.0),
                               wa)[None]
@@ -211,6 +233,8 @@ class GBM(ModelBuilder):
                     "checkpoint's engine")
         newton = dist_name not in ("gaussian", "laplace", "quantile",
                                    "huber")
+        if custom is not None:
+            newton = custom.newton
         if p.get("force_newton"):
             # XGBoost semantics: Newton leaf values for every objective
             # (squared error has unit hessian, so wg/(wh+reg_lambda))
@@ -238,6 +262,7 @@ class GBM(ModelBuilder):
                 child=ch,
                 max_depth=depth, f0=f0_out, effective_max_depth=depth,
                 distribution_resolved=dist_name,
+                custom_link=custom.link_name if custom else None,
                 response_domain=di.response_domain if nclass >= 2 else None,
                 domains={c: list(train.vec(c).domain)
                          for c in di.cat_names},
@@ -269,7 +294,8 @@ class GBM(ModelBuilder):
             reg_lambda=float(p.get("reg_lambda") or 0.0),
             col_sample_rate_per_tree=float(
                 p.get("col_sample_rate_per_tree") or 1.0),
-            huber_alpha=float(p["huber_alpha"]), kleaves=kleaves)
+            huber_alpha=float(p["huber_alpha"]), kleaves=kleaves,
+            custom_dist=custom)
         mono = self._mono_array(p, di)
         if mono is not None:
             train_kwargs["mono"] = jnp.asarray(mono)
@@ -306,7 +332,9 @@ class GBM(ModelBuilder):
 
             def to_metrics(Fv, ntot):
                 raw = raw_from_F(Fv, dom_sc, dist_name,
-                                 float(p["tweedie_power"]))
+                                 float(p["tweedie_power"]),
+                                 custom_link=custom.link_name
+                                 if custom else None)
                 return proto.metrics_from_raw(raw, score_frame)
 
             scorer = IncrementalScorer(bins_sc, F_sc, depth, to_metrics,

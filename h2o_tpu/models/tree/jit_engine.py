@@ -397,7 +397,7 @@ class TrainedForest(NamedTuple):
                      "mode", "tweedie_power", "quantile_alpha",
                      "huber_alpha", "reg_lambda",
                      "col_sample_rate_per_tree", "use_mono",
-                     "kleaves"))
+                     "kleaves", "custom_dist"))
 def train_forest(bins, yv, w, active, F0, is_cat, key, *, dist_name: str,
                  K: int, ntrees: int, max_depth: int, nbins: int,
                  k_cols: int, newton: bool, sample_rate: float,
@@ -409,7 +409,8 @@ def train_forest(bins, yv, w, active, F0, is_cat, key, *, dist_name: str,
                  huber_alpha: float = 0.9, reg_lambda: float = 0.0,
                  col_sample_rate_per_tree: float = 1.0,
                  mono=None, use_mono: bool = False,
-                 t0: int = 0, kleaves: int = 0) -> TrainedForest:
+                 t0: int = 0, kleaves: int = 0,
+                 custom_dist=None) -> TrainedForest:
     """The WHOLE forest training loop as one XLA program.
 
     mode="gbm": boosting — stats from distribution gradients at current F,
@@ -439,6 +440,11 @@ def train_forest(bins, yv, w, active, F0, is_cat, key, *, dist_name: str,
             yk = (yv == kcls).astype(jnp.float32)
             g = yk - p
             h = jnp.maximum(p * (1.0 - p), EPS)
+        elif dist_name == "custom":
+            # user CDistributionFunc (core/udf.py CustomDistribution):
+            # traced through jit like any engine distribution
+            g = jnp.nan_to_num(custom_dist.gradient(yv, F[:, 0]))
+            h = jnp.nan_to_num(custom_dist.hessian(yv, F[:, 0]))
         else:
             dist = get_distribution(dist_name, tweedie_power=tweedie_power,
                                     quantile_alpha=quantile_alpha,

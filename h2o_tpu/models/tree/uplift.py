@@ -11,7 +11,9 @@ TPU-native: the SAME 4-slot MXU histogram kernel as GBM/DRF, but the slots
 carry (w_treat, w_treat·y, w_ctrl, w_ctrl·y) — the uplift divergence gain
 is then a closed-form expression over bin cumsums, vectorized across every
 (leaf, col, bin, na-direction) candidate at once; the whole forest is one
-lax.scan XLA program like jit_engine.
+lax.scan XLA program on the sparse-frontier pool engine (jit_engine
+pattern: live leaves capped per level, explicit child pointers), so deep
+uplift trees train with bounded memory like GBM/DRF.
 """
 
 from __future__ import annotations
@@ -104,21 +106,46 @@ def _find_uplift_splits(hist, col_allowed, metric: str, min_rows: float):
     p_c = rate(at_col(tot[2]), at_col(tot[3]))
     n_leaf = jnp.take_along_axis(tot[0] + tot[2], col[:, None],
                                  axis=1)[:, 0]
+    # child rates at the chosen split (pre-written as child values, so
+    # no extra final-level histogram pass is needed)
+    li = jnp.arange(L)
+
+    def pick(cum, na):
+        base = cum[li, col, split_b]
+        return base + jnp.where(na_left, na[li, col], 0.0)
+
+    lwt_s, lwty_s = pick(cwt, nat[0]), pick(cwty, nat[1])
+    lwc_s, lwcy_s = pick(cwc, nat[2]), pick(cwcy, nat[3])
+    l_pt = rate(lwt_s, lwty_s)
+    l_pc = rate(lwc_s, lwcy_s)
+    r_pt = rate(at_col(tot[0]) - lwt_s, at_col(tot[1]) - lwty_s)
+    r_pc = rate(at_col(tot[2]) - lwc_s, at_col(tot[3]) - lwcy_s)
+    l_n = lwt_s + lwc_s
     return dict(do_split=do_split, col=col, bitset=bitset,
-                p_t=p_t, p_c=p_c, n=n_leaf)
+                p_t=p_t, p_c=p_c, n=n_leaf,
+                l_pt=l_pt, l_pc=l_pc, r_pt=r_pt, r_pc=r_pc,
+                l_n=l_n, r_n=n_leaf - l_n)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("ntrees", "max_depth", "nbins", "k_cols", "metric",
-                     "sample_rate", "min_rows"))
+                     "sample_rate", "min_rows", "kleaves"))
 def _train_uplift_forest(bins, treat, yv, w, active, key, *, ntrees: int,
                          max_depth: int, nbins: int, k_cols: int,
-                         metric: str, sample_rate: float, min_rows: float):
-    """Whole uplift forest as one XLA program (jit_engine pattern)."""
+                         metric: str, sample_rate: float, min_rows: float,
+                         kleaves: int = 4096):
+    """Whole uplift forest as one XLA program — the sparse-frontier
+    pool engine (jit_engine.build_tree_frontier pattern): live leaves
+    capped at ``kleaves`` per level with best-first selection by node
+    size, nodes in a grows-with-splits pool with explicit child
+    pointers.  Child rates come from the split's own cumsums, so no
+    extra final-level histogram pass is needed."""
+    from h2o_tpu.models.tree.jit_engine import frontier_plan
     R, C = bins.shape
     D, B = max_depth, nbins
-    H = 2 ** (D + 1) - 1
+    widths = frontier_plan(D, kleaves)
+    N = 1 + 2 * sum(widths)
 
     def one_tree(carry, key_t):
         ks, kc = jax.random.split(key_t)
@@ -126,15 +153,17 @@ def _train_uplift_forest(bins, treat, yv, w, active, key, *, ntrees: int,
         wa = jnp.where(samp, w, 0.0)
         stats = jnp.stack([wa * treat, wa * treat * yv,
                            wa * (1 - treat), wa * (1 - treat) * yv], axis=1)
-        split_col = jnp.full((H,), -1, jnp.int32)
-        bitset = jnp.zeros((H, B + 1), bool)
-        val_t = jnp.zeros((H,), jnp.float32)
-        val_c = jnp.zeros((H,), jnp.float32)
-        leaf = jnp.where(samp, 0, -1)
+        split_col = jnp.full((N + 1,), -1, jnp.int32)   # +1 trash slot
+        bitset = jnp.zeros((N + 1, B + 1), bool)
+        val_t = jnp.zeros((N + 1,), jnp.float32)
+        val_c = jnp.zeros((N + 1,), jnp.float32)
+        child = jnp.full((N + 1,), -1, jnp.int32)
+        frontier = jnp.zeros((1,), jnp.int32)
+        slot = jnp.where(samp, 0, -1).astype(jnp.int32)
+        base = 1
         for d in range(D):
-            L = 2 ** d
-            off = L - 1
-            hist = histogram_build_traced(bins, leaf, stats, L, B, 8192,
+            L = widths[d]
+            hist = histogram_build_traced(bins, slot, stats, L, B, 8192,
                                           False)
             kc, kcol = jax.random.split(kc)
             if k_cols < C:
@@ -146,27 +175,53 @@ def _train_uplift_forest(bins, treat, yv, w, active, key, *, ntrees: int,
             s = _find_uplift_splits(hist, col_allowed, metric, min_rows)
             live = s["n"] > 0
             do = s["do_split"] & live
-            split_col = jax.lax.dynamic_update_slice(
-                split_col, jnp.where(do, s["col"], -1), (off,))
-            bitset = jax.lax.dynamic_update_slice(bitset, s["bitset"],
-                                                  (off, 0))
-            val_t = jax.lax.dynamic_update_slice(val_t, s["p_t"], (off,))
-            val_c = jax.lax.dynamic_update_slice(val_c, s["p_c"], (off,))
-            leaf = st._advance_leaves(bins, leaf, do, s["col"],
-                                      s["bitset"])
-        # final level values (bin-summed col-0 slice = leaf totals)
-        L = 2 ** D
-        hist = histogram_build_traced(bins, leaf, stats, L, B, 8192, False)
-        tots = jnp.sum(hist, axis=2)[:, 0, :]                 # (L, 4)
-        p_t = tots[:, 1] / jnp.maximum(tots[:, 0], EPS)
-        p_c = tots[:, 3] / jnp.maximum(tots[:, 2], EPS)
-        val_t = jax.lax.dynamic_update_slice(val_t, p_t, (L - 1,))
-        val_c = jax.lax.dynamic_update_slice(val_c, p_c, (L - 1,))
-        return carry, (split_col, bitset, val_t, val_c)
+            child_ptr = base + 2 * jnp.arange(L, dtype=jnp.int32)
+            split_col = split_col.at[frontier].set(
+                jnp.where(do, s["col"], -1))
+            bitset = bitset.at[frontier].set(s["bitset"] & do[:, None])
+            # node's own rates stand when it terminates here
+            val_t = val_t.at[frontier].set(s["p_t"])
+            val_c = val_c.at[frontier].set(s["p_c"])
+            child = child.at[frontier].set(jnp.where(do, child_ptr, -1))
+            # pre-write child rates at their fresh pool slots
+            cvt = jnp.stack([s["l_pt"], s["r_pt"]], axis=1).reshape(2 * L)
+            cvc = jnp.stack([s["l_pc"], s["r_pc"]], axis=1).reshape(2 * L)
+            cmask = jnp.repeat(do, 2)
+            val_t = jax.lax.dynamic_update_slice(
+                val_t, jnp.where(cmask, cvt, 0.0), (base,))
+            val_c = jax.lax.dynamic_update_slice(
+                val_c, jnp.where(cmask, cvc, 0.0), (base,))
+            if d + 1 < D:
+                L_next = widths[d + 1]
+                # best-first by child size: the biggest nodes have the
+                # most evidence left to split on
+                cn = jnp.stack([s["l_n"], s["r_n"]], axis=1).reshape(2 * L)
+                ckey = jnp.where(cmask, cn, -jnp.inf)
+                if 2 * L <= L_next:
+                    sel = jnp.arange(2 * L, dtype=jnp.int32)
+                else:
+                    _, sel = jax.lax.top_k(ckey, L_next)
+                    sel = sel.astype(jnp.int32)
+                sel_valid = jnp.take(ckey, sel) > -jnp.inf
+                frontier = jnp.where(sel_valid, base + sel, N)
+                inv = jnp.full((2 * L,), -1, jnp.int32).at[sel].set(
+                    jnp.where(sel_valid,
+                              jnp.arange(L_next, dtype=jnp.int32), -1))
+                act = slot >= 0
+                sl = jnp.maximum(slot, 0)
+                c = s["col"][sl]
+                b = jnp.take_along_axis(bins, c[:, None], axis=1)[:, 0]
+                go_left = s["bitset"][sl, b]
+                cand = 2 * sl + jnp.where(go_left, 0, 1)
+                new_slot = jnp.where(act & do[sl], inv[cand], -1)
+                slot = jnp.where(act, new_slot, slot)
+            base += 2 * L
+        return carry, (split_col[:N], bitset[:N], val_t[:N], val_c[:N],
+                       child[:N])
 
-    _, (sc, bs, vt, vc) = jax.lax.scan(one_tree, 0,
-                                       jax.random.split(key, ntrees))
-    return sc, bs, vt, vc
+    _, (sc, bs, vt, vc, ch) = jax.lax.scan(one_tree, 0,
+                                           jax.random.split(key, ntrees))
+    return sc, bs, vt, vc, ch
 
 
 class UpliftDRFModel(Model):
@@ -182,10 +237,14 @@ class UpliftDRFModel(Model):
         T = max(int(out["ntrees_actual"]), 1)
         sc = jnp.asarray(out["split_col"])[:, None]
         bs = jnp.asarray(out["bitset"])[:, None]
+        ch = jnp.asarray(out["child"])[:, None] \
+            if out.get("child") is not None else None
         pt = st.forest_score(bins, sc, bs,
-                             jnp.asarray(out["val_t"])[:, None], D)[:, 0] / T
+                             jnp.asarray(out["val_t"])[:, None], D,
+                             child=ch)[:, 0] / T
         pc = st.forest_score(bins, sc, bs,
-                             jnp.asarray(out["val_c"])[:, None], D)[:, 0] / T
+                             jnp.asarray(out["val_c"])[:, None], D,
+                             child=ch)[:, 0] / T
         return jnp.stack([pt - pc, pt, pc], axis=1)
 
     def predict(self, frame: Frame) -> Frame:
@@ -253,23 +312,27 @@ class UpliftDRF(ModelBuilder):
             mtries = max(1, int(np.sqrt(C)))
         elif mtries <= 0:
             mtries = C
-        depth = min(int(p["max_depth"]), 12)
+        from h2o_tpu.core.log import get_logger
+        from h2o_tpu.models.tree.jit_engine import (clamp_depth,
+                                                    max_live_leaves)
+        depth = clamp_depth(int(p["max_depth"]), get_logger("upliftdrf"))
         if depth != int(p["max_depth"]):
-            job.warn(f"max_depth={p['max_depth']} exceeds the uplift "
-                     f"engine's dense-heap limit; trees were built to "
-                     f"depth {depth}")
+            job.warn(f"max_depth={p['max_depth']} exceeds the engine "
+                     f"depth limit; trees were built to depth {depth}")
         T = int(p["ntrees"])
         job.update(0.1, f"training {T} uplift trees")
-        sc, bs, vt, vc = _train_uplift_forest(
+        sc, bs, vt, vc, ch = _train_uplift_forest(
             binned.bins, treat, yv, w, active, self.rng_key(),
             ntrees=T, max_depth=depth, nbins=binned.nbins, k_cols=mtries,
             metric=(p["uplift_metric"] or "KL").lower(),
             sample_rate=float(p["sample_rate"]),
-            min_rows=float(p["min_rows"]))
+            min_rows=float(p["min_rows"]),
+            kleaves=max_live_leaves())
         out = dict(x=list(di.x), split_points=binned.split_points,
                    is_cat=binned.is_cat, nbins=binned.nbins,
                    split_col=np.asarray(sc), bitset=np.asarray(bs),
                    val_t=np.asarray(vt), val_c=np.asarray(vc),
+                   child=np.asarray(ch),
                    max_depth=depth, ntrees_actual=T,
                    response_domain=di.response_domain,
                    domains={c: list(train.vec(c).domain)

@@ -99,6 +99,11 @@ def export_mojo(model, path: str) -> str:
         raise NotImplementedError(
             f"algo '{model.algo}' has no MOJO scorer; supported: "
             f"{sorted(n[6:] for n in dir(scorers) if n.startswith('score_'))}")
+    if model.output.get("custom_link") is not None:
+        raise NotImplementedError(
+            "models trained with a custom distribution carry a python "
+            "UDF the standalone artifact cannot embed; score through "
+            "the cluster or retrain with a built-in distribution")
     arrays, meta = _flatten_arrays(model.output)
     params = {}
     for k, v in model.params.items():

@@ -284,11 +284,17 @@ _GBM_DIST = {"bernoulli": ("bernoulli", "logit"),
 def write_tree_mojo(model) -> bytes:
     """GBM/DRF model -> genmodel MOJO zip bytes.
 
+    Custom-distribution models are refused: the artifact cannot embed
+    the python UDF (the reference's MOJO has the same restriction).
+
     XGBoost/DT models are mathematically this engine's GBM/DRF trees
     (models/tree/{xgboost,dt}.py), so they export in those byte formats —
     a real genmodel jar scores them as gbm/drf (the reference's xgboost
     MOJO wraps a native booster blob that has no TPU analog)."""
     out = model.output
+    if out.get("custom_link") is not None:
+        raise NotImplementedError(
+            "custom-distribution models cannot export a standalone MOJO")
     algo = {"xgboost": "gbm", "dt": "drf"}.get(model.algo, model.algo)
     x = list(out["x"])
     dom_map = out.get("domains") or {}
@@ -962,6 +968,10 @@ def write_genmodel_mojo(model) -> bytes:
             "encoder step — score through the cluster, or retrain "
             "without preprocessing for a standalone MOJO")
     if model.algo in ("gbm", "drf", "xgboost", "dt"):
+        if model.algo == "xgboost" and \
+                model.output.get("split_col") is None:
+            # booster='gblinear' delegates to GLM: coefficient output
+            return write_glm_mojo(model)
         return write_tree_mojo(model)
     if model.algo == "glm":
         return write_glm_mojo(model)

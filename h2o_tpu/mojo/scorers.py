@@ -265,7 +265,10 @@ def score_naivebayes(arrays, meta, X):
 
 
 def score_xgboost(arrays, meta, X):
-    """XGBoost models ARE this engine's GBM trees (models/tree/xgboost)."""
+    """XGBoost models ARE this engine's GBM trees (models/tree/xgboost);
+    booster='gblinear' delegates to GLM and scores as one."""
+    if "split_col" not in arrays:
+        return score_glm(arrays, meta, X)
     return score_gbm(arrays, meta, X)
 
 

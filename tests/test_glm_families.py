@@ -173,6 +173,44 @@ def test_new_family_mojo_round_trips(xmat, cl, tmp_path):
     assert np.abs(g - clu).max() < 1e-4
 
 
+def test_beta_constraints_box_bounds(xmat, cl):
+    """GLM.java betaConstraints: per-coef lower/upper bounds, honored by
+    the COD projection; given in raw space, transformed by sigma when
+    standardizing."""
+    rng, X = xmat
+    y = X @ np.array([0.8, -0.5, 0.3, 0.0]) + 1.5 + \
+        rng.normal(scale=0.5, size=X.shape[0])
+    fr = _frame(X, y.astype(np.float32))
+    bc = {"x0": (None, 0.5),          # cap below the true 0.8
+          "x1": (0.0, None)}          # force the true -0.5 up to >= 0
+    m = GLM(family="gaussian", lambda_=0.0, standardize=True,
+            beta_constraints=bc).train(y="y", training_frame=fr)
+    co = m.coef()
+    assert co["x0"] <= 0.5 + 1e-6
+    assert co["x1"] >= -1e-6
+    # unconstrained coefs still free
+    assert abs(co["x2"] - 0.3) < 0.1
+    # frame-keyed constraints (the stock-client path) resolve via DKV
+    from h2o_tpu.core.cloud import cloud
+    from h2o_tpu.core.frame import Frame as _F, Vec as _V, T_STR
+    cfr = _F(["names", "lower_bounds", "upper_bounds"],
+             [_V(["x0", "x1"], T_STR),
+              _V(np.array([np.nan, 0.0], np.float32)),
+              _V(np.array([0.5, np.nan], np.float32))])
+    cloud().dkv.put("bc_frame", cfr)
+    try:
+        m2 = GLM(family="gaussian", lambda_=0.0,
+                 beta_constraints="bc_frame").train(
+            y="y", training_frame=fr)
+        co2 = m2.coef()
+        assert co2["x0"] <= 0.5 + 1e-6 and co2["x1"] >= -1e-6
+    finally:
+        cloud().dkv.remove("bc_frame")
+    with pytest.raises(ValueError, match="unknown coefficient"):
+        GLM(family="gaussian", beta_constraints={"nope": (0, 1)}).train(
+            y="y", training_frame=fr)
+
+
 def test_coefficients_table_always_present_for_glm(xmat, cl):
     rng, X = xmat
     y = (rng.uniform(size=X.shape[0]) > 0.5).astype(np.int32)

@@ -21,9 +21,16 @@ from h2o_tpu.models.deeplearning import (DeepLearning, init_params,
 @pytest.fixture()
 def tp_cloud():
     """4x2 mesh (DP over 4 nodes x TP over 2 model shards)."""
+    prev = Cloud._instance
     cl = Cloud.boot(nodes=4, model_axis=2, row_align=8)
     yield cl
-    Cloud.boot()          # restore the default mesh for later tests
+    # restore the ORIGINAL session cloud (same instance => same DKV —
+    # a fresh boot here would silently empty the store every later
+    # module reads through cloud())
+    if prev is not None:
+        Cloud._instance = prev
+    else:
+        Cloud.boot()
 
 
 def _frame(R=640, C=6, seed=0):

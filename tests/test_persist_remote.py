@@ -47,6 +47,23 @@ class _Srv(http.server.BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(b"{}")
 
+    def do_PUT(self):            # fake WebHDFS: NameNode 307 -> DataNode
+        if "op=CREATE" in self.path and "datanode" not in self.path:
+            host = self.headers.get("Host")
+            self.send_response(307)
+            self.send_header("Location",
+                             f"http://{host}{self.path}&datanode=1")
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        n = int(self.headers.get("Content-Length", 0))
+        data = self.rfile.read(n)
+        path = self.path.split("?")[0].replace("/webhdfs/v1", "")
+        self.store["/hdfs" + path] = data
+        self.send_response(201)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
     def log_message(self, *a):   # keep pytest output clean
         pass
 
@@ -107,6 +124,21 @@ def test_import_file_stock_client_over_http(h2o_rest, http_base):
     fr = h2o.import_file(f"{http_base}/data.csv")
     assert fr.nrow == 4
     assert fr.ncol == 3
+
+
+def test_webhdfs_roundtrip_against_fake_namenode(http_base, monkeypatch):
+    """hdfs:// reads via WebHDFS OPEN; writes via the two-step CREATE
+    (NameNode 307 redirect -> DataNode PUT)."""
+    monkeypatch.setenv("HDFS_NAMENODE_URL", http_base)
+    monkeypatch.setenv("HADOOP_USER_NAME", "h2o")
+    _Srv.store["/webhdfs/v1/data/in.csv"] = CSV     # OPEN hits GET
+    persist.register_hdfs()
+    try:
+        assert persist.read_bytes("hdfs://data/in.csv") == CSV
+        persist.write_bytes("hdfs://data/out.bin", b"\x05\x06")
+        assert _Srv.store["/hdfs/data/out.bin"] == b"\x05\x06"
+    finally:
+        persist.unregister_scheme("hdfs")
 
 
 def test_gcs_roundtrip_against_fake_endpoint(http_base, monkeypatch):
