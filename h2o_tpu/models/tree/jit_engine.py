@@ -42,7 +42,7 @@ still sanity-clamped at ``H2O_TPU_MAX_TREE_DEPTH`` (default 30).
 from __future__ import annotations
 
 import functools
-from typing import Dict, NamedTuple
+from typing import Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -117,6 +117,36 @@ def _node_val(wg, wh, w, newton: bool, reg_lambda: float = 0.0):
     return wg / denom
 
 
+def sibling_subtract_enabled() -> bool:
+    """The reference's DHistogram sibling-subtraction optimization
+    (ScoreBuildHistogram2/DHistogram: histogram one child, derive the
+    other as parent-minus-child).  Here it halves the one-hot matmul
+    width at every level >= 1: only LEFT children are histogrammed and
+    right = parent − left.  Exact in infinite precision (a split
+    partitions its parent's rows); in f32 it reorders accumulation, so
+    an escape hatch remains (H2O_TPU_SIBLING_SUBTRACT=0)."""
+    import os
+    return os.environ.get("H2O_TPU_SIBLING_SUBTRACT", "1") != "0"
+
+
+def _hist_level_with_sibling(bins, slot, stats, L: int, B: int, cfg,
+                             parent_hist, parent_split):
+    """Level-d histograms via sibling subtraction.
+
+    ``slot`` numbers children as 2*parent+{0,1} (both engines use this
+    interleaved layout on subtraction-eligible levels).  Histograms are
+    built for the L/2 LEFT children only; each right child is its
+    parent's histogram minus the left sibling (masked to split parents —
+    unsplit parents' children have no rows and must stay zero)."""
+    half = L // 2
+    left_slot = jnp.where((slot >= 0) & (slot % 2 == 0), slot // 2, -1)
+    left = _shard_histogram(bins, left_slot, stats, half, B,
+                            cfg["block_rows"], cfg["bf16"])
+    right = jnp.where(parent_split[:, None, None, None],
+                      parent_hist - left, 0.0)
+    return jnp.stack([left, right], axis=1).reshape(L, *left.shape[1:])
+
+
 def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
                       tree_col_mask=None, mono=None):
     """Traceable single-tree build.  Returns (split_col, bitset, value,
@@ -144,11 +174,17 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
     lo_b = jnp.full((1,), -jnp.inf, jnp.float32)
     hi_b = jnp.full((1,), jnp.inf, jnp.float32)
 
+    sib = bool(cfg.get("sibling", True))
+    prev_hist = prev_do = None
     for d in range(D):                       # static unroll — exact L per level
         L = 2 ** d
         off = L - 1
-        hist = _shard_histogram(bins, leaf, stats, L, B,
-                                cfg["block_rows"], cfg["bf16"])
+        if sib and d >= 1:
+            hist = _hist_level_with_sibling(bins, leaf, stats, L, B, cfg,
+                                            prev_hist, prev_do)
+        else:
+            hist = _shard_histogram(bins, leaf, stats, L, B,
+                                    cfg["block_rows"], cfg["bf16"])
         if k_cols < C:
             key, sub = jax.random.split(key)
             r = jax.random.uniform(sub, (L, C))
@@ -214,6 +250,7 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
         child = 2 * lf + jnp.where(go_left, 0, 1)
         leaf = jnp.where(active & do_split[lf], child,
                          jnp.where(active, -1, leaf))
+        prev_hist, prev_do = hist, do_split
     return split_col, bitset, value, varimp, node_gain
 
 
@@ -260,10 +297,20 @@ def build_tree_frontier(bins, stats, slot0, key, is_cat, cfg: Dict,
     hi_b = jnp.full((1,), jnp.inf, jnp.float32)
     base = 1                                       # next free pool slot
 
+    sib = bool(cfg.get("sibling", True))
+    prev_hist = prev_do = None
     for d in range(D):                             # static unroll
         L = widths[d]
-        hist = _shard_histogram(bins, slot, stats, L, B,
-                                cfg["block_rows"], cfg["bf16"])
+        if sib and d >= 1 and L == 2 * widths[d - 1]:
+            # uncapped transition: children sit at 2*parent+{0,1} in
+            # parent order (identity selection), so the dense sibling
+            # subtraction applies verbatim; capped levels (top_k
+            # reshuffles slots) fall back to the full histogram
+            hist = _hist_level_with_sibling(bins, slot, stats, L, B, cfg,
+                                            prev_hist, prev_do)
+        else:
+            hist = _shard_histogram(bins, slot, stats, L, B,
+                                    cfg["block_rows"], cfg["bf16"])
         if k_cols < C:
             key, sub = jax.random.split(key)
             r = jax.random.uniform(sub, (L, C))
@@ -351,6 +398,7 @@ def build_tree_frontier(bins, stats, slot0, key, is_cat, cfg: Dict,
             if use_mono:
                 lo_b = jnp.take(lo_c, sel)
                 hi_b = jnp.take(hi_c, sel)
+        prev_hist, prev_do = hist, do_split
         base += 2 * L
 
     return (split_col[:N], bitset[:N], value[:N], child[:N], varimp,
@@ -388,6 +436,16 @@ class TrainedForest(NamedTuple):
     child: object = None   # (T, K, N) left-child pool ptrs; None = dense
 
 
+def train_forest(*args, sibling: Optional[bool] = None, **kwargs):
+    """Public entry: resolves the sibling-subtraction flag from the env
+    OUTSIDE the trace (it is a static jit arg — part of the executable
+    cache key — so toggling H2O_TPU_SIBLING_SUBTRACT between trainings
+    takes effect instead of hitting a stale cached program)."""
+    if sibling is None:
+        sibling = sibling_subtract_enabled()
+    return _train_forest_jit(*args, sibling=sibling, **kwargs)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("dist_name", "K", "ntrees", "max_depth", "nbins",
@@ -397,8 +455,9 @@ class TrainedForest(NamedTuple):
                      "mode", "tweedie_power", "quantile_alpha",
                      "huber_alpha", "reg_lambda",
                      "col_sample_rate_per_tree", "use_mono",
-                     "kleaves", "custom_dist"))
-def train_forest(bins, yv, w, active, F0, is_cat, key, *, dist_name: str,
+                     "kleaves", "custom_dist", "sibling"))
+def _train_forest_jit(bins, yv, w, active, F0, is_cat, key, *,
+                      dist_name: str,
                  K: int, ntrees: int, max_depth: int, nbins: int,
                  k_cols: int, newton: bool, sample_rate: float,
                  learn_rate: float, learn_rate_annealing: float,
@@ -410,7 +469,8 @@ def train_forest(bins, yv, w, active, F0, is_cat, key, *, dist_name: str,
                  col_sample_rate_per_tree: float = 1.0,
                  mono=None, use_mono: bool = False,
                  t0: int = 0, kleaves: int = 0,
-                 custom_dist=None) -> TrainedForest:
+                 custom_dist=None,
+                 sibling: bool = True) -> TrainedForest:
     """The WHOLE forest training loop as one XLA program.
 
     mode="gbm": boosting — stats from distribution gradients at current F,
@@ -418,13 +478,15 @@ def train_forest(bins, yv, w, active, F0, is_cat, key, *, dist_name: str,
     mode="drf": bagging — stats fixed on the response, no f update (F output
     accumulates raw votes; caller divides by ntrees).
     kleaves=0: dense heap engine; >0: sparse-frontier engine with that
-    live-leaf cap (module docstring).
+    live-leaf cap (module docstring).  ``sibling`` (static; resolved by
+    the train_forest wrapper) enables histogram sibling subtraction.
     """
     cfg = dict(max_depth=max_depth, nbins=nbins, k_cols=k_cols,
                newton=newton, min_rows=min_rows,
                min_split_improvement=min_split_improvement,
                block_rows=block_rows, bf16=bf16, reg_lambda=reg_lambda,
-               use_mono=use_mono, max_live_leaves=kleaves)
+               use_mono=use_mono, max_live_leaves=kleaves,
+               sibling=sibling)
     R = bins.shape[0]
 
     def stats_for(kcls, F):

@@ -23,9 +23,13 @@ cross-node reduce is an ICI ``psum`` of the fixed-shape (L, C, B+1, S)
 tensor, replacing the reference's software binomial tree (MRTask.java:94-117).
 
 The NA bucket is bin index B (DHistogram INT_NA analog), so split finding can
-try NA-left vs NA-right.  The sibling-subtraction optimization (compute the
-smaller child, derive the other as parent-minus-child) lives in the tree
-builder, not here.
+try NA-left vs NA-right.  The sibling-subtraction optimization (histogram the
+LEFT children only, derive each right child as parent-minus-left — reference
+DHistogram) lives in the GBM/DRF tree builders
+(models/tree/jit_engine.py _hist_level_with_sibling): it halves this
+kernel's matmul width on every level whose parent level was uncapped
+(all levels >= 1 in the dense engine; the frontier engine's capped/top_k
+levels and the uplift engine use the full histogram).
 """
 
 from __future__ import annotations
