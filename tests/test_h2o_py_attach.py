@@ -264,3 +264,22 @@ def test_frame_remove(h2o_client):
     except (H2OResponseError, H2OServerError, KeyError):
         gone = None
     assert gone is None
+
+
+def test_glm_p_values_coef_table(h2o_client, uploaded):
+    """compute_p_values through the stock client: the coefficients
+    table renders as an H2OTwoDimTable with std_error/z_value/p_value
+    and coef() returns de-standardized values (VERDICT r3 item 4)."""
+    from h2o.estimators import H2OGeneralizedLinearEstimator
+    glm = H2OGeneralizedLinearEstimator(family="binomial", lambda_=0.0,
+                                        compute_p_values=True)
+    glm.train(x=["a", "b"], y="y", training_frame=uploaded)
+    tbl = glm._model_json["output"]["coefficients_table"]
+    assert {"names", "coefficients", "std_error", "z_value",
+            "p_value"} <= set(tbl.col_header)
+    co = glm.coef()
+    assert set(co) >= {"a", "b", "Intercept"}
+    rows = {r[0]: r for r in tbl.cell_values}
+    # a drives y in the fixture -> strongly significant
+    pv = rows["a"][tbl.col_header.index("p_value")]
+    assert pv < 1e-4
