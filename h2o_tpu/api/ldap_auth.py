@@ -14,6 +14,25 @@ import socket
 from typing import Tuple
 
 
+def escape_dn_value(value: str) -> str:
+    """RFC 4514 §2.4 escaping for one attribute value inside a DN.
+
+    Without this, a crafted HTTP Basic username like ``cn=svc,dc=x``
+    substituted into the ldap_dn_template would alter the DN structure
+    and bind outside the subtree the template constrains."""
+    out = []
+    for i, ch in enumerate(value):
+        if ch in ',+"\\<>;=' or \
+                (ch == " " and i in (0, len(value) - 1)) or \
+                (ch == "#" and i == 0):
+            out.append("\\" + ch)
+        elif ord(ch) < 0x20:           # control chars -> hex pairs
+            out.append("\\%02x" % ord(ch))
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
 def _ber_len(n: int) -> bytes:
     if n < 0x80:
         return bytes([n])
@@ -41,6 +60,11 @@ def _read_tlv(buf: bytes, off: int) -> Tuple[int, bytes, int]:
     off += 2
     if ln & 0x80:
         n = ln & 0x7F
+        if off + n > len(buf):
+            # long-form length straddles a TCP segment: decoding the
+            # partial slice would yield a bogus length — signal
+            # "incomplete" so the caller keeps buffering
+            raise IndexError("partial BER length")
         ln = int.from_bytes(buf[off: off + n], "big")
         off += n
     return tag, buf[off: off + ln], off + ln
@@ -83,13 +107,17 @@ def ldap_bind(host: str, port: int, dn: str, password: str,
                 continue                   # payload not complete yet
             if tag != 0x30:
                 return False
-            # LDAPMessage: messageID, then BindResponse [APPLICATION 1]
-            _t, _mid, off = _read_tlv(msg, 0)
-            rtag, resp, _ = _read_tlv(msg, off)
-            if rtag != 0x61:
-                return False
-            # BindResponse: resultCode ENUMERATED, matchedDN, diag
-            ctag, code, _ = _read_tlv(resp, 0)
+            try:
+                # LDAPMessage: messageID, then BindResponse
+                # [APPLICATION 1]
+                _t, _mid, off = _read_tlv(msg, 0)
+                rtag, resp, _ = _read_tlv(msg, off)
+                if rtag != 0x61:
+                    return False
+                # BindResponse: resultCode ENUMERATED, matchedDN, diag
+                ctag, code, _ = _read_tlv(resp, 0)
+            except IndexError:
+                return False           # malformed response -> deny
             return ctag == 0x0A and code == b"\x00"
 
 
@@ -106,6 +134,11 @@ def parse_ldap_url(url: str) -> Tuple[str, int, bool]:
                          " (use ldap:// or ldaps://)")
     tls = scheme == "ldaps"
     rest = rest.rstrip("/")
+    if rest.startswith("["):           # bracketed IPv6 literal
+        host, _, tail = rest[1:].partition("]")
+        if tail.startswith(":"):
+            return host, int(tail[1:]), tls
+        return host, (636 if tls else 389), tls
     if ":" in rest:
         host, port = rest.rsplit(":", 1)
         return host, int(port), tls

@@ -140,8 +140,10 @@ class _Handler(BaseHTTPRequestHandler):
         """HTTP Basic auth when the server was configured with credentials
         (reference: water/webserver JAAS Basic login; client
         h2o.connect(auth=(user, password))).  With ldap_url configured,
-        the credentials are verified by an LDAPv3 simple bind instead of
-        the static pair (JAAS LdapLoginModule analog)."""
+        credentials are verified by an LDAPv3 simple bind (JAAS
+        LdapLoginModule analog); a static basic_auth pair configured
+        alongside it stays reachable as an operator-lockout fallback
+        when the bind fails or the directory is down."""
         srv = getattr(self.server, "_rest_server", None)
         expected = getattr(srv, "basic_auth", None)
         ldap_url = getattr(srv, "ldap_url", None)
@@ -156,18 +158,26 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception:  # noqa: BLE001 — malformed header
                 got = ""
             if ldap_url:
-                from h2o_tpu.api.ldap_auth import ldap_bind, parse_ldap_url
+                from h2o_tpu.api.ldap_auth import (escape_dn_value,
+                                                   ldap_bind,
+                                                   parse_ldap_url)
                 user, _, pw = got.partition(":")
                 tmpl = srv.ldap_dn_template or "{}"
                 host, lport, tls = parse_ldap_url(ldap_url)
                 try:
+                    # RFC 4514-escape the username: a raw ',' or '='
+                    # would alter the DN structure and escape the
+                    # subtree the template constrains logins to
                     if user and ldap_bind(host, lport,
-                                          tmpl.format(user), pw,
-                                          use_tls=tls):
+                                          tmpl.format(
+                                              escape_dn_value(user)),
+                                          pw, use_tls=tls):
                         return True
                 except OSError:
                     pass               # directory unreachable -> 401
-            elif hmac.compare_digest(got, expected):
+            # static pair remains a reachable fallback even when LDAP
+            # is configured (operator lockout guard)
+            if expected and hmac.compare_digest(got, expected):
                 return True
         # the request body was never read — close the connection rather
         # than let keep-alive parse leftover body bytes as a request line

@@ -77,10 +77,22 @@ def _cached_file(subdir: str, key: str, suffix: str, producer,
     temp file before the atomic replace, so concurrent REST threads can
     never interleave; the temp is unlinked on producer failure."""
     import hashlib
+    import stat
     import tempfile
     import time as _time
-    cdir = os.path.join(tempfile.gettempdir(), subdir)
-    os.makedirs(cdir, exist_ok=True)
+    # per-user 0700 subtree: the system temp dir is world-writable, so a
+    # shared predictable path would let another local user pre-create or
+    # poison cache entries (injected training data)
+    cdir = os.path.join(tempfile.gettempdir(),
+                        f"{subdir}_u{os.getuid()}")
+    os.makedirs(cdir, mode=0o700, exist_ok=True)
+    st = os.lstat(cdir)
+    if stat.S_ISLNK(st.st_mode) or st.st_uid != os.getuid():
+        raise PermissionError(
+            f"download cache dir {cdir} is not an owned private "
+            "directory; refusing to trust cached entries")
+    if st.st_mode & 0o077:             # pre-existing looser dir: tighten
+        os.chmod(cdir, 0o700)
     local = os.path.join(
         cdir, hashlib.sha1(key.encode()).hexdigest()[:16] + suffix)
     try:

@@ -235,6 +235,95 @@ def _biff_string(buf: bytes, off: int):
     return text, pos - off
 
 
+class _SSTCursor:
+    """Reads the SST's logical byte stream across CONTINUE segments.
+
+    MS-XLS 2.5.293: a string may split only at a character boundary,
+    and the continued character data starts with a fresh option-flags
+    byte that re-declares the width (compressed/UTF-16) of the
+    remainder; non-character data (headers, format runs, ext blocks)
+    continues byte-for-byte without one."""
+
+    def __init__(self, segments: List[bytes]):
+        self._segs = segments
+        self._si = 0
+        self._off = 0
+
+    def _norm(self):
+        while self._si < len(self._segs) and \
+                self._off >= len(self._segs[self._si]):
+            self._si += 1
+            self._off = 0
+
+    def eof(self) -> bool:
+        self._norm()
+        return self._si >= len(self._segs)
+
+    def read(self, n: int) -> bytes:
+        out = []
+        while n > 0:
+            if self.eof():
+                raise ValueError("SST truncated mid-record")
+            seg = self._segs[self._si]
+            take = min(n, len(seg) - self._off)
+            out.append(seg[self._off: self._off + take])
+            self._off += take
+            n -= take
+        return b"".join(out)
+
+    def read_chars(self, cch: int, high: int) -> str:
+        text = []
+        seg_of_header = self._si
+        while cch > 0:
+            self._norm()
+            if self.eof():
+                raise ValueError("SST truncated mid-string")
+            if self._si != seg_of_header:
+                # character data resumes (or begins — the header can end
+                # exactly at a record boundary) in a continuation
+                # segment: it starts with a fresh option-flags byte
+                high = self._segs[self._si][self._off] & 0x01
+                self._off += 1
+                seg_of_header = self._si
+                continue
+            seg = self._segs[self._si]
+            avail = len(seg) - self._off
+            width = 2 if high else 1
+            take = min(cch, avail // width)
+            if take == 0:
+                if avail:
+                    raise ValueError("SST split inside a character")
+                continue               # segment exhausted: _norm + flags
+            raw = seg[self._off: self._off + take * width]
+            text.append(raw.decode("utf-16-le" if high else "latin-1",
+                                   "ignore"))
+            self._off += take * width
+            cch -= take
+        return "".join(text)
+
+
+def _parse_sst(segments: List[bytes], total: int) -> List[str]:
+    """SST body segments (SST record tail + CONTINUE bodies) -> strings.
+
+    Raises instead of returning a short table: a silently-truncated SST
+    would null out LABELSST cells downstream."""
+    cur = _SSTCursor(segments)
+    sst: List[str] = []
+    while len(sst) < total and not cur.eof():
+        cch = struct.unpack("<H", cur.read(2))[0]
+        flags = cur.read(1)[0]
+        n_runs = struct.unpack("<H", cur.read(2))[0] \
+            if flags & 0x08 else 0
+        ext = struct.unpack("<i", cur.read(4))[0] if flags & 0x04 else 0
+        sst.append(cur.read_chars(cch, flags & 0x01))
+        cur.read(4 * n_runs + max(ext, 0))   # format runs + ext block
+    if len(sst) < total:
+        raise ValueError(
+            f"SST declares {total} strings but only {len(sst)} decoded "
+            "— refusing to produce silently-nulled string cells")
+    return sst
+
+
 def read_xls(path: str) -> List[List[Optional[str]]]:
     """BIFF8 Workbook stream -> rows of cell strings (first sheet)."""
     with open(path, "rb") as f:
@@ -253,16 +342,16 @@ def read_xls(path: str) -> List[List[Optional[str]]]:
             sheets_seen += 1
             if sheets_seen > 2:             # globals + first sheet only
                 break
-        elif op == 0x00FC:                  # SST (CONTINUE not supported
+        elif op == 0x00FC:                  # SST (+ its CONTINUEs)
             total = struct.unpack_from("<I", body, 4)[0]
-            o = 8                           # for the tiny-file use case)
-            while o < len(body) and len(sst) < total:
-                try:
-                    s, used = _biff_string(body, o)
-                except (struct.error, IndexError):
+            segments = [bytes(body[8:])]
+            while pos + 4 <= len(stream):
+                nop, nln = struct.unpack_from("<HH", stream, pos)
+                if nop != 0x003C:           # CONTINUE
                     break
-                sst.append(s)
-                o += used
+                segments.append(bytes(stream[pos + 4: pos + 4 + nln]))
+                pos += 4 + nln
+            sst = _parse_sst(segments, total)
         elif op == 0x00FD and sheets_seen == 2:       # LABELSST
             r, c, _xf, isst = struct.unpack_from("<HHHI", body)
             cells[(r, c)] = sst[isst] if isst < len(sst) else None

@@ -931,7 +931,9 @@ def write_extiso_mojo(model) -> bytes:
     dom_map = out.get("domains") or {}
     domains: List[Optional[List[str]]] = [dom_map.get(c) for c in x]
     w = _ZipWriter()
-    _common_info(w, "isoforextended", "Extended Isolation Forest",
+    # genuine genmodel algo string (ExtendedIsolationForestMojoReader
+    # is registered under "extendedisolationforest")
+    _common_info(w, "extendedisolationforest", "Extended Isolation Forest",
                  "AnomalyDetection", str(model.key), False, len(x), 1,
                  len(x), sum(d is not None for d in domains), "1.00")
     w.writekv("ntrees", T)
@@ -1201,9 +1203,32 @@ def read_genmodel_mojo(data) -> Dict:
                 domain_files[int(ci)] = (int(cnt), fname)
         domains: List[Optional[List[str]]] = [None] * len(columns)
         for ci, (cnt, fname) in domain_files.items():
+            if ci >= len(columns):
+                # genuine H2O artifacts (e.g. pruned-base-model SE
+                # MOJOs) can declare domain indices from the original,
+                # wider column set; the reference skips them
+                # (ModelMojoReader.parseModelDomains: "col_index >=
+                # n_columns continue")
+                continue
             lines = z.read(f"domains/{fname}").decode().splitlines()
             domains[ci] = lines[:cnt]
         algo = info.get("algo", "").lower()
+        if not algo:
+            # mojo v1.0 artifacts (h2o < 3.12) predate the "algo" key;
+            # map the display "algorithm" name instead
+            algo = {
+                "gradient boosting machine": "gbm",
+                "gradient boosting method": "gbm",
+                "distributed random forest": "drf",
+                "generalized linear modeling": "glm",
+                "generalized linear model": "glm",
+                "isolation forest": "isolationforest",
+                "k-means": "kmeans",
+                "deep learning": "deeplearning",
+                "word2vec": "word2vec",
+            }.get(info.get("algorithm", "").lower(), "")
+        if algo == "extendedisolationforest":   # genuine H2O algo string
+            algo = "isoforextended"             # (internal alias)
         result = dict(info=info, columns=columns, domains=domains,
                       algo=algo)
         if algo in ("gbm", "drf", "isolationforest"):
@@ -1275,14 +1300,17 @@ def read_genmodel_mojo(data) -> Dict:
                         if entry.startswith(d):
                             oz.writestr(entry[len(d):], z.read(entry))
                 submodels[key] = buf.getvalue()
-            base = []
-            for i in range(int(info.get("base_models_num", 0))):
-                bk = info.get(f"base_model{i}")
-                if bk is not None:
-                    base.append(bk)
+            # Positional, WITH None holes: the metalearner is fed a flat
+            # basePreds vector indexed by base-model slot i; pruned
+            # ("useless") models keep their slot and contribute 0.0
+            # (StackedEnsembleMojoModel.java:34-58 skips null entries).
+            base = [info.get(f"base_model{i}")
+                    for i in range(int(info.get("base_models_num", 0)))]
             result["stackedensemble"] = dict(
                 submodels=submodels, base_models=base,
-                metalearner=info.get("metalearner"))
+                metalearner=info.get("metalearner"),
+                metalearner_transform=info.get("metalearner_transform",
+                                               "NONE"))
         elif algo == "isoforextended":
             T = int(info.get("ntrees", 0))
             trees_eif = []
@@ -1291,43 +1319,89 @@ def read_genmodel_mojo(data) -> Dict:
                 pos = 0
                 C_b = struct.unpack_from("<i", blob, pos)[0]; pos += 4
                 nodes = {}
-                while pos < len(blob):
+                # genuine H2O blobs are AutoBuffer-backed and can carry
+                # trailing padding past the last record; the reference
+                # scorer never reads it (every descent breaks at a
+                # leaf), so stop at the first non-record byte or when
+                # a record would overrun the buffer
+                while pos + 5 <= len(blob):
                     num = struct.unpack_from("<i", blob, pos)[0]
-                    pos += 4
-                    typ = blob[pos: pos + 1]; pos += 1
+                    typ = blob[pos + 4: pos + 5]
                     if typ == b"N":
+                        if pos + 5 + 16 * C_b > len(blob):
+                            break
+                        pos += 5
                         nvec = np.frombuffer(blob, "<f8", C_b, pos)
                         pos += 8 * C_b
                         pvec = np.frombuffer(blob, "<f8", C_b, pos)
                         pos += 8 * C_b
                         nodes[num] = ("N", nvec, pvec)
-                    else:
+                    elif typ == b"L":
+                        if pos + 5 + 4 > len(blob):
+                            break
+                        pos += 5
                         rows_ = struct.unpack_from("<i", blob, pos)[0]
                         pos += 4
                         nodes[num] = ("L", rows_)
+                    else:
+                        break
                 trees_eif.append(nodes)
             result["isoforextended"] = dict(
                 trees=trees_eif, ntrees=T,
                 sample_size=int(info.get("sample_size", 0)))
         elif algo == "glrm":
             garr = lambda key: _parse_float_arr(info, key)  # noqa: E731
-            k = int(info.get("archetypes_size1", 0))
-            P = int(info.get("archetypes_size2", 0))
+            if "archetypes_size1" in info:     # our writer's key set
+                k = int(info["archetypes_size1"])
+                P = int(info.get("archetypes_size2", 0))
+                cat_cards = [int(v) for v in garr("cat_cards")]
+                loss = info.get("loss", "Quadratic").lower()
+                uafl = info.get("use_all_factor_levels",
+                                "false") == "true"
+                standardize = info.get("standardize", "false") == "true"
+                permutation = None
+            else:                 # genuine H2O GlrmMojoWriter v1.00/1.10
+                k = int(info.get("nrowY", 0))
+                P = int(info.get("ncolY", 0))
+                ncats = int(info.get("num_categories", 0))
+                cat_cards = [int(v) for v in
+                             garr("num_levels_per_category")][:ncats]
+                # per-column loss file; our scorer is single-loss —
+                # accept a uniform numeric loss, refuse mixed ones
+                # loudly rather than score with the wrong objective
+                loss = "quadratic"
+                if "losses" in names:
+                    num_losses = {
+                        ln.strip() for ln in
+                        z.read("losses").decode().splitlines()
+                        if ln.strip() and ln.strip() != "Categorical"}
+                    if len(num_losses) > 1:
+                        raise NotImplementedError(
+                            "GLRM MOJO with mixed per-column losses "
+                            f"{sorted(num_losses)} is not supported by "
+                            "this reader (single-loss X solve)")
+                    if num_losses:
+                        loss = num_losses.pop().lower()
+                uafl = True        # GLRM expands every factor level
+                standardize = True  # normSub/normMul always applied
+                permutation = [int(float(s)) for s in
+                               info.get("cols_permutation", "[]")
+                               .strip("[]").split(",") if s.strip()]
             result["glrm"] = dict(
                 archetypes=np.frombuffer(z.read("archetypes"),
                                          dtype=">f8").astype(
                     np.float64).reshape(k, P),
-                loss=info.get("loss", "Quadratic").lower(),
+                loss=loss,
                 rx=info.get("regularizationX", "None").lower(),
                 gamma_x=float(info.get("gammaX", 0.0)),
                 x_iters=int(info.get(
                     "x_iters",
                     __import__("h2o_tpu.models.glrm",
                                fromlist=["GLRM_X_ITERS"]).GLRM_X_ITERS)),
-                standardize=info.get("standardize", "false") == "true",
-                uafl=info.get("use_all_factor_levels",
-                              "false") == "true",
-                cat_cards=[int(v) for v in garr("cat_cards")],
+                standardize=standardize,
+                uafl=uafl,
+                permutation=permutation,
+                cat_cards=cat_cards,
                 norm_sub=garr("norm_sub"), norm_mul=garr("norm_mul"),
                 cats=int(info.get("num_categories", 0)),
                 nums=int(info.get("num_numeric", 0)))
@@ -1529,7 +1603,17 @@ class GenmodelMojoModel:
             thr = float(info.get("default_threshold", 0.5))
             if p["algo"] == "gbm":
                 init_f = float(info.get("init_f", 0.0))
-                link = info.get("link_function", "identity")
+                link = info.get("link_function")
+                if link is None:
+                    # pre-link_function MOJOs derive it from the
+                    # distribution (ModelMojoReader.defaultLinkFunction)
+                    dist = info.get("distribution", "gaussian")
+                    link = ("logit" if dist in (
+                        "bernoulli", "fractionalbinomial",
+                        "quasibinomial", "modified_huber", "ordinal")
+                        else "log" if dist in ("multinomial", "poisson",
+                                               "gamma", "tweedie")
+                        else "identity")
                 if nclass == 2:
                     p1 = _link_inv(link, preds[:, 0] + init_f)
                     label = (p1 >= thr).astype(np.float64)
@@ -1625,24 +1709,39 @@ class GenmodelMojoModel:
             def sub_score(key):
                 sub = cache[key]
                 sel = [col_idx[c] for c in sub.columns]
-                return sub, np.atleast_2d(
+                return np.atleast_2d(
                     np.asarray(sub.score_matrix(X[:, sel])))
 
-            # level-one features named the way the metalearner was
-            # trained (models/ensemble.py _base_pred_columns)
-            l1: Dict[str, np.ndarray] = {}
-            for bk in se["base_models"]:
-                sub, raw = sub_score(bk)
-                bdom = sub.response_domain
-                if bdom is None:
-                    l1[bk] = raw.reshape(X.shape[0])
-                elif len(bdom) == 2:
-                    l1[bk] = raw[:, 2]
-                else:
-                    for kk, lvl in enumerate(bdom):
-                        l1[f"{bk}/{lvl}"] = raw[:, 1 + kk]
+            # Positional basePreds, exactly score0's layout
+            # (StackedEnsembleMojoModel.java:29-61): slot i for
+            # binomial p1 / regression pred, slots i*K..i*K+K-1 for
+            # multinomial probs; pruned (null) base models leave 0.0.
+            R = X.shape[0]
+            n_base = len(se["base_models"])
+            if nclass > 2:
+                Xm = np.zeros((R, n_base * nclass))
+                for i, bk in enumerate(se["base_models"]):
+                    if bk is None or bk not in cache:
+                        continue
+                    raw = sub_score(bk)
+                    Xm[:, i * nclass: (i + 1) * nclass] = \
+                        raw[:, 1: 1 + nclass]
+            elif nclass == 2:
+                Xm = np.zeros((R, n_base))
+                for i, bk in enumerate(se["base_models"]):
+                    if bk is None or bk not in cache:
+                        continue
+                    Xm[:, i] = sub_score(bk)[:, 2]
+            else:
+                Xm = np.zeros((R, n_base))
+                for i, bk in enumerate(se["base_models"]):
+                    if bk is None or bk not in cache:
+                        continue
+                    Xm[:, i] = sub_score(bk).reshape(R)
+            if nclass >= 2 and se.get("metalearner_transform") == "Logit":
+                q = np.clip(Xm, 1e-9, 1 - 1e-9)
+                Xm = np.maximum(-19.0, np.log(q / (1.0 - q)))
             meta = cache[se["metalearner"]]
-            Xm = np.stack([l1[c] for c in meta.columns], axis=1)
             return meta.score_matrix(Xm)
         if p["algo"] == "isoforextended":
             ei = p["isoforextended"]
@@ -1694,6 +1793,10 @@ class GenmodelMojoModel:
         if p["algo"] == "glrm":
             gl = p["glrm"]
             Y = gl["archetypes"]
+            if gl.get("permutation"):
+                # genuine H2O MOJOs keep external column order; internal
+                # col i reads external col permutation[i] (cats first)
+                X = X[:, gl["permutation"]]
             cats, nums = gl["cats"], gl["nums"]
             lo = 0 if gl["uafl"] else 1
             blocks, masks = [], []

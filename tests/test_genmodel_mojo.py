@@ -476,5 +476,7 @@ def test_extiso_mojo_cross_scoring(cl, rng):
     np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
     with zipfile.ZipFile(io.BytesIO(blob)) as z:
         ini = z.read("model.ini").decode()
-        assert "algo = isoforextended" in ini
+        # the genuine genmodel algo string (ModelMojoFactory registers
+        # EIF under "extendedisolationforest")
+        assert "algo = extendedisolationforest" in ini
         assert "trees/t00.bin" in z.namelist()

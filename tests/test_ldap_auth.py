@@ -110,3 +110,23 @@ def test_rest_server_ldap_auth(cl, ldap_srv, monkeypatch):
         assert get("alice", "s3cret") == 200       # LDAP bind succeeds
     finally:
         srv.stop()
+
+
+def test_escape_dn_value():
+    """RFC 4514 §2.4: structural characters in a username must not
+    rewrite the DN the template constrains (ADVICE r4 medium)."""
+    from h2o_tpu.api.ldap_auth import escape_dn_value
+    assert escape_dn_value("alice") == "alice"
+    assert escape_dn_value("cn=svc,dc=x") == "cn\\=svc\\,dc\\=x"
+    assert escape_dn_value(" lead") == "\\ lead"
+    assert escape_dn_value("trail ") == "trail\\ "
+    assert escape_dn_value("#hash") == "\\#hash"
+    assert escape_dn_value('a+b"c\\d<e>f;g') == \
+        'a\\+b\\"c\\\\d\\<e\\>f\\;g'
+    assert escape_dn_value("nul\x00byte") == "nul\\00byte"
+
+
+def test_parse_ldap_url_ipv6():
+    from h2o_tpu.api.ldap_auth import parse_ldap_url
+    assert parse_ldap_url("ldap://[::1]:3890") == ("::1", 3890, False)
+    assert parse_ldap_url("ldaps://[fe80::2]") == ("fe80::2", 636, True)

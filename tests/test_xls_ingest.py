@@ -127,6 +127,56 @@ def xls_path(tmp_path):
     return str(p)
 
 
+def _biff_stream_continued():
+    """SST split across CONTINUE records (MS-XLS 2.5.293): one boundary
+    between strings, one mid-string where the continued character data
+    re-declares its width with a fresh option-flags byte."""
+    out = b""
+    out += _rec(0x0809, struct.pack("<HH12x", 0x0600, 0x0005))
+    # 5 strings; SST record holds the first two, a CONTINUE holds the
+    # next, then a second CONTINUE starts mid-"blue" ("bl" | flags+"ue")
+    # and a third boundary right AFTER a string header ("green"'s
+    # cch/flags end cont2; its characters open cont3 behind a fresh
+    # option-flags byte)
+    head = struct.pack("<II", 6, 6) + _bstr("num") + _bstr("color")
+    cont1 = _bstr("y") + struct.pack("<HB", 4, 0) + b"bl"
+    cont2 = b"\x00" + b"ue" + _bstr("red") + struct.pack("<HB", 5, 0)
+    cont3 = b"\x00" + b"green"
+    out += _rec(0x00FC, head)
+    out += _rec(0x003C, cont1)
+    out += _rec(0x003C, cont2)
+    out += _rec(0x003C, cont3)
+    out += _rec(0x000A)
+    out += _rec(0x0809, struct.pack("<HH12x", 0x0600, 0x0010))
+    for c, isst in enumerate((0, 1, 2)):
+        out += _rec(0x00FD, struct.pack("<HHHI", 0, c, 0, isst))
+    for r, cc in ((1, 3), (2, 4), (3, 3)):
+        out += _rec(0x0203, struct.pack("<HHHd", r, 0, 0, float(r)))
+        out += _rec(0x00FD, struct.pack("<HHHI", r, 1, 0, cc))
+    out += _rec(0x000A)
+    return out
+
+
+def test_xls_sst_continue(cl, tmp_path):
+    p = tmp_path / "cont.xls"
+    p.write_bytes(_ole2(_biff_stream_continued()))
+    fr = parse_file(str(p))
+    assert fr.names == ["num", "color", "y"]
+    assert sorted(fr.vec("color").domain) == ["blue", "red"]
+
+
+def test_xls_truncated_sst_fails_loudly(cl, tmp_path):
+    """A short SST must raise, never silently null string cells."""
+    out = b""
+    out += _rec(0x0809, struct.pack("<HH12x", 0x0600, 0x0005))
+    out += _rec(0x00FC, struct.pack("<II", 9, 9) + _bstr("only"))
+    out += _rec(0x000A)
+    p = tmp_path / "trunc.xls"
+    p.write_bytes(_ole2(out))
+    with pytest.raises(ValueError, match="SST declares"):
+        parse_file(str(p))
+
+
 def test_xls_parse(cl, xls_path):
     fr = parse_file(xls_path)
     assert fr.names == ["num", "color", "y"]
