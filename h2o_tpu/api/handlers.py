@@ -752,6 +752,61 @@ def _cv_summary_table(summary):
                   ["string"] + ["double"] * (len(cols) - 1), rows)
 
 
+def _scoring_history_table(m: Model):
+    """output.scoring_history as a TwoDimTableV3 (SharedTree
+    doScoringAndSaveModel history; the client's model.scoring_history()
+    and h2o.explain()'s learning_curve_plot read it).  Models trained
+    without periodic scoring still get a single final-metrics row —
+    reference models always score at least once."""
+    out = m.output
+    rows = [dict(r) for r in (out.get("scoring_history") or [])]
+    if not rows:
+        mm = out.get("training_metrics")
+        if mm is None or "split_col" not in out and m.algo not in (
+                "deeplearning", "isolationforest"):
+            return None
+        row = {}
+        if out.get("ntrees_actual") is not None:
+            row["number_of_trees"] = out.get("ntrees_actual")
+        for pfx, met in (("training_", mm),
+                         ("validation_", out.get("validation_metrics"))):
+            if met is None:
+                continue
+            for k in ("mse", "logloss", "AUC", "pr_auc",
+                      "mean_residual_deviance", "err", "mae",
+                      "mean_anomaly_score"):
+                try:
+                    v = met.get(k)
+                except Exception:  # noqa: BLE001
+                    v = None
+                if v is not None:
+                    row[pfx + k.lower()] = float(v)
+        rows = [row]
+    for r in rows:
+        for pfx in ("training_", "validation_"):
+            if pfx + "mse" in r and pfx + "rmse" not in r:
+                r[pfx + "rmse"] = float(r[pfx + "mse"]) ** 0.5
+            if pfx + "err" in r:
+                r[pfx + "classification_error"] = r.pop(pfx + "err")
+            if pfx + "mean_residual_deviance" in r:
+                r[pfx + "deviance"] = r.pop(pfx + "mean_residual_deviance")
+    cols: list = []
+    for r in rows:
+        for k in r:
+            if k not in cols:
+                cols.append(k)
+    lead = [c for c in ("timestamp", "duration", "number_of_trees",
+                        "iterations", "epochs") if c in cols]
+    ordered = lead + [c for c in cols if c not in lead]
+    if not ordered:
+        return None
+    from h2o_tpu.api.handlers_ml import twodim
+    return twodim("Scoring History", ordered,
+                  ["string" if c == "timestamp" else "double"
+                   for c in ordered],
+                  [[r.get(c) for c in ordered] for r in rows])
+
+
 def _model_schema(m: Model) -> dict:
     out = m.output
     return {
@@ -762,9 +817,7 @@ def _model_schema(m: Model) -> dict:
         "data_frame": _key(m.params.get("training_frame", ""),
                            "Key<Frame>"),
         "timestamp": 0,
-        "parameters": [{"name": k, "actual_value": v if not isinstance(
-            v, np.ndarray) else v.tolist()}
-            for k, v in m.params.items() if not str(k).startswith("_")],
+        "parameters": _params_schema(m),
         "output": {
             "model_category": out.get("model_category") or (
                 "Binomial" if out.get("response_domain") and
@@ -800,7 +853,16 @@ def _model_schema(m: Model) -> dict:
                 else None),
             "variable_importances": None,
             "names": out.get("x", []),
-            "domains": [],
+            # parallel to "names": per-column categorical domains (the
+            # client's H2OTree levels decode indexes these —
+            # h2o-py/h2o/tree/tree.py:423-424)
+            "domains": [
+                (out.get("domains") or {}).get(c)
+                for c in out.get("x", [])],
+            # pre-encoding column names; h2o.explain() falls back to
+            # "names" when null but the KEY must exist (_explain.py:1906)
+            "original_names": None,
+            "scoring_history": _scoring_history_table(m),
             "status": "DONE",
             "run_time": m.run_time_ms,
             # engine-substitution warnings (depth clamp, maxout~relu, ...)
@@ -810,6 +872,28 @@ def _model_schema(m: Model) -> dict:
             "coefficients_table": out.get("coefficients_table"),
         },
     }
+
+
+def _params_schema(m: Model):
+    """ModelParameterSchemaV3 entries.  Column params use ColSpecifierV3
+    ({"column_name": ...}) and key params use KeyV3 ({"name": ...}) —
+    the client's actual_params property dereferences exactly these
+    shapes (model_base.py:88-95)."""
+    col_params = {"response_column", "weights_column", "offset_column",
+                  "fold_column", "treatment_column"}
+    key_params = {"model_id", "training_frame", "validation_frame"}
+    entries = []
+    for k, v in m.params.items():
+        if str(k).startswith("_"):
+            continue
+        if isinstance(v, np.ndarray):
+            v = v.tolist()
+        if k in col_params:
+            v = {"column_name": v} if v is not None else None
+        elif k in key_params:
+            v = {"name": str(v)} if v is not None else None
+        entries.append({"name": k, "actual_value": v})
+    return entries
 
 
 @route("GET", r"/3/GetGLMRegPath")
@@ -980,9 +1064,15 @@ def predict(params, model_id, frame_id):
         raise H2OError(404, f"frame {frame_id} not found")
     dest = params.get("predictions_frame") or f"predictions_{model_id}" \
         f"_{frame_id}"
-    recon = str(params.get("reconstruction_error", "")).lower() == "true"
-    per_feature = str(params.get("reconstruction_error_per_feature",
-                                 "")).lower() == "true"
+    def flag(name):
+        return str(params.get(name, "")).lower() == "true"
+
+    recon = flag("reconstruction_error")
+    per_feature = flag("reconstruction_error_per_feature")
+
+    contribs = flag("predict_contributions")
+    leaf_assign = flag("leaf_node_assignment")
+    staged = flag("predict_staged_proba")
     job = Job(dest=dest, description=f"predict {model_id} on {frame_id}")
 
     def body(j):
@@ -993,6 +1083,32 @@ def predict(params, model_id, frame_id):
                 raise H2OError(400, f"model {model_id} is not an "
                                     "autoencoder")
             pf = m.anomaly(fr, per_feature=per_feature)
+        elif contribs:
+            # TreeSHAP (ModelMetricsHandler.predictContributions; the
+            # client's model.predict_contributions v4 job flow)
+            def opt_n(name):
+                v = params.get(name)
+                return 0 if v in (None, "", "None") else int(v)
+            try:
+                pf = m.predict_contributions(
+                    fr, top_n=opt_n("top_n"), bottom_n=opt_n("bottom_n"),
+                    compare_abs=flag("compare_abs"),
+                    output_format=params.get(
+                        "predict_contributions_output_format",
+                        "Original") or "Original")
+            except NotImplementedError as e:
+                raise H2OError(400, str(e))
+        elif leaf_assign:
+            t = params.get("leaf_node_assignment_type") or "Path"
+            try:
+                pf = m.predict_leaf_node_assignment(fr, assign_type=t)
+            except NotImplementedError as e:
+                raise H2OError(400, str(e))
+        elif staged:
+            try:
+                pf = m.staged_predict_proba(fr)
+            except NotImplementedError as e:
+                raise H2OError(400, str(e))
         else:
             pf = m.predict(fr)
         pf.key = dest

@@ -352,3 +352,140 @@ def segment_models_build(params, algo):
             j, cls, coerced, x, y, train, valid, seg_cols,
             segments_frame, dest, parallelism))
     return {"job": job.to_dict()}
+
+
+# ---------------------------------------------------------------------------
+# /3/Tree — tree inspection (water TreeHandler / TreeV3; client
+# h2o.tree.H2OTree, tree.py:76-101)
+# ---------------------------------------------------------------------------
+
+@route("GET", r"/3/Tree")
+def get_tree(params):
+    m = _model_or_404(params.get("model"))
+    out = m.output
+    if out.get("split_col") is None:
+        raise H2OError(400, f"model {m.key} is not a tree model")
+    tree_number = int(params.get("tree_number") or 0)
+    sc_all = np.asarray(out["split_col"])
+    T, K, N = sc_all.shape
+    if not 0 <= tree_number < T:
+        raise H2OError(400, f"tree_number must be in [0, {T})")
+    dom = out.get("response_domain")
+    tree_class = params.get("tree_class") or None
+    if tree_class in ("", "None", None):
+        if K > 1:
+            raise H2OError(400, "tree_class is required for "
+                                "multinomial models")
+        kcls, cls_name = 0, None
+    elif K == 1:
+        kcls, cls_name = 0, None    # ignored for regression/binomial
+    else:
+        if dom is None or tree_class not in dom:
+            raise H2OError(400, f"unknown tree_class {tree_class!r}")
+        kcls, cls_name = dom.index(tree_class), tree_class
+    sc = sc_all[tree_number, kcls]
+    bs = np.asarray(out["bitset"])[tree_number, kcls]
+    vl = np.asarray(out["value"])[tree_number, kcls]
+    nw = np.asarray(out["node_w"])[tree_number, kcls] \
+        if out.get("node_w") is not None else None
+    ch = np.asarray(out["child"])[tree_number, kcls] \
+        if out.get("child") is not None else None
+    x = list(out["x"])
+    is_cat = np.asarray(out["is_cat"])
+    sp = np.asarray(out["split_points"])
+    B = int(out["nbins"])
+
+    def is_leaf(n):
+        return sc[n] < 0 or (ch is not None and ch[n] < 0)
+
+    def kids(n):
+        return (int(ch[n]), int(ch[n]) + 1) if ch is not None \
+            else (2 * n + 1, 2 * n + 2)
+
+    # BFS over internal ids; client renumbers by order of appearance
+    # (h2o-py tree.py __extract_internal_ids)
+    order = [0]
+    for n in order:
+        if not is_leaf(n):
+            l, r = kids(n)
+            order.append(l)
+            order.append(r)
+    pos = {n: i for i, n in enumerate(order)}
+
+    def node_pred(n):
+        if is_leaf(n) or nw is None:
+            return float(vl[n])
+        l, r = kids(n)
+        w = float(nw[n])
+        if w <= 0:
+            return float(vl[n])
+        return (float(nw[l]) * node_pred(l) +
+                float(nw[r]) * node_pred(r)) / w
+
+    left, right, thresholds, features, nas, descs, levels, preds = \
+        [], [], [], [], [], [], [], []
+    for n in order:
+        if is_leaf(n):
+            left.append(-1)
+            right.append(-1)
+            thresholds.append("NaN")
+            features.append(None)
+            nas.append(None)
+            descs.append(f"Leaf node: prediction {float(vl[n]):.6g}")
+            preds.append(float(vl[n]))
+            continue
+        l, r = kids(n)
+        col = int(sc[n])
+        left.append(l)
+        right.append(r)
+        features.append(x[col])
+        na_left = bool(bs[n, B])
+        nas.append("LEFT" if na_left else "RIGHT")
+        preds.append(node_pred(n))
+        if is_cat[col]:
+            thresholds.append("NaN")
+            descs.append(
+                f"Split on categorical column {x[col]} "
+                f"(NAs go {'left' if na_left else 'right'})")
+        else:
+            k = int(bs[n, :B].sum())        # contiguous leading-True run
+            thr = float(sp[col][k - 1]) if 0 < k <= sp.shape[1] and \
+                not np.isnan(sp[col][max(k - 1, 0)]) else float("nan")
+            thresholds.append("NaN" if np.isnan(thr) else thr)
+            descs.append(
+                f"Split: {x[col]} < {thr:.6g} goes left "
+                f"(NAs go {'left' if na_left else 'right'})")
+
+    # per-NODE inbound categorical levels (levels[child] = bins routed to
+    # that child at the parent's categorical split)
+    levels = [None] * len(order)
+    for n in order:
+        if is_leaf(n):
+            continue
+        col = int(sc[n])
+        if not is_cat[col]:
+            continue
+        l, r = kids(n)
+        # clip to the column's real cardinality: histogram bins past the
+        # domain are phantom (the client indexes domain[lvl] directly)
+        dom = (out.get("domains") or {}).get(x[col]) or []
+        card = min(B, len(dom)) if dom else B
+        levels[pos[l]] = [int(b) for b in range(card) if bs[n, b]]
+        levels[pos[r]] = [int(b) for b in range(card) if not bs[n, b]]
+
+    return {
+        "model": _key(str(m.key), "Key<Model>"),
+        "tree_number": tree_number,
+        "tree_class": cls_name,
+        "left_children": left,
+        "right_children": right,
+        "root_node_id": 0,
+        "thresholds": thresholds,
+        "features": features,
+        "nas": nas,
+        "descriptions": descs,
+        "levels": levels,
+        "predictions": preds,
+        "tree_decision_path": None,
+        "decision_paths": None,
+    }

@@ -61,6 +61,21 @@ class ParseSetupResult:
         }
 
 
+def _apply_cluster_tz(dt):
+    """Interpret naive wall-clock datetimes in the cluster timezone
+    ((setTimeZone ...) — reference ParseTime.setTimezone); the stored
+    epoch stays UTC ms.  Default (no zone set) keeps UTC semantics."""
+    try:
+        from h2o_tpu.core.cloud import cloud
+        tz = getattr(cloud(), "timezone", None)
+    except Exception:  # noqa: BLE001 — no cloud booted yet
+        tz = None
+    if not tz or tz == "UTC":
+        return dt
+    loc = dt.dt.tz_localize(tz, ambiguous="NaT", nonexistent="NaT")
+    return loc.dt.tz_convert("UTC").dt.tz_localize(None)
+
+
 def _is_remote(path: str) -> bool:
     """URI with a non-local scheme: ingest fetches it through the persist
     byte stores (http/https built-in, s3/gcs via their registrations —
@@ -334,8 +349,8 @@ def _parse_native(paths: Sequence[str], setup: ParseSetupResult,
             import pandas as pd
             # pin ms resolution: pandas>=2 infers s/us/ns per input, so
             # a bare astype(int64) is resolution-dependent
-            dt = pd.to_datetime(pd.Series(col.astype("U")),
-                                errors="coerce")
+            dt = _apply_cluster_tz(pd.to_datetime(
+                pd.Series(col.astype("U")), errors="coerce"))
             ms = dt.to_numpy().astype("datetime64[ms]").astype("int64")
             vals = np.where(pd.isna(dt).to_numpy(), np.nan,
                             ms.astype(np.float64))
@@ -437,7 +452,7 @@ def parse_files(paths: Sequence[str], setup: Optional[ParseSetupResult] = None,
             vals = pd.to_numeric(col, errors="coerce").to_numpy(np.float32)
             vecs.append(Vec(vals, T_NUM))
         elif t == T_TIME:
-            dt = pd.to_datetime(col, errors="coerce")
+            dt = _apply_cluster_tz(pd.to_datetime(col, errors="coerce"))
             ms = dt.to_numpy().astype("datetime64[ms]").astype("int64")
             vals = np.where(pd.isna(dt).to_numpy(), np.nan,
                             ms.astype(np.float64))
@@ -689,9 +704,9 @@ def parse_arff(path: str, dest: Optional[str] = None) -> Frame:
                 np.int32)
             vecs.append(Vec(codes, T_CAT, domain=list(dom)))
         elif ty == T_TIME:
-            ser = pd.to_datetime(
+            ser = _apply_cluster_tz(pd.to_datetime(
                 pd.Series([None if n else c for c, n in zip(raw, na)]),
-                errors="coerce")
+                errors="coerce"))
             ms = ser.to_numpy().astype("datetime64[ms]").astype("int64")
             vals = np.where(pd.isna(ser).to_numpy(), np.nan,
                             ms.astype(np.float64))

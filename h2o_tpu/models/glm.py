@@ -294,6 +294,117 @@ def _deviance_at(X, y, w, valid, beta, fam_name: str, tweedie_power=1.5,
     return fam.deviance(y, fam.link_inv(eta), w)
 
 
+def _lbfgs_minimize(value_and_grad, x0, max_iter: int = 200, m: int = 10,
+                    gtol: float = 1e-7, progress=None):
+    """Limited-memory BFGS: two-loop recursion + Armijo backtracking.
+
+    Reference hex/optimization/L_BFGS.java (solve/ginfo loop with
+    history k=20 and backtracking line search).  The loop runs on the
+    host — each iteration is ONE fused XLA dispatch of the jitted
+    value_and_grad (objective + gradient share the forward pass via AD);
+    the O(m·P) two-loop arithmetic is negligible host work.
+
+    Returns (x, f, n_iters).
+    """
+    x = np.asarray(x0, np.float64)
+    f, g = value_and_grad(x)
+    f, g = float(f), np.asarray(g, np.float64)
+    S, Y, RHO = [], [], []
+    it = 0
+    for it in range(1, max_iter + 1):
+        gnorm = float(np.max(np.abs(g)))
+        if gnorm < gtol:
+            break
+        # two-loop recursion
+        d = -g
+        alphas = []
+        for s, yv_, rho in zip(reversed(S), reversed(Y), reversed(RHO)):
+            a = rho * float(s @ d)
+            alphas.append(a)
+            d = d - a * yv_
+        if S:
+            gamma = float(S[-1] @ Y[-1]) / max(float(Y[-1] @ Y[-1]),
+                                               1e-300)
+            d = gamma * d
+        for (s, yv_, rho), a in zip(zip(S, Y, RHO), reversed(alphas)):
+            b = rho * float(yv_ @ d)
+            d = d + (a - b) * s
+        # Armijo backtracking
+        dg = float(g @ d)
+        if dg >= 0:                    # not a descent direction: reset
+            d, dg = -g, -float(g @ g)
+            S, Y, RHO = [], [], []
+        step = 1.0
+        f_new, g_new, x_new = f, g, x
+        for _ in range(30):
+            x_new = x + step * d
+            f_new, g_new = value_and_grad(x_new)
+            f_new = float(f_new)
+            if np.isfinite(f_new) and f_new <= f + 1e-4 * step * dg:
+                break
+            step *= 0.5
+        else:
+            break                      # line search failed: converged
+        g_new = np.asarray(g_new, np.float64)
+        s, yvec = x_new - x, g_new - g
+        sy = float(s @ yvec)
+        if sy > 1e-12:                 # curvature condition
+            S.append(s)
+            Y.append(yvec)
+            RHO.append(1.0 / sy)
+            if len(S) > m:
+                S.pop(0)
+                Y.pop(0)
+                RHO.pop(0)
+        if abs(f - f_new) <= 1e-12 * max(1.0, abs(f)):
+            x, f, g = x_new, f_new, g_new
+            break
+        x, f, g = x_new, f_new, g_new
+        if progress is not None and it % 10 == 0:
+            progress(it, f)
+    return x, f, it
+
+
+def _glm_objective_fn(X, yv, w, valid_m, fam_name: str, tweedie_power,
+                      theta, l2, pen=None, n_icpt: int = 1):
+    """Penalized GLM negative log-likelihood (deviance/2) + l2/2 ||b||²,
+    jitted with its gradient.  ``pen`` is an optional quadratic penalty
+    matrix in Gram units (GAM curvature).  For multinomial pass the flat
+    (K*(P+1),) params with n_icpt=K — softmax NLL."""
+    yz = jnp.where(valid_m, jnp.nan_to_num(yv), 0.0)
+    wz = jnp.where(valid_m, w, 0.0)
+    P = X.shape[1]
+
+    if fam_name == "multinomial":
+        def obj(params):
+            B = params.reshape(n_icpt, P + 1)
+            eta = X @ B[:, :-1].T + B[:, -1][None, :]      # (R, K)
+            lse = jax.scipy.special.logsumexp(eta, axis=1)
+            yk = jnp.clip(yz.astype(jnp.int32), 0, n_icpt - 1)
+            ll = jnp.take_along_axis(eta, yk[:, None], axis=1)[:, 0] - lse
+            nll = -jnp.sum(wz * ll)
+            reg = 0.5 * l2 * jnp.sum(B[:, :-1] ** 2)
+            return nll + reg
+    else:
+        fam = _family(fam_name, tweedie_power, theta)
+
+        def obj(params):
+            eta = X @ params[:-1] + params[-1]
+            mu = fam.link_inv(eta)
+            val = 0.5 * fam.deviance(yz, mu, wz) + \
+                0.5 * l2 * jnp.sum(params[:-1] ** 2)
+            if pen is not None:
+                val = val + 0.5 * params @ (pen @ params)
+            return val
+
+    vg = jax.jit(jax.value_and_grad(obj))
+
+    def value_and_grad(x):
+        f, g = vg(jnp.asarray(x, jnp.float32))
+        return f, np.asarray(g)
+    return value_and_grad
+
+
 @jax.jit
 def _chol_solve(G, q, lam_l2):
     P = G.shape[0]
@@ -490,12 +601,13 @@ class GLM(ModelBuilder):
     algo = "glm"
     model_cls = GLMModel
 
-    # engine-fixed: IRLSM/COD is the solver (L-BFGS absent; ordinal runs
-    # gradient descent like the reference's GRADIENT_DESCENT_LH), links
-    # are family-default, NAs mean-impute, collinear-removal absent
+    # engine-fixed: links are family-default, NAs mean-impute,
+    # collinear-removal absent.  Solvers: IRLSM/COD + L_BFGS (two-loop
+    # recursion, hex/optimization/L_BFGS.java analog) + ordinal gradient
+    # descent (GRADIENT_DESCENT_LH analog)
     ENGINE_FIXED = {
         "solver": ("AUTO", "IRLSM", "COORDINATE_DESCENT",
-                   "GRADIENT_DESCENT_LH"),
+                   "GRADIENT_DESCENT_LH", "L_BFGS"),
         "link": ("family_default",),
         "missing_values_handling": ("MeanImputation",),
         "remove_collinear_columns": (False,),
@@ -563,12 +675,46 @@ class GLM(ModelBuilder):
                              f"family='{fam_name}' (reference GLM has "
                              "the same restriction)")
         P = X.shape[1]
-        alpha = p["alpha"]
-        alpha = 0.5 if alpha is None else (
-            alpha[0] if isinstance(alpha, (list, tuple)) else float(alpha))
+        solver = str(p.get("solver") or "AUTO").upper()
+        alpha_in = p["alpha"]
+        if isinstance(alpha_in, (list, tuple)):
+            alpha_in = alpha_in[0] if alpha_in else None
+        if alpha_in is not None:
+            alpha_in = float(alpha_in)
+        if solver == "AUTO":
+            # defaultSolver() (GLM.java:3971-3997): lambda search /
+            # bounds -> COD; wide data or multinomial ridge -> L_BFGS.
+            # Our L-BFGS is smooth-objective only, so the wide-data
+            # branch applies only when no L1 would be in play (an
+            # explicit alpha>0 keeps the elastic-net-capable IRLSM).
+            if p.get("lambda_search"):
+                solver = "COORDINATE_DESCENT"
+            elif p.get("beta_constraints") is not None or \
+                    p.get("non_negative"):
+                solver = "COORDINATE_DESCENT"
+            elif P >= 5000 and (alpha_in is None or alpha_in == 0):
+                solver = "L_BFGS"
+            elif fam_name == "multinomial" and alpha_in == 0:
+                solver = "L_BFGS"
+            else:
+                solver = "IRLSM"
+        # GLM.java: alpha defaults to 0 under L-BFGS (no L1 support in
+        # the quasi-Newton path), 0.5 otherwise — applied AFTER the AUTO
+        # resolution so the default never feeds L1 into L-BFGS
+        alpha = alpha_in if alpha_in is not None else \
+            (0.0 if solver == "L_BFGS" else 0.5)
+        if solver == "L_BFGS" and (
+                p.get("beta_constraints") is not None or
+                p.get("non_negative") or p.get("_nonneg_mask") is not None):
+            raise ValueError(
+                "solver='L_BFGS' does not support beta constraints / "
+                "non_negative; use COORDINATE_DESCENT")
+        p["_solver_resolved"] = solver
         max_iter = int(p["max_iterations"])
         if max_iter <= 0:
-            max_iter = 50
+            # quasi-Newton steps are cheaper but more numerous than
+            # IRLSM Gram solves
+            max_iter = 300 if solver == "L_BFGS" else 50
 
         spec = expansion_spec(di)
         self._assemble_penalty(p, di, spec, X)
@@ -755,6 +901,39 @@ class GLM(ModelBuilder):
             dev_prev = dev
         return beta, float(dev)
 
+    def _lbfgs_at_lambda(self, X, yv, w, valid_m, fam_name, p, alpha, lam,
+                         beta, max_iter, n_obs, first_pass=None):
+        """L-BFGS to convergence at one fixed lambda — same contract as
+        _irlsm_at_lambda (hex/optimization/L_BFGS.java; GLM.fitLBFGS).
+        L1 is not representable in a smooth quasi-Newton objective, so
+        alpha*lambda > 0 is refused loudly (the reference's L-BFGS path
+        likewise prefers lambda=0/ridge; OWL-QN is not implemented)."""
+        theta = float(p.get("theta") or 1.0)
+        l1 = lam * alpha * n_obs
+        if l1 > 0:
+            raise ValueError(
+                "solver='L_BFGS' supports only L2 regularization "
+                "(alpha=0); use IRLSM/COORDINATE_DESCENT for elastic "
+                "net")
+        if p.get("_nonneg_mask") is not None or \
+                p.get("_beta_lo") is not None:
+            raise ValueError(
+                "solver='L_BFGS' does not support coefficient bounds; "
+                "use COORDINATE_DESCENT")
+        l2 = lam * (1 - alpha) * n_obs
+        pen = p.get("_penalty")
+        vg = _glm_objective_fn(
+            X, yv, w, valid_m, fam_name, p["tweedie_power"], theta, l2,
+            pen=jnp.asarray(pen) if pen is not None else None)
+        beta_np, _f, iters = _lbfgs_minimize(
+            vg, np.asarray(beta, np.float64), max_iter,
+            gtol=float(p.get("gradient_epsilon") or 0) or 1e-7)
+        self._last_iters = iters
+        beta_j = jnp.asarray(beta_np, jnp.float32)
+        dev = float(_deviance_at(X, yv, w, valid_m, beta_j, fam_name,
+                                 p["tweedie_power"], theta))
+        return beta_j, dev
+
     def _fit_binomial_ish(self, X, yv, w, valid_m, fam_name, p, alpha, lam,
                           max_iter, job, vdata=None):
         """Single-lambda IRLSM or the full lambda-search path.
@@ -793,17 +972,20 @@ class GLM(ModelBuilder):
                             max(alpha, 1e-3) / n_obs)
             first_pass = (G0, q0, dev0)
 
+        solver = p.get("_solver_resolved", "IRLSM")
+        solve = self._lbfgs_at_lambda if solver == "L_BFGS" \
+            else self._irlsm_at_lambda
         if not search:
             if lam is None:
                 lam = 1e-3 * lam_max   # default single lambda
-            beta, dev = self._irlsm_at_lambda(
+            beta, dev = solve(
                 X, yv, w, valid_m, fam_name, p, alpha, lam, beta,
                 max_iter, n_obs, first_pass=first_pass)
             extra["iterations"] = self._last_iters
             if bool(p.get("compute_p_values")):
                 extra.update(self._p_values(X, yv, w, valid_m, fam_name,
                                             p, beta, dev, n_obs))
-            job.update(1.0, "IRLSM converged")
+            job.update(1.0, f"{solver} converged")
             return beta, lam, dev, extra
 
         # ---- lambda search path ----
@@ -837,7 +1019,7 @@ class GLM(ModelBuilder):
         total_iters = 0
         worse_streak = 0
         for k, lam_k in enumerate(lams):
-            beta, dev = self._irlsm_at_lambda(
+            beta, dev = solve(
                 X, yv, w, valid_m, fam_name, p, alpha, float(lam_k), beta,
                 inner, n_obs, first_pass=first_pass if k == 0 else None)
             total_iters += self._last_iters
@@ -1039,6 +1221,28 @@ class GLM(ModelBuilder):
         pen_dev = jnp.asarray(pen) if pen is not None else None
         mask = p.get("_nonneg_mask")
         mask = jnp.asarray(mask, jnp.float32) if mask is not None else None
+        if p.get("_solver_resolved") == "L_BFGS" and pen_dev is None and \
+                mask is None and not p.get("non_negative"):
+            # full softmax NLL, all classes jointly (GLM.fitLBFGS
+            # multinomial; better conditioned than per-class IRLSM)
+            if lam * alpha > 0:
+                raise ValueError(
+                    "solver='L_BFGS' supports only L2 regularization "
+                    "(alpha=0) for multinomial")
+            l2 = lam * (1 - alpha) * n_obs
+            vg = _glm_objective_fn(X, yv, w, valid_m, "multinomial",
+                                   p["tweedie_power"],
+                                   float(p.get("theta") or 1.0), l2,
+                                   n_icpt=K)
+            flat0 = np.zeros((K * (P + 1),), np.float64)
+            flat, _f, iters = _lbfgs_minimize(
+                vg, flat0, max(max_iter, 300),
+                gtol=float(p.get("gradient_epsilon") or 0) or 1e-7,
+                progress=lambda i, f: job.update(
+                    min(0.9, i / max(max_iter, 300)),
+                    f"L-BFGS iter {i} obj={f:.5g}"))
+            self._last_iters = iters
+            return jnp.asarray(flat.reshape(K, P + 1), jnp.float32)
         for it in range(max_iter):
             max_delta = 0.0
             for k in range(K):

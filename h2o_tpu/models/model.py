@@ -189,6 +189,45 @@ class Model:
         return _raw_to_frame(self.predict_raw(frame), frame.nrows,
                              self.output.get("response_domain"))
 
+    # -- tree-family scoring options (hex/Model.java scoring flags) ---------
+
+    def _require_forest(self, what: str) -> None:
+        if self.output.get("split_col") is None:
+            raise NotImplementedError(
+                f"{what} is only supported for tree-based models "
+                f"(model {self.key} is {self.algo})")
+
+    def predict_contributions(self, frame: Frame, top_n: int = 0,
+                              bottom_n: int = 0,
+                              compare_abs: bool = False,
+                              output_format: str = "Original") -> Frame:
+        """TreeSHAP feature contributions
+        (SharedTreeModelWithContributions.scoreContributions)."""
+        self._require_forest("predict_contributions")
+        from h2o_tpu.models.tree.contributions import contributions_frame
+        return contributions_frame(self, frame, top_n=top_n,
+                                   bottom_n=bottom_n,
+                                   compare_abs=compare_abs,
+                                   output_format=output_format)
+
+    def predict_leaf_node_assignment(self, frame: Frame,
+                                     assign_type: str = "Path") -> Frame:
+        """Terminal node per tree (hex/tree/AssignLeafNodeTask)."""
+        self._require_forest("predict_leaf_node_assignment")
+        from h2o_tpu.models.tree.contributions import \
+            leaf_assignment_frame
+        return leaf_assignment_frame(self, frame, assign_type=assign_type)
+
+    def staged_predict_proba(self, frame: Frame) -> Frame:
+        """Cumulative probabilities per tree
+        (GBMModel.StagedPredictionsTask)."""
+        if self.algo not in ("gbm", "xgboost"):
+            raise NotImplementedError(
+                "staged_predict_proba is only supported for GBM models")
+        self._require_forest("staged_predict_proba")
+        from h2o_tpu.models.tree.contributions import staged_proba_frame
+        return staged_proba_frame(self, frame)
+
     def model_metrics(self, frame: Frame) -> mm.ModelMetrics:
         """Score + metrics against a labeled frame."""
         return self.metrics_from_raw(self.predict_raw(frame), frame)

@@ -163,6 +163,8 @@ class DRF(ModelBuilder):
             if ckpt is not None and co.get("node_gain") is not None:
                 # checkpoint per-node gains; driver appends new trees'
                 out["node_gain"] = np.asarray(co["node_gain"])
+            if ckpt is not None and co.get("node_w") is not None:
+                out["node_w"] = np.asarray(co["node_w"])
             model = self.model_cls(self.model_id, dict(p), out)
             model.params["response_column"] = y
             return model

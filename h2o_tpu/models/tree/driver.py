@@ -25,21 +25,27 @@ import numpy as np
 from h2o_tpu.models.score_keeper import ScoreKeeper
 
 
-def _set_node_gain(model, new_gain: np.ndarray) -> None:
-    """Store per-node gains covering ALL trees in the model (checkpoint
-    resume prepends the checkpoint's gains; checkpoints trained before
-    gains existed get a zero prefix so FeatureInteraction indexing stays
-    aligned with split_col)."""
+def _set_node_array(model, name: str, new: np.ndarray) -> None:
+    """Store a per-node array (gain, cover) covering ALL trees in the
+    model (checkpoint resume prepends the checkpoint's values;
+    checkpoints trained before the array existed get a zero prefix so
+    indexing stays aligned with split_col)."""
     sc_all = np.asarray(model.output["split_col"])
-    prior = model.output.get("node_gain")
+    prior = model.output.get(name)
     if prior is not None and \
-            prior.shape[0] + new_gain.shape[0] == sc_all.shape[0]:
-        new_gain = np.concatenate([np.asarray(prior), new_gain])
-    elif new_gain.shape[0] != sc_all.shape[0]:
-        pad = np.zeros((sc_all.shape[0] - new_gain.shape[0],) +
-                       new_gain.shape[1:], new_gain.dtype)
-        new_gain = np.concatenate([pad, new_gain])
-    model.output["node_gain"] = new_gain
+            prior.shape[0] + new.shape[0] == sc_all.shape[0]:
+        new = np.concatenate([np.asarray(prior), new])
+    elif new.shape[0] != sc_all.shape[0]:
+        if name == "node_w":
+            # fabricated zero covers would make TreeSHAP silently wrong
+            # for the checkpoint's trees — keep the loud "retrain to
+            # compute contributions" guard instead
+            model.output[name] = None
+            return
+        pad = np.zeros((sc_all.shape[0] - new.shape[0],) +
+                       new.shape[1:], new.dtype)
+        new = np.concatenate([pad, new])
+    model.output[name] = new
 
 
 class IncrementalScorer:
@@ -113,11 +119,12 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
         prior_vi = model.output.get("varimp")
         vi = np.asarray(tf.varimp)
         model.output["varimp"] = vi if prior_vi is None else prior_vi + vi
-        _set_node_gain(model, np.asarray(tf.node_gain))
+        _set_node_array(model, "node_gain", np.asarray(tf.node_gain))
+        _set_node_array(model, "node_w", np.asarray(tf.node_w))
         return model
 
     block = interval if interval > 0 else max(1, min(ntrees, 10))
-    scs, bss, vls, chs, gns = [], [], [], [], []
+    scs, bss, vls, chs, gns, nws = [], [], [], [], [], []
     vi_total = None
     F = F0
     done = 0
@@ -134,6 +141,7 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
         if tf.child is not None:
             chs.append(np.asarray(tf.child))
         gns.append(np.asarray(tf.node_gain))
+        nws.append(np.asarray(tf.node_w))
         vi = np.asarray(tf.varimp)
         vi_total = vi if vi_total is None else vi_total + vi
         done += n
@@ -158,7 +166,8 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
                        np.concatenate(vls),
                        np.concatenate(chs) if chs else None, done, F)
     model.output["scoring_history"] = sk.events
-    _set_node_gain(model, np.concatenate(gns))
+    _set_node_array(model, "node_gain", np.concatenate(gns))
+    _set_node_array(model, "node_w", np.concatenate(nws))
     prior_vi = model.output.get("varimp")
     if vi_total is not None:
         model.output["varimp"] = vi_total if prior_vi is None \

@@ -167,6 +167,7 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
     value = jnp.zeros((H,), jnp.float32)
     varimp = jnp.zeros((C,), jnp.float32)
     node_gain = jnp.zeros((H,), jnp.float32)   # per-split SE reduction
+    node_w = jnp.zeros((H,), jnp.float32)      # per-node cover (TreeSHAP)
     leaf = leaf0
     use_mono = bool(cfg.get("use_mono")) and mono is not None
     # monotone value bounds per live leaf (XGBoost-style two-part scheme:
@@ -233,6 +234,8 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
             bitset, s["bitset"] & do_split[:, None], (off, 0))
         value = jax.lax.dynamic_update_slice(
             value, jnp.where(term, leaf_vals, 0.0), (off,))
+        node_w = jax.lax.dynamic_update_slice(
+            node_w, jnp.where(live, s["leaf"]["w"], 0.0), (off,))
         # pre-write child values (interleaved left/right) at the next level
         child_vals = jnp.stack([lvals, rvals], axis=1).reshape(2 * L)
         child_mask = jnp.repeat(do_split, 2)
@@ -240,6 +243,13 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
         cur = jax.lax.dynamic_slice(value, (coff,), (2 * L,))
         value = jax.lax.dynamic_update_slice(
             value, jnp.where(child_mask, child_vals, cur), (coff,))
+        # pre-write child covers too (the depth-D level never runs the
+        # loop body, so its weights only exist via this write)
+        child_ws = jnp.stack([s["left"]["w"], s["right"]["w"]],
+                             axis=1).reshape(2 * L)
+        cur_w = jax.lax.dynamic_slice(node_w, (coff,), (2 * L,))
+        node_w = jax.lax.dynamic_update_slice(
+            node_w, jnp.where(child_mask, child_ws, cur_w), (coff,))
 
         # route rows
         active = leaf >= 0
@@ -251,7 +261,7 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
         leaf = jnp.where(active & do_split[lf], child,
                          jnp.where(active, -1, leaf))
         prev_hist, prev_do = hist, do_split
-    return split_col, bitset, value, varimp, node_gain
+    return split_col, bitset, value, varimp, node_gain, node_w
 
 
 def build_tree_frontier(bins, stats, slot0, key, is_cat, cfg: Dict,
@@ -288,6 +298,7 @@ def build_tree_frontier(bins, stats, slot0, key, is_cat, cfg: Dict,
     value = jnp.zeros((N + 1,), jnp.float32)
     child = jnp.full((N + 1,), -1, jnp.int32)
     node_gain = jnp.zeros((N + 1,), jnp.float32)
+    node_w = jnp.zeros((N + 1,), jnp.float32)  # per-node cover (TreeSHAP)
     varimp = jnp.zeros((C,), jnp.float32)
 
     frontier = jnp.zeros((1,), jnp.int32)          # pool ids of live leaves
@@ -359,11 +370,17 @@ def build_tree_frontier(bins, stats, slot0, key, is_cat, cfg: Dict,
         value = value.at[frontier].set(jnp.where(term, leaf_vals, 0.0))
         child = child.at[frontier].set(jnp.where(do_split, child_ptr, -1))
         node_gain = node_gain.at[frontier].set(gain_pos)
+        node_w = node_w.at[frontier].set(
+            jnp.where(live, s["leaf"]["w"], 0.0))
         # pre-write child values at their (fresh, contiguous) pool slots
         cvals = jnp.stack([lvals, rvals], axis=1).reshape(2 * L)
         cmask = jnp.repeat(do_split, 2)
         value = jax.lax.dynamic_update_slice(
             value, jnp.where(cmask, cvals, 0.0), (base,))
+        cw = jnp.stack([s["left"]["w"], s["right"]["w"]],
+                       axis=1).reshape(2 * L)
+        node_w = jax.lax.dynamic_update_slice(
+            node_w, jnp.where(cmask, cw, 0.0), (base,))
 
         if d + 1 < D:
             L_next = widths[d + 1]
@@ -402,7 +419,7 @@ def build_tree_frontier(bins, stats, slot0, key, is_cat, cfg: Dict,
         base += 2 * L
 
     return (split_col[:N], bitset[:N], value[:N], child[:N], varimp,
-            node_gain[:N])
+            node_gain[:N], node_w[:N])
 
 
 def _tree_predict(bins, split_col, bitset, value, D: int, child=None):
@@ -433,6 +450,7 @@ class TrainedForest(NamedTuple):
     f_final: jax.Array     # (R, K) link-scale training predictions
     varimp: jax.Array      # (C,) summed split-gain importance
     node_gain: jax.Array   # (T, K, N) per-split gain (FeatureInteraction)
+    node_w: jax.Array      # (T, K, N) per-node training cover (TreeSHAP)
     child: object = None   # (T, K, N) left-child pool ptrs; None = dense
 
 
@@ -536,16 +554,17 @@ def _train_forest_jit(bins, yv, w, active, F0, is_cat, key, *,
             if mode == "gbm" else 1.0
         if mode == "gbm" and dist_name == "multinomial":
             scale = scale * (K - 1) / K
-        scs, bss, vls, chs, preds, vis, gns = [], [], [], [], [], [], []
+        scs, bss, vls, chs, preds, vis, gns, nws = \
+            [], [], [], [], [], [], [], []
         for kcls in range(K):                    # static unroll over classes
             kc, kk = jax.random.split(kc)
             stats = stats_for(kcls, F)
             if kleaves > 0:
-                sc, bs, vl, ch, vi, gn = build_tree_frontier(
+                sc, bs, vl, ch, vi, gn, nw = build_tree_frontier(
                     bins, stats, leaf0, kk, is_cat, cfg, tree_cols,
                     mono=mono)
             else:
-                sc, bs, vl, vi, gn = build_tree_traced(
+                sc, bs, vl, vi, gn, nw = build_tree_traced(
                     bins, stats, leaf0, kk, is_cat, cfg, tree_cols,
                     mono=mono)
                 ch = None
@@ -556,11 +575,12 @@ def _train_forest_jit(bins, yv, w, active, F0, is_cat, key, *,
             chs.append(ch)
             vis.append(vi)
             gns.append(gn)
+            nws.append(nw)
             preds.append(_tree_predict(bins, sc, bs, vl, max_depth,
                                        child=ch))
         F = F + jnp.stack(preds, axis=1)
         out = (jnp.stack(scs), jnp.stack(bss), jnp.stack(vls),
-               sum(vis), jnp.stack(gns))
+               sum(vis), jnp.stack(gns), jnp.stack(nws))
         if kleaves > 0:
             out = out + (jnp.stack(chs),)
         return F, out
@@ -571,7 +591,8 @@ def _train_forest_jit(bins, yv, w, active, F0, is_cat, key, *,
     ts = jnp.arange(ntrees, dtype=jnp.float32) + jnp.float32(t0)
     F_final, outs = jax.lax.scan(tree_step, F0, (ts, keys))
     if kleaves > 0:
-        sc, bs, vl, vi, gn, ch = outs
+        sc, bs, vl, vi, gn, nw, ch = outs
     else:
-        (sc, bs, vl, vi, gn), ch = outs, None
-    return TrainedForest(sc, bs, vl, F_final, jnp.sum(vi, axis=0), gn, ch)
+        (sc, bs, vl, vi, gn, nw), ch = outs, None
+    return TrainedForest(sc, bs, vl, F_final, jnp.sum(vi, axis=0), gn, nw,
+                         ch)

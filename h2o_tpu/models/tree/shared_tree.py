@@ -296,6 +296,45 @@ def forest_score(bins, split_col, bitset, value, depth: int, child=None):
     return jnp.sum(vals.reshape(T, K, R), axis=0).T        # (R, K)
 
 
+@functools.partial(jax.jit, static_argnames=("depth",))
+def forest_tree_values(bins, split_col, bitset, value, depth: int,
+                       child=None):
+    """Per-TREE outputs (T, K, R) — forest_score without the sum, for
+    staged predictions (GBMModel.StagedPredictionsTask)."""
+    T, K, H = split_col.shape
+    R = bins.shape[0]
+
+    def one_tree(carry, tk):
+        if child is None:
+            sc, bs, vl = tk
+            ch = None
+        else:
+            sc, bs, vl, ch = tk
+        node = jnp.zeros((R,), jnp.int32)
+        for _ in range(depth):
+            c = sc[node]
+            term = c < 0
+            b = jnp.take_along_axis(bins, jnp.maximum(c, 0)[:, None],
+                                    axis=1)[:, 0]
+            go_left = bs[node, b]
+            if ch is None:
+                nxt = 2 * node + jnp.where(go_left, 1, 2)
+            else:
+                left = ch[node]
+                term = term | (left < 0)
+                nxt = left + jnp.where(go_left, 0, 1)
+            node = jnp.where(term, node, nxt)
+        return carry, vl[node]
+
+    xs = (split_col.reshape(T * K, H),
+          bitset.reshape(T * K, H, -1),
+          value.reshape(T * K, H))
+    if child is not None:
+        xs = xs + (child.reshape(T * K, H),)
+    _, vals = jax.lax.scan(one_tree, 0, xs)
+    return vals.reshape(T, K, R)
+
+
 def forest_score_out(bins, out: Dict, depth: int = None) -> jax.Array:
     """forest_score over a model-output dict (handles both node layouts;
     models saved before the frontier engine have no "child" key)."""

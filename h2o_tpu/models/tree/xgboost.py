@@ -190,7 +190,7 @@ class XGBoost(GBM):
         x_cols = [c for c in (x or train.names)
                   if c != y and c != "__dart_offset__"]
         R = train.nrows
-        scs, bss, vls, chs, preds = [], [], [], [], []
+        scs, bss, vls, chs, preds, nws = [], [], [], [], [], []
         scale: list = []
         base_out = None
         bins = None
@@ -242,6 +242,8 @@ class XGBoost(GBM):
                 scs.append(sc)
                 bss.append(bs)
                 vls.append(vl)
+                if m.output.get("node_w") is not None:
+                    nws.append(np.asarray(m.output["node_w"]))
                 if ch is not None:
                     chs.append(np.asarray(ch))
                 preds.append(Fnew)
@@ -258,6 +260,10 @@ class XGBoost(GBM):
             [v * np.float32(s) for v, s in zip(vls, scale)])
         out["child"] = np.concatenate(chs) if chs else None
         out["node_gain"] = None
+        # per-fit covers concatenate cleanly (DART rescales leaf VALUES,
+        # not row routing, so TreeSHAP stays exact on the scaled forest)
+        out["node_w"] = np.concatenate(nws) \
+            if len(nws) == len(scs) else None
         out["ntrees_actual"] = ntrees
         model = self.model_cls(self.model_id, dict(p_all), out)
         model.params["response_column"] = y

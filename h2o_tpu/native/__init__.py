@@ -78,6 +78,112 @@ def available() -> bool:
     return lib() is not None
 
 
+# ---------------------------------------------------------------------------
+# TreeSHAP kernel (treeshap.cpp) — same build-on-first-use discipline
+# ---------------------------------------------------------------------------
+
+_TS_SRC = os.path.join(_DIR, "treeshap.cpp")
+_TS_SO = os.path.join(_DIR, "_treeshap.so")
+_ts_lib = None
+_ts_tried = False
+
+
+def treeshap_lib() -> Optional[CDLL]:
+    global _ts_lib, _ts_tried
+    if _ts_lib is not None or _ts_tried:
+        return _ts_lib
+    with _lock:
+        if _ts_lib is not None or _ts_tried:
+            return _ts_lib
+        _ts_tried = True
+        try:
+            if not (os.path.exists(_TS_SO) and
+                    os.path.getmtime(_TS_SO) >= os.path.getmtime(_TS_SRC)):
+                cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread",
+                       "-std=c++17", _TS_SRC, "-o", _TS_SO + ".tmp"]
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=120)
+                os.replace(_TS_SO + ".tmp", _TS_SO)
+                log.info("built native treeshap -> %s", _TS_SO)
+            L = CDLL(_TS_SO)
+            i64, i32p = c_long, POINTER(c_int)
+            L.treeshap_contribs.restype = c_int
+            L.treeshap_contribs.argtypes = [
+                i32p, i64, i64, i32p, POINTER(c_ubyte),
+                POINTER(c_double), POINTER(c_double), i32p,
+                i64, i64, i64, POINTER(c_double), c_int]
+            L.tree_leaf_assign.restype = c_int
+            L.tree_leaf_assign.argtypes = [
+                i32p, i64, i64, i32p, POINTER(c_ubyte), i32p,
+                i64, i64, i64, i32p, POINTER(c_char), i64]
+            _ts_lib = L
+        except Exception as e:  # noqa: BLE001 — numpy fallback exists
+            log.warning("native treeshap unavailable: %r", e)
+        return _ts_lib
+
+
+def treeshap_contribs(bins: np.ndarray, split_col: np.ndarray,
+                      bitset: np.ndarray, value: np.ndarray,
+                      node_w: np.ndarray,
+                      child: Optional[np.ndarray]) -> np.ndarray:
+    """SHAP contributions for one class's (T, N) tree stack on binned
+    rows; returns (R, C+1) with the bias in the last column."""
+    L = treeshap_lib()
+    assert L is not None
+    R, C = bins.shape
+    T, N = split_col.shape
+    B1 = bitset.shape[-1]
+    bins = np.ascontiguousarray(bins, np.int32)
+    sc = np.ascontiguousarray(split_col, np.int32)
+    bs = np.ascontiguousarray(bitset, np.uint8).reshape(T, N, B1)
+    vl = np.ascontiguousarray(value, np.float64)
+    nw = np.ascontiguousarray(node_w, np.float64)
+    ch = np.ascontiguousarray(child, np.int32) \
+        if child is not None else None
+    phi = np.zeros((R, C + 1), np.float64)
+    rc = L.treeshap_contribs(
+        bins.ctypes.data_as(POINTER(c_int)), R, C,
+        sc.ctypes.data_as(POINTER(c_int)),
+        bs.ctypes.data_as(POINTER(c_ubyte)),
+        vl.ctypes.data_as(POINTER(c_double)),
+        nw.ctypes.data_as(POINTER(c_double)),
+        ch.ctypes.data_as(POINTER(c_int)) if ch is not None else None,
+        T, N, B1,
+        phi.ctypes.data_as(POINTER(c_double)), _nthreads())
+    if rc != 0:
+        raise RuntimeError(f"treeshap_contribs failed rc={rc}")
+    return phi
+
+
+def tree_leaf_assign(bins: np.ndarray, split_col: np.ndarray,
+                     bitset: np.ndarray,
+                     child: Optional[np.ndarray], max_path: int = 64):
+    """Per-row/tree terminal node ids + L/R descent paths."""
+    L = treeshap_lib()
+    assert L is not None
+    R, C = bins.shape
+    T, N = split_col.shape
+    B1 = bitset.shape[-1]
+    bins = np.ascontiguousarray(bins, np.int32)
+    sc = np.ascontiguousarray(split_col, np.int32)
+    bs = np.ascontiguousarray(bitset, np.uint8).reshape(T, N, B1)
+    ch = np.ascontiguousarray(child, np.int32) \
+        if child is not None else None
+    ids = np.zeros((R, T), np.int32)
+    paths = np.zeros((R, T), f"S{max_path}")
+    rc = L.tree_leaf_assign(
+        bins.ctypes.data_as(POINTER(c_int)), R, C,
+        sc.ctypes.data_as(POINTER(c_int)),
+        bs.ctypes.data_as(POINTER(c_ubyte)),
+        ch.ctypes.data_as(POINTER(c_int)) if ch is not None else None,
+        T, N, B1,
+        ids.ctypes.data_as(POINTER(c_int)),
+        paths.ctypes.data_as(POINTER(c_char)), max_path)
+    if rc != 0:
+        raise RuntimeError(f"tree_leaf_assign failed rc={rc}")
+    return ids, paths
+
+
 def _nthreads() -> int:
     return max(1, min(os.cpu_count() or 1, 16))
 

