@@ -390,17 +390,26 @@ def get_tree(params):
         if out.get("node_w") is not None else None
     ch = np.asarray(out["child"])[tree_number, kcls] \
         if out.get("child") is not None else None
+    th = np.asarray(out["thr_bin"])[tree_number, kcls] \
+        if out.get("thr_bin") is not None else None
+    nal = np.asarray(out["na_left"])[tree_number, kcls] \
+        if out.get("thr_bin") is not None else None
     x = list(out["x"])
     is_cat = np.asarray(out["is_cat"])
     sp = np.asarray(out["split_points"])
     B = int(out["nbins"])
 
+    # one descent-semantics implementation repo-wide: leaf/child rules
+    # come from the contributions module (which also backs the native
+    # kernel's layout contract)
+    from h2o_tpu.models.tree.contributions import _children, _is_leaf
+
     def is_leaf(n):
-        return sc[n] < 0 or (ch is not None and ch[n] < 0)
+        return _is_leaf(sc, ch, n)
 
     def kids(n):
-        return (int(ch[n]), int(ch[n]) + 1) if ch is not None \
-            else (2 * n + 1, 2 * n + 2)
+        left, right = _children(ch, n)
+        return int(left), int(right)
 
     # BFS over internal ids; client renumbers by order of appearance
     # (h2o-py tree.py __extract_internal_ids)
@@ -439,7 +448,8 @@ def get_tree(params):
         left.append(l)
         right.append(r)
         features.append(x[col])
-        na_left = bool(bs[n, B])
+        adaptive_num = th is not None and th[n] >= 0
+        na_left = bool(nal[n]) if adaptive_num else bool(bs[n, B])
         nas.append("LEFT" if na_left else "RIGHT")
         preds.append(node_pred(n))
         if is_cat[col]:
@@ -448,7 +458,10 @@ def get_tree(params):
                 f"Split on categorical column {x[col]} "
                 f"(NAs go {'left' if na_left else 'right'})")
         else:
-            k = int(bs[n, :B].sum())        # contiguous leading-True run
+            if adaptive_num:
+                k = int(th[n])              # fine-bin threshold
+            else:
+                k = int(bs[n, :B].sum())    # contiguous leading-True run
             thr = float(sp[col][k - 1]) if 0 < k <= sp.shape[1] and \
                 not np.isnan(sp[col][max(k - 1, 0)]) else float("nan")
             thresholds.append("NaN" if np.isnan(thr) else thr)

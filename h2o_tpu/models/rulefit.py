@@ -163,8 +163,11 @@ class RuleFit(ModelBuilder):
         for d_i, depth in enumerate(depths):
             job.update(0.1 + 0.4 * d_i / len(depths),
                        f"rule trees depth {depth}")
+            # rule extraction reads global-grid bitsets (_rule_conds):
+            # pin the quantile engine regardless of the tree default
             tm = tree_cls(ntrees=ntrees, max_depth=depth,
                           seed=int(p.get("seed") or -1),
+                          histogram_type="QuantilesGlobal",
                           **({"sample_rate": 0.632} if tree_cls is DRF
                              else {"learn_rate": 0.1}))
             tm_model = tm._fit(job, list(di.x), y, train, None)

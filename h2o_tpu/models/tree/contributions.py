@@ -39,20 +39,30 @@ def _binned(model, frame: Frame) -> np.ndarray:
     m = frame.as_matrix(out["x"])
     return np.asarray(st._bin_all(
         m, jnp.asarray(out["split_points"]), jnp.asarray(out["is_cat"]),
-        int(out["nbins"])))
+        st.model_fine_na(out)))
 
 
-def _forest_arrays(model):
-    """(T, K, N) stacks + None-able child; node_w required (models
-    trained before covers existed must retrain for SHAP)."""
+def _forest_arrays(model, need_cover: bool = True):
+    """(T, K, N) stacks + None-able child/thr; ``need_cover`` requires
+    node_w (TreeSHAP only — models trained before covers existed must
+    retrain for contributions; leaf assignment and staged predictions
+    never touch covers)."""
     out = model.output
-    if out.get("node_w") is None:
+    if need_cover and out.get("node_w") is None:
         raise ValueError(
             "this model predates per-node cover tracking; retrain to "
             "compute contributions")
+    if out.get("node_w") is None:
+        out = dict(out)
+        out["node_w"] = np.zeros_like(np.asarray(out["split_col"]),
+                                      dtype=np.float32)
     return (np.asarray(out["split_col"]), np.asarray(out["bitset"]),
             np.asarray(out["value"]), np.asarray(out["node_w"]),
             np.asarray(out["child"]) if out.get("child") is not None
+            else None,
+            np.asarray(out["thr_bin"]) if out.get("thr_bin") is not None
+            else None,
+            np.asarray(out["na_left"]) if out.get("thr_bin") is not None
             else None)
 
 
@@ -70,10 +80,19 @@ def _children(ch, n):
 # numpy TreeSHAP (fallback + oracle); mirrors native/treeshap.cpp
 # ---------------------------------------------------------------------------
 
-def _py_treeshap(bins, sc_s, bs_s, vl_s, nw_s, ch_s) -> np.ndarray:
+def _py_treeshap(bins, sc_s, bs_s, vl_s, nw_s, ch_s, th_s=None,
+                 na_s=None, fine_na: int = -1) -> np.ndarray:
     R, C = bins.shape
     T = sc_s.shape[0]
+    B = bs_s.shape[-1] - 1
     phi = np.zeros((R, C + 1))
+
+    def go_left(t, n, b):
+        if th_s is not None and th_s[t][n] >= 0:
+            if b == fine_na:
+                return bool(na_s[t][n])
+            return b < th_s[t][n]
+        return bool(bs_s[t][n, min(b, B)])
 
     def tree_mean(t, n):
         sc, ch, vl, nw = sc_s[t], \
@@ -139,9 +158,9 @@ def _py_treeshap(bins, sc_s, bs_s, vl_s, nw_s, ch_s) -> np.ndarray:
             return
         col = int(sc[n])
         b = int(row[col])
-        go_left = bool(bs_s[t][n, b])
+        gl = go_left(t, n, b)
         l, r = _children(ch, n)
-        hot, cold = (l, r) if go_left else (r, l)
+        hot, cold = (l, r) if gl else (r, l)
         w = nw[n]
         hz = nw[hot] / w if w != 0 else 0.5
         cz = nw[cold] / w if w != 0 else 0.5
@@ -161,13 +180,15 @@ def _py_treeshap(bins, sc_s, bs_s, vl_s, nw_s, ch_s) -> np.ndarray:
     return phi
 
 
-def _shap_matrix(bins, sc, bs, vl, nw, ch) -> np.ndarray:
+def _shap_matrix(bins, sc, bs, vl, nw, ch, th=None, na=None,
+                 fine_na: int = -1) -> np.ndarray:
     """One class's (T, N) stack -> (R, C+1) contributions; native kernel
     with numpy fallback."""
     from h2o_tpu import native
     if native.treeshap_lib() is not None:
-        return native.treeshap_contribs(bins, sc, bs, vl, nw, ch)
-    return _py_treeshap(bins, sc, bs, vl, nw, ch)
+        return native.treeshap_contribs(bins, sc, bs, vl, nw, ch, th, na,
+                                        fine_na)
+    return _py_treeshap(bins, sc, bs, vl, nw, ch, th, na, fine_na)
 
 
 # ---------------------------------------------------------------------------
@@ -187,14 +208,17 @@ def contributions_frame(model, frame: Frame, top_n: int = 0,
     if output_format not in (None, "", "Original"):
         raise NotImplementedError(
             'Only output_format "Original" is supported for this model.')
-    sc, bs, vl, nw, ch = _forest_arrays(model)
+    sc, bs, vl, nw, ch, th, na = _forest_arrays(model)
     if sc.shape[1] != 1:
         raise NotImplementedError(
             "Calculating contributions is currently not supported for "
             "multinomial models.")
     bins = _binned(model, frame)
+    fine_na = st.model_fine_na(model.output)
     phi = _shap_matrix(bins, sc[:, 0], bs[:, 0], vl[:, 0], nw[:, 0],
-                       ch[:, 0] if ch is not None else None)
+                       ch[:, 0] if ch is not None else None,
+                       th[:, 0] if th is not None else None,
+                       na[:, 0] if na is not None else None, fine_na)
     if model.algo == "drf":
         # DRF predicts the MEAN of its trees' votes; contributions sum
         # (with the bias) to the p1/mean prediction.  (The reference
@@ -225,25 +249,28 @@ def _sorted_contributions(phi: np.ndarray, x: List[str], top_n: int,
     def adjust(n):
         return C if (n < 0 or n > C) else n
 
-    tn, bn = adjust(int(top_n or 0)), adjust(int(bottom_n or 0))
-    if (int(top_n or 0) + int(bottom_n or 0)) >= C or \
-            int(top_n or 0) < 0 or int(bottom_n or 0) < 0:
-        tn, bn = C, 0                 # "all sorted descending" cases
+    t_in, b_in = int(top_n or 0), int(bottom_n or 0)
+    # ContributionComposer.composeContributions branch order:
+    # only-top -> descending; only-bottom -> ASCENDING (bottom_n < 0 =
+    # all ascending); both with sum >= C or either negative -> all
+    # descending; else top_n descending + bottom_n ascending
+    if t_in != 0 and b_in == 0:
+        tn, bn = adjust(t_in), 0
+    elif t_in == 0 and b_in != 0:
+        tn, bn = 0, adjust(b_in)
+    elif (t_in + b_in) >= C or t_in < 0 or b_in < 0:
+        tn, bn = C, 0
+    else:
+        tn, bn = t_in, b_in
     vals = phi[:, :C]
     key = np.abs(vals) if compare_abs else vals
     desc = np.argsort(-key, axis=1, kind="stable")         # descending
     asc = np.argsort(key, axis=1, kind="stable")           # ascending
-    if tn and not bn:
-        order = desc[:, :tn]
-    elif bn and not tn:
-        order = asc[:, :bn]
-    else:                            # both: top_n descending + bottom_n
-        order = np.concatenate([desc[:, :tn], asc[:, :bn][:, ::-1]],
-                               axis=1)
+    order = np.concatenate([desc[:, :tn], asc[:, :bn]], axis=1)
     R, M = order.shape
     cols: Dict[str, Vec] = {}
     for j in range(M):
-        prefix = ("top", j + 1) if j < tn else ("bottom", M - j)
+        prefix = ("top", j + 1) if j < tn else ("bottom", j - tn + 1)
         fname = f"{prefix[0]}_feature_{prefix[1]}"
         vname = f"{prefix[0]}_value_{prefix[1]}"
         cols[fname] = Vec(order[:, j].astype(np.float32), T_CAT,
@@ -268,20 +295,22 @@ def _tree_col_names(T: int, K: int) -> List[str]:
 def leaf_assignment_frame(model, frame: Frame,
                           assign_type: str = "Path") -> Frame:
     out = model.output
-    sc, bs, _vl, _nw, ch = _forest_arrays(model)
+    sc, bs, _vl, _nw, ch, th, na = _forest_arrays(model,
+                                                  need_cover=False)
     T, K, N = sc.shape
     bins = _binned(model, frame)
+    fine_na = st.model_fine_na(out)
     per_class = []
     for k in range(K):
         from h2o_tpu import native
+        args = (bins, sc[:, k], bs[:, k],
+                ch[:, k] if ch is not None else None,
+                th[:, k] if th is not None else None,
+                na[:, k] if na is not None else None, fine_na)
         if native.treeshap_lib() is not None:
-            ids, paths = native.tree_leaf_assign(
-                bins, sc[:, k], bs[:, k],
-                ch[:, k] if ch is not None else None)
+            ids, paths = native.tree_leaf_assign(*args)
         else:
-            ids, paths = _py_leaf_assign(
-                bins, sc[:, k], bs[:, k],
-                ch[:, k] if ch is not None else None)
+            ids, paths = _py_leaf_assign(*args)
         per_class.append((ids, paths))
     names = _tree_col_names(T, K)
     cols: List[Vec] = []
@@ -302,9 +331,11 @@ def leaf_assignment_frame(model, frame: Frame,
     return Frame(names, cols)
 
 
-def _py_leaf_assign(bins, sc_s, bs_s, ch_s):
+def _py_leaf_assign(bins, sc_s, bs_s, ch_s, th_s=None, na_s=None,
+                    fine_na: int = -1):
     R = bins.shape[0]
     T, N = sc_s.shape
+    B = bs_s.shape[-1] - 1
     ids = np.zeros((R, T), np.int32)
     paths = np.zeros((R, T), "S64")
     for t in range(T):
@@ -314,7 +345,12 @@ def _py_leaf_assign(bins, sc_s, bs_s, ch_s):
             n, p = 0, []
             while not _is_leaf(sc, ch, n) and len(p) < 63:
                 col = int(sc[n])
-                go_left = bool(bs_s[t][n, int(bins[r, col])])
+                b = int(bins[r, col])
+                if th_s is not None and th_s[t][n] >= 0:
+                    go_left = bool(na_s[t][n]) if b == fine_na \
+                        else b < th_s[t][n]
+                else:
+                    go_left = bool(bs_s[t][n, min(b, B)])
                 p.append("L" if go_left else "R")
                 l, rt = _children(ch, n)
                 n = l if go_left else rt
@@ -334,13 +370,17 @@ def staged_proba_frame(model, frame: Frame) -> Frame:
     import jax
     out = model.output
     dom = out.get("response_domain")
-    sc, bs, vl, _nw, ch = _forest_arrays(model)
+    sc, bs, vl, _nw, ch, th, na = _forest_arrays(model,
+                                                 need_cover=False)
     T, K, N = sc.shape
     bins = jnp.asarray(_binned(model, frame))
     per_tree = np.asarray(st.forest_tree_values(
         bins, jnp.asarray(sc), jnp.asarray(bs), jnp.asarray(vl),
         int(out["max_depth"]),
-        child=jnp.asarray(ch) if ch is not None else None))  # (T, K, R)
+        child=jnp.asarray(ch) if ch is not None else None,
+        thr=jnp.asarray(th) if th is not None else None,
+        na_l=jnp.asarray(na) if na is not None else None,
+        fine_na=st.model_fine_na(out)))                      # (T, K, R)
     F = np.cumsum(per_tree, axis=0)                          # (T, K, R)
     f0 = np.asarray(out["f0"]).reshape(-1)
     names = _tree_col_names(T, K)

@@ -46,7 +46,8 @@ class DRFModel(Model):
         out = self.output
         m = frame.as_matrix(out["x"])
         bins = st._bin_all(m, jnp.asarray(out["split_points"]),
-                           jnp.asarray(out["is_cat"]), int(out["nbins"]))
+                           jnp.asarray(out["is_cat"]),
+                           st.model_fine_na(out))
         F = st.forest_score_out(bins, out)
         return raw_from_votes(F, int(out["ntrees_actual"]),
                               out.get("response_domain"),
@@ -59,7 +60,8 @@ class DRF(ModelBuilder):
     model_cls = DRFModel
 
     ENGINE_FIXED = {
-        "histogram_type": ("AUTO", "QuantilesGlobal"),
+        "histogram_type": ("AUTO", "UniformAdaptive", "QuantilesGlobal",
+                           "Random"),
         "binomial_double_trees": (False,),
     }
 
@@ -68,7 +70,8 @@ class DRF(ModelBuilder):
         p.update(ntrees=50, max_depth=20, min_rows=1.0, nbins=20,
                  nbins_cats=1024, mtries=-1, sample_rate=0.632,
                  col_sample_rate_per_tree=1.0, min_split_improvement=1e-5,
-                 histogram_type="QuantilesGlobal", binomial_double_trees=False,
+                 histogram_type="AUTO", nbins_top_level=1024,
+                 binomial_double_trees=False,
                  score_each_iteration=False, score_tree_interval=0,
                  stopping_rounds=0, stopping_metric="AUTO",
                  stopping_tolerance=1e-3)
@@ -87,16 +90,21 @@ class DRF(ModelBuilder):
         nclass = di.nclasses
         K = nclass if nclass > 2 else 1
 
+        hist_type = st.resolve_histogram_type(p)
         if ckpt is not None:
+            hist_type = co.get("hist_type", "QuantilesGlobal")
+            ck_fine = int(co.get("fine_nbins") or co["nbins"])
             sp_dev = jnp.asarray(co["split_points"])
             binned = st.BinnedData(
                 st._bin_all(train.as_matrix(di.x), sp_dev,
-                            jnp.asarray(co["is_cat"]), int(co["nbins"])),
+                            jnp.asarray(co["is_cat"]), ck_fine),
                 np.asarray(co["split_points"]), sp_dev,
-                np.asarray(co["is_cat"]), int(co["nbins"]))
+                np.asarray(co["is_cat"]), int(co["nbins"]), ck_fine,
+                hist_type)
         else:
-            binned = st.prepare_bins(di, int(p["nbins"]),
-                                     int(p["nbins_cats"]))
+            binned = st.prepare_bins(
+                di, int(p["nbins"]), int(p["nbins_cats"]), hist_type,
+                int(p.get("nbins_top_level") or 1024))
         bins = binned.bins
         yv = di.response()
         w = di.weights()
@@ -149,7 +157,9 @@ class DRF(ModelBuilder):
                         else np.asarray(co["child"])
             out = dict(
                 x=list(di.x), split_points=sp_np, is_cat=ic_np,
-                nbins=binned.nbins, split_col=sc, bitset=bs, value=vl,
+                nbins=binned.nbins, fine_nbins=binned.fine,
+                hist_type=binned.hist_type,
+                split_col=sc, bitset=bs, value=vl,
                 child=ch,
                 max_depth=depth, effective_max_depth=depth,
                 response_domain=di.response_domain if nclass >= 2 else None,
@@ -165,6 +175,9 @@ class DRF(ModelBuilder):
                 out["node_gain"] = np.asarray(co["node_gain"])
             if ckpt is not None and co.get("node_w") is not None:
                 out["node_w"] = np.asarray(co["node_w"])
+            if ckpt is not None and co.get("thr_bin") is not None:
+                out["thr_bin"] = np.asarray(co["thr_bin"])
+                out["na_left"] = np.asarray(co["na_left"])
             model = self.model_cls(self.model_id, dict(p), out)
             model.params["response_column"] = y
             return model
@@ -180,7 +193,10 @@ class DRF(ModelBuilder):
             min_split_improvement=float(p["min_split_improvement"]),
             col_sample_rate_per_tree=float(
                 p.get("col_sample_rate_per_tree") or 1.0),
-            mode="drf", kleaves=kleaves)
+            mode="drf", kleaves=kleaves,
+            adaptive=binned.hist_type in ("UniformAdaptive", "Random"),
+            fine_nbins=binned.fine,
+            hist_random=binned.hist_type == "Random")
         kind = "binomial" if nclass == 2 else (
             "multinomial" if nclass > 2 else "regression")
         from h2o_tpu.models.tree.driver import (IncrementalScorer,
@@ -194,7 +210,7 @@ class DRF(ModelBuilder):
             score_frame = valid if valid is not None else train
             bins_sc = bins if valid is None else st._bin_all(
                 valid.as_matrix(di.x), binned.split_points_dev,
-                jnp.asarray(binned.is_cat), binned.nbins)
+                jnp.asarray(binned.is_cat), binned.fine)
             F_sc = jnp.zeros((bins_sc.shape[0], K), jnp.float32)
             if prior:
                 F_sc = F_sc + st.forest_score_out(bins_sc, co, depth)
@@ -212,7 +228,8 @@ class DRF(ModelBuilder):
                     raw_from_votes(Fv, ntot, dom_sc), score_frame)
 
             scorer = IncrementalScorer(bins_sc, F_sc, depth, to_metrics,
-                                       valid is not None)
+                                       valid is not None,
+                                       fine_na=binned.fine)
         job.update(0.05, f"training {int(p['ntrees']) - prior} trees")
         model = run_tree_driver(job, p, train_kwargs, F0, self.rng_key(),
                                 make_model, scorer, kind,

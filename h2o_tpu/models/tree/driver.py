@@ -42,8 +42,11 @@ def _set_node_array(model, name: str, new: np.ndarray) -> None:
             # compute contributions" guard instead
             model.output[name] = None
             return
-        pad = np.zeros((sc_all.shape[0] - new.shape[0],) +
-                       new.shape[1:], new.dtype)
+        # thr_bin prefix must be -1 (bitset mode) so checkpoint trees
+        # keep their pure-bitset descent semantics; others pad zero
+        fill = -1 if name == "thr_bin" else 0
+        pad = np.full((sc_all.shape[0] - new.shape[0],) +
+                      new.shape[1:], fill, new.dtype)
         new = np.concatenate([pad, new])
     model.output[name] = new
 
@@ -56,19 +59,24 @@ class IncrementalScorer:
     """
 
     def __init__(self, bins, F_init, depth: int,
-                 to_metrics: Callable, is_validation: bool):
+                 to_metrics: Callable, is_validation: bool,
+                 fine_na: int = -1):
         self.bins = bins
         self.F = F_init
         self.depth = depth
         self.to_metrics = to_metrics
         self.is_validation = is_validation
+        self.fine_na = fine_na
 
-    def add(self, sc, bs, vl, ch=None) -> None:
+    def add(self, sc, bs, vl, ch=None, th=None, na=None) -> None:
         from h2o_tpu.models.tree.shared_tree import forest_score
         self.F = self.F + forest_score(
             self.bins, jnp.asarray(sc), jnp.asarray(bs), jnp.asarray(vl),
             self.depth,
-            child=jnp.asarray(ch) if ch is not None else None)
+            child=jnp.asarray(ch) if ch is not None else None,
+            thr=jnp.asarray(th) if th is not None else None,
+            na_l=jnp.asarray(na) if na is not None else None,
+            fine_na=self.fine_na)
 
     def metrics(self, ntrees_total: int):
         return self.to_metrics(self.F, ntrees_total)
@@ -121,10 +129,12 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
         model.output["varimp"] = vi if prior_vi is None else prior_vi + vi
         _set_node_array(model, "node_gain", np.asarray(tf.node_gain))
         _set_node_array(model, "node_w", np.asarray(tf.node_w))
+        _set_node_array(model, "thr_bin", np.asarray(tf.thr_bin))
+        _set_node_array(model, "na_left", np.asarray(tf.na_left))
         return model
 
     block = interval if interval > 0 else max(1, min(ntrees, 10))
-    scs, bss, vls, chs, gns, nws = [], [], [], [], [], []
+    scs, bss, vls, chs, gns, nws, ths, nas = [], [], [], [], [], [], [], []
     vi_total = None
     F = F0
     done = 0
@@ -142,10 +152,13 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
             chs.append(np.asarray(tf.child))
         gns.append(np.asarray(tf.node_gain))
         nws.append(np.asarray(tf.node_w))
+        ths.append(np.asarray(tf.thr_bin))
+        nas.append(np.asarray(tf.na_left))
         vi = np.asarray(tf.varimp)
         vi_total = vi if vi_total is None else vi_total + vi
         done += n
-        scorer.add(tf.split_col, tf.bitset, tf.value, tf.child)
+        scorer.add(tf.split_col, tf.bitset, tf.value, tf.child,
+                   tf.thr_bin, tf.na_left)
         mm = scorer.metrics(prior_trees + done)
         row = {"number_of_trees": prior_trees + done,
                "timestamp": time.time()}
@@ -168,6 +181,8 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
     model.output["scoring_history"] = sk.events
     _set_node_array(model, "node_gain", np.concatenate(gns))
     _set_node_array(model, "node_w", np.concatenate(nws))
+    _set_node_array(model, "thr_bin", np.concatenate(ths))
+    _set_node_array(model, "na_left", np.concatenate(nas))
     prior_vi = model.output.get("varimp")
     if vi_total is not None:
         model.output["varimp"] = vi_total if prior_vi is None \

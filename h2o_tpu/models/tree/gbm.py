@@ -56,7 +56,7 @@ class GBMModel(Model):
         m = frame.as_matrix(di_x)
         bins = st._bin_all(m, jnp.asarray(out["split_points"]),
                            jnp.asarray(out["is_cat"]),
-                           int(out["nbins"]))
+                           st.model_fine_na(out))
         F = st.forest_score_out(bins, out)
         F = F + jnp.asarray(out["f0"])[None, :]
         off_col = self.params.get("offset_column")
@@ -77,7 +77,8 @@ class GBM(ModelBuilder):
     # engine-fixed params (ModelBuilder._validate_fixed: accepted values
     # only — anything else errors instead of silently no-opping)
     ENGINE_FIXED = {
-        "histogram_type": ("AUTO", "QuantilesGlobal"),
+        "histogram_type": ("AUTO", "UniformAdaptive", "QuantilesGlobal",
+                           "Random"),
         "categorical_encoding": ("AUTO", "Enum"),
         "calibrate_model": (False,),
     }
@@ -88,7 +89,8 @@ class GBM(ModelBuilder):
                  nbins_cats=1024, learn_rate=0.1, learn_rate_annealing=1.0,
                  sample_rate=1.0, col_sample_rate=1.0,
                  col_sample_rate_per_tree=1.0, min_split_improvement=1e-5,
-                 histogram_type="QuantilesGlobal", categorical_encoding="AUTO",
+                 histogram_type="AUTO", nbins_top_level=1024,
+                 categorical_encoding="AUTO",
                  score_each_iteration=False, score_tree_interval=0,
                  stopping_rounds=0, stopping_metric="AUTO",
                  stopping_tolerance=1e-3, build_tree_one_node=False,
@@ -148,16 +150,22 @@ class GBM(ModelBuilder):
             else 1
         K = nclass if dist_name == "multinomial" else 1
 
+        hist_type = st.resolve_histogram_type(p)
         if ckpt is not None:
+            # resume MUST bin in the checkpoint's grid space
+            hist_type = co.get("hist_type", "QuantilesGlobal")
+            ck_fine = int(co.get("fine_nbins") or co["nbins"])
             sp_dev = jnp.asarray(co["split_points"])
             binned = st.BinnedData(
                 st._bin_all(train.as_matrix(di.x), sp_dev,
-                            jnp.asarray(co["is_cat"]), int(co["nbins"])),
+                            jnp.asarray(co["is_cat"]), ck_fine),
                 np.asarray(co["split_points"]), sp_dev,
-                np.asarray(co["is_cat"]), int(co["nbins"]))
+                np.asarray(co["is_cat"]), int(co["nbins"]), ck_fine,
+                hist_type)
         else:
-            binned = st.prepare_bins(di, int(p["nbins"]),
-                                     int(p["nbins_cats"]))
+            binned = st.prepare_bins(
+                di, int(p["nbins"]), int(p["nbins_cats"]), hist_type,
+                int(p.get("nbins_top_level") or 1024))
         bins = binned.bins
         yv = di.response()
         w = di.weights()
@@ -258,7 +266,9 @@ class GBM(ModelBuilder):
                         else np.asarray(co["child"])
             out = dict(
                 x=list(di.x), split_points=sp_np, is_cat=ic_np,
-                nbins=binned.nbins, split_col=sc, bitset=bs, value=vl,
+                nbins=binned.nbins, fine_nbins=binned.fine,
+                hist_type=binned.hist_type,
+                split_col=sc, bitset=bs, value=vl,
                 child=ch,
                 max_depth=depth, f0=f0_out, effective_max_depth=depth,
                 distribution_resolved=dist_name,
@@ -276,6 +286,9 @@ class GBM(ModelBuilder):
                 out["node_gain"] = np.asarray(co["node_gain"])
             if ckpt is not None and co.get("node_w") is not None:
                 out["node_w"] = np.asarray(co["node_w"])
+            if ckpt is not None and co.get("thr_bin") is not None:
+                out["thr_bin"] = np.asarray(co["thr_bin"])
+                out["na_left"] = np.asarray(co["na_left"])
             model = self.model_cls(self.model_id, dict(p), out)
             model.params["response_column"] = y
             return model
@@ -297,7 +310,10 @@ class GBM(ModelBuilder):
             col_sample_rate_per_tree=float(
                 p.get("col_sample_rate_per_tree") or 1.0),
             huber_alpha=float(p["huber_alpha"]), kleaves=kleaves,
-            custom_dist=custom)
+            custom_dist=custom,
+            adaptive=binned.hist_type in ("UniformAdaptive", "Random"),
+            fine_nbins=binned.fine,
+            hist_random=binned.hist_type == "Random")
         mono = self._mono_array(p, di)
         if mono is not None:
             train_kwargs["mono"] = jnp.asarray(mono)
@@ -315,7 +331,7 @@ class GBM(ModelBuilder):
             score_frame = valid if valid is not None else train
             bins_sc = bins if valid is None else st._bin_all(
                 valid.as_matrix(di.x), binned.split_points_dev,
-                jnp.asarray(binned.is_cat), binned.nbins)
+                jnp.asarray(binned.is_cat), binned.fine)
             F_sc = jnp.broadcast_to(
                 f0[None, :], (bins_sc.shape[0], K)).astype(jnp.float32)
             off_col = p.get("offset_column")
@@ -340,7 +356,8 @@ class GBM(ModelBuilder):
                 return proto.metrics_from_raw(raw, score_frame)
 
             scorer = IncrementalScorer(bins_sc, F_sc, depth, to_metrics,
-                                       valid is not None)
+                                       valid is not None,
+                                       fine_na=binned.fine)
         job.update(0.05, f"training {int(p['ntrees']) - prior} trees")
         model = run_tree_driver(job, p, train_kwargs, F, self.rng_key(),
                                 make_model, scorer, kind,

@@ -111,6 +111,80 @@ def pool_size(depth: int, kleaves: int) -> int:
     return 1 + 2 * sum(frontier_plan(depth, kleaves))
 
 
+def _adaptive_ranges_init(L: int, C: int, F: int):
+    """Root fine ranges: the whole top-level grid."""
+    return (jnp.zeros((L, C), jnp.int32),
+            jnp.full((L, C), F - 1, jnp.int32))
+
+
+def _rand_offsets(key, L: int, C: int, lo, hi, random_mode: bool):
+    """Random-histogram boundary offsets in fine units, per (leaf, col)
+    (DHistogram random split points analog: every node's bucket
+    boundaries shift by a random fraction of a bucket)."""
+    if not random_mode:
+        return jnp.zeros((L, C), jnp.int32)
+    span = jnp.maximum(hi - lo + 1, 1)
+    u = jax.random.uniform(key, (L, C))
+    return jnp.minimum((u * span.astype(jnp.float32)).astype(jnp.int32),
+                       span - 1)
+
+
+def _numeric_thr(s, lo, hi, off, B: int):
+    """Chosen bucket boundary -> EXACT fine-bin threshold: go-left is
+    bucket(x) < k  <=>  x < lo + ceil((k*span - o)/B) (all-integer, the
+    same arithmetic map_buckets applies)."""
+    L = lo.shape[0]
+    li = jnp.arange(L)
+    colc = s["col"]
+    lo_c = lo[li, colc]
+    hi_c = hi[li, colc]
+    o_c = off[li, colc]
+    span = jnp.maximum(hi_c - lo_c + 1, 1)
+    k = s["split_b"] + 1
+    return lo_c + (k * span - o_c + B - 1) // B
+
+
+def _refine_ranges(hist, lo, hi, off, B: int):
+    """Observed-range tightening from the level's own histograms
+    (DHistogram per-node min/max): the fine sub-range actually covered
+    by non-empty buckets — free adaptivity for EVERY column, not just
+    the split one."""
+    wb = hist[..., 0][:, :, :B]                    # (L, C, B) weights
+    have = wb > 0
+    anyb = jnp.any(have, axis=2)
+    first = jnp.argmax(have, axis=2).astype(jnp.int32)
+    last = (B - 1 - jnp.argmax(have[:, :, ::-1], axis=2)).astype(jnp.int32)
+    span = jnp.maximum(hi - lo + 1, 1)
+    # bucket j covers fine [lo + ceil((j*span-o)/B), lo + ceil(((j+1)*
+    # span-o)/B) - 1]
+    lo_edge = lo + jnp.maximum((first * span - off + B - 1) // B, 0)
+    hi_edge = lo + jnp.clip(((last + 1) * span - off + B - 1) // B,
+                            1, span) - 1
+    new_lo = jnp.where(anyb, lo_edge, lo)
+    new_hi = jnp.where(anyb, jnp.maximum(hi_edge, lo_edge), hi)
+    return new_lo, new_hi
+
+
+def _child_ranges(new_lo, new_hi, s, thr_leaf, is_cat, do_split):
+    """Children inherit the refined parent range; the split column is
+    additionally truncated at the threshold (left: [lo, thr-1], right:
+    [thr, hi]).  Returns (2L, C) interleaved left/right."""
+    L, C = new_lo.shape
+    li = jnp.arange(L)
+    colc = s["col"]
+    num_split = do_split & ~is_cat[colc]
+    big = jnp.int32(1 << 28)
+    lo2 = jnp.stack([new_lo, new_lo], axis=1).reshape(2 * L, C)
+    hi2 = jnp.stack([new_hi, new_hi], axis=1).reshape(2 * L, C)
+    thr_hi = jnp.where(num_split, thr_leaf - 1, big)     # left child cap
+    thr_lo = jnp.where(num_split, thr_leaf, -big)        # right child floor
+    hi2 = hi2.at[2 * li, colc].min(thr_hi)
+    lo2 = lo2.at[2 * li + 1, colc].max(thr_lo)
+    # degenerate guards (empty side): keep ranges ordered
+    lo2 = jnp.minimum(lo2, hi2)
+    return lo2, hi2
+
+
 def _node_val(wg, wh, w, newton: bool, reg_lambda: float = 0.0):
     denom = jnp.maximum(wh + reg_lambda, EPS) if newton \
         else jnp.maximum(w, EPS)
@@ -168,6 +242,8 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
     varimp = jnp.zeros((C,), jnp.float32)
     node_gain = jnp.zeros((H,), jnp.float32)   # per-split SE reduction
     node_w = jnp.zeros((H,), jnp.float32)      # per-node cover (TreeSHAP)
+    thr_arr = jnp.full((H,), -1, jnp.int32)    # adaptive numeric splits
+    na_arr = jnp.zeros((H,), bool)
     leaf = leaf0
     use_mono = bool(cfg.get("use_mono")) and mono is not None
     # monotone value bounds per live leaf (XGBoost-style two-part scheme:
@@ -175,12 +251,31 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
     lo_b = jnp.full((1,), -jnp.inf, jnp.float32)
     hi_b = jnp.full((1,), jnp.inf, jnp.float32)
 
-    sib = bool(cfg.get("sibling", True))
+    adaptive = bool(cfg.get("adaptive", False))
+    F = int(cfg.get("fine_nbins") or B)
+    random_mode = bool(cfg.get("hist_random", False))
+    if adaptive:
+        rlo, rhi = _adaptive_ranges_init(1, C, F)
+
+    # sibling subtraction needs identical bucket edges for parent and
+    # children — global-grid binning only; per-node adaptive ranges
+    # change the edges every level
+    sib = bool(cfg.get("sibling", True)) and not adaptive
     prev_hist = prev_do = None
     for d in range(D):                       # static unroll — exact L per level
         L = 2 ** d
         off = L - 1
-        if sib and d >= 1:
+        # reference halving schedule (nbins_top_level): F buckets at the
+        # root, halving per level down to nbins — per-level histogram
+        # cost L * Bd stays ~constant
+        Bd = max(B, F >> d) if adaptive else B
+        if adaptive:
+            key, sub = jax.random.split(key)
+            roff = _rand_offsets(sub, L, C, rlo, rhi, random_mode)
+            hist = _shard_histogram(
+                bins, leaf, stats, L, Bd, cfg["block_rows"], cfg["bf16"],
+                fine_map=(rlo, rhi, roff, is_cat, F))
+        elif sib and d >= 1:
             hist = _hist_level_with_sibling(bins, leaf, stats, L, B, cfg,
                                             prev_hist, prev_do)
         else:
@@ -230,8 +325,25 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
             jnp.where(do_split, jnp.maximum(s["gain"], 0.0), 0.0), (off,))
         split_col = jax.lax.dynamic_update_slice(
             split_col, jnp.where(do_split, s["col"], -1), (off,))
-        bitset = jax.lax.dynamic_update_slice(
-            bitset, s["bitset"] & do_split[:, None], (off, 0))
+        cat_choice = is_cat[s["col"]]
+        if adaptive:
+            thr_leaf = _numeric_thr(s, rlo, rhi, roff, Bd)
+            num_split = do_split & ~cat_choice
+            thr_arr = jax.lax.dynamic_update_slice(
+                thr_arr, jnp.where(num_split, thr_leaf, -1), (off,))
+            na_arr = jax.lax.dynamic_update_slice(
+                na_arr, num_split & s["na_left"], (off,))
+            # numeric nodes carry the fine threshold; their BUCKET
+            # bitsets are per-node artifacts and must not be stored.
+            # Cat splits: codes live in the first B buckets whatever Bd
+            # is; keep membership [:B] + the NA bit
+            bset_store = jnp.concatenate(
+                [s["bitset"][:, :B], s["bitset"][:, Bd: Bd + 1]], axis=1)
+            bset_w = bset_store & (do_split & cat_choice)[:, None]
+        else:
+            thr_leaf = None
+            bset_w = s["bitset"] & do_split[:, None]
+        bitset = jax.lax.dynamic_update_slice(bitset, bset_w, (off, 0))
         value = jax.lax.dynamic_update_slice(
             value, jnp.where(term, leaf_vals, 0.0), (off,))
         node_w = jax.lax.dynamic_update_slice(
@@ -256,12 +368,23 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
         lf = jnp.maximum(leaf, 0)
         c = s["col"][lf]
         b = jnp.take_along_axis(bins, c[:, None], axis=1)[:, 0]
-        go_left = s["bitset"][lf, b]
+        if adaptive:
+            gset = s["bitset"][lf, jnp.minimum(b, Bd)]
+            gthr = jnp.where(b == F, s["na_left"][lf],
+                             b < thr_leaf[lf])
+            go_left = jnp.where(cat_choice[lf], gset, gthr)
+        else:
+            go_left = s["bitset"][lf, b]
         child = 2 * lf + jnp.where(go_left, 0, 1)
         leaf = jnp.where(active & do_split[lf], child,
                          jnp.where(active, -1, leaf))
+        if adaptive and d + 1 < D:
+            new_lo, new_hi = _refine_ranges(hist, rlo, rhi, roff, Bd)
+            rlo, rhi = _child_ranges(new_lo, new_hi, s, thr_leaf,
+                                     is_cat, do_split)
         prev_hist, prev_do = hist, do_split
-    return split_col, bitset, value, varimp, node_gain, node_w
+    return (split_col, bitset, value, varimp, node_gain, node_w,
+            thr_arr, na_arr)
 
 
 def build_tree_frontier(bins, stats, slot0, key, is_cat, cfg: Dict,
@@ -299,6 +422,8 @@ def build_tree_frontier(bins, stats, slot0, key, is_cat, cfg: Dict,
     child = jnp.full((N + 1,), -1, jnp.int32)
     node_gain = jnp.zeros((N + 1,), jnp.float32)
     node_w = jnp.zeros((N + 1,), jnp.float32)  # per-node cover (TreeSHAP)
+    thr_pool = jnp.full((N + 1,), -1, jnp.int32)   # adaptive numeric thr
+    na_pool = jnp.zeros((N + 1,), bool)
     varimp = jnp.zeros((C,), jnp.float32)
 
     frontier = jnp.zeros((1,), jnp.int32)          # pool ids of live leaves
@@ -308,11 +433,24 @@ def build_tree_frontier(bins, stats, slot0, key, is_cat, cfg: Dict,
     hi_b = jnp.full((1,), jnp.inf, jnp.float32)
     base = 1                                       # next free pool slot
 
-    sib = bool(cfg.get("sibling", True))
+    adaptive = bool(cfg.get("adaptive", False))
+    F = int(cfg.get("fine_nbins") or B)
+    random_mode = bool(cfg.get("hist_random", False))
+    if adaptive:
+        rlo, rhi = _adaptive_ranges_init(1, C, F)
+
+    sib = bool(cfg.get("sibling", True)) and not adaptive
     prev_hist = prev_do = None
     for d in range(D):                             # static unroll
         L = widths[d]
-        if sib and d >= 1 and L == 2 * widths[d - 1]:
+        Bd = max(B, F >> d) if adaptive else B
+        if adaptive:
+            key, sub = jax.random.split(key)
+            roff = _rand_offsets(sub, L, C, rlo, rhi, random_mode)
+            hist = _shard_histogram(
+                bins, slot, stats, L, Bd, cfg["block_rows"], cfg["bf16"],
+                fine_map=(rlo, rhi, roff, is_cat, F))
+        elif sib and d >= 1 and L == 2 * widths[d - 1]:
             # uncapped transition: children sit at 2*parent+{0,1} in
             # parent order (identity selection), so the dense sibling
             # subtraction applies verbatim; capped levels (top_k
@@ -366,7 +504,20 @@ def build_tree_frontier(bins, stats, slot0, key, is_cat, cfg: Dict,
         child_ptr = base + 2 * jnp.arange(L, dtype=jnp.int32)
         split_col = split_col.at[frontier].set(
             jnp.where(do_split, s["col"], -1))
-        bitset = bitset.at[frontier].set(s["bitset"] & do_split[:, None])
+        cat_choice = is_cat[s["col"]]
+        if adaptive:
+            thr_leaf = _numeric_thr(s, rlo, rhi, roff, Bd)
+            num_split = do_split & ~cat_choice
+            thr_pool = thr_pool.at[frontier].set(
+                jnp.where(num_split, thr_leaf, -1))
+            na_pool = na_pool.at[frontier].set(num_split & s["na_left"])
+            bset_store = jnp.concatenate(
+                [s["bitset"][:, :B], s["bitset"][:, Bd: Bd + 1]], axis=1)
+            bset_w = bset_store & (do_split & cat_choice)[:, None]
+        else:
+            thr_leaf = None
+            bset_w = s["bitset"] & do_split[:, None]
+        bitset = bitset.at[frontier].set(bset_w)
         value = value.at[frontier].set(jnp.where(term, leaf_vals, 0.0))
         child = child.at[frontier].set(jnp.where(do_split, child_ptr, -1))
         node_gain = node_gain.at[frontier].set(gain_pos)
@@ -402,37 +553,53 @@ def build_tree_frontier(bins, stats, slot0, key, is_cat, cfg: Dict,
             inv = jnp.full((2 * L,), -1, jnp.int32).at[sel].set(
                 jnp.where(sel_valid,
                           jnp.arange(L_next, dtype=jnp.int32), -1))
-            # route rows: split-parent rows follow the bitset to a child;
+            # route rows: split-parent rows follow the split to a child;
             # rows whose child fell off the frontier finalize (-1)
             active = slot >= 0
             sl = jnp.maximum(slot, 0)
             c = s["col"][sl]
             b = jnp.take_along_axis(bins, c[:, None], axis=1)[:, 0]
-            go_left = s["bitset"][sl, b]
+            if adaptive:
+                gset = s["bitset"][sl, jnp.minimum(b, Bd)]
+                gthr = jnp.where(b == F, s["na_left"][sl],
+                                 b < thr_leaf[sl])
+                go_left = jnp.where(cat_choice[sl], gset, gthr)
+            else:
+                go_left = s["bitset"][sl, b]
             cand = 2 * sl + jnp.where(go_left, 0, 1)
             new_slot = jnp.where(active & do_split[sl], inv[cand], -1)
             slot = jnp.where(active, new_slot, slot)
             if use_mono:
                 lo_b = jnp.take(lo_c, sel)
                 hi_b = jnp.take(hi_c, sel)
+            if adaptive:
+                new_lo, new_hi = _refine_ranges(hist, rlo, rhi, roff, Bd)
+                clo, chi = _child_ranges(new_lo, new_hi, s, thr_leaf,
+                                         is_cat, do_split)
+                rlo = jnp.take(clo, sel, axis=0)
+                rhi = jnp.take(chi, sel, axis=0)
         prev_hist, prev_do = hist, do_split
         base += 2 * L
 
     return (split_col[:N], bitset[:N], value[:N], child[:N], varimp,
-            node_gain[:N], node_w[:N])
+            node_gain[:N], node_w[:N], thr_pool[:N], na_pool[:N])
 
 
-def _tree_predict(bins, split_col, bitset, value, D: int, child=None):
+def _tree_predict(bins, split_col, bitset, value, D: int, child=None,
+                  thr=None, na_l=None, fine_na: int = -1):
     """Descend one tree for all rows (traceable).  ``child`` None = dense
-    heap (children at 2n+1/2n+2), else explicit left-child pointers."""
+    heap (children at 2n+1/2n+2), else explicit left-child pointers;
+    ``thr``/``na_l`` carry adaptive numeric thresholds."""
+    from h2o_tpu.models.tree.shared_tree import _go_left
     R = bins.shape[0]
+    B = bitset.shape[-1] - 1
     node = jnp.zeros((R,), jnp.int32)
     for _ in range(D):
         c = split_col[node]
         term = c < 0
         b = jnp.take_along_axis(bins, jnp.maximum(c, 0)[:, None],
                                 axis=1)[:, 0]
-        go_left = bitset[node, b]
+        go_left = _go_left(bitset, node, b, thr, na_l, fine_na, B)
         if child is None:
             nxt = 2 * node + jnp.where(go_left, 1, 2)
         else:
@@ -451,6 +618,8 @@ class TrainedForest(NamedTuple):
     varimp: jax.Array      # (C,) summed split-gain importance
     node_gain: jax.Array   # (T, K, N) per-split gain (FeatureInteraction)
     node_w: jax.Array      # (T, K, N) per-node training cover (TreeSHAP)
+    thr_bin: jax.Array     # (T, K, N) adaptive numeric thr (-1 = bitset)
+    na_left: jax.Array     # (T, K, N) NA direction for thr splits
     child: object = None   # (T, K, N) left-child pool ptrs; None = dense
 
 
@@ -473,7 +642,8 @@ def train_forest(*args, sibling: Optional[bool] = None, **kwargs):
                      "mode", "tweedie_power", "quantile_alpha",
                      "huber_alpha", "reg_lambda",
                      "col_sample_rate_per_tree", "use_mono",
-                     "kleaves", "custom_dist", "sibling"))
+                     "kleaves", "custom_dist", "sibling",
+                     "adaptive", "fine_nbins", "hist_random"))
 def _train_forest_jit(bins, yv, w, active, F0, is_cat, key, *,
                       dist_name: str,
                  K: int, ntrees: int, max_depth: int, nbins: int,
@@ -488,7 +658,9 @@ def _train_forest_jit(bins, yv, w, active, F0, is_cat, key, *,
                  mono=None, use_mono: bool = False,
                  t0: int = 0, kleaves: int = 0,
                  custom_dist=None,
-                 sibling: bool = True) -> TrainedForest:
+                 sibling: bool = True,
+                 adaptive: bool = False, fine_nbins: int = 0,
+                 hist_random: bool = False) -> TrainedForest:
     """The WHOLE forest training loop as one XLA program.
 
     mode="gbm": boosting — stats from distribution gradients at current F,
@@ -504,7 +676,8 @@ def _train_forest_jit(bins, yv, w, active, F0, is_cat, key, *,
                min_split_improvement=min_split_improvement,
                block_rows=block_rows, bf16=bf16, reg_lambda=reg_lambda,
                use_mono=use_mono, max_live_leaves=kleaves,
-               sibling=sibling)
+               sibling=sibling, adaptive=adaptive,
+               fine_nbins=fine_nbins, hist_random=hist_random)
     R = bins.shape[0]
 
     def stats_for(kcls, F):
@@ -554,17 +727,17 @@ def _train_forest_jit(bins, yv, w, active, F0, is_cat, key, *,
             if mode == "gbm" else 1.0
         if mode == "gbm" and dist_name == "multinomial":
             scale = scale * (K - 1) / K
-        scs, bss, vls, chs, preds, vis, gns, nws = \
-            [], [], [], [], [], [], [], []
+        scs, bss, vls, chs, preds, vis, gns, nws, ths, nas = \
+            [], [], [], [], [], [], [], [], [], []
         for kcls in range(K):                    # static unroll over classes
             kc, kk = jax.random.split(kc)
             stats = stats_for(kcls, F)
             if kleaves > 0:
-                sc, bs, vl, ch, vi, gn, nw = build_tree_frontier(
+                sc, bs, vl, ch, vi, gn, nw, th, na = build_tree_frontier(
                     bins, stats, leaf0, kk, is_cat, cfg, tree_cols,
                     mono=mono)
             else:
-                sc, bs, vl, vi, gn, nw = build_tree_traced(
+                sc, bs, vl, vi, gn, nw, th, na = build_tree_traced(
                     bins, stats, leaf0, kk, is_cat, cfg, tree_cols,
                     mono=mono)
                 ch = None
@@ -576,11 +749,15 @@ def _train_forest_jit(bins, yv, w, active, F0, is_cat, key, *,
             vis.append(vi)
             gns.append(gn)
             nws.append(nw)
-            preds.append(_tree_predict(bins, sc, bs, vl, max_depth,
-                                       child=ch))
+            ths.append(th)
+            nas.append(na)
+            preds.append(_tree_predict(
+                bins, sc, bs, vl, max_depth, child=ch, thr=th, na_l=na,
+                fine_na=int(cfg.get("fine_nbins") or nbins)))
         F = F + jnp.stack(preds, axis=1)
         out = (jnp.stack(scs), jnp.stack(bss), jnp.stack(vls),
-               sum(vis), jnp.stack(gns), jnp.stack(nws))
+               sum(vis), jnp.stack(gns), jnp.stack(nws),
+               jnp.stack(ths), jnp.stack(nas))
         if kleaves > 0:
             out = out + (jnp.stack(chs),)
         return F, out
@@ -591,8 +768,8 @@ def _train_forest_jit(bins, yv, w, active, F0, is_cat, key, *,
     ts = jnp.arange(ntrees, dtype=jnp.float32) + jnp.float32(t0)
     F_final, outs = jax.lax.scan(tree_step, F0, (ts, keys))
     if kleaves > 0:
-        sc, bs, vl, vi, gn, nw, ch = outs
+        sc, bs, vl, vi, gn, nw, th, na, ch = outs
     else:
-        (sc, bs, vl, vi, gn, nw), ch = outs, None
+        (sc, bs, vl, vi, gn, nw, th, na), ch = outs, None
     return TrainedForest(sc, bs, vl, F_final, jnp.sum(vi, axis=0), gn, nw,
-                         ch)
+                         th, na, ch)

@@ -46,11 +46,19 @@ EPS = 1e-10
 
 
 class BinnedData(NamedTuple):
-    bins: jax.Array          # (R, C) int32 in [0, B]; B = NA bucket
-    split_points: np.ndarray  # (C, B-1) f32 host copy (model artifact)
+    bins: jax.Array          # (R, C) int32 in [0, F]; F = NA bucket
+    split_points: np.ndarray  # (C, F-1) f32 host copy (model artifact)
     split_points_dev: jax.Array
     is_cat: np.ndarray       # (C,) bool
-    nbins: int
+    nbins: int               # histogram bucket count B (bitset width B+1)
+    # fine-grid resolution F >= B (UniformAdaptive/Random: the uniform
+    # top-level grid, reference nbins_top_level; QuantilesGlobal: F == B)
+    fine_nbins: int = 0
+    hist_type: str = "QuantilesGlobal"
+
+    @property
+    def fine(self) -> int:
+        return self.fine_nbins or self.nbins
 
 
 @functools.partial(jax.jit, static_argnames=("nbins",))
@@ -76,36 +84,98 @@ def _quantile_split_points(matrix, nrows, nbins: int):
     return sp.T                                      # (C, B-1)
 
 
-def prepare_bins(di: DataInfo, nbins: int, nbins_cats: int) -> BinnedData:
-    """Global quantile binning (numeric) + code binning (categorical)."""
+def resolve_histogram_type(p) -> str:
+    """AUTO means UniformAdaptive, exactly like the reference
+    (DHistogram.java:19-62 — AUTO -> UniformAdaptive default)."""
+    ht = str(p.get("histogram_type") or "AUTO")
+    return "UniformAdaptive" if ht == "AUTO" else ht
+
+
+def prepare_bins(di: DataInfo, nbins: int, nbins_cats: int,
+                 histogram_type: str = "QuantilesGlobal",
+                 nbins_top_level: int = 1024) -> BinnedData:
+    """Feature binning for the tree engines.
+
+    QuantilesGlobal: per-column global quantile grid of ``nbins``
+    thresholds (the one-shot batched sort) — F == B.
+
+    UniformAdaptive / Random (reference DHistogram.java:19-62 AUTO
+    default): a UNIFORM top-level fine grid of ``nbins_top_level`` bins
+    over each column's [min, max]; the builders then place ``nbins``
+    histogram buckets per NODE over the node's surviving fine range,
+    refining resolution every level exactly like the reference's
+    per-node DHistogram ranges (nbins_top_level halving schedule).
+
+    Categorical columns always bin by level code; F >= B so codes and
+    the NA sentinel (F) coexist in one int32 matrix.
+    """
     fr, xs = di.frame, di.x
     C = len(xs)
     max_card = max([fr.vec(c).cardinality for c in di.cat_names] or [0])
     B = max(nbins, min(max_card, nbins_cats))
     is_cat = np.array([fr.vec(c).is_categorical for c in xs], bool)
     m = fr.as_matrix(xs)
-    sp_raw = np.asarray(_quantile_split_points(m, jnp.int32(fr.nrows), B))
-    # dedupe per column (repeated quantiles collapse to one threshold);
-    # categorical columns get no thresholds (code binning)
-    sp = np.full((C, B - 1), np.nan, np.float32)
-    for j in range(C):
-        if is_cat[j]:
-            continue
-        qs = np.unique(sp_raw[j][~np.isnan(sp_raw[j])])
-        sp[j, : len(qs)] = qs
+    if histogram_type in ("UniformAdaptive", "Random"):
+        F = max(int(nbins_top_level), B)
+        mn = np.asarray(_col_min_max(m, jnp.int32(fr.nrows)))
+        col_min, col_max = mn[0], mn[1]
+        span = np.where(col_max > col_min, col_max - col_min, 1.0)
+        sp = np.full((C, F - 1), np.nan, np.float32)
+        grid = (np.arange(1, F, dtype=np.float64)[None, :] / F)
+        vals = (col_min[:, None] + grid * span[:, None]).astype(np.float32)
+        for j in range(C):
+            if not is_cat[j]:
+                sp[j] = vals[j]
+    else:
+        F = B
+        sp_raw = np.asarray(_quantile_split_points(m, jnp.int32(fr.nrows),
+                                                   B))
+        # dedupe per column (repeated quantiles collapse to one
+        # threshold); categorical columns get no thresholds
+        sp = np.full((C, B - 1), np.nan, np.float32)
+        for j in range(C):
+            if is_cat[j]:
+                continue
+            qs = np.unique(sp_raw[j][~np.isnan(sp_raw[j])])
+            sp[j, : len(qs)] = qs
     sp_dev = jax.device_put(jnp.asarray(sp), cloud().replicated)
-    bins = _bin_all(m, sp_dev, jnp.asarray(is_cat), B)
-    return BinnedData(bins, sp, sp_dev, is_cat, B)
+    bins = _bin_all(m, sp_dev, jnp.asarray(is_cat), F)
+    return BinnedData(bins, sp, sp_dev, is_cat, B, F, histogram_type)
 
 
 @functools.partial(jax.jit, static_argnames=("nbins",))
 def _bin_all(matrix, split_points, is_cat, nbins: int):
-    v = matrix[:, :, None]
-    t = split_points[None, :, :]
-    num_bins = jnp.sum((v >= t) & ~jnp.isnan(t), axis=2).astype(jnp.int32)
+    """Raw values -> bin indices in [0, nbins]; nbins = NA bucket.
+
+    Wide fine grids (UniformAdaptive's 1024 thresholds) use a per-column
+    searchsorted instead of the (R, C, F-1) one-hot compare — log(F)
+    work per value and no quadratic-ish temporary."""
+    if split_points.shape[1] > 63:
+        t_sorted = split_points                  # NaN tails sort last
+        num_bins = jax.vmap(
+            lambda t, v: jnp.searchsorted(t, v, side="right"),
+            in_axes=(0, 1), out_axes=1)(t_sorted, matrix)
+        nan_counts = jnp.sum(jnp.isnan(split_points), axis=1)[None, :]
+        num_bins = jnp.minimum(
+            num_bins, split_points.shape[1] - nan_counts).astype(jnp.int32)
+    else:
+        v = matrix[:, :, None]
+        t = split_points[None, :, :]
+        num_bins = jnp.sum((v >= t) & ~jnp.isnan(t),
+                           axis=2).astype(jnp.int32)
     cat_bins = jnp.clip(matrix, 0, nbins - 1).astype(jnp.int32)
     b = jnp.where(is_cat[None, :], cat_bins, num_bins)
     return jnp.where(jnp.isnan(matrix), nbins, b)
+
+
+@jax.jit
+def _col_min_max(matrix, nrows):
+    """Per-column (min, max) over valid rows, NaN-blind — the uniform
+    fine grid's span (DHistogram find_maxEx/min analog)."""
+    R = matrix.shape[0]
+    rowmask = (jnp.arange(R) < nrows)[:, None]
+    mx = jnp.where(rowmask & ~jnp.isnan(matrix), matrix, jnp.nan)
+    return jnp.stack([jnp.nanmin(mx, axis=0), jnp.nanmax(mx, axis=0)])
 
 
 # ---------------------------------------------------------------------------
@@ -221,6 +291,7 @@ def find_splits(hist, is_cat, col_allowed, min_rows: float = 10.0,
                        wh=leaf_stats["wh"] - lwh,
                        wgg=leaf_stats["wgg"] - lwgg)
     return dict(do_split=do_split, gain=best_gain, col=col, bitset=bitset,
+                split_b=split_b, na_left=na_left,
                 leaf=leaf_stats, left=left_stats, right=right_stats)
 
 
@@ -254,69 +325,55 @@ class Forest(NamedTuple):
     child: object = None   # int32 (T, K, N) or None
 
 
-@functools.partial(jax.jit, static_argnames=("depth",))
-def forest_score(bins, split_col, bitset, value, depth: int, child=None):
+def _go_left(bs, node, b, th, na, fine_na: int, B: int):
+    """Mixed split semantics: thr >= 0 -> adaptive numeric threshold in
+    fine-bin units (NA routed by na); thr < 0 -> bitset membership
+    (categorical splits, and every split of pre-adaptive models)."""
+    nb = jnp.minimum(b, B)                       # NA (fine_na) -> slot B
+    gl = bs[node, nb]
+    if th is None:
+        return gl
+    tn = th[node]
+    return jnp.where(tn >= 0,
+                     jnp.where(b == fine_na, na[node], b < tn), gl)
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "fine_na"))
+def forest_score(bins, split_col, bitset, value, depth: int, child=None,
+                 thr=None, na_l=None, fine_na: int = -1):
     """Sum of tree outputs per (row, k-slot): bins (R,C) -> (R, K).
 
-    Descends all T*K trees over D steps; terminal nodes self-loop (col=-1).
-    ``child`` selects the node layout (Forest docstring).
-    """
-    T, K, H = split_col.shape
-    R = bins.shape[0]
-
-    def one_tree(carry, tk):
-        if child is None:
-            sc, bs, vl = tk                   # (H,), (H,B+1), (H,)
-            ch = None
-        else:
-            sc, bs, vl, ch = tk
-        node = jnp.zeros((R,), jnp.int32)
-        for _ in range(depth):
-            c = sc[node]
-            term = c < 0
-            b = jnp.take_along_axis(bins, jnp.maximum(c, 0)[:, None],
-                                    axis=1)[:, 0]
-            go_left = bs[node, b]
-            if ch is None:
-                nxt = 2 * node + jnp.where(go_left, 1, 2)
-            else:
-                left = ch[node]
-                term = term | (left < 0)
-                nxt = left + jnp.where(go_left, 0, 1)
-            node = jnp.where(term, node, nxt)
-        return carry, vl[node]
-
-    xs = (split_col.reshape(T * K, H),
-          bitset.reshape(T * K, H, -1),
-          value.reshape(T * K, H))
-    if child is not None:
-        xs = xs + (child.reshape(T * K, H),)
-    _, vals = jax.lax.scan(one_tree, 0, xs)
-    # vals: (T*K, R) -> sum per k slot
-    return jnp.sum(vals.reshape(T, K, R), axis=0).T        # (R, K)
+    One descent implementation only: the per-tree values come from
+    forest_tree_values (same scan) and are summed over trees — scoring
+    and staged predictions can never diverge."""
+    vals = forest_tree_values(bins, split_col, bitset, value, depth,
+                              child=child, thr=thr, na_l=na_l,
+                              fine_na=fine_na)              # (T, K, R)
+    return jnp.sum(vals, axis=0).T                          # (R, K)
 
 
-@functools.partial(jax.jit, static_argnames=("depth",))
+@functools.partial(jax.jit, static_argnames=("depth", "fine_na"))
 def forest_tree_values(bins, split_col, bitset, value, depth: int,
-                       child=None):
+                       child=None, thr=None, na_l=None, fine_na: int = -1):
     """Per-TREE outputs (T, K, R) — forest_score without the sum, for
     staged predictions (GBMModel.StagedPredictionsTask)."""
     T, K, H = split_col.shape
     R = bins.shape[0]
+    B = bitset.shape[-1] - 1
 
     def one_tree(carry, tk):
-        if child is None:
-            sc, bs, vl = tk
-            ch = None
-        else:
-            sc, bs, vl, ch = tk
+        sc, bs, vl = tk[0], tk[1], tk[2]
+        rest = list(tk[3:])
+        ch = rest.pop(0) if child is not None else None
+        th = rest.pop(0) if thr is not None else None
+        na = rest.pop(0) if thr is not None else None
         node = jnp.zeros((R,), jnp.int32)
         for _ in range(depth):
             c = sc[node]
             term = c < 0
             b = jnp.take_along_axis(bins, jnp.maximum(c, 0)[:, None],
                                     axis=1)[:, 0]
-            go_left = bs[node, b]
+            go_left = _go_left(bs, node, b, th, na, fine_na, B)
             if ch is None:
                 nxt = 2 * node + jnp.where(go_left, 1, 2)
             else:
@@ -331,8 +388,26 @@ def forest_tree_values(bins, split_col, bitset, value, depth: int,
           value.reshape(T * K, H))
     if child is not None:
         xs = xs + (child.reshape(T * K, H),)
+    if thr is not None:
+        xs = xs + (thr.reshape(T * K, H), na_l.reshape(T * K, H))
     _, vals = jax.lax.scan(one_tree, 0, xs)
     return vals.reshape(T, K, R)
+
+
+def model_fine_na(out: Dict) -> int:
+    """The NA bin sentinel of a model's stored binning (fine grid when
+    adaptive, else the histogram bucket count)."""
+    return int(out.get("fine_nbins") or out["nbins"])
+
+
+def forest_thr_args(out: Dict) -> Dict:
+    """kwargs carrying the adaptive numeric-threshold arrays (absent on
+    pre-adaptive models — pure-bitset descent)."""
+    if out.get("thr_bin") is None:
+        return dict(thr=None, na_l=None, fine_na=-1)
+    return dict(thr=jnp.asarray(out["thr_bin"]),
+                na_l=jnp.asarray(out["na_left"]),
+                fine_na=model_fine_na(out))
 
 
 def forest_score_out(bins, out: Dict, depth: int = None) -> jax.Array:
@@ -343,7 +418,8 @@ def forest_score_out(bins, out: Dict, depth: int = None) -> jax.Array:
         bins, jnp.asarray(out["split_col"]), jnp.asarray(out["bitset"]),
         jnp.asarray(out["value"]),
         int(depth if depth is not None else out["max_depth"]),
-        child=jnp.asarray(ch) if ch is not None else None)
+        child=jnp.asarray(ch) if ch is not None else None,
+        **forest_thr_args(out))
 
 
 def forest_predict_frame(forest: Forest, binned_bins) -> jax.Array:

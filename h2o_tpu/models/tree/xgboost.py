@@ -190,7 +190,8 @@ class XGBoost(GBM):
         x_cols = [c for c in (x or train.names)
                   if c != y and c != "__dart_offset__"]
         R = train.nrows
-        scs, bss, vls, chs, preds, nws = [], [], [], [], [], []
+        scs, bss, vls, chs, preds, nws, thsl, nasl = \
+            [], [], [], [], [], [], [], []
         scale: list = []
         base_out = None
         bins = None
@@ -218,19 +219,24 @@ class XGBoost(GBM):
                 bs = np.asarray(m.output["bitset"])
                 vl = np.asarray(m.output["value"])
                 ch = m.output.get("child")
+                th = m.output.get("thr_bin")
+                na = m.output.get("na_left")
                 if base_out is None:
                     base_out = m.output
                     bins = st._bin_all(
                         train.as_matrix(m.output["x"]),
                         jnp.asarray(m.output["split_points"]),
                         jnp.asarray(m.output["is_cat"]),
-                        int(m.output["nbins"]))
+                        st.model_fine_na(m.output))
                 Fnew = np.asarray(st.forest_score(
                     bins, jnp.asarray(sc), jnp.asarray(bs),
                     jnp.asarray(vl),
                     int(m.output["max_depth"]),
                     child=jnp.asarray(ch)
-                    if ch is not None else None))[: R, 0]
+                    if ch is not None else None,
+                    thr=jnp.asarray(th) if th is not None else None,
+                    na_l=jnp.asarray(na) if na is not None else None,
+                    fine_na=st.model_fine_na(m.output)))[: R, 0]
                 k = len(k_idx)
                 if k:
                     # normalize_type="tree": new tree 1/(k+1); dropped
@@ -244,6 +250,9 @@ class XGBoost(GBM):
                 vls.append(vl)
                 if m.output.get("node_w") is not None:
                     nws.append(np.asarray(m.output["node_w"]))
+                if th is not None:
+                    thsl.append(np.asarray(th))
+                    nasl.append(np.asarray(na))
                 if ch is not None:
                     chs.append(np.asarray(ch))
                 preds.append(Fnew)
@@ -264,6 +273,10 @@ class XGBoost(GBM):
         # not row routing, so TreeSHAP stays exact on the scaled forest)
         out["node_w"] = np.concatenate(nws) \
             if len(nws) == len(scs) else None
+        out["thr_bin"] = np.concatenate(thsl) \
+            if len(thsl) == len(scs) else None
+        out["na_left"] = np.concatenate(nasl) \
+            if len(nasl) == len(scs) else None
         out["ntrees_actual"] = ntrees
         model = self.model_cls(self.model_id, dict(p_all), out)
         model.params["response_column"] = y

@@ -76,11 +76,13 @@ class _TreeEncoder:
 
     def __init__(self, split_col, bitset, value, split_points, is_cat,
                  cardinalities, leaf_offset: float = 0.0,
-                 leaf_transform=None, child=None):
+                 leaf_transform=None, child=None, thr=None, na_l=None):
         self.split_col = np.asarray(split_col)
         self.bitset = np.asarray(bitset)
         self.value = np.asarray(value, np.float32)
         self.child = np.asarray(child) if child is not None else None
+        self.thr = np.asarray(thr) if thr is not None else None
+        self.na_l = np.asarray(na_l) if na_l is not None else None
         self.split_points = split_points          # (C, B-1) float, NaN-pad
         self.is_cat = is_cat
         self.cards = cardinalities                # per-column cardinality
@@ -111,6 +113,15 @@ class _TreeEncoder:
         c = int(self.split_col[n])
         bs = self.bitset[n]
         B = len(bs) - 1
+        if self.thr is not None and self.thr[n] >= 0:
+            # adaptive numeric split: fine-bin threshold -> the exact
+            # boundary value of the stored fine grid (v < value = left)
+            tb = int(self.thr[n])
+            na_dir = NA_LEFT if self.na_l[n] else NA_RIGHT
+            sp = self.split_points[c]
+            k = min(max(tb - 1, 0), len(sp) - 1)
+            thr = float(sp[k]) if not np.isnan(sp[k]) else 0.0
+            return 0, na_dir, struct.pack("<f", np.float32(thr))
         na_dir = NA_LEFT if bs[B] else NA_RIGHT
         if self.is_cat[c]:
             card = max(int(self.cards[c]), 1)
@@ -304,6 +315,10 @@ def write_tree_mojo(model) -> bytes:
     bs = np.asarray(out["bitset"])
     vl = np.asarray(out["value"])
     ch = np.asarray(out["child"]) if out.get("child") is not None else None
+    th = np.asarray(out["thr_bin"]) if out.get("thr_bin") is not None \
+        else None
+    na = np.asarray(out["na_left"]) if out.get("thr_bin") is not None \
+        else None
     T, K, H = sc.shape
     sp = np.asarray(out["split_points"])
     is_cat = np.asarray(out["is_cat"], bool)
@@ -353,7 +368,9 @@ def write_tree_mojo(model) -> bytes:
             enc = _TreeEncoder(sc[t, k], bs[t, k], vl[t, k], sp, is_cat,
                                cards, leaf_offset=offset,
                                leaf_transform=transform,
-                               child=ch[t, k] if ch is not None else None)
+                               child=ch[t, k] if ch is not None else None,
+                               thr=th[t, k] if th is not None else None,
+                               na_l=na[t, k] if na is not None else None)
             blob, aux = enc.encode()
             w.writeblob(f"trees/t{k:02d}_{t:03d}.bin", blob)
             w.writeblob(f"trees/t{k:02d}_{t:03d}_aux.bin", aux)

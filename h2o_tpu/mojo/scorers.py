@@ -48,10 +48,13 @@ def _bin_matrix(X, split_points, is_cat, nbins: int) -> np.ndarray:
 
 
 def _forest_score(bins, split_col, bitset, value, depth: int,
-                  child=None) -> np.ndarray:
+                  child=None, thr=None, na_l=None,
+                  fine_na: int = -1) -> np.ndarray:
     """Sum of per-tree leaf values (shared_tree.forest_score in numpy).
-    ``child`` None = dense heap (2n+1/2n+2), else left-child pointers."""
+    ``child`` None = dense heap (2n+1/2n+2), else left-child pointers;
+    ``thr``/``na_l`` carry adaptive numeric fine-bin thresholds."""
     T, K, H = split_col.shape
+    B = bitset.shape[-1] - 1
     R = bins.shape[0]
     out = np.zeros((R, K), np.float64)
     rows = np.arange(R)
@@ -59,12 +62,18 @@ def _forest_score(bins, split_col, bitset, value, depth: int,
         for k in range(K):
             sc, bs, vl = split_col[t, k], bitset[t, k], value[t, k]
             ch = child[t, k] if child is not None else None
+            th = thr[t, k] if thr is not None else None
+            na = na_l[t, k] if na_l is not None else None
             node = np.zeros(R, np.int64)
             for _ in range(depth):
                 c = sc[node]
                 term = c < 0
                 b = bins[rows, np.maximum(c, 0)]
-                go_left = bs[node, b]
+                go_left = bs[node, np.minimum(b, B)]
+                if th is not None:
+                    tn = th[node]
+                    g_thr = np.where(b == fine_na, na[node], b < tn)
+                    go_left = np.where(tn >= 0, g_thr, go_left)
                 if ch is None:
                     nxt = 2 * node + np.where(go_left, 1, 2)
                 else:
@@ -77,11 +86,14 @@ def _forest_score(bins, split_col, bitset, value, depth: int,
 
 
 def _tree_F(arrays: Dict, meta: Dict, X) -> np.ndarray:
+    fine = int(meta.get("fine_nbins") or meta["nbins"])
     bins = _bin_matrix(X, arrays["split_points"],
-                       arrays["is_cat"].astype(bool), int(meta["nbins"]))
+                       arrays["is_cat"].astype(bool), fine)
     return _forest_score(bins, arrays["split_col"], arrays["bitset"],
                          arrays["value"], int(meta["max_depth"]),
-                         child=arrays.get("child"))
+                         child=arrays.get("child"),
+                         thr=arrays.get("thr_bin"),
+                         na_l=arrays.get("na_left"), fine_na=fine)
 
 
 def _classify(F, dom):

@@ -110,11 +110,13 @@ def treeshap_lib() -> Optional[CDLL]:
             L.treeshap_contribs.restype = c_int
             L.treeshap_contribs.argtypes = [
                 i32p, i64, i64, i32p, POINTER(c_ubyte),
-                POINTER(c_double), POINTER(c_double), i32p,
+                POINTER(c_double), POINTER(c_double), i32p, i32p,
+                POINTER(c_ubyte), i64,
                 i64, i64, i64, POINTER(c_double), c_int]
             L.tree_leaf_assign.restype = c_int
             L.tree_leaf_assign.argtypes = [
-                i32p, i64, i64, i32p, POINTER(c_ubyte), i32p,
+                i32p, i64, i64, i32p, POINTER(c_ubyte), i32p, i32p,
+                POINTER(c_ubyte), i64,
                 i64, i64, i64, i32p, POINTER(c_char), i64]
             _ts_lib = L
         except Exception as e:  # noqa: BLE001 — numpy fallback exists
@@ -125,7 +127,10 @@ def treeshap_lib() -> Optional[CDLL]:
 def treeshap_contribs(bins: np.ndarray, split_col: np.ndarray,
                       bitset: np.ndarray, value: np.ndarray,
                       node_w: np.ndarray,
-                      child: Optional[np.ndarray]) -> np.ndarray:
+                      child: Optional[np.ndarray],
+                      thr: Optional[np.ndarray] = None,
+                      na_left: Optional[np.ndarray] = None,
+                      fine_na: int = -1) -> np.ndarray:
     """SHAP contributions for one class's (T, N) tree stack on binned
     rows; returns (R, C+1) with the bias in the last column."""
     L = treeshap_lib()
@@ -140,6 +145,9 @@ def treeshap_contribs(bins: np.ndarray, split_col: np.ndarray,
     nw = np.ascontiguousarray(node_w, np.float64)
     ch = np.ascontiguousarray(child, np.int32) \
         if child is not None else None
+    th = np.ascontiguousarray(thr, np.int32) if thr is not None else None
+    na = np.ascontiguousarray(na_left, np.uint8) \
+        if na_left is not None else None
     phi = np.zeros((R, C + 1), np.float64)
     rc = L.treeshap_contribs(
         bins.ctypes.data_as(POINTER(c_int)), R, C,
@@ -148,7 +156,9 @@ def treeshap_contribs(bins: np.ndarray, split_col: np.ndarray,
         vl.ctypes.data_as(POINTER(c_double)),
         nw.ctypes.data_as(POINTER(c_double)),
         ch.ctypes.data_as(POINTER(c_int)) if ch is not None else None,
-        T, N, B1,
+        th.ctypes.data_as(POINTER(c_int)) if th is not None else None,
+        na.ctypes.data_as(POINTER(c_ubyte)) if na is not None else None,
+        fine_na, T, N, B1,
         phi.ctypes.data_as(POINTER(c_double)), _nthreads())
     if rc != 0:
         raise RuntimeError(f"treeshap_contribs failed rc={rc}")
@@ -157,7 +167,10 @@ def treeshap_contribs(bins: np.ndarray, split_col: np.ndarray,
 
 def tree_leaf_assign(bins: np.ndarray, split_col: np.ndarray,
                      bitset: np.ndarray,
-                     child: Optional[np.ndarray], max_path: int = 64):
+                     child: Optional[np.ndarray],
+                     thr: Optional[np.ndarray] = None,
+                     na_left: Optional[np.ndarray] = None,
+                     fine_na: int = -1, max_path: int = 64):
     """Per-row/tree terminal node ids + L/R descent paths."""
     L = treeshap_lib()
     assert L is not None
@@ -169,6 +182,9 @@ def tree_leaf_assign(bins: np.ndarray, split_col: np.ndarray,
     bs = np.ascontiguousarray(bitset, np.uint8).reshape(T, N, B1)
     ch = np.ascontiguousarray(child, np.int32) \
         if child is not None else None
+    th = np.ascontiguousarray(thr, np.int32) if thr is not None else None
+    na = np.ascontiguousarray(na_left, np.uint8) \
+        if na_left is not None else None
     ids = np.zeros((R, T), np.int32)
     paths = np.zeros((R, T), f"S{max_path}")
     rc = L.tree_leaf_assign(
@@ -176,7 +192,9 @@ def tree_leaf_assign(bins: np.ndarray, split_col: np.ndarray,
         sc.ctypes.data_as(POINTER(c_int)),
         bs.ctypes.data_as(POINTER(c_ubyte)),
         ch.ctypes.data_as(POINTER(c_int)) if ch is not None else None,
-        T, N, B1,
+        th.ctypes.data_as(POINTER(c_int)) if th is not None else None,
+        na.ctypes.data_as(POINTER(c_ubyte)) if na is not None else None,
+        fine_na, T, N, B1,
         ids.ctypes.data_as(POINTER(c_int)),
         paths.ctypes.data_as(POINTER(c_char)), max_path)
     if rc != 0:

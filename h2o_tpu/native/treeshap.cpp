@@ -37,8 +37,11 @@ struct Tree {
   const double *val;    // (N,)
   const double *w;      // (N,) training cover
   const int32_t *child; // (N,) left-child pool ids, or null (dense heap)
+  const int32_t *thr;   // (N,) adaptive numeric fine-bin thr, or null
+  const uint8_t *nal;   // (N,) NA-left for thr splits, or null
   int64_t N;
   int64_t B1;
+  int64_t fine_na;      // NA sentinel of the fine grid
 
   bool is_leaf(int n) const {
     if (sc[n] < 0) return true;
@@ -47,6 +50,14 @@ struct Tree {
   }
   int left(int n) const { return child ? child[n] : 2 * n + 1; }
   int right(int n) const { return child ? child[n] + 1 : 2 * n + 2; }
+  bool go_left(int n, int b) const {
+    if (thr != nullptr && thr[n] >= 0) {   // adaptive numeric split
+      if (b == (int)fine_na) return nal[n] != 0;
+      return b < thr[n];
+    }
+    const int nb = b < (int)(B1 - 1) ? b : (int)(B1 - 1);
+    return bset[(int64_t)n * B1 + nb] != 0;
+  }
 };
 
 void extend_path(PathElem *p, int unique_depth, double pz, double po,
@@ -130,7 +141,7 @@ void tree_shap(const Tree &t, const int32_t *row, double *phi, int node,
 
   const int col = t.sc[node];
   const int b = row[col];
-  const bool go_left = t.bset[(int64_t)node * t.B1 + b] != 0;
+  const bool go_left = t.go_left(node, b);
   const int l = t.left(node), r = t.right(node);
   const int hot = go_left ? l : r;
   const int cold = go_left ? r : l;
@@ -180,15 +191,18 @@ extern "C" {
 int treeshap_contribs(const int32_t *bins, int64_t R, int64_t C,
                       const int32_t *split_col, const uint8_t *bitset,
                       const double *value, const double *node_w,
-                      const int32_t *child, int64_t T, int64_t N,
-                      int64_t B1, double *phi, int nthreads) {
+                      const int32_t *child, const int32_t *thr,
+                      const uint8_t *nal, int64_t fine_na, int64_t T,
+                      int64_t N, int64_t B1, double *phi, int nthreads) {
   std::vector<Tree> trees((size_t)T);
   double bias = 0.0;
   int maxd = 1;
   for (int64_t t = 0; t < T; ++t) {
     trees[t] = Tree{split_col + t * N, bitset + t * N * B1,
                     value + t * N,     node_w + t * N,
-                    child ? child + t * N : nullptr, N, B1};
+                    child ? child + t * N : nullptr,
+                    thr ? thr + t * N : nullptr,
+                    nal ? nal + t * N : nullptr, N, B1, fine_na};
     bias += tree_mean(trees[t], 0);
     const int d = tree_depth(trees[t], 0);
     if (d > maxd) maxd = d;
@@ -230,12 +244,15 @@ int treeshap_contribs(const int32_t *bins, int64_t R, int64_t C,
 // id and the root-to-leaf path as L/R characters (max 64 levels).
 int tree_leaf_assign(const int32_t *bins, int64_t R, int64_t C,
                      const int32_t *split_col, const uint8_t *bitset,
-                     const int32_t *child, int64_t T, int64_t N,
-                     int64_t B1, int32_t *node_ids, char *paths,
-                     int64_t path_stride) {
+                     const int32_t *child, const int32_t *thr,
+                     const uint8_t *nal, int64_t fine_na, int64_t T,
+                     int64_t N, int64_t B1, int32_t *node_ids,
+                     char *paths, int64_t path_stride) {
   for (int64_t t = 0; t < T; ++t) {
     Tree tr{split_col + t * N, bitset + t * N * B1, nullptr, nullptr,
-            child ? child + t * N : nullptr, N, B1};
+            child ? child + t * N : nullptr,
+            thr ? thr + t * N : nullptr,
+            nal ? nal + t * N : nullptr, N, B1, fine_na};
     for (int64_t r = 0; r < R; ++r) {
       int node = 0;
       char *out = paths + (r * T + t) * path_stride;
@@ -243,7 +260,7 @@ int tree_leaf_assign(const int32_t *bins, int64_t R, int64_t C,
       while (!tr.is_leaf(node) && pos < path_stride - 1) {
         const int col = tr.sc[node];
         const int b = bins[r * C + col];
-        const bool go_left = tr.bset[(int64_t)node * B1 + b] != 0;
+        const bool go_left = tr.go_left(node, b);
         out[pos++] = go_left ? 'L' : 'R';
         node = go_left ? tr.left(node) : tr.right(node);
       }

@@ -75,8 +75,33 @@ def _block_hist(bins_blk, leaf_blk, stats_blk, n_leaves: int, nbins: int,
         preferred_element_type=jnp.float32)                   # (C*B1, L*S)
 
 
+def map_buckets(bins_blk, leaf_blk, lo, hi, off, is_cat, nbins: int,
+                fine_na: int):
+    """Fine bins -> per-NODE histogram buckets (UniformAdaptive/Random).
+
+    Integer arithmetic throughout so training-time bucketing and the
+    recovered fine threshold (jit_engine._numeric_thr) agree EXACTLY:
+    bucket(x) = ((x - lo)*B + o) // span,  span = hi - lo + 1.
+
+    lo/hi: (L, C) int32 per-node fine ranges; off: (L, C) int32 random
+    boundary offsets in fine units (zeros = UniformAdaptive).
+    Categorical columns pass their level code through; NA (fine_na) maps
+    to bucket B.
+    """
+    lf = jnp.maximum(leaf_blk, 0)
+    lo_b = lo[lf]                                # (R, C)
+    hi_b = hi[lf]
+    o_b = off[lf]
+    span = jnp.maximum(hi_b - lo_b + 1, 1)
+    x = jnp.clip(bins_blk - lo_b, 0, span - 1)
+    nb = jnp.clip((x * nbins + o_b) // span, 0, nbins - 1)
+    out = jnp.where(is_cat[None, :], jnp.minimum(bins_blk, nbins), nb)
+    return jnp.where(bins_blk == fine_na, nbins, out)
+
+
 def histogram_build_traced(bins, leaf, stats, n_leaves: int, nbins: int,
-                           block_rows: int = 8192, bf16: bool = False):
+                           block_rows: int = 8192, bf16: bool = False,
+                           fine_map=None):
     """Traceable distributed histogram: (L, C, B+1, S) replicated on every
     device.  Nestable inside outer jit/scan programs (the fused tree engine
     calls this inside its per-tree scan body).
@@ -84,6 +109,9 @@ def histogram_build_traced(bins, leaf, stats, n_leaves: int, nbins: int,
     bins:  (padded_rows, C) int32, row-sharded — pre-binned features
     leaf:  (padded_rows,)  int32, row-sharded — leaf assignment, <0 inactive
     stats: (padded_rows, S) f32, row-sharded — (w, wg, wgg, wh)
+    fine_map: None for direct (global-grid) binning, else
+    (lo, hi, off, is_cat, fine_na) enabling per-node adaptive bucket
+    placement (map_buckets) fused into each row block.
 
     Padded/invalid rows must arrive with leaf < 0 (they then match no leaf
     one-hot and contribute nothing).
@@ -92,11 +120,19 @@ def histogram_build_traced(bins, leaf, stats, n_leaves: int, nbins: int,
     C, S = bins.shape[1], stats.shape[1]
     B1 = nbins + 1
 
+    if fine_map is None:
+        extra_specs = ()
+        extra = ()
+    else:
+        lo, hi, off, is_cat_m, fine_na = fine_map
+        extra_specs = (P(), P(), P(), P())
+        extra = (lo, hi, off, is_cat_m)
+
     @functools.partial(jax.shard_map, mesh=mesh,
                        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS),
-                                 P(DATA_AXIS, None)),
+                                 P(DATA_AXIS, None)) + extra_specs,
                        out_specs=P(), check_vma=False)
-    def run(b_sh, l_sh, s_sh):
+    def run(b_sh, l_sh, s_sh, *rep):
         R = b_sh.shape[0]
         blk = min(block_rows, R)
         nblk = R // blk
@@ -106,19 +142,27 @@ def histogram_build_traced(bins, leaf, stats, n_leaves: int, nbins: int,
 
         mmd = jnp.bfloat16 if bf16 else jnp.float32
 
+        def bucketize(bb, lb):
+            if fine_map is None:
+                return bb
+            return map_buckets(bb, lb, rep[0], rep[1], rep[2], rep[3],
+                               nbins, fine_na)
+
         def body(acc, xs):
             bb, lb, sb = xs
-            return acc + _block_hist(bb, lb, sb, n_leaves, nbins, mmd), None
+            return acc + _block_hist(bucketize(bb, lb), lb, sb, n_leaves,
+                                     nbins, mmd), None
 
         init = jnp.zeros((C * B1, n_leaves * S), jnp.float32)
         acc, _ = jax.lax.scan(body, init, (b3, l3, s3))
         rem = R - nblk * blk
         if rem:
-            acc = acc + _block_hist(b_sh[nblk * blk:], l_sh[nblk * blk:],
-                                    s_sh[nblk * blk:], n_leaves, nbins, mmd)
+            acc = acc + _block_hist(
+                bucketize(b_sh[nblk * blk:], l_sh[nblk * blk:]),
+                l_sh[nblk * blk:], s_sh[nblk * blk:], n_leaves, nbins, mmd)
         return jax.lax.psum(acc, DATA_AXIS)
 
-    h = run(bins, leaf, stats)                      # (C*B1, L*S)
+    h = run(bins, leaf, stats, *extra)              # (C*B1, L*S)
     return (h.reshape(C, B1, n_leaves, S)
              .transpose(2, 0, 1, 3))                # (L, C, B+1, S)
 

@@ -104,7 +104,7 @@ def test_engine_fixed_rejects_unsupported_values(cl):
     from h2o_tpu.models.glm import GLM
     from h2o_tpu.models.deeplearning import DeepLearning
     with pytest.raises(ValueError, match="histogram_type"):
-        GBM(histogram_type="UniformAdaptive")
+        GBM(histogram_type="RoundRobin")
     with pytest.raises(ValueError, match="remove_collinear_columns"):
         GLM(remove_collinear_columns=True)
     with pytest.raises(ValueError, match="rate_decay"):
@@ -134,7 +134,7 @@ def test_engine_fixed_rejected_over_rest(cl):
     try:
         data = urllib.parse.urlencode({
             "training_frame": "guard_fr", "response_column": "y",
-            "ntrees": 2, "histogram_type": "UniformAdaptive"}).encode()
+            "ntrees": 2, "histogram_type": "RoundRobin"}).encode()
         req = urllib.request.Request(
             f"http://127.0.0.1:{srv.port}/3/ModelBuilders/gbm", data=data,
             method="POST")

@@ -156,9 +156,10 @@ def test_rest_model_build_and_predict(cl, server):
                  ntrees="5", max_depth="3", model_id="gbm_rest_test",
                  seed="42")
     job_key = resp["job"]["key"]["name"]
-    # poll the job like a real client
+    # poll the job like a real client (the adaptive-histogram engine's
+    # first compile on the shared CPU mesh can take tens of seconds)
     import time
-    for _ in range(200):
+    for _ in range(900):
         j = _get(server, f"/3/Jobs/{job_key}")["jobs"][0]
         if j["status"] not in ("CREATED", "RUNNING"):
             break

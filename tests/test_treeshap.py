@@ -77,13 +77,17 @@ def test_native_matches_python_oracle(gbm_reg):
     from h2o_tpu.models.tree.contributions import (_binned,
                                                    _forest_arrays,
                                                    _py_treeshap)
+    from h2o_tpu.models.tree import shared_tree as st
     m, fr = gbm_reg
     if native.treeshap_lib() is None:
         pytest.skip("no native toolchain")
-    sc, bs, vl, nw, ch = _forest_arrays(m)
+    sc, bs, vl, nw, ch, th, na = _forest_arrays(m)
     bins = _binned(m, fr)[:25]
     args = (bins, sc[:, 0], bs[:, 0], vl[:, 0], nw[:, 0],
-            ch[:, 0] if ch is not None else None)
+            ch[:, 0] if ch is not None else None,
+            th[:, 0] if th is not None else None,
+            na[:, 0] if na is not None else None,
+            st.model_fine_na(m.output))
     np.testing.assert_allclose(native.treeshap_contribs(*args),
                                _py_treeshap(*args), atol=1e-6)
 
@@ -94,17 +98,24 @@ def test_brute_force_shapley(gbm_reg):
                                                    _forest_arrays,
                                                    _is_leaf,
                                                    _shap_matrix)
+    from h2o_tpu.models.tree import shared_tree as st
     m, fr = gbm_reg
-    sc, bs, vl, nw, ch = _forest_arrays(m)
+    sc, bs, vl, nw, ch, th, na = _forest_arrays(m)
     bins = _binned(m, fr)[:3]
+    fine_na = st.model_fine_na(m.output)
     phi = _shap_matrix(bins, sc[:, 0], bs[:, 0], vl[:, 0], nw[:, 0],
-                       ch[:, 0] if ch is not None else None)
+                       ch[:, 0] if ch is not None else None,
+                       th[:, 0] if th is not None else None,
+                       na[:, 0] if na is not None else None, fine_na)
     C = 3
 
     def marg_value(row, subset, t):
         scv = sc[t, 0]
         chv = ch[t, 0] if ch is not None else None
         vlv, nwv, bsv = vl[t, 0], nw[t, 0], bs[t, 0]
+        thv = th[t, 0] if th is not None else None
+        nav = na[t, 0] if na is not None else None
+        B = bsv.shape[-1] - 1
 
         def rec(node):
             if _is_leaf(scv, chv, node):
@@ -112,7 +123,12 @@ def test_brute_force_shapley(gbm_reg):
             col = int(scv[node])
             left, right = _children(chv, node)
             if col in subset:
-                go_left = bool(bsv[node, int(row[col])])
+                b = int(row[col])
+                if thv is not None and thv[node] >= 0:
+                    go_left = bool(nav[node]) if b == fine_na \
+                        else b < thv[node]
+                else:
+                    go_left = bool(bsv[node, min(b, B)])
                 return rec(left if go_left else right)
             w = nwv[node]
             if w == 0:
@@ -211,6 +227,13 @@ def test_sorted_contributions(gbm_reg):
                           "BiasTerm"]
     lo = np.asarray(both.vec("bottom_value_1").data)[:fr.nrows]
     assert (v1 >= lo).all()
+    # bottom_n < 0, top_n = 0: ALL features ascending under bottom_*
+    # names (ContributionComposer.returnOnlyBottomN)
+    allb = m.predict_contributions(fr, bottom_n=-1)
+    assert allb.names[:2] == ["bottom_feature_1", "bottom_value_1"]
+    b1 = np.asarray(allb.vec("bottom_value_1").data)[:fr.nrows]
+    b2 = np.asarray(allb.vec("bottom_value_2").data)[:fr.nrows]
+    assert (b1 <= b2).all()          # ascending
 
 
 def test_leaf_node_assignment_matches_scoring(gbm_bin):
