@@ -36,13 +36,18 @@ def main(argv=None) -> int:
                     help="client mode: no data homing (-client)")
     ap.add_argument("--auto-recovery-dir", default=None,
                     help="job recovery snapshots (-auto_recovery_dir)")
+    ap.add_argument("--model-axis", type=int, default=None,
+                    help="tensor-parallel axis width: fold devices into "
+                         "a (nodes, model) product mesh (deploy/README "
+                         "multi-slice notes)")
     ns = ap.parse_args(argv)
 
     flags = {k: v for k, v in dict(
         name=ns.name, port=ns.port, ip=ns.ip, ice_root=ns.ice_root,
         ssl_cert=ns.ssl_cert, ssl_key=ns.ssl_key,
         basic_auth=ns.basic_auth, client=ns.client or None,
-        auto_recovery_dir=ns.auto_recovery_dir).items() if v is not None}
+        auto_recovery_dir=ns.auto_recovery_dir,
+        model_axis=ns.model_axis).items() if v is not None}
 
     from h2o_tpu.core.cloud import Cloud
     coord = os.environ.get("H2O_TPU_COORDINATOR")

@@ -443,7 +443,8 @@ def v4_modelsinfo(params):
     from h2o_tpu.models.registry import builders
     return {"models": [{"algo": name, "algo_full_name": cls.algo,
                         "have_mojo": True, "have_pojo": name in
-                        ("gbm", "drf", "glm")}
+                        ("gbm", "drf", "glm", "xgboost", "dt", "kmeans",
+                         "deeplearning")}
                        for name, cls in builders().items()]}
 
 

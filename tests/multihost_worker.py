@@ -59,6 +59,35 @@ def main():
     auc = float(m.output["training_metrics"]["AUC"])
     assert auc > 0.8, auc
     print(f"[p{pid}] distributed GBM ok: auc={auc:.3f}", flush=True)
+
+    # ---- DP x TP PRODUCT mesh ACROSS processes (multi-slice analog) ----
+    # reboot the same 2-process device set as a 4x2 nodes-x-model mesh:
+    # the data axis spans both processes (DCN analog) and the model axis
+    # pairs devices for tensor parallelism; DeepLearning(model_parallel)
+    # and GBM both train THROUGH the product builders on it.
+    cl2 = Cloud.boot(model_axis=2)
+    assert cl2.n_nodes == 2 * nproc, cl2.n_nodes
+    assert dict(cl2.mesh.shape) == {"nodes": 2 * nproc, "model": 2}
+    print(f"[p{pid}] product mesh formed: {dict(cl2.mesh.shape)}",
+          flush=True)
+
+    from h2o_tpu.models.deeplearning import DeepLearning
+    fr2 = Frame([f"x{j}" for j in range(4)] + ["y"],
+                [Vec(X[:, j]) for j in range(4)] +
+                [Vec(y, T_CAT, domain=["n", "p"])])
+    dl = DeepLearning(hidden=[16, 16], epochs=2, seed=1,
+                      model_parallel=True, stopping_rounds=0).train(
+        y="y", training_frame=fr2)
+    dl_ll = float(dl.output["training_metrics"]["logloss"])
+    assert np.isfinite(dl_ll), dl_ll
+    print(f"[p{pid}] DP x TP DeepLearning ok: logloss={dl_ll:.3f}",
+          flush=True)
+
+    m2 = GBM(ntrees=2, max_depth=3, seed=1, nbins=16).train(
+        y="y", training_frame=fr2)
+    auc2 = float(m2.output["training_metrics"]["AUC"])
+    assert auc2 > 0.8, auc2
+    print(f"[p{pid}] product-mesh GBM ok: auc={auc2:.3f}", flush=True)
     print(f"[p{pid}] MULTIHOST_OK", flush=True)
 
 

@@ -49,3 +49,7 @@ def test_boot_multihost_two_processes():
         assert f"[p{pid}] MULTIHOST_OK" in out, out[-4000:]
         assert f"[p{pid}] cloud formed: 8 nodes over 2 processes" in out
         assert f"[p{pid}] distributed GBM ok" in out
+        assert f"[p{pid}] product mesh formed: " \
+               "{'nodes': 4, 'model': 2}" in out
+        assert f"[p{pid}] DP x TP DeepLearning ok" in out
+        assert f"[p{pid}] product-mesh GBM ok" in out
