@@ -68,15 +68,21 @@ def _timed_train(make_builder, fr, warmup=True):
     return model, time.time() - t0, wall_compile
 
 
-def bench_gbm(fr, rows, trees, depth):
+def bench_gbm(fr, rows, trees, depth,
+              histogram_type="QuantilesGlobal", bf16=False):
+    """Headline config pins QuantilesGlobal so vs_baseline stays
+    apples-to-apples with the r01/r02 captures; gbm_ua / gbm_bf16
+    measure the UniformAdaptive default and the bf16-histogram mode."""
     from h2o_tpu.models.tree.gbm import GBM
     m, wall, wall_c = _timed_train(
         lambda: GBM(ntrees=trees, max_depth=depth, learn_rate=0.1, seed=1,
-                    nbins=64), fr)
+                    nbins=64, histogram_type=histogram_type,
+                    bf16_histograms=bf16), fr)
     return {"value": round(rows * trees / wall, 1),
             "unit": "rows*trees/sec", "wall_s": round(wall, 2),
             "wall_with_compile_s": round(wall_c, 2),
             "ntrees": trees, "max_depth": depth,
+            "histogram_type": histogram_type, "bf16": bf16,
             "train_auc": round(float(m.output["training_metrics"]["AUC"]),
                                4)}
 
@@ -84,7 +90,8 @@ def bench_gbm(fr, rows, trees, depth):
 def bench_drf(fr, rows, trees, depth):
     from h2o_tpu.models.tree.drf import DRF
     m, wall, wall_c = _timed_train(
-        lambda: DRF(ntrees=trees, max_depth=depth, seed=1, nbins=64), fr)
+        lambda: DRF(ntrees=trees, max_depth=depth, seed=1, nbins=64,
+                    histogram_type="QuantilesGlobal"), fr)
     return {"value": round(rows * trees / wall, 1),
             "unit": "rows*trees/sec", "wall_s": round(wall, 2),
             "wall_with_compile_s": round(wall_c, 2),
@@ -353,7 +360,8 @@ def _main_ladder(detail):
     depth = int(os.environ.get("BENCH_DEPTH", 5))
     configs = os.environ.get(
         "BENCH_CONFIG",
-        "gbm,drf,glm,dl,hist,gbm10m,cpuref,deep").split(",")
+        "gbm,gbm_ua,gbm_bf16,drf,glm,dl,hist,gbm10m,cpuref,deep"
+    ).split(",")
 
     detail.update({"rows": rows, "cols": cols})
     _arm_watchdog([detail])
@@ -375,6 +383,11 @@ def _main_ladder(detail):
     X, y = _make_data(rows, cols)
     fr = _frame(X, y)
     runs = [("gbm", lambda: bench_gbm(fr, rows, trees, depth)),
+            ("gbm_ua", lambda: bench_gbm(
+                fr, rows, trees, depth,
+                histogram_type="UniformAdaptive")),
+            ("gbm_bf16", lambda: bench_gbm(fr, rows, trees, depth,
+                                           bf16=True)),
             ("drf", lambda: bench_drf(fr, rows, trees, depth)),
             ("glm", lambda: bench_glm(fr, rows)),
             ("dl", lambda: bench_dl(fr, rows)),
@@ -384,7 +397,8 @@ def _main_ladder(detail):
                                                    depth)),
             ("deep", lambda: bench_deep(fr, rows))]
     names = {"hist": "hist_kernel", "gbm10m": "gbm_10m",
-             "cpuref": "cpu_reference", "deep": "drf_deep20"}
+             "cpuref": "cpu_reference", "deep": "drf_deep20",
+             "gbm_ua": "gbm_uniform_adaptive", "gbm_bf16": "gbm_bf16"}
     for cfg, fn in runs:
         if cfg not in configs:
             continue

@@ -1,24 +1,340 @@
-"""Built-in web UI served at / — the Flow analog.
+"""Built-in web UI served at / — the Flow-shaped notebook.
 
-The reference serves the prebuilt h2o-flow notebook JS at :54321
-(h2o-web, SURVEY §2.3 "serve any static UI").  The TPU rebuild ships a
-self-contained single-file dashboard over the same REST v3 surface:
-cluster status, frames/models/jobs browsing, and a Rapids console —
-no external assets (works in air-gapped TPU pods).
+The reference serves the prebuilt h2o-flow notebook at :54321
+(h2o-web/README.md:1-30).  That artifact's compiled JS is not vendored
+in the reference snapshot, so full asset parity is impossible offline;
+this ships the WORKFLOW instead: a self-contained, cell-based notebook
+over the same REST v3 surface — ordered cells holding Flow-style
+commands (``assist``, ``importFiles``, ``parse``, ``buildModel``,
+``predict``, ``getFrames``/``getModels``/``getJobs``, raw Rapids),
+executed per cell against the live cluster, with add/rerun/delete,
+run-all, autosave, and .flow-style JSON download/upload.  No external
+assets (works in air-gapped TPU pods).
+
+The classic status dashboard remains at /dashboard.
 """
 
-FLOW_HTML = """<!DOCTYPE html>
-<html>
-<head>
-<meta charset="utf-8">
-<title>h2o-tpu</title>
-<style>
+_STYLE = """
   body { font-family: -apple-system, 'Segoe UI', Roboto, sans-serif;
          margin: 0; background: #f4f6f8; color: #1a1a2e; }
   header { background: #16213e; color: #fff; padding: 10px 24px;
            display: flex; align-items: baseline; gap: 16px; }
   header h1 { font-size: 18px; margin: 0; }
   header span { color: #9fb3c8; font-size: 13px; }
+  header a { color: #9fb3c8; font-size: 13px; margin-left: auto; }
+  table { border-collapse: collapse; width: 100%; font-size: 13px; }
+  th, td { text-align: left; padding: 4px 8px;
+           border-bottom: 1px solid #e8ecf1; }
+  th { color: #5a6a7a; font-weight: 600; }
+  tr:hover td { background: #f0f4ff; }
+  button { padding: 6px 14px; border: 0; border-radius: 4px;
+           background: #0f3460; color: #fff; cursor: pointer; }
+  pre { background: #0b132b; color: #d7e3f4; padding: 10px;
+        border-radius: 6px; font-size: 12px; overflow: auto;
+        max-height: 260px; }
+  .pill { display: inline-block; padding: 1px 8px; border-radius: 10px;
+          font-size: 11px; background: #e0f2e9; color: #14532d; }
+  .pill.run { background: #fef3c7; color: #92400e; }
+  .pill.fail { background: #fee2e2; color: #991b1b; }
+"""
+
+FLOW_HTML = """<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>h2o-tpu Flow</title>
+<style>
+""" + _STYLE + """
+  #cells { padding: 16px 10%; display: flex; flex-direction: column;
+           gap: 10px; }
+  .cell { background: #fff; border-radius: 8px; padding: 10px 14px;
+          box-shadow: 0 1px 3px rgba(0,0,0,.08);
+          border-left: 4px solid #cbd5e1; }
+  .cell.ok { border-left-color: #16a34a; }
+  .cell.err { border-left-color: #dc2626; }
+  .cell textarea { width: 100%; font: 13px/1.5 monospace; border: 0;
+          outline: none; resize: vertical; min-height: 22px;
+          background: transparent; }
+  .cellbar { display: flex; gap: 6px; margin-top: 4px; }
+  .cellbar button { padding: 2px 10px; font-size: 12px; }
+  .cellbar .ghost { background: #e2e8f0; color: #334155; }
+  .out { margin-top: 8px; }
+  .out pre { margin: 0; }
+  .assist { display: grid; grid-template-columns: repeat(3, 1fr);
+            gap: 6px; margin-top: 8px; }
+  .assist button { background: #eef2ff; color: #312e81;
+                   text-align: left; font-family: monospace; }
+  #toolbar { padding: 10px 10%; display: flex; gap: 8px; }
+  #toolbar .ghost { background: #e2e8f0; color: #334155; }
+</style>
+</head>
+<body>
+<header>
+  <h1>h2o-tpu <em style="font-weight:300">Flow</em></h1>
+  <span id="cloud">connecting…</span>
+  <a href="/dashboard">dashboard</a>
+</header>
+<div id="toolbar">
+  <button onclick="addCell('assist')">+ New cell</button>
+  <button class="ghost" onclick="runAll()">Run all</button>
+  <button class="ghost" onclick="saveFlow()">Download .flow</button>
+  <button class="ghost"
+          onclick="document.getElementById('upload').click()">Open
+          .flow</button>
+  <input type="file" id="upload" style="display:none"
+         onchange="loadFlow(this)">
+</div>
+<div id="cells"></div>
+<script>
+const J = p => fetch(p).then(r => r.json());
+const POST = (p, data) => fetch(p, {method: 'POST',
+  headers: {'Content-Type': 'application/x-www-form-urlencoded'},
+  body: new URLSearchParams(data)}).then(r => r.json());
+let cells = [];           // [{input, output, status}]
+const ROUTINES = [
+  ['assist', 'list the routines'],
+  ['getCloud', 'cluster status'],
+  ['getFrames', 'list frames'],
+  ['getModels', 'list models'],
+  ['getJobs', 'list jobs'],
+  ["importFiles [\\"/path/data.csv\\"]", 'import + parse a file'],
+  ["buildModel 'gbm', {training_frame: \\"data.hex\\", " +
+   "response_column: \\"y\\", ntrees: 10}", 'train a model'],
+  ["predict model: \\"model_id\\", frame: \\"data.hex\\"",
+   'score a frame'],
+  ["(mean (cols data.hex 'y'))", 'raw Rapids expression'],
+];
+
+function esc(s) { return String(s).replace(/&/g, '&amp;')
+  .replace(/</g, '&lt;').replace(/"/g, '&quot;'); }
+
+// data cells are ESCAPED by default; pass {html: ...} for trusted
+// markup (status pills)
+function table(head, data) {
+  const cell = c => (c && typeof c === 'object' && 'html' in c)
+    ? c.html : esc(c ?? '');
+  return '<table><tr>' + head.map(h => `<th>${esc(h)}</th>`).join('') +
+    '</tr>' + data.map(r => '<tr>' +
+      r.map(c => `<td>${cell(c)}</td>`).join('') + '</tr>').join('') +
+    '</table>';
+}
+
+async function pollJob(key) {
+  for (let i = 0; i < 600; i++) {
+    const j = (await J('/3/Jobs/' + encodeURIComponent(key))).jobs[0];
+    if (j.status !== 'RUNNING' && j.status !== 'CREATED') return j;
+    await new Promise(res => setTimeout(res, 500));
+  }
+  throw new Error('job poll timeout');
+}
+
+// one Flow-style command -> HTML output (the assist/execute routines of
+// the reference notebook, expressed over REST v3)
+async function execCommand(cmd) {
+  cmd = cmd.trim();
+  if (!cmd || cmd === 'assist') {
+    return '<div class="assist">' + ROUTINES.map(([c, d]) =>
+      `<button onclick='assistFill(this)' data-c="${esc(c)}">` +
+      `${esc(c)}<br><small>${esc(d)}</small></button>`).join('') +
+      '</div>';
+  }
+  if (cmd === 'getCloud') {
+    const c = await J('/3/Cloud');
+    return table(['name', 'size', 'version', 'uptime_ms'],
+      [[c.cloud_name, c.cloud_size, c.version, c.cloud_uptime_millis]]);
+  }
+  if (cmd === 'getFrames') {
+    const fr = await J('/3/Frames');
+    return table(['key', 'rows', 'cols'], fr.frames.map(f =>
+      [f.frame_id.name, f.rows || f.row_count, f.column_count]));
+  }
+  if (cmd === 'getModels') {
+    const mo = await J('/3/Models');
+    return table(['key', 'algo', 'category'], mo.models.map(m =>
+      [m.model_id.name, m.algo, m.output?.model_category]));
+  }
+  if (cmd === 'getJobs') {
+    const jb = await J('/3/Jobs');
+    return table(['key', 'description', 'status', 'progress'],
+      jb.jobs.map(j => [j.key?.name, j.description,
+        {html: `<span class="pill ${j.status === 'RUNNING' ? 'run' :
+          j.status === 'FAILED' ? 'fail' : ''}">${j.status}</span>`},
+        Math.round((j.progress ?? 0) * 100) + '%']));
+  }
+  let m = cmd.match(/^importFiles\\s*\\[\\s*"([^"]+)"\\s*\\]$/);
+  if (m) {
+    const path = m[1];
+    await J('/3/ImportFiles?path=' + encodeURIComponent(path));
+    const dest = path.split('/').pop().replace(/\\W+/g, '_') + '.hex';
+    const pj = await POST('/3/Parse',
+      {source_frames: path, destination_frame: dest});
+    if (pj.job?.key?.name) await pollJob(pj.job.key.name);
+    const fr = await J('/3/Frames/' + encodeURIComponent(dest));
+    const f = fr.frames[0];
+    return `<p>parsed into <b>${esc(dest)}</b></p>` +
+      table(['column', 'type'], f.columns.slice(0, 30).map(c =>
+        [c.label, c.type]));
+  }
+  m = cmd.match(/^buildModel\\s*'(\\w+)'\\s*,\\s*(\\{[\\s\\S]*\\})$/);
+  if (m) {
+    const algo = m[1];
+    const params = Function('return (' + m[2] + ')')();
+    const resp = await POST('/3/ModelBuilders/' + algo, params);
+    if (resp.error_count || resp.msg && resp.exception_type)
+      return '<pre>' + esc(JSON.stringify(resp, null, 2)) + '</pre>';
+    const job = await pollJob(resp.job.key.name);
+    if (job.status !== 'DONE')
+      return '<pre>' + esc(JSON.stringify(job, null, 2)) + '</pre>';
+    const mid = job.dest.name;
+    const mj = await J('/3/Models/' + encodeURIComponent(mid));
+    const out = mj.models[0].output;
+    const mm = out.training_metrics || {};
+    return `<p>model <b>${esc(mid)}</b> (${esc(algo)}, ` +
+      `${esc(out.model_category)})</p>` +
+      table(['metric', 'value'],
+        ['AUC', 'logloss', 'MSE', 'RMSE', 'mae', 'r2',
+         'mean_residual_deviance']
+          .filter(k => mm[k] != null).map(k => [k, mm[k]]));
+  }
+  m = cmd.match(
+    /^predict\\s+model:\\s*"([^"]+)"\\s*,\\s*frame:\\s*"([^"]+)"$/);
+  if (m) {
+    const resp = await POST('/3/Predictions/models/' +
+      encodeURIComponent(m[1]) + '/frames/' + encodeURIComponent(m[2]),
+      {});
+    const pf = resp.predictions_frame.name;
+    const fr = await J('/3/Frames/' + encodeURIComponent(pf) +
+                       '?row_count=10');
+    const f = fr.frames[0];
+    return `<p>predictions in <b>${esc(pf)}</b></p>` +
+      table(f.columns.map(c => c.label), (() => {
+        const n = Math.min(10, f.rows ?? 10);
+        const rs = [];
+        for (let i = 0; i < n; i++)
+          rs.push(f.columns.map(c =>
+            c.domain && c.data ? (c.domain[c.data[i]] ?? '') :
+            (c.data ? c.data[i] : '')));
+        return rs;
+      })());
+  }
+  // anything else is a Rapids expression
+  const r = await POST('/99/Rapids',
+    {ast: cmd, session_id: '_flow'});
+  return '<pre>' + esc(JSON.stringify(r, null, 2)) + '</pre>';
+}
+
+function render() {
+  const host = document.getElementById('cells');
+  host.innerHTML = '';
+  cells.forEach((cell, i) => {
+    const div = document.createElement('div');
+    div.className = 'cell ' + (cell.status || '');
+    div.innerHTML = `
+      <textarea rows="${Math.max(1, (cell.input || '')
+        .split('\\n').length)}"
+        onchange="cells[${i}].input = this.value; persist()"
+        >${esc(cell.input || '')}</textarea>
+      <div class="cellbar">
+        <button onclick="runCell(${i})">Run</button>
+        <button class="ghost" onclick="addCellAt(${i + 1})">+ Below
+        </button>
+        <button class="ghost" onclick="delCell(${i})">Delete</button>
+      </div>
+      <div class="out">${cell.output || ''}</div>`;
+    host.appendChild(div);
+  });
+}
+
+function persist() {
+  localStorage.setItem('h2o_tpu_flow', JSON.stringify(
+    {cells: cells.map(c => ({input: c.input}))}));
+}
+
+async function runCell(i) {
+  const ta = document.getElementsByClassName('cell')[i]
+    .querySelector('textarea');
+  cells[i].input = ta.value;
+  try {
+    cells[i].output = await execCommand(cells[i].input);
+    cells[i].status = 'ok';
+  } catch (e) {
+    cells[i].output = '<pre>' + esc(e) + '</pre>';
+    cells[i].status = 'err';
+  }
+  persist();
+  render();
+}
+
+async function runAll() {
+  for (let i = 0; i < cells.length; i++) await runCell(i);
+}
+
+function addCell(input) { cells.push({input: input || 'assist'});
+  persist(); render(); }
+function addCellAt(i) { cells.splice(i, 0, {input: ''});
+  persist(); render(); }
+function delCell(i) { cells.splice(i, 1); persist(); render(); }
+function assistFill(btn) {
+  const div = btn.closest('.cell');
+  const i = Array.prototype.indexOf.call(
+    document.getElementsByClassName('cell'), div);
+  cells[i].input = btn.dataset.c;
+  persist(); render();
+}
+
+function saveFlow() {
+  const blob = new Blob([JSON.stringify(
+    {version: '1.0.0',
+     cells: cells.map(c => ({type: 'cs', input: c.input}))}, null, 2)],
+    {type: 'application/json'});
+  const a = document.createElement('a');
+  a.href = URL.createObjectURL(blob);
+  a.download = 'notebook.flow';
+  a.click();
+}
+
+function loadFlow(inp) {
+  const f = inp.files[0];
+  inp.value = '';            // same file can be re-opened later
+  if (!f) return;
+  f.text().then(t => {
+    const doc = JSON.parse(t);
+    cells = (doc.cells || []).map(c => ({input: c.input}));
+    persist(); render();
+  }).catch(e => alert('could not open flow: ' + e));
+}
+
+async function heartbeat() {
+  try {
+    const c = await J('/3/Cloud');
+    document.getElementById('cloud').textContent =
+      `${c.cloud_name} — ${c.cloud_size} nodes — v${c.version}`;
+  } catch (e) {
+    document.getElementById('cloud').textContent = 'error: ' + e;
+  }
+}
+
+const saved = localStorage.getItem('h2o_tpu_flow');
+cells = saved ? JSON.parse(saved).cells : [{input: 'assist'}];
+render();
+// only auto-run a pristine notebook's assist cell — saved notebooks may
+// hold side-effectful commands (buildModel/importFiles) that must not
+// re-execute on page load
+if (!saved && cells.length) runCell(0);
+heartbeat();
+setInterval(heartbeat, 5000);
+</script>
+</body>
+</html>
+"""
+
+DASHBOARD_HTML = """<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>h2o-tpu</title>
+<style>
+""" + _STYLE + """
   main { padding: 16px 24px; display: grid; gap: 16px;
          grid-template-columns: 1fr 1fr; }
   section { background: #fff; border-radius: 8px; padding: 12px 16px;
@@ -26,27 +342,14 @@ FLOW_HTML = """<!DOCTYPE html>
   section.wide { grid-column: 1 / -1; }
   h2 { font-size: 14px; margin: 0 0 8px; color: #0f3460;
        text-transform: uppercase; letter-spacing: .05em; }
-  table { border-collapse: collapse; width: 100%; font-size: 13px; }
-  th, td { text-align: left; padding: 4px 8px;
-           border-bottom: 1px solid #e8ecf1; }
-  th { color: #5a6a7a; font-weight: 600; }
-  tr:hover td { background: #f0f4ff; }
   input[type=text] { width: 70%; padding: 6px 8px; font: 13px monospace;
            border: 1px solid #cbd5e1; border-radius: 4px; }
-  button { padding: 6px 14px; border: 0; border-radius: 4px;
-           background: #0f3460; color: #fff; cursor: pointer; }
-  pre { background: #0b132b; color: #d7e3f4; padding: 10px;
-        border-radius: 6px; font-size: 12px; overflow: auto;
-        max-height: 220px; }
-  .pill { display: inline-block; padding: 1px 8px; border-radius: 10px;
-          font-size: 11px; background: #e0f2e9; color: #14532d; }
-  .pill.run { background: #fef3c7; color: #92400e; }
-  .pill.fail { background: #fee2e2; color: #991b1b; }
 </style>
 </head>
 <body>
 <header>
   <h1>h2o-tpu</h1><span id="cloud">connecting…</span>
+  <a href="/flow">flow</a>
 </header>
 <main>
   <section class="wide">
@@ -117,6 +420,10 @@ def register_routes():
     @route("GET", r"/(?:flow/?(?:index\.html)?)?")
     def flow_index(params):
         return ("text/html; charset=utf-8", FLOW_HTML.encode())
+
+    @route("GET", r"/dashboard/?")
+    def dashboard(params):
+        return ("text/html; charset=utf-8", DASHBOARD_HTML.encode())
 
     @route("GET", r"/3/")
     def api_index(params):

@@ -20,14 +20,23 @@ def srv(cl):
 
 
 def test_flow_served_at_root(srv):
+    """/ serves the cell-based Flow notebook; /dashboard keeps the
+    status view (reference h2o-web serves the Flow notebook at /)."""
     with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/") as r:
         body = r.read().decode()
         assert r.headers["Content-Type"].startswith("text/html")
-    assert "<title>h2o-tpu</title>" in body
-    assert "/3/Cloud" in body and "Rapids" in body
+    assert "<title>h2o-tpu Flow</title>" in body
+    # the notebook workflow surface: cells, assist, Flow-style commands
+    for marker in ("execCommand", "assist", "importFiles", "buildModel",
+                   "saveFlow", "runAll"):
+        assert marker in body, marker
     with urllib.request.urlopen(
             f"http://127.0.0.1:{srv.port}/flow/index.html") as r:
         assert r.read().decode() == body
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/dashboard") as r:
+        dash = r.read().decode()
+    assert "Rapids console" in dash and "/3/Cloud" in dash
 
 
 def test_codegen_local(tmp_path):
