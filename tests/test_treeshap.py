@@ -291,3 +291,23 @@ def test_tree_rest_route(gbm_bin):
         else:
             assert resp["features"][i] in ("x0", "x1", "c")
             assert resp["nas"][i] in ("LEFT", "RIGHT")
+
+
+def test_xgboost_and_dart_contributions(cl):
+    """XGBoost (gbtree + dart) rides the shared engine's covers; DART's
+    rescaled leaf values keep TreeSHAP exact (value scaling only)."""
+    from h2o_tpu.models.tree.xgboost import XGBoost
+    rng = np.random.default_rng(8)
+    n = 300
+    x0 = rng.normal(size=n).astype(np.float32)
+    x1 = rng.normal(size=n).astype(np.float32)
+    y = (x0 + 0.5 * x1 > 0).astype(np.int32)
+    fr = Frame(["x0", "x1", "y"],
+               [Vec(x0), Vec(x1), Vec(y, T_CAT, domain=["n", "p"])])
+    for kw in (dict(), dict(booster="dart", rate_drop=0.3)):
+        m = XGBoost(ntrees=4, max_depth=3, seed=1, **kw).train(
+            x=["x0", "x1"], y="y", training_frame=fr)
+        phi = _phi(m.predict_contributions(fr), fr.nrows)
+        p1 = np.asarray(m.predict(fr).vec("p").data)[:fr.nrows]
+        np.testing.assert_allclose(1 / (1 + np.exp(-phi.sum(axis=1))),
+                                   p1, atol=1e-6)
