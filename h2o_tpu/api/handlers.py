@@ -493,18 +493,16 @@ def frame_summary(params, frame_id):
     return get_frame(params, frame_id)
 
 
-@route("GET", r"/3/DownloadDataset(?:\.bin)?")
-def download_dataset(params):
-    """Frame -> CSV export (water/api/DownloadDataHandler); backs the
-    client's as_data_frame / h2o.export_file local path."""
+def frame_csv_chunks(fr: Frame, sep: str = ",", header: bool = True,
+                     batch: int = 8192):
+    """Streaming CSV chunks for a frame — shared by DownloadDataset and
+    /3/Frames/{id}/export.  Column data materializes EAGERLY (a failing
+    vec must 500 before the 200/header bytes go out, not truncate the
+    stream mid-body); string conversion stays per batch so a multi-GB
+    export never holds the full text in RSS."""
     import csv as csvmod
     import io as iomod
-    frame_id = params.get("frame_id")
-    fr = cloud().dkv.get(frame_id)
-    if not isinstance(fr, Frame):
-        raise H2OError(404, f"frame {frame_id} not found")
-    # per-column raw data + formatter; string conversion happens per batch
-    # inside the generator so a multi-GB export never lives in RSS at once
+
     def _fmt_host(x):
         return "" if x is None else str(x)
 
@@ -528,21 +526,35 @@ def download_dataset(params):
             vals = np.asarray(v.to_numpy())[: fr.nrows]
             cols.append((vals, _fmt_time if v.type == "time" else _fmt_num))
 
-    def rows_csv(batch=8192):
+    def chunks():
         buf = iomod.StringIO()
-        w = csvmod.writer(buf, quoting=csvmod.QUOTE_MINIMAL)
-        w.writerow(fr.names)
-        yield buf.getvalue()
-        buf.seek(0)
-        buf.truncate(0)
+        w = csvmod.writer(buf, delimiter=sep,
+                          quoting=csvmod.QUOTE_MINIMAL)
+        if header:
+            w.writerow(fr.names)
+            yield buf.getvalue()
+            buf.seek(0)
+            buf.truncate(0)
         for lo in range(0, fr.nrows, batch):
             hi = min(lo + batch, fr.nrows)
-            strcols = [[fmt(x) for x in data[lo:hi]] for data, fmt in cols]
+            strcols = [[fmt(x) for x in data[lo:hi]]
+                       for data, fmt in cols]
             w.writerows(zip(*strcols))
             yield buf.getvalue()
             buf.seek(0)
             buf.truncate(0)
-    return ("text/csv", rows_csv())
+    return chunks()
+
+
+@route("GET", r"/3/DownloadDataset(?:\.bin)?")
+def download_dataset(params):
+    """Frame -> CSV export (water/api/DownloadDataHandler); backs the
+    client's as_data_frame / h2o.export_file local path."""
+    frame_id = params.get("frame_id")
+    fr = cloud().dkv.get(frame_id)
+    if not isinstance(fr, Frame):
+        raise H2OError(404, f"frame {frame_id} not found")
+    return ("text/csv", frame_csv_chunks(fr))
 
 
 @route("DELETE", r"/3/Frames/(?P<frame_id>[^/]+)")
@@ -752,6 +764,27 @@ def _cv_summary_table(summary):
                   ["string"] + ["double"] * (len(cols) - 1), rows)
 
 
+def _varimp_table(m: Model):
+    """output.variable_importances as a TwoDimTableV3 (the client's
+    model.varimp()/varimp_plot() read .col_header/.cell_values —
+    model_base.py:708-716; h2o.varimp_heatmap and explain()'s varimp
+    section gate on it)."""
+    rows = None
+    try:
+        rows = m.varimp()
+    except Exception:  # noqa: BLE001 — schema emission must not fail
+        rows = None
+    if not rows:
+        return None
+    from h2o_tpu.api.handlers_ml import twodim
+    return twodim(
+        "Variable Importances",
+        ["Variable", "Relative Importance", "Scaled Importance",
+         "Percentage"],
+        ["string", "double", "double", "double"],
+        [[v, rel, sc, pct] for v, rel, sc, pct in rows])
+
+
 def _scoring_history_table(m: Model):
     """output.scoring_history as a TwoDimTableV3 (SharedTree
     doScoringAndSaveModel history; the client's model.scoring_history()
@@ -851,7 +884,7 @@ def _model_schema(m: Model) -> dict:
                      "Key<Frame>")
                 if out.get("cross_validation_fold_assignment_frame_id")
                 else None),
-            "variable_importances": None,
+            "variable_importances": _varimp_table(m),
             "names": out.get("x", []),
             # parallel to "names": per-column categorical domains (the
             # client's H2OTree levels decode indexes these —
@@ -1230,15 +1263,86 @@ def recovery_resume(params):
 
 @route("POST", r"/3/Frames/(?P<frame_id>[^/]+)/export")
 def frame_export(params, frame_id):
-    from h2o_tpu.core.persist import save_frame
+    """h2o.export_file (FramesHandler.export + ExportFileTsk): write the
+    frame as CSV (or parquet) at a server-side path; the client wraps
+    the response in H2OJob and polls it."""
+    import os as _os
     fr = cloud().dkv.get(frame_id)
-    if fr is None:
+    if not isinstance(fr, Frame):
         raise H2OError(404, f"frame {frame_id} not found")
     path = params.get("path")
     if not path:
         raise H2OError(400, "path required")
-    save_frame(fr, path)
-    return {"path": path}
+    force = str(params.get("force", "")).lower() == "true"
+    parts = int(params.get("num_parts") or 1)
+    fmt = (params.get("format") or "csv").lower()
+    sep = params.get("separator") or ","
+    if sep.isdigit():                  # the client sends ord(sep)
+        sep = chr(int(sep))
+    if parts not in (1, -1):
+        raise H2OError(400, "multi-part export (num_parts > 1) is not "
+                            "supported; use num_parts=1")
+    if fmt not in ("csv", "parquet"):
+        raise H2OError(400, f"unsupported export format {fmt!r}")
+    remote = "://" in path and path.split("://", 1)[0] not in ("file",
+                                                              "nfs")
+    local = path[7:] if path.startswith("file://") else path
+    if not remote and _os.path.exists(local) and not force:
+        raise H2OError(400, f"{path} exists; use force=True to "
+                            "overwrite")
+    # exports are control-plane work: the reserved system pool keeps
+    # them from starving behind long model builds (core/job.py)
+    job = Job(dest=path, description=f"Export frame {frame_id}",
+              priority=Job.SYSTEM_PRIORITY)
+
+    def body(j):
+        if fmt == "parquet":
+            import io as iomod
+            import pandas as pd
+            import pyarrow as pa
+            import pyarrow.parquet as pq
+            data = {}
+            for n, v in zip(fr.names, fr.vecs):
+                if v.host_data is not None:
+                    data[n] = list(v.host_data)
+                elif v.is_categorical:
+                    codes = np.asarray(v.to_numpy())[: fr.nrows]
+                    dom = v.domain or []
+                    data[n] = [None if c < 0 else dom[int(c)]
+                               for c in codes]
+                else:
+                    data[n] = np.asarray(v.to_numpy())[: fr.nrows]
+            tbl = pa.Table.from_pandas(pd.DataFrame(data))
+            if remote:
+                buf = iomod.BytesIO()
+                pq.write_table(tbl, buf)
+                from h2o_tpu.core.persist import write_bytes
+                write_bytes(path.rstrip("/") + "/part-0.parquet",
+                            buf.getvalue())
+            else:
+                if force and _os.path.isfile(local):
+                    _os.unlink(local)   # format change: file -> dir
+                _os.makedirs(local, exist_ok=True)
+                pq.write_table(tbl, _os.path.join(local,
+                                                  "part-0.parquet"))
+        elif remote:
+            # scheme URIs (s3/gcs/hdfs/http) go through the persist
+            # byte stores exactly like save_frame does
+            from h2o_tpu.core.persist import write_bytes
+            write_bytes(path,
+                        "".join(frame_csv_chunks(fr, sep=sep)).encode())
+        else:
+            if force and _os.path.isdir(local):
+                import shutil as _sh   # format change: dir -> file
+                _sh.rmtree(local)
+            with open(local, "w", newline="") as f:
+                for chunk in frame_csv_chunks(fr, sep=sep):
+                    f.write(chunk)
+        return path
+
+    cloud().jobs.start(job, body)
+    job.join()
+    return {"job": job.to_dict(), "path": path}
 
 
 @route("POST", r"/3/Frames/load")

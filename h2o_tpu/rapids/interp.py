@@ -430,6 +430,9 @@ def _eval(node, env: _Env):
         op = op[:-2]    # NA-skipping variants; rollups already skip NAs
     if op in ("mean", "sum", "min", "max", "sd", "var", "median"):
         fr = _as_frame(_eval(node[1], env))
+        axis_form = len(node) > 2      # (op fr na_rm axis): AstMean.java
+        na_rm = bool(_eval(node[2], env)) if axis_form else True
+        axis = int(_eval(node[3], env)) if len(node) > 3 else 0
         def red(v):
             r = v.rollups
             if op == "mean":
@@ -446,6 +449,43 @@ def _eval(node, env: _Env):
                 return float(r.sigma ** 2)
             from h2o_tpu.core.quantile import quantile_vec
             return float(quantile_vec(v, 0.5))
+        if axis_form and axis == 1:
+            # row-wise (AstMean.java:57 rowwiseMean): (nrows, 1) frame
+            mats = [np.asarray(v.to_numpy(), np.float64)[: fr.nrows]
+                    for v in fr.vecs if not v.is_categorical]
+            M = np.stack(mats, axis=1) if mats else \
+                np.zeros((fr.nrows, 0))
+            if op == "mean":
+                vals = np.nanmean(M, axis=1) if na_rm else M.mean(axis=1)
+            elif op == "sum":
+                vals = np.nansum(M, axis=1) if na_rm else M.sum(axis=1)
+            elif op == "min":
+                vals = np.nanmin(M, axis=1) if na_rm else M.min(axis=1)
+            elif op == "max":
+                vals = np.nanmax(M, axis=1) if na_rm else M.max(axis=1)
+            elif op == "median":
+                vals = np.nanmedian(M, axis=1) if na_rm else \
+                    np.median(M, axis=1)
+            else:
+                ddof_fn = np.nanstd if na_rm else np.std
+                vals = ddof_fn(M, axis=1, ddof=1)
+                if op == "var":
+                    vals = vals ** 2
+            return Frame([op], [Vec(vals.astype(np.float32))])
+        if axis_form:
+            # (op fr na_rm 0): ONE-ROW frame of per-column reductions
+            # (the client's frame.mean() -> getrow flow).  na_rm=False
+            # returns NA for columns containing NAs (AstMean.java:68)
+            def col_val(v):
+                if v.is_categorical:
+                    return np.nan
+                if not na_rm and float(v.rollups.nacnt) > 0:
+                    return np.nan
+                return red(v)
+            return Frame(
+                list(fr.names),
+                [Vec(np.array([col_val(v)], np.float32))
+                 for v in fr.vecs])
         return _reduce_all(red, fr)
     if op == "quantile":
         fr = _as_frame(_eval(node[1], env))
@@ -628,10 +668,13 @@ def _sort_keys(fr: Frame, idxs, ascending) -> np.ndarray:
 
 
 def _sort(node, env):
-    """(sort fr [col_idxs] [ascending]) — RadixOrder.java analog; the sort
-    itself is numpy lexsort on host key copies, the reorder is a gather."""
+    """(sort fr [cols] [ascending]) — RadixOrder.java analog; the sort
+    itself is numpy lexsort on host key copies, the reorder is a gather.
+    Columns select by index OR name (the client serializes names:
+    frame.sort(by=['y']))."""
     fr = _as_frame(_eval(node[1], env))
-    idxs = [int(x) for x in node[2][1]]
+    idxs = [fr.names.index(x[1]) if isinstance(x, tuple) and
+            x[0] == "str" else int(x) for x in node[2][1]]
     asc = [bool(int(x)) for x in node[3][1]] if len(node) > 3 \
         else [True] * len(idxs)
     order = _sort_keys(fr, idxs, asc)
@@ -847,11 +890,21 @@ def _groupby(node, env):
 
 
 def _table(node, env):
-    """(table fr) / (table fr1 fr2) — level cross-tabulation."""
-    fr = _as_frame(_eval(node[1], env))
+    """(table fr [fr2] [dense]) — level cross-tabulation (AstTable; the
+    client always appends the dense boolean: frame.py table())."""
+    args = [_eval(a, env) for a in node[1:]]
+    dense = True
+    if args and not isinstance(args[-1], Frame):
+        try:
+            dense = bool(float(args[-1]))
+        except (TypeError, ValueError):
+            dense = bool(args[-1])
+        args.pop()
+    fr = _as_frame(args[0])
     v1 = fr.vecs[0]
     d1 = v1.to_numpy()
-    if fr.ncols == 1 and len(node) <= 2:
+    two_col = fr.ncols > 1 or len(args) > 1
+    if not two_col:
         vals, cnts = np.unique(d1[d1 >= 0] if v1.is_categorical else
                                d1[~np.isnan(d1)], return_counts=True)
         if v1.is_categorical:
@@ -860,13 +913,20 @@ def _table(node, env):
             c1 = Vec(vals.astype(np.float32))
         return Frame([fr.names[0], "Count"],
                      [c1, Vec(cnts.astype(np.float32))])
-    v2 = fr.vecs[1] if fr.ncols > 1 else \
-        _as_frame(_eval(node[2], env)).vecs[0]
+    v2 = fr.vecs[1] if fr.ncols > 1 else _as_frame(args[1]).vecs[0]
     d2 = v2.to_numpy()
     ok = ((d1 >= 0) if v1.is_categorical else ~np.isnan(d1)) & \
         ((d2 >= 0) if v2.is_categorical else ~np.isnan(d2))
     pairs = np.stack([d1[ok], d2[ok]], axis=1)
     uniq, cnts = np.unique(pairs, axis=0, return_counts=True)
+    if not dense and v1.is_categorical and v2.is_categorical:
+        # sparse=FALSE: every level combination, zero counts included
+        full = np.array([(a, b) for a in range(len(v1.domain))
+                         for b in range(len(v2.domain))], np.float64)
+        cmap = {(a, b): c for (a, b), c in
+                zip(map(tuple, uniq), cnts)}
+        uniq = full
+        cnts = np.array([cmap.get(tuple(p), 0) for p in full])
     c1 = Vec(uniq[:, 0].astype(np.int32), T_CAT,
              domain=list(v1.domain)) if v1.is_categorical else \
         Vec(uniq[:, 0].astype(np.float32))
@@ -1983,12 +2043,13 @@ def _op_ls(node, env):
 
 
 def _op_getrow(node, env):
-    """(getrow fr) — 1xN frame -> N-element value list."""
+    """(getrow fr) — 1xN frame -> N-element value list (AstGetrow
+    returns ValRow even for N=1: the client always subscripts, e.g.
+    frame.mean()[0] — _explain.py model_correlation)."""
     fr = _as_frame(_eval(node[1], env))
     if fr.nrows != 1:
         raise ValueError("getrow works on single-row frames only")
-    vals = [float(np.asarray(v.as_float())[0]) for v in fr.vecs]
-    return vals if len(vals) > 1 else vals[0]
+    return [float(np.asarray(v.as_float())[0]) for v in fr.vecs]
 
 
 def _op_flatten(node, env):

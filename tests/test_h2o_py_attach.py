@@ -354,3 +354,43 @@ def test_h2o_explain_end_to_end(h2o_client, uploaded):
     assert {"shap_explain_row", "ice"} <= set(row.keys())
     sh = m.scoring_history()
     assert sh is not None and len(sh) >= 1
+
+
+def test_varimp_table_and_frame_utils(h2o_client, uploaded):
+    """variable_importances TwoDimTable + table/sort/mean/getrow rapids
+    shapes + export_file job envelope — the round-5 client sweep."""
+    import matplotlib
+    matplotlib.use("Agg")
+    h2o = h2o_client
+    fr = uploaded
+    from h2o.estimators import H2OGradientBoostingEstimator
+    m = H2OGradientBoostingEstimator(ntrees=4, max_depth=3, seed=3)
+    m.train(x=["a", "b", "c"], y="y", training_frame=fr)
+    vi = m.varimp()
+    assert vi and len(vi[0]) == 4          # (var, rel, scaled, pct)
+    assert {v[0] for v in vi} == {"a", "b", "c"}
+    m.varimp_plot(server=True)
+    h2o.varimp_heatmap([m, m])
+
+    tab = fr["c"].table()                  # (table col dense) parses
+    counts = dict(tab.as_data_frame().values.tolist())
+    assert set(counts) == {"red", "blue"} and sum(counts.values()) == 300
+
+    assert fr.sort(by=["a"]).nrow == 300   # sort by NAME
+
+    means = fr[["a", "b"]].mean()          # 1-row frame -> getrow list
+    assert len(means) == 2
+    assert isinstance(fr["a"].mean()[0], float)   # ValRow even for 1x1
+
+    h2o.model_correlation_heatmap([m, m], fr)
+
+    import os
+    import tempfile
+    path = os.path.join(tempfile.mkdtemp(), "exp.csv")
+    h2o.export_file(fr.head(7), path, force=True)
+    lines = open(path).read().strip().splitlines()
+    assert lines[0] == "a,b,c,y" and len(lines) == 8
+    import pytest as _pytest
+    from h2o.exceptions import H2OResponseError
+    with _pytest.raises(H2OResponseError):
+        h2o.export_file(fr.head(7), path)  # no force -> 400

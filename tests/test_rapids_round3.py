@@ -141,7 +141,8 @@ def test_misc_introspection(cl, sess):
     assert "r3i" in (keys.vecs[0].domain or [])
     one = Frame(["z"], [Vec(np.asarray([42.0], np.float32))])
     _put(one, "r3j")
-    assert _ex("(getrow r3j)", sess) == 42.0
+    # ValRow contract: a LIST even for 1x1 (client does .getrow()[0])
+    assert _ex("(getrow r3j)", sess) == [42.0]
     assert _ex("(flatten r3j)", sess) == 42.0
     cloud().dkv.remove("r3i")
     cloud().dkv.remove("r3j")
