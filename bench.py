@@ -322,10 +322,10 @@ def _arm_watchdog(detail_ref):
                 detail = {}
             detail["watchdog"] = f"bench exceeded {secs:.0f}s; device " \
                 "hang suspected — partial results emitted"
-            _emit({
-                "metric": "gbm_higgs_like_train_throughput_steady",
-                "value": 0.0, "unit": "rows*trees/sec",
-                "vs_baseline": 0.0, "detail": detail})
+            # headline from whatever DID measure before the hang (same
+            # shared emit as the normal path); a run that already
+            # captured the GBM number must not read as 0
+            _emit_headline(detail)
         except BaseException:          # the exit (and with it the driver's
             pass                       # chance to read SOME line) must win
         os._exit(0)
@@ -344,13 +344,81 @@ def main():
     try:
         _main_ladder(detail)
     except BaseException as e:  # noqa: BLE001 — the contract line outranks
-        # any exception, including KeyboardInterrupt from a dying tunnel
+        # any exception, including KeyboardInterrupt from a dying tunnel;
+        # configs that DID measure before the crash still make the headline
         detail["error"] = repr(e)
-        _emit({
-            "metric": "gbm_higgs_like_train_throughput_steady",
-            "value": 0.0, "unit": "rows*trees/sec",
-            "vs_baseline": 0.0, "detail": detail})
+        _emit_headline(detail)
     return 0
+
+
+def _measured(v):
+    return isinstance(v, dict) and "value" in v
+
+
+def _pick_headline(detail):
+    """Headline preference: gbm, else gbm_10m, else any other TPU-engine
+    config that measured.  The CPU reference is a comparison point, NEVER
+    the headline — an all-TPU-failed run must read as 0, not as the CPU
+    throughput."""
+    return next((detail[k] for k in ("gbm", "gbm_10m")
+                 if _measured(detail.get(k))),
+                next((v for k, v in detail.items()
+                      if k != "cpu_reference" and _measured(v)), {}))
+
+
+def _emit_headline(detail):
+    """The ONE shared emit: vs_cpu_reference + headline pick + baseline
+    ratio.  Never raises — the watchdog path relies on this producing a
+    JSON line even with a corrupt baseline file."""
+    try:
+        try:
+            if _measured(detail.get("gbm")) and \
+                    _measured(detail.get("cpu_reference")) and \
+                    detail["cpu_reference"]["value"]:
+                detail["vs_cpu_reference"] = round(
+                    detail["gbm"]["value"] /
+                    detail["cpu_reference"]["value"], 3)
+        except Exception as e:  # noqa: BLE001 — ratio is decoration;
+            detail["vs_cpu_reference_error"] = repr(e)  # headline must win
+        head = _pick_headline(detail)
+        try:
+            vs = _vs_baseline(head, detail)
+        except Exception as e:  # noqa: BLE001 — baseline file problems
+            detail["vs_baseline_error"] = repr(e)
+            vs = 1.0 if head.get("value") else 0.0
+    except Exception as e:  # noqa: BLE001 — contract line must win
+        detail["emit_error"] = repr(e)
+        head, vs = {}, 0.0
+    _emit({
+        "metric": "gbm_higgs_like_train_throughput_steady",
+        "value": head.get("value", 0.0),
+        "unit": head.get("unit", "rows*trees/sec"),
+        "vs_baseline": vs,
+        "detail": detail,
+    })
+
+
+def _vs_baseline(head, detail):
+    """Ratio vs bench_baseline.json on its recorded methodology
+    (mutates detail with the methodology note when it applies)."""
+    base_path = os.path.join(os.path.dirname(__file__),
+                             "bench_baseline.json")
+    value = head.get("value", 0.0)
+    if not (os.path.exists(base_path) and value):
+        return 1.0 if value else 0.0
+    with open(base_path) as f:
+        prev = json.load(f)
+    cmp_value = value
+    if prev.get("methodology") == "wall_with_compile" and \
+            head.get("wall_with_compile_s") and head.get("wall_s"):
+        # apples-to-apples against a compile-inclusive baseline
+        cmp_value = value * head["wall_s"] / head["wall_with_compile_s"]
+        detail["vs_baseline_methodology"] = "wall_with_compile"
+        if prev.get("value"):
+            detail["vs_baseline_steady"] = round(value / prev["value"], 3)
+    if not prev.get("value"):
+        return 1.0
+    return round(cmp_value / prev["value"], 3)
 
 
 def _main_ladder(detail):
@@ -382,7 +450,11 @@ def _main_ladder(detail):
 
     X, y = _make_data(rows, cols)
     fr = _frame(X, y)
+    # cpuref runs right after the headline GBM: the external ratio must
+    # survive a mid-ladder tunnel wedge (it needs no TPU at all)
     runs = [("gbm", lambda: bench_gbm(fr, rows, trees, depth)),
+            ("cpuref", lambda: bench_cpu_reference(X, y, rows, trees,
+                                                   depth)),
             ("gbm_ua", lambda: bench_gbm(
                 fr, rows, trees, depth,
                 histogram_type="UniformAdaptive")),
@@ -393,8 +465,6 @@ def _main_ladder(detail):
             ("dl", lambda: bench_dl(fr, rows)),
             ("hist", lambda: bench_hist_mfu(rows, cols)),
             ("gbm10m", lambda: bench_gbm10m(cols, depth)),
-            ("cpuref", lambda: bench_cpu_reference(X, y, rows, trees,
-                                                   depth)),
             ("deep", lambda: bench_deep(fr, rows))]
     names = {"hist": "hist_kernel", "gbm10m": "gbm_10m",
              "cpuref": "cpu_reference", "deep": "drf_deep20",
@@ -408,48 +478,7 @@ def _main_ladder(detail):
             # not lose the rest of the ladder's measurements
             detail[names.get(cfg, cfg)] = {"error": repr(e)}
 
-    def _measured(v):
-        return isinstance(v, dict) and "value" in v
-
-    cpuref = detail.get("cpu_reference")
-    if _measured(detail.get("gbm")) and _measured(cpuref):
-        detail["vs_cpu_reference"] = round(
-            detail["gbm"]["value"] / cpuref["value"], 3)
-
-    # headline: gbm, else gbm_10m, else any other TPU-engine config that
-    # actually measured (a FAILED config holds {"error": ...}; the CPU
-    # reference is a comparison point, NEVER the headline — an all-TPU-
-    # failed run must read as 0, not as the CPU throughput)
-    head = next((detail[k] for k in ("gbm", "gbm_10m")
-                 if _measured(detail.get(k))),
-                next((v for k, v in detail.items()
-                      if k != "cpu_reference" and _measured(v)), {}))
-    value = head.get("value", 0.0)
-
-    base_path = os.path.join(os.path.dirname(__file__),
-                             "bench_baseline.json")
-    vs = 1.0
-    if os.path.exists(base_path):
-        with open(base_path) as f:
-            prev = json.load(f)
-        cmp_value = value
-        if prev.get("methodology") == "wall_with_compile" and \
-                isinstance(head, dict) and \
-                head.get("wall_with_compile_s") and head.get("wall_s"):
-            # apples-to-apples against a compile-inclusive baseline
-            cmp_value = value * head["wall_s"] / \
-                head["wall_with_compile_s"]
-            detail["vs_baseline_methodology"] = "wall_with_compile"
-        if prev.get("value") and cmp_value:
-            vs = cmp_value / prev["value"]
-
-    _emit({
-        "metric": "gbm_higgs_like_train_throughput_steady",
-        "value": value,
-        "unit": head.get("unit", "rows*trees/sec"),
-        "vs_baseline": round(vs, 3),
-        "detail": detail,
-    })
+    _emit_headline(detail)
 
 
 if __name__ == "__main__":

@@ -19,6 +19,7 @@ Mesh axes:
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Optional
 
@@ -34,6 +35,55 @@ log = get_logger("cloud")
 DATA_AXIS = "nodes"
 MODEL_AXIS = "model"
 
+_cache_enabled = False
+
+
+def backend_is_tpu() -> bool:
+    """Guarded default-backend probe (False when no backend can
+    initialize) — shared by trace-time TPU-only gates."""
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:
+        return False
+
+
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache (process-wide, once).
+
+    The whole-forest tree engine compiles large programs (minutes on a
+    tunneled backend); the disk cache makes every process after the first
+    pay steady-state cost only — the TPU analog of the reference shipping
+    pre-built Java bytecode rather than re-JITting per JVM.  Opt out with
+    H2O_TPU_COMPILE_CACHE=0|off; any other value overrides the directory.
+    """
+    global _cache_enabled
+    if _cache_enabled:
+        return
+    raw = os.environ.get("H2O_TPU_COMPILE_CACHE", "")
+    if raw.lower() in ("0", "off", "false", "none", "no", "disable",
+                       "disabled"):
+        return
+    explicit = bool(raw)
+    if raw.lower() in ("1", "on", "true", "yes"):
+        raw = ""                       # plain "enable" spellings: default dir
+    if not explicit and not backend_is_tpu():
+        # default-on only where it solves a real problem (minutes-long
+        # tunnel compiles); XLA:CPU AOT reloads warn about machine-feature
+        # mismatches across processes, so CPU needs an explicit opt-in
+        # (any truthy H2O_TPU_COMPILE_CACHE value, incl. "1"/"on")
+        return
+    path = raw or os.path.join(os.path.expanduser("~"), ".cache",
+                               "h2o_tpu_xla")
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache every program the tunnel would otherwise recompile
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        _cache_enabled = True
+    except Exception as e:  # noqa: BLE001 — cache is an optimisation only
+        log.warning("compilation cache unavailable: %r", e)
+
 
 class Cloud:
     """Singleton runtime: device mesh + config + store + job registry."""
@@ -43,6 +93,7 @@ class Cloud:
 
     def __init__(self, args: OptArgs, devices=None):
         self.args = args
+        _enable_compile_cache()
         devs = list(devices if devices is not None else jax.devices())
         n = args.nodes or (len(devs) // args.model_axis)
         m = args.model_axis

@@ -215,7 +215,8 @@ def _hist_level_with_sibling(bins, slot, stats, L: int, B: int, cfg,
     half = L // 2
     left_slot = jnp.where((slot >= 0) & (slot % 2 == 0), slot // 2, -1)
     left = _shard_histogram(bins, left_slot, stats, half, B,
-                            cfg["block_rows"], cfg["bf16"])
+                            cfg["block_rows"], cfg["bf16"],
+                            pallas=cfg.get("pallas"))
     right = jnp.where(parent_split[:, None, None, None],
                       parent_hist - left, 0.0)
     return jnp.stack([left, right], axis=1).reshape(L, *left.shape[1:])
@@ -280,7 +281,8 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
                                             prev_hist, prev_do)
         else:
             hist = _shard_histogram(bins, leaf, stats, L, B,
-                                    cfg["block_rows"], cfg["bf16"])
+                                    cfg["block_rows"], cfg["bf16"],
+                                    pallas=cfg.get("pallas"))
         if k_cols < C:
             key, sub = jax.random.split(key)
             r = jax.random.uniform(sub, (L, C))
@@ -459,7 +461,8 @@ def build_tree_frontier(bins, stats, slot0, key, is_cat, cfg: Dict,
                                             prev_hist, prev_do)
         else:
             hist = _shard_histogram(bins, slot, stats, L, B,
-                                    cfg["block_rows"], cfg["bf16"])
+                                    cfg["block_rows"], cfg["bf16"],
+                                    pallas=cfg.get("pallas"))
         if k_cols < C:
             key, sub = jax.random.split(key)
             r = jax.random.uniform(sub, (L, C))
@@ -623,14 +626,20 @@ class TrainedForest(NamedTuple):
     child: object = None   # (T, K, N) left-child pool ptrs; None = dense
 
 
-def train_forest(*args, sibling: Optional[bool] = None, **kwargs):
-    """Public entry: resolves the sibling-subtraction flag from the env
-    OUTSIDE the trace (it is a static jit arg — part of the executable
-    cache key — so toggling H2O_TPU_SIBLING_SUBTRACT between trainings
-    takes effect instead of hitting a stale cached program)."""
+def train_forest(*args, sibling: Optional[bool] = None,
+                 hist_pallas: Optional[bool] = None, **kwargs):
+    """Public entry: resolves the sibling-subtraction and Pallas-histogram
+    flags from the env OUTSIDE the trace (they are static jit args — part
+    of the executable cache key — so toggling H2O_TPU_SIBLING_SUBTRACT /
+    H2O_TPU_HIST_PALLAS between trainings takes effect instead of hitting
+    a stale cached program)."""
     if sibling is None:
         sibling = sibling_subtract_enabled()
-    return _train_forest_jit(*args, sibling=sibling, **kwargs)
+    if hist_pallas is None:
+        from h2o_tpu.ops.histogram import pallas_env_enabled
+        hist_pallas = pallas_env_enabled()
+    return _train_forest_jit(*args, sibling=sibling,
+                             hist_pallas=hist_pallas, **kwargs)
 
 
 @functools.partial(
@@ -643,7 +652,8 @@ def train_forest(*args, sibling: Optional[bool] = None, **kwargs):
                      "huber_alpha", "reg_lambda",
                      "col_sample_rate_per_tree", "use_mono",
                      "kleaves", "custom_dist", "sibling",
-                     "adaptive", "fine_nbins", "hist_random"))
+                     "adaptive", "fine_nbins", "hist_random",
+                     "hist_pallas"))
 def _train_forest_jit(bins, yv, w, active, F0, is_cat, key, *,
                       dist_name: str,
                  K: int, ntrees: int, max_depth: int, nbins: int,
@@ -660,7 +670,8 @@ def _train_forest_jit(bins, yv, w, active, F0, is_cat, key, *,
                  custom_dist=None,
                  sibling: bool = True,
                  adaptive: bool = False, fine_nbins: int = 0,
-                 hist_random: bool = False) -> TrainedForest:
+                 hist_random: bool = False,
+                 hist_pallas: bool = True) -> TrainedForest:
     """The WHOLE forest training loop as one XLA program.
 
     mode="gbm": boosting — stats from distribution gradients at current F,
@@ -677,7 +688,8 @@ def _train_forest_jit(bins, yv, w, active, F0, is_cat, key, *,
                block_rows=block_rows, bf16=bf16, reg_lambda=reg_lambda,
                use_mono=use_mono, max_live_leaves=kleaves,
                sibling=sibling, adaptive=adaptive,
-               fine_nbins=fine_nbins, hist_random=hist_random)
+               fine_nbins=fine_nbins, hist_random=hist_random,
+               pallas=hist_pallas)
     R = bins.shape[0]
 
     def stats_for(kcls, F):

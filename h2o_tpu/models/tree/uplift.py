@@ -29,7 +29,7 @@ from h2o_tpu.core.frame import Frame, Vec
 from h2o_tpu.models import metrics as mm
 from h2o_tpu.models.model import DataInfo, Model, ModelBuilder
 from h2o_tpu.models.tree import shared_tree as st
-from h2o_tpu.ops.histogram import histogram_build_traced
+from h2o_tpu.ops.histogram import histogram_build_traced, pallas_env_enabled
 
 EPS = 1e-6
 
@@ -130,11 +130,11 @@ def _find_uplift_splits(hist, col_allowed, metric: str, min_rows: float):
 @functools.partial(
     jax.jit,
     static_argnames=("ntrees", "max_depth", "nbins", "k_cols", "metric",
-                     "sample_rate", "min_rows", "kleaves"))
+                     "sample_rate", "min_rows", "kleaves", "hist_pallas"))
 def _train_uplift_forest(bins, treat, yv, w, active, key, *, ntrees: int,
                          max_depth: int, nbins: int, k_cols: int,
                          metric: str, sample_rate: float, min_rows: float,
-                         kleaves: int = 4096):
+                         kleaves: int = 4096, hist_pallas: bool = True):
     """Whole uplift forest as one XLA program — the sparse-frontier
     pool engine (jit_engine.build_tree_frontier pattern): live leaves
     capped at ``kleaves`` per level with best-first selection by node
@@ -164,7 +164,7 @@ def _train_uplift_forest(bins, treat, yv, w, active, key, *, ntrees: int,
         for d in range(D):
             L = widths[d]
             hist = histogram_build_traced(bins, slot, stats, L, B, 8192,
-                                          False)
+                                          False, pallas=hist_pallas)
             kc, kcol = jax.random.split(kc)
             if k_cols < C:
                 r = jax.random.uniform(kcol, (L, C))
@@ -327,7 +327,7 @@ class UpliftDRF(ModelBuilder):
             metric=(p["uplift_metric"] or "KL").lower(),
             sample_rate=float(p["sample_rate"]),
             min_rows=float(p["min_rows"]),
-            kleaves=max_live_leaves())
+            kleaves=max_live_leaves(), hist_pallas=pallas_env_enabled())
         out = dict(x=list(di.x), split_points=binned.split_points,
                    is_cat=binned.is_cat, nbins=binned.nbins,
                    split_col=np.asarray(sc), bitset=np.asarray(bs),

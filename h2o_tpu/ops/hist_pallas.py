@@ -1,0 +1,118 @@
+"""Pallas TPU kernel for the (leaf, col, bin) histogram — fused one-hot
+matmul.
+
+The XLA path (ops/histogram.py) materializes each row block's one-hot
+matrix ``binhot (blk, C*(B+1))`` in HBM before the MXU contraction — at
+1M rows that is gigabytes of HBM traffic per level for what is logically
+a throwaway intermediate.  This kernel builds the one-hot TILE-BY-TILE in
+VMEM and feeds the MXU directly, so HBM sees only the true inputs
+(bins, leaf, stats — ~R*(C+5)*4 bytes) and the true output
+((C*(B+1), L*S) partials).  Reference hot loop:
+ScoreBuildHistogram2.java:16-61 (same redesign rationale as
+ops/histogram.py — TPUs hate scatter, so binning is a matmul).
+
+Grid: sequential over row tiles; every step accumulates into the SAME
+output block (TPU grids execute in order, making read-modify-write on the
+output block safe).  Tile height adapts to keep the in-VMEM one-hot under
+a fixed byte budget whatever (C, B) the caller brings.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# VMEM budget for the one-hot tile (the kernel's dominant buffer); 4 MiB
+# leaves ample room for bins/stats tiles, the A tile, and the accumulator
+# in a 16 MiB VMEM.
+_ONEHOT_BYTES = 4 * 2 ** 20
+
+
+def min_tile_fits(C: int, B1: int) -> bool:
+    """True when the 512-row minimum tile's one-hot fits the VMEM budget
+    at the widest (f32) dtype — eligibility gate for wide-feature shapes
+    (ops/histogram.py falls back to the XLA path otherwise)."""
+    return 512 * C * B1 * 4 <= _ONEHOT_BYTES
+
+
+def _tile_rows(C: int, B1: int, mm_dtype) -> int:
+    """Largest 512-multiple tile height whose one-hot fits the budget."""
+    itemsize = jnp.dtype(mm_dtype).itemsize
+    t = _ONEHOT_BYTES // max(C * B1 * itemsize, 1)
+    return max(512, min(4096, (t // 512) * 512))
+
+
+def _hist_kernel(bins_ref, leaf_ref, stats_ref, out_ref, *,
+                 n_leaves: int, nbins: int, mm_dtype):
+    """One row tile: out += binhot(bins)^T @ (leafhot(leaf) ⊗ stats)."""
+    B1 = nbins + 1
+    TR, C = bins_ref.shape
+    S = stats_ref.shape[1]
+    L = n_leaves
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    leaf = leaf_ref[:, 0]                                    # (TR,)
+    leafhot = (leaf[:, None] ==
+               lax.broadcasted_iota(jnp.int32, (TR, L), 1))
+    # zero stats of inactive rows BEFORE the product (padded rows carry
+    # NaN payloads; 0 * NaN would poison the accumulator)
+    stats = jnp.where(leaf[:, None] >= 0, stats_ref[:], 0.0)
+    a = (leafhot[:, :, None] * stats[:, None, :]).reshape(TR, L * S)
+    binhot = (bins_ref[:][:, :, None] ==
+              lax.broadcasted_iota(jnp.int32, (TR, C, B1), 2)
+              ).reshape(TR, C * B1)
+    out_ref[:] += lax.dot_general(
+        binhot.astype(mm_dtype), a.astype(mm_dtype),
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                  # (C*B1, L*S)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "n_leaves", "nbins", "bf16", "interpret"))
+def hist_pallas(bins, leaf, stats, n_leaves: int, nbins: int,
+                bf16: bool = False, interpret: bool = False):
+    """(C*(B+1), L*S) histogram of one device shard via the fused kernel.
+
+    Same contract as the XLA path's accumulated ``_block_hist``: rows with
+    ``leaf < 0`` contribute nothing; bin ``nbins`` is the NA bucket.
+    Pads rows to a tile multiple internally (padded rows get leaf −1).
+    """
+    R, C = bins.shape
+    S = stats.shape[1]
+    B1 = nbins + 1
+    mm_dtype = jnp.bfloat16 if bf16 else jnp.float32
+    TR = _tile_rows(C, B1, mm_dtype)
+    pad = (-R) % TR
+    if pad:
+        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        leaf = jnp.pad(leaf, (0, pad), constant_values=-1)
+        stats = jnp.pad(stats, ((0, pad), (0, 0)))
+    n_tiles = (R + pad) // TR
+
+    kernel = functools.partial(_hist_kernel, n_leaves=n_leaves,
+                               nbins=nbins, mm_dtype=mm_dtype)
+    return pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((TR, C), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TR, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TR, S), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((C * B1, n_leaves * S), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((C * B1, n_leaves * S),
+                                       jnp.float32),
+        interpret=interpret,
+    )(bins, leaf.reshape(-1, 1), stats)

@@ -47,6 +47,37 @@ W, WG, WGG, WH = 0, 1, 2, 3
 N_STATS = 4
 
 
+def pallas_env_enabled() -> bool:
+    """H2O_TPU_HIST_PALLAS=0 opts out of the fused kernel.  Resolve this
+    OUTSIDE jit traces (the engine's train_forest wrapper does) — a value
+    read at trace time is baked into the executable cache key's shapes
+    and a later env flip would silently not apply."""
+    import os
+    return os.environ.get("H2O_TPU_HIST_PALLAS", "1") != "0"
+
+
+def _pallas_eligible(C: int, B1: int, n_leaves: int, S: int,
+                     fine_map, allowed=None) -> bool:
+    """Static choice of the fused Pallas kernel (ops/hist_pallas.py):
+    TPU backend only (CPU tests keep the portable XLA path), global-grid
+    binning only (the adaptive fine_map fuses map_buckets into the XLA
+    scan body), and both kernel buffers must fit VMEM.  ``allowed`` is
+    the env opt-out resolved outside the trace (None = resolve here)."""
+    if allowed is None:
+        allowed = pallas_env_enabled()
+    if not allowed or fine_map is not None:
+        return False
+    from h2o_tpu.core.cloud import backend_is_tpu
+    if not backend_is_tpu():
+        return False
+    from h2o_tpu.ops.hist_pallas import min_tile_fits
+    # accumulator block must fit VMEM comfortably AND the kernel's
+    # smallest row tile must keep its in-VMEM one-hot under budget
+    # (wide-feature shapes fall back to the XLA path)
+    return (C * B1 * n_leaves * S * 4 <= 6 * 2 ** 20 and
+            min_tile_fits(C, B1))
+
+
 def _block_hist(bins_blk, leaf_blk, stats_blk, n_leaves: int, nbins: int,
                 mm_dtype=jnp.float32):
     """One row block's histogram: (C*(B+1), L*S).
@@ -101,7 +132,7 @@ def map_buckets(bins_blk, leaf_blk, lo, hi, off, is_cat, nbins: int,
 
 def histogram_build_traced(bins, leaf, stats, n_leaves: int, nbins: int,
                            block_rows: int = 8192, bf16: bool = False,
-                           fine_map=None):
+                           fine_map=None, pallas=None):
     """Traceable distributed histogram: (L, C, B+1, S) replicated on every
     device.  Nestable inside outer jit/scan programs (the fused tree engine
     calls this inside its per-tree scan body).
@@ -128,11 +159,19 @@ def histogram_build_traced(bins, leaf, stats, n_leaves: int, nbins: int,
         extra_specs = (P(), P(), P(), P())
         extra = (lo, hi, off, is_cat_m)
 
+    use_pallas = _pallas_eligible(C, B1, n_leaves, S, fine_map,
+                                  allowed=pallas)
+
     @functools.partial(jax.shard_map, mesh=mesh,
                        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS),
                                  P(DATA_AXIS, None)) + extra_specs,
                        out_specs=P(), check_vma=False)
     def run(b_sh, l_sh, s_sh, *rep):
+        if use_pallas:
+            from h2o_tpu.ops.hist_pallas import hist_pallas
+            acc = hist_pallas(b_sh, l_sh, s_sh, n_leaves, nbins,
+                              bf16=bf16)
+            return jax.lax.psum(acc, DATA_AXIS)
         R = b_sh.shape[0]
         blk = min(block_rows, R)
         nblk = R // blk
@@ -167,9 +206,20 @@ def histogram_build_traced(bins, leaf, stats, n_leaves: int, nbins: int,
              .transpose(2, 0, 1, 3))                # (L, C, B+1, S)
 
 
-histogram_build = jax.jit(
+_histogram_build_jit = jax.jit(
     histogram_build_traced,
-    static_argnames=("n_leaves", "nbins", "block_rows", "bf16"))
+    static_argnames=("n_leaves", "nbins", "block_rows", "bf16",
+                     "pallas"))
+
+
+def histogram_build(bins, leaf, stats, n_leaves: int, nbins: int,
+                    block_rows: int = 8192, bf16: bool = False):
+    """Public standalone entry: resolves the Pallas opt-out env OUTSIDE
+    the trace (it is a static jit arg, so toggling H2O_TPU_HIST_PALLAS
+    between calls takes effect instead of hitting a stale executable)."""
+    return _histogram_build_jit(bins, leaf, stats, n_leaves=n_leaves,
+                                nbins=nbins, block_rows=block_rows,
+                                bf16=bf16, pallas=pallas_env_enabled())
 
 
 def bin_features(matrix, split_points):

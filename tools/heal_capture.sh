@@ -1,0 +1,27 @@
+#!/bin/bash
+# Round-5 remaining-ladder capture: probes the axon tunnel with a short
+# timeout (a wedged tunnel hangs any jax init, so the probe must be a
+# killable subprocess); the moment it heals, runs each outstanding bench
+# config in its OWN process (a hang in one cannot lose the others) and
+# leaves one JSON file per config for the evidence merge.
+cd /root/repo || exit 1
+log=${HEAL_LOG:-/tmp/heal_capture.log}
+configs=${HEAL_CONFIGS:-hist gbm10m deep gbm}
+while true; do
+  if timeout 120 python -c \
+      "import jax, jax.numpy as jnp; x = jnp.ones((256, 256)); \
+print(float((x @ x).sum()), jax.devices())" >>"$log" 2>&1; then
+    echo "$(date -u) tunnel healthy; capturing: $configs" >>"$log"
+    for cfg in $configs; do
+      BENCH_WATCHDOG_SECS=1800 BENCH_CONFIG=$cfg \
+        python bench.py >"/tmp/bench_${cfg}.json" \
+        2>"/tmp/bench_${cfg}.log"
+      echo "$(date -u) $cfg rc=$? $(tail -c 200 /tmp/bench_${cfg}.json)" \
+        >>"$log"
+    done
+    echo "$(date -u) capture complete" >>"$log"
+    break
+  fi
+  echo "$(date -u) tunnel down; retrying" >>"$log"
+  sleep 120
+done

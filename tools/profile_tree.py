@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Piecewise timing of the fused tree engine on the bench workload.
+
+The bench measures the whole program (10.8s steady for 20 trees at 1M x 28
+on v5e); bf16 histograms move it ~2%, so the MXU matmul is NOT the
+bottleneck.  This profiler times each stage of the per-level loop as its
+own jitted program on the real data shapes, to locate where the ~540ms
+per tree actually goes before optimizing anything.
+
+Stages (all steady-state, host-fetch barrier like bench.py):
+  full      - train_forest exactly as the bench config runs it
+  depth     - full train at D=1..5: marginal per-level cost
+  hist      - histogram_build per level width L (and sibling-halved L/2)
+  stats     - gradient/hessian stats build (distribution ops)
+  route     - one level's row routing (col gather + bitset gather)
+  predict   - one tree's _tree_predict descent
+  splits    - find_splits on (L, C, B+1, 4)
+  blocks    - histogram block_rows sweep (8192..65536)
+
+Usage: python tools/profile_tree.py [rows] [stage,stage,...]
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def timed(fn, reps=5):
+    """Steady-state seconds per call (first call compiles, untimed)."""
+    out = fn()
+    _barrier(out)
+    t0 = time.time()
+    for _ in range(reps):
+        out = fn()
+    _barrier(out)
+    return (time.time() - t0) / reps
+
+
+def _barrier(out):
+    import jax
+    leaves = [x for x in jax.tree_util.tree_leaves(out)
+              if hasattr(x, "dtype")]
+    if leaves:
+        float(leaves[-1].ravel()[0])
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    stages = (sys.argv[2].split(",") if len(sys.argv) > 2 else
+              ["full", "depth", "hist", "stats", "route", "predict",
+               "splits", "blocks"])
+    import jax
+    import jax.numpy as jnp
+    from h2o_tpu.core.cloud import Cloud
+    from h2o_tpu.ops.histogram import histogram_build
+    from h2o_tpu.models.tree.jit_engine import train_forest, _tree_predict
+    from h2o_tpu.models.tree.shared_tree import find_splits
+
+    Cloud.boot()
+    print(f"# devices={jax.devices()} rows={rows}", flush=True)
+    C, B, D, T = 28, 20, 5, 20
+    rng = np.random.default_rng(0)
+    bins = jnp.asarray(rng.integers(0, B, size=(rows, C)), jnp.int32)
+    yv = jnp.asarray(rng.integers(0, 2, size=(rows,)), jnp.float32)
+    w = jnp.ones((rows,), jnp.float32)
+    active = jnp.ones((rows,), bool)
+    F0 = jnp.zeros((rows, 1), jnp.float32)
+    is_cat = jnp.zeros((C,), bool)
+    key = jax.random.PRNGKey(0)
+    res = {}
+
+    def full(ntrees=T, depth=D, sibling=None):
+        return train_forest(
+            bins, yv, w, active, F0, is_cat, key,
+            dist_name="bernoulli", K=1, ntrees=ntrees, max_depth=depth,
+            nbins=B, k_cols=C, newton=True, sample_rate=1.0,
+            learn_rate=0.1, learn_rate_annealing=1.0, min_rows=10.0,
+            min_split_improvement=1e-5, sibling=sibling)
+
+    if "full" in stages:
+        res["full_20t_d5_s"] = timed(lambda: full(), reps=3)
+        res["full_nosib_s"] = timed(lambda: full(sibling=False), reps=3)
+        print(f"full: {res['full_20t_d5_s']:.3f}s/20 trees "
+              f"(no-sibling {res['full_nosib_s']:.3f}s)", flush=True)
+    if "depth" in stages:
+        for d in range(1, D + 1):
+            res[f"depth{d}_s"] = timed(lambda d=d: full(depth=d), reps=3)
+            print(f"depth {d}: {res[f'depth{d}_s']:.3f}s/20 trees",
+                  flush=True)
+    if "hist" in stages:
+        for L in (1, 2, 4, 8, 16, 32):
+            leaf = jnp.asarray(rng.integers(0, L, size=(rows,)),
+                               jnp.int32)
+            stats = jnp.asarray(rng.normal(size=(rows, 4)), jnp.float32)
+            res[f"hist_L{L}_s"] = timed(
+                lambda L=L: histogram_build(bins, leaf, stats, L, B))
+            print(f"hist L={L}: {res[f'hist_L{L}_s']*1e3:.2f}ms",
+                  flush=True)
+    if "stats" in stages:
+        from h2o_tpu.models.distributions import get_distribution
+        dist = get_distribution("bernoulli")
+
+        @jax.jit
+        def mkstats(F):
+            g = jnp.nan_to_num(dist.gradient(yv, F[:, 0]))
+            h = jnp.nan_to_num(dist.hessian(yv, F[:, 0]))
+            return jnp.stack([w, w * g, w * g * g, w * h], axis=1)
+
+        res["stats_s"] = timed(lambda: mkstats(F0))
+        print(f"stats: {res['stats_s']*1e3:.2f}ms", flush=True)
+    if "route" in stages:
+        L = 16
+        leaf = jnp.asarray(rng.integers(0, L, size=(rows,)), jnp.int32)
+        col = jnp.asarray(rng.integers(0, C, size=(L,)), jnp.int32)
+        bset = jnp.asarray(rng.integers(0, 2, size=(L, B + 1)), bool)
+        do = jnp.ones((L,), bool)
+
+        @jax.jit
+        def route(leaf):
+            active = leaf >= 0
+            lf = jnp.maximum(leaf, 0)
+            c = col[lf]
+            b = jnp.take_along_axis(bins, c[:, None], axis=1)[:, 0]
+            go_left = bset[lf, b]
+            child = 2 * lf + jnp.where(go_left, 0, 1)
+            return jnp.where(active & do[lf], child,
+                             jnp.where(active, -1, leaf))
+
+        res["route_s"] = timed(lambda: route(leaf))
+        print(f"route (1 level): {res['route_s']*1e3:.2f}ms", flush=True)
+    if "predict" in stages:
+        H = 2 ** (D + 1) - 1
+        sc = jnp.asarray(rng.integers(-1, C, size=(H,)), jnp.int32)
+        bs = jnp.asarray(rng.integers(0, 2, size=(H, B + 1)), bool)
+        vl = jnp.asarray(rng.normal(size=(H,)), jnp.float32)
+        pred = jax.jit(lambda: _tree_predict(bins, sc, bs, vl, D))
+        res["predict_s"] = timed(pred)
+        print(f"predict (1 tree): {res['predict_s']*1e3:.2f}ms",
+              flush=True)
+    if "splits" in stages:
+        for L in (16, 32):
+            hist = jnp.abs(jnp.asarray(
+                rng.normal(size=(L, C, B + 1, 4)), jnp.float32))
+            allowed = jnp.ones((L, C), bool)
+            fs = jax.jit(lambda h, a: find_splits(
+                h, is_cat, a, min_rows=10.0,
+                min_split_improvement=1e-5, newton=True))
+            res[f"splits_L{L}_s"] = timed(lambda h=hist, a=allowed:
+                                          fs(h, a))
+            print(f"find_splits L={L}: {res[f'splits_L{L}_s']*1e3:.2f}ms",
+                  flush=True)
+    if "blocks" in stages:
+        L = 16
+        leaf = jnp.asarray(rng.integers(0, L, size=(rows,)), jnp.int32)
+        stats = jnp.asarray(rng.normal(size=(rows, 4)), jnp.float32)
+        for blk in (8192, 16384, 32768, 65536):
+            res[f"hist_blk{blk}_s"] = timed(
+                lambda blk=blk: histogram_build(bins, leaf, stats, L, B,
+                                                block_rows=blk))
+            print(f"hist block={blk}: {res[f'hist_blk{blk}_s']*1e3:.2f}ms",
+                  flush=True)
+
+    import json
+    print(json.dumps({k: round(v, 5) for k, v in res.items()}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
