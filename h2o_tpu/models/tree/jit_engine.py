@@ -185,6 +185,77 @@ def _child_ranges(new_lo, new_hi, s, thr_leaf, is_cat, do_split):
     return lo2, hi2
 
 
+def matmul_route_enabled() -> Optional[bool]:
+    """H2O_TPU_MATMUL_ROUTE: 1 forces the matmul router, 0 the gather
+    router, unset = auto (TPU on / CPU off).  Resolve OUTSIDE jit traces
+    (static arg) like the sibling/pallas flags."""
+    import os
+    v = os.environ.get("H2O_TPU_MATMUL_ROUTE", "").lower()
+    if v in ("1", "on", "true", "yes"):
+        return True
+    if v in ("0", "off", "false", "none", "no", "disable", "disabled"):
+        return False
+    from h2o_tpu.core.cloud import backend_is_tpu
+    return backend_is_tpu()
+
+
+# largest lookup table the matmul router will one-hot over; beyond this
+# (deep frontier pools, wide adaptive root grids) the (R, table)
+# intermediates outgrow the gathers they replace — the adaptive halving
+# schedule's top levels (Bd up to nbins_top_level=1024) would otherwise
+# materialize multi-GB (R, Bd+1) picks
+_MM_ROUTE_MAX_TABLE = 128
+
+_HI = jax.lax.Precision.HIGHEST
+
+
+def _mm_pick(hot, table):
+    """Exact per-row table lookup as a matmul: ``table[idx]`` with
+    ``hot = onehot(idx)``.  Every row of ``hot`` has at most one nonzero,
+    so the f32 contraction is exact (ints < 2**24, incl. -1 sentinels).
+    TPUs serialize per-row random gathers; this rides the MXU instead."""
+    return jax.lax.dot_general(
+        hot.astype(jnp.float32), table.astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())), precision=_HI)
+
+
+def _mm_route_level(bins, lf, s, do_split, L: int, Bd: int, cat_choice,
+                    adaptive: bool, thr_leaf, F: int):
+    """Gather-free analog of the per-level routing block: returns
+    (go_left, do_split[lf]) using one-hot matmuls over the (L, ·) split
+    tables and a masked reduction for the per-row column pick.  Bitwise
+    identical to the gather path (all contractions have one nonzero
+    term per row).  ``cat_choice`` is the caller's is_cat[s["col"]]."""
+    R, C = bins.shape
+    leafhot = lf[:, None] == jnp.arange(L)[None, :]          # (R, L)
+    colhot = (s["col"][:, None] ==
+              jnp.arange(C)[None, :])                        # (L, C)
+    # bins[r, col[lf[r]]]: pick the leaf's column per row
+    P = _mm_pick(leafhot, colhot)                            # (R, C)
+    b = jnp.sum(bins.astype(jnp.float32) * P, axis=1).astype(jnp.int32)
+    # bitset[lf, b]: leaf-pick the bitset row, then mask-reduce bucket b
+    T = _mm_pick(leafhot, s["bitset"])                       # (R, B+1)
+    bcl = jnp.minimum(b, Bd) if adaptive else b
+    gset = jnp.sum(
+        T * (bcl[:, None] == jnp.arange(T.shape[1])[None, :]),
+        axis=1) > 0.5
+    if adaptive:
+        # numeric thresholds + NA direction + split-kind, all leaf-picked
+        tbl = jnp.stack([thr_leaf.astype(jnp.float32),
+                         s["na_left"].astype(jnp.float32),
+                         cat_choice.astype(jnp.float32),
+                         do_split.astype(jnp.float32)], axis=1)
+        V = _mm_pick(leafhot, tbl)                           # (R, 4)
+        gthr = jnp.where(b == F, V[:, 1] > 0.5, b < V[:, 0])
+        go_left = jnp.where(V[:, 2] > 0.5, gset, gthr)
+        do_lf = V[:, 3] > 0.5
+    else:
+        go_left = gset
+        do_lf = _mm_pick(leafhot, do_split.astype(jnp.float32)[:, None]
+                         )[:, 0] > 0.5
+    return go_left, do_lf
+
+
 def _node_val(wg, wh, w, newton: bool, reg_lambda: float = 0.0):
     denom = jnp.maximum(wh + reg_lambda, EPS) if newton \
         else jnp.maximum(w, EPS)
@@ -368,17 +439,24 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
         # route rows
         active = leaf >= 0
         lf = jnp.maximum(leaf, 0)
-        c = s["col"][lf]
-        b = jnp.take_along_axis(bins, c[:, None], axis=1)[:, 0]
-        if adaptive:
-            gset = s["bitset"][lf, jnp.minimum(b, Bd)]
-            gthr = jnp.where(b == F, s["na_left"][lf],
-                             b < thr_leaf[lf])
-            go_left = jnp.where(cat_choice[lf], gset, gthr)
+        if cfg.get("mm_route") and L <= _MM_ROUTE_MAX_TABLE and \
+                (Bd if adaptive else B) < _MM_ROUTE_MAX_TABLE:
+            go_left, do_lf = _mm_route_level(
+                bins, lf, s, do_split, L, Bd if adaptive else B,
+                cat_choice, adaptive, thr_leaf, F)
         else:
-            go_left = s["bitset"][lf, b]
+            c = s["col"][lf]
+            b = jnp.take_along_axis(bins, c[:, None], axis=1)[:, 0]
+            if adaptive:
+                gset = s["bitset"][lf, jnp.minimum(b, Bd)]
+                gthr = jnp.where(b == F, s["na_left"][lf],
+                                 b < thr_leaf[lf])
+                go_left = jnp.where(cat_choice[lf], gset, gthr)
+            else:
+                go_left = s["bitset"][lf, b]
+            do_lf = do_split[lf]
         child = 2 * lf + jnp.where(go_left, 0, 1)
-        leaf = jnp.where(active & do_split[lf], child,
+        leaf = jnp.where(active & do_lf, child,
                          jnp.where(active, -1, leaf))
         if adaptive and d + 1 < D:
             new_lo, new_hi = _refine_ranges(hist, rlo, rhi, roff, Bd)
@@ -560,17 +638,29 @@ def build_tree_frontier(bins, stats, slot0, key, is_cat, cfg: Dict,
             # rows whose child fell off the frontier finalize (-1)
             active = slot >= 0
             sl = jnp.maximum(slot, 0)
-            c = s["col"][sl]
-            b = jnp.take_along_axis(bins, c[:, None], axis=1)[:, 0]
-            if adaptive:
-                gset = s["bitset"][sl, jnp.minimum(b, Bd)]
-                gthr = jnp.where(b == F, s["na_left"][sl],
-                                 b < thr_leaf[sl])
-                go_left = jnp.where(cat_choice[sl], gset, gthr)
+            if cfg.get("mm_route") and 2 * L <= _MM_ROUTE_MAX_TABLE and \
+                    (Bd if adaptive else B) < _MM_ROUTE_MAX_TABLE:
+                go_left, do_sl = _mm_route_level(
+                    bins, sl, s, do_split, L, Bd if adaptive else B,
+                    cat_choice, adaptive, thr_leaf, F)
+                cand = 2 * sl + jnp.where(go_left, 0, 1)
+                candhot = cand[:, None] == jnp.arange(2 * L)[None, :]
+                inv_c = _mm_pick(candhot, inv.astype(jnp.float32)[:, None]
+                                 )[:, 0].astype(jnp.int32)
             else:
-                go_left = s["bitset"][sl, b]
-            cand = 2 * sl + jnp.where(go_left, 0, 1)
-            new_slot = jnp.where(active & do_split[sl], inv[cand], -1)
+                c = s["col"][sl]
+                b = jnp.take_along_axis(bins, c[:, None], axis=1)[:, 0]
+                if adaptive:
+                    gset = s["bitset"][sl, jnp.minimum(b, Bd)]
+                    gthr = jnp.where(b == F, s["na_left"][sl],
+                                     b < thr_leaf[sl])
+                    go_left = jnp.where(cat_choice[sl], gset, gthr)
+                else:
+                    go_left = s["bitset"][sl, b]
+                do_sl = do_split[sl]
+                cand = 2 * sl + jnp.where(go_left, 0, 1)
+                inv_c = inv[cand]
+            new_slot = jnp.where(active & do_sl, inv_c, -1)
             slot = jnp.where(active, new_slot, slot)
             if use_mono:
                 lo_b = jnp.take(lo_c, sel)
@@ -589,27 +679,70 @@ def build_tree_frontier(bins, stats, slot0, key, is_cat, cfg: Dict,
 
 
 def _tree_predict(bins, split_col, bitset, value, D: int, child=None,
-                  thr=None, na_l=None, fine_na: int = -1):
+                  thr=None, na_l=None, fine_na: int = -1,
+                  mm: bool = False):
     """Descend one tree for all rows (traceable).  ``child`` None = dense
     heap (children at 2n+1/2n+2), else explicit left-child pointers;
-    ``thr``/``na_l`` carry adaptive numeric thresholds."""
-    from h2o_tpu.models.tree.shared_tree import _go_left
-    R = bins.shape[0]
+    ``thr``/``na_l`` carry adaptive numeric thresholds.  ``mm`` routes the
+    per-level lookups through one-hot matmuls (gather-free; identical
+    results) when the node table is small enough."""
+    R, C = bins.shape
     B = bitset.shape[-1] - 1
+    H = split_col.shape[0]
     node = jnp.zeros((R,), jnp.int32)
+    use_mm = mm and H <= _MM_ROUTE_MAX_TABLE
     for _ in range(D):
-        c = split_col[node]
-        term = c < 0
-        b = jnp.take_along_axis(bins, jnp.maximum(c, 0)[:, None],
-                                axis=1)[:, 0]
-        go_left = _go_left(bitset, node, b, thr, na_l, fine_na, B)
-        if child is None:
-            nxt = 2 * node + jnp.where(go_left, 1, 2)
+        if use_mm:
+            nodehot = node[:, None] == jnp.arange(H)[None, :]  # (R, H)
+            tbl = [split_col.astype(jnp.float32),
+                   (thr if thr is not None else
+                    jnp.full((H,), -1, jnp.int32)).astype(jnp.float32),
+                   (na_l if na_l is not None else
+                    jnp.zeros((H,), bool)).astype(jnp.float32),
+                   (child if child is not None else
+                    jnp.full((H,), -1, jnp.int32)).astype(jnp.float32)]
+            V = _mm_pick(nodehot, jnp.stack(tbl, axis=1))      # (R, 4)
+            c = V[:, 0].astype(jnp.int32)
+            term = c < 0
+            colhot = jnp.maximum(c, 0)[:, None] == \
+                jnp.arange(C)[None, :]
+            b = jnp.sum(bins.astype(jnp.float32) * colhot,
+                        axis=1).astype(jnp.int32)
+            T = _mm_pick(nodehot, bitset)                      # (R, B+1)
+            nb = jnp.minimum(b, B)
+            gl = jnp.sum(
+                T * (nb[:, None] == jnp.arange(B + 1)[None, :]),
+                axis=1) > 0.5
+            if thr is None:
+                go_left = gl
+            else:
+                tn = V[:, 1].astype(jnp.int32)
+                go_left = jnp.where(
+                    tn >= 0,
+                    jnp.where(b == fine_na, V[:, 2] > 0.5, b < tn), gl)
+            if child is None:
+                nxt = 2 * node + jnp.where(go_left, 1, 2)
+            else:
+                left = V[:, 3].astype(jnp.int32)
+                term = term | (left < 0)
+                nxt = left + jnp.where(go_left, 0, 1)
         else:
-            left = child[node]
-            term = term | (left < 0)
-            nxt = left + jnp.where(go_left, 0, 1)
+            from h2o_tpu.models.tree.shared_tree import _go_left
+            c = split_col[node]
+            term = c < 0
+            b = jnp.take_along_axis(bins, jnp.maximum(c, 0)[:, None],
+                                    axis=1)[:, 0]
+            go_left = _go_left(bitset, node, b, thr, na_l, fine_na, B)
+            if child is None:
+                nxt = 2 * node + jnp.where(go_left, 1, 2)
+            else:
+                left = child[node]
+                term = term | (left < 0)
+                nxt = left + jnp.where(go_left, 0, 1)
         node = jnp.where(term, node, nxt)
+    if use_mm:
+        nodehot = node[:, None] == jnp.arange(H)[None, :]
+        return _mm_pick(nodehot, value[:, None])[:, 0]
     return value[node]
 
 
@@ -638,6 +771,8 @@ def train_forest(*args, sibling: Optional[bool] = None,
     if hist_pallas is None:
         from h2o_tpu.ops.histogram import pallas_env_enabled
         hist_pallas = pallas_env_enabled()
+    if "mm_route" not in kwargs or kwargs["mm_route"] is None:
+        kwargs["mm_route"] = matmul_route_enabled()
     return _train_forest_jit(*args, sibling=sibling,
                              hist_pallas=hist_pallas, **kwargs)
 
@@ -653,7 +788,7 @@ def train_forest(*args, sibling: Optional[bool] = None,
                      "col_sample_rate_per_tree", "use_mono",
                      "kleaves", "custom_dist", "sibling",
                      "adaptive", "fine_nbins", "hist_random",
-                     "hist_pallas"))
+                     "hist_pallas", "mm_route"))
 def _train_forest_jit(bins, yv, w, active, F0, is_cat, key, *,
                       dist_name: str,
                  K: int, ntrees: int, max_depth: int, nbins: int,
@@ -671,7 +806,8 @@ def _train_forest_jit(bins, yv, w, active, F0, is_cat, key, *,
                  sibling: bool = True,
                  adaptive: bool = False, fine_nbins: int = 0,
                  hist_random: bool = False,
-                 hist_pallas: bool = True) -> TrainedForest:
+                 hist_pallas: bool = True,
+                 mm_route: bool = False) -> TrainedForest:
     """The WHOLE forest training loop as one XLA program.
 
     mode="gbm": boosting — stats from distribution gradients at current F,
@@ -689,7 +825,7 @@ def _train_forest_jit(bins, yv, w, active, F0, is_cat, key, *,
                use_mono=use_mono, max_live_leaves=kleaves,
                sibling=sibling, adaptive=adaptive,
                fine_nbins=fine_nbins, hist_random=hist_random,
-               pallas=hist_pallas)
+               pallas=hist_pallas, mm_route=mm_route)
     R = bins.shape[0]
 
     def stats_for(kcls, F):
@@ -765,7 +901,8 @@ def _train_forest_jit(bins, yv, w, active, F0, is_cat, key, *,
             nas.append(na)
             preds.append(_tree_predict(
                 bins, sc, bs, vl, max_depth, child=ch, thr=th, na_l=na,
-                fine_na=int(cfg.get("fine_nbins") or nbins)))
+                fine_na=int(cfg.get("fine_nbins") or nbins),
+                mm=bool(cfg.get("mm_route"))))
         F = F + jnp.stack(preds, axis=1)
         out = (jnp.stack(scs), jnp.stack(bss), jnp.stack(vls),
                sum(vis), jnp.stack(gns), jnp.stack(nws),

@@ -1,0 +1,61 @@
+"""The gather-free (one-hot matmul) router must build the SAME trees as
+the gather router.  The router's own contractions are exact (one nonzero
+term per row), so split structure must match bit-for-bit; leaf values and
+training predictions get a tight float tolerance because the two program
+structures make XLA reassociate unrelated f32 math (gradients, psums)
+differently at the ~1e-7 level."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from h2o_tpu.models.tree.jit_engine import train_forest
+
+
+def _data(rows=3000, C=6, B=12, seed=3):
+    rng = np.random.default_rng(seed)
+    bins = jnp.asarray(rng.integers(0, B + 1, size=(rows, C)), jnp.int32)
+    yv = jnp.asarray(rng.integers(0, 2, size=(rows,)), jnp.float32)
+    w = jnp.ones((rows,), jnp.float32)
+    active = jnp.ones((rows,), bool)
+    F0 = jnp.zeros((rows, 1), jnp.float32)
+    is_cat = jnp.zeros((C,), bool)
+    return bins, yv, w, active, F0, is_cat, B
+
+
+@pytest.mark.parametrize("kleaves,adaptive,fine", [
+    (0, False, 0),      # dense heap, global grid
+    (4, False, 0),      # sparse frontier (capped at 4 -> selection active)
+    (0, True, 64),      # dense heap, UniformAdaptive (all levels mm)
+    (0, True, 256),     # wide adaptive root: top levels exceed the
+                        # router's width cap and fall back to gathers,
+                        # bottom levels ride the mm path — mixed program
+])
+def test_mm_route_identical_trees(kleaves, adaptive, fine):
+    bins, yv, w, active, F0, is_cat, B = _data()
+    kw = dict(dist_name="bernoulli", K=1, ntrees=4, max_depth=4,
+              nbins=B, k_cols=6, newton=True, sample_rate=1.0,
+              learn_rate=0.1, learn_rate_annealing=1.0, min_rows=5.0,
+              min_split_improvement=1e-5, kleaves=kleaves,
+              adaptive=adaptive, fine_nbins=fine)
+    key = jax.random.PRNGKey(7)
+    a = train_forest(bins, yv, w, active, F0, is_cat, key,
+                     mm_route=False, **kw)
+    b = train_forest(bins, yv, w, active, F0, is_cat, key,
+                     mm_route=True, **kw)
+    np.testing.assert_array_equal(np.asarray(a.split_col),
+                                  np.asarray(b.split_col))
+    np.testing.assert_array_equal(np.asarray(a.thr_bin),
+                                  np.asarray(b.thr_bin))
+    np.testing.assert_array_equal(np.asarray(a.bitset),
+                                  np.asarray(b.bitset))
+    np.testing.assert_allclose(np.asarray(a.value),
+                               np.asarray(b.value),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(a.f_final),
+                               np.asarray(b.f_final),
+                               rtol=1e-5, atol=1e-6)
+    if kleaves:
+        np.testing.assert_array_equal(np.asarray(a.child),
+                                      np.asarray(b.child))
