@@ -346,7 +346,8 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
             roff = _rand_offsets(sub, L, C, rlo, rhi, random_mode)
             hist = _shard_histogram(
                 bins, leaf, stats, L, Bd, cfg["block_rows"], cfg["bf16"],
-                fine_map=(rlo, rhi, roff, is_cat, F))
+                fine_map=(rlo, rhi, roff, is_cat, F),
+                pallas=cfg.get("pallas"))
         elif sib and d >= 1:
             hist = _hist_level_with_sibling(bins, leaf, stats, L, B, cfg,
                                             prev_hist, prev_do)
@@ -529,7 +530,8 @@ def build_tree_frontier(bins, stats, slot0, key, is_cat, cfg: Dict,
             roff = _rand_offsets(sub, L, C, rlo, rhi, random_mode)
             hist = _shard_histogram(
                 bins, slot, stats, L, Bd, cfg["block_rows"], cfg["bf16"],
-                fine_map=(rlo, rhi, roff, is_cat, F))
+                fine_map=(rlo, rhi, roff, is_cat, F),
+                pallas=cfg.get("pallas"))
         elif sib and d >= 1 and L == 2 * widths[d - 1]:
             # uncapped transition: children sit at 2*parent+{0,1} in
             # parent order (identity selection), so the dense sibling

@@ -75,6 +75,135 @@ def _hist_kernel(bins_ref, leaf_ref, stats_ref, out_ref, *,
         preferred_element_type=jnp.float32)                  # (C*B1, L*S)
 
 
+def _adaptive_kernel(bins_ref, leaf_ref, stats_ref, lo_ref, hi_ref,
+                     off_ref, cat_ref, out_ref, *, n_leaves: int,
+                     nbins: int, fine_na: int, mm_dtype):
+    """Adaptive variant: fuses the fine-bin -> per-node bucket map
+    (ops/histogram.py map_buckets, same all-integer arithmetic) into the
+    one-hot build.  Grid is (col_groups, row_tiles): each column group
+    owns its own output rows and sweeps all row tiles, accumulating.
+
+    Per-leaf range picks (lo/hi/off)[leaf] ride a one-hot f32 matmul —
+    single nonzero per row, ints < 2**24, exact."""
+    B1 = nbins + 1
+    TR, Cg = bins_ref.shape
+    L = n_leaves
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    leaf = leaf_ref[:, 0]
+    leafhot = (leaf[:, None] ==
+               lax.broadcasted_iota(jnp.int32, (TR, L), 1))
+    lh = leafhot.astype(jnp.float32)
+
+    def pick(tbl_ref):                            # (L, Cg) -> (TR, Cg)
+        # HIGHEST precision: fine-bin ints reach nbins_top_level (1024),
+        # beyond bf16's exact-int range — the pick must not truncate
+        return lax.dot_general(
+            lh, tbl_ref[:].astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            precision=lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32).astype(jnp.int32)
+
+    lo_b, hi_b, o_b = pick(lo_ref), pick(hi_ref), pick(off_ref)
+    bins_blk = bins_ref[:]
+    span = jnp.maximum(hi_b - lo_b + 1, 1)
+    x = jnp.clip(bins_blk - lo_b, 0, span - 1)
+    nb = jnp.clip((x * nbins + o_b) // span, 0, nbins - 1)
+    is_cat_row = cat_ref[0, :] != 0               # (Cg,)
+    out = jnp.where(is_cat_row[None, :],
+                    jnp.minimum(bins_blk, nbins), nb)
+    bucket = jnp.where(bins_blk == fine_na, nbins, out)
+
+    stats = jnp.where(leaf[:, None] >= 0, stats_ref[:], 0.0)
+    a = (leafhot[:, :, None] * stats[:, None, :]).reshape(
+        TR, L * stats.shape[1])
+    binhot = (bucket[:, :, None] ==
+              lax.broadcasted_iota(jnp.int32, (TR, Cg, B1), 2)
+              ).reshape(TR, Cg * B1)
+    out_ref[:] += lax.dot_general(
+        binhot.astype(mm_dtype), a.astype(mm_dtype),
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "n_leaves", "nbins", "fine_na", "bf16", "interpret"))
+def hist_pallas_adaptive(bins, leaf, stats, lo, hi, off, is_cat,
+                         n_leaves: int, nbins: int, fine_na: int,
+                         bf16: bool = False, interpret: bool = False):
+    """(C*(B+1), L*S) adaptive-bucket histogram of one device shard.
+
+    Matches map_buckets + the XLA accumulation exactly.  Columns are
+    processed in groups sized so each group's one-hot tile fits the VMEM
+    budget — the halving schedule's wide top levels (Bd up to
+    nbins_top_level) stream column groups instead of materializing the
+    full (R, C*(Bd+1)) one-hot in HBM."""
+    R, C = bins.shape
+    S = stats.shape[1]
+    B1 = nbins + 1
+    mm_dtype = jnp.bfloat16 if bf16 else jnp.float32
+    itemsize = jnp.dtype(mm_dtype).itemsize
+    # pick (col group, tile rows): group as wide as keeps BOTH a 512-row
+    # one-hot AND the (Cg*B1, L*S) accumulator block within budget,
+    # tiles then as tall as the group allows
+    Cg = max(1, min(C,
+                    _ONEHOT_BYTES // max(512 * B1 * itemsize, 1),
+                    _ONEHOT_BYTES // max(B1 * n_leaves * S * 4, 1)))
+    ncg = -(-C // Cg)
+    cpad = ncg * Cg - C
+    TR = _tile_rows(Cg, B1, mm_dtype)
+    pad = (-R) % TR
+    if cpad:
+        # padded columns carry the fine_na sentinel, so every row maps
+        # to their NA bucket; those output rows are sliced off below
+        bins = jnp.pad(bins, ((0, 0), (0, cpad)),
+                       constant_values=fine_na)
+        lo = jnp.pad(lo, ((0, 0), (0, cpad)))
+        hi = jnp.pad(hi, ((0, 0), (0, cpad)))
+        off = jnp.pad(off, ((0, 0), (0, cpad)))
+        is_cat = jnp.pad(is_cat, (0, cpad))
+    if pad:
+        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        leaf = jnp.pad(leaf, (0, pad), constant_values=-1)
+        stats = jnp.pad(stats, ((0, pad), (0, 0)))
+    n_tiles = (R + pad) // TR
+
+    kernel = functools.partial(
+        _adaptive_kernel, n_leaves=n_leaves, nbins=nbins,
+        fine_na=fine_na, mm_dtype=mm_dtype)
+    out = pl.pallas_call(
+        kernel,
+        grid=(ncg, n_tiles),
+        in_specs=[
+            pl.BlockSpec((TR, Cg), lambda j, i: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TR, 1), lambda j, i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TR, S), lambda j, i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((n_leaves, Cg), lambda j, i: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((n_leaves, Cg), lambda j, i: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((n_leaves, Cg), lambda j, i: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Cg), lambda j, i: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((Cg * B1, n_leaves * S),
+                               lambda j, i: (j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((ncg * Cg * B1, n_leaves * S),
+                                       jnp.float32),
+        interpret=interpret,
+    )(bins, leaf.reshape(-1, 1), stats, lo, hi, off,
+      is_cat.astype(jnp.int32).reshape(1, -1))
+    return out[: C * B1]
+
+
 @functools.partial(jax.jit, static_argnames=(
     "n_leaves", "nbins", "bf16", "interpret"))
 def hist_pallas(bins, leaf, stats, n_leaves: int, nbins: int,

@@ -65,11 +65,17 @@ def _pallas_eligible(C: int, B1: int, n_leaves: int, S: int,
     the env opt-out resolved outside the trace (None = resolve here)."""
     if allowed is None:
         allowed = pallas_env_enabled()
-    if not allowed or fine_map is not None:
+    if not allowed:
         return False
     from h2o_tpu.core.cloud import backend_is_tpu
     if not backend_is_tpu():
         return False
+    if fine_map is not None:
+        # adaptive kernel streams column groups, so width never blocks
+        # it; its leaf-hot tile (rows x L) bounds the live frontier —
+        # the halving schedule's wide-B levels are exactly the small-L
+        # top levels where it matters most
+        return n_leaves <= 128
     from h2o_tpu.ops.hist_pallas import min_tile_fits
     # accumulator block must fit VMEM comfortably AND the kernel's
     # smallest row tile must keep its in-VMEM one-hot under budget
@@ -168,9 +174,15 @@ def histogram_build_traced(bins, leaf, stats, n_leaves: int, nbins: int,
                        out_specs=P(), check_vma=False)
     def run(b_sh, l_sh, s_sh, *rep):
         if use_pallas:
-            from h2o_tpu.ops.hist_pallas import hist_pallas
-            acc = hist_pallas(b_sh, l_sh, s_sh, n_leaves, nbins,
-                              bf16=bf16)
+            if fine_map is None:
+                from h2o_tpu.ops.hist_pallas import hist_pallas
+                acc = hist_pallas(b_sh, l_sh, s_sh, n_leaves, nbins,
+                                  bf16=bf16)
+            else:
+                from h2o_tpu.ops.hist_pallas import hist_pallas_adaptive
+                acc = hist_pallas_adaptive(
+                    b_sh, l_sh, s_sh, rep[0], rep[1], rep[2],
+                    rep[3], n_leaves, nbins, fine_na, bf16=bf16)
             return jax.lax.psum(acc, DATA_AXIS)
         R = b_sh.shape[0]
         blk = min(block_rows, R)
