@@ -185,18 +185,19 @@ def _child_ranges(new_lo, new_hi, s, thr_leaf, is_cat, do_split):
     return lo2, hi2
 
 
-def matmul_route_enabled() -> Optional[bool]:
-    """H2O_TPU_MATMUL_ROUTE: 1 forces the matmul router, 0 the gather
-    router, unset = auto (TPU on / CPU off).  Resolve OUTSIDE jit traces
-    (static arg) like the sibling/pallas flags."""
+def matmul_route_enabled() -> bool:
+    """H2O_TPU_MATMUL_ROUTE: 1/on enables the matmul router, "auto"
+    enables it on TPU backends only, default off until the on-hardware
+    A/B (tools/heal_capture.sh) proves it beats the gather router on the
+    headline config — the driver's end-of-round bench must reproduce the
+    captured engine, not gamble on an unproven one.  Resolve OUTSIDE jit
+    traces (static arg) like the sibling/pallas flags."""
     import os
     v = os.environ.get("H2O_TPU_MATMUL_ROUTE", "").lower()
-    if v in ("1", "on", "true", "yes"):
-        return True
-    if v in ("0", "off", "false", "none", "no", "disable", "disabled"):
-        return False
-    from h2o_tpu.core.cloud import backend_is_tpu
-    return backend_is_tpu()
+    if v == "auto":
+        from h2o_tpu.core.cloud import backend_is_tpu
+        return backend_is_tpu()
+    return v in ("1", "on", "true", "yes")
 
 
 # largest lookup table the matmul router will one-hot over; beyond this

@@ -48,12 +48,15 @@ N_STATS = 4
 
 
 def pallas_env_enabled() -> bool:
-    """H2O_TPU_HIST_PALLAS=0 opts out of the fused kernel.  Resolve this
+    """H2O_TPU_HIST_PALLAS=1 opts INTO the fused kernel (default off
+    until an on-hardware A/B proves it — the kernel is interpret-mode
+    verified but Mosaic-untested while the tunnel is down; a compile
+    failure here would take training down with no fallback).  Resolve
     OUTSIDE jit traces (the engine's train_forest wrapper does) — a value
     read at trace time is baked into the executable cache key's shapes
     and a later env flip would silently not apply."""
     import os
-    return os.environ.get("H2O_TPU_HIST_PALLAS", "1") != "0"
+    return os.environ.get("H2O_TPU_HIST_PALLAS", "0") == "1"
 
 
 def _pallas_eligible(C: int, B1: int, n_leaves: int, S: int,
@@ -62,7 +65,7 @@ def _pallas_eligible(C: int, B1: int, n_leaves: int, S: int,
     TPU backend only (CPU tests keep the portable XLA path), global-grid
     binning only (the adaptive fine_map fuses map_buckets into the XLA
     scan body), and both kernel buffers must fit VMEM.  ``allowed`` is
-    the env opt-out resolved outside the trace (None = resolve here)."""
+    the env OPT-IN resolved outside the trace (None = resolve here)."""
     if allowed is None:
         allowed = pallas_env_enabled()
     if not allowed:
@@ -226,7 +229,7 @@ _histogram_build_jit = jax.jit(
 
 def histogram_build(bins, leaf, stats, n_leaves: int, nbins: int,
                     block_rows: int = 8192, bf16: bool = False):
-    """Public standalone entry: resolves the Pallas opt-out env OUTSIDE
+    """Public standalone entry: resolves the Pallas opt-IN env OUTSIDE
     the trace (it is a static jit arg, so toggling H2O_TPU_HIST_PALLAS
     between calls takes effect instead of hitting a stale executable)."""
     return _histogram_build_jit(bins, leaf, stats, n_leaves=n_leaves,

@@ -83,27 +83,32 @@ def test_adaptive_pallas_matches_map_buckets():
 def test_eligibility_gate():
     import jax
     import os
-    if jax.default_backend() != "tpu":
-        # CPU backend -> ineligible (portable path keeps serving tests)
-        assert not _pallas_eligible(28, 21, 16, 4, None)
-    else:
-        # on TPU the bench shape IS eligible; a wide-feature shape whose
-        # minimum tile overflows VMEM is not
-        assert _pallas_eligible(28, 21, 16, 4, None)
-        assert not _pallas_eligible(200, 65, 16, 4, None)
-        # adaptive: eligible at small frontiers, not at wide ones
-        assert _pallas_eligible(28, 21, 16, 4, object())
-        assert not _pallas_eligible(28, 21, 256, 4, object())
-    os.environ["H2O_TPU_HIST_PALLAS"] = "0"
+    from h2o_tpu.ops.histogram import pallas_env_enabled
+    # the env default is OFF (opt-in until hardware-proven): allowed=None
+    # resolves to disabled whatever the backend.  Pin the env so an
+    # exported H2O_TPU_HIST_PALLAS=1 (the A/B instructions) can't flip
+    # these asserts.
+    saved = os.environ.pop("H2O_TPU_HIST_PALLAS", None)
     try:
+        assert not pallas_env_enabled()
         assert not _pallas_eligible(28, 21, 16, 4, None)
-    finally:
-        del os.environ["H2O_TPU_HIST_PALLAS"]
-    # env opt-out also covers the adaptive kernel (checked above per
-    # backend; here just the off-switch path)
-    import os as _os
-    _os.environ["H2O_TPU_HIST_PALLAS"] = "0"
-    try:
         assert not _pallas_eligible(28, 21, 16, 4, object())
+        os.environ["H2O_TPU_HIST_PALLAS"] = "1"
+        assert pallas_env_enabled()
     finally:
-        del _os.environ["H2O_TPU_HIST_PALLAS"]
+        if saved is None:
+            os.environ.pop("H2O_TPU_HIST_PALLAS", None)
+        else:
+            os.environ["H2O_TPU_HIST_PALLAS"] = saved
+    if jax.default_backend() != "tpu":
+        # CPU backend -> ineligible even when opted in
+        assert not _pallas_eligible(28, 21, 16, 4, None, allowed=True)
+    else:
+        # on TPU the bench shape IS eligible when opted in; a
+        # wide-feature shape whose minimum tile overflows VMEM is not
+        assert _pallas_eligible(28, 21, 16, 4, None, allowed=True)
+        assert not _pallas_eligible(200, 65, 16, 4, None, allowed=True)
+        # adaptive: eligible at small frontiers, not at wide ones
+        assert _pallas_eligible(28, 21, 16, 4, object(), allowed=True)
+        assert not _pallas_eligible(28, 21, 256, 4, object(),
+                                    allowed=True)
