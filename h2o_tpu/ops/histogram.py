@@ -56,7 +56,8 @@ def pallas_env_enabled() -> bool:
     read at trace time is baked into the executable cache key's shapes
     and a later env flip would silently not apply."""
     import os
-    return os.environ.get("H2O_TPU_HIST_PALLAS", "0") == "1"
+    return os.environ.get("H2O_TPU_HIST_PALLAS", "").lower() in (
+        "1", "on", "true", "yes")
 
 
 def _pallas_eligible(C: int, B1: int, n_leaves: int, S: int,
