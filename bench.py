@@ -366,10 +366,10 @@ def _pick_headline(detail):
                       if k != "cpu_reference" and _measured(v)), {}))
 
 
-def _emit_headline(detail):
-    """The ONE shared emit: vs_cpu_reference + headline pick + baseline
-    ratio.  Never raises — the watchdog path relies on this producing a
-    JSON line even with a corrupt baseline file."""
+def headline_payload(detail):
+    """vs_cpu_reference + headline pick + baseline ratio as the contract
+    payload.  Never raises — the watchdog path (and the evidence merge
+    tool) rely on this producing a payload even with corrupt inputs."""
     try:
         try:
             if _measured(detail.get("gbm")) and \
@@ -389,13 +389,17 @@ def _emit_headline(detail):
     except Exception as e:  # noqa: BLE001 — contract line must win
         detail["emit_error"] = repr(e)
         head, vs = {}, 0.0
-    _emit({
+    return {
         "metric": "gbm_higgs_like_train_throughput_steady",
         "value": head.get("value", 0.0),
         "unit": head.get("unit", "rows*trees/sec"),
         "vs_baseline": vs,
         "detail": detail,
-    })
+    }
+
+
+def _emit_headline(detail):
+    _emit(headline_payload(detail))
 
 
 def _vs_baseline(head, detail):

@@ -30,25 +30,26 @@ def _load(path):
         return None
     # evidence files are indented multi-line JSON; per-config stdout
     # files may carry log lines with the JSON contract line last
-    try:
-        return json.loads(txt)
-    except ValueError:
-        pass
-    try:
-        return json.loads(txt.splitlines()[-1])
-    except (ValueError, IndexError):
-        return None
+    for cand in (txt,) + tuple(reversed(txt.splitlines())):
+        try:
+            obj = json.loads(cand)
+        except ValueError:
+            continue
+        if isinstance(obj, dict):
+            return obj
+    return None
 
 
-def main():
+def main(ev_path=None, src_dir="/tmp"):
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    ev_path = os.path.join(root, "BENCH_evidence.json")
+    ev_path = ev_path or os.environ.get(
+        "H2O_TPU_EVIDENCE_PATH",
+        os.path.join(root, "BENCH_evidence.json"))
     ev = _load(ev_path) or {"detail": {}}
     detail = ev.setdefault("detail", {})
 
-    sources = ["/tmp/bench_full.json", "/tmp/bench_gbm.json",
-               "/tmp/bench_hist.json", "/tmp/bench_gbm10m.json",
-               "/tmp/bench_deep.json"]
+    sources = [os.path.join(src_dir, f"bench_{n}.json")
+               for n in ("full", "gbm", "hist", "gbm10m", "deep")]
     for src in sources:
         d = (_load(src) or {}).get("detail") or {}
         for key, val in d.items():
@@ -59,13 +60,15 @@ def main():
                     val.get("value", 0) > cur.get("value", 0):
                 detail[key] = val
         for meta in ("rows", "cols", "platform"):
-            detail.setdefault(meta, d.get(meta))
+            if detail.get(meta) is None and d.get(meta) is not None:
+                detail[meta] = d[meta]
 
     ab = {}
     for mm in (0, 1):
         for hp in (0, 1):
-            cell = _load(f"/tmp/bench_ab_mm{mm}_hp{hp}.json")
-            g = (cell or {}).get("detail", {}).get("gbm")
+            cell = _load(os.path.join(
+                src_dir, f"bench_ab_mm{mm}_hp{hp}.json"))
+            g = ((cell or {}).get("detail") or {}).get("gbm")
             if bench._measured(g):
                 ab[f"mm{mm}_hp{hp}"] = {
                     "value": g["value"], "wall_s": g.get("wall_s"),
@@ -73,21 +76,8 @@ def main():
     if ab:
         detail["engine_flag_ab"] = ab
 
-    if bench._measured(detail.get("gbm")) and \
-            bench._measured(detail.get("cpu_reference")) and \
-            detail["cpu_reference"]["value"]:
-        detail["vs_cpu_reference"] = round(
-            detail["gbm"]["value"] / detail["cpu_reference"]["value"], 3)
-    head = bench._pick_headline(detail)
-    try:
-        vs = bench._vs_baseline(head, detail)
-    except Exception as e:  # noqa: BLE001
-        detail["vs_baseline_error"] = repr(e)
-        vs = 1.0 if head.get("value") else 0.0
-    out = {"metric": "gbm_higgs_like_train_throughput_steady",
-           "value": head.get("value", 0.0),
-           "unit": head.get("unit", "rows*trees/sec"),
-           "vs_baseline": vs, "detail": detail}
+    # ratios + headline via bench's own never-raises helper
+    out = bench.headline_payload(detail)
     with open(ev_path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({k: out[k] for k in ("value", "vs_baseline")}),
