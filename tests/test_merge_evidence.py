@@ -46,6 +46,19 @@ def test_merge_preserves_and_upgrades(tmp_path):
     assert d["engine_flag_ab"]["mm1_hp0"]["value"] == 110.0
 
 
+def test_cpu_references_never_headline(tmp_path):
+    """Both cpu_reference keys are comparison points; an all-TPU-failed
+    evidence file must read 0, not the CPU throughput."""
+    ev = tmp_path / "ev.json"
+    ev.write_text(json.dumps(_ev(
+        gbm={"error": "hang"},
+        cpu_reference={"value": 999.0, "unit": "rows*trees/sec"},
+        cpu_reference_10m={"value": 888.0, "unit": "rows*trees/sec"})))
+    merge_evidence.main(ev_path=str(ev), src_dir=str(tmp_path))
+    out = json.loads(ev.read_text())
+    assert out["value"] == 0.0
+
+
 def test_merge_idempotent_with_no_sources(tmp_path):
     ev = tmp_path / "ev.json"
     original = _ev(gbm={"value": 100.0, "unit": "rows*trees/sec",

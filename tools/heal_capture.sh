@@ -49,9 +49,10 @@ print(float((x @ x).sum()), jax.devices())" >>"$log" 2>&1; then
   fi
 
   retried=0
-  for cfg in gbm hist gbm10m deep; do
+  for cfg in gbm hist gbm10m cpuref10m deep; do
     key=$(echo "$cfg" | sed 's/^hist$/hist_kernel/;
-          s/^gbm10m$/gbm_10m/; s/^deep$/drf_deep20/')
+          s/^gbm10m$/gbm_10m/; s/^cpuref10m$/cpu_reference_10m/;
+          s/^deep$/drf_deep20/')
     if ! measured "$key" /tmp/bench_full.json && \
        ! measured "$key" "/tmp/bench_${cfg}.json" && \
        may_try "retry_$cfg" 2; then

@@ -1,11 +1,13 @@
 #!/usr/bin/env python
 """Merge heal-window captures into BENCH_evidence.json.
 
-Inputs (whatever exists):
-  BENCH_evidence.json            — the committed evidence (first capture)
-  /tmp/bench_full.json           — full-ladder re-run
-  /tmp/bench_{gbm,hist,gbm10m,deep}.json — per-config retries
-  /tmp/bench_ab_mm{0,1}_hp{0,1}.json     — engine-flag A/B cells
+Inputs (whatever exists; evidence path overridable via
+H2O_TPU_EVIDENCE_PATH or main(ev_path=...), source dir via
+main(src_dir=...), default /tmp):
+  BENCH_evidence.json          — the committed evidence (first capture)
+  bench_full.json              — full-ladder re-run
+  bench_{gbm,hist,gbm10m,cpuref10m,deep}.json — per-config retries
+  bench_ab_mm{0,1}_hp{0,1}.json               — engine-flag A/B cells
 
 Per-config rule: a MEASURED result always replaces an error/absent one;
 between two measured results the higher-throughput one wins (same
@@ -49,7 +51,8 @@ def main(ev_path=None, src_dir="/tmp"):
     detail = ev.setdefault("detail", {})
 
     sources = [os.path.join(src_dir, f"bench_{n}.json")
-               for n in ("full", "gbm", "hist", "gbm10m", "deep")]
+               for n in ("full", "gbm", "hist", "gbm10m", "cpuref10m",
+                         "deep")]
     for src in sources:
         d = (_load(src) or {}).get("detail") or {}
         for key, val in d.items():
