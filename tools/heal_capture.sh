@@ -82,6 +82,14 @@ $(tail -c 200 /tmp/bench_${cfg}.json)" >>"$log"
       fi
     done
   done
+  f=/tmp/bench_hist_pallas.json
+  if ! measured hist_kernel "$f" && may_try hist_pallas 2; then
+    ran_ab=1
+    echo "$(date -u) [2/3] hist MFU with the Pallas kernel" >>"$log"
+    H2O_TPU_HIST_PALLAS=1 BENCH_CONFIG=hist BENCH_WATCHDOG_SECS=1200 \
+      python bench.py >"$f" 2>>"$log"
+    echo "$(date -u) hist_pallas rc=$? $(tail -c 300 "$f")" >>"$log"
+  fi
   [ "$ran_ab" = 1 ] && continue
 
   if [ ! -f /tmp/profile_tree.done ] && may_try profiler 2; then

@@ -78,6 +78,10 @@ def main(ev_path=None, src_dir="/tmp"):
                     "wall_with_compile_s": g.get("wall_with_compile_s")}
     if ab:
         detail["engine_flag_ab"] = ab
+    hp = _load(os.path.join(src_dir, "bench_hist_pallas.json"))
+    hk = ((hp or {}).get("detail") or {}).get("hist_kernel")
+    if bench._measured(hk):
+        detail["hist_kernel_pallas"] = hk
 
     # ratios + headline via bench's own never-raises helper
     out = bench.headline_payload(detail)
