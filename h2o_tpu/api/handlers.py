@@ -1242,6 +1242,51 @@ def device_memory_route(params):
     return {"devices": device_memory()}
 
 
+@route("GET", r"/3/Recovery")
+def recovery_list(params):
+    """Pending recovery snapshots, with iteration-checkpoint state
+    (trees/steps done so far) so clients can see HOW FAR a crashed job
+    got before deciding to resume it."""
+    from h2o_tpu.core.recovery import pending_recoveries
+    d = params.get("recovery_dir") or cloud().args.auto_recovery_dir
+    if not d:
+        raise H2OError(400, "recovery_dir required (no auto_recovery_dir "
+                            "configured)")
+    out = []
+    for info in pending_recoveries(d):
+        out.append({
+            "kind": info.get("kind"), "job_id": info.get("job_id"),
+            "dir": info.get("dir"), "started": info.get("started"),
+            "models_done": len(info.get("models") or ()),
+            "has_iteration_checkpoint":
+                bool(info.get("has_iteration_checkpoint")),
+            "iteration": info.get("iteration")})
+    return {"recovery_dir": d, "pending": out}
+
+
+@route("GET", r"/3/Resilience")
+def resilience_stats(params):
+    """Retry/chaos/watchdog observability: cumulative retry counters
+    (core/resilience.py), injected-fault counts (core/chaos.py) and the
+    job watchdog's expiry/eviction totals — the numbers chaos soak
+    tests assert against."""
+    from h2o_tpu.core import resilience
+    from h2o_tpu.core.chaos import chaos
+    jr = cloud().jobs
+    c = chaos()
+    return {
+        "retry": resilience.stats(),
+        "chaos": {"enabled": c.enabled, "injected": c.injected,
+                  "injected_persist": c.injected_persist,
+                  "injected_stalls": c.injected_stalls},
+        "watchdog": {"expired_jobs": jr.expired_count,
+                     "evicted_jobs": jr.evicted_count,
+                     "default_deadline_secs": jr.default_deadline_secs,
+                     "default_stall_secs": jr.default_stall_secs,
+                     "jobs_cap": jr.jobs_cap},
+    }
+
+
 @route("POST", r"/3/Recovery/resume")
 def recovery_resume(params):
     """Asynchronous resume: returns a job key immediately, the recovery

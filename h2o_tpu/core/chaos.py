@@ -10,18 +10,29 @@ the HOST layer the framework owns:
   mid-run (exercises Job FAILED propagation, grid failure collection,
   AutoML skip-and-continue, and Recovery resume);
 - device-put faults: a probability that a host->HBM transfer raises
-  (exercises ingest/training error paths without corrupting state).
+  (exercises ingest/training error paths without corrupting state);
+- persist-I/O faults: byte-store reads/writes raise — either with a
+  probability, or in TRANSIENT mode (fail the first N attempts of each
+  distinct operation, then succeed) so tests prove the retry layer in
+  core/resilience.py actually recovers rather than merely re-raising;
+- stall faults: a job body sleeps without emitting a progress heartbeat,
+  exercising the JobRegistry watchdog (deadline/stall detection).
 
 Enable with ``H2O_TPU_CHAOS_JOB=0.3`` / ``H2O_TPU_CHAOS_DEVICE_PUT=0.1``
-(probabilities) and optional ``H2O_TPU_CHAOS_SEED``; or programmatically
-via ``configure()``.  Off by default; zero overhead when off.
+(probabilities), ``H2O_TPU_CHAOS_PERSIST=0.2`` (probability) or
+``H2O_TPU_CHAOS_PERSIST_TRANSIENT=2`` (fail-N-then-succeed),
+``H2O_TPU_CHAOS_STALL=0.5`` + ``H2O_TPU_CHAOS_STALL_SECS=30`` (stall
+probability and duration), and optional ``H2O_TPU_CHAOS_SEED``; or
+programmatically via ``configure()``.  Off by default; zero overhead
+when off.
 """
 
 from __future__ import annotations
 
 import os
 import threading
-from typing import Optional
+import time
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -34,20 +45,35 @@ class ChaosError(RuntimeError):
     """Injected failure (never raised unless chaos is enabled)."""
 
 
+class ChaosIOError(ChaosError, IOError):
+    """Injected persist-I/O failure.  Also an OSError, so the retry
+    layer classifies it transient — exactly like a real flaky store."""
+
+
 class _Chaos:
     def __init__(self):
-        self.job_p = float(os.environ.get("H2O_TPU_CHAOS_JOB", 0) or 0)
-        self.device_put_p = float(
-            os.environ.get("H2O_TPU_CHAOS_DEVICE_PUT", 0) or 0)
-        seed = os.environ.get("H2O_TPU_CHAOS_SEED")
+        e = os.environ.get
+        self.job_p = float(e("H2O_TPU_CHAOS_JOB", 0) or 0)
+        self.device_put_p = float(e("H2O_TPU_CHAOS_DEVICE_PUT", 0) or 0)
+        self.persist_p = float(e("H2O_TPU_CHAOS_PERSIST", 0) or 0)
+        self.persist_transient = int(
+            e("H2O_TPU_CHAOS_PERSIST_TRANSIENT", 0) or 0)
+        self.stall_p = float(e("H2O_TPU_CHAOS_STALL", 0) or 0)
+        self.stall_secs = float(e("H2O_TPU_CHAOS_STALL_SECS", 30) or 30)
+        seed = e("H2O_TPU_CHAOS_SEED")
         self._rng = np.random.default_rng(
             int(seed) if seed is not None else None)
         self._lock = threading.Lock()
+        self._transient_seen: Dict[Tuple[str, str], int] = {}
         self.injected = 0
+        self.injected_persist = 0
+        self.injected_stalls = 0
 
     @property
     def enabled(self) -> bool:
-        return self.job_p > 0 or self.device_put_p > 0
+        return (self.job_p > 0 or self.device_put_p > 0 or
+                self.persist_p > 0 or self.persist_transient > 0 or
+                self.stall_p > 0)
 
     def _roll(self, p: float) -> bool:
         if p <= 0:
@@ -68,6 +94,42 @@ class _Chaos:
             log.warning("chaos: injecting device_put failure")
             raise ChaosError("injected device_put fault")
 
+    def maybe_fail_persist(self, op: str, uri: str) -> None:
+        """Persist-I/O injector: called once per ATTEMPT by the byte-store
+        layer, so transient mode deterministically fails the first N
+        attempts of each distinct (op, uri) and then lets it through —
+        the retry loop must absorb exactly N faults to succeed."""
+        if self.persist_transient > 0:
+            k = (op, uri)
+            with self._lock:
+                n = self._transient_seen.get(k, 0)
+                if n < self.persist_transient:
+                    self._transient_seen[k] = n + 1
+                    self.injected += 1
+                    self.injected_persist += 1
+                else:
+                    return
+            log.warning("chaos: transient persist fault %d/%d (%s %s)",
+                        n + 1, self.persist_transient, op, uri)
+            raise ChaosIOError(
+                f"injected transient persist fault {n + 1}/"
+                f"{self.persist_transient} ({op} {uri})")
+        if self._roll(self.persist_p):
+            with self._lock:
+                self.injected_persist += 1
+            log.warning("chaos: injecting persist failure (%s %s)", op, uri)
+            raise ChaosIOError(f"injected persist fault ({op} {uri})")
+
+    def maybe_stall(self, what: str) -> None:
+        """Stall injector: sleep without a progress heartbeat — the job
+        watchdog (core/job.py) must detect and expire the job."""
+        if self._roll(self.stall_p):
+            with self._lock:
+                self.injected_stalls += 1
+            log.warning("chaos: stalling %s for %.1fs", what,
+                        self.stall_secs)
+            time.sleep(self.stall_secs)
+
 
 _instance: Optional[_Chaos] = None
 
@@ -80,12 +142,18 @@ def chaos() -> _Chaos:
 
 
 def configure(job_p: float = 0.0, device_put_p: float = 0.0,
-              seed: Optional[int] = None) -> _Chaos:
+              seed: Optional[int] = None, persist_p: float = 0.0,
+              persist_transient: int = 0, stall_p: float = 0.0,
+              stall_secs: float = 30.0) -> _Chaos:
     """Programmatic enable (tests); returns the active instance."""
     global _instance
     _instance = _Chaos()
     _instance.job_p = float(job_p)
     _instance.device_put_p = float(device_put_p)
+    _instance.persist_p = float(persist_p)
+    _instance.persist_transient = int(persist_transient)
+    _instance.stall_p = float(stall_p)
+    _instance.stall_secs = float(stall_secs)
     if seed is not None:
         _instance._rng = np.random.default_rng(seed)
     return _instance

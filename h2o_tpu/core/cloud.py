@@ -38,6 +38,19 @@ MODEL_AXIS = "model"
 _cache_enabled = False
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` across jax versions: the top-level spelling
+    (with ``check_vma``) when present, else the 0.4.x experimental one
+    (whose equivalent flag is ``check_rep``).  Every shard_map in the
+    codebase goes through here so a jax upgrade is a one-line change."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
 def backend_is_tpu() -> bool:
     """Guarded default-backend probe (False when no backend can
     initialize) — shared by trace-time TPU-only gates."""
@@ -108,7 +121,11 @@ class Cloud:
         from h2o_tpu.core.store import DKV
         from h2o_tpu.core.job import JobRegistry
         self.dkv = DKV()
-        self.jobs = JobRegistry()
+        self.jobs = JobRegistry(
+            default_deadline_secs=args.job_deadline_secs,
+            default_stall_secs=args.job_stall_secs,
+            watchdog_interval=args.watchdog_interval_secs,
+            jobs_cap=args.jobs_cap)
         self.session_counter = 0
         if args.hbm_budget:
             from h2o_tpu.core.memory import set_budget

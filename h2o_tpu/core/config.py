@@ -4,6 +4,21 @@ TPU-native analog of H2O's single ``OptArgs`` POJO parsed from argv with an
 ``ai.h2o.*`` system-property overlay (reference: water/H2O.java:233-466,
 2355-2366).  Here flags come from constructor kwargs with an ``H2O_TPU_*``
 environment-variable overlay, and the parsed config seeds the Cloud singleton.
+
+Resilience knobs NOT held on OptArgs (read directly from env by their
+owning modules, like the chaos flags, so they work before a cloud boots):
+
+- retry policy (core/resilience.py, applied to every persist byte-store
+  op and recovery checkpoint write):
+  ``H2O_TPU_RETRY_MAX_ATTEMPTS`` (4), ``H2O_TPU_RETRY_BASE_DELAY``
+  (0.05 s), ``H2O_TPU_RETRY_MAX_DELAY`` (2 s),
+  ``H2O_TPU_RETRY_TOTAL_DEADLINE`` (60 s across attempts; 0 = none);
+- fault injection (core/chaos.py): ``H2O_TPU_CHAOS_JOB``,
+  ``H2O_TPU_CHAOS_DEVICE_PUT``, ``H2O_TPU_CHAOS_PERSIST``
+  (probabilities), ``H2O_TPU_CHAOS_PERSIST_TRANSIENT`` (fail the first
+  N attempts of each persist op, then succeed),
+  ``H2O_TPU_CHAOS_STALL`` + ``H2O_TPU_CHAOS_STALL_SECS`` (job-stall
+  injector for the watchdog), ``H2O_TPU_CHAOS_SEED``.
 """
 
 from __future__ import annotations
@@ -68,6 +83,16 @@ class OptArgs:
     # -client mode: join the control plane without homing data
     # (water/H2O.java:391-394); client nodes never shard frame rows
     client: bool = False
+    # job deadlines + watchdog (core/job.py): default wall-clock budget
+    # per job (0 = unbounded; jobs may override per-instance) and the
+    # stall window — a RUNNING job with no update() heartbeat for this
+    # long is expired FAILED(TimeoutError) and its pool slot reclaimed
+    job_deadline_secs: float = 0.0
+    job_stall_secs: float = 0.0
+    # watchdog scan period
+    watchdog_interval_secs: float = 0.5
+    # registry bound: terminal jobs past this count are LRU-evicted
+    jobs_cap: int = 512
 
     @classmethod
     def from_env(cls, **overrides) -> "OptArgs":
@@ -84,8 +109,10 @@ class OptArgs:
 
 def _cast_for(tp) -> type:
     tp = str(tp)
-    if "int" in tp:
-        return int
     if "bool" in tp:
         return bool
+    if "float" in tp:
+        return float
+    if "int" in tp:
+        return int
     return str

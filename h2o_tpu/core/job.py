@@ -4,6 +4,18 @@ Jobs run on a host thread pool (the FJ-pool analog for *control* work — the
 actual compute is dispatched to the TPU mesh inside the job body).  Progress,
 cancellation, exception propagation, and DKV visibility match the reference's
 Job<T> semantics.
+
+Resilience (core/resilience.py):
+- per-job DEADLINES: a job may declare ``deadline_secs`` (or inherit the
+  registry default); a watchdog thread expires jobs that outlive it,
+  marking them FAILED with a ``TimeoutError`` and reclaiming the pool
+  slot so later jobs are never starved behind a hang;
+- STALL detection: ``update()`` doubles as a progress heartbeat; a job
+  with no heartbeat inside its ``stall_secs`` window is expired the same
+  way (the reference's analogous guard is the client-disconnect
+  watchdog, water/Job.java cancel plumbing);
+- bounded registry: terminal jobs past ``jobs_cap`` are LRU-evicted so a
+  long-lived server doesn't leak one entry per job.
 """
 
 from __future__ import annotations
@@ -24,6 +36,7 @@ RUNNING = "RUNNING"
 DONE = "DONE"
 CANCELLED = "CANCELLED"
 FAILED = "FAILED"
+TERMINAL = (DONE, CANCELLED, FAILED)
 
 
 class JobCancelledException(Exception):
@@ -40,7 +53,9 @@ class Job:
 
     def __init__(self, dest: Optional[str] = None, description: str = "",
                  dest_type: str = "Key<Frame>",
-                 priority: int = USER_PRIORITY):
+                 priority: int = USER_PRIORITY,
+                 deadline_secs: Optional[float] = None,
+                 stall_secs: Optional[float] = None):
         self.priority = int(priority)
         self.key = Key.make("job")
         self.dest = Key(dest) if dest else Key.make("result")
@@ -53,16 +68,26 @@ class Job:
         self.exception: Optional[BaseException] = None
         self.start_time = 0.0
         self.end_time = 0.0
+        # None = inherit the registry default; 0 = explicitly unbounded
+        self.deadline_secs = deadline_secs
+        self.stall_secs = stall_secs
+        self.last_progress = 0.0
+        self._timed_out = False
         self._cancel_requested = threading.Event()
         self._done = threading.Event()
+        # serializes the terminal transition between the worker thread
+        # and the watchdog (core/job.py JobRegistry._expire)
+        self._state_lock = threading.Lock()
         self.result: Any = None
 
     # -- body-side API ------------------------------------------------------
 
     def update(self, progress: float, msg: str = "") -> None:
-        """Called from inside the job body; raises if cancel was requested
+        """Called from inside the job body; doubles as the watchdog's
+        progress heartbeat and raises if cancel was requested
         (cooperative cancellation, like the reference's Job.stop_requested)."""
         self.progress = float(progress)
+        self.last_progress = time.time()
         if msg:
             self.progress_msg = msg
         if self._cancel_requested.is_set():
@@ -88,7 +113,21 @@ class Job:
         if not self._done.wait(timeout):
             raise TimeoutError(f"job {self.key} still running")
         if self.status == FAILED:
-            raise self.exception
+            exc = self.exception
+            if exc is None:
+                # defensive: a FAILED job must carry its cause; surface
+                # the inconsistency instead of raising TypeError(None)
+                raise RuntimeError(
+                    f"job {self.key} FAILED with no recorded exception")
+            # Re-raise a same-type clone CHAINED from the original, so the
+            # worker-thread traceback survives intact on the cause instead
+            # of being mutated by every joiner re-raising the shared
+            # exception object.
+            try:
+                clone = type(exc)(*exc.args)
+            except Exception:        # exotic ctor signature — raise as-is
+                raise exc
+            raise clone from exc
         if self.status == CANCELLED:
             raise JobCancelledException(self.description)
         return self.result
@@ -121,7 +160,35 @@ class Job:
             "stacktrace": None,
             "ready_for_view": self.status == "DONE",
             "auto_recoverable": False,
+            # resilience surface (deadline/watchdog state)
+            "deadline_secs": self.deadline_secs,
+            "stall_secs": self.stall_secs,
+            "last_progress": ms(self.last_progress),
+            "timed_out": self._timed_out,
         }
+
+
+def _grow_pool(pool: ThreadPoolExecutor) -> bool:
+    """Add one worker slot (CPython internals; a watchdog-expired job's
+    thread may still be wedged in its body, so the registry compensates
+    to keep the configured concurrency available)."""
+    try:
+        with pool._shutdown_lock:
+            pool._max_workers += 1
+            pool._adjust_thread_count()
+        return True
+    except Exception:  # noqa: BLE001 — best-effort on non-CPython
+        return False
+
+
+def _shrink_pool(pool: ThreadPoolExecutor) -> None:
+    """Give back a compensated slot once the wedged thread finally exits."""
+    try:
+        with pool._shutdown_lock:
+            if pool._max_workers > 1:
+                pool._max_workers -= 1
+    except Exception:  # noqa: BLE001
+        pass
 
 
 class JobRegistry:
@@ -130,19 +197,108 @@ class JobRegistry:
     pool; jobs at SYSTEM_PRIORITY and above run on a reserved pool so
     control work (recovery resume, exports, admin) is never starved
     behind long model builds — the same non-starvation invariant the
-    reference's leveled ForkJoin pools provide."""
+    reference's leveled ForkJoin pools provide.
 
-    def __init__(self, max_workers: int = 8, system_workers: int = 2):
+    A daemon watchdog enforces per-job deadlines and stall windows (see
+    the module docstring); ``jobs_cap`` bounds the registry by LRU-
+    evicting terminal jobs (REST /3/Jobs simply stops listing them, the
+    same observable behavior as the reference's expiring job keys).
+    """
+
+    def __init__(self, max_workers: int = 8, system_workers: int = 2,
+                 default_deadline_secs: float = 0.0,
+                 default_stall_secs: float = 0.0,
+                 watchdog_interval: float = 0.5,
+                 jobs_cap: int = 512):
         self._jobs: Dict[Key, Job] = {}
         self._pool = ThreadPoolExecutor(max_workers=max_workers,
                                         thread_name_prefix="h2o-job")
         self._sys_pool = ThreadPoolExecutor(
             max_workers=system_workers, thread_name_prefix="h2o-sysjob")
         self._lock = threading.Lock()
+        self.default_deadline_secs = float(default_deadline_secs)
+        self.default_stall_secs = float(default_stall_secs)
+        self.watchdog_interval = float(watchdog_interval)
+        self.jobs_cap = int(jobs_cap)
+        self.expired_count = 0
+        self.evicted_count = 0
+        self._watchdog: Optional[threading.Thread] = None
+
+    # -- watchdog -----------------------------------------------------------
+
+    def _ensure_watchdog(self) -> None:
+        if self._watchdog is not None and self._watchdog.is_alive():
+            return
+        t = threading.Thread(target=self._watch, daemon=True,
+                             name="h2o-job-watchdog")
+        self._watchdog = t
+        t.start()
+
+    def _watch(self) -> None:
+        while True:
+            time.sleep(self.watchdog_interval)
+            now = time.time()
+            for job in self.list():
+                if job.status != RUNNING or job._timed_out:
+                    continue
+                dl = job.deadline_secs if job.deadline_secs is not None \
+                    else self.default_deadline_secs
+                if dl and now - job.start_time > dl:
+                    self._expire(job, f"deadline of {dl:g}s exceeded")
+                    continue
+                stall = job.stall_secs if job.stall_secs is not None \
+                    else self.default_stall_secs
+                beat = job.last_progress or job.start_time
+                if stall and now - beat > stall:
+                    self._expire(job, "no progress heartbeat for "
+                                      f"{stall:g}s (stall window)")
+
+    def _expire(self, job: Job, why: str) -> None:
+        """Watchdog-side terminal transition: FAILED + TimeoutError, done
+        event set (joiners unblock NOW), cooperative cancel requested so
+        the body exits at its next update(), and the pool compensated in
+        case the body never does."""
+        with job._state_lock:
+            if job.status in TERMINAL:
+                return
+            log.warning("watchdog: expiring job %s (%s): %s", job.key,
+                        job.description, why)
+            job._timed_out = True
+            job.exception = TimeoutError(
+                f"job {job.key} ({job.description}): {why}")
+            job.cancel()
+            job.status = FAILED
+            job.end_time = time.time()
+            self.expired_count += 1
+            job._done.set()
+        pool = self._sys_pool if job.priority >= Job.SYSTEM_PRIORITY \
+            else self._pool
+        if _grow_pool(pool):
+            job._compensated_pool = pool
+
+    # -- registry bound -----------------------------------------------------
+
+    def _evict_terminal(self) -> None:
+        """LRU-evict terminal jobs past jobs_cap (oldest end_time first);
+        live jobs are never evicted."""
+        with self._lock:
+            over = len(self._jobs) - self.jobs_cap
+            if over <= 0:
+                return
+            dead = sorted((j for j in self._jobs.values()
+                           if j.status in TERMINAL),
+                          key=lambda j: j.end_time)
+            for j in dead[:over]:
+                del self._jobs[j.key]
+                self.evicted_count += 1
+
+    # -- scheduling ---------------------------------------------------------
 
     def start(self, job: Job, body: Callable[[Job], Any]) -> Job:
         with self._lock:
             self._jobs[job.key] = job
+        self._evict_terminal()
+        self._ensure_watchdog()
 
         def run():
             from h2o_tpu.core.diag import TimeLine
@@ -150,22 +306,36 @@ class JobRegistry:
                             description=job.description)
             job.status = RUNNING
             job.start_time = time.time()
+            job.last_progress = job.start_time
             try:
                 from h2o_tpu.core.chaos import chaos
                 if chaos().enabled:
                     chaos().maybe_fail_job(job.description)
-                job.result = body(job)
-                job.status = DONE
-                job.progress = 1.0
+                    chaos().maybe_stall(job.description)
+                result = body(job)
+                with job._state_lock:
+                    if not job._timed_out:
+                        job.result = result
+                        job.status = DONE
+                        job.progress = 1.0
             except JobCancelledException:
-                job.status = CANCELLED
+                with job._state_lock:
+                    if not job._timed_out:
+                        job.status = CANCELLED
             except BaseException as e:  # noqa: BLE001 — propagate to joiner
-                job.status = FAILED
-                job.exception = e
+                with job._state_lock:
+                    if not job._timed_out:
+                        job.status = FAILED
+                        job.exception = e
                 log.error("job %s failed: %s\n%s", job.key, e,
                           traceback.format_exc())
             finally:
-                job.end_time = time.time()
+                with job._state_lock:
+                    if not job._timed_out:
+                        job.end_time = time.time()
+                pool = getattr(job, "_compensated_pool", None)
+                if pool is not None:
+                    _shrink_pool(pool)
                 TimeLine.record("job", "end", key=str(job.key),
                                 status=job.status)
                 job._done.set()

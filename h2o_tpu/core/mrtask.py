@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from h2o_tpu.core.cloud import DATA_AXIS, cloud
+from h2o_tpu.core.cloud import DATA_AXIS, cloud, shard_map_compat
 from h2o_tpu.core.frame import Frame
 
 REDUCERS = {
@@ -52,7 +52,7 @@ def map_reduce(map_fn: Callable, *arrays: jax.Array, reduce: str = "sum",
     in_specs = tuple(P(DATA_AXIS, *([None] * (a.ndim - 1))) for a in arrays)
     in_specs += tuple(P() for _ in extra_args)
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map_compat, mesh=mesh,
                        in_specs=in_specs, out_specs=P(),
                        check_vma=False)
     def run(*xs):

@@ -61,7 +61,13 @@ def _http_read(uri: str) -> bytes:
         return r.read()
 
 
-def read_bytes(uri: str) -> bytes:
+def _read_once(uri: str) -> bytes:
+    """One read attempt (the retry wrapper in read_bytes re-invokes this,
+    so the chaos hook fires per ATTEMPT — transient injection proves the
+    retries recover)."""
+    from h2o_tpu.core.chaos import chaos
+    if chaos().enabled:
+        chaos().maybe_fail_persist("read", uri)
     scheme, rest = _split(uri)
     if scheme in _SCHEMES:
         return _SCHEMES[scheme]["read"](uri)
@@ -81,7 +87,19 @@ def read_bytes(uri: str) -> bytes:
         "h2o_tpu.core.persist.register_scheme")
 
 
-def write_bytes(uri: str, data: bytes) -> None:
+def read_bytes(uri: str) -> bytes:
+    """Read a blob, retrying transient faults (network hiccups, flaky
+    stores) per the process RetryPolicy — permanent errors (missing
+    file, unknown scheme) raise immediately."""
+    from h2o_tpu.core.resilience import default_policy
+    return default_policy().call(_read_once, uri,
+                                 what=f"persist read {uri}")
+
+
+def _write_once(uri: str, data: bytes) -> None:
+    from h2o_tpu.core.chaos import chaos
+    if chaos().enabled:
+        chaos().maybe_fail_persist("write", uri)
     scheme, rest = _split(uri)
     if scheme in _SCHEMES:
         _SCHEMES[scheme]["write"](uri, data)
@@ -105,6 +123,15 @@ def write_bytes(uri: str, data: bytes) -> None:
     raise NotImplementedError(
         f"no persist backend for scheme '{scheme}' — register one with "
         "h2o_tpu.core.persist.register_scheme")
+
+
+def write_bytes(uri: str, data: bytes) -> None:
+    """Write a blob with the same retry envelope as read_bytes.  Scheme
+    writers must be idempotent (whole-object PUT semantics — true for
+    every built-in backend), so a retried partial write converges."""
+    from h2o_tpu.core.resilience import default_policy
+    default_policy().call(_write_once, uri, data,
+                          what=f"persist write {uri}")
 
 
 # -- frame snapshots (FramePersist) -----------------------------------------

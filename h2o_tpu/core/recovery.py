@@ -9,12 +9,22 @@ the newest snapshot and resumes the job where it stopped (REST
 itself cannot survive member loss (Paxos locks membership) — recovery is
 deliberately job-level, and the TPU runtime has the same fixed-mesh
 constraint (SURVEY §5.3), so the design carries over unchanged.
+
+Beyond the reference's whole-model granularity, snapshots carry
+ITERATION-level checkpoints (``save_iteration``/``load_iteration``): the
+tree driver saves per-block forest state (models/tree/driver.py), GLM its
+IRLSM beta per iteration, DeepLearning its params/optimizer per block —
+so ``auto_recover`` resumes a single model MID-BUILD instead of losing
+the whole forest to a crash.  Checkpoint I/O goes through the retry
+layer (core/resilience.py) and the chaos persist injector, like every
+other persist path.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import pickle
 import shutil
 import time
 from typing import Any, Dict, List, Optional
@@ -61,6 +71,70 @@ class Recovery:
         """Mark complete and clean up (reference deletes the snapshot)."""
         shutil.rmtree(self.dir, ignore_errors=True)
 
+    # -- iteration checkpoints (mid-build resume) ----------------------------
+
+    def save_iteration(self, payload: Dict[str, Any],
+                       meta: Optional[Dict] = None) -> None:
+        """Atomically checkpoint in-progress builder state.
+
+        ``payload`` is an arbitrary pickleable dict (np arrays welcome);
+        ``meta`` is a SMALL json summary written alongside so discovery
+        (pending_recoveries, GET /3/Recovery) can report checkpoint
+        progress without deserializing the full payload.  Writes are
+        retried like any persist op, with the chaos injector live."""
+        from h2o_tpu.core.resilience import default_policy
+
+        def write():
+            from h2o_tpu.core.chaos import chaos
+            if chaos().enabled:
+                chaos().maybe_fail_persist(
+                    "write", os.path.join(self.dir, "iter.pkl"))
+            tmp = os.path.join(self.dir, "iter.pkl.tmp")
+            with open(tmp, "wb") as f:
+                pickle.dump(payload, f)
+            os.replace(tmp, os.path.join(self.dir, "iter.pkl"))
+            m = dict(meta or {})
+            m["saved_at"] = time.time()
+            tmp_m = os.path.join(self.dir, "iter.json.tmp")
+            with open(tmp_m, "w") as f:
+                json.dump(m, f)
+            os.replace(tmp_m, os.path.join(self.dir, "iter.json"))
+
+        default_policy().call(
+            write, what=f"iteration checkpoint {self.dir}")
+
+    def load_iteration(self) -> Optional[Dict[str, Any]]:
+        """The last iteration checkpoint, or None (no checkpoint yet /
+        unreadable — a torn write loses the increment, never the job)."""
+        p = os.path.join(self.dir, "iter.pkl")
+        if not os.path.exists(p):
+            return None
+        try:
+            with open(p, "rb") as f:
+                return pickle.load(f)
+        except Exception as e:  # noqa: BLE001 — corrupt checkpoint
+            log.warning("unreadable iteration checkpoint %s (%r) — "
+                        "resuming from the previous boundary", p, e)
+            return None
+
+    def iteration_meta(self) -> Optional[Dict[str, Any]]:
+        """The small json summary of the last checkpoint (cheap)."""
+        p = os.path.join(self.dir, "iter.json")
+        if not os.path.exists(p):
+            return None
+        try:
+            with open(p) as f:
+                return json.load(f)
+        except (json.JSONDecodeError, OSError):
+            return None
+
+    def clear_iteration(self) -> None:
+        for n in ("iter.pkl", "iter.json"):
+            try:
+                os.remove(os.path.join(self.dir, n))
+            except OSError:
+                pass
+
     # -- reading (auto-recover on restart) ----------------------------------
 
     def _info(self) -> Dict:
@@ -68,10 +142,19 @@ class Recovery:
             return json.load(f)
 
     def _write_info(self, info: Dict) -> None:
-        tmp = os.path.join(self.dir, "info.json.tmp")
-        with open(tmp, "w") as f:
-            json.dump(info, f)
-        os.replace(tmp, os.path.join(self.dir, "info.json"))
+        from h2o_tpu.core.resilience import default_policy
+
+        def write():
+            from h2o_tpu.core.chaos import chaos
+            if chaos().enabled:
+                chaos().maybe_fail_persist(
+                    "write", os.path.join(self.dir, "info.json"))
+            tmp = os.path.join(self.dir, "info.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump(info, f)
+            os.replace(tmp, os.path.join(self.dir, "info.json"))
+
+        default_policy().call(write, what=f"recovery info {self.dir}")
 
 
 def _jsonable(params: Dict) -> Dict:
@@ -86,28 +169,69 @@ def _jsonable(params: Dict) -> Dict:
 
 
 def pending_recoveries(recovery_dir: str) -> List[Dict]:
-    """Unfinished snapshots in the recovery dir (newest first)."""
+    """Unfinished snapshots in the recovery dir (newest first).
+
+    A truncated/corrupt ``info.json`` (torn write at crash time) is
+    SKIPPED with a warning — one bad snapshot must never abort discovery
+    of every other recoverable job."""
     out = []
     if not os.path.isdir(recovery_dir):
         return out
     for d in os.listdir(recovery_dir):
         info_p = os.path.join(recovery_dir, d, "info.json")
-        if os.path.exists(info_p):
+        if not os.path.exists(info_p):
+            continue
+        try:
             with open(info_p) as f:
                 info = json.load(f)
-            if not info.get("done"):
-                info["dir"] = os.path.join(recovery_dir, d)
-                out.append(info)
+        except (json.JSONDecodeError, OSError) as e:
+            log.warning("skipping unreadable recovery snapshot %s (%r)",
+                        info_p, e)
+            continue
+        if not isinstance(info, dict):
+            log.warning("skipping malformed recovery snapshot %s", info_p)
+            continue
+        if not info.get("done"):
+            info["dir"] = os.path.join(recovery_dir, d)
+            # cheap checkpoint summary for /3/Recovery + auto_recover
+            iter_p = os.path.join(recovery_dir, d, "iter.json")
+            info["has_iteration_checkpoint"] = os.path.exists(
+                os.path.join(recovery_dir, d, "iter.pkl"))
+            if os.path.exists(iter_p):
+                try:
+                    with open(iter_p) as f:
+                        info["iteration"] = json.load(f)
+                except (json.JSONDecodeError, OSError):
+                    pass
+            out.append(info)
     out.sort(key=lambda i: -i.get("started", 0))
     return out
 
 
+def _resume_model(info: Dict, train: Frame):
+    """Resume ONE interrupted single-model build from its snapshot: the
+    builder re-attaches to the snapshot dir and its algo driver picks up
+    from the iteration checkpoint (mid-forest / mid-IRLSM / mid-epoch)."""
+    from h2o_tpu.models.registry import builder_class
+    extra = info["extra"]
+    cls = builder_class(extra["algo"])
+    allowed = cls().default_params()
+    params = {k: v for k, v in (info.get("params") or {}).items()
+              if k in allowed}
+    params["recovery_dir"] = os.path.dirname(info["dir"])
+    b = cls(model_id=info["job_id"], **params)
+    b._recovery_resuming = True
+    return b.train(x=extra.get("x"), y=extra.get("y"),
+                   training_frame=train)
+
+
 def auto_recover(recovery_dir: str) -> List[Any]:
-    """Resume every unfinished Grid job found in ``recovery_dir`` (the
+    """Resume every unfinished job found in ``recovery_dir`` (the
     Recovery.autoRecover / POST /3/Recovery/resume path).
 
-    Completed models are reloaded into the DKV; only the REMAINING hyper
-    combos are trained.  Returns the resumed result objects.
+    Grid jobs reload completed models into the DKV and train only the
+    REMAINING hyper combos; single-model jobs resume MID-BUILD from
+    their iteration checkpoint.  Returns the resumed result objects.
     """
     from h2o_tpu.core.cloud import cloud
     from h2o_tpu.models.model import Model
@@ -115,11 +239,13 @@ def auto_recover(recovery_dir: str) -> List[Any]:
     results = []
     for info in pending_recoveries(recovery_dir):
         kind = info["kind"]
-        log.info("auto-recovering %s job %s (%d models already done)",
-                 kind, info["job_id"], len(info["models"]))
+        log.info("auto-recovering %s job %s (%d models already done%s)",
+                 kind, info["job_id"], len(info.get("models") or ()),
+                 ", iteration checkpoint present"
+                 if info.get("has_iteration_checkpoint") else "")
         train = persist.load_frame(os.path.join(info["dir"], "train"))
         done_models = []
-        for m in info["models"]:
+        for m in info.get("models") or ():
             mdl = Model.load(m["path"])
             cloud().dkv.put(mdl.key, mdl)
             done_models.append(mdl)
@@ -127,6 +253,8 @@ def auto_recover(recovery_dir: str) -> List[Any]:
             from h2o_tpu.models.grid import GridSearch
             results.append(GridSearch.resume_from_recovery(
                 info, train, done_models))
+        elif kind == "model":
+            results.append(_resume_model(info, train))
         else:
             log.warning("unknown recoverable kind %r", kind)
     return results

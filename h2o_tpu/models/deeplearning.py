@@ -396,6 +396,22 @@ class DeepLearning(ModelBuilder):
         block = min(steps, 200)
         loss = None
         done = 0
+        # iteration-level fault tolerance (core/recovery.py): resume a
+        # crashed run from the last per-block checkpoint — params,
+        # optimizer state and the RNG key continue exactly
+        rec = getattr(self, "_recovery", None)
+        if rec is not None:
+            st = rec.load_iteration()
+            if st and st.get("kind") == "dl" and \
+                    st.get("steps") == steps and st.get("sizes") == sizes:
+                done = int(st["done"])
+                params = jax.tree.map(jnp.asarray, st["params"])
+                opt_state = jax.tree.map(jnp.asarray, st["opt"])
+                key = jax.random.wrap_key_data(jnp.asarray(st["key"]))
+                if p.get("model_parallel"):
+                    params = shard_params_tp(params, cloud().mesh)
+                job.update(done / steps,
+                           f"resumed at step {done}/{steps}")
         common_kw = dict(
             activation=activation, nclass=nclass, dist_name=dist_name,
             batch=batch, nrows=nrows, adaptive=adaptive,
@@ -419,6 +435,14 @@ class DeepLearning(ModelBuilder):
             done += n
             job.update(done / steps, f"step {done}/{steps} "
                                      f"loss={float(loss):.4f}")
+            if rec is not None:
+                rec.save_iteration(
+                    {"kind": "dl", "steps": steps, "sizes": sizes,
+                     "done": done,
+                     "params": jax.tree.map(np.asarray, params),
+                     "opt": jax.tree.map(np.asarray, opt_state),
+                     "key": np.asarray(jax.random.key_data(key))},
+                    meta={"kind": "dl", "step": done, "steps": steps})
 
         out = dict(
             x=list(di.x), expansion_spec=expansion_spec(di),

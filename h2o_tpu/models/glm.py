@@ -873,6 +873,18 @@ class GLM(ModelBuilder):
         hi = jnp.asarray(hi) if hi is not None else None
         dev_prev, dev = None, None
         self._last_iters = 0
+        # iteration-level fault tolerance (core/recovery.py): resume a
+        # crashed solve from the last checkpointed beta at this lambda
+        # (the warm start converges to the same optimum)
+        rec = getattr(self, "_recovery", None)
+        if rec is not None:
+            st = rec.load_iteration()
+            if st and st.get("kind") == "glm" and \
+                    st["beta"].shape == np.asarray(beta).shape and \
+                    np.isclose(st.get("lam", -1.0), float(lam),
+                               rtol=1e-12, atol=0.0):
+                beta = jnp.asarray(st["beta"])
+                first_pass = None      # stale for the restored beta
         for it in range(max_iter):
             if it == 0 and first_pass is not None:
                 G, q, dev = first_pass
@@ -894,6 +906,12 @@ class GLM(ModelBuilder):
                 beta_new = _chol_solve(G, q, l2)
             delta = float(jnp.max(jnp.abs(beta_new - beta)))
             beta = beta_new
+            if rec is not None:
+                rec.save_iteration(
+                    {"kind": "glm", "lam": float(lam),
+                     "beta": np.asarray(beta), "it": it},
+                    meta={"kind": "glm-irlsm", "iteration": it,
+                          "lambda": float(lam)})
             if dev_prev is not None and fam_name == "gaussian":
                 break  # gaussian converges in one weighted solve
             if delta < float(p["beta_epsilon"]):
@@ -925,10 +943,27 @@ class GLM(ModelBuilder):
         vg = _glm_objective_fn(
             X, yv, w, valid_m, fam_name, p["tweedie_power"], theta, l2,
             pen=jnp.asarray(pen) if pen is not None else None)
+        # resume/checkpoint per lambda solve (coarser than IRLSM's
+        # per-iteration cadence: the L-BFGS two-loop state is not worth
+        # snapshotting, a warm-started beta reconverges immediately)
+        rec = getattr(self, "_recovery", None)
+        if rec is not None:
+            st = rec.load_iteration()
+            if st and st.get("kind") == "glm" and \
+                    st["beta"].shape == np.asarray(beta).shape and \
+                    np.isclose(st.get("lam", -1.0), float(lam),
+                               rtol=1e-12, atol=0.0):
+                beta = jnp.asarray(st["beta"])
         beta_np, _f, iters = _lbfgs_minimize(
             vg, np.asarray(beta, np.float64), max_iter,
             gtol=float(p.get("gradient_epsilon") or 0) or 1e-7)
         self._last_iters = iters
+        if rec is not None:
+            rec.save_iteration(
+                {"kind": "glm", "lam": float(lam),
+                 "beta": np.asarray(beta_np), "it": iters},
+                meta={"kind": "glm-lbfgs", "iteration": iters,
+                      "lambda": float(lam)})
         beta_j = jnp.asarray(beta_np, jnp.float32)
         dev = float(_deviance_at(X, yv, w, valid_m, beta_j, fam_name,
                                  p["tweedie_power"], theta))

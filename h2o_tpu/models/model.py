@@ -398,7 +398,14 @@ class ModelBuilder:
                     keep_cross_validation_models=True,
                     keep_cross_validation_predictions=False,
                     keep_cross_validation_fold_assignment=False,
-                    checkpoint=None, custom_metric_func=None)
+                    checkpoint=None, custom_metric_func=None,
+                    # fault tolerance (core/recovery.py): snapshot this
+                    # build's params+frame and iteration-level checkpoints
+                    # under recovery_dir so auto_recover resumes it
+                    # MID-BUILD after a crash; checkpoint_interval is the
+                    # cadence in driver units (trees per checkpoint for
+                    # the tree engines; 0 = engine default)
+                    recovery_dir=None, checkpoint_interval=0)
 
     # -- public surface (mirrors h2o-py estimator.train) -------------------
 
@@ -432,6 +439,21 @@ class ModelBuilder:
             int(self.params.get("nfolds") or 0) > 1 or
             self.params.get("fold_column"))
 
+        # job-level fault tolerance (core/recovery.py): snapshot the
+        # params + training frame up front; the algo drivers add
+        # iteration-level checkpoints so auto_recover resumes mid-build
+        rec = None
+        if self.params.get("recovery_dir"):
+            from h2o_tpu.core.recovery import Recovery
+            rec = Recovery(self.params["recovery_dir"], "model",
+                           self.model_id)
+            self._recovery = rec
+            if not getattr(self, "_recovery_resuming", False):
+                rec.begin({k: v for k, v in self.params.items()
+                           if not str(k).startswith("_")},
+                          training_frame,
+                          extra={"algo": self.algo, "x": list(x), "y": y})
+
         def body(j: Job) -> Model:
             if use_cv:
                 model = self._fit_cv(j, x, y, training_frame,
@@ -456,6 +478,8 @@ class ModelBuilder:
                     if mm_obj is not None and fr_m is not None:
                         attach_custom_metric(model, mm_obj, fr_m, cmf)
             model.run_time_ms = int((time.time() - t0) * 1000)
+            if rec is not None:
+                rec.done()          # success — drop the snapshot
             cloud().dkv.put(model.key, model)
             log.info("%s trained in %.2fs -> %s", self.algo,
                      time.time() - t0, model.key)
@@ -527,7 +551,7 @@ class ModelBuilder:
             sub_params = dict(p)
             sub_params.update(nfolds=0, fold_column=None,
                               weights_column=wname, checkpoint=None,
-                              model_id=None)
+                              model_id=None, recovery_dir=None)
             sub = self.__class__(**{k: v for k, v in sub_params.items()
                                     if k in self.default_params()})
             sub.params["response_column"] = y

@@ -233,7 +233,8 @@ class DRF(ModelBuilder):
         job.update(0.05, f"training {int(p['ntrees']) - prior} trees")
         model = run_tree_driver(job, p, train_kwargs, F0, self.rng_key(),
                                 make_model, scorer, kind,
-                                prior_trees=prior)
+                                prior_trees=prior,
+                                recovery=getattr(self, "_recovery", None))
         model.output["training_metrics"] = model.model_metrics(train)
         if valid is not None:
             model.output["validation_metrics"] = model.model_metrics(valid)

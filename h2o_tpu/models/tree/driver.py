@@ -82,11 +82,14 @@ class IncrementalScorer:
         return self.to_metrics(self.F, ntrees_total)
 
 
+_CKPT_LISTS = ("scs", "bss", "vls", "chs", "gns", "nws", "ths", "nas")
+
+
 def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
                     make_model: Callable,
                     scorer: Optional[IncrementalScorer],
                     kind: str, prior_trees: int = 0,
-                    t_start: float = None) -> object:
+                    t_start: float = None, recovery=None) -> object:
     """Train ``p['ntrees']`` total trees (``prior_trees`` of which already
     exist on a checkpoint), scoring every ``score_tree_interval`` trees when
     early stopping / periodic scoring / a runtime budget is requested.
@@ -94,8 +97,17 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
     make_model(sc, bs, vl, ch, n_new, F_final) -> Model; arrays are the
     NEW trees only (the builder prepends checkpoint trees itself); ch is
     None for dense-heap trees.
+
+    ``recovery`` (core/recovery.py Recovery): when attached, the driver
+    runs in blocks regardless of scoring and saves an iteration-level
+    checkpoint after each block — per-block tree arrays, the carried F,
+    and the RNG key — so an interrupted build resumes MID-FOREST and,
+    because the random stream continues exactly, reproduces the
+    uninterrupted forest bit-for-bit.
     """
     from h2o_tpu.models.tree.jit_engine import train_forest
+    from h2o_tpu.models.tree.shared_tree import (rng_key_from_np,
+                                                 rng_key_to_np)
 
     ntrees = int(p["ntrees"]) - prior_trees
     if prior_trees and ntrees <= 0:
@@ -115,7 +127,11 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
 
     want_scoring = (rounds > 0 or interval > 0 or max_rt > 0) and \
         scorer is not None
-    if not want_scoring or ntrees <= 0:
+    ckpt_every = int(p.get("checkpoint_interval") or 0) \
+        if recovery is not None else 0
+    if recovery is not None and ckpt_every <= 0:
+        ckpt_every = 10                 # default checkpoint cadence
+    if (not want_scoring and recovery is None) or ntrees <= 0:
         tf = train_forest(F0=F0, key=key, ntrees=max(ntrees, 0),
                           t0=prior_trees, **train_kwargs)
         model = make_model(np.asarray(tf.split_col), np.asarray(tf.bitset),
@@ -133,12 +149,38 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
         _set_node_array(model, "na_left", np.asarray(tf.na_left))
         return model
 
-    block = interval if interval > 0 else max(1, min(ntrees, 10))
-    scs, bss, vls, chs, gns, nws, ths, nas = [], [], [], [], [], [], [], []
+    if interval > 0:
+        block = min(interval, ckpt_every) if ckpt_every else interval
+    else:
+        block = ckpt_every or max(1, min(ntrees, 10))
+    lists = {n: [] for n in _CKPT_LISTS}
+    scs, bss, vls, chs = (lists[n] for n in ("scs", "bss", "vls", "chs"))
+    gns, nws, ths, nas = (lists[n] for n in ("gns", "nws", "ths", "nas"))
     vi_total = None
     F = F0
     done = 0
-    prefix = "validation_" if scorer.is_validation else "training_"
+    prefix = "validation_" if scorer is not None and \
+        scorer.is_validation else "training_"
+    if recovery is not None:
+        st = recovery.load_iteration()
+        # resume only a checkpoint of THIS build shape — a stale state
+        # from different params must not leak trees in
+        if st and st.get("kind") == "tree" and \
+                st.get("prior_trees") == prior_trees and \
+                st.get("ntrees_target") == ntrees and \
+                st.get("block") == block:
+            done = int(st["done"])
+            F = jnp.asarray(st["F"])
+            key = rng_key_from_np(st["key"])
+            for n in _CKPT_LISTS:
+                lists[n].extend(st["lists"][n])
+            vi_total = st.get("vi_total")
+            if st.get("sk") is not None:
+                sk = st["sk"]
+            if scorer is not None and st.get("scorer_F") is not None:
+                scorer.F = jnp.asarray(st["scorer_F"])
+            job.update(0.05 + 0.85 * done / ntrees,
+                       f"resumed mid-forest at {prior_trees + done} trees")
     while done < ntrees:
         n = min(block, ntrees - done)
         key, sub = jax.random.split(key)
@@ -157,20 +199,39 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
         vi = np.asarray(tf.varimp)
         vi_total = vi if vi_total is None else vi_total + vi
         done += n
-        scorer.add(tf.split_col, tf.bitset, tf.value, tf.child,
-                   tf.thr_bin, tf.na_left)
-        mm = scorer.metrics(prior_trees + done)
-        row = {"number_of_trees": prior_trees + done,
-               "timestamp": time.time()}
-        for k in ("mse", "logloss", "AUC", "mean_residual_deviance", "err"):
-            if mm.get(k) is not None:
-                row[prefix + k.lower()] = mm.get(k)
-        sk.add(mm, row)
-        job.update(0.05 + 0.85 * done / ntrees,
-                   f"{prior_trees + done} trees, "
-                   f"{sk.metric_name}={sk.history[-1]:.5g}")
-        if sk.stop_early():
-            job.update(0.9, f"early stop at {prior_trees + done} trees")
+        stop = False
+        if scorer is not None:
+            scorer.add(tf.split_col, tf.bitset, tf.value, tf.child,
+                       tf.thr_bin, tf.na_left)
+            mm = scorer.metrics(prior_trees + done)
+            row = {"number_of_trees": prior_trees + done,
+                   "timestamp": time.time()}
+            for k in ("mse", "logloss", "AUC", "mean_residual_deviance",
+                      "err"):
+                if mm.get(k) is not None:
+                    row[prefix + k.lower()] = mm.get(k)
+            sk.add(mm, row)
+            job.update(0.05 + 0.85 * done / ntrees,
+                       f"{prior_trees + done} trees, "
+                       f"{sk.metric_name}={sk.history[-1]:.5g}")
+            if sk.stop_early():
+                job.update(0.9, f"early stop at {prior_trees + done} trees")
+                stop = True
+        else:
+            job.update(0.05 + 0.85 * done / ntrees,
+                       f"{prior_trees + done} trees")
+        if recovery is not None:
+            recovery.save_iteration(
+                {"kind": "tree", "prior_trees": prior_trees,
+                 "ntrees_target": ntrees, "block": block, "done": done,
+                 "F": np.asarray(F), "key": rng_key_to_np(key),
+                 "lists": lists, "vi_total": vi_total, "sk": sk,
+                 "scorer_F": np.asarray(scorer.F)
+                 if scorer is not None else None},
+                meta={"kind": "tree",
+                      "trees_done": prior_trees + done,
+                      "ntrees": int(p["ntrees"])})
+        if stop:
             break
         if max_rt > 0 and time.time() - t_start > max_rt:
             job.update(0.9, f"max_runtime_secs hit at {done} trees")

@@ -361,7 +361,8 @@ class GBM(ModelBuilder):
         job.update(0.05, f"training {int(p['ntrees']) - prior} trees")
         model = run_tree_driver(job, p, train_kwargs, F, self.rng_key(),
                                 make_model, scorer, kind,
-                                prior_trees=prior)
+                                prior_trees=prior,
+                                recovery=getattr(self, "_recovery", None))
         if p.get("_skip_final_metrics"):
             # per-tree inner fits (DART driver) discard these; the outer
             # loop scores the final concatenated forest once

@@ -179,6 +179,22 @@ def _col_min_max(matrix, nrows):
 
 
 # ---------------------------------------------------------------------------
+# RNG-state serialization (iteration checkpoints, core/recovery.py)
+# ---------------------------------------------------------------------------
+
+def rng_key_to_np(key) -> np.ndarray:
+    """Typed PRNG key -> raw uint32 host array (checkpointable)."""
+    return np.asarray(jax.random.key_data(key))
+
+
+def rng_key_from_np(data: np.ndarray):
+    """Inverse of rng_key_to_np — resumed builds continue the exact
+    random stream, so an interrupted+resumed forest is bitwise equal to
+    an uninterrupted one."""
+    return jax.random.wrap_key_data(jnp.asarray(data))
+
+
+# ---------------------------------------------------------------------------
 # split finding
 # ---------------------------------------------------------------------------
 

@@ -40,7 +40,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from h2o_tpu.core.cloud import DATA_AXIS, cloud
+from h2o_tpu.core.cloud import DATA_AXIS, cloud, shard_map_compat
 
 # stats slots
 W, WG, WGG, WH = 0, 1, 2, 3
@@ -172,7 +172,7 @@ def histogram_build_traced(bins, leaf, stats, n_leaves: int, nbins: int,
     use_pallas = _pallas_eligible(C, B1, n_leaves, S, fine_map,
                                   allowed=pallas)
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map_compat, mesh=mesh,
                        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS),
                                  P(DATA_AXIS, None)) + extra_specs,
                        out_specs=P(), check_vma=False)

@@ -19,6 +19,7 @@ import math
 import re
 from typing import Any, Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -645,8 +646,10 @@ def _eval(node, env: _Env):
 # RadixOrder.java, ast/prims/mungers/AstGroup.java, ast/prims/string/*)
 # ---------------------------------------------------------------------------
 
+# lax.cummin/cummax rather than jnp.minimum.accumulate — the ufunc
+# .accumulate spelling only exists on newer jax
 _CUMOPS = {"cumsum": jnp.cumsum, "cumprod": jnp.cumprod,
-           "cummin": jnp.minimum.accumulate, "cummax": jnp.maximum.accumulate}
+           "cummin": jax.lax.cummin, "cummax": jax.lax.cummax}
 _CUM_IDENT = {"cumsum": 0.0, "cumprod": 1.0, "cummin": jnp.inf,
               "cummax": -jnp.inf}
 

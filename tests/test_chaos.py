@@ -71,6 +71,87 @@ def test_device_put_fault(cl, rng):
         Vec(rng.normal(size=64).astype(np.float32))
 
 
+def test_persist_chaos_soak(cl, rng, tmp_path):
+    """Acceptance drill: under fail-then-succeed persist injection, a
+    frame snapshot round-trip AND a full GBM build (whose recovery
+    snapshot + iteration checkpoints all hit the injected byte store)
+    complete via retries, with fault and retry counts observable."""
+    from h2o_tpu.core import chaos, persist, resilience
+    from h2o_tpu.models.tree.gbm import GBM
+    fr = _frame(rng)
+    resilience.reset_stats()
+    chaos.configure(persist_transient=2, seed=0)
+    # frame snapshot round-trip
+    persist.save_frame(fr, str(tmp_path / "snap"))
+    fr2 = persist.load_frame(str(tmp_path / "snap"))
+    np.testing.assert_allclose(fr2.vec("x").to_numpy(),
+                               fr.vec("x").to_numpy())
+    # GBM build with recovery snapshots riding the same faulty store
+    m = GBM(ntrees=4, max_depth=2, seed=1,
+            recovery_dir=str(tmp_path / "rec"),
+            checkpoint_interval=2).train(y="y", training_frame=fr)
+    assert m.output["ntrees_actual"] == 4
+    c = chaos.chaos()
+    st = resilience.stats()
+    assert c.injected_persist >= 6          # snapshot + recovery writes
+    assert st["retries"] >= c.injected_persist
+    assert st["recoveries"] >= 3
+    assert st["giveups"] == 0
+
+
+def test_gbm_mid_forest_resume_bitwise(cl, rng, tmp_path):
+    """Kill a GBM mid-forest, auto_recover from the iteration
+    checkpoint, and demand predictions BITWISE equal to an uninterrupted
+    run — the resumed build must continue the exact RNG stream and F
+    state, not approximately retrain."""
+    from h2o_tpu.core.recovery import auto_recover, pending_recoveries
+    from h2o_tpu.models.tree import jit_engine
+    from h2o_tpu.models.tree.gbm import GBM
+    fr = _frame(rng)
+
+    m_ref = GBM(ntrees=6, max_depth=3, seed=7,
+                recovery_dir=str(tmp_path / "recA"),
+                checkpoint_interval=2).train(y="y", training_frame=fr)
+    pred_ref = np.asarray(m_ref.predict_raw(fr))
+
+    class Crash(BaseException):
+        """Process-death stand-in (not an Exception — nothing may
+        absorb it)."""
+
+    calls = {"n": 0}
+    orig = jit_engine.train_forest
+
+    def crashy(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise Crash("simulated death mid-forest")
+        return orig(*a, **k)
+
+    jit_engine.train_forest = crashy
+    try:
+        with pytest.raises(Crash):
+            GBM(ntrees=6, max_depth=3, seed=7,
+                recovery_dir=str(tmp_path / "recB"),
+                checkpoint_interval=2,
+                model_id="gbm_midforest").train(y="y", training_frame=fr)
+    finally:
+        jit_engine.train_forest = orig
+
+    pend = pending_recoveries(str(tmp_path / "recB"))
+    assert len(pend) == 1 and pend[0]["has_iteration_checkpoint"]
+    assert pend[0]["iteration"]["trees_done"] == 2
+
+    resumed = auto_recover(str(tmp_path / "recB"))
+    assert len(resumed) == 1
+    m2 = resumed[0]
+    assert str(m2.key) == "gbm_midforest"
+    assert m2.output["ntrees_actual"] == 6
+    np.testing.assert_array_equal(pred_ref, np.asarray(m2.predict_raw(fr)))
+    np.testing.assert_array_equal(np.asarray(m_ref.output["split_col"]),
+                                  np.asarray(m2.output["split_col"]))
+    assert pending_recoveries(str(tmp_path / "recB")) == []
+
+
 def test_recovery_after_injected_crash(cl, rng, tmp_path):
     """Kill a grid mid-run via injected faults, then auto-recover it —
     the crash-resume drill (hex/faulttolerance/Recovery + the reference's

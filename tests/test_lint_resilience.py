@@ -1,0 +1,40 @@
+"""Grep-based lint: raw network I/O must go through the retry layer.
+
+Every HTTP(S)/byte-store touch belongs behind core/persist.py's
+read_bytes/write_bytes (retried, chaos-injectable, observable) — a bare
+``urllib.request.urlopen`` anywhere else silently reopens the
+one-shot-I/O hole this layer closed.  Allowed: persist.py (the scheme
+backends themselves) and resilience.py (the wrapper's own plumbing,
+should it ever need one).
+"""
+
+import os
+import re
+
+import h2o_tpu
+
+ALLOWED = {os.path.join("core", "persist.py"),
+           os.path.join("core", "resilience.py")}
+PATTERN = re.compile(r"\burlopen\s*\(")
+
+
+def test_no_bare_urlopen_outside_persist():
+    pkg_root = os.path.dirname(h2o_tpu.__file__)
+    offenders = []
+    for dirpath, _dirs, files in os.walk(pkg_root):
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, pkg_root)
+            if rel in ALLOWED:
+                continue
+            with open(path, encoding="utf-8", errors="replace") as f:
+                for i, line in enumerate(f, 1):
+                    if PATTERN.search(line):
+                        offenders.append(f"{rel}:{i}: {line.strip()}")
+    assert not offenders, (
+        "bare urlopen() outside the persist/retry layer — route these "
+        "through h2o_tpu.core.persist.read_bytes/write_bytes (or add a "
+        "scheme backend in persist.py) so transient faults retry:\n"
+        + "\n".join(offenders))

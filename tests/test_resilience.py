@@ -1,0 +1,339 @@
+"""Resilience layer: retry/backoff, deadlines, job watchdog, bounded
+registry, and recovery-snapshot robustness (core/resilience.py,
+core/job.py, core/recovery.py).  Fast tier — no model builds; the
+compile-heavy chaos soak lives in test_chaos.py."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    from h2o_tpu.core import chaos, resilience
+    resilience.reset_stats()
+    yield
+    chaos.reset()
+    resilience.reset_stats()
+
+
+# -- RetryPolicy / Deadline --------------------------------------------------
+
+def test_retry_recovers_transient():
+    from h2o_tpu.core.resilience import RetryPolicy, stats
+    pol = RetryPolicy(max_attempts=4, base_delay=0.001, max_delay=0.002)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    assert pol.call(flaky) == "ok"
+    assert calls["n"] == 3
+    st = stats()
+    assert st["retries"] == 2 and st["recoveries"] == 1
+
+
+def test_retry_permanent_raises_immediately():
+    from h2o_tpu.core.resilience import RetryPolicy, stats
+    pol = RetryPolicy(max_attempts=5, base_delay=0.001)
+    calls = {"n": 0}
+
+    def perm():
+        calls["n"] += 1
+        raise FileNotFoundError("gone")
+
+    with pytest.raises(FileNotFoundError):
+        pol.call(perm)
+    assert calls["n"] == 1          # no pointless retries
+    assert stats()["permanent_failures"] == 1
+
+
+def test_retry_gives_up_after_max_attempts():
+    from h2o_tpu.core.resilience import RetryPolicy, stats
+    pol = RetryPolicy(max_attempts=3, base_delay=0.001, max_delay=0.001)
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise ConnectionError("still down")
+
+    with pytest.raises(ConnectionError):
+        pol.call(always)
+    assert calls["n"] == 3
+    assert stats()["giveups"] == 1
+
+
+def test_retry_http_classification():
+    from h2o_tpu.core.resilience import is_retryable
+    import urllib.error
+    mk = lambda code: urllib.error.HTTPError("http://x", code, "m", {},
+                                             None)
+    assert is_retryable(mk(503)) and is_retryable(mk(429))
+    assert not is_retryable(mk(404)) and not is_retryable(mk(403))
+    assert is_retryable(ConnectionResetError("rst"))
+    assert not is_retryable(ValueError("bad arg"))
+
+
+def test_deadline():
+    from h2o_tpu.core.resilience import Deadline
+    d = Deadline(0.02)
+    assert not d.expired and d.remaining() > 0
+    time.sleep(0.03)
+    assert d.expired
+    with pytest.raises(TimeoutError):
+        d.check("thing")
+    assert Deadline(0).remaining() == float("inf")   # unbounded
+
+
+def test_retry_respects_deadline():
+    from h2o_tpu.core.resilience import Deadline, RetryPolicy
+    pol = RetryPolicy(max_attempts=100, base_delay=0.05, max_delay=0.05)
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        pol.call(always, deadline=Deadline(0.1))
+    assert calls["n"] < 10          # budget cut it off long before 100
+
+
+# -- persist retry wiring ----------------------------------------------------
+
+def test_persist_transient_faults_recovered(tmp_path):
+    """The acceptance drill: byte-store ops succeed under fail-N-then-
+    succeed injection, with injected and retry counts observable."""
+    from h2o_tpu.core import chaos, persist, resilience
+    chaos.configure(persist_transient=2, seed=0)
+    uri = str(tmp_path / "blob.bin")
+    persist.write_bytes(uri, b"payload")
+    assert persist.read_bytes(uri) == b"payload"
+    c = chaos.chaos()
+    assert c.injected_persist == 4          # 2 per op, write + read
+    assert resilience.stats()["retries"] >= 4
+    assert resilience.stats()["recoveries"] == 2
+
+
+def test_persist_permanent_still_raises(tmp_path, cl):
+    from h2o_tpu.core import persist, resilience
+    with pytest.raises(FileNotFoundError):
+        persist.read_bytes(str(tmp_path / "nope.bin"))
+    with pytest.raises(NotImplementedError):
+        persist.read_bytes("s3://bucket/key")
+    assert resilience.stats()["retries"] == 0
+
+
+def test_frame_snapshot_under_transient_faults(cl, tmp_path):
+    from h2o_tpu.core import chaos, persist
+    from h2o_tpu.core.frame import Frame, Vec
+    rng = np.random.default_rng(0)
+    fr = Frame(["a", "b"], [Vec(rng.normal(size=40).astype(np.float32)),
+                            Vec(rng.normal(size=40).astype(np.float32))])
+    chaos.configure(persist_transient=1, seed=0)
+    persist.save_frame(fr, str(tmp_path / "snap"))
+    fr2 = persist.load_frame(str(tmp_path / "snap"))
+    assert fr2.nrows == fr.nrows
+    np.testing.assert_allclose(fr2.vec("a").to_numpy(),
+                               fr.vec("a").to_numpy())
+    assert chaos.chaos().injected_persist >= 4
+
+
+# -- recovery snapshot robustness -------------------------------------------
+
+def test_pending_recoveries_skips_corrupt_snapshot(tmp_path):
+    from h2o_tpu.core.recovery import pending_recoveries
+    rd = tmp_path / "rec"
+    good = rd / "grid_ok"
+    good.mkdir(parents=True)
+    (good / "info.json").write_text(json.dumps(
+        {"kind": "grid", "job_id": "ok", "done": False, "started": 1.0,
+         "models": []}))
+    bad = rd / "grid_bad"
+    bad.mkdir()
+    (bad / "info.json").write_text('{"kind": "grid", "job_')  # torn write
+    worse = rd / "grid_worse"
+    worse.mkdir()
+    (worse / "info.json").write_text("[1, 2, 3]")             # wrong shape
+    pend = pending_recoveries(str(rd))
+    assert [p["job_id"] for p in pend] == ["ok"]
+
+
+def test_iteration_checkpoint_roundtrip(tmp_path):
+    from h2o_tpu.core.recovery import Recovery
+    rec = Recovery(str(tmp_path), "model", "m1")
+    rec.save_iteration({"kind": "tree", "done": 4,
+                        "arr": np.arange(8, dtype=np.float32)},
+                       meta={"kind": "tree", "trees_done": 4})
+    st = rec.load_iteration()
+    assert st["done"] == 4
+    np.testing.assert_array_equal(st["arr"],
+                                  np.arange(8, dtype=np.float32))
+    assert rec.iteration_meta()["trees_done"] == 4
+    # corrupt payload degrades to "no checkpoint", never a crash
+    with open(os.path.join(rec.dir, "iter.pkl"), "wb") as f:
+        f.write(b"\x80garbage")
+    assert rec.load_iteration() is None
+    rec.clear_iteration()
+    assert rec.iteration_meta() is None
+
+
+# -- Job.join semantics ------------------------------------------------------
+
+def test_join_chains_original_traceback(cl):
+    from h2o_tpu.core.job import Job
+
+    def boom(j):
+        raise ValueError("kapow")
+
+    job = cl.jobs.start(Job(description="boom"), boom)
+    with pytest.raises(ValueError, match="kapow") as ei:
+        job.join(10)
+    # the original exception (with its worker-thread traceback) is the
+    # explicit cause; the registry keeps it un-mutated for /3/Jobs
+    assert ei.value.__cause__ is job.exception
+    assert ei.value.__cause__.__traceback__ is not None
+
+
+def test_join_failed_without_exception_guard():
+    from h2o_tpu.core.job import FAILED, Job
+    j = Job(description="weird")
+    j.status = FAILED
+    j._done.set()
+    with pytest.raises(RuntimeError, match="no recorded exception"):
+        j.join(1)
+
+
+# -- registry bound ----------------------------------------------------------
+
+def test_terminal_jobs_lru_evicted():
+    from h2o_tpu.core.job import Job, JobRegistry
+    reg = JobRegistry(max_workers=2, jobs_cap=5)
+    jobs = [reg.start(Job(description=f"q{i}"), lambda j: None)
+            for i in range(10)]
+    for j in jobs:
+        j._done.wait(10)
+    last = reg.start(Job(description="last"), lambda j: None)
+    last.join(10)
+    assert len(reg.list()) <= 5
+    assert reg.evicted_count >= 5
+    # the newest job survives eviction
+    assert reg.get(str(last.key)) is not None
+
+
+def test_running_jobs_never_evicted():
+    from h2o_tpu.core.job import Job, JobRegistry
+    reg = JobRegistry(max_workers=4, jobs_cap=2)
+    gate = threading.Event()
+    live = [reg.start(Job(description=f"live{i}"),
+                      lambda j: gate.wait(20)) for i in range(3)]
+    try:
+        done = reg.start(Job(description="done"), lambda j: None)
+        done.join(10)
+        for j in live:          # over cap, but RUNNING jobs must remain
+            assert reg.get(str(j.key)) is not None
+    finally:
+        gate.set()
+        for j in live:
+            j._done.wait(10)
+
+
+# -- deadlines + watchdog ----------------------------------------------------
+
+def test_stall_watchdog_expires_and_frees_slot():
+    """A hung job (no heartbeat) is FAILED(TimeoutError) and its pool
+    slot reclaimed: a subsequent job on the same 1-worker pool runs."""
+    from h2o_tpu.core.job import FAILED, Job, JobRegistry
+    reg = JobRegistry(max_workers=1, watchdog_interval=0.1)
+    release = threading.Event()
+    stuck = reg.start(Job(description="stuck", stall_secs=0.4),
+                      lambda j: release.wait(20))
+    try:
+        with pytest.raises(TimeoutError, match="stall window"):
+            stuck.join(10)
+        assert stuck.status == FAILED
+        assert isinstance(stuck.exception, TimeoutError)
+        assert stuck.to_dict()["timed_out"] is True
+        nxt = reg.start(Job(description="next"), lambda j: "ran")
+        assert nxt.join(10) == "ran"
+    finally:
+        release.set()
+
+
+def test_deadline_expires_cooperative_body(cl):
+    """A job that heartbeats but outlives its deadline is cancelled at
+    the next update() and recorded FAILED(TimeoutError), visible over
+    the /3/Jobs REST surface."""
+    from h2o_tpu.api import handlers
+    from h2o_tpu.core.job import FAILED, Job
+
+    def spin(j):
+        while True:
+            time.sleep(0.05)
+            j.update(0.1, "spinning")
+
+    job = cl.jobs.start(Job(description="budget",
+                            deadline_secs=0.4), spin)
+    with pytest.raises(TimeoutError, match="deadline"):
+        job.join(15)
+    assert job.status == FAILED
+    d = handlers.get_job({}, job_id=str(job.key))["jobs"][0]
+    assert d["status"] == "FAILED"
+    assert "TimeoutError" in d["exception"]
+    assert d["deadline_secs"] == 0.4
+
+
+def test_chaos_stall_injector_trips_watchdog():
+    from h2o_tpu.core import chaos
+    from h2o_tpu.core.job import FAILED, Job, JobRegistry
+    chaos.configure(stall_p=1.0, stall_secs=2.0, seed=0)
+    reg = JobRegistry(max_workers=2, watchdog_interval=0.1)
+    job = reg.start(Job(description="victim", stall_secs=0.4),
+                    lambda j: "never-counts")
+    with pytest.raises(TimeoutError):
+        job.join(15)
+    assert job.status == FAILED
+    assert chaos.chaos().injected_stalls == 1
+
+
+# -- REST observability ------------------------------------------------------
+
+def test_resilience_route(cl):
+    from h2o_tpu.api import handlers
+    from h2o_tpu.core import chaos, resilience
+    chaos.configure(persist_transient=1, seed=0)
+    from h2o_tpu.core import persist
+    persist.write_bytes("/tmp/h2o_tpu_res_route/blob", b"x")
+    out = handlers.resilience_stats({})
+    assert out["retry"]["retries"] >= 1
+    assert out["chaos"]["injected_persist"] >= 1
+    assert "expired_jobs" in out["watchdog"]
+    assert out["watchdog"]["jobs_cap"] == cl.jobs.jobs_cap
+
+
+def test_recovery_route_lists_checkpoint_state(cl, tmp_path):
+    from h2o_tpu.api import handlers
+    from h2o_tpu.core.recovery import Recovery
+    from h2o_tpu.core.frame import Frame, Vec
+    rng = np.random.default_rng(0)
+    fr = Frame(["a"], [Vec(rng.normal(size=16).astype(np.float32))])
+    rec = Recovery(str(tmp_path), "model", "m_rest")
+    rec.begin({"ntrees": 4}, fr, extra={"algo": "gbm", "x": ["a"],
+                                        "y": None})
+    rec.save_iteration({"kind": "tree", "done": 2},
+                       meta={"kind": "tree", "trees_done": 2})
+    out = handlers.recovery_list({"recovery_dir": str(tmp_path)})
+    assert len(out["pending"]) == 1
+    p = out["pending"][0]
+    assert p["job_id"] == "m_rest"
+    assert p["has_iteration_checkpoint"] is True
+    assert p["iteration"]["trees_done"] == 2
+    with pytest.raises(Exception):      # no dir anywhere -> 400
+        handlers.recovery_list({})
