@@ -1,0 +1,154 @@
+"""Online-scoring REST surface: /3/Serving.
+
+Reference: the reference platform serves production traffic from a
+dedicated scoring layer fed by exported MOJOs (genmodel + Steam's
+scoring service REST API), keeping `/3/Predictions` a batch map/reduce.
+This module is that serving front door for the TPU rebuild:
+
+- ``POST   /3/Serving``                    deploy / hot-swap a model
+- ``GET    /3/Serving``                    list deployments + stats
+- ``GET    /3/Serving/<name>``             one deployment's detail
+- ``POST   /3/Serving/<name>/score``       rows in, predictions out
+- ``POST   /3/Serving/<name>/rollback``    reactivate previous version
+- ``DELETE /3/Serving/<name>``             drain + undeploy
+
+Status mapping: queue at capacity -> 429 (load shed), per-request
+deadline exceeded -> 408, unknown alias -> 404, unservable model -> 400.
+
+NOTE: no ``jax.jit`` may appear in api/handlers*.py (lint-enforced) —
+per-request compiles live behind serve/engine.py's bounded bucket cache.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from h2o_tpu.api.server import H2OError, route
+from h2o_tpu.core.cloud import cloud
+from h2o_tpu.models.model import Model
+from h2o_tpu.serve import (QueueFull, ServingConfig, UnsupportedModelError,
+                           registry)
+
+
+def _bool(v, default=True) -> bool:
+    if v is None:
+        return default
+    return str(v).lower() not in ("false", "0", "no")
+
+
+@route("POST", r"/3/Serving")
+def serving_deploy(params):
+    """Deploy (or hot-swap) a trained model under a stable alias."""
+    model_id = params.get("model_id")
+    if not model_id:
+        raise H2OError(400, "model_id is required")
+    m = cloud().dkv.get(model_id)
+    if not isinstance(m, Model):
+        raise H2OError(404, f"model {model_id} not found")
+    name = params.get("name") or str(model_id)
+    cfg = ServingConfig(
+        max_batch=int(params.get("max_batch", 32)),
+        max_delay_ms=float(params.get("max_delay_ms", 2.0)),
+        queue_cap=int(params.get("queue_cap", 64)),
+        deadline_ms=float(params.get("deadline_ms", 0.0)))
+    try:
+        info = registry().deploy(name, m, cfg,
+                                 warm=_bool(params.get("warm")))
+    except UnsupportedModelError as e:
+        raise H2OError(400, str(e))
+    except RuntimeError as e:
+        raise H2OError(409, str(e))
+    return {"deployment": info}
+
+
+@route("GET", r"/3/Serving")
+def serving_list(params):
+    out = {"deployments": registry().list()}
+    out["engine"] = registry().engine.stats()
+    return out
+
+
+@route("GET", r"/3/Serving/(?P<name>[^/]+)")
+def serving_get(params, name):
+    dep = registry().get(name)
+    if dep is None:
+        raise H2OError(404, f"no deployment named {name}")
+    return {"deployment": registry().describe(dep)}
+
+
+@route("POST", r"/3/Serving/(?P<name>[^/]+)/rollback")
+def serving_rollback(params, name):
+    try:
+        info = registry().rollback(name)
+    except KeyError as e:
+        raise H2OError(404, str(e))
+    except ValueError as e:
+        raise H2OError(400, str(e))
+    return {"deployment": info}
+
+
+@route("DELETE", r"/3/Serving/(?P<name>[^/]+)")
+def serving_undeploy(params, name):
+    try:
+        info = registry().undeploy(
+            name, drain_secs=float(params.get("drain_secs", 10.0)))
+    except KeyError as e:
+        raise H2OError(404, str(e))
+    return info
+
+
+def _format_predictions(raw: np.ndarray,
+                        domain: Optional[List[str]],
+                        rows: List[Dict[str, Any]]) -> List[Dict]:
+    preds: List[Dict[str, Any]] = []
+    raw = np.asarray(raw)
+    for i, row in enumerate(rows):
+        if domain:
+            r = np.atleast_2d(raw)[i]
+            li = int(r[0])
+            p: Dict[str, Any] = {
+                "predict": domain[li] if 0 <= li < len(domain) else li,
+                "probabilities": {str(d): float(r[1 + k])
+                                  for k, d in enumerate(domain)}}
+        elif raw.ndim == 2 and raw.shape[1] > 1:
+            # multi-output heads (PCA/SVD projections)
+            p = {"predict": [float(v) for v in raw[i]]}
+        else:
+            p = {"predict": float(raw[i] if raw.ndim == 1
+                                  else raw[i, 0])}
+        if isinstance(row, dict) and row.get("_row_id") is not None:
+            # echo the caller's correlation id (also what the
+            # no-cross-request-row-mixing test pins)
+            p["row_id"] = row["_row_id"]
+        preds.append(p)
+    return preds
+
+
+@route("POST", r"/3/Serving/(?P<name>[^/]+)/score")
+def serving_score(params, name):
+    """Score JSON rows: ``{"rows": [{col: value, ...}, ...]}`` (a single
+    row dict is accepted too).  Rows coalesce with concurrent requests
+    into one device micro-batch."""
+    rows = params.get("rows")
+    if isinstance(rows, dict):
+        rows = [rows]
+    if not isinstance(rows, list) or not rows or \
+            not all(isinstance(r, dict) for r in rows):
+        raise H2OError(400, 'body must be JSON {"rows": [{...}, ...]}')
+    deadline_ms = params.get("deadline_ms")
+    deadline_ms = float(deadline_ms) if deadline_ms is not None else None
+    reg = registry()
+    try:
+        raw, ver = reg.score_rows(name, rows, deadline_ms=deadline_ms)
+    except KeyError as e:
+        raise H2OError(404, str(e))
+    except QueueFull as e:
+        raise H2OError(429, str(e))
+    except TimeoutError as e:
+        raise H2OError(408, str(e))
+    dep = reg.get(name)
+    domain = reg.response_domain(dep, ver) if dep is not None else None
+    return {"model_id": ver.model_id, "version": ver.version,
+            "predictions": _format_predictions(raw, domain, rows)}

@@ -16,15 +16,19 @@ the HOST layer the framework owns:
   distinct operation, then succeed) so tests prove the retry layer in
   core/resilience.py actually recovers rather than merely re-raising;
 - stall faults: a job body sleeps without emitting a progress heartbeat,
-  exercising the JobRegistry watchdog (deadline/stall detection).
+  exercising the JobRegistry watchdog (deadline/stall detection);
+- slow-score faults: the online-scoring engine (serve/engine.py) sleeps
+  inside a device batch, exercising the micro-batcher's admission-queue
+  load shedding (429) and per-request deadline expiry (408).
 
 Enable with ``H2O_TPU_CHAOS_JOB=0.3`` / ``H2O_TPU_CHAOS_DEVICE_PUT=0.1``
 (probabilities), ``H2O_TPU_CHAOS_PERSIST=0.2`` (probability) or
 ``H2O_TPU_CHAOS_PERSIST_TRANSIENT=2`` (fail-N-then-succeed),
 ``H2O_TPU_CHAOS_STALL=0.5`` + ``H2O_TPU_CHAOS_STALL_SECS=30`` (stall
-probability and duration), and optional ``H2O_TPU_CHAOS_SEED``; or
-programmatically via ``configure()``.  Off by default; zero overhead
-when off.
+probability and duration), ``H2O_TPU_CHAOS_SCORE_SLOW=1.0`` +
+``H2O_TPU_CHAOS_SCORE_SLOW_MS=200`` (slow-score probability and
+duration), and optional ``H2O_TPU_CHAOS_SEED``; or programmatically via
+``configure()``.  Off by default; zero overhead when off.
 """
 
 from __future__ import annotations
@@ -60,6 +64,9 @@ class _Chaos:
             e("H2O_TPU_CHAOS_PERSIST_TRANSIENT", 0) or 0)
         self.stall_p = float(e("H2O_TPU_CHAOS_STALL", 0) or 0)
         self.stall_secs = float(e("H2O_TPU_CHAOS_STALL_SECS", 30) or 30)
+        self.score_slow_p = float(e("H2O_TPU_CHAOS_SCORE_SLOW", 0) or 0)
+        self.score_slow_ms = float(
+            e("H2O_TPU_CHAOS_SCORE_SLOW_MS", 200) or 200)
         seed = e("H2O_TPU_CHAOS_SEED")
         self._rng = np.random.default_rng(
             int(seed) if seed is not None else None)
@@ -68,12 +75,13 @@ class _Chaos:
         self.injected = 0
         self.injected_persist = 0
         self.injected_stalls = 0
+        self.injected_slow_scores = 0
 
     @property
     def enabled(self) -> bool:
         return (self.job_p > 0 or self.device_put_p > 0 or
                 self.persist_p > 0 or self.persist_transient > 0 or
-                self.stall_p > 0)
+                self.stall_p > 0 or self.score_slow_p > 0)
 
     def _roll(self, p: float) -> bool:
         if p <= 0:
@@ -120,6 +128,17 @@ class _Chaos:
             log.warning("chaos: injecting persist failure (%s %s)", op, uri)
             raise ChaosIOError(f"injected persist fault ({op} {uri})")
 
+    def maybe_slow_score(self, what: str = "score") -> None:
+        """Slow-score injector: sleep inside an online-scoring device
+        batch — the micro-batcher's admission queue must back up (shed
+        as 429) and queued requests must hit their deadlines (408)."""
+        if self._roll(self.score_slow_p):
+            with self._lock:
+                self.injected_slow_scores += 1
+            log.warning("chaos: slowing %s by %.0fms", what,
+                        self.score_slow_ms)
+            time.sleep(self.score_slow_ms / 1000.0)
+
     def maybe_stall(self, what: str) -> None:
         """Stall injector: sleep without a progress heartbeat — the job
         watchdog (core/job.py) must detect and expire the job."""
@@ -144,7 +163,8 @@ def chaos() -> _Chaos:
 def configure(job_p: float = 0.0, device_put_p: float = 0.0,
               seed: Optional[int] = None, persist_p: float = 0.0,
               persist_transient: int = 0, stall_p: float = 0.0,
-              stall_secs: float = 30.0) -> _Chaos:
+              stall_secs: float = 30.0, score_slow_p: float = 0.0,
+              score_slow_ms: float = 200.0) -> _Chaos:
     """Programmatic enable (tests); returns the active instance."""
     global _instance
     _instance = _Chaos()
@@ -154,6 +174,8 @@ def configure(job_p: float = 0.0, device_put_p: float = 0.0,
     _instance.persist_transient = int(persist_transient)
     _instance.stall_p = float(stall_p)
     _instance.stall_secs = float(stall_secs)
+    _instance.score_slow_p = float(score_slow_p)
+    _instance.score_slow_ms = float(score_slow_ms)
     if seed is not None:
         _instance._rng = np.random.default_rng(seed)
     return _instance

@@ -19,6 +19,7 @@ Mesh axes:
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 from typing import Optional
@@ -130,8 +131,35 @@ class Cloud:
         if args.hbm_budget:
             from h2o_tpu.core.memory import set_budget
             set_budget(args.hbm_budget)
+        # collective-execution gate (see device_gate below): only the
+        # host-emulated multi-device topology needs it
+        self._device_gate = threading.RLock() if (
+            devs[0].platform == "cpu" and len(devs) > 1 and
+            os.environ.get("H2O_TPU_DEVICE_GATE", "1").lower()
+            not in ("0", "off", "false")) else None
         log.info("Cloud '%s' of size %d formed (mesh %dx%d, platform=%s)",
                  args.name, n, n, m, devs[0].platform)
+
+    def device_gate(self):
+        """Serialize multi-device collective programs across host threads.
+
+        XLA:CPU's in-process collectives have no gang scheduler: two
+        programs dispatched concurrently from different threads can
+        enqueue onto the virtual devices in different orders and
+        deadlock at the all-reduce rendezvous (program A holds device 0
+        waiting for devices 1-7, which are parked in program B waiting
+        for device 0).  Real TPU backends gang-schedule per-core streams
+        so this cannot happen there — the gate is a no-op lock off the
+        forced-host-device test topology (and can be forced off with
+        ``H2O_TPU_DEVICE_GATE=0``).  Held around whole model-build
+        bodies (ModelBuilder.train_async), where parallel grids /
+        AutoML / segment training create exactly this concurrency;
+        single-device programs (the online-scoring engine's bucketed
+        predicts) need no gate — they cannot form a rendezvous cycle.
+        """
+        if self._device_gate is None:
+            return contextlib.nullcontext()
+        return self._device_gate
 
     # -- singleton management (the reference's H2O.CLOUD / H2O.SELF statics) --
 

@@ -112,6 +112,13 @@ class Profiler:
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "Profiler":
+        """Idempotent: a second ``start()`` while sampling is a no-op —
+        never a second (leaked) sampler thread.  Restarting a stopped
+        profiler resumes sampling into the same counts."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+
         def run():
             while not self._stop.wait(self.interval):
                 for frame in sys._current_frames().values():
@@ -121,15 +128,19 @@ class Profiler:
                                f"{f.f_code.co_name}:{f.f_lineno}")
                         self.counts[key] = self.counts.get(key, 0) + 1
                         f = f.f_back
+        # daemon: a forgotten profiler must never block interpreter exit
         self._thread = threading.Thread(target=run, daemon=True,
                                         name="h2o-tpu-profiler")
         self._thread.start()
         return self
 
     def stop(self) -> Dict[str, int]:
+        """Idempotent: ``stop()`` after ``stop()`` just returns the
+        counts again."""
         self._stop.set()
-        if self._thread:
-            self._thread.join(timeout=1.0)
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=1.0)
         return dict(sorted(self.counts.items(), key=lambda kv: -kv[1]))
 
 

@@ -139,7 +139,18 @@ class StackedEnsemble(ModelBuilder):
         else:
             from h2o_tpu.models.registry import builder_class
             builder = builder_class(algo)(**mp)
-        meta_model = builder.train(y=y, training_frame=l1)
+        # in-thread fit (the _fit_cv sub-build pattern), NOT a child
+        # train() job: this body runs under the cloud's device_gate and
+        # a spawned child build would block on it from another thread
+        # while we join it — deadlock by construction
+        builder.params["response_column"] = y
+        x_meta = [c for c in l1.names if c != y]
+        if builder.supports_cv and int(
+                builder.params.get("nfolds") or 0) > 1:
+            meta_model = builder._fit_cv(job, x_meta, y, l1, None)
+        else:
+            meta_model = builder._fit(job, x_meta, y, l1, None)
+        meta_model.params["response_column"] = y
         cloud().dkv.put(meta_model.key, meta_model)
         job.update(0.9, "metalearner trained")
 

@@ -21,7 +21,7 @@ reference.  Multinomial runs per-class IRLSM against softmax residuals.
 from __future__ import annotations
 
 import functools
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -470,6 +470,32 @@ def expand_for_scoring(frame: Frame, spec: Dict):
     return jax.device_put(m, cloud().matrix_sharding())
 
 
+def expand_array(X, spec: Dict, order: Optional[Sequence[str]] = None):
+    """Device twin of mojo/scorers._expand: apply a training expansion
+    spec to a RAW column matrix (codes/floats in ``order``, NAs as NaN)
+    instead of a Frame — the online-scoring fast path, jit-traceable.
+    Unseen/NaN categorical codes one-hot to all-zeros (baseline level),
+    matching both the Frame path and the numpy artifact scorer."""
+    order = list(order or (list(spec["cat_names"]) +
+                           list(spec["num_names"])))
+    pos = {c: i for i, c in enumerate(order)}
+    X = jnp.asarray(X, jnp.float32)
+    cols = []
+    for c, card in zip(spec["cat_names"], spec["cat_cards"]):
+        codes = X[:, pos[c]]
+        lo = 0 if spec["use_all_factor_levels"] else 1
+        for k in range(lo, card):
+            cols.append((codes == k).astype(jnp.float32))
+    for c, mean, sigma in zip(spec["num_names"], spec["means"],
+                              spec["sigmas"]):
+        d = jnp.nan_to_num(X[:, pos[c]], nan=float(mean))
+        if spec["standardize"]:
+            d = (d - mean) / (sigma or 1.0)
+        cols.append(d)
+    return jnp.stack(cols, axis=1) if cols else jnp.zeros(
+        (X.shape[0], 0), jnp.float32)
+
+
 def expansion_spec(di: DataInfo) -> Dict:
     return dict(
         cat_names=list(di.cat_names),
@@ -551,8 +577,18 @@ class GLMModel(Model):
     algo = "glm"
 
     def predict_raw(self, frame: Frame):
+        return self._raw_from_expanded(
+            expand_for_scoring(frame, self.output["expansion_spec"]))
+
+    def predict_raw_array(self, X):
+        """Online fast path (serve/engine.py): raw column matrix in
+        output['x'] order — expansion happens on device, jit-traceable."""
         out = self.output
-        X = expand_for_scoring(frame, out["expansion_spec"])
+        return self._raw_from_expanded(
+            expand_array(X, out["expansion_spec"], out.get("x")))
+
+    def _raw_from_expanded(self, X):
+        out = self.output
         dom = out.get("response_domain")
         if out.get("is_ordinal"):
             beta = jnp.asarray(out["beta"])

@@ -189,6 +189,40 @@ class Model:
         return _raw_to_frame(self.predict_raw(frame), frame.nrows,
                              self.output.get("response_domain"))
 
+    # -- online fast path (serve/engine.py) ---------------------------------
+
+    def predict_raw_array(self, X) -> jax.Array:
+        """Device predictions over a raw (rows, len(output['x'])) matrix
+        of column values in training order (categoricals as domain
+        codes, NAs as NaN) — no Frame, no DKV, shape-stable so the
+        serving engine can jit it per batch bucket.  Families with a
+        device scoring path override this (GBM/DRF/XGBoost/GLM);
+        ``predict_raw(frame)`` delegates to it where possible."""
+        raise NotImplementedError(
+            f"{self.algo} has no device array-predict fast path")
+
+    def predict_array(self, X: np.ndarray) -> np.ndarray:
+        """Online scoring entry: raw ndarray in, raw predictions out —
+        never round-trips through a DKV Frame.  Uses the device fast
+        path when the model family implements one, else the pure-numpy
+        MOJO scorer over the same flattened artifact arrays."""
+        X = np.asarray(X)
+        try:
+            return np.asarray(self.predict_raw_array(
+                jnp.asarray(X, jnp.float32)))
+        except NotImplementedError:
+            pass
+        from h2o_tpu.mojo import _flatten_arrays, scorers
+        fn = getattr(scorers, f"score_{self.algo}", None)
+        if fn is None:
+            raise NotImplementedError(
+                f"{self.algo} has neither a device predict_raw_array "
+                "nor a standalone numpy scorer")
+        out = {k: (np.asarray(v) if isinstance(v, jax.Array) else v)
+               for k, v in self.output.items()}
+        arrays, meta = _flatten_arrays(out)
+        return np.asarray(fn(arrays, meta, np.asarray(X, np.float64)))
+
     # -- tree-family scoring options (hex/Model.java scoring flags) ---------
 
     def _require_forest(self, what: str) -> None:
@@ -455,6 +489,14 @@ class ModelBuilder:
                           extra={"algo": self.algo, "x": list(x), "y": y})
 
         def body(j: Job) -> Model:
+            # device_gate: parallel builds (grid parallelism, AutoML,
+            # segments) must not execute collective programs
+            # concurrently on the host-emulated mesh (core/cloud.py
+            # device_gate; no-op on real TPU topologies)
+            with cloud().device_gate():
+                return _train(j)
+
+        def _train(j: Job) -> Model:
             if use_cv:
                 model = self._fit_cv(j, x, y, training_frame,
                                      validation_frame)

@@ -42,9 +42,11 @@ def raw_from_votes(F, ntrees: int, dom, threshold: float = 0.5):
 class DRFModel(Model):
     algo = "drf"
 
-    def predict_raw(self, frame: Frame):
+    def predict_raw_array(self, X) -> jax.Array:
+        """Online fast path (serve/engine.py): raw column matrix in
+        output['x'] order, no Frame/DKV."""
         out = self.output
-        m = frame.as_matrix(out["x"])
+        m = jnp.asarray(X, jnp.float32)
         bins = st._bin_all(m, jnp.asarray(out["split_points"]),
                            jnp.asarray(out["is_cat"]),
                            st.model_fine_na(out))
@@ -53,6 +55,10 @@ class DRFModel(Model):
                               out.get("response_domain"),
                               threshold=float(out.get(
                                   "default_threshold", 0.5)))
+
+    def predict_raw(self, frame: Frame):
+        # delegates to the array fast path — one scoring implementation
+        return self.predict_raw_array(frame.as_matrix(self.output["x"]))
 
 
 class DRF(ModelBuilder):

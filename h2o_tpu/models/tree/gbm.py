@@ -50,24 +50,37 @@ def raw_from_F(F, dom, dist_name: str, tweedie_power: float = 1.5,
 class GBMModel(Model):
     algo = "gbm"
 
-    def predict_raw(self, frame: Frame):
+    def _forest_F(self, m) -> jax.Array:
+        """(rows, C) raw-code matrix -> link-scale forest sum (shared by
+        the Frame path and the online array fast path)."""
         out = self.output
-        di_x = out["x"]
-        m = frame.as_matrix(di_x)
         bins = st._bin_all(m, jnp.asarray(out["split_points"]),
                            jnp.asarray(out["is_cat"]),
                            st.model_fine_na(out))
-        F = st.forest_score_out(bins, out)
-        F = F + jnp.asarray(out["f0"])[None, :]
-        off_col = self.params.get("offset_column")
-        if off_col and off_col in frame:
-            F = F + frame.vec(off_col).data[:, None]
+        return st.forest_score_out(bins, out) + \
+            jnp.asarray(out["f0"])[None, :]
+
+    def _raw_from_F(self, F) -> jax.Array:
+        out = self.output
         return raw_from_F(F, out.get("response_domain"),
                           out["distribution_resolved"],
                           self.params.get("tweedie_power", 1.5),
                           threshold=float(out.get("default_threshold",
                                                   0.5)),
                           custom_link=out.get("custom_link"))
+
+    def predict_raw_array(self, X) -> jax.Array:
+        """Online fast path (serve/engine.py): raw column matrix in
+        output['x'] order, no Frame/DKV."""
+        return self._raw_from_F(self._forest_F(
+            jnp.asarray(X, jnp.float32)))
+
+    def predict_raw(self, frame: Frame):
+        F = self._forest_F(frame.as_matrix(self.output["x"]))
+        off_col = self.params.get("offset_column")
+        if off_col and off_col in frame:
+            F = F + frame.vec(off_col).data[:, None]
+        return self._raw_from_F(F)
 
 
 class GBM(ModelBuilder):

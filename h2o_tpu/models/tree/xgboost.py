@@ -72,6 +72,15 @@ class XGBoostLinearModel(XGBoostModel):
         from h2o_tpu.models.glm import GLMModel
         return GLMModel.predict_raw(self, frame)
 
+    def predict_raw_array(self, X):
+        from h2o_tpu.models.glm import GLMModel
+        return GLMModel.predict_raw_array(self, X)
+
+    def _raw_from_expanded(self, X):
+        # the borrowed GLM scoring paths above resolve this on self
+        from h2o_tpu.models.glm import GLMModel
+        return GLMModel._raw_from_expanded(self, X)
+
     def model_metrics(self, frame: Frame = None):
         from h2o_tpu.models.glm import GLMModel
         return GLMModel.model_metrics(self, frame)

@@ -1,0 +1,26 @@
+"""Online scoring — the genmodel/Steam serving path, in-cluster.
+
+Reference: H2O-3 separates training from production scoring — models are
+exported as MOJOs and served at low latency by a dedicated layer (the
+h2o-genmodel EasyPredictModelWrapper + Steam scoring service), while the
+cluster's own ``/3/Predictions`` stays a batch map/reduce over a DKV
+frame.  This package is the missing online half for the TPU rebuild:
+
+- :mod:`h2o_tpu.serve.registry` — versioned deployments behind a stable
+  alias (deploy / hot-swap / rollback / draining undeploy) with
+  per-deployment stats (request/reject counts, latency percentiles);
+- :mod:`h2o_tpu.serve.engine` — row-dict -> padded ndarray encoding from
+  the model's training schema, a bounded cache of jitted predict
+  functions with power-of-two batch bucketing, and a pure-NumPy
+  ``mojo``-scorer fallback for model types without a device predict;
+- :mod:`h2o_tpu.serve.batcher` — micro-batching of concurrent requests
+  into one device batch with a bounded admission queue (load shedding)
+  and per-request deadlines.
+
+REST surface: ``/3/Serving`` (h2o_tpu/api/handlers_serving.py).
+"""
+
+from h2o_tpu.serve.batcher import MicroBatcher, QueueFull  # noqa: F401
+from h2o_tpu.serve.engine import ScoringEngine  # noqa: F401
+from h2o_tpu.serve.registry import (ServingConfig,  # noqa: F401
+                                    UnsupportedModelError, registry)

@@ -1,0 +1,180 @@
+"""MicroBatcher — coalesce concurrent score requests into device batches.
+
+Reference: the in-cluster scoring path amortizes per-row cost by design
+(BigScore is an MRTask over whole chunks); a low-latency serving layer
+has to recreate that batching from the other direction — many tiny
+concurrent requests, one device dispatch.  The shape here is the classic
+serving micro-batch (TF-Serving BatchingSession / Triton dynamic
+batcher):
+
+- requests enqueue a future and block; a per-deployment worker drains
+  the queue, waiting at most ``max_delay_ms`` beyond the first request
+  and closing the batch at ``max_batch`` rows;
+- admission control: a bounded queue (``queue_cap`` in-flight requests)
+  sheds load by raising :class:`QueueFull` — the REST surface maps it
+  to HTTP 429 so clients back off instead of piling onto a cold cache;
+- per-request deadlines (core/resilience.Deadline): a request that
+  expires while queued is failed with ``TimeoutError`` without wasting
+  a device slot on an answer nobody is waiting for.
+
+The worker scores through a caller-supplied ``score_fn(rows)`` so the
+batch is encoded against the deployment's CURRENT active version —
+requests racing a hot-swap all score consistently.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, List, Optional, Sequence
+
+from h2o_tpu.core.diag import TimeLine
+from h2o_tpu.core.log import get_logger
+from h2o_tpu.core.resilience import Deadline
+
+log = get_logger("serve")
+
+
+class QueueFull(RuntimeError):
+    """Admission queue over capacity — shed load (HTTP 429)."""
+
+
+class _Item:
+    __slots__ = ("rows", "n", "future", "deadline")
+
+    def __init__(self, rows: Sequence[dict], deadline: Optional[Deadline]):
+        self.rows = list(rows)
+        self.n = len(self.rows)
+        self.future: Future = Future()
+        self.deadline = deadline
+
+
+class MicroBatcher:
+    """One worker thread per deployment, coalescing requests."""
+
+    def __init__(self, score_fn: Callable[[List[dict]], "object"],
+                 max_batch: int = 32, max_delay_ms: float = 2.0,
+                 queue_cap: int = 64, name: str = "serve",
+                 on_batch: Optional[Callable[[int, int], None]] = None):
+        self.score_fn = score_fn
+        self.max_batch = int(max_batch)
+        self.max_delay_ms = float(max_delay_ms)
+        self.queue_cap = int(queue_cap)
+        self.name = name
+        self.on_batch = on_batch
+        self._q: "queue.Queue[_Item]" = queue.Queue()
+        self._pending = 0                 # queued + being scored
+        self._plock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"h2o-serve-{name}")
+        self._thread.start()
+
+    # -- admission -----------------------------------------------------------
+
+    @property
+    def pending(self) -> int:
+        with self._plock:
+            return self._pending
+
+    def configure(self, max_batch: Optional[int] = None,
+                  max_delay_ms: Optional[float] = None,
+                  queue_cap: Optional[int] = None) -> None:
+        """Re-tune on hot-swap (worker reads these every cycle)."""
+        if max_batch is not None:
+            self.max_batch = int(max_batch)
+        if max_delay_ms is not None:
+            self.max_delay_ms = float(max_delay_ms)
+        if queue_cap is not None:
+            self.queue_cap = int(queue_cap)
+
+    def submit(self, rows: Sequence[dict],
+               deadline: Optional[Deadline] = None) -> Future:
+        """Enqueue a request; returns its future.  Raises
+        :class:`QueueFull` when the admission queue is at capacity."""
+        if self._stop_evt.is_set():
+            raise RuntimeError(f"batcher {self.name} is stopped")
+        with self._plock:
+            if self._pending >= self.queue_cap:
+                raise QueueFull(
+                    f"serving queue for {self.name} at capacity "
+                    f"({self.queue_cap} in flight); retry later")
+            self._pending += 1
+        item = _Item(rows, deadline)
+        self._q.put(item)
+        return item.future
+
+    def _done(self) -> None:
+        with self._plock:
+            self._pending -= 1
+
+    # -- worker --------------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            try:
+                first = self._q.get(timeout=0.05)
+            except queue.Empty:
+                if self._stop_evt.is_set():
+                    return
+                continue
+            batch = [first]
+            nrows = first.n
+            t_close = time.monotonic() + self.max_delay_ms / 1000.0
+            while nrows < self.max_batch:
+                remaining = t_close - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    it = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                batch.append(it)
+                nrows += it.n
+            live: List[_Item] = []
+            for it in batch:
+                if it.deadline is not None and it.deadline.expired:
+                    it.future.set_exception(TimeoutError(
+                        f"request expired after its "
+                        f"{it.deadline.seconds:g}s deadline while queued "
+                        f"on {self.name}"))
+                    TimeLine.record("serve", "deadline_expired",
+                                    deployment=self.name)
+                    self._done()
+                else:
+                    live.append(it)
+            if not live:
+                continue
+            rows: List[dict] = []
+            for it in live:
+                rows.extend(it.rows)
+            try:
+                raw = self.score_fn(rows)
+            except Exception as e:  # noqa: BLE001 — fan the fault out
+                for it in live:
+                    it.future.set_exception(e)
+                    self._done()
+                continue
+            if self.on_batch is not None:
+                self.on_batch(len(live), len(rows))
+            off = 0
+            for it in live:
+                it.future.set_result(raw[off:off + it.n])
+                off += it.n
+                self._done()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Stop the worker (it drains the queue first), then fail
+        anything still queued."""
+        self._stop_evt.set()
+        self._thread.join(timeout)
+        while True:
+            try:
+                it = self._q.get_nowait()
+            except queue.Empty:
+                break
+            it.future.set_exception(RuntimeError(
+                f"deployment {self.name} was undeployed"))
+            self._done()

@@ -1,0 +1,221 @@
+"""ScoringEngine — compiled online predict with batch bucketing.
+
+Reference: the genmodel scoring path keeps one parsed MOJO per deployed
+model and scores rows against it with zero per-request setup
+(EasyPredictModelWrapper.java:65).  The TPU analog has an extra concern
+the JVM scorer never had: XLA compiles one program PER INPUT SHAPE, so a
+naive ``jit(predict)(rows)`` recompiles for every distinct batch size an
+online workload produces.  The engine bounds that:
+
+- batches pad to the next power of two (``_bucket``), so a deployment
+  compiles at most log2(max_batch)+1 predict programs, each reused by
+  every batch that rounds up to it;
+- compiled functions live in a bounded LRU keyed by
+  ``(model_id, version, batch_bucket)`` — hot-swapped or undeployed
+  versions age out instead of pinning device programs forever;
+- the cache is warmed at deploy time (bucket 1 + the max-batch bucket)
+  so the first real request never eats a compile;
+- model types without a device ``predict_raw_array`` fall back to the
+  pure-NumPy ``mojo``/genmodel scorer — same artifact math, no compile.
+
+Row encoding reuses the MOJO view of the model's training schema
+(columns in training order, categorical domain lookup, unseen level /
+missing column -> NaN), so online JSON rows and standalone artifact
+scoring agree by construction.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from h2o_tpu.core.chaos import chaos
+from h2o_tpu.core.log import get_logger
+
+log = get_logger("serve")
+
+DEFAULT_CACHE_ENTRIES = 64
+
+
+def _bucket(n: int) -> int:
+    """Smallest power of two >= n (the compile-bounding batch shape)."""
+    return 1 if n <= 1 else 1 << (int(n - 1).bit_length())
+
+
+class ScoringEngine:
+    """Schema encoding + compiled-predict cache for online scoring."""
+
+    def __init__(self, max_entries: Optional[int] = None):
+        import os
+        self.max_entries = int(max_entries or
+                               os.environ.get("H2O_TPU_SERVE_CACHE",
+                                              DEFAULT_CACHE_ENTRIES))
+        self._lock = threading.RLock()
+        # (model_id, version, bucket) -> jitted predict (LRU, bounded)
+        self._compiled: "OrderedDict[Tuple[str, int, int], Any]" = \
+            OrderedDict()
+        # (model_id, version) -> MojoModel schema/fallback view
+        self._views: Dict[Tuple[str, int], Any] = {}
+        # versions whose device predict failed to trace -> numpy fallback
+        self._no_device: set = set()
+        self.compiled_entries = 0          # cumulative compile count
+        self.device_batches = 0
+        self.fallback_batches = 0
+
+    # -- schema view ---------------------------------------------------------
+
+    def view(self, model, version: int = 0):
+        """MOJO view of a live model: training columns, categorical
+        domains, and the standalone numpy scorer — built once per
+        (model_id, version)."""
+        key = (str(model.key), int(version))
+        with self._lock:
+            v = self._views.get(key)
+        if v is not None:
+            return v
+        import jax
+        from h2o_tpu.mojo import MojoModel, _flatten_arrays
+        out = {k: (np.asarray(val) if isinstance(val, jax.Array) else val)
+               for k, val in model.output.items()}
+        arrays, meta = _flatten_arrays(out)
+        v = MojoModel(model.algo, dict(model.params), meta, arrays)
+        with self._lock:
+            self._views[key] = v
+        return v
+
+    def supports(self, model) -> bool:
+        """Deployable: a device predict OR a standalone numpy scorer."""
+        from h2o_tpu.mojo import scorers
+        return self.has_device_predict(model) or \
+            getattr(scorers, f"score_{model.algo}", None) is not None
+
+    @staticmethod
+    def has_device_predict(model) -> bool:
+        from h2o_tpu.models.model import Model
+        return type(model).predict_raw_array is not Model.predict_raw_array
+
+    # -- row encoding --------------------------------------------------------
+
+    def encode_rows(self, model, version: int,
+                    rows: Sequence[Dict[str, Any]]) -> np.ndarray:
+        """JSON row dicts -> (rows, C) float64 matrix in training-column
+        order.  Categorical strings map through the training domain;
+        unseen levels, missing columns and unparseable values score as
+        NA (NaN) — the convertUnknownCategoricalLevelsToNa behavior."""
+        view = self.view(model, version)
+        cols = view.columns
+        luts = {}
+        for c in cols:
+            dom = view.domain_of(c)
+            if dom is not None:
+                luts[c] = {str(s): float(i) for i, s in enumerate(dom)}
+        X = np.full((len(rows), len(cols)), np.nan, np.float64)
+        for i, row in enumerate(rows):
+            for j, c in enumerate(cols):
+                v = row.get(c)
+                if v is None:
+                    continue
+                if isinstance(v, str) and c in luts:
+                    X[i, j] = luts[c].get(v, np.nan)
+                else:
+                    try:
+                        X[i, j] = float(v)
+                    except (TypeError, ValueError):
+                        pass                      # unparseable -> NA
+        return X
+
+    # -- compiled predict ----------------------------------------------------
+
+    def _get_compiled(self, model, version: int, bucket: int):
+        import jax
+        key = (str(model.key), int(version), int(bucket))
+        with self._lock:
+            fn = self._compiled.get(key)
+            if fn is not None:
+                self._compiled.move_to_end(key)
+                return fn
+        fn = jax.jit(model.predict_raw_array)
+        with self._lock:
+            self._compiled[key] = fn
+            self.compiled_entries += 1
+            while len(self._compiled) > self.max_entries:
+                old, _ = self._compiled.popitem(last=False)
+                log.info("serve: evicting compiled predict %s", old)
+        return fn
+
+    def warm(self, model, version: int,
+             batch_sizes: Sequence[int] = (1,)) -> None:
+        """Pre-compile the deployment's predict programs (deploy-time
+        warm so first requests never pay the compile).  A model whose
+        device predict fails to trace is marked numpy-fallback instead
+        of failing the deploy."""
+        if not self.has_device_predict(model):
+            return
+        view = self.view(model, version)
+        ncols = len(view.columns)
+        for n in batch_sizes:
+            b = _bucket(int(n))
+            try:
+                fn = self._get_compiled(model, version, b)
+                np.asarray(fn(np.zeros((b, ncols), np.float32)))
+            except Exception as e:  # noqa: BLE001 — fall back, don't fail
+                log.warning("serve: device predict for %s v%d does not "
+                            "trace (%s); using numpy scorer", model.key,
+                            version, e)
+                with self._lock:
+                    self._no_device.add((str(model.key), int(version)))
+                    self._compiled.pop(
+                        (str(model.key), int(version), b), None)
+                return
+
+    def predict(self, model, version: int, X: np.ndarray) -> np.ndarray:
+        """Score one (already encoded) micro-batch.  Pads rows up to the
+        power-of-two bucket, runs the cached compiled predict, slices the
+        padding back off.  The chaos slow-score injector lives here so
+        overload shedding and deadline expiry are testable."""
+        chaos().maybe_slow_score(f"serve:{model.key}")
+        n = X.shape[0]
+        use_device = self.has_device_predict(model) and \
+            (str(model.key), int(version)) not in self._no_device
+        if not use_device:
+            raw = self.view(model, version).score_matrix(
+                np.asarray(X, np.float64))
+            with self._lock:
+                self.fallback_batches += 1
+            return np.asarray(raw)
+        b = _bucket(n)
+        Xp = np.zeros((b, X.shape[1]), np.float32)
+        Xp[:n] = X
+        fn = self._get_compiled(model, version, b)
+        raw = np.asarray(fn(Xp))
+        with self._lock:
+            self.device_batches += 1
+        return raw[:n]
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def buckets_for(self, model_id: str, version: int) -> List[int]:
+        with self._lock:
+            return sorted(b for (mid, ver, b) in self._compiled
+                          if mid == str(model_id) and ver == int(version))
+
+    def evict(self, model_id: str, version: int) -> None:
+        """Drop a version's compiled programs + schema view (undeploy /
+        rollback of a hot-swapped version)."""
+        key = (str(model_id), int(version))
+        with self._lock:
+            self._views.pop(key, None)
+            self._no_device.discard(key)
+            for k in [k for k in self._compiled if k[:2] == key]:
+                self._compiled.pop(k, None)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"compiled_cache_entries": len(self._compiled),
+                    "compiled_total": self.compiled_entries,
+                    "cache_capacity": self.max_entries,
+                    "device_batches": self.device_batches,
+                    "fallback_batches": self.fallback_batches}

@@ -1,0 +1,332 @@
+"""ServingRegistry — versioned model deployments behind stable aliases.
+
+Reference: H2O Steam's scoring-service registry — a deployed model gets a
+stable endpoint name, new versions roll out behind it, and operators can
+roll back without clients noticing.  Here a *deployment* is an alias
+name bound to a stack of ``(model_id, version)`` entries; the active
+binding switches atomically under the deployment lock:
+
+- ``deploy(name, model)`` — first call creates the alias at version 1;
+  deploying again to the same name is a HOT SWAP (version n+1 becomes
+  active; in-flight micro-batches finish on whichever version they
+  started encoding against);
+- ``rollback(name)`` — pop the active version, reactivate the previous
+  one, and evict the popped version's compiled programs;
+- ``undeploy(name)`` — mark the alias draining (new requests 404), wait
+  for in-flight requests to finish, stop the batcher, evict everything.
+
+Per-deployment stats: request/reject/deadline-expired counters and
+p50/p95/p99 latency over a fixed-size ring buffer (the TimeLine-ring
+idiom from core/diag.py applied to serving latency).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import TimeoutError as _FuturesTimeout
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from h2o_tpu.core.diag import TimeLine
+from h2o_tpu.core.log import get_logger
+from h2o_tpu.core.resilience import Deadline
+from h2o_tpu.serve.batcher import MicroBatcher, QueueFull
+from h2o_tpu.serve.engine import ScoringEngine
+
+log = get_logger("serve")
+
+LATENCY_RING = 1024
+
+
+class UnsupportedModelError(ValueError):
+    """Model type has neither a device predict nor a numpy scorer."""
+
+
+class ServingConfig:
+    """Per-deployment tuning (REST params of POST /3/Serving)."""
+
+    def __init__(self, max_batch: int = 32, max_delay_ms: float = 2.0,
+                 queue_cap: int = 64, deadline_ms: float = 0.0):
+        self.max_batch = int(max_batch)
+        self.max_delay_ms = float(max_delay_ms)
+        self.queue_cap = int(queue_cap)
+        self.deadline_ms = float(deadline_ms)   # 0 = unbounded
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"max_batch": self.max_batch,
+                "max_delay_ms": self.max_delay_ms,
+                "queue_cap": self.queue_cap,
+                "deadline_ms": self.deadline_ms}
+
+
+class DeploymentStats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.requests = 0
+        self.rejected = 0
+        self.expired = 0
+        self.batches = 0
+        self.rows_scored = 0
+        self.max_observed_batch = 0
+        self.latency_ms: deque = deque(maxlen=LATENCY_RING)
+
+    def record_batch(self, n_requests: int, n_rows: int) -> None:
+        with self.lock:
+            self.batches += 1
+            self.rows_scored += n_rows
+            self.max_observed_batch = max(self.max_observed_batch, n_rows)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self.lock:
+            lat = list(self.latency_ms)
+            out = {"request_count": self.requests,
+                   "reject_count": self.rejected,
+                   "deadline_expired_count": self.expired,
+                   "batch_count": self.batches,
+                   "rows_scored": self.rows_scored,
+                   "max_observed_batch": self.max_observed_batch}
+        if lat:
+            p50, p95, p99 = np.percentile(lat, [50, 95, 99])
+            out.update(p50_ms=float(p50), p95_ms=float(p95),
+                       p99_ms=float(p99))
+        else:
+            out.update(p50_ms=0.0, p95_ms=0.0, p99_ms=0.0)
+        return out
+
+
+class DeploymentVersion:
+    __slots__ = ("version", "model_id", "model")
+
+    def __init__(self, version: int, model):
+        self.version = version
+        self.model_id = str(model.key)
+        self.model = model
+
+
+class Deployment:
+    def __init__(self, name: str, config: ServingConfig,
+                 batcher: MicroBatcher):
+        self.name = name
+        self.config = config
+        self.batcher = batcher
+        self.lock = threading.Lock()
+        self.versions: List[DeploymentVersion] = []
+        self.active: Optional[DeploymentVersion] = None
+        self.draining = False
+        self.stats = DeploymentStats()
+        self.created = time.time()
+
+
+class ServingRegistry:
+    """Process-wide deployment table (the /3/Serving backing store)."""
+
+    def __init__(self, engine: Optional[ScoringEngine] = None):
+        self.engine = engine or ScoringEngine()
+        self._lock = threading.Lock()
+        self._deployments: Dict[str, Deployment] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def deploy(self, name: str, model,
+               config: Optional[ServingConfig] = None,
+               warm: bool = True) -> Dict[str, Any]:
+        """Create or hot-swap the alias ``name`` to ``model``.  The cache
+        is warmed (bucket 1 + the max-batch bucket) BEFORE the atomic
+        alias switch, so a swap never exposes a cold version."""
+        if not self.engine.supports(model):
+            raise UnsupportedModelError(
+                f"model type '{model.algo}' is not servable: no device "
+                "predict_raw_array and no standalone MOJO scorer")
+        config = config or ServingConfig()
+        with self._lock:
+            dep = self._deployments.get(name)
+            if dep is None:
+                dep = Deployment(name, config, batcher=None)
+                dep.batcher = MicroBatcher(
+                    score_fn=lambda rows, _d=dep: self._score_batch(
+                        _d, rows),
+                    max_batch=config.max_batch,
+                    max_delay_ms=config.max_delay_ms,
+                    queue_cap=config.queue_cap, name=name,
+                    on_batch=lambda k, n, _d=dep: self._on_batch(_d, k, n))
+                self._deployments[name] = dep
+            elif dep.draining:
+                raise RuntimeError(f"deployment {name} is draining")
+        with dep.lock:
+            version = (dep.versions[-1].version + 1) if dep.versions else 1
+        ver = DeploymentVersion(version, model)
+        if warm:
+            self.engine.warm(model, version,
+                             batch_sizes=(1, config.max_batch))
+        with dep.lock:
+            dep.config = config
+            dep.batcher.configure(config.max_batch, config.max_delay_ms,
+                                  config.queue_cap)
+            dep.versions.append(ver)
+            swapped = dep.active is not None
+            dep.active = ver
+        TimeLine.record("serve", "hot_swap" if swapped else "deploy",
+                        deployment=name, model=ver.model_id,
+                        version=version)
+        log.info("serve: %s %s -> %s v%d",
+                 "hot-swapped" if swapped else "deployed", name,
+                 ver.model_id, version)
+        return self.describe(dep)
+
+    def rollback(self, name: str) -> Dict[str, Any]:
+        dep = self._get(name)
+        with dep.lock:
+            if len(dep.versions) < 2:
+                raise ValueError(
+                    f"deployment {name} has no previous version to "
+                    "roll back to")
+            dropped = dep.versions.pop()
+            dep.active = dep.versions[-1]
+            active = dep.active
+        self.engine.evict(dropped.model_id, dropped.version)
+        TimeLine.record("serve", "rollback", deployment=name,
+                        from_version=dropped.version,
+                        to_version=active.version)
+        log.info("serve: rolled back %s v%d -> v%d", name,
+                 dropped.version, active.version)
+        return self.describe(dep)
+
+    def undeploy(self, name: str, drain_secs: float = 10.0) -> Dict:
+        """Drain in-flight requests, then remove the alias."""
+        dep = self._get(name)
+        with dep.lock:
+            dep.draining = True
+        deadline = Deadline(drain_secs)
+        while dep.batcher.pending > 0 and not deadline.expired:
+            time.sleep(0.005)
+        drained = dep.batcher.pending == 0
+        dep.batcher.stop()
+        with self._lock:
+            self._deployments.pop(name, None)
+        for ver in dep.versions:
+            self.engine.evict(ver.model_id, ver.version)
+        TimeLine.record("serve", "undeploy", deployment=name,
+                        drained=drained)
+        log.info("serve: undeployed %s (drained=%s)", name, drained)
+        return {"name": name, "drained": drained,
+                "stats": dep.stats.snapshot()}
+
+    def reset(self) -> None:
+        """Undeploy everything (test teardown)."""
+        for name in list(self._deployments):
+            try:
+                self.undeploy(name, drain_secs=1.0)
+            except KeyError:
+                pass
+
+    # -- scoring -------------------------------------------------------------
+
+    def score_rows(self, name: str, rows: Sequence[dict],
+                   deadline_ms: Optional[float] = None):
+        """Encode+score ``rows`` through the deployment's micro-batcher.
+
+        Raises ``KeyError`` (unknown/draining alias), :class:`QueueFull`
+        (shed — HTTP 429), ``TimeoutError`` (per-request deadline)."""
+        dep = self._get(name)
+        if dep.draining:
+            raise KeyError(f"deployment {name} is draining")
+        st = dep.stats
+        with st.lock:
+            st.requests += 1
+        if deadline_ms is None:
+            deadline_ms = dep.config.deadline_ms
+        dl = Deadline(deadline_ms / 1000.0) if deadline_ms else Deadline(0)
+        t0 = time.monotonic()
+        try:
+            fut = dep.batcher.submit(rows, deadline=dl)
+        except QueueFull:
+            with st.lock:
+                st.rejected += 1
+            TimeLine.record("serve", "shed", deployment=name)
+            raise
+        timeout = dl.remaining()
+        try:
+            raw = fut.result(timeout=None if timeout == float("inf")
+                             else timeout)
+        except (TimeoutError, _FuturesTimeout):
+            # worker-side expiry or wait timeout — same contract (408)
+            with st.lock:
+                st.expired += 1
+            raise TimeoutError(
+                f"scoring request on {name} exceeded its "
+                f"{deadline_ms:g}ms deadline")
+        with st.lock:
+            st.latency_ms.append((time.monotonic() - t0) * 1000.0)
+        ver = dep.active
+        return np.asarray(raw), ver
+
+    def _score_batch(self, dep: Deployment, rows: List[dict]):
+        """Batch body run on the worker thread: resolve the ACTIVE
+        version once, encode every request's rows against it, one device
+        dispatch."""
+        ver = dep.active
+        X = self.engine.encode_rows(ver.model, ver.version, rows)
+        return self.engine.predict(ver.model, ver.version, X)
+
+    def _on_batch(self, dep: Deployment, n_requests: int,
+                  n_rows: int) -> None:
+        dep.stats.record_batch(n_requests, n_rows)
+        TimeLine.record("serve", "batch", deployment=dep.name,
+                        requests=n_requests, rows=n_rows)
+
+    # -- introspection -------------------------------------------------------
+
+    def _get(self, name: str) -> Deployment:
+        dep = self._deployments.get(name)
+        if dep is None:
+            raise KeyError(f"no deployment named {name}")
+        return dep
+
+    def get(self, name: str) -> Optional[Deployment]:
+        return self._deployments.get(name)
+
+    def response_domain(self, dep: Deployment,
+                        ver: DeploymentVersion) -> Optional[List[str]]:
+        return self.engine.view(ver.model, ver.version).response_domain
+
+    def describe(self, dep: Deployment) -> Dict[str, Any]:
+        with dep.lock:
+            active = dep.active
+            versions = [{"version": v.version, "model_id": v.model_id,
+                         "active": v is active} for v in dep.versions]
+        return {
+            "name": dep.name,
+            "model_id": active.model_id if active else None,
+            "version": active.version if active else None,
+            "algo": active.model.algo if active else None,
+            "status": "draining" if dep.draining else "active",
+            "device_predict": self.engine.has_device_predict(
+                active.model) if active else False,
+            "compiled_buckets": self.engine.buckets_for(
+                active.model_id, active.version) if active else [],
+            "versions": versions,
+            "config": dep.config.as_dict(),
+            "queue_depth": dep.batcher.pending,
+            "stats": dep.stats.snapshot(),
+        }
+
+    def list(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            deps = list(self._deployments.values())
+        return [self.describe(d) for d in deps]
+
+
+_instance: Optional[ServingRegistry] = None
+_instance_lock = threading.Lock()
+
+
+def registry() -> ServingRegistry:
+    global _instance
+    if _instance is None:
+        with _instance_lock:
+            if _instance is None:
+                _instance = ServingRegistry()
+    return _instance

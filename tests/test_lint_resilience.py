@@ -38,3 +38,30 @@ def test_no_bare_urlopen_outside_persist():
         "through h2o_tpu.core.persist.read_bytes/write_bytes (or add a "
         "scheme backend in persist.py) so transient faults retry:\n"
         + "\n".join(offenders))
+
+
+# Per-request compiles must live behind serve/engine.py's bounded,
+# bucket-keyed cache — a jax.jit in a REST handler compiles an XLA
+# program per request shape and silently reopens the recompile storm the
+# serving engine closed.
+JIT_PATTERN = re.compile(r"\bjax\s*\.\s*jit\s*\(")
+JIT_IMPORT = re.compile(r"^\s*from\s+jax\s+import\s+.*\bjit\b")
+
+
+def test_no_jax_jit_in_api_handlers():
+    pkg_root = os.path.dirname(h2o_tpu.__file__)
+    api_dir = os.path.join(pkg_root, "api")
+    offenders = []
+    for name in sorted(os.listdir(api_dir)):
+        if not (name.startswith("handlers") and name.endswith(".py")):
+            continue
+        path = os.path.join(api_dir, name)
+        with open(path, encoding="utf-8", errors="replace") as f:
+            for i, line in enumerate(f, 1):
+                if JIT_PATTERN.search(line) or JIT_IMPORT.search(line):
+                    offenders.append(f"api/{name}:{i}: {line.strip()}")
+    assert not offenders, (
+        "jax.jit inside api/handlers*.py — per-request compiles belong "
+        "behind h2o_tpu/serve/engine.py's bounded compiled-predict "
+        "cache (power-of-two batch buckets), not in REST handlers:\n"
+        + "\n".join(offenders))

@@ -162,6 +162,34 @@ def test_profiler_samples(cl):
     assert len(counts) > 0
 
 
+def test_profiler_idempotent_start_stop(cl):
+    """Double-start must not leak a second sampler thread; stop after
+    stop is a no-op; the sampler is a daemon (never blocks exit)."""
+    import threading
+    import time
+    from h2o_tpu.core.diag import Profiler
+
+    def samplers():
+        return [t for t in threading.enumerate()
+                if t.name == "h2o-tpu-profiler"]
+
+    base = len(samplers())
+    p = Profiler(interval_s=0.002)
+    p.start()
+    p.start()                        # idempotent — no second thread
+    assert len(samplers()) == base + 1
+    assert all(t.daemon for t in samplers())
+    time.sleep(0.02)
+    counts = p.stop()
+    assert p.stop() == counts        # stop after stop: no-op
+    time.sleep(0.01)
+    assert len(samplers()) == base
+    # restart after stop resumes sampling with a fresh thread
+    p.start()
+    assert len(samplers()) == base + 1
+    p.stop()
+
+
 def test_rest_diag_routes(cl):
     import json
     import urllib.request

@@ -1,0 +1,364 @@
+"""Online scoring service (h2o_tpu/serve + /3/Serving REST surface).
+
+Covers the serving acceptance path: deploy -> score (single rows and
+bursts) -> hot-swap -> rollback -> undeploy, plus micro-batch
+coalescing without cross-request row mixing, admission-queue load
+shedding (429), chaos slow-score deadline expiry (408), compiled-cache
+batch bucketing, and MOJO-artifact parity of online predictions.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.shared_dkv
+
+N_ROWS = 240
+DOMAIN = ["a", "b", "c"]
+
+
+def _call(srv, method, path, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}", data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture(scope="module")
+def data(cl):
+    rng = np.random.default_rng(12)
+    X = rng.normal(size=(N_ROWS, 4)).astype(np.float32)
+    cat = rng.integers(0, 3, N_ROWS).astype(np.int32)
+    logits = 1.2 * X[:, 0] - X[:, 1] + 0.5 * (cat == 1)
+    y = (rng.uniform(size=N_ROWS) <
+         1 / (1 + np.exp(-logits))).astype(np.int32)
+    return X, cat, y
+
+
+def _make_frame(data):
+    from h2o_tpu.core.frame import Frame, T_CAT, Vec
+    X, cat, y = data
+    names = [f"x{j}" for j in range(4)] + ["c", "y"]
+    vecs = [Vec(X[:, j]) for j in range(4)] + [
+        Vec(cat, T_CAT, domain=list(DOMAIN)),
+        Vec(y, T_CAT, domain=["no", "yes"])]
+    return Frame(names, vecs)
+
+
+def _rows(data, idx, with_ids=False):
+    X, cat, _y = data
+    rows = []
+    for i in idx:
+        r = {f"x{j}": float(X[i, j]) for j in range(4)}
+        r["c"] = DOMAIN[int(cat[i])]
+        if with_ids:
+            r["_row_id"] = int(i)
+        rows.append(r)
+    return rows
+
+
+@pytest.fixture(scope="module")
+def models(cl, data):
+    from h2o_tpu.models.glm import GLM
+    from h2o_tpu.models.tree.gbm import GBM
+    gbm = GBM(ntrees=4, max_depth=3, seed=7).train(
+        y="y", training_frame=_make_frame(data))
+    glm = GLM(family="binomial").train(
+        y="y", training_frame=_make_frame(data))
+    return {"gbm": gbm, "glm": glm}
+
+
+@pytest.fixture(scope="module")
+def srv(cl):
+    from h2o_tpu.api.server import RestServer
+    from h2o_tpu.serve import registry
+    server = RestServer(port=0).start()
+    yield server
+    registry().reset()
+    server.stop()
+
+
+@pytest.fixture()
+def chaos_off():
+    from h2o_tpu.core.chaos import reset
+    yield
+    reset()
+
+
+# -- satellite: predict_array fast path (no DKV Frame) ----------------------
+
+def test_predict_array_matches_frame_scoring(cl, data, models):
+    fr = _make_frame(data)
+    for name, m in models.items():
+        Xraw = np.column_stack(
+            [np.asarray(fr.vec(c).as_float())[:N_ROWS]
+             for c in m.output["x"]])
+        via_array = np.asarray(m.predict_array(Xraw))
+        via_frame = np.asarray(m.predict_raw(fr))[:N_ROWS]
+        np.testing.assert_allclose(via_array, via_frame, atol=1e-5,
+                                   err_msg=f"{name} array/frame mismatch")
+
+
+def test_predict_array_numpy_fallback_kmeans(cl, data):
+    """Model families without a device predict_raw_array score through
+    the numpy MOJO scorer — same input convention, no Frame."""
+    from h2o_tpu.models.kmeans import KMeans
+    from h2o_tpu.serve import registry
+    fr = _make_frame(data).drop(["y", "c"])
+    km = KMeans(k=3, seed=5, max_iterations=5).train(training_frame=fr)
+    cols = registry().engine.view(km, 0).columns
+    assert cols == [f"x{j}" for j in range(4)]
+    Xraw = np.column_stack(
+        [np.asarray(fr.vec(c).as_float())[:N_ROWS] for c in cols])
+    clusters = np.asarray(km.predict_array(Xraw))
+    assert clusters.shape[0] == N_ROWS
+    assert set(np.unique(clusters)) <= {0.0, 1.0, 2.0}
+
+
+# -- parity: online scoring == exported-MOJO scoring ------------------------
+
+def test_online_scoring_matches_mojo(cl, data, models, srv, tmp_path):
+    """Deploy + score 50 rows through /3/Serving/<name>/score and check
+    predictions against mojo/genmodel scoring of the exported MOJO."""
+    from h2o_tpu.mojo import export_mojo, load_mojo
+    X, cat, _y = data
+    idx = list(range(50))
+    for name, m in models.items():
+        st, r = _call(srv, "POST", "/3/Serving",
+                      {"model_id": str(m.key), "name": f"parity_{name}"})
+        assert st == 200, r
+        assert r["deployment"]["version"] == 1
+        st, r = _call(srv, "POST", f"/3/Serving/parity_{name}/score",
+                      {"rows": _rows(data, idx)})
+        assert st == 200, r
+        preds = r["predictions"]
+        assert len(preds) == 50
+        mojo = load_mojo(export_mojo(m, str(tmp_path / f"{name}.zip")))
+        cols = {f"x{j}": X[idx, j] for j in range(4)}
+        cols["c"] = np.array([DOMAIN[int(c)] for c in cat[idx]])
+        raw = np.atleast_2d(mojo.predict(cols))
+        for i, p in enumerate(preds):
+            probs = p["probabilities"]
+            assert abs(probs["no"] - raw[i, 1]) < 1e-5, (name, i)
+            assert abs(probs["yes"] - raw[i, 2]) < 1e-5, (name, i)
+            assert p["predict"] in ("no", "yes")
+
+
+# -- lifecycle: hot swap, rollback, draining undeploy -----------------------
+
+def test_deploy_swap_rollback_undeploy(cl, data, models, srv):
+    gbm, glm = models["gbm"], models["glm"]
+    st, r = _call(srv, "POST", "/3/Serving",
+                  {"model_id": str(gbm.key), "name": "alias"})
+    assert st == 200 and r["deployment"]["version"] == 1
+    # hot swap: same alias, new model — version bumps atomically
+    st, r = _call(srv, "POST", "/3/Serving",
+                  {"model_id": str(glm.key), "name": "alias"})
+    assert st == 200
+    assert r["deployment"]["version"] == 2
+    assert r["deployment"]["model_id"] == str(glm.key)
+    st, r = _call(srv, "POST", "/3/Serving/alias/score",
+                  {"rows": _rows(data, [0, 1])})
+    assert st == 200 and r["model_id"] == str(glm.key) \
+        and r["version"] == 2
+    # rollback reactivates v1
+    st, r = _call(srv, "POST", "/3/Serving/alias/rollback")
+    assert st == 200
+    assert r["deployment"]["version"] == 1
+    assert r["deployment"]["model_id"] == str(gbm.key)
+    st, r = _call(srv, "POST", "/3/Serving/alias/score",
+                  {"rows": _rows(data, [0])})
+    assert st == 200 and r["model_id"] == str(gbm.key)
+    # rollback past the first version is a clear 400
+    st, r = _call(srv, "POST", "/3/Serving/alias/rollback")
+    assert st == 400
+    # undeploy drains, then the alias is gone
+    st, r = _call(srv, "DELETE", "/3/Serving/alias")
+    assert st == 200 and r["drained"] is True
+    st, _ = _call(srv, "POST", "/3/Serving/alias/score",
+                  {"rows": _rows(data, [0])})
+    assert st == 404
+    st, r = _call(srv, "GET", "/3/Serving")
+    assert "alias" not in [d["name"] for d in r["deployments"]]
+    # every lifecycle transition left a TimeLine event (core/diag ring)
+    from h2o_tpu.core.diag import TimeLine
+    kinds = {e["what"] for e in TimeLine.snapshot()
+             if e["kind"] == "serve"}
+    assert {"deploy", "hot_swap", "rollback", "undeploy"} <= kinds, kinds
+
+
+def test_deploy_validation(cl, srv):
+    st, _ = _call(srv, "POST", "/3/Serving", {"model_id": "nope"})
+    assert st == 404
+    st, _ = _call(srv, "POST", "/3/Serving", {})
+    assert st == 400
+    st, _ = _call(srv, "GET", "/3/Serving/missing")
+    assert st == 404
+
+
+# -- micro-batching: coalescing + no cross-request row mixing ---------------
+
+def test_microbatch_coalesces_without_row_mixing(cl, data, models, srv,
+                                                 chaos_off):
+    """Hammer one deployment from 8 threads with single-row requests.
+    Chaos slow-score holds each device batch long enough that queued
+    requests must coalesce; the echoed _row_id pins every prediction to
+    its request."""
+    from h2o_tpu.core.chaos import configure
+    gbm = models["gbm"]
+    st, _ = _call(srv, "POST", "/3/Serving",
+                  {"model_id": str(gbm.key), "name": "burst",
+                   "max_batch": 16, "max_delay_ms": 20, "queue_cap": 256})
+    assert st == 200
+    # reference predictions, computed once through the array fast path
+    fr = _make_frame(data)
+    Xraw = np.column_stack(
+        [np.asarray(fr.vec(c).as_float())[:N_ROWS]
+         for c in gbm.output["x"]])
+    ref = np.asarray(gbm.predict_array(Xraw))
+    configure(score_slow_p=1.0, score_slow_ms=40, seed=1)
+    results = {}
+    errors = []
+    barrier = threading.Barrier(8)
+
+    def worker(tid):
+        barrier.wait()
+        for i in range(tid, 32, 8):
+            st_i, r_i = _call(srv, "POST", "/3/Serving/burst/score",
+                              {"rows": _rows(data, [i], with_ids=True)})
+            if st_i != 200:
+                errors.append((i, st_i, r_i))
+            else:
+                results[i] = r_i["predictions"][0]
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert len(results) == 32
+    for i, p in results.items():
+        assert p["row_id"] == i          # the echo survived batching
+        assert abs(p["probabilities"]["yes"] - ref[i, 2]) < 1e-5, i
+    st, r = _call(srv, "GET", "/3/Serving/burst")
+    stats = r["deployment"]["stats"]
+    assert stats["max_observed_batch"] > 1, stats   # coalescing happened
+    assert stats["request_count"] >= 32
+    assert stats["p50_ms"] > 0 and stats["p99_ms"] >= stats["p50_ms"]
+    _call(srv, "DELETE", "/3/Serving/burst")
+
+
+def test_queue_cap_sheds_load_as_429(cl, data, models, srv, chaos_off):
+    from h2o_tpu.core.chaos import configure
+    gbm = models["gbm"]
+    st, _ = _call(srv, "POST", "/3/Serving",
+                  {"model_id": str(gbm.key), "name": "tiny",
+                   "max_batch": 1, "max_delay_ms": 0, "queue_cap": 2})
+    assert st == 200
+    configure(score_slow_p=1.0, score_slow_ms=150, seed=1)
+    codes = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(12)
+
+    def worker():
+        barrier.wait()
+        st_i, _ = _call(srv, "POST", "/3/Serving/tiny/score",
+                        {"rows": _rows(data, [0])})
+        with lock:
+            codes.append(st_i)
+
+    threads = [threading.Thread(target=worker) for _ in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert 429 in codes, codes          # overflow shed
+    assert 200 in codes, codes          # admitted requests still score
+    st, r = _call(srv, "GET", "/3/Serving/tiny")
+    assert r["deployment"]["stats"]["reject_count"] >= 1
+    _call(srv, "DELETE", "/3/Serving/tiny")
+
+
+def test_deadline_expiry_returns_408(cl, data, models, srv, chaos_off):
+    from h2o_tpu.core.chaos import configure
+    gbm = models["gbm"]
+    st, _ = _call(srv, "POST", "/3/Serving",
+                  {"model_id": str(gbm.key), "name": "slow",
+                   "deadline_ms": 30})
+    assert st == 200
+    configure(score_slow_p=1.0, score_slow_ms=300, seed=1)
+    st, r = _call(srv, "POST", "/3/Serving/slow/score",
+                  {"rows": _rows(data, [0])})
+    assert st == 408, r
+    st, r = _call(srv, "GET", "/3/Serving/slow")
+    assert r["deployment"]["stats"]["deadline_expired_count"] >= 1
+    _call(srv, "DELETE", "/3/Serving/slow")
+
+
+# -- compiled-predict cache: power-of-two batch bucketing -------------------
+
+def test_batch_bucketing_bounds_recompiles(cl, data, models):
+    from h2o_tpu.serve.engine import ScoringEngine, _bucket
+    assert [_bucket(n) for n in (1, 2, 3, 4, 5, 8, 9, 16, 17)] == \
+        [1, 2, 4, 4, 8, 8, 16, 16, 32]
+    gbm = models["gbm"]
+    eng = ScoringEngine()
+    fr = _make_frame(data)
+    Xraw = np.column_stack(
+        [np.asarray(fr.vec(c).as_float())[:N_ROWS]
+         for c in gbm.output["x"]])
+    ref = np.asarray(gbm.predict_array(Xraw))
+    # 5..8-row batches all round up to ONE bucket-8 program
+    for n in (5, 6, 7, 8):
+        out = eng.predict(gbm, 1, Xraw[:n])
+        assert out.shape[0] == n
+        np.testing.assert_allclose(out, ref[:n], atol=1e-5)
+    assert eng.compiled_entries == 1
+    assert eng.buckets_for(str(gbm.key), 1) == [8]
+    eng.predict(gbm, 1, Xraw[:3])        # new bucket: 4
+    assert eng.compiled_entries == 2
+    eng.evict(str(gbm.key), 1)
+    assert eng.buckets_for(str(gbm.key), 1) == []
+
+
+def test_device_gate_active_on_host_mesh(cl):
+    """The forced-8-device CPU mesh must serialize collective programs
+    (XLA:CPU has no gang scheduler — concurrent all-reduce programs
+    from parallel builds deadlock at the rendezvous without this)."""
+    import threading
+    from h2o_tpu.core.cloud import cloud
+    gate = cloud().device_gate()
+    assert isinstance(gate, type(threading.RLock()))
+    with gate:           # reentrant: CV sub-builds fit under the parent
+        with cloud().device_gate():
+            pass
+
+
+def test_encode_rows_handles_unknowns(cl, data, models):
+    """Unseen categorical levels, missing columns and junk values score
+    as NA instead of erroring (convertUnknownCategoricalLevelsToNa)."""
+    from h2o_tpu.serve import registry
+    gbm = models["gbm"]
+    eng = registry().engine
+    X = eng.encode_rows(gbm, 1, [
+        {"x0": 1.0, "x1": 2.0, "x2": 3.0, "x3": 4.0, "c": "b"},
+        {"x0": 1.0, "c": "NEVER-SEEN", "x1": "junk"},
+    ])
+    assert X.shape == (2, 5)
+    assert X[0, 4] == 1.0                      # "b" -> code 1
+    assert np.isnan(X[1, 4])                   # unseen level -> NA
+    assert np.isnan(X[1, 1])                   # junk -> NA
+    assert np.isnan(X[1, 2]) and np.isnan(X[1, 3])   # missing -> NA
+    raw = gbm.predict_array(X)                 # NAs route through trees
+    assert np.isfinite(np.asarray(raw)).all()
