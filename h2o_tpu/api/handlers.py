@@ -1242,6 +1242,18 @@ def device_memory_route(params):
     return {"devices": device_memory()}
 
 
+@route("GET", r"/3/Dispatch")
+def dispatch_route(params):
+    """Data-plane dispatch observability: per-phase compile/dispatch/
+    transfer counters (core/diag.DispatchStats) plus the compiled-
+    program cache's hit/miss totals (core/mrtask.DispatchCache) — the
+    numbers that prove steady-state training recompiles nothing."""
+    from h2o_tpu.core.diag import DispatchStats
+    from h2o_tpu.core.mrtask import dispatch_cache
+    return {"dispatch": DispatchStats.snapshot(),
+            "cache": dispatch_cache().stats()}
+
+
 @route("GET", r"/3/Recovery")
 def recovery_list(params):
     """Pending recovery snapshots, with iteration-checkpoint state

@@ -198,8 +198,7 @@ def network_test(params):
     UDP/TCP round-trips between nodes; the TPU-native rebuild measures the
     fabric that replaced them: psum over the mesh's ``nodes`` axis at
     several payload sizes)."""
-    import jax
-    import jax.numpy as jnp
+    from h2o_tpu.core.mrtask import device_sum
 
     c = cloud()
     sizes = [1 << 10, 1 << 16, 1 << 20]   # bytes of f32 payload
@@ -209,15 +208,11 @@ def network_test(params):
         x = c.device_put_rows(np.ones(
             ((n + c.n_nodes - 1) // c.n_nodes) * c.n_nodes, np.float32))
 
-        @jax.jit
-        def allreduce(x):
-            return x.sum()
-
-        allreduce(x).block_until_ready()          # compile untimed
+        device_sum(x).block_until_ready()         # compile untimed
         reps = 5
         t0 = time.time()
         for _ in range(reps):
-            out = allreduce(x)
+            out = device_sum(x)
         out.block_until_ready()
         us = (time.time() - t0) / reps * 1e6
         mbs = size / (us / 1e6) / 1e6

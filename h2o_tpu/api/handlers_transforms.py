@@ -27,6 +27,13 @@ def _key(name, tpe="Key"):
     return {"name": str(name), "type": tpe, "URL": None}
 
 
+def _nan_where(x, m):
+    """Module-level mask->NaN transform for Vec.map_inplace (a per-call
+    closure would miss the dispatch cache every time)."""
+    import jax.numpy as jnp
+    return jnp.where(m, jnp.nan, x)
+
+
 def _frame_or_404(frame_id) -> Frame:
     fr = cloud().dkv.get(frame_id)
     if not isinstance(fr, Frame):
@@ -166,11 +173,21 @@ def missing_inserter(params):
     job = Job(dest=str(fr.key), description="Insert Missing Values")
 
     def body(j):
+        from h2o_tpu.core.frame import T_NUM
         for i, v in enumerate(fr.vecs):
             mask = rng.uniform(size=fr.nrows) < fraction
             if v.host_data is not None:
                 v.host_data = [None if m else x
                                for x, m in zip(v.host_data, mask)]
+                continue
+            if v.type == T_NUM and v._data is not None:
+                # in-place device path: pad the mask (padding rows stay
+                # untouched) and mutate through the dispatch cache, which
+                # DONATES the old payload on donation backends
+                pm = np.zeros((v._data.shape[0],), bool)
+                pm[: fr.nrows] = mask
+                v.map_inplace(_nan_where, cloud().device_put_rows(pm))
+                fr._matrix_cache.clear()
                 continue
             arr = v.to_numpy().copy()
             if v.is_categorical:

@@ -67,6 +67,10 @@ class _Chaos:
         self.score_slow_p = float(e("H2O_TPU_CHAOS_SCORE_SLOW", 0) or 0)
         self.score_slow_ms = float(
             e("H2O_TPU_CHAOS_SCORE_SLOW_MS", 200) or 200)
+        self.transfer_slow_p = float(
+            e("H2O_TPU_CHAOS_TRANSFER_SLOW", 0) or 0)
+        self.transfer_slow_ms = float(
+            e("H2O_TPU_CHAOS_TRANSFER_SLOW_MS", 100) or 100)
         seed = e("H2O_TPU_CHAOS_SEED")
         self._rng = np.random.default_rng(
             int(seed) if seed is not None else None)
@@ -76,12 +80,14 @@ class _Chaos:
         self.injected_persist = 0
         self.injected_stalls = 0
         self.injected_slow_scores = 0
+        self.injected_slow_transfers = 0
 
     @property
     def enabled(self) -> bool:
         return (self.job_p > 0 or self.device_put_p > 0 or
                 self.persist_p > 0 or self.persist_transient > 0 or
-                self.stall_p > 0 or self.score_slow_p > 0)
+                self.stall_p > 0 or self.score_slow_p > 0 or
+                self.transfer_slow_p > 0)
 
     def _roll(self, p: float) -> bool:
         if p <= 0:
@@ -139,6 +145,18 @@ class _Chaos:
                         self.score_slow_ms)
             time.sleep(self.score_slow_ms / 1000.0)
 
+    def maybe_slow_transfer(self, what: str = "transfer") -> None:
+        """Slow-transfer injector: sleep inside a device->host block
+        materialization — in the async tree driver this widens the host
+        window block *t+1*'s device build must hide, making the overlap
+        (or its absence) visible to timed assertions."""
+        if self._roll(self.transfer_slow_p):
+            with self._lock:
+                self.injected_slow_transfers += 1
+            log.warning("chaos: slowing %s transfer by %.0fms", what,
+                        self.transfer_slow_ms)
+            time.sleep(self.transfer_slow_ms / 1000.0)
+
     def maybe_stall(self, what: str) -> None:
         """Stall injector: sleep without a progress heartbeat — the job
         watchdog (core/job.py) must detect and expire the job."""
@@ -164,7 +182,9 @@ def configure(job_p: float = 0.0, device_put_p: float = 0.0,
               seed: Optional[int] = None, persist_p: float = 0.0,
               persist_transient: int = 0, stall_p: float = 0.0,
               stall_secs: float = 30.0, score_slow_p: float = 0.0,
-              score_slow_ms: float = 200.0) -> _Chaos:
+              score_slow_ms: float = 200.0,
+              transfer_slow_p: float = 0.0,
+              transfer_slow_ms: float = 100.0) -> _Chaos:
     """Programmatic enable (tests); returns the active instance."""
     global _instance
     _instance = _Chaos()
@@ -176,6 +196,8 @@ def configure(job_p: float = 0.0, device_put_p: float = 0.0,
     _instance.stall_secs = float(stall_secs)
     _instance.score_slow_p = float(score_slow_p)
     _instance.score_slow_ms = float(score_slow_ms)
+    _instance.transfer_slow_p = float(transfer_slow_p)
+    _instance.transfer_slow_ms = float(transfer_slow_ms)
     if seed is not None:
         _instance._rng = np.random.default_rng(seed)
     return _instance

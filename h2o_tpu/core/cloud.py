@@ -61,6 +61,22 @@ def backend_is_tpu() -> bool:
         return False
 
 
+def donation_enabled() -> bool:
+    """Buffer-donation switch for the hot carries (forest F, scorer F,
+    serve micro-batches, in-place frame mutations).  H2O_TPU_DONATE=1
+    forces donation on, =0 forces it off; unset defaults to
+    donation-on-TPU only — XLA:CPU ignores donation (the buffers are
+    simply not aliased) and warns per call, so the CPU test mesh runs
+    the non-donating variants unless a test opts in explicitly.
+    Resolve OUTSIDE jit traces (it selects between jit wrappers)."""
+    v = os.environ.get("H2O_TPU_DONATE", "").lower()
+    if v in ("0", "off", "false", "no"):
+        return False
+    if v in ("1", "on", "true", "yes"):
+        return True
+    return backend_is_tpu()
+
+
 def _enable_compile_cache() -> None:
     """Persistent XLA compilation cache (process-wide, once).
 

@@ -30,6 +30,99 @@ from typing import Any, Dict, List, Optional
 MAX_EVENTS = 2048
 
 
+class DispatchStats:
+    """Per-phase compile/dispatch/transfer counters — the data-plane
+    observability the MRTask-era stack never needed (one JVM task = one
+    "dispatch") but an XLA substrate lives or dies by: a hot loop that
+    recompiles per call shows up here as compiles growing with
+    dispatches instead of staying flat.
+
+    Phases are free-form strings ("map_reduce", "tree_block", "rollups",
+    "quantile"...).  ``xla_compiles`` counts BACKEND compiles globally
+    via jax's monitoring events (install_xla_listener), so even jit
+    sites that do not route through the dispatch cache are visible —
+    the number the compile-count regression tests and the bench's
+    compiles-per-tree report are built on.
+    """
+
+    _lock = threading.Lock()
+    _compiles: Dict[str, int] = {}
+    _dispatches: Dict[str, int] = {}
+    _cache_hits: Dict[str, int] = {}
+    _transfers: Dict[str, int] = {}
+    _transfer_bytes: Dict[str, int] = {}
+    _xla_compiles = 0
+    _listener_installed = False
+
+    @classmethod
+    def _bump(cls, d: Dict[str, int], phase: str, n: int = 1) -> None:
+        with cls._lock:
+            d[phase] = d.get(phase, 0) + n
+
+    @classmethod
+    def note_compile(cls, phase: str) -> None:
+        cls._bump(cls._compiles, phase)
+        TimeLine.record("dispatch", "compile", phase=phase)
+
+    @classmethod
+    def note_dispatch(cls, phase: str) -> None:
+        cls._bump(cls._dispatches, phase)
+
+    @classmethod
+    def note_cache_hit(cls, phase: str) -> None:
+        cls._bump(cls._cache_hits, phase)
+
+    @classmethod
+    def note_transfer(cls, phase: str, nbytes: int = 0) -> None:
+        cls._bump(cls._transfers, phase)
+        cls._bump(cls._transfer_bytes, phase, int(nbytes))
+
+    @classmethod
+    def install_xla_listener(cls) -> None:
+        """Idempotent: register a jax monitoring listener that counts
+        backend compiles (the '/jax/core/compile/backend_compile_
+        duration' event — one per XLA executable actually built)."""
+        with cls._lock:
+            if cls._listener_installed:
+                return
+            cls._listener_installed = True
+        from jax._src import monitoring
+
+        def on_event(event: str, duration: float, **kw) -> None:
+            if event.endswith("backend_compile_duration"):
+                with cls._lock:
+                    cls._xla_compiles += 1
+
+        monitoring.register_event_duration_secs_listener(on_event)
+
+    @classmethod
+    def xla_compiles(cls) -> int:
+        with cls._lock:
+            return cls._xla_compiles
+
+    @classmethod
+    def snapshot(cls) -> Dict[str, Any]:
+        with cls._lock:
+            return {"compiles": dict(cls._compiles),
+                    "dispatches": dict(cls._dispatches),
+                    "cache_hits": dict(cls._cache_hits),
+                    "transfers": dict(cls._transfers),
+                    "transfer_bytes": dict(cls._transfer_bytes),
+                    "xla_compiles": cls._xla_compiles,
+                    "xla_listener": cls._listener_installed}
+
+    @classmethod
+    def reset(cls) -> None:
+        """Zero the per-phase counters (the global xla_compiles counter
+        keeps running — it is a monotone process-lifetime count)."""
+        with cls._lock:
+            cls._compiles.clear()
+            cls._dispatches.clear()
+            cls._cache_hits.clear()
+            cls._transfers.clear()
+            cls._transfer_bytes.clear()
+
+
 class TimeLine:
     """Fixed-size event ring (water/TimeLine.java)."""
 

@@ -253,6 +253,8 @@ class Vec:
     @property
     def rollups(self) -> RollupStats:
         if self._rollups is None:
+            from h2o_tpu.core.diag import DispatchStats
+            DispatchStats.note_dispatch("rollups")
             d = _rollups_matrix_kernel(self.as_float()[:, None],
                                        jnp.int32(self.nrows))
             self._rollups = RollupStats(
@@ -289,6 +291,27 @@ class Vec:
     def invalidate(self) -> None:
         self._rollups = None
         self._hist = None
+
+    # -- in-place mutation (donating) --------------------------------------
+
+    def map_inplace(self, fn, *extras) -> None:
+        """Elementwise in-place transform of the device payload:
+        ``payload = fn(payload, *extras)`` through the dispatch cache,
+        DONATING the old buffer when the backend supports it
+        (H2O_TPU_DONATE) — the mutating-frame-op analog of the forest
+        carry donation: no fresh HBM allocation per mutation.  ``fn``
+        must be a module-level function (a per-call closure would defeat
+        the cache).  Rollups/histograms invalidate; callers that hold
+        the vec in a Frame must clear that frame's matrix cache."""
+        assert self._data is not None or self._spill_np is not None, \
+            "map_inplace needs a device payload"
+        assert self._host_f64 is None, \
+            "map_inplace would desync the exact host copy (T_TIME)"
+        from h2o_tpu.core.mrtask import mutate_array
+        # route through the data property so spilled payloads reload
+        new = mutate_array(fn, self.data, *extras)
+        self.data = new                # setter re-registers with the MM
+        self.invalidate()
 
 
 class SparseVec(Vec):
@@ -515,6 +538,8 @@ class Frame:
                 self.vec(n).data is not None]
         if not todo:
             return
+        from h2o_tpu.core.diag import DispatchStats
+        DispatchStats.note_dispatch("rollups")
         m = self.as_matrix(todo)
         d = jax.tree.map(np.asarray,
                          _rollups_matrix_kernel(m, jnp.int32(self.nrows)))

@@ -17,18 +17,35 @@ T arriving back at the caller).
 For elementwise outputs (the reference's NewChunk-producing MRTasks that
 build new aligned Frames, MRTask.java doAll(nouts...)), use ``map_frame`` —
 the output stays row-sharded and aligned with the input by construction.
+
+DISPATCH CACHE: compilation is a ONE-TIME cost per (fn, reduce, shapes/
+dtypes/shardings) signature.  The original implementation wrapped a fresh
+closure in ``jax.jit`` on every call, so every rollup, quantile and Gram
+pass re-traced and re-compiled from scratch — exactly the framework
+overhead the one-compiled-program premise forbids.  ``DispatchCache``
+holds the jitted executables in a bounded LRU keyed on the map function's
+identity (the key strongly references the function, so ``id`` reuse is
+impossible while the entry lives) plus the argument avals; repeated calls
+with identical shapes hit one executable.  Hit/miss counters feed
+core/diag.DispatchStats and the GET /3/Dispatch REST surface.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Callable, Sequence
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from h2o_tpu.core.cloud import DATA_AXIS, cloud, shard_map_compat
+from h2o_tpu.core.cloud import (DATA_AXIS, cloud, donation_enabled,
+                                shard_map_compat)
+from h2o_tpu.core.diag import DispatchStats
 from h2o_tpu.core.frame import Frame
 
 REDUCERS = {
@@ -36,6 +53,83 @@ REDUCERS = {
     "min": lambda x: jax.lax.pmin(x, DATA_AXIS),
     "max": lambda x: jax.lax.pmax(x, DATA_AXIS),
 }
+
+_DEFAULT_CACHE_ENTRIES = 256
+
+
+def _aval_key(x) -> Tuple:
+    """Hashable signature of one argument: shape/dtype/sharding for
+    arrays (a resharded input is a different program), value for
+    hashable statics."""
+    if isinstance(x, jax.Array):
+        try:
+            shard = repr(x.sharding)
+        except Exception:  # noqa: BLE001 — deleted/donated arrays
+            shard = None
+        return ("arr", x.shape, str(x.dtype), shard)
+    if isinstance(x, np.ndarray):
+        return ("np", x.shape, str(x.dtype))
+    return ("static", type(x).__name__, x)
+
+
+class DispatchCache:
+    """Bounded LRU of compiled dispatch programs with hit/miss counters.
+
+    One entry = one executable: the builder is only invoked on a miss,
+    so ``misses`` IS the compile count for everything routed through the
+    cache (the compile-count regression tests assert on exactly this).
+    Entries pin their key's function object, so a long-lived cache also
+    keeps ``id(fn)`` collisions impossible; the LRU bound
+    (H2O_TPU_DISPATCH_CACHE, default 256) keeps that pinning finite.
+    """
+
+    def __init__(self, max_entries: int = None):
+        self.max_entries = int(max_entries or os.environ.get(
+            "H2O_TPU_DISPATCH_CACHE", _DEFAULT_CACHE_ENTRIES))
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[Tuple, Any]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get_or_build(self, phase: str, key: Tuple,
+                     build: Callable[[], Any]):
+        with self._lock:
+            fn = self._entries.get(key)
+            if fn is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+        if fn is not None:
+            DispatchStats.note_cache_hit(phase)
+            return fn
+        # build outside the lock: tracing can be slow and may itself
+        # dispatch; a rare concurrent double-build is harmless (last
+        # writer wins, both executables are correct)
+        fn = build()
+        with self._lock:
+            self._entries[key] = fn
+            self.misses += 1
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+        DispatchStats.note_compile(phase)
+        return fn
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"entries": len(self._entries),
+                    "capacity": self.max_entries,
+                    "hits": self.hits, "misses": self.misses}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+_CACHE = DispatchCache()
+
+
+def dispatch_cache() -> DispatchCache:
+    """The module-level compiled-program cache (REST + tests)."""
+    return _CACHE
 
 
 def map_reduce(map_fn: Callable, *arrays: jax.Array, reduce: str = "sum",
@@ -45,21 +139,33 @@ def map_reduce(map_fn: Callable, *arrays: jax.Array, reduce: str = "sum",
     ``arrays`` are row-sharded (leading axis over ``nodes``); ``map_fn``
     receives the local shard(s) plus replicated extras and returns a pytree of
     fixed-shape accumulators (histograms, Gram blocks, partial sums...).
+    Repeated calls with the same (map_fn, reduce, shapes) reuse ONE
+    compiled executable via the dispatch cache.
     """
     c = cloud()
     mesh = c.mesh
     red = REDUCERS[reduce]
-    in_specs = tuple(P(DATA_AXIS, *([None] * (a.ndim - 1))) for a in arrays)
-    in_specs += tuple(P() for _ in extra_args)
+    key = ("map_reduce", map_fn, reduce,
+           tuple(_aval_key(a) for a in arrays),
+           tuple(_aval_key(e) for e in extra_args))
 
-    @functools.partial(shard_map_compat, mesh=mesh,
-                       in_specs=in_specs, out_specs=P(),
-                       check_vma=False)
-    def run(*xs):
-        out = map_fn(*xs)
-        return jax.tree.map(red, out)
+    def build():
+        in_specs = tuple(P(DATA_AXIS, *([None] * (a.ndim - 1)))
+                         for a in arrays)
+        in_specs += tuple(P() for _ in extra_args)
 
-    return jax.jit(run)(*arrays, *extra_args)
+        @functools.partial(shard_map_compat, mesh=mesh,
+                           in_specs=in_specs, out_specs=P(),
+                           check_vma=False)
+        def run(*xs):
+            out = map_fn(*xs)
+            return jax.tree.map(red, out)
+
+        return jax.jit(run)
+
+    fn = _CACHE.get_or_build("map_reduce", key, build)
+    DispatchStats.note_dispatch("map_reduce")
+    return fn(*arrays, *extra_args)
 
 
 def map_frame(map_fn: Callable, frame: Frame,
@@ -68,10 +174,47 @@ def map_frame(map_fn: Callable, frame: Frame,
 
     Output sharding equals input sharding — the NewChunk/AppendableVec analog
     with alignment guaranteed by construction instead of VectorGroup checks.
+    Compiles once per (map_fn, matrix shape) via the dispatch cache instead
+    of re-jitting per call.
     """
     m = frame.as_matrix(names)
-    out = jax.jit(map_fn)(m)
-    return out
+    key = ("map_frame", map_fn, _aval_key(m))
+    fn = _CACHE.get_or_build("map_frame", key, lambda: jax.jit(map_fn))
+    DispatchStats.note_dispatch("map_frame")
+    return fn(m)
+
+
+def mutate_array(map_fn: Callable, array: jax.Array,
+                 *extras) -> jax.Array:
+    """Dispatch-cached elementwise mutation of a device payload.  When the
+    backend honors donation (core/cloud.donation_enabled) the input buffer
+    is DONATED to the program, so an in-place Vec mutation reuses its HBM
+    allocation instead of round-tripping through a fresh one.  The caller
+    must treat ``array`` as consumed."""
+    donate = donation_enabled()
+    key = ("mutate", map_fn, donate, _aval_key(array),
+           tuple(_aval_key(e) for e in extras))
+
+    def build():
+        return jax.jit(map_fn, donate_argnums=(0,) if donate else ())
+
+    fn = _CACHE.get_or_build("mutate", key, build)
+    DispatchStats.note_dispatch("mutate")
+    return fn(array, *extras)
+
+
+@jax.jit
+def _device_sum(x: jax.Array) -> jax.Array:
+    return x.sum()
+
+
+def device_sum(x: jax.Array) -> jax.Array:
+    """Module-level jitted all-reduce-style sum (one compile per shape,
+    shared process-wide) — used by the /3/NetworkTest collective
+    microbenchmark so repeated requests reuse the executable instead of
+    re-jitting a fresh closure per payload size per request."""
+    DispatchStats.note_dispatch("device_sum")
+    return _device_sum(x)
 
 
 def row_mask_shard(padded_rows: int, nrows: int) -> jax.Array:

@@ -73,9 +73,12 @@ def quantile_vec(vec: Vec, probs: Union[float, Sequence[float]],
     # target rank = p*(n-1) (type-7 style index; fractional part refined away)
     ranks = jnp.asarray(ps * (n - 1), data.dtype)
     nrows = jnp.int32(vec.nrows)
+    from h2o_tpu.core.diag import DispatchStats
     for _ in range(rounds):
+        DispatchStats.note_dispatch("quantile")
         los, his, ranks = _refine(data, nrows, los, his, ranks)
     out = np.asarray(los, np.float64)
+    DispatchStats.note_transfer("quantile", out.nbytes)
     return out[0] if scalar else out
 
 

@@ -365,44 +365,116 @@ def _lbfgs_minimize(value_and_grad, x0, max_iter: int = 200, m: int = 10,
     return x, f, it
 
 
+def _glm_obj(params, X, yz, wz, l2, pen, fam_name: str, tweedie_power,
+             theta, n_icpt: int):
+    """Penalized GLM negative log-likelihood (deviance/2) + l2/2 ||b||².
+    Module-level traced body: data AND the l2 strength are runtime args,
+    so the whole lambda path of a lambda search shares ONE compiled
+    value-and-grad executable per (family, shape) instead of re-jitting
+    a fresh closure per _glm_objective_fn call."""
+    P = X.shape[1]
+    if fam_name == "multinomial":
+        B = params.reshape(n_icpt, P + 1)
+        eta = X @ B[:, :-1].T + B[:, -1][None, :]          # (R, K)
+        lse = jax.scipy.special.logsumexp(eta, axis=1)
+        yk = jnp.clip(yz.astype(jnp.int32), 0, n_icpt - 1)
+        ll = jnp.take_along_axis(eta, yk[:, None], axis=1)[:, 0] - lse
+        nll = -jnp.sum(wz * ll)
+        return nll + 0.5 * l2 * jnp.sum(B[:, :-1] ** 2)
+    fam = _family(fam_name, tweedie_power, theta)
+    eta = X @ params[:-1] + params[-1]
+    mu = fam.link_inv(eta)
+    val = 0.5 * fam.deviance(yz, mu, wz) + \
+        0.5 * l2 * jnp.sum(params[:-1] ** 2)
+    if pen is not None:
+        val = val + 0.5 * params @ (pen @ params)
+    return val
+
+
+_glm_value_grad = functools.partial(
+    jax.jit, static_argnames=("fam_name", "tweedie_power", "theta",
+                              "n_icpt"))(jax.value_and_grad(_glm_obj))
+
+
 def _glm_objective_fn(X, yv, w, valid_m, fam_name: str, tweedie_power,
                       theta, l2, pen=None, n_icpt: int = 1):
-    """Penalized GLM negative log-likelihood (deviance/2) + l2/2 ||b||²,
-    jitted with its gradient.  ``pen`` is an optional quadratic penalty
-    matrix in Gram units (GAM curvature).  For multinomial pass the flat
-    (K*(P+1),) params with n_icpt=K — softmax NLL."""
+    """Penalized GLM objective closure for L-BFGS: routes through the
+    module-level jitted ``_glm_value_grad`` (one compile per family and
+    shape — the re-jit-per-call of the old inline ``jax.jit(jax.
+    value_and_grad(obj))`` is gone).  ``pen`` is an optional quadratic
+    penalty matrix in Gram units (GAM curvature).  For multinomial pass
+    the flat (K*(P+1),) params with n_icpt=K — softmax NLL."""
     yz = jnp.where(valid_m, jnp.nan_to_num(yv), 0.0)
     wz = jnp.where(valid_m, w, 0.0)
-    P = X.shape[1]
-
-    if fam_name == "multinomial":
-        def obj(params):
-            B = params.reshape(n_icpt, P + 1)
-            eta = X @ B[:, :-1].T + B[:, -1][None, :]      # (R, K)
-            lse = jax.scipy.special.logsumexp(eta, axis=1)
-            yk = jnp.clip(yz.astype(jnp.int32), 0, n_icpt - 1)
-            ll = jnp.take_along_axis(eta, yk[:, None], axis=1)[:, 0] - lse
-            nll = -jnp.sum(wz * ll)
-            reg = 0.5 * l2 * jnp.sum(B[:, :-1] ** 2)
-            return nll + reg
-    else:
-        fam = _family(fam_name, tweedie_power, theta)
-
-        def obj(params):
-            eta = X @ params[:-1] + params[-1]
-            mu = fam.link_inv(eta)
-            val = 0.5 * fam.deviance(yz, mu, wz) + \
-                0.5 * l2 * jnp.sum(params[:-1] ** 2)
-            if pen is not None:
-                val = val + 0.5 * params @ (pen @ params)
-            return val
-
-    vg = jax.jit(jax.value_and_grad(obj))
+    l2t = jnp.float32(l2)
 
     def value_and_grad(x):
-        f, g = vg(jnp.asarray(x, jnp.float32))
+        f, g = _glm_value_grad(jnp.asarray(x, jnp.float32), X, yz, wz,
+                               l2t, pen, fam_name=fam_name,
+                               tweedie_power=float(tweedie_power),
+                               theta=float(theta), n_icpt=int(n_icpt))
         return f, np.asarray(g)
     return value_and_grad
+
+
+def _ordinal_unpack(params, P: int, K: int):
+    """(beta, monotone thresholds) from the flat ordinal param vector —
+    softplus-increment parametrization keeps thr strictly increasing."""
+    beta = params[:P]
+    t0 = params[P]
+    if K > 2:
+        thr = jnp.concatenate(
+            [t0[None], t0 + jnp.cumsum(jax.nn.softplus(params[P + 1:]))])
+    else:
+        thr = t0[None]
+    return beta, thr
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("P", "K", "steps", "has_pen", "has_proj"))
+def _ordinal_gd(params0, X, yk, wa, n_obs, l1, l2, pen_dev, proj_mask, *,
+                P: int, K: int, steps: int, has_pen: bool,
+                has_proj: bool):
+    """Full-batch Adam on the exact cumulative-logit likelihood.
+    Module-level jitted (lambda strengths are runtime args): repeated
+    ordinal fits with the same shape share one executable instead of
+    re-jitting a per-fit closure."""
+    import optax
+
+    opt = optax.adam(optax.exponential_decay(0.5, steps // 4, 0.3))
+
+    def nll(params):
+        beta, thr = _ordinal_unpack(params, P, K)
+        eta = X @ beta
+        c = jax.nn.sigmoid(thr[None, :] - eta[:, None])    # (R, K-1)
+        c = jnp.concatenate([jnp.zeros_like(c[:, :1]), c,
+                             jnp.ones_like(c[:, :1])], axis=1)
+        idx = yk[:, None]
+        p_hi = jnp.take_along_axis(c, idx + 1, axis=1)[:, 0]
+        p_lo = jnp.take_along_axis(c, idx, axis=1)[:, 0]
+        pk = jnp.clip(p_hi - p_lo, EPS, 1.0)
+        obj = -jnp.sum(wa * jnp.log(pk)) / n_obs
+        if has_pen:
+            bf = jnp.concatenate([beta, jnp.zeros((1,))])
+            obj = obj + 0.5 * (bf @ pen_dev @ bf) / n_obs
+        return obj + 0.5 * l2 * jnp.sum(beta ** 2) + \
+            l1 * jnp.sum(jnp.abs(beta))
+
+    def step(carry, _):
+        prm, st = carry
+        loss, g = jax.value_and_grad(nll)(prm)
+        upd, st = opt.update(g, st, prm)
+        prm = optax.apply_updates(prm, upd)
+        if has_proj:
+            prm = jnp.where(proj_mask > 0,
+                            jnp.maximum(prm, 0.0), prm)
+        return (prm, st), loss
+
+    state = opt.init(params0)
+    (params, _), losses = jax.lax.scan(
+        step, (params0, state), None, length=steps)
+    return params, losses
 
 
 @jax.jit
@@ -1209,15 +1281,7 @@ class GLM(ModelBuilder):
             jnp.asarray(s0, jnp.float32)]).astype(jnp.float32)
 
         def unpack(params):
-            beta = params[:P]
-            t0 = params[P]
-            if K > 2:
-                thr = jnp.concatenate(
-                    [t0[None], t0 + jnp.cumsum(
-                        jax.nn.softplus(params[P + 1:]))])
-            else:
-                thr = t0[None]
-            return beta, thr
+            return _ordinal_unpack(params, P, K)
 
         # GAM wiring: quadratic penalty (calibrated on the sum-scale Gram
         # => divide by n_obs for this mean-scale objective) and the
@@ -1231,46 +1295,11 @@ class GLM(ModelBuilder):
                 jnp.asarray(mask, jnp.float32)[:P],
                 jnp.zeros((params0.shape[0] - P,), jnp.float32)])
 
-        def nll(params):
-            beta, thr = unpack(params)
-            eta = X @ beta
-            c = jax.nn.sigmoid(thr[None, :] - eta[:, None])    # (R, K-1)
-            c = jnp.concatenate([jnp.zeros_like(c[:, :1]), c,
-                                 jnp.ones_like(c[:, :1])], axis=1)
-            idx = yk[:, None]
-            p_hi = jnp.take_along_axis(c, idx + 1, axis=1)[:, 0]
-            p_lo = jnp.take_along_axis(c, idx, axis=1)[:, 0]
-            pk = jnp.clip(p_hi - p_lo, EPS, 1.0)
-            obj = -jnp.sum(wa * jnp.log(pk)) / n_obs
-            if pen_dev is not None:
-                bf = jnp.concatenate([beta, jnp.zeros((1,))])
-                obj = obj + 0.5 * (bf @ pen_dev @ bf) / n_obs
-            return obj + 0.5 * l2 * jnp.sum(beta ** 2) + \
-                l1 * jnp.sum(jnp.abs(beta))
-
-        import optax
         steps = 200 * max(max_iter, 10)        # full-batch; cheap per step
-        opt = optax.adam(optax.exponential_decay(0.5, steps // 4, 0.3))
-
-        @jax.jit
-        def run(params):
-            state = opt.init(params)
-
-            def step(carry, _):
-                prm, st = carry
-                loss, g = jax.value_and_grad(nll)(prm)
-                upd, st = opt.update(g, st, prm)
-                prm = optax.apply_updates(prm, upd)
-                if proj_mask is not None:
-                    prm = jnp.where(proj_mask > 0,
-                                    jnp.maximum(prm, 0.0), prm)
-                return (prm, st), loss
-
-            (params, _), losses = jax.lax.scan(
-                step, (params, state), None, length=steps)
-            return params, losses
-
-        params, losses = run(params0)
+        params, losses = _ordinal_gd(
+            params0, X, yk, wa, n_obs, jnp.float32(l1), jnp.float32(l2),
+            pen_dev, proj_mask, P=P, K=K, steps=steps,
+            has_pen=pen_dev is not None, has_proj=proj_mask is not None)
         job.update(0.9, f"ordinal GD {steps} steps, "
                         f"nll={float(losses[-1]):.5g}")
         beta, thr = unpack(params)

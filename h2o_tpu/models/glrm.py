@@ -82,7 +82,11 @@ def _prox(name: str):
                 nonnegative=non_negative, non_negative=non_negative)[name]
 
 
+@functools.lru_cache(maxsize=32)
 def _make_step(loss_name: str, rx: str, ry: str):
+    """Jitted objective/step pair, cached per config — repeated GLRM
+    builds with the same loss/regularizers reuse one executable instead
+    of re-jitting fresh closures per train."""
     loss = _loss_fn(loss_name)
     prox_x, prox_y = _prox(rx), _prox(ry)
 

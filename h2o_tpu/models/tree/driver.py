@@ -11,10 +11,27 @@ link-scale predictions are a running F to which only the new block's trees
 are added (one forest_score over the block), so total scoring work is O(T) —
 the reference's per-scoring-round full-model rescore (BigScore over all
 trees) is avoided entirely.
+
+ASYNC DOUBLE-BUFFERING (H2O_TPU_ASYNC_DRIVER, default on): the original
+loop blocked on ``np.asarray`` per block, serializing host
+materialization of block *t*'s tree arrays against the device build of
+block *t+1*.  Now block *t+1* is DISPATCHED before block *t* is
+materialized — the only device->host data t+1 needs is the carried F,
+which never leaves the device — and block *t*'s arrays are pulled with
+``copy_to_host_async`` so the transfer rides under t+1's compute.  Only
+the ScoreKeeper decision point synchronizes (its metrics need host
+values); an early stop discards the one speculatively-launched block,
+which is why speculative launches never donate their F0 (the stop path
+still needs the previous block's f_final).  Tree outputs are bitwise
+identical to the synchronous path: the RNG stream is split in the same
+order, and discarded speculative keys are exactly the keys the
+synchronous path never consumes.
 """
 
 from __future__ import annotations
 
+import functools
+import os
 import time
 from typing import Callable, Dict, Optional
 
@@ -22,7 +39,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from h2o_tpu.core.chaos import chaos
+from h2o_tpu.core.diag import DispatchStats, TimeLine
 from h2o_tpu.models.score_keeper import ScoreKeeper
+
+
+def async_driver_enabled() -> bool:
+    """H2O_TPU_ASYNC_DRIVER=0 restores the fully synchronous block loop
+    (the bitwise-equality reference the overlap tests compare against)."""
+    return os.environ.get("H2O_TPU_ASYNC_DRIVER", "1") != "0"
 
 
 def _set_node_array(model, name: str, new: np.ndarray) -> None:
@@ -69,20 +94,59 @@ class IncrementalScorer:
         self.fine_na = fine_na
 
     def add(self, sc, bs, vl, ch=None, th=None, na=None) -> None:
+        from h2o_tpu.core.cloud import donation_enabled
         from h2o_tpu.models.tree.shared_tree import forest_score
-        self.F = self.F + forest_score(
+        delta = forest_score(
             self.bins, jnp.asarray(sc), jnp.asarray(bs), jnp.asarray(vl),
             self.depth,
             child=jnp.asarray(ch) if ch is not None else None,
             thr=jnp.asarray(th) if th is not None else None,
             na_l=jnp.asarray(na) if na is not None else None,
             fine_na=self.fine_na)
+        # donate the running F into the accumulate: the scorer's carry is
+        # never read after being replaced, so in-place aliasing is always
+        # safe here (unlike the forest F, which speculation may re-read)
+        acc = _accum_donate if donation_enabled() else _accum
+        self.F = acc(self.F, delta)
 
     def metrics(self, ntrees_total: int):
         return self.to_metrics(self.F, ntrees_total)
 
 
+@jax.jit
+def _accum(F, delta):
+    return F + delta
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _accum_donate(F, delta):
+    return F + delta
+
+
 _CKPT_LISTS = ("scs", "bss", "vls", "chs", "gns", "nws", "ths", "nas")
+
+# TrainedForest fields pulled to the host per block (child may be None)
+_BLOCK_FIELDS = ("split_col", "bitset", "value", "child", "node_gain",
+                 "node_w", "thr_bin", "na_left", "varimp")
+
+
+def _start_host_pull(tf) -> None:
+    """Enqueue async device->host copies of a block's tree arrays so the
+    later ``np.asarray`` calls find the bytes already in flight (or
+    landed) instead of stalling the pipeline."""
+    for name in _BLOCK_FIELDS:
+        a = getattr(tf, name)
+        if a is not None:
+            try:
+                a.copy_to_host_async()
+            except Exception:  # noqa: BLE001 — optional fast path only;
+                return         # np.asarray below stays correct without it
+
+
+def _block_nbytes(tf) -> int:
+    return sum(int(np.prod(a.shape)) * a.dtype.itemsize
+               for name in _BLOCK_FIELDS
+               for a in (getattr(tf, name),) if a is not None)
 
 
 def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
@@ -181,12 +245,52 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
                 scorer.F = jnp.asarray(st["scorer_F"])
             job.update(0.05 + 0.85 * done / ntrees,
                        f"resumed mid-forest at {prior_trees + done} trees")
-    while done < ntrees:
-        n = min(block, ntrees - done)
+    use_async = async_driver_enabled()
+    may_stop = (rounds > 0 and scorer is not None) or max_rt > 0
+    # speculative launches must not donate their F0: on an early stop /
+    # runtime-budget break the discarded block's INPUT (the last kept
+    # block's f_final) is still read by make_model, and recovery
+    # checkpoints np.asarray the post-block F after the next block has
+    # already been dispatched.  Sync mode (and async without any stop
+    # path) uses the default donation policy — the carry is then written
+    # in place across blocks.
+    donate_launch = False if (use_async and
+                              (may_stop or recovery is not None)) else None
+    launched = done
+
+    def _launch(off: int, n: int) -> Dict:
+        nonlocal key, F
         key, sub = jax.random.split(key)
         tf = train_forest(F0=F, key=sub, ntrees=n,
-                          t0=prior_trees + done, **train_kwargs)
+                          t0=prior_trees + off, donate=donate_launch,
+                          **train_kwargs)
         F = tf.f_final
+        _start_host_pull(tf)
+        TimeLine.record("dispatch", "tree_block_launch",
+                        t0=prior_trees + off, n=n)
+        # key_after is what the sync loop would checkpoint at this block:
+        # the stream state BEFORE any speculative split for block t+1
+        return {"tf": tf, "n": n, "off": off, "key_after": key}
+
+    pend = None
+    if use_async and done < ntrees:
+        pend = _launch(launched, min(block, ntrees - launched))
+        launched += pend["n"]
+    while done < ntrees:
+        if use_async:
+            cur = pend
+            pend = None
+            if launched < ntrees:
+                # dispatch block t+1 BEFORE materializing block t — the
+                # host pulls below overlap its device build; only the
+                # ScoreKeeper decision point below synchronizes
+                pend = _launch(launched, min(block, ntrees - launched))
+                launched += pend["n"]
+        else:
+            cur = _launch(launched, min(block, ntrees - launched))
+            launched += cur["n"]
+        tf, n = cur["tf"], cur["n"]
+        chaos().maybe_slow_transfer("tree_block")
         scs.append(np.asarray(tf.split_col))
         bss.append(np.asarray(tf.bitset))
         vls.append(np.asarray(tf.value))
@@ -197,6 +301,9 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
         ths.append(np.asarray(tf.thr_bin))
         nas.append(np.asarray(tf.na_left))
         vi = np.asarray(tf.varimp)
+        TimeLine.record("dispatch", "tree_block_materialize",
+                        t0=prior_trees + cur["off"], n=n)
+        DispatchStats.note_transfer("tree_block", _block_nbytes(tf))
         vi_total = vi if vi_total is None else vi_total + vi
         done += n
         stop = False
@@ -224,17 +331,24 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
             recovery.save_iteration(
                 {"kind": "tree", "prior_trees": prior_trees,
                  "ntrees_target": ntrees, "block": block, "done": done,
-                 "F": np.asarray(F), "key": rng_key_to_np(key),
+                 "F": np.asarray(tf.f_final),
+                 "key": rng_key_to_np(cur["key_after"]),
                  "lists": lists, "vi_total": vi_total, "sk": sk,
                  "scorer_F": np.asarray(scorer.F)
                  if scorer is not None else None},
                 meta={"kind": "tree",
                       "trees_done": prior_trees + done,
                       "ntrees": int(p["ntrees"])})
-        if stop:
-            break
-        if max_rt > 0 and time.time() - t_start > max_rt:
+        if not stop and max_rt > 0 and time.time() - t_start > max_rt:
             job.update(0.9, f"max_runtime_secs hit at {done} trees")
+            stop = True
+        if stop:
+            if pend is not None:
+                # discard the speculative block: its trees are not part
+                # of the model; roll the carry back to the last kept
+                # block (valid — speculative launches never donate F0)
+                F = tf.f_final
+                pend = None
             break
     model = make_model(np.concatenate(scs), np.concatenate(bss),
                        np.concatenate(vls),

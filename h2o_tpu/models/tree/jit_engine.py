@@ -763,12 +763,21 @@ class TrainedForest(NamedTuple):
 
 
 def train_forest(*args, sibling: Optional[bool] = None,
-                 hist_pallas: Optional[bool] = None, **kwargs):
+                 hist_pallas: Optional[bool] = None,
+                 donate: Optional[bool] = None, **kwargs):
     """Public entry: resolves the sibling-subtraction and Pallas-histogram
     flags from the env OUTSIDE the trace (they are static jit args — part
     of the executable cache key — so toggling H2O_TPU_SIBLING_SUBTRACT /
     H2O_TPU_HIST_PALLAS between trainings takes effect instead of hitting
-    a stale cached program)."""
+    a stale cached program).
+
+    ``donate`` selects the F0-donating executable (None = the
+    H2O_TPU_DONATE/backend default): the forest accumulator F is the hot
+    carry of the whole training loop, and donating it lets XLA update it
+    in place across blocks instead of allocating a fresh (R, K) HBM
+    buffer per block.  Callers that still need the passed-in F0 AFTER
+    the call (speculative async blocks under early stopping, recovery
+    checkpoints of the pre-block F) must pass donate=False."""
     if sibling is None:
         sibling = sibling_subtract_enabled()
     if hist_pallas is None:
@@ -776,24 +785,29 @@ def train_forest(*args, sibling: Optional[bool] = None,
         hist_pallas = pallas_env_enabled()
     if "mm_route" not in kwargs or kwargs["mm_route"] is None:
         kwargs["mm_route"] = matmul_route_enabled()
-    return _train_forest_jit(*args, sibling=sibling,
-                             hist_pallas=hist_pallas, **kwargs)
+    if donate is None:
+        from h2o_tpu.core.cloud import donation_enabled
+        donate = donation_enabled()
+    from h2o_tpu.core.diag import DispatchStats
+    DispatchStats.note_dispatch("tree_block")
+    fn = _train_forest_jit_donate if donate else _train_forest_jit
+    return fn(*args, sibling=sibling, hist_pallas=hist_pallas, **kwargs)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("dist_name", "K", "ntrees", "max_depth", "nbins",
-                     "k_cols", "newton", "sample_rate", "learn_rate",
-                     "learn_rate_annealing", "min_rows",
-                     "min_split_improvement", "block_rows", "bf16",
-                     "mode", "tweedie_power", "quantile_alpha",
-                     "huber_alpha", "reg_lambda",
-                     "col_sample_rate_per_tree", "use_mono",
-                     "kleaves", "custom_dist", "sibling",
-                     "adaptive", "fine_nbins", "hist_random",
-                     "hist_pallas", "mm_route"))
-def _train_forest_jit(bins, yv, w, active, F0, is_cat, key, *,
-                      dist_name: str,
+_TF_STATIC = ("dist_name", "K", "ntrees", "max_depth", "nbins",
+              "k_cols", "newton", "sample_rate", "learn_rate",
+              "learn_rate_annealing", "min_rows",
+              "min_split_improvement", "block_rows", "bf16",
+              "mode", "tweedie_power", "quantile_alpha",
+              "huber_alpha", "reg_lambda",
+              "col_sample_rate_per_tree", "use_mono",
+              "kleaves", "custom_dist", "sibling",
+              "adaptive", "fine_nbins", "hist_random",
+              "hist_pallas", "mm_route")
+
+
+def _train_forest_impl(bins, yv, w, active, F0, is_cat, key, *,
+                       dist_name: str,
                  K: int, ntrees: int, max_depth: int, nbins: int,
                  k_cols: int, newton: bool, sample_rate: float,
                  learn_rate: float, learn_rate_annealing: float,
@@ -925,3 +939,14 @@ def _train_forest_jit(bins, yv, w, active, F0, is_cat, key, *,
         (sc, bs, vl, vi, gn, nw, th, na), ch = outs, None
     return TrainedForest(sc, bs, vl, F_final, jnp.sum(vi, axis=0), gn, nw,
                          th, na, ch)
+
+
+# two module-level executables over ONE traced body: the donating variant
+# aliases the F0 input buffer into f_final (in-place carry on backends
+# that honor donation); train_forest picks per call — donation must never
+# silently change which program a recompile-sensitive flag flip hits
+_train_forest_jit = functools.partial(
+    jax.jit, static_argnames=_TF_STATIC)(_train_forest_impl)
+_train_forest_jit_donate = functools.partial(
+    jax.jit, static_argnames=_TF_STATIC,
+    donate_argnames=("F0",))(_train_forest_impl)

@@ -131,13 +131,21 @@ class ScoringEngine:
 
     def _get_compiled(self, model, version: int, bucket: int):
         import jax
+        from h2o_tpu.core.cloud import donation_enabled
+        from h2o_tpu.core.diag import DispatchStats
         key = (str(model.key), int(version), int(bucket))
         with self._lock:
             fn = self._compiled.get(key)
             if fn is not None:
                 self._compiled.move_to_end(key)
+                DispatchStats.note_cache_hit("serve")
                 return fn
-        fn = jax.jit(model.predict_raw_array)
+        # donate the micro-batch input: every request builds a fresh
+        # padded batch, so its device buffer is dead after the predict —
+        # donation hands it to XLA as scratch instead of a new HBM alloc
+        donate = (0,) if donation_enabled() else ()
+        fn = jax.jit(model.predict_raw_array, donate_argnums=donate)
+        DispatchStats.note_compile("serve")
         with self._lock:
             self._compiled[key] = fn
             self.compiled_entries += 1

@@ -57,6 +57,25 @@ def pytest_configure(config):
         "default run stays the release gate")
 
 
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """Print the dispatch-cache hit/miss totals at session end so a
+    compile-count regression (misses growing with dispatches instead of
+    staying flat) is visible in every tier-1 log without a dedicated
+    run."""
+    try:
+        from h2o_tpu.core.diag import DispatchStats
+        from h2o_tpu.core.mrtask import dispatch_cache
+        s = dispatch_cache().stats()
+        snap = DispatchStats.snapshot()
+        terminalreporter.write_line(
+            f"[dispatch-cache] hits={s['hits']} misses={s['misses']} "
+            f"entries={s['entries']}/{s['capacity']} "
+            f"xla_compiles={snap['xla_compiles']} "
+            f"dispatches={sum(snap['dispatches'].values())}")
+    except Exception:  # noqa: BLE001 — reporting must never fail a run
+        pass
+
+
 _TEST_COUNTER = {"n": 0}
 
 

@@ -8,6 +8,7 @@ backends themselves) and resilience.py (the wrapper's own plumbing,
 should it ever need one).
 """
 
+import ast
 import os
 import re
 
@@ -65,3 +66,72 @@ def test_no_jax_jit_in_api_handlers():
         "behind h2o_tpu/serve/engine.py's bounded compiled-predict "
         "cache (power-of-two batch buckets), not in REST handlers:\n"
         + "\n".join(offenders))
+
+
+# jax.jit applied inside a function body wraps a freshly-created closure
+# per call, so EVERY call re-traces and re-compiles — the anti-pattern
+# the dispatch cache (core/mrtask.py) exists to kill.  Jitting belongs at
+# module level (one executable per shape, process-wide) or behind a
+# counted, bounded cache.  Allowed: the dispatch-cache module itself and
+# the serving engine's bucket-keyed compiled-predict cache.
+JIT_CLOSURE_ALLOWED = {os.path.join("core", "mrtask.py"),
+                       os.path.join("serve", "engine.py"),
+                       # jits live under functools.lru_cache(maxsize=32)
+                       # keyed on (loss, regularizer) config — bounded
+                       # once-per-config, not per-call
+                       os.path.join("models", "glrm.py")}
+
+
+def _is_jax_jit(node) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr == "jit" and
+            isinstance(node.value, ast.Name) and node.value.id == "jax")
+
+
+def _jit_in_function_bodies(tree):
+    """Line numbers of ``jax.jit`` references inside function BODIES.
+    A module-level ``@jax.jit`` decorator (or module-level assignment)
+    evaluates once at import and is the CORRECT pattern — decorators are
+    visited at their enclosing scope, not the function's body scope."""
+    hits = []
+
+    def visit(node, in_body):
+        if _is_jax_jit(node) and in_body:
+            hits.append(node.lineno)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                visit(dec, in_body)
+            for child in node.body:
+                visit(child, True)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, in_body)
+
+    visit(tree, False)
+    return hits
+
+
+def test_no_jax_jit_on_local_closures():
+    pkg_root = os.path.dirname(h2o_tpu.__file__)
+    offenders = []
+    for dirpath, _dirs, files in os.walk(pkg_root):
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, pkg_root)
+            if rel in JIT_CLOSURE_ALLOWED:
+                continue
+            with open(path, encoding="utf-8", errors="replace") as f:
+                try:
+                    tree = ast.parse(f.read())
+                except SyntaxError:
+                    continue
+            offenders.extend(f"{rel}:{ln}"
+                             for ln in _jit_in_function_bodies(tree))
+    assert not offenders, (
+        "jax.jit referenced inside a function body — this wraps a fresh "
+        "closure per call and re-compiles every time.  Move the jit to "
+        "module level, or route through the dispatch cache "
+        "(h2o_tpu/core/mrtask.py map_reduce/map_frame/mutate_array):\n"
+        + "\n".join(sorted(set(offenders))))
