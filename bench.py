@@ -228,6 +228,44 @@ def bench_deep(fr, rows):
                                4)}
 
 
+def bench_rapids_groupby(rows, groups=1024, reps=5):
+    """Rapids data-munging throughput: one group-by bundle
+    (mean+sum+max) over a categorical key, steady-state after a warm
+    call pays the munge-kernel compiles (H2O's AstGroup workload on the
+    device-resident path, core/munge.py).  Unit is rows*groups/sec —
+    work scales with both the scan and the segment width."""
+    from h2o_tpu.core.cloud import cloud
+    from h2o_tpu.core.frame import Frame, T_CAT, Vec
+    from h2o_tpu.rapids.interp import Session, rapids_exec
+    rng = np.random.default_rng(3)
+    g = rng.integers(0, groups, size=rows).astype(np.int32)
+    x = rng.normal(size=rows).astype(np.float32)
+    fr = Frame(["g", "x"],
+               [Vec(g, T_CAT, domain=[f"g{i}" for i in range(groups)]),
+                Vec(x)])
+    fr.key = "bench_rapids_gb"
+    cloud().dkv.put("bench_rapids_gb", fr)
+    sess = Session("bench")
+    expr = ("(GB bench_rapids_gb [0] mean 1 'all' sum 1 'all' "
+            "max 1 'all')")
+    try:
+        rapids_exec(expr, sess)                      # warm (compiles)
+        c0 = _xla_compiles()
+        t0 = time.time()
+        for _ in range(reps):
+            out = rapids_exec(expr, sess)
+        wall = (time.time() - t0) / reps
+        sc = _xla_compiles() - c0
+        from h2o_tpu.core.munge import device_munge_enabled
+        return {"value": round(rows * groups / wall, 1),
+                "unit": "rows*groups/sec", "wall_s": round(wall, 4),
+                "rows": rows, "groups": int(out.nrows),
+                "steady_compiles": sc, "reps": reps,
+                "device_munge": bool(device_munge_enabled())}
+    finally:
+        cloud().dkv.remove("bench_rapids_gb")
+
+
 def bench_cpu_reference(X, y, rows, trees, depth):
     """External CPU baseline for the north-star ratio (VERDICT r3 item 3):
     the same GBM workload through a widely-accepted CPU hist
@@ -484,7 +522,7 @@ def _main_ladder(detail):
     depth = int(os.environ.get("BENCH_DEPTH", 5))
     configs = os.environ.get(
         "BENCH_CONFIG",
-        "gbm,gbm_ua,gbm_bf16,drf,glm,dl,hist,gbm10m,cpuref,"
+        "gbm,gbm_ua,gbm_bf16,drf,glm,dl,hist,rapidsgb,gbm10m,cpuref,"
         "cpuref10m,deep"
     ).split(",")
 
@@ -524,7 +562,8 @@ def _main_ladder(detail):
         trees = min(trees, int(os.environ.get(
             "BENCH_CPU_FALLBACK_TREES", 5)))
         configs = [c for c in configs
-                   if c in ("gbm", "cpuref", "drf", "glm", "hist")]
+                   if c in ("gbm", "cpuref", "drf", "glm", "hist",
+                            "rapidsgb")]
         detail["rows"] = rows
     detail["platform"] = platform
 
@@ -544,13 +583,17 @@ def _main_ladder(detail):
             ("glm", lambda: bench_glm(fr, rows)),
             ("dl", lambda: bench_dl(fr, rows)),
             ("hist", lambda: bench_hist_mfu(rows, cols)),
+            ("rapidsgb", lambda: bench_rapids_groupby(
+                min(rows, int(os.environ.get("BENCH_RAPIDS_GB_ROWS",
+                                             1_000_000))))),
             ("gbm10m", lambda: bench_gbm10m(cols, depth)),
             ("cpuref10m", lambda: bench_cpu_reference_10m(cols, depth)),
             ("deep", lambda: bench_deep(fr, rows))]
     names = {"hist": "hist_kernel", "gbm10m": "gbm_10m",
              "cpuref": "cpu_reference", "deep": "drf_deep20",
              "gbm_ua": "gbm_uniform_adaptive", "gbm_bf16": "gbm_bf16",
-             "cpuref10m": "cpu_reference_10m"}
+             "cpuref10m": "cpu_reference_10m",
+             "rapidsgb": "rapids_groupby_throughput"}
     for cfg, fn in runs:
         if cfg not in configs:
             continue

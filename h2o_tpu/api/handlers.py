@@ -1247,7 +1247,12 @@ def dispatch_route(params):
     """Data-plane dispatch observability: per-phase compile/dispatch/
     transfer counters (core/diag.DispatchStats) plus the compiled-
     program cache's hit/miss totals (core/mrtask.DispatchCache) — the
-    numbers that prove steady-state training recompiles nothing."""
+    numbers that prove steady-state training recompiles nothing.
+
+    The ``munge`` phase covers the device-resident sort/merge/group-by/
+    filter kernels (core/munge.py); ``host_pulls``/``host_pull_bytes``
+    count Vec payload device->host materializations per phase — the
+    munge row must stay at zero while the verbs run on device."""
     from h2o_tpu.core.diag import DispatchStats
     from h2o_tpu.core.mrtask import dispatch_cache
     return {"dispatch": DispatchStats.snapshot(),

@@ -357,7 +357,10 @@ class RestServer:
         return self
 
     def stop(self) -> None:
-        self.httpd.shutdown()
-        self.httpd.server_close()
+        # clear the process-global handle BEFORE tearing the socket down:
+        # a /3/Shutdown poller that sees the port refuse connections must
+        # never still observe RestServer.current pointing at this server
         if RestServer.current is self:
             RestServer.current = None
+        self.httpd.shutdown()
+        self.httpd.server_close()

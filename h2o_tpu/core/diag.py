@@ -51,6 +51,9 @@ class DispatchStats:
     _cache_hits: Dict[str, int] = {}
     _transfers: Dict[str, int] = {}
     _transfer_bytes: Dict[str, int] = {}
+    _host_pulls: Dict[str, int] = {}
+    _host_pull_bytes: Dict[str, int] = {}
+    _phase_local = threading.local()
     _xla_compiles = 0
     _listener_installed = False
 
@@ -76,6 +79,49 @@ class DispatchStats:
     def note_transfer(cls, phase: str, nbytes: int = 0) -> None:
         cls._bump(cls._transfers, phase)
         cls._bump(cls._transfer_bytes, phase, int(nbytes))
+
+    # -- device->host pull accounting (Vec.to_numpy instrumentation) ------
+
+    @classmethod
+    def current_phase(cls) -> str:
+        """The phase the calling thread attributes host pulls to
+        ("unattributed" outside any phase_scope)."""
+        return getattr(cls._phase_local, "stack", ["unattributed"])[-1]
+
+    @classmethod
+    def phase_scope(cls, phase: str):
+        """Context manager: host pulls on this thread inside the scope
+        are attributed to ``phase`` — the munge verbs wrap themselves in
+        ``phase_scope("munge")`` so HBM->host traffic per data-plane
+        phase is visible at GET /3/Dispatch."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def scope():
+            stack = getattr(cls._phase_local, "stack", None)
+            if stack is None:
+                stack = cls._phase_local.stack = ["unattributed"]
+            stack.append(phase)
+            try:
+                yield
+            finally:
+                stack.pop()
+        return scope()
+
+    @classmethod
+    def note_host_pull(cls, nbytes: int, phase: Optional[str] = None) -> None:
+        """One device->host materialization of ``nbytes`` (a Vec payload
+        pulled off HBM).  This is the traffic the device-munge layer
+        exists to eliminate; the per-phase byte totals are the
+        before/after evidence."""
+        p = phase if phase is not None else cls.current_phase()
+        cls._bump(cls._host_pulls, p)
+        cls._bump(cls._host_pull_bytes, p, int(nbytes))
+
+    @classmethod
+    def host_pulls(cls, phase: str) -> int:
+        with cls._lock:
+            return cls._host_pulls.get(phase, 0)
 
     @classmethod
     def install_xla_listener(cls) -> None:
@@ -108,6 +154,8 @@ class DispatchStats:
                     "cache_hits": dict(cls._cache_hits),
                     "transfers": dict(cls._transfers),
                     "transfer_bytes": dict(cls._transfer_bytes),
+                    "host_pulls": dict(cls._host_pulls),
+                    "host_pull_bytes": dict(cls._host_pull_bytes),
                     "xla_compiles": cls._xla_compiles,
                     "xla_listener": cls._listener_installed}
 
@@ -121,6 +169,8 @@ class DispatchStats:
             cls._cache_hits.clear()
             cls._transfers.clear()
             cls._transfer_bytes.clear()
+            cls._host_pulls.clear()
+            cls._host_pull_bytes.clear()
 
 
 class TimeLine:

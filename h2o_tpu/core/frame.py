@@ -237,7 +237,12 @@ class Vec:
 
     def to_numpy(self) -> np.ndarray:
         """Unpadded host copy (NA = NaN for numeric, -1 for categorical).
-        T_TIME returns the exact float64 epoch-ms copy when available."""
+        T_TIME returns the exact float64 epoch-ms copy when available.
+
+        Every call that actually reads the DEVICE payload is counted
+        (count + bytes) against the calling thread's DispatchStats
+        phase — the HBM->host traffic the device-munge layer exists to
+        eliminate shows up per phase at GET /3/Dispatch."""
         if self.host_data is not None:
             return np.asarray(self.host_data, dtype=object)
         if self._host_f64 is not None:
@@ -246,7 +251,10 @@ class Vec:
             if self._data is None and self._spill_np is not None:
                 # host reads of spilled columns never touch the device
                 return self._spill_np[: self.nrows]
-        return np.asarray(self.data)[: self.nrows]
+        from h2o_tpu.core.diag import DispatchStats
+        arr = np.asarray(self.data)
+        DispatchStats.note_host_pull(arr.nbytes)
+        return arr[: self.nrows]
 
     # -- rollups -----------------------------------------------------------
 
@@ -283,9 +291,12 @@ class Vec:
 
     def nacnt(self) -> int:
         if self.type == T_CAT:
-            # categorical NA is the -1 code, invisible to the NaN-based kernel
-            idx_valid = np.asarray(self.data)[: self.nrows]
-            return int((idx_valid < 0).sum())
+            # categorical NA is the -1 code, invisible to the NaN-based
+            # kernel; counted as a device reduction (one scalar syncs)
+            # instead of pulling the whole code column to host
+            d = self.data
+            valid = jnp.arange(d.shape[0]) < self.nrows
+            return int(jnp.sum((d < 0) & valid))
         return int(self.rollups.nacnt)
 
     def invalidate(self) -> None:
@@ -402,6 +413,16 @@ class SparseVec(Vec):
         return self._densify_host()
 
 
+def frame_device_ok(fr: "Frame") -> bool:
+    """True when every column lives (or can live) on device with exact
+    semantics: numeric/categorical payloads only.  T_TIME is excluded
+    (its exact f64 epoch-ms copy is host-side by design), as are
+    strings/UUIDs — frames holding those take the host munge path."""
+    return bool(fr.vecs) and all(
+        v.type in (T_NUM, T_CAT) and v.host_data is None
+        for v in fr.vecs)
+
+
 class Frame:
     """An ordered collection of equally-long, identically-sharded Vecs."""
 
@@ -490,8 +511,19 @@ class Frame:
         return Frame(self.names + other.names, self.vecs + other.vecs)
 
     def slice_rows(self, mask_or_idx) -> "Frame":
-        """New Frame of the selected rows (host gather + re-upload — the
-        deep-slice/row-filter path, reference rapids AstRowSlice)."""
+        """New Frame of the selected rows (the deep-slice/row-filter
+        path, reference rapids AstRowSlice).
+
+        A ``jax.Array`` boolean mask routes through the device-munge
+        compaction kernel (core/munge.py): the mask never materializes
+        on host, rows are selected by a cumsum-of-mask gather on device,
+        and only the surviving row COUNT syncs back.  Host masks/index
+        lists keep the host gather + re-upload path."""
+        if isinstance(mask_or_idx, jax.Array):
+            from h2o_tpu.core.munge import device_munge_enabled, filter_rows
+            if device_munge_enabled() and frame_device_ok(self):
+                return filter_rows(self, mask_or_idx)
+            mask_or_idx = np.asarray(mask_or_idx)[: self.nrows]
         sel = np.asarray(mask_or_idx)
         idx = np.flatnonzero(sel) if sel.dtype == bool else sel
         vecs = []

@@ -132,6 +132,24 @@ def dispatch_cache() -> DispatchCache:
     return _CACHE
 
 
+def aval_key(x) -> Tuple:
+    """Public alias of the argument-signature hasher, for other layers
+    (core/munge.py) that key their kernels into the same cache."""
+    return _aval_key(x)
+
+
+def cached_kernel(phase: str, name: str, statics: Tuple,
+                  build: Callable[[], Any], *arrays) -> Any:
+    """Fetch-or-compile a kernel through the shared DispatchCache, keyed
+    on (phase, name, statics, argument avals) — the device-munge verbs'
+    route into the PR 3 compile-once contract.  ``build`` returns the
+    jitted callable; the caller invokes it with ``arrays``."""
+    key = (phase, name, statics, tuple(_aval_key(a) for a in arrays))
+    fn = _CACHE.get_or_build(phase, key, build)
+    DispatchStats.note_dispatch(phase)
+    return fn
+
+
 def map_reduce(map_fn: Callable, *arrays: jax.Array, reduce: str = "sum",
                extra_args: Sequence = ()) -> jax.Array:
     """Run ``map_fn(shard, *extra)`` per node-shard; reduce results over ICI.

@@ -24,7 +24,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from h2o_tpu.core.cloud import cloud
-from h2o_tpu.core.frame import Frame, T_CAT, T_NUM, T_STR, T_TIME, Vec
+from h2o_tpu.core.diag import DispatchStats
+from h2o_tpu.core.frame import (Frame, T_CAT, T_NUM, T_STR, T_TIME, Vec,
+                                frame_device_ok)
 
 # ---------------------------------------------------------------------------
 # parser (Rapids.java grammar: ( fun args... ), [num list], 'str', ids)
@@ -157,8 +159,14 @@ def _string_compare(op, a, b):
         elif v.type == T_CAT:
             dom = v.domain or []
             code = dom.index(lit) if lit in dom else -2
-            eq = (np.asarray(v.to_numpy())[: v.nrows] == code).astype(
-                np.float32)
+            if v.data is not None:
+                # code comparison runs on device — no host pull of the
+                # code column just to compare against one level code
+                eq_dev = (v.data == code).astype(jnp.float32)
+                vecs.append(Vec(eq_dev if op == "==" else 1.0 - eq_dev,
+                                nrows=v.nrows))
+                continue
+            eq = (np.asarray(v.to_numpy()) == code).astype(np.float32)
         else:
             eq = np.zeros(v.nrows, np.float32)
         vecs.append(Vec(eq if op == "==" else 1.0 - eq))
@@ -264,14 +272,33 @@ def _lit(node):
 
 def _row_select(fr: Frame, sel, sess) -> Frame:
     if isinstance(sel, Frame):  # boolean mask frame
-        mask = np.asarray(sel.vecs[0].data)[: fr.nrows] > 0
-        idx = np.nonzero(mask)[0]
+        mv = sel.vecs[0]
+        from h2o_tpu.core.munge import device_munge_enabled
+        if device_munge_enabled() and frame_device_ok(fr) and \
+                mv.data is not None:
+            # device compaction: the mask never lands on host; only the
+            # surviving row count syncs (core/munge.filter_rows)
+            return fr.slice_rows(mv.data)
+        with DispatchStats.phase_scope("munge"):
+            return _row_select_mask_host(fr, mv)
     elif isinstance(sel, tuple) and sel[0] == "numlist":
         idx = np.asarray(_expand_numlist(sel[1]), np.int64)
     elif isinstance(sel, tuple) and sel[0] == "span":
         idx = np.asarray(_expand_numlist([sel]), np.int64)
     else:
         idx = np.asarray([int(sel)], np.int64)
+    return _row_select_host(fr, idx)
+
+
+def _row_select_mask_host(fr: Frame, mask_vec: Vec) -> Frame:
+    """Host fallback for boolean-mask selection: pull the mask, gather."""
+    mask = np.asarray(mask_vec.to_numpy(), np.float64) > 0
+    return _row_select_host(fr, np.nonzero(mask)[0])
+
+
+def _row_select_host(fr: Frame, idx: np.ndarray) -> Frame:
+    """Host gather + re-upload fallback (explicit index lists, and the
+    parity oracle for the device boolean-mask compaction)."""
     vecs = []
     for v in fr.vecs:
         data = v.to_numpy()[idx]
@@ -527,15 +554,15 @@ def _eval(node, env: _Env):
         out = []
         for v in fr.vecs:
             if v.is_categorical:
-                # numeric-looking domains convert by value, else by code
+                # numeric-looking domains convert by value, else by
+                # code; ONE pull of the code column either way
+                codes = v.to_numpy()
                 try:
                     dom = np.asarray([float(d) for d in v.domain],
                                      np.float32)
-                    codes = v.to_numpy()
                     vals = np.where(codes < 0, np.nan,
                                     dom[np.clip(codes, 0, None)])
                 except ValueError:
-                    codes = v.to_numpy()
                     vals = np.where(codes < 0, np.nan,
                                     codes.astype(np.float32))
                 out.append(Vec(vals.astype(np.float32), T_NUM))
@@ -592,6 +619,13 @@ def _eval(node, env: _Env):
         return _time_part(op, node, env)
     if op == "na.omit":
         fr = _as_frame(_eval(node[1], env))
+        from h2o_tpu.core.munge import device_munge_enabled
+        if device_munge_enabled() and frame_device_ok(fr):
+            # NA mask + row compaction entirely on device (cat NA codes
+            # appear as NaN through as_matrix's as_float view)
+            with DispatchStats.phase_scope("munge"):
+                keep = ~jnp.isnan(fr.as_matrix()).any(axis=1)
+            return fr.slice_rows(keep)
         keep = np.ones(fr.nrows, bool)
         for v in fr.vecs:
             if v.data is None:
@@ -605,11 +639,10 @@ def _eval(node, env: _Env):
         for v in fr.vecs:
             if v.host_data is not None:
                 out.append(float(sum(x is None for x in v.host_data)))
-            elif v.is_categorical:
-                out.append(float((np.asarray(v.to_numpy()) < 0).sum()))
             else:
-                out.append(float(np.isnan(
-                    np.asarray(v.to_numpy(), np.float64)).sum()))
+                # rollups / device reduction — counting NAs must not
+                # pull the whole column to host
+                out.append(float(v.nacnt()))
         return out
     if op == "which":
         fr = _as_frame(_eval(node[1], env))
@@ -671,8 +704,10 @@ def _sort_keys(fr: Frame, idxs, ascending) -> np.ndarray:
 
 
 def _sort(node, env):
-    """(sort fr [cols] [ascending]) — RadixOrder.java analog; the sort
-    itself is numpy lexsort on host key copies, the reorder is a gather.
+    """(sort fr [cols] [ascending]) — RadixOrder.java analog.  Device
+    path (H2O_TPU_DEVICE_MUNGE=1): key ranking is a jnp.lexsort kernel
+    and the reorder a device gather — zero host pulls.  Host fallback:
+    numpy lexsort over pulled key copies + slice_rows re-upload.
     Columns select by index OR name (the client serializes names:
     frame.sort(by=['y']))."""
     fr = _as_frame(_eval(node[1], env))
@@ -680,16 +715,27 @@ def _sort(node, env):
             x[0] == "str" else int(x) for x in node[2][1]]
     asc = [bool(int(x)) for x in node[3][1]] if len(node) > 3 \
         else [True] * len(idxs)
-    order = _sort_keys(fr, idxs, asc)
-    return fr.slice_rows(order)
+    from h2o_tpu.core.munge import device_munge_enabled, sort_frame
+    if device_munge_enabled() and frame_device_ok(fr):
+        return sort_frame(fr, idxs, asc)
+    with DispatchStats.phase_scope("munge"):
+        order = _sort_keys(fr, idxs, asc)
+        return fr.slice_rows(order)
 
 
 def _key_codes(fr: Frame, cols: List[int]):
-    """Rows -> dense group codes over the named key columns."""
+    """Rows -> dense group codes over the named key columns.  Numeric
+    NaN keys canonicalize to a -inf sentinel so all NA rows form ONE
+    group that sorts first (np.unique treats each NaN row as distinct —
+    AstGroup's NA group semantics need them merged); the categorical NA
+    code -1 is already a single first-sorting group value.  The device
+    factorize kernel (core/munge.py) uses the same sentinel."""
     mats = []
     for j in cols:
         v = fr.vecs[j]
         d = np.asarray(v.to_numpy(), np.float64)
+        if not v.is_categorical:
+            d = np.where(np.isnan(d), -np.inf, d)
         mats.append(d)
     stacked = np.stack(mats, axis=1)
     uniq, inv = np.unique(stacked, axis=0, return_inverse=True)
@@ -698,7 +744,9 @@ def _key_codes(fr: Frame, cols: List[int]):
 
 def _merge(node, env):
     """(merge left right all_x all_y [by_x] [by_y] method) — the radix
-    join (rapids/Merge.java, BinaryMerge.java).  Key matching is a host
+    join (rapids/Merge.java, BinaryMerge.java).  Device path: sorted
+    join over a shared dense code space (core/munge.merge_frames), only
+    the output row count syncing to host.  Host fallback: string-join
     sort-merge over dense key codes."""
     L = _as_frame(_eval(node[1], env))
     R = _as_frame(_eval(node[2], env))
@@ -712,6 +760,17 @@ def _merge(node, env):
         common = [n for n in L.names if n in R.names]
         by_x = [L.names.index(n) for n in common]
         by_y = [R.names.index(n) for n in common]
+    from h2o_tpu.core.munge import (device_munge_enabled, merge_device_ok,
+                                    merge_frames)
+    if device_munge_enabled() and merge_device_ok(L, R, by_x, by_y):
+        return merge_frames(L, R, all_x, all_y, by_x, by_y)
+    with DispatchStats.phase_scope("munge"):
+        return _merge_host(L, R, all_x, all_y, by_x, by_y)
+
+
+def _merge_host(L: Frame, R: Frame, all_x: bool, all_y: bool,
+                by_x: List[int], by_y: List[int]) -> Frame:
+    """Host sort-merge fallback and parity oracle for the device join."""
     # unify key space: categorical keys match by LABEL, numeric by value
     def key_matrix(fr, cols):
         out = []
@@ -819,7 +878,11 @@ _GB_AGGS = ("min", "max", "mean", "sum", "sd", "var", "nrow", "count",
 
 
 def _groupby(node, env):
-    """(GB fr [group_idxs] agg col na_method ...) — AstGroup.java."""
+    """(GB fr [group_idxs] agg col na_method ...) — AstGroup.java.
+    Device path (core/munge.groupby_frame): factorize keys on device,
+    run the whole aggregate bundle as one fused segment-reduction pass;
+    only the group count syncs.  median/mode (per-group sorts) and
+    non-device frames fall back to the host path."""
     fr = _as_frame(_eval(node[1], env))
     gcols = [int(x) for x in node[2][1]]
     aggs = []
@@ -836,6 +899,17 @@ def _groupby(node, env):
         na = _lit(node[i + 2]) if i + 2 < len(node) else "all"
         aggs.append((a, col_i, na))
         i += 3
+    from h2o_tpu.core.munge import (DEVICE_AGGS, device_munge_enabled,
+                                    groupby_frame)
+    if device_munge_enabled() and frame_device_ok(fr) and \
+            all(a in DEVICE_AGGS for a, _c, _n in aggs):
+        return groupby_frame(fr, gcols, aggs)
+    with DispatchStats.phase_scope("munge"):
+        return _groupby_host(fr, gcols, aggs)
+
+
+def _groupby_host(fr: Frame, gcols: List[int], aggs) -> Frame:
+    """Host bincount/searchsorted fallback and parity oracle."""
     uniq, inv = _key_codes(fr, gcols)
     G = len(uniq)
     names, vecs = [], []
@@ -846,6 +920,8 @@ def _groupby(node, env):
             vecs.append(Vec(col.astype(np.int32), T_CAT,
                             domain=list(v.domain)))
         else:
+            # the -inf NA-group sentinel reads back as NaN
+            col = np.where(np.isneginf(col), np.nan, col)
             vecs.append(Vec(col.astype(np.float32), v.type))
         names.append(fr.names[j])
     counts = np.bincount(inv, minlength=G)

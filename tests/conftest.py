@@ -72,6 +72,12 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
             f"entries={s['entries']}/{s['capacity']} "
             f"xla_compiles={snap['xla_compiles']} "
             f"dispatches={sum(snap['dispatches'].values())}")
+        pulls = snap.get("host_pulls", {})
+        pbytes = snap.get("host_pull_bytes", {})
+        terminalreporter.write_line(
+            "[host-pulls] total={} bytes={} munge={} munge_bytes={}"
+            .format(sum(pulls.values()), sum(pbytes.values()),
+                    pulls.get("munge", 0), pbytes.get("munge", 0)))
     except Exception:  # noqa: BLE001 — reporting must never fail a run
         pass
 

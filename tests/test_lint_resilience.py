@@ -76,6 +76,11 @@ def test_no_jax_jit_in_api_handlers():
 # the serving engine's bucket-keyed compiled-predict cache.
 JIT_CLOSURE_ALLOWED = {os.path.join("core", "mrtask.py"),
                        os.path.join("serve", "engine.py"),
+                       # munge kernel builders run ONLY under
+                       # mrtask.cached_kernel (dispatch-cache miss =
+                       # compile, counted) — one executable per
+                       # (verb, schema, shape-bucket)
+                       os.path.join("core", "munge.py"),
                        # jits live under functools.lru_cache(maxsize=32)
                        # keyed on (loss, regularizer) config — bounded
                        # once-per-config, not per-call
@@ -109,6 +114,65 @@ def _jit_in_function_bodies(tree):
 
     visit(tree, False)
     return hits
+
+
+# The device-munge conversion (core/munge.py) eliminated per-row
+# device->host pulls from the Rapids hot verbs.  A `to_numpy()` creeping
+# back into a converted verb (or into the munge kernel layer itself)
+# silently reopens the HBM->host->HBM round-trip this layer closed.
+# Host fallbacks live in explicitly-suffixed `*_host` functions (the
+# allowlist below) — new host-only ops go there, not in the dispatchers.
+DEVICE_MUNGE_VERBS = {"_sort", "_merge", "_groupby", "_row_select"}
+MUNGE_HOST_ALLOWED = {"_merge_host", "_groupby_host", "_row_select_host",
+                      "_row_select_mask_host", "_sort_keys", "_key_codes"}
+
+
+def _to_numpy_hits(tree, only_functions=None):
+    """Line numbers of ``.to_numpy(`` calls, optionally restricted to
+    the bodies of the named top-level functions."""
+    hits = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if only_functions is not None and node.name not in only_functions:
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) and sub.attr == "to_numpy":
+                hits.append((node.name, sub.lineno))
+    return hits
+
+
+def test_no_to_numpy_in_device_munge_verbs():
+    pkg_root = os.path.dirname(h2o_tpu.__file__)
+    offenders = []
+    interp = os.path.join(pkg_root, "rapids", "interp.py")
+    with open(interp, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    for fn, ln in _to_numpy_hits(tree, DEVICE_MUNGE_VERBS):
+        offenders.append(f"rapids/interp.py:{ln} in {fn}()")
+    munge = os.path.join(pkg_root, "core", "munge.py")
+    with open(munge, encoding="utf-8") as f:
+        mtree = ast.parse(f.read())
+    for fn, ln in _to_numpy_hits(mtree):
+        offenders.append(f"core/munge.py:{ln} in {fn}()")
+    assert not offenders, (
+        "to_numpy() inside a device-converted munge verb — these verbs "
+        "must stay zero-host-pull.  Put host-only logic in the *_host "
+        "fallbacks (rapids/interp.py) instead:\n" + "\n".join(offenders))
+
+
+def test_munge_host_fallbacks_still_exist():
+    """The host oracle is part of the contract (H2O_TPU_DEVICE_MUNGE=0
+    must keep working) — renaming a fallback away breaks the parity
+    suite's comparison baseline."""
+    pkg_root = os.path.dirname(h2o_tpu.__file__)
+    interp = os.path.join(pkg_root, "rapids", "interp.py")
+    with open(interp, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    names = {n.name for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    missing = MUNGE_HOST_ALLOWED - names
+    assert not missing, f"host munge fallbacks missing: {sorted(missing)}"
 
 
 def test_no_jax_jit_on_local_closures():
