@@ -104,7 +104,7 @@ def shutdown(params):
         if job.is_running:
             job.cancel()
     for k in list(c.dkv.keys()):
-        c.dkv.remove(k)
+        c.dkv.remove(k, force=True)   # shutdown teardown overrides locks
     # stop the server that RECEIVED this request (not a process-global):
     # multiple live servers each shut down only themselves
     srv = getattr(request_context, "server", None) or RestServer.current
@@ -1283,19 +1283,23 @@ def recovery_list(params):
 
 @route("GET", r"/3/Resilience")
 def resilience_stats(params):
-    """Retry/chaos/watchdog observability: cumulative retry counters
-    (core/resilience.py), injected-fault counts (core/chaos.py) and the
-    job watchdog's expiry/eviction totals — the numbers chaos soak
-    tests assert against."""
-    from h2o_tpu.core import resilience
+    """Retry/chaos/watchdog/OOM observability: cumulative retry
+    counters (core/resilience.py), the FULL injected-fault counter set
+    (core/chaos.py — one dedicated counter per injector,
+    lint-enforced), the job watchdog's expiry/eviction totals, the OOM
+    degradation-ladder state (core/oom.py: oom_events, sweeps,
+    degradations per site/rung) and the HBM memory-manager accounting —
+    the numbers the chaos soak harness asserts against."""
+    from h2o_tpu.core import oom, resilience
     from h2o_tpu.core.chaos import chaos
+    from h2o_tpu.core.memory import manager
     jr = cloud().jobs
     c = chaos()
     return {
         "retry": resilience.stats(),
-        "chaos": {"enabled": c.enabled, "injected": c.injected,
-                  "injected_persist": c.injected_persist,
-                  "injected_stalls": c.injected_stalls},
+        "chaos": dict(enabled=c.enabled, **c.counters()),
+        "oom": oom.stats(),
+        "memory": manager().stats(),
         "watchdog": {"expired_jobs": jr.expired_count,
                      "evicted_jobs": jr.evicted_count,
                      "default_deadline_secs": jr.default_deadline_secs,

@@ -140,7 +140,7 @@ def remove_all(params):
     # retains exactly the listed keys (ModelBase/Frame)
     for k in list(c.dkv.keys()):
         if str(k) not in retained:
-            c.dkv.remove(k)
+            c.dkv.remove(k, force=True)   # purge-all overrides locks
     return {}
 
 
@@ -496,7 +496,7 @@ def delete_all_frames(params):
     dkv = cloud().dkv
     for k in list(dkv.keys()):
         if isinstance(dkv.get(k), Frame):
-            dkv.remove(k)
+            dkv.remove(k, force=True)   # delete-all overrides locks
     return {}
 
 

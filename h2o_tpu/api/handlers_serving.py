@@ -13,7 +13,8 @@ This module is that serving front door for the TPU rebuild:
 - ``DELETE /3/Serving/<name>``             drain + undeploy
 
 Status mapping: queue at capacity -> 429 (load shed), per-request
-deadline exceeded -> 408, unknown alias -> 404, unservable model -> 400.
+deadline exceeded -> 408, unknown alias -> 404, unservable model -> 400,
+terminal device OOM (ladder exhausted, core/oom.py) -> 503.
 
 NOTE: no ``jax.jit`` may appear in api/handlers*.py (lint-enforced) —
 per-request compiles live behind serve/engine.py's bounded bucket cache.
@@ -27,6 +28,7 @@ import numpy as np
 
 from h2o_tpu.api.server import H2OError, route
 from h2o_tpu.core.cloud import cloud
+from h2o_tpu.core.oom import OOMError
 from h2o_tpu.models.model import Model
 from h2o_tpu.serve import (QueueFull, ServingConfig, UnsupportedModelError,
                            registry)
@@ -148,6 +150,10 @@ def serving_score(params, name):
         raise H2OError(429, str(e))
     except TimeoutError as e:
         raise H2OError(408, str(e))
+    except OOMError as e:
+        # terminal rung of the OOM ladder: this request failed, the
+        # server did not — shed it like an overload, clients back off
+        raise H2OError(503, str(e))
     dep = reg.get(name)
     domain = reg.response_domain(dep, ver) if dep is not None else None
     return {"model_id": ver.model_id, "version": ver.version,

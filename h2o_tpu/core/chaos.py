@@ -19,7 +19,13 @@ the HOST layer the framework owns:
   exercising the JobRegistry watchdog (deadline/stall detection);
 - slow-score faults: the online-scoring engine (serve/engine.py) sleeps
   inside a device batch, exercising the micro-batcher's admission-queue
-  load shedding (429) and per-request deadline expiry (408).
+  load shedding (429) and per-request deadline expiry (408);
+- device-OOM faults: a dispatch choke point raises a synthetic
+  RESOURCE_EXHAUSTED — either with a probability, or in TRANSIENT mode
+  (fail the first N attempts of each distinct SITE, then succeed,
+  mirroring the persist-transient design) — so the full OOM degradation
+  ladder (core/oom.py: sweep -> shrink -> host fallback -> terminal) is
+  exercisable on CPU CI without real HBM pressure.
 
 Enable with ``H2O_TPU_CHAOS_JOB=0.3`` / ``H2O_TPU_CHAOS_DEVICE_PUT=0.1``
 (probabilities), ``H2O_TPU_CHAOS_PERSIST=0.2`` (probability) or
@@ -27,8 +33,17 @@ Enable with ``H2O_TPU_CHAOS_JOB=0.3`` / ``H2O_TPU_CHAOS_DEVICE_PUT=0.1``
 ``H2O_TPU_CHAOS_STALL=0.5`` + ``H2O_TPU_CHAOS_STALL_SECS=30`` (stall
 probability and duration), ``H2O_TPU_CHAOS_SCORE_SLOW=1.0`` +
 ``H2O_TPU_CHAOS_SCORE_SLOW_MS=200`` (slow-score probability and
-duration), and optional ``H2O_TPU_CHAOS_SEED``; or programmatically via
-``configure()``.  Off by default; zero overhead when off.
+duration), ``H2O_TPU_CHAOS_OOM=0.1`` (probability) or
+``H2O_TPU_CHAOS_OOM_TRANSIENT=2`` (fail-first-N-per-site), and optional
+``H2O_TPU_CHAOS_SEED``; or programmatically via ``configure()``.  Off
+by default; zero overhead when off.
+
+COUNTER DISCIPLINE (lint-enforced, tests/test_lint_resilience.py):
+every ``maybe_*`` injector increments a DEDICATED ``injected_*``
+counter (plus the ``injected`` grand total), and every counter appears
+in the ``GET /3/Resilience`` payload — so a soak run can prove that
+every injected fault is accounted for (``injected`` equals the sum of
+the per-type counters).
 """
 
 from __future__ import annotations
@@ -54,6 +69,12 @@ class ChaosIOError(ChaosError, IOError):
     layer classifies it transient — exactly like a real flaky store."""
 
 
+class ChaosOOMError(ChaosError):
+    """Injected device-OOM.  core/oom.py classifies it exactly like a
+    real XLA RESOURCE_EXHAUSTED, so the degradation ladder walks its
+    rungs without needing real HBM pressure."""
+
+
 class _Chaos:
     def __init__(self):
         e = os.environ.get
@@ -71,23 +92,42 @@ class _Chaos:
             e("H2O_TPU_CHAOS_TRANSFER_SLOW", 0) or 0)
         self.transfer_slow_ms = float(
             e("H2O_TPU_CHAOS_TRANSFER_SLOW_MS", 100) or 100)
+        self.oom_p = float(e("H2O_TPU_CHAOS_OOM", 0) or 0)
+        self.oom_transient = int(e("H2O_TPU_CHAOS_OOM_TRANSIENT", 0) or 0)
         seed = e("H2O_TPU_CHAOS_SEED")
         self._rng = np.random.default_rng(
             int(seed) if seed is not None else None)
         self._lock = threading.Lock()
         self._transient_seen: Dict[Tuple[str, str], int] = {}
+        self._oom_seen: Dict[str, int] = {}
         self.injected = 0
+        self.injected_jobs = 0
+        self.injected_device_puts = 0
         self.injected_persist = 0
         self.injected_stalls = 0
         self.injected_slow_scores = 0
         self.injected_slow_transfers = 0
+        self.injected_oom = 0
 
     @property
     def enabled(self) -> bool:
         return (self.job_p > 0 or self.device_put_p > 0 or
                 self.persist_p > 0 or self.persist_transient > 0 or
                 self.stall_p > 0 or self.score_slow_p > 0 or
-                self.transfer_slow_p > 0)
+                self.transfer_slow_p > 0 or self.oom_p > 0 or
+                self.oom_transient > 0)
+
+    def counters(self) -> Dict[str, int]:
+        """All injected-fault counters (the /3/Resilience chaos block).
+        Invariant the soak harness asserts: ``injected`` equals the sum
+        of every ``injected_*`` per-type counter — no unaccounted
+        faults."""
+        with self._lock:
+            return {k: getattr(self, k) for k in (
+                "injected", "injected_jobs", "injected_device_puts",
+                "injected_persist", "injected_stalls",
+                "injected_slow_scores", "injected_slow_transfers",
+                "injected_oom")}
 
     def _roll(self, p: float) -> bool:
         if p <= 0:
@@ -100,13 +140,46 @@ class _Chaos:
 
     def maybe_fail_job(self, what: str) -> None:
         if self._roll(self.job_p):
+            with self._lock:
+                self.injected_jobs += 1
             log.warning("chaos: injecting job failure into %s", what)
             raise ChaosError(f"injected job fault ({what})")
 
     def maybe_fail_device_put(self) -> None:
         if self._roll(self.device_put_p):
+            with self._lock:
+                self.injected_device_puts += 1
             log.warning("chaos: injecting device_put failure")
             raise ChaosError("injected device_put fault")
+
+    def maybe_oom(self, site: str) -> None:
+        """Device-OOM injector: called once per ATTEMPT by the OOM
+        ladder (core/oom.py oom_ladder), so transient mode
+        deterministically fails the first N attempts at each distinct
+        site and then lets it through — the ladder must absorb exactly
+        N faults (sweeps, then quantum shrinks / host fallback) to
+        succeed."""
+        if self.oom_transient > 0:
+            with self._lock:
+                n = self._oom_seen.get(site, 0)
+                if n < self.oom_transient:
+                    self._oom_seen[site] = n + 1
+                    self.injected += 1
+                    self.injected_oom += 1
+                else:
+                    return
+            log.warning("chaos: transient device OOM %d/%d at %s",
+                        n + 1, self.oom_transient, site)
+            raise ChaosOOMError(
+                f"injected device OOM {n + 1}/{self.oom_transient} at "
+                f"{site}: RESOURCE_EXHAUSTED (synthetic)")
+        if self._roll(self.oom_p):
+            with self._lock:
+                self.injected_oom += 1
+            log.warning("chaos: injecting device OOM at %s", site)
+            raise ChaosOOMError(
+                f"injected device OOM at {site}: RESOURCE_EXHAUSTED "
+                f"(synthetic)")
 
     def maybe_fail_persist(self, op: str, uri: str) -> None:
         """Persist-I/O injector: called once per ATTEMPT by the byte-store
@@ -184,7 +257,8 @@ def configure(job_p: float = 0.0, device_put_p: float = 0.0,
               stall_secs: float = 30.0, score_slow_p: float = 0.0,
               score_slow_ms: float = 200.0,
               transfer_slow_p: float = 0.0,
-              transfer_slow_ms: float = 100.0) -> _Chaos:
+              transfer_slow_ms: float = 100.0,
+              oom_p: float = 0.0, oom_transient: int = 0) -> _Chaos:
     """Programmatic enable (tests); returns the active instance."""
     global _instance
     _instance = _Chaos()
@@ -198,6 +272,8 @@ def configure(job_p: float = 0.0, device_put_p: float = 0.0,
     _instance.score_slow_ms = float(score_slow_ms)
     _instance.transfer_slow_p = float(transfer_slow_p)
     _instance.transfer_slow_ms = float(transfer_slow_ms)
+    _instance.oom_p = float(oom_p)
+    _instance.oom_transient = int(oom_transient)
     if seed is not None:
         _instance._rng = np.random.default_rng(seed)
     return _instance

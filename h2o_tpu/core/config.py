@@ -18,7 +18,16 @@ owning modules, like the chaos flags, so they work before a cloud boots):
   (probabilities), ``H2O_TPU_CHAOS_PERSIST_TRANSIENT`` (fail the first
   N attempts of each persist op, then succeed),
   ``H2O_TPU_CHAOS_STALL`` + ``H2O_TPU_CHAOS_STALL_SECS`` (job-stall
-  injector for the watchdog), ``H2O_TPU_CHAOS_SEED``.
+  injector for the watchdog), ``H2O_TPU_CHAOS_SCORE_SLOW[_MS]`` (slow
+  online-scoring batches), ``H2O_TPU_CHAOS_TRANSFER_SLOW[_MS]`` (slow
+  device->host block pulls), ``H2O_TPU_CHAOS_OOM`` (probability) /
+  ``H2O_TPU_CHAOS_OOM_TRANSIENT`` (fail the first N attempts at each
+  dispatch site with a synthetic RESOURCE_EXHAUSTED),
+  ``H2O_TPU_CHAOS_SEED``;
+- OOM degradation ladder (core/oom.py, wrapped around every device
+  dispatch choke point): ``H2O_TPU_OOM_SWEEP_RETRIES`` (default 2 —
+  how many spill-the-LRU-and-retry attempts before the ladder descends
+  to quantum shrinking / host fallback / terminal job failure).
 """
 
 from __future__ import annotations

@@ -17,6 +17,13 @@ Transient compute buffers (binned matrices, histograms, model state) are
 XLA's to manage; the data plane — the part that scales with row count —
 is what lives here, exactly as the reference's Cleaner only swaps DKV
 Values, not call stacks.
+
+This is the ACCOUNTING half of the memory story; the RECOVERY half is
+core/oom.py: on a device RESOURCE_EXHAUSTED, the OOM ladder's first
+rung calls :meth:`MemoryManager.sweep` (spill everything cold) and
+retries the dispatch.  Spills run OUTSIDE the manager lock (candidates
+are collected under it), so a Vec whose spill/reload path re-enters the
+manager can never deadlock against a concurrent sweep.
 """
 
 from __future__ import annotations
@@ -56,17 +63,18 @@ class MemoryManager:
             return sum(self._resident.values())
 
     def register(self, vec, nbytes: int) -> None:
-        """A Vec's device payload came alive; evict LRU columns first if
-        the budget would be exceeded (Cleaner sweep)."""
+        """A Vec's device payload came alive; evict LRU columns if the
+        budget is exceeded (Cleaner sweep).  The spill itself runs
+        OUTSIDE the manager lock (see _spill_lru)."""
         with self._lock:
             self._prune()
-            if self.budget > 0:
-                need = self.resident_bytes + nbytes - self.budget
-                if need > 0:
-                    self._spill_lru(need, exclude=vec)
             r = weakref.ref(vec)
             vec._mm_ref = r              # O(1) touch/unregister handle
             self._resident[r] = int(nbytes)
+            need = (sum(self._resident.values()) - self.budget) \
+                if self.budget > 0 else 0
+        if need > 0:
+            self._spill_lru(need, exclude=vec)
 
     def touch(self, vec) -> None:
         """Mark recently used (moves to the MRU end)."""
@@ -85,32 +93,57 @@ class MemoryManager:
             self._resident.pop(r, None)
 
     def _spill_lru(self, need_bytes: int, exclude=None) -> int:
+        """Spill the coldest columns until ``need_bytes`` are freed.
+
+        Two-phase: candidates are COLLECTED under the manager lock, but
+        each ``v._spill()`` (the device-array drop, which takes the
+        Vec's own spill lock and may re-enter manager accounting) runs
+        OUTSIDE it — a Vec whose spill/reload path touches the manager
+        can never deadlock against a concurrent sweep."""
+        with self._lock:
+            cands = []
+            planned = 0
+            for r in list(self._resident):      # LRU order
+                if planned >= need_bytes:
+                    break
+                v = r()
+                if v is None or v is exclude:
+                    continue
+                cands.append((r, v, self._resident[r]))
+                planned += self._resident[r]
         freed = 0
-        for r in list(self._resident):          # LRU order
-            if freed >= need_bytes:
-                break
-            v = r()
-            if v is None or v is exclude:
-                continue
-            nb = self._resident[r]
+        for r, v, nb in cands:
             if v._spill():                      # drops the device array
-                self._resident.pop(r, None)
-                freed += nb
-                self.spill_count += 1
+                with self._lock:
+                    if self._resident.pop(r, None) is not None:
+                        self.spill_count += 1
+                        freed += nb
         if freed:
             log.info("spilled %d bytes of cold columns to host "
                      "(budget %d)", freed, self.budget)
         return freed
 
+    def sweep(self) -> int:
+        """Emergency Cleaner sweep (OOM-ladder rung (a), core/oom.py):
+        spill EVERY resident column, returning the bytes freed — the
+        user-mode-swap answer to a RESOURCE_EXHAUSTED dispatch."""
+        return self._spill_lru(1 << 62)
+
     def note_reload(self) -> None:
         self.reload_count += 1
 
     def stats(self) -> dict:
-        return {"budget": self.budget,
-                "resident_bytes": self.resident_bytes,
-                "resident_vecs": len(self._resident),
-                "spills": self.spill_count,
-                "reloads": self.reload_count}
+        with self._lock:
+            self._prune()
+            sizes = sorted(self._resident.values(), reverse=True)
+            return {"budget": self.budget,
+                    "resident_bytes": sum(sizes),
+                    "resident_vecs": len(sizes),
+                    "spills": self.spill_count,
+                    "reloads": self.reload_count,
+                    # who is holding HBM (top allocations) — the OOM
+                    # terminal diagnostic names these
+                    "largest_holders": sizes[:5]}
 
 
 _manager: Optional[MemoryManager] = None
@@ -142,8 +175,7 @@ def set_budget(budget_bytes: int) -> MemoryManager:
             new.reload_count = _manager.reload_count
         _manager = new
     if new.budget > 0:
-        with new._lock:
-            over = new.resident_bytes - new.budget
-            if over > 0:
-                new._spill_lru(over)
+        over = new.resident_bytes - new.budget
+        if over > 0:
+            new._spill_lru(over)
     return new

@@ -47,6 +47,7 @@ from h2o_tpu.core.cloud import (DATA_AXIS, cloud, donation_enabled,
                                 shard_map_compat)
 from h2o_tpu.core.diag import DispatchStats
 from h2o_tpu.core.frame import Frame
+from h2o_tpu.core.oom import oom_ladder
 
 REDUCERS = {
     "sum": lambda x: jax.lax.psum(x, DATA_AXIS),
@@ -183,7 +184,11 @@ def map_reduce(map_fn: Callable, *arrays: jax.Array, reduce: str = "sum",
 
     fn = _CACHE.get_or_build("map_reduce", key, build)
     DispatchStats.note_dispatch("map_reduce")
-    return fn(*arrays, *extra_args)
+    # OOM ladder (core/oom.py): a RESOURCE_EXHAUSTED dispatch sweeps the
+    # HBM LRU and retries instead of killing the job — there is no work
+    # quantum to shrink here (one fused program), so the ladder is
+    # sweep-retry -> terminal OOMError
+    return oom_ladder("map_reduce", lambda: fn(*arrays, *extra_args))
 
 
 def map_frame(map_fn: Callable, frame: Frame,
@@ -199,7 +204,7 @@ def map_frame(map_fn: Callable, frame: Frame,
     key = ("map_frame", map_fn, _aval_key(m))
     fn = _CACHE.get_or_build("map_frame", key, lambda: jax.jit(map_fn))
     DispatchStats.note_dispatch("map_frame")
-    return fn(m)
+    return oom_ladder("map_frame", lambda: fn(m))
 
 
 def mutate_array(map_fn: Callable, array: jax.Array,
@@ -218,7 +223,20 @@ def mutate_array(map_fn: Callable, array: jax.Array,
 
     fn = _CACHE.get_or_build("mutate", key, build)
     DispatchStats.note_dispatch("mutate")
-    return fn(array, *extras)
+    state = {"fn": fn}
+
+    def _no_donate(_exc):
+        # OOM-ladder retries must not re-donate: the retry re-reads the
+        # input buffer, so route it through the non-donating executable
+        if donate:
+            nd_key = ("mutate", map_fn, False, _aval_key(array),
+                      tuple(_aval_key(e) for e in extras))
+            state["fn"] = _CACHE.get_or_build(
+                "mutate", nd_key,
+                lambda: jax.jit(map_fn, donate_argnums=()))
+
+    return oom_ladder("mutate", lambda: state["fn"](array, *extras),
+                      on_oom=_no_donate)
 
 
 @jax.jit

@@ -77,8 +77,16 @@ class DKV:
         with self._lock:
             return Key(key) in self._store
 
-    def remove(self, key: str) -> None:
+    def remove(self, key: str, force: bool = False) -> None:
+        """Delete a key.  Respects the Lockable discipline like ``put``:
+        removing a write-locked entry raises :class:`LockedException`
+        unless ``force=True`` — the escape hatch for job-cleanup paths
+        (Scope teardown, remove-all, shutdown) that legitimately tear
+        down mid-build state."""
         with self._lock:
+            e = self._store.get(Key(key))
+            if e is not None and e.write_locked and not force:
+                raise LockedException(f"{key} is write-locked")
             self._store.pop(Key(key), None)
 
     def keys(self, pattern: str = "*") -> List[Key]:
@@ -119,10 +127,15 @@ class DKV:
 
     # -- atomic update (water/Atomic.java CAS-on-home-node) ----------------
 
-    def atomic(self, key: str, fn) -> Any:
-        """Atomically transform the value under ``key``; returns new value."""
+    def atomic(self, key: str, fn, force: bool = False) -> Any:
+        """Atomically transform the value under ``key``; returns new
+        value.  A write-locked entry raises :class:`LockedException`
+        (the same discipline ``put`` enforces — an atomic update is
+        still a replace) unless ``force=True``."""
         with self._lock:
             e = self._store.get(Key(key))
+            if e is not None and e.write_locked and not force:
+                raise LockedException(f"{key} is write-locked")
             old = None if e is None else e.value
             new = fn(old)
             self._store[Key(key)] = _Entry(new)
@@ -156,7 +169,9 @@ class Scope:
         Scope._tls.stack.pop()
         for k in self.tracked:
             if k not in self.protected:
-                self.dkv.remove(k)
+                # cleanup path: a tracked temp may die write-locked when
+                # its builder failed mid-run — force the leak purge
+                self.dkv.remove(k, force=True)
         return None
 
     def track(self, key: str) -> Key:

@@ -12,6 +12,16 @@ are added (one forest_score over the block), so total scoring work is O(T) —
 the reference's per-scoring-round full-model rescore (BigScore over all
 trees) is avoided entirely.
 
+OOM DEGRADATION LADDER (core/oom.py): every block launch runs under
+``oom_ladder("tree.block", ...)`` — a RESOURCE_EXHAUSTED dispatch first
+sweeps the HBM LRU and retries, then HALVES the block size (the smaller
+quantum sticks for the rest of the run) and retries again.  Degraded
+runs stay bitwise-identical because per-tree RNG keys fold the ABSOLUTE
+tree index into the forest master key (jit_engine), so any partition of
+the forest into blocks reproduces the same trees.  A terminal OOM (or
+any crash) inside a speculative launch first persists the completed-
+but-uncheckpointed previous block, so Recovery resumes after it.
+
 ASYNC DOUBLE-BUFFERING (H2O_TPU_ASYNC_DRIVER, default on): the original
 loop blocked on ``np.asarray`` per block, serializing host
 materialization of block *t*'s tree arrays against the device build of
@@ -41,6 +51,7 @@ import numpy as np
 
 from h2o_tpu.core.chaos import chaos
 from h2o_tpu.core.diag import DispatchStats, TimeLine
+from h2o_tpu.core.oom import oom_ladder
 from h2o_tpu.models.score_keeper import ScoreKeeper
 
 
@@ -196,8 +207,12 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
     if recovery is not None and ckpt_every <= 0:
         ckpt_every = 10                 # default checkpoint cadence
     if (not want_scoring and recovery is None) or ntrees <= 0:
-        tf = train_forest(F0=F0, key=key, ntrees=max(ntrees, 0),
-                          t0=prior_trees, **train_kwargs)
+        # single-dispatch path: the OOM ladder can sweep-and-retry but
+        # has no block to shrink (the blocked loop below does)
+        tf = oom_ladder(
+            "tree.block",
+            lambda: train_forest(F0=F0, key=key, ntrees=max(ntrees, 0),
+                                 t0=prior_trees, **train_kwargs))
         model = make_model(np.asarray(tf.split_col), np.asarray(tf.bitset),
                            np.asarray(tf.value),
                            np.asarray(tf.child)
@@ -257,38 +272,56 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
     donate_launch = False if (use_async and
                               (may_stop or recovery is not None)) else None
     launched = done
+    no_donate = False       # latched by the OOM ladder: retries re-read F
 
     def _launch(off: int, n: int) -> Dict:
-        nonlocal key, F
-        key, sub = jax.random.split(key)
-        tf = train_forest(F0=F, key=sub, ntrees=n,
-                          t0=prior_trees + off, donate=donate_launch,
-                          **train_kwargs)
+        nonlocal F, block, no_donate
+        # Per-tree RNG folds the ABSOLUTE tree index into the forest
+        # master key (jit_engine), so every block receives the SAME
+        # master key and any partition — including an OOM-degraded
+        # halving below — reproduces the identical forest bitwise.
+        F_in = F
+        state = {"n": n}
+
+        def attempt():
+            return train_forest(F0=F_in, key=key, ntrees=state["n"],
+                                t0=prior_trees + off,
+                                donate=False if no_donate
+                                else donate_launch,
+                                **train_kwargs)
+
+        def shrink() -> bool:
+            # OOM-ladder rung (b): halve the block; the smaller quantum
+            # sticks for the rest of the run (stay degraded, stay alive)
+            nonlocal block
+            if state["n"] <= 1:
+                return False
+            state["n"] //= 2
+            block = min(block, state["n"])
+            return True
+
+        def on_oom(_e):
+            # a retried dispatch re-reads F_in — never donate it again
+            nonlocal no_donate
+            no_donate = True
+
+        tf = oom_ladder("tree.block", attempt, shrink=shrink,
+                        on_oom=on_oom)
         F = tf.f_final
         _start_host_pull(tf)
         TimeLine.record("dispatch", "tree_block_launch",
-                        t0=prior_trees + off, n=n)
-        # key_after is what the sync loop would checkpoint at this block:
-        # the stream state BEFORE any speculative split for block t+1
-        return {"tf": tf, "n": n, "off": off, "key_after": key}
+                        t0=prior_trees + off, n=state["n"])
+        # key_after: the master key is block-invariant, so a checkpoint
+        # resumed at any block boundary continues the same stream
+        return {"tf": tf, "n": state["n"], "off": off, "key_after": key}
 
-    pend = None
-    if use_async and done < ntrees:
-        pend = _launch(launched, min(block, ntrees - launched))
-        launched += pend["n"]
-    while done < ntrees:
-        if use_async:
-            cur = pend
-            pend = None
-            if launched < ntrees:
-                # dispatch block t+1 BEFORE materializing block t — the
-                # host pulls below overlap its device build; only the
-                # ScoreKeeper decision point below synchronizes
-                pend = _launch(launched, min(block, ntrees - launched))
-                launched += pend["n"]
-        else:
-            cur = _launch(launched, min(block, ntrees - launched))
-            launched += cur["n"]
+    def _absorb(cur: Dict) -> bool:
+        """Materialize block ``cur``, fold it into the model state,
+        score it, and write its recovery checkpoint; returns the early-
+        stop decision.  Shared by the happy path and the crash path
+        below (a speculative launch that dies must not lose the
+        already-completed previous block)."""
+        nonlocal vi_total, done
         tf, n = cur["tf"], cur["n"]
         chaos().maybe_slow_transfer("tree_block")
         scs.append(np.asarray(tf.split_col))
@@ -339,6 +372,43 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
                 meta={"kind": "tree",
                       "trees_done": prior_trees + done,
                       "ntrees": int(p["ntrees"])})
+        return stop
+
+    pend = None
+    if use_async and done < ntrees:
+        pend = _launch(launched, min(block, ntrees - launched))
+        launched += pend["n"]
+    while done < ntrees:
+        if use_async:
+            cur = pend
+            pend = None
+            if launched < ntrees:
+                # dispatch block t+1 BEFORE materializing block t — the
+                # host pulls below overlap its device build; only the
+                # ScoreKeeper decision point below synchronizes
+                try:
+                    pend = _launch(launched,
+                                   min(block, ntrees - launched))
+                    launched += pend["n"]
+                except BaseException:
+                    # the speculative launch died (crash, terminal OOM)
+                    # with block t complete on device but NOT yet
+                    # checkpointed — persist it best-effort before
+                    # propagating, so Recovery resumes AFTER it instead
+                    # of losing it (durability beats overlap on the
+                    # death path)
+                    if recovery is not None and cur is not None:
+                        try:
+                            _absorb(cur)
+                            cur = None
+                        except BaseException:  # noqa: BLE001
+                            pass               # dying anyway
+                    raise
+        else:
+            cur = _launch(launched, min(block, ntrees - launched))
+            launched += cur["n"]
+        tf = cur["tf"]
+        stop = _absorb(cur)
         if not stop and max_rt > 0 and time.time() - t_start > max_rt:
             job.update(0.9, f"max_runtime_secs hit at {done} trees")
             stop = True

@@ -928,10 +928,16 @@ def _train_forest_impl(bins, yv, w, active, F0, is_cat, key, *,
             out = out + (jnp.stack(chs),)
         return F, out
 
-    keys = jax.random.split(key, ntrees)
-    # t0 is a TRACED scalar (not static): per-block calls with varying tree
-    # offsets reuse one compiled program
-    ts = jnp.arange(ntrees, dtype=jnp.float32) + jnp.float32(t0)
+    # Per-tree keys fold the ABSOLUTE tree index into the forest master
+    # key (not a per-block split): tree t's stream depends only on
+    # (master key, t), so ANY partition of the forest into blocks —
+    # including a mid-run block-size halving by the OOM degradation
+    # ladder (models/tree/driver.py) — reproduces the identical forest
+    # bit for bit.  t0 stays a TRACED scalar: per-block calls with
+    # varying tree offsets reuse one compiled program.
+    ti = jnp.arange(ntrees, dtype=jnp.int32) + jnp.int32(t0)
+    keys = jax.vmap(lambda t: jax.random.fold_in(key, t))(ti)
+    ts = ti.astype(jnp.float32)
     F_final, outs = jax.lax.scan(tree_step, F0, (ts, keys))
     if kleaves > 0:
         sc, bs, vl, vi, gn, nw, th, na, ch = outs

@@ -118,7 +118,8 @@ def tree_pojo(model) -> str:
         f"// Model: {model.key}  algo={model.algo}  ntrees={T} "
         f"nclasses={nclass}",
         f"public class {cls} {{",
-        f"  public static final String[] NAMES = {{{', '.join('"%s"' % n for n in x)}}};",  # noqa: E501
+        "  public static final String[] NAMES = {%s};"
+        % ", ".join('"%s"' % n for n in x),
     ]
     if resp_dom:
         doms = ", ".join(f'"{d}"' for d in resp_dom)
@@ -218,7 +219,8 @@ def glm_pojo(model) -> str:
         "// Generated POJO scorer - h2o-tpu "
         "(reference format: hex/glm/GLMModel.toJavaPredictBody)",
         f"public class {cls} {{",
-        f"  public static final String[] NAMES = {{{', '.join('"%s"' % n for n in x)}}};",  # noqa: E501
+        "  public static final String[] NAMES = {%s};"
+        % ", ".join('"%s"' % n for n in x),
         "  public static double[] score0(double[] data) {",
         f"    double eta = {intercept!r};",
     ]
@@ -326,7 +328,8 @@ def kmeans_pojo(model) -> str:
         "// Generated POJO scorer - h2o-tpu "
         "(reference format: hex/kmeans KMeansModel POJO)",
         f"public class {cls} {{",
-        f"  public static final String[] NAMES = {{{', '.join('"%s"' % n for n in x)}}};",  # noqa: E501
+        "  public static final String[] NAMES = {%s};"
+        % ", ".join('"%s"' % n for n in x),
     ]
     _matrix_java("CENTERS", centers, lines)
     lines.append("  public static double[] score0(double[] data) {")
@@ -372,7 +375,8 @@ def deeplearning_pojo(model) -> str:
         "// Generated POJO scorer - h2o-tpu "
         "(reference format: DeepLearningModel POJO codegen)",
         f"public class {cls} {{",
-        f"  public static final String[] NAMES = {{{', '.join('"%s"' % n for n in x)}}};",  # noqa: E501
+        "  public static final String[] NAMES = {%s};"
+        % ", ".join('"%s"' % n for n in x),
     ]
     if resp_dom:
         doms = ", ".join(f'"{d}"' for d in resp_dom)

@@ -270,15 +270,28 @@ def _lit(node):
     return node
 
 
+def _host_oracle(fn, *args):
+    """Run a ``*_host`` parity oracle under the munge phase scope (host
+    pulls stay attributed) — the OOM ladder's rung-(c) entry point."""
+    with DispatchStats.phase_scope("munge"):
+        return fn(*args)
+
+
 def _row_select(fr: Frame, sel, sess) -> Frame:
     if isinstance(sel, Frame):  # boolean mask frame
         mv = sel.vecs[0]
         from h2o_tpu.core.munge import device_munge_enabled
+        from h2o_tpu.core.oom import oom_ladder
         if device_munge_enabled() and frame_device_ok(fr) and \
                 mv.data is not None:
             # device compaction: the mask never lands on host; only the
-            # surviving row count syncs (core/munge.filter_rows)
-            return fr.slice_rows(mv.data)
+            # surviving row count syncs (core/munge.filter_rows).  On
+            # device OOM the ladder sweeps the HBM LRU and finally runs
+            # the host parity oracle (same rows by the parity contract).
+            return oom_ladder(
+                "munge.filter", lambda: fr.slice_rows(mv.data),
+                host_fallback=lambda: _host_oracle(
+                    _row_select_mask_host, fr, mv))
         with DispatchStats.phase_scope("munge"):
             return _row_select_mask_host(fr, mv)
     elif isinstance(sel, tuple) and sel[0] == "numlist":
@@ -716,11 +729,19 @@ def _sort(node, env):
     asc = [bool(int(x)) for x in node[3][1]] if len(node) > 3 \
         else [True] * len(idxs)
     from h2o_tpu.core.munge import device_munge_enabled, sort_frame
+    from h2o_tpu.core.oom import oom_ladder
     if device_munge_enabled() and frame_device_ok(fr):
-        return sort_frame(fr, idxs, asc)
-    with DispatchStats.phase_scope("munge"):
-        order = _sort_keys(fr, idxs, asc)
-        return fr.slice_rows(order)
+        return oom_ladder(
+            "munge.sort", lambda: sort_frame(fr, idxs, asc),
+            host_fallback=lambda: _host_oracle(_sort_host, fr, idxs,
+                                               asc))
+    return _host_oracle(_sort_host, fr, idxs, asc)
+
+
+def _sort_host(fr: Frame, idxs, asc) -> Frame:
+    """Host lexsort fallback and parity oracle for the device sort."""
+    order = _sort_keys(fr, idxs, asc)
+    return fr.slice_rows(order)
 
 
 def _key_codes(fr: Frame, cols: List[int]):
@@ -762,10 +783,14 @@ def _merge(node, env):
         by_y = [R.names.index(n) for n in common]
     from h2o_tpu.core.munge import (device_munge_enabled, merge_device_ok,
                                     merge_frames)
+    from h2o_tpu.core.oom import oom_ladder
     if device_munge_enabled() and merge_device_ok(L, R, by_x, by_y):
-        return merge_frames(L, R, all_x, all_y, by_x, by_y)
-    with DispatchStats.phase_scope("munge"):
-        return _merge_host(L, R, all_x, all_y, by_x, by_y)
+        return oom_ladder(
+            "munge.merge",
+            lambda: merge_frames(L, R, all_x, all_y, by_x, by_y),
+            host_fallback=lambda: _host_oracle(_merge_host, L, R, all_x,
+                                               all_y, by_x, by_y))
+    return _host_oracle(_merge_host, L, R, all_x, all_y, by_x, by_y)
 
 
 def _merge_host(L: Frame, R: Frame, all_x: bool, all_y: bool,
@@ -901,11 +926,14 @@ def _groupby(node, env):
         i += 3
     from h2o_tpu.core.munge import (DEVICE_AGGS, device_munge_enabled,
                                     groupby_frame)
+    from h2o_tpu.core.oom import oom_ladder
     if device_munge_enabled() and frame_device_ok(fr) and \
             all(a in DEVICE_AGGS for a, _c, _n in aggs):
-        return groupby_frame(fr, gcols, aggs)
-    with DispatchStats.phase_scope("munge"):
-        return _groupby_host(fr, gcols, aggs)
+        return oom_ladder(
+            "munge.groupby", lambda: groupby_frame(fr, gcols, aggs),
+            host_fallback=lambda: _host_oracle(_groupby_host, fr, gcols,
+                                               aggs))
+    return _host_oracle(_groupby_host, fr, gcols, aggs)
 
 
 def _groupby_host(fr: Frame, gcols: List[int], aggs) -> Frame:

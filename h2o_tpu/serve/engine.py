@@ -183,17 +183,54 @@ class ScoringEngine:
         """Score one (already encoded) micro-batch.  Pads rows up to the
         power-of-two bucket, runs the cached compiled predict, slices the
         padding back off.  The chaos slow-score injector lives here so
-        overload shedding and deadline expiry are testable."""
+        overload shedding and deadline expiry are testable.
+
+        OOM ladder (core/oom.py): a RESOURCE_EXHAUSTED predict sweeps
+        the HBM LRU and retries; if that fails the micro-batch is SPLIT
+        (halved chunks score through smaller — already warm or cheaper —
+        buckets, a recorded degradation); the last rung before failing
+        the request is the pure-NumPy mojo scorer."""
         chaos().maybe_slow_score(f"serve:{model.key}")
         n = X.shape[0]
         use_device = self.has_device_predict(model) and \
             (str(model.key), int(version)) not in self._no_device
         if not use_device:
-            raw = self.view(model, version).score_matrix(
-                np.asarray(X, np.float64))
-            with self._lock:
-                self.fallback_batches += 1
-            return np.asarray(raw)
+            return self._predict_host(model, version, X)
+        state = {"chunk": n}
+
+        def attempt():
+            c = state["chunk"]
+            if c >= n:
+                return self._predict_bucketed(model, version, X)
+            outs = [self._predict_bucketed(model, version, X[i:i + c])
+                    for i in range(0, n, c)]
+            return np.concatenate(outs, axis=0)
+
+        def shrink() -> bool:
+            if state["chunk"] <= 1:
+                return False
+            state["chunk"] = max(1, state["chunk"] // 2)
+            return True
+
+        from h2o_tpu.core.oom import oom_ladder
+        return oom_ladder(
+            "serve.predict", attempt, shrink=shrink,
+            host_fallback=lambda: self._predict_host(model, version, X))
+
+    def _predict_host(self, model, version: int, X: np.ndarray) \
+            -> np.ndarray:
+        """Pure-NumPy mojo-scorer path (no device, no compile) — the
+        no-device fallback and the OOM ladder's last resort."""
+        raw = self.view(model, version).score_matrix(
+            np.asarray(X, np.float64))
+        with self._lock:
+            self.fallback_batches += 1
+        return np.asarray(raw)
+
+    def _predict_bucketed(self, model, version: int,
+                          X: np.ndarray) -> np.ndarray:
+        """One compiled-predict dispatch at X's power-of-two bucket."""
+        n = X.shape[0]
         b = _bucket(n)
         Xp = np.zeros((b, X.shape[1]), np.float32)
         Xp[:n] = X

@@ -55,6 +55,13 @@ def pytest_configure(config):
         "slow: compile-heavy suite (multi-minute on the 1-core CPU "
         "mesh).  Fast tier: pytest -m 'not slow' (~minutes); the full "
         "default run stays the release gate")
+    config.addinivalue_line(
+        "markers",
+        "soak: randomized multi-fault chaos soak (tools/soak.py; "
+        "seeded, minute-scale).  Soak tests are ALSO marked slow, so "
+        "the tier-1 fast run (-m 'not slow') excludes them by the "
+        "existing convention; run explicitly with -m soak or via "
+        "tools/soak.py --seed N --duration S")
 
 
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
@@ -78,6 +85,18 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
             "[host-pulls] total={} bytes={} munge={} munge_bytes={}"
             .format(sum(pulls.values()), sum(pbytes.values()),
                     pulls.get("munge", 0), pbytes.get("munge", 0)))
+        from h2o_tpu.core import oom, resilience
+        from h2o_tpu.core.chaos import chaos
+        from h2o_tpu.core.memory import manager
+        rs, os_, ms = resilience.stats(), oom.stats(), manager().stats()
+        terminalreporter.write_line(
+            "[resilience] retries={} recoveries={} giveups={} | "
+            "oom_events={} sweeps={} degradations={} terminal={} | "
+            "spills={} reloads={} | chaos_injected={}".format(
+                rs["retries"], rs["recoveries"], rs["giveups"],
+                os_["oom_events"], os_["sweeps"], os_["degradations"],
+                os_["terminal_failures"], ms["spills"], ms["reloads"],
+                chaos().injected))
     except Exception:  # noqa: BLE001 — reporting must never fail a run
         pass
 
@@ -126,6 +145,6 @@ def _dkv_leak_check(request):
         return
     leaked = sorted(set(map(str, inst.dkv.keys())) - before)
     for k in leaked:
-        inst.dkv.remove(k)
+        inst.dkv.remove(k, force=True)   # purge even locked leftovers
     if leaked and os.environ.get("H2O_TPU_STRICT_LEAKS") == "1":
         pytest.fail(f"leaked {len(leaked)} DKV keys: {leaked[:20]}")

@@ -175,6 +175,123 @@ def test_munge_host_fallbacks_still_exist():
     assert not missing, f"host munge fallbacks missing: {sorted(missing)}"
 
 
+# Every chaos injector must be observable: a ``maybe_*`` method that
+# injects without bumping a DEDICATED ``injected_*`` counter makes soak
+# accounting impossible (faults happen that no counter explains), and a
+# counter that never reaches the /3/Resilience payload is invisible to
+# operators.  Both halves are enforced here: AST over core/chaos.py for
+# the increments, and a live handler call for the payload.
+
+def _chaos_injector_counters():
+    """Map each ``maybe_*`` method of _Chaos to the set of dedicated
+    ``self.injected_*`` counters it increments (AugAssign or the
+    ``self.x += 1``-equivalent Assign), excluding the ``injected``
+    grand total."""
+    pkg_root = os.path.dirname(h2o_tpu.__file__)
+    path = os.path.join(pkg_root, "core", "chaos.py")
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    cls = next(n for n in ast.walk(tree)
+               if isinstance(n, ast.ClassDef) and n.name == "_Chaos")
+    out = {}
+    for fn in cls.body:
+        if not isinstance(fn, ast.FunctionDef) or \
+                not fn.name.startswith("maybe_"):
+            continue
+        counters = set()
+        for node in ast.walk(fn):
+            targets = []
+            if isinstance(node, ast.AugAssign):
+                targets = [node.target]
+            elif isinstance(node, ast.Assign):
+                targets = node.targets
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self" and \
+                        t.attr.startswith("injected_"):
+                    counters.add(t.attr)
+        out[fn.name] = counters
+    return out
+
+
+def test_every_chaos_injector_has_a_dedicated_counter():
+    by_injector = _chaos_injector_counters()
+    assert by_injector, "no maybe_* injectors found in core/chaos.py"
+    missing = sorted(name for name, ctrs in by_injector.items()
+                     if not ctrs)
+    assert not missing, (
+        "chaos injectors without a dedicated injected_* counter — soak "
+        "runs cannot account for their faults (add self.injected_<x> "
+        "+= 1 next to the injection): " + ", ".join(missing))
+
+
+def test_chaos_counters_reach_resilience_payload(cl):
+    """Every dedicated injector counter (and the grand total) must be a
+    key of the /3/Resilience ``chaos`` block; the soak harness asserts
+    injected == sum of the per-type counters against exactly this
+    payload."""
+    from h2o_tpu.api.handlers import resilience_stats
+    payload = resilience_stats({})
+    chaos_block = payload["chaos"]
+    wanted = {"injected"}
+    for ctrs in _chaos_injector_counters().values():
+        wanted |= ctrs
+    missing = sorted(wanted - set(chaos_block))
+    assert not missing, (
+        f"chaos counters absent from GET /3/Resilience: {missing}")
+    # the OOM ladder + memory manager surfaces ride the same route
+    assert {"oom_events", "degradations", "sweeps", "sites"} <= \
+        set(payload["oom"])
+    assert {"resident_bytes", "spills", "reloads",
+            "largest_holders"} <= set(payload["memory"])
+
+
+def test_chaos_injection_sequence_is_seed_deterministic():
+    """Same H2O_TPU_CHAOS_SEED => identical injection decisions across
+    the FULL injector set (the soak harness's reproducibility
+    contract).  Sleeps are zeroed so the drill is instant."""
+    from h2o_tpu.core import chaos
+
+    def run_script():
+        c = chaos.configure(job_p=0.4, device_put_p=0.4, persist_p=0.4,
+                            stall_p=0.4, stall_secs=0.0,
+                            score_slow_p=0.4, score_slow_ms=0.0,
+                            transfer_slow_p=0.4, transfer_slow_ms=0.0,
+                            oom_p=0.4, seed=1234)
+        seq = []
+        for i in range(30):
+            for step, fn in (
+                    ("job", lambda: c.maybe_fail_job("drill")),
+                    ("dput", c.maybe_fail_device_put),
+                    ("persist", lambda: c.maybe_fail_persist(
+                        "write", f"mem://k{i}")),
+                    ("stall", lambda: c.maybe_stall("drill")),
+                    ("slow", lambda: c.maybe_slow_score("drill")),
+                    ("xfer", lambda: c.maybe_slow_transfer("drill")),
+                    ("oom", lambda: c.maybe_oom(f"site{i}"))):
+                before = c.injected
+                try:
+                    fn()
+                except chaos.ChaosError:
+                    pass
+                seq.append((step, c.injected - before))
+        counters = dict(c.counters())
+        # accounting invariant: the grand total equals the per-type sum
+        assert counters.pop("injected") == sum(counters.values())
+        return seq, counters
+
+    try:
+        s1, c1 = run_script()
+        s2, c2 = run_script()
+        assert s1 == s2, \
+            "same seed produced different injection sequences"
+        assert c1 == c2
+        assert sum(n for _w, n in s1) > 0, "drill injected nothing"
+    finally:
+        chaos.reset()
+
+
 def test_no_jax_jit_on_local_closures():
     pkg_root = os.path.dirname(h2o_tpu.__file__)
     offenders = []

@@ -85,4 +85,119 @@ def test_stats_surface(cl, tight_budget, rng):
     assert s["budget"] == 600_000
     assert s["resident_bytes"] >= 0
     assert set(s) >= {"budget", "resident_bytes", "resident_vecs",
-                      "spills", "reloads"}
+                      "spills", "reloads", "largest_holders"}
+    # largest holders are real allocation sizes, descending
+    lh = s["largest_holders"]
+    assert lh == sorted(lh, reverse=True)
+
+
+def test_emergency_sweep_spills_everything(cl, rng):
+    """The OOM ladder's rung (a): sweep() drops EVERY resident device
+    payload; reads afterwards are transparent reloads."""
+    from h2o_tpu.core.frame import Frame, Vec
+    from h2o_tpu.core.memory import manager
+    m = manager()
+    n = 10_000
+    data = rng.normal(size=n).astype(np.float32)
+    fr = Frame(["a", "b"], [Vec(data), Vec(data * 2)])
+    before = m.stats()["spills"]
+    freed = m.sweep()
+    assert freed > 0
+    assert m.stats()["spills"] >= before + 2
+    # frame columns survived the sweep byte-for-byte
+    np.testing.assert_array_equal(fr.vec("a").to_numpy(), data)
+    np.testing.assert_array_equal(fr.vec("b").to_numpy(), data * 2)
+
+
+def test_concurrent_register_touch_spill_reload(cl, rng):
+    """Satellite drill: parallel register/touch/sweep/reload against a
+    tight budget — accounting must never go negative, reloads must be
+    transparent (every column always reads back its exact bytes), and
+    no thread may deadlock (the two-phase _spill_lru runs device drops
+    OUTSIDE the manager lock)."""
+    import threading
+    from h2o_tpu.core.frame import Frame, Vec
+    from h2o_tpu.core.memory import manager, set_budget
+    prev = manager().budget
+    m = set_budget(400_000)
+    errors = []
+    stop = threading.Event()
+    try:
+        n = 8_000                     # 32 KB/col on device
+        cols = []                     # list: appends are atomic
+
+        def maker(tid):
+            try:
+                r = np.random.default_rng(tid)
+                for i in range(6):
+                    data = r.normal(size=n).astype(np.float32)
+                    fr = Frame([f"c{tid}_{i}"], [Vec(data)])
+                    cols.append((fr, data))
+            except Exception as e:  # noqa: BLE001 — collected
+                errors.append(e)
+
+        def reader(tid):
+            try:
+                r = np.random.default_rng(100 + tid)
+                while not stop.is_set():
+                    k = len(cols)
+                    if not k:
+                        continue
+                    fr, data = cols[int(r.integers(k))]
+                    got = fr.vecs[0].to_numpy()   # touch or reload
+                    np.testing.assert_array_equal(got, data)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def sweeper():
+            try:
+                while not stop.is_set():
+                    m.sweep()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=maker, args=(t,))
+                   for t in range(3)]
+        threads += [threading.Thread(target=reader, args=(t,))
+                    for t in range(2)]
+        threads += [threading.Thread(target=sweeper)]
+        for t in threads:
+            t.start()
+        for t in threads[:3]:
+            t.join(timeout=60)
+        stop.set()
+        for t in threads[3:]:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), \
+            "memory-manager thread wedged (spill-path deadlock?)"
+        assert not errors, errors
+        assert m.resident_bytes >= 0   # accounting never went negative
+        # every column still reads back exactly after the storm
+        for fr, data in cols:
+            np.testing.assert_array_equal(fr.vecs[0].to_numpy(), data)
+    finally:
+        stop.set()
+        set_budget(prev)
+
+
+def test_set_budget_mid_flight_enforces_immediately(cl, rng):
+    """Tightening the budget while columns are live sweeps AT ONCE (not
+    on the next register) and carries accounting over."""
+    from h2o_tpu.core.frame import Frame, Vec
+    from h2o_tpu.core.memory import manager, set_budget
+    prev = manager().budget
+    try:
+        set_budget(0)                 # unlimited: everything resident
+        frames = [Frame(["a"], [Vec(rng.normal(size=20_000)
+                                    .astype(np.float32))])
+                  for _ in range(4)]
+        m = manager()
+        resident = m.resident_bytes
+        assert resident >= 4 * 20_000 * 4
+        m2 = set_budget(100_000)      # tighter than one column set
+        assert m2.resident_bytes <= 100_000
+        assert m2.spill_count > 0
+        for fr in frames:
+            assert fr.vecs[0].to_numpy().shape[0] == 20_000
+    finally:
+        set_budget(prev)

@@ -1,0 +1,281 @@
+"""OOM recovery protocol — the degradation ladder (core/oom.py).
+
+The reference degrades instead of dying under heap pressure
+(water/Cleaner.java swap-to-disk + water/MemoryManager.java OOM-callback
+retries).  These tests drive the TPU rebuild's equivalent with the
+deterministic chaos injector (``H2O_TPU_CHAOS_OOM_TRANSIENT`` /
+``configure(oom_transient=N)``): every dispatch choke point must walk
+sweep -> shrink -> host-fallback -> terminal, degraded reruns must be
+BITWISE-identical to fault-free runs, and a terminal OOM must fail the
+JOB (with an actionable diagnostic) — never the process — leaving the
+DKV/job registry consistent.
+"""
+
+import numpy as np
+import pytest
+
+from h2o_tpu.core.frame import Frame, Vec, T_CAT
+
+
+@pytest.fixture(autouse=True)
+def _reset(cl):
+    from h2o_tpu.core import chaos, oom
+    oom.reset_stats()
+    yield
+    chaos.reset()
+    oom.reset_stats()
+
+
+def _site(name):
+    from h2o_tpu.core import oom
+    return oom.stats()["sites"].get(name, {})
+
+
+# -- classification ----------------------------------------------------------
+
+def test_classification():
+    from h2o_tpu.core import oom
+    from h2o_tpu.core.chaos import ChaosOOMError
+
+    class XlaRuntimeError(Exception):
+        pass
+
+    assert oom.is_device_oom(XlaRuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory allocating 1073741824 bytes"))
+    assert oom.is_device_oom(RuntimeError(
+        "Resource exhausted: failed to allocate request"))
+    assert oom.is_device_oom(ChaosOOMError("injected device OOM"))
+    # not OOM: other runtime errors, other exception families
+    assert not oom.is_device_oom(XlaRuntimeError("INVALID_ARGUMENT"))
+    assert not oom.is_device_oom(ValueError("Out of memory"))
+    # terminal OOMError is NOT re-recoverable (the ladder already ran)
+    assert not oom.is_device_oom(oom.OOMError("site", "diag"))
+
+
+def test_non_oom_errors_propagate_untouched():
+    from h2o_tpu.core import oom
+
+    def attempt():
+        raise ValueError("not an OOM")
+
+    with pytest.raises(ValueError):
+        oom.oom_ladder("t.unrelated", attempt)
+    assert oom.stats()["oom_events"] == 0
+
+
+# -- ladder rung order -------------------------------------------------------
+
+def test_ladder_walks_sweep_shrink_fallback_terminal():
+    """A synthetic site that always OOMs on-device must record every
+    rung in order and end in the host fallback (then, without one, in a
+    terminal OOMError carrying the memory diagnostic)."""
+    from h2o_tpu.core import chaos, oom
+    chaos.configure(oom_transient=1000, seed=0)
+    calls = {"n": 0}
+    quantum = {"q": 8}
+
+    def attempt():
+        calls["n"] += 1
+        return "device"
+
+    def shrink():
+        if quantum["q"] <= 1:
+            return False
+        quantum["q"] //= 2
+        return True
+
+    out = oom.oom_ladder("t.full", attempt, shrink=shrink,
+                         host_fallback=lambda: "host")
+    assert out == "host"
+    s = _site("t.full")
+    assert s["sweeps"] == oom.sweep_retries()
+    assert s["shrinks"] == 3          # 8 -> 4 -> 2 -> 1
+    assert s["host_fallbacks"] == 1
+    assert s["terminal"] == 0
+    # attempts: initial + per-sweep + per-shrink; fallback is off-device
+    assert calls["n"] == 0            # every attempt was injected away
+
+    with pytest.raises(oom.OOMError) as ei:
+        oom.oom_ladder("t.terminal", attempt)
+    assert "resident_bytes" in str(ei.value)      # actionable diagnostic
+    assert "budget" in str(ei.value)
+    assert _site("t.terminal")["terminal"] == 1
+
+
+def test_transient_faults_absorbed_by_sweeps():
+    """fail-first-N-per-site with N <= sweep retries: the ladder
+    recovers at the same quantum and the result is the device one."""
+    from h2o_tpu.core import chaos, oom
+    chaos.configure(oom_transient=2, seed=0)
+    out = oom.oom_ladder("t.sweep", lambda: "device")
+    assert out == "device"
+    s = _site("t.sweep")
+    assert s["oom_events"] == 2 and s["sweeps"] == 2
+    assert s["shrinks"] == 0 and s["terminal"] == 0
+    assert chaos.chaos().injected_oom == 2
+    # site counter exhausted: the next call sails through uninjected
+    assert oom.oom_ladder("t.sweep", lambda: "device") == "device"
+    assert chaos.chaos().injected_oom == 2
+
+
+# -- choke-point integration -------------------------------------------------
+
+def _shard_sum(shard, mask):
+    return (shard * mask).sum()
+
+
+def test_map_reduce_recovers_and_matches(cl, rng):
+    from h2o_tpu.core import chaos, oom
+    from h2o_tpu.core.mrtask import map_reduce, row_mask_shard
+    x = rng.normal(size=256).astype(np.float32)
+    fr = Frame(["x"], [Vec(x)])
+    d = fr.vecs[0].data
+    mask = row_mask_shard(d.shape[0], fr.nrows).astype(np.float32)
+    ref = float(map_reduce(_shard_sum, d, mask))
+    chaos.configure(oom_transient=2, seed=0)
+    assert float(map_reduce(_shard_sum, d, mask)) == ref
+    s = _site("map_reduce")
+    assert s["oom_events"] == 2 and s["sweeps"] == 2
+    assert oom.stats()["terminal_failures"] == 0
+
+
+def test_gbm_train_bitwise_under_injected_oom(cl, rng):
+    """Acceptance drill: with fail-first-2 injection at every site a GBM
+    train completes, records spill/degradation events, and the model is
+    BITWISE-identical to the fault-free run — including when the ladder
+    descends to the block-halving rung (fail-first-4)."""
+    from h2o_tpu.core import chaos, oom
+    x = rng.normal(size=300).astype(np.float32)
+    y = (x + rng.normal(size=300) * 0.3 > 0).astype(np.int32)
+
+    def mk():
+        return Frame(["x", "y"],
+                     [Vec(x), Vec(y, T_CAT, domain=["a", "b"])])
+
+    from h2o_tpu.models.tree.gbm import GBM
+
+    def train():
+        # block size 4: the ladder has 1 initial + 2 sweep + 2 shrink
+        # (4 -> 2 -> 1) attempts, enough to absorb fail-first-4
+        return GBM(ntrees=8, max_depth=3, seed=7, sample_rate=0.7,
+                   score_tree_interval=4).train(y="y",
+                                                training_frame=mk())
+
+    pred_ref = np.asarray(train().predict_raw(mk()))
+    chaos.configure(oom_transient=2, seed=0)
+    m2 = train()
+    np.testing.assert_array_equal(pred_ref,
+                                  np.asarray(m2.predict_raw(mk())))
+    s = _site("tree.block")
+    assert s["oom_events"] >= 1 and s["sweeps"] >= 1
+    # deeper injection: the shrink rung halves the block mid-run and the
+    # forest STILL reproduces bitwise (per-tree RNG keys fold the
+    # absolute tree index, so any block partition is the same forest)
+    chaos.configure(oom_transient=4, seed=0)
+    oom.reset_stats()
+    m3 = train()
+    np.testing.assert_array_equal(pred_ref,
+                                  np.asarray(m3.predict_raw(mk())))
+    assert _site("tree.block")["shrinks"] >= 1
+
+
+def test_groupby_bitwise_under_injected_oom(cl, rng):
+    from h2o_tpu.core import chaos, oom
+    from h2o_tpu.rapids.interp import rapids_exec
+    g = rng.integers(0, 7, size=200).astype(np.float32)
+    v = rng.normal(size=200).astype(np.float32)
+    cl.dkv.put("oomgb", Frame(["g", "v"], [Vec(g), Vec(v)]))
+    ast = '(GB oomgb [0] sum 1 "all" mean 1 "all" nrow 1 "all")'
+    try:
+        ref = [c.to_numpy().copy() for c in rapids_exec(ast).vecs]
+        chaos.configure(oom_transient=2, seed=0)
+        out = rapids_exec(ast)
+        for a, b in zip(ref, out.vecs):
+            np.testing.assert_array_equal(a, b.to_numpy())
+        s = _site("munge.groupby")
+        assert s["oom_events"] == 2 and s["sweeps"] == 2
+        # ladder bottoms out at the host parity oracle: same result to
+        # the parity contract (row order exact; aggregate values to
+        # float noise — the host sums in a different order than the
+        # fused device segment-reduction)
+        chaos.configure(oom_transient=1000, seed=0)
+        oom.reset_stats()
+        out2 = rapids_exec(ast)
+        for a, b in zip(ref, out2.vecs):
+            np.testing.assert_allclose(a, b.to_numpy(), rtol=1e-5,
+                                       atol=1e-6)
+        assert _site("munge.groupby")["host_fallbacks"] == 1
+    finally:
+        cl.dkv.remove("oomgb", force=True)
+
+
+def test_serve_predict_bitwise_under_injected_oom(cl, rng):
+    from h2o_tpu.core import chaos, oom
+    from h2o_tpu.models.tree.gbm import GBM
+    from h2o_tpu.serve.engine import ScoringEngine
+    x = rng.normal(size=300).astype(np.float32)
+    y = (x > 0).astype(np.int32)
+    fr = Frame(["x", "y"], [Vec(x), Vec(y, T_CAT, domain=["n", "p"])])
+    m = GBM(ntrees=3, max_depth=3, seed=1).train(y="y",
+                                                 training_frame=fr)
+    eng = ScoringEngine()
+    X = eng.encode_rows(m, 0, [{"x": float(v)} for v in x[:16]])
+    ref = np.asarray(eng.predict(m, 0, X))
+    # 2 sweeps + 2 batch-splits: degraded chunked scoring, same bytes
+    chaos.configure(oom_transient=4, seed=0)
+    out = np.asarray(eng.predict(m, 0, X))
+    np.testing.assert_array_equal(ref, out)
+    s = _site("serve.predict")
+    assert s["sweeps"] == 2 and s["shrinks"] == 2
+    # ladder bottoms out at the numpy mojo scorer, still serving
+    chaos.configure(oom_transient=1000, seed=0)
+    oom.reset_stats()
+    out2 = np.asarray(eng.predict(m, 0, X))
+    assert out2.shape == ref.shape
+    assert _site("serve.predict")["host_fallbacks"] == 1
+
+
+def test_terminal_oom_fails_job_not_process(cl, rng):
+    """An unrecoverable OOM must surface as a FAILED job carrying
+    OOMError — pool slot reclaimed, registry consistent — exactly like
+    any other job fault (crash-only: no wedged state, no process
+    death)."""
+    from h2o_tpu.core import chaos, oom
+    from h2o_tpu.core.job import Job
+
+    chaos.configure(oom_transient=1000, seed=0)
+
+    def body(job):
+        return oom.oom_ladder("t.job", lambda: "never")
+
+    job = Job(description="oom drill")
+    cl.jobs.start(job, body)
+    with pytest.raises(oom.OOMError):
+        job.join(timeout=30)
+    assert job.status == "FAILED"
+    assert isinstance(job.exception, oom.OOMError)
+    # registry still schedules new work (slot was not leaked)
+    ok = Job(description="after oom")
+    cl.jobs.start(ok, lambda j: 42)
+    assert ok.join(timeout=30) == 42
+
+
+def test_sweep_actually_frees_then_reloads(cl, rng):
+    """Rung (a) is a REAL Cleaner sweep: resident device payloads are
+    spilled to host by oom_ladder and transparently reload after."""
+    from h2o_tpu.core import chaos
+    from h2o_tpu.core.memory import manager
+    from h2o_tpu.core.mrtask import map_reduce, row_mask_shard
+    x = rng.normal(size=4096).astype(np.float32)
+    fr = Frame(["x"], [Vec(x)])
+    spare = Frame(["s"], [Vec(x * 3.0)])      # a cold column to spill
+    d = fr.vecs[0].data
+    mask = row_mask_shard(d.shape[0], fr.nrows).astype(np.float32)
+    before = manager().stats()["spills"]
+    chaos.configure(oom_transient=1, seed=0)
+    tot = float(map_reduce(_shard_sum, d, mask))   # ladder sweeps once
+    assert abs(tot - x.sum()) < 1e-1
+    assert manager().stats()["spills"] > before
+    # spilled columns reload transparently with the same bytes
+    np.testing.assert_array_equal(spare.vec("s").to_numpy(), x * 3.0)
+    np.testing.assert_array_equal(fr.vec("x").to_numpy(), x)

@@ -1,0 +1,328 @@
+#!/usr/bin/env python
+"""Chaos soak orchestrator — randomized multi-fault endurance runs.
+
+In the discipline of Basiri et al. ("Chaos Engineering", IEEE Software
+2016), a resilience mechanism is only real once the SYSTEM's invariants
+are asserted under randomized, composed faults over real workloads —
+not one injector at a time.  This driver composes the full injector set
+(job faults, persist faults, stalls, slow scores, device OOMs) over a
+seeded workload mix (frame build + rollups -> Rapids munge -> GBM train
+with resume -> grid -> online serving) and asserts, after the clock
+runs out:
+
+- every job reached a terminal state (none wedged RUNNING);
+- no leaked pool slots: both job pools return to their configured
+  concurrency once wedged bodies drain;
+- no leaked DKV keys: the store returns to its pre-soak key set;
+- REST stayed responsive THROUGHOUT (every poll of /3/Resilience during
+  the run answered inside its deadline);
+- models recovered through faults are BITWISE-identical to a fault-free
+  run of the same seed;
+- every injected fault is accounted for: the chaos grand total equals
+  the sum of the per-type counters, and OOM ladder events reconcile
+  with the OOM injector's count.
+
+Usage:
+    python tools/soak.py --seed 7 --duration 60
+
+Exit code 0 iff every invariant held; the report prints as JSON.
+``tests/test_chaos_soak.py`` (pytest markers: soak + slow, excluded
+from the tier-1 fast run) drives the same entry point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# allow `python tools/soak.py` from a source checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+TERMINAL = ("DONE", "CANCELLED", "FAILED")
+
+# fault mix: probabilities are deliberately moderate — the point is
+# composition under load, not a 100% storm that never completes work
+FAULTS = dict(job_p=0.15, persist_p=0.15, stall_p=0.10, stall_secs=1.0,
+              score_slow_p=0.3, score_slow_ms=50.0, oom_p=0.10)
+
+
+def _poll_rest(port: int, timeout: float = 5.0) -> dict:
+    import urllib.request
+    t0 = time.monotonic()
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/3/Resilience",
+            timeout=timeout) as r:
+        payload = json.loads(r.read().decode())
+    return {"latency": time.monotonic() - t0, "payload": payload}
+
+
+def _train_reference(frame_of, seed: int):
+    """Fault-free GBM of the soak's fixed (data, params) — the bitwise
+    baseline every recovered model must reproduce."""
+    from h2o_tpu.models.tree.gbm import GBM
+    import numpy as np
+    m = GBM(ntrees=4, max_depth=3, seed=seed,
+            score_tree_interval=2).train(y="y", training_frame=frame_of())
+    return np.asarray(m.predict_raw(frame_of()))
+
+
+def _train_with_recovery(frame_of, seed: int, rec_dir: str,
+                         max_tries: int = 8):
+    """Train the same GBM under faults: injected job faults may kill the
+    build; resume it from its recovery snapshot (or restart) until it
+    completes.  Device OOMs are absorbed by the ladder underneath."""
+    import numpy as np
+    from h2o_tpu.core.recovery import auto_recover, pending_recoveries
+    from h2o_tpu.models.tree.gbm import GBM
+    for attempt in range(max_tries):
+        try:
+            if attempt > 0 and pending_recoveries(rec_dir):
+                models = auto_recover(rec_dir)
+                if models:
+                    m = models[0]
+                    return np.asarray(m.predict_raw(frame_of()))
+                continue
+            m = GBM(ntrees=4, max_depth=3, seed=seed,
+                    score_tree_interval=2, recovery_dir=rec_dir,
+                    checkpoint_interval=2,
+                    model_id=f"soak_gbm_{seed}_{attempt}").train(
+                        y="y", training_frame=frame_of())
+            return np.asarray(m.predict_raw(frame_of()))
+        except Exception:  # noqa: BLE001 — injected fault; try resume
+            continue
+    raise RuntimeError(f"GBM did not complete within {max_tries} "
+                       f"attempts under fault injection")
+
+
+def run_soak(seed: int = 7, duration: float = 60.0,
+             faults: dict = None, verbose: bool = False) -> dict:
+    """Run the soak; returns the invariant report (report['ok'] is the
+    verdict).  Chaos state is reset on exit."""
+    import numpy as np
+
+    from h2o_tpu.api.server import RestServer
+    from h2o_tpu.core import chaos, oom, resilience
+    from h2o_tpu.core.cloud import Cloud
+    from h2o_tpu.core.frame import Frame, T_CAT, Vec
+    from h2o_tpu.rapids.interp import rapids_exec
+
+    cl = Cloud.boot()
+    rng = np.random.default_rng(seed)
+    report = {"seed": seed, "duration": duration, "rounds": 0,
+              "rest_polls": 0, "rest_max_latency": 0.0,
+              "failures": [], "invariants": {}}
+
+    def fail(inv: str, msg: str) -> None:
+        report["failures"].append(f"{inv}: {msg}")
+
+    # ---- baselines (fault-free) -------------------------------------
+    chaos.reset()
+    oom.reset_stats()
+    resilience.reset_stats()
+    keys_before = set(map(str, cl.dkv.keys()))
+    pool_workers = cl.jobs._pool._max_workers
+    sys_workers = cl.jobs._sys_pool._max_workers
+
+    x = rng.normal(size=400).astype(np.float32)
+    g = rng.integers(0, 6, size=400).astype(np.float32)
+    y = (x + rng.normal(size=400) * 0.3 > 0).astype(np.int32)
+
+    def frame_of():
+        return Frame(["x", "y"],
+                     [Vec(x), Vec(y, T_CAT, domain=["n", "p"])])
+
+    pred_ref = _train_reference(frame_of, seed)
+    gb_ast = '(GB soak_fr [1] sum 0 "all" mean 0 "all" nrow 0 "all")'
+    cl.dkv.put("soak_fr", Frame(["x", "g"], [Vec(x), Vec(g)]))
+    gb_ref = [c.to_numpy().copy() for c in rapids_exec(gb_ast).vecs]
+
+    srv = RestServer(port=0).start()
+    rec_root = os.path.join(cl.args.ice_root, f"soak_rec_{seed}")
+
+    # ---- the storm --------------------------------------------------
+    f = dict(FAULTS, **(faults or {}))
+    chaos.configure(seed=seed, **f)
+    t_end = time.monotonic() + duration
+    deployed = []
+    try:
+        while time.monotonic() < t_end:
+            r = report["rounds"]
+            report["rounds"] += 1
+            # REST must answer while the storm runs
+            try:
+                p = _poll_rest(srv.port)
+                report["rest_polls"] += 1
+                report["rest_max_latency"] = max(
+                    report["rest_max_latency"], p["latency"])
+            except Exception as e:  # noqa: BLE001
+                fail("rest_responsive", repr(e))
+            # 1. frame build + rollups (device_put / map_reduce surface)
+            try:
+                fr = Frame(["a"], [Vec(rng.normal(size=256)
+                                       .astype(np.float32))])
+                fr.vec("a").mean()
+            except Exception:  # noqa: BLE001 — injected faults are fine
+                pass
+            # 2. munge: the group-by must ALWAYS reproduce the baseline
+            #    — bitwise while on device (sweep/shrink rungs), to
+            #    float noise if the ladder lands on the host oracle
+            #    (different summation order, same parity contract)
+            try:
+                fb_before = oom.stats()["sites"].get(
+                    "munge.groupby", {}).get("host_fallbacks", 0)
+                out = rapids_exec(gb_ast)
+                fb_after = oom.stats()["sites"].get(
+                    "munge.groupby", {}).get("host_fallbacks", 0)
+                exact = fb_after == fb_before
+                for a, b in zip(gb_ref, out.vecs):
+                    got = b.to_numpy()
+                    ok = np.array_equal(a, got) if exact else \
+                        np.allclose(a, got, rtol=1e-5, atol=1e-6)
+                    if not ok:
+                        fail("groupby_bitwise", f"round {r} diverged")
+                        break
+            except Exception as e:  # noqa: BLE001
+                fail("groupby_completes", f"round {r}: {e!r}")
+            # 3. train with resume; bitwise against the fault-free model
+            try:
+                pred = _train_with_recovery(
+                    frame_of, seed, os.path.join(rec_root, f"r{r}"))
+                if not np.array_equal(pred_ref, pred):
+                    fail("model_bitwise", f"round {r} diverged")
+            except Exception as e:  # noqa: BLE001
+                fail("train_completes", f"round {r}: {e!r}")
+            # 4. grid: failures are collected, never wedge the pool
+            try:
+                from h2o_tpu.models.grid import GridSearch
+                from h2o_tpu.models.tree.gbm import GBM
+                gs = GridSearch(GBM, {"ntrees": [2, 3]}, max_depth=2,
+                                seed=seed, grid_id=f"soak_grid_{r}")
+                grid = gs.train(y="y", training_frame=frame_of())
+                if len(grid.models) + len(grid.failures) != 2:
+                    fail("grid_accounting",
+                         f"round {r}: {len(grid.models)} models + "
+                         f"{len(grid.failures)} failures != 2")
+            except Exception:  # noqa: BLE001 — whole-grid injected kill
+                pass
+            # 5. serve: deploy, score (slow-score shedding is legal:
+            #    429/408/503 are contracts, crashes are not), undeploy
+            try:
+                from h2o_tpu.serve import ServingConfig, registry
+                from h2o_tpu.models.tree.gbm import GBM
+                m = None
+                for _ in range(6):    # injected job faults may kill it
+                    try:
+                        m = GBM(ntrees=2, max_depth=2, seed=seed).train(
+                            y="y", training_frame=frame_of())
+                        break
+                    except Exception:  # noqa: BLE001 — retry the build
+                        continue
+                if m is None:
+                    continue          # storm won this round; next one
+                name = f"soak_dep_{r}"
+                registry().deploy(name, m, ServingConfig(), warm=False)
+                deployed.append(name)
+                rows = [{"x": float(v)} for v in x[:4]]
+                try:
+                    registry().score_rows(name, rows, deadline_ms=2000)
+                except Exception as e:  # noqa: BLE001
+                    if type(e).__name__ not in ("QueueFull",
+                                                "TimeoutError",
+                                                "OOMError"):
+                        fail("serve_contract",
+                             f"round {r}: unexpected {e!r}")
+                registry().undeploy(name, drain_secs=2.0)
+                deployed.remove(name)
+            except Exception as e:  # noqa: BLE001
+                fail("serve_lifecycle", f"round {r}: {e!r}")
+            if verbose:
+                print(f"[soak] round {r} done, "
+                      f"{t_end - time.monotonic():.0f}s left",
+                      file=sys.stderr)
+    finally:
+        chaos_counters = chaos.chaos().counters()
+        oom_stats = oom.stats()
+        chaos.reset()                 # faults OFF before teardown
+        for name in deployed:
+            try:
+                from h2o_tpu.serve import registry
+                registry().undeploy(name, drain_secs=0.5)
+            except Exception:  # noqa: BLE001
+                pass
+        srv.stop()
+
+    # ---- invariants -------------------------------------------------
+    inv = report["invariants"]
+    # jobs: give stalled bodies (stall_secs) time to reach terminal
+    deadline = time.monotonic() + 4 * f["stall_secs"] + 10.0
+    while time.monotonic() < deadline:
+        live = [j for j in cl.jobs.list() if j.status not in TERMINAL]
+        if not live:
+            break
+        time.sleep(0.2)
+    live = [f"{j.key}:{j.status}" for j in cl.jobs.list()
+            if j.status not in TERMINAL]
+    inv["jobs_terminal"] = not live
+    if live:
+        fail("jobs_terminal", f"non-terminal jobs: {live[:5]}")
+    # pool slots: compensation slots must have been given back
+    pw, sw = cl.jobs._pool._max_workers, cl.jobs._sys_pool._max_workers
+    inv["pool_slots"] = (pw == pool_workers and sw == sys_workers)
+    if not inv["pool_slots"]:
+        fail("pool_slots", f"user {pool_workers}->{pw}, "
+                           f"system {sys_workers}->{sw}")
+    # DKV: purge soak keys, then demand the pre-soak key set
+    for k in list(map(str, cl.dkv.keys())):
+        if k not in keys_before:
+            cl.dkv.remove(k, force=True)
+    leaked = set(map(str, cl.dkv.keys())) ^ keys_before
+    inv["dkv_clean"] = not leaked
+    if leaked:
+        fail("dkv_clean", f"key-set drift: {sorted(leaked)[:10]}")
+    # REST responded at least once a round
+    inv["rest_responsive"] = report["rest_polls"] >= report["rounds"]
+    if not inv["rest_responsive"]:
+        fail("rest_responsive",
+             f"{report['rest_polls']} polls < {report['rounds']} rounds")
+    # fault accounting: grand total == sum of per-type counters, and
+    # ladder OOM events reconcile with the OOM injector's count
+    per_type = {k: v for k, v in chaos_counters.items()
+                if k != "injected"}
+    inv["faults_accounted"] = (
+        chaos_counters["injected"] == sum(per_type.values()))
+    if not inv["faults_accounted"]:
+        fail("faults_accounted",
+             f"injected={chaos_counters['injected']} != "
+             f"sum({per_type})")
+    inv["oom_ladder_accounted"] = (
+        oom_stats["oom_events"] >= chaos_counters["injected_oom"])
+    if not inv["oom_ladder_accounted"]:
+        fail("oom_ladder_accounted",
+             f"ladder saw {oom_stats['oom_events']} OOMs < injector's "
+             f"{chaos_counters['injected_oom']}")
+    report["chaos"] = chaos_counters
+    report["oom"] = oom_stats
+    report["retry"] = resilience.stats()
+    report["ok"] = not report["failures"]
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--duration", type=float, default=60.0,
+                    help="soak wall-clock seconds (default 60)")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    report = run_soak(seed=args.seed, duration=args.duration,
+                      verbose=args.verbose)
+    print(json.dumps(report, indent=2, default=str))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
