@@ -266,6 +266,91 @@ def bench_rapids_groupby(rows, groups=1024, reps=5):
         cloud().dkv.remove("bench_rapids_gb")
 
 
+_COLD_START_SRC = r"""
+import json, os, sys, time
+import numpy as np
+p = os.environ.get('BENCH_PLATFORM')
+if p:
+    import jax
+    jax.config.update('jax_platforms', p)
+import jax
+rows, cols, trees, depth = (int(os.environ[k]) for k in
+                            ('CS_ROWS', 'CS_COLS', 'CS_TREES', 'CS_DEPTH'))
+rng = np.random.default_rng(7)
+X = rng.normal(size=(rows, cols)).astype(np.float32)
+y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.int32)
+from h2o_tpu.core.frame import Frame, Vec, T_CAT
+from h2o_tpu.core.diag import DispatchStats
+DispatchStats.install_xla_listener()
+fr = Frame([f'x{j}' for j in range(cols)] + ['y'],
+           [Vec(X[:, j]) for j in range(cols)] +
+           [Vec(y, T_CAT, domain=['b', 's'])])
+from h2o_tpu.models.tree.gbm import GBM
+t0 = time.time()
+m = GBM(ntrees=trees, max_depth=depth, learn_rate=0.1, seed=1,
+        nbins=32, model_id='coldstart_gbm').train(y='y', training_frame=fr)
+train_s = time.time() - t0
+from h2o_tpu.serve.engine import ScoringEngine
+eng = ScoringEngine()
+t0 = time.time()
+out = eng.predict(m, 0, X[:16].astype(np.float64))
+score_s = time.time() - t0
+from h2o_tpu.core.exec_store import exec_store
+s = exec_store().stats()
+print(json.dumps({'train_s': train_s, 'score_s': score_s,
+                  'disk_hits': s['disk_hits'],
+                  'disk_stores': s['disk_stores'],
+                  'serialized_bytes': s['serialized_bytes_written'],
+                  'backend_compiles': DispatchStats.xla_compiles(),
+                  'pred0': float(np.asarray(out).ravel()[0])}))
+"""
+
+
+def bench_cold_start():
+    """Cold-vs-warm process start (the exec-store AOT + XLA persistent
+    cache unlock): the SAME tiny GBM-train + first-serve-score workload
+    runs in two fresh subprocesses sharing one store/cache directory.
+    Run 1 is fully cold (pays every XLA compile and writes the store);
+    run 2 is a warm restart — it loads serialized executables from disk
+    and hits the persistent compile cache.  The headline value is the
+    cold/warm wall ratio for first-train; first-score and backend
+    compile counts ride in detail."""
+    import subprocess
+    import tempfile
+    tmp = tempfile.mkdtemp(prefix="h2o_cold_")
+    env = dict(os.environ)
+    env["H2O_TPU_EXEC_STORE_DIR"] = os.path.join(tmp, "exec")
+    env["H2O_TPU_COMPILE_CACHE"] = os.path.join(tmp, "xla")
+    env.setdefault("XLA_FLAGS", "")
+    rows = int(os.environ.get("BENCH_COLD_ROWS", 50_000))
+    env.update({"CS_ROWS": str(rows), "CS_COLS": "8",
+                "CS_TREES": "3", "CS_DEPTH": "4"})
+
+    def run():
+        r = subprocess.run([sys.executable, "-c", _COLD_START_SRC],
+                           capture_output=True, env=env, timeout=900)
+        if r.returncode != 0:
+            raise RuntimeError(r.stderr.decode()[-400:])
+        return json.loads(r.stdout.decode().strip().splitlines()[-1])
+
+    cold = run()
+    warm = run()
+    return {"value": round(cold["train_s"] / max(warm["train_s"], 1e-9),
+                           3),
+            "unit": "cold/warm first-train wall ratio",
+            "cold_train_s": round(cold["train_s"], 2),
+            "warm_train_s": round(warm["train_s"], 2),
+            "cold_score_s": round(cold["score_s"], 3),
+            "warm_score_s": round(warm["score_s"], 3),
+            "cold_backend_compiles": cold["backend_compiles"],
+            "warm_backend_compiles": warm["backend_compiles"],
+            "warm_disk_hits": warm["disk_hits"],
+            "cold_disk_stores": cold["disk_stores"],
+            "serialized_bytes": cold["serialized_bytes"],
+            "rows": rows,
+            "pred_match": cold["pred0"] == warm["pred0"]}
+
+
 def bench_cpu_reference(X, y, rows, trees, depth):
     """External CPU baseline for the north-star ratio (VERDICT r3 item 3):
     the same GBM workload through a widely-accepted CPU hist
@@ -396,10 +481,18 @@ def _arm_watchdog(detail_ref):
 
     def fire():
         try:
-            try:
-                detail = dict(detail_ref[0] or {})
-            except RuntimeError:       # main thread mutating mid-copy
-                detail = {}
+            # snapshot BEFORE emitting: the main thread may be mutating
+            # the dict mid-copy ("dictionary changed size during
+            # iteration" RuntimeError).  Retry the shallow copy a few
+            # times instead of collapsing to {} — a run that already
+            # captured the GBM number must not read as 0.0
+            detail = {}
+            for _ in range(10):
+                try:
+                    detail = dict(detail_ref[0] or {})
+                    break
+                except RuntimeError:   # main thread mutating mid-copy
+                    time.sleep(0.05)
             detail["watchdog"] = f"bench exceeded {secs:.0f}s; device " \
                 "hang suspected — partial results emitted"
             # headline from whatever DID measure before the hang (same
@@ -523,7 +616,7 @@ def _main_ladder(detail):
     configs = os.environ.get(
         "BENCH_CONFIG",
         "gbm,gbm_ua,gbm_bf16,drf,glm,dl,hist,rapidsgb,gbm10m,cpuref,"
-        "cpuref10m,deep"
+        "cpuref10m,deep,coldstart"
     ).split(",")
 
     detail.update({"rows": rows, "cols": cols})
@@ -563,7 +656,7 @@ def _main_ladder(detail):
             "BENCH_CPU_FALLBACK_TREES", 5)))
         configs = [c for c in configs
                    if c in ("gbm", "cpuref", "drf", "glm", "hist",
-                            "rapidsgb")]
+                            "rapidsgb", "coldstart")]
         detail["rows"] = rows
     detail["platform"] = platform
 
@@ -588,12 +681,14 @@ def _main_ladder(detail):
                                              1_000_000))))),
             ("gbm10m", lambda: bench_gbm10m(cols, depth)),
             ("cpuref10m", lambda: bench_cpu_reference_10m(cols, depth)),
-            ("deep", lambda: bench_deep(fr, rows))]
+            ("deep", lambda: bench_deep(fr, rows)),
+            ("coldstart", bench_cold_start)]
     names = {"hist": "hist_kernel", "gbm10m": "gbm_10m",
              "cpuref": "cpu_reference", "deep": "drf_deep20",
              "gbm_ua": "gbm_uniform_adaptive", "gbm_bf16": "gbm_bf16",
              "cpuref10m": "cpu_reference_10m",
-             "rapidsgb": "rapids_groupby_throughput"}
+             "rapidsgb": "rapids_groupby_throughput",
+             "coldstart": "cold_start"}
     for cfg, fn in runs:
         if cfg not in configs:
             continue

@@ -1245,18 +1245,24 @@ def device_memory_route(params):
 @route("GET", r"/3/Dispatch")
 def dispatch_route(params):
     """Data-plane dispatch observability: per-phase compile/dispatch/
-    transfer counters (core/diag.DispatchStats) plus the compiled-
-    program cache's hit/miss totals (core/mrtask.DispatchCache) — the
-    numbers that prove steady-state training recompiles nothing.
+    transfer counters (core/diag.DispatchStats) plus the unified
+    executable store's totals (core/exec_store.py) — the numbers that
+    prove steady-state training recompiles nothing AND that a fresh
+    process warmed its kernel set from disk.
 
-    The ``munge`` phase covers the device-resident sort/merge/group-by/
+    ``store`` carries size (entries/capacity), the persistent-AOT layer
+    (disk_hits / disk_stores / serialized bytes written+read /
+    serialize_unsupported fallbacks), and eviction counts; ``cache`` is
+    the same stats block under the PR 3 name for older clients.  The
+    ``munge`` phase covers the device-resident sort/merge/group-by/
     filter kernels (core/munge.py); ``host_pulls``/``host_pull_bytes``
     count Vec payload device->host materializations per phase — the
     munge row must stay at zero while the verbs run on device."""
     from h2o_tpu.core.diag import DispatchStats
-    from h2o_tpu.core.mrtask import dispatch_cache
+    from h2o_tpu.core.exec_store import exec_store
+    s = exec_store().stats()
     return {"dispatch": DispatchStats.snapshot(),
-            "cache": dispatch_cache().stats()}
+            "cache": s, "store": s}
 
 
 @route("GET", r"/3/Recovery")

@@ -27,7 +27,25 @@ owning modules, like the chaos flags, so they work before a cloud boots):
 - OOM degradation ladder (core/oom.py, wrapped around every device
   dispatch choke point): ``H2O_TPU_OOM_SWEEP_RETRIES`` (default 2 —
   how many spill-the-LRU-and-retry attempts before the ladder descends
-  to quantum shrinking / host fallback / terminal job failure).
+  to quantum shrinking / host fallback / terminal job failure);
+- unified executable store (core/exec_store.py — the one compiled-
+  program cache under the MRTask verbs, the serve predict path, the
+  munge kernels and the tree-engine executable pair):
+  ``H2O_TPU_EXEC_STORE`` (LRU capacity in entries, default 256; the
+  legacy ``H2O_TPU_DISPATCH_CACHE`` spelling is honored),
+  ``H2O_TPU_EXEC_STORE_DIR`` (directory for persistent AOT-serialized
+  executables; unset = disk layer off.  A fresh process warms its
+  kernel set from here — disk entries are schema-versioned and
+  invalidate cleanly on any key mismatch: schema bump, jax version,
+  backend topology, or header corruption), and
+  ``H2O_TPU_COMPILE_CACHE`` (XLA persistent compile cache directory /
+  on-off switch, core/cloud.py — the fallback warm-start layer for
+  entries executable serialization cannot cover, e.g. jit-level
+  shape-polymorphic programs and closure map fns);
+- buffer donation: ``H2O_TPU_DONATE`` (the store's donation policy;
+  default on-TPU-only — donating and non-donating variants are
+  distinct store entries and OOM retries auto-route to the
+  non-donating twin).
 """
 
 from __future__ import annotations

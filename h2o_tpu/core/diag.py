@@ -49,6 +49,7 @@ class DispatchStats:
     _compiles: Dict[str, int] = {}
     _dispatches: Dict[str, int] = {}
     _cache_hits: Dict[str, int] = {}
+    _disk_hits: Dict[str, int] = {}
     _transfers: Dict[str, int] = {}
     _transfer_bytes: Dict[str, int] = {}
     _host_pulls: Dict[str, int] = {}
@@ -74,6 +75,14 @@ class DispatchStats:
     @classmethod
     def note_cache_hit(cls, phase: str) -> None:
         cls._bump(cls._cache_hits, phase)
+
+    @classmethod
+    def note_disk_hit(cls, phase: str) -> None:
+        """One executable warmed from the persistent store (a fresh
+        process loading a serialized program instead of compiling —
+        core/exec_store.py's AOT layer)."""
+        cls._bump(cls._disk_hits, phase)
+        TimeLine.record("dispatch", "disk_hit", phase=phase)
 
     @classmethod
     def note_transfer(cls, phase: str, nbytes: int = 0) -> None:
@@ -152,6 +161,7 @@ class DispatchStats:
             return {"compiles": dict(cls._compiles),
                     "dispatches": dict(cls._dispatches),
                     "cache_hits": dict(cls._cache_hits),
+                    "disk_hits": dict(cls._disk_hits),
                     "transfers": dict(cls._transfers),
                     "transfer_bytes": dict(cls._transfer_bytes),
                     "host_pulls": dict(cls._host_pulls),
@@ -167,6 +177,7 @@ class DispatchStats:
             cls._compiles.clear()
             cls._dispatches.clear()
             cls._cache_hits.clear()
+            cls._disk_hits.clear()
             cls._transfers.clear()
             cls._transfer_bytes.clear()
             cls._host_pulls.clear()

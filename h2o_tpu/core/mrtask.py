@@ -18,36 +18,32 @@ For elementwise outputs (the reference's NewChunk-producing MRTasks that
 build new aligned Frames, MRTask.java doAll(nouts...)), use ``map_frame`` —
 the output stays row-sharded and aligned with the input by construction.
 
-DISPATCH CACHE: compilation is a ONE-TIME cost per (fn, reduce, shapes/
-dtypes/shardings) signature.  The original implementation wrapped a fresh
-closure in ``jax.jit`` on every call, so every rollup, quantile and Gram
-pass re-traced and re-compiled from scratch — exactly the framework
-overhead the one-compiled-program premise forbids.  ``DispatchCache``
-holds the jitted executables in a bounded LRU keyed on the map function's
-identity (the key strongly references the function, so ``id`` reuse is
-impossible while the entry lives) plus the argument avals; repeated calls
-with identical shapes hit one executable.  Hit/miss counters feed
-core/diag.DispatchStats and the GET /3/Dispatch REST surface.
+DISPATCH: compilation is a ONE-TIME cost per (fn, reduce, shapes/dtypes/
+shardings) signature.  The original implementation wrapped a fresh closure
+in ``jax.jit`` on every call, so every rollup, quantile and Gram pass
+re-traced and re-compiled from scratch — exactly the framework overhead the
+one-compiled-program premise forbids.  PR 3's ``DispatchCache`` fixed that
+here; this layer now routes through the UNIFIED executable store
+(core/exec_store.py) shared with the serve predict cache and the munge
+kernels — one LRU, one donation policy, one OOM-ladder wrapper, and
+persistent AOT warm-start, instead of three re-implementations.
 """
 
 from __future__ import annotations
 
+from typing import Callable, Sequence
+
 import functools
-import os
-import threading
-from collections import OrderedDict
-from typing import Any, Callable, Dict, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
-from h2o_tpu.core.cloud import (DATA_AXIS, cloud, donation_enabled,
-                                shard_map_compat)
+from h2o_tpu.core.cloud import DATA_AXIS, cloud, shard_map_compat
 from h2o_tpu.core.diag import DispatchStats
+from h2o_tpu.core.exec_store import (aval_key, cached_kernel,  # noqa: F401
+                                     exec_store, stable_fn_name)
 from h2o_tpu.core.frame import Frame
-from h2o_tpu.core.oom import oom_ladder
 
 REDUCERS = {
     "sum": lambda x: jax.lax.psum(x, DATA_AXIS),
@@ -55,100 +51,13 @@ REDUCERS = {
     "max": lambda x: jax.lax.pmax(x, DATA_AXIS),
 }
 
-_DEFAULT_CACHE_ENTRIES = 256
 
-
-def _aval_key(x) -> Tuple:
-    """Hashable signature of one argument: shape/dtype/sharding for
-    arrays (a resharded input is a different program), value for
-    hashable statics."""
-    if isinstance(x, jax.Array):
-        try:
-            shard = repr(x.sharding)
-        except Exception:  # noqa: BLE001 — deleted/donated arrays
-            shard = None
-        return ("arr", x.shape, str(x.dtype), shard)
-    if isinstance(x, np.ndarray):
-        return ("np", x.shape, str(x.dtype))
-    return ("static", type(x).__name__, x)
-
-
-class DispatchCache:
-    """Bounded LRU of compiled dispatch programs with hit/miss counters.
-
-    One entry = one executable: the builder is only invoked on a miss,
-    so ``misses`` IS the compile count for everything routed through the
-    cache (the compile-count regression tests assert on exactly this).
-    Entries pin their key's function object, so a long-lived cache also
-    keeps ``id(fn)`` collisions impossible; the LRU bound
-    (H2O_TPU_DISPATCH_CACHE, default 256) keeps that pinning finite.
-    """
-
-    def __init__(self, max_entries: int = None):
-        self.max_entries = int(max_entries or os.environ.get(
-            "H2O_TPU_DISPATCH_CACHE", _DEFAULT_CACHE_ENTRIES))
-        self._lock = threading.RLock()
-        self._entries: "OrderedDict[Tuple, Any]" = OrderedDict()
-        self.hits = 0
-        self.misses = 0
-
-    def get_or_build(self, phase: str, key: Tuple,
-                     build: Callable[[], Any]):
-        with self._lock:
-            fn = self._entries.get(key)
-            if fn is not None:
-                self._entries.move_to_end(key)
-                self.hits += 1
-        if fn is not None:
-            DispatchStats.note_cache_hit(phase)
-            return fn
-        # build outside the lock: tracing can be slow and may itself
-        # dispatch; a rare concurrent double-build is harmless (last
-        # writer wins, both executables are correct)
-        fn = build()
-        with self._lock:
-            self._entries[key] = fn
-            self.misses += 1
-            while len(self._entries) > self.max_entries:
-                self._entries.popitem(last=False)
-        DispatchStats.note_compile(phase)
-        return fn
-
-    def stats(self) -> Dict[str, int]:
-        with self._lock:
-            return {"entries": len(self._entries),
-                    "capacity": self.max_entries,
-                    "hits": self.hits, "misses": self.misses}
-
-    def clear(self) -> None:
-        with self._lock:
-            self._entries.clear()
-
-
-_CACHE = DispatchCache()
-
-
-def dispatch_cache() -> DispatchCache:
-    """The module-level compiled-program cache (REST + tests)."""
-    return _CACHE
-
-
-def aval_key(x) -> Tuple:
-    """Public alias of the argument-signature hasher, for other layers
-    (core/munge.py) that key their kernels into the same cache."""
-    return _aval_key(x)
-
-
-def cached_kernel(phase: str, name: str, statics: Tuple,
-                  build: Callable[[], Any], *arrays) -> Any:
-    """Fetch-or-compile a kernel through the shared DispatchCache, keyed
-    on (phase, name, statics, argument avals) — the device-munge verbs'
-    route into the PR 3 compile-once contract.  ``build`` returns the
-    jitted callable; the caller invokes it with ``arrays``."""
-    key = (phase, name, statics, tuple(_aval_key(a) for a in arrays))
-    fn = _CACHE.get_or_build(phase, key, build)
-    DispatchStats.note_dispatch(phase)
-    return fn
+def dispatch_cache():
+    """The process-wide executable store (REST + tests).  Kept under the
+    PR 3 name so callers keying on hit/miss/entries/capacity semantics
+    (conftest session summary, compile-count regression tests) read the
+    one true cache."""
+    return exec_store()
 
 
 def map_reduce(map_fn: Callable, *arrays: jax.Array, reduce: str = "sum",
@@ -159,14 +68,16 @@ def map_reduce(map_fn: Callable, *arrays: jax.Array, reduce: str = "sum",
     receives the local shard(s) plus replicated extras and returns a pytree of
     fixed-shape accumulators (histograms, Gram blocks, partial sums...).
     Repeated calls with the same (map_fn, reduce, shapes) reuse ONE
-    compiled executable via the dispatch cache.
+    compiled executable via the store; OOM dispatches walk the ladder
+    (sweep-the-LRU-and-retry — there is no work quantum to shrink in one
+    fused program).
     """
     c = cloud()
     mesh = c.mesh
     red = REDUCERS[reduce]
     key = ("map_reduce", map_fn, reduce,
-           tuple(_aval_key(a) for a in arrays),
-           tuple(_aval_key(e) for e in extra_args))
+           tuple(aval_key(a) for a in arrays),
+           tuple(aval_key(e) for e in extra_args))
 
     def build():
         in_specs = tuple(P(DATA_AXIS, *([None] * (a.ndim - 1)))
@@ -180,15 +91,12 @@ def map_reduce(map_fn: Callable, *arrays: jax.Array, reduce: str = "sum",
             out = map_fn(*xs)
             return jax.tree.map(red, out)
 
-        return jax.jit(run)
+        return run
 
-    fn = _CACHE.get_or_build("map_reduce", key, build)
-    DispatchStats.note_dispatch("map_reduce")
-    # OOM ladder (core/oom.py): a RESOURCE_EXHAUSTED dispatch sweeps the
-    # HBM LRU and retries instead of killing the job — there is no work
-    # quantum to shrink here (one fused program), so the ladder is
-    # sweep-retry -> terminal OOMError
-    return oom_ladder("map_reduce", lambda: fn(*arrays, *extra_args))
+    name = stable_fn_name(map_fn)
+    return exec_store().dispatch(
+        "map_reduce", key, build, (*arrays, *extra_args),
+        persist=f"map_reduce:{name}:{reduce}" if name else None)
 
 
 def map_frame(map_fn: Callable, frame: Frame,
@@ -197,46 +105,33 @@ def map_frame(map_fn: Callable, frame: Frame,
 
     Output sharding equals input sharding — the NewChunk/AppendableVec analog
     with alignment guaranteed by construction instead of VectorGroup checks.
-    Compiles once per (map_fn, matrix shape) via the dispatch cache instead
-    of re-jitting per call.
+    Compiles once per (map_fn, matrix shape) via the store instead of
+    re-jitting per call.
     """
     m = frame.as_matrix(names)
-    key = ("map_frame", map_fn, _aval_key(m))
-    fn = _CACHE.get_or_build("map_frame", key, lambda: jax.jit(map_fn))
-    DispatchStats.note_dispatch("map_frame")
-    return oom_ladder("map_frame", lambda: fn(m))
+    key = ("map_frame", map_fn, aval_key(m))
+    name = stable_fn_name(map_fn)
+    return exec_store().dispatch(
+        "map_frame", key, lambda: map_fn, (m,),
+        persist=f"map_frame:{name}" if name else None)
 
 
 def mutate_array(map_fn: Callable, array: jax.Array,
                  *extras) -> jax.Array:
-    """Dispatch-cached elementwise mutation of a device payload.  When the
-    backend honors donation (core/cloud.donation_enabled) the input buffer
-    is DONATED to the program, so an in-place Vec mutation reuses its HBM
-    allocation instead of round-tripping through a fresh one.  The caller
-    must treat ``array`` as consumed."""
-    donate = donation_enabled()
-    key = ("mutate", map_fn, donate, _aval_key(array),
-           tuple(_aval_key(e) for e in extras))
-
-    def build():
-        return jax.jit(map_fn, donate_argnums=(0,) if donate else ())
-
-    fn = _CACHE.get_or_build("mutate", key, build)
-    DispatchStats.note_dispatch("mutate")
-    state = {"fn": fn}
-
-    def _no_donate(_exc):
-        # OOM-ladder retries must not re-donate: the retry re-reads the
-        # input buffer, so route it through the non-donating executable
-        if donate:
-            nd_key = ("mutate", map_fn, False, _aval_key(array),
-                      tuple(_aval_key(e) for e in extras))
-            state["fn"] = _CACHE.get_or_build(
-                "mutate", nd_key,
-                lambda: jax.jit(map_fn, donate_argnums=()))
-
-    return oom_ladder("mutate", lambda: state["fn"](array, *extras),
-                      on_oom=_no_donate)
+    """Store-cached elementwise mutation of a device payload.  When the
+    backend honors donation (the store's donation policy) the input
+    buffer is DONATED to the program, so an in-place Vec mutation reuses
+    its HBM allocation instead of round-tripping through a fresh one.
+    The caller must treat ``array`` as consumed.  OOM-ladder retries
+    automatically re-route through the non-donating twin — a retry
+    re-reads the input buffer."""
+    key = ("mutate", map_fn, aval_key(array),
+           tuple(aval_key(e) for e in extras))
+    name = stable_fn_name(map_fn)
+    return exec_store().dispatch(
+        "mutate", key, lambda: map_fn, (array, *extras),
+        donate_argnums=(0,),
+        persist=f"mutate:{name}" if name else None)
 
 
 @jax.jit

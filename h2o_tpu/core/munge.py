@@ -30,9 +30,12 @@ size.  This module is the TPU-native rebuild of those verbs:
 
 Compile bounding: row counts pad to power-of-two shape buckets (the
 serving layer's ``_bucket`` discipline applied to the data plane), and
-every kernel routes through the PR 3 ``DispatchCache`` under the
-``munge`` phase — one compile per (verb, schema, shape-bucket), with
-hit/miss/host-pull counters surfaced at GET /3/Dispatch.
+every kernel routes through the unified executable store
+(core/exec_store.py) under the ``munge`` phase — one compile per
+(verb, schema, shape-bucket), AOT-serialized to disk when
+``H2O_TPU_EXEC_STORE_DIR`` is set (a fresh process warms its munge
+kernels instead of recompiling), with hit/miss/disk-hit/host-pull
+counters surfaced at GET /3/Dispatch.
 
 Fallback contract: ``H2O_TPU_DEVICE_MUNGE=0`` (or any frame holding
 T_TIME/T_STR/T_UUID columns, or a group-by with median/mode aggregates)
@@ -61,7 +64,7 @@ from h2o_tpu.core.cloud import cloud
 from h2o_tpu.core.diag import DispatchStats
 from h2o_tpu.core.frame import (Frame, T_CAT, Vec, _row_pad,
                                 frame_device_ok)
-from h2o_tpu.core.mrtask import cached_kernel
+from h2o_tpu.core.exec_store import cached_kernel
 
 PHASE = "munge"
 
@@ -104,8 +107,8 @@ def _mk_vec(arr: jax.Array, like: Vec, nrows: int) -> Vec:
 
 
 # ---------------------------------------------------------------------------
-# kernels (module-level builders; jitted once per shape-bucket via the
-# dispatch cache — see cached_kernel)
+# kernels (module-level builders returning RAW functions; the executable
+# store jits + AOT-compiles them once per shape-bucket — see cached_kernel)
 # ---------------------------------------------------------------------------
 
 
@@ -117,7 +120,7 @@ def _build_sort(B: int, K: int):
         cols = [jnp.where(valid, keys[:, k], jnp.inf) for k in range(K)]
         # lexsort: LAST key is primary; keys stack primary-first
         return jnp.lexsort(cols[::-1])
-    return jax.jit(kern)
+    return kern
 
 
 def _build_factorize(B: int, K: int):
@@ -140,7 +143,7 @@ def _build_factorize(B: int, K: int):
         last = jnp.take(gid_sorted, jnp.maximum(nvalid - 1, 0))
         n_groups = jnp.where(nvalid > 0, last + 1, 0)
         return inv, order, n_groups
-    return jax.jit(kern)
+    return kern
 
 
 def _build_group_aggs(B: int, K: int, Gb: int, ops: Tuple[str, ...]):
@@ -185,7 +188,7 @@ def _build_group_aggs(B: int, K: int, Gb: int, ops: Tuple[str, ...]):
                 raise NotImplementedError(op)
             outs.append(out)
         return keyvals, counts, tuple(outs)
-    return jax.jit(kern)
+    return kern
 
 
 def _build_filter(B: int):
@@ -197,7 +200,7 @@ def _build_filter(B: int):
         # cumsum-of-mask compaction expressed as a single stable rank
         order = jnp.argsort(jnp.where(keep, idx, B + idx))
         return n_out, order
-    return jax.jit(kern)
+    return kern
 
 
 def _build_merge_match(PL: int, PR: int, all_x: bool, all_y: bool):
@@ -227,7 +230,7 @@ def _build_merge_match(PL: int, PR: int, all_x: bool, all_y: bool):
         uord = jnp.argsort(jnp.where(unmatched, jnp.arange(PR), BIG))
         n_out = n_pairs + u_cnt
         return n_out, n_pairs, counts, offsets, lo, r_order, uord
-    return jax.jit(kern)
+    return kern
 
 
 def _build_merge_emit(PL: int, PR: int, NB: int):
@@ -247,7 +250,7 @@ def _build_merge_emit(PL: int, PR: int, NB: int):
         li = jnp.where(in_pairs, ic, -1)
         ri = jnp.where(in_pairs, ri_m, ri_u)
         return li.astype(jnp.int32), ri.astype(jnp.int32)
-    return jax.jit(kern)
+    return kern
 
 
 # ---------------------------------------------------------------------------
@@ -299,9 +302,10 @@ def sort_frame(fr: Frame, idxs: Sequence[int],
         P = fr.vecs[0].data.shape[0]
         B = _bucket_rows(P)
         keys = _pad_rows(_sort_key_matrix(fr, idxs, ascending), B, jnp.inf)
+        nr = jnp.int32(fr.nrows)
         kern = cached_kernel(PHASE, "sort", (B, len(idxs)),
-                             lambda: _build_sort(B, len(idxs)), keys)
-        order = kern(keys, jnp.int32(fr.nrows))[:P]
+                             lambda: _build_sort(B, len(idxs)), keys, nr)
+        order = kern(keys, nr)[:P]
         vecs = [_mk_vec(jnp.take(v.data, order, axis=0), v, fr.nrows)
                 for v in fr.vecs]
         return Frame(list(fr.names), vecs)
@@ -315,9 +319,10 @@ def filter_rows(fr: Frame, mask: jax.Array) -> Frame:
         P = fr.vecs[0].data.shape[0]
         B = _bucket_rows(P)
         m = _pad_rows(mask.astype(jnp.float32), B, 0.0)
+        nr = jnp.int32(fr.nrows)
         kern = cached_kernel(PHASE, "filter", (B,),
-                             lambda: _build_filter(B), m)
-        n_dev, order = kern(m, jnp.int32(fr.nrows))
+                             lambda: _build_filter(B), m, nr)
+        n_dev, order = kern(m, nr)
         n_out = int(n_dev)                       # the one host sync
         take = order[: _row_pad(n_out)]
         vecs = [_mk_vec(jnp.take(v.data, take, axis=0), v, n_out)
@@ -337,7 +342,7 @@ def groupby_frame(fr: Frame, gcols: Sequence[int],
         keys = _pad_rows(_factor_key_matrix(fr, gcols), B, jnp.inf)
         valid = jnp.arange(B) < fr.nrows
         fact = cached_kernel(PHASE, "factorize", (B, K),
-                             lambda: _build_factorize(B, K), keys)
+                             lambda: _build_factorize(B, K), keys, valid)
         inv, order, g_dev = fact(keys, valid)
         G = int(g_dev)                           # the one host sync
         Gb = _bucket_rows(max(_row_pad(G), 1))
@@ -347,7 +352,7 @@ def groupby_frame(fr: Frame, gcols: Sequence[int],
             else jnp.zeros((B, 0), jnp.float32)
         agg = cached_kernel(PHASE, "group_aggs", (B, K, Gb, ops),
                             lambda: _build_group_aggs(B, K, Gb, ops),
-                            keys, vals)
+                            keys, valid, inv, order, vals)
         keyvals, counts, outs = agg(keys, valid, inv, order, vals)
         Gpad = _row_pad(G)
         names: List[str] = []
@@ -419,25 +424,25 @@ def merge_frames(L: Frame, R: Frame, all_x: bool, all_y: bool,
         ck = _pad_rows(ck, B, jnp.inf)
         cv = _pad_rows(cv, B, False)
         fact = cached_kernel(PHASE, "factorize", (B, K),
-                             lambda: _build_factorize(B, K), ck)
+                             lambda: _build_factorize(B, K), ck, cv)
         inv, _order, _g = fact(ck, cv)
         lcode, rcode = inv[:PL], inv[PL: PL + PR]
         match = cached_kernel(PHASE, "merge_match",
                               (PL, PR, all_x, all_y),
                               lambda: _build_merge_match(PL, PR, all_x,
                                                          all_y),
-                              lcode, rcode)
+                              lcode, rcode, lvalid, rvalid)
         n_dev, np_dev, counts, offsets, lo, r_order, uord = \
             match(lcode, rcode, lvalid, rvalid)
         n_out = int(n_dev)                       # the one host sync
         n_pairs = int(np_dev)
         u_cnt = n_out - n_pairs
         NB = _bucket_rows(max(_row_pad(n_out), 1))
+        npdev = jnp.int32(n_pairs)
         emit = cached_kernel(PHASE, "merge_emit", (PL, PR, NB),
                              lambda: _build_merge_emit(PL, PR, NB),
-                             counts, offsets)
-        li, ri = emit(counts, offsets, lo, r_order, uord,
-                      jnp.int32(n_pairs))
+                             counts, offsets, lo, r_order, uord, npdev)
+        li, ri = emit(counts, offsets, lo, r_order, uord, npdev)
         Ppad = _row_pad(n_out)
         li, ri = li[:Ppad], ri[:Ppad]
         lc = jnp.clip(li, 0, max(PL - 1, 0))

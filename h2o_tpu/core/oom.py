@@ -79,6 +79,44 @@ class OOMError(RuntimeError):
             self.site = site
 
 
+# message markers of a Mosaic/Pallas custom-kernel compile failure — the
+# opt-in fused histogram is interpret-mode verified but Mosaic-untested,
+# so a lowering bug must degrade to the portable XLA path, not kill the
+# training job with no fallback (ADVICE.md VMEM-gate follow-up)
+_KERNEL_MARKERS = ("Mosaic", "mosaic", "Pallas", "pallas",
+                   "custom_call_target", "tpu_custom_call")
+
+
+def is_kernel_compile_failure(exc: BaseException) -> bool:
+    """Classify an exception as a custom-kernel (Mosaic/Pallas) lowering
+    or compile failure — recoverable by re-dispatching through the
+    portable XLA path.  Device OOMs are NOT kernel failures (they walk
+    the memory ladder instead)."""
+    if isinstance(exc, OOMError) or is_device_oom(exc):
+        return False
+    msg = f"{type(exc).__name__}: {exc}"
+    return any(m in msg for m in _KERNEL_MARKERS)
+
+
+def kernel_fallback(site: str, run: Callable[[bool], object], *,
+                    pallas: bool):
+    """Run ``run(pallas)``; on a Mosaic/Pallas kernel-compile failure
+    with the fused kernel enabled, record a ladder event and re-dispatch
+    ``run(False)`` — the portable XLA executable (a distinct static-arg
+    program, so the broken kernel is never cached).  Everything else
+    propagates untouched."""
+    try:
+        return run(pallas)
+    except Exception as e:  # noqa: BLE001 — reclassified below
+        if not (pallas and is_kernel_compile_failure(e)):
+            raise
+        _note(site, "kernel_fallbacks")
+        log.warning("%s: Pallas kernel failed to compile (%s); degrading "
+                    "to the portable XLA histogram path", site,
+                    str(e)[:200])
+        return run(False)
+
+
 def is_device_oom(exc: BaseException) -> bool:
     """Classify an exception as a recoverable device OOM (XLA
     RESOURCE_EXHAUSTED / jaxlib allocation failure / injected chaos
@@ -99,7 +137,8 @@ def is_device_oom(exc: BaseException) -> bool:
 
 # -- observability -----------------------------------------------------------
 
-_RUNGS = ("oom_events", "sweeps", "shrinks", "host_fallbacks", "terminal")
+_RUNGS = ("oom_events", "sweeps", "shrinks", "host_fallbacks",
+          "kernel_fallbacks", "terminal")
 
 _stats_lock = threading.Lock()
 _sites: Dict[str, Dict[str, int]] = {}
@@ -119,7 +158,8 @@ def stats() -> dict:
     return {
         "oom_events": sum(d["oom_events"] for d in sites.values()),
         "sweeps": sum(d["sweeps"] for d in sites.values()),
-        "degradations": sum(d["shrinks"] + d["host_fallbacks"]
+        "degradations": sum(d["shrinks"] + d["host_fallbacks"] +
+                            d.get("kernel_fallbacks", 0)
                             for d in sites.values()),
         "terminal_failures": sum(d["terminal"] for d in sites.values()),
         "sites": sites,

@@ -41,7 +41,6 @@ still sanity-clamped at ``H2O_TPU_MAX_TREE_DEPTH`` (default 30).
 
 from __future__ import annotations
 
-import functools
 from typing import Dict, NamedTuple, Optional
 
 import jax
@@ -771,13 +770,26 @@ def train_forest(*args, sibling: Optional[bool] = None,
     H2O_TPU_HIST_PALLAS between trainings takes effect instead of hitting
     a stale cached program).
 
-    ``donate`` selects the F0-donating executable (None = the
-    H2O_TPU_DONATE/backend default): the forest accumulator F is the hot
-    carry of the whole training loop, and donating it lets XLA update it
-    in place across blocks instead of allocating a fresh (R, K) HBM
-    buffer per block.  Callers that still need the passed-in F0 AFTER
-    the call (speculative async blocks under early stopping, recovery
-    checkpoints of the pre-block F) must pass donate=False."""
+    ``donate`` selects the F0-donating executable (None = the store's
+    backend donation policy): the forest accumulator F is the hot carry
+    of the whole training loop, and donating it lets XLA update it in
+    place across blocks instead of allocating a fresh (R, K) HBM buffer
+    per block.  Callers that still need the passed-in F0 AFTER the call
+    (speculative async blocks under early stopping, recovery checkpoints
+    of the pre-block F) must pass donate=False.
+
+    Both executables (donating / non-donating) live in the unified
+    executable store (core/exec_store.py) over the ONE traced body —
+    donation must never silently change which program a
+    recompile-sensitive flag flip hits.  Shape polymorphism stays at the
+    jit level (the static-argname signature), so persistence for this
+    entry rides the XLA persistent compile cache rather than
+    executable serialization.
+
+    A Mosaic/Pallas kernel-compile failure with the opt-in fused
+    histogram enabled degrades to the portable XLA histogram path (a
+    recorded OOM-ladder event) instead of taking training down with no
+    fallback."""
     if sibling is None:
         sibling = sibling_subtract_enabled()
     if hist_pallas is None:
@@ -785,13 +797,20 @@ def train_forest(*args, sibling: Optional[bool] = None,
         hist_pallas = pallas_env_enabled()
     if "mm_route" not in kwargs or kwargs["mm_route"] is None:
         kwargs["mm_route"] = matmul_route_enabled()
-    if donate is None:
-        from h2o_tpu.core.cloud import donation_enabled
-        donate = donation_enabled()
     from h2o_tpu.core.diag import DispatchStats
+    from h2o_tpu.core.exec_store import exec_store
+    from h2o_tpu.core.oom import kernel_fallback
     DispatchStats.note_dispatch("tree_block")
-    fn = _train_forest_jit_donate if donate else _train_forest_jit
-    return fn(*args, sibling=sibling, hist_pallas=hist_pallas, **kwargs)
+
+    def run(pallas: bool):
+        fn = exec_store().get_or_build(
+            "tree_block", ("train_forest",),
+            lambda: _train_forest_impl,
+            jit_kwargs={"static_argnames": _TF_STATIC},
+            donate_argnames=("F0",), donate=donate)
+        return fn(*args, sibling=sibling, hist_pallas=pallas, **kwargs)
+
+    return kernel_fallback("tree.block", run, pallas=hist_pallas)
 
 
 _TF_STATIC = ("dist_name", "K", "ntrees", "max_depth", "nbins",
@@ -823,7 +842,7 @@ def _train_forest_impl(bins, yv, w, active, F0, is_cat, key, *,
                  sibling: bool = True,
                  adaptive: bool = False, fine_nbins: int = 0,
                  hist_random: bool = False,
-                 hist_pallas: bool = True,
+                 hist_pallas: bool = False,
                  mm_route: bool = False) -> TrainedForest:
     """The WHOLE forest training loop as one XLA program.
 
@@ -947,12 +966,8 @@ def _train_forest_impl(bins, yv, w, active, F0, is_cat, key, *,
                          th, na, ch)
 
 
-# two module-level executables over ONE traced body: the donating variant
-# aliases the F0 input buffer into f_final (in-place carry on backends
-# that honor donation); train_forest picks per call — donation must never
-# silently change which program a recompile-sensitive flag flip hits
-_train_forest_jit = functools.partial(
-    jax.jit, static_argnames=_TF_STATIC)(_train_forest_impl)
-_train_forest_jit_donate = functools.partial(
-    jax.jit, static_argnames=_TF_STATIC,
-    donate_argnames=("F0",))(_train_forest_impl)
+# The donating/non-donating executable pair over this one traced body
+# lives in core/exec_store.py (train_forest fetches per call) — the
+# default hist_pallas=False above means only the env-resolving wrapper
+# can enable the Mosaic-untested fused kernel; a bare _train_forest_impl
+# call stays on the portable XLA histogram path.

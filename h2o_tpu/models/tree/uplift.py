@@ -134,7 +134,7 @@ def _find_uplift_splits(hist, col_allowed, metric: str, min_rows: float):
 def _train_uplift_forest(bins, treat, yv, w, active, key, *, ntrees: int,
                          max_depth: int, nbins: int, k_cols: int,
                          metric: str, sample_rate: float, min_rows: float,
-                         kleaves: int = 4096, hist_pallas: bool = True):
+                         kleaves: int = 4096, hist_pallas: bool = False):
     """Whole uplift forest as one XLA program — the sparse-frontier
     pool engine (jit_engine.build_tree_frontier pattern): live leaves
     capped at ``kleaves`` per level with best-first selection by node
@@ -321,13 +321,19 @@ class UpliftDRF(ModelBuilder):
                      f"depth limit; trees were built to depth {depth}")
         T = int(p["ntrees"])
         job.update(0.1, f"training {T} uplift trees")
-        sc, bs, vt, vc, ch = _train_uplift_forest(
-            binned.bins, treat, yv, w, active, self.rng_key(),
-            ntrees=T, max_depth=depth, nbins=binned.nbins, k_cols=mtries,
-            metric=(p["uplift_metric"] or "KL").lower(),
-            sample_rate=float(p["sample_rate"]),
-            min_rows=float(p["min_rows"]),
-            kleaves=max_live_leaves(), hist_pallas=pallas_env_enabled())
+        from h2o_tpu.core.oom import kernel_fallback
+        key0 = self.rng_key()
+        sc, bs, vt, vc, ch = kernel_fallback(
+            "tree.block",
+            lambda pallas: _train_uplift_forest(
+                binned.bins, treat, yv, w, active, key0,
+                ntrees=T, max_depth=depth, nbins=binned.nbins,
+                k_cols=mtries,
+                metric=(p["uplift_metric"] or "KL").lower(),
+                sample_rate=float(p["sample_rate"]),
+                min_rows=float(p["min_rows"]),
+                kleaves=max_live_leaves(), hist_pallas=pallas),
+            pallas=pallas_env_enabled())
         out = dict(x=list(di.x), split_points=binned.split_points,
                    is_cat=binned.is_cat, nbins=binned.nbins,
                    split_col=np.asarray(sc), bitset=np.asarray(bs),

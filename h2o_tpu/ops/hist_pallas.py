@@ -27,24 +27,54 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# VMEM budget for the one-hot tile (the kernel's dominant buffer); 4 MiB
-# leaves ample room for bins/stats tiles, the A tile, and the accumulator
-# in a 16 MiB VMEM.
+# VMEM budget for the one-hot tile alone (used to size column groups in
+# the adaptive kernel); 4 MiB leaves room for the other buffers in a
+# 16 MiB VMEM.
 _ONEHOT_BYTES = 4 * 2 ** 20
 
+# Budget for the COMBINED per-tile working set: the one-hot (TR, C*B1),
+# the A-matrix temporary (TR, L*S), the leaf-hot (TR, L), the bins/
+# stats/leaf input tiles, and the f32 accumulator block (C*B1, L*S).
+# The original gate bounded only the one-hot and the accumulator — the
+# (TR, L*S) A temporary was UNBOUNDED in L, so a wide frontier with a
+# narrow feature set (small C*B1, large L) passed the gate and then
+# Mosaic-failed (or silently spilled) at many times VMEM (ADVICE.md).
+_VMEM_WORKSET_BYTES = 12 * 2 ** 20
 
-def min_tile_fits(C: int, B1: int) -> bool:
-    """True when the 512-row minimum tile's one-hot fits the VMEM budget
-    at the widest (f32) dtype — eligibility gate for wide-feature shapes
-    (ops/histogram.py falls back to the XLA path otherwise)."""
-    return 512 * C * B1 * 4 <= _ONEHOT_BYTES
 
-
-def _tile_rows(C: int, B1: int, mm_dtype) -> int:
-    """Largest 512-multiple tile height whose one-hot fits the budget."""
+def plan_tile_rows(C: int, B1: int, L: int, S: int, mm_dtype):
+    """Row-tile height (512-multiple, capped at 4096) whose combined
+    working set fits ``_VMEM_WORKSET_BYTES``, or None when even the
+    512-row minimum tile cannot — the caller must reject the fused
+    kernel and stay on the portable XLA path."""
     itemsize = jnp.dtype(mm_dtype).itemsize
-    t = _ONEHOT_BYTES // max(C * B1 * itemsize, 1)
-    return max(512, min(4096, (t // 512) * 512))
+    acc = C * B1 * L * S * 4                       # f32 accumulator block
+    per_row = ((C * B1 + L * S) * itemsize        # one-hot + A temporary
+               + L * 4                            # leaf-hot
+               + (C + S + 1) * 4)                 # bins/stats/leaf tiles
+    avail = _VMEM_WORKSET_BYTES - acc
+    if avail < per_row * 512:
+        return None
+    return int(min(4096, (avail // per_row // 512) * 512))
+
+
+def min_tile_fits(C: int, B1: int, L: int = 1, S: int = 4) -> bool:
+    """True when the minimum (512-row) tile's combined working set fits
+    the VMEM budget at the widest (f32) dtype — eligibility gate for
+    wide-feature AND wide-frontier shapes (ops/histogram.py falls back
+    to the XLA path otherwise)."""
+    return plan_tile_rows(C, B1, L, S, jnp.float32) is not None
+
+
+def _tile_rows(C: int, B1: int, L: int, S: int, mm_dtype) -> int:
+    """Working-set-bounded tile height; asserts eligibility was gated."""
+    t = plan_tile_rows(C, B1, L, S, mm_dtype)
+    if t is None:
+        raise ValueError(
+            f"hist_pallas working set exceeds VMEM at the minimum tile "
+            f"(C={C}, B1={B1}, L={L}, S={S}) — _pallas_eligible should "
+            f"have rejected this shape")
+    return t
 
 
 def _hist_kernel(bins_ref, leaf_ref, stats_ref, out_ref, *,
@@ -152,9 +182,14 @@ def hist_pallas_adaptive(bins, leaf, stats, lo, hi, off, is_cat,
     Cg = max(1, min(C,
                     _ONEHOT_BYTES // max(512 * B1 * itemsize, 1),
                     _ONEHOT_BYTES // max(B1 * n_leaves * S * 4, 1)))
+    # shrink the group until the COMBINED working set (incl. the
+    # (TR, L*S) A temporary, unbounded in the old gate) admits a tile
+    while Cg > 1 and plan_tile_rows(Cg, B1, n_leaves, S,
+                                    mm_dtype) is None:
+        Cg = max(1, Cg // 2)
     ncg = -(-C // Cg)
     cpad = ncg * Cg - C
-    TR = _tile_rows(Cg, B1, mm_dtype)
+    TR = _tile_rows(Cg, B1, n_leaves, S, mm_dtype)
     pad = (-R) % TR
     if cpad:
         # padded columns carry the fine_na sentinel, so every row maps
@@ -218,7 +253,7 @@ def hist_pallas(bins, leaf, stats, n_leaves: int, nbins: int,
     S = stats.shape[1]
     B1 = nbins + 1
     mm_dtype = jnp.bfloat16 if bf16 else jnp.float32
-    TR = _tile_rows(C, B1, mm_dtype)
+    TR = _tile_rows(C, B1, n_leaves, S, mm_dtype)
     pad = (-R) % TR
     if pad:
         bins = jnp.pad(bins, ((0, pad), (0, 0)))

@@ -61,31 +61,38 @@ def pallas_env_enabled() -> bool:
 
 
 def _pallas_eligible(C: int, B1: int, n_leaves: int, S: int,
-                     fine_map, allowed=None) -> bool:
+                     fine_map, allowed: bool) -> bool:
     """Static choice of the fused Pallas kernel (ops/hist_pallas.py):
     TPU backend only (CPU tests keep the portable XLA path), global-grid
     binning only (the adaptive fine_map fuses map_buckets into the XLA
-    scan body), and both kernel buffers must fit VMEM.  ``allowed`` is
-    the env OPT-IN resolved outside the trace (None = resolve here)."""
+    scan body), and the kernel's COMBINED per-tile working set — the
+    one-hot, the (TR, L*S) A-matrix temporary, the leaf-hot, and the
+    accumulator block — must fit VMEM (~12 MiB working-set budget; the
+    original gate left the A temporary unbounded in L, so a wide
+    frontier over few columns could pass and then Mosaic-fail with no
+    fallback — the ADVICE.md VMEM-gate bug).  ``allowed`` is the env
+    OPT-IN and must be resolved OUTSIDE the trace by the caller — it is
+    part of the executable's static signature, never re-read here."""
     if allowed is None:
-        allowed = pallas_env_enabled()
+        raise TypeError(
+            "pallas must be an explicit bool resolved outside the trace "
+            "(pallas_env_enabled() at the jit boundary) — resolving the "
+            "env inside a traced function bakes a stale value into the "
+            "cached executable")
     if not allowed:
         return False
     from h2o_tpu.core.cloud import backend_is_tpu
     if not backend_is_tpu():
         return False
-    if fine_map is not None:
-        # adaptive kernel streams column groups, so width never blocks
-        # it; its leaf-hot tile (rows x L) bounds the live frontier —
-        # the halving schedule's wide-B levels are exactly the small-L
-        # top levels where it matters most
-        return n_leaves <= 128
     from h2o_tpu.ops.hist_pallas import min_tile_fits
-    # accumulator block must fit VMEM comfortably AND the kernel's
-    # smallest row tile must keep its in-VMEM one-hot under budget
-    # (wide-feature shapes fall back to the XLA path)
-    return (C * B1 * n_leaves * S * 4 <= 6 * 2 ** 20 and
-            min_tile_fits(C, B1))
+    if fine_map is not None:
+        # adaptive kernel streams column groups (width never blocks it),
+        # but the leaf-hot and A tiles still bound the live frontier —
+        # the halving schedule's wide-B levels are exactly the small-L
+        # top levels where it matters most; min_tile_fits at Cg=1 is the
+        # floor the group-shrinking loop can always reach
+        return n_leaves <= 128 and min_tile_fits(1, B1, n_leaves, S)
+    return min_tile_fits(C, B1, n_leaves, S)
 
 
 def _block_hist(bins_blk, leaf_blk, stats_blk, n_leaves: int, nbins: int,
@@ -142,7 +149,7 @@ def map_buckets(bins_blk, leaf_blk, lo, hi, off, is_cat, nbins: int,
 
 def histogram_build_traced(bins, leaf, stats, n_leaves: int, nbins: int,
                            block_rows: int = 8192, bf16: bool = False,
-                           fine_map=None, pallas=None):
+                           fine_map=None, pallas: bool = False):
     """Traceable distributed histogram: (L, C, B+1, S) replicated on every
     device.  Nestable inside outer jit/scan programs (the fused tree engine
     calls this inside its per-tree scan body).
@@ -153,6 +160,13 @@ def histogram_build_traced(bins, leaf, stats, n_leaves: int, nbins: int,
     fine_map: None for direct (global-grid) binning, else
     (lo, hi, off, is_cat, fine_na) enabling per-node adaptive bucket
     placement (map_buckets) fused into each row block.
+
+    ``pallas`` must be an EXPLICIT bool resolved outside any enclosing
+    trace (``pallas_env_enabled()`` at the jit boundary, where it is a
+    static arg of the executable key): resolving H2O_TPU_HIST_PALLAS
+    here — inside a traced function — would bake the value read at
+    first-trace time into the cached executable, and a later env flip
+    would silently hit the stale program.
 
     Padded/invalid rows must arrive with leaf < 0 (they then match no leaf
     one-hot and contribute nothing).

@@ -10,11 +10,16 @@ online workload produces.  The engine bounds that:
 - batches pad to the next power of two (``_bucket``), so a deployment
   compiles at most log2(max_batch)+1 predict programs, each reused by
   every batch that rounds up to it;
-- compiled functions live in a bounded LRU keyed by
-  ``(model_id, version, batch_bucket)`` — hot-swapped or undeployed
-  versions age out instead of pinning device programs forever;
+- compiled functions live in the UNIFIED executable store
+  (core/exec_store.py — one bounded LRU shared with the MRTask and
+  munge kernels) keyed by ``(model_id, version, batch_bucket)``;
+  hot-swapped or undeployed versions are evicted instead of pinning
+  device programs forever;
 - the cache is warmed at deploy time (bucket 1 + the max-batch bucket)
-  so the first real request never eats a compile;
+  so the first real request never eats a compile — and with
+  ``H2O_TPU_EXEC_STORE_DIR`` set, a NEW REPLICA pre-loads its alias's
+  serialized executables from disk at deploy-warm time, skipping the
+  XLA compile entirely (the replica fan-out path);
 - model types without a device ``predict_raw_array`` fall back to the
   pure-NumPy ``mojo``/genmodel scorer — same artifact math, no compile.
 
@@ -27,41 +32,37 @@ scoring agree by construction.
 from __future__ import annotations
 
 import threading
-from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from h2o_tpu.core.chaos import chaos
+from h2o_tpu.core.exec_store import bucket_pow2, exec_store
 from h2o_tpu.core.log import get_logger
 
 log = get_logger("serve")
 
-DEFAULT_CACHE_ENTRIES = 64
-
 
 def _bucket(n: int) -> int:
-    """Smallest power of two >= n (the compile-bounding batch shape)."""
-    return 1 if n <= 1 else 1 << (int(n - 1).bit_length())
+    """Smallest power of two >= n (the compile-bounding batch shape —
+    the store's shared bucketing discipline)."""
+    return bucket_pow2(n)
 
 
 class ScoringEngine:
     """Schema encoding + compiled-predict cache for online scoring."""
 
     def __init__(self, max_entries: Optional[int] = None):
-        import os
-        self.max_entries = int(max_entries or
-                               os.environ.get("H2O_TPU_SERVE_CACHE",
-                                              DEFAULT_CACHE_ENTRIES))
+        # executables live in the process-wide store; the engine only
+        # tracks WHICH (model_id, version, bucket) entries it has
+        # materialized, for buckets_for/evict/stats bookkeeping
         self._lock = threading.RLock()
-        # (model_id, version, bucket) -> jitted predict (LRU, bounded)
-        self._compiled: "OrderedDict[Tuple[str, int, int], Any]" = \
-            OrderedDict()
+        self._keys: set = set()
         # (model_id, version) -> MojoModel schema/fallback view
         self._views: Dict[Tuple[str, int], Any] = {}
         # versions whose device predict failed to trace -> numpy fallback
         self._no_device: set = set()
-        self.compiled_entries = 0          # cumulative compile count
+        self.compiled_entries = 0          # entries this engine opened
         self.device_batches = 0
         self.fallback_batches = 0
 
@@ -129,29 +130,26 @@ class ScoringEngine:
 
     # -- compiled predict ----------------------------------------------------
 
-    def _get_compiled(self, model, version: int, bucket: int):
-        import jax
-        from h2o_tpu.core.cloud import donation_enabled
-        from h2o_tpu.core.diag import DispatchStats
+    def _get_compiled(self, model, version: int, bucket: int,
+                      example: np.ndarray):
+        """Fetch the compiled predict for this (model, version, bucket)
+        from the unified store.  The micro-batch input is DONATED (per
+        the store's backend policy): every request builds a fresh padded
+        batch, so its device buffer is dead after the predict.  With a
+        store directory configured the executable is AOT-serialized on
+        first build and disk-loaded by fresh replicas."""
         key = (str(model.key), int(version), int(bucket))
+        fn = exec_store().get_or_build(
+            "serve", ("predict",) + key,
+            lambda: model.predict_raw_array,
+            donate_argnums=(0,),
+            persist=(f"serve:{model.algo}:{key[0]}:v{key[1]}:"
+                     f"b{key[2]}"),
+            args=(example,))
         with self._lock:
-            fn = self._compiled.get(key)
-            if fn is not None:
-                self._compiled.move_to_end(key)
-                DispatchStats.note_cache_hit("serve")
-                return fn
-        # donate the micro-batch input: every request builds a fresh
-        # padded batch, so its device buffer is dead after the predict —
-        # donation hands it to XLA as scratch instead of a new HBM alloc
-        donate = (0,) if donation_enabled() else ()
-        fn = jax.jit(model.predict_raw_array, donate_argnums=donate)
-        DispatchStats.note_compile("serve")
-        with self._lock:
-            self._compiled[key] = fn
-            self.compiled_entries += 1
-            while len(self._compiled) > self.max_entries:
-                old, _ = self._compiled.popitem(last=False)
-                log.info("serve: evicting compiled predict %s", old)
+            if key not in self._keys:
+                self._keys.add(key)
+                self.compiled_entries += 1
         return fn
 
     def warm(self, model, version: int,
@@ -167,16 +165,16 @@ class ScoringEngine:
         for n in batch_sizes:
             b = _bucket(int(n))
             try:
-                fn = self._get_compiled(model, version, b)
-                np.asarray(fn(np.zeros((b, ncols), np.float32)))
+                X0 = np.zeros((b, ncols), np.float32)
+                fn = self._get_compiled(model, version, b, X0)
+                np.asarray(fn(X0))
             except Exception as e:  # noqa: BLE001 — fall back, don't fail
                 log.warning("serve: device predict for %s v%d does not "
                             "trace (%s); using numpy scorer", model.key,
                             version, e)
+                self.evict(str(model.key), int(version))
                 with self._lock:
                     self._no_device.add((str(model.key), int(version)))
-                    self._compiled.pop(
-                        (str(model.key), int(version), b), None)
                 return
 
     def predict(self, model, version: int, X: np.ndarray) -> np.ndarray:
@@ -234,7 +232,7 @@ class ScoringEngine:
         b = _bucket(n)
         Xp = np.zeros((b, X.shape[1]), np.float32)
         Xp[:n] = X
-        fn = self._get_compiled(model, version, b)
+        fn = self._get_compiled(model, version, b, Xp)
         raw = np.asarray(fn(Xp))
         with self._lock:
             self.device_batches += 1
@@ -244,23 +242,27 @@ class ScoringEngine:
 
     def buckets_for(self, model_id: str, version: int) -> List[int]:
         with self._lock:
-            return sorted(b for (mid, ver, b) in self._compiled
+            return sorted(b for (mid, ver, b) in self._keys
                           if mid == str(model_id) and ver == int(version))
 
     def evict(self, model_id: str, version: int) -> None:
         """Drop a version's compiled programs + schema view (undeploy /
-        rollback of a hot-swapped version)."""
+        rollback of a hot-swapped version) from the store."""
         key = (str(model_id), int(version))
         with self._lock:
             self._views.pop(key, None)
             self._no_device.discard(key)
-            for k in [k for k in self._compiled if k[:2] == key]:
-                self._compiled.pop(k, None)
+            self._keys = {k for k in self._keys if k[:2] != key}
+        exec_store().evict(
+            lambda k: len(k) >= 5 and k[0] == "serve" and
+            k[1] == "predict" and (k[2], k[3]) == key)
 
     def stats(self) -> Dict[str, Any]:
+        store = exec_store().stats()
         with self._lock:
-            return {"compiled_cache_entries": len(self._compiled),
+            return {"compiled_cache_entries": len(self._keys),
                     "compiled_total": self.compiled_entries,
-                    "cache_capacity": self.max_entries,
+                    "cache_capacity": store["capacity"],
+                    "store_disk_hits": store["disk_hits"],
                     "device_batches": self.device_batches,
                     "fallback_batches": self.fallback_batches}

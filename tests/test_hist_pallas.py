@@ -83,18 +83,25 @@ def test_adaptive_pallas_matches_map_buckets():
 def test_eligibility_gate():
     import jax
     import os
+    import pytest
     from h2o_tpu.ops.histogram import pallas_env_enabled
-    # the env default is OFF (opt-in until hardware-proven): allowed=None
-    # resolves to disabled whatever the backend.  Pin the env so an
-    # exported H2O_TPU_HIST_PALLAS=1 (the A/B instructions) can't flip
-    # these asserts.
+    # the env default is OFF (opt-in until hardware-proven).  The gate
+    # REQUIRES an explicit bool resolved outside the trace — a None
+    # (i.e. "resolve the env in here, mid-trace") is a stale-executable
+    # hazard and must raise, never silently read the env.  Pin the env
+    # so an exported H2O_TPU_HIST_PALLAS=1 (the A/B instructions) can't
+    # flip these asserts.
     saved = os.environ.pop("H2O_TPU_HIST_PALLAS", None)
     try:
         assert not pallas_env_enabled()
-        assert not _pallas_eligible(28, 21, 16, 4, None)
-        assert not _pallas_eligible(28, 21, 16, 4, object())
+        with pytest.raises(TypeError):
+            _pallas_eligible(28, 21, 16, 4, None, None)
+        assert not _pallas_eligible(28, 21, 16, 4, None, False)
         os.environ["H2O_TPU_HIST_PALLAS"] = "1"
         assert pallas_env_enabled()
+        # the env flip must NOT leak into the gate without the caller
+        # re-resolving it explicitly
+        assert not _pallas_eligible(28, 21, 16, 4, None, False)
     finally:
         if saved is None:
             os.environ.pop("H2O_TPU_HIST_PALLAS", None)

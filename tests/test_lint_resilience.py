@@ -70,17 +70,13 @@ def test_no_jax_jit_in_api_handlers():
 
 # jax.jit applied inside a function body wraps a freshly-created closure
 # per call, so EVERY call re-traces and re-compiles — the anti-pattern
-# the dispatch cache (core/mrtask.py) exists to kill.  Jitting belongs at
-# module level (one executable per shape, process-wide) or behind a
-# counted, bounded cache.  Allowed: the dispatch-cache module itself and
-# the serving engine's bucket-keyed compiled-predict cache.
-JIT_CLOSURE_ALLOWED = {os.path.join("core", "mrtask.py"),
-                       os.path.join("serve", "engine.py"),
-                       # munge kernel builders run ONLY under
-                       # mrtask.cached_kernel (dispatch-cache miss =
-                       # compile, counted) — one executable per
-                       # (verb, schema, shape-bucket)
-                       os.path.join("core", "munge.py"),
+# the unified executable store (core/exec_store.py) exists to kill.
+# Jitting belongs at module level (one executable per shape,
+# process-wide) or inside the store (counted, bounded, donation-policed,
+# persisted).  The old mrtask/serve/munge allowlist is FOLDED INTO the
+# store: those layers now pass raw functions to get_or_build/dispatch
+# and must not own jit wrappers themselves.
+JIT_CLOSURE_ALLOWED = {os.path.join("core", "exec_store.py"),
                        # jits live under functools.lru_cache(maxsize=32)
                        # keyed on (loss, regularizer) config — bounded
                        # once-per-config, not per-call
