@@ -315,40 +315,44 @@ def bench_cold_start():
     and hits the persistent compile cache.  The headline value is the
     cold/warm wall ratio for first-train; first-score and backend
     compile counts ride in detail."""
+    import shutil
     import subprocess
     import tempfile
     tmp = tempfile.mkdtemp(prefix="h2o_cold_")
-    env = dict(os.environ)
-    env["H2O_TPU_EXEC_STORE_DIR"] = os.path.join(tmp, "exec")
-    env["H2O_TPU_COMPILE_CACHE"] = os.path.join(tmp, "xla")
-    env.setdefault("XLA_FLAGS", "")
-    rows = int(os.environ.get("BENCH_COLD_ROWS", 50_000))
-    env.update({"CS_ROWS": str(rows), "CS_COLS": "8",
-                "CS_TREES": "3", "CS_DEPTH": "4"})
+    try:
+        env = dict(os.environ)
+        env["H2O_TPU_EXEC_STORE_DIR"] = os.path.join(tmp, "exec")
+        env["H2O_TPU_COMPILE_CACHE"] = os.path.join(tmp, "xla")
+        env.setdefault("XLA_FLAGS", "")
+        rows = int(os.environ.get("BENCH_COLD_ROWS", 50_000))
+        env.update({"CS_ROWS": str(rows), "CS_COLS": "8",
+                    "CS_TREES": "3", "CS_DEPTH": "4"})
 
-    def run():
-        r = subprocess.run([sys.executable, "-c", _COLD_START_SRC],
-                           capture_output=True, env=env, timeout=900)
-        if r.returncode != 0:
-            raise RuntimeError(r.stderr.decode()[-400:])
-        return json.loads(r.stdout.decode().strip().splitlines()[-1])
+        def run():
+            r = subprocess.run([sys.executable, "-c", _COLD_START_SRC],
+                               capture_output=True, env=env, timeout=900)
+            if r.returncode != 0:
+                raise RuntimeError(r.stderr.decode()[-400:])
+            return json.loads(r.stdout.decode().strip().splitlines()[-1])
 
-    cold = run()
-    warm = run()
-    return {"value": round(cold["train_s"] / max(warm["train_s"], 1e-9),
-                           3),
-            "unit": "cold/warm first-train wall ratio",
-            "cold_train_s": round(cold["train_s"], 2),
-            "warm_train_s": round(warm["train_s"], 2),
-            "cold_score_s": round(cold["score_s"], 3),
-            "warm_score_s": round(warm["score_s"], 3),
-            "cold_backend_compiles": cold["backend_compiles"],
-            "warm_backend_compiles": warm["backend_compiles"],
-            "warm_disk_hits": warm["disk_hits"],
-            "cold_disk_stores": cold["disk_stores"],
-            "serialized_bytes": cold["serialized_bytes"],
-            "rows": rows,
-            "pred_match": cold["pred0"] == warm["pred0"]}
+        cold = run()
+        warm = run()
+        return {"value": round(cold["train_s"] /
+                               max(warm["train_s"], 1e-9), 3),
+                "unit": "cold/warm first-train wall ratio",
+                "cold_train_s": round(cold["train_s"], 2),
+                "warm_train_s": round(warm["train_s"], 2),
+                "cold_score_s": round(cold["score_s"], 3),
+                "warm_score_s": round(warm["score_s"], 3),
+                "cold_backend_compiles": cold["backend_compiles"],
+                "warm_backend_compiles": warm["backend_compiles"],
+                "warm_disk_hits": warm["disk_hits"],
+                "cold_disk_stores": cold["disk_stores"],
+                "serialized_bytes": cold["serialized_bytes"],
+                "rows": rows,
+                "pred_match": cold["pred0"] == warm["pred0"]}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def bench_cpu_reference(X, y, rows, trees, depth):

@@ -36,8 +36,15 @@ owning modules, like the chaos flags, so they work before a cloud boots):
   ``H2O_TPU_EXEC_STORE_DIR`` (directory for persistent AOT-serialized
   executables; unset = disk layer off.  A fresh process warms its
   kernel set from here — disk entries are schema-versioned and
-  invalidate cleanly on any key mismatch: schema bump, jax version,
-  backend topology, or header corruption), and
+  invalidate cleanly on any key mismatch: schema bump, h2o_tpu or jax
+  version, backend topology, content fingerprint [function body /
+  model parameter digest — a retrained model under a reused model_id
+  or an upgraded kernel body rebuilds instead of loading stale], or
+  header corruption.  SECURITY: entries are unpickled on load, which
+  is code execution — point this only at a directory writable solely
+  by principals trusted to run code in every process that warms from
+  it; the store writes 0o600 files in a 0o700 dir and warns if the
+  dir is group/other-writable), and
   ``H2O_TPU_COMPILE_CACHE`` (XLA persistent compile cache directory /
   on-off switch, core/cloud.py — the fallback warm-start layer for
   entries executable serialization cannot cover, e.g. jit-level

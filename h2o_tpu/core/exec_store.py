@@ -40,9 +40,24 @@ per-algorithm plumbing, so this module is that substrate: a single
   compile cache (core/cloud._enable_compile_cache) so the backend
   compile — the expensive half — still warms from disk.
 
-Disk entries are schema-versioned: a header mismatch (schema bump, jax
-upgrade, different device topology, key collision) invalidates the entry
-cleanly — it is ignored and rebuilt, never half-loaded.
+Disk entries are schema-versioned: a header mismatch (schema bump,
+h2o_tpu or jax upgrade, different device topology, key collision)
+invalidates the entry cleanly — it is ignored and rebuilt, never
+half-loaded.  Because a serialized executable bakes its closure
+constants in (serve predict entries embed the MODEL WEIGHTS; kernels
+embed their traced body), the disk key also carries a **content
+fingerprint**: a digest of the persisted function's compiled body
+(``code_fingerprint``) or, for serve entries, of the model's parameter
+arrays — so a different model under a reused model_id, or an upgraded
+kernel under an unchanged qualname, can never silently load the stale
+program.
+
+TRUST BOUNDARY: disk entries are unpickled on load, and unpickling is
+code execution.  ``H2O_TPU_EXEC_STORE_DIR`` must only point at a
+directory writable solely by principals already trusted to run code in
+every process that warms from it (the store writes 0o600 files in a
+0o700 directory and warns once if the directory is group/other-
+writable); the header/magic checks authenticate nothing.
 """
 
 from __future__ import annotations
@@ -110,6 +125,45 @@ def _backend_fingerprint() -> Tuple[str, int]:
     return jax.default_backend(), jax.device_count()
 
 
+def _is_deleted_array(x) -> bool:
+    import jax
+    if not isinstance(x, jax.Array):
+        return False
+    try:
+        return bool(x.is_deleted())
+    except Exception:  # noqa: BLE001 — tracers etc. count as alive
+        return False
+
+
+def code_fingerprint(fn) -> str:
+    """Digest of a function's COMPILED BODY (co_code + consts + names,
+    nested code objects recursed, defaults) — the content half of a
+    disk key.  A persisted executable embeds its traced body, so a
+    changed implementation under an unchanged ``module.qualname`` must
+    select a different disk entry, never load the stale program."""
+    h = hashlib.sha256()
+
+    def walk(code) -> None:
+        h.update(code.co_code)
+        h.update(",".join(code.co_names).encode())
+        h.update(",".join(code.co_varnames).encode())
+        for c in code.co_consts:
+            if hasattr(c, "co_code"):
+                walk(c)
+            else:
+                h.update(repr(c).encode())
+
+    code = getattr(fn, "__code__", None)
+    if code is None:                       # builtins / C extensions
+        h.update(f"{getattr(fn, '__module__', '')}."
+                 f"{getattr(fn, '__qualname__', repr(type(fn)))}".encode())
+    else:
+        walk(code)
+        for d in getattr(fn, "__defaults__", None) or ():
+            h.update(repr(d).encode())
+    return h.hexdigest()[:16]
+
+
 def stable_fn_name(fn) -> Optional[str]:
     """Cross-process-stable identity for a map function, or None when
     there is none.  Only a plain module-level function qualifies: a
@@ -170,6 +224,7 @@ class ExecStore:
                      donate: Optional[bool] = None,
                      jit_kwargs: Optional[Dict[str, Any]] = None,
                      persist: Optional[str] = None,
+                     content: Optional[str] = None,
                      args: Optional[Tuple] = None,
                      kwargs: Optional[Dict[str, Any]] = None):
         """Fetch the executable for ``key`` (+ the resolved donation
@@ -183,7 +238,10 @@ class ExecStore:
         ``H2O_TPU_EXEC_STORE_DIR`` configured, the compiled executable
         is serialized to disk on build and loaded from disk — skipping
         trace AND backend compile — on the first fetch of a fresh
-        process."""
+        process.  ``content`` is the caller's content fingerprint
+        (``code_fingerprint`` of the persisted function, a digest of a
+        model's parameters) folded into the disk key so a changed body
+        under an unchanged name invalidates instead of loading stale."""
         dn = bool(donate_argnums or donate_argnames) and \
             (self.donation_on() if donate is None else bool(donate))
         k = (phase,) + tuple(key) + (("__donate__", dn),)
@@ -197,8 +255,8 @@ class ExecStore:
             return fn
         disk_key = None
         if persist is not None and args is not None and store_dir():
-            disk_key = self._disk_key(persist, dn, jit_kwargs, args,
-                                      kwargs)
+            disk_key = self._disk_key(persist, content, dn, jit_kwargs,
+                                      args, kwargs)
             fn = self._disk_load(phase, disk_key)
             if fn is not None:
                 self._insert(k, fn, aot=True)
@@ -254,6 +312,7 @@ class ExecStore:
                  donate: Optional[bool] = None,
                  jit_kwargs: Optional[Dict[str, Any]] = None,
                  persist: Optional[str] = None,
+                 content: Optional[str] = None,
                  aot: bool = True,
                  shrink: Optional[Callable[[], bool]] = None,
                  host_fallback: Optional[Callable[[], object]] = None,
@@ -261,18 +320,33 @@ class ExecStore:
         """Fetch-or-compile, then EXECUTE under the OOM degradation
         ladder (core/oom.py).  When the entry donates input buffers, an
         OOM retry re-routes through the non-donating twin — a retry
-        re-reads its inputs, so re-donating them would be wrong."""
+        re-reads its inputs, so re-donating them would be wrong.  If the
+        failed donating run already CONSUMED a donated input (XLA may
+        invalidate donated buffers even on a RESOURCE_EXHAUSTED
+        execution), no retry can re-read it: that surfaces as a terminal
+        OOMError naming the dead argument instead of an unclassified
+        'Array has been deleted' mid-ladder."""
         from h2o_tpu.core.oom import oom_ladder
         fn = self.get_or_build(
             phase, key, build, donate_argnums=donate_argnums,
             donate=donate, jit_kwargs=jit_kwargs, persist=persist,
-            args=args if aot else None)
+            content=content, args=args if aot else None)
         DispatchStats.note_dispatch(phase)
         state = {"fn": fn}
 
         def _on_oom(exc):
             if donate_argnums and \
                     (self.donation_on() if donate is None else donate):
+                dead = [i for i, a in enumerate(args)
+                        if _is_deleted_array(a)]
+                if dead:
+                    from h2o_tpu.core.oom import OOMError
+                    raise OOMError(
+                        f"device out of memory at {site or phase}: the "
+                        f"donating executable consumed donated input "
+                        f"buffer(s) {dead} before the OOM retry could "
+                        f"re-read them — re-materialize the inputs or "
+                        f"dispatch with donate=False") from exc
                 state["fn"] = self.get_or_build(
                     phase, key, build, donate_argnums=donate_argnums,
                     donate=False, jit_kwargs=jit_kwargs,
@@ -286,27 +360,55 @@ class ExecStore:
 
     # -- persistence ---------------------------------------------------------
 
-    def _disk_key(self, persist: str, donate: bool, jit_kwargs, args,
+    def _disk_key(self, persist: str, content: Optional[str],
+                  donate: bool, jit_kwargs, args,
                   kwargs) -> Tuple[str, str]:
         """(human keystring, sha256 filename stem).  Everything that
         selects a different executable is in the string: schema version,
-        the caller's stable name, jit statics, donation, every argument
-        aval (shape/dtype/sharding), jax version and backend topology —
-        a mismatch on load is an invalidation, never a wrong program."""
+        the caller's stable name, the CONTENT fingerprint (function body
+        / model parameters — the executable bakes closure constants in),
+        jit statics, donation, every argument aval (shape/dtype/
+        sharding), h2o_tpu + jax versions and backend topology — a
+        mismatch on load is an invalidation, never a wrong program."""
         import jax
+        import h2o_tpu
         plat, ndev = _backend_fingerprint()
         parts = [f"schema={SCHEMA_VERSION}", f"name={persist}",
+                 f"content={content}",
                  f"jit={sorted((jit_kwargs or {}).items())!r}",
                  f"donate={donate}",
                  f"args={tuple(aval_key(a) for a in args)!r}",
                  f"kwargs={sorted((kwargs or {}).items(), key=lambda kv: kv[0])!r}"
                  if kwargs else "kwargs=()",
+                 f"h2o={h2o_tpu.__version__}",
                  f"jax={jax.__version__}", f"backend={plat}x{ndev}"]
         keystr = ";".join(parts)
         return keystr, hashlib.sha256(keystr.encode()).hexdigest()
 
     def _path(self, stem: str) -> str:
         return os.path.join(store_dir(), f"{stem}.exec")
+
+    _trust_warned = False
+
+    def _check_dir_trust(self) -> None:
+        """Loading an entry unpickles it — code execution.  Warn (once)
+        when the store directory is writable by group/other, since any
+        writer there owns every process that warms from it."""
+        if ExecStore._trust_warned:
+            return
+        try:
+            mode = os.stat(store_dir()).st_mode
+        except OSError:
+            return
+        if mode & 0o022:
+            ExecStore._trust_warned = True
+            log.warning(
+                "exec store: %s is group/other-writable (mode %o) — "
+                "serialized executables are unpickled on load, so any "
+                "principal that can write here can execute code in "
+                "every process warming from it; chmod 700 the "
+                "directory or unset H2O_TPU_EXEC_STORE_DIR",
+                store_dir(), mode & 0o777)
 
     def _disk_store(self, disk_key: Tuple[str, str], compiled) -> None:
         keystr, stem = disk_key
@@ -324,10 +426,13 @@ class ExecStore:
         header = json.dumps({"schema": SCHEMA_VERSION,
                              "key": keystr}).encode()
         try:
-            os.makedirs(store_dir(), exist_ok=True)
+            os.makedirs(store_dir(), mode=0o700, exist_ok=True)
+            self._check_dir_trust()
             path = self._path(stem)
             tmp = f"{path}.tmp.{os.getpid()}"
-            with open(tmp, "wb") as f:
+            fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                         0o600)
+            with os.fdopen(fd, "wb") as f:
                 f.write(_MAGIC)
                 f.write(struct.pack("<I", len(header)))
                 f.write(header)
@@ -340,6 +445,10 @@ class ExecStore:
             log.warning("exec store: could not persist %s: %r", stem, e)
 
     def _disk_load(self, phase: str, disk_key: Tuple[str, str]):
+        """Load one serialized executable.  NOTE: the payload is
+        unpickled — the store directory is a trust boundary (module
+        docstring); the header check below validates the KEY, it does
+        not authenticate the writer."""
         keystr, stem = disk_key
         path = self._path(stem)
         try:
@@ -347,6 +456,7 @@ class ExecStore:
                 raw = f.read()
         except OSError:
             return None
+        self._check_dir_trust()
         try:
             buf = io.BytesIO(raw)
             if buf.read(len(_MAGIC)) != _MAGIC:
@@ -386,6 +496,14 @@ class ExecStore:
                 self._entries.pop(k, None)
                 self._aot.discard(k)
             return len(victims)
+
+    def keys(self) -> list:
+        """Snapshot of live entry keys — callers that keep their own
+        bookkeeping over a key subset (the serve engine's bucket map)
+        reconcile against this so LRU evictions by OTHER phases never
+        leave them reporting a warm program that would recompile."""
+        with self._lock:
+            return list(self._entries)
 
     def clear(self) -> None:
         with self._lock:
@@ -430,11 +548,14 @@ def cached_kernel(phase: str, name: str, statics: Tuple,
     future kernel layer's) route into the compile-once contract.
     ``build`` returns the RAW kernel function; the store jits, AOT-
     compiles at the given arrays' avals, and (``persist``) serializes it
-    under a stable ``phase:name:statics`` disk name."""
+    under a stable ``phase:name:statics`` disk name, content-keyed on
+    the builder's compiled body so an upgraded kernel never loads the
+    previous version's program."""
     key = (name, statics, tuple(aval_key(a) for a in arrays))
     fn = exec_store().get_or_build(
         phase, key, build,
         persist=f"{phase}:{name}:{statics!r}" if persist else None,
+        content=code_fingerprint(build) if persist else None,
         args=tuple(arrays))
     DispatchStats.note_dispatch(phase)
     return fn

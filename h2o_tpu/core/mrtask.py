@@ -42,7 +42,8 @@ from jax.sharding import PartitionSpec as P
 from h2o_tpu.core.cloud import DATA_AXIS, cloud, shard_map_compat
 from h2o_tpu.core.diag import DispatchStats
 from h2o_tpu.core.exec_store import (aval_key, cached_kernel,  # noqa: F401
-                                     exec_store, stable_fn_name)
+                                     code_fingerprint, exec_store,
+                                     stable_fn_name)
 from h2o_tpu.core.frame import Frame
 
 REDUCERS = {
@@ -96,7 +97,8 @@ def map_reduce(map_fn: Callable, *arrays: jax.Array, reduce: str = "sum",
     name = stable_fn_name(map_fn)
     return exec_store().dispatch(
         "map_reduce", key, build, (*arrays, *extra_args),
-        persist=f"map_reduce:{name}:{reduce}" if name else None)
+        persist=f"map_reduce:{name}:{reduce}" if name else None,
+        content=code_fingerprint(map_fn) if name else None)
 
 
 def map_frame(map_fn: Callable, frame: Frame,
@@ -113,7 +115,8 @@ def map_frame(map_fn: Callable, frame: Frame,
     name = stable_fn_name(map_fn)
     return exec_store().dispatch(
         "map_frame", key, lambda: map_fn, (m,),
-        persist=f"map_frame:{name}" if name else None)
+        persist=f"map_frame:{name}" if name else None,
+        content=code_fingerprint(map_fn) if name else None)
 
 
 def mutate_array(map_fn: Callable, array: jax.Array,
@@ -131,7 +134,8 @@ def mutate_array(map_fn: Callable, array: jax.Array,
     return exec_store().dispatch(
         "mutate", key, lambda: map_fn, (array, *extras),
         donate_argnums=(0,),
-        persist=f"mutate:{name}" if name else None)
+        persist=f"mutate:{name}" if name else None,
+        content=code_fingerprint(map_fn) if name else None)
 
 
 @jax.jit

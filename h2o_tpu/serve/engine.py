@@ -31,8 +31,9 @@ scoring agree by construction.
 
 from __future__ import annotations
 
+import hashlib
 import threading
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Sequence, Tuple
 
 import numpy as np
 
@@ -52,14 +53,18 @@ def _bucket(n: int) -> int:
 class ScoringEngine:
     """Schema encoding + compiled-predict cache for online scoring."""
 
-    def __init__(self, max_entries: Optional[int] = None):
-        # executables live in the process-wide store; the engine only
-        # tracks WHICH (model_id, version, bucket) entries it has
-        # materialized, for buckets_for/evict/stats bookkeeping
+    def __init__(self):
+        # executables live in the process-wide store (capacity:
+        # H2O_TPU_EXEC_STORE); the engine only tracks WHICH
+        # (model_id, version, bucket) entries it has materialized, for
+        # buckets_for/evict/stats bookkeeping — reconciled against the
+        # store so cross-phase LRU evictions are never reported as warm
         self._lock = threading.RLock()
         self._keys: set = set()
         # (model_id, version) -> MojoModel schema/fallback view
         self._views: Dict[Tuple[str, int], Any] = {}
+        # (model_id, version) -> parameter-content digest (disk keying)
+        self._content: Dict[Tuple[str, int], str] = {}
         # versions whose device predict failed to trace -> numpy fallback
         self._no_device: set = set()
         self.compiled_entries = 0          # entries this engine opened
@@ -130,6 +135,29 @@ class ScoringEngine:
 
     # -- compiled predict ----------------------------------------------------
 
+    def _model_fingerprint(self, model, version: int) -> str:
+        """Digest of the model's parameter arrays.  The serialized
+        predict executable bakes the WEIGHTS in as closure constants,
+        and model ids are user-chosen (or auto-sequenced), so the disk
+        key must be keyed on content: a different model trained later
+        under a reused (model_id, version) must rebuild, never load the
+        old model's program and return its predictions."""
+        key = (str(model.key), int(version))
+        with self._lock:
+            fp = self._content.get(key)
+        if fp is not None:
+            return fp
+        view = self.view(model, version)
+        h = hashlib.sha256()
+        for name in sorted(view.arrays):
+            a = np.ascontiguousarray(view.arrays[name])
+            h.update(f"{name}:{a.shape}:{a.dtype}".encode())
+            h.update(a.tobytes())
+        fp = h.hexdigest()[:16]
+        with self._lock:
+            self._content[key] = fp
+        return fp
+
     def _get_compiled(self, model, version: int, bucket: int,
                       example: np.ndarray):
         """Fetch the compiled predict for this (model, version, bucket)
@@ -145,6 +173,7 @@ class ScoringEngine:
             donate_argnums=(0,),
             persist=(f"serve:{model.algo}:{key[0]}:v{key[1]}:"
                      f"b{key[2]}"),
+            content=self._model_fingerprint(model, version),
             args=(example,))
         with self._lock:
             if key not in self._keys:
@@ -240,7 +269,18 @@ class ScoringEngine:
 
     # -- lifecycle -----------------------------------------------------------
 
+    def _reconcile(self) -> None:
+        """Drop bookkeeping for entries the SHARED store has LRU-evicted
+        (heavy munge/map_reduce traffic competes for the same capacity):
+        buckets_for/stats must never report a warm program that would
+        actually recompile on the next request."""
+        live = {(k[2], k[3], k[4]) for k in exec_store().keys()
+                if len(k) >= 5 and k[0] == "serve" and k[1] == "predict"}
+        with self._lock:
+            self._keys &= live
+
     def buckets_for(self, model_id: str, version: int) -> List[int]:
+        self._reconcile()
         with self._lock:
             return sorted(b for (mid, ver, b) in self._keys
                           if mid == str(model_id) and ver == int(version))
@@ -251,6 +291,7 @@ class ScoringEngine:
         key = (str(model_id), int(version))
         with self._lock:
             self._views.pop(key, None)
+            self._content.pop(key, None)
             self._no_device.discard(key)
             self._keys = {k for k in self._keys if k[:2] != key}
         exec_store().evict(
@@ -258,6 +299,7 @@ class ScoringEngine:
             k[1] == "predict" and (k[2], k[3]) == key)
 
     def stats(self) -> Dict[str, Any]:
+        self._reconcile()
         store = exec_store().stats()
         with self._lock:
             return {"compiled_cache_entries": len(self._keys),

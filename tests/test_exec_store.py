@@ -43,6 +43,10 @@ def _scale(x):
     return x * 3.0
 
 
+def _add_one(x):
+    return x + 1.0
+
+
 # ------------------------------------------------------------- LRU core
 
 
@@ -137,6 +141,43 @@ def test_dispatch_reroutes_nondonating_on_oom(cl, monkeypatch):
         chaos_mod.reset()
 
 
+def test_dispatch_deleted_donated_input_is_terminal(cl, monkeypatch):
+    """If the failed donating run already consumed a donated buffer,
+    no retry can re-read it: the ladder must surface a clear OOMError
+    naming the dead argument, not an unclassified 'Array has been
+    deleted' RuntimeError."""
+    from h2o_tpu.core import chaos as chaos_mod
+    from h2o_tpu.core.oom import OOMError
+    monkeypatch.setenv("H2O_TPU_DONATE", "1")
+    st = ExecStore(max_entries=8)
+    a = jnp.arange(8, dtype=jnp.float32)
+    out = st.dispatch("t", ("dead",), lambda: _scale, (jnp.array(a),),
+                      donate_argnums=(0,), site="exec_store.test_dead")
+    np.testing.assert_allclose(np.asarray(out), 3.0 * np.arange(8))
+    dead = jnp.array(a)
+    dead.delete()
+    chaos_mod.configure(oom_transient=1)
+    try:
+        with pytest.raises(OOMError, match="donated input buffer"):
+            st.dispatch("t", ("dead",), lambda: _scale, (dead,),
+                        donate_argnums=(0,),
+                        site="exec_store.test_dead")
+    finally:
+        chaos_mod.reset()
+
+
+def test_engine_bookkeeping_reconciles_with_store():
+    """Serve bucket bookkeeping must track the SHARED store's LRU: an
+    entry evicted by other phases' traffic (or never present) may not
+    be reported as a warm bucket."""
+    from h2o_tpu.serve.engine import ScoringEngine
+    eng = ScoringEngine()
+    with eng._lock:
+        eng._keys.add(("ghost_model", 0, 8))
+    assert eng.buckets_for("ghost_model", 0) == []
+    assert ("ghost_model", 0, 8) not in eng._keys
+
+
 # --------------------------------------------------- persistent layer
 
 
@@ -181,6 +222,60 @@ def test_disk_key_mismatch_invalidates_cleanly(tmp_path, monkeypatch):
     st3.get_or_build("t", ("p2",), lambda: _scale,
                      persist="test:p2", args=(a,))
     assert st3.disk_hits == 1 and st3.disk_invalid == 0
+
+
+def test_code_fingerprint_tracks_body():
+    from h2o_tpu.core.exec_store import code_fingerprint
+    assert code_fingerprint(_scale) == code_fingerprint(_scale)
+    assert code_fingerprint(_scale) != code_fingerprint(_add)
+
+    def v1(x):
+        return x * 2.0
+
+    def v2(x):
+        return x * 5.0
+
+    # same arity/name-shape, different constant: distinct fingerprints
+    assert code_fingerprint(v1) != code_fingerprint(v2)
+
+
+def test_disk_key_content_fingerprint_invalidates(tmp_path, monkeypatch):
+    """The stale-content hazard: a serialized executable bakes closure
+    constants in, so the same persist name with DIFFERENT content (a
+    retrained model under a reused model_id, an upgraded kernel body)
+    must rebuild — never disk-load the old program."""
+    monkeypatch.setenv("H2O_TPU_EXEC_STORE_DIR", str(tmp_path))
+    a = jnp.arange(16, dtype=jnp.float32)
+    st1 = ExecStore(max_entries=8)
+    st1.get_or_build("t", ("c1",), lambda: _scale,
+                     persist="test:content", content="modelA", args=(a,))
+    assert st1.disk_stores == 1
+    st2 = ExecStore(max_entries=8)
+    fn = st2.get_or_build("t", ("c1",), lambda: _add_one,
+                          persist="test:content", content="modelB",
+                          args=(a,))
+    assert st2.disk_hits == 0 and st2.disk_stores == 1
+    np.testing.assert_allclose(np.asarray(fn(a)), np.arange(16) + 1.0)
+    # matching content still warms from disk
+    st3 = ExecStore(max_entries=8)
+    st3.get_or_build("t", ("c1",), lambda: _scale,
+                     persist="test:content", content="modelA", args=(a,))
+    assert st3.disk_hits == 1
+
+
+def test_store_files_are_private(tmp_path, monkeypatch):
+    """Disk entries are unpickled on load (code execution), so the
+    store writes 0o600 files in a 0o700 directory."""
+    monkeypatch.setenv("H2O_TPU_EXEC_STORE_DIR", str(tmp_path / "s"))
+    a = jnp.arange(8, dtype=jnp.float32)
+    st = ExecStore(max_entries=8)
+    st.get_or_build("t", ("perm",), lambda: _scale,
+                    persist="test:perm", args=(a,))
+    assert st.disk_stores == 1
+    d = tmp_path / "s"
+    assert (os.stat(d).st_mode & 0o777) == 0o700
+    for f in os.listdir(d):
+        assert (os.stat(d / f).st_mode & 0o777) == 0o600
 
 
 def test_closure_entries_never_persist(tmp_path, monkeypatch):
