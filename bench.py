@@ -355,6 +355,90 @@ def bench_cold_start():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_streaming_refresh(rows=None, chunk_rows=None):
+    """Streaming ingest + online refresh (h2o_tpu/stream): one pipeline
+    ingests a CSV in chunks, GBM checkpoint-refreshes every 5 chunks and
+    hot-swaps a serve alias, while a hammer thread scores the alias
+    continuously.  Reports sustained ingest rows/s (headline), mean
+    refresh-to-hot-swap latency, and /score p99 DURING refreshes — the
+    no-downtime number the live alias contract promises."""
+    import tempfile
+    import threading
+    from h2o_tpu.core.cloud import cloud
+    from h2o_tpu.serve.registry import registry
+    from h2o_tpu.stream import ChunkReader, start_pipeline, stop_pipeline
+
+    rows = int(rows or os.environ.get("BENCH_STREAM_ROWS", 100_000))
+    chunk_rows = int(chunk_rows or
+                     os.environ.get("BENCH_STREAM_CHUNK_ROWS",
+                                    max(rows // 25, 1)))
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(rows, 6)).astype(np.float32)
+    y = np.where(X[:, 0] + 0.5 * X[:, 1] > 0, "s", "b")
+    fd, path = tempfile.mkstemp(suffix=".csv")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(",".join(f"x{j}" for j in range(6)) + ",y\n")
+            for i in range(rows):
+                f.write(",".join(f"{v:.5f}" for v in X[i]) +
+                        f",{y[i]}\n")
+        alias = "bench_stream_live"
+        lat, codes = [], []
+        stop = threading.Event()
+        probe = {f"x{j}": 0.1 for j in range(6)}
+
+        def hammer():
+            while not stop.is_set():
+                t0 = time.time()
+                try:
+                    registry().score_rows(alias, [probe])
+                    codes.append(200)
+                except KeyError:
+                    codes.append(404)      # before the first deploy
+                except Exception:  # noqa: BLE001 — shed/deadline
+                    codes.append(503)
+                lat.append((time.time() - t0) * 1000.0)
+                time.sleep(0.002)
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t0 = time.time()
+        pipe = start_pipeline(
+            "bench_stream", ChunkReader(path, chunk_rows=chunk_rows),
+            "y", algo="gbm",
+            model_params=dict(max_depth=4, seed=1, nbins=16, ntrees=0),
+            refresh_chunks=5, trees_per_refresh=5, alias=alias)
+        t.start()
+        pipe.job.join(timeout=1800)
+        wall = time.time() - t0
+        stop.set()
+        t.join(timeout=5)
+        st = pipe.status()
+        ok_lat = [l for l, c in zip(lat, codes) if c == 200]
+        p99 = float(np.percentile(ok_lat, 99)) if ok_lat else 0.0
+        out = {"value": round(rows / wall, 1), "unit": "ingest rows/sec",
+               "wall_s": round(wall, 2), "rows": rows,
+               "chunks": st["chunks_landed"],
+               "refreshes": st["refreshes"],
+               "failed_refreshes": st["failed_refreshes"],
+               "final_lag": st["lag"],
+               "swap_ms_mean": round(float(np.mean(st["swap_ms"])), 2)
+               if st["swap_ms"] else 0.0,
+               "score_p99_ms_during_refresh": round(p99, 2),
+               "score_requests": len(codes),
+               "score_5xx": sum(1 for c in codes if c >= 500)}
+        try:
+            registry().undeploy(alias, drain_secs=2.0)
+        except KeyError:
+            pass
+        stop_pipeline("bench_stream", remove=True)
+        return out
+    finally:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
 def bench_cpu_reference(X, y, rows, trees, depth):
     """External CPU baseline for the north-star ratio (VERDICT r3 item 3):
     the same GBM workload through a widely-accepted CPU hist
@@ -620,7 +704,7 @@ def _main_ladder(detail):
     configs = os.environ.get(
         "BENCH_CONFIG",
         "gbm,gbm_ua,gbm_bf16,drf,glm,dl,hist,rapidsgb,gbm10m,cpuref,"
-        "cpuref10m,deep,coldstart"
+        "cpuref10m,deep,coldstart,streamref"
     ).split(",")
 
     detail.update({"rows": rows, "cols": cols})
@@ -686,13 +770,15 @@ def _main_ladder(detail):
             ("gbm10m", lambda: bench_gbm10m(cols, depth)),
             ("cpuref10m", lambda: bench_cpu_reference_10m(cols, depth)),
             ("deep", lambda: bench_deep(fr, rows)),
-            ("coldstart", bench_cold_start)]
+            ("coldstart", bench_cold_start),
+            ("streamref", bench_streaming_refresh)]
     names = {"hist": "hist_kernel", "gbm10m": "gbm_10m",
              "cpuref": "cpu_reference", "deep": "drf_deep20",
              "gbm_ua": "gbm_uniform_adaptive", "gbm_bf16": "gbm_bf16",
              "cpuref10m": "cpu_reference_10m",
              "rapidsgb": "rapids_groupby_throughput",
-             "coldstart": "cold_start"}
+             "coldstart": "cold_start",
+             "streamref": "streaming_refresh"}
     for cfg, fn in runs:
         if cfg not in configs:
             continue

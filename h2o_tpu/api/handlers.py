@@ -1436,6 +1436,7 @@ from h2o_tpu.api import handlers_frames  # noqa: E402,F401
 from h2o_tpu.api import handlers_ext  # noqa: E402,F401
 from h2o_tpu.api import handlers_models  # noqa: E402,F401
 from h2o_tpu.api import handlers_serving  # noqa: E402,F401
+from h2o_tpu.api import handlers_stream  # noqa: E402,F401
 from h2o_tpu.api import handlers_transforms  # noqa: E402,F401
 from h2o_tpu.api import handlers_analysis  # noqa: E402,F401
 from h2o_tpu.api import flow_ui  # noqa: E402

@@ -1,0 +1,118 @@
+"""Streaming ingest + online refresh REST surface: /3/Stream.
+
+- ``POST   /3/Stream``                 start a pipeline (source -> frame
+                                       -> cadence retrain -> alias swap)
+- ``GET    /3/Stream``                 list pipelines + lag stats
+- ``GET    /3/Stream/<id>``            one pipeline's detail (chunks
+                                       landed vs trained = lag, versions,
+                                       swap latencies, last error)
+- ``POST   /3/Stream/<id>/stop``       cooperative stop (also DELETE)
+- ``DELETE /3/Stream/<id>``            stop + remove from the table
+
+NOTE: no ``jax.jit`` may appear in api/handlers*.py (lint-enforced) —
+the stream data plane compiles behind the exec store's append kernels.
+"""
+
+from __future__ import annotations
+
+import json
+
+from h2o_tpu.api.server import H2OError, route
+from h2o_tpu.core.store import Key
+from h2o_tpu.serve.registry import ServingConfig
+
+
+def _int(params, key, default):
+    v = params.get(key)
+    return int(v) if v is not None else default
+
+
+@route("POST", r"/3/Stream")
+def stream_start(params):
+    """Start a streaming pipeline.  Required: ``source`` (path/URI) and
+    ``y``.  Optional: ``algo`` (gbm/drf/xgboost/glm, default gbm), ``x``
+    (comma list), ``alias`` (serve deployment to hot-swap), ``chunk_rows``,
+    ``refresh_chunks``, ``trees_per_refresh``, ``lag_bound``,
+    ``recovery_dir`` (mid-block checkpoint/resume of refreshes),
+    ``dest_frame``, ``max_chunks``, ``params`` (JSON dict of model
+    params, e.g. {"max_depth": 3, "seed": 7})."""
+    from h2o_tpu.stream import ChunkReader, start_pipeline
+    source = params.get("source")
+    y = params.get("y") or params.get("response_column")
+    if not source or not y:
+        raise H2OError(400, "source and y are required")
+    model_params = params.get("params") or {}
+    if isinstance(model_params, str):
+        try:
+            model_params = json.loads(model_params)
+        except json.JSONDecodeError:
+            raise H2OError(400, f"params is not valid JSON: "
+                                f"{model_params!r}")
+    x = params.get("x")
+    if isinstance(x, str):
+        x = [c.strip() for c in x.split(",") if c.strip()]
+    pid = params.get("id") or str(Key.make("stream"))
+    cfg = None
+    if params.get("max_batch") or params.get("queue_cap"):
+        cfg = ServingConfig(
+            max_batch=_int(params, "max_batch", 32),
+            max_delay_ms=float(params.get("max_delay_ms", 2.0)),
+            queue_cap=_int(params, "queue_cap", 64),
+            deadline_ms=float(params.get("deadline_ms", 0.0)))
+    try:
+        reader = ChunkReader(
+            source,
+            chunk_rows=_int(params, "chunk_rows", None),
+            deadline_secs=float(params.get("deadline_secs", 0.0)))
+        pipe = start_pipeline(
+            pid, reader, y, x=x,
+            algo=params.get("algo", "gbm"),
+            model_params=model_params,
+            refresh_chunks=_int(params, "refresh_chunks", None),
+            trees_per_refresh=_int(params, "trees_per_refresh", 10),
+            alias=params.get("alias"),
+            dest_frame=params.get("dest_frame"),
+            recovery_dir=params.get("recovery_dir"),
+            lag_bound=_int(params, "lag_bound", None),
+            serve_config=cfg,
+            max_chunks=_int(params, "max_chunks", None))
+    except ValueError as e:
+        raise H2OError(400, str(e))
+    except FileNotFoundError as e:
+        raise H2OError(404, str(e))
+    return {"pipeline": pipe.status()}
+
+
+@route("GET", r"/3/Stream")
+def stream_list(params):
+    from h2o_tpu.stream import list_pipelines
+    return {"pipelines": [p.status() for p in list_pipelines()]}
+
+
+@route("GET", r"/3/Stream/(?P<pid>[^/]+)")
+def stream_get(params, pid):
+    from h2o_tpu.stream import get_pipeline
+    p = get_pipeline(pid)
+    if p is None:
+        raise H2OError(404, f"no stream pipeline named {pid}")
+    return {"pipeline": p.status()}
+
+
+@route("POST", r"/3/Stream/(?P<pid>[^/]+)/stop")
+def stream_stop(params, pid):
+    from h2o_tpu.stream import get_pipeline, stop_pipeline
+    if not stop_pipeline(pid):
+        raise H2OError(404, f"no stream pipeline named {pid}")
+    return {"pipeline": get_pipeline(pid).status()}
+
+
+@route("DELETE", r"/3/Stream/(?P<pid>[^/]+)")
+def stream_delete(params, pid):
+    from h2o_tpu.stream import get_pipeline
+    p = get_pipeline(pid)
+    if p is None:
+        raise H2OError(404, f"no stream pipeline named {pid}")
+    out = p.status()
+    from h2o_tpu.stream import stop_pipeline
+    stop_pipeline(pid, remove=True)
+    return out

@@ -94,12 +94,20 @@ class _Chaos:
             e("H2O_TPU_CHAOS_TRANSFER_SLOW_MS", 100) or 100)
         self.oom_p = float(e("H2O_TPU_CHAOS_OOM", 0) or 0)
         self.oom_transient = int(e("H2O_TPU_CHAOS_OOM_TRANSIENT", 0) or 0)
+        self.stream_truncate_p = float(
+            e("H2O_TPU_CHAOS_STREAM_TRUNCATE", 0) or 0)
+        self.stream_truncate_transient = int(
+            e("H2O_TPU_CHAOS_STREAM_TRUNCATE_TRANSIENT", 0) or 0)
+        self.stream_slow_p = float(e("H2O_TPU_CHAOS_STREAM_SLOW", 0) or 0)
+        self.stream_slow_ms = float(
+            e("H2O_TPU_CHAOS_STREAM_SLOW_MS", 100) or 100)
         seed = e("H2O_TPU_CHAOS_SEED")
         self._rng = np.random.default_rng(
             int(seed) if seed is not None else None)
         self._lock = threading.Lock()
         self._transient_seen: Dict[Tuple[str, str], int] = {}
         self._oom_seen: Dict[str, int] = {}
+        self._stream_seen: Dict[str, int] = {}
         self.injected = 0
         self.injected_jobs = 0
         self.injected_device_puts = 0
@@ -108,6 +116,8 @@ class _Chaos:
         self.injected_slow_scores = 0
         self.injected_slow_transfers = 0
         self.injected_oom = 0
+        self.injected_stream_truncations = 0
+        self.injected_slow_streams = 0
 
     @property
     def enabled(self) -> bool:
@@ -115,7 +125,9 @@ class _Chaos:
                 self.persist_p > 0 or self.persist_transient > 0 or
                 self.stall_p > 0 or self.score_slow_p > 0 or
                 self.transfer_slow_p > 0 or self.oom_p > 0 or
-                self.oom_transient > 0)
+                self.oom_transient > 0 or self.stream_truncate_p > 0 or
+                self.stream_truncate_transient > 0 or
+                self.stream_slow_p > 0)
 
     def counters(self) -> Dict[str, int]:
         """All injected-fault counters (the /3/Resilience chaos block).
@@ -127,7 +139,8 @@ class _Chaos:
                 "injected", "injected_jobs", "injected_device_puts",
                 "injected_persist", "injected_stalls",
                 "injected_slow_scores", "injected_slow_transfers",
-                "injected_oom")}
+                "injected_oom", "injected_stream_truncations",
+                "injected_slow_streams")}
 
     def _roll(self, p: float) -> bool:
         if p <= 0:
@@ -180,6 +193,44 @@ class _Chaos:
             raise ChaosOOMError(
                 f"injected device OOM at {site}: RESOURCE_EXHAUSTED "
                 f"(synthetic)")
+
+    def maybe_truncate_stream(self, source: str) -> None:
+        """Streaming-ingest truncation injector: a chunk read raises as
+        if the source was cut off mid-record — retried by the stream
+        reader's retry policy (ChaosIOError is an OSError, so it
+        classifies transient).  Transient mode fails the first N reads
+        of each distinct SOURCE then lets it through, proving the retry
+        loop absorbs exactly N faults (the persist-transient design)."""
+        if self.stream_truncate_transient > 0:
+            with self._lock:
+                n = self._stream_seen.get(source, 0)
+                if n < self.stream_truncate_transient:
+                    self._stream_seen[source] = n + 1
+                    self.injected += 1
+                    self.injected_stream_truncations += 1
+                else:
+                    return
+            log.warning("chaos: transient stream truncation %d/%d (%s)",
+                        n + 1, self.stream_truncate_transient, source)
+            raise ChaosIOError(
+                f"injected stream truncation {n + 1}/"
+                f"{self.stream_truncate_transient} ({source})")
+        if self._roll(self.stream_truncate_p):
+            with self._lock:
+                self.injected_stream_truncations += 1
+            log.warning("chaos: injecting stream truncation (%s)", source)
+            raise ChaosIOError(f"injected stream truncation ({source})")
+
+    def maybe_slow_stream(self, what: str = "stream") -> None:
+        """Slow-source injector: a chunk read stalls — the pipeline's
+        job heartbeat must keep beating (no watchdog expiry) and lag
+        accounting must reflect the stalled ingest."""
+        if self._roll(self.stream_slow_p):
+            with self._lock:
+                self.injected_slow_streams += 1
+            log.warning("chaos: slowing %s read by %.0fms", what,
+                        self.stream_slow_ms)
+            time.sleep(self.stream_slow_ms / 1000.0)
 
     def maybe_fail_persist(self, op: str, uri: str) -> None:
         """Persist-I/O injector: called once per ATTEMPT by the byte-store
@@ -258,10 +309,18 @@ def configure(job_p: float = 0.0, device_put_p: float = 0.0,
               score_slow_ms: float = 200.0,
               transfer_slow_p: float = 0.0,
               transfer_slow_ms: float = 100.0,
-              oom_p: float = 0.0, oom_transient: int = 0) -> _Chaos:
+              oom_p: float = 0.0, oom_transient: int = 0,
+              stream_truncate_p: float = 0.0,
+              stream_truncate_transient: int = 0,
+              stream_slow_p: float = 0.0,
+              stream_slow_ms: float = 100.0) -> _Chaos:
     """Programmatic enable (tests); returns the active instance."""
     global _instance
     _instance = _Chaos()
+    _instance.stream_truncate_p = float(stream_truncate_p)
+    _instance.stream_truncate_transient = int(stream_truncate_transient)
+    _instance.stream_slow_p = float(stream_slow_p)
+    _instance.stream_slow_ms = float(stream_slow_ms)
     _instance.job_p = float(job_p)
     _instance.device_put_p = float(device_put_p)
     _instance.persist_p = float(persist_p)

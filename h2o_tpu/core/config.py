@@ -52,7 +52,25 @@ owning modules, like the chaos flags, so they work before a cloud boots):
 - buffer donation: ``H2O_TPU_DONATE`` (the store's donation policy;
   default on-TPU-only — donating and non-donating variants are
   distinct store entries and OOM retries auto-route to the
-  non-donating twin).
+  non-donating twin);
+- streaming ingest + online refresh (h2o_tpu/stream — the
+  train-on-fresh-data pipeline: chunked parse -> append-able Frames ->
+  warm-start retrain -> serve-alias hot-swap):
+  ``H2O_TPU_STREAM_CHUNK_ROWS`` (target rows per ingest chunk, default
+  4096 — the byte budget per source read derives from the sampled mean
+  record length; chunk landings are pow2-shape-bucketed device block
+  writes, so same-sized chunks cost zero steady-state recompiles),
+  ``H2O_TPU_STREAM_REFRESH_CHUNKS`` (retrain cadence in chunks, default
+  5 — GBM/DRF checkpoint-resume new tree blocks, GLM warm-starts from
+  the previous beta), ``H2O_TPU_STREAM_LAG_BOUND`` (0 = unbounded;
+  chunks-landed minus chunks-trained above this flags the pipeline
+  ``lagging`` at GET /3/Stream and attaches a job warning), and the
+  stream chaos injectors ``H2O_TPU_CHAOS_STREAM_TRUNCATE``
+  (probability) / ``H2O_TPU_CHAOS_STREAM_TRUNCATE_TRANSIENT`` (fail
+  the first N reads of each source, then succeed — proves the retry
+  loop heals a truncated/flaky source) and
+  ``H2O_TPU_CHAOS_STREAM_SLOW`` + ``H2O_TPU_CHAOS_STREAM_SLOW_MS``
+  (stalled source reads).
 """
 
 from __future__ import annotations

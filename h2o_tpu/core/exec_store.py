@@ -106,7 +106,8 @@ def bucket_pow2(n: int) -> int:
 def aval_key(x) -> Tuple:
     """Hashable signature of one argument: shape/dtype/sharding for
     arrays (a resharded input is a different program), value for
-    hashable statics."""
+    hashable statics.  Containers (the DL layer-param pytrees, optimizer
+    states) recurse so a whole pytree argument keys on its leaf avals."""
     import jax
     import numpy as np
     if isinstance(x, jax.Array):
@@ -117,6 +118,12 @@ def aval_key(x) -> Tuple:
         return ("arr", x.shape, str(x.dtype), shard)
     if isinstance(x, np.ndarray):
         return ("np", x.shape, str(x.dtype))
+    if isinstance(x, (list, tuple)):
+        return ("seq", type(x).__name__,
+                tuple(aval_key(v) for v in x))
+    if isinstance(x, dict):
+        return ("dict", tuple((k, aval_key(v))
+                              for k, v in sorted(x.items())))
     return ("static", type(x).__name__, x)
 
 

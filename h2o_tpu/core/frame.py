@@ -53,6 +53,59 @@ def _row_pad(n: int) -> int:
     return ((n + q - 1) // q) * q
 
 
+def _append_capacity(n: int) -> int:
+    """Device-buffer capacity for ``n`` logical rows on the append path:
+    the power-of-two shape bucket (exec_store.bucket_pow2) padded to the
+    shard quantum, so a stream of appends revisits at most ~log2(N)
+    distinct buffer shapes — and therefore at most ~log2(N) compiled
+    kernels per verb (zero steady-state recompiles per chunk)."""
+    from h2o_tpu.core.exec_store import bucket_pow2
+    return _row_pad(bucket_pow2(max(int(n), 1)))
+
+
+def _merge_domains(base: Optional[List[str]], new: Optional[List[str]]):
+    """Union categorical domain (base levels keep their codes, new levels
+    append in first-seen order — the streaming analog of the multi-file
+    domain merge, ParseDataset.java:356-535) plus the remap array taking
+    ``new``-local codes into the union space (-1 stays -1)."""
+    union = list(base or [])
+    seen = {d: i for i, d in enumerate(union)}
+    remap = np.empty(len(new or []) + 1, np.int32)
+    remap[-1] = -1
+    for j, d in enumerate(new or []):
+        if d not in seen:
+            seen[d] = len(union)
+            union.append(d)
+        remap[j] = seen[d]
+    return union, remap
+
+
+# -- append kernels (phase "append", cached through the exec store: one
+#    compile per (capacity, chunk-bucket, dtype) — the pow2 buckets bound
+#    the program count logarithmically) ----------------------------------
+
+def _build_grow(cap_old: int, cap_new: int, fill_kind: str):
+    # fill_kind is a STRING marker ("nan" | "neg1"), not the value: a NaN
+    # inside a cache key never compares equal to itself, so it would
+    # defeat the kernel cache entirely
+    fill = float("nan") if fill_kind == "nan" else -1
+
+    def kern(buf):
+        pad = jnp.full((cap_new - cap_old,), fill, buf.dtype)
+        return jnp.concatenate([buf, pad])
+    return kern
+
+
+def _build_append_write(cap: int, ch: int):
+    def kern(buf, chunk, start, nvalid):
+        idx = jnp.arange(cap)
+        src = jnp.clip(idx - start, 0, ch - 1)
+        vals = jnp.take(chunk, src)
+        write = (idx >= start) & (idx < start + nvalid)
+        return jnp.where(write, vals, buf)
+    return kern
+
+
 @jax.jit
 def _rollups_matrix_kernel(matrix: jax.Array, nrows: jax.Array):
     """Fused single-pass rollup stats over ALL columns of a padded, sharded
@@ -303,6 +356,99 @@ class Vec:
         self._rollups = None
         self._hist = None
 
+    # -- streaming append (h2o_tpu/stream: append-able Vecs) ----------------
+
+    def _device_rows(self) -> int:
+        """Length of the device payload (or its parked host copy) — the
+        Vec's buffer CAPACITY, which exceeds ``_row_pad(nrows)`` once the
+        append path has grown it to a pow2 bucket.  0 for host-side
+        columns (T_STR/T_UUID, unmaterialized sparse)."""
+        with self._spill_lock:
+            if self._data is not None:
+                return int(self._data.shape[0])
+            if self._spill_np is not None:
+                return int(self._spill_np.shape[0])
+        return 0
+
+    def append(self, values, domain: Optional[List[str]] = None) -> None:
+        """Grow this Vec by ``values`` rows IN PLACE, landing the new rows
+        as one device block write — the existing payload is never pulled
+        to host (zero-host-pull, lint-enforced like the munge verbs).
+
+        The device buffer is sized in power-of-two capacity buckets
+        (``_append_capacity``) and new rows land via a cached
+        ``dynamic-update`` kernel keyed on (capacity, chunk-bucket), so a
+        steady stream of same-sized chunks costs ZERO recompiles after
+        the first; capacity growth re-allocates at the next bucket
+        (~log2(N) growths over a stream's lifetime).
+
+        ``values``: host array of new rows (float payload for T_NUM /
+        T_TIME epoch-ms; int codes for T_CAT).  ``domain`` gives the
+        chunk-LOCAL categorical domain; new levels extend this Vec's
+        domain and the chunk codes are remapped into the union space.
+        Cached rollups/histograms invalidate; callers holding the vec in
+        a Frame must clear that frame's matrix cache (Frame.append_rows
+        does)."""
+        if self.type in (T_STR, T_UUID):
+            self.host_data.extend(list(values))
+            self.nrows = len(self.host_data)
+            return
+        arr = np.asarray(values)
+        n_new = int(arr.shape[0])
+        if n_new == 0:
+            return
+        from h2o_tpu.core.diag import DispatchStats
+        from h2o_tpu.core.exec_store import cached_kernel
+        if self.type == T_CAT:
+            codes = arr.astype(np.int32)
+            if domain is not None and list(domain) != list(self.domain
+                                                          or []):
+                self.domain, remap = _merge_domains(self.domain, domain)
+                ok = (codes >= 0) & (codes < len(domain))
+                codes = np.where(ok, remap[np.clip(codes, 0,
+                                                   len(domain) - 1)],
+                                 -1).astype(np.int32)
+            chunk = codes
+            fill_kind = "neg1"
+        else:
+            if self.type == T_TIME:
+                if self._host_f64 is None:
+                    raise ValueError(
+                        "appending to a T_TIME vec that lost its exact "
+                        "float64 host copy would silently degrade "
+                        "time-part extraction to f32 precision")
+                self._host_f64 = np.concatenate(
+                    [self._host_f64[: self.nrows],
+                     arr.astype(np.float64)])
+            chunk = arr.astype(np.float32)
+            fill_kind = "nan"
+        old_n, new_n = self.nrows, self.nrows + n_new
+        cap = max(_append_capacity(new_n), self._device_rows() or 0)
+        ch = _append_capacity(n_new)
+        fill = np.nan if fill_kind == "nan" else -1
+        if ch > n_new:
+            chunk = np.concatenate(
+                [chunk, np.full(ch - n_new, fill, chunk.dtype)])
+        with DispatchStats.phase_scope("append"):
+            chunk_dev = cloud().device_put_rows(chunk)
+            buf = self.data            # spilled payloads reload here
+            assert buf is not None, "append needs a device payload"
+            cap_old = int(buf.shape[0])
+            if cap_old < cap:
+                grow = cached_kernel(
+                    "append", "grow", (cap_old, cap, fill_kind),
+                    lambda: _build_grow(cap_old, cap, fill_kind), buf)
+                buf = grow(buf)
+            write = cached_kernel(
+                "append", "write", (cap, ch, str(buf.dtype)),
+                lambda: _build_append_write(cap, ch), buf, chunk_dev,
+                jnp.int32(old_n), jnp.int32(n_new))
+            new = write(buf, chunk_dev, jnp.int32(old_n),
+                        jnp.int32(n_new))
+        self.nrows = new_n
+        self.data = new                # setter re-registers with the MM
+        self.invalidate()
+
     # -- in-place mutation (donating) --------------------------------------
 
     def map_inplace(self, fn, *extras) -> None:
@@ -413,6 +559,30 @@ class SparseVec(Vec):
         return self._densify_host()
 
 
+def _chunk_cols_from_frame(target: "Frame", chunk: "Frame") -> Dict:
+    """Host column payloads of a CHUNK frame, shaped for ``Vec.append``.
+    Deliberately outside the zero-host-pull append verbs: it reads only
+    the (small, freshly-staged) chunk — never the accumulated frame."""
+    if list(chunk.names) != list(target.names):
+        raise ValueError(
+            f"append_rows schema mismatch: frame has {target.names}, "
+            f"chunk has {chunk.names}")
+    cols: Dict = {}
+    for name, v in zip(chunk.names, chunk.vecs):
+        tv = target.vec(name)
+        if v.type != tv.type:
+            raise ValueError(
+                f"append_rows type mismatch on {name!r}: frame is "
+                f"{tv.type}, chunk is {v.type}")
+        if v.host_data is not None:
+            cols[name] = list(v.host_data)
+        elif v.type == T_CAT:
+            cols[name] = (v.to_numpy(), list(v.domain or []))
+        else:
+            cols[name] = v.to_numpy()
+    return cols
+
+
 def frame_device_ok(fr: "Frame") -> bool:
     """True when every column lives (or can live) on device with exact
     semantics: numeric/categorical payloads only.  T_TIME is excluded
@@ -476,7 +646,15 @@ class Frame:
 
     @property
     def padded_rows(self) -> int:
-        return _row_pad(self.nrows)
+        """Device row count of this frame's matrices.  Equals
+        ``_row_pad(nrows)`` for parse-built frames; once the append path
+        has grown a column into a pow2 capacity bucket, the bucket IS the
+        padded shape (rows beyond ``nrows`` are masked everywhere by the
+        row-validity predicate)."""
+        n = _row_pad(self.nrows)
+        for v in self.vecs:
+            n = max(n, v._device_rows())
+        return n
 
     def vec(self, name: str) -> Vec:
         return self.vecs[self.names.index(name)]
@@ -509,6 +687,44 @@ class Frame:
 
     def cbind(self, other: "Frame") -> "Frame":
         return Frame(self.names + other.names, self.vecs + other.vecs)
+
+    # -- streaming append ---------------------------------------------------
+
+    def append_rows(self, chunk) -> "Frame":
+        """Append a chunk of rows IN PLACE — the streaming-ingest landing
+        verb (h2o_tpu/stream).  ``chunk`` is either a dict of host column
+        payloads (``name -> ndarray`` for numeric/time, ``(codes,
+        domain)`` for categorical, ``list`` for strings — the zero-copy
+        form the chunk tokenizer emits) or another Frame with the same
+        schema.  Every column grows by the same row count via
+        ``Vec.append`` (pow2-bucketed device block writes, no host pull
+        of the existing payload); categorical domains merge; cached
+        rollups and the frame matrix cache invalidate."""
+        cols = chunk if isinstance(chunk, dict) else \
+            _chunk_cols_from_frame(self, chunk)
+        missing = [n for n in self.names if n not in cols]
+        if missing:
+            raise ValueError(f"append_rows chunk is missing columns "
+                             f"{missing}")
+        n_new = None
+        for name in self.names:
+            payload = cols[name]
+            vals, dom = (payload if isinstance(payload, tuple)
+                         else (payload, None))
+            n = len(vals)
+            if n_new is None:
+                n_new = n
+            elif n != n_new:
+                raise ValueError(
+                    f"ragged append chunk: column {name!r} has {n} rows, "
+                    f"expected {n_new}")
+        for name in self.names:
+            payload = cols[name]
+            vals, dom = (payload if isinstance(payload, tuple)
+                         else (payload, None))
+            self.vec(name).append(vals, domain=dom)
+        self._matrix_cache.clear()
+        return self
 
     def slice_rows(self, mask_or_idx) -> "Frame":
         """New Frame of the selected rows (the deep-slice/row-filter
@@ -550,7 +766,14 @@ class Frame:
         ck = (names, jnp.dtype(dtype).name)
         m = self._matrix_cache.get(ck)
         if m is None:
+            R = self.padded_rows
             cols = [self.vec(n).as_float() for n in names]
+            # appendable columns carry pow2 capacity; a column added
+            # AFTER appends (or a lazy sparse one) may be shorter — pad
+            # it to the frame's capacity so the stack stays rectangular
+            cols = [c if c.shape[0] == R else
+                    jnp.pad(c, (0, R - c.shape[0]),
+                            constant_values=jnp.nan) for c in cols]
             m = jnp.stack(cols, axis=1).astype(dtype)
             m = jax.device_put(m, cloud().matrix_sharding())
             self._matrix_cache[ck] = m

@@ -382,6 +382,115 @@ def _parse_native(paths: Sequence[str], setup: ParseSetupResult,
     return fr
 
 
+def tokenize_chunk(data: bytes, setup: ParseSetupResult,
+                   header: bool = False,
+                   use_native: bool = True) -> Dict[str, object]:
+    """Tokenize ONE streamed block of complete records (the
+    h2o_tpu/stream chunk-landing path): raw bytes -> host column
+    payloads shaped for ``Frame.append_rows`` — ``ndarray`` for
+    numeric/time, ``(codes, chunk-local domain)`` for categoricals,
+    ``list`` for strings.
+
+    Same byte-level tokenizer as the whole-file path (the native C++
+    loop when built, pandas' C engine otherwise) and the same NA/quote
+    semantics, so a chunked parse reassembles to exactly the rows
+    ``parse_files`` yields on the concatenated bytes (categorical CODES
+    may differ — streamed domains merge in first-seen order instead of
+    one global sort — but decoded labels are identical).
+    """
+    ncols = len(setup.column_names)
+    out: Dict[str, object] = {}
+    if not data.strip():
+        for name, t in zip(setup.column_names, setup.column_types):
+            out[name] = [] if t == T_STR else (
+                (np.empty(0, np.int32), []) if t == T_CAT
+                else np.empty(0, np.float64 if t == T_TIME
+                              else np.float32))
+        return out
+    from h2o_tpu import native
+    if use_native and native.available() and \
+            os.environ.get("H2O_TPU_NATIVE_PARSE", "1") != "0":
+        is_num = np.asarray([t in (T_NUM,) for t in setup.column_types],
+                            np.uint8)
+        nrows, num, soff, slen, squo = native.tokenize_csv(
+            data, setup.separator, ncols, is_num, setup.na_strings)
+        lo = 1 if header else 0
+        data_np = np.frombuffer(data, np.uint8)
+        num = num[lo:]
+        na_bytes = {s.encode() for s in setup.na_strings}
+        ni = si = 0
+        for j, name in enumerate(setup.column_names):
+            t = setup.column_types[j]
+            if t == T_NUM:
+                out[name] = num[:, ni].astype(np.float32)
+                ni += 1
+                continue
+            col = native.spans_to_fixed_bytes(
+                data_np, soff[lo:, si], slen[lo:, si])
+            quoted = squo[lo:, si].astype(bool)
+            si += 1
+            col = np.where(quoted, col, np.char.strip(col))
+            na_mask = np.isin(col, list(na_bytes)) & ~quoted
+            if t == T_TIME:
+                import pandas as pd
+                dt = _apply_cluster_tz(pd.to_datetime(
+                    pd.Series(col.astype("U")), errors="coerce"))
+                ms = dt.to_numpy().astype("datetime64[ms]").astype(
+                    "int64")
+                vals = np.where(pd.isna(dt).to_numpy(), np.nan,
+                                ms.astype(np.float64))
+                vals[na_mask] = np.nan
+                out[name] = vals
+            elif t == T_STR:
+                out[name] = [
+                    None if na else
+                    v.decode("utf-8", "replace").replace('""', '"')
+                    for v, na in zip(col, na_mask)]
+            else:
+                domain_b, codes = np.unique(col, return_inverse=True)
+                codes = codes.ravel()
+                keep = np.bincount(codes[~na_mask],
+                                   minlength=len(domain_b)) > 0
+                remap = np.full(len(domain_b), -1, np.int32)
+                remap[keep] = np.arange(int(keep.sum()), dtype=np.int32)
+                codes = remap[codes]
+                codes[na_mask] = -1
+                domain = [d.decode("utf-8", "replace").replace('""', '"')
+                          for d in domain_b[keep]]
+                out[name] = (codes.astype(np.int32), domain)
+        return out
+    import pandas as pd
+    df = pd.read_csv(
+        io.BytesIO(data), sep=setup.separator,
+        header=0 if header else None, names=setup.column_names,
+        na_values=list(setup.na_strings), keep_default_na=False,
+        skipinitialspace=True, engine="c", dtype=object)
+    for j, name in enumerate(setup.column_names):
+        col = df[name]
+        t = setup.column_types[j]
+        if t == T_NUM:
+            out[name] = pd.to_numeric(col,
+                                      errors="coerce").to_numpy(np.float32)
+        elif t == T_TIME:
+            dt = _apply_cluster_tz(pd.to_datetime(col, errors="coerce"))
+            ms = dt.to_numpy().astype("datetime64[ms]").astype("int64")
+            out[name] = np.where(pd.isna(dt).to_numpy(), np.nan,
+                                 ms.astype(np.float64))
+        elif t == T_STR:
+            out[name] = [None if v is None else str(v) for v in col]
+        else:
+            svals = col.astype("string")
+            mask = svals.isna().to_numpy()
+            arr = svals.fillna("").to_numpy(dtype=object)
+            domain = sorted(set(arr[~mask].tolist()))
+            lut = {d: i for i, d in enumerate(domain)}
+            codes = np.fromiter((lut.get(v, -1) for v in arr), np.int32,
+                                len(arr))
+            codes[mask] = -1
+            out[name] = (codes, domain)
+    return out
+
+
 def parse_files(paths: Sequence[str], setup: Optional[ParseSetupResult] = None,
                 dest: Optional[str] = None,
                 column_types: Optional[Dict[str, str]] = None,

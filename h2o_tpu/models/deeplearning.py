@@ -142,21 +142,36 @@ def _loss_fn(params, X, y, w, activation, nclass: int, dist_name: str,
     return loss
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("activation", "nclass", "dist_name", "n_steps",
-                     "batch", "nrows", "adaptive", "rho", "epsilon",
-                     "rate", "rate_annealing", "momentum_start",
-                     "momentum_stable", "momentum_ramp", "l1", "l2",
-                     "input_dropout", "hidden_dropout", "nesterov",
-                     "max_w2"))
-def train_block(params, opt_state, X, y, w, key, t0, *, activation: str,
-                nclass: int, dist_name: str, n_steps: int, batch: int,
-                nrows: int, adaptive: bool, rho: float, epsilon: float,
-                rate: float, rate_annealing: float, momentum_start: float,
-                momentum_stable: float, momentum_ramp: float, l1: float,
-                l2: float, input_dropout: float, hidden_dropout: float,
-                nesterov: bool = True, max_w2: float = 3.4e38):
+def train_block(params, opt_state, X, y, w, key, t0, **statics):
+    """Scanned optimizer block, routed through the unified executable
+    store UNDER THE OOM DEGRADATION LADDER (the still-open GLM/DL tail
+    of the PR 6 store migration): one executable per (statics, shape)
+    process-wide, AOT-persisted to ``H2O_TPU_EXEC_STORE_DIR``, and a
+    RESOURCE_EXHAUSTED dispatch sweeps the HBM LRU and retries before it
+    can fail the job — a streaming refresh retrain degrades instead of
+    dying."""
+    from h2o_tpu.core.exec_store import (aval_key, code_fingerprint,
+                                         exec_store)
+    skey = tuple(sorted(statics.items()))
+    args = (params, opt_state, X, y, w, key, t0)
+    cache_key = ("dl", "train_block", skey,
+                 tuple(aval_key(a) for a in args))
+    return exec_store().dispatch(
+        "dl.solver", cache_key,
+        lambda: functools.partial(_train_block_impl, **statics),
+        args, site="dl.train_block",
+        persist=f"dl:train_block:{skey!r}",
+        content=code_fingerprint(_train_block_impl))
+
+
+def _train_block_impl(params, opt_state, X, y, w, key, t0, *,
+                      activation: str,
+                      nclass: int, dist_name: str, n_steps: int, batch: int,
+                      nrows: int, adaptive: bool, rho: float, epsilon: float,
+                      rate: float, rate_annealing: float, momentum_start: float,
+                      momentum_stable: float, momentum_ramp: float, l1: float,
+                      l2: float, input_dropout: float, hidden_dropout: float,
+                      nesterov: bool = True, max_w2: float = 3.4e38):
     """N optimizer steps as ONE dispatch (lax.scan over steps).
 
     The reference's per-row Hogwild updates amortize dispatch by being

@@ -213,14 +213,42 @@ def _family(name: str, tweedie_power=1.5, theta=1.0) -> _Family:
 # distributed Gram + IRLSM working response (the GLMIterationTask)
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("fam_name", "theta"))
+def _solver_dispatch(name: str, impl, args, statics: Dict, site: str,
+                     content_fn=None):
+    """Route one GLM solver data pass through the unified executable
+    store (core/exec_store.py) and UNDER THE OOM DEGRADATION LADDER —
+    the still-open tail of the PR 6 store migration.  The store owns the
+    jit (statics bind via ``functools.partial``, so one executable per
+    (statics, shape) process-wide), AOT-serializes the pass to disk
+    (``H2O_TPU_EXEC_STORE_DIR`` — a restarted refresh loop warms its
+    solver kernels), and a RESOURCE_EXHAUSTED dispatch sweeps the HBM
+    LRU and retries instead of failing the retrain job outright — a
+    streaming refresh degrades, it does not die."""
+    from h2o_tpu.core.exec_store import (aval_key, code_fingerprint,
+                                         exec_store)
+    skey = tuple(sorted(statics.items()))
+    key = ("glm", name, skey, tuple(aval_key(a) for a in args))
+    return exec_store().dispatch(
+        "glm.solver", key, lambda: functools.partial(impl, **statics),
+        args, site=site, persist=f"glm:{name}:{skey!r}",
+        content=code_fingerprint(content_fn or impl))
+
+
 def _irlsm_pass(X, y, w, valid, beta, fam_name: str, tweedie_power=1.5,
                 theta=1.0):
-    """One data pass: weighted Gram [X,1]'W[X,1] and [X,1]'Wz.
+    """One data pass: weighted Gram [X,1]'W[X,1] and [X,1]'Wz — the
+    GLM analog of the tree block dispatch, routed through the exec
+    store + OOM ladder (see ``_solver_dispatch``)."""
+    return _solver_dispatch(
+        "irlsm_pass", _irlsm_pass_impl,
+        (X, y, w, valid, beta, jnp.float32(tweedie_power)),
+        dict(fam_name=fam_name, theta=float(theta)), site="glm.irlsm")
 
-    Returns (G, q) with the intercept folded in as the last column; XLA
-    turns the einsums into MXU matmuls + ICI psum over the row sharding.
-    """
+
+def _irlsm_pass_impl(X, y, w, valid, beta, tweedie_power, *,
+                     fam_name: str, theta: float):
+    """Raw traced body (the store jits it).  XLA turns the einsums into
+    MXU matmuls + ICI psum over the row sharding."""
     fam = _family(fam_name, tweedie_power, theta)
     y = jnp.where(valid, y, 0.0)
     w = jnp.where(valid, w, 0.0)
@@ -282,11 +310,18 @@ def _cod_solve(G, q, beta0, lam_l1, lam_l2, n_sweeps: int = 50,
     return beta
 
 
-@functools.partial(jax.jit, static_argnames=("fam_name", "theta"))
 def _deviance_at(X, y, w, valid, beta, fam_name: str, tweedie_power=1.5,
                  theta=1.0):
     """Deviance of a fixed beta on a (possibly held-out) data split — the
     lambda-path selection criterion (GLM.java lambda search scoring)."""
+    return _solver_dispatch(
+        "deviance_at", _deviance_at_impl,
+        (X, y, w, valid, beta, jnp.float32(tweedie_power)),
+        dict(fam_name=fam_name, theta=float(theta)), site="glm.deviance")
+
+
+def _deviance_at_impl(X, y, w, valid, beta, tweedie_power, *,
+                      fam_name: str, theta: float):
     fam = _family(fam_name, tweedie_power, theta)
     y = jnp.where(valid, y, 0.0)
     w = jnp.where(valid, w, 0.0)
@@ -391,28 +426,31 @@ def _glm_obj(params, X, yz, wz, l2, pen, fam_name: str, tweedie_power,
     return val
 
 
-_glm_value_grad = functools.partial(
-    jax.jit, static_argnames=("fam_name", "tweedie_power", "theta",
-                              "n_icpt"))(jax.value_and_grad(_glm_obj))
+_glm_value_grad_raw = jax.value_and_grad(_glm_obj)
 
 
 def _glm_objective_fn(X, yv, w, valid_m, fam_name: str, tweedie_power,
                       theta, l2, pen=None, n_icpt: int = 1):
-    """Penalized GLM objective closure for L-BFGS: routes through the
-    module-level jitted ``_glm_value_grad`` (one compile per family and
-    shape — the re-jit-per-call of the old inline ``jax.jit(jax.
-    value_and_grad(obj))`` is gone).  ``pen`` is an optional quadratic
-    penalty matrix in Gram units (GAM curvature).  For multinomial pass
-    the flat (K*(P+1),) params with n_icpt=K — softmax NLL."""
+    """Penalized GLM objective closure for L-BFGS: every evaluation is a
+    store-routed dispatch of the module-level value-and-grad body (one
+    executable per (family, shape) process-wide, AOT-persisted) running
+    under the OOM ladder — a quasi-Newton refresh retrain degrades
+    through LRU sweeps instead of dying on RESOURCE_EXHAUSTED.  ``pen``
+    is an optional quadratic penalty matrix in Gram units (GAM
+    curvature).  For multinomial pass the flat (K*(P+1),) params with
+    n_icpt=K — softmax NLL."""
     yz = jnp.where(valid_m, jnp.nan_to_num(yv), 0.0)
     wz = jnp.where(valid_m, w, 0.0)
     l2t = jnp.float32(l2)
+    statics = dict(fam_name=fam_name,
+                   tweedie_power=float(tweedie_power),
+                   theta=float(theta), n_icpt=int(n_icpt))
 
     def value_and_grad(x):
-        f, g = _glm_value_grad(jnp.asarray(x, jnp.float32), X, yz, wz,
-                               l2t, pen, fam_name=fam_name,
-                               tweedie_power=float(tweedie_power),
-                               theta=float(theta), n_icpt=int(n_icpt))
+        f, g = _solver_dispatch(
+            "value_grad", _glm_value_grad_raw,
+            (jnp.asarray(x, jnp.float32), X, yz, wz, l2t, pen),
+            statics, site="glm.lbfgs", content_fn=_glm_obj)
         return f, np.asarray(g)
     return value_and_grad
 
@@ -430,16 +468,23 @@ def _ordinal_unpack(params, P: int, K: int):
     return beta, thr
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("P", "K", "steps", "has_pen", "has_proj"))
 def _ordinal_gd(params0, X, yk, wa, n_obs, l1, l2, pen_dev, proj_mask, *,
                 P: int, K: int, steps: int, has_pen: bool,
                 has_proj: bool):
-    """Full-batch Adam on the exact cumulative-logit likelihood.
-    Module-level jitted (lambda strengths are runtime args): repeated
-    ordinal fits with the same shape share one executable instead of
-    re-jitting a per-fit closure."""
+    """Full-batch Adam on the exact cumulative-logit likelihood, routed
+    through the exec store + OOM ladder like the other solver passes
+    (lambda strengths are runtime args: repeated ordinal fits with the
+    same shape share one executable)."""
+    return _solver_dispatch(
+        "ordinal_gd", _ordinal_gd_impl,
+        (params0, X, yk, wa, n_obs, l1, l2, pen_dev, proj_mask),
+        dict(P=P, K=K, steps=steps, has_pen=has_pen, has_proj=has_proj),
+        site="glm.ordinal")
+
+
+def _ordinal_gd_impl(params0, X, yk, wa, n_obs, l1, l2, pen_dev,
+                     proj_mask, *, P: int, K: int, steps: int,
+                     has_pen: bool, has_proj: bool):
     import optax
 
     opt = optax.adam(optax.exponential_decay(0.5, steps // 4, 0.3))
@@ -1100,6 +1145,18 @@ class GLM(ModelBuilder):
             jnp.where(valid_m, jnp.nan_to_num(yv), 0.0),
             jnp.full_like(yv, mu0), wa))
         extra = dict(null_deviance=null_dev)
+
+        # online-refresh warm start (h2o_tpu/stream): seed the solve from
+        # the previous refresh's solution — IRLSM/L-BFGS reconverge in a
+        # handful of passes from a near-optimal beta.  A shape mismatch
+        # (appended rows introduced new categorical levels, widening the
+        # expansion) silently falls back to the cold start.
+        warm = p.get("_warm_start_beta")
+        if warm is not None:
+            warm = np.asarray(warm, np.float32)
+            if warm.shape == (P + 1,) and np.all(np.isfinite(warm)):
+                beta = jnp.asarray(warm)
+                extra["warm_started"] = True
 
         search = bool(p.get("lambda_search"))
         first_pass = None

@@ -233,6 +233,12 @@ class ServingRegistry:
         dep = self._get(name)
         if dep.draining:
             raise KeyError(f"deployment {name} is draining")
+        if dep.active is None:
+            # first-deploy window: the alias row exists (the batcher is
+            # being wired) but no version has been activated yet — a
+            # request here must 404 like an unknown alias, not reach the
+            # scorer and 500 on a None version
+            raise KeyError(f"deployment {name} has no active version yet")
         st = dep.stats
         with st.lock:
             st.requests += 1
@@ -268,6 +274,11 @@ class ServingRegistry:
         version once, encode every request's rows against it, one device
         dispatch."""
         ver = dep.active
+        if ver is None:
+            # belt-and-braces for the same first-deploy window: a batch
+            # admitted just before the None-active check landed
+            raise KeyError(
+                f"deployment {dep.name} has no active version yet")
         X = self.engine.encode_rows(ver.model, ver.version, rows)
         return self.engine.predict(ver.model, ver.version, X)
 

@@ -1,0 +1,32 @@
+"""Streaming ingest + online model refresh (h2o_tpu/stream).
+
+H2O-3's killer workflow is train-on-fresh-data: data lands continuously,
+models retrain incrementally, and the serving tier always scores with
+the latest model.  This package composes three existing subsystems —
+chunked parse (core/parse.py), iteration checkpoints (core/recovery.py)
+and the serve registry (serve/registry.py) — into that continuous
+pipeline:
+
+- :class:`ChunkReader` (ingest.py): incremental, quote-aware CSV
+  chunking with retry/deadline wiring and chaos injectors for
+  truncated/slow sources; chunks land on the growing Frame via the
+  append path (``Frame.append_rows`` — pow2-bucketed device block
+  writes, zero steady-state recompiles, zero host pulls of the
+  accumulated payload);
+- :class:`StreamPipeline` (refresh.py): the refresh driver — ingest
+  chunks, retrain on a cadence (GBM/DRF add tree blocks via the
+  ``checkpoint`` resume path; GLM warm-starts from the previous
+  solution), validate, and hot-swap the new version behind a stable
+  serve alias so ``/score`` tracks fresh data with no downtime;
+- REST: ``POST/GET/DELETE /3/Stream`` (api/handlers_stream.py) starts /
+  monitors (lag = chunks landed - chunks trained) / stops a pipeline.
+"""
+
+from h2o_tpu.stream.ingest import ChunkReader, last_record_end
+from h2o_tpu.stream.refresh import (StreamPipeline, get_pipeline,
+                                    list_pipelines, start_pipeline,
+                                    stop_pipeline)
+
+__all__ = ["ChunkReader", "last_record_end", "StreamPipeline",
+           "start_pipeline", "get_pipeline", "list_pipelines",
+           "stop_pipeline"]

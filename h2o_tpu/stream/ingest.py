@@ -1,0 +1,256 @@
+"""Streaming parse: incremental chunked CSV ingest.
+
+Reference: the whole-file path (core/parse.py) mirrors
+``ParseDataset``'s two-pass design and assumes the file is staged on
+host in full.  Streaming ingest keeps the SAME setup inference and the
+SAME byte tokenizer (the native C++ loop via ``parse.tokenize_chunk``,
+pandas fallback) but reads the source in bounded blocks and lands each
+block's column payloads directly on the growing device-resident Frame
+(``Frame.append_rows`` — pow2-bucketed block writes, no whole-file host
+staging, no host pull of the accumulated payload).
+
+CHUNK-BOUNDARY CORRECTNESS: a read block may end mid-record — including
+inside a QUOTED field that itself contains newlines (or a CRLF split
+between the CR and LF).  :func:`last_record_end` scans the buffered
+bytes with quote-parity tracking and returns the end of the last
+COMPLETE record; the tail is carried into the next block, so a chunked
+parse is record-identical to the whole-file parse no matter where the
+block boundaries fall (the parity test sweeps a split point across a
+quoted multi-line record).
+
+RESILIENCE: every source read runs under the process retry policy
+(core/resilience.py — backoff + deadline) with the stream chaos
+injectors live (``H2O_TPU_CHAOS_STREAM_TRUNCATE[_TRANSIENT]`` raises a
+retryable truncation, ``H2O_TPU_CHAOS_STREAM_SLOW[_MS]`` stalls the
+read), so a flaky tail -f-style source degrades to retries instead of
+killing the pipeline.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from h2o_tpu.core.log import get_logger
+from h2o_tpu.core.parse import (ParseSetupResult, localize, parse_setup,
+                                tokenize_chunk)
+from h2o_tpu.core.resilience import Deadline, default_policy
+
+log = get_logger("stream")
+
+# H2O_TPU_STREAM_CHUNK_ROWS: target rows per ingest chunk (the byte
+# budget per read is derived from the sampled mean record length)
+DEFAULT_CHUNK_ROWS = 4096
+
+
+def stream_chunk_rows() -> int:
+    return int(os.environ.get("H2O_TPU_STREAM_CHUNK_ROWS",
+                              DEFAULT_CHUNK_ROWS) or DEFAULT_CHUNK_ROWS)
+
+
+def last_record_end(buf: bytes, quote: int = 0x22) -> int:
+    """Offset just past the LAST complete record in ``buf`` (0 when no
+    record is complete yet).
+
+    Quote-parity scan: a newline inside an open quoted field is DATA,
+    not a record boundary — the classic chunk-boundary bug this function
+    exists to prevent (an escaped ``""`` toggles parity twice, so it
+    needs no special case).  A trailing ``\\r`` is kept with its record
+    tail, so a CRLF split between blocks stitches correctly: the
+    boundary is only ever declared after the ``\\n``.
+    """
+    in_q = False
+    end = 0
+    for i, b in enumerate(buf):
+        if b == quote:
+            in_q = not in_q
+        elif b == 0x0A and not in_q:        # \n at quote depth 0
+            end = i + 1
+    return end
+
+
+class ChunkReader:
+    """Incremental CSV reader: bounded byte blocks in, complete-record
+    column chunks out.
+
+    ``source`` is a local path / remote URI (fetched through the persist
+    layer via ``localize``), an open binary file object, or an iterator
+    of byte blocks (the test harness's split-sweep source).  ``setup``
+    defaults to ``parse_setup`` inference on the source's head sample.
+    ``deadline_secs`` bounds the TOTAL ingest wall clock (0 = unbounded).
+    """
+
+    def __init__(self, source, setup: Optional[ParseSetupResult] = None,
+                 chunk_rows: Optional[int] = None,
+                 chunk_bytes: Optional[int] = None,
+                 use_native: bool = True,
+                 deadline_secs: float = 0.0):
+        self.use_native = use_native
+        self._carry = b""
+        self._eof = False
+        self._first = True
+        self.chunks_read = 0
+        self.rows_read = 0
+        self.deadline = Deadline(deadline_secs)
+        self._iter: Optional[Iterator[bytes]] = None
+        self._fobj = None
+        if isinstance(source, (str, os.PathLike)):
+            self.name = str(source)
+            self._fobj = open(localize(str(source)), "rb")
+        elif hasattr(source, "read"):
+            self.name = getattr(source, "name", "<stream>")
+            self._fobj = source
+        else:
+            self.name = "<blocks>"
+            self._iter = iter(source)
+        self.setup = setup if setup is not None else self._sniff_setup()
+        rows = int(chunk_rows or stream_chunk_rows())
+        if chunk_bytes is not None:
+            self.chunk_bytes = int(chunk_bytes)
+        else:
+            # byte budget from the sampled mean record length so a chunk
+            # lands ~chunk_rows rows (exact row counts do not matter —
+            # the append path buckets them anyway)
+            sample = self._peek()
+            recs = max(sample.count(b"\n"), 1)
+            self.chunk_bytes = max(
+                256, rows * max(len(sample) // recs, 8))
+
+    # -- source plumbing -----------------------------------------------------
+
+    def _peek(self, n: int = 65536) -> bytes:
+        """Buffer up to ``n`` bytes into the carry (setup sniffing /
+        record-length estimation) without consuming records."""
+        while len(self._carry) < n and not self._eof:
+            block = self._read_block(n - len(self._carry))
+            if not block:
+                break
+            self._carry += block
+        return self._carry
+
+    def _sniff_setup(self) -> ParseSetupResult:
+        import tempfile
+        head = self._peek()
+        if not head:
+            raise ValueError(f"empty stream source: {self.name}")
+        fd, tmp = tempfile.mkstemp(suffix=".csv")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                # sniff only complete lines (a torn tail token would
+                # corrupt type inference)
+                f.write(head[: last_record_end(head) or len(head)])
+            return parse_setup([tmp])
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def _read_block(self, n: int) -> bytes:
+        """One source read under the retry policy with the stream chaos
+        injectors live — a truncated/flaky source retries with backoff
+        instead of failing the pipeline."""
+        def attempt() -> bytes:
+            from h2o_tpu.core.chaos import chaos
+            c = chaos()
+            if c.enabled:
+                c.maybe_slow_stream(self.name)
+                c.maybe_truncate_stream(self.name)
+            if self._fobj is not None:
+                return self._fobj.read(n)
+            try:
+                return next(self._iter)
+            except StopIteration:
+                return b""
+
+        data = default_policy().call(
+            attempt, what=f"stream read {self.name}",
+            deadline=self.deadline if self.deadline.seconds else None)
+        if not data:
+            self._eof = True
+        return data or b""
+
+    # -- chunk iteration -----------------------------------------------------
+
+    def next_chunk(self) -> Optional[Dict[str, object]]:
+        """The next chunk of COMPLETE records as host column payloads
+        (``Frame.append_rows`` shape), or None at end of stream."""
+        self.deadline.check(f"stream ingest {self.name}")
+        records = b""
+        while True:
+            if self._carry and (self._eof or
+                                len(self._carry) >= self.chunk_bytes):
+                # bound the chunk at chunk_bytes, backing up to the last
+                # complete record; a single record longer than the
+                # window (one huge quoted field) widens to the full
+                # carry before giving up and reading more
+                window = self._carry[: self.chunk_bytes]
+                end = last_record_end(window)
+                if end == 0:
+                    end = last_record_end(self._carry)
+                if end > 0:
+                    records = self._carry[:end]
+                    self._carry = self._carry[end:]
+                    break
+            if self._eof:
+                # torn tail: the final record may lack its newline
+                records, self._carry = self._carry, b""
+                break
+            self._carry += self._read_block(self.chunk_bytes)
+        if not records.strip():
+            return None
+        header = self._first and self.setup.header
+        self._first = False
+        cols = tokenize_chunk(records, self.setup, header=header,
+                              use_native=self.use_native)
+        n = _chunk_len(cols)
+        self.chunks_read += 1
+        self.rows_read += n
+        log.debug("stream %s: chunk %d (%d rows, %d bytes carried)",
+                  self.name, self.chunks_read, n, len(self._carry))
+        return cols
+
+    def __iter__(self):
+        while True:
+            c = self.next_chunk()
+            if c is None:
+                return
+            yield c
+
+    def close(self) -> None:
+        if self._fobj is not None:
+            try:
+                self._fobj.close()
+            except OSError:
+                pass
+
+
+def _chunk_len(cols: Dict[str, object]) -> int:
+    for payload in cols.values():
+        vals = payload[0] if isinstance(payload, tuple) else payload
+        return len(vals)
+    return 0
+
+
+def frame_from_chunk(cols: Dict[str, object], setup: ParseSetupResult,
+                     key: Optional[str] = None):
+    """First-chunk landing: build the (appendable) Frame the remaining
+    chunks grow into.  Column order follows the parse setup."""
+    from h2o_tpu.core.frame import Frame, T_CAT, T_STR, T_TIME, Vec
+    names, vecs = [], []
+    for name, t in zip(setup.column_names, setup.column_types):
+        payload = cols[name]
+        names.append(name)
+        if t == T_CAT:
+            codes, domain = payload
+            vecs.append(Vec(np.asarray(codes, np.int32), T_CAT,
+                            domain=list(domain)))
+        elif t == T_STR:
+            vecs.append(Vec(list(payload), T_STR))
+        elif t == T_TIME:
+            vecs.append(Vec(np.asarray(payload, np.float64), T_TIME))
+        else:
+            vecs.append(Vec(np.asarray(payload, np.float32)))
+    return Frame(names, vecs, key=key)

@@ -1,0 +1,357 @@
+"""Online model refresh: retrain on a cadence, hot-swap the serve alias.
+
+The refresh driver closes the loop the ROADMAP calls train-on-fresh-
+data: a :class:`StreamPipeline` ingests chunks (stream/ingest.py) onto
+an append-able Frame, and every ``refresh_chunks`` chunks retrains the
+model WARM:
+
+- **GBM / DRF / XGBoost**: the new version checkpoint-resumes the
+  previous one (``checkpoint`` param — the SharedTree resume path), so
+  each refresh only adds ``trees_per_refresh`` tree blocks on the grown
+  frame.  Absolute-tree-index RNG keys (PR 5) make the refreshed forest
+  bitwise-identical to a manual checkpoint-resume replay over the same
+  appends.
+- **GLM**: each refresh re-solves, warm-started from the previous beta
+  (``_warm_start_beta`` — IRLSM/L-BFGS converge in a handful of passes
+  from a near-optimal start).
+
+Each refresh runs as a normal core/job.py job body — under the OOM
+degradation ladder at every dispatch choke point — and, when a
+``recovery_dir`` is set, checkpoints per tree block via
+core/recovery.py: a refresh killed MID-BLOCK resumes from the last
+checkpoint on the next cadence while the serve alias keeps serving the
+previous version (the hot-swap only happens after a refresh completes
+AND validates).
+
+Hot-swap: ``ServingRegistry.deploy`` to the stable alias (in-flight
+micro-batches drain on their version; the swap is atomic under the
+deployment lock).  A refresh whose validation fails is NOT deployed —
+the alias keeps the previous version and the failure is surfaced in the
+pipeline status (the rollback-on-failed-validation contract).
+
+Lag accounting: ``lag = chunks_landed - chunks_trained`` is reported at
+``GET /3/Stream``; ``H2O_TPU_STREAM_LAG_BOUND`` (0 = unbounded) flags
+the pipeline ``lagging`` and attaches a job warning when exceeded
+(e.g. when refreshes keep failing while ingest continues).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from h2o_tpu.core.diag import TimeLine
+from h2o_tpu.core.job import Job
+from h2o_tpu.core.log import get_logger
+from h2o_tpu.stream.ingest import ChunkReader, frame_from_chunk
+
+log = get_logger("stream")
+
+DEFAULT_REFRESH_CHUNKS = 5
+
+# algos whose refresh rides the tree checkpoint-resume path
+_TREE_ALGOS = ("gbm", "drf", "xgboost")
+
+
+def stream_refresh_chunks() -> int:
+    return int(os.environ.get("H2O_TPU_STREAM_REFRESH_CHUNKS",
+                              DEFAULT_REFRESH_CHUNKS) or
+               DEFAULT_REFRESH_CHUNKS)
+
+
+def stream_lag_bound() -> int:
+    return int(os.environ.get("H2O_TPU_STREAM_LAG_BOUND", 0) or 0)
+
+
+def _default_validate(model) -> bool:
+    """Deploy gate: the refreshed model's training metrics must be
+    finite (a diverged refresh must never reach the alias)."""
+    mm = model.output.get("training_metrics")
+    data = getattr(mm, "data", None) or {}
+    for k in ("mse", "logloss", "mean_residual_deviance"):
+        v = data.get(k)
+        if isinstance(v, (int, float)):
+            return math.isfinite(float(v))
+    return True
+
+
+class StreamPipeline:
+    """One continuous ingest -> append -> warm retrain -> hot-swap loop,
+    tracked as a core/job.py job (cancellable, watchdogged, observable
+    at GET /3/Stream)."""
+
+    def __init__(self, pipeline_id: str, reader: ChunkReader, y: str,
+                 x: Optional[List[str]] = None, algo: str = "gbm",
+                 model_params: Optional[Dict[str, Any]] = None,
+                 refresh_chunks: Optional[int] = None,
+                 trees_per_refresh: int = 10,
+                 alias: Optional[str] = None,
+                 dest_frame: Optional[str] = None,
+                 recovery_dir: Optional[str] = None,
+                 lag_bound: Optional[int] = None,
+                 validate_fn: Optional[Callable[[Any], bool]] = None,
+                 serve_config=None,
+                 max_chunks: Optional[int] = None):
+        self.id = pipeline_id
+        self.reader = reader
+        self.y = y
+        self.x = x
+        self.algo = algo.lower()
+        self.model_params = dict(model_params or {})
+        self.refresh_chunks = int(refresh_chunks or
+                                  stream_refresh_chunks())
+        self.trees_per_refresh = int(trees_per_refresh)
+        self.alias = alias
+        self.dest_frame = dest_frame or f"{pipeline_id}_frame"
+        self.recovery_dir = recovery_dir
+        self.lag_bound = stream_lag_bound() if lag_bound is None \
+            else int(lag_bound)
+        self.validate_fn = validate_fn or _default_validate
+        self.serve_config = serve_config
+        self.max_chunks = max_chunks
+
+        self.frame = None
+        self.model = None
+        self.chunks_landed = 0
+        self.rows_landed = 0
+        self.chunks_trained = 0
+        self.refreshes = 0
+        self.failed_refreshes = 0
+        self.skipped_swaps = 0
+        self.last_error: Optional[str] = None
+        self.versions: List[Dict[str, Any]] = []
+        self.swap_ms: List[float] = []
+        self.lagging = False
+        self.job: Optional[Job] = None
+        self._lock = threading.Lock()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> Job:
+        from h2o_tpu.core.cloud import cloud
+        job = Job(dest=self.dest_frame,
+                  description=f"stream pipeline {self.id} "
+                              f"({self.algo} -> {self.alias or 'no alias'})")
+        self.job = job
+        cloud().jobs.start(job, self._run)
+        return job
+
+    def stop(self) -> None:
+        if self.job is not None:
+            self.job.cancel()
+
+    # -- the loop ------------------------------------------------------------
+
+    def _run(self, job: Job):
+        try:
+            for cols in self.reader:
+                self._land(job, cols)
+                if self.max_chunks and self.chunks_landed >= \
+                        self.max_chunks:
+                    break
+                if self.chunks_landed - self.chunks_trained >= \
+                        self.refresh_chunks:
+                    self._refresh(job)
+                self._check_lag(job)
+            # drain: one final refresh over any untrained tail
+            if self.frame is not None and \
+                    self.chunks_trained < self.chunks_landed:
+                self._refresh(job)
+            job.update(1.0, f"stream done: {self.chunks_landed} chunks, "
+                            f"{self.refreshes} refreshes")
+            return self.frame
+        finally:
+            self.reader.close()
+
+    def _land(self, job: Job, cols) -> None:
+        """Chunk landing: append the tokenized columns onto the growing
+        device frame (pow2-bucketed block writes — zero host pulls of
+        the accumulated payload, zero steady-state recompiles)."""
+        from h2o_tpu.core.cloud import cloud
+        if self.frame is None:
+            self.frame = frame_from_chunk(cols, self.reader.setup,
+                                          key=self.dest_frame)
+            cloud().dkv.put(self.frame.key, self.frame)
+        else:
+            self.frame.append_rows(cols)
+        self.chunks_landed += 1
+        self.rows_landed = self.frame.nrows
+        TimeLine.record("stream", "chunk_landed", pipeline=self.id,
+                        chunk=self.chunks_landed, rows=self.frame.nrows)
+        job.update(min(0.95, 0.9 * self.chunks_trained /
+                       max(self.chunks_landed, 1)),
+                   f"{self.chunks_landed} chunks / {self.frame.nrows} "
+                   f"rows landed, lag {self.lag}")
+
+    # -- refresh -------------------------------------------------------------
+
+    def _builder(self):
+        """The next version's warm-started builder."""
+        from h2o_tpu.models.registry import builder_class
+        cls = builder_class(self.algo)
+        params = dict(self.model_params)
+        params.pop("model_id", None)
+        version = self.refreshes + 1
+        model_id = f"{self.id}_v{version}"
+        if self.algo in _TREE_ALGOS:
+            prior = int(self.model.output["ntrees_actual"]) \
+                if self.model is not None else 0
+            params["ntrees"] = prior + self.trees_per_refresh
+            if self.model is not None:
+                params["checkpoint"] = str(self.model.key)
+        if self.recovery_dir:
+            params["recovery_dir"] = self.recovery_dir
+        b = cls(model_id=model_id, **params)
+        if self.algo == "glm" and self.model is not None and \
+                self.model.output.get("beta") is not None:
+            b.params["_warm_start_beta"] = np.asarray(
+                self.model.output["beta"])
+        return b, model_id, version
+
+    def _refresh(self, job: Job) -> None:
+        """One warm retrain + validate + hot-swap round.  A failure
+        (injected fault, OOM ladder exhaustion, mid-block kill) is
+        absorbed: the alias keeps serving the previous version and the
+        next cadence retries — with ``recovery_dir`` set, the retry
+        RESUMES from the last per-block checkpoint instead of starting
+        over."""
+        target = self.chunks_landed
+        b, model_id, version = self._builder()
+        job.update(job.progress,
+                   f"refresh v{version} on {self.frame.nrows} rows")
+        t0 = time.monotonic()
+        try:
+            model = b.train(x=self.x, y=self.y,
+                            training_frame=self.frame)
+        except BaseException as e:  # noqa: BLE001 — pipeline survives
+            self.failed_refreshes += 1
+            self.last_error = f"{type(e).__name__}: {e}"
+            log.warning("stream %s: refresh v%d failed (%s) — alias "
+                        "keeps the previous version", self.id, version,
+                        self.last_error)
+            TimeLine.record("stream", "refresh_failed", pipeline=self.id,
+                            version=version, error=type(e).__name__)
+            return
+        train_s = time.monotonic() - t0
+        if not self.validate_fn(model):
+            self.skipped_swaps += 1
+            self.last_error = f"validation failed for {model_id}"
+            log.warning("stream %s: v%d failed validation — not "
+                        "deployed, alias keeps the previous version",
+                        self.id, version)
+            TimeLine.record("stream", "swap_skipped", pipeline=self.id,
+                            version=version)
+            return
+        swap_t0 = time.monotonic()
+        if self.alias:
+            from h2o_tpu.serve.registry import registry
+            registry().deploy(self.alias, model,
+                              config=self.serve_config)
+            self.swap_ms.append((time.monotonic() - swap_t0) * 1000.0)
+        with self._lock:
+            self.model = model
+            self.refreshes = version
+            self.chunks_trained = target
+            self.versions.append(
+                {"version": version, "model_id": model_id,
+                 "rows": int(self.frame.nrows),
+                 "ntrees": model.output.get("ntrees_actual"),
+                 "train_s": round(train_s, 3)})
+        self.last_error = None
+        TimeLine.record("stream", "hot_swap", pipeline=self.id,
+                        version=version, alias=self.alias,
+                        rows=int(self.frame.nrows))
+        log.info("stream %s: v%d live (%d rows, %.2fs train%s)",
+                 self.id, version, self.frame.nrows, train_s,
+                 f", alias {self.alias}" if self.alias else "")
+
+    def _check_lag(self, job: Job) -> None:
+        lag = self.lag
+        if self.lag_bound and lag > self.lag_bound:
+            if not self.lagging:
+                job.warn(f"stream pipeline {self.id} lag {lag} exceeds "
+                         f"bound {self.lag_bound} (failing refreshes?)")
+            self.lagging = True
+        else:
+            self.lagging = False
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def lag(self) -> int:
+        return self.chunks_landed - self.chunks_trained
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            versions = list(self.versions)
+        job = self.job
+        return {
+            "id": self.id,
+            "status": job.status if job is not None else "CREATED",
+            "algo": self.algo,
+            "alias": self.alias,
+            "frame_id": str(self.frame.key)
+            if self.frame is not None else None,
+            "rows_landed": int(self.rows_landed),
+            "chunks_landed": self.chunks_landed,
+            "chunks_trained": self.chunks_trained,
+            "lag": self.lag,
+            "lag_bound": self.lag_bound,
+            "lagging": self.lagging,
+            "refreshes": self.refreshes,
+            "failed_refreshes": self.failed_refreshes,
+            "skipped_swaps": self.skipped_swaps,
+            "last_error": self.last_error,
+            "model_id": str(self.model.key)
+            if self.model is not None else None,
+            "versions": versions,
+            "swap_ms": [round(s, 2) for s in self.swap_ms],
+            "refresh_chunks": self.refresh_chunks,
+            "job": str(job.key) if job is not None else None,
+        }
+
+
+# -- process-wide pipeline table (the /3/Stream backing store) ---------------
+
+_pipelines: Dict[str, StreamPipeline] = {}
+_pipelines_lock = threading.Lock()
+
+
+def start_pipeline(pipeline_id: str, reader: ChunkReader, y: str,
+                   **kwargs) -> StreamPipeline:
+    p = StreamPipeline(pipeline_id, reader, y, **kwargs)
+    with _pipelines_lock:
+        old = _pipelines.get(pipeline_id)
+        if old is not None and old.job is not None and \
+                old.job.is_running:
+            raise ValueError(f"stream pipeline {pipeline_id} is already "
+                             "running")
+        _pipelines[pipeline_id] = p
+    p.start()
+    return p
+
+
+def get_pipeline(pipeline_id: str) -> Optional[StreamPipeline]:
+    with _pipelines_lock:
+        return _pipelines.get(pipeline_id)
+
+
+def list_pipelines() -> List[StreamPipeline]:
+    with _pipelines_lock:
+        return list(_pipelines.values())
+
+
+def stop_pipeline(pipeline_id: str, remove: bool = False) -> bool:
+    with _pipelines_lock:
+        p = _pipelines.get(pipeline_id)
+        if p is None:
+            return False
+        if remove:
+            _pipelines.pop(pipeline_id, None)
+    p.stop()
+    return True

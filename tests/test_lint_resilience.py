@@ -157,6 +157,49 @@ def test_no_to_numpy_in_device_munge_verbs():
         "fallbacks (rapids/interp.py) instead:\n" + "\n".join(offenders))
 
 
+# The streaming chunk-landing path (h2o_tpu/stream/ingest.py and the
+# Frame/Vec append verbs) must never pull the ACCUMULATED device payload
+# to host: a `to_numpy()` creeping in reopens the HBM->host->HBM
+# round-trip per chunk — the same rule as the munge verbs.  Host logic
+# over the (small, freshly-tokenized) incoming chunk lives in the
+# tokenizer / the explicitly-named `_chunk_cols_from_frame` converter.
+STREAM_APPEND_VERBS = {"append", "append_rows", "_build_grow",
+                       "_build_append_write"}
+
+
+def test_no_to_numpy_in_stream_chunk_landing():
+    pkg_root = os.path.dirname(h2o_tpu.__file__)
+    offenders = []
+    ingest = os.path.join(pkg_root, "stream", "ingest.py")
+    with open(ingest, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    for fn, ln in _to_numpy_hits(tree):
+        offenders.append(f"stream/ingest.py:{ln} in {fn}()")
+    frame = os.path.join(pkg_root, "core", "frame.py")
+    with open(frame, encoding="utf-8") as f:
+        ftree = ast.parse(f.read())
+    for fn, ln in _to_numpy_hits(ftree, STREAM_APPEND_VERBS):
+        offenders.append(f"core/frame.py:{ln} in {fn}()")
+    assert not offenders, (
+        "to_numpy() inside the streaming chunk-landing path — appends "
+        "must stay zero-host-pull (pow2-bucketed device block writes).  "
+        "Chunk-side host logic belongs in parse.tokenize_chunk / "
+        "_chunk_cols_from_frame:\n" + "\n".join(offenders))
+
+
+def test_stream_append_verbs_still_exist():
+    """The append verbs the lint above polices are part of the streaming
+    contract — renaming one away silently un-scopes the lint."""
+    pkg_root = os.path.dirname(h2o_tpu.__file__)
+    frame = os.path.join(pkg_root, "core", "frame.py")
+    with open(frame, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    names = {n.name for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    missing = STREAM_APPEND_VERBS - names
+    assert not missing, f"stream append verbs missing: {sorted(missing)}"
+
+
 def test_munge_host_fallbacks_still_exist():
     """The host oracle is part of the contract (H2O_TPU_DEVICE_MUNGE=0
     must keep working) — renaming a fallback away breaks the parity
@@ -254,7 +297,9 @@ def test_chaos_injection_sequence_is_seed_deterministic():
                             stall_p=0.4, stall_secs=0.0,
                             score_slow_p=0.4, score_slow_ms=0.0,
                             transfer_slow_p=0.4, transfer_slow_ms=0.0,
-                            oom_p=0.4, seed=1234)
+                            oom_p=0.4, stream_truncate_p=0.4,
+                            stream_slow_p=0.4, stream_slow_ms=0.0,
+                            seed=1234)
         seq = []
         for i in range(30):
             for step, fn in (
@@ -265,7 +310,10 @@ def test_chaos_injection_sequence_is_seed_deterministic():
                     ("stall", lambda: c.maybe_stall("drill")),
                     ("slow", lambda: c.maybe_slow_score("drill")),
                     ("xfer", lambda: c.maybe_slow_transfer("drill")),
-                    ("oom", lambda: c.maybe_oom(f"site{i}"))):
+                    ("oom", lambda: c.maybe_oom(f"site{i}")),
+                    ("trunc", lambda: c.maybe_truncate_stream(
+                        f"src{i}")),
+                    ("sslow", lambda: c.maybe_slow_stream("drill"))):
                 before = c.injected
                 try:
                     fn()

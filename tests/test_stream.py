@@ -1,0 +1,612 @@
+"""Streaming ingest + online refresh (h2o_tpu/stream + /3/Stream REST).
+
+Covers the PR 7 acceptance path end to end: quote-aware chunk-boundary
+parity (split point swept byte-by-byte across a quoted multi-line
+record), append-able Frames (rollup/domain invalidation, zero
+steady-state recompiles per chunk, zero host pulls of the accumulated
+payload), warm-start refresh equivalence (k refreshes bitwise-equal to
+a manual checkpoint-resume replay), GLM warm start, the GLM/DL solver
+OOM-ladder routing, validation-gated hot-swap, mid-block kill + resume
+with the alias still serving the previous version, and the REST drill:
+>= 20 chunks ingested while GBM refreshes every 5 chunks hot-swap a
+live alias that answers /score throughout with no 5xx.
+"""
+
+import io
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+
+def _call(srv, method, path, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}", data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _csv_bytes(n, seed, header=True):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 3))
+    y = np.where(X[:, 0] + 0.5 * X[:, 1] > 0, "s", "b")
+    buf = io.StringIO()
+    if header:
+        buf.write("x0,x1,x2,y\n")
+    for i in range(n):
+        buf.write(f"{X[i, 0]:.6f},{X[i, 1]:.6f},{X[i, 2]:.6f},{y[i]}\n")
+    return buf.getvalue().encode()
+
+
+@pytest.fixture()
+def csv_path(tmp_path):
+    def make(n, seed=1, name="stream.csv"):
+        p = tmp_path / name
+        p.write_bytes(_csv_bytes(n, seed))
+        return str(p)
+    return make
+
+
+@pytest.fixture()
+def chaos_clean():
+    from h2o_tpu.core import chaos, oom
+    yield
+    chaos.reset()
+    oom.reset_stats()
+
+
+# ---------------------------------------------------------------------------
+# chunk-boundary tokenization (satellite: quoted newline / CRLF parity)
+# ---------------------------------------------------------------------------
+
+def test_last_record_end_quote_parity():
+    from h2o_tpu.stream import last_record_end
+    assert last_record_end(b"a,b\nc,d\n") == 8
+    assert last_record_end(b"a,b\nc,d") == 4          # torn tail
+    assert last_record_end(b'1,"x\ny"\n2,z') == 8     # quoted \n is data
+    assert last_record_end(b'1,"open\nnever') == 0    # still inside quote
+    assert last_record_end(b'1,"a""b"\n') == 9        # "" escapes, even
+    # CRLF: boundary only after the \n, the \r rides with its record
+    assert last_record_end(b"a\r\nb\r") == 3
+
+
+def test_chunk_split_sweep_across_quoted_record(cl, tmp_path):
+    """A quoted field containing a newline (and a CRLF ending, an
+    escaped quote, a quoted separator, an NA) must parse identically to
+    the whole-file path for EVERY split position across the payload."""
+    from h2o_tpu.core.parse import parse_file
+    from h2o_tpu.stream import ChunkReader
+    from h2o_tpu.stream.ingest import frame_from_chunk
+    data = (b'x,lbl\n'
+            b'1,"a\nmulti line"\n'
+            b'2,"b,c"\r\n'
+            b'3,plain\n'
+            b'4,"q""uote"\n'
+            b'5,NA\n')
+    p = tmp_path / "sweep.csv"
+    p.write_bytes(data)
+    whole = parse_file(str(p))
+    wp = whole.to_pandas()
+    for split in range(1, len(data)):
+        rd = ChunkReader(iter([data[:split], data[split:]]),
+                         chunk_bytes=4)
+        fr = None
+        for cols in rd:
+            fr = frame_from_chunk(cols, rd.setup) if fr is None \
+                else fr.append_rows(cols)
+        assert fr.nrows == whole.nrows, f"split={split}"
+        ap = fr.to_pandas()
+        assert (ap["x"] == wp["x"]).all(), f"split={split}"
+        assert (ap["lbl"].astype(str) == wp["lbl"].astype(str)).all(), \
+            f"split={split}"
+
+
+def test_chunked_parse_matches_whole_file(cl, csv_path):
+    """Many small chunks through the reader reassemble the exact rows of
+    the one-shot parse (native tokenizer path when built)."""
+    from h2o_tpu.core.parse import parse_file
+    from h2o_tpu.stream import ChunkReader
+    from h2o_tpu.stream.ingest import frame_from_chunk
+    path = csv_path(200, seed=3)
+    whole = parse_file(path)
+    rd = ChunkReader(path, chunk_rows=16)
+    fr = None
+    n_chunks = 0
+    for cols in rd:
+        fr = frame_from_chunk(cols, rd.setup) if fr is None \
+            else fr.append_rows(cols)
+        n_chunks += 1
+    assert n_chunks > 3, "reader did not actually chunk"
+    assert fr.nrows == whole.nrows
+    for c in ("x0", "x1", "x2"):
+        np.testing.assert_array_equal(fr.vec(c).to_numpy(),
+                                      whole.vec(c).to_numpy())
+    a, b = fr.to_pandas(), whole.to_pandas()
+    assert (a["y"].astype(str) == b["y"].astype(str)).all()
+
+
+def test_stream_truncation_chaos_retries(cl, csv_path, chaos_clean):
+    """A truncated/flaky source heals through the retry layer: transient
+    mode fails the first N reads, the reader recovers, and the injected
+    faults are accounted at the dedicated counter."""
+    from h2o_tpu.core import chaos
+    from h2o_tpu.core.parse import parse_file
+    from h2o_tpu.stream import ChunkReader
+    from h2o_tpu.stream.ingest import frame_from_chunk
+    path = csv_path(60, seed=4, name="trunc.csv")
+    whole = parse_file(path)
+    chaos.configure(stream_truncate_transient=2)
+    rd = ChunkReader(path, chunk_rows=16)
+    fr = None
+    for cols in rd:
+        fr = frame_from_chunk(cols, rd.setup) if fr is None \
+            else fr.append_rows(cols)
+    assert fr.nrows == whole.nrows
+    c = chaos.chaos().counters()
+    assert c["injected_stream_truncations"] == 2
+    assert c["injected"] == sum(v for k, v in c.items()
+                                if k != "injected")
+
+
+# ---------------------------------------------------------------------------
+# append-able Frames (satellite: rollup/domain invalidation, 0 recompiles)
+# ---------------------------------------------------------------------------
+
+def test_append_invalidates_rollups_and_histograms(cl):
+    from h2o_tpu.core.frame import Vec
+    v = Vec(np.arange(10, dtype=np.float32))
+    assert v.mean() == pytest.approx(4.5)
+    h0 = v.histogram(8).copy()
+    v.append(np.array([100.0, 200.0, np.nan], np.float32))
+    allv = np.concatenate([np.arange(10), [100.0, 200.0, np.nan]])
+    assert v.nrows == 13
+    assert v.mean() == pytest.approx(np.nanmean(allv))
+    assert v.sigma() == pytest.approx(np.nanstd(allv, ddof=1), rel=1e-4)
+    assert v.nacnt() == 1
+    assert v.min() == 0.0 and v.max() == 200.0
+    h1 = v.histogram(8)
+    assert not np.array_equal(h0, h1), "stale histogram after append"
+    np.testing.assert_array_equal(v.to_numpy()[:12], allv[:12])
+
+
+def test_append_extends_categorical_domain(cl):
+    from h2o_tpu.core.frame import T_CAT, Vec
+    v = Vec(np.array([0, 1, 0, -1], np.int32), T_CAT, domain=["a", "b"])
+    assert v.nacnt() == 1
+    # chunk-local domain: "b" is code 0, new level "c" is code 1
+    v.append(np.array([0, 1, -1], np.int32), domain=["b", "c"])
+    assert v.domain == ["a", "b", "c"]
+    np.testing.assert_array_equal(v.to_numpy(),
+                                  [0, 1, 0, -1, 1, 2, -1])
+    assert v.nacnt() == 2
+    assert v.cardinality == 3
+
+
+def test_append_invalidates_frame_matrix_cache(cl):
+    from h2o_tpu.core.frame import Frame, Vec
+    fr = Frame(["x"], [Vec(np.arange(6, dtype=np.float32))])
+    m0 = fr.as_matrix(["x"])
+    fr.append_rows({"x": np.arange(6, 20, dtype=np.float32)})
+    m1 = fr.as_matrix(["x"])
+    assert m1.shape[0] == fr.padded_rows
+    assert float(np.nansum(np.asarray(m1)[: fr.nrows, 0])) == \
+        float(np.arange(20).sum())
+    assert m0 is not m1, "stale matrix cache after append"
+
+
+def test_append_time_and_string_columns(cl):
+    from h2o_tpu.core.frame import Frame, T_STR, T_TIME, Vec
+    t = Vec(np.array([1.7e12, 1.7e12 + 1000.0], np.float64), T_TIME)
+    s = Vec(["a", "b"], T_STR)
+    fr = Frame(["t", "s"], [t, s])
+    fr.append_rows({"t": np.array([1.7e12 + 2000.0], np.float64),
+                    "s": ["c"]})
+    assert fr.nrows == 3
+    # exact f64 epoch copy extended (ms precision survives)
+    np.testing.assert_array_equal(
+        t.to_numpy(), [1.7e12, 1.7e12 + 1000.0, 1.7e12 + 2000.0])
+    assert s.host_data == ["a", "b", "c"]
+
+
+def test_append_zero_steady_state_compiles(cl):
+    """Same-bucket appends after the first hit existing compiled
+    kernels: ZERO exec-store misses and zero append-phase compiles per
+    chunk (the pow2 shape-bucket contract)."""
+    from h2o_tpu.core.diag import DispatchStats
+    from h2o_tpu.core.exec_store import exec_store
+    from h2o_tpu.core.frame import Frame, Vec
+    fr = Frame(["x"], [Vec(np.arange(64, dtype=np.float32))])
+    fr.append_rows({"x": np.arange(8, dtype=np.float32)})  # first grow
+    m0 = exec_store().stats()["misses"]
+    c0 = DispatchStats.snapshot()["compiles"].get("append", 0)
+    for _ in range(5):
+        fr.append_rows({"x": np.arange(8, dtype=np.float32)})
+    assert exec_store().stats()["misses"] == m0
+    assert DispatchStats.snapshot()["compiles"].get("append", 0) == c0
+    assert fr.nrows == 64 + 6 * 8
+
+
+def test_append_no_host_pull_of_accumulated_payload(cl):
+    """Chunk landing never reads the EXISTING device payload back to
+    host (the munge zero-host-pull rule applied to appends)."""
+    from h2o_tpu.core.diag import DispatchStats
+    from h2o_tpu.core.frame import Frame, T_CAT, Vec
+    fr = Frame(["x", "g"],
+               [Vec(np.arange(64, dtype=np.float32)),
+                Vec(np.zeros(64, np.int32), T_CAT, domain=["u"])])
+    before = DispatchStats.snapshot()["host_pulls"].get("append", 0)
+    for i in range(4):
+        fr.append_rows({"x": np.arange(16, dtype=np.float32),
+                        "g": (np.zeros(16, np.int32), ["u", f"v{i}"])})
+    after = DispatchStats.snapshot()["host_pulls"].get("append", 0)
+    assert after == before, "append pulled device payload to host"
+    assert fr.vec("g").domain == ["u", "v0", "v1", "v2", "v3"]
+
+
+# ---------------------------------------------------------------------------
+# warm-start refresh (satellite: bitwise equivalence, GLM warm start)
+# ---------------------------------------------------------------------------
+
+def _drain_pipeline(path, chunk_rows, **kw):
+    from h2o_tpu.stream import ChunkReader, start_pipeline
+    pipe = start_pipeline(kw.pop("pid"), ChunkReader(
+        path, chunk_rows=chunk_rows), "y", **kw)
+    pipe.job.join(timeout=600)
+    return pipe
+
+
+def test_refresh_bitwise_vs_manual_checkpoint_replay(cl, csv_path):
+    """A forest grown by k refreshes over appended rows is BITWISE
+    identical to a manual checkpoint-resume replay over the same
+    appends (absolute-tree-index RNG keys, PR 5)."""
+    from h2o_tpu.models.tree.gbm import GBM
+    from h2o_tpu.stream import ChunkReader
+    from h2o_tpu.stream.ingest import frame_from_chunk
+    path = csv_path(192, seed=7, name="bitwise.csv")
+    pipe = _drain_pipeline(
+        path, 24, pid="bw_pipe", algo="gbm",
+        model_params=dict(max_depth=3, seed=7, nbins=8),
+        refresh_chunks=2, trees_per_refresh=2)
+    st = pipe.status()
+    assert st["refreshes"] >= 3 and st["lag"] == 0, st
+
+    # manual replay: same reader config => same chunks => same appends
+    rd = ChunkReader(path, chunk_rows=24)
+    fr, prev, trees, pending, done = None, None, 0, 0, 0
+    for cols in rd:
+        fr = frame_from_chunk(cols, rd.setup) if fr is None \
+            else fr.append_rows(cols)
+        pending += 1
+        if pending >= 2:
+            trees += 2
+            params = dict(ntrees=trees, max_depth=3, seed=7, nbins=8)
+            if prev is not None:
+                params["checkpoint"] = prev
+            done += 1
+            prev = GBM(model_id=f"bw_man_{done}", **params).train(
+                y="y", training_frame=fr)
+            pending = 0
+    if pending:
+        trees += 2
+        prev = GBM(model_id="bw_man_tail", ntrees=trees, max_depth=3,
+                   seed=7, nbins=8, checkpoint=prev).train(
+            y="y", training_frame=fr)
+    final = pipe.model
+    assert final.output["ntrees_actual"] == prev.output["ntrees_actual"]
+    for k in ("split_col", "bitset", "value"):
+        np.testing.assert_array_equal(
+            np.asarray(final.output[k]), np.asarray(prev.output[k]),
+            err_msg=f"refresh forest differs from manual replay at {k}")
+
+
+def test_glm_refresh_warm_starts_from_previous_beta(cl, csv_path):
+    from h2o_tpu.models.glm import GLM
+    path = csv_path(160, seed=9, name="glm.csv")
+    pipe = _drain_pipeline(
+        path, 40, pid="glm_pipe", algo="glm",
+        model_params=dict(family="binomial", lambda_=0.05),
+        refresh_chunks=2)
+    st = pipe.status()
+    assert st["refreshes"] >= 2 and st["lag"] == 0, st
+    # the second+ refresh must actually have warm-started
+    assert pipe.model.output.get("warm_started") is True
+    # and the warm solution matches a cold fit on the same final frame
+    cold = GLM(family="binomial", lambda_=0.05, model_id="glm_cold") \
+        .train(y="y", training_frame=pipe.frame)
+    np.testing.assert_allclose(np.asarray(pipe.model.output["beta"]),
+                               np.asarray(cold.output["beta"]),
+                               atol=5e-4)
+
+
+# ---------------------------------------------------------------------------
+# satellite: GLM/DL solver dispatches under the exec store + OOM ladder
+# ---------------------------------------------------------------------------
+
+def test_glm_solver_routes_through_store_and_ladder(cl, chaos_clean):
+    from h2o_tpu.core import chaos, oom
+    from h2o_tpu.core.frame import Frame, T_CAT, Vec
+    from h2o_tpu.models.glm import GLM
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(96, 3)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int32)
+    fr = Frame(["x0", "x1", "x2", "y"],
+               [Vec(X[:, j]) for j in range(3)] +
+               [Vec(y, T_CAT, domain=["a", "b"])])
+    oom.reset_stats()
+    chaos.configure(oom_transient=1)
+    m = GLM(family="binomial", lambda_=0.05, model_id="glm_oom").train(
+        y="y", training_frame=fr)
+    sites = oom.stats()["sites"]
+    assert sites.get("glm.irlsm", {}).get("sweeps", 0) >= 1, sites
+    assert np.all(np.isfinite(np.asarray(m.output["beta"])))
+    # the solver pass is a store entry now (glm.solver phase dispatches)
+    from h2o_tpu.core.diag import DispatchStats
+    assert DispatchStats.snapshot()["dispatches"].get("glm.solver", 0) > 0
+
+
+def test_dl_solver_routes_through_store_and_ladder(cl, chaos_clean):
+    from h2o_tpu.core import chaos, oom
+    from h2o_tpu.core.frame import Frame, T_CAT, Vec
+    from h2o_tpu.models.deeplearning import DeepLearning
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(96, 3)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int32)
+    fr = Frame(["x0", "x1", "x2", "y"],
+               [Vec(X[:, j]) for j in range(3)] +
+               [Vec(y, T_CAT, domain=["a", "b"])])
+    oom.reset_stats()
+    chaos.configure(oom_transient=1)
+    DeepLearning(hidden=[4], epochs=1, seed=1, model_id="dl_oom").train(
+        y="y", training_frame=fr)
+    sites = oom.stats()["sites"]
+    assert sites.get("dl.train_block", {}).get("sweeps", 0) >= 1, sites
+
+
+# ---------------------------------------------------------------------------
+# hot-swap semantics: validation gate, mid-block kill + resume
+# ---------------------------------------------------------------------------
+
+def test_failed_validation_keeps_previous_version_serving(cl, csv_path):
+    from h2o_tpu.serve.registry import registry
+    from h2o_tpu.stream import ChunkReader, start_pipeline
+    path = csv_path(128, seed=11, name="valgate.csv")
+    calls = {"n": 0}
+
+    def validate_only_first(model):
+        calls["n"] += 1
+        return calls["n"] == 1
+
+    pipe = start_pipeline(
+        "valgate", ChunkReader(path, chunk_rows=32), "y", algo="gbm",
+        model_params=dict(max_depth=3, seed=3, nbins=8),
+        refresh_chunks=1, trees_per_refresh=2, alias="valgate_live",
+        validate_fn=validate_only_first)
+    try:
+        pipe.job.join(timeout=600)
+        st = pipe.status()
+        assert st["skipped_swaps"] >= 1, st
+        assert st["refreshes"] == 1, st
+        assert st["lag"] > 0, st               # untrained data is LAG
+        dep = registry().get("valgate_live")
+        assert dep.active.version == 1
+        assert dep.active.model_id == "valgate_v1"
+        raw, _ver = registry().score_rows(
+            "valgate_live", [{"x0": 0.1, "x1": 0.2, "x2": 0.3}])
+        assert np.asarray(raw).size > 0
+    finally:
+        try:
+            registry().undeploy("valgate_live", drain_secs=1.0)
+        except KeyError:
+            pass
+
+
+def test_refresh_killed_mid_block_resumes_with_alias_intact(
+        cl, csv_path, tmp_path, chaos_clean):
+    """Kill a refresh retrain mid-forest: the alias keeps serving the
+    previous version; the retry RESUMES from the last per-block
+    recovery checkpoint and the resumed forest is bitwise-equal to an
+    uninterrupted build."""
+    from h2o_tpu.core import chaos
+    from h2o_tpu.core.parse import parse_file
+    from h2o_tpu.serve.registry import registry
+    from h2o_tpu.models.tree.gbm import GBM
+    rec_dir = str(tmp_path / "rec")
+    path = csv_path(128, seed=13, name="kill.csv")
+    fr = parse_file(path)
+    v1 = GBM(ntrees=2, max_depth=3, seed=5, nbins=8,
+             model_id="kill_v1").train(y="y", training_frame=fr)
+    registry().deploy("kill_live", v1)
+    try:
+        # v2: +6 trees, one tree per block, slowed block materialization
+        # so the cancel deterministically lands mid-forest
+        chaos.configure(transfer_slow_p=1.0, transfer_slow_ms=150)
+        b = GBM(ntrees=8, max_depth=3, seed=5, nbins=8,
+                checkpoint=v1, recovery_dir=rec_dir,
+                checkpoint_interval=1, model_id="kill_v2")
+        job = b.train_async(y="y", training_frame=fr)
+        from h2o_tpu.core.recovery import Recovery
+        rec = Recovery(rec_dir, "model", "kill_v2")
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            meta = rec.iteration_meta()
+            if meta and meta.get("trees_done", 0) >= 3:
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("no mid-forest checkpoint observed")
+        job.cancel()
+        with pytest.raises(Exception):
+            job.join(timeout=120)
+        chaos.reset()
+        # the alias never saw the dead refresh
+        dep = registry().get("kill_live")
+        assert dep.active.version == 1 and \
+            dep.active.model_id == "kill_v1"
+        raw, _ = registry().score_rows(
+            "kill_live", [{"x0": 0.0, "x1": 0.0, "x2": 0.0}])
+        assert np.asarray(raw).size > 0
+        # retry resumes from the checkpoint (same model_id/recovery dir)
+        assert rec.load_iteration() is not None
+        b2 = GBM(ntrees=8, max_depth=3, seed=5, nbins=8,
+                 checkpoint=v1, recovery_dir=rec_dir,
+                 checkpoint_interval=1, model_id="kill_v2")
+        b2._recovery_resuming = True
+        v2 = b2.train(y="y", training_frame=fr)
+        # uninterrupted reference
+        ref = GBM(ntrees=8, max_depth=3, seed=5, nbins=8,
+                  checkpoint=v1, model_id="kill_ref").train(
+            y="y", training_frame=fr)
+        for k in ("split_col", "bitset", "value"):
+            np.testing.assert_array_equal(
+                np.asarray(v2.output[k]), np.asarray(ref.output[k]),
+                err_msg=f"resumed forest differs at {k}")
+        registry().deploy("kill_live", v2)
+        assert registry().get("kill_live").active.version == 2
+    finally:
+        chaos.reset()
+        try:
+            registry().undeploy("kill_live", drain_secs=1.0)
+        except KeyError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# REST acceptance drill
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def srv(cl):
+    from h2o_tpu.api.server import RestServer
+    from h2o_tpu.serve import registry
+    server = RestServer(port=0).start()
+    yield server
+    registry().reset()
+    server.stop()
+
+
+def test_stream_rest_drill(cl, srv, csv_path):
+    """The ISSUE acceptance drill: >= 20 chunks ingest while GBM
+    refreshes every 5 chunks hot-swap a deployed alias; /score answers
+    throughout (no 5xx); lag returns to 0; appends reach steady state
+    (zero compiles for further same-bucket chunks)."""
+    path = csv_path(252, seed=17, name="drill.csv")
+    status, out = _call(srv, "POST", "/3/Stream", {
+        "source": path, "y": "y", "algo": "gbm", "id": "drill",
+        "alias": "drill_live", "chunk_rows": 12, "refresh_chunks": 5,
+        "trees_per_refresh": 2,
+        "params": {"max_depth": 3, "seed": 19, "nbins": 8}})
+    assert status == 200, out
+
+    codes = []
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            st, _ = _call(srv, "POST", "/3/Serving/drill_live/score",
+                          {"rows": [{"x0": 0.1, "x1": -0.2,
+                                     "x2": 0.3}]})
+            codes.append(st)
+            time.sleep(0.01)
+
+    t = threading.Thread(target=hammer, daemon=True)
+    t.start()
+    deadline = time.time() + 500
+    while time.time() < deadline:
+        status, out = _call(srv, "GET", "/3/Stream/drill")
+        assert status == 200
+        if out["pipeline"]["status"] in ("DONE", "FAILED", "CANCELLED"):
+            break
+        time.sleep(0.2)
+    stop.set()
+    t.join(timeout=5)
+    p = out["pipeline"]
+    assert p["status"] == "DONE", p
+    assert p["chunks_landed"] >= 20, p
+    assert p["refreshes"] >= 4, p
+    assert p["lag"] == 0, p
+    assert p["failed_refreshes"] == 0, p
+    # /score answered throughout: 404 only before the first deploy,
+    # then 200s; NO 5xx ever (no injected faults in this drill)
+    assert not any(c >= 500 for c in codes), codes
+    assert any(c == 200 for c in codes)
+    first_200 = codes.index(200)
+    assert all(c in (200, 429, 408) for c in codes[first_200:]), codes
+    # alias tracks the newest version
+    status, sv = _call(srv, "GET", "/3/Serving/drill_live")
+    assert sv["deployment"]["model_id"] == p["model_id"]
+    assert sv["deployment"]["version"] == p["refreshes"]
+    # steady state: after one more append absorbs any capacity-bucket
+    # growth, further same-bucket chunks cost ZERO compiles
+    from h2o_tpu.core.diag import DispatchStats
+    from h2o_tpu.core.exec_store import exec_store
+    from h2o_tpu.stream import get_pipeline
+    pipe = get_pipeline("drill")
+    compiles_during_drill = \
+        DispatchStats.snapshot()["compiles"].get("append", 0)
+    assert compiles_during_drill < p["chunks_landed"], \
+        "append compiles grew per-chunk (bucketing broken)"
+    chunk = {"x0": np.zeros(12, np.float32),
+             "x1": np.zeros(12, np.float32),
+             "x2": np.zeros(12, np.float32),
+             "y": (np.zeros(12, np.int32), ["b"])}
+    pipe.frame.append_rows(chunk)          # may grow the capacity bucket
+    m0 = exec_store().stats()["misses"]
+    c0 = DispatchStats.snapshot()["compiles"].get("append", 0)
+    for _ in range(3):
+        pipe.frame.append_rows(chunk)
+    assert exec_store().stats()["misses"] == m0
+    assert DispatchStats.snapshot()["compiles"].get("append", 0) == c0
+    # bitwise: the served forest equals a manual checkpoint-resume
+    # replay over the same chunk sequence
+    from h2o_tpu.models.tree.gbm import GBM
+    from h2o_tpu.stream import ChunkReader
+    from h2o_tpu.stream.ingest import frame_from_chunk
+    rd = ChunkReader(path, chunk_rows=12)
+    fr, prev, trees, pending, n = None, None, 0, 0, 0
+    for cols in rd:
+        fr = frame_from_chunk(cols, rd.setup) if fr is None \
+            else fr.append_rows(cols)
+        pending += 1
+        if pending >= 5:
+            trees += 2
+            params = dict(ntrees=trees, max_depth=3, seed=19, nbins=8)
+            if prev is not None:
+                params["checkpoint"] = prev
+            n += 1
+            prev = GBM(model_id=f"drill_man_{n}", **params).train(
+                y="y", training_frame=fr)
+            pending = 0
+    if pending:
+        trees += 2
+        prev = GBM(model_id="drill_man_tail", ntrees=trees, max_depth=3,
+                   seed=19, nbins=8, checkpoint=prev).train(
+            y="y", training_frame=fr)
+    final = pipe.model
+    for k in ("split_col", "bitset", "value"):
+        np.testing.assert_array_equal(
+            np.asarray(final.output[k]), np.asarray(prev.output[k]),
+            err_msg=f"served forest differs from batch replay at {k}")
+    # stop + remove
+    status, _ = _call(srv, "DELETE", "/3/Stream/drill")
+    assert status == 200
+    status, _ = _call(srv, "GET", "/3/Stream/drill")
+    assert status == 404
+
+
+def test_stream_rest_list_and_errors(cl, srv):
+    status, out = _call(srv, "GET", "/3/Stream")
+    assert status == 200 and "pipelines" in out
+    status, _ = _call(srv, "POST", "/3/Stream", {"source": "/nope.csv"})
+    assert status == 400                       # y missing
+    status, _ = _call(srv, "GET", "/3/Stream/nope")
+    assert status == 404
