@@ -266,6 +266,98 @@ def bench_rapids_groupby(rows, groups=1024, reps=5):
         cloud().dkv.remove("bench_rapids_gb")
 
 
+_SCALEOUT_SRC = r"""
+import json, os, sys, time
+import numpy as np
+p = os.environ.get('BENCH_PLATFORM')
+if p:
+    import jax
+    jax.config.update('jax_platforms', p)
+import jax
+nodes = int(os.environ['SCALEOUT_NODES'])
+rows = int(os.environ['SCALEOUT_ROWS'])
+groups = int(os.environ.get('SCALEOUT_GROUPS', 512))
+reps = int(os.environ.get('SCALEOUT_REPS', 3))
+from h2o_tpu.core.cloud import Cloud
+Cloud.boot(nodes=nodes, model_axis=1)
+from h2o_tpu.core.frame import Frame, T_CAT, Vec
+from h2o_tpu.core import munge
+from h2o_tpu.core.diag import DispatchStats
+rng = np.random.default_rng(3)
+g = rng.integers(0, groups, size=rows).astype(np.int32)
+x = rng.normal(size=rows).astype(np.float32)
+fr = Frame(['g', 'x'],
+           [Vec(g, T_CAT, domain=[f'g{i}' for i in range(groups)]),
+            Vec(x)])
+aggs = [('mean', 1, 'all'), ('sum', 1, 'all'), ('max', 1, 'all')]
+
+def pipeline():
+    s = munge.sort_frame(fr, [1], [True])
+    gb = munge.groupby_frame(fr, [0], aggs)
+    fl = munge.filter_rows(fr, fr.vec('x').data > 0)
+    # host-fetch barrier: a scalar from each result pins completion
+    return (float(s.vecs[1].data[0]) + float(gb.vecs[1].data[0]) +
+            float(fl.vecs[1].data[0] if fl.nrows else 0.0))
+
+p0 = DispatchStats.host_pulls('munge')
+pipeline()                                   # warm (compiles)
+t0 = time.time()
+for _ in range(reps):
+    pipeline()
+wall = (time.time() - t0) / reps
+print(json.dumps({
+    'nodes': nodes, 'rows': rows, 'wall_s': wall,
+    'verb_rows_per_s': rows * 3 / wall,
+    'munge_host_pulls': DispatchStats.host_pulls('munge') - p0,
+    'shard_munge': munge.shard_munge_enabled()}))
+"""
+
+
+def bench_rapids_scaleout():
+    """Scale-out data plane: the sort+group-by+filter pipeline as
+    shard_map collectives at nodes=1 vs nodes=4, each in a fresh
+    subprocess (the mesh shape is fixed at boot).  Off-TPU the
+    subprocess forces an 8-virtual-device host platform, so the rung
+    measures the SAME collectives CI runs — the headline is verb-rows/s
+    at 4 nodes, with the 1-node number and the speedup in detail."""
+    import subprocess
+    rows = int(os.environ.get("BENCH_SCALEOUT_ROWS", 200_000))
+    out = {"rows": rows, "unit": "verb rows/sec @4 nodes"}
+    per = {}
+    for nodes in (1, 4):
+        env = dict(os.environ)
+        env.update({"SCALEOUT_NODES": str(nodes),
+                    "SCALEOUT_ROWS": str(rows),
+                    "H2O_TPU_ROW_ALIGN":
+                        env.get("H2O_TPU_ROW_ALIGN", "128")})
+        if env.get("BENCH_PLATFORM", "").startswith("cpu") or \
+                "--xla_force_host_platform_device_count" not in \
+                env.get("XLA_FLAGS", ""):
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                " --xla_force_host_platform_device_"
+                                "count=8")
+        r = subprocess.run([sys.executable, "-c", _SCALEOUT_SRC],
+                           capture_output=True, env=env, timeout=900)
+        if r.returncode != 0:
+            per[f"nodes_{nodes}"] = {
+                "error": r.stderr.decode()[-300:]}
+            continue
+        per[f"nodes_{nodes}"] = json.loads(
+            r.stdout.decode().strip().splitlines()[-1])
+    out.update(per)
+    n4 = per.get("nodes_4", {})
+    n1 = per.get("nodes_1", {})
+    out["value"] = round(n4.get("verb_rows_per_s", 0.0), 1)
+    if n1.get("verb_rows_per_s") and n4.get("verb_rows_per_s"):
+        out["speedup_4x_vs_1x"] = round(
+            n4["verb_rows_per_s"] / n1["verb_rows_per_s"], 3)
+    if not out["value"] and n1.get("verb_rows_per_s"):
+        # a <4-device backend still reports the 1-node measurement
+        out["value"] = round(n1["verb_rows_per_s"], 1)
+        out["unit"] = "verb rows/sec @1 node"
+    return out
+
+
 _COLD_START_SRC = r"""
 import json, os, sys, time
 import numpy as np
@@ -703,8 +795,8 @@ def _main_ladder(detail):
     depth = int(os.environ.get("BENCH_DEPTH", 5))
     configs = os.environ.get(
         "BENCH_CONFIG",
-        "gbm,gbm_ua,gbm_bf16,drf,glm,dl,hist,rapidsgb,gbm10m,cpuref,"
-        "cpuref10m,deep,coldstart,streamref"
+        "gbm,gbm_ua,gbm_bf16,drf,glm,dl,hist,rapidsgb,scaleout,gbm10m,"
+        "cpuref,cpuref10m,deep,coldstart,streamref"
     ).split(",")
 
     detail.update({"rows": rows, "cols": cols})
@@ -737,14 +829,21 @@ def _main_ladder(detail):
         platform = "cpu-fallback"
         # shrink the workload to what a host CPU finishes inside the
         # watchdog budget, and drop the configs that only make sense on
-        # the accelerator (10M-row ladder, deep frontier, DL)
+        # the accelerator (deep frontier, DL).  The 10M-row GBM rung and
+        # its CPU reference STAY in the ladder — at a capped row count —
+        # so the scale rung always emits a real measurement instead of
+        # a 0.0 placeholder (detail.rows says what actually ran).
         rows = min(rows, int(os.environ.get(
             "BENCH_CPU_FALLBACK_ROWS", 100_000)))
         trees = min(trees, int(os.environ.get(
             "BENCH_CPU_FALLBACK_TREES", 5)))
+        os.environ.setdefault("BENCH_ROWS_10M", os.environ.get(
+            "BENCH_CPU_FALLBACK_ROWS_10M", "300000"))
+        os.environ.setdefault("BENCH_SCALEOUT_ROWS", "100000")
         configs = [c for c in configs
                    if c in ("gbm", "cpuref", "drf", "glm", "hist",
-                            "rapidsgb", "coldstart")]
+                            "rapidsgb", "scaleout", "gbm10m",
+                            "cpuref10m", "coldstart")]
         detail["rows"] = rows
     detail["platform"] = platform
 
@@ -767,6 +866,7 @@ def _main_ladder(detail):
             ("rapidsgb", lambda: bench_rapids_groupby(
                 min(rows, int(os.environ.get("BENCH_RAPIDS_GB_ROWS",
                                              1_000_000))))),
+            ("scaleout", bench_rapids_scaleout),
             ("gbm10m", lambda: bench_gbm10m(cols, depth)),
             ("cpuref10m", lambda: bench_cpu_reference_10m(cols, depth)),
             ("deep", lambda: bench_deep(fr, rows)),
@@ -777,6 +877,7 @@ def _main_ladder(detail):
              "gbm_ua": "gbm_uniform_adaptive", "gbm_bf16": "gbm_bf16",
              "cpuref10m": "cpu_reference_10m",
              "rapidsgb": "rapids_groupby_throughput",
+             "scaleout": "rapids_scaleout",
              "coldstart": "cold_start",
              "streamref": "streaming_refresh"}
     for cfg, fn in runs:

@@ -196,6 +196,46 @@ class Cloud:
         return cls._instance
 
     @classmethod
+    def reform(cls, **flags) -> "Cloud":
+        """Re-form the cloud on a DIFFERENT mesh shape while keeping the
+        control plane — the mesh-resize event (a slice shrank, a node
+        pool grew).  The reference cannot do this at all (membership
+        locks at the first distributed write, Paxos.java:145-166); here
+        the DKV, job registry and session counter carry over and every
+        device-backed Frame in the store is re-homed onto the new mesh
+        (one host bounce per column — a topology change, not a hot-path
+        verb; padding quantum and sharding are both mesh-shaped).
+        Checkpoint/resume survives the resize: recovery state is
+        host-side, and the tree driver re-pads a checkpointed F carry
+        to the new quantum on load (models/tree/driver.py)."""
+        with cls._lock:
+            old = cls._instance
+            newc = Cloud(OptArgs.from_env(**flags))
+            if old is not None:
+                newc.dkv = old.dkv
+                newc.jobs = old.jobs
+                newc.session_counter = old.session_counter
+            cls._instance = newc
+        # drop jitted-trace caches: module-level jits that trace-capture
+        # the mesh (histogram collective, uplift engine, quantile
+        # refine) would otherwise replay jaxprs built for the old
+        # device set on shape-compatible inputs
+        jax.clear_caches()
+        if old is not None:
+            from h2o_tpu.core.frame import Frame
+            for key in list(newc.dkv.keys()):
+                val = newc.dkv.get(key)
+                if isinstance(val, Frame):
+                    for v in val.vecs:
+                        v._rehome()
+                    val._matrix_cache.clear()
+            log.info("Cloud re-formed to mesh %dx%d (%d frames re-homed)",
+                     newc.n_nodes, newc.args.model_axis,
+                     sum(1 for k in newc.dkv.keys()
+                         if isinstance(newc.dkv.get(k), Frame)))
+        return newc
+
+    @classmethod
     def boot_multihost(cls, coordinator: str, num_processes: int,
                        process_id: int, **flags) -> "Cloud":
         """Multi-host boot: the flatfile-discovery analog.  Each host calls

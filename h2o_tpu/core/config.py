@@ -53,6 +53,18 @@ owning modules, like the chaos flags, so they work before a cloud boots):
   default on-TPU-only — donating and non-donating variants are
   distinct store entries and OOM retries auto-route to the
   non-donating twin);
+- scale-out data plane (core/munge.py shard_map collectives — the
+  chunk-homed MRTask munge verbs):
+  ``H2O_TPU_DEVICE_MUNGE`` (0 = host-NumPy parity-oracle paths),
+  ``H2O_TPU_SHARD_MUNGE`` (default 1: sort/merge/group-by/filter run
+  as shard_map collectives over the mesh ``nodes`` axis — rows stay
+  home-sharded, only splitters/partials/per-shard counts cross the
+  interconnect; 0 = the PR 4 global-jnp device kernels, where XLA may
+  gather rows cross-shard), and
+  ``H2O_TPU_SORT_OVERSAMPLE`` (default 4: sample-sort splitter samples
+  per shard are oversample x n_nodes — more samples tighten bucket
+  balance in the exchange at the cost of a wider replicated splitter
+  sort);
 - streaming ingest + online refresh (h2o_tpu/stream — the
   train-on-fresh-data pipeline: chunked parse -> append-able Frames ->
   warm-start retrain -> serve-alias hot-swap):

@@ -517,6 +517,19 @@ class ExecStore:
             self._entries.clear()
             self._aot.clear()
 
+    def kernel_names(self) -> Dict[str, list]:
+        """Distinct named kernel entries per phase (keys shaped
+        ``(phase, name, statics, avals..., donate)``) — how REST
+        observability proves e.g. the SHARDED munge variants are
+        separate compiled programs from the global ones."""
+        out: Dict[str, set] = {}
+        with self._lock:
+            for k in self._entries:
+                if len(k) >= 2 and isinstance(k[0], str) and \
+                        isinstance(k[1], str):
+                    out.setdefault(k[0], set()).add(k[1])
+        return {ph: sorted(names) for ph, names in sorted(out.items())}
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             return {"entries": len(self._entries),
@@ -530,7 +543,8 @@ class ExecStore:
                     "serialize_unsupported": self.serialize_unsupported,
                     "serialized_bytes_written": self.disk_bytes_written,
                     "serialized_bytes_read": self.disk_bytes_read,
-                    "dir": store_dir()}
+                    "dir": store_dir(),
+                    "kernels": self.kernel_names()}
 
 
 _STORE: Optional[ExecStore] = None

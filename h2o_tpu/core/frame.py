@@ -25,6 +25,18 @@ TPU-native redesign:
 - strings/UUIDs stay host-side (SURVEY §7 "strings stay host-side");
 - rollups are one fused jit reduction, cached on the Vec, invalidated on
   mutation — same contract as RollupStats' lazy compute-once.
+
+SHARD-RESIDENCY CONTRACT (the scale-out data plane, core/munge.py):
+``is_row_sharded`` Vecs/Frames carry their payload row-sharded over the
+mesh's ``nodes`` axis.  Canonical frames keep valid rows as one global
+prefix (``iota < nrows``); frames produced by the sharded filter/merge
+collectives are instead RAGGED — each shard holds a local prefix of
+valid rows tracked by ``shard_counts`` (one int per shard, the analog of
+per-node chunk row counts).  ``valid_mask()`` is the one predicate both
+layouts share; downstream munge verbs consume ragged frames directly by
+masking, and anything that needs the canonical layout (``as_matrix`` for
+training, appends) first calls ``Frame.repack()`` — a balanced
+``all_to_all`` exchange on device, never a host gather.
 """
 
 from __future__ import annotations
@@ -91,8 +103,11 @@ def _build_grow(cap_old: int, cap_new: int, fill_kind: str):
     fill = float("nan") if fill_kind == "nan" else -1
 
     def kern(buf):
-        pad = jnp.full((cap_new - cap_old,), fill, buf.dtype)
-        return jnp.concatenate([buf, pad])
+        # jnp.pad, not concatenate-with-filler: the latter miscompiles
+        # for sharded operands on meshes with a model axis (see
+        # core/munge._pad_rows)
+        return jnp.pad(buf, (0, cap_new - cap_old),
+                       constant_values=fill)
     return kern
 
 
@@ -107,17 +122,19 @@ def _build_append_write(cap: int, ch: int):
 
 
 @jax.jit
-def _rollups_matrix_kernel(matrix: jax.Array, nrows: jax.Array):
+def _rollups_matrix_kernel(matrix: jax.Array, rowvalid: jax.Array):
     """Fused single-pass rollup stats over ALL columns of a padded, sharded
     (rows, cols) matrix at once.
 
     Equivalent of the RollupStats MRTask (water/fvec/RollupStats.java), but
     batched column-wise: the reference computes rollups one Vec at a time
     (one MRTask each); here one XLA program covers the whole frame, and the
-    row sharding makes every axis-0 reduction an ICI psum.
+    row sharding makes every axis-0 reduction an ICI psum.  ``rowvalid``
+    is the row-validity predicate — a plain ``iota < nrows`` prefix for
+    canonical frames, the per-shard-count mask for ragged ones — so the
+    kernel consumes sharded inputs as-is, no reshard or repack first.
     """
-    idx = jnp.arange(matrix.shape[0])[:, None]
-    valid = idx < nrows
+    valid = rowvalid[:, None]
     isna = jnp.isnan(matrix) & valid
     ok = valid & ~isna
     x = jnp.where(ok, matrix, 0.0)
@@ -137,11 +154,10 @@ def _rollups_matrix_kernel(matrix: jax.Array, nrows: jax.Array):
 
 
 @functools.partial(jax.jit, static_argnames=("nbins",))
-def _hist_kernel(data: jax.Array, nrows: jax.Array, vmin, vmax,
+def _hist_kernel(data: jax.Array, rowvalid: jax.Array, vmin, vmax,
                  nbins: int = 64):
     """Lazy fixed-width histogram for one column (REST frame summaries)."""
-    idx = jnp.arange(data.shape[0])
-    ok = (idx < nrows) & ~jnp.isnan(data)
+    ok = rowvalid & ~jnp.isnan(data)
     span = jnp.maximum(vmax - vmin, 1e-30)
     b = jnp.clip(((data - vmin) / span * nbins).astype(jnp.int32), 0,
                  nbins - 1)
@@ -170,13 +186,22 @@ class Vec:
     """One column.  Numeric/categorical/time payloads live on-device."""
 
     def __init__(self, data, vtype: str = T_NUM, nrows: Optional[int] = None,
-                 domain: Optional[List[str]] = None):
+                 domain: Optional[List[str]] = None,
+                 shard_counts: Optional[np.ndarray] = None):
         self.type = vtype
         self.domain = domain
         self._rollups: Optional[RollupStats] = None
         self._hist: Optional[np.ndarray] = None
         self._host_f64: Optional[np.ndarray] = None
         self._spill_np: Optional[np.ndarray] = None   # parked host copy
+        # ragged shard layout (sharded filter/merge outputs): valid rows
+        # are a PER-SHARD prefix; shard_counts[s] rows of shard s are
+        # real, the rest is masked padding.  None = canonical global
+        # prefix (iota < nrows).
+        self.shard_counts = (np.asarray(shard_counts, np.int64)
+                             if shard_counts is not None else None)
+        if self.shard_counts is not None and nrows is None:
+            nrows = int(self.shard_counts.sum())
         import threading as _th
         self._spill_lock = _th.Lock()   # guards _data <-> _spill_np swaps
         if vtype in (T_STR, T_UUID):
@@ -278,6 +303,47 @@ class Vec:
         return self.type in (T_NUM, T_TIME)
 
     @property
+    def is_ragged(self) -> bool:
+        """True when valid rows are a per-shard prefix (shard_counts)
+        rather than one global prefix."""
+        return self.shard_counts is not None
+
+    @property
+    def is_row_sharded(self) -> bool:
+        """Cheap shard-residency invariant: the device payload exists and
+        is sharded over the mesh's ``nodes`` axis (the chunk-homing
+        contract the scale-out munge verbs rely on).  Checked against
+        the CURRENT cloud — a payload left over from a pre-``reform``
+        mesh reads False."""
+        with self._spill_lock:
+            d = self._data
+        if d is None:
+            return False
+        try:
+            from h2o_tpu.core.cloud import DATA_AXIS, cloud
+            spec = d.sharding.spec
+            if not spec or spec[0] != DATA_AXIS:
+                return False
+            return d.sharding.mesh.devices.ravel()[0] in set(
+                cloud().mesh.devices.ravel())
+        except Exception:  # noqa: BLE001 — single-device/host arrays
+            return False
+
+    def valid_mask(self) -> jax.Array:
+        """Row-validity predicate over the device payload: a global
+        prefix for canonical Vecs, the per-shard prefix for ragged ones.
+        This is the ONE mask every munge collective and reduction
+        kernel consumes — padding is masked, never re-gathered."""
+        B = self._device_rows() or _row_pad(self.nrows)
+        idx = jnp.arange(B)
+        if self.shard_counts is None:
+            return idx < self.nrows
+        n = len(self.shard_counts)
+        L = B // n
+        counts = jnp.asarray(self.shard_counts, jnp.int32)
+        return idx % L < jnp.take(counts, idx // L)
+
+    @property
     def cardinality(self) -> int:
         return len(self.domain) if self.domain is not None else -1
 
@@ -303,11 +369,23 @@ class Vec:
         with self._spill_lock:
             if self._data is None and self._spill_np is not None:
                 # host reads of spilled columns never touch the device
-                return self._spill_np[: self.nrows]
+                return self._compact_host(self._spill_np)
         from h2o_tpu.core.diag import DispatchStats
         arr = np.asarray(self.data)
         DispatchStats.note_host_pull(arr.nbytes)
-        return arr[: self.nrows]
+        return self._compact_host(arr)
+
+    def _compact_host(self, arr: np.ndarray) -> np.ndarray:
+        """Unpadded host view: global prefix for canonical Vecs; ragged
+        Vecs concatenate each shard's valid prefix (host-side — the
+        ragged->canonical device path is Frame.repack)."""
+        if self.shard_counts is None:
+            return arr[: self.nrows]
+        n = len(self.shard_counts)
+        L = arr.shape[0] // n
+        blocks = arr.reshape((n, L) + arr.shape[1:])
+        return np.concatenate([blocks[s][: int(c)]
+                               for s, c in enumerate(self.shard_counts)])
 
     # -- rollups -----------------------------------------------------------
 
@@ -317,7 +395,7 @@ class Vec:
             from h2o_tpu.core.diag import DispatchStats
             DispatchStats.note_dispatch("rollups")
             d = _rollups_matrix_kernel(self.as_float()[:, None],
-                                       jnp.int32(self.nrows))
+                                       self.valid_mask())
             self._rollups = RollupStats(
                 {k: np.asarray(v)[0] for k, v in d.items()}, vec=self)
         return self._rollups
@@ -326,7 +404,7 @@ class Vec:
         r = self.rollups
         if self._hist is None or len(self._hist) != nbins:
             self._hist = np.asarray(_hist_kernel(
-                self.as_float(), jnp.int32(self.nrows),
+                self.as_float(), self.valid_mask(),
                 jnp.float32(r.min), jnp.float32(r.max), nbins))
         return self._hist
 
@@ -348,8 +426,7 @@ class Vec:
             # kernel; counted as a device reduction (one scalar syncs)
             # instead of pulling the whole code column to host
             d = self.data
-            valid = jnp.arange(d.shape[0]) < self.nrows
-            return int(jnp.sum((d < 0) & valid))
+            return int(jnp.sum((d < 0) & self.valid_mask()))
         return int(self.rollups.nacnt)
 
     def invalidate(self) -> None:
@@ -393,6 +470,11 @@ class Vec:
             self.host_data.extend(list(values))
             self.nrows = len(self.host_data)
             return
+        if self.shard_counts is not None:
+            raise ValueError(
+                "cannot append to a ragged (shard-prefix) Vec — call "
+                "Frame.repack() first to restore the canonical prefix "
+                "layout the append block-writes assume")
         arr = np.asarray(values)
         n_new = int(arr.shape[0])
         if n_new == 0:
@@ -449,6 +531,36 @@ class Vec:
         self.data = new                # setter re-registers with the MM
         self.invalidate()
 
+    # -- mesh resize (Cloud.reform) ----------------------------------------
+
+    def _rehome(self) -> None:
+        """Re-land the payload on the CURRENT cloud's mesh — the mesh-
+        resize event (Cloud.reform).  The payload bounces through host
+        once (the resize is a topology change, not a hot-path verb):
+        padding quantum and sharding both depend on the mesh shape, so
+        the old device buffer cannot be reused.  Ragged Vecs compact to
+        the canonical prefix layout as part of the move."""
+        if self.host_data is not None or self._data is None and \
+                self._spill_np is None:
+            return
+        from h2o_tpu.core.memory import manager
+        with self._spill_lock:
+            src = self._spill_np if self._data is None else \
+                np.asarray(self._data)
+        arr = self._compact_host(src)
+        manager().unregister(self)
+        with self._spill_lock:
+            self._spill_np = None
+            if self.type == T_CAT:
+                self._data = cloud().device_put_rows(
+                    arr.astype(np.int32, copy=False))
+            else:
+                self._data = cloud().device_put_rows(
+                    arr.astype(np.float32, copy=False))
+        self.shard_counts = None
+        self._account()
+        self.invalidate()
+
     # -- in-place mutation (donating) --------------------------------------
 
     def map_inplace(self, fn, *extras) -> None:
@@ -498,6 +610,7 @@ class SparseVec(Vec):
         self._hist = None
         self._host_f64 = None
         self._spill_np = None
+        self.shard_counts = None             # sparse vecs are canonical
         self._spill_lock = _th.Lock()
         self._sparse = (idx, vals, np.float32(default))
         self._data = None                    # dense device form, lazy
@@ -549,6 +662,17 @@ class SparseVec(Vec):
                 return False
             self._data = None
             return True
+
+    def _rehome(self) -> None:
+        if self._sparse is None:
+            Vec._rehome(self)
+            return
+        # sparse source is authoritative: drop the dense copy and let
+        # the next access re-densify onto the new mesh
+        from h2o_tpu.core.memory import manager
+        manager().unregister(self)
+        with self._spill_lock:
+            self._data = None
 
     def to_numpy(self) -> np.ndarray:
         if self._sparse is None:
@@ -656,6 +780,30 @@ class Frame:
             n = max(n, v._device_rows())
         return n
 
+    @property
+    def is_ragged(self) -> bool:
+        return any(v.is_ragged for v in self.vecs)
+
+    @property
+    def is_row_sharded(self) -> bool:
+        """Shard-residency invariant for the whole frame: every column's
+        payload lives row-sharded on the current mesh."""
+        return bool(self.vecs) and all(v.is_row_sharded
+                                       for v in self.vecs)
+
+    def repack(self) -> "Frame":
+        """Restore the canonical global-prefix layout IN PLACE after a
+        ragged-producing collective (sharded filter/merge): one balanced
+        ``all_to_all`` exchange on device — rows move shard-to-shard
+        over the interconnect, never through host, and never replicate.
+        No-op for canonical frames."""
+        if not self.is_ragged:
+            return self
+        from h2o_tpu.core.munge import repack_frame
+        repack_frame(self)
+        self._matrix_cache.clear()
+        return self
+
     def vec(self, name: str) -> Vec:
         return self.vecs[self.names.index(name)]
 
@@ -733,8 +881,10 @@ class Frame:
         A ``jax.Array`` boolean mask routes through the device-munge
         compaction kernel (core/munge.py): the mask never materializes
         on host, rows are selected by a cumsum-of-mask gather on device,
-        and only the surviving row COUNT syncs back.  Host masks/index
-        lists keep the host gather + re-upload path."""
+        and only the surviving row COUNT syncs back.  Integer index
+        arrays (the rapids numlist path) route through the device
+        ``take`` kernel — a sharded gather, no column round-trips host.
+        Host boolean masks keep the host gather + re-upload path."""
         if isinstance(mask_or_idx, jax.Array):
             from h2o_tpu.core.munge import device_munge_enabled, filter_rows
             if device_munge_enabled() and frame_device_ok(self):
@@ -742,6 +892,10 @@ class Frame:
             mask_or_idx = np.asarray(mask_or_idx)[: self.nrows]
         sel = np.asarray(mask_or_idx)
         idx = np.flatnonzero(sel) if sel.dtype == bool else sel
+        if sel.dtype != bool and np.issubdtype(sel.dtype, np.integer):
+            from h2o_tpu.core.munge import device_munge_enabled, take_rows
+            if device_munge_enabled() and frame_device_ok(self):
+                return take_rows(self, np.asarray(idx, np.int64))
         vecs = []
         for v in self.vecs:
             if v.host_data is not None:
@@ -762,6 +916,10 @@ class Frame:
         fused "decompress chunks into a dense row block" analog of
         DataInfo row extraction (hex/DataInfo.java), but done once.
         """
+        if self.is_ragged:
+            # training/metrics kernels assume the canonical prefix; the
+            # repack is one balanced device exchange, not a host gather
+            self.repack()
         names = tuple(names) if names is not None else tuple(self.names)
         ck = (names, jnp.dtype(dtype).name)
         m = self._matrix_cache.get(ck)
@@ -780,7 +938,10 @@ class Frame:
         return m
 
     def row_mask(self) -> jax.Array:
-        """Validity predicate over padded rows."""
+        """Validity predicate over padded rows (ragged-aware: all vecs of
+        a munge-built frame share one shard layout)."""
+        if self.vecs and self.vecs[0].is_ragged:
+            return self.vecs[0].valid_mask()
         return jnp.arange(self.padded_rows) < self.nrows
 
     def fill_rollups(self, names: Optional[Sequence[str]] = None) -> None:
@@ -797,7 +958,7 @@ class Frame:
         DispatchStats.note_dispatch("rollups")
         m = self.as_matrix(todo)
         d = jax.tree.map(np.asarray,
-                         _rollups_matrix_kernel(m, jnp.int32(self.nrows)))
+                         _rollups_matrix_kernel(m, self.row_mask()))
         for j, n in enumerate(todo):
             v = self.vec(n)
             v._rollups = RollupStats({k: d[k][j] for k in d}, vec=v)

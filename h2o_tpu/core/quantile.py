@@ -27,15 +27,15 @@ _NBINS = 512
 
 
 @functools.partial(jax.jit, static_argnames=("nbins",))
-def _refine(data, nrows, los, his, ranks, nbins: int = _NBINS):
+def _refine(data, rowvalid, los, his, ranks, nbins: int = _NBINS):
     """One refinement round for a batch of quantile brackets.
 
-    data: (padded_rows,) sharded column; los/his/ranks: (P,) per-prob
-    bracket bounds and remaining target rank within the bracket.
-    Returns new (los, his, ranks) with each bracket narrowed ~nbins-fold.
+    data: (padded_rows,) sharded column; rowvalid: its row-validity
+    predicate (prefix or ragged-shard mask); los/his/ranks: (P,)
+    per-prob bracket bounds and remaining target rank within the
+    bracket.  Returns new (los, his, ranks) narrowed ~nbins-fold.
     """
-    idx = jnp.arange(data.shape[0])
-    ok = (idx < nrows) & ~jnp.isnan(data)
+    ok = rowvalid & ~jnp.isnan(data)
 
     def one(lo, hi, rank):
         span = jnp.maximum(hi - lo, 1e-37)
@@ -72,14 +72,40 @@ def quantile_vec(vec: Vec, probs: Union[float, Sequence[float]],
     his = jnp.full(ps.shape, np.nextafter(r.max, np.inf), data.dtype)
     # target rank = p*(n-1) (type-7 style index; fractional part refined away)
     ranks = jnp.asarray(ps * (n - 1), data.dtype)
-    nrows = jnp.int32(vec.nrows)
+    rowvalid = vec.valid_mask()
     from h2o_tpu.core.diag import DispatchStats
     for _ in range(rounds):
         DispatchStats.note_dispatch("quantile")
-        los, his, ranks = _refine(data, nrows, los, his, ranks)
+        los, his, ranks = _refine(data, rowvalid, los, his, ranks)
     out = np.asarray(los, np.float64)
     DispatchStats.note_transfer("quantile", out.nbytes)
     return out[0] if scalar else out
+
+
+def segment_median(vals, ok, inv, B: int, Gb: int):
+    """Per-group EXACT median (traced helper; core/munge.py's group-by
+    device path calls it inside the fused aggregate kernel).
+
+    The iterative-histogram refinement above converges to the lower
+    bracket value, but the reference's group-by median (AstGroup ->
+    AstMedian) is ``np.median`` — the midpoint of the two middle order
+    statistics — so this is a sort-based order-statistic pass instead:
+    one lexsort by (group, value) with NA/invalid rows keyed last, then
+    each group's middle element(s) are picked by its boundary offsets.
+    ``vals`` (B,) values, ``ok`` (B,) valid-and-not-NA, ``inv`` (B,)
+    dense group codes, ``Gb`` the group-count bucket."""
+    BIG = jnp.int32(1 << 30)
+    gkey = jnp.where(ok, inv, BIG)
+    order = jnp.lexsort((jnp.where(ok, vals, jnp.inf), gkey))
+    vs = jnp.take(vals, order)
+    gs = jnp.take(gkey, order)
+    starts = jnp.searchsorted(gs, jnp.arange(Gb))
+    cnt = jax.ops.segment_sum(ok.astype(jnp.int32), inv,
+                              num_segments=Gb)
+    lo = jnp.clip(starts + jnp.maximum(cnt - 1, 0) // 2, 0, B - 1)
+    hi = jnp.clip(starts + cnt // 2, 0, B - 1)
+    med = (jnp.take(vs, lo) + jnp.take(vs, hi)) * 0.5
+    return jnp.where(cnt > 0, med, jnp.nan)
 
 
 def quantile(frame: Frame, probs: Sequence[float],

@@ -134,6 +134,21 @@ def _accum_donate(F, delta):
     return F + delta
 
 
+def _fit_rows(arr: np.ndarray, want: int) -> np.ndarray:
+    """Re-fit a checkpointed per-row carry (F, scorer F) to the CURRENT
+    mesh's padded row count.  A checkpoint written on a different mesh
+    shape (Cloud.reform) padded to a different row quantum; the valid
+    prefix is identical — rows beyond it are masked everywhere — so the
+    resize is a pure pad/truncate of the masked tail."""
+    arr = np.asarray(arr)
+    if arr.shape[0] == want:
+        return arr
+    if arr.shape[0] > want:
+        return arr[:want]
+    pad = np.zeros((want - arr.shape[0],) + arr.shape[1:], arr.dtype)
+    return np.concatenate([arr, pad])
+
+
 _CKPT_LISTS = ("scs", "bss", "vls", "chs", "gns", "nws", "ths", "nas")
 
 # TrainedForest fields pulled to the host per block (child may be None)
@@ -249,7 +264,7 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
                 st.get("ntrees_target") == ntrees and \
                 st.get("block") == block:
             done = int(st["done"])
-            F = jnp.asarray(st["F"])
+            F = jnp.asarray(_fit_rows(st["F"], int(F0.shape[0])))
             key = rng_key_from_np(st["key"])
             for n in _CKPT_LISTS:
                 lists[n].extend(st["lists"][n])
@@ -257,7 +272,8 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
             if st.get("sk") is not None:
                 sk = st["sk"]
             if scorer is not None and st.get("scorer_F") is not None:
-                scorer.F = jnp.asarray(st["scorer_F"])
+                scorer.F = jnp.asarray(_fit_rows(
+                    st["scorer_F"], int(scorer.F.shape[0])))
             job.update(0.05 + 0.85 * done / ntrees,
                        f"resumed mid-forest at {prior_trees + done} trees")
     use_async = async_driver_enabled()

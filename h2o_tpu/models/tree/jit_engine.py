@@ -802,13 +802,22 @@ def train_forest(*args, sibling: Optional[bool] = None,
     from h2o_tpu.core.oom import kernel_fallback
     DispatchStats.note_dispatch("tree_block")
 
+    # the traced body bakes cloud().mesh into its shard_map (the
+    # histogram collective), and jit's TRACE cache keys on shapes only —
+    # so the store entry must key on the mesh, or a Cloud.reform to a
+    # different shape would replay a jaxpr built for the old device set
+    from h2o_tpu.core.cloud import cloud
+    mesh_fp = (cloud().mesh.devices.shape,
+               tuple(d.id for d in cloud().mesh.devices.ravel()))
+
     def run(pallas: bool):
         fn = exec_store().get_or_build(
-            "tree_block", ("train_forest",),
+            "tree_block", ("train_forest", mesh_fp),
             lambda: _train_forest_impl,
             jit_kwargs={"static_argnames": _TF_STATIC},
             donate_argnames=("F0",), donate=donate)
-        return fn(*args, sibling=sibling, hist_pallas=pallas, **kwargs)
+        return fn(*args, sibling=sibling, hist_pallas=pallas,
+                  mesh_fp=mesh_fp, **kwargs)
 
     return kernel_fallback("tree.block", run, pallas=hist_pallas)
 
@@ -822,7 +831,7 @@ _TF_STATIC = ("dist_name", "K", "ntrees", "max_depth", "nbins",
               "col_sample_rate_per_tree", "use_mono",
               "kleaves", "custom_dist", "sibling",
               "adaptive", "fine_nbins", "hist_random",
-              "hist_pallas", "mm_route")
+              "hist_pallas", "mm_route", "mesh_fp")
 
 
 def _train_forest_impl(bins, yv, w, active, F0, is_cat, key, *,
@@ -843,8 +852,16 @@ def _train_forest_impl(bins, yv, w, active, F0, is_cat, key, *,
                  adaptive: bool = False, fine_nbins: int = 0,
                  hist_random: bool = False,
                  hist_pallas: bool = False,
-                 mm_route: bool = False) -> TrainedForest:
+                 mm_route: bool = False,
+                 mesh_fp=None) -> TrainedForest:
     """The WHOLE forest training loop as one XLA program.
+
+    ``mesh_fp`` is a STATIC fingerprint of the cloud mesh, unused in the
+    body: the histogram collective traces ``cloud().mesh`` into its
+    shard_map, and jax's trace cache is shared across jit wrappers of
+    the same function and keyed on avals (shapes, not device sets) — so
+    after a Cloud.reform/boot to a new mesh shape, an unchanged
+    signature would replay a jaxpr built for the OLD device set.
 
     mode="gbm": boosting — stats from distribution gradients at current F,
     f updated after each iteration, leaf values scaled by learn_rate.

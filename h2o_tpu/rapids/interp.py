@@ -137,6 +137,18 @@ def _as_frame(v) -> Frame:
     raise TypeError(f"expected frame, got {type(v)}")
 
 
+def _dense(fr: Frame) -> Frame:
+    """Canonical-prefix view of a possibly-RAGGED frame (a sharded
+    filter/merge output whose valid rows are per-shard prefixes).  The
+    four munge verbs consume ragged frames directly by masking; every
+    OTHER device consumer (elementwise math, cumops, ifelse, updates)
+    assumes the global prefix, so it repacks here first — one balanced
+    all_to_all on device, never a host gather."""
+    if isinstance(fr, Frame) and fr.is_ragged:
+        fr.repack()
+    return fr
+
+
 def _string_compare(op, a, b):
     """==/!= against a string literal over str/categorical columns (the
     reference compares level names, not codes — AstEq string semantics).
@@ -152,6 +164,7 @@ def _string_compare(op, a, b):
         fr, lit = a, str(b)
     if fr is None or not any(v.type in (T_STR, T_CAT) for v in fr.vecs):
         return None
+    _dense(fr)
     vecs = []
     for v in fr.vecs:
         if v.type == T_STR:
@@ -183,6 +196,10 @@ def _elementwise(op, a, b=None, name=None):
     Binary ops that involve a T_TIME column run on the exact float64
     host copies instead of the f32 device payload (epoch-ms rounding,
     see _NP_BINOPS note)."""
+    if isinstance(a, Frame):
+        _dense(a)
+    if isinstance(b, Frame):
+        _dense(b)
     if b is not None and name in _NP_BINOPS and \
             (_has_time(a) or _has_time(b)):
         npop = _NP_BINOPS[name]
@@ -300,6 +317,16 @@ def _row_select(fr: Frame, sel, sess) -> Frame:
         idx = np.asarray(_expand_numlist([sel]), np.int64)
     else:
         idx = np.asarray([int(sel)], np.int64)
+    from h2o_tpu.core.munge import device_munge_enabled
+    from h2o_tpu.core.oom import oom_ladder
+    if device_munge_enabled() and frame_device_ok(fr) and \
+            np.all(idx >= 0):
+        # explicit index lists run as a device gather (munge.take_rows):
+        # the index uploads once, no column round-trips host
+        return oom_ladder(
+            "munge.take", lambda: fr.slice_rows(idx),
+            host_fallback=lambda: _host_oracle(_row_select_host, fr,
+                                               idx))
     return _row_select_host(fr, idx)
 
 
@@ -435,7 +462,7 @@ def _eval(node, env: _Env):
         fr.names = list(names)
         return fr
     if op == "cbind":
-        frames = [_as_frame(_eval(a, env)) for a in node[1:]]
+        frames = [_dense(_as_frame(_eval(a, env))) for a in node[1:]]
         out = frames[0]
         for f2 in frames[1:]:
             out = out.cbind(f2)
@@ -541,14 +568,18 @@ def _eval(node, env: _Env):
         cond = _eval(node[1], env)
         a = _eval(node[2], env)
         b = _eval(node[3], env)
-        cf = _as_frame(cond)
+        cf = _dense(_as_frame(cond))
+        if isinstance(a, Frame):
+            _dense(a)
+        if isinstance(b, Frame):
+            _dense(b)
         cv = cf.vecs[0].as_float()
         av = a.vecs[0].as_float() if isinstance(a, Frame) else a
         bv = b.vecs[0].as_float() if isinstance(b, Frame) else b
         return Frame(["ifelse"],
                      [Vec(jnp.where(cv != 0, av, bv), nrows=cf.nrows)])
     if op in ("asfactor", "as.factor"):
-        fr = _as_frame(_eval(node[1], env))
+        fr = _dense(_as_frame(_eval(node[1], env)))
         out = []
         for v in fr.vecs:
             if v.is_categorical:
@@ -563,7 +594,7 @@ def _eval(node, env: _Env):
                 out.append(Vec(codes, T_CAT, domain=dom))
         return Frame(list(fr.names), out)
     if op in ("asnumeric", "as.numeric"):
-        fr = _as_frame(_eval(node[1], env))
+        fr = _dense(_as_frame(_eval(node[1], env)))
         out = []
         for v in fr.vecs:
             if v.is_categorical:
@@ -616,7 +647,7 @@ def _eval(node, env: _Env):
     if op == "table":
         return _table(node, env)
     if op in _CUMOPS:
-        fr = _as_frame(_eval(node[1], env))
+        fr = _dense(_as_frame(_eval(node[1], env)))
         fn = _CUMOPS[op]
         vecs = []
         for v in fr.vecs:
@@ -674,8 +705,8 @@ def _eval(node, env: _Env):
     if op == ":=":
         return _update(node, env)
     if op == "append":
-        fr = _as_frame(_eval(node[1], env))
-        col = _as_frame(_eval(node[2], env))
+        fr = _dense(_as_frame(_eval(node[1], env)))
+        col = _dense(_as_frame(_eval(node[2], env)))
         name = _lit(node[3])
         out = Frame(list(fr.names), list(fr.vecs))
         out.add(name, col.vecs[0])
@@ -739,9 +770,12 @@ def _sort(node, env):
 
 
 def _sort_host(fr: Frame, idxs, asc) -> Frame:
-    """Host lexsort fallback and parity oracle for the device sort."""
+    """Host lexsort fallback and parity oracle for the device sort.
+    Gathers via the pure-host row-select (NOT Frame.slice_rows, whose
+    integer-index path now routes to the device take kernel — an oracle
+    must never dispatch the layer it oracles)."""
     order = _sort_keys(fr, idxs, asc)
-    return fr.slice_rows(order)
+    return _row_select_host(fr, order)
 
 
 def _key_codes(fr: Frame, cols: List[int]):
@@ -904,10 +938,11 @@ _GB_AGGS = ("min", "max", "mean", "sum", "sd", "var", "nrow", "count",
 
 def _groupby(node, env):
     """(GB fr [group_idxs] agg col na_method ...) — AstGroup.java.
-    Device path (core/munge.groupby_frame): factorize keys on device,
-    run the whole aggregate bundle as one fused segment-reduction pass;
-    only the group count syncs.  median/mode (per-group sorts) and
-    non-device frames fall back to the host path."""
+    Device path (core/munge.groupby_frame): shard-resident partials +
+    cross-shard combine for the combinable bundle (or the global fused
+    segment pass, incl. device median via the segment order-statistic
+    kernel); only the group count syncs.  ``mode`` (per-group bincount
+    argmax) and non-device frames fall back to the host path."""
     fr = _as_frame(_eval(node[1], env))
     gcols = [int(x) for x in node[2][1]]
     aggs = []
@@ -1179,8 +1214,10 @@ def _time_part(op, node, env):
 
 def _update(node, env):
     """(:= fr rhs col_idxs row_sel) — in-place column/cell update."""
-    fr = _as_frame(_eval(node[1], env))
+    fr = _dense(_as_frame(_eval(node[1], env)))
     rhs = _eval(node[2], env)
+    if isinstance(rhs, Frame):
+        _dense(rhs)
     cols = _col_indices(fr, node[3] if isinstance(node[3], tuple)
                         else _eval(node[3], env))
     row_sel = node[4] if len(node) > 4 else None
@@ -1202,6 +1239,7 @@ def _update(node, env):
                 else row_sel
             old = old_vec.to_numpy().astype(np.float64)
             if isinstance(sel, Frame):
+                _dense(sel)
                 mask = np.asarray(sel.vecs[0].data)[: fr.nrows] > 0
             else:
                 if isinstance(sel, tuple):
@@ -1233,7 +1271,7 @@ def _update(node, env):
 def _impute(node, env):
     """(h2o.impute fr col method combine_method [gb_cols] ...) — mean/
     median/mode imputation (ast/prims/advmath/AstImpute)."""
-    fr = _as_frame(_eval(node[1], env))
+    fr = _dense(_as_frame(_eval(node[1], env)))
     col = int(_eval(node[2], env))
     method = _lit(node[3]) if len(node) > 3 else "mean"
     v = fr.vecs[col]

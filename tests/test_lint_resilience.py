@@ -200,6 +200,66 @@ def test_stream_append_verbs_still_exist():
     assert not missing, f"stream append verbs missing: {sorted(missing)}"
 
 
+# The SHARDED munge collectives (ISSUE 8) keep rows home-sharded: a
+# full-array jax.device_get / Vec.to_numpy in a sharded verb body pulls
+# a whole frame across the host, and a device_put with the REPLICATED
+# sharding gathers every row onto every device — both silently undo the
+# shard-residency contract.  (The small per-shard count syncs are
+# np.asarray of (n,)-sized replicated outputs, which this lint allows.)
+SHARD_MUNGE_VERBS = {
+    "_shard_sort_frame", "sort_frame", "filter_rows", "repack_frame",
+    "take_rows", "_shard_groupby", "_shard_merge", "_global_groupby",
+    "_global_merge", "_build_shard_sort", "_build_shard_filter",
+    "_build_shard_repack", "_build_shard_group_count",
+    "_build_shard_group_aggs", "_build_shard_merge_match",
+    "_build_shard_merge_emit", "_route"}
+
+
+def _attr_hits(tree, attrs, only_functions=None):
+    """(function, line) pairs referencing any attribute in ``attrs``
+    inside the named top-level function bodies."""
+    hits = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if only_functions is not None and node.name not in only_functions:
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) and sub.attr in attrs:
+                hits.append((node.name, sub.lineno, sub.attr))
+    return hits
+
+
+def test_no_host_gather_in_sharded_munge_verbs():
+    pkg_root = os.path.dirname(h2o_tpu.__file__)
+    munge = os.path.join(pkg_root, "core", "munge.py")
+    with open(munge, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    offenders = [
+        f"core/munge.py:{ln} in {fn}(): .{attr}"
+        for fn, ln, attr in _attr_hits(
+            tree, {"device_get", "to_numpy", "replicated"},
+            SHARD_MUNGE_VERBS)]
+    assert not offenders, (
+        "full-array device_get/to_numpy/replicated-sharding use inside "
+        "a SHARDED munge verb — rows must stay home-sharded; only the "
+        "per-shard counts / group tables may leave the device:\n"
+        + "\n".join(offenders))
+
+
+def test_sharded_munge_verbs_still_exist():
+    """The collective verbs the lint above polices are the ISSUE-8
+    contract — renaming one away silently un-scopes the lint."""
+    pkg_root = os.path.dirname(h2o_tpu.__file__)
+    munge = os.path.join(pkg_root, "core", "munge.py")
+    with open(munge, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    names = {n.name for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    missing = (SHARD_MUNGE_VERBS - {"_shard_sort_frame"}) - names
+    assert not missing, f"sharded munge verbs missing: {sorted(missing)}"
+
+
 def test_munge_host_fallbacks_still_exist():
     """The host oracle is part of the contract (H2O_TPU_DEVICE_MUNGE=0
     must keep working) — renaming a fallback away breaks the parity

@@ -1,0 +1,181 @@
+"""Mesh-resize: Cloud.reform + checkpoint/resume across device counts.
+
+Closes the ROADMAP line "checkpoint/resume must survive a mesh resize".
+The drill: a forest trained WITH iteration checkpoints on a 4x2
+nodes x model mesh dies mid-forest; the cloud re-forms on a smaller
+mesh (2x2, then 1x1) and ``auto_recover`` resumes the build there.  The
+resumed forest must be BITWISE equal to an uninterrupted run on the
+resumed mesh — the PR 5 absolute-tree-index RNG keys continue the exact
+stream, the driver re-fits the checkpointed F carry to the new row
+quantum, and the training data re-lands via the recovery snapshot.
+
+The drill's dataset is arranged so every row-reduction feeding the
+FIRST tree block is exact in f32 (integer features, y in {0, 1}, a
+power-of-two row count, UniformAdaptive min/max split points): exact
+sums are order-independent, so the checkpointed block is bitwise
+IDENTICAL no matter which mesh shape computed it — the anchor that
+makes cross-mesh resume equality well-defined.  (Later blocks involve
+rounded leaf values whose histogram sums are reduction-order-dependent,
+i.e. mesh-shaped — which is exactly why the comparison baseline runs on
+the RESUMED mesh.)
+"""
+
+import numpy as np
+import pytest
+
+FOREST_KEYS = ("split_col", "value", "thr_bin", "bitset", "na_left")
+
+
+@pytest.fixture()
+def reboot():
+    """Boot/resize meshes inside a test, restoring the ORIGINAL session
+    Cloud INSTANCE at teardown (see test_shard_munge.reboot: a fresh
+    boot would strand the session fixture's DKV on a dead object)."""
+    from h2o_tpu.core.cloud import Cloud
+    saved = Cloud._instance
+
+    def boot(n, m):
+        return Cloud.boot(nodes=n, model_axis=m)
+
+    yield boot
+    with Cloud._lock:
+        Cloud._instance = saved
+
+
+def _exact_frame():
+    """Integer features, y in {0,1}, 512 rows: every tree-1 reduction is
+    exact in f32 (see module docstring)."""
+    from h2o_tpu.core.frame import Frame, Vec
+    rng = np.random.default_rng(5)
+    n = 512
+    x0 = rng.integers(0, 16, size=n).astype(np.float32)
+    x1 = rng.integers(0, 8, size=n).astype(np.float32)
+    x2 = rng.integers(0, 4, size=n).astype(np.float32)
+    y = ((x0 + 2 * x1 + x2) % 2).astype(np.float32)
+    return Frame(["x0", "x1", "x2", "y"],
+                 [Vec(x0), Vec(x1), Vec(x2), Vec(y)])
+
+
+def _gbm(**kw):
+    from h2o_tpu.models.tree.gbm import GBM
+    return GBM(ntrees=4, max_depth=3, seed=7, nbins=16, learn_rate=0.5,
+               distribution="gaussian", histogram_type="UniformAdaptive",
+               **kw)
+
+
+def _forest_arrays(model):
+    return {k: np.asarray(model.output[k]) for k in FOREST_KEYS
+            if model.output.get(k) is not None}
+
+
+def test_cloud_reform_rehomes_dkv_frames(cl, reboot):
+    """reform keeps the control plane (DKV, jobs) and re-lands every
+    stored Frame on the new mesh — including ragged munge outputs,
+    which compact to the canonical prefix as part of the move."""
+    from h2o_tpu.core import munge
+    from h2o_tpu.core.cloud import Cloud, cloud
+    reboot(4, 2)
+    from h2o_tpu.core.frame import Frame, Vec
+    x = np.arange(96, dtype=np.float32)
+    fr = Frame(["x"], [Vec(x)])
+    ragged = munge.filter_rows(fr, fr.vec("x").data % 2 == 0)
+    assert ragged.is_ragged
+    cloud().dkv.put("resize_src", fr)
+    cloud().dkv.put("resize_ragged", ragged)
+    jobs = cloud().jobs
+    try:
+        cl2 = Cloud.reform(nodes=2, model_axis=1)
+        assert cl2.n_nodes == 2
+        assert cl2.jobs is jobs                 # control plane carried
+        fr2 = cl2.dkv.get("resize_src")
+        assert fr2 is fr and fr2.is_row_sharded
+        np.testing.assert_array_equal(fr2.vec("x").to_numpy(), x)
+        rg2 = cl2.dkv.get("resize_ragged")
+        assert not rg2.is_ragged                # compacted on the move
+        np.testing.assert_array_equal(rg2.vec("x").to_numpy(), x[::2])
+    finally:
+        cloud().dkv.remove("resize_src", force=True)
+        cloud().dkv.remove("resize_ragged", force=True)
+
+
+def _crash_after_first_block(jit_engine):
+    class Crash(BaseException):
+        """Process-death stand-in (not an Exception)."""
+
+    calls = {"n": 0}
+    orig = jit_engine.train_forest
+
+    def crashy(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise Crash("simulated death mid-forest")
+        return orig(*a, **k)
+
+    return Crash, crashy, orig
+
+
+@pytest.mark.parametrize("target", [(1, 1), (2, 2)])
+def test_forest_mesh_resize_resume_bitwise(cl, reboot, tmp_path,
+                                           target):
+    """Checkpoint on 4x2, die, reform to ``target``, resume: the forest
+    equals the uninterrupted run on the target mesh bit-for-bit."""
+    from h2o_tpu.core.cloud import Cloud
+    from h2o_tpu.core.recovery import auto_recover, pending_recoveries
+    from h2o_tpu.models.tree import jit_engine
+    tn, tm = target
+    rec = str(tmp_path / f"rec_{tn}x{tm}")
+
+    # uninterrupted baseline on the TARGET mesh
+    reboot(tn, tm)
+    m_ref = _gbm().train(y="y", training_frame=_exact_frame())
+    ref = _forest_arrays(m_ref)
+    pred_ref = np.asarray(m_ref.predict_raw(_exact_frame()))
+
+    # train on 4x2 with per-tree checkpoints; die after block 1 landed
+    reboot(4, 2)
+    Crash, crashy, orig = _crash_after_first_block(jit_engine)
+    jit_engine.train_forest = crashy
+    try:
+        with pytest.raises(Crash):
+            _gbm(recovery_dir=rec, checkpoint_interval=1,
+                 model_id=f"resize_gbm_{tn}x{tm}").train(
+                y="y", training_frame=_exact_frame())
+    finally:
+        jit_engine.train_forest = orig
+    pend = pending_recoveries(rec)
+    assert len(pend) == 1 and pend[0]["has_iteration_checkpoint"]
+    assert pend[0]["iteration"]["trees_done"] == 1
+
+    # THE RESIZE: re-form the cloud on the target mesh and resume there
+    Cloud.reform(nodes=tn, model_axis=tm)
+    resumed = auto_recover(rec)
+    assert len(resumed) == 1
+    m2 = resumed[0]
+    assert m2.output["ntrees_actual"] == 4
+    got = _forest_arrays(m2)
+    assert set(got) == set(ref)
+    for k in ref:
+        np.testing.assert_array_equal(ref[k], got[k], err_msg=k)
+    np.testing.assert_array_equal(
+        pred_ref, np.asarray(m2.predict_raw(_exact_frame())))
+    assert pending_recoveries(rec) == []
+
+
+def test_first_block_is_mesh_invariant(cl, reboot):
+    """The anchor property: with the exact-arithmetic dataset, tree 1
+    is bitwise identical across mesh shapes (exact f32 sums are
+    reduction-order-independent) — this is what makes a checkpoint
+    written on one mesh a valid continuation point on another."""
+    outs = []
+    for n, m in ((4, 2), (2, 2), (1, 1)):
+        reboot(n, m)
+        from h2o_tpu.models.tree.gbm import GBM
+        mod = GBM(ntrees=1, max_depth=3, seed=7, nbins=16,
+                  learn_rate=0.5, distribution="gaussian",
+                  histogram_type="UniformAdaptive").train(
+            y="y", training_frame=_exact_frame())
+        outs.append(_forest_arrays(mod))
+    for other in outs[1:]:
+        for k in outs[0]:
+            np.testing.assert_array_equal(outs[0][k], other[k],
+                                          err_msg=k)

@@ -202,10 +202,15 @@ def test_groupby_parity_multikey(cl, sess, rng, monkeypatch):
                 "(GB gb3 [0 1] sum 2 'all' count 2 'all')", rtol=1e-4)
 
 
-def test_groupby_median_falls_back_to_host(cl, sess, rng, monkeypatch):
-    monkeypatch.setenv("H2O_TPU_DEVICE_MUNGE", "1")
+def test_groupby_median_device_parity(cl, sess, rng, monkeypatch):
+    """median group-by rides the device path now (segment order
+    statistic, core/quantile.segment_median) — parity vs the host
+    oracle; mode still falls back to host (no crash either way)."""
     _put("gb4", _gb_frame(rng, n=50))
-    out = _exec(sess, "(GB gb4 [0] median 2 'all')")     # host path, no crash
+    _both_modes(sess, monkeypatch,
+                "(GB gb4 [0] median 2 'all' nrow 2 'all')", rtol=1e-5)
+    monkeypatch.setenv("H2O_TPU_DEVICE_MUNGE", "1")
+    out = _exec(sess, "(GB gb4 [0] mode 1 'all')")       # host fallback
     assert out.nrows >= 4
 
 
