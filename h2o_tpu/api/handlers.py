@@ -1314,6 +1314,18 @@ def resilience_stats(params):
     }
 
 
+@route("GET", r"/3/Autotune")
+def autotune_route(params):
+    """Kernel-autotuner observability (core/autotune.py): the active
+    mode and backend, every registered lever (site, env knob, candidate
+    variants, forced override if any), the decision table loaded this
+    process — winner, per-candidate probe timings / parity verdicts,
+    source (probe vs disk) — and the probe/disk counters the subprocess
+    zero-probe drill asserts against."""
+    from h2o_tpu.core.autotune import autotune_payload
+    return autotune_payload()
+
+
 @route("POST", r"/3/Recovery/resume")
 def recovery_resume(params):
     """Asynchronous resume: returns a job key immediately, the recovery

@@ -65,6 +65,30 @@ owning modules, like the chaos flags, so they work before a cloud boots):
   per shard are oversample x n_nodes — more samples tighten bucket
   balance in the exchange at the cost of a wider replicated splitter
   sort);
+- kernel autotuner (core/autotune.py — measured per-backend selection
+  of the tunable kernel levers, decisions persisted next to
+  ``H2O_TPU_EXEC_STORE_DIR`` executables):
+  ``H2O_TPU_AUTOTUNE`` (``auto`` default: probe on TPU backends only,
+  off-TPU the reference variants win with zero probe runs; ``0``/off =
+  always reference variants, never probe; ``force`` = probe on any
+  backend — what the bench ladder's lever_ab block uses),
+  ``H2O_TPU_AUTOTUNE_REPS`` (timed reps per candidate after the
+  untimed compile run, default 5 — winner is the median),
+  ``H2O_TPU_AUTOTUNE_ROWS`` (probe workload row cap, default 65536,
+  rounded up to the mesh row multiple) and
+  ``H2O_TPU_AUTOTUNE_MARGIN`` (default 0.03 — a non-reference variant
+  must beat the reference by this fractional margin to win, so noise
+  never flips a lever).  The per-lever knobs are TRI-STATE —
+  ``H2O_TPU_HIST_PALLAS`` (hist.kernel: fused Pallas histogram vs the
+  one-hot-matmul XLA reference), ``H2O_TPU_MATMUL_ROUTE``
+  (tree.matmul_route: one-hot-matmul row routing vs gather) and
+  ``H2O_TPU_SIBLING_SUBTRACT`` (tree.sibling_subtract: left-child
+  histogram + parent-minus-left vs full rebuild) each accept ``1``
+  (force on, no probe), ``0`` (force off, no probe) or unset/``auto``
+  (defer to the autotuner's parity-gated, persisted decision).  A
+  candidate that fails the parity gate against its reference output is
+  disqualified for that backend — a miscompiling kernel degrades to
+  the reference instead of corrupting training;
 - streaming ingest + online refresh (h2o_tpu/stream — the
   train-on-fresh-data pipeline: chunked parse -> append-able Frames ->
   warm-start retrain -> serve-alias hot-swap):

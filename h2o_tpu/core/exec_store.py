@@ -132,6 +132,14 @@ def _backend_fingerprint() -> Tuple[str, int]:
     return jax.default_backend(), jax.device_count()
 
 
+def backend_fingerprint() -> Tuple[str, int]:
+    """Public (platform, device_count) identity of the live backend —
+    the backend half of every disk key, shared with the autotuner's
+    decision table (core/autotune.py) so a decision probed on one
+    backend can never be replayed on another."""
+    return _backend_fingerprint()
+
+
 def _is_deleted_array(x) -> bool:
     import jax
     if not isinstance(x, jax.Array):

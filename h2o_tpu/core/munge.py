@@ -60,9 +60,12 @@ compile per (verb, schema, shape-bucket, mesh shape); hit/miss/disk-hit
 GET /3/Dispatch.
 
 Fallback contract: ``H2O_TPU_DEVICE_MUNGE=0`` (or any frame holding
-T_TIME/T_STR/T_UUID columns, or a group-by with mode aggregates) takes
-the host-NumPy path in rapids/interp.py — which doubles as the parity
-oracle for tests/test_munge_device.py and tests/test_shard_munge.py.
+T_TIME/T_STR/T_UUID columns, or a group-by whose ``mode`` aggregates
+target numeric / high-cardinality columns — mode_device_eligible)
+takes the host-NumPy path in rapids/interp.py — which doubles as the
+parity oracle for tests/test_munge_device.py and
+tests/test_shard_munge.py.  Categorical ``mode`` itself runs on device
+via the segment-bincount + argmax kernel (core/quantile.segment_mode).
 
 NA/tie semantics (all paths agree):
 - sort: NAs group FIRST in both sort directions (RadixOrder's
@@ -94,14 +97,37 @@ from h2o_tpu.core.exec_store import (cached_kernel, code_fingerprint,
 PHASE = "munge"
 
 # group-by aggregates with a device form.  min..count combine from
-# per-shard partials in the shard collective; median needs a per-group
-# order statistic and runs via the global factorize + segment-median
-# kernels (device-resident, not yet a pure collective); mode stays a
+# per-shard partials in the shard collective; median and mode need a
+# per-group order statistic / bincount and run via the global
+# factorize + fused segment kernels (device-resident, not yet pure
+# collectives).  mode is device-eligible only for categorical columns
+# whose domain fits the (groups, cardinality) count table
+# (mode_device_eligible); numeric / high-cardinality mode stays a
 # documented host fallback (rapids/interp.py _groupby_host).
 DEVICE_AGGS = ("min", "max", "mean", "sum", "sd", "var", "nrow", "count",
-               "median")
+               "median", "mode")
 COMBINABLE_AGGS = ("min", "max", "mean", "sum", "sd", "var", "nrow",
                    "count")
+
+# widest categorical domain the segment-bincount mode kernel will
+# one-hot a count table for: (Gb, card) f32 stays a few MiB even at
+# the largest group buckets
+_MODE_MAX_CARD = 1024
+
+
+def mode_device_eligible(fr, aggs) -> bool:
+    """True when every ``mode`` agg in the bundle targets a categorical
+    column with a domain small enough for the segment-bincount kernel
+    (cardinality <= 1024).  Numeric or high-cardinality mode columns
+    keep the documented host fallback."""
+    for a, c, _na in aggs:
+        if a != "mode":
+            continue
+        v = fr.vecs[c]
+        if not v.is_categorical or not v.domain or \
+                len(v.domain) > _MODE_MAX_CARD:
+            return False
+    return True
 
 
 def device_munge_enabled() -> bool:
@@ -594,9 +620,13 @@ def _build_factorize(B: int, K: int):
     return kern
 
 
-def _build_group_aggs(B: int, K: int, Gb: int, ops: Tuple[str, ...]):
+def _build_group_aggs(B: int, K: int, Gb: int, ops: Tuple[str, ...],
+                      cards: Tuple[int, ...] = ()):
     """One fused pass: group key values + counts + every aggregate of
-    the bundle.  ``vals`` is the (B, A) agg-column matrix (NA = NaN)."""
+    the bundle.  ``vals`` is the (B, A) agg-column matrix (NA = NaN);
+    ``cards`` carries the static per-agg categorical cardinality the
+    segment-bincount mode kernel sizes its count table with (0 for
+    non-mode aggs)."""
     def kern(keys, valid, inv, order, vals):
         gid_sorted = jnp.take(inv, order)           # nondecreasing
         bpos = jnp.searchsorted(gid_sorted, jnp.arange(Gb))
@@ -635,6 +665,9 @@ def _build_group_aggs(B: int, K: int, Gb: int, ops: Tuple[str, ...]):
             elif op == "median":
                 from h2o_tpu.core.quantile import segment_median
                 out = segment_median(d, ok, inv, B, Gb)
+            elif op == "mode":
+                from h2o_tpu.core.quantile import segment_mode
+                out = segment_mode(d, ok, inv, Gb, cards[a])
             else:  # pragma: no cover — guarded by DEVICE_AGGS
                 raise NotImplementedError(op)
             outs.append(out)
@@ -914,9 +947,10 @@ def groupby_frame(fr: Frame, gcols: Sequence[int],
                   aggs: Sequence[Tuple[str, int, str]]) -> Frame:
     """AstGroup on device.  Shard mode (combinable aggs): per-shard
     factorize + fused partials, cross-shard combine of the partial
-    tables — only the group table replicates.  Median bundles (and
-    ``H2O_TPU_SHARD_MUNGE=0``) run the global factorize + fused
-    segment pass, with median as a device order-statistic kernel."""
+    tables — only the group table replicates.  Median/mode bundles
+    (and ``H2O_TPU_SHARD_MUNGE=0``) run the global factorize + fused
+    segment pass, with median as a device order-statistic kernel and
+    mode as a segment-bincount + argmax kernel."""
     ops = tuple(a for a, _c, _na in aggs)
     if shard_munge_enabled() and all(a in COMBINABLE_AGGS for a in ops):
         return _shard_groupby(fr, gcols, aggs)
@@ -982,11 +1016,15 @@ def _global_groupby(fr: Frame, gcols: Sequence[int],
         G = int(g_dev)                           # the one host sync
         Gb = _bucket_rows(max(_row_pad(G), 1))
         ops = tuple(a for a, _c, _na in aggs)
+        cards = tuple(
+            (len(fr.vecs[c].domain or ()) if a == "mode" else 0)
+            for a, c, _na in aggs)
         acols = [fr.vecs[c].as_float() for _a, c, _na in aggs]
         vals = _pad_rows(jnp.stack(acols, axis=1), B, jnp.nan) if acols \
             else jnp.zeros((B, 0), jnp.float32)
-        agg = cached_kernel(PHASE, "group_aggs", (B, K, Gb, ops),
-                            lambda: _build_group_aggs(B, K, Gb, ops),
+        agg = cached_kernel(PHASE, "group_aggs", (B, K, Gb, ops, cards),
+                            lambda: _build_group_aggs(B, K, Gb, ops,
+                                                      cards),
                             keys, valid, inv, order, vals)
         keyvals, counts, outs = agg(keys, valid, inv, order, vals)
         return _group_table(fr, gcols, aggs, keyvals, counts, list(outs),

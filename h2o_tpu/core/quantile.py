@@ -108,6 +108,31 @@ def segment_median(vals, ok, inv, B: int, Gb: int):
     return jnp.where(cnt > 0, med, jnp.nan)
 
 
+def segment_mode(vals, ok, inv, Gb: int, card: int):
+    """Per-group MODE of a small-cardinality non-negative integer
+    column (categorical codes) — segment-bincount + argmax (traced
+    helper for core/munge.py's group-by device path, the
+    ``mode``-closing sibling of segment_median above).
+
+    One segment_sum over a combined (group, value) index builds the
+    (Gb, card) count table; argmax over the value axis picks the mode,
+    ties breaking to the SMALLEST value — matching the host oracle's
+    ``np.bincount(seg).argmax()`` (rapids/interp.py _groupby_host).
+    Empty groups (no valid values) return NaN.  ``card`` bounds the
+    count table and is static — high-cardinality columns stay on the
+    documented host fallback (munge.mode_device_eligible)."""
+    v = jnp.clip(vals.astype(jnp.int32), 0, card - 1)
+    # invalid rows key out of range; jax segment_sum drops OOB indices
+    idx = jnp.where(ok, inv * card + v, Gb * card)
+    counts = jax.ops.segment_sum(ok.astype(jnp.float32), idx,
+                                 num_segments=Gb * card)
+    mode = jnp.argmax(counts.reshape(Gb, card),
+                      axis=1).astype(jnp.float32)
+    n_ok = jax.ops.segment_sum(ok.astype(jnp.float32), inv,
+                               num_segments=Gb)
+    return jnp.where(n_ok > 0, mode, jnp.nan)
+
+
 def quantile(frame: Frame, probs: Sequence[float],
              columns: Sequence[str] = None) -> dict:
     """Per-column quantiles (the /3/Quantiles REST surface shape)."""

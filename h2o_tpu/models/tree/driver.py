@@ -195,9 +195,16 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
     because the random stream continues exactly, reproduces the
     uninterrupted forest bit-for-bit.
     """
-    from h2o_tpu.models.tree.jit_engine import train_forest
+    from h2o_tpu.models.tree.jit_engine import (resolve_train_levers,
+                                                train_forest)
     from h2o_tpu.models.tree.shared_tree import (rng_key_from_np,
                                                  rng_key_to_np)
+
+    # pin the tunable-lever flags ONCE for the whole run: every block —
+    # including OOM-ladder retries and speculative re-dispatches — hits
+    # the same (possibly autotuner-probed) executable, and a probe only
+    # ever runs before the first block, never mid-forest
+    train_kwargs = resolve_train_levers(dict(train_kwargs))
 
     ntrees = int(p["ntrees"]) - prior_trees
     if prior_trees and ntrees <= 0:

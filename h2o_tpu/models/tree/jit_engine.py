@@ -185,18 +185,16 @@ def _child_ranges(new_lo, new_hi, s, thr_leaf, is_cat, do_split):
 
 
 def matmul_route_enabled() -> bool:
-    """H2O_TPU_MATMUL_ROUTE: 1/on enables the matmul router, "auto"
-    enables it on TPU backends only, default off until the on-hardware
-    A/B (tools/heal_capture.sh) proves it beats the gather router on the
-    headline config — the driver's end-of-round bench must reproduce the
-    captured engine, not gamble on an unproven one.  Resolve OUTSIDE jit
-    traces (static arg) like the sibling/pallas flags."""
-    import os
-    v = os.environ.get("H2O_TPU_MATMUL_ROUTE", "").lower()
-    if v == "auto":
-        from h2o_tpu.core.cloud import backend_is_tpu
-        return backend_is_tpu()
-    return v in ("1", "on", "true", "yes")
+    """Tri-state H2O_TPU_MATMUL_ROUTE: ``1`` forces the matmul router,
+    ``0`` forces the gather router, ``auto``/unset (the default) defers
+    to the autotuner (core/autotune.py ``tree.matmul_route`` lever) —
+    on TPU both routers are probed on the live backend with a bitwise
+    parity gate and the persisted winner applies; elsewhere the gather
+    reference wins with zero probe runs.  This replaces the old blind
+    "auto = on-if-TPU" rule with a measured decision.  Resolve OUTSIDE
+    jit traces (static arg) like the sibling/pallas flags."""
+    from h2o_tpu.core.autotune import resolve_flag
+    return resolve_flag("tree.matmul_route")
 
 
 # largest lookup table the matmul router will one-hot over; beyond this
@@ -269,9 +267,14 @@ def sibling_subtract_enabled() -> bool:
     width at every level >= 1: only LEFT children are histogrammed and
     right = parent − left.  Exact in infinite precision (a split
     partitions its parent's rows); in f32 it reorders accumulation, so
-    an escape hatch remains (H2O_TPU_SIBLING_SUBTRACT=0)."""
-    import os
-    return os.environ.get("H2O_TPU_SIBLING_SUBTRACT", "1") != "0"
+    an escape hatch remains (H2O_TPU_SIBLING_SUBTRACT=0).  The knob is
+    tri-state: ``1`` forces subtraction on, ``0`` off, ``auto``/unset
+    defers to the autotuner's ``tree.sibling_subtract`` lever — whose
+    REFERENCE variant is ``on`` (the pre-tuner default), so behavior is
+    unchanged wherever probing is gated off (CPU tiers,
+    H2O_TPU_AUTOTUNE=0)."""
+    from h2o_tpu.core.autotune import resolve_flag
+    return resolve_flag("tree.sibling_subtract")
 
 
 def _hist_level_with_sibling(bins, slot, stats, L: int, B: int, cfg,
@@ -748,6 +751,36 @@ def _tree_predict(bins, split_col, bitset, value, D: int, child=None,
     return value[node]
 
 
+def _hist_bucket(args, kwargs):
+    """Shape bucket for the hist.kernel lever from a train_forest call:
+    (pow2 rows, pow2 cols, nbins, live leaves).  None (→ the lever's
+    default bucket) when the bins matrix isn't identifiable."""
+    bins = kwargs.get("bins", args[0] if args else None)
+    if bins is None or getattr(bins, "ndim", 0) != 2:
+        return None
+    from h2o_tpu.core.autotune import hist_bucket
+    R, C = bins.shape
+    L = min(1 << int(kwargs.get("max_depth", 5)), max_live_leaves())
+    return hist_bucket(int(R), int(C), int(kwargs.get("nbins", 64)), L)
+
+
+def resolve_train_levers(train_kwargs: dict) -> dict:
+    """Resolve the three tunable-lever flags ONCE (driver entry) so a
+    multi-block training run — and its recovery/speculative re-
+    dispatches — uses one stable, already-probed decision per lever
+    instead of re-resolving at every block boundary.  Flags the caller
+    pinned explicitly are left alone."""
+    if train_kwargs.get("sibling") is None:
+        train_kwargs["sibling"] = sibling_subtract_enabled()
+    if train_kwargs.get("hist_pallas") is None:
+        from h2o_tpu.ops.histogram import pallas_env_enabled
+        train_kwargs["hist_pallas"] = pallas_env_enabled(
+            _hist_bucket((), train_kwargs))
+    if train_kwargs.get("mm_route") is None:
+        train_kwargs["mm_route"] = matmul_route_enabled()
+    return train_kwargs
+
+
 class TrainedForest(NamedTuple):
     split_col: jax.Array   # (T, K, N)
     bitset: jax.Array      # (T, K, N, B+1)
@@ -786,15 +819,15 @@ def train_forest(*args, sibling: Optional[bool] = None,
     entry rides the XLA persistent compile cache rather than
     executable serialization.
 
-    A Mosaic/Pallas kernel-compile failure with the opt-in fused
-    histogram enabled degrades to the portable XLA histogram path (a
-    recorded OOM-ladder event) instead of taking training down with no
-    fallback."""
+    A Mosaic/Pallas kernel-compile failure with the autotuned/forced
+    fused histogram enabled degrades to the portable XLA histogram path
+    (a recorded OOM-ladder event) instead of taking training down with
+    no fallback."""
     if sibling is None:
         sibling = sibling_subtract_enabled()
     if hist_pallas is None:
         from h2o_tpu.ops.histogram import pallas_env_enabled
-        hist_pallas = pallas_env_enabled()
+        hist_pallas = pallas_env_enabled(_hist_bucket(args, kwargs))
     if "mm_route" not in kwargs or kwargs["mm_route"] is None:
         kwargs["mm_route"] = matmul_route_enabled()
     from h2o_tpu.core.diag import DispatchStats

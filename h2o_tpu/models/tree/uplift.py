@@ -28,6 +28,7 @@ import numpy as np
 from h2o_tpu.core.frame import Frame, Vec
 from h2o_tpu.models import metrics as mm
 from h2o_tpu.models.model import DataInfo, Model, ModelBuilder
+from h2o_tpu.core.autotune import hist_bucket
 from h2o_tpu.models.tree import shared_tree as st
 from h2o_tpu.ops.histogram import histogram_build_traced, pallas_env_enabled
 
@@ -333,7 +334,11 @@ class UpliftDRF(ModelBuilder):
                 sample_rate=float(p["sample_rate"]),
                 min_rows=float(p["min_rows"]),
                 kleaves=max_live_leaves(), hist_pallas=pallas),
-            pallas=pallas_env_enabled())
+            # autotuned/forced Pallas decision for the uplift hist
+            # shapes, resolved OUTSIDE the trace (static jit arg)
+            pallas=pallas_env_enabled(hist_bucket(
+                binned.bins.shape[0], binned.bins.shape[1],
+                binned.nbins, min(1 << depth, max_live_leaves()))))
         out = dict(x=list(di.x), split_points=binned.split_points,
                    is_cat=binned.is_cat, nbins=binned.nbins,
                    split_col=np.asarray(sc), bitset=np.asarray(bs),

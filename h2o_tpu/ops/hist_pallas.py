@@ -15,6 +15,15 @@ Grid: sequential over row tiles; every step accumulates into the SAME
 output block (TPU grids execute in order, making read-modify-write on the
 output block safe).  Tile height adapts to keep the in-VMEM one-hot under
 a fixed byte budget whatever (C, B) the caller brings.
+
+Validation: beyond the interpret-mode parity tests in tests/, the kernel
+is parity-gated ON THE LIVE BACKEND by the autotuner (core/autotune.py,
+``hist.kernel`` lever) before it can win a shape bucket — the first use
+of each (backend, shape-bucket) compares this kernel's output against
+the XLA reference and a Mosaic miscompile disqualifies the candidate
+instead of corrupting training.  That retires the old
+"interpret-mode-only validated" caveat: no hardware run ever trusts
+this kernel un-checked.
 """
 
 from __future__ import annotations

@@ -47,17 +47,21 @@ W, WG, WGG, WH = 0, 1, 2, 3
 N_STATS = 4
 
 
-def pallas_env_enabled() -> bool:
-    """H2O_TPU_HIST_PALLAS=1 opts INTO the fused kernel (default off
-    until an on-hardware A/B proves it — the kernel is interpret-mode
-    verified but Mosaic-untested while the tunnel is down; a compile
-    failure here would take training down with no fallback).  Resolve
-    OUTSIDE jit traces (the engine's train_forest wrapper does) — a value
-    read at trace time is baked into the executable cache key's shapes
-    and a later env flip would silently not apply."""
-    import os
-    return os.environ.get("H2O_TPU_HIST_PALLAS", "").lower() in (
-        "1", "on", "true", "yes")
+def pallas_env_enabled(bucket=None) -> bool:
+    """Tri-state H2O_TPU_HIST_PALLAS: ``1`` forces the fused Pallas
+    kernel, ``0`` forces the portable XLA scan, and ``auto``/unset (the
+    default) defers to the autotuner (core/autotune.py ``hist.kernel``
+    lever): on TPU each candidate is compiled on the live backend,
+    parity-gated against the XLA reference, timed, and the persisted
+    winner applies — a Mosaic miscompile is disqualified instead of
+    corrupting training; off-TPU the XLA reference wins with zero probe
+    runs.  ``bucket`` optionally scopes the decision to a workload
+    shape bucket (rows, C, nbins, L).  Resolve OUTSIDE jit traces (the
+    engine's train_forest wrapper does) — a value read at trace time is
+    baked into the executable cache key's shapes and a later flip would
+    silently not apply."""
+    from h2o_tpu.core.autotune import resolve_flag
+    return resolve_flag("hist.kernel", bucket)
 
 
 def _pallas_eligible(C: int, B1: int, n_leaves: int, S: int,

@@ -940,9 +940,10 @@ def _groupby(node, env):
     """(GB fr [group_idxs] agg col na_method ...) — AstGroup.java.
     Device path (core/munge.groupby_frame): shard-resident partials +
     cross-shard combine for the combinable bundle (or the global fused
-    segment pass, incl. device median via the segment order-statistic
-    kernel); only the group count syncs.  ``mode`` (per-group bincount
-    argmax) and non-device frames fall back to the host path."""
+    segment pass — device median via the segment order-statistic
+    kernel, categorical ``mode`` via the segment-bincount + argmax
+    kernel); only the group count syncs.  Numeric / high-cardinality
+    ``mode`` and non-device frames fall back to the host path."""
     fr = _as_frame(_eval(node[1], env))
     gcols = [int(x) for x in node[2][1]]
     aggs = []
@@ -960,10 +961,11 @@ def _groupby(node, env):
         aggs.append((a, col_i, na))
         i += 3
     from h2o_tpu.core.munge import (DEVICE_AGGS, device_munge_enabled,
-                                    groupby_frame)
+                                    groupby_frame, mode_device_eligible)
     from h2o_tpu.core.oom import oom_ladder
     if device_munge_enabled() and frame_device_ok(fr) and \
-            all(a in DEVICE_AGGS for a, _c, _n in aggs):
+            all(a in DEVICE_AGGS for a, _c, _n in aggs) and \
+            mode_device_eligible(fr, aggs):
         return oom_ladder(
             "munge.groupby", lambda: groupby_frame(fr, gcols, aggs),
             host_fallback=lambda: _host_oracle(_groupby_host, fr, gcols,

@@ -1,0 +1,394 @@
+"""Kernel autotuner (core/autotune.py): probe -> parity gate ->
+decision table.
+
+The acceptance drills from the PR brief:
+- default ``auto`` mode on the CPU mesh resolves every lever to its
+  reference variant with ZERO probe runs (CPU-tier results stay
+  bitwise-identical to the pre-autotuner engine);
+- explicit env 1/0 and H2O_TPU_AUTOTUNE=0 bypass probing outright;
+- probe decisions round-trip through the on-disk ``.tune`` table, and a
+  FRESH SUBPROCESS sharing the store dir reuses them with zero probes;
+- a backend / candidate-fingerprint change keys a different record and
+  re-probes cleanly;
+- a deliberately-wrong candidate is parity-disqualified — it never
+  wins, and the failure never reaches the caller;
+- the probe's compile run sits under the OOM ladder at the dedicated
+  ``autotune`` site (a transient probe OOM degrades, never kills).
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from h2o_tpu.core import autotune as at
+
+
+@pytest.fixture(autouse=True)
+def _tune_env(monkeypatch, cl):
+    """Hermetic knob state: no forced levers, no store dir, 1 timed rep
+    (probe speed), counters zeroed before AND after."""
+    for v in ("H2O_TPU_AUTOTUNE", "H2O_TPU_HIST_PALLAS",
+              "H2O_TPU_MATMUL_ROUTE", "H2O_TPU_SIBLING_SUBTRACT",
+              "H2O_TPU_EXEC_STORE_DIR", "H2O_TPU_AUTOTUNE_ROWS",
+              "H2O_TPU_AUTOTUNE_MARGIN"):
+        monkeypatch.delenv(v, raising=False)
+    monkeypatch.setenv("H2O_TPU_AUTOTUNE_REPS", "1")
+    at.reset()
+    yield
+    at.reset()
+
+
+def _toy_lever(site, outputs, ref_sleep=0.0, fp="fpA"):
+    """A throwaway lever over trivial device math: ``outputs`` maps
+    variant name -> additive offset (0 = parity with the reference);
+    ``ref_sleep`` slows the reference so a correct candidate can win
+    the timing race deterministically."""
+    def run(name, w):
+        if name == "ref" and ref_sleep:
+            time.sleep(ref_sleep)
+        return w["x"] + outputs[name]
+    return at.Lever(
+        site=site, env_var="H2O_TPU_TOY_" + site.upper(),
+        variants=tuple(outputs), true_variants=frozenset(
+            n for n in outputs if n != "ref"),
+        default_bucket=(64,),
+        make_workload=lambda b: {"x": jnp.arange(b[0],
+                                                 dtype=jnp.float32)},
+        run_variant=run, fingerprint=lambda: fp, tol=(0.0, 1e-6))
+
+
+# ------------------------------------------------------- mode gating
+
+
+def test_cpu_auto_resolves_references_with_zero_probes():
+    """THE CPU-tier acceptance criterion: default ``auto`` never
+    probes off-TPU, and every lever lands on its reference variant —
+    exactly the pre-autotuner flag defaults (pallas off, matmul route
+    off, sibling subtraction on)."""
+    assert at.autotune_mode() == "auto"
+    assert at.resolve_flag("hist.kernel") is False
+    assert at.resolve_flag("tree.matmul_route") is False
+    assert at.resolve_flag("tree.sibling_subtract") is True
+    s = at.stats()
+    assert s["probes"] == 0 and s["probe_runs"] == 0, s
+
+
+def test_autotune_off_forces_references(monkeypatch):
+    monkeypatch.setenv("H2O_TPU_AUTOTUNE", "0")
+    lv = _toy_lever("toy.off", {"ref": 0.0, "cand": 0.0})
+    at.register_lever(lv)
+    try:
+        assert at.resolve_flag("toy.off") is False  # ref not in true
+        assert at.stats()["probes"] == 0
+    finally:
+        at.unregister_lever("toy.off")
+
+
+def test_explicit_env_override_bypasses_probing(monkeypatch):
+    """Forced 1/0 wins over everything — even ``force`` mode makes
+    zero probe runs when the knob is pinned."""
+    monkeypatch.setenv("H2O_TPU_AUTOTUNE", "force")
+    monkeypatch.setenv("H2O_TPU_HIST_PALLAS", "1")
+    monkeypatch.setenv("H2O_TPU_MATMUL_ROUTE", "0")
+    monkeypatch.setenv("H2O_TPU_SIBLING_SUBTRACT", "0")
+    assert at.resolve_flag("hist.kernel") is True
+    assert at.resolve_flag("tree.matmul_route") is False
+    assert at.resolve_flag("tree.sibling_subtract") is False
+    s = at.stats()
+    assert s["probes"] == 0 and s["probe_runs"] == 0, s
+
+
+def test_tri_state_parsing(monkeypatch):
+    monkeypatch.setenv("H2O_TPU_HIST_PALLAS", "auto")
+    assert at.tri_state("H2O_TPU_HIST_PALLAS") is None
+    monkeypatch.setenv("H2O_TPU_HIST_PALLAS", "on")
+    assert at.tri_state("H2O_TPU_HIST_PALLAS") is True
+    monkeypatch.setenv("H2O_TPU_HIST_PALLAS", "off")
+    assert at.tri_state("H2O_TPU_HIST_PALLAS") is False
+    monkeypatch.delenv("H2O_TPU_HIST_PALLAS")
+    assert at.tri_state("H2O_TPU_HIST_PALLAS") is None
+
+
+# ------------------------------------------------- probe + parity gate
+
+
+def test_fast_correct_candidate_wins(monkeypatch):
+    monkeypatch.setenv("H2O_TPU_AUTOTUNE", "force")
+    lv = _toy_lever("toy.win", {"ref": 0.0, "fast": 0.0},
+                    ref_sleep=0.02)
+    at.register_lever(lv)
+    try:
+        assert at.resolve_flag("toy.win") is True
+        rec = at.resolve("toy.win")
+        assert rec["winner"] == "fast" and rec["flag"] is True
+        assert rec["candidates"]["fast"]["status"] == "ok"
+        assert rec["candidates"]["fast"]["vs_ref"] > 1.0
+    finally:
+        at.unregister_lever("toy.win")
+
+
+def test_wrong_candidate_parity_disqualified(monkeypatch):
+    """The Mosaic-miscompile drill: a candidate that returns WRONG
+    numbers — even one that would win on speed — is disqualified at
+    the parity gate and the reference variant is selected.  The caller
+    sees a clean decision, never an exception."""
+    monkeypatch.setenv("H2O_TPU_AUTOTUNE", "force")
+    lv = _toy_lever("toy.bad", {"ref": 0.0, "wrong": 1.0},
+                    ref_sleep=0.02)
+    at.register_lever(lv)
+    try:
+        assert at.resolve_flag("toy.bad") is False
+        rec = at.resolve("toy.bad")
+        assert rec["winner"] == "ref"
+        assert rec["candidates"]["wrong"]["status"] == "parity_fail"
+        assert at.stats()["parity_disqualified"] == 1
+    finally:
+        at.unregister_lever("toy.bad")
+
+
+def test_crashing_candidate_disqualified_not_fatal(monkeypatch):
+    monkeypatch.setenv("H2O_TPU_AUTOTUNE", "force")
+
+    def run(name, w):
+        if name == "boom":
+            raise RuntimeError("Mosaic lowering failed")
+        return w["x"]
+
+    lv = at.Lever(
+        site="toy.boom", env_var="H2O_TPU_TOY_BOOM",
+        variants=("ref", "boom"), true_variants=frozenset({"boom"}),
+        default_bucket=(8,),
+        make_workload=lambda b: {"x": jnp.ones(b[0])},
+        run_variant=run, fingerprint=lambda: "fp")
+    at.register_lever(lv)
+    try:
+        assert at.resolve_flag("toy.boom") is False
+        rec = at.resolve("toy.boom")
+        assert rec["candidates"]["boom"]["status"] == "error"
+        assert "Mosaic" in rec["candidates"]["boom"]["error"]
+        assert at.stats()["probe_failures"] == 1
+    finally:
+        at.unregister_lever("toy.boom")
+
+
+def test_resolver_crash_degrades_to_reference(monkeypatch):
+    """resolve_flag must NEVER take training down: a workload builder
+    that explodes falls back to the reference flag and counts a
+    resolve_error."""
+    monkeypatch.setenv("H2O_TPU_AUTOTUNE", "force")
+
+    def bad_workload(bucket):
+        raise ValueError("no such workload")
+
+    lv = at.Lever(
+        site="toy.crash", env_var="H2O_TPU_TOY_CRASH",
+        variants=("ref", "cand"), true_variants=frozenset({"cand"}),
+        default_bucket=(8,), make_workload=bad_workload,
+        run_variant=lambda n, w: None, fingerprint=lambda: "fp")
+    at.register_lever(lv)
+    try:
+        assert at.resolve_flag("toy.crash") is False
+        assert at.stats()["resolve_errors"] == 1
+    finally:
+        at.unregister_lever("toy.crash")
+
+
+def test_probe_oom_rides_the_autotune_ladder_site(monkeypatch):
+    """Satellite: probe compile runs sit under oom_ladder at the
+    dedicated ``autotune`` site — a transient injected OOM sweeps and
+    retries, the decision still lands, and the event is visible in the
+    GET /3/Resilience site breakdown."""
+    from h2o_tpu.core import chaos, oom
+    monkeypatch.setenv("H2O_TPU_AUTOTUNE", "force")
+    before = oom.stats()["sites"].get("autotune",
+                                      {}).get("oom_events", 0)
+    lv = _toy_lever("toy.oomp", {"ref": 0.0, "cand": 0.0})
+    at.register_lever(lv)
+    chaos.configure(oom_transient=1, seed=3)
+    try:
+        rec = at.resolve("toy.oomp")
+        assert rec["winner"] in ("ref", "cand")
+    finally:
+        chaos.reset()
+        at.unregister_lever("toy.oomp")
+    after = oom.stats()["sites"]["autotune"]["oom_events"]
+    assert after >= before + 1
+
+
+# --------------------------------------------- persistence + invalidation
+
+
+def test_decision_persists_and_reloads(tmp_path, monkeypatch):
+    monkeypatch.setenv("H2O_TPU_AUTOTUNE", "force")
+    monkeypatch.setenv("H2O_TPU_EXEC_STORE_DIR", str(tmp_path))
+    lv = _toy_lever("toy.disk", {"ref": 0.0, "cand": 0.0})
+    at.register_lever(lv)
+    try:
+        rec1 = at.resolve("toy.disk")
+        assert rec1["source"] == "probe"
+        files = glob.glob(str(tmp_path / "*.tune"))
+        assert len(files) == 1
+        rec_disk = json.loads(open(files[0]).read())
+        assert rec_disk["winner"] == rec1["winner"]
+        at.reset()  # drop memory, keep disk
+        at.register_lever(lv)
+        rec2 = at.resolve("toy.disk")
+        assert rec2["source"] == "disk"
+        assert rec2["winner"] == rec1["winner"]
+        s = at.stats()
+        assert s["probes"] == 0 and s["probe_runs"] == 0
+        assert s["disk_hits"] == 1
+    finally:
+        at.unregister_lever("toy.disk")
+
+
+def test_backend_change_invalidates_decision(tmp_path, monkeypatch):
+    monkeypatch.setenv("H2O_TPU_AUTOTUNE", "force")
+    monkeypatch.setenv("H2O_TPU_EXEC_STORE_DIR", str(tmp_path))
+    lv = _toy_lever("toy.bke", {"ref": 0.0, "cand": 0.0})
+    at.register_lever(lv)
+    try:
+        at.resolve("toy.bke")
+        at.reset()
+        at.register_lever(lv)
+        # a different backend topology keys a DIFFERENT record — the
+        # stale winner is unreachable, not consulted-and-rejected
+        monkeypatch.setattr(at, "backend_fingerprint",
+                            lambda: ("faketpu", 2))
+        rec = at.resolve("toy.bke")
+        assert rec["source"] == "probe"
+        assert at.stats()["probes"] == 1
+        assert at.stats()["disk_hits"] == 0
+    finally:
+        at.unregister_lever("toy.bke")
+
+
+def test_fingerprint_change_invalidates_decision(tmp_path, monkeypatch):
+    """An upgraded kernel body (changed candidate fingerprint) must
+    re-probe — a persisted winner for the OLD code never leaks onto
+    the new code."""
+    monkeypatch.setenv("H2O_TPU_AUTOTUNE", "force")
+    monkeypatch.setenv("H2O_TPU_EXEC_STORE_DIR", str(tmp_path))
+    at.register_lever(_toy_lever("toy.fpi", {"ref": 0.0, "cand": 0.0},
+                                 fp="fpA"))
+    try:
+        at.resolve("toy.fpi")
+        at.reset()
+        at.register_lever(_toy_lever("toy.fpi",
+                                     {"ref": 0.0, "cand": 0.0},
+                                     fp="fpB"))
+        rec = at.resolve("toy.fpi")
+        assert rec["source"] == "probe"
+        assert at.stats()["disk_hits"] == 0
+        assert len(glob.glob(str(tmp_path / "*.tune"))) == 2
+    finally:
+        at.unregister_lever("toy.fpi")
+
+
+def test_jax_version_in_decision_key():
+    lv = at.lever("tree.matmul_route")
+    import jax as _jax
+    key = at._decision_key(lv, lv.default_bucket)
+    assert f"jax={_jax.__version__}" in key
+    assert "backend=" in key and "cands=" in key
+
+
+def test_tampered_record_rejected(tmp_path, monkeypatch):
+    monkeypatch.setenv("H2O_TPU_AUTOTUNE", "force")
+    monkeypatch.setenv("H2O_TPU_EXEC_STORE_DIR", str(tmp_path))
+    lv = _toy_lever("toy.tmp", {"ref": 0.0, "cand": 0.0})
+    at.register_lever(lv)
+    try:
+        at.resolve("toy.tmp")
+        path = glob.glob(str(tmp_path / "*.tune"))[0]
+        rec = json.loads(open(path).read())
+        rec["winner"] = "not_a_variant"
+        open(path, "w").write(json.dumps(rec))
+        at.reset()
+        at.register_lever(lv)
+        out = at.resolve("toy.tmp")  # invalid -> clean re-probe
+        assert out["source"] == "probe"
+        assert at.stats()["disk_invalid"] == 1
+    finally:
+        at.unregister_lever("toy.tmp")
+
+
+# ------------------------------------------------------- REST payload
+
+
+def test_autotune_rest_payload(monkeypatch):
+    from h2o_tpu.api.handlers import autotune_route
+    monkeypatch.setenv("H2O_TPU_AUTOTUNE", "force")
+    lv = _toy_lever("toy.rest", {"ref": 0.0, "cand": 0.0})
+    at.register_lever(lv)
+    try:
+        at.resolve("toy.rest")
+        body = autotune_route({})
+        assert body["mode"] == "force"
+        assert "toy.rest" in [l["site"] for l in body["levers"]]
+        recs = [d for d in body["decisions"]
+                if d["site"] == "toy.rest"]
+        assert len(recs) == 1 and recs[0]["winner"] in ("ref", "cand")
+        assert body["stats"]["probes"] == 1
+    finally:
+        at.unregister_lever("toy.rest")
+
+
+# --------------------------------------- fresh-process decision reuse
+
+
+_TUNE_SRC = textwrap.dedent("""
+    import json, os
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from h2o_tpu.core.cloud import Cloud
+    Cloud.boot()
+    from h2o_tpu.core import autotune as at
+    # tiny buckets: the drill proves decision REUSE, not kernel speed
+    recs = {s: at.resolve(s, b) for s, b in (
+        ("tree.matmul_route", (64, 4, 4, 8)),
+        ("tree.sibling_subtract", (64, 4, 8, 4)))}
+    print(json.dumps({
+        "winners": {s: r["winner"] for s, r in recs.items()},
+        "sources": {s: r["source"] for s, r in recs.items()},
+        "stats": at.stats()}))
+""")
+
+
+def _run_tune_proc(store_dir):
+    env = dict(os.environ)
+    env["H2O_TPU_EXEC_STORE_DIR"] = str(store_dir)
+    env["H2O_TPU_AUTOTUNE"] = "force"
+    env["H2O_TPU_AUTOTUNE_REPS"] = "1"
+    env["H2O_TPU_ROW_ALIGN"] = "8"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=8")
+    r = subprocess.run([sys.executable, "-c", _TUNE_SRC],
+                       capture_output=True, env=env, timeout=420,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    return json.loads(r.stdout.decode().strip().splitlines()[-1])
+
+
+def test_fresh_process_reuses_decisions_zero_probes(tmp_path):
+    """THE acceptance drill: two fresh processes share one store dir.
+    The first probes and persists; the second must make ZERO probe
+    runs — every lever resolved from the on-disk decision table with
+    identical winners."""
+    cold = _run_tune_proc(tmp_path)
+    warm = _run_tune_proc(tmp_path)
+    assert cold["stats"]["probes"] == 2, cold
+    assert set(cold["sources"].values()) == {"probe"}
+    assert warm["stats"]["probes"] == 0, warm
+    assert warm["stats"]["probe_runs"] == 0, warm
+    assert warm["stats"]["disk_hits"] == 2, warm
+    assert set(warm["sources"].values()) == {"disk"}
+    assert warm["winners"] == cold["winners"]

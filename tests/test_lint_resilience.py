@@ -396,6 +396,151 @@ def test_chaos_injection_sequence_is_seed_deterministic():
         chaos.reset()
 
 
+# The autotuner (core/autotune.py) is the ONE resolution point for the
+# kernel-lever knobs: consumers receive a resolved decision as a STATIC
+# arg at the jit boundary.  An os.environ read of a lever knob anywhere
+# else — worst of all inside a traced body — silently bakes the env
+# value at trace time, so toggling the knob (or the autotuner flipping
+# a winner) hits a stale executable.  Banned everywhere outside
+# autotune.py; inside autotune.py, banned outside ``_env_value``.
+LEVER_ENV_VARS = ("H2O_TPU_HIST_PALLAS", "H2O_TPU_MATMUL_ROUTE",
+                  "H2O_TPU_SIBLING_SUBTRACT", "H2O_TPU_AUTOTUNE")
+AUTOTUNE_FILE = os.path.join("core", "autotune.py")
+
+
+def _is_environ_read(node) -> bool:
+    """Call to os.environ.get/os.getenv, or an os.environ subscript."""
+    if isinstance(node, ast.Subscript):
+        v = node.value
+        return (isinstance(v, ast.Attribute) and v.attr == "environ" and
+                isinstance(v.value, ast.Name) and v.value.id == "os")
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "getenv" and \
+            isinstance(f.value, ast.Name) and f.value.id == "os":
+        return True
+    return (isinstance(f, ast.Attribute) and f.attr == "get" and
+            isinstance(f.value, ast.Attribute) and
+            f.value.attr == "environ" and
+            isinstance(f.value.value, ast.Name) and
+            f.value.value.id == "os")
+
+
+def _lever_env_reads(tree):
+    """Line numbers of environ reads whose key names a lever/autotune
+    knob (string constants only — docstrings and comments don't call
+    os.environ, so they never hit this)."""
+    hits = []
+    for node in ast.walk(tree):
+        if not _is_environ_read(node):
+            continue
+        consts = [c.value for c in ast.walk(node)
+                  if isinstance(c, ast.Constant) and
+                  isinstance(c.value, str)]
+        if any(c.startswith(v) for c in consts for v in LEVER_ENV_VARS):
+            hits.append(node.lineno)
+    return hits
+
+
+def test_lever_env_vars_resolved_only_in_autotune():
+    pkg_root = os.path.dirname(h2o_tpu.__file__)
+    offenders = []
+    for dirpath, _dirs, files in os.walk(pkg_root):
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, pkg_root)
+            if rel == AUTOTUNE_FILE:
+                continue
+            with open(path, encoding="utf-8", errors="replace") as f:
+                try:
+                    tree = ast.parse(f.read())
+                except SyntaxError:
+                    continue
+            offenders.extend(f"{rel}:{ln}"
+                             for ln in _lever_env_reads(tree))
+    assert not offenders, (
+        "lever/autotune env knob read outside core/autotune.py — "
+        "decisions must flow through autotune.resolve_flag() and reach "
+        "traced code as STATIC args (an env read near a trace bakes a "
+        "stale value into the executable):\n"
+        + "\n".join(sorted(set(offenders))))
+
+
+def test_autotune_reads_env_only_in_env_value():
+    """Inside autotune.py itself every environ read lives in
+    ``_env_value`` — the single point the module docstring promises."""
+    pkg_root = os.path.dirname(h2o_tpu.__file__)
+    with open(os.path.join(pkg_root, AUTOTUNE_FILE),
+              encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    offenders = []
+
+    def visit(node, fn_name):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn_name = node.name
+        if _is_environ_read(node) and fn_name != "_env_value":
+            offenders.append(f"{AUTOTUNE_FILE}:{node.lineno}"
+                             f" (in {fn_name})")
+        for child in ast.iter_child_nodes(node):
+            visit(child, fn_name)
+
+    visit(tree, "<module>")
+    assert not offenders, (
+        "environ read in core/autotune.py outside _env_value — keep "
+        "the single lint-enforceable read point:\n"
+        + "\n".join(offenders))
+
+
+def test_lever_consumers_route_through_resolve_flag():
+    """Companion existence check: the three consumer gates still exist
+    and still call autotune.resolve_flag — without this, deleting the
+    delegation would quietly turn the ban above into dead code."""
+    pkg_root = os.path.dirname(h2o_tpu.__file__)
+    expected = {
+        os.path.join("ops", "histogram.py"): {"pallas_env_enabled"},
+        os.path.join("models", "tree", "jit_engine.py"):
+            {"matmul_route_enabled", "sibling_subtract_enabled"},
+    }
+    for rel, fns in expected.items():
+        with open(os.path.join(pkg_root, rel), encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+        for want in fns:
+            fn = next((n for n in ast.walk(tree)
+                       if isinstance(n, ast.FunctionDef) and
+                       n.name == want), None)
+            assert fn is not None, f"{rel}: {want}() is gone"
+            calls = {c.func.id if isinstance(c.func, ast.Name)
+                     else getattr(c.func, "attr", None)
+                     for c in ast.walk(fn)
+                     if isinstance(c, ast.Call)}
+            assert "resolve_flag" in calls, (
+                f"{rel}: {want}() no longer delegates to "
+                "autotune.resolve_flag")
+
+
+def test_probe_runs_under_dedicated_autotune_oom_site():
+    """The probe's compiling first execution must sit under oom_ladder
+    at the literal ``autotune`` site — that is what routes probe OOMs
+    into the GET /3/Resilience site breakdown (the runtime half is
+    test_autotune.py's chaos drill)."""
+    pkg_root = os.path.dirname(h2o_tpu.__file__)
+    with open(os.path.join(pkg_root, AUTOTUNE_FILE),
+              encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    sites = [node.args[0].value for node in ast.walk(tree)
+             if isinstance(node, ast.Call) and
+             (getattr(node.func, "id", None) == "oom_ladder" or
+              getattr(node.func, "attr", None) == "oom_ladder") and
+             node.args and isinstance(node.args[0], ast.Constant)]
+    assert "autotune" in sites, (
+        "core/autotune.py no longer runs its probe under "
+        "oom_ladder('autotune', ...) — probe OOMs would kill the "
+        "training job instead of degrading the probe")
+
+
 def test_no_jax_jit_on_local_closures():
     pkg_root = os.path.dirname(h2o_tpu.__file__)
     offenders = []

@@ -205,13 +205,48 @@ def test_groupby_parity_multikey(cl, sess, rng, monkeypatch):
 def test_groupby_median_device_parity(cl, sess, rng, monkeypatch):
     """median group-by rides the device path now (segment order
     statistic, core/quantile.segment_median) — parity vs the host
-    oracle; mode still falls back to host (no crash either way)."""
+    oracle; NUMERIC-column mode still falls back to host (no crash
+    either way — mode_device_eligible gates it out)."""
     _put("gb4", _gb_frame(rng, n=50))
     _both_modes(sess, monkeypatch,
                 "(GB gb4 [0] median 2 'all' nrow 2 'all')", rtol=1e-5)
     monkeypatch.setenv("H2O_TPU_DEVICE_MUNGE", "1")
     out = _exec(sess, "(GB gb4 [0] mode 1 'all')")       # host fallback
     assert out.nrows >= 4
+
+
+def test_groupby_mode_device_parity(cl, sess, rng, monkeypatch):
+    """categorical mode group-by rides the device path now (segment
+    bincount + argmax, core/quantile.segment_mode): exact parity vs the
+    host oracle incl. NA group keys, NA agg codes, count ties (SMALLEST
+    code wins, np.bincount().argmax() semantics) and an all-NA group
+    (NaN mode) — with zero host pulls."""
+    from h2o_tpu.core.frame import Frame, T_CAT, Vec
+    n = 257
+    g = rng.integers(-1, 5, size=n).astype(np.int32)     # -1 = NA group
+    m = rng.integers(-1, 4, size=n).astype(np.int32)     # -1 = cat NA
+    m[g == 3] = -1                        # one group is all-NA -> NaN
+    _put("gbmode1",
+         Frame(["g", "m"],
+               [Vec(g, T_CAT, domain=["a", "b", "c", "d", "e"]),
+                Vec(m, T_CAT, domain=["p", "q", "r", "s"])]))
+    _both_modes(sess, monkeypatch,
+                "(GB gbmode1 [0] mode 1 'all' nrow 1 'all')")
+
+
+def test_groupby_mode_high_cardinality_host_fallback(cl, sess, rng,
+                                                     monkeypatch):
+    """a mode column whose domain exceeds the count-table cap keeps the
+    documented host fallback (and matches it, trivially)."""
+    import h2o_tpu.core.munge as mg
+    from h2o_tpu.core.diag import DispatchStats
+    monkeypatch.setattr(mg, "_MODE_MAX_CARD", 2)
+    monkeypatch.setenv("H2O_TPU_DEVICE_MUNGE", "1")
+    _put("gbmode2", _gb_frame(rng, n=60))
+    snap0 = DispatchStats.host_pulls("munge")
+    out = _exec(sess, "(GB gbmode2 [1] mode 0 'all')")   # 4 levels > 2
+    assert out.nrows >= 1
+    assert DispatchStats.host_pulls("munge") >= snap0
 
 
 # ------------------------------------------------------------------ filter
