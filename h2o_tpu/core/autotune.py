@@ -81,7 +81,7 @@ _STATS = {"probes": 0, "probe_runs": 0, "parity_disqualified": 0,
 
 # ---------------------------------------------------------------------------
 # env knobs — the ONE module allowed to read them (lint-enforced:
-# tests/test_lint_resilience.py bans these names everywhere else, so
+# graftlint GL620/GL621 ban these names everywhere else, so
 # decisions always reach traced code as static args)
 # ---------------------------------------------------------------------------
 

@@ -38,7 +38,7 @@ duration), ``H2O_TPU_CHAOS_OOM=0.1`` (probability) or
 ``H2O_TPU_CHAOS_SEED``; or programmatically via ``configure()``.  Off
 by default; zero overhead when off.
 
-COUNTER DISCIPLINE (lint-enforced, tests/test_lint_resilience.py):
+COUNTER DISCIPLINE (lint-enforced, graftlint GL612/GL613):
 every ``maybe_*`` injector increments a DEDICATED ``injected_*``
 counter (plus the ``injected`` grand total), and every counter appears
 in the ``GET /3/Resilience`` payload — so a soak run can prove that
@@ -75,6 +75,14 @@ class ChaosOOMError(ChaosError):
     rungs without needing real HBM pressure."""
 
 
+class ChaosKernelRejectError(ChaosError):
+    """Injected Pallas/Mosaic kernel rejection (a VMEM-gate or lowering
+    failure).  The message carries the Pallas marker so
+    core/oom.is_kernel_compile_failure classifies it exactly like a real
+    Mosaic compile error — kernel_fallback must degrade the dispatch to
+    the portable XLA path without CI needing real TPU VMEM pressure."""
+
+
 class _Chaos:
     def __init__(self):
         e = os.environ.get
@@ -101,6 +109,8 @@ class _Chaos:
         self.stream_slow_p = float(e("H2O_TPU_CHAOS_STREAM_SLOW", 0) or 0)
         self.stream_slow_ms = float(
             e("H2O_TPU_CHAOS_STREAM_SLOW_MS", 100) or 100)
+        self.kernel_reject_p = float(
+            e("H2O_TPU_CHAOS_KERNEL_REJECT", 0) or 0)
         seed = e("H2O_TPU_CHAOS_SEED")
         self._rng = np.random.default_rng(
             int(seed) if seed is not None else None)
@@ -118,6 +128,7 @@ class _Chaos:
         self.injected_oom = 0
         self.injected_stream_truncations = 0
         self.injected_slow_streams = 0
+        self.injected_kernel_rejects = 0
 
     @property
     def enabled(self) -> bool:
@@ -127,7 +138,7 @@ class _Chaos:
                 self.transfer_slow_p > 0 or self.oom_p > 0 or
                 self.oom_transient > 0 or self.stream_truncate_p > 0 or
                 self.stream_truncate_transient > 0 or
-                self.stream_slow_p > 0)
+                self.stream_slow_p > 0 or self.kernel_reject_p > 0)
 
     def counters(self) -> Dict[str, int]:
         """All injected-fault counters (the /3/Resilience chaos block).
@@ -140,7 +151,7 @@ class _Chaos:
                 "injected_persist", "injected_stalls",
                 "injected_slow_scores", "injected_slow_transfers",
                 "injected_oom", "injected_stream_truncations",
-                "injected_slow_streams")}
+                "injected_slow_streams", "injected_kernel_rejects")}
 
     def _roll(self, p: float) -> bool:
         if p <= 0:
@@ -193,6 +204,20 @@ class _Chaos:
             raise ChaosOOMError(
                 f"injected device OOM at {site}: RESOURCE_EXHAUSTED "
                 f"(synthetic)")
+
+    def maybe_kernel_reject(self, site: str) -> None:
+        """Kernel-rejection injector: called by core/oom.kernel_fallback
+        once per fused-kernel dispatch, so CI can prove a Pallas
+        VMEM-gate/Mosaic rejection degrades the dispatch to the portable
+        XLA path (run(False)) instead of failing the training job."""
+        if self._roll(self.kernel_reject_p):
+            with self._lock:
+                self.injected_kernel_rejects += 1
+            log.warning("chaos: injecting Pallas kernel rejection at %s",
+                        site)
+            raise ChaosKernelRejectError(
+                f"injected Pallas kernel rejection at {site}: working "
+                f"set exceeds VMEM (synthetic)")
 
     def maybe_truncate_stream(self, source: str) -> None:
         """Streaming-ingest truncation injector: a chunk read raises as
@@ -313,7 +338,8 @@ def configure(job_p: float = 0.0, device_put_p: float = 0.0,
               stream_truncate_p: float = 0.0,
               stream_truncate_transient: int = 0,
               stream_slow_p: float = 0.0,
-              stream_slow_ms: float = 100.0) -> _Chaos:
+              stream_slow_ms: float = 100.0,
+              kernel_reject_p: float = 0.0) -> _Chaos:
     """Programmatic enable (tests); returns the active instance."""
     global _instance
     _instance = _Chaos()
@@ -333,6 +359,7 @@ def configure(job_p: float = 0.0, device_put_p: float = 0.0,
     _instance.transfer_slow_ms = float(transfer_slow_ms)
     _instance.oom_p = float(oom_p)
     _instance.oom_transient = int(oom_transient)
+    _instance.kernel_reject_p = float(kernel_reject_p)
     if seed is not None:
         _instance._rng = np.random.default_rng(seed)
     return _instance

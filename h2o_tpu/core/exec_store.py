@@ -286,6 +286,8 @@ class ExecStore:
                 jkw.setdefault("donate_argnums", tuple(donate_argnums))
             if donate_argnames:
                 jkw.setdefault("donate_argnames", tuple(donate_argnames))
+        # graftlint: disable=GL603  the store IS the sanctioned jit
+        # point: entries are LRU-bounded, donation-policed, counted
         fn = jax.jit(build(), **jkw)
         if args is not None:
             try:

@@ -83,7 +83,7 @@ class OOMError(RuntimeError):
 # opt-in fused histogram is interpret-mode verified but Mosaic-untested,
 # so a lowering bug must degrade to the portable XLA path, not kill the
 # training job with no fallback (ADVICE.md VMEM-gate follow-up)
-_KERNEL_MARKERS = ("Mosaic", "mosaic", "Pallas", "pallas",
+_KERNEL_MARKERS = ("Mosaic", "mosaic", "Pallas", "pallas", "VMEM",
                    "custom_call_target", "tpu_custom_call")
 
 
@@ -104,8 +104,14 @@ def kernel_fallback(site: str, run: Callable[[bool], object], *,
     with the fused kernel enabled, record a ladder event and re-dispatch
     ``run(False)`` — the portable XLA executable (a distinct static-arg
     program, so the broken kernel is never cached).  Everything else
-    propagates untouched."""
+    propagates untouched.  The chaos injector
+    (``H2O_TPU_CHAOS_KERNEL_REJECT``) fires here so CPU CI can walk the
+    rejection path — including the hist_pallas VMEM gate shape — without
+    a real Mosaic failure."""
+    from h2o_tpu.core.chaos import chaos
     try:
+        if pallas:
+            chaos().maybe_kernel_reject(site)
         return run(pallas)
     except Exception as e:  # noqa: BLE001 — reclassified below
         if not (pallas and is_kernel_compile_failure(e)):

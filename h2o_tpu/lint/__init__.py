@@ -1,0 +1,49 @@
+"""graftlint — dataflow-aware static analysis for the h2o_tpu package.
+
+Four of this repo's worst latent bugs were STATIC bug classes fixed by
+hand after they bit at runtime: env reads baked into persisted AOT
+executables, "Array has been deleted" from reads of donated inputs, the
+GSPMD concatenate-on-row-sharded-operands miscompile, and the
+re-entrant spill deadlock.  graftlint makes each class a lint failure
+before dispatch.
+
+Architecture (one module per concern):
+
+- ``core``      — framework: ModuleInfo (parse-once AST + scope
+                  annotation + inline suppressions), Finding (with a
+                  line-independent fingerprint), the rule registry, the
+                  session AST cache, :func:`run_lint`;
+- ``classify``  — shared module/function classification: handler
+                  modules, shard-verb modules, ``shard_map`` bodies,
+                  and the traced-body reachability closure every
+                  dataflow pass keys off;
+- ``rules_purity``   — GL101–104 trace purity (env/clock/RNG/mutable-
+                  global reads inside traced bodies);
+- ``rules_donation`` — GL201 use-after-donate dataflow;
+- ``rules_shard``    — GL301–303 sharded-collective safety;
+- ``rules_locks``    — GL401/402 lock discipline + acquisition order;
+- ``rules_persist``  — GL501 exec-store persist safety;
+- ``rules_legacy``   — GL6xx: the 16 ad-hoc scans formerly hard-coded
+                  in tests/test_lint_resilience.py, migrated onto the
+                  framework (that file is now a thin tier-1 runner);
+- ``baseline``  — checked-in accepted-findings file
+                  (tools/graftlint_baseline.json) keyed by fingerprint;
+- ``__main__``  — the ``python -m h2o_tpu.lint`` CLI (text/JSON,
+                  nonzero exit on unbaselined findings).
+
+Suppress a single finding inline with a trailing (or own-line-above)
+comment carrying a reason::
+
+    fn = jax.jit(build(), **jkw)  # graftlint: disable=GL603  the store
+                                  # IS the sanctioned jit point
+
+Adding a pass: write ``check(mi, ctx)`` (or ``check(ctx)`` for
+package-wide contracts) in a ``rules_*`` module, decorate it with
+:func:`~h2o_tpu.lint.core.rule`, import the module from
+``core._load_passes``, and give it fixture coverage in
+tests/test_graftlint.py (positive, negative, suppressed).
+"""
+
+from h2o_tpu.lint.core import (Finding, LintResult, ModuleInfo,  # noqa: F401
+                               PackageContext, all_rules, last_summary,
+                               package_context, run_lint)

@@ -1,0 +1,105 @@
+"""CLI: ``python -m h2o_tpu.lint`` — text or JSON, nonzero on NEW
+findings (anything not in the checked-in baseline).
+
+Exit codes: 0 = clean (or every finding baselined), 1 = new findings,
+2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from h2o_tpu.lint import baseline as bl
+from h2o_tpu.lint.core import all_rules, package_context, run_lint
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m h2o_tpu.lint",
+        description="graftlint: dataflow-aware static analysis for the "
+                    "h2o_tpu package (trace purity, donation safety, "
+                    "sharded-collective correctness, lock discipline, "
+                    "persist safety + the migrated legacy scans)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable JSON report on stdout")
+    p.add_argument("--rules", metavar="IDS",
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    p.add_argument("--baseline", metavar="PATH", default=bl.DEFAULT_PATH,
+                   help="baseline file (default: tools/"
+                        "graftlint_baseline.json)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="snapshot current findings into the baseline "
+                        "(entries then need human-written reasons)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignoring the baseline")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rid, spec in sorted(all_rules().items()):
+            doc = (spec.doc or "").strip().splitlines()
+            head = doc[0] if doc else ""
+            print(f"{rid}  {spec.name:28s} [{spec.severity}/"
+                  f"{spec.kind}] {head}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = set(rules) - set(all_rules())
+        if unknown:
+            print(f"unknown rule ids: {sorted(unknown)}", file=sys.stderr)
+            return 2
+
+    result = run_lint(package_context(), rules=rules)
+
+    if args.write_baseline:
+        reasons = {e["fingerprint"]: e.get("reason", "")
+                   for e in bl.load(args.baseline).values()
+                   if e.get("reason")}
+        bl.save(result.findings, args.baseline, reasons=reasons)
+        print(f"baseline written: {len(result.findings)} finding(s) -> "
+              f"{args.baseline}")
+        return 0
+
+    if args.no_baseline:
+        new, old, stale = result.findings, [], []
+    else:
+        new, old, stale = bl.split(result.findings, args.baseline)
+
+    if args.json:
+        print(json.dumps({
+            "summary": {"rules_run": result.rules_run,
+                        "modules": result.modules,
+                        "findings": len(result.findings),
+                        "new": len(new), "baselined": len(old),
+                        "suppressed": result.suppressed,
+                        "stale_baseline": len(stale)},
+            "new": [vars(f) | {"fingerprint": f.fingerprint}
+                    for f in new],
+            "baselined": [f.fingerprint for f in old],
+            "stale_baseline": stale,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        if stale:
+            print(f"note: {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} (finding fixed "
+                  f"— remove from {args.baseline}):")
+            for s in stale:
+                print(f"  {s}")
+        print(f"graftlint: {result.rules_run} rules over "
+              f"{result.modules} modules — {len(new)} new, "
+              f"{len(old)} baselined, {result.suppressed} suppressed")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:       # | head and friends
+        sys.exit(0)

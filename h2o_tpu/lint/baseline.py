@@ -1,0 +1,75 @@
+"""Checked-in baseline: known findings that are accepted, with reasons.
+
+The baseline is the migration valve every adopted-late analyzer needs:
+``python -m h2o_tpu.lint --write-baseline`` snapshots today's findings
+(each entry then gets a human-written ``reason``), the CLI and the
+tier-1 runner fail only on findings NOT in the snapshot, and fixing a
+finding makes its entry stale (reported so the file shrinks instead of
+rotting).
+
+Entries are keyed by the line-INDEPENDENT
+:attr:`~h2o_tpu.lint.core.Finding.fingerprint`
+(``rule|path|scope|detail``), so unrelated edits to a file never
+invalidate the baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+from h2o_tpu.lint.core import Finding
+
+DEFAULT_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "tools", "graftlint_baseline.json")
+
+
+def load(path: str = DEFAULT_PATH) -> Dict[str, dict]:
+    """fingerprint -> entry ({"reason": ...} at minimum)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return {}
+    return {e["fingerprint"]: e for e in data.get("findings", [])}
+
+
+def save(findings: List[Finding], path: str = DEFAULT_PATH,
+         reasons: Dict[str, str] | None = None) -> None:
+    reasons = reasons or {}
+    entries = []
+    seen = set()
+    for f in sorted(findings, key=lambda f: f.fingerprint):
+        if f.fingerprint in seen:
+            continue
+        seen.add(f.fingerprint)
+        entries.append({
+            "fingerprint": f.fingerprint,
+            "rule": f.rule,
+            "path": f.path,
+            "reason": reasons.get(f.fingerprint,
+                                  "TODO: justify or fix"),
+        })
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"schema": 1, "findings": entries}, f, indent=2,
+                  sort_keys=True)
+        f.write("\n")
+
+
+def split(findings: List[Finding], path: str = DEFAULT_PATH
+          ) -> Tuple[List[Finding], List[Finding], List[str]]:
+    """(new, baselined, stale-fingerprints) against the baseline file."""
+    table = load(path)
+    new, old = [], []
+    hit = set()
+    for f in findings:
+        if f.fingerprint in table:
+            old.append(f)
+            hit.add(f.fingerprint)
+        else:
+            new.append(f)
+    stale = sorted(set(table) - hit)
+    return new, old, stale

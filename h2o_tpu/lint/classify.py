@@ -1,0 +1,330 @@
+"""Module / function classification shared by the dataflow passes.
+
+Four roles matter to the passes:
+
+- **handler**: REST surface (``api/handlers*.py``, ``api/server.py``) —
+  per-request code where a ``jax.jit`` is a recompile storm;
+- **shard-verb**: modules that build ``shard_map`` collectives (import
+  or call ``shard_map_compat`` / ``jax.shard_map``) — the home-sharded
+  data plane with its concatenate/host-gather hazards;
+- **shard body**: the function literally run under ``shard_map`` (its
+  arrays are per-shard locals; collectives are legal, host pulls are
+  not);
+- **traced body**: any function whose code can end up inside a
+  ``jax.jit`` trace — directly jitted, a shard body, a
+  ``lax.scan``/``while_loop``/``cond`` body, returned by a builder
+  passed to ``ExecStore.get_or_build``/``dispatch``/``cached_kernel``/
+  ``_dispatch_kernel``, plus everything reachable from those roots
+  through the intra-module call graph.  Host-side effects (env reads,
+  clocks, Python RNG, mutable globals) inside a traced body are baked
+  into the executable at trace time — the stale-AOT bug class.
+
+All results are computed once per module and cached on the
+:class:`~h2o_tpu.lint.core.ModuleInfo`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from h2o_tpu.lint.core import ModuleInfo
+
+# builder-taking exec-store entries: argument index of the builder
+BUILDER_ARG = {"get_or_build": 2, "dispatch": 2, "cached_kernel": 3,
+               "_dispatch_kernel": 2}
+
+# jax.lax control-flow combinators whose function args are traced
+_LAX_BODY_ARGS = {"scan": (0,), "while_loop": (0, 1), "cond": (1, 2),
+                  "fori_loop": (2,), "map": (0,), "switch": None,
+                  "associative_scan": (0,)}
+
+_COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather",
+                "all_to_all", "axis_index", "ppermute", "pshuffle",
+                "psum_scatter", "axis_size"}
+
+
+def _cached(mi: ModuleInfo, key: str, fn):
+    if key not in mi._cache:
+        mi._cache[key] = fn(mi)
+    return mi._cache[key]
+
+
+def is_handler_module(rel: str) -> bool:
+    return rel.startswith("api/") and (
+        rel.split("/")[-1].startswith("handlers") or rel == "api/server.py")
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    """Trailing simple name of the called expression: ``f(...)`` -> f,
+    ``a.b.f(...)`` -> f."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _attr_chain(node) -> List[str]:
+    """``a.b.c`` -> ["a", "b", "c"]; non-chains -> []."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return []
+
+
+def is_jax_jit_expr(node) -> bool:
+    """``jax.jit`` attribute, bare ``jit`` imported from jax is NOT
+    matched here (the handler rule checks the import form itself)."""
+    return _attr_chain(node) == ["jax", "jit"]
+
+
+def _partial_of(node: ast.Call) -> Optional[ast.AST]:
+    """``functools.partial(X, ...)`` / ``partial(X, ...)`` -> X."""
+    name = _call_name(node)
+    if name != "partial" or not node.args:
+        return None
+    return node.args[0]
+
+
+def uses_shard_map(mi: ModuleInfo) -> bool:
+    def compute(mi):
+        for node in ast.walk(mi.tree):
+            if isinstance(node, ast.Call):
+                n = _call_name(node)
+                if n in ("shard_map_compat", "shard_map"):
+                    return True
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    if alias.name.split(".")[-1] in (
+                            "shard_map_compat", "shard_map"):
+                        return True
+        return False
+    return _cached(mi, "uses_shard_map", compute)
+
+
+def _nested_defs(func: ast.AST) -> Dict[str, ast.FunctionDef]:
+    """Function defs lexically nested anywhere inside ``func``."""
+    out: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(func):
+        if node is not func and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, node)
+    return out
+
+
+def _module_defs(mi: ModuleInfo) -> Dict[str, ast.FunctionDef]:
+    out: Dict[str, ast.FunctionDef] = {}
+    for stmt in mi.tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[stmt.name] = stmt
+    return out
+
+
+def _resolve_fn_ref(mi: ModuleInfo, node, at_node) -> Optional[ast.AST]:
+    """A Name/Lambda/def used where a traceable function is expected ->
+    the function node it denotes (same module only)."""
+    if isinstance(node, ast.Lambda):
+        return node
+    if isinstance(node, ast.Name):
+        func = getattr(at_node, "_gl_func", None)
+        while func is not None:
+            hit = _nested_defs(func).get(node.id)
+            if hit is not None and hit._gl_func is func:
+                return hit
+            func = getattr(func, "_gl_func", None)
+        return _module_defs(mi).get(node.id)
+    return None
+
+
+def shard_bodies(mi: ModuleInfo) -> Dict[ast.AST, Tuple]:
+    """Function nodes executed under ``shard_map`` -> their literal
+    ``in_specs`` tuple expression (or None).  Two spellings:
+    ``shard_map_compat(kern, ...)`` with a first-arg function reference,
+    and ``@functools.partial(shard_map_compat, ...)`` decorators."""
+
+    def compute(mi):
+        out: Dict[ast.AST, Tuple] = {}
+        for node in ast.walk(mi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            if name in ("shard_map_compat", "shard_map") and node.args:
+                fn = _resolve_fn_ref(mi, node.args[0], node)
+                if fn is not None:
+                    out[fn] = _kw(node, "in_specs")
+        for fn in mi.functions():
+            for dec in fn.decorator_list:
+                if isinstance(dec, ast.Call):
+                    target = _partial_of(dec)
+                    if target is not None and isinstance(
+                            target, (ast.Name, ast.Attribute)):
+                        tname = target.id if isinstance(target, ast.Name) \
+                            else target.attr
+                        if tname in ("shard_map_compat", "shard_map"):
+                            out[fn] = _kw(dec, "in_specs")
+        return out
+
+    return _cached(mi, "shard_bodies", compute)
+
+
+def _kw(call: ast.Call, name: str):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def collective_calls(mi: ModuleInfo):
+    """(call node, collective name, axis-arg expr) for every
+    ``lax.<collective>`` / ``jax.lax.<collective>`` call."""
+    out = []
+    for node in ast.walk(mi.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if len(chain) >= 2 and chain[-2] == "lax" and \
+                chain[-1] in _COLLECTIVES:
+            axis = _kw(node, "axis_name")
+            if axis is None:
+                # positional: axis_index/axis_size take it first,
+                # everything else second
+                idx = 0 if chain[-1] in ("axis_index", "axis_size") else 1
+                if len(node.args) > idx:
+                    axis = node.args[idx]
+            out.append((node, chain[-1], axis))
+    return out
+
+
+def traced_nodes(mi: ModuleInfo) -> Set[ast.AST]:
+    """Every function node whose body can be captured inside a jit
+    trace (module docstring), closed over the intra-module call graph."""
+
+    def compute(mi):
+        roots: Set[ast.AST] = set(shard_bodies(mi))
+        builders: Set[ast.AST] = set()
+
+        def mark(ref, at):
+            fn = _resolve_fn_ref(mi, ref, at)
+            if fn is not None:
+                roots.add(fn)
+
+        for node in ast.walk(mi.tree):
+            if isinstance(node, ast.Call):
+                # jax.jit(X, ...)
+                if is_jax_jit_expr(node.func) and node.args:
+                    mark(node.args[0], node)
+                # functools.partial(jax.jit, X) is not a thing; the
+                # decorator form is handled below
+                name = _call_name(node)
+                # lax.scan(body, ...), lax.while_loop(cond, body, ...)
+                chain = _attr_chain(node.func)
+                if len(chain) >= 2 and chain[-2] == "lax" and \
+                        chain[-1] in _LAX_BODY_ARGS:
+                    idxs = _LAX_BODY_ARGS[chain[-1]]
+                    if idxs is None:                    # lax.switch
+                        for a in node.args[1:]:
+                            mark(a, node)
+                    else:
+                        for i in idxs:
+                            if len(node.args) > i:
+                                mark(node.args[i], node)
+                # exec-store builders: the function the builder RETURNS
+                # is traced; the builder itself runs on host
+                if name in BUILDER_ARG:
+                    i = BUILDER_ARG[name]
+                    b = node.args[i] if len(node.args) > i \
+                        else _kw(node, "build") or _kw(node, "builder")
+                    if b is not None:
+                        fn = _resolve_fn_ref(mi, b, node)
+                        if isinstance(fn, ast.Lambda):
+                            # lambda: KERN  /  lambda: make_kern(...)
+                            body = fn.body
+                            if isinstance(body, ast.Name):
+                                mark(body, node)
+                            elif isinstance(body, ast.Call):
+                                bf = _resolve_fn_ref(mi, body.func, node)
+                                if bf is not None:
+                                    builders.add(bf)
+                        elif fn is not None:
+                            builders.add(fn)
+        # a builder's returned function references are traced roots
+        for b in builders:
+            for node in ast.walk(b):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    v = node.value
+                    if isinstance(v, (ast.Name, ast.Lambda)):
+                        fn = _resolve_fn_ref(mi, v, node)
+                        if fn is not None:
+                            roots.add(fn)
+        # decorator forms: @jax.jit / @functools.partial(jax.jit, ...)
+        for fn in mi.functions():
+            for dec in fn.decorator_list:
+                if is_jax_jit_expr(dec):
+                    roots.add(fn)
+                elif isinstance(dec, ast.Call):
+                    if is_jax_jit_expr(dec.func):
+                        roots.add(fn)
+                    else:
+                        target = _partial_of(dec)
+                        if target is not None and is_jax_jit_expr(target):
+                            roots.add(fn)
+
+        # close over the intra-module call graph
+        mod_defs = _module_defs(mi)
+        reach = set(roots)
+        frontier = list(roots)
+        while frontier:
+            fn = frontier.pop()
+            nested = _nested_defs(fn) if not isinstance(fn, ast.Lambda) \
+                else {}
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = _call_name(node)
+                if cname is None:
+                    continue
+                callee = nested.get(cname) or mod_defs.get(cname)
+                if callee is not None and callee not in reach:
+                    reach.add(callee)
+                    frontier.append(callee)
+        return reach
+
+    return _cached(mi, "traced_nodes", compute)
+
+
+def walk_own(func) -> list:
+    """Nodes of ``func``'s own body, excluding nested function/lambda
+    subtrees (those are separate traced entries when reachable)."""
+    out = []
+    stack = list(getattr(func, "body", [])) if not isinstance(
+        func, ast.Lambda) else [func.body]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def globally_rebound_names(mi: ModuleInfo) -> Set[str]:
+    """Names some function rebinds through ``global`` — the module's
+    MUTABLE globals.  Reading one inside a traced body bakes the value
+    seen at trace time into the executable."""
+
+    def compute(mi):
+        out: Set[str] = set()
+        for node in ast.walk(mi.tree):
+            if isinstance(node, ast.Global):
+                out.update(node.names)
+        return out
+
+    return _cached(mi, "globally_rebound", compute)

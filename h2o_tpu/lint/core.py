@@ -1,0 +1,314 @@
+"""graftlint framework core: modules, findings, suppressions, registry.
+
+The shared substrate every pass builds on:
+
+- :class:`ModuleInfo` — one parsed source file: AST (parsed once per
+  process, mtime-keyed session cache), source lines, per-node scope
+  annotation (``_gl_scope`` / ``_gl_func``), and the inline-suppression
+  table (``# graftlint: disable=RULE[,RULE]  reason``, applying to the
+  same physical line or the single line below the comment);
+- :class:`Finding` — one diagnostic, with a LINE-INDEPENDENT
+  ``fingerprint`` (rule, path, enclosing scope, rule-chosen detail
+  token) so the checked-in baseline survives unrelated edits;
+- the rule registry — :func:`rule` registers a checker; ``module``
+  rules run once per file, ``package`` rules once per lint with the
+  whole :class:`PackageContext` (contract/existence checks);
+- :func:`run_lint` — the one entry the CLI, the tier-1 runner and the
+  conftest summary all share.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+SEVERITIES = ("error", "warning")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_*,]+)(?:\s+(.*))?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic.  ``detail`` is a rule-chosen stable token (an
+    attribute name, an env var, a lock pair) — never a line number — so
+    the baseline fingerprint survives line drift."""
+
+    rule: str
+    severity: str
+    path: str          # package-relative posix path
+    line: int
+    scope: str         # enclosing function qualname, or "<module>"
+    message: str
+    detail: str = ""
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}|{self.path}|{self.scope}|{self.detail}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule} [{self.severity}] "
+                f"{self.message}")
+
+
+class ModuleInfo:
+    """One parsed module plus the derived tables every pass shares.
+
+    Classification results (shard bodies, traced reachability, …) are
+    attached lazily by h2o_tpu.lint.classify and cached on the
+    instance, so N rules over M modules parse and classify each module
+    exactly once per session.
+    """
+
+    def __init__(self, rel: str, source: str, path: str = ""):
+        self.rel = rel.replace(os.sep, "/")
+        self.path = path or rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        self._annotate_scopes()
+        self.suppressions = self._parse_suppressions()
+        self._cache: Dict[str, object] = {}   # classify.* lazy results
+
+    # -- scope annotation ---------------------------------------------------
+
+    def _annotate_scopes(self) -> None:
+        """Stamp every node with its enclosing-function qualname
+        (``_gl_scope``) and nearest function node (``_gl_func``)."""
+
+        def visit(node, scope: str, func):
+            node._gl_scope = scope
+            node._gl_func = func
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                inner = node.name if scope == "<module>" \
+                    else f"{scope}.{node.name}"
+                node._gl_qualname = inner
+                for dec in node.decorator_list:
+                    visit(dec, scope, func)
+                visit(node.args, inner, node)
+                for stmt in node.body:
+                    visit(stmt, inner, node)
+                return
+            if isinstance(node, ast.ClassDef):
+                inner = node.name if scope == "<module>" \
+                    else f"{scope}.{node.name}"
+                for dec in node.decorator_list:
+                    visit(dec, scope, func)
+                for b in list(node.bases) + list(node.keywords):
+                    visit(b, scope, func)
+                for stmt in node.body:
+                    visit(stmt, inner, func)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, scope, func)
+
+        visit(self.tree, "<module>", None)
+
+    # -- suppressions -------------------------------------------------------
+
+    def _parse_suppressions(self) -> Dict[int, set]:
+        """line -> set of rule ids disabled there.  A comment on its own
+        line covers the next CODE line (skipping the rest of a
+        contiguous comment block), so a multi-line justification above a
+        decorator or long expression still lands on the code."""
+        table: Dict[int, set] = {}
+        for i, line in enumerate(self.lines, 1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            table.setdefault(i, set()).update(rules)
+            if line.lstrip().startswith("#"):      # own-line comment
+                j = i + 1
+                while j <= len(self.lines) and \
+                        self.lines[j - 1].lstrip().startswith("#"):
+                    j += 1
+                table.setdefault(j, set()).update(rules)
+        return table
+
+    def suppressed(self, rule_id: str, line: int) -> bool:
+        rules = self.suppressions.get(line)
+        return bool(rules and (rule_id in rules or "*" in rules))
+
+    # -- helpers used by many rules ----------------------------------------
+
+    def functions(self) -> List[ast.FunctionDef]:
+        return [n for n in ast.walk(self.tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def function_named(self, name: str):
+        for n in self.functions():
+            if n.name == name:
+                return n
+        return None
+
+    def scope_of(self, node) -> str:
+        return getattr(node, "_gl_scope", "<module>")
+
+
+@dataclasses.dataclass
+class RuleSpec:
+    id: str
+    name: str
+    severity: str
+    kind: str                      # "module" | "package"
+    doc: str
+    check: Callable
+
+
+_REGISTRY: Dict[str, RuleSpec] = {}
+
+
+def rule(rule_id: str, name: str, *, severity: str = "error",
+         kind: str = "module", doc: str = ""):
+    """Register a pass.  ``module`` checks get ``(mi, ctx)`` per file;
+    ``package`` checks get ``(ctx,)`` once per lint run."""
+    assert severity in SEVERITIES, severity
+    assert kind in ("module", "package"), kind
+
+    def deco(fn):
+        _REGISTRY[rule_id] = RuleSpec(rule_id, name, severity, kind,
+                                      doc or (fn.__doc__ or "").strip(),
+                                      fn)
+        return fn
+    return deco
+
+
+def all_rules() -> Dict[str, RuleSpec]:
+    _load_passes()
+    return dict(_REGISTRY)
+
+
+class PackageContext:
+    """Everything a pass may need beyond its own module: the full
+    module table (contract rules look other files up by rel path) and
+    the package root."""
+
+    def __init__(self, modules: Dict[str, ModuleInfo],
+                 pkg_root: str = ""):
+        self.modules = modules
+        self.pkg_root = pkg_root
+
+    def get(self, rel: str) -> Optional[ModuleInfo]:
+        return self.modules.get(rel)
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]
+    suppressed: int
+    rules_run: int
+    modules: int
+
+    def by_rule(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+
+# -- session AST cache -------------------------------------------------------
+
+_ast_cache: Dict[str, Tuple[float, ModuleInfo]] = {}
+_ast_cache_lock = threading.Lock()
+
+
+def load_module(path: str, rel: str) -> Optional[ModuleInfo]:
+    """Parse-once-per-session module loader (mtime-invalidated): the
+    tier-1 runner, the conftest summary and repeated CLI invocations in
+    one process all share the same parsed ASTs."""
+    try:
+        mtime = os.stat(path).st_mtime
+    except OSError:
+        return None
+    with _ast_cache_lock:
+        hit = _ast_cache.get(path)
+        if hit is not None and hit[0] == mtime:
+            return hit[1]
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            src = f.read()
+        mi = ModuleInfo(rel, src, path=path)
+    except SyntaxError:
+        return None
+    with _ast_cache_lock:
+        _ast_cache[path] = (mtime, mi)
+    return mi
+
+
+def package_context(pkg_root: Optional[str] = None) -> PackageContext:
+    if pkg_root is None:
+        import h2o_tpu
+        pkg_root = os.path.dirname(h2o_tpu.__file__)
+    modules: Dict[str, ModuleInfo] = {}
+    for dirpath, dirs, files in os.walk(pkg_root):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, pkg_root).replace(os.sep, "/")
+            mi = load_module(path, rel)
+            if mi is not None:
+                modules[rel] = mi
+    return PackageContext(modules, pkg_root)
+
+
+def _load_passes() -> None:
+    """Import every rules module exactly once (registration side
+    effect)."""
+    from h2o_tpu.lint import (rules_donation, rules_legacy,  # noqa: F401
+                              rules_locks, rules_persist, rules_purity,
+                              rules_shard)
+
+
+_last_summary: Optional[dict] = None
+
+
+def last_summary() -> Optional[dict]:
+    """Stats of the most recent :func:`run_lint` in this process — the
+    conftest ``[graftlint]`` terminal line reads exactly this."""
+    return _last_summary
+
+
+def run_lint(ctx: Optional[PackageContext] = None,
+             rules: Optional[Iterable[str]] = None,
+             note_summary: bool = True) -> LintResult:
+    """Run the selected rules (default: all) over ``ctx`` (default: the
+    installed h2o_tpu package).  Inline suppressions are applied here;
+    baseline filtering is the caller's (CLI / tier-1 runner) job so the
+    raw finding set stays inspectable."""
+    global _last_summary
+    _load_passes()
+    if ctx is None:
+        ctx = package_context()
+    specs = [s for rid, s in sorted(_REGISTRY.items())
+             if rules is None or rid in set(rules)]
+    findings: List[Finding] = []
+    suppressed = 0
+    for spec in specs:
+        if spec.kind == "package":
+            emitted = list(spec.check(ctx) or ())
+        else:
+            emitted = []
+            for rel in sorted(ctx.modules):
+                emitted.extend(spec.check(ctx.modules[rel], ctx) or ())
+        for f in emitted:
+            mi = ctx.modules.get(f.path)
+            if mi is not None and mi.suppressed(f.rule, f.line):
+                suppressed += 1
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    result = LintResult(findings=findings, suppressed=suppressed,
+                        rules_run=len(specs), modules=len(ctx.modules))
+    if note_summary:
+        _last_summary = {"rules_run": result.rules_run,
+                         "findings": len(result.findings),
+                         "suppressed": result.suppressed,
+                         "modules": result.modules}
+    return result

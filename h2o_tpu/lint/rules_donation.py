@@ -1,0 +1,241 @@
+"""GL201 — donation safety: no read of an argument after it was donated.
+
+The PR 6 bug class: a dispatch donates an input buffer
+(``donate_argnums``/``donate_argnames``), XLA invalidates the array, and
+a later host-side read of the same Python name dies with "Array has been
+deleted" — at runtime, possibly only on the backend where the donation
+policy is on.  This pass finds it statically with intra-function
+dataflow:
+
+1. **donation events** — three spellings:
+   (a) a call carrying a non-empty literal ``donate_argnums=`` /
+   ``donate_argnames=`` together with its argument tuple (the
+   ``ExecStore.dispatch(phase, key, build, (a, b))`` shape) — the Names
+   inside any tuple/list positional are donated;
+   (b) a name bound to a donating factory —
+   ``fn = ...get_or_build(..., donate_argnums=(0,))`` or
+   ``fn = jax.jit(body, donate_argnums=(0,))`` — later calls of that
+   name donate the positional args at the literal argnums (keyword args
+   matching the literal argnames); with ``*args`` splats the indices
+   are unresolvable and every positional Name is treated as donated;
+   (c) a module-level function decorated
+   ``@functools.partial(jax.jit, donate_argnums=(...))`` — direct calls
+   to it donate the same way.
+2. **use after donation** — any Load of a donated name on a later line
+   of the same function flags, unless the name was rebound in between.
+
+Line order approximates control flow (the repo's dispatch sites are
+straight-line); a donate-then-retry loop needs an inline suppression
+with its safety argument, exactly like core/exec_store.py's re-route
+machinery documents.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from h2o_tpu.lint import classify
+from h2o_tpu.lint.core import Finding, ModuleInfo, rule
+
+RULE = "GL201"
+
+
+def _literal_ints(node) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _literal_strs(node) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _donate_kwargs(call: ast.Call):
+    """(argnums, argnames) literals if the call donates, else None.
+    Non-literal donate specs (forwarded parameters) are invisible —
+    the flagging happens at the literal declaration site instead."""
+    argnums = argnames = None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            argnums = _literal_ints(kw.value)
+        elif kw.arg == "donate_argnames":
+            argnames = _literal_strs(kw.value)
+    if argnums or argnames:
+        return argnums or (), argnames or ()
+    return None
+
+
+def _names_in(node) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _module_donating_defs(mi: ModuleInfo) -> Dict[str, Tuple]:
+    """name -> (argnums, argnames) for module-level defs decorated with
+    a donating jax.jit partial."""
+    out: Dict[str, Tuple] = {}
+    for stmt in mi.tree.body:
+        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in stmt.decorator_list:
+            if isinstance(dec, ast.Call):
+                target = classify._partial_of(dec)
+                spec = _donate_kwargs(dec)
+                if spec and (classify.is_jax_jit_expr(dec.func) or
+                             (target is not None and
+                              classify.is_jax_jit_expr(target))):
+                    out[stmt.name] = spec
+    return out
+
+
+def _donated_at_call(call: ast.Call, spec: Tuple) -> Set[str]:
+    """Names donated by calling a donating callable with ``spec``."""
+    argnums, argnames = spec
+    donated: Set[str] = set()
+    has_star = any(isinstance(a, ast.Starred) for a in call.args)
+    if has_star:
+        # indices unresolvable: treat every positional Name as donated
+        for a in call.args:
+            v = a.value if isinstance(a, ast.Starred) else a
+            if isinstance(v, ast.Name):
+                donated.add(v.id)
+    else:
+        for i in argnums:
+            if i < len(call.args) and isinstance(call.args[i], ast.Name):
+                donated.add(call.args[i].id)
+    for kw in call.keywords:
+        if kw.arg in argnames and isinstance(kw.value, ast.Name):
+            donated.add(kw.value.id)
+    if not has_star and argnames and not donated:
+        # donate_argnames with positionally-passed args: cannot map
+        # names to parameters across modules — donate every positional
+        # Name (conservative; rebind tracking keeps the noise down)
+        for a in call.args:
+            if isinstance(a, ast.Name):
+                donated.add(a.id)
+    return donated
+
+
+def _check_function(mi: ModuleInfo, func, donating_defs) -> List[Finding]:
+    # (start, end, names, via): a read is only a use-after-donate when
+    # it falls AFTER the donating call's full span — names inside the
+    # call itself (the args tuple, the cache key) are the donation
+    events: List[Tuple[int, int, Set[str], str]] = []
+    factories: Dict[str, Tuple] = {}               # fnvar -> donate spec
+
+    body_nodes = classify.walk_own(func)
+    # pass 1: find donation events and donating factories, in any order
+    for node in body_nodes:
+        if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call):
+            spec = _donate_kwargs(node.value)
+            cname = classify._call_name(node.value)
+            if spec and (cname in ("get_or_build", "jit") or
+                         classify.is_jax_jit_expr(node.value.func)):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        factories[t.id] = spec
+                continue
+        if not isinstance(node, ast.Call):
+            continue
+        end = getattr(node, "end_lineno", None) or node.lineno
+        spec = _donate_kwargs(node)
+        if spec is not None:
+            # inline donating dispatch: ONLY the args tuple is consumed
+            # (dispatch(phase, key, build, (a, b), ...) — positional 3 —
+            # or the args= kwarg); the cache-key tuple is host metadata
+            donated: Set[str] = set()
+            cands = []
+            if classify._call_name(node) in ("dispatch",
+                                             "_dispatch_kernel") and \
+                    len(node.args) > 3:
+                cands.append(node.args[3])
+            kw_args = classify._kw(node, "args")
+            if kw_args is not None:
+                cands.append(kw_args)
+            for a in cands:
+                if isinstance(a, (ast.Tuple, ast.List)):
+                    donated |= {e.id for e in a.elts
+                                if isinstance(e, ast.Name)}
+            if donated:
+                events.append((node.lineno, end, donated, "dispatch"))
+        if isinstance(node.func, ast.Name):
+            spec2 = factories.get(node.func.id) or \
+                donating_defs.get(node.func.id)
+            if spec2:
+                donated = _donated_at_call(node, spec2)
+                if donated:
+                    events.append((node.lineno, end, donated,
+                                   node.func.id))
+    if not events:
+        return []
+
+    # pass 2: rebind lines per name (a rebound name is a fresh array)
+    rebinds: Dict[str, List[int]] = {}
+    for node in body_nodes:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign,
+                               ast.For, ast.withitem)):
+            targets = [getattr(node, "target", None) or
+                       getattr(node, "optional_vars", None)]
+        for t in targets:
+            if t is None:
+                continue
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    rebinds.setdefault(n.id, []).append(node.lineno)
+
+    # pass 3: flag loads after the donating call's span ends, unless
+    # the name was rebound at-or-after the donation (the
+    # ``x = step(x, ...)`` self-update rebinds to the RESULT buffer,
+    # which is fresh — that pattern is donation-correct)
+    out: List[Finding] = []
+    seen: Set[Tuple[int, str]] = set()
+    for node in body_nodes:
+        if not (isinstance(node, ast.Name) and
+                isinstance(node.ctx, ast.Load)):
+            continue
+        for start, end, names, via in events:
+            if node.id not in names or node.lineno <= end:
+                continue
+            if any(start <= rb <= node.lineno
+                   for rb in rebinds.get(node.id, ())):
+                continue
+            key = (node.lineno, node.id)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(Finding(
+                RULE, "error", mi.rel, node.lineno,
+                mi.scope_of(node),
+                f"`{node.id}` read after being donated at line {start} "
+                f"(via {via}) — XLA may have invalidated the buffer "
+                f"('Array has been deleted'); re-materialize the input "
+                f"or dispatch with donate=False",
+                detail=f"use-after-donate:{node.id}"))
+    return out
+
+
+@rule(RULE, "use-after-donate", severity="error", doc=__doc__)
+def check(mi: ModuleInfo, ctx):
+    donating_defs = _module_donating_defs(mi)
+    out: List[Finding] = []
+    for func in mi.functions():
+        out.extend(_check_function(mi, func, donating_defs))
+    return out

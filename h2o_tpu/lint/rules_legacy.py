@@ -1,0 +1,468 @@
+"""GL6xx — the 16 ad-hoc scans of tests/test_lint_resilience.py, as
+framework rules.
+
+Each rule keeps its original check's exact semantics (same scopes, same
+allowlists where the allowed file IS the implementation, e.g. the
+persist backends for GL601) — but gains rule IDs, fingerprints,
+suppressions and the baseline workflow.  The two checks with runtime
+halves keep them in the thin tier-1 runner: GL613's payload-reach
+assertion (live handler call) and GL614's seed-determinism drill.
+
+Mapping (old test -> rule):
+
+==============================================  ======
+test_no_bare_urlopen_outside_persist            GL601
+test_no_jax_jit_in_api_handlers                 GL602
+test_no_jax_jit_on_local_closures               GL603
+test_no_to_numpy_in_device_munge_verbs          GL604
+test_no_to_numpy_in_stream_chunk_landing        GL605
+test_no_host_gather_in_sharded_munge_verbs      GL303 (rules_shard)
+test_stream_append_verbs_still_exist            GL607
+test_sharded_munge_verbs_still_exist            GL608
+test_munge_host_fallbacks_still_exist           GL609
+test_lever_consumers_route_through_resolve_flag GL610
+test_probe_runs_under_dedicated_autotune_oom_…  GL611
+test_every_chaos_injector_has_a_dedicated_…     GL612
+test_chaos_counters_reach_resilience_payload    GL613 (static half)
+test_chaos_injection_sequence_is_seed_determ…   GL614 (static half)
+test_lever_env_vars_resolved_only_in_autotune   GL620
+test_autotune_reads_env_only_in_env_value       GL621
+==============================================  ======
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set
+
+from h2o_tpu.lint import classify
+from h2o_tpu.lint.core import Finding, ModuleInfo, PackageContext, rule
+from h2o_tpu.lint.rules_shard import SHARD_MUNGE_VERBS
+
+# -- GL601: raw network I/O must go through the retry layer ------------------
+
+_URLOPEN_ALLOWED = {"core/persist.py", "core/resilience.py"}
+_URLOPEN = re.compile(r"\burlopen\s*\(")
+
+
+@rule("GL601", "bare-urlopen")
+def check_urlopen(mi: ModuleInfo, ctx):
+    """urlopen outside core/persist.py's retried byte-store layer."""
+    if mi.rel in _URLOPEN_ALLOWED:
+        return []
+    out = []
+    for i, line in enumerate(mi.lines, 1):
+        if _URLOPEN.search(line):
+            out.append(Finding(
+                "GL601", "error", mi.rel, i, "<module>",
+                "bare urlopen call outside the persist/retry layer; route "
+                "through h2o_tpu.core.persist.read_bytes/write_bytes (or "
+                "add a scheme backend in persist.py) so transient faults "
+                "retry", detail="urlopen"))
+    return out
+
+
+# -- GL602: no per-request compiles in REST handlers -------------------------
+
+_JIT_RE = re.compile(r"\bjax\s*\.\s*jit\s*\(")
+_JIT_IMPORT = re.compile(r"^\s*from\s+jax\s+import\s+.*\bjit\b")
+
+
+@rule("GL602", "jit-in-handler")
+def check_handler_jit(mi: ModuleInfo, ctx):
+    """jax.jit inside api/handlers*.py — a compile per request shape."""
+    base = mi.rel.split("/")[-1]
+    if not (mi.rel.startswith("api/") and base.startswith("handlers")):
+        return []
+    out = []
+    for i, line in enumerate(mi.lines, 1):
+        if _JIT_RE.search(line) or _JIT_IMPORT.search(line):
+            out.append(Finding(
+                "GL602", "error", mi.rel, i, "<module>",
+                "jax.jit inside a REST handler module — per-request "
+                "compiles belong behind h2o_tpu/serve/engine.py's "
+                "bounded compiled-predict cache (power-of-two batch "
+                "buckets)", detail=f"jit-line:{i}"))
+    return out
+
+
+# -- GL603: no jax.jit on per-call closures ----------------------------------
+
+@rule("GL603", "jit-closure")
+def check_jit_closure(mi: ModuleInfo, ctx):
+    """jax.jit referenced inside a function body wraps a fresh closure
+    per call — every call re-traces and re-compiles.  Module-level jits
+    (decorators and assignments) evaluate once and are fine.  Legitimate
+    exceptions (the exec store's own build path; bounded lru_cache'd
+    factories) carry inline suppressions with their reasons."""
+    out = []
+    for node in ast.walk(mi.tree):
+        if not (isinstance(node, ast.Attribute) and node.attr == "jit" and
+                isinstance(node.value, ast.Name) and
+                node.value.id == "jax"):
+            continue
+        if getattr(node, "_gl_func", None) is None:
+            continue                      # module level: the good pattern
+        out.append(Finding(
+            "GL603", "error", mi.rel, node.lineno, mi.scope_of(node),
+            "jax.jit inside a function body — wraps a fresh closure per "
+            "call and re-compiles every time; move the jit to module "
+            "level or route through the exec store "
+            "(core/exec_store.get_or_build / core/mrtask.map_reduce)",
+            detail=f"jit-closure:{mi.scope_of(node)}"))
+    return out
+
+
+# -- GL604/GL605: zero-host-pull verbs ---------------------------------------
+
+DEVICE_MUNGE_VERBS = {"_sort", "_merge", "_groupby", "_row_select"}
+MUNGE_HOST_ALLOWED = {"_merge_host", "_groupby_host", "_row_select_host",
+                      "_row_select_mask_host", "_sort_keys", "_key_codes"}
+STREAM_APPEND_VERBS = {"append", "append_rows", "_build_grow",
+                       "_build_append_write"}
+
+
+def _to_numpy_findings(mi: ModuleInfo, rule_id: str, only_fns,
+                       msg: str) -> List[Finding]:
+    out = []
+    for func in mi.functions():
+        if only_fns is not None and func.name not in only_fns:
+            continue
+        for sub in ast.walk(func):
+            if isinstance(sub, ast.Attribute) and sub.attr == "to_numpy":
+                out.append(Finding(
+                    rule_id, "error", mi.rel, sub.lineno,
+                    mi.scope_of(sub), msg,
+                    detail=f"to_numpy:{func.name}"))
+    return out
+
+
+@rule("GL604", "munge-host-pull")
+def check_munge_host_pull(mi: ModuleInfo, ctx):
+    """to_numpy inside a device-converted munge verb."""
+    if mi.rel == "rapids/interp.py":
+        return _to_numpy_findings(
+            mi, "GL604", DEVICE_MUNGE_VERBS,
+            "to_numpy() inside a device-converted munge verb — these "
+            "verbs must stay zero-host-pull; put host-only logic in the "
+            "*_host fallbacks")
+    if mi.rel == "core/munge.py":
+        return _to_numpy_findings(
+            mi, "GL604", None,
+            "to_numpy() inside the munge kernel layer — reopens the "
+            "HBM->host->HBM round-trip the device conversion closed")
+    return []
+
+
+@rule("GL605", "stream-host-pull")
+def check_stream_host_pull(mi: ModuleInfo, ctx):
+    """to_numpy inside the streaming chunk-landing path."""
+    if mi.rel == "stream/ingest.py":
+        return _to_numpy_findings(
+            mi, "GL605", None,
+            "to_numpy() inside streaming ingest — appends must stay "
+            "zero-host-pull; chunk-side host logic belongs in "
+            "parse.tokenize_chunk / _chunk_cols_from_frame")
+    if mi.rel == "core/frame.py":
+        return _to_numpy_findings(
+            mi, "GL605", STREAM_APPEND_VERBS,
+            "to_numpy() inside a Frame/Vec append verb — appends must "
+            "stay zero-host-pull (pow2-bucketed device block writes)")
+    return []
+
+
+# -- GL607/608/609: contract-existence checks --------------------------------
+
+def _existence(ctx: PackageContext, rule_id: str, rel: str,
+               wanted: Set[str], what: str) -> List[Finding]:
+    mi = ctx.get(rel)
+    if mi is None:
+        return [Finding(rule_id, "error", rel, 1, "<module>",
+                        f"{rel} is gone — the {what} contract moved "
+                        f"without updating the lint", detail="module")]
+    names = {f.name for f in mi.functions()}
+    return [Finding(
+        rule_id, "error", rel, 1, "<module>",
+        f"{what} verb `{m}` missing from {rel} — renaming it away "
+        f"silently un-scopes the host-pull lint that polices it",
+        detail=f"missing:{m}") for m in sorted(wanted - names)]
+
+
+@rule("GL607", "stream-verbs-exist", kind="package")
+def check_stream_verbs(ctx: PackageContext):
+    """The append verbs GL605 polices still exist in core/frame.py."""
+    return _existence(ctx, "GL607", "core/frame.py",
+                      STREAM_APPEND_VERBS, "stream append")
+
+
+@rule("GL608", "shard-verbs-exist", kind="package")
+def check_shard_verbs(ctx: PackageContext):
+    """The sharded verbs GL303 polices still exist in core/munge.py."""
+    return _existence(ctx, "GL608", "core/munge.py",
+                      SHARD_MUNGE_VERBS - {"_shard_sort_frame"},
+                      "sharded munge")
+
+
+@rule("GL609", "host-fallbacks-exist", kind="package")
+def check_host_fallbacks(ctx: PackageContext):
+    """The host parity oracles (H2O_TPU_DEVICE_MUNGE=0) still exist."""
+    return _existence(ctx, "GL609", "rapids/interp.py",
+                      MUNGE_HOST_ALLOWED, "host munge fallback")
+
+
+# -- GL610/GL611: autotune contract checks -----------------------------------
+
+_LEVER_CONSUMERS = {
+    "ops/histogram.py": {"pallas_env_enabled"},
+    "models/tree/jit_engine.py": {"matmul_route_enabled",
+                                  "sibling_subtract_enabled"},
+}
+
+
+@rule("GL610", "lever-consumers-resolve", kind="package")
+def check_lever_consumers(ctx: PackageContext):
+    """The lever consumer gates still delegate to autotune.resolve_flag
+    — without this, GL620's env ban would quietly become dead code."""
+    out = []
+    for rel, fns in _LEVER_CONSUMERS.items():
+        mi = ctx.get(rel)
+        if mi is None:
+            out.append(Finding("GL610", "error", rel, 1, "<module>",
+                               f"{rel} is gone", detail="module"))
+            continue
+        for want in sorted(fns):
+            fn = mi.function_named(want)
+            if fn is None:
+                out.append(Finding(
+                    "GL610", "error", rel, 1, "<module>",
+                    f"{rel}: {want}() is gone — the lever gate contract "
+                    f"moved without updating the lint",
+                    detail=f"missing:{want}"))
+                continue
+            calls = {classify._call_name(c) for c in ast.walk(fn)
+                     if isinstance(c, ast.Call)}
+            if "resolve_flag" not in calls:
+                out.append(Finding(
+                    "GL610", "error", rel, fn.lineno, want,
+                    f"{want}() no longer delegates to "
+                    f"autotune.resolve_flag — lever decisions must flow "
+                    f"through the one measured resolution point",
+                    detail=f"no-resolve:{want}"))
+    return out
+
+
+@rule("GL611", "autotune-oom-site", kind="package")
+def check_autotune_oom_site(ctx: PackageContext):
+    """The autotune probe still runs under oom_ladder('autotune', ...)
+    so probe OOMs degrade the probe instead of killing the job."""
+    mi = ctx.get("core/autotune.py")
+    if mi is None:
+        return [Finding("GL611", "error", "core/autotune.py", 1,
+                        "<module>", "core/autotune.py is gone",
+                        detail="module")]
+    sites = [n.args[0].value for n in ast.walk(mi.tree)
+             if isinstance(n, ast.Call) and
+             classify._call_name(n) == "oom_ladder" and
+             n.args and isinstance(n.args[0], ast.Constant)]
+    if "autotune" not in sites:
+        return [Finding(
+            "GL611", "error", mi.rel, 1, "<module>",
+            "no oom_ladder('autotune', ...) call — probe OOMs would "
+            "kill the training job instead of degrading the probe",
+            detail="no-autotune-site")]
+    return []
+
+
+# -- GL612/613/614: chaos-injector discipline --------------------------------
+
+def _chaos_cls(mi: ModuleInfo):
+    for n in ast.walk(mi.tree):
+        if isinstance(n, ast.ClassDef) and n.name == "_Chaos":
+            return n
+    return None
+
+
+def _injector_counters(cls) -> Dict[str, Set[str]]:
+    """maybe_* method -> dedicated self.injected_* counters it bumps."""
+    out: Dict[str, Set[str]] = {}
+    for fn in cls.body:
+        if not isinstance(fn, ast.FunctionDef) or \
+                not fn.name.startswith("maybe_"):
+            continue
+        counters: Set[str] = set()
+        for node in ast.walk(fn):
+            targets = []
+            if isinstance(node, ast.AugAssign):
+                targets = [node.target]
+            elif isinstance(node, ast.Assign):
+                targets = node.targets
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self" and \
+                        t.attr.startswith("injected_"):
+                    counters.add(t.attr)
+        out[fn.name] = counters
+    return out
+
+
+@rule("GL612", "chaos-counter-discipline")
+def check_chaos_counters(mi: ModuleInfo, ctx):
+    """Every maybe_* injector bumps a DEDICATED injected_* counter —
+    otherwise soak runs see faults no counter explains."""
+    if mi.rel != "core/chaos.py":
+        return []
+    cls = _chaos_cls(mi)
+    if cls is None:
+        return [Finding("GL612", "error", mi.rel, 1, "<module>",
+                        "class _Chaos is gone", detail="no-class")]
+    out = []
+    for name, counters in _injector_counters(cls).items():
+        if not counters:
+            out.append(Finding(
+                "GL612", "error", mi.rel, cls.lineno, f"_Chaos.{name}",
+                f"chaos injector {name}() has no dedicated injected_* "
+                f"counter — add self.injected_<x> += 1 next to the "
+                f"injection so soak accounting balances",
+                detail=f"no-counter:{name}"))
+    return out
+
+
+@rule("GL613", "chaos-counters-exported", kind="package")
+def check_counters_exported(ctx: PackageContext):
+    """Static half of the payload-reach contract: every dedicated
+    injector counter is a key of _Chaos.counters(), and the resilience
+    handler spreads counters() into its chaos block.  (The runtime
+    half — the live /3/Resilience payload — stays in the tier-1
+    runner.)"""
+    out: List[Finding] = []
+    mi = ctx.get("core/chaos.py")
+    cls = _chaos_cls(mi) if mi is not None else None
+    if cls is None:
+        return [Finding("GL613", "error", "core/chaos.py", 1, "<module>",
+                        "class _Chaos is gone", detail="no-class")]
+    wanted = {"injected"}
+    for ctrs in _injector_counters(cls).values():
+        wanted |= ctrs
+    counters_fn = next((f for f in cls.body
+                        if isinstance(f, ast.FunctionDef) and
+                        f.name == "counters"), None)
+    exported: Set[str] = set()
+    if counters_fn is not None:
+        exported = {c.value for c in ast.walk(counters_fn)
+                    if isinstance(c, ast.Constant) and
+                    isinstance(c.value, str)}
+    for missing in sorted(wanted - exported):
+        out.append(Finding(
+            "GL613", "error", mi.rel,
+            counters_fn.lineno if counters_fn else cls.lineno,
+            "_Chaos.counters",
+            f"injector counter `{missing}` is not exported by "
+            f"_Chaos.counters() — it never reaches GET /3/Resilience, "
+            f"so its faults are invisible to operators",
+            detail=f"unexported:{missing}"))
+    hmi = ctx.get("api/handlers.py")
+    hfn = hmi.function_named("resilience_stats") if hmi else None
+    if hfn is None or "counters" not in {
+            classify._call_name(c) for c in ast.walk(hfn)
+            if isinstance(c, ast.Call)}:
+        out.append(Finding(
+            "GL613", "error", "api/handlers.py",
+            hfn.lineno if hfn else 1,
+            "resilience_stats" if hfn else "<module>",
+            "resilience_stats no longer spreads chaos().counters() into "
+            "the payload — the soak harness's accounting invariant has "
+            "nothing to assert against", detail="handler-no-counters"))
+    return out
+
+
+@rule("GL614", "chaos-deterministic-rng")
+def check_chaos_rng(mi: ModuleInfo, ctx):
+    """Static half of the seed-determinism contract: all _Chaos
+    randomness flows through the seeded self._rng — a global-RNG draw
+    (random.* / np.random.<draw>) would break H2O_TPU_CHAOS_SEED
+    reproducibility.  (The runtime drill stays in the tier-1 runner.)"""
+    if mi.rel != "core/chaos.py":
+        return []
+    out = []
+    for node in ast.walk(mi.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = classify._attr_chain(node.func)
+        bad = None
+        if len(chain) >= 2 and chain[0] == "random":
+            bad = ".".join(chain)
+        elif (len(chain) >= 3 and chain[0] in ("np", "numpy") and
+                chain[1] == "random" and chain[-1] != "default_rng"):
+            bad = ".".join(chain)
+        if bad is not None:
+            out.append(Finding(
+                "GL614", "error", mi.rel, node.lineno, mi.scope_of(node),
+                f"global-RNG draw `{bad}()` in the chaos layer — "
+                f"injection decisions must come from the seeded "
+                f"self._rng so H2O_TPU_CHAOS_SEED reproduces soaks",
+                detail=f"global-rng:{bad}"))
+    return out
+
+
+# -- GL620/GL621: lever env knobs resolve in exactly one place ---------------
+
+LEVER_ENV_VARS = ("H2O_TPU_HIST_PALLAS", "H2O_TPU_MATMUL_ROUTE",
+                  "H2O_TPU_SIBLING_SUBTRACT", "H2O_TPU_AUTOTUNE")
+
+
+def _is_environ_read(node) -> bool:
+    if isinstance(node, ast.Subscript):
+        return classify._attr_chain(node.value) == ["os", "environ"]
+    if isinstance(node, ast.Call):
+        chain = classify._attr_chain(node.func)
+        return chain in (["os", "getenv"], ["os", "environ", "get"])
+    return False
+
+
+@rule("GL620", "lever-env-outside-autotune")
+def check_lever_env(mi: ModuleInfo, ctx):
+    """Lever/autotune env knob read outside core/autotune.py — the
+    decision must flow through autotune.resolve_flag() and reach traced
+    code as a STATIC arg."""
+    if mi.rel == "core/autotune.py":
+        return []
+    out = []
+    for node in ast.walk(mi.tree):
+        if not _is_environ_read(node):
+            continue
+        consts = [c.value for c in ast.walk(node)
+                  if isinstance(c, ast.Constant) and
+                  isinstance(c.value, str)]
+        hit = next((c for c in consts
+                    for v in LEVER_ENV_VARS if c.startswith(v)), None)
+        if hit is not None:
+            out.append(Finding(
+                "GL620", "error", mi.rel, node.lineno, mi.scope_of(node),
+                f"lever env knob {hit!r} read outside core/autotune.py "
+                f"— an env read near a trace bakes a stale value into "
+                f"the executable; use autotune.resolve_flag()",
+                detail=f"lever:{hit}"))
+    return out
+
+
+@rule("GL621", "autotune-env-single-point")
+def check_autotune_env(mi: ModuleInfo, ctx):
+    """Inside core/autotune.py every environ read lives in _env_value —
+    the single lint-enforceable read point its docstring promises."""
+    if mi.rel != "core/autotune.py":
+        return []
+    out = []
+    for node in ast.walk(mi.tree):
+        if not _is_environ_read(node):
+            continue
+        func = getattr(node, "_gl_func", None)
+        if func is not None and func.name == "_env_value":
+            continue
+        out.append(Finding(
+            "GL621", "error", mi.rel, node.lineno, mi.scope_of(node),
+            "environ read in core/autotune.py outside _env_value — keep "
+            "the single lint-enforceable read point",
+            detail=f"env-read:{mi.scope_of(node)}"))
+    return out

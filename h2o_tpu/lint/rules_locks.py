@@ -1,0 +1,139 @@
+"""GL401/GL402 — lock discipline around the DKV and the memory manager.
+
+The PR 5 deadlock class: ``MemoryManager._spill_lru`` once called
+``Vec._spill()`` while holding the manager lock; the spill path
+re-entered manager accounting from another thread and the two lock
+orders deadlocked.  The fix (core/memory.py) is structural — collect
+candidates under the lock, spill outside it — and this pass keeps it
+that way:
+
+- **GL401** inside a ``with <lock>:`` body in core/store.py /
+  core/memory.py / core/exec_store.py, no device/jax work
+  (``jax.*`` / ``jnp.*`` calls, ``device_put``/``device_get``/
+  ``block_until_ready``/``to_numpy``) and no re-entrant spill work
+  (``_spill`` / ``_spill_lru`` / ``sweep`` / ``reload``).  Device
+  dispatches can block for seconds (compiles) to minutes (OOM ladder)
+  — under the DKV or manager lock that stalls every other thread; and
+  spill work re-enters the very accounting the lock guards.
+- **GL402** lock-acquisition order: syntactically nested ``with``
+  acquisitions are collected package-wide; a pair of locks acquired in
+  BOTH orders anywhere is a deadlock waiting for two threads.  (Orders
+  threaded through calls are out of scope — the GL401 re-entrancy ban
+  covers the known case.)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from h2o_tpu.lint import classify
+from h2o_tpu.lint.core import Finding, ModuleInfo, PackageContext, rule
+
+_GUARDED_MODULES = ("core/store.py", "core/memory.py",
+                    "core/exec_store.py")
+
+_REENTRANT = {"_spill", "_spill_lru", "sweep", "reload"}
+_DEVICE = {"device_put", "device_get", "block_until_ready", "to_numpy"}
+
+
+def _lock_name(expr) -> Optional[str]:
+    """``self._lock`` / ``_manager_lock`` / ``cls._lock`` → dotted name
+    when the trailing identifier looks like a lock, else None."""
+    chain = classify._attr_chain(expr)
+    if not chain:
+        return None
+    tail = chain[-1].lower()
+    if "lock" in tail or "gate" in tail:
+        return ".".join(chain)
+    return None
+
+
+def _with_locks(node: ast.With) -> List[str]:
+    out = []
+    for item in node.items:
+        name = _lock_name(item.context_expr)
+        if name is not None:
+            out.append(name)
+    return out
+
+
+@rule("GL401", "device-call-under-lock")
+def check_under_lock(mi: ModuleInfo, ctx):
+    if mi.rel not in _GUARDED_MODULES:
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(mi.tree):
+        if not isinstance(node, ast.With) or not _with_locks(node):
+            continue
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if not isinstance(sub, ast.Call):
+                    continue
+                chain = classify._attr_chain(sub.func)
+                name = classify._call_name(sub)
+                bad = None
+                if chain and chain[0] in ("jax", "jnp"):
+                    bad = ".".join(chain)
+                elif name in _DEVICE or name in _REENTRANT:
+                    bad = name
+                if bad is None:
+                    continue
+                out.append(Finding(
+                    "GL401", "error", mi.rel, sub.lineno,
+                    mi.scope_of(sub),
+                    f"`{bad}(...)` while holding "
+                    f"{'/'.join(_with_locks(node))} — device work and "
+                    f"spill/reload re-entrancy must run OUTSIDE the "
+                    f"lock (collect under it, act after releasing; see "
+                    f"MemoryManager._spill_lru)",
+                    detail=f"under-lock:{bad}"))
+    return out
+
+
+def _acquisition_pairs(mi: ModuleInfo) -> List[Tuple[str, str, int]]:
+    """(outer, inner, line) for every syntactically nested lock pair."""
+    pairs = []
+
+    def visit(node, held: Tuple[str, ...]):
+        if isinstance(node, ast.With):
+            locks = _with_locks(node)
+            for outer in held:
+                for inner in locks:
+                    if inner != outer:
+                        pairs.append((outer, inner, node.lineno))
+            held = held + tuple(locks)
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    visit(mi.tree, ())
+    return pairs
+
+
+@rule("GL402", "lock-order", kind="package")
+def check_lock_order(ctx: PackageContext):
+    by_pair: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    for rel in sorted(ctx.modules):
+        mi = ctx.modules[rel]
+        for outer, inner, line in _acquisition_pairs(mi):
+            by_pair.setdefault((outer, inner), (rel, line))
+    out: List[Finding] = []
+    reported = set()
+    for (a, b), (rel, line) in sorted(by_pair.items()):
+        if (b, a) not in by_pair:
+            continue
+        key = tuple(sorted((a, b)))
+        if key in reported:
+            continue
+        reported.add(key)
+        other_rel, other_line = by_pair[(b, a)]
+        out.append(Finding(
+            "GL402", "error", rel, line, "<module>",
+            f"lock order inversion: {a} -> {b} here but {b} -> {a} at "
+            f"{other_rel}:{other_line} — two threads taking these in "
+            f"opposite orders deadlock; pick one canonical order",
+            detail=f"order:{key[0]}<>{key[1]}"))
+    return out

@@ -1,0 +1,68 @@
+"""GL501 — stable-name/persist safety for the exec store's disk layer.
+
+A persisted executable is reloaded by FRESH processes, so its disk key
+must identify the traced body across processes.  The runtime half
+(core/exec_store.py) already refuses: ``stable_fn_name`` returns None
+for closures and ``<locals>`` qualnames.  The static half enforces the
+complementary contract at every ``get_or_build``/``dispatch`` call
+site that asks for persistence (``persist=`` that is not literally
+None):
+
+- a ``content=`` fingerprint must be supplied (and not literal None) —
+  without it two different bodies under the same persist name collide
+  on one disk entry, the PR 6 stale-executable hazard;
+- the builder must not be ``lambda: <lambda>`` — persisting an
+  anonymous inline body whose captured state never reaches the key.
+
+(``cached_kernel`` computes its own content fingerprint and is exempt.)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from h2o_tpu.lint import classify
+from h2o_tpu.lint.core import Finding, ModuleInfo, rule
+
+_PERSIST_ENTRIES = {"get_or_build": 2, "dispatch": 2}
+
+
+def _is_none(node) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+@rule("GL501", "closure-persist")
+def check(mi: ModuleInfo, ctx):
+    if mi.rel == "core/exec_store.py":     # the store's own plumbing
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(mi.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = classify._call_name(node)
+        if name not in _PERSIST_ENTRIES:
+            continue
+        persist = classify._kw(node, "persist")
+        if persist is None or _is_none(persist):
+            continue
+        content = classify._kw(node, "content")
+        if content is None or _is_none(content):
+            out.append(Finding(
+                "GL501", "error", mi.rel, node.lineno, mi.scope_of(node),
+                f"{name}(persist=...) without a content= fingerprint — "
+                f"two bodies under one persist name collide on a disk "
+                f"entry and a changed implementation reloads the STALE "
+                f"executable; pass content=code_fingerprint(builder)",
+                detail=f"persist-no-content:{mi.scope_of(node)}"))
+        i = _PERSIST_ENTRIES[name]
+        b = node.args[i] if len(node.args) > i \
+            else classify._kw(node, "build")
+        if isinstance(b, ast.Lambda) and isinstance(b.body, ast.Lambda):
+            out.append(Finding(
+                "GL501", "error", mi.rel, b.lineno, mi.scope_of(node),
+                "persisting an inline lambda body — its captured state "
+                "never reaches the disk key (stable_fn_name is None for "
+                "closures); hoist the body to a module-level def",
+                detail=f"persist-lambda:{mi.scope_of(node)}"))
+    return out

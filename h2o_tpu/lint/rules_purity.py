@@ -1,0 +1,147 @@
+"""GL101–GL104 — trace purity: no host-side effects inside traced code.
+
+Anything reachable inside a body that flows into ``jax.jit`` /
+``ExecStore.dispatch`` / AOT persistence executes exactly ONCE, at trace
+time; its result is baked into the executable, which the exec store then
+serializes to disk and reloads in fresh processes.  So a host-side read
+inside a traced body is not merely nondeterministic — it is FROZEN:
+
+- **GL101** ``os.environ`` / ``os.getenv`` reads — toggling the knob
+  later hits a stale executable (the PR 6/10 bug class the lever-env
+  lint caught for four specific vars; this generalizes it to every env
+  read on every traced path);
+- **GL102** clock reads (``time.*``, ``datetime.now``) — the trace-time
+  timestamp is replayed forever;
+- **GL103** Python/NumPy host RNG (``random.*``, ``np.random.*``) — one
+  trace-time draw becomes a constant (``jax.random`` with threaded keys
+  is the traced-correct spelling and is not flagged);
+- **GL104** mutable-global capture — reading a name some function
+  rebinds via ``global`` bakes the value seen at trace time.
+
+Reachability is the :func:`~h2o_tpu.lint.classify.traced_nodes` closure:
+jit roots, lax control-flow bodies, shard_map bodies, exec-store builder
+returns, plus everything they call intra-module.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from h2o_tpu.lint import classify
+from h2o_tpu.lint.core import Finding, ModuleInfo, rule
+
+_TIME_ATTRS = {"time", "monotonic", "perf_counter", "process_time",
+               "time_ns", "monotonic_ns", "perf_counter_ns",
+               "thread_time", "clock_gettime"}
+_DT_ATTRS = {"now", "utcnow", "today"}
+
+
+def _is_environ_read(node) -> bool:
+    if isinstance(node, ast.Subscript):
+        return classify._attr_chain(node.value) == ["os", "environ"]
+    if isinstance(node, ast.Call):
+        chain = classify._attr_chain(node.func)
+        return chain in (["os", "getenv"], ["os", "environ", "get"])
+    return False
+
+
+def _env_key(node) -> str:
+    for c in ast.walk(node):
+        if isinstance(c, ast.Constant) and isinstance(c.value, str):
+            return c.value
+    return "environ"
+
+
+def _scan(mi: ModuleInfo, rule_id: str, hit, msg, detail) -> List[Finding]:
+    out: List[Finding] = []
+    seen = set()
+    for fn in classify.traced_nodes(mi):
+        for node in classify.walk_own(fn):
+            if not hit(node):
+                continue
+            d = detail(node)
+            key = (mi.scope_of(node), d)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(Finding(rule_id, "error", mi.rel, node.lineno,
+                               mi.scope_of(node), msg(node), detail=d))
+    return out
+
+
+@rule("GL101", "trace-env-read")
+def check_env(mi: ModuleInfo, ctx):
+    """os.environ read reachable inside a traced body."""
+    return _scan(
+        mi, "GL101", _is_environ_read,
+        lambda n: (f"os.environ read of {_env_key(n)!r} inside a traced "
+                   f"body — the value is baked into the (possibly "
+                   f"disk-persisted) executable at trace time; resolve "
+                   f"it outside the trace and pass it as a static arg"),
+        lambda n: f"env:{_env_key(n)}")
+
+
+@rule("GL102", "trace-time-read")
+def check_time(mi: ModuleInfo, ctx):
+    """Clock read reachable inside a traced body."""
+
+    def hit(node):
+        if not isinstance(node, ast.Call):
+            return False
+        chain = classify._attr_chain(node.func)
+        if len(chain) >= 2 and chain[0] == "time" and \
+                chain[-1] in _TIME_ATTRS:
+            return True
+        return len(chain) >= 2 and "datetime" in chain[:-1] and \
+            chain[-1] in _DT_ATTRS
+
+    return _scan(
+        mi, "GL102", hit,
+        lambda n: (f"clock read `{'.'.join(classify._attr_chain(n.func))}"
+                   f"()` inside a traced body — the trace-time timestamp "
+                   f"becomes a compiled-in constant; measure outside the "
+                   f"jit boundary"),
+        lambda n: f"clock:{'.'.join(classify._attr_chain(n.func))}")
+
+
+@rule("GL103", "trace-py-rng")
+def check_rng(mi: ModuleInfo, ctx):
+    """Host RNG draw reachable inside a traced body."""
+
+    def hit(node):
+        if not isinstance(node, ast.Call):
+            return False
+        chain = classify._attr_chain(node.func)
+        if len(chain) >= 2 and chain[0] == "random":
+            return True
+        return (len(chain) >= 3 and chain[0] in ("np", "numpy") and
+                chain[1] == "random")
+
+    return _scan(
+        mi, "GL103", hit,
+        lambda n: (f"host RNG `{'.'.join(classify._attr_chain(n.func))}"
+                   f"()` inside a traced body — one trace-time draw "
+                   f"becomes a constant in every replay; use jax.random "
+                   f"with an explicitly threaded key"),
+        lambda n: f"rng:{'.'.join(classify._attr_chain(n.func))}")
+
+
+@rule("GL104", "trace-mutable-global")
+def check_mutable_global(mi: ModuleInfo, ctx):
+    """Mutable-global read reachable inside a traced body."""
+    mutable = classify.globally_rebound_names(mi)
+    if not mutable:
+        return []
+
+    def hit(node):
+        return (isinstance(node, ast.Name) and
+                isinstance(node.ctx, ast.Load) and node.id in mutable)
+
+    return _scan(
+        mi, "GL104", hit,
+        lambda n: (f"read of mutable global `{n.id}` (rebound via "
+                   f"`global` elsewhere in this module) inside a traced "
+                   f"body — the trace captures one snapshot; pass it as "
+                   f"an argument instead"),
+        lambda n: f"global:{n.id}")

@@ -1,0 +1,191 @@
+"""GL301–GL303 — sharded-collective safety.
+
+The PR 8 miscompile class: under GSPMD, ``jnp.concatenate`` of a
+row-sharded operand with freshly-created filler forces an implicit
+all-gather/reshard whose layout solution has produced wrong numerics
+(the ``_pad_rows`` incident — see core/munge.py's docstring on why row
+padding is spelled ``jnp.pad``).  Plus two contract checks for the
+home-sharded data plane:
+
+- **GL301** in a shard-verb module (one that builds ``shard_map``
+  collectives), a GLOBAL-context function (NOT a shard body — inside a
+  shard body the arrays are per-shard locals and concatenation is
+  legal) must not ``jnp.concatenate`` a parameter-derived operand with
+  fresh filler (``jnp.zeros``/``full``/…) on axis 0 — the row axis is
+  the sharded axis; spell padding as ``jnp.pad``;
+- **GL302** collective axis names must be axes the mesh declares
+  (core/cloud.py ``*_AXIS`` constants) — a typo'd string axis fails
+  only at dispatch time on a multi-device mesh, which CI never has;
+- **GL303** no host gather in the sharded data plane: full-array
+  ``device_get`` / ``to_numpy`` / REPLICATED sharding inside a shard
+  body (any module) or inside core/munge.py's sharded verbs (the
+  ISSUE-8 contract list) silently undoes shard residency.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from h2o_tpu.lint import classify
+from h2o_tpu.lint.core import Finding, ModuleInfo, rule
+
+_FILLERS = {"zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+            "full_like", "empty_like"}
+
+# the ISSUE-8 sharded-verb contract (core/munge.py); the companion
+# existence rule GL608 keeps this list honest
+SHARD_MUNGE_VERBS = {
+    "_shard_sort_frame", "sort_frame", "filter_rows", "repack_frame",
+    "take_rows", "_shard_groupby", "_shard_merge", "_global_groupby",
+    "_global_merge", "_build_shard_sort", "_build_shard_filter",
+    "_build_shard_repack", "_build_shard_group_count",
+    "_build_shard_group_aggs", "_build_shard_merge_match",
+    "_build_shard_merge_emit", "_route"}
+
+_HOST_GATHER_ATTRS = {"device_get", "to_numpy", "replicated"}
+
+
+def _param_names(func) -> Set[str]:
+    if isinstance(func, ast.Lambda):
+        a = func.args
+    else:
+        a = func.args
+    names = {x.arg for x in a.args + a.posonlyargs + a.kwonlyargs}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    return names
+
+
+def _axis0(call: ast.Call) -> bool:
+    ax = classify._kw(call, "axis")
+    if ax is None and len(call.args) > 1:
+        ax = call.args[1]
+    if ax is None:
+        return True                       # default axis=0
+    return isinstance(ax, ast.Constant) and ax.value == 0
+
+
+def _is_filler(node) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    chain = classify._attr_chain(node.func)
+    return (len(chain) >= 2 and chain[0] in ("jnp", "np", "numpy", "jax")
+            and chain[-1] in _FILLERS)
+
+
+@rule("GL301", "sharded-concat")
+def check_concat(mi: ModuleInfo, ctx):
+    """axis-0 concatenate of param-derived data with fresh filler in the
+    global (GSPMD) context of a shard-verb module."""
+    if not classify.uses_shard_map(mi):
+        return []
+    bodies = set(classify.shard_bodies(mi))
+    out: List[Finding] = []
+    for func in mi.functions():
+        if func in bodies:
+            continue
+        params = _param_names(func)
+        for node in classify.walk_own(func):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = classify._attr_chain(node.func)
+            if not (len(chain) >= 2 and chain[0] in ("jnp", "jax") and
+                    chain[-1] in ("concatenate", "concat")):
+                continue
+            if not (node.args and isinstance(node.args[0],
+                                             (ast.Tuple, ast.List))):
+                continue
+            if not _axis0(node):
+                continue
+            elts = node.args[0].elts
+            has_filler = any(_is_filler(e) for e in elts)
+            has_param = any(
+                isinstance(n, ast.Name) and n.id in params
+                for e in elts if not _is_filler(e)
+                for n in ast.walk(e))
+            if has_filler and has_param:
+                out.append(Finding(
+                    "GL301", "error", mi.rel, node.lineno,
+                    mi.scope_of(node),
+                    "axis-0 jnp.concatenate of sharded data with fresh "
+                    "filler in GSPMD context — the _pad_rows miscompile "
+                    "class (wrong numerics via implicit reshard); spell "
+                    "row padding as jnp.pad",
+                    detail=f"concat:{mi.scope_of(node)}"))
+    return out
+
+
+def _declared_axes(ctx) -> Set[str]:
+    axes: Set[str] = set()
+    cloud = ctx.get("core/cloud.py") if ctx is not None else None
+    if cloud is not None:
+        for stmt in cloud.tree.body:
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Constant) and \
+                    isinstance(stmt.value.value, str):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name) and t.id.endswith("_AXIS"):
+                        axes.add(stmt.value.value)
+    return axes or {"nodes", "model"}
+
+
+@rule("GL302", "collective-axis")
+def check_axes(mi: ModuleInfo, ctx):
+    """Literal collective axis name not declared by the mesh."""
+    declared = _declared_axes(ctx)
+    out: List[Finding] = []
+    for node, name, axis in classify.collective_calls(mi):
+        bad: List[str] = []
+        if isinstance(axis, ast.Constant) and isinstance(axis.value, str):
+            if axis.value not in declared:
+                bad.append(axis.value)
+        elif isinstance(axis, (ast.Tuple, ast.List)):
+            bad = [e.value for e in axis.elts
+                   if isinstance(e, ast.Constant) and
+                   isinstance(e.value, str) and e.value not in declared]
+        for b in bad:
+            out.append(Finding(
+                "GL302", "error", mi.rel, node.lineno, mi.scope_of(node),
+                f"lax.{name} over axis {b!r}, which no mesh declares "
+                f"(known axes: {sorted(declared)}) — this fails only at "
+                f"dispatch time on a real multi-device mesh; use the "
+                f"core/cloud.py *_AXIS constants",
+                detail=f"axis:{name}:{b}"))
+    return out
+
+
+@rule("GL303", "shard-host-gather")
+def check_host_gather(mi: ModuleInfo, ctx):
+    """device_get/to_numpy/replicated inside the sharded data plane."""
+    out: List[Finding] = []
+    seen = set()
+
+    def flag(node, where):
+        key = (mi.scope_of(node), node.attr)
+        if key in seen:
+            return
+        seen.add(key)
+        out.append(Finding(
+            "GL303", "error", mi.rel, node.lineno, mi.scope_of(node),
+            f".{node.attr} inside {where} — rows must stay home-sharded "
+            f"(only per-shard counts / group tables may leave the "
+            f"device); host logic belongs in the *_host fallbacks",
+            detail=f"gather:{node.attr}"))
+
+    for body in classify.shard_bodies(mi):
+        for node in ast.walk(body):
+            if isinstance(node, ast.Attribute) and \
+                    node.attr in _HOST_GATHER_ATTRS:
+                flag(node, "a shard_map body")
+    if mi.rel == "core/munge.py":
+        for func in mi.functions():
+            if func.name not in SHARD_MUNGE_VERBS:
+                continue
+            for node in ast.walk(func):
+                if isinstance(node, ast.Attribute) and \
+                        node.attr in _HOST_GATHER_ATTRS:
+                    flag(node, f"sharded munge verb {func.name}()")
+    return out

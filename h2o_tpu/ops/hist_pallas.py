@@ -75,11 +75,19 @@ def min_tile_fits(C: int, B1: int, L: int = 1, S: int = 4) -> bool:
     return plan_tile_rows(C, B1, L, S, jnp.float32) is not None
 
 
+class VMEMGateError(ValueError):
+    """The fused kernel's combined working set exceeds VMEM even at the
+    minimum tile.  The message carries the ``VMEM`` marker, so
+    core/oom.is_kernel_compile_failure classifies it as a recoverable
+    kernel rejection and ``kernel_fallback`` degrades the dispatch to
+    the portable XLA path instead of failing the training job."""
+
+
 def _tile_rows(C: int, B1: int, L: int, S: int, mm_dtype) -> int:
     """Working-set-bounded tile height; asserts eligibility was gated."""
     t = plan_tile_rows(C, B1, L, S, mm_dtype)
     if t is None:
-        raise ValueError(
+        raise VMEMGateError(
             f"hist_pallas working set exceeds VMEM at the minimum tile "
             f"(C={C}, B1={B1}, L={L}, S={S}) — _pallas_eligible should "
             f"have rejected this shape")

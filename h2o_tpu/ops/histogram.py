@@ -250,10 +250,21 @@ def histogram_build(bins, leaf, stats, n_leaves: int, nbins: int,
                     block_rows: int = 8192, bf16: bool = False):
     """Public standalone entry: resolves the Pallas opt-IN env OUTSIDE
     the trace (it is a static jit arg, so toggling H2O_TPU_HIST_PALLAS
-    between calls takes effect instead of hitting a stale executable)."""
-    return _histogram_build_jit(bins, leaf, stats, n_leaves=n_leaves,
-                                nbins=nbins, block_rows=block_rows,
-                                bf16=bf16, pallas=pallas_env_enabled())
+    between calls takes effect instead of hitting a stale executable).
+    Dispatched through ``kernel_fallback``: a Mosaic/Pallas compile
+    failure or VMEM-gate rejection degrades to the portable XLA
+    executable (pallas=False is a distinct static-arg program) instead
+    of failing the caller — closing the core/oom.py follow-up where this
+    standalone entry had no fallback route."""
+    from h2o_tpu.core.oom import kernel_fallback
+
+    def run(use_pallas: bool):
+        return _histogram_build_jit(bins, leaf, stats, n_leaves=n_leaves,
+                                    nbins=nbins, block_rows=block_rows,
+                                    bf16=bf16, pallas=use_pallas)
+
+    return kernel_fallback("hist.standalone", run,
+                           pallas=pallas_env_enabled())
 
 
 def bin_features(matrix, split_points):

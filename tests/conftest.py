@@ -97,6 +97,13 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
                 os_["oom_events"], os_["sweeps"], os_["degradations"],
                 os_["terminal_failures"], ms["spills"], ms["reloads"],
                 chaos().injected))
+        from h2o_tpu.lint import last_summary
+        ls = last_summary()
+        if ls is not None:
+            terminalreporter.write_line(
+                "[graftlint] rules={} modules={} findings={} "
+                "suppressed={}".format(ls["rules_run"], ls["modules"],
+                                       ls["findings"], ls["suppressed"]))
     except Exception:  # noqa: BLE001 — reporting must never fail a run
         pass
 

@@ -1,0 +1,559 @@
+"""Fixture suite for the graftlint framework (h2o_tpu/lint/).
+
+Table-driven: every rule carries a POSITIVE fixture (must fire), a
+NEGATIVE fixture (must stay clean), and a derived SUPPRESSED fixture —
+the positive with an inline ``# graftlint: disable=RULE  reason``
+appended to the flagged line must lint clean and be counted as
+suppressed.  On top of the table:
+
+- the two acceptance fixtures: the PR 6 use-after-donate pattern and
+  the PR 8 ``_pad_rows`` sharded-concatenate pattern both FAIL lint;
+- baseline round-trip: save -> load -> split (new/baselined/stale);
+- registry completeness: every retired ad-hoc scan's rule ID is
+  registered (the old-test -> rule map in rules_legacy's docstring).
+
+Fixtures lint SYNTHETIC PackageContexts built from snippet strings —
+never the installed package (that is the tier-1 runner's job in
+test_lint_resilience.py) — so each case isolates exactly one rule.
+"""
+
+import textwrap
+
+import pytest
+
+from h2o_tpu.lint import baseline
+from h2o_tpu.lint.core import (Finding, ModuleInfo, PackageContext,
+                               all_rules, run_lint)
+
+from h2o_tpu.lint.rules_legacy import MUNGE_HOST_ALLOWED
+from h2o_tpu.lint.rules_shard import SHARD_MUNGE_VERBS
+
+SHARD_VERB_DEFS = "\n".join(
+    f"def {n}():\n    pass\n" for n in sorted(SHARD_MUNGE_VERBS))
+
+HOST_FALLBACK_DEFS = "\n".join(
+    f"def {n}():\n    pass\n" for n in sorted(MUNGE_HOST_ALLOWED))
+
+JIT_ENGINE_GATES = """
+    def matmul_route_enabled():
+        return resolve_flag("mm.route")
+
+    def sibling_subtract_enabled():
+        return resolve_flag("tree.sibling")
+"""
+
+HANDLERS_OK = """
+    def resilience_stats(params):
+        from h2o_tpu.core.chaos import chaos
+        return {"chaos": dict(chaos().counters())}
+"""
+
+
+def _ctx(modules):
+    return PackageContext({
+        rel: ModuleInfo(rel, textwrap.dedent(src))
+        for rel, src in modules.items()})
+
+
+def _lint(rule_id, modules):
+    return run_lint(_ctx(modules), rules=[rule_id], note_summary=False)
+
+
+# (rule, primary rel, positive src, negative src, extra modules)
+CASES = [
+    ("GL101", "core/fx.py", """
+        import os, jax
+
+        @jax.jit
+        def f(x):
+            mode = os.environ.get("H2O_TPU_MODE", "0")
+            return x
+     """, """
+        import os, jax
+
+        def resolve():
+            return os.environ.get("H2O_TPU_MODE", "0")
+
+        @jax.jit
+        def f(x, mode):
+            return x
+     """, {}),
+    ("GL102", "core/fx.py", """
+        import time, jax
+
+        @jax.jit
+        def f(x):
+            t0 = time.perf_counter()
+            return x
+     """, """
+        import time, jax
+
+        def outside(x):
+            return time.perf_counter()
+
+        @jax.jit
+        def f(x):
+            return x
+     """, {}),
+    ("GL103", "core/fx.py", """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            noise = np.random.normal()
+            return x + noise
+     """, """
+        import jax
+
+        @jax.jit
+        def f(x, key):
+            return x + jax.random.normal(key, x.shape)
+     """, {}),
+    ("GL104", "core/fx.py", """
+        import jax
+
+        _MODE = 0
+
+        def set_mode(m):
+            global _MODE
+            _MODE = m
+
+        @jax.jit
+        def f(x):
+            return x + _MODE
+     """, """
+        import jax
+
+        _MODE = 0
+
+        @jax.jit
+        def f(x, mode):
+            return x + mode
+     """, {}),
+    ("GL201", "models/fx.py", """
+        def train(store, x, build):
+            out = store.dispatch("train", ("k",), build, (x,), donate_argnums=(0,))
+            loss = float(x.mean())
+            return out, loss
+     """, """
+        def train(store, x, build):
+            x = store.dispatch("train", ("k",), build, (x,), donate_argnums=(0,))
+            loss = float(x.mean())
+            return x, loss
+     """, {}),
+    ("GL301", "core/fx.py", """
+        import jax.numpy as jnp
+        from h2o_tpu.core.cloud import shard_map_compat
+
+        def _pad_rows(rows, n):
+            return jnp.concatenate([rows, jnp.zeros((n, 4))], axis=0)
+     """, """
+        import jax.numpy as jnp
+        from h2o_tpu.core.cloud import shard_map_compat
+
+        def _pad_rows(rows, n):
+            return jnp.pad(rows, ((0, n), (0, 0)))
+     """, {}),
+    ("GL302", "core/fx.py", """
+        from jax import lax
+
+        def total(x):
+            return lax.psum(x, "nodez")
+     """, """
+        from jax import lax
+
+        def total(x):
+            return lax.psum(x, "nodes")
+     """, {}),
+    ("GL303", "core/fx.py", """
+        from h2o_tpu.core.cloud import shard_map_compat
+
+        def _kern(v):
+            host = v.to_numpy()
+            return host
+
+        run = shard_map_compat(_kern, mesh=None)
+     """, """
+        from h2o_tpu.core.cloud import shard_map_compat
+
+        def _kern(v):
+            return v + 1
+
+        run = shard_map_compat(_kern, mesh=None)
+
+        def summarize(v):
+            return v.to_numpy()
+     """, {}),
+    ("GL401", "core/store.py", """
+        import threading
+        import jax.numpy as jnp
+
+        _lock = threading.Lock()
+
+        def put(v):
+            with _lock:
+                arr = jnp.asarray(v)
+            return arr
+     """, """
+        import threading
+        import jax.numpy as jnp
+
+        _lock = threading.Lock()
+
+        def put(v):
+            arr = jnp.asarray(v)
+            with _lock:
+                table = {"v": arr}
+            return table
+     """, {}),
+    ("GL402", "core/fx.py", """
+        import threading
+
+        a_lock = threading.Lock()
+        b_lock = threading.Lock()
+
+        def f():
+            with a_lock:
+                with b_lock:
+                    pass
+
+        def g():
+            with b_lock:
+                with a_lock:
+                    pass
+     """, """
+        import threading
+
+        a_lock = threading.Lock()
+        b_lock = threading.Lock()
+
+        def f():
+            with a_lock:
+                with b_lock:
+                    pass
+
+        def g():
+            with a_lock:
+                with b_lock:
+                    pass
+     """, {}),
+    ("GL501", "models/fx.py", """
+        def build():
+            return None
+
+        def go(store, x):
+            fn = store.get_or_build("p", ("k",), build, persist="glm.irls")
+            return fn(x)
+     """, """
+        def build():
+            return None
+
+        def go(store, x, fp):
+            fn = store.get_or_build("p", ("k",), build, persist="glm.irls",
+                                    content=fp)
+            return fn(x)
+     """, {}),
+    ("GL601", "core/fx.py", """
+        from urllib.request import urlopen
+
+        def fetch(url):
+            return urlopen(url).read()
+     """, """
+        from h2o_tpu.core.persist import read_bytes
+
+        def fetch(url):
+            return read_bytes(url)
+     """, {}),
+    ("GL602", "api/handlers_fx.py", """
+        import jax
+
+        def predict_handler(params):
+            fn = jax.jit(lambda x: x)
+            return fn(params)
+     """, """
+        def predict_handler(params):
+            from h2o_tpu.serve.engine import engine
+            return engine().predict(params)
+     """, {}),
+    ("GL603", "core/fx.py", """
+        import jax
+
+        def f(x):
+            g = jax.jit(lambda y: y + 1)
+            return g(x)
+     """, """
+        import jax
+
+        def _body(y):
+            return y + 1
+
+        _g = jax.jit(_body)
+
+        def f(x):
+            return _g(x)
+     """, {}),
+    ("GL604", "rapids/interp.py", """
+        def _sort(fr):
+            vals = fr.vec("x").to_numpy()
+            return vals
+     """, """
+        def _sort(fr):
+            return fr.device_sorted("x")
+
+        def _sort_keys_helper(fr):
+            return fr.vec("x")
+     """, {}),
+    ("GL605", "stream/ingest.py", """
+        def land_chunk(fr, chunk):
+            host = fr.vec("x").to_numpy()
+            return host
+     """, """
+        def land_chunk(fr, chunk):
+            return fr.append_device(chunk)
+     """, {}),
+    ("GL607", "core/frame.py", """
+        def unrelated():
+            pass
+     """, """
+        def append(): pass
+        def append_rows(): pass
+        def _build_grow(): pass
+        def _build_append_write(): pass
+     """, {}),
+    ("GL608", "core/munge.py", """
+        def unrelated():
+            pass
+     """, SHARD_VERB_DEFS, {}),
+    ("GL609", "rapids/interp.py", """
+        def unrelated():
+            pass
+     """, HOST_FALLBACK_DEFS, {}),
+    ("GL610", "ops/histogram.py", """
+        import os
+
+        def pallas_env_enabled(bucket=None):
+            return os.environ.get("X") == "1"
+     """, """
+        def pallas_env_enabled(bucket=None):
+            from h2o_tpu.core.autotune import resolve_flag
+            return resolve_flag("hist.kernel", bucket)
+     """, {"models/tree/jit_engine.py": JIT_ENGINE_GATES}),
+    ("GL611", "core/autotune.py", """
+        def probe(fn):
+            return fn()
+     """, """
+        from h2o_tpu.core.oom import oom_ladder
+
+        def probe(fn):
+            return oom_ladder("autotune", fn)
+     """, {}),
+    ("GL612", "core/chaos.py", """
+        class _Chaos:
+            def maybe_reject(self, site):
+                raise RuntimeError(site)
+     """, """
+        class _Chaos:
+            def maybe_reject(self, site):
+                self.injected_rejects += 1
+                raise RuntimeError(site)
+     """, {}),
+    ("GL613", "core/chaos.py", """
+        class _Chaos:
+            def maybe_reject(self, site):
+                self.injected_rejects += 1
+
+            def counters(self):
+                return {"injected": 0}
+     """, """
+        class _Chaos:
+            def maybe_reject(self, site):
+                self.injected_rejects += 1
+
+            def counters(self):
+                return {"injected": 0,
+                        "injected_rejects": self.injected_rejects}
+     """, {"api/handlers.py": HANDLERS_OK}),
+    ("GL614", "core/chaos.py", """
+        import random
+
+        class _Chaos:
+            def maybe_reject(self, site):
+                self.injected_rejects += 1
+                return random.random() < 0.5
+     """, """
+        import numpy as np
+
+        class _Chaos:
+            def __init__(self):
+                self._rng = np.random.default_rng(0)
+
+            def maybe_reject(self, site):
+                self.injected_rejects += 1
+                return self._rng.random() < 0.5
+     """, {}),
+    ("GL620", "models/fx.py", """
+        import os
+
+        def gate():
+            return os.environ.get("H2O_TPU_HIST_PALLAS") == "1"
+     """, """
+        def gate():
+            from h2o_tpu.core.autotune import resolve_flag
+            return resolve_flag("hist.kernel")
+     """, {}),
+    ("GL621", "core/autotune.py", """
+        import os
+
+        def resolve_flag(lever, bucket=None):
+            return os.environ.get("H2O_TPU_AUTOTUNE") == "1"
+     """, """
+        import os
+
+        def _env_value(var):
+            return os.environ.get(var)
+
+        def resolve_flag(lever, bucket=None):
+            return _env_value("H2O_TPU_AUTOTUNE") == "1"
+     """, {}),
+]
+
+IDS = [c[0] for c in CASES]
+
+
+@pytest.mark.parametrize("rule_id,rel,pos,neg,extra", CASES, ids=IDS)
+def test_positive_fires(rule_id, rel, pos, neg, extra):
+    res = _lint(rule_id, {rel: pos, **extra})
+    assert res.findings, f"{rule_id}: positive fixture produced no finding"
+    assert all(f.rule == rule_id for f in res.findings)
+    assert all(f.severity in ("error", "warning") for f in res.findings)
+
+
+@pytest.mark.parametrize("rule_id,rel,pos,neg,extra", CASES, ids=IDS)
+def test_negative_clean(rule_id, rel, pos, neg, extra):
+    res = _lint(rule_id, {rel: neg, **extra})
+    assert not res.findings, (
+        f"{rule_id}: negative fixture flagged: "
+        + "; ".join(f.render() for f in res.findings))
+
+
+@pytest.mark.parametrize("rule_id,rel,pos,neg,extra", CASES, ids=IDS)
+def test_inline_suppression_honored(rule_id, rel, pos, neg, extra):
+    first = _lint(rule_id, {rel: pos, **extra}).findings[0]
+    lines = textwrap.dedent(pos).splitlines()
+    idx = first.line - 1
+    lines[idx] += f"  # graftlint: disable={rule_id}  fixture exception"
+    suppressed_src = "\n".join(lines)
+    res = _lint(rule_id, {first.path: suppressed_src,
+                          **{r: s for r, s in ({rel: pos, **extra}).items()
+                             if r != first.path}})
+    assert not any(f.line == first.line and f.path == first.path
+                   for f in res.findings), \
+        f"{rule_id}: inline suppression not honored"
+    assert res.suppressed >= 1
+
+
+# -- acceptance fixtures -----------------------------------------------------
+
+def test_pr6_use_after_donate_fixture_fails_lint():
+    """The PR 6 bug shape — donate an input buffer through a dispatch,
+    then read the same name on the host afterwards — must fail lint."""
+    src = """
+        def train_epoch(store, batch, build):
+            out = store.dispatch("gbm.level", ("k", 8), build, (batch,),
+                                 donate_argnums=(0,))
+            rows = int(batch.shape[0])
+            return out, rows
+    """
+    res = _lint("GL201", {"models/fx.py": src})
+    assert any(f.detail == "use-after-donate:batch" for f in res.findings)
+
+
+def test_pr8_pad_rows_concat_fixture_fails_lint():
+    """The PR 8 miscompile shape — `_pad_rows` concatenating a
+    row-sharded operand with fresh filler in GSPMD context — must fail
+    lint (the fix spelled it jnp.pad)."""
+    src = """
+        import jax.numpy as jnp
+        from h2o_tpu.core.cloud import shard_map_compat
+
+        def _pad_rows(x, target):
+            return jnp.concatenate(
+                [x, jnp.zeros((target,) + x.shape[1:], x.dtype)], axis=0)
+    """
+    res = _lint("GL301", {"core/fx.py": src})
+    assert res.findings and res.findings[0].rule == "GL301"
+    assert "jnp.pad" in res.findings[0].message
+
+
+# -- framework plumbing ------------------------------------------------------
+
+LEGACY_RULE_IDS = {
+    "GL601", "GL602", "GL603", "GL604", "GL605", "GL303", "GL607",
+    "GL608", "GL609", "GL610", "GL611", "GL612", "GL613", "GL614",
+    "GL620", "GL621"}
+
+
+def test_every_legacy_check_has_a_registered_rule():
+    ids = set(all_rules())
+    missing = LEGACY_RULE_IDS - ids
+    assert not missing, f"legacy ad-hoc checks without rules: {missing}"
+    # and the new dataflow passes are all present too
+    assert {"GL101", "GL102", "GL103", "GL104", "GL201", "GL301",
+            "GL302", "GL401", "GL402", "GL501"} <= ids
+
+
+def test_fixture_table_covers_every_rule():
+    """Every registered rule has a fixture row — adding a pass without
+    positive/negative/suppressed coverage fails here."""
+    covered = {c[0] for c in CASES}
+    missing = set(all_rules()) - covered
+    assert not missing, f"rules without fixtures: {sorted(missing)}"
+
+
+def test_baseline_round_trip(tmp_path):
+    path = str(tmp_path / "graftlint_baseline.json")
+    res = _lint("GL601", {"core/fx.py": """
+        from urllib.request import urlopen
+
+        def fetch(url):
+            return urlopen(url).read()
+    """})
+    assert res.findings
+    reasons = {res.findings[0].fingerprint: "pre-existing debt"}
+    baseline.save(res.findings, path, reasons)
+    loaded = baseline.load(path)
+    assert set(loaded) == {f.fingerprint for f in res.findings}
+    assert loaded[res.findings[0].fingerprint]["reason"] == \
+        "pre-existing debt"
+    new, old, stale = baseline.split(res.findings, path)
+    assert not new and len(old) == len(res.findings) and not stale
+    # a fixed finding turns its entry stale
+    new2, old2, stale2 = baseline.split([], path)
+    assert not new2 and not old2 and stale2 == sorted(loaded)
+
+
+def test_fingerprint_is_line_independent():
+    a = Finding("GL601", "error", "core/fx.py", 4, "fetch", "m",
+                detail="urlopen")
+    b = Finding("GL601", "error", "core/fx.py", 40, "fetch", "m",
+                detail="urlopen")
+    assert a.fingerprint == b.fingerprint
+    assert a.fingerprint == "GL601|core/fx.py|fetch|urlopen"
+
+
+def test_suppression_comment_above_code_line():
+    """An own-line disable comment covers the next code line, skipping
+    the rest of a contiguous comment block (the multi-line-justification
+    case)."""
+    src = textwrap.dedent("""
+        from urllib.request import urlopen
+
+        def fetch(url):
+            # graftlint: disable=GL601  fixture: this layer IS the
+            # retry layer in this synthetic module
+            return urlopen(url).read()
+    """)
+    res = _lint("GL601", {"core/fx.py": src})
+    assert not res.findings
+    assert res.suppressed == 1

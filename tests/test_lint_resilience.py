@@ -1,328 +1,60 @@
-"""Grep-based lint: raw network I/O must go through the retry layer.
+"""Tier-1 graftlint runner + the runtime chaos contracts.
 
-Every HTTP(S)/byte-store touch belongs behind core/persist.py's
-read_bytes/write_bytes (retried, chaos-injectable, observable) — a bare
-``urllib.request.urlopen`` anywhere else silently reopens the
-one-shot-I/O hole this layer closed.  Allowed: persist.py (the scheme
-backends themselves) and resilience.py (the wrapper's own plumbing,
-should it ever need one).
+The sixteen ad-hoc source scans that used to live in this file are now
+registered rules of the ``h2o_tpu.lint`` framework — see
+h2o_tpu/lint/rules_legacy.py for the old-test -> rule-ID map (GL601..
+GL621) and h2o_tpu/lint/__init__.py for the framework tour.  This file
+keeps exactly three things:
+
+- **the framework run** (:func:`test_graftlint_clean`): all rules over
+  the whole package must produce zero findings beyond the checked-in
+  baseline (tools/graftlint_baseline.json), and the baseline must carry
+  no stale entries.  This single test IS the old scans plus the five
+  dataflow passes (trace purity, donation safety, sharded-collective
+  safety, lock discipline, persist safety);
+- the two RUNTIME halves static analysis cannot prove: that every chaos
+  injector counter actually reaches the ``GET /3/Resilience`` payload,
+  and that the full injection drill is seed-deterministic (the soak
+  harness's reproducibility contract).
 """
 
-import ast
-import os
-import re
-
-import h2o_tpu
-
-ALLOWED = {os.path.join("core", "persist.py"),
-           os.path.join("core", "resilience.py")}
-PATTERN = re.compile(r"\burlopen\s*\(")
+from h2o_tpu.lint import baseline, run_lint
 
 
-def test_no_bare_urlopen_outside_persist():
-    pkg_root = os.path.dirname(h2o_tpu.__file__)
-    offenders = []
-    for dirpath, _dirs, files in os.walk(pkg_root):
-        for name in files:
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            rel = os.path.relpath(path, pkg_root)
-            if rel in ALLOWED:
-                continue
-            with open(path, encoding="utf-8", errors="replace") as f:
-                for i, line in enumerate(f, 1):
-                    if PATTERN.search(line):
-                        offenders.append(f"{rel}:{i}: {line.strip()}")
-    assert not offenders, (
-        "bare urlopen() outside the persist/retry layer — route these "
-        "through h2o_tpu.core.persist.read_bytes/write_bytes (or add a "
-        "scheme backend in persist.py) so transient faults retry:\n"
-        + "\n".join(offenders))
+def test_graftlint_clean():
+    """Zero unbaselined findings over the installed package, and no
+    stale baseline entries.  On failure: fix the finding, suppress it
+    inline with ``# graftlint: disable=RULE  reason``, or (for a
+    pre-existing debt item) ``python -m h2o_tpu.lint --write-baseline``
+    and justify the entry in the PR."""
+    result = run_lint()
+    new, _baselined, stale = baseline.split(result.findings)
+    assert not new, "\n".join(
+        [f.render() for f in new] +
+        ["^ new graftlint findings — fix, suppress inline with a "
+         "reason, or baseline via `python -m h2o_tpu.lint "
+         "--write-baseline`"])
+    assert not stale, (
+        "stale baseline entries (finding no longer fires — prune them "
+        "with `python -m h2o_tpu.lint --write-baseline`): "
+        + ", ".join(sorted(stale)))
 
 
-# Per-request compiles must live behind serve/engine.py's bounded,
-# bucket-keyed cache — a jax.jit in a REST handler compiles an XLA
-# program per request shape and silently reopens the recompile storm the
-# serving engine closed.
-JIT_PATTERN = re.compile(r"\bjax\s*\.\s*jit\s*\(")
-JIT_IMPORT = re.compile(r"^\s*from\s+jax\s+import\s+.*\bjit\b")
+# -- runtime halves ----------------------------------------------------------
 
-
-def test_no_jax_jit_in_api_handlers():
-    pkg_root = os.path.dirname(h2o_tpu.__file__)
-    api_dir = os.path.join(pkg_root, "api")
-    offenders = []
-    for name in sorted(os.listdir(api_dir)):
-        if not (name.startswith("handlers") and name.endswith(".py")):
-            continue
-        path = os.path.join(api_dir, name)
-        with open(path, encoding="utf-8", errors="replace") as f:
-            for i, line in enumerate(f, 1):
-                if JIT_PATTERN.search(line) or JIT_IMPORT.search(line):
-                    offenders.append(f"api/{name}:{i}: {line.strip()}")
-    assert not offenders, (
-        "jax.jit inside api/handlers*.py — per-request compiles belong "
-        "behind h2o_tpu/serve/engine.py's bounded compiled-predict "
-        "cache (power-of-two batch buckets), not in REST handlers:\n"
-        + "\n".join(offenders))
-
-
-# jax.jit applied inside a function body wraps a freshly-created closure
-# per call, so EVERY call re-traces and re-compiles — the anti-pattern
-# the unified executable store (core/exec_store.py) exists to kill.
-# Jitting belongs at module level (one executable per shape,
-# process-wide) or inside the store (counted, bounded, donation-policed,
-# persisted).  The old mrtask/serve/munge allowlist is FOLDED INTO the
-# store: those layers now pass raw functions to get_or_build/dispatch
-# and must not own jit wrappers themselves.
-JIT_CLOSURE_ALLOWED = {os.path.join("core", "exec_store.py"),
-                       # jits live under functools.lru_cache(maxsize=32)
-                       # keyed on (loss, regularizer) config — bounded
-                       # once-per-config, not per-call
-                       os.path.join("models", "glrm.py")}
-
-
-def _is_jax_jit(node) -> bool:
-    return (isinstance(node, ast.Attribute) and node.attr == "jit" and
-            isinstance(node.value, ast.Name) and node.value.id == "jax")
-
-
-def _jit_in_function_bodies(tree):
-    """Line numbers of ``jax.jit`` references inside function BODIES.
-    A module-level ``@jax.jit`` decorator (or module-level assignment)
-    evaluates once at import and is the CORRECT pattern — decorators are
-    visited at their enclosing scope, not the function's body scope."""
-    hits = []
-
-    def visit(node, in_body):
-        if _is_jax_jit(node) and in_body:
-            hits.append(node.lineno)
-            return
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for dec in node.decorator_list:
-                visit(dec, in_body)
-            for child in node.body:
-                visit(child, True)
-            return
-        for child in ast.iter_child_nodes(node):
-            visit(child, in_body)
-
-    visit(tree, False)
-    return hits
-
-
-# The device-munge conversion (core/munge.py) eliminated per-row
-# device->host pulls from the Rapids hot verbs.  A `to_numpy()` creeping
-# back into a converted verb (or into the munge kernel layer itself)
-# silently reopens the HBM->host->HBM round-trip this layer closed.
-# Host fallbacks live in explicitly-suffixed `*_host` functions (the
-# allowlist below) — new host-only ops go there, not in the dispatchers.
-DEVICE_MUNGE_VERBS = {"_sort", "_merge", "_groupby", "_row_select"}
-MUNGE_HOST_ALLOWED = {"_merge_host", "_groupby_host", "_row_select_host",
-                      "_row_select_mask_host", "_sort_keys", "_key_codes"}
-
-
-def _to_numpy_hits(tree, only_functions=None):
-    """Line numbers of ``.to_numpy(`` calls, optionally restricted to
-    the bodies of the named top-level functions."""
-    hits = []
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if only_functions is not None and node.name not in only_functions:
-            continue
-        for sub in ast.walk(node):
-            if isinstance(sub, ast.Attribute) and sub.attr == "to_numpy":
-                hits.append((node.name, sub.lineno))
-    return hits
-
-
-def test_no_to_numpy_in_device_munge_verbs():
-    pkg_root = os.path.dirname(h2o_tpu.__file__)
-    offenders = []
-    interp = os.path.join(pkg_root, "rapids", "interp.py")
-    with open(interp, encoding="utf-8") as f:
-        tree = ast.parse(f.read())
-    for fn, ln in _to_numpy_hits(tree, DEVICE_MUNGE_VERBS):
-        offenders.append(f"rapids/interp.py:{ln} in {fn}()")
-    munge = os.path.join(pkg_root, "core", "munge.py")
-    with open(munge, encoding="utf-8") as f:
-        mtree = ast.parse(f.read())
-    for fn, ln in _to_numpy_hits(mtree):
-        offenders.append(f"core/munge.py:{ln} in {fn}()")
-    assert not offenders, (
-        "to_numpy() inside a device-converted munge verb — these verbs "
-        "must stay zero-host-pull.  Put host-only logic in the *_host "
-        "fallbacks (rapids/interp.py) instead:\n" + "\n".join(offenders))
-
-
-# The streaming chunk-landing path (h2o_tpu/stream/ingest.py and the
-# Frame/Vec append verbs) must never pull the ACCUMULATED device payload
-# to host: a `to_numpy()` creeping in reopens the HBM->host->HBM
-# round-trip per chunk — the same rule as the munge verbs.  Host logic
-# over the (small, freshly-tokenized) incoming chunk lives in the
-# tokenizer / the explicitly-named `_chunk_cols_from_frame` converter.
-STREAM_APPEND_VERBS = {"append", "append_rows", "_build_grow",
-                       "_build_append_write"}
-
-
-def test_no_to_numpy_in_stream_chunk_landing():
-    pkg_root = os.path.dirname(h2o_tpu.__file__)
-    offenders = []
-    ingest = os.path.join(pkg_root, "stream", "ingest.py")
-    with open(ingest, encoding="utf-8") as f:
-        tree = ast.parse(f.read())
-    for fn, ln in _to_numpy_hits(tree):
-        offenders.append(f"stream/ingest.py:{ln} in {fn}()")
-    frame = os.path.join(pkg_root, "core", "frame.py")
-    with open(frame, encoding="utf-8") as f:
-        ftree = ast.parse(f.read())
-    for fn, ln in _to_numpy_hits(ftree, STREAM_APPEND_VERBS):
-        offenders.append(f"core/frame.py:{ln} in {fn}()")
-    assert not offenders, (
-        "to_numpy() inside the streaming chunk-landing path — appends "
-        "must stay zero-host-pull (pow2-bucketed device block writes).  "
-        "Chunk-side host logic belongs in parse.tokenize_chunk / "
-        "_chunk_cols_from_frame:\n" + "\n".join(offenders))
-
-
-def test_stream_append_verbs_still_exist():
-    """The append verbs the lint above polices are part of the streaming
-    contract — renaming one away silently un-scopes the lint."""
-    pkg_root = os.path.dirname(h2o_tpu.__file__)
-    frame = os.path.join(pkg_root, "core", "frame.py")
-    with open(frame, encoding="utf-8") as f:
-        tree = ast.parse(f.read())
-    names = {n.name for n in ast.walk(tree)
-             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
-    missing = STREAM_APPEND_VERBS - names
-    assert not missing, f"stream append verbs missing: {sorted(missing)}"
-
-
-# The SHARDED munge collectives (ISSUE 8) keep rows home-sharded: a
-# full-array jax.device_get / Vec.to_numpy in a sharded verb body pulls
-# a whole frame across the host, and a device_put with the REPLICATED
-# sharding gathers every row onto every device — both silently undo the
-# shard-residency contract.  (The small per-shard count syncs are
-# np.asarray of (n,)-sized replicated outputs, which this lint allows.)
-SHARD_MUNGE_VERBS = {
-    "_shard_sort_frame", "sort_frame", "filter_rows", "repack_frame",
-    "take_rows", "_shard_groupby", "_shard_merge", "_global_groupby",
-    "_global_merge", "_build_shard_sort", "_build_shard_filter",
-    "_build_shard_repack", "_build_shard_group_count",
-    "_build_shard_group_aggs", "_build_shard_merge_match",
-    "_build_shard_merge_emit", "_route"}
-
-
-def _attr_hits(tree, attrs, only_functions=None):
-    """(function, line) pairs referencing any attribute in ``attrs``
-    inside the named top-level function bodies."""
-    hits = []
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if only_functions is not None and node.name not in only_functions:
-            continue
-        for sub in ast.walk(node):
-            if isinstance(sub, ast.Attribute) and sub.attr in attrs:
-                hits.append((node.name, sub.lineno, sub.attr))
-    return hits
-
-
-def test_no_host_gather_in_sharded_munge_verbs():
-    pkg_root = os.path.dirname(h2o_tpu.__file__)
-    munge = os.path.join(pkg_root, "core", "munge.py")
-    with open(munge, encoding="utf-8") as f:
-        tree = ast.parse(f.read())
-    offenders = [
-        f"core/munge.py:{ln} in {fn}(): .{attr}"
-        for fn, ln, attr in _attr_hits(
-            tree, {"device_get", "to_numpy", "replicated"},
-            SHARD_MUNGE_VERBS)]
-    assert not offenders, (
-        "full-array device_get/to_numpy/replicated-sharding use inside "
-        "a SHARDED munge verb — rows must stay home-sharded; only the "
-        "per-shard counts / group tables may leave the device:\n"
-        + "\n".join(offenders))
-
-
-def test_sharded_munge_verbs_still_exist():
-    """The collective verbs the lint above polices are the ISSUE-8
-    contract — renaming one away silently un-scopes the lint."""
-    pkg_root = os.path.dirname(h2o_tpu.__file__)
-    munge = os.path.join(pkg_root, "core", "munge.py")
-    with open(munge, encoding="utf-8") as f:
-        tree = ast.parse(f.read())
-    names = {n.name for n in ast.walk(tree)
-             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
-    missing = (SHARD_MUNGE_VERBS - {"_shard_sort_frame"}) - names
-    assert not missing, f"sharded munge verbs missing: {sorted(missing)}"
-
-
-def test_munge_host_fallbacks_still_exist():
-    """The host oracle is part of the contract (H2O_TPU_DEVICE_MUNGE=0
-    must keep working) — renaming a fallback away breaks the parity
-    suite's comparison baseline."""
-    pkg_root = os.path.dirname(h2o_tpu.__file__)
-    interp = os.path.join(pkg_root, "rapids", "interp.py")
-    with open(interp, encoding="utf-8") as f:
-        tree = ast.parse(f.read())
-    names = {n.name for n in ast.walk(tree)
-             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
-    missing = MUNGE_HOST_ALLOWED - names
-    assert not missing, f"host munge fallbacks missing: {sorted(missing)}"
-
-
-# Every chaos injector must be observable: a ``maybe_*`` method that
-# injects without bumping a DEDICATED ``injected_*`` counter makes soak
-# accounting impossible (faults happen that no counter explains), and a
-# counter that never reaches the /3/Resilience payload is invisible to
-# operators.  Both halves are enforced here: AST over core/chaos.py for
-# the increments, and a live handler call for the payload.
-
-def _chaos_injector_counters():
-    """Map each ``maybe_*`` method of _Chaos to the set of dedicated
-    ``self.injected_*`` counters it increments (AugAssign or the
-    ``self.x += 1``-equivalent Assign), excluding the ``injected``
-    grand total."""
-    pkg_root = os.path.dirname(h2o_tpu.__file__)
-    path = os.path.join(pkg_root, "core", "chaos.py")
-    with open(path, encoding="utf-8") as f:
-        tree = ast.parse(f.read())
-    cls = next(n for n in ast.walk(tree)
-               if isinstance(n, ast.ClassDef) and n.name == "_Chaos")
-    out = {}
-    for fn in cls.body:
-        if not isinstance(fn, ast.FunctionDef) or \
-                not fn.name.startswith("maybe_"):
-            continue
-        counters = set()
-        for node in ast.walk(fn):
-            targets = []
-            if isinstance(node, ast.AugAssign):
-                targets = [node.target]
-            elif isinstance(node, ast.Assign):
-                targets = node.targets
-            for t in targets:
-                if isinstance(t, ast.Attribute) and \
-                        isinstance(t.value, ast.Name) and \
-                        t.value.id == "self" and \
-                        t.attr.startswith("injected_"):
-                    counters.add(t.attr)
-        out[fn.name] = counters
-    return out
-
-
-def test_every_chaos_injector_has_a_dedicated_counter():
-    by_injector = _chaos_injector_counters()
-    assert by_injector, "no maybe_* injectors found in core/chaos.py"
-    missing = sorted(name for name, ctrs in by_injector.items()
-                     if not ctrs)
-    assert not missing, (
-        "chaos injectors without a dedicated injected_* counter — soak "
-        "runs cannot account for their faults (add self.injected_<x> "
-        "+= 1 next to the injection): " + ", ".join(missing))
+def _injector_counter_names():
+    """Every dedicated ``injected_*`` counter any ``maybe_*`` injector
+    bumps — derived from the same AST helpers rule GL612 polices, so
+    this list can never drift from the source."""
+    from h2o_tpu.lint import rules_legacy
+    from h2o_tpu.lint.core import package_context
+    cls = rules_legacy._chaos_cls(package_context().get("core/chaos.py"))
+    assert cls is not None, "core/chaos.py injector class not found"
+    names = set()
+    for ctrs in rules_legacy._injector_counters(cls).values():
+        names |= ctrs
+    assert names, "no injector counters discovered"
+    return names
 
 
 def test_chaos_counters_reach_resilience_payload(cl):
@@ -333,9 +65,7 @@ def test_chaos_counters_reach_resilience_payload(cl):
     from h2o_tpu.api.handlers import resilience_stats
     payload = resilience_stats({})
     chaos_block = payload["chaos"]
-    wanted = {"injected"}
-    for ctrs in _chaos_injector_counters().values():
-        wanted |= ctrs
+    wanted = {"injected"} | _injector_counter_names()
     missing = sorted(wanted - set(chaos_block))
     assert not missing, (
         f"chaos counters absent from GET /3/Resilience: {missing}")
@@ -359,7 +89,7 @@ def test_chaos_injection_sequence_is_seed_deterministic():
                             transfer_slow_p=0.4, transfer_slow_ms=0.0,
                             oom_p=0.4, stream_truncate_p=0.4,
                             stream_slow_p=0.4, stream_slow_ms=0.0,
-                            seed=1234)
+                            kernel_reject_p=0.4, seed=1234)
         seq = []
         for i in range(30):
             for step, fn in (
@@ -373,7 +103,9 @@ def test_chaos_injection_sequence_is_seed_deterministic():
                     ("oom", lambda: c.maybe_oom(f"site{i}")),
                     ("trunc", lambda: c.maybe_truncate_stream(
                         f"src{i}")),
-                    ("sslow", lambda: c.maybe_slow_stream("drill"))):
+                    ("sslow", lambda: c.maybe_slow_stream("drill")),
+                    ("kreject", lambda: c.maybe_kernel_reject(
+                        f"kern{i}"))):
                 before = c.injected
                 try:
                     fn()
@@ -392,176 +124,7 @@ def test_chaos_injection_sequence_is_seed_deterministic():
             "same seed produced different injection sequences"
         assert c1 == c2
         assert sum(n for _w, n in s1) > 0, "drill injected nothing"
+        assert c1["injected_kernel_rejects"] > 0, \
+            "drill never exercised the kernel-reject injector"
     finally:
         chaos.reset()
-
-
-# The autotuner (core/autotune.py) is the ONE resolution point for the
-# kernel-lever knobs: consumers receive a resolved decision as a STATIC
-# arg at the jit boundary.  An os.environ read of a lever knob anywhere
-# else — worst of all inside a traced body — silently bakes the env
-# value at trace time, so toggling the knob (or the autotuner flipping
-# a winner) hits a stale executable.  Banned everywhere outside
-# autotune.py; inside autotune.py, banned outside ``_env_value``.
-LEVER_ENV_VARS = ("H2O_TPU_HIST_PALLAS", "H2O_TPU_MATMUL_ROUTE",
-                  "H2O_TPU_SIBLING_SUBTRACT", "H2O_TPU_AUTOTUNE")
-AUTOTUNE_FILE = os.path.join("core", "autotune.py")
-
-
-def _is_environ_read(node) -> bool:
-    """Call to os.environ.get/os.getenv, or an os.environ subscript."""
-    if isinstance(node, ast.Subscript):
-        v = node.value
-        return (isinstance(v, ast.Attribute) and v.attr == "environ" and
-                isinstance(v.value, ast.Name) and v.value.id == "os")
-    if not isinstance(node, ast.Call):
-        return False
-    f = node.func
-    if isinstance(f, ast.Attribute) and f.attr == "getenv" and \
-            isinstance(f.value, ast.Name) and f.value.id == "os":
-        return True
-    return (isinstance(f, ast.Attribute) and f.attr == "get" and
-            isinstance(f.value, ast.Attribute) and
-            f.value.attr == "environ" and
-            isinstance(f.value.value, ast.Name) and
-            f.value.value.id == "os")
-
-
-def _lever_env_reads(tree):
-    """Line numbers of environ reads whose key names a lever/autotune
-    knob (string constants only — docstrings and comments don't call
-    os.environ, so they never hit this)."""
-    hits = []
-    for node in ast.walk(tree):
-        if not _is_environ_read(node):
-            continue
-        consts = [c.value for c in ast.walk(node)
-                  if isinstance(c, ast.Constant) and
-                  isinstance(c.value, str)]
-        if any(c.startswith(v) for c in consts for v in LEVER_ENV_VARS):
-            hits.append(node.lineno)
-    return hits
-
-
-def test_lever_env_vars_resolved_only_in_autotune():
-    pkg_root = os.path.dirname(h2o_tpu.__file__)
-    offenders = []
-    for dirpath, _dirs, files in os.walk(pkg_root):
-        for name in files:
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            rel = os.path.relpath(path, pkg_root)
-            if rel == AUTOTUNE_FILE:
-                continue
-            with open(path, encoding="utf-8", errors="replace") as f:
-                try:
-                    tree = ast.parse(f.read())
-                except SyntaxError:
-                    continue
-            offenders.extend(f"{rel}:{ln}"
-                             for ln in _lever_env_reads(tree))
-    assert not offenders, (
-        "lever/autotune env knob read outside core/autotune.py — "
-        "decisions must flow through autotune.resolve_flag() and reach "
-        "traced code as STATIC args (an env read near a trace bakes a "
-        "stale value into the executable):\n"
-        + "\n".join(sorted(set(offenders))))
-
-
-def test_autotune_reads_env_only_in_env_value():
-    """Inside autotune.py itself every environ read lives in
-    ``_env_value`` — the single point the module docstring promises."""
-    pkg_root = os.path.dirname(h2o_tpu.__file__)
-    with open(os.path.join(pkg_root, AUTOTUNE_FILE),
-              encoding="utf-8") as f:
-        tree = ast.parse(f.read())
-    offenders = []
-
-    def visit(node, fn_name):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            fn_name = node.name
-        if _is_environ_read(node) and fn_name != "_env_value":
-            offenders.append(f"{AUTOTUNE_FILE}:{node.lineno}"
-                             f" (in {fn_name})")
-        for child in ast.iter_child_nodes(node):
-            visit(child, fn_name)
-
-    visit(tree, "<module>")
-    assert not offenders, (
-        "environ read in core/autotune.py outside _env_value — keep "
-        "the single lint-enforceable read point:\n"
-        + "\n".join(offenders))
-
-
-def test_lever_consumers_route_through_resolve_flag():
-    """Companion existence check: the three consumer gates still exist
-    and still call autotune.resolve_flag — without this, deleting the
-    delegation would quietly turn the ban above into dead code."""
-    pkg_root = os.path.dirname(h2o_tpu.__file__)
-    expected = {
-        os.path.join("ops", "histogram.py"): {"pallas_env_enabled"},
-        os.path.join("models", "tree", "jit_engine.py"):
-            {"matmul_route_enabled", "sibling_subtract_enabled"},
-    }
-    for rel, fns in expected.items():
-        with open(os.path.join(pkg_root, rel), encoding="utf-8") as f:
-            tree = ast.parse(f.read())
-        for want in fns:
-            fn = next((n for n in ast.walk(tree)
-                       if isinstance(n, ast.FunctionDef) and
-                       n.name == want), None)
-            assert fn is not None, f"{rel}: {want}() is gone"
-            calls = {c.func.id if isinstance(c.func, ast.Name)
-                     else getattr(c.func, "attr", None)
-                     for c in ast.walk(fn)
-                     if isinstance(c, ast.Call)}
-            assert "resolve_flag" in calls, (
-                f"{rel}: {want}() no longer delegates to "
-                "autotune.resolve_flag")
-
-
-def test_probe_runs_under_dedicated_autotune_oom_site():
-    """The probe's compiling first execution must sit under oom_ladder
-    at the literal ``autotune`` site — that is what routes probe OOMs
-    into the GET /3/Resilience site breakdown (the runtime half is
-    test_autotune.py's chaos drill)."""
-    pkg_root = os.path.dirname(h2o_tpu.__file__)
-    with open(os.path.join(pkg_root, AUTOTUNE_FILE),
-              encoding="utf-8") as f:
-        tree = ast.parse(f.read())
-    sites = [node.args[0].value for node in ast.walk(tree)
-             if isinstance(node, ast.Call) and
-             (getattr(node.func, "id", None) == "oom_ladder" or
-              getattr(node.func, "attr", None) == "oom_ladder") and
-             node.args and isinstance(node.args[0], ast.Constant)]
-    assert "autotune" in sites, (
-        "core/autotune.py no longer runs its probe under "
-        "oom_ladder('autotune', ...) — probe OOMs would kill the "
-        "training job instead of degrading the probe")
-
-
-def test_no_jax_jit_on_local_closures():
-    pkg_root = os.path.dirname(h2o_tpu.__file__)
-    offenders = []
-    for dirpath, _dirs, files in os.walk(pkg_root):
-        for name in files:
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            rel = os.path.relpath(path, pkg_root)
-            if rel in JIT_CLOSURE_ALLOWED:
-                continue
-            with open(path, encoding="utf-8", errors="replace") as f:
-                try:
-                    tree = ast.parse(f.read())
-                except SyntaxError:
-                    continue
-            offenders.extend(f"{rel}:{ln}"
-                             for ln in _jit_in_function_bodies(tree))
-    assert not offenders, (
-        "jax.jit referenced inside a function body — this wraps a fresh "
-        "closure per call and re-compiles every time.  Move the jit to "
-        "module level, or route through the dispatch cache "
-        "(h2o_tpu/core/mrtask.py map_reduce/map_frame/mutate_array):\n"
-        + "\n".join(sorted(set(offenders))))

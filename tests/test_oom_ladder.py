@@ -279,3 +279,57 @@ def test_sweep_actually_frees_then_reloads(cl, rng):
     # spilled columns reload transparently with the same bytes
     np.testing.assert_array_equal(spare.vec("s").to_numpy(), x * 3.0)
     np.testing.assert_array_equal(fr.vec("x").to_numpy(), x)
+
+
+# -- kernel rejection (the VMEM-gate follow-up) ------------------------------
+
+def test_vmem_gate_error_is_recoverable_kernel_failure():
+    from h2o_tpu.core import oom
+    from h2o_tpu.ops.hist_pallas import VMEMGateError
+    e = VMEMGateError(
+        "hist_pallas working set exceeds VMEM at the minimum tile")
+    assert oom.is_kernel_compile_failure(e)
+    assert not oom.is_device_oom(e)
+    # and kernel_fallback degrades it like any Mosaic failure
+    calls = []
+
+    def run(use_pallas):
+        calls.append(use_pallas)
+        if use_pallas:
+            raise e
+        return "xla"
+
+    assert oom.kernel_fallback("test.vmem", run, pallas=True) == "xla"
+    assert calls == [True, False]
+    assert _site("test.vmem")["kernel_fallbacks"] == 1
+
+
+def test_chaos_kernel_reject_degrades_standalone_histogram(cl, monkeypatch):
+    """An injected Pallas rejection inside histogram_build degrades to
+    the portable XLA executable via kernel_fallback — same values,
+    kernel_fallbacks rung counted, injector counter exported — instead
+    of failing the caller (the core/oom.py VMEM-gate follow-up)."""
+    import jax.numpy as jnp
+    from h2o_tpu.core import chaos, oom
+    from h2o_tpu.ops import histogram as H
+
+    rng = np.random.default_rng(5)
+    bins = jnp.asarray(rng.integers(0, 5, (96, 3)), jnp.int32)
+    leaf = jnp.asarray(rng.integers(0, 2, (96,)), jnp.int32)
+    stats = jnp.asarray(rng.normal(size=(96, 4)), jnp.float32)
+    ref = np.asarray(H.histogram_build(bins, leaf, stats,
+                                       n_leaves=2, nbins=4))
+
+    # opt the fused kernel in (CPU would normally gate it off) and force
+    # the injector: every pallas dispatch is rejected before it runs
+    monkeypatch.setattr(H, "pallas_env_enabled",
+                        lambda bucket=None: True)
+    c = chaos.configure(kernel_reject_p=1.0, seed=3)
+    before = _site("hist.standalone").get("kernel_fallbacks", 0)
+    out = np.asarray(H.histogram_build(bins, leaf, stats,
+                                       n_leaves=2, nbins=4))
+    np.testing.assert_array_equal(out, ref)
+    assert _site("hist.standalone")["kernel_fallbacks"] - before == 1
+    assert c.counters()["injected_kernel_rejects"] == 1
+    # the degradation is visible on the resilience surface
+    assert oom.stats()["degradations"] >= 1
