@@ -398,6 +398,106 @@ print(json.dumps({'train_s': train_s, 'score_s': score_s,
 """
 
 
+_ELASTIC_SRC = r"""
+import json, os, sys, time
+import numpy as np
+import jax
+ndev = len(jax.devices())
+if ndev < 2:
+    print(json.dumps({"skipped": f"{ndev} device(s) - reform needs >= 2"}))
+    sys.exit(0)
+from h2o_tpu.core.cloud import Cloud
+from h2o_tpu.core import chaos as chaos_mod
+from h2o_tpu.core import membership
+from h2o_tpu.core.oom import is_device_loss
+from h2o_tpu.models.tree.gbm import GBM
+model_axis = 2 if ndev >= 8 else 1
+nodes = (ndev // model_axis) & ~1 or 1
+cl = Cloud.boot(nodes=nodes, model_axis=model_axis)
+rows = int(os.environ.get("ER_ROWS", 4096))
+trees = int(os.environ.get("ER_TREES", 6))
+rng = np.random.default_rng(11)
+X = rng.normal(size=(rows, 6)).astype(np.float32)
+y = (X @ rng.normal(size=6).astype(np.float32)).astype(np.float32)
+from h2o_tpu.core.frame import Frame, Vec
+def frame():
+    return Frame([f"x{i}" for i in range(6)] + ["y"],
+                 [Vec(X[:, i]) for i in range(6)] + [Vec(y)])
+rec = os.environ["ER_REC_DIR"]
+mon = membership.monitor().configure(recovery_dir=rec, auto=True)
+chaos_mod.configure(slice_loss_at_block=2, seed=1)
+params = dict(ntrees=trees, max_depth=3, seed=7, nbins=16,
+              distribution="gaussian", score_tree_interval=2,
+              checkpoint_interval=2)
+t0 = time.monotonic()
+err = None
+try:
+    GBM(recovery_dir=rec, model_id="er_gbm", **params).train(
+        y="y", training_frame=frame())
+except Exception as e:
+    err = e
+if err is None or not is_device_loss(err):
+    print(json.dumps({"error": f"expected an injected slice loss, "
+                               f"got {err!r}"}))
+    sys.exit(0)
+t_loss = time.monotonic()
+if not mon.wait_stable(600):
+    print(json.dumps({"error": "recovery did not reach stable"}))
+    sys.exit(0)
+t_rec = time.monotonic() - t_loss
+ev = mon.events()[-1]
+m = mon.last_results[0] if mon.last_results else None
+chaos_mod.reset()
+t1 = time.monotonic()
+GBM(model_id="er_post", **params).train(y="y", training_frame=frame())
+post_s = time.monotonic() - t1
+print(json.dumps({
+    "time_to_recover_s": round(t_rec, 3),
+    "post_reform_throughput": round(rows * trees / post_s, 1),
+    "post_train_s": round(post_s, 3),
+    "old_mesh": ev.get("old_mesh"), "new_mesh": ev.get("new_mesh"),
+    "reform_ok": bool(ev.get("ok")), "attempts": ev.get("attempts"),
+    "resumed": m is not None,
+    "jobs_interrupted": len(ev.get("jobs_interrupted") or ())}))
+"""
+
+
+def bench_elastic_resume():
+    """Elastic-membership drill (core/membership.py): a GBM training
+    under per-block checkpoints is hit by an injected slice loss
+    mid-forest; the membership layer quiesces, reforms the mesh onto
+    the surviving half and resumes the build from its last block
+    checkpoint.  Headline value is time-to-recover (loss surfacing ->
+    mesh stable with the job resumed); post-reform training throughput
+    on the shrunken mesh rides in detail.  Runs in a fresh subprocess
+    so the mesh resize cannot disturb the rest of the ladder (and so a
+    CPU run can force a multi-device host topology)."""
+    import shutil
+    import subprocess
+    import tempfile
+    tmp = tempfile.mkdtemp(prefix="h2o_elastic_")
+    try:
+        env = dict(os.environ)
+        env["ER_REC_DIR"] = os.path.join(tmp, "rec")
+        if os.environ.get("BENCH_PLATFORM", "").startswith("cpu") or \
+                os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                " --xla_force_host_platform_device_count"
+                                "=8").strip()
+        r = subprocess.run([sys.executable, "-c", _ELASTIC_SRC],
+                           capture_output=True, env=env, timeout=900)
+        if r.returncode != 0:
+            raise RuntimeError(r.stderr.decode()[-400:])
+        out = json.loads(r.stdout.decode().strip().splitlines()[-1])
+        if "time_to_recover_s" in out:
+            out = {"value": out.pop("time_to_recover_s"),
+                   "unit": "s loss->recovered", **out}
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_cold_start():
     """Cold-vs-warm process start (the exec-store AOT + XLA persistent
     cache unlock): the SAME tiny GBM-train + first-serve-score workload
@@ -835,7 +935,7 @@ def _main_ladder(detail):
     configs = os.environ.get(
         "BENCH_CONFIG",
         "gbm,gbm_ua,gbm_bf16,drf,glm,dl,hist,rapidsgb,scaleout,gbm10m,"
-        "cpuref,cpuref10m,deep,coldstart,streamref,leverab"
+        "cpuref,cpuref10m,deep,coldstart,streamref,leverab,elastic"
     ).split(",")
 
     detail.update({"rows": rows, "cols": cols})
@@ -882,7 +982,8 @@ def _main_ladder(detail):
         configs = [c for c in configs
                    if c in ("gbm", "cpuref", "drf", "glm", "hist",
                             "rapidsgb", "scaleout", "gbm10m",
-                            "cpuref10m", "coldstart", "leverab")]
+                            "cpuref10m", "coldstart", "leverab",
+                            "elastic")]
         detail["rows"] = rows
     detail["platform"] = platform
 
@@ -911,7 +1012,8 @@ def _main_ladder(detail):
             ("deep", lambda: bench_deep(fr, rows)),
             ("coldstart", bench_cold_start),
             ("streamref", bench_streaming_refresh),
-            ("leverab", bench_lever_ab)]
+            ("leverab", bench_lever_ab),
+            ("elastic", bench_elastic_resume)]
     names = {"hist": "hist_kernel", "gbm10m": "gbm_10m",
              "cpuref": "cpu_reference", "deep": "drf_deep20",
              "gbm_ua": "gbm_uniform_adaptive", "gbm_bf16": "gbm_bf16",
@@ -920,7 +1022,8 @@ def _main_ladder(detail):
              "scaleout": "rapids_scaleout",
              "coldstart": "cold_start",
              "streamref": "streaming_refresh",
-             "leverab": "lever_ab"}
+             "leverab": "lever_ab",
+             "elastic": "elastic_resume"}
     for cfg, fn in runs:
         if cfg not in configs:
             continue

@@ -41,8 +41,11 @@ def _key(name, tpe="Key"):
 @route("GET", r"/(?:3|4)/Cloud(?:\.json)?")
 def cloud_status(params):
     c = cloud()
+    from h2o_tpu.core.membership import monitor
     from h2o_tpu.core.memory import manager
     mem = manager().stats()
+    mship = monitor().status()
+    lost = set((mship.get("last_probe") or {}).get("lost") or ())
     return {
         "__meta": {"schema_version": 3, "schema_name": "CloudV3",
                    "schema_type": "Iced"},
@@ -54,13 +57,19 @@ def cloud_status(params):
         "cloud_name": c.args.name,
         "cloud_size": c.n_nodes,
         "cloud_uptime_millis": int((time.time() - _START_TIME) * 1000),
-        "cloud_healthy": True,
+        # healthy = stable membership (no reform in flight, no lost
+        # devices in the last liveness probe)
+        "cloud_healthy": mship["state"] == "stable" and not lost,
         "consensus": True,
-        "locked": True,
+        # the reference locks membership forever (Paxos.java:145-166);
+        # here "locked" means only "not currently re-forming"
+        "locked": mship["state"] == "stable",
+        "membership": mship,
         "is_client": bool(c.args.client),
         "internal_security_enabled": bool(c.args.ssl_cert),
         "nodes": [{
-            "h2o": f"tpu-{i}", "ip_port": f"device:{i}", "healthy": True,
+            "h2o": f"tpu-{i}", "ip_port": f"device:{i}",
+            "healthy": i not in lost,
             "last_ping": int(time.time() * 1000), "pid": os.getpid(),
             "num_cpus": 1, "cpus_allowed": 1, "nthreads": 1,
             "my_cpu_pct": -1, "sys_cpu_pct": -1,
@@ -73,7 +82,7 @@ def cloud_status(params):
             "num_keys": len(c.dkv.keys()),
             "max_mem": 0, "sys_load": -1.0,
         } for i in range(c.n_nodes)],
-        "bad_nodes": 0,
+        "bad_nodes": len([i for i in lost if i < c.n_nodes]),
         "skip_ticks": False,
     }
 
@@ -1294,10 +1303,13 @@ def resilience_stats(params):
     (core/chaos.py — one dedicated counter per injector,
     lint-enforced), the job watchdog's expiry/eviction totals, the OOM
     degradation-ladder state (core/oom.py: oom_events, sweeps,
-    degradations per site/rung) and the HBM memory-manager accounting —
-    the numbers the chaos soak harness asserts against."""
+    degradations per site/rung), the HBM memory-manager accounting and
+    the elastic-membership state with its per-reform event history
+    (core/membership.py: cause, old/new mesh, jobs interrupted/resumed,
+    duration) — the numbers the chaos soak harness asserts against."""
     from h2o_tpu.core import oom, resilience
     from h2o_tpu.core.chaos import chaos
+    from h2o_tpu.core.membership import monitor
     from h2o_tpu.core.memory import manager
     jr = cloud().jobs
     c = chaos()
@@ -1306,6 +1318,7 @@ def resilience_stats(params):
         "chaos": dict(enabled=c.enabled, **c.counters()),
         "oom": oom.stats(),
         "memory": manager().stats(),
+        "membership": monitor().payload(),
         "watchdog": {"expired_jobs": jr.expired_count,
                      "evicted_jobs": jr.evicted_count,
                      "default_deadline_secs": jr.default_deadline_secs,

@@ -14,7 +14,9 @@ This module is that serving front door for the TPU rebuild:
 
 Status mapping: queue at capacity -> 429 (load shed), per-request
 deadline exceeded -> 408, unknown alias -> 404, unservable model -> 400,
-terminal device OOM (ladder exhausted, core/oom.py) -> 503.
+terminal device OOM (ladder exhausted, core/oom.py) -> 503, mesh
+re-forming after a slice loss (core/membership.py) -> 503 with a
+``Retry-After`` header.
 
 NOTE: no ``jax.jit`` may appear in api/handlers*.py (lint-enforced) —
 per-request compiles live behind serve/engine.py's bounded bucket cache.
@@ -28,6 +30,7 @@ import numpy as np
 
 from h2o_tpu.api.server import H2OError, route
 from h2o_tpu.core.cloud import cloud
+from h2o_tpu.core.membership import MeshReforming
 from h2o_tpu.core.oom import OOMError
 from h2o_tpu.models.model import Model
 from h2o_tpu.serve import (QueueFull, ServingConfig, UnsupportedModelError,
@@ -144,6 +147,12 @@ def serving_score(params, name):
     reg = registry()
     try:
         raw, ver = reg.score_rows(name, rows, deadline_ms=deadline_ms)
+    except MeshReforming as e:
+        # the membership layer is re-forming the mesh after a slice
+        # loss: fail fast with an explicit retry window — never hang
+        # the request on a dead mesh, never dispatch a stale executable
+        raise H2OError(503, str(e), headers={
+            "Retry-After": str(max(1, int(round(e.retry_after_s))))})
     except KeyError as e:
         raise H2OError(404, str(e))
     except QueueFull as e:

@@ -50,10 +50,14 @@ def route(method: str, pattern: str, raw: bool = False):
 
 
 class H2OError(Exception):
-    def __init__(self, status: int, msg: str):
+    def __init__(self, status: int, msg: str,
+                 headers: Optional[Dict[str, str]] = None):
         super().__init__(msg)
         self.status = status
         self.msg = msg
+        # extra response headers (e.g. Retry-After on a 503 while the
+        # mesh re-forms after a slice loss)
+        self.headers = headers or {}
 
 
 def _sanitize(x):
@@ -240,7 +244,8 @@ class _Handler(BaseHTTPRequestHandler):
                 except H2OError as e:
                     self._send(e.status, self._error_json(
                         path, e.status, e.msg, e.msg,
-                        "water.exceptions.H2OIllegalArgumentException"))
+                        "water.exceptions.H2OIllegalArgumentException"),
+                        headers=e.headers)
                 except NotImplementedError as e:
                     # unimplemented surface (e.g. a rapids op): a clear
                     # 501 naming the feature, not a stacktrace 500
@@ -258,9 +263,11 @@ class _Handler(BaseHTTPRequestHandler):
                                          f"no route for {method} {path}",
                                          f"no route for {method} {path}"))
 
-    def _send(self, status: int, payload: dict):
+    def _send(self, status: int, payload: dict,
+              headers: Optional[Dict[str, str]] = None):
         self._send_bytes(status, "application/json",
-                         json.dumps(_sanitize(payload)).encode())
+                         json.dumps(_sanitize(payload)).encode(),
+                         headers=headers)
 
     def _send_stream(self, status: int, ctype: str, chunks):
         """Chunked transfer for large exports (DownloadDataHandler streams

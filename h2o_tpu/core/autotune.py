@@ -420,6 +420,17 @@ def stats() -> dict:
         return out
 
 
+def invalidate_decisions() -> None:
+    """Drop the in-memory decision cache ONLY (counters keep running).
+    Called by ``Cloud.reform``: decisions are keyed per platform×ndev on
+    DISK (``_environ_key``), but the memory cache is keyed (site,
+    bucket) alone — after a mesh resize it would keep serving winners
+    measured on the old device set.  The next ``resolve`` re-reads the
+    correctly-keyed disk record (or re-probes) for the new mesh."""
+    with _LOCK:
+        _DECISIONS.clear()
+
+
 def reset() -> None:
     """Drop in-memory decisions and zero the counters (tests; persisted
     ``.tune`` records are untouched — delete the store dir for that)."""

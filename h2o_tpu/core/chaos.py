@@ -25,18 +25,55 @@ the HOST layer the framework owns:
   (fail the first N attempts of each distinct SITE, then succeed,
   mirroring the persist-transient design) — so the full OOM degradation
   ladder (core/oom.py: sweep -> shrink -> host fallback -> terminal) is
-  exercisable on CPU CI without real HBM pressure.
+  exercisable on CPU CI without real HBM pressure;
+- stream faults: a chunk read raises as a truncated source
+  (probability or fail-first-N-per-source transient mode) or stalls,
+  exercising the streaming-ingest retry loop and lag accounting;
+- kernel-reject faults: a fused-kernel dispatch raises a synthetic
+  Pallas/VMEM-gate rejection, proving kernel_fallback degrades to the
+  portable XLA path;
+- slice-loss faults: a dispatch choke point raises a synthetic
+  "device unavailable" (a preempted TPU slice / ICI fault) — either
+  with a probability, or DETERMINISTICALLY at the Nth dispatch of each
+  distinct site (``maybe_lose_slice`` counts calls per site and fires
+  exactly once when the count reaches N) — so the elastic-membership
+  recovery protocol (core/membership.py: quiesce -> Cloud.reform ->
+  auto_recover, bitwise) is exercisable on CPU CI without preempting
+  real capacity.
 
-Enable with ``H2O_TPU_CHAOS_JOB=0.3`` / ``H2O_TPU_CHAOS_DEVICE_PUT=0.1``
-(probabilities), ``H2O_TPU_CHAOS_PERSIST=0.2`` (probability) or
-``H2O_TPU_CHAOS_PERSIST_TRANSIENT=2`` (fail-N-then-succeed),
-``H2O_TPU_CHAOS_STALL=0.5`` + ``H2O_TPU_CHAOS_STALL_SECS=30`` (stall
-probability and duration), ``H2O_TPU_CHAOS_SCORE_SLOW=1.0`` +
-``H2O_TPU_CHAOS_SCORE_SLOW_MS=200`` (slow-score probability and
-duration), ``H2O_TPU_CHAOS_OOM=0.1`` (probability) or
-``H2O_TPU_CHAOS_OOM_TRANSIENT=2`` (fail-first-N-per-site), and optional
-``H2O_TPU_CHAOS_SEED``; or programmatically via ``configure()``.  Off
-by default; zero overhead when off.
+The authoritative flag table (all off by default; zero overhead when
+off; seedable with ``H2O_TPU_CHAOS_SEED``; programmatic via
+``configure()`` — the README mirrors this table):
+
+=========================================== ===========================
+Flag                                        Meaning
+=========================================== ===========================
+H2O_TPU_CHAOS_JOB                           P(job body raises at start)
+H2O_TPU_CHAOS_DEVICE_PUT                    P(host->HBM transfer raises)
+H2O_TPU_CHAOS_PERSIST                       P(byte-store read/write raises)
+H2O_TPU_CHAOS_PERSIST_TRANSIENT=N           fail first N attempts of each
+                                            persist op, then succeed
+H2O_TPU_CHAOS_STALL / _STALL_SECS           P/duration of a heartbeat-free
+                                            stall (watchdog drill)
+H2O_TPU_CHAOS_SCORE_SLOW / _SCORE_SLOW_MS   P/duration of a slow serving
+                                            batch (429/408 drill)
+H2O_TPU_CHAOS_TRANSFER_SLOW /               P/duration of a slow
+  _TRANSFER_SLOW_MS                         device->host block pull
+H2O_TPU_CHAOS_OOM                           P(synthetic RESOURCE_EXHAUSTED)
+H2O_TPU_CHAOS_OOM_TRANSIENT=N               fail first N attempts at each
+                                            dispatch site, then succeed
+H2O_TPU_CHAOS_STREAM_TRUNCATE               P(chunk read raises truncated)
+H2O_TPU_CHAOS_STREAM_TRUNCATE_TRANSIENT=N   fail first N reads of each
+                                            source, then succeed
+H2O_TPU_CHAOS_STREAM_SLOW / _STREAM_SLOW_MS P/duration of a stalled read
+H2O_TPU_CHAOS_KERNEL_REJECT                 P(synthetic Pallas/VMEM-gate
+                                            kernel rejection)
+H2O_TPU_CHAOS_SLICE_LOSS                    P(synthetic device-unavailable
+                                            slice loss)
+H2O_TPU_CHAOS_SLICE_LOSS_AT_BLOCK=N         lose the slice exactly once,
+                                            at the Nth dispatch of each
+                                            site (deterministic)
+=========================================== ===========================
 
 COUNTER DISCIPLINE (lint-enforced, graftlint GL612/GL613):
 every ``maybe_*`` injector increments a DEDICATED ``injected_*``
@@ -83,6 +120,16 @@ class ChaosKernelRejectError(ChaosError):
     the portable XLA path without CI needing real TPU VMEM pressure."""
 
 
+class ChaosSliceLossError(ChaosError):
+    """Injected slice loss (a preempted TPU slice / ICI fault).  The
+    message carries the "device unavailable" marker so
+    core/oom.is_device_loss classifies it exactly like a real XLA
+    device-unavailable/halted error — the membership layer must quiesce,
+    reform the mesh on the survivors, and resume every job bitwise,
+    without CI needing real preemptible capacity.  Deliberately NOT a
+    ChaosOOMError: slice loss must never walk the OOM shrink ladder."""
+
+
 class _Chaos:
     def __init__(self):
         e = os.environ.get
@@ -111,6 +158,9 @@ class _Chaos:
             e("H2O_TPU_CHAOS_STREAM_SLOW_MS", 100) or 100)
         self.kernel_reject_p = float(
             e("H2O_TPU_CHAOS_KERNEL_REJECT", 0) or 0)
+        self.slice_loss_p = float(e("H2O_TPU_CHAOS_SLICE_LOSS", 0) or 0)
+        self.slice_loss_at_block = int(
+            e("H2O_TPU_CHAOS_SLICE_LOSS_AT_BLOCK", 0) or 0)
         seed = e("H2O_TPU_CHAOS_SEED")
         self._rng = np.random.default_rng(
             int(seed) if seed is not None else None)
@@ -118,6 +168,7 @@ class _Chaos:
         self._transient_seen: Dict[Tuple[str, str], int] = {}
         self._oom_seen: Dict[str, int] = {}
         self._stream_seen: Dict[str, int] = {}
+        self._slice_calls: Dict[str, int] = {}
         self.injected = 0
         self.injected_jobs = 0
         self.injected_device_puts = 0
@@ -129,6 +180,7 @@ class _Chaos:
         self.injected_stream_truncations = 0
         self.injected_slow_streams = 0
         self.injected_kernel_rejects = 0
+        self.injected_slice_losses = 0
 
     @property
     def enabled(self) -> bool:
@@ -138,7 +190,8 @@ class _Chaos:
                 self.transfer_slow_p > 0 or self.oom_p > 0 or
                 self.oom_transient > 0 or self.stream_truncate_p > 0 or
                 self.stream_truncate_transient > 0 or
-                self.stream_slow_p > 0 or self.kernel_reject_p > 0)
+                self.stream_slow_p > 0 or self.kernel_reject_p > 0 or
+                self.slice_loss_p > 0 or self.slice_loss_at_block > 0)
 
     def counters(self) -> Dict[str, int]:
         """All injected-fault counters (the /3/Resilience chaos block).
@@ -151,7 +204,8 @@ class _Chaos:
                 "injected_persist", "injected_stalls",
                 "injected_slow_scores", "injected_slow_transfers",
                 "injected_oom", "injected_stream_truncations",
-                "injected_slow_streams", "injected_kernel_rejects")}
+                "injected_slow_streams", "injected_kernel_rejects",
+                "injected_slice_losses")}
 
     def _roll(self, p: float) -> bool:
         if p <= 0:
@@ -218,6 +272,34 @@ class _Chaos:
             raise ChaosKernelRejectError(
                 f"injected Pallas kernel rejection at {site}: working "
                 f"set exceeds VMEM (synthetic)")
+
+    def maybe_lose_slice(self, site: str) -> None:
+        """Slice-loss injector: called at dispatch choke points (the
+        tree driver's per-block launch, the membership liveness probe).
+        AT_BLOCK mode counts calls per distinct SITE and fires exactly
+        once, on call number N — so a drill can lose the slice
+        mid-forest and the RESUMED run (whose calls keep counting past
+        N) completes untouched.  Probability mode rolls per call."""
+        if self.slice_loss_at_block > 0:
+            with self._lock:
+                n = self._slice_calls.get(site, 0) + 1
+                self._slice_calls[site] = n
+                if n != self.slice_loss_at_block:
+                    return
+                self.injected += 1
+                self.injected_slice_losses += 1
+            log.warning("chaos: losing slice at %s (dispatch %d)",
+                        site, n)
+            raise ChaosSliceLossError(
+                f"injected slice loss at {site} (dispatch {n}): device "
+                f"unavailable — slice preempted (synthetic)")
+        if self._roll(self.slice_loss_p):
+            with self._lock:
+                self.injected_slice_losses += 1
+            log.warning("chaos: losing slice at %s", site)
+            raise ChaosSliceLossError(
+                f"injected slice loss at {site}: device unavailable — "
+                f"slice preempted (synthetic)")
 
     def maybe_truncate_stream(self, source: str) -> None:
         """Streaming-ingest truncation injector: a chunk read raises as
@@ -339,7 +421,9 @@ def configure(job_p: float = 0.0, device_put_p: float = 0.0,
               stream_truncate_transient: int = 0,
               stream_slow_p: float = 0.0,
               stream_slow_ms: float = 100.0,
-              kernel_reject_p: float = 0.0) -> _Chaos:
+              kernel_reject_p: float = 0.0,
+              slice_loss_p: float = 0.0,
+              slice_loss_at_block: int = 0) -> _Chaos:
     """Programmatic enable (tests); returns the active instance."""
     global _instance
     _instance = _Chaos()
@@ -360,6 +444,8 @@ def configure(job_p: float = 0.0, device_put_p: float = 0.0,
     _instance.oom_p = float(oom_p)
     _instance.oom_transient = int(oom_transient)
     _instance.kernel_reject_p = float(kernel_reject_p)
+    _instance.slice_loss_p = float(slice_loss_p)
+    _instance.slice_loss_at_block = int(slice_loss_at_block)
     if seed is not None:
         _instance._rng = np.random.default_rng(seed)
     return _instance

@@ -221,6 +221,14 @@ class Cloud:
         # refine) would otherwise replay jaxprs built for the old
         # device set on shape-compatible inputs
         jax.clear_caches()
+        # the exec store and autotune decisions are keyed per
+        # platform×ndev ON DISK, but their in-memory sides are not:
+        # a cached executable or a measured lever winner from the old
+        # mesh must not be served on the new one
+        from h2o_tpu.core.exec_store import exec_store
+        from h2o_tpu.core import autotune
+        exec_store().clear()
+        autotune.invalidate_decisions()
         if old is not None:
             from h2o_tpu.core.frame import Frame
             for key in list(newc.dkv.keys()):

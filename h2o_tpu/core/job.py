@@ -36,11 +36,24 @@ RUNNING = "RUNNING"
 DONE = "DONE"
 CANCELLED = "CANCELLED"
 FAILED = "FAILED"
-TERMINAL = (DONE, CANCELLED, FAILED)
+# INTERRUPTED is terminal FOR THIS JOB OBJECT but not for the work: the
+# job was stopped by a device/slice loss (or a membership quiesce) with
+# its checkpoints intact, and the recovery protocol replays it as a NEW
+# job on the reformed mesh (``requeued_as`` links the two).  Distinct
+# from FAILED so dashboards/soaks can tell "the work died" from "the
+# work moved".
+INTERRUPTED = "INTERRUPTED"
+TERMINAL = (DONE, CANCELLED, FAILED, INTERRUPTED)
 
 
 class JobCancelledException(Exception):
     pass
+
+
+class JobInterruptedException(Exception):
+    """Raised inside a job body at its next ``update()`` after a
+    membership interrupt, and to joiners of an INTERRUPTED job that
+    carries no underlying device-loss exception."""
 
 
 class Job:
@@ -74,6 +87,11 @@ class Job:
         self.last_progress = 0.0
         self._timed_out = False
         self._cancel_requested = threading.Event()
+        self._interrupt_requested = threading.Event()
+        self.interrupted_by = ""
+        # set by the recovery protocol once the work is replayed on the
+        # reformed mesh: the key of the resumed job/model
+        self.requeued_as: Optional[str] = None
         self._done = threading.Event()
         # serializes the terminal transition between the worker thread
         # and the watchdog (core/job.py JobRegistry._expire)
@@ -90,6 +108,9 @@ class Job:
         self.last_progress = time.time()
         if msg:
             self.progress_msg = msg
+        if self._interrupt_requested.is_set():
+            raise JobInterruptedException(
+                f"{self.description}: {self.interrupted_by or 'interrupted'}")
         if self._cancel_requested.is_set():
             raise JobCancelledException(self.description)
 
@@ -107,6 +128,17 @@ class Job:
     # -- control-side API ---------------------------------------------------
 
     def cancel(self) -> None:
+        self._cancel_requested.set()
+
+    def interrupt(self, cause: str = "") -> None:
+        """Request a RESUMABLE stop (membership quiesce): the body exits
+        at its next ``update()`` with the job marked INTERRUPTED, its
+        recovery checkpoints intact, ready for replay on a new mesh.
+        Also sets the cooperative-cancel event so bodies polling
+        ``stop_requested`` exit too (run() reclassifies their
+        cancellation as an interrupt)."""
+        self.interrupted_by = cause or "membership interrupt"
+        self._interrupt_requested.set()
         self._cancel_requested.set()
 
     def join(self, timeout: Optional[float] = None) -> Any:
@@ -130,6 +162,18 @@ class Job:
             raise clone from exc
         if self.status == CANCELLED:
             raise JobCancelledException(self.description)
+        if self.status == INTERRUPTED:
+            exc = self.exception
+            if exc is not None:
+                # surface the classified device loss itself, so callers
+                # (and is_device_loss) see what actually happened
+                try:
+                    clone = type(exc)(*exc.args)
+                except Exception:
+                    raise exc
+                raise clone from exc
+            raise JobInterruptedException(
+                f"{self.description}: {self.interrupted_by}")
         return self.result
 
     @property
@@ -159,7 +203,9 @@ class Job:
             "exception": repr(self.exception) if self.exception else None,
             "stacktrace": None,
             "ready_for_view": self.status == "DONE",
-            "auto_recoverable": False,
+            "auto_recoverable": self.status == INTERRUPTED,
+            "interrupted_by": self.interrupted_by or None,
+            "requeued_as": self.requeued_as,
             # resilience surface (deadline/watchdog state)
             "deadline_secs": self.deadline_secs,
             "stall_secs": self.stall_secs,
@@ -318,17 +364,40 @@ class JobRegistry:
                         job.result = result
                         job.status = DONE
                         job.progress = 1.0
+            except JobInterruptedException as e:
+                with job._state_lock:
+                    if not job._timed_out:
+                        job.status = INTERRUPTED
+                        job.exception = None
+                log.warning("job %s interrupted (%s): %s", job.key,
+                            job.description, e)
             except JobCancelledException:
+                interrupted = job._interrupt_requested.is_set()
                 with job._state_lock:
                     if not job._timed_out:
-                        job.status = CANCELLED
+                        job.status = INTERRUPTED if interrupted \
+                            else CANCELLED
             except BaseException as e:  # noqa: BLE001 — propagate to joiner
+                from h2o_tpu.core.oom import is_device_loss
+                lost = is_device_loss(e)
                 with job._state_lock:
                     if not job._timed_out:
-                        job.status = FAILED
+                        job.status = INTERRUPTED if lost else FAILED
                         job.exception = e
-                log.error("job %s failed: %s\n%s", job.key, e,
-                          traceback.format_exc())
+                        if lost and not job.interrupted_by:
+                            job.interrupted_by = f"device loss: {e}"
+                if lost:
+                    log.warning("job %s interrupted by device/slice "
+                                "loss: %s", job.key, e)
+                    try:
+                        from h2o_tpu.core.membership import monitor
+                        monitor().note_loss(e, source=f"job:{job.key}")
+                    except Exception:  # noqa: BLE001 — loss reporting
+                        # must never mask the job's own outcome
+                        log.exception("membership loss report failed")
+                else:
+                    log.error("job %s failed: %s\n%s", job.key, e,
+                              traceback.format_exc())
             finally:
                 with job._state_lock:
                     if not job._timed_out:
@@ -348,6 +417,27 @@ class JobRegistry:
     def run_sync(self, job: Job, body: Callable[[Job], Any]) -> Any:
         self.start(job, body)
         return job.join()
+
+    def quiesce(self, cause: str = "membership reform",
+                wait_secs: float = 15.0, exclude=()) -> list:
+        """Interrupt every live job (resumably — checkpoints intact) and
+        wait a bounded window for their bodies to exit; the membership
+        recovery protocol calls this before ``Cloud.reform`` so no job
+        body dispatches onto the dying mesh mid-resize.  Returns the
+        interrupted jobs; a body wedged past the window is left to die
+        on its own dispatch failure (the watchdog compensates its pool
+        slot)."""
+        victims = []
+        for job in self.list():
+            if str(job.key) in exclude:
+                continue
+            if job.status in (CREATED, RUNNING):
+                job.interrupt(cause)
+                victims.append(job)
+        deadline = time.time() + max(0.0, wait_secs)
+        for job in victims:
+            job._done.wait(max(0.0, deadline - time.time()))
+        return victims
 
     def get(self, key: str) -> Optional[Job]:
         with self._lock:

@@ -1,0 +1,394 @@
+"""Elastic membership — detect slice loss, reform the mesh, resume.
+
+The reference keeps the cloud alive with UDP heartbeats
+(water/HeartBeatThread.java:24) but *locks* membership at the first
+distributed write (water/Paxos.java:145-166), so a dead node kills the
+cloud anyway.  The TPU rebuild inverts that: membership is a fixed
+hardware mesh, but ``Cloud.reform`` (PR 8) can re-home every frame onto
+a DIFFERENT mesh shape and the per-block checkpoints resume bitwise
+across shapes — this module closes the loop from *failure* to that
+*recovery*:
+
+1. **detect** — a supervisor thread probes device liveness (one tiny
+   ``device_put`` per device, plus the ``maybe_lose_slice`` chaos
+   injector), and every job body that dies on a classified device loss
+   (``core/oom.is_device_loss``: XLA device-unavailable / halted / ICI
+   errors, injected ``ChaosSliceLossError``) reports in via
+   ``note_loss`` — the job is marked INTERRUPTED, not FAILED, with its
+   recovery checkpoints intact;
+2. **quiesce** — the job registry interrupts every live job resumably
+   (``JobRegistry.quiesce``) so nothing dispatches onto the dying mesh
+   mid-resize;
+3. **reform** — ``Cloud.reform`` onto the surviving shape (default
+   policy: halve the ``nodes`` axis per attempt, keep the model axis;
+   a loss DURING reform — re-entrant — retries with a further-shrunk
+   target, bounded by ``H2O_TPU_MEMBERSHIP_MAX_REFORMS``);
+4. **resume** — ``auto_recover`` replays every pending snapshot so each
+   in-flight GBM/DRF/GLM/DL job continues from its last block
+   checkpoint on the new mesh, bitwise (the per-tree RNG keys off the
+   ABSOLUTE tree index, and the driver re-pads the F carry to the new
+   row quantum);
+5. **degrade, never hang** — while a reform is in flight the serve
+   layer's admission checks (``check_serving``) raise
+   :class:`MeshReforming`, which the REST layer maps to 503 +
+   ``Retry-After`` — an in-flight ``/score`` never hangs on a dead
+   mesh and never runs a stale-mesh executable (``Cloud.reform`` drops
+   the exec store and autotune decision caches).
+
+LOCK DISCIPLINE (lint-enforced, graftlint GL403): the supervisor lock
+(``_supervisor_lock``) only ever guards *state transitions* — no
+blocking wait, no device dispatch, no thread join may run under it.
+Probes, quiesce, reform, and replay all happen OUTSIDE the lock; the
+lock is taken briefly to publish their outcomes.  This is what keeps
+``note_loss`` safe to call from any failing job thread.
+
+Every reform is recorded as an event (cause, old/new shape, attempts,
+jobs interrupted/resumed, duration) surfaced at ``GET /3/Cloud``
+(status) and ``GET /3/Resilience`` (per-event history).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from h2o_tpu.core.log import get_logger
+
+log = get_logger("membership")
+
+STABLE = "stable"
+REFORMING = "reforming"
+
+
+class MeshReforming(RuntimeError):
+    """The mesh is mid-reform after a slice loss: serving admission is
+    briefly closed.  REST maps this to 503 with a ``Retry-After``
+    header — clients retry instead of hanging on a dead mesh."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class MembershipMonitor:
+    """Host-side health monitor + recovery supervisor (singleton via
+    :func:`monitor`)."""
+
+    def __init__(self):
+        # guards ONLY the published state below (GL403: never hold it
+        # across a blocking wait or a device dispatch)
+        self._supervisor_lock = threading.Lock()
+        self.state = STABLE
+        self.epoch = 0                    # completed reforms
+        self._events: List[Dict[str, Any]] = []
+        self._losses: List[Dict[str, Any]] = []   # reported, undrained
+        self.losses_detected = 0
+        self.probes = 0
+        self.last_probe: Optional[Dict[str, Any]] = None
+        self.last_results: List[Any] = []  # resumed objects, last reform
+        self._stable_evt = threading.Event()
+        self._stable_evt.set()
+        self._recover_thread: Optional[threading.Thread] = None
+        self._probe_thread: Optional[threading.Thread] = None
+        self._stop_probe = threading.Event()
+        # recovery protocol config
+        self.auto_recover = False
+        self.recovery_dir: Optional[str] = None
+        self.survivor_policy: Optional[Callable[[int, int, int], dict]] \
+            = None
+        self.quiesce_wait_secs = 15.0
+        self.max_reform_attempts = int(os.environ.get(
+            "H2O_TPU_MEMBERSHIP_MAX_REFORMS", 3) or 3)
+        self.probe_interval_secs = float(os.environ.get(
+            "H2O_TPU_MEMBERSHIP_PROBE_SECS", 0) or 0)
+
+    # -- configuration ------------------------------------------------------
+
+    def configure(self, recovery_dir: Optional[str] = None,
+                  survivor_policy: Optional[Callable] = None,
+                  auto: bool = True,
+                  quiesce_wait_secs: Optional[float] = None,
+                  max_reform_attempts: Optional[int] = None
+                  ) -> "MembershipMonitor":
+        """Arm the recovery protocol.  ``survivor_policy(old_nodes,
+        old_model, attempt)`` returns the target-mesh flags for
+        ``Cloud.reform`` (default: halve the nodes axis per attempt);
+        ``recovery_dir`` is where ``auto_recover`` finds the pending
+        snapshots to replay.  With ``auto=False`` losses are recorded
+        but recovery only runs via an explicit :meth:`recover_now`."""
+        self.recovery_dir = recovery_dir
+        if survivor_policy is not None:
+            self.survivor_policy = survivor_policy
+        self.auto_recover = bool(auto)
+        if quiesce_wait_secs is not None:
+            self.quiesce_wait_secs = float(quiesce_wait_secs)
+        if max_reform_attempts is not None:
+            self.max_reform_attempts = int(max_reform_attempts)
+        return self
+
+    # -- detection ----------------------------------------------------------
+
+    def note_loss(self, exc: BaseException, source: str = "") -> None:
+        """Report a classified device/slice loss (called by the job
+        layer when a body dies on ``is_device_loss``, and by the probe).
+        Recording is always on; the recovery protocol launches once per
+        loss burst when armed (``configure(auto=True)``).  Never blocks,
+        never raises — safe from any failing thread."""
+        spawn = None
+        with self._supervisor_lock:
+            self.losses_detected += 1
+            self._losses.append({
+                "time": time.time(), "source": source,
+                "error": f"{type(exc).__name__}: {exc}"})
+            if self.auto_recover and self.state == STABLE:
+                self.state = REFORMING
+                self._stable_evt.clear()
+                spawn = threading.Thread(
+                    target=self._recover, daemon=True,
+                    name="h2o-membership-recover")
+                self._recover_thread = spawn
+        if spawn is not None:
+            log.warning("membership: device/slice loss reported by %s — "
+                        "starting mesh recovery", source or "probe")
+            spawn.start()
+
+    def probe(self) -> Dict[str, Any]:
+        """One device-liveness sweep: a trivial host->device transfer
+        per device (a lost/halted device raises here), with the chaos
+        slice-loss injector at the same choke point so CI can fail the
+        probe deterministically.  A classified loss is reported via
+        ``note_loss``; anything else propagates."""
+        import jax
+        from h2o_tpu.core.chaos import chaos
+        from h2o_tpu.core.oom import is_device_loss
+        healthy, lost = [], []
+        err: Optional[BaseException] = None
+        try:
+            c = chaos()
+            if c.enabled:
+                c.maybe_lose_slice("membership.probe")
+            for d in jax.devices():
+                try:
+                    jax.device_put(0, d)
+                    healthy.append(d.id)
+                except Exception as e:  # noqa: BLE001 — classified below
+                    if not is_device_loss(e):
+                        raise
+                    lost.append(d.id)
+                    err = e
+        except Exception as e:  # noqa: BLE001 — classified below
+            if not is_device_loss(e):
+                raise
+            err = e
+        report = {"time": time.time(), "healthy": healthy, "lost": lost,
+                  "ok": err is None}
+        with self._supervisor_lock:
+            self.probes += 1
+            self.last_probe = report
+        if err is not None:
+            self.note_loss(err, source="membership.probe")
+        return report
+
+    def start(self, interval_secs: Optional[float] = None) -> None:
+        """Start the supervisor thread (periodic liveness probe) — the
+        HeartBeatThread analog, host-side."""
+        if interval_secs is not None:
+            self.probe_interval_secs = float(interval_secs)
+        if self.probe_interval_secs <= 0:
+            return
+        if self._probe_thread is not None and \
+                self._probe_thread.is_alive():
+            return
+        self._stop_probe.clear()
+        t = threading.Thread(target=self._probe_loop, daemon=True,
+                             name="h2o-membership-probe")
+        self._probe_thread = t
+        t.start()
+
+    def stop(self) -> None:
+        self._stop_probe.set()
+
+    def _probe_loop(self) -> None:
+        while not self._stop_probe.wait(self.probe_interval_secs):
+            try:
+                self.probe()
+            except Exception:  # noqa: BLE001 — the probe must outlive
+                # transient non-loss errors (backend hiccups)
+                log.exception("membership probe failed")
+
+    # -- recovery protocol --------------------------------------------------
+
+    def recover_now(self) -> Dict[str, Any]:
+        """Run the recovery protocol synchronously (tests, operators).
+        No-op returning the last event if a recovery is already in
+        flight — it will finish on its own thread."""
+        with self._supervisor_lock:
+            if self.state == REFORMING:
+                running = self._recover_thread
+            else:
+                self.state = REFORMING
+                self._stable_evt.clear()
+                running = None
+        if running is not None:
+            return {"already_running": True}
+        return self._recover()
+
+    def _drained_losses(self) -> List[Dict[str, Any]]:
+        with self._supervisor_lock:
+            losses, self._losses = self._losses, []
+        return losses
+
+    def _target_shape(self, old_nodes: int, old_model: int,
+                      attempt: int) -> dict:
+        if self.survivor_policy is not None:
+            return dict(self.survivor_policy(old_nodes, old_model,
+                                             attempt))
+        # default: halve the data axis per attempt — the shape the
+        # surviving half-slice can host — and keep the model axis
+        return {"nodes": max(1, old_nodes >> attempt),
+                "model_axis": old_model}
+
+    def _recover(self) -> Dict[str, Any]:
+        """quiesce -> reform (retrying on re-entrant loss) -> replay.
+        Runs OFF the supervisor lock; publishes the outcome under it."""
+        from h2o_tpu.core.cloud import Cloud, cloud
+        from h2o_tpu.core.oom import is_device_loss
+        from h2o_tpu.core.recovery import auto_recover
+        t0 = time.time()
+        ev: Dict[str, Any] = {"started": t0, "ok": False, "attempts": 0,
+                              "causes": self._drained_losses()}
+        resumed: List[Any] = []
+        try:
+            c = cloud()
+            old_nodes, old_model = c.n_nodes, c.args.model_axis
+            ev["old_mesh"] = {"nodes": old_nodes, "model": old_model}
+            victims = c.jobs.quiesce(
+                cause="slice loss — mesh reform",
+                wait_secs=self.quiesce_wait_secs)
+            # the job whose death TRIGGERED this recovery is already
+            # terminal (INTERRUPTED) — the quiesce sweep never sees it,
+            # but its checkpointed work is exactly what the replay
+            # resumes: account and requeue-link it with the victims
+            victims += [j for j in c.jobs.list()
+                        if j.status == "INTERRUPTED"
+                        and j.requeued_as is None and j not in victims]
+            ev["jobs_interrupted"] = [str(j.key) for j in victims]
+            attempt = 0
+            while True:
+                attempt += 1
+                ev["attempts"] = attempt
+                target = self._target_shape(old_nodes, old_model,
+                                            attempt)
+                try:
+                    newc = Cloud.reform(**target)
+                    if self.recovery_dir:
+                        resumed = auto_recover(self.recovery_dir)
+                    break
+                except Exception as e:  # noqa: BLE001 — re-entrant loss
+                    if is_device_loss(e) and \
+                            attempt < self.max_reform_attempts:
+                        log.warning("membership: loss during reform "
+                                    "attempt %d (%s) — shrinking "
+                                    "further", attempt, e)
+                        ev.setdefault("reentrant_losses", []).append(
+                            f"{type(e).__name__}: {e}")
+                        continue
+                    raise
+            ev["new_mesh"] = {"nodes": newc.n_nodes,
+                              "model": newc.args.model_axis}
+            ev["jobs_resumed"] = len(resumed)
+            # link each interrupted job to its replay by destination
+            # key (the recovery snapshot's model id)
+            by_dest = {str(j.dest): j for j in victims}
+            for r in reversed(resumed):
+                j = by_dest.get(str(getattr(r, "key", r)))
+                if j is not None:
+                    j.requeued_as = str(getattr(r, "key", r))
+            ev["ok"] = True
+            log.info("membership: mesh reformed %dx%d -> %dx%d in %.2fs "
+                     "(%d jobs interrupted, %d resumed)", old_nodes,
+                     old_model, newc.n_nodes, newc.args.model_axis,
+                     time.time() - t0, len(victims), len(resumed))
+        except Exception as e:  # noqa: BLE001 — recovery must terminate
+            ev["error"] = f"{type(e).__name__}: {e}"
+            log.exception("membership: mesh recovery failed")
+        finally:
+            ev["duration_s"] = time.time() - t0
+            # losses reported asynchronously while we were reforming
+            # (e.g. quiesced jobs dying on the injected loss) belong to
+            # THIS event, not to the next burst
+            ev["causes"].extend(self._drained_losses())
+            with self._supervisor_lock:
+                self.epoch += 1
+                self._events.append(ev)
+                self.last_results = resumed
+                self.state = STABLE
+                self._recover_thread = None
+            self._stable_evt.set()
+        return ev
+
+    # -- consumers ----------------------------------------------------------
+
+    def check_serving(self) -> None:
+        """Serving admission gate: raise :class:`MeshReforming` while a
+        reform is in flight (the registry calls this on submit AND in
+        the batch worker, so neither new nor queued requests dispatch
+        onto a re-forming mesh)."""
+        if self.state == REFORMING:
+            raise MeshReforming(
+                "mesh is re-forming after a slice loss; retry shortly")
+
+    def wait_stable(self, timeout: Optional[float] = None) -> bool:
+        """Block (NOT under the supervisor lock) until no recovery is in
+        flight; True if stable within the timeout."""
+        return self._stable_evt.wait(timeout)
+
+    @property
+    def reforming(self) -> bool:
+        return self.state == REFORMING
+
+    def status(self) -> Dict[str, Any]:
+        """Compact state for ``GET /3/Cloud``."""
+        with self._supervisor_lock:
+            return {"state": self.state, "epoch": self.epoch,
+                    "losses_detected": self.losses_detected,
+                    "reform_events": len(self._events),
+                    "probes": self.probes,
+                    "probe_interval_secs": self.probe_interval_secs,
+                    "last_probe": dict(self.last_probe)
+                    if self.last_probe else None,
+                    "armed": self.auto_recover}
+
+    def events(self) -> List[Dict[str, Any]]:
+        """Per-reform event history for ``GET /3/Resilience``."""
+        with self._supervisor_lock:
+            return [dict(e) for e in self._events]
+
+    def payload(self) -> Dict[str, Any]:
+        out = self.status()
+        out["events"] = self.events()
+        return out
+
+
+_instance: Optional[MembershipMonitor] = None
+_instance_lock = threading.Lock()
+
+
+def monitor() -> MembershipMonitor:
+    global _instance
+    if _instance is None:
+        with _instance_lock:
+            if _instance is None:
+                _instance = MembershipMonitor()
+    return _instance
+
+
+def reset() -> None:
+    """Drop the singleton (tests).  Any live probe thread is stopped."""
+    global _instance
+    with _instance_lock:
+        if _instance is not None:
+            _instance.stop()
+        _instance = None

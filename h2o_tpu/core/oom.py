@@ -127,9 +127,11 @@ def is_device_oom(exc: BaseException) -> bool:
     """Classify an exception as a recoverable device OOM (XLA
     RESOURCE_EXHAUSTED / jaxlib allocation failure / injected chaos
     OOM).  A terminal :class:`OOMError` is NOT recoverable — the ladder
-    already ran."""
-    from h2o_tpu.core.chaos import ChaosOOMError
-    if isinstance(exc, OOMError):
+    already ran.  A device/slice LOSS is not an OOM either: no amount
+    of sweeping or shrinking brings a preempted slice back, so it must
+    reach the membership layer instead of walking the memory ladder."""
+    from h2o_tpu.core.chaos import ChaosOOMError, ChaosSliceLossError
+    if isinstance(exc, (OOMError, ChaosSliceLossError)):
         return False
     if isinstance(exc, ChaosOOMError):
         return True
@@ -139,6 +141,37 @@ def is_device_oom(exc: BaseException) -> bool:
         return False
     msg = str(exc)
     return any(m in msg for m in _OOM_MARKERS)
+
+
+# message markers of a lost/halted device or a broken inter-chip link —
+# the failure class behind a preempted TPU slice.  Deliberately disjoint
+# from _OOM_MARKERS and _KERNEL_MARKERS: loss is handled by mesh reform
+# (core/membership.py), never by the memory ladder or kernel fallback.
+_LOSS_MARKERS = ("device unavailable", "Device unavailable",
+                 "DEVICE UNAVAILABLE", "UNAVAILABLE:", "device halted",
+                 "Device halted", "core halted", "ICI failure",
+                 "interconnect failure", "slice preempted",
+                 "device is lost", "Device lost")
+
+
+def is_device_loss(exc: BaseException) -> bool:
+    """Classify an exception as a device/slice LOSS (a preempted TPU
+    slice, a halted core, a broken ICI link, or the injected chaos
+    equivalent) — recoverable only by reforming the mesh on the
+    surviving devices and resuming from checkpoints
+    (core/membership.py).  OOMs and kernel-compile failures are NOT
+    losses: they have their own in-place recovery ladders."""
+    from h2o_tpu.core.chaos import ChaosSliceLossError
+    if isinstance(exc, ChaosSliceLossError):
+        return True
+    if isinstance(exc, OOMError) or is_device_oom(exc):
+        return False
+    cls = type(exc)
+    if cls.__name__ not in _OOM_CLASSES and \
+            not cls.__module__.startswith(("jaxlib", "jax")):
+        return False
+    msg = str(exc)
+    return any(m in msg for m in _LOSS_MARKERS)
 
 
 # -- observability -----------------------------------------------------------

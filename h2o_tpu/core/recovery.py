@@ -55,6 +55,7 @@ class Recovery:
         info = {"kind": self.kind, "job_id": self.job_id,
                 "started": time.time(),
                 "params": _jsonable(params), "extra": extra or {},
+                "mesh": _mesh_info(),
                 "done": False, "models": []}
         self._write_info(info)
 
@@ -95,6 +96,7 @@ class Recovery:
             os.replace(tmp, os.path.join(self.dir, "iter.pkl"))
             m = dict(meta or {})
             m["saved_at"] = time.time()
+            m.setdefault("mesh", _mesh_info())
             tmp_m = os.path.join(self.dir, "iter.json.tmp")
             with open(tmp_m, "w") as f:
                 json.dump(m, f)
@@ -157,6 +159,18 @@ class Recovery:
         default_policy().call(write, what=f"recovery info {self.dir}")
 
 
+def _mesh_info() -> Optional[Dict]:
+    """Shape of the mesh the snapshot was written under — discovery uses
+    it to refuse snapshots from a BIGGER cloud than this process can
+    host (a shared recovery dir between differently-sized pods)."""
+    from h2o_tpu.core.cloud import Cloud
+    c = Cloud._instance
+    if c is None:
+        return None
+    return {"nodes": c.n_nodes, "model": c.args.model_axis,
+            "devices": c.n_nodes * c.args.model_axis}
+
+
 def _jsonable(params: Dict) -> Dict:
     out = {}
     for k, v in params.items():
@@ -191,6 +205,21 @@ def pending_recoveries(recovery_dir: str) -> List[Dict]:
         if not isinstance(info, dict):
             log.warning("skipping malformed recovery snapshot %s", info_p)
             continue
+        mesh = info.get("mesh")
+        if isinstance(mesh, dict) and mesh.get("devices"):
+            import jax
+            avail = jax.device_count()
+            if int(mesh["devices"]) > avail:
+                # checkpoints re-pad across mesh SHAPES (PR 8), but a
+                # snapshot stamped by a cloud with more devices than
+                # this process can see came from a different/bigger pod
+                # sharing the recovery dir — resuming it here would
+                # silently claim another cloud's work
+                log.warning(
+                    "skipping recovery snapshot %s: written by a "
+                    "%d-device mesh but only %d devices are available",
+                    info_p, int(mesh["devices"]), avail)
+                continue
         if not info.get("done"):
             info["dir"] = os.path.join(recovery_dir, d)
             # cheap checkpoint summary for /3/Recovery + auto_recover

@@ -1,4 +1,5 @@
-"""GL401/GL402 — lock discipline around the DKV and the memory manager.
+"""GL401–GL403 — lock discipline around the DKV, memory manager, and
+membership supervisor.
 
 The PR 5 deadlock class: ``MemoryManager._spill_lru`` once called
 ``Vec._spill()`` while holding the manager lock; the spill path
@@ -20,6 +21,16 @@ that way:
   BOTH orders anywhere is a deadlock waiting for two threads.  (Orders
   threaded through calls are out of scope — the GL401 re-entrancy ban
   covers the known case.)
+- **GL403** the membership-supervisor lock
+  (core/membership.py ``_supervisor_lock``) is taken from FAILING job
+  threads (``note_loss``) and from the serving admission path — it may
+  only ever guard state transitions.  A blocking wait (``join`` /
+  ``wait`` / ``sleep`` / ``acquire`` / ``result`` / ``quiesce`` /
+  ``run_sync``), a device dispatch (``jax.*`` / ``jnp.*`` /
+  device verbs), or a recovery-protocol step (``reform`` /
+  ``auto_recover`` / ``probe``) under it would let one dying mesh hang
+  every thread that reports a loss or checks serving admission.
+  Collect under the lock, act after releasing.
 """
 
 from __future__ import annotations
@@ -91,6 +102,57 @@ def check_under_lock(mi: ModuleInfo, ctx):
                     f"lock (collect under it, act after releasing; see "
                     f"MemoryManager._spill_lru)",
                     detail=f"under-lock:{bad}"))
+    return out
+
+
+# blocking / protocol calls that must never run under the supervisor
+# lock (GL403) — each can wait on device work or other threads
+_SUPERVISOR_BLOCKING = {"join", "wait", "sleep", "acquire", "result",
+                        "quiesce", "run_sync", "reform", "auto_recover",
+                        "probe"}
+
+
+def _supervisor_locks(node: ast.With) -> List[str]:
+    return [name for name in _with_locks(node)
+            if "supervisor" in name.lower()]
+
+
+@rule("GL403", "blocking-under-supervisor-lock")
+def check_supervisor_lock(mi: ModuleInfo, ctx):
+    out: List[Finding] = []
+    for node in ast.walk(mi.tree):
+        if not isinstance(node, ast.With):
+            continue
+        held = _supervisor_locks(node)
+        if not held:
+            continue
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if not isinstance(sub, ast.Call):
+                    continue
+                chain = classify._attr_chain(sub.func)
+                name = classify._call_name(sub)
+                bad = None
+                if chain and chain[0] in ("jax", "jnp"):
+                    bad = ".".join(chain)
+                elif name in _DEVICE or name in _SUPERVISOR_BLOCKING:
+                    bad = name
+                if bad is None:
+                    continue
+                out.append(Finding(
+                    "GL403", "error", mi.rel, sub.lineno,
+                    mi.scope_of(sub),
+                    f"`{bad}(...)` while holding {'/'.join(held)} — the "
+                    f"supervisor lock is taken from failing job threads "
+                    f"and the serving admission path, so it may only "
+                    f"guard state transitions; blocking waits, device "
+                    f"dispatch and recovery-protocol steps must run "
+                    f"OUTSIDE it (collect under the lock, act after "
+                    f"releasing)",
+                    detail=f"under-supervisor-lock:{bad}"))
     return out
 
 

@@ -299,6 +299,14 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
 
     def _launch(off: int, n: int) -> Dict:
         nonlocal F, block, no_donate
+        # Slice-loss choke point: a lost/preempted slice surfaces HERE,
+        # at the block dispatch, as a RESUMABLE interrupt — every
+        # already-absorbed block is durably checkpointed, the job layer
+        # reclassifies the loss as INTERRUPTED (not FAILED), and the
+        # membership recovery protocol replays this build from the last
+        # block boundary on the reformed mesh, bitwise.
+        if chaos().enabled:
+            chaos().maybe_lose_slice("tree.block")
         # Per-tree RNG folds the ABSOLUTE tree index into the forest
         # master key (jit_engine), so every block receives the SAME
         # master key and any partition — including an OOM-degraded

@@ -216,7 +216,15 @@ class ScoringEngine:
         the HBM LRU and retries; if that fails the micro-batch is SPLIT
         (halved chunks score through smaller — already warm or cheaper —
         buckets, a recorded degradation); the last rung before failing
-        the request is the pure-NumPy mojo scorer."""
+        the request is the pure-NumPy mojo scorer.
+
+        Membership gate: during a mesh reform this raises MeshReforming
+        (503-retry) even for callers that bypass the registry — a
+        compiled predict from the pre-loss mesh must never dispatch
+        (Cloud.reform drops the exec store, so getting past this gate
+        mid-reform would also mean a recompile against a dying mesh)."""
+        from h2o_tpu.core.membership import monitor
+        monitor().check_serving()
         chaos().maybe_slow_score(f"serve:{model.key}")
         n = X.shape[0]
         use_device = self.has_device_predict(model) and \

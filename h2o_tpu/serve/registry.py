@@ -229,7 +229,13 @@ class ServingRegistry:
         """Encode+score ``rows`` through the deployment's micro-batcher.
 
         Raises ``KeyError`` (unknown/draining alias), :class:`QueueFull`
-        (shed — HTTP 429), ``TimeoutError`` (per-request deadline)."""
+        (shed — HTTP 429), ``TimeoutError`` (per-request deadline), and
+        ``MeshReforming`` (HTTP 503 + Retry-After) while the membership
+        layer is re-forming the mesh after a slice loss — a request in
+        that window must fail fast and retry, never hang on a dead mesh
+        or dispatch a stale-mesh executable."""
+        from h2o_tpu.core.membership import monitor
+        monitor().check_serving()
         dep = self._get(name)
         if dep.draining:
             raise KeyError(f"deployment {name} is draining")
@@ -273,6 +279,11 @@ class ServingRegistry:
         """Batch body run on the worker thread: resolve the ACTIVE
         version once, encode every request's rows against it, one device
         dispatch."""
+        # a batch admitted just before a reform started must not
+        # dispatch onto the re-forming mesh — fail its requests fast
+        # with the same 503-retry contract as the admission gate
+        from h2o_tpu.core.membership import monitor
+        monitor().check_serving()
         ver = dep.active
         if ver is None:
             # belt-and-braces for the same first-deploy window: a batch

@@ -29,6 +29,10 @@ def main():
     coordinator, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
     from h2o_tpu.core.cloud import Cloud
 
+    # banner BEFORE the rendezvous: a worker wedged in boot_multihost
+    # must leave an identifiable log line for the watchdog's tail, not
+    # an empty file
+    print(f"[p{pid}] joining {coordinator} as {pid}/{nproc}", flush=True)
     cl = Cloud.boot_multihost(coordinator, nproc, pid)
     assert jax.process_count() == nproc, jax.process_count()
     assert cl.n_nodes == 4 * nproc, cl.n_nodes

@@ -207,6 +207,27 @@ CASES = [
                 table = {"v": arr}
             return table
      """, {}),
+    ("GL403", "core/membership.py", """
+        import threading
+
+        _supervisor_lock = threading.Lock()
+
+        def note_loss(jobs):
+            with _supervisor_lock:
+                victims = jobs.quiesce("reform")
+            return victims
+     """, """
+        import threading
+
+        _supervisor_lock = threading.Lock()
+
+        def note_loss(jobs):
+            with _supervisor_lock:
+                armed = True
+            if armed:
+                victims = jobs.quiesce("reform")
+            return victims
+     """, {}),
     ("GL402", "core/fx.py", """
         import threading
 

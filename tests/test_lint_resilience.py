@@ -89,7 +89,8 @@ def test_chaos_injection_sequence_is_seed_deterministic():
                             transfer_slow_p=0.4, transfer_slow_ms=0.0,
                             oom_p=0.4, stream_truncate_p=0.4,
                             stream_slow_p=0.4, stream_slow_ms=0.0,
-                            kernel_reject_p=0.4, seed=1234)
+                            kernel_reject_p=0.4, slice_loss_p=0.4,
+                            seed=1234)
         seq = []
         for i in range(30):
             for step, fn in (
@@ -105,7 +106,9 @@ def test_chaos_injection_sequence_is_seed_deterministic():
                         f"src{i}")),
                     ("sslow", lambda: c.maybe_slow_stream("drill")),
                     ("kreject", lambda: c.maybe_kernel_reject(
-                        f"kern{i}"))):
+                        f"kern{i}")),
+                    ("sloss", lambda: c.maybe_lose_slice(
+                        f"slice{i}"))):
                 before = c.injected
                 try:
                     fn()
@@ -126,5 +129,7 @@ def test_chaos_injection_sequence_is_seed_deterministic():
         assert sum(n for _w, n in s1) > 0, "drill injected nothing"
         assert c1["injected_kernel_rejects"] > 0, \
             "drill never exercised the kernel-reject injector"
+        assert c1["injected_slice_losses"] > 0, \
+            "drill never exercised the slice-loss injector"
     finally:
         chaos.reset()

@@ -1,0 +1,456 @@
+"""Elastic membership: slice loss -> quiesce -> reform -> bitwise resume.
+
+The PR's acceptance drill plus the edge contracts around
+core/membership.py:
+
+- a GBM training on a 4x2 mesh dies on an injected slice loss
+  (``H2O_TPU_CHAOS_SLICE_LOSS_AT_BLOCK`` semantics) mid-forest; the
+  membership monitor quiesces the job registry, re-forms the cloud onto
+  the surviving 2x2 mesh and replays the recovery snapshot — the
+  resumed forest is BITWISE equal to an uninterrupted run on the target
+  mesh (same anchor dataset as test_mesh_resize: exact-f32 first-block
+  reductions make cross-mesh resume equality well-defined);
+- an in-flight ``/score`` during the reform window gets an explicit 503
+  + ``Retry-After`` — never a hang, never a stale-mesh dispatch;
+- re-entrant loss (a second slice dies DURING the reform) retries with
+  a further-shrunk target; a loss with zero in-flight jobs still
+  reforms; a loss mid-StreamPipeline refresh is absorbed by the
+  pipeline (alias keeps the previous version, the next cadence
+  resumes); ``pending_recoveries`` refuses snapshots stamped by a
+  bigger mesh than this process can host;
+- ``Cloud.reform`` drops BOTH stale-executable caches (exec store +
+  in-memory autotune decisions) so nothing compiled for the old mesh
+  survives the resize.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+FOREST_KEYS = ("split_col", "value", "thr_bin", "bitset", "na_left")
+
+
+@pytest.fixture()
+def reboot():
+    """Boot/resize meshes inside a test, restoring the ORIGINAL session
+    Cloud INSTANCE at teardown (see test_mesh_resize.reboot)."""
+    from h2o_tpu.core.cloud import Cloud
+    saved = Cloud._instance
+
+    def boot(n, m):
+        return Cloud.boot(nodes=n, model_axis=m)
+
+    yield boot
+    with Cloud._lock:
+        Cloud._instance = saved
+
+
+@pytest.fixture()
+def membership_clean():
+    """Fresh monitor per test; drop chaos + the singleton afterwards so
+    no armed recovery protocol leaks into the rest of the session."""
+    from h2o_tpu.core import chaos, membership
+    membership.reset()
+    yield membership.monitor()
+    chaos.reset()
+    membership.reset()
+
+
+def _exact_frame():
+    """Integer features, y in {0,1}, 512 rows: every tree-1 reduction is
+    exact in f32 (test_mesh_resize's cross-mesh anchor dataset)."""
+    from h2o_tpu.core.frame import Frame, Vec
+    rng = np.random.default_rng(5)
+    n = 512
+    x0 = rng.integers(0, 16, size=n).astype(np.float32)
+    x1 = rng.integers(0, 8, size=n).astype(np.float32)
+    x2 = rng.integers(0, 4, size=n).astype(np.float32)
+    y = ((x0 + 2 * x1 + x2) % 2).astype(np.float32)
+    return Frame(["x0", "x1", "x2", "y"],
+                 [Vec(x0), Vec(x1), Vec(x2), Vec(y)])
+
+
+def _gbm(**kw):
+    from h2o_tpu.models.tree.gbm import GBM
+    return GBM(ntrees=4, max_depth=3, seed=7, nbins=16, learn_rate=0.5,
+               distribution="gaussian", histogram_type="UniformAdaptive",
+               **kw)
+
+
+def _forest_arrays(model):
+    return {k: np.asarray(model.output[k]) for k in FOREST_KEYS
+            if model.output.get(k) is not None}
+
+
+def _wait_epoch(mon, n, timeout=180.0):
+    deadline = time.time() + timeout
+    while mon.epoch < n and time.time() < deadline:
+        time.sleep(0.05)
+    assert mon.epoch >= n, \
+        f"recovery never completed (epoch {mon.epoch} < {n}): " \
+        f"{mon.events()}"
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill
+# ---------------------------------------------------------------------------
+
+def test_slice_loss_mid_forest_reforms_and_resumes_bitwise(
+        cl, reboot, tmp_path, membership_clean):
+    """GBM on 4x2 dies on an injected slice loss mid-forest; the
+    monitor auto-reforms to 2x2 and the resumed forest is bitwise equal
+    to an uninterrupted run on 2x2.  An in-flight score DURING the
+    reform gets the MeshReforming 503 contract, live."""
+    from h2o_tpu.api.handlers import cloud_status, resilience_stats
+    from h2o_tpu.api.handlers_serving import serving_score
+    from h2o_tpu.api.server import H2OError
+    from h2o_tpu.core import chaos
+    from h2o_tpu.core.cloud import cloud
+    from h2o_tpu.core.membership import MeshReforming
+    from h2o_tpu.core.oom import is_device_loss
+    from h2o_tpu.core.recovery import pending_recoveries
+    from h2o_tpu.serve.registry import registry
+    mon = membership_clean
+    rec = str(tmp_path / "rec")
+
+    # uninterrupted baseline on the TARGET mesh; deploy it so the
+    # mid-reform serving probe has a live alias to hit
+    reboot(2, 2)
+    m_ref = _gbm().train(y="y", training_frame=_exact_frame())
+    ref = _forest_arrays(m_ref)
+    pred_ref = np.asarray(m_ref.predict_raw(_exact_frame()))
+    registry().deploy("ms_live", m_ref)
+
+    probe = {}
+
+    def policy(old_nodes, old_model, attempt):
+        # runs on the recovery thread while state == REFORMING: probe
+        # the live serving contract from inside the reform window
+        # (never assert here — a raise would look like a reform failure)
+        try:
+            registry().score_rows("ms_live", [{"x0": 1, "x1": 1,
+                                               "x2": 1}])
+            probe["registry"] = "no raise"
+        except MeshReforming:
+            probe["registry"] = "reforming"
+        except Exception as e:  # noqa: BLE001 — recorded for the assert
+            probe["registry"] = repr(e)
+        try:
+            serving_score({"rows": [{"x0": 1, "x1": 1, "x2": 1}]},
+                          "ms_live")
+            probe["rest"] = "no raise"
+        except H2OError as e:
+            probe["rest"] = (e.status, e.headers.get("Retry-After"))
+        except Exception as e:  # noqa: BLE001 — recorded for the assert
+            probe["rest"] = repr(e)
+        return {"nodes": max(1, old_nodes >> attempt),
+                "model_axis": old_model}
+
+    try:
+        reboot(4, 2)
+        mon.configure(recovery_dir=rec, survivor_policy=policy,
+                      auto=True)
+        # first block trains + checkpoints; the 2nd block dispatch IS
+        # the slice loss (the resumed run's later dispatches pass —
+        # cumulative per-site counting)
+        chaos.configure(slice_loss_at_block=2, seed=3)
+        with pytest.raises(BaseException) as ei:
+            _gbm(recovery_dir=rec, checkpoint_interval=1,
+                 model_id="ms_gbm").train(y="y",
+                                          training_frame=_exact_frame())
+        assert is_device_loss(ei.value), ei.value
+        assert chaos.chaos().injected_slice_losses >= 1
+
+        _wait_epoch(mon, 1)
+        assert mon.wait_stable(60)
+        ev = mon.events()[-1]
+        assert ev["ok"], ev
+        assert ev["old_mesh"] == {"nodes": 4, "model": 2}
+        assert ev["new_mesh"] == {"nodes": 2, "model": 2}
+        assert len(ev["jobs_interrupted"]) == 1
+        assert ev["jobs_resumed"] == 1
+        assert ev["causes"], "loss report never reached the event"
+
+        # the live mid-reform serving probe: explicit 503 + Retry-After
+        assert probe.get("registry") == "reforming", probe
+        status, retry_after = probe.get("rest")
+        assert status == 503 and int(retry_after) >= 1, probe
+
+        # the interrupted job is terminal-but-requeued, not FAILED
+        jobs = [j for j in cloud().jobs.list()
+                if str(j.key) in ev["jobs_interrupted"]]
+        assert len(jobs) == 1
+        j = jobs[0]
+        assert j.status == "INTERRUPTED"
+        assert j.requeued_as
+        assert j.to_dict()["auto_recoverable"] is True
+        assert all(jj.status in ("DONE", "CANCELLED", "FAILED",
+                                 "INTERRUPTED")
+                   for jj in cloud().jobs.list())
+
+        # bitwise: resumed forest == uninterrupted run on the 2x2 mesh
+        assert len(mon.last_results) == 1
+        m2 = mon.last_results[0]
+        assert m2.output["ntrees_actual"] == 4
+        got = _forest_arrays(m2)
+        assert set(got) == set(ref)
+        for k in ref:
+            np.testing.assert_array_equal(ref[k], got[k], err_msg=k)
+        np.testing.assert_array_equal(
+            pred_ref, np.asarray(m2.predict_raw(_exact_frame())))
+        assert pending_recoveries(rec) == []
+
+        # REST surfaces: status at /3/Cloud, event history at
+        # /3/Resilience
+        cs = cloud_status({})
+        assert cs["membership"]["state"] == "stable"
+        assert cs["membership"]["epoch"] == 1
+        assert cs["cloud_healthy"] is True
+        rs = resilience_stats({})
+        assert rs["membership"]["events"], rs["membership"]
+        assert rs["membership"]["events"][-1]["ok"] is True
+        assert rs["chaos"]["injected_slice_losses"] >= 1
+
+        # serving admission reopened with the reform
+        raw, _ver = registry().score_rows(
+            "ms_live", [{"x0": 1, "x1": 1, "x2": 1}])
+        assert np.asarray(raw).size > 0
+    finally:
+        try:
+            registry().undeploy("ms_live", drain_secs=1.0)
+        except KeyError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# serving gate unit contract
+# ---------------------------------------------------------------------------
+
+def test_score_while_reforming_is_503_with_retry_after(
+        cl, membership_clean):
+    """Unit half of the serving contract: with the monitor REFORMING,
+    the registry submit path raises MeshReforming and REST maps it to
+    503 + Retry-After (the drill above proves the same thing live from
+    inside a real reform window)."""
+    from h2o_tpu.api.handlers_serving import serving_score
+    from h2o_tpu.api.server import H2OError
+    from h2o_tpu.core import membership
+    from h2o_tpu.core.membership import MeshReforming
+    from h2o_tpu.serve.registry import registry
+    m = _gbm(model_id="ms_gate_gbm").train(y="y",
+                                           training_frame=_exact_frame())
+    registry().deploy("ms_gate", m)
+    mon = membership_clean
+    try:
+        mon.state = membership.REFORMING
+        assert mon.reforming
+        with pytest.raises(MeshReforming):
+            registry().score_rows("ms_gate", [{"x0": 1, "x1": 1,
+                                               "x2": 1}])
+        with pytest.raises(H2OError) as ei:
+            serving_score({"rows": [{"x0": 1, "x1": 1, "x2": 1}]},
+                          "ms_gate")
+        assert ei.value.status == 503
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        mon.state = membership.STABLE
+        raw, _ver = registry().score_rows(
+            "ms_gate", [{"x0": 1, "x1": 1, "x2": 1}])
+        assert np.asarray(raw).size > 0
+    finally:
+        mon.state = membership.STABLE
+        try:
+            registry().undeploy("ms_gate", drain_secs=1.0)
+        except KeyError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# edges: re-entrant loss, zero jobs, mid-refresh loss, oversized snapshot
+# ---------------------------------------------------------------------------
+
+def test_reentrant_loss_during_reform_shrinks_further(
+        cl, reboot, membership_clean, monkeypatch):
+    """A second slice dying DURING the reform: the attempt loop retries
+    with a further-shrunk target instead of giving up or deadlocking."""
+    from h2o_tpu.core.chaos import ChaosSliceLossError
+    from h2o_tpu.core.cloud import Cloud
+    mon = membership_clean
+    reboot(4, 1)
+    orig = Cloud.reform
+    calls = {"n": 0}
+
+    def flaky_reform(**kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ChaosSliceLossError(
+                "injected slice loss at reform: device unavailable — "
+                "slice preempted (synthetic)")
+        return orig(**kw)
+
+    monkeypatch.setattr(Cloud, "reform", staticmethod(flaky_reform))
+    mon.configure(recovery_dir=None, auto=True)
+    mon.note_loss(ChaosSliceLossError("device unavailable (synthetic)"),
+                  source="test")
+    _wait_epoch(mon, 1)
+    ev = mon.events()[-1]
+    assert ev["ok"], ev
+    assert ev["attempts"] == 2
+    assert len(ev["reentrant_losses"]) == 1
+    # attempt 1 targeted 4>>1=2 nodes and died; attempt 2 landed 4>>2=1
+    assert ev["new_mesh"] == {"nodes": 1, "model": 1}
+    assert not mon.reforming
+
+
+def test_loss_with_zero_inflight_jobs_still_reforms(
+        cl, reboot, membership_clean):
+    """Nothing running when the slice dies: the reform still happens
+    (the mesh is broken regardless), with empty interrupt/resume sets."""
+    from h2o_tpu.core.chaos import ChaosSliceLossError
+    mon = membership_clean
+    reboot(2, 1)
+    mon.configure(recovery_dir=None, auto=True)
+    mon.note_loss(ChaosSliceLossError("device unavailable (synthetic)"),
+                  source="probe")
+    _wait_epoch(mon, 1)
+    ev = mon.events()[-1]
+    assert ev["ok"], ev
+    assert ev["jobs_interrupted"] == []
+    assert ev["jobs_resumed"] == 0
+    assert ev["new_mesh"] == {"nodes": 1, "model": 1}
+    mon.check_serving()                      # admission reopened
+
+
+def test_loss_mid_stream_refresh_keeps_alias_and_resumes(
+        cl, membership_clean):
+    """A slice loss inside a StreamPipeline refresh is absorbed at the
+    pipeline layer: the alias keeps serving the previous version and
+    the next cadence retries — no mesh reform for a refresh-local
+    fault that the pipeline already knows how to survive."""
+    from h2o_tpu.core.chaos import ChaosSliceLossError
+    from h2o_tpu.models.tree import jit_engine
+    from h2o_tpu.serve.registry import registry
+    from h2o_tpu.stream import ChunkReader, start_pipeline
+    from h2o_tpu.stream.refresh import stop_pipeline
+    mon = membership_clean
+    rng = np.random.default_rng(3)
+    lines = ["x0,x1,x2,y\n"]
+    for _ in range(128):
+        v = rng.normal(size=3)
+        lab = "s" if v[0] + 0.5 * v[1] > 0 else "b"
+        lines.append(f"{v[0]:.6f},{v[1]:.6f},{v[2]:.6f},{lab}\n")
+    payload = "".join(lines).encode()
+    half = len(lines[0]) + sum(len(s) for s in lines[1:65])
+    gate = threading.Event()
+
+    def byte_source():
+        yield payload[:half]                 # chunks 1+2 -> refresh v1
+        gate.wait(120)
+        yield payload[half:]                 # chunks 3+4 -> refresh v2
+
+    armed = {"on": False, "fired": False}
+    orig = jit_engine.train_forest
+
+    def lossy(*a, **k):
+        if armed["on"] and not armed["fired"]:
+            armed["fired"] = True
+            raise ChaosSliceLossError(
+                "injected slice loss at stream.refresh: device "
+                "unavailable — slice preempted (synthetic)")
+        return orig(*a, **k)
+
+    jit_engine.train_forest = lossy
+    pipe = None
+    try:
+        pipe = start_pipeline(
+            "ms_stream", ChunkReader(byte_source(), chunk_rows=32),
+            "y", algo="gbm",
+            model_params=dict(max_depth=3, seed=7, nbins=8),
+            refresh_chunks=2, trees_per_refresh=2, alias="ms_stream_live")
+        deadline = time.time() + 120
+        while pipe.refreshes < 1 and time.time() < deadline:
+            time.sleep(0.02)
+        assert pipe.refreshes == 1, pipe.status()
+        dep = registry().get("ms_stream_live")
+        assert dep.active.version == 1
+        armed["on"] = True                   # v2's first dispatch dies
+        gate.set()
+        assert pipe.job.join(timeout=300) is not None
+        st = pipe.status()
+        assert armed["fired"], st
+        assert st["failed_refreshes"] >= 1, st
+        # the drain retry after the absorbed loss completed v2 and
+        # swapped the alias; the failed attempt never reached it
+        assert st["refreshes"] == 2 and st["lag"] == 0, st
+        dep = registry().get("ms_stream_live")
+        assert dep.active.version == 2
+        # refresh-local absorption: no mesh reform was triggered
+        assert mon.epoch == 0 and not mon.reforming
+    finally:
+        jit_engine.train_forest = orig
+        gate.set()
+        stop_pipeline("ms_stream", remove=True)
+        try:
+            registry().undeploy("ms_stream_live", drain_secs=1.0)
+        except KeyError:
+            pass
+
+
+def test_pending_recoveries_skips_bigger_mesh_snapshots(cl, tmp_path):
+    """A snapshot stamped by a mesh with more devices than this process
+    can see (another pod sharing the recovery dir) is skipped; a
+    same-size stamp — and a legacy stamp with no mesh at all — stay
+    recoverable."""
+    import jax
+    from h2o_tpu.core.recovery import pending_recoveries
+    rec = tmp_path / "rec"
+    avail = jax.device_count()
+
+    def snap(name, mesh):
+        d = rec / name
+        d.mkdir(parents=True)
+        info = {"key": name, "algo": "gbm", "started": 1.0,
+                "done": False}
+        if mesh is not None:
+            info["mesh"] = mesh
+        (d / "info.json").write_text(json.dumps(info))
+
+    snap("too_big", {"nodes": avail * 2, "model": 1,
+                     "devices": avail * 2})
+    snap("fits", {"nodes": avail, "model": 1, "devices": avail})
+    snap("legacy", None)
+    pend = pending_recoveries(str(rec))
+    keys = sorted(p["key"] for p in pend)
+    assert keys == ["fits", "legacy"], pend
+
+
+# ---------------------------------------------------------------------------
+# reform invalidates stale compile/tuning state (satellite)
+# ---------------------------------------------------------------------------
+
+def test_reform_invalidates_exec_store_and_autotune_decisions(
+        cl, reboot):
+    """Executables and autotune decisions measured on the OLD mesh must
+    not survive a reform — a stale sharded executable on a different
+    device set is a miscompile, and a stale lever decision re-imposes
+    the old mesh's winner on the new one."""
+    from h2o_tpu.core import autotune
+    from h2o_tpu.core.cloud import Cloud
+    from h2o_tpu.core.exec_store import exec_store
+    reboot(4, 2)
+    es = exec_store()
+    es._insert(("membership_probe_phase", ("k",)), lambda x: x, False)
+    assert ("membership_probe_phase", ("k",)) in es.keys()
+    with autotune._LOCK:
+        autotune._DECISIONS[("ms_site", ("bucket",))] = {"choice": "x"}
+        stats_before = dict(autotune._STATS)
+    Cloud.reform(nodes=2, model_axis=2)
+    assert es.keys() == []
+    with autotune._LOCK:
+        assert autotune._DECISIONS == {}
+        # invalidation drops DECISIONS only — the probe/hit counters
+        # are cumulative observability, not mesh-shaped state
+        assert dict(autotune._STATS) == stats_before

@@ -42,12 +42,18 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-TERMINAL = ("DONE", "CANCELLED", "FAILED")
+# INTERRUPTED is terminal for the job object: the work moved to a
+# resumed job, it did not hang (core/job.py)
+TERMINAL = ("DONE", "CANCELLED", "FAILED", "INTERRUPTED")
 
 # fault mix: probabilities are deliberately moderate — the point is
-# composition under load, not a 100% storm that never completes work
+# composition under load, not a 100% storm that never completes work.
+# slice_loss_p fires at the tree-block dispatch and the membership
+# probe: a hit interrupts the build resumably (checkpoints intact) and
+# the soak's train_with_recovery retry path resumes it.
 FAULTS = dict(job_p=0.15, persist_p=0.15, stall_p=0.10, stall_secs=1.0,
-              score_slow_p=0.3, score_slow_ms=50.0, oom_p=0.10)
+              score_slow_p=0.3, score_slow_ms=50.0, oom_p=0.10,
+              slice_loss_p=0.05)
 
 
 def _poll_rest(port: int, timeout: float = 5.0) -> dict:
@@ -232,7 +238,8 @@ def run_soak(seed: int = 7, duration: float = 60.0,
                 except Exception as e:  # noqa: BLE001
                     if type(e).__name__ not in ("QueueFull",
                                                 "TimeoutError",
-                                                "OOMError"):
+                                                "OOMError",
+                                                "MeshReforming"):
                         fail("serve_contract",
                              f"round {r}: unexpected {e!r}")
                 registry().undeploy(name, drain_secs=2.0)
