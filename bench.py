@@ -498,6 +498,59 @@ def bench_elastic_resume():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_audit_overhead():
+    """graftaudit zero-overhead contract (core/lockwitness.py): the
+    runtime lock witness must be free when ``H2O_TPU_LOCK_WITNESS`` is
+    off and within noise when on — the factory returns plain threading
+    primitives at creation when disabled, and the steady-state witness
+    path is one tls lookup + an existing-edge counter bump.  Two
+    ExecStores built with the flag off/on dispatch the same cached
+    kernel; headline is the median per-dispatch delta, gated < 2%.
+    The kernel is munge-sized (256k rows, a few ops): the witness cost
+    is a ~µs-scale constant per dispatch, so the gate is meaningful
+    against a representative dispatch, not a no-op microbenchmark."""
+    import statistics
+
+    import jax.numpy as jnp
+
+    from h2o_tpu.core.exec_store import ExecStore
+
+    x = jnp.arange(262144.0)
+    reps, iters = 7, 40
+
+    def measure(flag):
+        prev = os.environ.get("H2O_TPU_LOCK_WITNESS")
+        os.environ["H2O_TPU_LOCK_WITNESS"] = flag
+        try:
+            st = ExecStore()  # lock flavor is decided at creation
+            run = lambda: st.dispatch(  # noqa: E731
+                "munge", ("audit_ovh", 262144),
+                lambda: (lambda a: jnp.cumsum(a * 2.0) + 1.0), (x,),
+                site="munge:audit_ovh")
+            run()  # compile once; the loop times the cached path
+            samples = []
+            for _ in range(reps):
+                t0 = time.time()
+                for _ in range(iters):
+                    run()
+                samples.append((time.time() - t0) / iters)
+            return statistics.median(samples)
+        finally:
+            if prev is None:
+                os.environ.pop("H2O_TPU_LOCK_WITNESS", None)
+            else:
+                os.environ["H2O_TPU_LOCK_WITNESS"] = prev
+
+    off_s = measure("0")
+    on_s = measure("1")
+    delta_pct = (on_s - off_s) / off_s * 100.0
+    return {"value": round(delta_pct, 3),
+            "unit": "% dispatch delta, witness on vs off",
+            "ok": bool(delta_pct < 2.0),
+            "dispatch_off_us": round(off_s * 1e6, 2),
+            "dispatch_on_us": round(on_s * 1e6, 2)}
+
+
 def bench_cold_start():
     """Cold-vs-warm process start (the exec-store AOT + XLA persistent
     cache unlock): the SAME tiny GBM-train + first-serve-score workload
@@ -935,7 +988,8 @@ def _main_ladder(detail):
     configs = os.environ.get(
         "BENCH_CONFIG",
         "gbm,gbm_ua,gbm_bf16,drf,glm,dl,hist,rapidsgb,scaleout,gbm10m,"
-        "cpuref,cpuref10m,deep,coldstart,streamref,leverab,elastic"
+        "cpuref,cpuref10m,deep,coldstart,streamref,leverab,elastic,"
+        "auditovh"
     ).split(",")
 
     detail.update({"rows": rows, "cols": cols})
@@ -1013,7 +1067,8 @@ def _main_ladder(detail):
             ("coldstart", bench_cold_start),
             ("streamref", bench_streaming_refresh),
             ("leverab", bench_lever_ab),
-            ("elastic", bench_elastic_resume)]
+            ("elastic", bench_elastic_resume),
+            ("auditovh", bench_audit_overhead)]
     names = {"hist": "hist_kernel", "gbm10m": "gbm_10m",
              "cpuref": "cpu_reference", "deep": "drf_deep20",
              "gbm_ua": "gbm_uniform_adaptive", "gbm_bf16": "gbm_bf16",
@@ -1023,7 +1078,8 @@ def _main_ladder(detail):
              "coldstart": "cold_start",
              "streamref": "streaming_refresh",
              "leverab": "lever_ab",
-             "elastic": "elastic_resume"}
+             "elastic": "elastic_resume",
+             "auditovh": "audit_overhead"}
     for cfg, fn in runs:
         if cfg not in configs:
             continue

@@ -1339,6 +1339,20 @@ def autotune_route(params):
     return autotune_payload()
 
 
+@route("GET", r"/3/Audit")
+def audit_route(params):
+    """graftaudit observability (lint/audit.py + core/lockwitness.py):
+    which tiers are live (``H2O_TPU_AUDIT`` for the IR executable
+    auditor, ``H2O_TPU_LOCK_WITNESS`` for the runtime lock witness),
+    the GL7xx/GL8xx findings computed from THIS process's recorders,
+    the witnessed lock-acquisition graph cross-checked against
+    graftlint's static GL402 edges (witnessed_only / static_only),
+    any acquisition-order cycles with their captured stacks, held-lock
+    device dispatches, and per-site compile/aval-churn counters."""
+    from h2o_tpu.lint.audit import audit_payload
+    return audit_payload()
+
+
 @route("POST", r"/3/Recovery/resume")
 def recovery_resume(params):
     """Asynchronous resume: returns a job key immediately, the recovery

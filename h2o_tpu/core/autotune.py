@@ -65,13 +65,14 @@ import numpy as np
 
 from h2o_tpu.core.exec_store import (SCHEMA_VERSION, backend_fingerprint,
                                      code_fingerprint, store_dir)
+from h2o_tpu.core.lockwitness import make_rlock
 from h2o_tpu.ops.histogram import (N_STATS, _pallas_eligible,
                                    histogram_build_traced)
 
 _TRUE = ("1", "on", "true", "yes")
 _FALSE = ("0", "off", "false", "no")
 
-_LOCK = threading.RLock()
+_LOCK = make_rlock("autotune._LOCK")
 _REGISTRY: Dict[str, "Lever"] = {}
 _DECISIONS: Dict[Tuple[str, Tuple], dict] = {}
 _STATS = {"probes": 0, "probe_runs": 0, "parity_disqualified": 0,
@@ -380,12 +381,25 @@ def resolve(site: str, bucket=None) -> dict:
         if rec is not None:
             _STATS["memory_hits"] += 1
             return rec
-        rec = _load_decision(lv, bkt)
-        if rec is None:
-            rec = _probe(lv, bkt)
-            _store_decision(rec)
+    # probe OUTSIDE the registry lock: a probe compiles and executes
+    # device work for seconds, and holding _LOCK across it stalled
+    # every other lever resolution — the first real inversion the
+    # GL802 runtime witness flagged.  A rare concurrent double-probe
+    # is harmless: the first inserter wins, the loser's record (same
+    # candidates, same backend) is discarded unpersisted.
+    rec = _load_decision(lv, bkt)
+    probed = rec is None
+    if probed:
+        rec = _probe(lv, bkt)
+    with _LOCK:
+        prior = _DECISIONS.get((site, bkt))
+        if prior is not None:
+            _STATS["memory_hits"] += 1
+            return prior
         _DECISIONS[(site, bkt)] = rec
-        return rec
+    if probed:
+        _store_decision(rec)
+    return rec
 
 
 def resolve_flag(site: str, bucket=None) -> bool:

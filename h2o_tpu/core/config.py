@@ -106,7 +106,25 @@ owning modules, like the chaos flags, so they work before a cloud boots):
   the first N reads of each source, then succeed — proves the retry
   loop heals a truncated/flaky source) and
   ``H2O_TPU_CHAOS_STREAM_SLOW`` + ``H2O_TPU_CHAOS_STREAM_SLOW_MS``
-  (stalled source reads).
+  (stalled source reads);
+- graftaudit recorder tiers (lint/audit.py + core/lockwitness.py —
+  the IR executable auditor and the runtime lock witness behind
+  ``python -m h2o_tpu.lint --tier ir|runtime`` and GET /3/Audit):
+  ``H2O_TPU_AUDIT`` (default off: the exec store records a compact
+  per-AOT-compile summary — donation aliasing, host custom-call
+  targets, input/output shardings, per-site aval churn — for the
+  GL701–GL704 rules; recording is compile-time-only, the steady-state
+  dispatch path is untouched), ``H2O_TPU_AUDIT_CHURN`` (default 8 —
+  distinct argument-aval keys per dispatch site before GL704 calls it
+  a shape-bucketing regression) and ``H2O_TPU_LOCK_WITNESS`` (default
+  off; tests/conftest.py turns it on for the whole suite: the named
+  supervisor/store/memory/exec-store/serving locks are created through
+  the witness factory, which records the real acquisition-order graph
+  for GL801 cycle detection and flags device dispatch under any
+  witnessed lock as GL802.  Decided at lock CREATION time — set it
+  before the first h2o_tpu import; off means plain ``threading``
+  primitives and zero overhead, a contract the bench ladder's
+  ``audit_overhead`` rung gates at < 2% dispatch delta).
 """
 
 from __future__ import annotations

@@ -72,10 +72,22 @@ import threading
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from h2o_tpu.core import lockwitness
 from h2o_tpu.core.diag import DispatchStats
 from h2o_tpu.core.log import get_logger
 
 log = get_logger("exec_store")
+
+_AUDIT_TRUE = ("1", "on", "true", "yes")
+
+
+def _audit_enabled() -> bool:
+    """H2O_TPU_AUDIT — record per-compile executable summaries for the
+    graftlint IR tier (h2o_tpu/lint/audit.py).  Checked before any lint
+    import so the off path costs one env lookup on the COMPILE path
+    only (never per dispatch)."""
+    return os.environ.get("H2O_TPU_AUDIT", "").strip().lower() \
+        in _AUDIT_TRUE
 
 SCHEMA_VERSION = 1
 _MAGIC = b"H2OEXEC1"
@@ -207,7 +219,7 @@ class ExecStore:
 
     def __init__(self, max_entries: Optional[int] = None):
         self.max_entries = int(max_entries or _env_capacity())
-        self._lock = threading.RLock()
+        self._lock = lockwitness.make_rlock("exec_store.ExecStore._lock")
         self._entries: "OrderedDict[Tuple, Any]" = OrderedDict()
         self._aot: set = set()            # keys holding AOT executables
         self.hits = 0
@@ -291,23 +303,64 @@ class ExecStore:
         fn = jax.jit(build(), **jkw)
         if args is not None:
             try:
-                compiled = fn.lower(*args, **(kwargs or {})).compile()
+                lowered = fn.lower(*args, **(kwargs or {}))
+                compiled = lowered.compile()
             except Exception as e:  # noqa: BLE001 — AOT is an optimisation;
                 # the jit wrapper stays correct (and the XLA persistent
                 # compile cache still warms the backend half)
                 log.debug("AOT lowering failed for %s (%r); keeping the "
                           "jit-level entry", phase, e)
                 self._insert(k, fn, aot=False)
+                self._note_audit_compile(phase, key, args)
                 DispatchStats.note_compile(phase)
                 return fn
             if disk_key is not None:
                 self._disk_store(disk_key, compiled)
+            if _audit_enabled():
+                self._record_audit(phase, key, lowered, compiled,
+                                   declared=bool(donate_argnums or
+                                                 donate_argnames),
+                                   resolved=dn, args=args)
             fn = compiled
             self._insert(k, fn, aot=True)
         else:
             self._insert(k, fn, aot=False)
+        self._note_audit_compile(phase, key, args)
         DispatchStats.note_compile(phase)
         return fn
+
+    # -- graftlint IR-audit hooks (H2O_TPU_AUDIT) ---------------------------
+
+    @staticmethod
+    def _audit_site(phase: str, key: Tuple) -> str:
+        """Stable per-site label: kernel/serve keys lead with a name
+        string; anonymous keys fall back to the phase."""
+        if key and isinstance(key[0], str):
+            return f"{phase}:{key[0]}"
+        return phase
+
+    def _note_audit_compile(self, phase: str, key: Tuple,
+                            args: Optional[Tuple]) -> None:
+        """Per-site distinct-aval-key accounting (GL704 recompile
+        churn) — every compile miss, AOT or jit-level."""
+        if not _audit_enabled():
+            return
+        from h2o_tpu.lint import audit
+        digest = repr(tuple(aval_key(a) for a in args)) \
+            if args is not None else repr(key)
+        audit.note_compile(self._audit_site(phase, key), digest)
+
+    def _record_audit(self, phase: str, key: Tuple, lowered, compiled,
+                      *, declared: bool, resolved: bool,
+                      args: Tuple) -> None:
+        from h2o_tpu.lint import audit
+        try:
+            audit.record_executable(
+                phase, self._audit_site(phase, key), declared, resolved,
+                lowered, compiled, args)
+        except Exception as e:  # noqa: BLE001 — the audit observes, it
+            # must never fail a build
+            log.debug("exec audit record failed for %s (%r)", phase, e)
 
     def _insert(self, k: Tuple, fn, aot: bool) -> None:
         with self._lock:
@@ -348,6 +401,9 @@ class ExecStore:
             phase, key, build, donate_argnums=donate_argnums,
             donate=donate, jit_kwargs=jit_kwargs, persist=persist,
             content=content, args=args if aot else None)
+        # GL802 runtime witness: executing under any witnessed lock
+        # stalls every thread contending for it (no-op when off)
+        lockwitness.note_device_dispatch(site or phase)
         DispatchStats.note_dispatch(phase)
         state = {"fn": fn}
 
@@ -558,7 +614,7 @@ class ExecStore:
 
 
 _STORE: Optional[ExecStore] = None
-_STORE_LOCK = threading.Lock()
+_STORE_LOCK = lockwitness.make_lock("exec_store._STORE_LOCK")
 
 
 def exec_store() -> ExecStore:
@@ -588,5 +644,6 @@ def cached_kernel(phase: str, name: str, statics: Tuple,
         persist=f"{phase}:{name}:{statics!r}" if persist else None,
         content=code_fingerprint(build) if persist else None,
         args=tuple(arrays))
+    lockwitness.note_device_dispatch(f"{phase}:{name}")
     DispatchStats.note_dispatch(phase)
     return fn

@@ -26,6 +26,7 @@ import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, Optional
 
+from h2o_tpu.core.lockwitness import make_lock
 from h2o_tpu.core.log import get_logger
 from h2o_tpu.core.store import Key
 
@@ -95,7 +96,7 @@ class Job:
         self._done = threading.Event()
         # serializes the terminal transition between the worker thread
         # and the watchdog (core/job.py JobRegistry._expire)
-        self._state_lock = threading.Lock()
+        self._state_lock = make_lock("job.Job._state_lock")
         self.result: Any = None
 
     # -- body-side API ------------------------------------------------------
@@ -261,7 +262,7 @@ class JobRegistry:
                                         thread_name_prefix="h2o-job")
         self._sys_pool = ThreadPoolExecutor(
             max_workers=system_workers, thread_name_prefix="h2o-sysjob")
-        self._lock = threading.Lock()
+        self._lock = make_lock("job.JobRegistry._lock")
         self.default_deadline_secs = float(default_deadline_secs)
         self.default_stall_secs = float(default_stall_secs)
         self.watchdog_interval = float(watchdog_interval)

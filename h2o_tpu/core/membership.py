@@ -54,6 +54,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from h2o_tpu.core.lockwitness import make_lock
 from h2o_tpu.core.log import get_logger
 
 log = get_logger("membership")
@@ -79,7 +80,8 @@ class MembershipMonitor:
     def __init__(self):
         # guards ONLY the published state below (GL403: never hold it
         # across a blocking wait or a device dispatch)
-        self._supervisor_lock = threading.Lock()
+        self._supervisor_lock = make_lock(
+            "membership.MembershipMonitor._supervisor_lock")
         self.state = STABLE
         self.epoch = 0                    # completed reforms
         self._events: List[Dict[str, Any]] = []
@@ -373,7 +375,7 @@ class MembershipMonitor:
 
 
 _instance: Optional[MembershipMonitor] = None
-_instance_lock = threading.Lock()
+_instance_lock = make_lock("membership._instance_lock")
 
 
 def monitor() -> MembershipMonitor:

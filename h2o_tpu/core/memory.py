@@ -33,6 +33,7 @@ import threading
 import weakref
 from typing import Optional
 
+from h2o_tpu.core.lockwitness import make_lock, make_rlock
 from h2o_tpu.core.log import get_logger
 
 log = get_logger("memory")
@@ -43,7 +44,7 @@ class MemoryManager:
 
     def __init__(self, budget_bytes: int = 0):
         self.budget = int(budget_bytes)
-        self._lock = threading.RLock()
+        self._lock = make_rlock("memory.MemoryManager._lock")
         # insertion-ordered dict of weakref -> nbytes; order = LRU
         self._resident: "dict[weakref.ref, int]" = {}
         self.spill_count = 0
@@ -147,7 +148,7 @@ class MemoryManager:
 
 
 _manager: Optional[MemoryManager] = None
-_manager_lock = threading.Lock()
+_manager_lock = make_lock("memory._manager_lock")
 
 
 def manager() -> MemoryManager:

@@ -24,6 +24,8 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional
 
+from h2o_tpu.core.lockwitness import make_rlock
+
 
 class Key(str):
     """A DKV key: just a unique name.  ``make`` mirrors water.Key.make()."""
@@ -52,7 +54,7 @@ class DKV:
 
     def __init__(self):
         self._store: Dict[Key, _Entry] = {}
-        self._lock = threading.RLock()
+        self._lock = make_rlock("store.DKV._lock")
 
     # -- basic ops (DKV.put/get/remove) ------------------------------------
 

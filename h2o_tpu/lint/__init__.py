@@ -26,9 +26,19 @@ Architecture (one module per concern):
 - ``rules_legacy``   — GL6xx: the 16 ad-hoc scans formerly hard-coded
                   in tests/test_lint_resilience.py, migrated onto the
                   framework (that file is now a thin tier-1 runner);
+- ``audit``     — GL7xx/GL8xx, the NON-AST tiers: IR audits over
+                  recorded compiled executables (donation honored?
+                  host transfers in steady state? replicated blowups?
+                  recompile churn? — H2O_TPU_AUDIT) and the runtime
+                  lock witness (real acquisition-order cycles,
+                  dispatch under a held lock — H2O_TPU_LOCK_WITNESS,
+                  recorders fed by core/exec_store.py and
+                  core/lockwitness.py); surfaced at ``GET /3/Audit``
+                  and ``tools/audit_gate.py``;
 - ``baseline``  — checked-in accepted-findings file
                   (tools/graftlint_baseline.json) keyed by fingerprint;
 - ``__main__``  — the ``python -m h2o_tpu.lint`` CLI (text/JSON,
+                  ``--tier ast|ir|runtime|all``, ``--fail-on-stale``,
                   nonzero exit on unbaselined findings).
 
 Suppress a single finding inline with a trailing (or own-line-above)
@@ -46,4 +56,5 @@ tests/test_graftlint.py (positive, negative, suppressed).
 
 from h2o_tpu.lint.core import (Finding, LintResult, ModuleInfo,  # noqa: F401
                                PackageContext, all_rules, last_summary,
-                               package_context, run_lint)
+                               note_baseline_result, package_context,
+                               run_lint)

@@ -1,8 +1,16 @@
 """CLI: ``python -m h2o_tpu.lint`` — text or JSON, nonzero on NEW
 findings (anything not in the checked-in baseline).
 
-Exit codes: 0 = clean (or every finding baselined), 1 = new findings,
-2 = usage error.
+Exit codes: 0 = clean (or every finding baselined), 1 = new findings
+(or stale baseline entries with ``--fail-on-stale``), 2 = usage error.
+
+``--tier`` selects an analysis tier: ``ast`` (GL1xx–GL6xx, source
+only), ``ir`` (GL7xx — recorded compiled-executable audits), ``runtime``
+(GL8xx — the lock witness graph), or ``all`` (default).  The ir/runtime
+tiers report on events recorded IN THIS PROCESS (H2O_TPU_AUDIT /
+H2O_TPU_LOCK_WITNESS); a bare CLI run has empty recorders — use
+``tools/audit_gate.py`` (or the tier-1 conftest run) to exercise a
+workload first.
 """
 
 from __future__ import annotations
@@ -12,7 +20,9 @@ import json
 import sys
 
 from h2o_tpu.lint import baseline as bl
-from h2o_tpu.lint.core import all_rules, package_context, run_lint
+from h2o_tpu.lint.audit import tier_of
+from h2o_tpu.lint.core import (all_rules, note_baseline_result,
+                               package_context, run_lint)
 
 
 def main(argv=None) -> int:
@@ -26,6 +36,14 @@ def main(argv=None) -> int:
                    help="machine-readable JSON report on stdout")
     p.add_argument("--rules", metavar="IDS",
                    help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--tier", choices=("ast", "ir", "runtime", "all"),
+                   default="all",
+                   help="analysis tier: ast = source rules, ir = GL7xx "
+                        "executable audits, runtime = GL8xx lock "
+                        "witness (default: all)")
+    p.add_argument("--fail-on-stale", action="store_true",
+                   help="exit 1 when the baseline carries stale "
+                        "(already-fixed) entries, so the file shrinks")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule table and exit")
     p.add_argument("--baseline", metavar="PATH", default=bl.DEFAULT_PATH,
@@ -53,6 +71,9 @@ def main(argv=None) -> int:
         if unknown:
             print(f"unknown rule ids: {sorted(unknown)}", file=sys.stderr)
             return 2
+    if args.tier != "all":
+        pool = rules if rules is not None else list(all_rules())
+        rules = [r for r in pool if tier_of(r) == args.tier]
 
     result = run_lint(package_context(), rules=rules)
 
@@ -69,6 +90,7 @@ def main(argv=None) -> int:
         new, old, stale = result.findings, [], []
     else:
         new, old, stale = bl.split(result.findings, args.baseline)
+        note_baseline_result(len(new), len(stale))
 
     if args.json:
         print(json.dumps({
@@ -95,7 +117,11 @@ def main(argv=None) -> int:
         print(f"graftlint: {result.rules_run} rules over "
               f"{result.modules} modules — {len(new)} new, "
               f"{len(old)} baselined, {result.suppressed} suppressed")
-    return 1 if new else 0
+    if new:
+        return 1
+    if stale and args.fail_on_stale:
+        return 1
+    return 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,410 @@
+"""GL7xx/GL8xx — IR-level executable audit + runtime lock witness.
+
+The AST tier (GL1xx–GL6xx) checks what the source SAYS; this module
+checks what actually happened: what XLA compiled (the IR tier) and what
+locks threads really took (the runtime tier).  Both tiers are post-hoc
+analyses over in-process recorders — they register in the same rule
+registry, flow through the same fingerprint baseline, and no-op when
+their recorder is empty (a bare ``python -m h2o_tpu.lint`` in a fresh
+process reports nothing for them; ``tools/audit_gate.py`` and the
+tier-1 conftest run them against real recorded data).
+
+IR tier — ``H2O_TPU_AUDIT`` gates recording; ``ExecStore.get_or_build``
+calls :func:`record_executable` once per fresh compile (the audit costs
+one HLO-text scan AT COMPILE TIME, nothing per dispatch):
+
+- **GL701** donation-not-honored: donation was declared AND resolved on,
+  but the compiled executable carries no input/output aliasing — the
+  silently-dropped-donation class (an output shape mismatch quietly
+  doubles HBM on the tree-train hot carry).
+- **GL702** host-transfer-in-steady-state: a ``munge``/``append``/
+  ``tree_block``-phase executable lowered host-callback/outfeed/infeed
+  ops — the zero-host-pull guarantee checked at the IR instead of by
+  counters (a ``device_get`` spelled via ``pure_callback`` traces
+  fine and is invisible to the AST ban).
+- **GL703** sharding blowup: a kernel with ``nodes``-sharded inputs
+  produced a fully-REPLICATED output at least as large as the sharded
+  input's global size — the all-gather-the-frame miscompile class.
+- **GL704** recompile churn: one store site compiled more than
+  ``H2O_TPU_AUDIT_CHURN`` (default 8) distinct argument-aval keys this
+  session — a bucketing regression caught as a lint finding instead of
+  a slow bench.
+
+Runtime tier — reads :mod:`h2o_tpu.core.lockwitness`'s registry
+(``H2O_TPU_LOCK_WITNESS``, on in the tier-1 conftest):
+
+- **GL801** witnessed lock-order cycle, instance-level, with every
+  participating edge's first-seen acquisition stack in the message;
+- **GL802** device dispatch while holding a witnessed lock (compiles
+  block for seconds, the OOM ladder for minutes — no guarded lock may
+  span a dispatch).
+
+:func:`audit_payload` is the shared REST/CI surface: findings by tier,
+the witnessed name-graph cross-checked against GL402's static edges
+(each tier reports what the other missed), and per-site compile counts.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from collections import deque
+from typing import Dict, Iterable, List, Optional
+
+from h2o_tpu.lint.core import Finding, rule
+
+_TRUE = ("1", "on", "true", "yes")
+
+_MAX_EVENTS = 512
+_MAX_KEYS_PER_SITE = 64
+
+# phases with a steady-state zero-host-transfer contract (GL702)
+STEADY_PHASES = ("munge", "append", "tree_block")
+
+_CC_RE = re.compile(r'custom_call_target="([^"]+)"')
+_HOST_CC_TOKENS = ("callback", "outfeed", "infeed", "xla_python",
+                   "host_transfer")
+_HOST_OPS = (" outfeed(", " infeed(", " send(", " recv(",
+             " send-done(", " recv-done(")
+
+
+def audit_on() -> bool:
+    """H2O_TPU_AUDIT: record one summary dict per fresh exec-store
+    compile for the IR rules (off = the hook is a dict lookup)."""
+    return os.environ.get("H2O_TPU_AUDIT", "").strip().lower() in _TRUE
+
+
+def churn_threshold() -> int:
+    """H2O_TPU_AUDIT_CHURN (default 8): distinct aval keys one store
+    site may compile per session before GL704 fires."""
+    return max(int(os.environ.get("H2O_TPU_AUDIT_CHURN", "") or "8"), 1)
+
+
+# -- the IR recorder ---------------------------------------------------------
+
+_EVENTS: "deque[dict]" = deque(maxlen=_MAX_EVENTS)
+# site -> {"keys": set of aval digests, "overflow": int, "compiles": int}
+_COMPILES: Dict[str, dict] = {}
+
+
+def reset() -> None:
+    _EVENTS.clear()
+    _COMPILES.clear()
+
+
+def events() -> List[dict]:
+    return list(_EVENTS)
+
+
+def compile_counts() -> Dict[str, dict]:
+    return {s: {"distinct_aval_keys": len(v["keys"]) + v["overflow"],
+                "compiles": v["compiles"]}
+            for s, v in sorted(_COMPILES.items())}
+
+
+def note_compile(site: str, aval_digest: str) -> None:
+    """Per-site churn accounting (GL704) — called on every exec-store
+    compile miss, AOT or jit-level."""
+    rec = _COMPILES.setdefault(site, {"keys": set(), "overflow": 0,
+                                      "compiles": 0})
+    rec["compiles"] += 1
+    if aval_digest in rec["keys"]:
+        return
+    if len(rec["keys"]) < _MAX_KEYS_PER_SITE:
+        rec["keys"].add(aval_digest)
+    else:
+        rec["overflow"] += 1
+
+
+def _arr_info(x) -> Optional[dict]:
+    import jax
+    import numpy as np
+    if not isinstance(x, jax.Array):
+        return None
+    try:
+        sh = x.sharding
+        replicated = bool(sh.is_fully_replicated)
+    except Exception:  # noqa: BLE001 — deleted/donated arrays
+        replicated = True
+    nbytes = int(np.prod(x.shape)) * x.dtype.itemsize if x.shape else \
+        x.dtype.itemsize
+    return {"shape": tuple(x.shape), "dtype": str(x.dtype),
+            "sharded": not replicated, "global_nbytes": nbytes}
+
+
+def _out_info(lowered, compiled) -> List[dict]:
+    import jax
+    import numpy as np
+    infos = []
+    try:
+        leaves = jax.tree_util.tree_leaves(lowered.out_info)
+    except Exception:  # noqa: BLE001 — older stages without out_info
+        leaves = []
+    try:
+        shardings = jax.tree_util.tree_leaves(compiled.output_shardings)
+    except Exception:  # noqa: BLE001
+        shardings = []
+    for i, leaf in enumerate(leaves):
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = getattr(leaf, "dtype", None)
+        itemsize = getattr(dtype, "itemsize", 4)
+        sh = shardings[i] if i < len(shardings) else \
+            getattr(leaf, "sharding", None)
+        try:
+            replicated = bool(sh.is_fully_replicated) if sh is not None \
+                else True
+        except Exception:  # noqa: BLE001
+            replicated = True
+        infos.append({"shape": shape, "dtype": str(dtype),
+                      "replicated": replicated,
+                      "nbytes": int(np.prod(shape)) * itemsize
+                      if shape else itemsize})
+    return infos
+
+
+def record_executable(phase: str, site: str, declared_donate: bool,
+                      resolved_donate: bool, lowered, compiled,
+                      args: Iterable) -> None:
+    """Summarize one freshly AOT-compiled entry for the IR rules.  All
+    extraction happens here, once, at compile time — the recorder keeps
+    small dicts, never executables."""
+    try:
+        text = compiled.as_text()
+    except Exception:  # noqa: BLE001 — backends without HLO text
+        text = ""
+    markers = set()
+    if text:
+        for target in _CC_RE.findall(text):
+            if any(tok in target.lower() for tok in _HOST_CC_TOKENS):
+                markers.add(target)
+        for op in _HOST_OPS:
+            if op in text:
+                markers.add(op.strip(" ("))
+    _EVENTS.append({
+        "phase": phase, "site": site,
+        "declared_donate": bool(declared_donate),
+        "resolved_donate": bool(resolved_donate),
+        "aliased": ("input_output_alias" in text) if text else None,
+        "host_markers": sorted(markers),
+        "inputs": [i for i in (_arr_info(a) for a in args)
+                   if i is not None],
+        "outputs": _out_info(lowered, compiled),
+    })
+
+
+# -- IR findings (GL701–GL704) ----------------------------------------------
+
+def ir_findings(evs: Optional[List[dict]] = None,
+                counts: Optional[Dict[str, dict]] = None,
+                rules: Optional[set] = None) -> List[Finding]:
+    """The GL7xx analysis over recorded events — shared by the
+    registered rules (global recorder) and the planted-defect tests
+    (explicit event lists)."""
+    evs = events() if evs is None else evs
+    counts = compile_counts() if counts is None else counts
+    out: List[Finding] = []
+    seen = set()
+
+    def emit(rid, site, message, detail):
+        if rules is not None and rid not in rules:
+            return
+        if (rid, detail) in seen:
+            return
+        seen.add((rid, detail))
+        out.append(Finding(rid, "error", "core/exec_store.py", 0,
+                           site, message, detail=detail))
+
+    for ev in evs:
+        site = ev["site"]
+        if ev["declared_donate"] and ev["resolved_donate"] and \
+                ev["aliased"] is False:
+            emit("GL701", site,
+                 f"declared donation was DROPPED by XLA at {site}: the "
+                 f"compiled executable carries no input/output aliasing "
+                 f"(usually an output shape/dtype mismatch with the "
+                 f"donated input) — the donated buffer is copied, not "
+                 f"reused, silently doubling HBM on this dispatch",
+                 detail=f"donation-dropped:{site}")
+        if ev["phase"] in STEADY_PHASES and ev["host_markers"]:
+            emit("GL702", site,
+                 f"steady-state executable at {site} lowered host-"
+                 f"transfer ops ({', '.join(ev['host_markers'])}) — the "
+                 f"{ev['phase']} phase has a zero-host-pull contract; a "
+                 f"host callback or outfeed here serializes every "
+                 f"dispatch on PCIe/DCN",
+                 detail=f"host-transfer:{site}")
+        sharded_in = [i for i in ev["inputs"] if i["sharded"]]
+        if sharded_in:
+            biggest = max(i["global_nbytes"] for i in sharded_in)
+            for o in ev["outputs"]:
+                if o["replicated"] and o["nbytes"] >= biggest > 0:
+                    emit("GL703", site,
+                         f"shard kernel at {site} produced a fully-"
+                         f"REPLICATED output of {o['nbytes']} bytes — "
+                         f">= its sharded input's global size "
+                         f"({biggest} bytes); the kernel all-gathered "
+                         f"the frame instead of keeping it shard-"
+                         f"resident",
+                         detail=f"replicated-blowup:{site}")
+                    break
+    thresh = churn_threshold()
+    for site, rec in counts.items():
+        if rec["distinct_aval_keys"] > thresh:
+            emit("GL704", site,
+                 f"recompile churn at {site}: "
+                 f"{rec['distinct_aval_keys']} distinct argument-aval "
+                 f"keys compiled this session (threshold {thresh}, "
+                 f"H2O_TPU_AUDIT_CHURN) — a shape-bucketing regression; "
+                 f"route sizes through bucket_pow2 or widen the bucket",
+                 detail=f"recompile-churn:{site}")
+    return out
+
+
+@rule("GL701", "donation-not-honored", kind="package")
+def check_donation_honored(ctx):
+    """IR audit: declared+resolved donation absent from the compiled
+    executable's input/output aliasing."""
+    return ir_findings(rules={"GL701"})
+
+
+@rule("GL702", "host-transfer-in-steady-state", kind="package")
+def check_host_transfer(ctx):
+    """IR audit: transfer/callback/outfeed ops in munge/append/
+    tree_block-phase executables."""
+    return ir_findings(rules={"GL702"})
+
+
+@rule("GL703", "sharding-blowup", kind="package")
+def check_sharding_blowup(ctx):
+    """IR audit: fully-replicated output >= the sharded input's global
+    size in a shard kernel."""
+    return ir_findings(rules={"GL703"})
+
+
+@rule("GL704", "recompile-churn", kind="package")
+def check_recompile_churn(ctx):
+    """IR audit: one store site compiling > N distinct aval keys per
+    session."""
+    return ir_findings(rules={"GL704"})
+
+
+# -- runtime findings (GL801/GL802) -----------------------------------------
+
+def witness_findings(reg=None, rules: Optional[set] = None
+                     ) -> List[Finding]:
+    """The GL8xx analysis over a witness registry — shared by the
+    registered rules (the process-wide registry) and the planted-
+    inversion tests (private registries, so deliberate cycles never
+    pollute the real graph)."""
+    from h2o_tpu.core import lockwitness
+    reg = lockwitness.registry() if reg is None else reg
+    out: List[Finding] = []
+    if rules is None or "GL801" in rules:
+        for cyc in reg.find_cycles():
+            names = sorted(set(cyc["names"]))
+            stacks = "\n".join(
+                f"--- witnessed {e['outer']} -> {e['inner']} "
+                f"(thread {e['thread']}, seen {e['count']}x):\n"
+                f"{e['stack']}" for e in cyc["edges"])
+            out.append(Finding(
+                "GL801", "error", "core/lockwitness.py", 0,
+                "<runtime>",
+                f"witnessed lock-order cycle: "
+                f"{' -> '.join(cyc['names'] + [cyc['names'][0]])} — two "
+                f"threads really took these locks in opposite orders "
+                f"this run; pick one canonical order.\n{stacks}",
+                detail=f"cycle:{'<>'.join(names)}"))
+    if rules is None or "GL802" in rules:
+        for rec in reg.held_dispatches():
+            out.append(Finding(
+                "GL802", "error", "core/lockwitness.py", 0,
+                rec["site"],
+                f"device dispatch at {rec['site']} while holding "
+                f"{'/'.join(rec['locks'])} (thread {rec['thread']}, "
+                f"{rec['count']}x) — a compile blocks for seconds and "
+                f"the OOM ladder for minutes; no witnessed lock may "
+                f"span a dispatch.  Witnessed stack:\n{rec['stack']}",
+                detail=f"dispatch-under-lock:"
+                       f"{','.join(rec['locks'])}:{rec['site']}"))
+    return out
+
+
+@rule("GL801", "witnessed-lock-cycle", kind="package")
+def check_witnessed_cycles(ctx):
+    """Runtime witness: a cycle in the real acquisition-order graph."""
+    return witness_findings(rules={"GL801"})
+
+
+@rule("GL802", "dispatch-under-lock", kind="package")
+def check_dispatch_under_lock(ctx):
+    """Runtime witness: device dispatch while holding a witnessed
+    lock."""
+    return witness_findings(rules={"GL802"})
+
+
+# -- tiers + the shared REST/CI payload -------------------------------------
+
+def tier_of(rule_id: str) -> str:
+    if rule_id.startswith("GL7"):
+        return "ir"
+    if rule_id.startswith("GL8"):
+        return "runtime"
+    return "ast"
+
+
+def static_lock_edges(ctx=None) -> List[List[str]]:
+    """GL402's syntactic acquisition pairs, name-normalized to their
+    trailing identifier — the static half of the cross-check."""
+    from h2o_tpu.lint.core import package_context
+    from h2o_tpu.lint.rules_locks import _acquisition_pairs
+    ctx = package_context() if ctx is None else ctx
+    pairs = set()
+    for rel in sorted(ctx.modules):
+        for outer, inner, _line in _acquisition_pairs(ctx.modules[rel]):
+            pairs.add((outer.split(".")[-1], inner.split(".")[-1]))
+    return sorted([a, b] for a, b in pairs)
+
+
+def audit_payload(ctx=None) -> dict:
+    """GET /3/Audit + tools/audit_gate.py: IR/runtime findings, the
+    witnessed lock graph cross-checked against GL402's static edges
+    (witnessed_only = orders the AST cannot see; static_only = orders
+    no tier-1 thread actually exercised), and per-site compile
+    counts."""
+    from h2o_tpu.core import lockwitness
+    reg = lockwitness.registry()
+    witnessed = [{"outer": a, "inner": b, "count": n}
+                 for (a, b), n in sorted(reg.name_edges().items())]
+    static = static_lock_edges(ctx)
+    static_set = {tuple(p) for p in static}
+    wit_set = {(e["outer"].split(".")[-1], e["inner"].split(".")[-1])
+               for e in witnessed}
+    ir = ir_findings()
+    rt = witness_findings()
+    return {
+        "enabled": {"ir": audit_on(),
+                    "runtime": lockwitness.enabled()},
+        "events_recorded": len(_EVENTS),
+        "findings": {
+            "ir": [{"rule": f.rule, "site": f.scope,
+                    "fingerprint": f.fingerprint,
+                    "message": f.message} for f in ir],
+            "runtime": [{"rule": f.rule, "site": f.scope,
+                         "fingerprint": f.fingerprint,
+                         "message": f.message} for f in rt]},
+        "lock_graph": {
+            "witnessed_edges": witnessed,
+            "static_edges": static,
+            "witnessed_only": sorted(
+                [a, b] for a, b in wit_set - static_set),
+            "static_only": sorted(
+                [a, b] for a, b in static_set - wit_set),
+            "cycles": [{"names": c["names"]}
+                       for c in reg.find_cycles()],
+            "held_dispatches": [
+                {k: v for k, v in d.items() if k != "stack"}
+                for d in reg.held_dispatches()],
+            "stats": reg.stats()},
+        "compile_counts": compile_counts(),
+        "churn_threshold": churn_threshold(),
+    }

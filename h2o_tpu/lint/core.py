@@ -213,21 +213,24 @@ class LintResult:
 
 # -- session AST cache -------------------------------------------------------
 
-_ast_cache: Dict[str, Tuple[float, ModuleInfo]] = {}
+_ast_cache: Dict[str, Tuple[Tuple[int, int], ModuleInfo]] = {}
 _ast_cache_lock = threading.Lock()
 
 
 def load_module(path: str, rel: str) -> Optional[ModuleInfo]:
-    """Parse-once-per-session module loader (mtime-invalidated): the
-    tier-1 runner, the conftest summary and repeated CLI invocations in
-    one process all share the same parsed ASTs."""
+    """Parse-once-per-session module loader, invalidated on
+    ``(st_mtime_ns, st_size)`` — float mtime alone misses same-second
+    rewrites on coarse-timestamp filesystems.  The tier-1 runner, the
+    conftest summary and repeated CLI invocations in one process all
+    share the same parsed ASTs."""
     try:
-        mtime = os.stat(path).st_mtime
+        st = os.stat(path)
+        stamp = (st.st_mtime_ns, st.st_size)
     except OSError:
         return None
     with _ast_cache_lock:
         hit = _ast_cache.get(path)
-        if hit is not None and hit[0] == mtime:
+        if hit is not None and hit[0] == stamp:
             return hit[1]
     try:
         with open(path, encoding="utf-8", errors="replace") as f:
@@ -236,7 +239,7 @@ def load_module(path: str, rel: str) -> Optional[ModuleInfo]:
     except SyntaxError:
         return None
     with _ast_cache_lock:
-        _ast_cache[path] = (mtime, mi)
+        _ast_cache[path] = (stamp, mi)
     return mi
 
 
@@ -261,9 +264,9 @@ def package_context(pkg_root: Optional[str] = None) -> PackageContext:
 def _load_passes() -> None:
     """Import every rules module exactly once (registration side
     effect)."""
-    from h2o_tpu.lint import (rules_donation, rules_legacy,  # noqa: F401
-                              rules_locks, rules_persist, rules_purity,
-                              rules_shard)
+    from h2o_tpu.lint import (audit, rules_donation,  # noqa: F401
+                              rules_legacy, rules_locks, rules_persist,
+                              rules_purity, rules_shard)
 
 
 _last_summary: Optional[dict] = None
@@ -273,6 +276,17 @@ def last_summary() -> Optional[dict]:
     """Stats of the most recent :func:`run_lint` in this process — the
     conftest ``[graftlint]`` terminal line reads exactly this."""
     return _last_summary
+
+
+def note_baseline_result(new: int, stale: int) -> None:
+    """Fold the baseline split into the last summary.  run_lint keeps
+    baseline filtering a caller concern; the callers that DO split (the
+    CLI, the tier-1 runner, audit_gate) report it here so the conftest
+    ``[graftlint]`` line shows stale entries — the nudge that makes the
+    baseline file shrink instead of rot."""
+    if _last_summary is not None:
+        _last_summary["new"] = int(new)
+        _last_summary["stale"] = int(stale)
 
 
 def run_lint(ctx: Optional[PackageContext] = None,

@@ -31,6 +31,7 @@ from concurrent.futures import Future
 from typing import Callable, List, Optional, Sequence
 
 from h2o_tpu.core.diag import TimeLine
+from h2o_tpu.core.lockwitness import make_lock
 from h2o_tpu.core.log import get_logger
 from h2o_tpu.core.resilience import Deadline
 
@@ -66,7 +67,7 @@ class MicroBatcher:
         self.on_batch = on_batch
         self._q: "queue.Queue[_Item]" = queue.Queue()
         self._pending = 0                 # queued + being scored
-        self._plock = threading.Lock()
+        self._plock = make_lock("batcher.MicroBatcher._plock")
         self._stop_evt = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"h2o-serve-{name}")

@@ -39,6 +39,7 @@ import numpy as np
 
 from h2o_tpu.core.chaos import chaos
 from h2o_tpu.core.exec_store import bucket_pow2, exec_store
+from h2o_tpu.core.lockwitness import make_rlock
 from h2o_tpu.core.log import get_logger
 
 log = get_logger("serve")
@@ -59,7 +60,7 @@ class ScoringEngine:
         # (model_id, version, bucket) entries it has materialized, for
         # buckets_for/evict/stats bookkeeping — reconciled against the
         # store so cross-phase LRU evictions are never reported as warm
-        self._lock = threading.RLock()
+        self._lock = make_rlock("engine.ScoringEngine._lock")
         self._keys: set = set()
         # (model_id, version) -> MojoModel schema/fallback view
         self._views: Dict[Tuple[str, int], Any] = {}

@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from h2o_tpu.core.diag import TimeLine
+from h2o_tpu.core.lockwitness import make_lock
 from h2o_tpu.core.log import get_logger
 from h2o_tpu.core.resilience import Deadline
 from h2o_tpu.serve.batcher import MicroBatcher, QueueFull
@@ -64,7 +65,7 @@ class ServingConfig:
 
 class DeploymentStats:
     def __init__(self):
-        self.lock = threading.Lock()
+        self.lock = make_lock("registry.DeploymentStats.lock")
         self.requests = 0
         self.rejected = 0
         self.expired = 0
@@ -112,7 +113,7 @@ class Deployment:
         self.name = name
         self.config = config
         self.batcher = batcher
-        self.lock = threading.Lock()
+        self.lock = make_lock("registry.Deployment.lock")
         self.versions: List[DeploymentVersion] = []
         self.active: Optional[DeploymentVersion] = None
         self.draining = False
@@ -125,7 +126,7 @@ class ServingRegistry:
 
     def __init__(self, engine: Optional[ScoringEngine] = None):
         self.engine = engine or ScoringEngine()
-        self._lock = threading.Lock()
+        self._lock = make_lock("registry.ServingRegistry._lock")
         self._deployments: Dict[str, Deployment] = {}
 
     # -- lifecycle -----------------------------------------------------------
@@ -342,7 +343,7 @@ class ServingRegistry:
 
 
 _instance: Optional[ServingRegistry] = None
-_instance_lock = threading.Lock()
+_instance_lock = make_lock("registry._instance_lock")
 
 
 def registry() -> ServingRegistry:

@@ -47,6 +47,7 @@ import numpy as np
 
 from h2o_tpu.core.diag import TimeLine
 from h2o_tpu.core.job import Job
+from h2o_tpu.core.lockwitness import make_lock
 from h2o_tpu.core.log import get_logger
 from h2o_tpu.stream.ingest import ChunkReader, frame_from_chunk
 
@@ -128,7 +129,7 @@ class StreamPipeline:
         self.swap_ms: List[float] = []
         self.lagging = False
         self.job: Optional[Job] = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("refresh.StreamPipeline._lock")
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -319,7 +320,7 @@ class StreamPipeline:
 # -- process-wide pipeline table (the /3/Stream backing store) ---------------
 
 _pipelines: Dict[str, StreamPipeline] = {}
-_pipelines_lock = threading.Lock()
+_pipelines_lock = make_lock("refresh._pipelines_lock")
 
 
 def start_pipeline(pipeline_id: str, reader: ChunkReader, y: str,

@@ -21,6 +21,12 @@ os.environ.setdefault("H2O_TPU_ROW_ALIGN", "8")
 # explicit CPU opt-in — the tree/GLM suites compile hundreds of programs
 # and the cache keeps repeat tier-1 runs inside the time budget
 os.environ.setdefault("H2O_TPU_COMPILE_CACHE", "1")
+# runtime lock witness (core/lockwitness.py): on for the whole suite so
+# every lock the package creates is wrapped and the mid-suite graftlint
+# run (test_lint_resilience.test_graftlint_clean) checks the REAL
+# witnessed acquisition graph for GL8xx findings.  Must be set before
+# any h2o_tpu module creates a lock — the factory decides at creation.
+os.environ.setdefault("H2O_TPU_LOCK_WITNESS", "1")
 
 # The container presets JAX_PLATFORMS=axon and a sitecustomize registers the
 # axon TPU backend at interpreter start; the env var is latched there, so the
@@ -100,10 +106,24 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
         from h2o_tpu.lint import last_summary
         ls = last_summary()
         if ls is not None:
+            extra = ""
+            if "new" in ls or "stale" in ls:
+                extra = " new={} stale={}".format(ls.get("new", 0),
+                                                  ls.get("stale", 0))
             terminalreporter.write_line(
                 "[graftlint] rules={} modules={} findings={} "
-                "suppressed={}".format(ls["rules_run"], ls["modules"],
-                                       ls["findings"], ls["suppressed"]))
+                "suppressed={}{}".format(ls["rules_run"], ls["modules"],
+                                         ls["findings"], ls["suppressed"],
+                                         extra))
+        from h2o_tpu.core import lockwitness
+        if lockwitness.enabled():
+            ws = lockwitness.registry().stats()
+            terminalreporter.write_line(
+                "[lock-witness] locks={} acquisitions={} edges={} "
+                "cycles={} held_dispatches={}".format(
+                    ws["locks_created"], ws["acquisitions"], ws["edges"],
+                    len(lockwitness.registry().find_cycles()),
+                    ws["held_dispatches"]))
     except Exception:  # noqa: BLE001 — reporting must never fail a run
         pass
 

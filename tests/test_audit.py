@@ -1,0 +1,363 @@
+"""graftaudit — planted-defect fixtures for the GL7xx IR tier and the
+GL8xx runtime lock witness (lint/audit.py + core/lockwitness.py).
+
+Each planted defect must produce exactly ONE finding and each negative
+twin must stay clean — the same contract the AST-tier fixture table in
+test_graftlint.py enforces, applied to evidence the AST cannot see:
+compiled executables and witnessed lock acquisitions.
+
+Isolation: the IR tests enable ``H2O_TPU_AUDIT`` per-test and reset the
+global recorders on exit; the witness tests plant their inversions in
+PRIVATE :class:`WitnessRegistry` instances.  Neither ever dirties the
+process-wide state that test_lint_resilience's real-package clean run
+checks.
+"""
+
+import os
+import threading
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from h2o_tpu.core import lockwitness
+from h2o_tpu.core.exec_store import ExecStore
+from h2o_tpu.lint import audit
+
+
+@pytest.fixture()
+def ir_audit(monkeypatch):
+    """Audit recording on, clean global recorders before AND after —
+    the after-reset keeps the mid-suite package-wide lint run blind to
+    anything planted here."""
+    monkeypatch.setenv("H2O_TPU_AUDIT", "1")
+    audit.reset()
+    yield
+    audit.reset()
+
+
+def _compile(store, phase, name, build, x, **kw):
+    with warnings.catch_warnings():
+        # jax warns when a declared donation is dropped — that warning
+        # IS the planted defect, not test noise
+        warnings.simplefilter("ignore")
+        return store.get_or_build(phase, (name, x.shape[0]), build,
+                                  args=(x,), **kw)
+
+
+# -- GL701: donation declared but dropped by XLA -----------------------------
+
+def test_gl701_donation_dropped_fires_once(ir_audit):
+    st = ExecStore()
+    x = jnp.arange(1024.0)
+    # planted: output shape != donated input shape → XLA drops the alias
+    _compile(st, "munge", "gl701_bad",
+             lambda: (lambda a: jnp.concatenate([a, a])), x,
+             donate_argnums=(0,), donate=True)
+    # negative twin: same-shape update → alias honored
+    _compile(st, "munge", "gl701_ok",
+             lambda: (lambda a: a * 2.0), x,
+             donate_argnums=(0,), donate=True)
+    found = [f for f in audit.ir_findings() if f.rule == "GL701"]
+    assert len(found) == 1, [f.render() for f in found]
+    assert "gl701_bad" in found[0].detail
+    assert found[0].severity == "error"
+
+
+def test_gl701_silent_when_donation_not_resolved(ir_audit):
+    # donation declared but resolved OFF (the CPU default) — nothing to
+    # audit: the executable legitimately carries no aliasing
+    st = ExecStore()
+    x = jnp.arange(64.0)
+    _compile(st, "munge", "gl701_off",
+             lambda: (lambda a: jnp.concatenate([a, a])), x,
+             donate_argnums=(0,), donate=False)
+    assert not [f for f in audit.ir_findings() if f.rule == "GL701"]
+
+
+# -- GL702: host transfer inside a steady-state executable -------------------
+
+def test_gl702_host_callback_in_steady_state(ir_audit):
+    st = ExecStore()
+    x = jnp.arange(256.0)
+
+    def build():
+        def f(a):
+            b = jax.pure_callback(lambda v: np.asarray(v) + 1.0,
+                                  jax.ShapeDtypeStruct(a.shape, a.dtype),
+                                  a)
+            return b * 2.0
+        return f
+
+    _compile(st, "munge", "gl702_bad", build, x)
+    # negative twin: pure device kernel in the same phase
+    _compile(st, "munge", "gl702_ok",
+             lambda: (lambda a: jnp.cumsum(a)), x)
+    found = [f for f in audit.ir_findings() if f.rule == "GL702"]
+    assert len(found) == 1, [f.render() for f in found]
+    assert "gl702_bad" in found[0].detail
+    assert "callback" in found[0].message
+
+
+def test_gl702_silent_outside_steady_phases(ir_audit):
+    # the same callback in a non-steady phase (model scoring may
+    # legitimately call host code) is not a finding
+    st = ExecStore()
+    x = jnp.arange(256.0)
+
+    def build():
+        def f(a):
+            return jax.pure_callback(
+                lambda v: np.asarray(v) + 1.0,
+                jax.ShapeDtypeStruct(a.shape, a.dtype), a)
+        return f
+
+    _compile(st, "score", "gl702_other_phase", build, x)
+    assert not [f for f in audit.ir_findings() if f.rule == "GL702"]
+
+
+# -- GL703: sharded input, replicated output >= global size ------------------
+
+def _nodes_mesh():
+    return Mesh(np.array(jax.devices()), ("nodes",))
+
+
+def test_gl703_replicated_blowup(ir_audit):
+    st = ExecStore()
+    mesh = _nodes_mesh()
+    xs = jax.device_put(jnp.arange(4096.0),
+                        NamedSharding(mesh, P("nodes")))
+    # planted: the kernel forces a fully-replicated output the size of
+    # the sharded input's GLOBAL array — an accidental all-gather
+    _compile(st, "tree_block", "gl703_bad",
+             lambda: jax.jit(lambda a: a + 1.0,
+                             out_shardings=NamedSharding(mesh, P())), xs)
+    # negative twin: output keeps the input's sharding
+    _compile(st, "tree_block", "gl703_ok",
+             lambda: jax.jit(lambda a: a + 1.0,
+                             out_shardings=NamedSharding(mesh,
+                                                         P("nodes"))), xs)
+    found = [f for f in audit.ir_findings() if f.rule == "GL703"]
+    assert len(found) == 1, [f.render() for f in found]
+    assert "gl703_bad" in found[0].detail
+
+
+def test_gl703_small_replicated_scalar_ok(ir_audit):
+    # a replicated REDUCTION (scalar) is the normal shape of tree_block
+    # results — far below the input's global size, not a finding
+    st = ExecStore()
+    mesh = _nodes_mesh()
+    xs = jax.device_put(jnp.arange(4096.0),
+                        NamedSharding(mesh, P("nodes")))
+    _compile(st, "tree_block", "gl703_reduce",
+             lambda: jax.jit(lambda a: jnp.sum(a),
+                             out_shardings=NamedSharding(mesh, P())), xs)
+    assert not [f for f in audit.ir_findings() if f.rule == "GL703"]
+
+
+# -- GL704: recompile churn --------------------------------------------------
+
+def test_gl704_recompile_churn(ir_audit, monkeypatch):
+    monkeypatch.setenv("H2O_TPU_AUDIT_CHURN", "4")
+    for n in range(6):
+        audit.note_compile("munge:churny", f"aval{n}")
+    for n in range(2):
+        audit.note_compile("munge:steady", f"aval{n}")
+    found = [f for f in audit.ir_findings() if f.rule == "GL704"]
+    assert len(found) == 1, [f.render() for f in found]
+    assert "churny" in found[0].detail
+    assert audit.compile_counts()["munge:churny"]["distinct_aval_keys"] == 6
+
+
+# -- GL801: witnessed lock-order inversion -----------------------------------
+
+def test_gl801_planted_inversion():
+    """Two threads take (memory, exec_store) locks in opposite orders —
+    sequentially, so no real deadlock, but the witnessed graph carries
+    the cycle and GL801 must report it with BOTH acquisition stacks."""
+    reg = lockwitness.WitnessRegistry()
+    mem = lockwitness.make_rlock("memory.MemoryManager._lock",
+                                 _registry=reg)
+    exe = lockwitness.make_rlock("exec_store.ExecStore._lock",
+                                 _registry=reg)
+
+    def forward():
+        with mem:
+            with exe:
+                pass
+
+    def backward():
+        with exe:
+            with mem:
+                pass
+
+    for target in (forward, backward):
+        t = threading.Thread(target=target)
+        t.start()
+        t.join()
+
+    found = audit.witness_findings(reg)
+    cycles = [f for f in found if f.rule == "GL801"]
+    assert len(cycles) == 1, [f.render() for f in found]
+    f = cycles[0]
+    assert f.detail == ("cycle:exec_store.ExecStore._lock"
+                        "<>memory.MemoryManager._lock")
+    # both witnessed edges, each with its captured stack
+    assert f.message.count("--- witnessed") == 2
+    assert "forward" in f.message and "backward" in f.message
+
+
+def test_gl801_consistent_order_is_clean():
+    reg = lockwitness.WitnessRegistry()
+    a = lockwitness.make_lock("a", _registry=reg)
+    b = lockwitness.make_lock("b", _registry=reg)
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert reg.find_cycles() == []
+    assert not audit.witness_findings(reg)
+    assert reg.name_edges() == {("a", "b"): 3}
+
+
+def test_gl801_same_name_instances_not_a_cycle():
+    """Many Job._state_lock INSTANCES share one name; job A's lock
+    inside job B's must not read as a self-cycle — the graph is keyed
+    on instances, names are only for display."""
+    reg = lockwitness.WitnessRegistry()
+    j1 = lockwitness.make_lock("job.Job._state_lock", _registry=reg)
+    j2 = lockwitness.make_lock("job.Job._state_lock", _registry=reg)
+    with j1:
+        with j2:
+            pass
+    with j2:
+        with j1:
+            pass
+    # instance-order inversion IS reported (it is a real cycle) but a
+    # single nesting of two same-named instances alone is not
+    reg2 = lockwitness.WitnessRegistry()
+    k1 = lockwitness.make_lock("job.Job._state_lock", _registry=reg2)
+    k2 = lockwitness.make_lock("job.Job._state_lock", _registry=reg2)
+    with k1:
+        with k2:
+            pass
+    assert reg2.find_cycles() == []
+    assert len(reg.find_cycles()) == 1
+
+
+# -- GL802: device dispatch while holding a witnessed lock -------------------
+
+def test_gl802_dispatch_under_lock():
+    reg = lockwitness.WitnessRegistry()
+    lk = lockwitness.make_lock("memory.MemoryManager._lock",
+                               _registry=reg)
+    with lk:
+        reg.note_device_dispatch("munge:frame_slice")
+    reg.note_device_dispatch("munge:frame_slice")  # lock-free: clean
+    found = audit.witness_findings(reg)
+    assert len(found) == 1, [f.render() for f in found]
+    f = found[0]
+    assert f.rule == "GL802"
+    assert f.detail == ("dispatch-under-lock:"
+                        "memory.MemoryManager._lock:munge:frame_slice")
+    assert "test_gl802_dispatch_under_lock" in f.message
+
+
+# -- witness mechanics -------------------------------------------------------
+
+def test_witness_rlock_reentry_records_no_edge():
+    reg = lockwitness.WitnessRegistry()
+    r = lockwitness.make_rlock("r", _registry=reg)
+    with r:
+        with r:  # re-entry: count bump, no self-edge
+            pass
+    assert reg.name_edges() == {}
+    assert reg.stats()["acquisitions"] == 2
+
+
+def test_witness_off_returns_plain_primitives(monkeypatch):
+    monkeypatch.setenv("H2O_TPU_LOCK_WITNESS", "0")
+    lk = lockwitness.make_lock("x")
+    rk = lockwitness.make_rlock("y")
+    assert not isinstance(lk, lockwitness._WitnessLock)
+    assert not isinstance(rk, lockwitness._WitnessLock)
+    with lk:
+        pass
+    with rk:
+        with rk:
+            pass
+
+
+def test_witness_acquire_release_api():
+    reg = lockwitness.WitnessRegistry()
+    lk = lockwitness.make_lock("api", _registry=reg)
+    assert lk.acquire()
+    assert lk.locked()
+    lk.release()
+    assert not lk.locked()
+    assert lk.acquire(blocking=False)
+    lk.release()
+
+
+# -- tiers, cross-check, payload ---------------------------------------------
+
+def test_tier_of():
+    assert audit.tier_of("GL701") == "ir"
+    assert audit.tier_of("GL801") == "runtime"
+    assert audit.tier_of("GL402") == "ast"
+
+
+def test_gl7xx_gl8xx_registered():
+    from h2o_tpu.lint import all_rules
+    ids = set(all_rules())
+    assert {"GL701", "GL702", "GL703", "GL704",
+            "GL801", "GL802"} <= ids
+
+
+def test_static_lock_edges_sees_package_nesting():
+    # GL402's static pairs feed the witnessed-vs-static cross-check;
+    # the real package has at least one syntactically nested pair
+    edges = audit.static_lock_edges()
+    assert isinstance(edges, list)
+
+
+def test_audit_payload_shape(ir_audit):
+    st = ExecStore()
+    x = jnp.arange(64.0)
+    _compile(st, "munge", "payload_site",
+             lambda: (lambda a: a + 1.0), x)
+    p = audit.audit_payload()
+    assert p["enabled"]["ir"] is True
+    assert p["events_recorded"] >= 1
+    assert set(p["findings"]) == {"ir", "runtime"}
+    lg = p["lock_graph"]
+    for k in ("witnessed_edges", "static_edges", "witnessed_only",
+              "static_only", "cycles", "held_dispatches", "stats"):
+        assert k in lg, k
+
+
+def test_audit_rest_route(ir_audit):
+    from h2o_tpu.api.handlers import audit_route
+    body = audit_route({})
+    assert "lock_graph" in body and "findings" in body
+    assert body["enabled"]["ir"] is True
+
+
+# -- satellite: graftlint module cache keyed on (mtime_ns, size) -------------
+
+def test_module_cache_invalidates_on_size_with_same_mtime(tmp_path):
+    """Same-second rewrite with a preserved mtime must still reparse —
+    the (st_mtime_ns, st_size) stamp catches what float mtime missed."""
+    from h2o_tpu.lint.core import load_module
+    p = tmp_path / "m.py"
+    p.write_text("x = 1\n")
+    st0 = os.stat(p)
+    mi1 = load_module(str(p), "m.py")
+    assert mi1 is not None and "x = 1" in mi1.source
+    p.write_text("x = 1  # grew\n")
+    os.utime(p, ns=(st0.st_atime_ns, st0.st_mtime_ns))  # freeze mtime
+    mi2 = load_module(str(p), "m.py")
+    assert "grew" in mi2.source, "stale AST served after same-mtime rewrite"
