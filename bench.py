@@ -723,6 +723,55 @@ def bench_lever_ab():
             "levers": levers, "stats": autotune.stats()}
 
 
+def bench_bins_pack(fr, rows, depth):
+    """Packed vs int32 binned-matrix A/B (ops/binpack.py, the
+    ``tree.bins_dtype`` lever): the binned matrix's HBM footprint under
+    each carrier, and the steady-state train-throughput delta with the
+    lever forced each way.  The acceptance bar is >= 2x byte reduction
+    at B <= 64 — the uint8 carrier gives 4x by construction; the
+    throughput ratio is the measured half the autotuner's margin gate
+    consumes on real silicon."""
+    import jax.numpy as jnp
+    from h2o_tpu.models.tree.gbm import GBM
+    from h2o_tpu.ops import binpack
+
+    trees = int(os.environ.get("BENCH_PACK_TREES", 5))
+    prev = os.environ.get("H2O_TPU_BINS_PACK")
+    walls, out = {}, {}
+    try:
+        for mode, flag in (("packed", "1"), ("int32", "0")):
+            os.environ["H2O_TPU_BINS_PACK"] = flag
+            m, wall, wall_c, sc = _timed_train(
+                lambda: GBM(ntrees=trees, max_depth=depth,
+                            learn_rate=0.1, seed=1, nbins=64,
+                            histogram_type="QuantilesGlobal"), fr)
+            walls[mode] = wall
+            out[mode] = {"rows_trees_per_s": round(rows * trees / wall,
+                                                   1),
+                         "wall_s": round(wall, 2),
+                         "steady_compiles": sc}
+        from h2o_tpu.models.tree import shared_tree as st
+        fine = st.model_fine_na(m.output)
+        C = len(m.output["x"])
+        itemsize = jnp.dtype(binpack.bins_dtype_for(fine)).itemsize
+        bytes_i32 = rows * C * 4
+        bytes_packed = rows * C * itemsize
+        out.update({
+            "packed_dtype": binpack.packed_dtype_name(fine, True),
+            "fine_nbins": fine,
+            "bins_bytes_int32": bytes_i32,
+            "bins_bytes_packed": bytes_packed,
+            "bytes_reduction": round(bytes_i32 / bytes_packed, 2)})
+    finally:
+        if prev is None:
+            os.environ.pop("H2O_TPU_BINS_PACK", None)
+        else:
+            os.environ["H2O_TPU_BINS_PACK"] = prev
+    out["value"] = round(walls["int32"] / walls["packed"], 4)
+    out["unit"] = "packed/int32 speedup (train steady-state)"
+    return out
+
+
 def bench_cpu_reference(X, y, rows, trees, depth):
     """External CPU baseline for the north-star ratio (VERDICT r3 item 3):
     the same GBM workload through a widely-accepted CPU hist
@@ -989,7 +1038,7 @@ def _main_ladder(detail):
         "BENCH_CONFIG",
         "gbm,gbm_ua,gbm_bf16,drf,glm,dl,hist,rapidsgb,scaleout,gbm10m,"
         "cpuref,cpuref10m,deep,coldstart,streamref,leverab,elastic,"
-        "auditovh"
+        "auditovh,binspack"
     ).split(",")
 
     detail.update({"rows": rows, "cols": cols})
@@ -1037,7 +1086,7 @@ def _main_ladder(detail):
                    if c in ("gbm", "cpuref", "drf", "glm", "hist",
                             "rapidsgb", "scaleout", "gbm10m",
                             "cpuref10m", "coldstart", "leverab",
-                            "elastic")]
+                            "elastic", "binspack")]
         detail["rows"] = rows
     detail["platform"] = platform
 
@@ -1068,7 +1117,8 @@ def _main_ladder(detail):
             ("streamref", bench_streaming_refresh),
             ("leverab", bench_lever_ab),
             ("elastic", bench_elastic_resume),
-            ("auditovh", bench_audit_overhead)]
+            ("auditovh", bench_audit_overhead),
+            ("binspack", lambda: bench_bins_pack(fr, rows, depth))]
     names = {"hist": "hist_kernel", "gbm10m": "gbm_10m",
              "cpuref": "cpu_reference", "deep": "drf_deep20",
              "gbm_ua": "gbm_uniform_adaptive", "gbm_bf16": "gbm_bf16",
@@ -1079,7 +1129,8 @@ def _main_ladder(detail):
              "streamref": "streaming_refresh",
              "leverab": "lever_ab",
              "elastic": "elastic_resume",
-             "auditovh": "audit_overhead"}
+             "auditovh": "audit_overhead",
+             "binspack": "bins_pack"}
     for cfg, fn in runs:
         if cfg not in configs:
             continue

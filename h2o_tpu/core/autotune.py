@@ -2,8 +2,9 @@
 
 The tree engine carries performance levers that are backend-sensitive:
 the fused Pallas histogram (H2O_TPU_HIST_PALLAS), the one-hot-matmul
-row router (H2O_TPU_MATMUL_ROUTE), and sibling subtraction
-(H2O_TPU_SIBLING_SUBTRACT).  Which side wins depends on the chip, the
+row router (H2O_TPU_MATMUL_ROUTE), sibling subtraction
+(H2O_TPU_SIBLING_SUBTRACT), and the packed binned-matrix dtype
+(H2O_TPU_BINS_PACK — ops/binpack.py).  Which side wins depends on the chip, the
 mesh, and the shape — a hand-run hardware A/B does not survive the
 next backend.  This module makes the selection automatic:
 
@@ -37,7 +38,7 @@ Escape hatches (all resolved ONLY here — lint-enforced):
                             ``auto`` probes on TPU only, so CPU tiers
                             stay bitwise-identical to the references)
   H2O_TPU_HIST_PALLAS / H2O_TPU_MATMUL_ROUTE / H2O_TPU_SIBLING_SUBTRACT
-                            tri-state: 1 forces the variant on, 0 off,
+  / H2O_TPU_BINS_PACK       tri-state: 1 forces the variant on, 0 off,
                             auto/unset defers to the measured decision.
   H2O_TPU_AUTOTUNE_REPS / _ROWS / _MARGIN
                             probe depth / probe row cap / flip margin.
@@ -646,6 +647,42 @@ def _sib_fp() -> str:
         je._hist_level_with_sibling, histogram_build_traced))
 
 
+def _pack_workload(bucket: Tuple) -> dict:
+    from h2o_tpu.ops import binpack
+    R, C, F = bucket                    # (rows, C, fine_nbins)
+    R = _probe_rows(R)
+    kb, kl, ks = jax.random.split(jax.random.PRNGKey(23), 3)
+    L = 32
+    # int32 reference matrix spanning the full alphabet [0, F] (F is
+    # the NA sentinel); the packed candidate is the SAME values in the
+    # narrow carrier — the decode contract says they must histogram
+    # bitwise-identically
+    bins32 = jax.random.randint(kb, (R, C), 0, F + 1, jnp.int32)
+    return {
+        "bins32": bins32,
+        "bins_packed": binpack.cast_bins(bins32,
+                                         binpack.bins_dtype_for(F)),
+        "leaf": jax.random.randint(kl, (R,), 0, L, jnp.int32),
+        "stats": jax.random.uniform(ks, (R, N_STATS), jnp.float32),
+        "F": F, "L": L,
+    }
+
+
+def _pack_run(v: str, w: dict):
+    bins = w["bins_packed"] if v == "packed" else w["bins32"]
+    return _hist_plain(bins, w["leaf"], w["stats"], n_leaves=w["L"],
+                       nbins=w["F"], pallas=False)
+
+
+def _pack_fp() -> str:
+    from h2o_tpu.models.tree import shared_tree as st
+    from h2o_tpu.ops import binpack as bp
+    from h2o_tpu.ops import histogram as hg
+    return ",".join(code_fingerprint(f) for f in (
+        bp.bins_dtype_for, bp.cast_bins, bp.widen_bins,
+        hg._block_hist, hg.histogram_build_traced, st._bin_all))
+
+
 register_lever(Lever(
     site="hist.kernel",
     env_var="H2O_TPU_HIST_PALLAS",
@@ -689,4 +726,19 @@ register_lever(Lever(
     run_variant=_sib_run,
     fingerprint=_sib_fp,
     tol=(1e-3, 1e-2),                           # f32 reorder only
+))
+
+register_lever(Lever(
+    site="tree.bins_dtype",
+    env_var="H2O_TPU_BINS_PACK",
+    variants=("int32", "packed"),
+    true_variants=frozenset({"packed"}),
+    default_bucket=(1 << 16, 32, 64),           # (rows, C, fine_nbins)
+    make_workload=_pack_workload,
+    run_variant=_pack_run,
+    fingerprint=_pack_fp,
+    # the decode contract (ops/binpack.py) promises identical INTEGER
+    # bin values under both carriers, so the histograms — and therefore
+    # whole forests — must match bitwise, not approximately
+    tol=(0.0, 0.0),
 ))

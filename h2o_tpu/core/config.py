@@ -81,9 +81,15 @@ owning modules, like the chaos flags, so they work before a cloud boots):
   never flips a lever).  The per-lever knobs are TRI-STATE —
   ``H2O_TPU_HIST_PALLAS`` (hist.kernel: fused Pallas histogram vs the
   one-hot-matmul XLA reference), ``H2O_TPU_MATMUL_ROUTE``
-  (tree.matmul_route: one-hot-matmul row routing vs gather) and
+  (tree.matmul_route: one-hot-matmul row routing vs gather),
   ``H2O_TPU_SIBLING_SUBTRACT`` (tree.sibling_subtract: left-child
-  histogram + parent-minus-left vs full rebuild) each accept ``1``
+  histogram + parent-minus-left vs full rebuild) and
+  ``H2O_TPU_BINS_PACK`` (tree.bins_dtype: the binned feature matrix
+  carried at the narrowest dtype its fine bin count permits — uint8
+  iff the NA sentinel F <= 255, int16 iff F <= 32767 — vs the int32
+  reference; ops/binpack.py owns the decode contract, kernels widen
+  in-register per tile, and the parity gate is BITWISE, tol (0, 0),
+  since packing must not change a single forest bit) each accept ``1``
   (force on, no probe), ``0`` (force off, no probe) or unset/``auto``
   (defer to the autotuner's parity-gated, persisted decision).  A
   candidate that fails the parity gate against its reference output is

@@ -265,8 +265,8 @@ def _load_passes() -> None:
     """Import every rules module exactly once (registration side
     effect)."""
     from h2o_tpu.lint import (audit, rules_donation,  # noqa: F401
-                              rules_legacy, rules_locks, rules_persist,
-                              rules_purity, rules_shard)
+                              rules_legacy, rules_locks, rules_pack,
+                              rules_persist, rules_purity, rules_shard)
 
 
 _last_summary: Optional[dict] = None

@@ -1,0 +1,110 @@
+"""GL630 — packed-bin dtype discipline.
+
+ops/binpack.py is the ONE sanctioned place that chooses the binned
+matrix's carrier dtype (uint8/int16/int32 by fine bin count) and the
+one place allowed to widen it back.  A stray ``bins.astype(jnp.int32)``
+anywhere else silently materializes the full-width copy in HBM that
+packing exists to prevent — the 2-4x traffic win evaporates with no
+error, no parity break, nothing a test would catch.  This rule bans
+explicit int32 re-widening of any value whose name says it is a bin
+matrix (``bins``, ``bins_blk``, ``binned_x``, ...) outside the packing
+layer; kernels that need int32 arithmetic on a tile call
+``ops.binpack.widen_bins`` (a fusing in-register convert) instead.
+
+Scope is deliberately name-based and receiver-narrow (plain names and
+attribute chains only, never call results): ``jnp.sum(...).astype(
+jnp.int32)`` reductions over bins are new int32 values, not re-widened
+matrices, and stay legal.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from h2o_tpu.lint import classify
+from h2o_tpu.lint.core import Finding, ModuleInfo, rule
+
+#: modules allowed to convert bin carriers: the packing layer itself,
+#: and the native C-ABI boundary (host-side ``ascontiguousarray`` into
+#: the fixed int32 treeshap ABI — host numpy, never an HBM copy)
+_SANCTIONED = {"ops/binpack.py", "native/__init__.py"}
+
+_BIN_TOKENS = {"bin", "bins", "binned"}
+
+_NUMPY_ROOTS = ("jnp", "np", "numpy", "jax", "lax")
+
+
+def _terminal_name(node) -> Optional[str]:
+    """The receiver's last identifier for plain names / attr chains;
+    None for call results, subscripts, literals — those are new values,
+    not the bin matrix itself."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _names_a_bin(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    return any(t in _BIN_TOKENS for t in name.lower().split("_"))
+
+
+def _is_int32_dtype(node) -> bool:
+    if isinstance(node, ast.Constant):
+        return node.value == "int32"
+    chain = classify._attr_chain(node)
+    return (len(chain) >= 2 and chain[0] in _NUMPY_ROOTS
+            and chain[-1] == "int32")
+
+
+@rule("GL630", "packed-bin-rewiden")
+def check_bin_rewiden(mi: ModuleInfo, ctx):
+    """int32 widening of a bin-named value outside ops/binpack.py."""
+    if mi.rel in _SANCTIONED:
+        return []
+    out: List[Finding] = []
+
+    def flag(node, receiver: str, form: str):
+        out.append(Finding(
+            "GL630", "error", mi.rel, node.lineno, mi.scope_of(node),
+            f"{form} re-widens the packed binned matrix {receiver!r} to "
+            f"int32 outside the sanctioned packing layer — this "
+            f"materializes the full-width HBM copy packing exists to "
+            f"prevent; use ops.binpack.widen_bins for in-register tile "
+            f"arithmetic, or keep the packed carrier",
+            detail=f"rewiden:{mi.scope_of(node)}:{receiver}"))
+
+    for node in ast.walk(mi.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # form 1: <bins>.astype(jnp.int32)
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "astype" and node.args and \
+                _is_int32_dtype(node.args[0]):
+            recv = _terminal_name(node.func.value)
+            if _names_a_bin(recv):
+                flag(node, recv, ".astype(int32)")
+            continue
+        chain = classify._attr_chain(node.func)
+        if not chain or chain[0] not in _NUMPY_ROOTS:
+            continue
+        # form 2: jnp.asarray/array(<bins>, jnp.int32)
+        if chain[-1] in ("asarray", "array", "ascontiguousarray"):
+            dt = classify._kw(node, "dtype")
+            if dt is None and len(node.args) > 1:
+                dt = node.args[1]
+            if dt is not None and _is_int32_dtype(dt) and node.args:
+                recv = _terminal_name(node.args[0])
+                if _names_a_bin(recv):
+                    flag(node, recv, f"{chain[-1]}(..., int32)")
+            continue
+        # form 3: lax.convert_element_type(<bins>, jnp.int32)
+        if chain[-1] == "convert_element_type" and len(node.args) > 1 \
+                and _is_int32_dtype(node.args[1]):
+            recv = _terminal_name(node.args[0])
+            if _names_a_bin(recv):
+                flag(node, recv, "convert_element_type(..., int32)")
+    return out

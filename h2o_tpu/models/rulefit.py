@@ -93,8 +93,8 @@ class RuleFitModel(Model):
         """Rule + linear feature frame for the inner GLM."""
         out = self.output
         m = frame.as_matrix(out["x"])
-        bins = st._bin_all(m, jnp.asarray(out["split_points"]),
-                           jnp.asarray(out["is_cat"]), int(out["nbins"]))
+        bins = st.bin_matrix(m, jnp.asarray(out["split_points"]),
+                             out["is_cat"], int(out["nbins"]))
         cols: List[Vec] = []
         names: List[str] = []
         for fi, f in enumerate(out["forests"]):

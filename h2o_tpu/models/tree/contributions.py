@@ -37,8 +37,8 @@ from h2o_tpu.models.tree import shared_tree as st
 def _binned(model, frame: Frame) -> np.ndarray:
     out = model.output
     m = frame.as_matrix(out["x"])
-    return np.asarray(st._bin_all(
-        m, jnp.asarray(out["split_points"]), jnp.asarray(out["is_cat"]),
+    return np.asarray(st.bin_matrix(
+        m, jnp.asarray(out["split_points"]), out["is_cat"],
         st.model_fine_na(out)))
 
 

@@ -47,9 +47,8 @@ class DRFModel(Model):
         output['x'] order, no Frame/DKV."""
         out = self.output
         m = jnp.asarray(X, jnp.float32)
-        bins = st._bin_all(m, jnp.asarray(out["split_points"]),
-                           jnp.asarray(out["is_cat"]),
-                           st.model_fine_na(out))
+        bins = st.bin_matrix(m, jnp.asarray(out["split_points"]),
+                             out["is_cat"], st.model_fine_na(out))
         F = st.forest_score_out(bins, out)
         return raw_from_votes(F, int(out["ntrees_actual"]),
                               out.get("response_domain"),
@@ -102,8 +101,8 @@ class DRF(ModelBuilder):
             ck_fine = int(co.get("fine_nbins") or co["nbins"])
             sp_dev = jnp.asarray(co["split_points"])
             binned = st.BinnedData(
-                st._bin_all(train.as_matrix(di.x), sp_dev,
-                            jnp.asarray(co["is_cat"]), ck_fine),
+                st.bin_matrix(train.as_matrix(di.x), sp_dev,
+                              co["is_cat"], ck_fine),
                 np.asarray(co["split_points"]), sp_dev,
                 np.asarray(co["is_cat"]), int(co["nbins"]), ck_fine,
                 hist_type)
@@ -214,9 +213,9 @@ class DRF(ModelBuilder):
             float(p.get("max_runtime_secs") or 0) > 0
         if want_scoring:
             score_frame = valid if valid is not None else train
-            bins_sc = bins if valid is None else st._bin_all(
+            bins_sc = bins if valid is None else st.bin_matrix(
                 valid.as_matrix(di.x), binned.split_points_dev,
-                jnp.asarray(binned.is_cat), binned.fine)
+                binned.is_cat, binned.fine)
             F_sc = jnp.zeros((bins_sc.shape[0], K), jnp.float32)
             if prior:
                 F_sc = F_sc + st.forest_score_out(bins_sc, co, depth)

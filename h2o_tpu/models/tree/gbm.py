@@ -54,9 +54,8 @@ class GBMModel(Model):
         """(rows, C) raw-code matrix -> link-scale forest sum (shared by
         the Frame path and the online array fast path)."""
         out = self.output
-        bins = st._bin_all(m, jnp.asarray(out["split_points"]),
-                           jnp.asarray(out["is_cat"]),
-                           st.model_fine_na(out))
+        bins = st.bin_matrix(m, jnp.asarray(out["split_points"]),
+                             out["is_cat"], st.model_fine_na(out))
         return st.forest_score_out(bins, out) + \
             jnp.asarray(out["f0"])[None, :]
 
@@ -170,8 +169,8 @@ class GBM(ModelBuilder):
             ck_fine = int(co.get("fine_nbins") or co["nbins"])
             sp_dev = jnp.asarray(co["split_points"])
             binned = st.BinnedData(
-                st._bin_all(train.as_matrix(di.x), sp_dev,
-                            jnp.asarray(co["is_cat"]), ck_fine),
+                st.bin_matrix(train.as_matrix(di.x), sp_dev,
+                              co["is_cat"], ck_fine),
                 np.asarray(co["split_points"]), sp_dev,
                 np.asarray(co["is_cat"]), int(co["nbins"]), ck_fine,
                 hist_type)
@@ -342,9 +341,9 @@ class GBM(ModelBuilder):
             float(p.get("max_runtime_secs") or 0) > 0
         if want_scoring:
             score_frame = valid if valid is not None else train
-            bins_sc = bins if valid is None else st._bin_all(
+            bins_sc = bins if valid is None else st.bin_matrix(
                 valid.as_matrix(di.x), binned.split_points_dev,
-                jnp.asarray(binned.is_cat), binned.fine)
+                binned.is_cat, binned.fine)
             F_sc = jnp.broadcast_to(
                 f0[None, :], (bins_sc.shape[0], K)).astype(jnp.float32)
             off_col = p.get("offset_column")

@@ -40,13 +40,19 @@ import numpy as np
 from h2o_tpu.core.cloud import cloud
 from h2o_tpu.core.frame import Frame
 from h2o_tpu.models.model import DataInfo, Model, ModelBuilder
+from h2o_tpu.ops.binpack import (bins_bucket, bins_pack_enabled, cast_bins,
+                                 packed_dtype_name)
 from h2o_tpu.ops.histogram import histogram_build
 
 EPS = 1e-10
 
 
 class BinnedData(NamedTuple):
-    bins: jax.Array          # (R, C) int32 in [0, F]; F = NA bucket
+    # (R, C) packed int in [0, F]; F = NA bucket.  Dtype is the
+    # narrowest the fine bin count permits under the tree.bins_dtype
+    # lever (ops/binpack.py decode contract: same integers, narrower
+    # carrier), int32 when the lever resolves to the reference.
+    bins: jax.Array
     split_points: np.ndarray  # (C, F-1) f32 host copy (model artifact)
     split_points_dev: jax.Array
     is_cat: np.ndarray       # (C,) bool
@@ -107,7 +113,8 @@ def prepare_bins(di: DataInfo, nbins: int, nbins_cats: int,
     per-node DHistogram ranges (nbins_top_level halving schedule).
 
     Categorical columns always bin by level code; F >= B so codes and
-    the NA sentinel (F) coexist in one int32 matrix.
+    the NA sentinel (F) coexist in one packed matrix (uint8/int16/int32
+    by F under the ``tree.bins_dtype`` lever — ops/binpack.py).
     """
     fr, xs = di.frame, di.x
     C = len(xs)
@@ -139,33 +146,55 @@ def prepare_bins(di: DataInfo, nbins: int, nbins_cats: int,
             qs = np.unique(sp_raw[j][~np.isnan(sp_raw[j])])
             sp[j, : len(qs)] = qs
     sp_dev = jax.device_put(jnp.asarray(sp), cloud().replicated)
-    bins = _bin_all(m, sp_dev, jnp.asarray(is_cat), F)
+    bins = bin_matrix(m, sp_dev, is_cat, F)
     return BinnedData(bins, sp, sp_dev, is_cat, B, F, histogram_type)
 
 
-@functools.partial(jax.jit, static_argnames=("nbins",))
-def _bin_all(matrix, split_points, is_cat, nbins: int):
+def bin_matrix(matrix, split_points_dev, is_cat, fine_nbins: int):
+    """Bin raw values AND pack to the narrowest dtype the fine bin
+    count permits — the one binning entry every trainer and scorer
+    shares.  The ``tree.bins_dtype`` lever is resolved HERE, outside
+    the jit trace (the packed dtype is part of every downstream
+    executable's aval signature, so a lever flip selects a different
+    executable instead of silently hitting a stale one).  Scoring a
+    model under a different lever state than it trained with is safe:
+    packed and int32 matrices hold identical integers (ops/binpack.py
+    decode contract), so descent and histograms agree bitwise."""
+    packed = bins_pack_enabled(
+        bins_bucket(matrix.shape[0], matrix.shape[1], fine_nbins))
+    return _bin_all(matrix, split_points_dev, jnp.asarray(is_cat),
+                    fine_nbins,
+                    out_dtype=packed_dtype_name(fine_nbins, packed))
+
+
+@functools.partial(jax.jit, static_argnames=("nbins", "out_dtype"))
+def _bin_all(matrix, split_points, is_cat, nbins: int,
+             out_dtype: str = "int32"):
     """Raw values -> bin indices in [0, nbins]; nbins = NA bucket.
 
     Wide fine grids (UniformAdaptive's 1024 thresholds) use a per-column
     searchsorted instead of the (R, C, F-1) one-hot compare — log(F)
-    work per value and no quadratic-ish temporary."""
+    work per value and no quadratic-ish temporary.
+
+    ``out_dtype`` is the PACKING boundary: intermediates are int32
+    (register-level, fused), the returned matrix is the narrow carrier.
+    This function plus ops/binpack.py form the sanctioned packing layer
+    (graftlint GL630 bans bin-matrix int32 widening everywhere else)."""
     if split_points.shape[1] > 63:
         t_sorted = split_points                  # NaN tails sort last
         num_bins = jax.vmap(
             lambda t, v: jnp.searchsorted(t, v, side="right"),
             in_axes=(0, 1), out_axes=1)(t_sorted, matrix)
         nan_counts = jnp.sum(jnp.isnan(split_points), axis=1)[None, :]
-        num_bins = jnp.minimum(
-            num_bins, split_points.shape[1] - nan_counts).astype(jnp.int32)
+        num_bins = jnp.minimum(num_bins,
+                               split_points.shape[1] - nan_counts)
     else:
         v = matrix[:, :, None]
         t = split_points[None, :, :]
-        num_bins = jnp.sum((v >= t) & ~jnp.isnan(t),
-                           axis=2).astype(jnp.int32)
+        num_bins = jnp.sum((v >= t) & ~jnp.isnan(t), axis=2)
     cat_bins = jnp.clip(matrix, 0, nbins - 1).astype(jnp.int32)
     b = jnp.where(is_cat[None, :], cat_bins, num_bins)
-    return jnp.where(jnp.isnan(matrix), nbins, b)
+    return cast_bins(jnp.where(jnp.isnan(matrix), nbins, b), out_dtype)
 
 
 @jax.jit

@@ -232,8 +232,8 @@ class UpliftDRFModel(Model):
     def predict_raw(self, frame: Frame):
         out = self.output
         m = frame.as_matrix(out["x"])
-        bins = st._bin_all(m, jnp.asarray(out["split_points"]),
-                           jnp.asarray(out["is_cat"]), int(out["nbins"]))
+        bins = st.bin_matrix(m, jnp.asarray(out["split_points"]),
+                             out["is_cat"], int(out["nbins"]))
         D = int(out["max_depth"])
         T = max(int(out["ntrees_actual"]), 1)
         sc = jnp.asarray(out["split_col"])[:, None]

@@ -232,10 +232,10 @@ class XGBoost(GBM):
                 na = m.output.get("na_left")
                 if base_out is None:
                     base_out = m.output
-                    bins = st._bin_all(
+                    bins = st.bin_matrix(
                         train.as_matrix(m.output["x"]),
                         jnp.asarray(m.output["split_points"]),
-                        jnp.asarray(m.output["is_cat"]),
+                        m.output["is_cat"],
                         st.model_fine_na(m.output))
                 Fnew = np.asarray(st.forest_score(
                     bins, jnp.asarray(sc), jnp.asarray(bs),

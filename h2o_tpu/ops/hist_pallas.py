@@ -36,6 +36,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from h2o_tpu.ops.binpack import widen_bins
+
 # VMEM budget for the one-hot tile alone (used to size column groups in
 # the adaptive kernel); 4 MiB leaves room for the other buffers in a
 # 16 MiB VMEM.
@@ -51,16 +53,23 @@ _ONEHOT_BYTES = 4 * 2 ** 20
 _VMEM_WORKSET_BYTES = 12 * 2 ** 20
 
 
-def plan_tile_rows(C: int, B1: int, L: int, S: int, mm_dtype):
+def plan_tile_rows(C: int, B1: int, L: int, S: int, mm_dtype,
+                   bins_itemsize: int = 4):
     """Row-tile height (512-multiple, capped at 4096) whose combined
     working set fits ``_VMEM_WORKSET_BYTES``, or None when even the
     512-row minimum tile cannot — the caller must reject the fused
-    kernel and stay on the portable XLA path."""
+    kernel and stay on the portable XLA path.
+
+    ``bins_itemsize`` is the PACKED bins dtype's width (ops/binpack.py):
+    a uint8 matrix costs the tile a quarter of the int32 cost, so
+    packed callers plan TALLER tiles from the same budget — the
+    narrower working set is the point of packing."""
     itemsize = jnp.dtype(mm_dtype).itemsize
     acc = C * B1 * L * S * 4                       # f32 accumulator block
     per_row = ((C * B1 + L * S) * itemsize        # one-hot + A temporary
                + L * 4                            # leaf-hot
-               + (C + S + 1) * 4)                 # bins/stats/leaf tiles
+               + C * bins_itemsize                # packed bins tile
+               + (S + 1) * 4)                     # stats/leaf tiles
     avail = _VMEM_WORKSET_BYTES - acc
     if avail < per_row * 512:
         return None
@@ -69,9 +78,11 @@ def plan_tile_rows(C: int, B1: int, L: int, S: int, mm_dtype):
 
 def min_tile_fits(C: int, B1: int, L: int = 1, S: int = 4) -> bool:
     """True when the minimum (512-row) tile's combined working set fits
-    the VMEM budget at the widest (f32) dtype — eligibility gate for
-    wide-feature AND wide-frontier shapes (ops/histogram.py falls back
-    to the XLA path otherwise)."""
+    the VMEM budget at the widest (f32 matmul, int32 bins) dtypes —
+    eligibility gate for wide-feature AND wide-frontier shapes
+    (ops/histogram.py falls back to the XLA path otherwise).  Packed
+    bins only shrink the working set, so worst-case eligibility here
+    stays valid for every packed dtype."""
     return plan_tile_rows(C, B1, L, S, jnp.float32) is not None
 
 
@@ -83,9 +94,10 @@ class VMEMGateError(ValueError):
     the portable XLA path instead of failing the training job."""
 
 
-def _tile_rows(C: int, B1: int, L: int, S: int, mm_dtype) -> int:
+def _tile_rows(C: int, B1: int, L: int, S: int, mm_dtype,
+               bins_itemsize: int = 4) -> int:
     """Working-set-bounded tile height; asserts eligibility was gated."""
-    t = plan_tile_rows(C, B1, L, S, mm_dtype)
+    t = plan_tile_rows(C, B1, L, S, mm_dtype, bins_itemsize)
     if t is None:
         raise VMEMGateError(
             f"hist_pallas working set exceeds VMEM at the minimum tile "
@@ -113,7 +125,9 @@ def _hist_kernel(bins_ref, leaf_ref, stats_ref, out_ref, *,
     # NaN payloads; 0 * NaN would poison the accumulator)
     stats = jnp.where(leaf[:, None] >= 0, stats_ref[:], 0.0)
     a = (leafhot[:, :, None] * stats[:, None, :]).reshape(TR, L * S)
-    binhot = (bins_ref[:][:, :, None] ==
+    # in-tile widen of the packed bins tile (ops/binpack.py): the
+    # compare needs int32 operands, the widened values never leave VMEM
+    binhot = (widen_bins(bins_ref[:])[:, :, None] ==
               lax.broadcasted_iota(jnp.int32, (TR, C, B1), 2)
               ).reshape(TR, C * B1)
     out_ref[:] += lax.dot_general(
@@ -130,8 +144,9 @@ def _adaptive_kernel(bins_ref, leaf_ref, stats_ref, lo_ref, hi_ref,
     one-hot build.  Grid is (col_groups, row_tiles): each column group
     owns its own output rows and sweeps all row tiles, accumulating.
 
-    Per-leaf range picks (lo/hi/off)[leaf] ride a one-hot f32 matmul —
-    single nonzero per row, ints < 2**24, exact."""
+    Per-leaf range picks (lo/hi/off)[leaf] ride a one-hot INTEGER
+    matmul — single nonzero per row, exact in int32 with no f32
+    round-trip or widened temporary."""
     B1 = nbins + 1
     TR, Cg = bins_ref.shape
     L = n_leaves
@@ -143,19 +158,22 @@ def _adaptive_kernel(bins_ref, leaf_ref, stats_ref, lo_ref, hi_ref,
     leaf = leaf_ref[:, 0]
     leafhot = (leaf[:, None] ==
                lax.broadcasted_iota(jnp.int32, (TR, L), 1))
-    lh = leafhot.astype(jnp.float32)
+    lh_i = leafhot.astype(jnp.int32)
 
     def pick(tbl_ref):                            # (L, Cg) -> (TR, Cg)
-        # HIGHEST precision: fine-bin ints reach nbins_top_level (1024),
-        # beyond bf16's exact-int range — the pick must not truncate
+        # one-hot x int32 table is exact in int32: accumulate in the
+        # target dtype via preferred_element_type instead of the old
+        # f32-HIGHEST dot + trailing .astype(jnp.int32), which round-
+        # tripped every pick through a wider f32 temporary
         return lax.dot_general(
-            lh, tbl_ref[:].astype(jnp.float32),
+            lh_i, tbl_ref[:],
             dimension_numbers=(((1,), (0,)), ((), ())),
-            precision=lax.Precision.HIGHEST,
-            preferred_element_type=jnp.float32).astype(jnp.int32)
+            preferred_element_type=jnp.int32)
 
     lo_b, hi_b, o_b = pick(lo_ref), pick(hi_ref), pick(off_ref)
-    bins_blk = bins_ref[:]
+    # in-tile widen of the packed bins tile (ops/binpack.py): bucket
+    # arithmetic below reaches x * nbins — int32 range, VMEM-local
+    bins_blk = widen_bins(bins_ref[:])
     span = jnp.maximum(hi_b - lo_b + 1, 1)
     x = jnp.clip(bins_blk - lo_b, 0, span - 1)
     nb = jnp.clip((x * nbins + o_b) // span, 0, nbins - 1)
@@ -201,12 +219,12 @@ def hist_pallas_adaptive(bins, leaf, stats, lo, hi, off, is_cat,
                     _ONEHOT_BYTES // max(B1 * n_leaves * S * 4, 1)))
     # shrink the group until the COMBINED working set (incl. the
     # (TR, L*S) A temporary, unbounded in the old gate) admits a tile
-    while Cg > 1 and plan_tile_rows(Cg, B1, n_leaves, S,
-                                    mm_dtype) is None:
+    while Cg > 1 and plan_tile_rows(Cg, B1, n_leaves, S, mm_dtype,
+                                    bins.dtype.itemsize) is None:
         Cg = max(1, Cg // 2)
     ncg = -(-C // Cg)
     cpad = ncg * Cg - C
-    TR = _tile_rows(Cg, B1, n_leaves, S, mm_dtype)
+    TR = _tile_rows(Cg, B1, n_leaves, S, mm_dtype, bins.dtype.itemsize)
     pad = (-R) % TR
     if cpad:
         # padded columns carry the fine_na sentinel, so every row maps
@@ -270,7 +288,7 @@ def hist_pallas(bins, leaf, stats, n_leaves: int, nbins: int,
     S = stats.shape[1]
     B1 = nbins + 1
     mm_dtype = jnp.bfloat16 if bf16 else jnp.float32
-    TR = _tile_rows(C, B1, n_leaves, S, mm_dtype)
+    TR = _tile_rows(C, B1, n_leaves, S, mm_dtype, bins.dtype.itemsize)
     pad = (-R) % TR
     if pad:
         bins = jnp.pad(bins, ((0, pad), (0, 0)))

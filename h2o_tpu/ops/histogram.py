@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from h2o_tpu.core.cloud import DATA_AXIS, cloud, shard_map_compat
+from h2o_tpu.ops.binpack import widen_bins
 
 # stats slots
 W, WG, WGG, WH = 0, 1, 2, 3
@@ -103,7 +104,10 @@ def _block_hist(bins_blk, leaf_blk, stats_blk, n_leaves: int, nbins: int,
                 mm_dtype=jnp.float32):
     """One row block's histogram: (C*(B+1), L*S).
 
-    bins_blk:  (R, C) int32 in [0, B] (B = NA bucket)
+    bins_blk:  (R, C) packed int (uint8/int16/int32) in [0, B] (B = NA
+               bucket) — the one-hot compare below promotes against the
+               int32 iota in-register, so packed bins feed the MXU with
+               no widened copy of the block
     leaf_blk:  (R,)  int32 in [0, L); negative = row inactive this pass
     stats_blk: (R, S) f32
     mm_dtype:  matmul input dtype; bf16 doubles MXU throughput at the cost
@@ -140,6 +144,11 @@ def map_buckets(bins_blk, leaf_blk, lo, hi, off, is_cat, nbins: int,
     Categorical columns pass their level code through; NA (fine_na) maps
     to bucket B.
     """
+    # sanctioned block-local widen (ops/binpack.py): the bucket
+    # arithmetic below needs int32 range (x * nbins reaches F * B); the
+    # convert fuses into this block's ops — no packed->int32 copy of
+    # the matrix ever lands in HBM
+    bins_blk = widen_bins(bins_blk)
     lf = jnp.maximum(leaf_blk, 0)
     lo_b = lo[lf]                                # (R, C)
     hi_b = hi[lf]
@@ -158,7 +167,8 @@ def histogram_build_traced(bins, leaf, stats, n_leaves: int, nbins: int,
     device.  Nestable inside outer jit/scan programs (the fused tree engine
     calls this inside its per-tree scan body).
 
-    bins:  (padded_rows, C) int32, row-sharded — pre-binned features
+    bins:  (padded_rows, C) packed int (uint8/int16/int32), row-sharded
+           — pre-binned features at the dtype the bin count permits
     leaf:  (padded_rows,)  int32, row-sharded — leaf assignment, <0 inactive
     stats: (padded_rows, S) f32, row-sharded — (w, wg, wgg, wh)
     fine_map: None for direct (global-grid) binning, else

@@ -36,8 +36,8 @@ def _tune_env(monkeypatch, cl):
     (probe speed), counters zeroed before AND after."""
     for v in ("H2O_TPU_AUTOTUNE", "H2O_TPU_HIST_PALLAS",
               "H2O_TPU_MATMUL_ROUTE", "H2O_TPU_SIBLING_SUBTRACT",
-              "H2O_TPU_EXEC_STORE_DIR", "H2O_TPU_AUTOTUNE_ROWS",
-              "H2O_TPU_AUTOTUNE_MARGIN"):
+              "H2O_TPU_BINS_PACK", "H2O_TPU_EXEC_STORE_DIR",
+              "H2O_TPU_AUTOTUNE_ROWS", "H2O_TPU_AUTOTUNE_MARGIN"):
         monkeypatch.delenv(v, raising=False)
     monkeypatch.setenv("H2O_TPU_AUTOTUNE_REPS", "1")
     at.reset()
@@ -76,6 +76,7 @@ def test_cpu_auto_resolves_references_with_zero_probes():
     assert at.resolve_flag("hist.kernel") is False
     assert at.resolve_flag("tree.matmul_route") is False
     assert at.resolve_flag("tree.sibling_subtract") is True
+    assert at.resolve_flag("tree.bins_dtype") is False
     s = at.stats()
     assert s["probes"] == 0 and s["probe_runs"] == 0, s
 

@@ -467,6 +467,21 @@ CASES = [
         def resolve_flag(lever, bucket=None):
             return _env_value("H2O_TPU_AUTOTUNE") == "1"
      """, {}),
+    ("GL630", "ops/fx.py", """
+        import jax.numpy as jnp
+
+        def kernel(bins, leaf):
+            wide = bins.astype(jnp.int32)
+            return wide[leaf]
+     """, """
+        import jax.numpy as jnp
+        from h2o_tpu.ops.binpack import widen_bins
+
+        def kernel(bins, leaf):
+            wide = widen_bins(bins)
+            counts = jnp.sum(bins == 0, axis=0).astype(jnp.int32)
+            return wide[leaf], counts
+     """, {}),
 ]
 
 IDS = [c[0] for c in CASES]

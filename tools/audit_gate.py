@@ -66,6 +66,23 @@ def _workload() -> None:
                         out_shardings=NamedSharding(mesh, P())),
         (xs,), site="tree_block:gate_reduce")
 
+    # a real packed-bins train: the tree executables the GL7xx tier
+    # audits must include the uint8-carrier path, so a stray int32
+    # materialization of the binned matrix (GL702's HBM-copy check)
+    # shows up here, not on silicon
+    os.environ["H2O_TPU_BINS_PACK"] = "1"
+    from h2o_tpu.core.frame import Frame, Vec
+    from h2o_tpu.models.tree.gbm import GBM
+
+    rng = np.random.default_rng(11)
+    R = 1024
+    fr = Frame(["x0", "x1", "y"],
+               [Vec(rng.normal(size=R).astype(np.float32)),
+                Vec(rng.normal(size=R).astype(np.float32)),
+                Vec(rng.normal(size=R).astype(np.float32))])
+    GBM(ntrees=2, max_depth=3, seed=3, nbins=64).train(
+        y="y", training_frame=fr)
+
     from h2o_tpu.core.job import Job
     from h2o_tpu.core.memory import manager
     from h2o_tpu.core.store import DKV
