@@ -772,6 +772,74 @@ def bench_bins_pack(fr, rows, depth):
     return out
 
 
+def bench_ingest_bigger_than_hbm(rows, cols, depth):
+    """Train on a frame BIGGER than the configured HBM budget — the
+    tiered-column-store rung (core/landing.py + core/memory.py):
+    shard-direct ingest (no whole-frame single-host transfer), then a
+    streamed-bins GBM whose windows page through HBM <-> host under
+    ``H2O_TPU_MEM_BUDGET``.  Reports ingest rows/s (headline), the
+    steady-state train throughput, peak HBM bytes vs the budget, the
+    prefetcher's hit rate / demand-page stalls and the landing layer's
+    pull accounting (largest single host->device transfer).  Rows
+    arrive pre-capped by the CPU-fallback ladder."""
+    from h2o_tpu.core import landing
+    from h2o_tpu.core.memory import manager, set_budget
+    from h2o_tpu.models.tree.gbm import GBM
+
+    trees = int(os.environ.get("BENCH_TIER_TREES", 5))
+    frame_bytes = rows * (cols + 1) * 4
+    # bounded budget: a third of the frame, unless the operator pinned
+    # one — either way the auto stream gate must trip
+    budget = int(os.environ.get("H2O_TPU_MEM_BUDGET", 0) or
+                 frame_bytes // 3)
+    prev_budget = manager().budget
+    prev_stream = os.environ.get("H2O_TPU_TIER_STREAM")
+    os.environ["H2O_TPU_TIER_STREAM"] = "auto"
+    X, y = _make_data(rows, cols, seed=3)
+    m = set_budget(budget)
+    out = {"budget_bytes": budget, "frame_bytes": frame_bytes,
+           "rows": rows}
+    try:
+        s0 = m.stats()
+        landing.reset_stats()
+        t0 = time.time()
+        fr = _frame(X, y)
+        ingest_wall = time.time() - t0
+        model, wall, _wc, sc = _timed_train(
+            lambda: GBM(ntrees=trees, max_depth=depth, learn_rate=0.1,
+                        seed=1, nbins=32,
+                        histogram_type="UniformAdaptive"), fr)
+        s1 = m.stats()
+        land = landing.stats()
+        hits = s1["prefetch_hits"] - s0["prefetch_hits"]
+        misses = s1["prefetch_misses"] - s0["prefetch_misses"]
+        out.update({
+            "ingest_rows_per_s": round(rows / max(ingest_wall, 1e-9), 1),
+            "train_rows_trees_per_s": round(rows * trees / wall, 1),
+            "train_wall_s": round(wall, 2),
+            "steady_compiles": sc,
+            "peak_hbm_bytes": s1["peak_hbm_bytes"],
+            "pages_in": s1["pages_in"] - s0["pages_in"],
+            "pages_out": s1["pages_out"] - s0["pages_out"],
+            "prefetch_hits": hits, "prefetch_misses": misses,
+            "prefetch_hit_rate": round(hits / (hits + misses), 3)
+            if (hits + misses) else None,
+            "demand_page_stalls": s1["demand_page_stalls"]
+            - s0["demand_page_stalls"],
+            "landed_chunks": land["chunks_landed"],
+            "whole_puts": land["whole_puts"],
+            "max_single_transfer_bytes": land["max_transfer_bytes"]})
+    finally:
+        set_budget(prev_budget)
+        if prev_stream is None:
+            os.environ.pop("H2O_TPU_TIER_STREAM", None)
+        else:
+            os.environ["H2O_TPU_TIER_STREAM"] = prev_stream
+    out["value"] = out["ingest_rows_per_s"]
+    out["unit"] = "rows/sec ingest (HBM-bounded, shard-direct)"
+    return out
+
+
 def bench_cpu_reference(X, y, rows, trees, depth):
     """External CPU baseline for the north-star ratio (VERDICT r3 item 3):
     the same GBM workload through a widely-accepted CPU hist
@@ -1038,7 +1106,7 @@ def _main_ladder(detail):
         "BENCH_CONFIG",
         "gbm,gbm_ua,gbm_bf16,drf,glm,dl,hist,rapidsgb,scaleout,gbm10m,"
         "cpuref,cpuref10m,deep,coldstart,streamref,leverab,elastic,"
-        "auditovh,binspack"
+        "auditovh,binspack,tierhbm"
     ).split(",")
 
     detail.update({"rows": rows, "cols": cols})
@@ -1086,7 +1154,7 @@ def _main_ladder(detail):
                    if c in ("gbm", "cpuref", "drf", "glm", "hist",
                             "rapidsgb", "scaleout", "gbm10m",
                             "cpuref10m", "coldstart", "leverab",
-                            "elastic", "binspack")]
+                            "elastic", "binspack", "tierhbm")]
         detail["rows"] = rows
     detail["platform"] = platform
 
@@ -1118,7 +1186,10 @@ def _main_ladder(detail):
             ("leverab", bench_lever_ab),
             ("elastic", bench_elastic_resume),
             ("auditovh", bench_audit_overhead),
-            ("binspack", lambda: bench_bins_pack(fr, rows, depth))]
+            ("binspack", lambda: bench_bins_pack(fr, rows, depth)),
+            ("tierhbm", lambda: bench_ingest_bigger_than_hbm(
+                min(rows, int(os.environ.get("BENCH_TIER_ROWS",
+                                             rows))), cols, depth))]
     names = {"hist": "hist_kernel", "gbm10m": "gbm_10m",
              "cpuref": "cpu_reference", "deep": "drf_deep20",
              "gbm_ua": "gbm_uniform_adaptive", "gbm_bf16": "gbm_bf16",
@@ -1130,7 +1201,8 @@ def _main_ladder(detail):
              "leverab": "lever_ab",
              "elastic": "elastic_resume",
              "auditovh": "audit_overhead",
-             "binspack": "bins_pack"}
+             "binspack": "bins_pack",
+             "tierhbm": "ingest_bigger_than_hbm"}
     for cfg, fn in runs:
         if cfg not in configs:
             continue

@@ -1306,7 +1306,13 @@ def resilience_stats(params):
     degradations per site/rung), the HBM memory-manager accounting and
     the elastic-membership state with its per-reform event history
     (core/membership.py: cause, old/new mesh, jobs interrupted/resumed,
-    duration) — the numbers the chaos soak harness asserts against."""
+    duration) — the numbers the chaos soak harness asserts against.
+    The ``memory`` block carries the tiered-column-store telemetry
+    (core/memory.py MemoryManager.stats()): per-tier resident bytes
+    (``tiers.hbm/host/persist``), ``peak_hbm_bytes``, block paging
+    counters (``pages_in``/``pages_out``, ``persists``/
+    ``persist_reloads``) and the streaming prefetcher's
+    ``prefetch_hits``/``prefetch_misses``/``demand_page_stalls``."""
     from h2o_tpu.core import oom, resilience
     from h2o_tpu.core.chaos import chaos
     from h2o_tpu.core.membership import monitor

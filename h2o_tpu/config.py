@@ -1,0 +1,87 @@
+"""Central registry for the tiered-column-store tuning knobs.
+
+Every knob is an environment variable read at CALL time (never cached at
+import), so tests can monkeypatch ``os.environ`` and long-lived sessions
+can retune between jobs.  The accessors below are the single source of
+truth for defaults; the modules that consume them (``core/memory.py``,
+``core/landing.py``, ``models/tree/shared_tree.py``) import from here.
+
+Knobs
+-----
+
+``H2O_TPU_HBM_BUDGET`` (alias ``H2O_TPU_MEM_BUDGET``) — bytes of device
+    HBM the tier manager may hold resident before LRU-spilling cold
+    column blocks to host.  ``0`` (default) means unbounded: nothing
+    spills and streaming's ``auto`` gate stays closed.
+    ``MemoryManager.set_budget()`` overrides the env at runtime.
+
+``H2O_TPU_HOST_BUDGET`` — bytes of host RAM the middle tier may hold
+    before cold blocks sink further to the persist tier (the
+    reference's "ice": compressed npz spill files).  ``0`` (default)
+    means unbounded host tier; persistence then only happens via an
+    explicit ``persist_sweep()``.
+
+``H2O_TPU_TIER_BLOCK_ROWS`` — per-shard row quantum (default 65536) for
+    block-granular residency and for the streamed-training window.  It
+    is the OOM ladder's shrink unit: under device-OOM the streaming
+    ladder halves it (re-aligned to ``row_multiple``) and retries, so
+    the value must stay a multiple of the row alignment for bitwise
+    window parity.
+
+``H2O_TPU_PREFETCH_DEPTH`` — how many upcoming windows the streamer
+    stages host->device ahead of consumption (default 1, i.e. double
+    buffering).  Raising it hides more page-in latency at the cost of
+    ``depth * window_bytes`` extra transient HBM.
+
+``H2O_TPU_SHARD_LANDING`` — ``1`` (default) lands ingest chunks
+    shard-direct: each host chunk is split along the row axis and
+    ``device_put`` per-shard, so the largest single transfer is one
+    shard of one chunk and no host ever materializes the whole frame.
+    ``0`` restores the legacy whole-array put (the parity oracle used
+    by tests and the bench gate-off run).
+
+``H2O_TPU_TIER_STREAM`` — streamed GBM bin-preparation mode: ``auto``
+    (default) streams only when an HBM budget is set and the binned
+    matrix would not fit; ``1``/``on`` forces streaming; ``0``/``off``
+    disables it even under pressure.
+"""
+
+import os
+
+__all__ = [
+    "hbm_budget", "host_budget", "tier_block_rows", "prefetch_depth",
+    "shard_landing_enabled", "tier_stream_mode",
+]
+
+
+def hbm_budget() -> int:
+    """Device-HBM residency budget in bytes; 0 = unbounded."""
+    return int(os.environ.get("H2O_TPU_HBM_BUDGET")
+               or os.environ.get("H2O_TPU_MEM_BUDGET")
+               or 0)
+
+
+def host_budget() -> int:
+    """Host-tier residency budget in bytes; 0 = unbounded."""
+    return int(os.environ.get("H2O_TPU_HOST_BUDGET", "0") or 0)
+
+
+def tier_block_rows() -> int:
+    """Per-shard row quantum for tier blocks and streaming windows."""
+    return int(os.environ.get("H2O_TPU_TIER_BLOCK_ROWS", "65536") or 65536)
+
+
+def prefetch_depth() -> int:
+    """Windows staged ahead by the streamer (1 = double buffering)."""
+    return int(os.environ.get("H2O_TPU_PREFETCH_DEPTH", "1") or 1)
+
+
+def shard_landing_enabled() -> bool:
+    """False restores the legacy whole-array ``device_put`` landing."""
+    return os.environ.get("H2O_TPU_SHARD_LANDING", "1").lower() not in (
+        "0", "off", "false", "no")
+
+
+def tier_stream_mode() -> str:
+    """``auto`` | ``on``/``1`` | ``off``/``0`` (normalized, lowercase)."""
+    return os.environ.get("H2O_TPU_TIER_STREAM", "auto").lower()

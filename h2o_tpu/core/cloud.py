@@ -287,16 +287,10 @@ class Cloud:
         from h2o_tpu.core.chaos import chaos
         if chaos().enabled:
             chaos().maybe_fail_device_put()
-        arr = np.asarray(host_array)
-        q = self.row_multiple()
-        pad = (-arr.shape[0]) % q
-        if pad:
-            pad_width = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
-            fill = np.nan if np.issubdtype(arr.dtype, np.floating) else 0
-            arr = np.pad(arr, pad_width, constant_values=fill)
-        sh = self.row_sharding if arr.ndim == 1 else NamedSharding(
-            self.mesh, P(DATA_AXIS, *([None] * (arr.ndim - 1))))
-        return jax.device_put(arr, sh)
+        # Placement lives in the landing layer: each shard's slice goes
+        # straight to its home device (no whole-array single-host put).
+        from h2o_tpu.core import landing
+        return landing.land_rows(host_array)
 
 
 def cloud() -> Cloud:

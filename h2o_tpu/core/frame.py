@@ -192,8 +192,8 @@ class Vec:
         self.domain = domain
         self._rollups: Optional[RollupStats] = None
         self._hist: Optional[np.ndarray] = None
-        self._host_f64: Optional[np.ndarray] = None
-        self._spill_np: Optional[np.ndarray] = None   # parked host copy
+        self._host_f64 = None     # residue-backed property (tier model)
+        self._spill_np = None     # parked host copy (memory.HostBlocks)
         # ragged shard layout (sharded filter/merge outputs): valid rows
         # are a PER-SHARD prefix; shard_counts[s] rows of shard s are
         # real, the rest is masked padding.  None = canonical global
@@ -246,14 +246,25 @@ class Vec:
 
     def _spill(self) -> bool:
         """Drop the device payload after parking a host copy (called by
-        the MemoryManager under budget pressure).  Returns False when
-        there is nothing to spill."""
+        the MemoryManager under budget pressure).  The park is a
+        block-chunked :class:`~h2o_tpu.core.memory.HostBlocks` — the
+        host tier of the column store: individually persistable blocks
+        that the blocked training paths stream back window-at-a-time.
+        Returns False when there is nothing to spill."""
+        from h2o_tpu.core.cloud import Cloud
+        from h2o_tpu.core.memory import HostBlocks, manager
         with self._spill_lock:
             if self._data is None:
                 return False
-            self._spill_np = np.asarray(self._data)
+            inst = Cloud._instance
+            park = HostBlocks(np.asarray(self._data),
+                              inst.n_nodes if inst is not None else 1)
+            self._spill_np = park
             self._data = None
-            return True
+        # host-tier registration outside the vec lock (it may trigger a
+        # persist sweep of OTHER parks, which take their own I/O locks)
+        manager().register_host(park, park.nbytes)
+        return True
 
     @property
     def data(self) -> Optional[jax.Array]:
@@ -261,16 +272,21 @@ class Vec:
         The lock makes reload/spill atomic: a concurrent Cleaner sweep
         can never hand a reader None mid-swap."""
         from h2o_tpu.core.memory import manager
+        park = None
         with self._spill_lock:
             if self._data is None and self._spill_np is not None:
-                arr = self._spill_np
-                self._data = cloud().device_put_rows(arr)
+                park = self._spill_np
+                # rehydrate (paging persisted blocks back in) and land
+                # shard-direct — each shard straight to its home device
+                self._data = cloud().device_put_rows(park.to_ndarray())
                 self._spill_np = None
                 manager().note_reload()
                 reloaded = True
             else:
                 reloaded = False
             out = self._data
+        if park is not None:
+            manager().unregister_host(park)
         # manager calls outside the vec lock (it takes its own lock; a
         # register may spill OTHER vecs, which grab their own locks)
         if reloaded:
@@ -285,9 +301,54 @@ class Vec:
         manager().unregister(self)
         with self._spill_lock:
             self._data = value
+            old_park = self._spill_np
             self._spill_np = None
+        if old_park is not None:
+            manager().unregister_host(old_park)
         if value is not None:
             self._account()
+
+    # -- host-tier residues (T_TIME exact f64, T_STR/T_UUID lists) ---------
+    # These payloads never touch HBM by design; in the tier model they
+    # page host ⇄ persist through the MemoryManager's host tier
+    # (core/memory.HostResidue) and reload transparently on access —
+    # the properties keep every existing reader/writer site unchanged.
+
+    @property
+    def _host_f64(self) -> Optional[np.ndarray]:
+        res = self.__dict__.get("_time_res")
+        return res.get() if res is not None else None
+
+    @_host_f64.setter
+    def _host_f64(self, value) -> None:
+        from h2o_tpu.core.memory import HostResidue, manager
+        old = self.__dict__.get("_time_res")
+        if old is not None:
+            manager().unregister_host(old)
+        if value is None:
+            self.__dict__["_time_res"] = None
+            return
+        res = HostResidue(np.asarray(value, np.float64))
+        self.__dict__["_time_res"] = res
+        manager().register_host(res, res.nbytes)
+
+    @property
+    def host_data(self) -> Optional[List]:
+        res = self.__dict__.get("_str_res")
+        return res.get() if res is not None else None
+
+    @host_data.setter
+    def host_data(self, value) -> None:
+        from h2o_tpu.core.memory import HostResidue, manager
+        old = self.__dict__.get("_str_res")
+        if old is not None:
+            manager().unregister_host(old)
+        if value is None:
+            self.__dict__["_str_res"] = None
+            return
+        res = HostResidue(value if isinstance(value, list) else list(value))
+        self.__dict__["_str_res"] = res
+        manager().register_host(res, res.nbytes)
 
     # -- basics ------------------------------------------------------------
 
@@ -369,7 +430,7 @@ class Vec:
         with self._spill_lock:
             if self._data is None and self._spill_np is not None:
                 # host reads of spilled columns never touch the device
-                return self._compact_host(self._spill_np)
+                return self._compact_host(self._spill_np.to_ndarray())
         from h2o_tpu.core.diag import DispatchStats
         arr = np.asarray(self.data)
         DispatchStats.note_host_pull(arr.nbytes)
@@ -467,8 +528,12 @@ class Vec:
         a Frame must clear that frame's matrix cache (Frame.append_rows
         does)."""
         if self.type in (T_STR, T_UUID):
-            self.host_data.extend(list(values))
-            self.nrows = len(self.host_data)
+            lst = self.host_data
+            lst.extend(list(values))
+            # re-wrap: refreshes the host-tier byte accounting and drops
+            # any stale persisted copy of the pre-append payload
+            self.host_data = lst
+            self.nrows = len(lst)
             return
         if self.shard_counts is not None:
             raise ValueError(
@@ -545,12 +610,16 @@ class Vec:
             return
         from h2o_tpu.core.memory import manager
         with self._spill_lock:
-            src = self._spill_np if self._data is None else \
+            src = self._spill_np.to_ndarray() if self._data is None else \
                 np.asarray(self._data)
         arr = self._compact_host(src)
         manager().unregister(self)
         with self._spill_lock:
+            old_park = self._spill_np
             self._spill_np = None
+        if old_park is not None:
+            manager().unregister_host(old_park)
+        with self._spill_lock:
             if self.type == T_CAT:
                 self._data = cloud().device_put_rows(
                     arr.astype(np.int32, copy=False))
@@ -933,7 +1002,8 @@ class Frame:
                     jnp.pad(c, (0, R - c.shape[0]),
                             constant_values=jnp.nan) for c in cols]
             m = jnp.stack(cols, axis=1).astype(dtype)
-            m = jax.device_put(m, cloud().matrix_sharding())
+            from h2o_tpu.core import landing
+            m = landing.reshard_rows(m, cloud().matrix_sharding())
             self._matrix_cache[ck] = m
         return m
 

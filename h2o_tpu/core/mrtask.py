@@ -62,7 +62,8 @@ def dispatch_cache():
 
 
 def map_reduce(map_fn: Callable, *arrays: jax.Array, reduce: str = "sum",
-               extra_args: Sequence = ()) -> jax.Array:
+               extra_args: Sequence = (),
+               _ladder: bool = True) -> jax.Array:
     """Run ``map_fn(shard, *extra)`` per node-shard; reduce results over ICI.
 
     ``arrays`` are row-sharded (leading axis over ``nodes``); ``map_fn``
@@ -71,7 +72,10 @@ def map_reduce(map_fn: Callable, *arrays: jax.Array, reduce: str = "sum",
     Repeated calls with the same (map_fn, reduce, shapes) reuse ONE
     compiled executable via the store; OOM dispatches walk the ladder
     (sweep-the-LRU-and-retry — there is no work quantum to shrink in one
-    fused program).
+    fused program).  ``_ladder=False`` executes WITHOUT the dispatch
+    ladder — for callers that already run inside their own ladder (the
+    blocked streamer's ``tier.block`` site: nesting a quantum-less inner
+    ladder would terminal-fail before the outer shrink rung ever runs).
     """
     c = cloud()
     mesh = c.mesh
@@ -95,10 +99,20 @@ def map_reduce(map_fn: Callable, *arrays: jax.Array, reduce: str = "sum",
         return run
 
     name = stable_fn_name(map_fn)
+    persist = f"map_reduce:{name}:{reduce}" if name else None
+    content = code_fingerprint(map_fn) if name else None
+    if not _ladder:
+        args = (*arrays, *extra_args)
+        fn = exec_store().get_or_build(
+            "map_reduce", key, build, persist=persist, content=content,
+            args=args)
+        from h2o_tpu.core import lockwitness
+        lockwitness.note_device_dispatch("map_reduce")
+        DispatchStats.note_dispatch("map_reduce")
+        return fn(*args)
     return exec_store().dispatch(
         "map_reduce", key, build, (*arrays, *extra_args),
-        persist=f"map_reduce:{name}:{reduce}" if name else None,
-        content=code_fingerprint(map_fn) if name else None)
+        persist=persist, content=content)
 
 
 def map_frame(map_fn: Callable, frame: Frame,
@@ -154,5 +168,200 @@ def device_sum(x: jax.Array) -> jax.Array:
 
 def row_mask_shard(padded_rows: int, nrows: int) -> jax.Array:
     """Replicable helper: global row-validity mask, row-sharded."""
+    from h2o_tpu.core import landing
     mask = jnp.arange(padded_rows) < nrows
-    return jax.device_put(mask, cloud().row_sharding)
+    return landing.reshard_rows(mask, cloud().row_sharding)
+
+
+# -- blocked streaming over the tiered column store --------------------------
+#
+# The consumer half of core/memory.py's tier manager: a frame larger
+# than the HBM budget trains by streaming shard-aligned row WINDOWS
+# (per-shard rows [w0, w1) of every shard at once) back through the
+# device — block t computes while block t+1 stages on a prefetch
+# thread, the reference's Cleaner prefetch done TPU-natively.  Every
+# window lands shard-direct via core/landing.py, and every window
+# dispatch runs under the OOM ladder with the window size as the shrink
+# quantum (pressure halves the resident window before
+# RESOURCE_EXHAUSTED ever terminates the job).
+
+class FrameBlockStreamer:
+    """Stream a frame's columns as shard-aligned float32 row windows.
+
+    Construction DEMOTES every source column HBM → host (the park is a
+    block-chunked ``HostBlocks``), so the frame's device bytes drop to
+    ~one window regardless of total size.  ``host_block`` assembles the
+    window ``[w0, w1)`` exactly as ``Frame.as_matrix`` would present
+    those rows (float32, cat codes < 0 → NaN, short columns NaN-padded)
+    — the bitwise-parity contract the bounded-HBM drill asserts.
+    """
+
+    def __init__(self, frame: Frame, names: Sequence[str],
+                 block_rows: int = 0):
+        from h2o_tpu.core.cloud import cloud as _cloud
+        from h2o_tpu.core.memory import manager, tier_block_rows
+        c = _cloud()
+        self._names = tuple(names)
+        self._vecs = [frame.vec(n) for n in self._names]
+        self._n = c.n_nodes
+        align = c.args.row_align
+        self._L = frame.padded_rows // self._n
+        q = int(block_rows) or tier_block_rows()
+        q = max(align, (min(q, self._L) // align) * align)
+        self._q = q
+        self._align = align
+        # park every source column on the host tier; drop the frame's
+        # cached full matrix so nothing keeps the whole frame in HBM
+        frame._matrix_cache.clear()
+        for v in self._vecs:
+            if v._data is not None:
+                manager().demote(v)
+        self._mgr = manager()
+        import concurrent.futures as _fut
+        self._pool = _fut.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tier-prefetch")
+        self._staged: dict = {}
+
+    # -- geometry ----------------------------------------------------------
+
+    @property
+    def per_shard_rows(self) -> int:
+        return self._L
+
+    @property
+    def window(self) -> int:
+        """Current per-shard window size (the OOM-shrinkable quantum)."""
+        return self._q
+
+    def shrink(self) -> bool:
+        """Halve the window (OOM-ladder rung (b)).  Alignment holds: the
+        new quantum stays a row_align multiple, and any resume position
+        that was a multiple of the old quantum is one of the new."""
+        new = (self._q // 2 // self._align) * self._align
+        if new < self._align:
+            return False
+        self._q = new
+        self._staged.clear()
+        return True
+
+    # -- assembly ----------------------------------------------------------
+
+    def _col_window(self, v, w0: int, w1: int) -> np.ndarray:
+        import numpy as _np
+        hb = v._spill_np
+        if hb is None:                  # re-parked between windows
+            self._mgr.demote(v)
+            hb = v._spill_np
+        q = w1 - w0
+        Lv = hb.shape[0] // self._n
+        if w0 >= Lv:
+            return _np.full((self._n, q), _np.nan, _np.float32)
+        part = hb.slice_shard_rows(w0, min(w1, Lv))
+        if v.is_categorical:
+            part = _np.where(part < 0, _np.nan,
+                             part.astype(_np.float32))
+        else:
+            part = part.astype(_np.float32, copy=False)
+        if part.shape[1] < q:
+            part = _np.pad(part, ((0, 0), (0, q - part.shape[1])),
+                           constant_values=_np.nan)
+        return part
+
+    def _assemble(self, w0: int, w1: int) -> np.ndarray:
+        import numpy as _np
+        cols = [self._col_window(v, w0, w1) for v in self._vecs]
+        blk = _np.stack(cols, axis=-1)            # (n, q, C)
+        return _np.ascontiguousarray(
+            blk.reshape(self._n * (w1 - w0), len(self._vecs)))
+
+    # -- prefetch + landing ------------------------------------------------
+
+    def stage(self, w0: int, w1: int) -> None:
+        """Queue host assembly of window ``[w0, w1)`` on the prefetch
+        thread (lookahead: block t+1 pages in while block t computes)."""
+        if w0 >= self._L or w0 < 0 or (w0, w1) in self._staged:
+            return
+        self._staged[(w0, w1)] = self._pool.submit(
+            self._assemble, w0, w1)
+
+    def host_block(self, w0: int, w1: int) -> np.ndarray:
+        fut = self._staged.pop((w0, w1), None)
+        if fut is None:
+            self._mgr.note_prefetch(hit=False)
+            return self._assemble(w0, w1)
+        if not fut.done():
+            # the demand page beat the prefetcher — a counted stall
+            self._mgr.note_demand_stall()
+            self._mgr.note_prefetch(hit=False)
+        else:
+            self._mgr.note_prefetch(hit=True)
+        return fut.result()
+
+    def device_block(self, w0: int, w1: int) -> jax.Array:
+        """Window ``[w0, w1)`` landed shard-direct on the mesh, shape
+        ``(n*(w1-w0), C)`` row-sharded — each shard's rows go straight
+        to their home device (core/landing.py pull accounting)."""
+        from h2o_tpu.core import landing
+        c = cloud()
+        return landing.land_rows(
+            self.host_block(w0, w1), c.matrix_sharding())
+
+    def close(self) -> None:
+        self._staged.clear()
+        self._pool.shutdown(wait=False)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def map_reduce_blocked(map_fn: Callable, streamer: FrameBlockStreamer, *,
+                       reduce: str = "sum", combine: Callable = None,
+                       extra_args: Sequence = ()):
+    """Blocked MRTask over a tiered frame: ``map_fn`` runs per shard on
+    each streamed window (same contract as :func:`map_reduce`), the
+    per-window results are combined on host with ``combine`` (default:
+    the host twin of ``reduce``).  Each window dispatch runs under the
+    OOM ladder at site ``tier.block`` with the streamer's window as the
+    shrink quantum — memory pressure shrinks the resident window as a
+    counted degradation instead of failing the job."""
+    import numpy as np
+    from h2o_tpu.core.oom import oom_ladder
+    # the clamped tail window OVERLAPS already-seen rows (recomputing
+    # identical values) — sound only for idempotent combines
+    assert reduce in ("min", "max") or combine is not None, \
+        "map_reduce_blocked: 'sum' double-counts the clamped tail — " \
+        "pass an overlap-aware combine or use an idempotent reduce"
+    if combine is None:
+        combine = {"sum": np.add, "min": np.minimum,
+                   "max": np.maximum}[reduce]
+    L = streamer.per_shard_rows
+    pos = 0
+    acc = None
+    streamer.stage(0, streamer.window)
+    while pos < L:
+
+        def attempt():
+            # re-derive the window INSIDE the attempt: an OOM-ladder
+            # shrink between retries must land a smaller block
+            q = streamer.window
+            w0 = min(pos, max(0, L - q))
+            blk = streamer.device_block(w0, w0 + q)
+            # _ladder=False: THIS attempt is the ladder (tier.block);
+            # a nested quantum-less ladder would terminal-fail before
+            # the window-shrink rung below ever ran
+            part = map_reduce(map_fn, blk, reduce=reduce,
+                              extra_args=extra_args, _ladder=False)
+            return part, w0 + q
+
+        part, pos = oom_ladder("tier.block", attempt,
+                               shrink=streamer.shrink)
+        part = jax.tree.map(np.asarray, part)
+        acc = part if acc is None else jax.tree.map(combine, acc, part)
+        if pos < L:
+            q = streamer.window
+            n0 = min(pos, L - q)
+            streamer.stage(n0, n0 + q)
+    return acc

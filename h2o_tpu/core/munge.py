@@ -87,6 +87,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from h2o_tpu.core import landing
 from h2o_tpu.core.cloud import DATA_AXIS, cloud, shard_map_compat
 from h2o_tpu.core.diag import DispatchStats
 from h2o_tpu.core.frame import (Frame, T_CAT, Vec, _row_pad,
@@ -181,7 +182,7 @@ def _pad_rows(arr: jax.Array, n: int, fill) -> jax.Array:
 def _mk_vec(arr: jax.Array, like: Vec, nrows: int,
             shard_counts=None) -> Vec:
     """Wrap a munge-kernel output column as a row-sharded Vec."""
-    arr = jax.device_put(arr, cloud().row_sharding)
+    arr = landing.reshard_rows(arr)
     return Vec(arr, like.type, nrows=nrows,
                domain=list(like.domain) if like.domain else None,
                shard_counts=shard_counts)
@@ -915,7 +916,7 @@ def repack_frame(fr: Frame) -> Frame:
             if v.is_categorical:
                 col = jnp.where(jnp.isnan(col), -1.0,
                                 col).astype(jnp.int32)
-            v.data = jax.device_put(col, cloud().row_sharding)
+            v.data = landing.reshard_rows(col)
             v.shard_counts = None
             v.invalidate()
         return fr
@@ -1049,9 +1050,7 @@ def _group_table(fr: Frame, gcols, aggs, keyvals, counts, outs,
         names.append(fr.names[j])
     for (a, col_i, _na), out in zip(aggs, outs):
         names.append(f"{a}_{fr.names[col_i]}")
-        vecs.append(Vec(jax.device_put(out[:Gpad],
-                                       cloud().row_sharding),
-                        nrows=G))
+        vecs.append(Vec(landing.reshard_rows(out[:Gpad]), nrows=G))
     return Frame(names, vecs)
 
 
@@ -1160,7 +1159,7 @@ def _shard_merge(L: Frame, R: Frame, all_x: bool, all_y: bool,
                                 out).astype(jnp.int32)
                 dom = unions[j] if j in by_x and u_cnt > 0 \
                     else list(v.domain)
-                arr = jax.device_put(cat, cloud().row_sharding)
+                arr = landing.reshard_rows(cat)
                 vecs.append(Vec(arr, T_CAT, nrows=n_out, domain=dom,
                                 shard_counts=sc))
             else:
@@ -1173,7 +1172,7 @@ def _shard_merge(L: Frame, R: Frame, all_x: bool, all_y: bool,
             if v.is_categorical:
                 cat = jnp.where(jnp.isnan(out), -1.0,
                                 out).astype(jnp.int32)
-                arr = jax.device_put(cat, cloud().row_sharding)
+                arr = landing.reshard_rows(cat)
                 vecs.append(Vec(arr, T_CAT, nrows=n_out,
                                 domain=list(v.domain), shard_counts=sc))
             else:
@@ -1243,7 +1242,7 @@ def _global_merge(L: Frame, R: Frame, all_x: bool, all_y: bool,
                     out = jnp.where(li >= 0, out,
                                     jnp.where(ri >= 0, rg, -1)
                                     ).astype(jnp.int32)
-                arr = jax.device_put(out, cloud().row_sharding)
+                arr = landing.reshard_rows(out)
                 vecs.append(Vec(arr, T_CAT, nrows=n_out, domain=dom))
             else:
                 out = jnp.where(li >= 0, lg, jnp.nan)
@@ -1253,7 +1252,7 @@ def _global_merge(L: Frame, R: Frame, all_x: bool, all_y: bool,
                                   axis=0)
                     out = jnp.where(li >= 0, out,
                                     jnp.where(ri >= 0, rg, jnp.nan))
-                vecs.append(Vec(jax.device_put(out, cloud().row_sharding),
+                vecs.append(Vec(landing.reshard_rows(out),
                                 v.type, nrows=n_out))
             names.append(n)
         for j, n in enumerate(R.names):
@@ -1263,12 +1262,12 @@ def _global_merge(L: Frame, R: Frame, all_x: bool, all_y: bool,
             rg = jnp.take(v.data, rc, axis=0)
             if v.is_categorical:
                 out = jnp.where(ri >= 0, rg, -1).astype(jnp.int32)
-                arr = jax.device_put(out, cloud().row_sharding)
+                arr = landing.reshard_rows(out)
                 vecs.append(Vec(arr, T_CAT, nrows=n_out,
                                 domain=list(v.domain)))
             else:
                 out = jnp.where(ri >= 0, rg, jnp.nan)
-                vecs.append(Vec(jax.device_put(out, cloud().row_sharding),
+                vecs.append(Vec(landing.reshard_rows(out),
                                 v.type, nrows=n_out))
             names.append(n if n not in names else f"{n}_y")
         return Frame(names, vecs)

@@ -1,4 +1,4 @@
-"""GL301–GL303 — sharded-collective safety.
+"""GL301–GL304 — sharded-collective safety.
 
 The PR 8 miscompile class: under GSPMD, ``jnp.concatenate`` of a
 row-sharded operand with freshly-created filler forces an implicit
@@ -20,6 +20,13 @@ home-sharded data plane:
   ``device_get`` / ``to_numpy`` / REPLICATED sharding inside a shard
   body (any module) or inside core/munge.py's sharded verbs (the
   ISSUE-8 contract list) silently undoes shard residency.
+- **GL304** row-sharded placement only through the landing layer: a
+  bare ``jax.device_put`` onto ``row_sharding`` / ``matrix_sharding``
+  (or any sharding built from ``DATA_AXIS``) outside core/landing.py
+  and core/memory.py bypasses shard-direct placement — it stages the
+  WHOLE array on one host and forfeits pull accounting, tier telemetry
+  and the big-frame ingest path.  Use ``landing.land_rows`` (host data)
+  or ``landing.reshard_rows`` (device data).
 """
 
 from __future__ import annotations
@@ -188,4 +195,55 @@ def check_host_gather(mi: ModuleInfo, ctx):
                 if isinstance(node, ast.Attribute) and \
                         node.attr in _HOST_GATHER_ATTRS:
                     flag(node, f"sharded munge verb {func.name}()")
+    return out
+
+
+# modules allowed to place row-sharded data directly: the landing layer
+# itself and the tier manager that pages blocks back in
+_LANDING_EXEMPT = {"core/landing.py", "core/memory.py"}
+
+_ROW_SHARDING_ATTRS = {"row_sharding", "matrix_sharding"}
+
+
+def _is_row_sharding_expr(node) -> bool:
+    """Does this sharding expression resolve to the row/matrix data
+    plane?  Matches ``cloud().row_sharding`` / ``c.matrix_sharding()``
+    attribute chains and any sharding literally built from the
+    DATA_AXIS constant (``NamedSharding(mesh, P(DATA_AXIS))``)."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and \
+                n.attr in _ROW_SHARDING_ATTRS:
+            return True
+        if isinstance(n, ast.Name) and n.id == "DATA_AXIS":
+            return True
+        if isinstance(n, ast.Attribute) and n.attr == "DATA_AXIS":
+            return True
+    return False
+
+
+@rule("GL304", "landing-bypass")
+def check_landing_bypass(mi: ModuleInfo, ctx):
+    """jax.device_put onto the row/matrix shardings outside the
+    sanctioned landing layer (core/landing.py, core/memory.py)."""
+    if mi.rel in _LANDING_EXEMPT:
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(mi.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if classify._attr_chain(node.func) != ["jax", "device_put"]:
+            continue
+        sh = classify._kw(node, "device")
+        if sh is None and len(node.args) > 1:
+            sh = node.args[1]
+        if sh is None or not _is_row_sharding_expr(sh):
+            continue
+        out.append(Finding(
+            "GL304", "error", mi.rel, node.lineno, mi.scope_of(node),
+            "jax.device_put onto a row/matrix sharding outside the "
+            "landing layer — this stages the whole array through one "
+            "host and bypasses shard-direct placement, pull accounting "
+            "and tier telemetry; use landing.land_rows (host data) or "
+            "landing.reshard_rows (device data)",
+            detail="landing-bypass:device_put"))
     return out

@@ -581,10 +581,11 @@ def expand_for_scoring(frame: Frame, spec: Dict):
         if spec["standardize"]:
             d = (d - mean) / (sigma or 1.0)
         cols.append(d)
+    from h2o_tpu.core import landing
     from h2o_tpu.core.cloud import cloud
     m = jnp.stack(cols, axis=1) if cols else jnp.zeros(
         (frame.padded_rows, 0), jnp.float32)
-    return jax.device_put(m, cloud().matrix_sharding())
+    return landing.reshard_rows(m, cloud().matrix_sharding())
 
 
 def expand_array(X, spec: Dict, order: Optional[Sequence[str]] = None):

@@ -141,7 +141,8 @@ class DataInfo:
                 names.append(c)
             m = jnp.stack(cols, axis=1) if cols else jnp.zeros(
                 (self.frame.padded_rows, 0), jnp.float32)
-            self._matrix = jax.device_put(m, cloud().matrix_sharding())
+            from h2o_tpu.core import landing
+            self._matrix = landing.reshard_rows(m, cloud().matrix_sharding())
             self._names_expanded = names
         return self._matrix
 

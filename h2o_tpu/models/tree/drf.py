@@ -239,7 +239,8 @@ class DRF(ModelBuilder):
         model = run_tree_driver(job, p, train_kwargs, F0, self.rng_key(),
                                 make_model, scorer, kind,
                                 prior_trees=prior,
-                                recovery=getattr(self, "_recovery", None))
+                                recovery=getattr(self, "_recovery", None),
+                                data_frame=train)
         model.output["training_metrics"] = model.model_metrics(train)
         if valid is not None:
             model.output["validation_metrics"] = model.model_metrics(valid)

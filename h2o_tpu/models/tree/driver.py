@@ -179,7 +179,8 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
                     make_model: Callable,
                     scorer: Optional[IncrementalScorer],
                     kind: str, prior_trees: int = 0,
-                    t_start: float = None, recovery=None) -> object:
+                    t_start: float = None, recovery=None,
+                    data_frame=None) -> object:
     """Train ``p['ntrees']`` total trees (``prior_trees`` of which already
     exist on a checkpoint), scoring every ``score_tree_interval`` trees when
     early stopping / periodic scoring / a runtime budget is requested.
@@ -205,6 +206,20 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
     # the same (possibly autotuner-probed) executable, and a probe only
     # ever runs before the first block, never mid-forest
     train_kwargs = resolve_train_levers(dict(train_kwargs))
+
+    # tiered column store: once binning is done, the RAW frame columns
+    # are dead weight for the whole forest — under an HBM budget, demote
+    # them to the host tier up front so the budget goes to the packed
+    # bins + histograms instead of the ladder discovering this via
+    # RESOURCE_EXHAUSTED mid-block (core/memory.py tier manager)
+    if data_frame is not None:
+        from h2o_tpu.core.memory import manager
+        mm = manager()
+        if mm.budget > 0:
+            data_frame._matrix_cache.clear()
+            for v in data_frame.vecs:
+                if v._data is not None:
+                    mm.demote(v)
 
     ntrees = int(p["ntrees"]) - prior_trees
     if prior_trees and ntrees <= 0:

@@ -374,7 +374,8 @@ class GBM(ModelBuilder):
         model = run_tree_driver(job, p, train_kwargs, F, self.rng_key(),
                                 make_model, scorer, kind,
                                 prior_trees=prior,
-                                recovery=getattr(self, "_recovery", None))
+                                recovery=getattr(self, "_recovery", None),
+                                data_frame=train)
         if p.get("_skip_final_metrics"):
             # per-tree inner fits (DART driver) discard these; the outer
             # loop scores the final concatenated forest once

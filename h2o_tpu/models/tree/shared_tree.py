@@ -31,13 +31,15 @@ TPU-native redesign:
 from __future__ import annotations
 
 import functools
+import os
 from typing import Dict, List, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
-from h2o_tpu.core.cloud import cloud
+from h2o_tpu.core.cloud import DATA_AXIS, cloud, shard_map_compat
 from h2o_tpu.core.frame import Frame
 from h2o_tpu.models.model import DataInfo, Model, ModelBuilder
 from h2o_tpu.ops.binpack import (bins_bucket, bins_pack_enabled, cast_bins,
@@ -121,18 +123,18 @@ def prepare_bins(di: DataInfo, nbins: int, nbins_cats: int,
     max_card = max([fr.vec(c).cardinality for c in di.cat_names] or [0])
     B = max(nbins, min(max_card, nbins_cats))
     is_cat = np.array([fr.vec(c).is_categorical for c in xs], bool)
+    if (histogram_type in ("UniformAdaptive", "Random")
+            and _stream_blocks_enabled(fr, xs)):
+        # frame bigger than the HBM budget: never materialize the full
+        # matrix — stream shard-aligned windows through binning instead
+        return _prepare_bins_streamed(fr, xs, is_cat, B,
+                                      max(int(nbins_top_level), B),
+                                      histogram_type)
     m = fr.as_matrix(xs)
     if histogram_type in ("UniformAdaptive", "Random"):
         F = max(int(nbins_top_level), B)
         mn = np.asarray(_col_min_max(m, jnp.int32(fr.nrows)))
-        col_min, col_max = mn[0], mn[1]
-        span = np.where(col_max > col_min, col_max - col_min, 1.0)
-        sp = np.full((C, F - 1), np.nan, np.float32)
-        grid = (np.arange(1, F, dtype=np.float64)[None, :] / F)
-        vals = (col_min[:, None] + grid * span[:, None]).astype(np.float32)
-        for j in range(C):
-            if not is_cat[j]:
-                sp[j] = vals[j]
+        sp = _uniform_split_points(mn[0], mn[1], is_cat, C, F)
     else:
         F = B
         sp_raw = np.asarray(_quantile_split_points(m, jnp.int32(fr.nrows),
@@ -205,6 +207,151 @@ def _col_min_max(matrix, nrows):
     rowmask = (jnp.arange(R) < nrows)[:, None]
     mx = jnp.where(rowmask & ~jnp.isnan(matrix), matrix, jnp.nan)
     return jnp.stack([jnp.nanmin(mx, axis=0), jnp.nanmax(mx, axis=0)])
+
+
+def _uniform_split_points(col_min, col_max, is_cat, C: int,
+                          F: int) -> np.ndarray:
+    """The UniformAdaptive fine-grid thresholds from per-column (min,
+    max) — ONE shared implementation so the streamed (blocked min/max)
+    and full-matrix paths produce bit-identical split points."""
+    span = np.where(col_max > col_min, col_max - col_min, 1.0)
+    sp = np.full((C, F - 1), np.nan, np.float32)
+    grid = (np.arange(1, F, dtype=np.float64)[None, :] / F)
+    vals = (col_min[:, None] + grid * span[:, None]).astype(np.float32)
+    for j in range(C):
+        if not is_cat[j]:
+            sp[j] = vals[j]
+    return sp
+
+
+# -- streamed binning: frames bigger than the HBM budget ---------------------
+
+def _stream_blocks_enabled(fr: Frame, xs) -> bool:
+    """Stream windows instead of materializing the full matrix?
+
+    ``H2O_TPU_TIER_STREAM``: ``auto`` (default) streams when an HBM
+    budget is set and the estimated f32 matrix exceeds it; ``1`` forces
+    streaming (tests/drills); ``0`` disables.  Streaming requires the
+    canonical layout (not ragged) and every column sharing the frame's
+    capacity — the shard-aligned window math assumes ONE row layout."""
+    from h2o_tpu.config import tier_stream_mode
+    mode = tier_stream_mode()
+    if mode in ("0", "off", "false", "no"):
+        return False
+    if fr.is_ragged:
+        return False
+    R = fr.padded_rows
+    for c in xs:
+        v = fr.vec(c)
+        if v._device_rows() != R or v.host_data is not None:
+            return False
+    if mode in ("1", "on", "true", "yes"):
+        return True
+    from h2o_tpu.core.memory import manager
+    budget = manager().budget
+    return budget > 0 and R * len(xs) * 4 > budget
+
+
+def _blk_neg_minmax(m):
+    """Per-shard (min, -max) of a window — combined with pmin across
+    shards and np.minimum across windows.  min is EXACT (no accumulation
+    rounding), so any block partition reproduces the full-matrix
+    nanmin/nanmax bit-for-bit; all-NaN columns come back (+inf, +inf)
+    and are mapped to NaN by the caller, matching nanmin on empty."""
+    ok = ~jnp.isnan(m)
+    big = jnp.asarray(jnp.inf, m.dtype)
+    return jnp.stack([jnp.min(jnp.where(ok, m, big), axis=0),
+                      jnp.min(jnp.where(ok, -m, big), axis=0)])
+
+
+def _build_window_scatter():
+    """AOT-cached scatter: write a binned window into the full packed
+    bins buffer at per-shard row offset ``start``.  Provably shard-local
+    (dynamic_update_slice on each shard's own rows, no collectives);
+    ``start`` is a TRACED operand, so ONE executable serves every
+    window — zero steady-state recompiles."""
+    mesh = cloud().mesh
+
+    def body(buf, blk, start):
+        return jax.lax.dynamic_update_slice_in_dim(buf, blk, start,
+                                                   axis=0)
+
+    return shard_map_compat(
+        body, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P()),
+        out_specs=P(DATA_AXIS, None), check_vma=False)
+
+
+def _scatter_window(buf, blk, w0: int):
+    from h2o_tpu.core.exec_store import (aval_key, code_fingerprint,
+                                         exec_store)
+    key = ("tier_scatter", aval_key(buf), aval_key(blk))
+    # site="tier.block": the scatter shares the streaming site's ladder
+    # identity — its dispatch-level ladder sweeps (donation-aware);
+    # the window-shrink rung lives in the caller's tier.block ladder
+    return exec_store().dispatch(
+        "tier", key, _build_window_scatter,
+        (buf, blk, jnp.int32(w0)),
+        site="tier.block",
+        donate_argnums=(0,),
+        persist=f"tier:scatter:{buf.dtype}:{blk.shape[0]}",
+        content=code_fingerprint(_build_window_scatter))
+
+
+def _prepare_bins_streamed(fr: Frame, xs, is_cat: np.ndarray, B: int,
+                           F: int, histogram_type: str) -> BinnedData:
+    """UniformAdaptive/Random binning without ever materializing the
+    full matrix: pass 1 streams windows through a blocked min/max, pass
+    2 bins each window and scatters it into the packed bins buffer.
+    Both passes run under the OOM ladder at site ``tier.block`` (the
+    window is the shrink quantum) and produce a BinnedData BITWISE equal
+    to the full-matrix path — the bounded-HBM drill's contract."""
+    from h2o_tpu.core import landing
+    from h2o_tpu.core.mrtask import FrameBlockStreamer, map_reduce_blocked
+    from h2o_tpu.core.oom import oom_ladder
+    C = len(xs)
+    R = fr.padded_rows
+    streamer = FrameBlockStreamer(fr, xs)
+    try:
+        acc = map_reduce_blocked(_blk_neg_minmax, streamer, reduce="min")
+        col_min, nmx = acc[0], acc[1]
+        col_max = -nmx
+        empty = (col_min == np.inf) & (nmx == np.inf)
+        col_min = np.where(empty, np.nan, col_min).astype(np.float32)
+        col_max = np.where(empty, np.nan, col_max).astype(np.float32)
+        sp = _uniform_split_points(col_min, col_max, is_cat, C, F)
+        sp_dev = jax.device_put(jnp.asarray(sp), cloud().replicated)
+        packed = bins_pack_enabled(bins_bucket(R, C, F))
+        dt = packed_dtype_name(F, packed)
+        is_cat_dev = jnp.asarray(is_cat)
+        buf = landing.reshard_rows(jnp.zeros((R, C), dt),
+                                   cloud().matrix_sharding())
+        L = streamer.per_shard_rows
+        pos = 0
+        streamer.stage(0, streamer.window)
+        while pos < L:
+
+            def attempt():
+                # window re-derived inside: a ladder shrink between
+                # retries must land a smaller block
+                q = streamer.window
+                w0 = min(pos, max(0, L - q))
+                blk = streamer.device_block(w0, w0 + q)
+                bb = _bin_all(blk, sp_dev, is_cat_dev, F, out_dtype=dt)
+                return w0, bb, w0 + q
+
+            w0, bb, pos = oom_ladder("tier.block", attempt,
+                                     shrink=streamer.shrink)
+            # tail-clamp overlap rewrites identical values (elementwise
+            # binning), so the buffer stays bitwise-stable
+            buf = _scatter_window(buf, bb, w0)
+            if pos < L:
+                q = streamer.window
+                n0 = min(pos, L - q)
+                streamer.stage(n0, n0 + q)
+    finally:
+        streamer.close()
+    return BinnedData(buf, sp, sp_dev, is_cat, B, F, histogram_type)
 
 
 # ---------------------------------------------------------------------------

@@ -237,7 +237,16 @@ def _chunk_len(cols: Dict[str, object]) -> int:
 def frame_from_chunk(cols: Dict[str, object], setup: ParseSetupResult,
                      key: Optional[str] = None):
     """First-chunk landing: build the (appendable) Frame the remaining
-    chunks grow into.  Column order follows the parse setup."""
+    chunks grow into.  Column order follows the parse setup.
+
+    Every device placement here and in the append path goes through
+    ``core/landing.py`` (Vec.data -> cloud().device_put_rows ->
+    landing.land_rows): each chunk is padded to the row quantum and
+    placed shard-by-shard on its home device, so no single host ever
+    stages a whole column — the largest host->device transfer during
+    ingest is ONE shard of one chunk (landing.stats() pull accounting).
+    T_TIME/T_STR payloads stay host-resident residues (core/memory.py
+    tiers them host <-> persist; they never claim HBM)."""
     from h2o_tpu.core.frame import Frame, T_CAT, T_STR, T_TIME, Vec
     names, vecs = [], []
     for name, t in zip(setup.column_names, setup.column_types):

@@ -103,6 +103,17 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
                 os_["oom_events"], os_["sweeps"], os_["degradations"],
                 os_["terminal_failures"], ms["spills"], ms["reloads"],
                 chaos().injected))
+        hits, misses = ms["prefetch_hits"], ms["prefetch_misses"]
+        rate = hits / (hits + misses) if (hits + misses) else 1.0
+        terminalreporter.write_line(
+            "[tier] pages_in={} pages_out={} persists={} "
+            "persist_reloads={} | prefetch_hits={} misses={} "
+            "hit_rate={:.2f} stalls={} | host_bytes={} persist_bytes={} "
+            "peak_hbm={}".format(
+                ms["pages_in"], ms["pages_out"], ms["persists"],
+                ms["persist_reloads"], hits, misses, rate,
+                ms["demand_page_stalls"], ms["tiers"]["host"],
+                ms["tiers"]["persist"], ms["peak_hbm_bytes"]))
         from h2o_tpu.lint import last_summary
         ls = last_summary()
         if ls is not None:

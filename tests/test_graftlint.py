@@ -185,6 +185,18 @@ CASES = [
         def summarize(v):
             return v.to_numpy()
      """, {}),
+    ("GL304", "core/fx.py", """
+        import jax
+        from h2o_tpu.core.cloud import cloud
+
+        def place(arr):
+            return jax.device_put(arr, cloud().row_sharding)
+     """, """
+        from h2o_tpu.core import landing
+
+        def place(arr):
+            return landing.reshard_rows(arr)
+     """, {}),
     ("GL401", "core/store.py", """
         import threading
         import jax.numpy as jnp
@@ -566,7 +578,7 @@ def test_every_legacy_check_has_a_registered_rule():
     assert not missing, f"legacy ad-hoc checks without rules: {missing}"
     # and the new dataflow passes are all present too
     assert {"GL101", "GL102", "GL103", "GL104", "GL201", "GL301",
-            "GL302", "GL401", "GL402", "GL501"} <= ids
+            "GL302", "GL304", "GL401", "GL402", "GL501"} <= ids
 
 
 def test_fixture_table_covers_every_rule():
