@@ -684,6 +684,97 @@ def bench_streaming_refresh(rows=None, chunk_rows=None):
             pass
 
 
+def bench_serving_sustained():
+    """Sustained serving under fixed offered load across a replica
+    fleet (the VERDICT #5 BigScore analog, serving edition): N client
+    threads drive a fixed request rate at a deployed alias routed
+    through the fleet for a fixed window.  Reports achieved scored
+    rows/s (headline), p50/p95/p99 latency of successful requests, the
+    reject rate (429/503 sheds — deliberate degradation, not failures),
+    and the adaptive/breaker state after the run.  Every non-contract
+    status counts as an error."""
+    import threading
+    from h2o_tpu.models.tree.gbm import GBM
+    from h2o_tpu.serve import ServingConfig
+    from h2o_tpu.serve.replica import fleet, reset_fleet
+
+    secs = float(os.environ.get("BENCH_SERVE_SECS", 15.0))
+    offered = float(os.environ.get("BENCH_SERVE_RPS", 300.0))
+    n_rep = int(os.environ.get("BENCH_SERVE_REPLICAS", 3))
+    clients = int(os.environ.get("BENCH_SERVE_CLIENTS", 8))
+    Xt, yt = _make_data(4096, 6, seed=13)
+    fr = _frame(Xt, yt)
+    m = GBM(ntrees=5, max_depth=4, seed=13, nbins=16).train(
+        y="y", training_frame=fr)
+    fl = fleet(n_rep)
+    alias = "bench_serve_sustained"
+    fl.deploy(alias, m, ServingConfig(max_batch=32, max_delay_ms=1.0,
+                                      queue_cap=256, adaptive=True))
+    lat, oks, rejects, errors = [], [0], [0], [0]
+    lock = threading.Lock()
+    stop = threading.Event()
+    interval = clients / max(offered, 1.0)
+    probe = [{f"x{j}": 0.1 for j in range(6)}]
+
+    def client():
+        while not stop.is_set():
+            t0 = time.monotonic()
+            try:
+                fl.score_rows(alias, probe, deadline_ms=2000)
+                with lock:
+                    oks[0] += 1
+                    lat.append((time.monotonic() - t0) * 1000.0)
+            except Exception as e:  # noqa: BLE001 — classify by contract
+                kind = type(e).__name__
+                with lock:
+                    if kind in ("QueueFull", "ShedLoad", "BreakerOpen",
+                                "TimeoutError", "MeshReforming",
+                                "NoHealthyReplica"):
+                        rejects[0] += 1
+                    else:
+                        errors[0] += 1
+            # fixed offered load: sleep off the remainder of the slot
+            left = interval - (time.monotonic() - t0)
+            if left > 0:
+                time.sleep(left)
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(secs)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    wall = time.monotonic() - t0
+    info = fl.describe(alias)
+    total = oks[0] + rejects[0] + errors[0]
+    p50, p95, p99 = (np.percentile(lat, [50, 95, 99])
+                     if lat else (0.0, 0.0, 0.0))
+    out = {"value": round(oks[0] / wall, 1), "unit": "scored req/sec",
+           "wall_s": round(wall, 2), "replicas": n_rep,
+           "clients": clients, "offered_rps": offered,
+           "requests": total, "ok": oks[0],
+           "rejected": rejects[0], "errors": errors[0],
+           "reject_rate": round(rejects[0] / total, 4) if total else 0.0,
+           "p50_ms": round(float(p50), 2),
+           "p95_ms": round(float(p95), 2),
+           "p99_ms": round(float(p99), 2),
+           "max_batch_final": info["config"]["max_batch"]
+           if not info["adaptive"].get("enabled") else
+           info["adaptive"]["max_batch"],
+           "retunes": info["adaptive"].get("retunes", 0),
+           "breaker_trips": (info["breaker"] or {}).get("trips", 0),
+           "fleet_retries": fl.stats()["retries"]}
+    try:
+        fl.undeploy(alias, drain_secs=2.0)
+    except KeyError:
+        pass
+    reset_fleet()
+    return out
+
+
 def bench_lever_ab():
     """Per-lever A/B deltas (core/autotune.py): force-probe every
     registered lever's candidates on the live backend — parity gate +
@@ -1106,7 +1197,7 @@ def _main_ladder(detail):
         "BENCH_CONFIG",
         "gbm,gbm_ua,gbm_bf16,drf,glm,dl,hist,rapidsgb,scaleout,gbm10m,"
         "cpuref,cpuref10m,deep,coldstart,streamref,leverab,elastic,"
-        "auditovh,binspack,tierhbm"
+        "auditovh,binspack,tierhbm,servesus"
     ).split(",")
 
     detail.update({"rows": rows, "cols": cols})
@@ -1154,7 +1245,8 @@ def _main_ladder(detail):
                    if c in ("gbm", "cpuref", "drf", "glm", "hist",
                             "rapidsgb", "scaleout", "gbm10m",
                             "cpuref10m", "coldstart", "leverab",
-                            "elastic", "binspack", "tierhbm")]
+                            "elastic", "binspack", "tierhbm",
+                            "servesus")]
         detail["rows"] = rows
     detail["platform"] = platform
 
@@ -1189,7 +1281,8 @@ def _main_ladder(detail):
             ("binspack", lambda: bench_bins_pack(fr, rows, depth)),
             ("tierhbm", lambda: bench_ingest_bigger_than_hbm(
                 min(rows, int(os.environ.get("BENCH_TIER_ROWS",
-                                             rows))), cols, depth))]
+                                             rows))), cols, depth)),
+            ("servesus", bench_serving_sustained)]
     names = {"hist": "hist_kernel", "gbm10m": "gbm_10m",
              "cpuref": "cpu_reference", "deep": "drf_deep20",
              "gbm_ua": "gbm_uniform_adaptive", "gbm_bf16": "gbm_bf16",
@@ -1202,7 +1295,8 @@ def _main_ladder(detail):
              "elastic": "elastic_resume",
              "auditovh": "audit_overhead",
              "binspack": "bins_pack",
-             "tierhbm": "ingest_bigger_than_hbm"}
+             "tierhbm": "ingest_bigger_than_hbm",
+             "servesus": "serving_sustained"}
     for cfg, fn in runs:
         if cfg not in configs:
             continue

@@ -1312,11 +1312,17 @@ def resilience_stats(params):
     (``tiers.hbm/host/persist``), ``peak_hbm_bytes``, block paging
     counters (``pages_in``/``pages_out``, ``persists``/
     ``persist_reloads``) and the streaming prefetcher's
-    ``prefetch_hits``/``prefetch_misses``/``demand_page_stalls``."""
+    ``prefetch_hits``/``prefetch_misses``/``demand_page_stalls``.
+    The ``serving`` block carries the serve-fleet protection state
+    (serve/registry.serving_stats): process-wide ``breaker_trips``/
+    ``breaker_sheds``/``breaker_half_opens``/``breaker_closes``,
+    ``canary_rollbacks`` and ``shadow_mismatches`` totals, and each
+    deployment's current breaker state and queue depth."""
     from h2o_tpu.core import oom, resilience
     from h2o_tpu.core.chaos import chaos
     from h2o_tpu.core.membership import monitor
     from h2o_tpu.core.memory import manager
+    from h2o_tpu.serve.registry import serving_stats
     jr = cloud().jobs
     c = chaos()
     return {
@@ -1325,6 +1331,7 @@ def resilience_stats(params):
         "oom": oom.stats(),
         "memory": manager().stats(),
         "membership": monitor().payload(),
+        "serving": serving_stats(),
         "watchdog": {"expired_jobs": jr.expired_count,
                      "evicted_jobs": jr.evicted_count,
                      "default_deadline_secs": jr.default_deadline_secs,

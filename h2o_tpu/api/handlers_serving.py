@@ -6,17 +6,27 @@ scoring service REST API), keeping `/3/Predictions` a batch map/reduce.
 This module is that serving front door for the TPU rebuild:
 
 - ``POST   /3/Serving``                    deploy / hot-swap a model
-- ``GET    /3/Serving``                    list deployments + stats
+- ``GET    /3/Serving``                    list deployments + fleet
 - ``GET    /3/Serving/<name>``             one deployment's detail
 - ``POST   /3/Serving/<name>/score``       rows in, predictions out
 - ``POST   /3/Serving/<name>/rollback``    reactivate previous version
 - ``DELETE /3/Serving/<name>``             drain + undeploy
+- ``POST   /3/Serving/<name>/canary``      stage a candidate version
+- ``POST   /3/Serving/<name>/canary/promote``  make the canary active
+- ``DELETE /3/Serving/<name>/canary``      roll the canary back
+- ``POST   /3/Serving/<name>/shadow``      mirror traffic to a version
+- ``DELETE /3/Serving/<name>/shadow``      stop mirroring
 
-Status mapping: queue at capacity -> 429 (load shed), per-request
-deadline exceeded -> 408, unknown alias -> 404, unservable model -> 400,
-terminal device OOM (ladder exhausted, core/oom.py) -> 503, mesh
-re-forming after a slice loss (core/membership.py) -> 503 with a
-``Retry-After`` header.
+Requests route through the replica fleet (serve/replica.py): healthy
+replicas round-robin, a dead replica's traffic redistributes with one
+bounded retry.
+
+Status mapping — every shed carries ``Retry-After``: queue at capacity
+or breaker SHEDDING -> 429 + Retry-After (load shed), breaker OPEN /
+no healthy replica / terminal device OOM -> 503 + Retry-After,
+per-request deadline exceeded -> 408, unknown or undeployed alias ->
+404, unservable model -> 400, mesh re-forming after a slice loss
+(core/membership.py) -> 503 + Retry-After.
 
 NOTE: no ``jax.jit`` may appear in api/handlers*.py (lint-enforced) —
 per-request compiles live behind serve/engine.py's bounded bucket cache.
@@ -33,8 +43,9 @@ from h2o_tpu.core.cloud import cloud
 from h2o_tpu.core.membership import MeshReforming
 from h2o_tpu.core.oom import OOMError
 from h2o_tpu.models.model import Model
-from h2o_tpu.serve import (QueueFull, ServingConfig, UnsupportedModelError,
-                           registry)
+from h2o_tpu.serve import (BreakerOpen, QueueFull, ServingConfig, ShedLoad,
+                           UnsupportedModelError, registry)
+from h2o_tpu.serve.replica import NoHealthyReplica, fleet
 
 
 def _bool(v, default=True) -> bool:
@@ -43,24 +54,41 @@ def _bool(v, default=True) -> bool:
     return str(v).lower() not in ("false", "0", "no")
 
 
-@route("POST", r"/3/Serving")
-def serving_deploy(params):
-    """Deploy (or hot-swap) a trained model under a stable alias."""
+def _retry_after(e, default: float = 1.0) -> Dict[str, str]:
+    secs = getattr(e, "retry_after_s", default)
+    return {"Retry-After": str(max(1, int(round(secs))))}
+
+
+def _config_from(params) -> ServingConfig:
+    return ServingConfig(
+        max_batch=int(params.get("max_batch", 32)),
+        max_delay_ms=float(params.get("max_delay_ms", 2.0)),
+        queue_cap=int(params.get("queue_cap", 64)),
+        deadline_ms=float(params.get("deadline_ms", 0.0)),
+        adaptive=(None if params.get("adaptive") is None
+                  else _bool(params.get("adaptive"))),
+        p99_slo_ms=float(params.get("p99_slo_ms", 0.0)),
+        breaker_enabled=_bool(params.get("breaker_enabled")))
+
+
+def _model_from(params) -> Model:
     model_id = params.get("model_id")
     if not model_id:
         raise H2OError(400, "model_id is required")
     m = cloud().dkv.get(model_id)
     if not isinstance(m, Model):
         raise H2OError(404, f"model {model_id} not found")
-    name = params.get("name") or str(model_id)
-    cfg = ServingConfig(
-        max_batch=int(params.get("max_batch", 32)),
-        max_delay_ms=float(params.get("max_delay_ms", 2.0)),
-        queue_cap=int(params.get("queue_cap", 64)),
-        deadline_ms=float(params.get("deadline_ms", 0.0)))
+    return m
+
+
+@route("POST", r"/3/Serving")
+def serving_deploy(params):
+    """Deploy (or hot-swap) a trained model under a stable alias."""
+    m = _model_from(params)
+    name = params.get("name") or str(params.get("model_id"))
     try:
-        info = registry().deploy(name, m, cfg,
-                                 warm=_bool(params.get("warm")))
+        info = fleet().deploy(name, m, _config_from(params),
+                              warm=_bool(params.get("warm")))
     except UnsupportedModelError as e:
         raise H2OError(400, str(e))
     except RuntimeError as e:
@@ -70,23 +98,24 @@ def serving_deploy(params):
 
 @route("GET", r"/3/Serving")
 def serving_list(params):
-    out = {"deployments": registry().list()}
+    out = {"deployments": fleet().list()}
     out["engine"] = registry().engine.stats()
+    out["fleet"] = fleet().stats()
     return out
 
 
 @route("GET", r"/3/Serving/(?P<name>[^/]+)")
 def serving_get(params, name):
-    dep = registry().get(name)
-    if dep is None:
+    try:
+        return {"deployment": fleet().describe(name)}
+    except KeyError:
         raise H2OError(404, f"no deployment named {name}")
-    return {"deployment": registry().describe(dep)}
 
 
 @route("POST", r"/3/Serving/(?P<name>[^/]+)/rollback")
 def serving_rollback(params, name):
     try:
-        info = registry().rollback(name)
+        info = fleet().rollback(name)
     except KeyError as e:
         raise H2OError(404, str(e))
     except ValueError as e:
@@ -97,11 +126,70 @@ def serving_rollback(params, name):
 @route("DELETE", r"/3/Serving/(?P<name>[^/]+)")
 def serving_undeploy(params, name):
     try:
-        info = registry().undeploy(
+        info = fleet().undeploy(
             name, drain_secs=float(params.get("drain_secs", 10.0)))
     except KeyError as e:
         raise H2OError(404, str(e))
     return info
+
+
+@route("POST", r"/3/Serving/(?P<name>[^/]+)/canary")
+def serving_canary(params, name):
+    """Stage a candidate version behind the alias: ``fraction`` of
+    requests score on it; a regression auto-rolls it back."""
+    m = _model_from(params)
+    try:
+        info = fleet().set_canary(
+            name, m, fraction=float(params.get("fraction", 0.1)))
+    except KeyError as e:
+        raise H2OError(404, str(e))
+    except UnsupportedModelError as e:
+        raise H2OError(400, str(e))
+    except ValueError as e:
+        raise H2OError(409, str(e))
+    return {"deployment": info}
+
+
+@route("POST", r"/3/Serving/(?P<name>[^/]+)/canary/promote")
+def serving_canary_promote(params, name):
+    try:
+        info = fleet().promote_canary(name)
+    except KeyError as e:
+        raise H2OError(404, str(e))
+    except ValueError as e:
+        raise H2OError(400, str(e))
+    return {"deployment": info}
+
+
+@route("DELETE", r"/3/Serving/(?P<name>[^/]+)/canary")
+def serving_canary_clear(params, name):
+    try:
+        info = fleet().clear_canary(name, reason="operator clear")
+    except KeyError as e:
+        raise H2OError(404, str(e))
+    return {"deployment": info}
+
+
+@route("POST", r"/3/Serving/(?P<name>[^/]+)/shadow")
+def serving_shadow(params, name):
+    """Mirror traffic to a shadow version: compared, never returned."""
+    m = _model_from(params)
+    try:
+        info = fleet().set_shadow(name, m)
+    except KeyError as e:
+        raise H2OError(404, str(e))
+    except UnsupportedModelError as e:
+        raise H2OError(400, str(e))
+    return {"deployment": info}
+
+
+@route("DELETE", r"/3/Serving/(?P<name>[^/]+)/shadow")
+def serving_shadow_clear(params, name):
+    try:
+        info = fleet().clear_shadow(name)
+    except KeyError as e:
+        raise H2OError(404, str(e))
+    return {"deployment": info}
 
 
 def _format_predictions(raw: np.ndarray,
@@ -144,26 +232,35 @@ def serving_score(params, name):
         raise H2OError(400, 'body must be JSON {"rows": [{...}, ...]}')
     deadline_ms = params.get("deadline_ms")
     deadline_ms = float(deadline_ms) if deadline_ms is not None else None
-    reg = registry()
+    fl = fleet()
     try:
-        raw, ver = reg.score_rows(name, rows, deadline_ms=deadline_ms)
+        raw, ver = fl.score_rows(name, rows, deadline_ms=deadline_ms)
     except MeshReforming as e:
         # the membership layer is re-forming the mesh after a slice
         # loss: fail fast with an explicit retry window — never hang
         # the request on a dead mesh, never dispatch a stale executable
-        raise H2OError(503, str(e), headers={
-            "Retry-After": str(max(1, int(round(e.retry_after_s))))})
+        raise H2OError(503, str(e), headers=_retry_after(e))
     except KeyError as e:
         raise H2OError(404, str(e))
+    except ShedLoad as e:
+        # breaker SHEDDING: pre-emptive load shed, client backs off
+        raise H2OError(429, str(e), headers=_retry_after(e))
     except QueueFull as e:
-        raise H2OError(429, str(e))
+        raise H2OError(429, str(e), headers=_retry_after(e))
+    except BreakerOpen as e:
+        # breaker OPEN: the trip happened BEFORE the OOM ladder could
+        # reach a terminal RESOURCE_EXHAUSTED — deliberate degradation
+        raise H2OError(503, str(e), headers=_retry_after(e))
+    except NoHealthyReplica as e:
+        raise H2OError(503, str(e), headers=_retry_after(e))
     except TimeoutError as e:
         raise H2OError(408, str(e))
     except OOMError as e:
         # terminal rung of the OOM ladder: this request failed, the
         # server did not — shed it like an overload, clients back off
-        raise H2OError(503, str(e))
-    dep = reg.get(name)
-    domain = reg.response_domain(dep, ver) if dep is not None else None
+        raise H2OError(503, str(e), headers=_retry_after(e, 2.0))
+    dep = fl.get(name)
+    domain = (registry().engine.view(ver.model, ver.version)
+              .response_domain if dep is not None else None)
     return {"model_id": ver.model_id, "version": ver.version,
             "predictions": _format_predictions(raw, domain, rows)}

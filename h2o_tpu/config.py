@@ -44,6 +44,48 @@ Knobs
     (default) streams only when an HBM budget is set and the binned
     matrix would not fit; ``1``/``on`` forces streaming; ``0``/``off``
     disables it even under pressure.
+
+Serving-fleet knobs (``serve/replica.py``)
+------------------------------------------
+
+``H2O_TPU_SERVE_REPLICAS`` — number of serve replicas the fleet layer
+    spins up (default 1: the plain single-registry path).  Replicas are
+    in-process registries sharing one ScoringEngine, so every replica
+    warm-starts kernels + autotune decisions from the shared exec store
+    (``H2O_TPU_EXEC_STORE_DIR``) with zero extra compiles.
+
+Breaker knobs (``serve/breaker.py``) — pressure scores are normalized
+to [0, 1]:
+
+``H2O_TPU_BREAKER_SOFT`` — score at which the breaker enters SHEDDING
+    (shrink batch quanta + refuse a fraction with 429).  Default 0.85.
+
+``H2O_TPU_BREAKER_HARD`` — score at which the breaker trips OPEN
+    (refuse everything with 503 until the cooldown).  Default 0.97.
+
+``H2O_TPU_BREAKER_OPEN_SECS`` — OPEN cooldown before HALF_OPEN probes
+    are admitted.  Default 5.0.
+
+``H2O_TPU_BREAKER_PROBES`` — live requests admitted in HALF_OPEN; all
+    must succeed (with a calm score) to close.  Default 3.
+
+``H2O_TPU_BREAKER_INTERVAL_MS`` — minimum milliseconds between breaker
+    telemetry re-evaluations (admissions in between reuse the last
+    verdict).  Default 50.
+
+``H2O_TPU_BREAKER_STALL_SOFT`` — demand-page stalls per sample window
+    that count as a fully-saturated stall signal.  Default 4.
+
+Adaptive micro-batching knobs (``serve/batcher.py`` tuner) — bounds are
+pow2 so adaptation never leaves the engine's compiled bucket set:
+
+``H2O_TPU_SERVE_ADAPTIVE`` — ``1`` enables the adaptive batch tuner by
+    default for new deployments (default ``0``: static knobs; the
+    REST/``ServingConfig`` field overrides per deployment).
+
+``H2O_TPU_SERVE_MIN_BATCH`` / ``H2O_TPU_SERVE_MAX_BATCH`` — inclusive
+    pow2 bounds the tuner may move ``max_batch`` within (defaults 1 and
+    128; non-pow2 values are rounded up to the next bucket).
 """
 
 import os
@@ -51,6 +93,10 @@ import os
 __all__ = [
     "hbm_budget", "host_budget", "tier_block_rows", "prefetch_depth",
     "shard_landing_enabled", "tier_stream_mode",
+    "serve_replicas", "breaker_soft", "breaker_hard",
+    "breaker_open_secs", "breaker_probes", "breaker_interval_ms",
+    "breaker_stall_soft", "serve_adaptive_default", "serve_min_batch",
+    "serve_max_batch",
 ]
 
 
@@ -85,3 +131,56 @@ def shard_landing_enabled() -> bool:
 def tier_stream_mode() -> str:
     """``auto`` | ``on``/``1`` | ``off``/``0`` (normalized, lowercase)."""
     return os.environ.get("H2O_TPU_TIER_STREAM", "auto").lower()
+
+
+def serve_replicas() -> int:
+    """Serve-fleet size (default 1 = single-registry path)."""
+    return max(1, int(os.environ.get("H2O_TPU_SERVE_REPLICAS", "1") or 1))
+
+
+def breaker_soft() -> float:
+    """Pressure score that enters SHEDDING (shrink + 429s)."""
+    return float(os.environ.get("H2O_TPU_BREAKER_SOFT", "0.85") or 0.85)
+
+
+def breaker_hard() -> float:
+    """Pressure score that trips OPEN (503s until cooldown)."""
+    return float(os.environ.get("H2O_TPU_BREAKER_HARD", "0.97") or 0.97)
+
+
+def breaker_open_secs() -> float:
+    """OPEN cooldown seconds before HALF_OPEN probes are admitted."""
+    return float(os.environ.get("H2O_TPU_BREAKER_OPEN_SECS", "5.0") or 5.0)
+
+
+def breaker_probes() -> int:
+    """Live requests admitted while HALF_OPEN."""
+    return max(1, int(os.environ.get("H2O_TPU_BREAKER_PROBES", "3") or 3))
+
+
+def breaker_interval_ms() -> float:
+    """Minimum ms between breaker telemetry re-evaluations."""
+    return float(os.environ.get("H2O_TPU_BREAKER_INTERVAL_MS", "50")
+                 or 50.0)
+
+
+def breaker_stall_soft() -> float:
+    """Demand-page stalls per sample that saturate the stall signal."""
+    return float(os.environ.get("H2O_TPU_BREAKER_STALL_SOFT", "4") or 4.0)
+
+
+def serve_adaptive_default() -> bool:
+    """Whether new deployments default to the adaptive batch tuner."""
+    return os.environ.get("H2O_TPU_SERVE_ADAPTIVE", "0").lower() in (
+        "1", "on", "true", "yes")
+
+
+def serve_min_batch() -> int:
+    """Lower pow2 bound for the adaptive tuner's ``max_batch``."""
+    return max(1, int(os.environ.get("H2O_TPU_SERVE_MIN_BATCH", "1") or 1))
+
+
+def serve_max_batch() -> int:
+    """Upper pow2 bound for the adaptive tuner's ``max_batch``."""
+    return max(1, int(os.environ.get("H2O_TPU_SERVE_MAX_BATCH", "128")
+                      or 128))

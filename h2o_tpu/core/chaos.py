@@ -32,6 +32,11 @@ the HOST layer the framework owns:
 - kernel-reject faults: a fused-kernel dispatch raises a synthetic
   Pallas/VMEM-gate rejection, proving kernel_fallback degrades to the
   portable XLA path;
+- serve-pressure faults: the serving circuit breaker's telemetry read
+  reports a synthetic critical memory-pressure sample, so the full
+  breaker protocol (serve/breaker.py: shrink quanta -> shed 429 ->
+  trip open -> half-open probe -> close) is exercisable on CPU CI
+  without a real HBM budget or traffic storm;
 - slice-loss faults: a dispatch choke point raises a synthetic
   "device unavailable" (a preempted TPU slice / ICI fault) — either
   with a probability, or DETERMINISTICALLY at the Nth dispatch of each
@@ -68,6 +73,8 @@ H2O_TPU_CHAOS_STREAM_TRUNCATE_TRANSIENT=N   fail first N reads of each
 H2O_TPU_CHAOS_STREAM_SLOW / _STREAM_SLOW_MS P/duration of a stalled read
 H2O_TPU_CHAOS_KERNEL_REJECT                 P(synthetic Pallas/VMEM-gate
                                             kernel rejection)
+H2O_TPU_CHAOS_SERVE_PRESSURE                P(breaker telemetry read sees
+                                            synthetic critical pressure)
 H2O_TPU_CHAOS_SLICE_LOSS                    P(synthetic device-unavailable
                                             slice loss)
 H2O_TPU_CHAOS_SLICE_LOSS_AT_BLOCK=N         lose the slice exactly once,
@@ -158,6 +165,8 @@ class _Chaos:
             e("H2O_TPU_CHAOS_STREAM_SLOW_MS", 100) or 100)
         self.kernel_reject_p = float(
             e("H2O_TPU_CHAOS_KERNEL_REJECT", 0) or 0)
+        self.serve_pressure_p = float(
+            e("H2O_TPU_CHAOS_SERVE_PRESSURE", 0) or 0)
         self.slice_loss_p = float(e("H2O_TPU_CHAOS_SLICE_LOSS", 0) or 0)
         self.slice_loss_at_block = int(
             e("H2O_TPU_CHAOS_SLICE_LOSS_AT_BLOCK", 0) or 0)
@@ -181,6 +190,7 @@ class _Chaos:
         self.injected_slow_streams = 0
         self.injected_kernel_rejects = 0
         self.injected_slice_losses = 0
+        self.injected_serve_pressure = 0
 
     @property
     def enabled(self) -> bool:
@@ -191,6 +201,7 @@ class _Chaos:
                 self.oom_transient > 0 or self.stream_truncate_p > 0 or
                 self.stream_truncate_transient > 0 or
                 self.stream_slow_p > 0 or self.kernel_reject_p > 0 or
+                self.serve_pressure_p > 0 or
                 self.slice_loss_p > 0 or self.slice_loss_at_block > 0)
 
     def counters(self) -> Dict[str, int]:
@@ -205,7 +216,7 @@ class _Chaos:
                 "injected_slow_scores", "injected_slow_transfers",
                 "injected_oom", "injected_stream_truncations",
                 "injected_slow_streams", "injected_kernel_rejects",
-                "injected_slice_losses")}
+                "injected_slice_losses", "injected_serve_pressure")}
 
     def _roll(self, p: float) -> bool:
         if p <= 0:
@@ -272,6 +283,23 @@ class _Chaos:
             raise ChaosKernelRejectError(
                 f"injected Pallas kernel rejection at {site}: working "
                 f"set exceeds VMEM (synthetic)")
+
+    def maybe_serve_pressure(self, site: str) -> bool:
+        """Serve-pressure injector: called by the serving circuit
+        breaker (serve/breaker.py) each time it samples its telemetry.
+        Returns True when the sample must be treated as CRITICAL
+        memory pressure — the breaker then walks its protocol (shrink
+        quanta -> shed -> trip open) exactly as it would under a real
+        HBM squeeze, without CI needing a budget or a traffic storm.
+        Unlike the raising injectors this one only biases a reading, so
+        no exception type: the breaker's response IS the behavior under
+        test."""
+        if self._roll(self.serve_pressure_p):
+            with self._lock:
+                self.injected_serve_pressure += 1
+            log.warning("chaos: injecting serve pressure at %s", site)
+            return True
+        return False
 
     def maybe_lose_slice(self, site: str) -> None:
         """Slice-loss injector: called at dispatch choke points (the
@@ -422,6 +450,7 @@ def configure(job_p: float = 0.0, device_put_p: float = 0.0,
               stream_slow_p: float = 0.0,
               stream_slow_ms: float = 100.0,
               kernel_reject_p: float = 0.0,
+              serve_pressure_p: float = 0.0,
               slice_loss_p: float = 0.0,
               slice_loss_at_block: int = 0) -> _Chaos:
     """Programmatic enable (tests); returns the active instance."""
@@ -444,6 +473,7 @@ def configure(job_p: float = 0.0, device_put_p: float = 0.0,
     _instance.oom_p = float(oom_p)
     _instance.oom_transient = int(oom_transient)
     _instance.kernel_reject_p = float(kernel_reject_p)
+    _instance.serve_pressure_p = float(serve_pressure_p)
     _instance.slice_loss_p = float(slice_loss_p)
     _instance.slice_loss_at_block = int(slice_loss_at_block)
     if seed is not None:

@@ -530,6 +530,29 @@ class MemoryManager:
                     # terminal diagnostic names these
                     "largest_holders": sizes[:5]}
 
+    def pressure(self) -> dict:
+        """One memory-pressure sample for the serving circuit breaker
+        (serve/breaker.py): ``hbm_frac`` is resident/budget (0.0 when
+        unbounded — nothing to protect against), plus the CUMULATIVE
+        paging counters the breaker differentiates between samples
+        (demand-page stalls and pages in/out rising between two reads
+        mean the tier store is actively thrashing — the leading
+        indicator that the next big dispatch walks the OOM ladder).
+        Cheap by design: sums the residency table under the lock, no
+        device work, no I/O — safe from the admission path."""
+        with self._lock:
+            self._prune()
+            hbm = sum(self._resident.values())
+            return {
+                "hbm_frac": (hbm / self.budget) if self.budget > 0
+                else 0.0,
+                "resident_bytes": hbm,
+                "demand_page_stalls": self.demand_stall_count,
+                "pages_in": self.pages_in,
+                "pages_out": self.pages_out,
+                "spills": self.spill_count,
+            }
+
 
 _manager: Optional[MemoryManager] = None
 _manager_lock = make_lock("memory._manager_lock")

@@ -1,5 +1,5 @@
-"""GL401–GL403 — lock discipline around the DKV, memory manager, and
-membership supervisor.
+"""GL401–GL404 — lock discipline around the DKV, memory manager,
+membership supervisor, and serving breaker/fleet.
 
 The PR 5 deadlock class: ``MemoryManager._spill_lru`` once called
 ``Vec._spill()`` while holding the manager lock; the spill path
@@ -31,6 +31,15 @@ that way:
   ``auto_recover`` / ``probe``) under it would let one dying mesh hang
   every thread that reports a loss or checks serving admission.
   Collect under the lock, act after releasing.
+- **GL404** the same discipline for the serving protection layer's
+  locks (serve/breaker.py ``_breaker_lock``, serve/replica.py fleet
+  locks — any lock whose dotted name contains ``breaker`` or
+  ``fleet``): the breaker lock sits on EVERY admission and the fleet
+  lock on every routing decision, so a blocking wait, device dispatch,
+  or recovery step under either stalls the whole serve path — exactly
+  the PR 5 / PR 12 deadlock family the supervisor rule closed for
+  membership.  (A fleet lock named with ``supervisor`` is GL403's;
+  GL404 covers the rest so renaming can't dodge the discipline.)
 """
 
 from __future__ import annotations
@@ -153,6 +162,51 @@ def check_supervisor_lock(mi: ModuleInfo, ctx):
                     f"OUTSIDE it (collect under the lock, act after "
                     f"releasing)",
                     detail=f"under-supervisor-lock:{bad}"))
+    return out
+
+
+def _breaker_fleet_locks(node: ast.With) -> List[str]:
+    return [name for name in _with_locks(node)
+            if ("breaker" in name.lower() or "fleet" in name.lower())
+            and "supervisor" not in name.lower()]
+
+
+@rule("GL404", "blocking-under-breaker-lock")
+def check_breaker_lock(mi: ModuleInfo, ctx):
+    out: List[Finding] = []
+    for node in ast.walk(mi.tree):
+        if not isinstance(node, ast.With):
+            continue
+        held = _breaker_fleet_locks(node)
+        if not held:
+            continue
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if not isinstance(sub, ast.Call):
+                    continue
+                chain = classify._attr_chain(sub.func)
+                name = classify._call_name(sub)
+                bad = None
+                if chain and chain[0] in ("jax", "jnp"):
+                    bad = ".".join(chain)
+                elif name in _DEVICE or name in _SUPERVISOR_BLOCKING:
+                    bad = name
+                if bad is None:
+                    continue
+                out.append(Finding(
+                    "GL404", "error", mi.rel, sub.lineno,
+                    mi.scope_of(sub),
+                    f"`{bad}(...)` while holding {'/'.join(held)} — "
+                    f"breaker/fleet locks sit on every serving admission "
+                    f"and routing decision, so they may only guard state "
+                    f"transitions; blocking waits, device dispatch and "
+                    f"recovery steps must run OUTSIDE them (sample "
+                    f"telemetry first, publish the verdict under the "
+                    f"lock)",
+                    detail=f"under-breaker-lock:{bad}"))
     return out
 
 

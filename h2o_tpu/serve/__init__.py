@@ -14,13 +14,28 @@ frame.  This package is the missing online half for the TPU rebuild:
   functions with power-of-two batch bucketing, and a pure-NumPy
   ``mojo``-scorer fallback for model types without a device predict;
 - :mod:`h2o_tpu.serve.batcher` — micro-batching of concurrent requests
-  into one device batch with a bounded admission queue (load shedding)
-  and per-request deadlines.
+  into one device batch with a bounded admission queue (load shedding),
+  per-request deadlines, and an adaptive tuner that retunes
+  ``max_batch``/``max_delay_ms`` from measured load within the pow2
+  buckets the engine compiles;
+- :mod:`h2o_tpu.serve.breaker` — the pre-emptive load-shedding circuit
+  breaker (memory-tier pressure + queue depth + p99 ->
+  shrink / shed 429 / trip 503, with hysteresis and half-open probes);
+- :mod:`h2o_tpu.serve.replica` — the replica fleet: N registries
+  sharing one engine (exec-store warm starts), DKV-published
+  deployments, health-gated round-robin routing with one bounded
+  retry, and canary/shadow rollout fanned out fleet-wide.
 
 REST surface: ``/3/Serving`` (h2o_tpu/api/handlers_serving.py).
 """
 
-from h2o_tpu.serve.batcher import MicroBatcher, QueueFull  # noqa: F401
+from h2o_tpu.serve.batcher import (AdaptiveBatchTuner,  # noqa: F401
+                                   BatcherStopped, MicroBatcher,
+                                   QueueFull)
+from h2o_tpu.serve.breaker import (BreakerOpen, LoadBreaker,  # noqa: F401
+                                   ShedLoad)
 from h2o_tpu.serve.engine import ScoringEngine  # noqa: F401
 from h2o_tpu.serve.registry import (ServingConfig,  # noqa: F401
-                                    UnsupportedModelError, registry)
+                                    UnsupportedModelError, registry,
+                                    serving_stats)
+from h2o_tpu.serve.replica import ReplicaFleet, fleet  # noqa: F401

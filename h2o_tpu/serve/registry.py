@@ -18,10 +18,27 @@ binding switches atomically under the deployment lock:
 Per-deployment stats: request/reject/deadline-expired counters and
 p50/p95/p99 latency over a fixed-size ring buffer (the TimeLine-ring
 idiom from core/diag.py applied to serving latency).
+
+This PR grows each deployment into a protected, self-tuning unit:
+
+- a :class:`~h2o_tpu.serve.breaker.LoadBreaker` gates every admission
+  (pre-emptive shed/trip on memory-tier pressure, queue depth, p99);
+- an optional :class:`~h2o_tpu.serve.batcher.AdaptiveBatchTuner`
+  retunes the micro-batcher from measured load (paused while the
+  breaker is anything but CLOSED — never fight the protection);
+- **canary**: ``set_canary`` routes a deterministic fraction of
+  requests to a candidate version on its own batcher lane; a windowed
+  error-rate/p99 comparison against the primary auto-rolls the canary
+  back, and a canary-lane failure falls back to the stable lane so the
+  blast radius is zero client-visible errors;
+- **shadow**: ``set_shadow`` mirrors scored traffic to a shadow
+  version on a bounded drop-oldest queue; results are compared
+  (mismatch counter) but NEVER returned.
 """
 
 from __future__ import annotations
 
+import queue as _queue
 import threading
 import time
 from collections import deque
@@ -34,7 +51,9 @@ from h2o_tpu.core.diag import TimeLine
 from h2o_tpu.core.lockwitness import make_lock
 from h2o_tpu.core.log import get_logger
 from h2o_tpu.core.resilience import Deadline
-from h2o_tpu.serve.batcher import MicroBatcher, QueueFull
+from h2o_tpu.serve.batcher import (AdaptiveBatchTuner, BatcherStopped,
+                                   MicroBatcher, QueueFull)
+from h2o_tpu.serve.breaker import BreakerOpen, LoadBreaker, ShedLoad
 from h2o_tpu.serve.engine import ScoringEngine
 
 log = get_logger("serve")
@@ -50,17 +69,27 @@ class ServingConfig:
     """Per-deployment tuning (REST params of POST /3/Serving)."""
 
     def __init__(self, max_batch: int = 32, max_delay_ms: float = 2.0,
-                 queue_cap: int = 64, deadline_ms: float = 0.0):
+                 queue_cap: int = 64, deadline_ms: float = 0.0,
+                 adaptive: Optional[bool] = None, p99_slo_ms: float = 0.0,
+                 breaker_enabled: bool = True):
+        from h2o_tpu import config as _cfg
         self.max_batch = int(max_batch)
         self.max_delay_ms = float(max_delay_ms)
         self.queue_cap = int(queue_cap)
         self.deadline_ms = float(deadline_ms)   # 0 = unbounded
+        self.adaptive = (_cfg.serve_adaptive_default() if adaptive is None
+                         else bool(adaptive))
+        self.p99_slo_ms = float(p99_slo_ms)     # 0 = no latency signal
+        self.breaker_enabled = bool(breaker_enabled)
 
     def as_dict(self) -> Dict[str, Any]:
         return {"max_batch": self.max_batch,
                 "max_delay_ms": self.max_delay_ms,
                 "queue_cap": self.queue_cap,
-                "deadline_ms": self.deadline_ms}
+                "deadline_ms": self.deadline_ms,
+                "adaptive": self.adaptive,
+                "p99_slo_ms": self.p99_slo_ms,
+                "breaker_enabled": self.breaker_enabled}
 
 
 class DeploymentStats:
@@ -69,10 +98,13 @@ class DeploymentStats:
         self.requests = 0
         self.rejected = 0
         self.expired = 0
+        self.errors = 0
         self.batches = 0
         self.rows_scored = 0
         self.max_observed_batch = 0
         self.latency_ms: deque = deque(maxlen=LATENCY_RING)
+        self._p99 = 0.0
+        self._p99_at = 0.0
 
     def record_batch(self, n_requests: int, n_rows: int) -> None:
         with self.lock:
@@ -80,12 +112,27 @@ class DeploymentStats:
             self.rows_scored += n_rows
             self.max_observed_batch = max(self.max_observed_batch, n_rows)
 
+    def p99_ms(self) -> float:
+        """Cheap cached p99 for the breaker's admission-path sampling
+        (recomputed at most every 100ms — never a per-request
+        percentile over the full ring)."""
+        now = time.monotonic()
+        with self.lock:
+            if now - self._p99_at < 0.1:
+                return self._p99
+            lat = list(self.latency_ms)
+        p = float(np.percentile(lat, 99)) if lat else 0.0
+        with self.lock:
+            self._p99, self._p99_at = p, now
+        return p
+
     def snapshot(self) -> Dict[str, Any]:
         with self.lock:
             lat = list(self.latency_ms)
             out = {"request_count": self.requests,
                    "reject_count": self.rejected,
                    "deadline_expired_count": self.expired,
+                   "error_count": self.errors,
                    "batch_count": self.batches,
                    "rows_scored": self.rows_scored,
                    "max_observed_batch": self.max_observed_batch}
@@ -117,8 +164,27 @@ class Deployment:
         self.versions: List[DeploymentVersion] = []
         self.active: Optional[DeploymentVersion] = None
         self.draining = False
+        self.removed = False        # set before eviction: no straggler
         self.stats = DeploymentStats()
         self.created = time.time()
+        self.breaker: Optional[LoadBreaker] = None
+        self.tuner: Optional[AdaptiveBatchTuner] = None
+        # canary lane (candidate version on its own batcher)
+        self.canary: Optional[DeploymentVersion] = None
+        self.canary_batcher: Optional[MicroBatcher] = None
+        self.canary_fraction = 0.0
+        self.canary_stats = DeploymentStats()
+        self.canary_rollbacks = 0
+        self.canary_fallbacks = 0
+        self._route_counter = 0
+        # shadow lane (mirrored, compared, never returned)
+        self.shadow: Optional[DeploymentVersion] = None
+        self._shadow_q: Optional["_queue.Queue"] = None
+        self._shadow_thread: Optional[threading.Thread] = None
+        self.shadow_compared = 0
+        self.shadow_mismatches = 0
+        self.shadow_errors = 0
+        self.shadow_dropped = 0
 
 
 class ServingRegistry:
@@ -153,6 +219,10 @@ class ServingRegistry:
                     max_delay_ms=config.max_delay_ms,
                     queue_cap=config.queue_cap, name=name,
                     on_batch=lambda k, n, _d=dep: self._on_batch(_d, k, n))
+                dep.breaker = LoadBreaker(
+                    name, p99_slo_ms=config.p99_slo_ms,
+                    on_shrink=lambda _d=dep: self._shrink_batch(_d),
+                    on_restore=lambda _d=dep: self._restore_batch(_d))
                 self._deployments[name] = dep
             elif dep.draining:
                 raise RuntimeError(f"deployment {name} is draining")
@@ -166,6 +236,11 @@ class ServingRegistry:
             dep.config = config
             dep.batcher.configure(config.max_batch, config.max_delay_ms,
                                   config.queue_cap)
+            dep.breaker.p99_slo_ms = config.p99_slo_ms
+            if config.adaptive and dep.tuner is None:
+                dep.tuner = AdaptiveBatchTuner(dep.batcher)
+            elif not config.adaptive:
+                dep.tuner = None
             dep.versions.append(ver)
             swapped = dep.active is not None
             dep.active = ver
@@ -196,7 +271,14 @@ class ServingRegistry:
         return self.describe(dep)
 
     def undeploy(self, name: str, drain_secs: float = 10.0) -> Dict:
-        """Drain in-flight requests, then remove the alias."""
+        """Drain in-flight requests, then remove the alias.
+
+        Ordering is the undeploy/score race fix: ``draining`` turns new
+        admissions into 404 immediately; the table entry is popped and
+        ``removed`` is set BEFORE any version is evicted, so a straggler
+        batch that slipped past the admission gate fails its requests
+        with 404 in ``_score_batch`` rather than ever scoring against a
+        half-removed deployment."""
         dep = self._get(name)
         with dep.lock:
             dep.draining = True
@@ -205,10 +287,20 @@ class ServingRegistry:
             time.sleep(0.005)
         drained = dep.batcher.pending == 0
         dep.batcher.stop()
+        if dep.canary_batcher is not None:
+            dep.canary_batcher.stop()
+        if dep._shadow_q is not None:
+            dep._shadow_q.put(None)     # shadow worker exit sentinel
         with self._lock:
             self._deployments.pop(name, None)
+        with dep.lock:
+            dep.removed = True
         for ver in dep.versions:
             self.engine.evict(ver.model_id, ver.version)
+        if dep.canary is not None:
+            self.engine.evict(dep.canary.model_id, dep.canary.version)
+        if dep.shadow is not None:
+            self.engine.evict(dep.shadow.model_id, dep.shadow.version)
         TimeLine.record("serve", "undeploy", deployment=name,
                         drained=drained)
         log.info("serve: undeployed %s (drained=%s)", name, drained)
@@ -230,7 +322,9 @@ class ServingRegistry:
         """Encode+score ``rows`` through the deployment's micro-batcher.
 
         Raises ``KeyError`` (unknown/draining alias), :class:`QueueFull`
-        (shed — HTTP 429), ``TimeoutError`` (per-request deadline), and
+        or :class:`ShedLoad` (shed — HTTP 429 + Retry-After),
+        :class:`BreakerOpen` (HTTP 503 + Retry-After while the breaker
+        is open), ``TimeoutError`` (per-request deadline), and
         ``MeshReforming`` (HTTP 503 + Retry-After) while the membership
         layer is re-forming the mesh after a slice loss — a request in
         that window must fail fast and retry, never hang on a dead mesh
@@ -249,10 +343,102 @@ class ServingRegistry:
         st = dep.stats
         with st.lock:
             st.requests += 1
+        if dep.breaker is not None and dep.config.breaker_enabled:
+            p99 = (st.p99_ms() if dep.breaker.p99_slo_ms > 0 else 0.0)
+            try:
+                dep.breaker.admit(dep.batcher.pending,
+                                  dep.batcher.queue_cap, p99)
+            except (ShedLoad, BreakerOpen):
+                with st.lock:
+                    st.rejected += 1
+                TimeLine.record("serve", "breaker_reject",
+                                deployment=name)
+                raise
         if deadline_ms is None:
             deadline_ms = dep.config.deadline_ms
         dl = Deadline(deadline_ms / 1000.0) if deadline_ms else Deadline(0)
+        # deterministic canary routing: every k-th request takes the
+        # candidate lane (a whole batch is one version, so the lanes
+        # are separate batchers rather than per-request version mixes)
+        lane = dep.batcher
+        canary = None
+        if dep.canary is not None and dep.canary_fraction > 0:
+            with dep.lock:
+                canary = dep.canary
+                if canary is not None:
+                    dep._route_counter += 1
+                    k = max(1, int(round(1.0 / dep.canary_fraction)))
+                    if dep._route_counter % k == 0:
+                        lane = dep.canary_batcher
+        on_canary = lane is not dep.batcher
+        lane_stats = dep.canary_stats if on_canary else st
+        if on_canary:
+            with lane_stats.lock:
+                lane_stats.requests += 1
         t0 = time.monotonic()
+        try:
+            fut = lane.submit(rows, deadline=dl)
+        except QueueFull:
+            if on_canary:
+                # canary lane over capacity: fall back to the stable
+                # lane rather than shedding a request the primary could
+                # have served
+                return self._primary_fallback(dep, name, rows, dl,
+                                              deadline_ms, t0)
+            with st.lock:
+                st.rejected += 1
+            TimeLine.record("serve", "shed", deployment=name)
+            raise
+        except BatcherStopped:
+            raise KeyError(f"deployment {name} was undeployed")
+        timeout = dl.remaining()
+        try:
+            raw = fut.result(timeout=None if timeout == float("inf")
+                             else timeout)
+        except (TimeoutError, _FuturesTimeout):
+            # worker-side expiry or wait timeout — same contract (408)
+            with lane_stats.lock:
+                lane_stats.expired += 1
+            if dep.breaker is not None:
+                dep.breaker.note_result(False)
+            if on_canary:
+                self._note_canary(dep)
+            raise TimeoutError(
+                f"scoring request on {name} exceeded its "
+                f"{deadline_ms:g}ms deadline")
+        except BatcherStopped:
+            raise KeyError(f"deployment {name} was undeployed")
+        except Exception:
+            with lane_stats.lock:
+                lane_stats.errors += 1
+            if dep.breaker is not None:
+                dep.breaker.note_result(False)
+            if on_canary:
+                # candidate version misbehaving: count it against the
+                # canary and serve the client from the stable lane
+                self._note_canary(dep)
+                with dep.lock:
+                    dep.canary_fallbacks += 1
+                return self._primary_fallback(dep, name, rows, dl,
+                                              deadline_ms, t0)
+            raise
+        with lane_stats.lock:
+            lane_stats.latency_ms.append((time.monotonic() - t0) * 1000.0)
+        if dep.breaker is not None:
+            dep.breaker.note_result(True)
+        if on_canary:
+            self._note_canary(dep)
+        ver = canary if on_canary else dep.active
+        out = np.asarray(raw)
+        if not on_canary:
+            self._mirror_shadow(dep, rows, out)
+        return out, ver
+
+    def _primary_fallback(self, dep: Deployment, name: str,
+                          rows: Sequence[dict], dl: Deadline,
+                          deadline_ms: float, t0: float):
+        """Stable-lane fallback for a failed/overfull canary request."""
+        st = dep.stats
         try:
             fut = dep.batcher.submit(rows, deadline=dl)
         except QueueFull:
@@ -260,21 +446,25 @@ class ServingRegistry:
                 st.rejected += 1
             TimeLine.record("serve", "shed", deployment=name)
             raise
+        except BatcherStopped:
+            raise KeyError(f"deployment {name} was undeployed")
         timeout = dl.remaining()
         try:
             raw = fut.result(timeout=None if timeout == float("inf")
                              else timeout)
         except (TimeoutError, _FuturesTimeout):
-            # worker-side expiry or wait timeout — same contract (408)
             with st.lock:
                 st.expired += 1
             raise TimeoutError(
                 f"scoring request on {name} exceeded its "
                 f"{deadline_ms:g}ms deadline")
+        except BatcherStopped:
+            raise KeyError(f"deployment {name} was undeployed")
         with st.lock:
             st.latency_ms.append((time.monotonic() - t0) * 1000.0)
-        ver = dep.active
-        return np.asarray(raw), ver
+        out = np.asarray(raw)
+        self._mirror_shadow(dep, rows, out)
+        return out, dep.active
 
     def _score_batch(self, dep: Deployment, rows: List[dict]):
         """Batch body run on the worker thread: resolve the ACTIVE
@@ -285,6 +475,12 @@ class ServingRegistry:
         # with the same 503-retry contract as the admission gate
         from h2o_tpu.core.membership import monitor
         monitor().check_serving()
+        if dep.removed:
+            # the undeploy/score race, closed: the deployment's entry is
+            # gone and its versions are being (or have been) evicted — a
+            # straggler batch must 404 its requests, never hand back a
+            # result scored against a half-removed deployment
+            raise KeyError(f"deployment {dep.name} was undeployed")
         ver = dep.active
         if ver is None:
             # belt-and-braces for the same first-deploy window: a batch
@@ -294,11 +490,254 @@ class ServingRegistry:
         X = self.engine.encode_rows(ver.model, ver.version, rows)
         return self.engine.predict(ver.model, ver.version, X)
 
+    def _score_canary_batch(self, dep: Deployment, rows: List[dict]):
+        """Canary-lane batch body: score against the CANDIDATE."""
+        from h2o_tpu.core.membership import monitor
+        monitor().check_serving()
+        if dep.removed:
+            raise KeyError(f"deployment {dep.name} was undeployed")
+        ver = dep.canary
+        if ver is None:
+            raise KeyError(
+                f"deployment {dep.name} has no canary version")
+        X = self.engine.encode_rows(ver.model, ver.version, rows)
+        return self.engine.predict(ver.model, ver.version, X)
+
     def _on_batch(self, dep: Deployment, n_requests: int,
                   n_rows: int) -> None:
         dep.stats.record_batch(n_requests, n_rows)
+        # adaptive retune from measured load — paused unless the
+        # breaker is CLOSED (never regrow batches under pressure)
+        if dep.tuner is not None and (
+                dep.breaker is None or dep.breaker.state == "closed"):
+            dep.tuner.observe(dep.batcher.pending, n_rows)
         TimeLine.record("serve", "batch", deployment=dep.name,
                         requests=n_requests, rows=n_rows)
+
+    def _shrink_batch(self, dep: Deployment) -> None:
+        """Breaker SHEDDING entry: halve the batch quantum (pow2, floor
+        1) — smaller dispatches mean smaller transient HBM while the
+        pressure lasts."""
+        from h2o_tpu.core.exec_store import bucket_pow2
+        cur = bucket_pow2(max(1, dep.batcher.max_batch))
+        new = max(1, cur // 2)
+        dep.batcher.configure(max_batch=new)
+        TimeLine.record("serve", "batch_shrink", deployment=dep.name,
+                        max_batch=new)
+        log.warning("serve: %s under pressure, batch quantum %d -> %d",
+                    dep.name, cur, new)
+
+    def _restore_batch(self, dep: Deployment) -> None:
+        """Breaker re-close: restore the configured knobs (the adaptive
+        tuner takes it from there if enabled)."""
+        dep.batcher.configure(max_batch=dep.config.max_batch,
+                              max_delay_ms=dep.config.max_delay_ms)
+        TimeLine.record("serve", "batch_restore", deployment=dep.name,
+                        max_batch=dep.config.max_batch)
+
+    # -- canary / shadow -----------------------------------------------------
+
+    def set_canary(self, name: str, model,
+                   fraction: float = 0.1) -> Dict[str, Any]:
+        """Stage ``model`` as the canary for alias ``name``: a
+        deterministic ``fraction`` of requests scores on the candidate
+        lane; a windowed regression check auto-rolls it back."""
+        if not self.engine.supports(model):
+            raise UnsupportedModelError(
+                f"model type '{model.algo}' is not servable: no device "
+                "predict_raw_array and no standalone MOJO scorer")
+        fraction = min(0.5, max(0.0, float(fraction)))
+        dep = self._get(name)
+        if dep.draining:
+            raise KeyError(f"deployment {name} is draining")
+        with dep.lock:
+            if dep.canary is not None:
+                raise ValueError(
+                    f"deployment {name} already has a canary "
+                    f"(v{dep.canary.version}); promote or clear it first")
+            version = (dep.versions[-1].version + 1) if dep.versions else 1
+        ver = DeploymentVersion(version, model)
+        self.engine.warm(model, version,
+                         batch_sizes=(1, dep.config.max_batch))
+        with dep.lock:
+            if dep.canary_batcher is None:
+                dep.canary_batcher = MicroBatcher(
+                    score_fn=lambda rows, _d=dep: self._score_canary_batch(
+                        _d, rows),
+                    max_batch=dep.config.max_batch,
+                    max_delay_ms=dep.config.max_delay_ms,
+                    queue_cap=max(2, dep.config.queue_cap // 4),
+                    name=f"{name}#canary")
+            dep.canary = ver
+            dep.canary_fraction = fraction
+            dep.canary_stats = DeploymentStats()
+            dep._route_counter = 0
+        TimeLine.record("serve", "canary_start", deployment=name,
+                        model=ver.model_id, version=version,
+                        fraction=fraction)
+        log.info("serve: canary on %s -> %s v%d at %.0f%%", name,
+                 ver.model_id, version, fraction * 100)
+        return self.describe(dep)
+
+    def promote_canary(self, name: str) -> Dict[str, Any]:
+        """Make the canary the active version (hot swap semantics)."""
+        dep = self._get(name)
+        with dep.lock:
+            ver = dep.canary
+            if ver is None:
+                raise ValueError(f"deployment {name} has no canary")
+            dep.canary = None
+            dep.canary_fraction = 0.0
+            dep.versions.append(ver)
+            dep.active = ver
+        TimeLine.record("serve", "canary_promote", deployment=name,
+                        version=ver.version)
+        log.info("serve: promoted canary on %s -> v%d", name, ver.version)
+        return self.describe(dep)
+
+    def clear_canary(self, name: str,
+                     reason: str = "cleared") -> Dict[str, Any]:
+        """Drop the canary (manual clear or auto-rollback): routing
+        stops first, then the candidate's programs are evicted."""
+        dep = self._get(name)
+        with dep.lock:
+            ver = dep.canary
+            dep.canary = None
+            dep.canary_fraction = 0.0
+        if ver is not None:
+            self.engine.evict(ver.model_id, ver.version)
+            TimeLine.record("serve", "canary_rollback", deployment=name,
+                            version=ver.version, reason=reason)
+            log.warning("serve: canary on %s rolled back (v%d): %s",
+                        name, ver.version, reason)
+        return self.describe(dep)
+
+    def _note_canary(self, dep: Deployment) -> None:
+        """Windowed canary-vs-primary regression check, run after every
+        canary-lane outcome (the caller has already recorded the
+        outcome in ``canary_stats``): an error rate more than 10 points
+        over the primary's, or a p99 beyond 2x the primary's,
+        auto-rolls back."""
+        cs = dep.canary_stats
+        with cs.lock:
+            creq = cs.requests
+            cerr = cs.errors + cs.expired
+        if creq < 5:
+            return
+        st = dep.stats
+        with st.lock:
+            preq = max(1, st.requests)
+            perr = st.errors + st.expired
+        c_rate = cerr / creq
+        p_rate = perr / preq
+        regression = None
+        if c_rate > p_rate + 0.10:
+            regression = (f"error rate {c_rate:.0%} vs primary "
+                          f"{p_rate:.0%}")
+        elif creq >= 20:
+            c99, p99 = cs.p99_ms(), st.p99_ms()
+            if p99 > 0 and c99 > 2.0 * p99:
+                regression = (f"p99 {c99:.1f}ms vs primary "
+                              f"{p99:.1f}ms")
+        if regression is None:
+            return
+        with dep.lock:
+            if dep.canary is None:      # another thread rolled it back
+                return
+            dep.canary_rollbacks += 1
+        self.clear_canary(dep.name, reason=f"auto-rollback: {regression}")
+
+    def set_shadow(self, name: str, model) -> Dict[str, Any]:
+        """Mirror scored traffic to ``model`` on a bounded drop-oldest
+        queue; predictions are compared against the primary's (mismatch
+        counter on describe()) and NEVER returned to a client."""
+        if not self.engine.supports(model):
+            raise UnsupportedModelError(
+                f"model type '{model.algo}' is not servable: no device "
+                "predict_raw_array and no standalone MOJO scorer")
+        dep = self._get(name)
+        if dep.draining:
+            raise KeyError(f"deployment {name} is draining")
+        with dep.lock:
+            version = (dep.versions[-1].version + 1) if dep.versions else 1
+        ver = DeploymentVersion(version, model)
+        self.engine.warm(model, version,
+                         batch_sizes=(1, dep.config.max_batch))
+        with dep.lock:
+            dep.shadow = ver
+            dep.shadow_compared = 0
+            dep.shadow_mismatches = 0
+            dep.shadow_errors = 0
+            dep.shadow_dropped = 0
+            if dep._shadow_q is None:
+                dep._shadow_q = _queue.Queue(maxsize=64)
+                dep._shadow_thread = threading.Thread(
+                    target=self._shadow_loop, args=(dep,), daemon=True,
+                    name=f"h2o-shadow-{name}")
+                dep._shadow_thread.start()
+        TimeLine.record("serve", "shadow_start", deployment=name,
+                        model=ver.model_id, version=version)
+        log.info("serve: shadowing %s with %s v%d", name, ver.model_id,
+                 version)
+        return self.describe(dep)
+
+    def clear_shadow(self, name: str) -> Dict[str, Any]:
+        dep = self._get(name)
+        with dep.lock:
+            ver = dep.shadow
+            dep.shadow = None
+        if ver is not None:
+            self.engine.evict(ver.model_id, ver.version)
+            TimeLine.record("serve", "shadow_stop", deployment=name,
+                            version=ver.version)
+        return self.describe(dep)
+
+    def _mirror_shadow(self, dep: Deployment, rows: Sequence[dict],
+                       primary: np.ndarray) -> None:
+        """Primary-path mirror: enqueue-or-drop, never block scoring."""
+        if dep.shadow is None or dep._shadow_q is None:
+            return
+        item = (list(rows), primary)
+        try:
+            dep._shadow_q.put_nowait(item)
+        except _queue.Full:
+            with dep.lock:
+                dep.shadow_dropped += 1
+            try:
+                dep._shadow_q.get_nowait()      # drop-oldest
+            except _queue.Empty:
+                pass
+            try:
+                dep._shadow_q.put_nowait(item)
+            except _queue.Full:
+                pass
+
+    def _shadow_loop(self, dep: Deployment) -> None:
+        """Shadow worker: score mirrored rows on the shadow version and
+        compare — results stay in the counters, never in a response."""
+        while True:
+            item = dep._shadow_q.get()
+            if item is None:
+                return
+            ver = dep.shadow
+            if ver is None or dep.removed:
+                continue
+            rows, primary = item
+            try:
+                X = self.engine.encode_rows(ver.model, ver.version, rows)
+                out = np.asarray(
+                    self.engine.predict(ver.model, ver.version, X))
+                match = (out.shape == primary.shape and np.allclose(
+                    out, primary, rtol=1e-3, atol=1e-5, equal_nan=True))
+                with dep.lock:
+                    dep.shadow_compared += 1
+                    if not match:
+                        dep.shadow_mismatches += 1
+            except Exception as e:  # noqa: BLE001 — shadow never hurts
+                with dep.lock:
+                    dep.shadow_errors += 1
+                log.debug("serve: shadow scoring on %s failed: %s",
+                          dep.name, e)
 
     # -- introspection -------------------------------------------------------
 
@@ -334,7 +773,34 @@ class ServingRegistry:
             "config": dep.config.as_dict(),
             "queue_depth": dep.batcher.pending,
             "stats": dep.stats.snapshot(),
+            "breaker": dep.breaker.stats() if dep.breaker else None,
+            "adaptive": (dep.tuner.stats() if dep.tuner
+                         else {"enabled": False}),
+            "canary": self._describe_canary(dep),
+            "shadow": self._describe_shadow(dep),
         }
+
+    def _describe_canary(self, dep: Deployment) -> Dict[str, Any]:
+        with dep.lock:
+            ver = dep.canary
+            out = {"rollbacks": dep.canary_rollbacks,
+                   "fallbacks": dep.canary_fallbacks}
+        if ver is not None:
+            out.update(model_id=ver.model_id, version=ver.version,
+                       fraction=dep.canary_fraction,
+                       stats=dep.canary_stats.snapshot())
+        return out
+
+    def _describe_shadow(self, dep: Deployment) -> Dict[str, Any]:
+        with dep.lock:
+            ver = dep.shadow
+            out = {"compared": dep.shadow_compared,
+                   "mismatches": dep.shadow_mismatches,
+                   "errors": dep.shadow_errors,
+                   "dropped": dep.shadow_dropped}
+        if ver is not None:
+            out.update(model_id=ver.model_id, version=ver.version)
+        return out
 
     def list(self) -> List[Dict[str, Any]]:
         with self._lock:
@@ -353,3 +819,32 @@ def registry() -> ServingRegistry:
             if _instance is None:
                 _instance = ServingRegistry()
     return _instance
+
+
+def serving_stats() -> Dict[str, Any]:
+    """The ``serving`` block of ``GET /3/Resilience``: process-wide
+    breaker totals plus per-deployment protection state (cheap — no
+    device work).  Safe to call before any deployment exists."""
+    from h2o_tpu.serve import breaker as _breaker
+    out: Dict[str, Any] = dict(_breaker.totals())
+    deployments: Dict[str, Any] = {}
+    canary_rollbacks = 0
+    shadow_mismatches = 0
+    reg = _instance
+    if reg is not None:
+        with reg._lock:
+            deps = list(reg._deployments.values())
+        for dep in deps:
+            canary_rollbacks += dep.canary_rollbacks
+            shadow_mismatches += dep.shadow_mismatches
+            deployments[dep.name] = {
+                "breaker_state": (dep.breaker.state if dep.breaker
+                                  else None),
+                "breaker_trips": (dep.breaker.trips if dep.breaker
+                                  else 0),
+                "queue_depth": dep.batcher.pending,
+            }
+    out.update(canary_rollbacks=canary_rollbacks,
+               shadow_mismatches=shadow_mismatches,
+               deployments=deployments)
+    return out

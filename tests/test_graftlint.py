@@ -270,6 +270,34 @@ CASES = [
                 if stable:
                     job.join(timeout=1.0)
      """, {}),
+    # GL404: the serving breaker/fleet locks sit on every admission and
+    # routing decision — same discipline, different lock family
+    ("GL404", "serve/breaker.py", """
+        class LoadBreaker:
+            def admit(self, fut):
+                with self._breaker_lock:
+                    return fut.result(timeout=5.0)
+     """, """
+        class LoadBreaker:
+            def admit(self, fut):
+                with self._breaker_lock:
+                    state = self.state
+                if state == "open":
+                    return fut.result(timeout=5.0)
+                return None
+     """, {}),
+    ("GL404", "serve/replica.py", """
+        class ReplicaFleet:
+            def kill(self, rep):
+                with self._fleet_lock:
+                    rep.batcher.join(timeout=1.0)
+     """, """
+        class ReplicaFleet:
+            def kill(self, rep):
+                with self._fleet_lock:
+                    rep.healthy = False
+                rep.batcher.join(timeout=1.0)
+     """, {}),
     ("GL402", "core/fx.py", """
         import threading
 

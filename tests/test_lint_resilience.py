@@ -96,7 +96,7 @@ def test_chaos_injection_sequence_is_seed_deterministic():
                             oom_p=0.4, stream_truncate_p=0.4,
                             stream_slow_p=0.4, stream_slow_ms=0.0,
                             kernel_reject_p=0.4, slice_loss_p=0.4,
-                            seed=1234)
+                            serve_pressure_p=0.4, seed=1234)
         seq = []
         for i in range(30):
             for step, fn in (
@@ -114,7 +114,9 @@ def test_chaos_injection_sequence_is_seed_deterministic():
                     ("kreject", lambda: c.maybe_kernel_reject(
                         f"kern{i}")),
                     ("sloss", lambda: c.maybe_lose_slice(
-                        f"slice{i}"))):
+                        f"slice{i}")),
+                    ("spressure", lambda: c.maybe_serve_pressure(
+                        f"dep{i}"))):
                 before = c.injected
                 try:
                     fn()
@@ -137,5 +139,7 @@ def test_chaos_injection_sequence_is_seed_deterministic():
             "drill never exercised the kernel-reject injector"
         assert c1["injected_slice_losses"] > 0, \
             "drill never exercised the slice-loss injector"
+        assert c1["injected_serve_pressure"] > 0, \
+            "drill never exercised the serve-pressure injector"
     finally:
         chaos.reset()

@@ -1,0 +1,520 @@
+"""The serving protection layer (PR 16): LoadBreaker state machine,
+adaptive micro-batching, the replica fleet, canary/shadow rollout, and
+the chaos serve-pressure drill.
+
+Covers the ISSUE 16 acceptance surface that test_serving.py (single
+registry, happy path + shed/deadline) does not: breaker transitions
+closed -> shedding -> open -> half_open -> closed under deterministic
+pressure, Retry-After on every shed, the counters' path through
+``GET /3/Resilience``, pow2-bounded adaptive retuning with zero
+steady-state recompiles, kill/redistribute with at most one bounded
+retry, canary auto-rollback, shadow mismatch counting, and a mini
+chaos drill where every refusal is a classified protocol error.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.shared_dkv
+
+N_ROWS = 160
+
+
+def _call(srv, method, path, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}", data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+@pytest.fixture(scope="module")
+def data(cl):
+    rng = np.random.default_rng(23)
+    X = rng.normal(size=(N_ROWS, 4)).astype(np.float32)
+    logits = 1.1 * X[:, 0] - 0.7 * X[:, 1] + X[:, 2]
+    y = (rng.uniform(size=N_ROWS) <
+         1 / (1 + np.exp(-logits))).astype(np.int32)
+    return X, y
+
+
+def _make_frame(data):
+    from h2o_tpu.core.frame import Frame, T_CAT, Vec
+    X, y = data
+    names = [f"x{j}" for j in range(4)] + ["y"]
+    vecs = [Vec(X[:, j]) for j in range(4)] + \
+        [Vec(y, T_CAT, domain=["no", "yes"])]
+    return Frame(names, vecs)
+
+
+def _rows(data, idx):
+    X, _y = data
+    return [{f"x{j}": float(X[i, j]) for j in range(4)} for i in idx]
+
+
+@pytest.fixture(scope="module")
+def models(cl, data):
+    from h2o_tpu.models.glm import GLM
+    from h2o_tpu.models.tree.gbm import GBM
+    fr = _make_frame(data)
+    gbm = GBM(ntrees=4, max_depth=3, seed=9).train(
+        y="y", training_frame=fr)
+    glm = GLM(family="binomial").train(y="y", training_frame=fr)
+    return {"gbm": gbm, "glm": glm}
+
+
+@pytest.fixture()
+def clean_serve(cl):
+    """Every test starts and ends with no fleet, no deployments, no
+    chaos, and zeroed breaker totals."""
+    from h2o_tpu.core.chaos import reset as chaos_reset
+    from h2o_tpu.serve import breaker, registry
+    from h2o_tpu.serve.replica import reset_fleet
+    reset_fleet()
+    registry().reset()
+    breaker.reset_totals()
+    yield
+    chaos_reset()
+    reset_fleet()
+    registry().reset()
+    breaker.reset_totals()
+
+
+def _ref(models, data):
+    gbm = models["gbm"]
+    fr = _make_frame(data)
+    Xraw = np.column_stack(
+        [np.asarray(fr.vec(c).as_float())[:N_ROWS]
+         for c in gbm.output["x"]])
+    return np.asarray(gbm.predict_array(Xraw))
+
+
+# -- breaker state machine ---------------------------------------------------
+
+def test_breaker_full_cycle_closed_shed_open_halfopen_closed(
+        cl, clean_serve):
+    """Walk the whole protocol with deterministic queue pressure (no
+    chaos): every shed carries Retry-After, OPEN pre-empts admission,
+    probes close the breaker only when the score has calmed."""
+    from h2o_tpu.serve import breaker as B
+    from h2o_tpu.serve.breaker import BreakerOpen, LoadBreaker, ShedLoad
+    fired = []
+    b = LoadBreaker("cycle", soft=0.6, hard=0.95, open_secs=0.05,
+                    probe_n=2, interval_ms=0, p99_slo_ms=0.0,
+                    on_shrink=lambda: fired.append("shrink"),
+                    on_restore=lambda: fired.append("restore"))
+    b.admit(0, 10)
+    assert b.state == "closed"
+    # sustained 0.8 pressure: SHEDDING, a deterministic fraction refused
+    sheds, admits = 0, 0
+    for _ in range(20):
+        try:
+            b.admit(8, 10)
+            admits += 1
+        except ShedLoad as e:
+            assert e.retry_after_s > 0          # Retry-After, every time
+            sheds += 1
+    assert b.state == "shedding"
+    assert fired == ["shrink"]                  # batch quantum shrank once
+    assert sheds > 0 and admits > 0             # fraction, not blackout
+    # pressure crosses HARD: trips OPEN and refuses with the cooldown
+    with pytest.raises(BreakerOpen) as ei:
+        b.admit(10, 10)
+    assert ei.value.retry_after_s > 0
+    assert b.state == "open" and b.trips == 1
+    with pytest.raises(BreakerOpen):
+        b.admit(0, 10)                          # still cooling down
+    time.sleep(0.06)
+    # cooldown elapsed: HALF_OPEN admits exactly probe_n live probes
+    b.admit(0, 10)
+    assert b.state == "half_open"
+    b.admit(0, 10)
+    with pytest.raises(BreakerOpen):
+        b.admit(0, 10)                          # probe window is full
+    b.note_result(True)
+    b.note_result(True)                         # both probes ok + calm
+    assert b.state == "closed"
+    assert fired == ["shrink", "restore"]
+    edges = [(e["from"], e["to"]) for e in b.stats()["events"]]
+    assert ("closed", "shedding") in edges
+    assert ("shedding", "open") in edges
+    assert ("open", "half_open") in edges
+    assert ("half_open", "closed") in edges
+    totals = B.totals()
+    assert totals["breaker_trips"] >= 1
+    assert totals["breaker_sheds"] >= sheds
+    assert totals["breaker_half_opens"] >= 1
+    assert totals["breaker_closes"] >= 1
+
+
+def test_halfopen_probe_failure_reopens(cl, clean_serve):
+    from h2o_tpu.serve.breaker import BreakerOpen, LoadBreaker
+    b = LoadBreaker("reopen", soft=0.6, hard=0.95, open_secs=0.02,
+                    probe_n=2, interval_ms=0)
+    with pytest.raises(BreakerOpen):
+        b.admit(10, 10)
+    time.sleep(0.03)
+    b.admit(0, 10)
+    assert b.state == "half_open"
+    b.note_result(False)                        # one failed probe
+    assert b.state == "open" and b.trips == 2
+
+
+def test_breaker_chaos_trip_reaches_resilience_payload(
+        cl, data, models, clean_serve):
+    """The injected-pressure path end to end: chaos forces a critical
+    sample, the breaker trips OPEN before any device dispatch could hit
+    the OOM ladder, and both the injection counter and the trip are
+    visible on GET /3/Resilience."""
+    from h2o_tpu.api.handlers import resilience_stats
+    from h2o_tpu.core.chaos import configure
+    from h2o_tpu.serve.breaker import BreakerOpen
+    from h2o_tpu.serve.registry import ServingConfig, registry
+    reg = registry()
+    reg.deploy("chaostrip", models["gbm"], ServingConfig())
+    configure(serve_pressure_p=1.0, seed=3)
+    with pytest.raises(BreakerOpen) as ei:
+        reg.score_rows("chaostrip", _rows(data, [0]))
+    assert ei.value.retry_after_s > 0
+    dep = reg.get("chaostrip")
+    assert dep.breaker.state == "open"
+    assert dep.breaker.signals.get("injected") == 1.0
+    payload = resilience_stats({})
+    serving = payload["serving"]
+    assert serving["breaker_trips"] >= 1
+    assert serving["deployments"]["chaostrip"]["breaker_state"] == "open"
+    assert payload["chaos"]["injected_serve_pressure"] >= 1
+    assert dep.stats.snapshot()["reject_count"] >= 1
+
+
+# -- adaptive micro-batching -------------------------------------------------
+
+def test_adaptive_retunes_pow2_bounded(cl, data, models, clean_serve):
+    """Deterministic tuner drive: sustained demand doubles the batch
+    quantum up pow2 buckets (never past hi), a sustained idle window
+    halves it back (never past lo); the delay stretches and relaxes
+    with it."""
+    from h2o_tpu.serve.registry import ServingConfig, registry
+    reg = registry()
+    reg.deploy("adapt", models["gbm"],
+               ServingConfig(max_batch=4, max_delay_ms=2.0,
+                             queue_cap=16, adaptive=True))
+    dep = reg.get("adapt")
+    t = dep.tuner
+    assert t is not None and t.stats()["enabled"]
+    for _ in range(t.window):                   # demand ~0.75: grow
+        t.observe(12, 4)
+    assert dep.batcher.max_batch == 8
+    assert dep.batcher.max_delay_ms > 2.0
+    for _ in range(t.window):
+        t.observe(12, 8)
+    assert dep.batcher.max_batch == 16
+    for _ in range(6 * t.window):               # idle: shrink back down
+        t.observe(0, 1)
+    s = t.stats()
+    # floor is 2, not lo=1: at max_batch 2 a 1-row batch is HALF full,
+    # which fails the idle test (fill <= 0.25) — exactly the guard that
+    # keeps the tuner from thrashing at the bottom of the range
+    assert dep.batcher.max_batch == 2
+    assert dep.batcher.max_delay_ms == pytest.approx(2.0)
+    assert s["grows"] >= 2 and s["shrinks"] >= 1
+    assert t.lo <= s["max_batch"] <= t.hi
+    assert s["max_batch"] & (s["max_batch"] - 1) == 0    # pow2
+
+
+def test_adaptive_traffic_steady_state_zero_recompiles(
+        cl, data, models, clean_serve):
+    """Real traffic through an adaptive deployment: once the tuner has
+    settled, further bursts add ZERO compiled entries — the tuner can
+    only pick pow2 buckets the engine already compiled."""
+    from h2o_tpu.serve.registry import ServingConfig, registry
+    reg = registry()
+    reg.deploy("steady", models["gbm"],
+               ServingConfig(max_batch=4, max_delay_ms=1.0,
+                             queue_cap=16, adaptive=True))
+
+    def burst():
+        errs = []
+        barrier = threading.Barrier(6)
+
+        def worker(tid):
+            barrier.wait()
+            for i in range(tid, 48, 6):
+                try:
+                    reg.score_rows("steady", _rows(data, [i % N_ROWS]))
+                except Exception as e:  # noqa: BLE001 — collected
+                    errs.append(repr(e))
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+
+    burst()                                     # warm + let it retune
+    entries_settled = reg.engine.compiled_entries
+    burst()                                     # steady state
+    assert reg.engine.compiled_entries == entries_settled
+    mb = reg.get("steady").batcher.max_batch
+    assert mb & (mb - 1) == 0                   # still on a pow2 bucket
+
+
+# -- replica fleet -----------------------------------------------------------
+
+def test_fleet_deploy_converges_and_routes(cl, data, models, clean_serve):
+    from h2o_tpu.serve.replica import fleet
+    fl = fleet(3)
+    fl.deploy("fanout", models["gbm"])
+    assert fl.converged("fanout")
+    assert fl.routed("fanout")
+    assert fl.records()["fanout"]["model_id"] == str(models["gbm"].key)
+    ref = _ref(models, data)
+    for i in range(12):                         # round-robins the fleet
+        out, ver = fl.score_rows("fanout", _rows(data, [i]))
+        assert ver.version == 1
+        assert abs(out[0][2] - ref[i, 2]) < 1e-5
+    served = [r.served for r in fl.replicas]
+    assert sum(served) == 12
+    assert sum(1 for s in served if s > 0) >= 2     # spread, not pinned
+    info = fl.describe("fanout")
+    assert info["fleet"]["routed"] is True
+    fl.undeploy("fanout", drain_secs=2.0)
+    assert not fl.routed("fanout")
+    with pytest.raises(KeyError):
+        fl.score_rows("fanout", _rows(data, [0]))
+
+
+def test_fleet_dead_replica_redistributes_one_retry(
+        cl, data, models, clean_serve):
+    """A replica that dies mid-flight (batchers stopped, health bit
+    still up — the worst case) costs each affected request AT MOST one
+    bounded retry on another replica; the fleet health-gates it out on
+    first contact and later revives it from the DKV records with a
+    warm-started registry."""
+    from h2o_tpu.serve.replica import fleet
+    fl = fleet(3)
+    fl.deploy("failover", models["gbm"])
+    ref = _ref(models, data)
+    # simulate an unannounced death: stop replica 1's batchers but
+    # leave it routed — the next request landing there must fail over
+    dead = fl.replicas[1]
+    for dep in dead.registry._deployments.values():
+        dep.batcher.stop(timeout=1.0)
+    for i in range(24):
+        out, _ver = fl.score_rows("failover", _rows(data, [i]))
+        assert abs(out[0][2] - ref[i, 2]) < 1e-5     # client never errors
+    st = fl.stats()
+    assert st["healthy"] == 2                   # health-gated out
+    assert st["redistributed"] >= 1
+    assert st["retries"] == st["redistributed"]  # at most ONE per request
+    # revive: registry rebuilt from the fleet's DKV records
+    fl.revive(1)
+    assert fl.stats()["healthy"] == 3
+    assert fl.converged("failover")
+    out, _ver = fl.score_rows("failover", _rows(data, [0]))
+    assert abs(out[0][2] - ref[0, 2]) < 1e-5
+
+
+def test_fleet_all_dead_is_503_class(cl, data, models, clean_serve):
+    from h2o_tpu.serve.replica import NoHealthyReplica, fleet
+    fl = fleet(2)
+    fl.deploy("doomed", models["gbm"])
+    fl.kill(0)
+    fl.kill(1)
+    with pytest.raises(NoHealthyReplica) as ei:
+        fl.score_rows("doomed", _rows(data, [0]))
+    assert ei.value.retry_after_s > 0
+
+
+# -- canary / shadow ---------------------------------------------------------
+
+def test_canary_promote_happy_path(cl, data, models, clean_serve):
+    from h2o_tpu.serve.registry import ServingConfig, registry
+    reg = registry()
+    reg.deploy("canp", models["gbm"], ServingConfig())
+    info = reg.set_canary("canp", models["glm"], fraction=0.5)
+    assert info["canary"]["version"] == 2
+    for i in range(8):                          # both lanes serve 200s
+        out, ver = reg.score_rows("canp", _rows(data, [i]))
+        assert ver.version in (1, 2)
+        assert np.isfinite(np.asarray(out, dtype=float)).all()
+    versions = {reg.score_rows("canp", _rows(data, [i]))[1].version
+                for i in range(8)}
+    assert versions == {1, 2}                   # deterministic 50% split
+    info = reg.promote_canary("canp")
+    assert info["version"] == 2 and info["canary"].get("version") is None
+    out, ver = reg.score_rows("canp", _rows(data, [0]))
+    assert ver.version == 2                     # candidate went active
+
+
+def test_canary_regression_auto_rolls_back(cl, data, models, clean_serve):
+    """A canary whose scoring errors must (a) never surface to clients
+    — every canary-lane failure falls back to the stable lane — and
+    (b) auto-roll back once the windowed error-rate check fires."""
+    from h2o_tpu.core.diag import TimeLine
+    from h2o_tpu.serve.registry import ServingConfig, registry
+    reg = registry()
+    reg.deploy("canbad", models["gbm"], ServingConfig())
+    reg.set_canary("canbad", models["glm"], fraction=0.5)
+    dep = reg.get("canbad")
+    bad_version = dep.canary.version
+    orig = reg.engine.predict
+
+    def boom(model, version, X):
+        if version == bad_version and \
+                str(model.key) == str(models["glm"].key):
+            raise RuntimeError("canary regression (injected)")
+        return orig(model, version, X)
+
+    reg.engine.predict = boom
+    try:
+        ref = _ref(models, data)
+        for i in range(30):
+            out, ver = reg.score_rows("canbad", _rows(data, [i]))
+            assert ver.version == 1             # client only ever sees v1
+            assert abs(out[0][2] - ref[i, 2]) < 1e-5
+            if dep.canary is None:
+                break
+    finally:
+        reg.engine.predict = orig
+    assert dep.canary is None                   # rolled back, not promoted
+    assert dep.canary_rollbacks == 1
+    assert dep.canary_fallbacks >= 5            # failures served by primary
+    info = reg.describe(dep)
+    assert info["canary"]["rollbacks"] == 1
+    events = [e for e in TimeLine.snapshot()
+              if e["kind"] == "serve" and e["what"] == "canary_rollback"]
+    assert any("auto-rollback" in e.get("reason", "") for e in events)
+
+
+def test_shadow_mismatches_counted_never_returned(
+        cl, data, models, clean_serve):
+    """Shadow traffic scores on the mirror, disagreements land in a
+    counter, and the client's bytes are the primary's alone."""
+    from h2o_tpu.serve.registry import ServingConfig, registry
+    reg = registry()
+    reg.deploy("shad", models["gbm"], ServingConfig())
+    reg.set_shadow("shad", models["glm"])
+    dep = reg.get("shad")
+    ref = _ref(models, data)
+    n = 8
+    for i in range(n):
+        out, ver = reg.score_rows("shad", _rows(data, [i]))
+        assert ver.version == 1
+        assert abs(out[0][2] - ref[i, 2]) < 1e-5     # primary's answer
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        with dep.lock:
+            done = dep.shadow_compared + dep.shadow_errors
+            done += dep.shadow_dropped
+        if done >= n:
+            break
+        time.sleep(0.02)
+    info = reg.describe(dep)
+    assert info["shadow"]["compared"] >= 1
+    assert info["shadow"]["mismatches"] >= 1    # GLM disagrees with GBM
+    reg.clear_shadow("shad")
+    assert reg.get("shad").shadow is None
+
+
+# -- REST surface ------------------------------------------------------------
+
+@pytest.fixture()
+def srv(cl, clean_serve):
+    from h2o_tpu.api.server import RestServer
+    server = RestServer(port=0).start()
+    yield server
+    server.stop()
+
+
+def test_rest_fleet_canary_shadow_and_retry_after(
+        cl, data, models, srv):
+    from h2o_tpu.core.chaos import configure, reset
+    gbm, glm = models["gbm"], models["glm"]
+    st, r, _h = _call(srv, "POST", "/3/Serving",
+                      {"model_id": str(gbm.key), "name": "restfleet"})
+    assert st == 200, r
+    st, r, _h = _call(srv, "GET", "/3/Serving")
+    assert st == 200 and r["fleet"]["healthy"] >= 1
+    st, r, _h = _call(srv, "POST", "/3/Serving/restfleet/canary",
+                      {"model_id": str(glm.key), "fraction": 0.25})
+    assert st == 200 and r["deployment"]["canary"]["version"] == 2
+    st, r, _h = _call(srv, "DELETE", "/3/Serving/restfleet/canary")
+    assert st == 200 and r["deployment"]["canary"].get("version") is None
+    st, r, _h = _call(srv, "POST", "/3/Serving/restfleet/shadow",
+                      {"model_id": str(glm.key)})
+    assert st == 200 and r["deployment"]["shadow"]["version"] >= 2
+    st, r, _h = _call(srv, "DELETE", "/3/Serving/restfleet/shadow")
+    assert st == 200
+    # a tripped breaker answers 503 + Retry-After over the wire
+    configure(serve_pressure_p=1.0, seed=5)
+    try:
+        st, r, hdrs = _call(srv, "POST", "/3/Serving/restfleet/score",
+                            {"rows": _rows(data, [0])})
+        assert st == 503, r
+        assert float(hdrs["Retry-After"]) > 0
+    finally:
+        reset()
+    st, r, _h = _call(srv, "GET", "/3/Resilience")
+    assert st == 200
+    assert r["serving"]["breaker_trips"] >= 1
+    assert r["serving"]["deployments"]["restfleet"]["breaker_state"] \
+        == "open"
+    st, r, _h = _call(srv, "DELETE", "/3/Serving/restfleet")
+    assert st == 200
+
+
+# -- the mini chaos drill ----------------------------------------------------
+
+def test_serve_pressure_drill_every_refusal_classified(
+        cl, data, models, clean_serve, monkeypatch):
+    """A scaled-down soak acceptance drill: 3 replicas, chaos
+    serve-pressure injection, a replica death mid-drill.  Invariants:
+    zero unclassified errors (every refusal is a protocol error with a
+    Retry-After where the contract demands one), the breaker tripped
+    at least once and recovered, and the fleet kept serving
+    throughout."""
+    from h2o_tpu.core.chaos import configure
+    from h2o_tpu.serve.breaker import BreakerOpen, ShedLoad
+    from h2o_tpu.serve.replica import fleet
+    monkeypatch.setenv("H2O_TPU_BREAKER_OPEN_SECS", "0.05")
+    monkeypatch.setenv("H2O_TPU_BREAKER_INTERVAL_MS", "0")
+    fl = fleet(3)
+    fl.deploy("drill", models["gbm"])
+    configure(serve_pressure_p=0.25, seed=11)
+    ok, classified, unclassified = 0, 0, []
+    for i in range(150):
+        if i == 60:                             # death mid-drill
+            for dep in fl.replicas[2].registry._deployments.values():
+                dep.batcher.stop(timeout=1.0)
+        try:
+            out, _ver = fl.score_rows("drill", _rows(data, [i % N_ROWS]))
+            assert np.isfinite(np.asarray(out, dtype=float)).all()
+            ok += 1
+        except (ShedLoad, BreakerOpen) as e:
+            assert e.retry_after_s > 0
+            classified += 1
+        except Exception as e:  # noqa: BLE001 — the drill's invariant
+            unclassified.append((i, repr(e)))
+        time.sleep(0.002)
+    assert not unclassified, unclassified
+    assert ok > 0, "drill never scored a single request"
+    assert classified > 0, "chaos pressure never refused anything"
+    from h2o_tpu.serve.breaker import totals
+    t = totals()
+    assert t["breaker_trips"] >= 1
+    assert t["breaker_closes"] >= 1             # it recovered, too
+    st = fl.stats()
+    assert st["healthy"] == 2                   # the death was gated out
+    assert st["redistributed"] >= 1

@@ -345,6 +345,133 @@ def test_device_gate_active_on_host_mesh(cl):
             pass
 
 
+# -- satellite: hot-reconfigure under fire ----------------------------------
+
+def test_hot_reconfigure_hammer(cl, data, models):
+    """Batcher.configure() racing live traffic: 8 scorer threads hammer
+    one deployment while a reconfigure thread flips max_batch /
+    max_delay_ms every few ms.  The snapshot contract (knobs read once
+    under the lock at batch open) means every request is scored exactly
+    once against the right model — no lost futures, no double scores,
+    no torn (max_batch, max_delay) pairs mid-batch."""
+    from h2o_tpu.serve import registry
+    gbm = models["gbm"]
+    reg = registry()
+    from h2o_tpu.serve.registry import ServingConfig
+    reg.deploy("reconf", gbm, ServingConfig(max_batch=8, max_delay_ms=2,
+                                            queue_cap=512))
+    fr = _make_frame(data)
+    Xraw = np.column_stack(
+        [np.asarray(fr.vec(c).as_float())[:N_ROWS]
+         for c in gbm.output["x"]])
+    ref = np.asarray(gbm.predict_array(Xraw))
+    dep = reg.get("reconf")
+    stop = threading.Event()
+
+    def reconfigurer():
+        flip = 0
+        while not stop.is_set():
+            flip += 1
+            dep.batcher.configure(
+                max_batch=(1, 4, 16, 64)[flip % 4],
+                max_delay_ms=(0.0, 1.0, 5.0)[flip % 3])
+
+    results = {}
+    errors = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(8)
+
+    def scorer(tid):
+        barrier.wait()
+        for i in range(tid, 96, 8):
+            try:
+                out, _ver = reg.score_rows("reconf", _rows(data, [i]))
+                with lock:
+                    assert i not in results     # no double-scoring
+                    results[i] = out[0]
+            except Exception as e:  # noqa: BLE001 — collected for assert
+                with lock:
+                    errors.append((i, repr(e)))
+
+    rc = threading.Thread(target=reconfigurer, daemon=True)
+    rc.start()
+    threads = [threading.Thread(target=scorer, args=(t,))
+               for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    rc.join(timeout=5)
+    assert not errors, errors
+    assert len(results) == 96           # nothing lost
+    for i, p in results.items():
+        assert abs(p[2] - ref[i, 2]) < 1e-5, i
+    snap = dep.stats.snapshot()
+    assert snap["request_count"] == 96  # each request counted once
+    reg.undeploy("reconf", drain_secs=2.0)
+
+
+# -- satellite: the undeploy/score race --------------------------------------
+
+def test_undeploy_score_race_is_404_never_halfway(cl, data, models):
+    """Requests racing an undeploy must each resolve to exactly one of:
+    a complete, correct prediction (admitted before the removal) or
+    KeyError/404 (after).  Never a hang, a half-removed result, or an
+    unclassified error.  Regression for the PR 16 race close:
+    ``Deployment.removed`` is set before version eviction, and the
+    worker checks it before dispatching a batch."""
+    from h2o_tpu.serve import registry
+    from h2o_tpu.serve.registry import ServingConfig
+    gbm = models["gbm"]
+    reg = registry()
+    fr = _make_frame(data)
+    Xraw = np.column_stack(
+        [np.asarray(fr.vec(c).as_float())[:N_ROWS]
+         for c in gbm.output["x"]])
+    ref = np.asarray(gbm.predict_array(Xraw))
+    for attempt in range(3):            # the race needs a few rolls
+        alias = f"racy{attempt}"
+        reg.deploy(alias, gbm, ServingConfig(max_batch=4, max_delay_ms=1,
+                                             queue_cap=512))
+        oks, gones, bad = [], [], []
+        lock = threading.Lock()
+        start = threading.Barrier(7)
+
+        def scorer(tid, alias=alias):
+            start.wait()
+            for i in range(tid, 60, 6):
+                try:
+                    out, ver = reg.score_rows(alias, _rows(data, [i]))
+                    with lock:
+                        oks.append((i, out[0], ver))
+                except KeyError:
+                    with lock:
+                        gones.append(i)
+                except Exception as e:  # noqa: BLE001 — must stay empty
+                    with lock:
+                        bad.append((i, repr(e)))
+
+        threads = [threading.Thread(target=scorer, args=(t,))
+                   for t in range(6)]
+        for t in threads:
+            t.start()
+        start.wait()                    # fire the undeploy mid-burst
+        reg.undeploy(alias, drain_secs=2.0)
+        for t in threads:
+            t.join()
+        assert not bad, bad             # only 200 or 404, ever
+        for i, p, ver in oks:
+            assert ver is not None and ver.version == 1
+            assert abs(p[2] - ref[i, 2]) < 1e-5, i   # complete results
+        assert reg.get(alias) is None
+        with pytest.raises(KeyError):
+            reg.score_rows(alias, _rows(data, [0]))
+        if gones:                       # the race actually happened
+            break
+    assert gones, "undeploy never raced a score in 3 attempts"
+
+
 def test_encode_rows_handles_unknowns(cl, data, models):
     """Unseen categorical levels, missing columns and junk values score
     as NA instead of erroring (convertUnknownCategoricalLevelsToNa)."""

@@ -5,10 +5,11 @@ In the discipline of Basiri et al. ("Chaos Engineering", IEEE Software
 2016), a resilience mechanism is only real once the SYSTEM's invariants
 are asserted under randomized, composed faults over real workloads —
 not one injector at a time.  This driver composes the full injector set
-(job faults, persist faults, stalls, slow scores, device OOMs) over a
-seeded workload mix (frame build + rollups -> Rapids munge -> GBM train
-with resume -> grid -> online serving) and asserts, after the clock
-runs out:
+(job faults, persist faults, stalls, slow scores, device OOMs, slice
+losses, serve pressure) over a seeded workload mix (frame build +
+rollups -> Rapids munge -> GBM train with resume -> grid -> online
+serving through a 2-replica fleet) and asserts, after the clock runs
+out:
 
 - every job reached a terminal state (none wedged RUNNING);
 - no leaked pool slots: both job pools return to their configured
@@ -53,7 +54,14 @@ TERMINAL = ("DONE", "CANCELLED", "FAILED", "INTERRUPTED")
 # the soak's train_with_recovery retry path resumes it.
 FAULTS = dict(job_p=0.15, persist_p=0.15, stall_p=0.10, stall_secs=1.0,
               score_slow_p=0.3, score_slow_ms=50.0, oom_p=0.10,
-              slice_loss_p=0.05)
+              slice_loss_p=0.05, serve_pressure_p=0.10)
+
+# the serve leg's legal outcomes: protection statuses are contracts,
+# crashes are not.  QueueFull/ShedLoad -> 429, TimeoutError -> 408,
+# OOMError/BreakerOpen/MeshReforming/NoHealthyReplica -> 503 — all
+# retryable; anything else is a serve_contract failure.
+SERVE_RETRYABLE = ("QueueFull", "ShedLoad", "TimeoutError", "OOMError",
+                   "BreakerOpen", "MeshReforming", "NoHealthyReplica")
 
 
 def _poll_rest(port: int, timeout: float = 5.0) -> dict:
@@ -214,11 +222,17 @@ def run_soak(seed: int = 7, duration: float = 60.0,
                          f"{len(grid.failures)} failures != 2")
             except Exception:  # noqa: BLE001 — whole-grid injected kill
                 pass
-            # 5. serve: deploy, score (slow-score shedding is legal:
-            #    429/408/503 are contracts, crashes are not), undeploy
+            # 5. serve: deploy across the replica fleet, score through
+            #    the fleet router (slow-score shedding and breaker
+            #    trips are legal: 429/408/503 are contracts, crashes
+            #    are not), undeploy.  Injected serve pressure
+            #    (serve_pressure_p) drives the breaker through its full
+            #    protocol while the rest of the storm runs.
             try:
-                from h2o_tpu.serve import ServingConfig, registry
+                from h2o_tpu.serve import ServingConfig
+                from h2o_tpu.serve.replica import fleet
                 from h2o_tpu.models.tree.gbm import GBM
+                fl = fleet(2)         # multi-replica serve contract
                 m = None
                 for _ in range(6):    # injected job faults may kill it
                     try:
@@ -230,19 +244,17 @@ def run_soak(seed: int = 7, duration: float = 60.0,
                 if m is None:
                     continue          # storm won this round; next one
                 name = f"soak_dep_{r}"
-                registry().deploy(name, m, ServingConfig(), warm=False)
+                fl.deploy(name, m, ServingConfig(), warm=False)
                 deployed.append(name)
                 rows = [{"x": float(v)} for v in x[:4]]
-                try:
-                    registry().score_rows(name, rows, deadline_ms=2000)
-                except Exception as e:  # noqa: BLE001
-                    if type(e).__name__ not in ("QueueFull",
-                                                "TimeoutError",
-                                                "OOMError",
-                                                "MeshReforming"):
-                        fail("serve_contract",
-                             f"round {r}: unexpected {e!r}")
-                registry().undeploy(name, drain_secs=2.0)
+                for _ in range(4):
+                    try:
+                        fl.score_rows(name, rows, deadline_ms=2000)
+                    except Exception as e:  # noqa: BLE001
+                        if type(e).__name__ not in SERVE_RETRYABLE:
+                            fail("serve_contract",
+                                 f"round {r}: unexpected {e!r}")
+                fl.undeploy(name, drain_secs=2.0)
                 deployed.remove(name)
             except Exception as e:  # noqa: BLE001
                 fail("serve_lifecycle", f"round {r}: {e!r}")
@@ -253,13 +265,16 @@ def run_soak(seed: int = 7, duration: float = 60.0,
     finally:
         chaos_counters = chaos.chaos().counters()
         oom_stats = oom.stats()
+        from h2o_tpu.serve.registry import serving_stats
+        serve_stats = serving_stats()
         chaos.reset()                 # faults OFF before teardown
+        from h2o_tpu.serve.replica import fleet as _fleet, reset_fleet
         for name in deployed:
             try:
-                from h2o_tpu.serve import registry
-                registry().undeploy(name, drain_secs=0.5)
+                _fleet().undeploy(name, drain_secs=0.5)
             except Exception:  # noqa: BLE001
                 pass
+        reset_fleet()
         srv.stop()
 
     # ---- invariants -------------------------------------------------
@@ -314,6 +329,7 @@ def run_soak(seed: int = 7, duration: float = 60.0,
     report["chaos"] = chaos_counters
     report["oom"] = oom_stats
     report["retry"] = resilience.stats()
+    report["serving"] = serve_stats
     report["ok"] = not report["failures"]
     return report
 
