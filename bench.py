@@ -266,6 +266,79 @@ def bench_rapids_groupby(rows, groups=1024, reps=5):
         cloud().dkv.remove("bench_rapids_gb")
 
 
+def bench_rapids_pipeline(rows, reps=5):
+    """Fused vs per-verb Rapids pipeline: the lazy planner
+    (rapids/plan.py) compiles the filter -> na.omit -> sort chain and
+    the filter -> group-by chain each into ONE exec-store-cached
+    shard_map program (H2O_TPU_RAPIDS_FUSE=1); the eager oracle
+    (=0) runs the same verbs one dispatch at a time.  The headline is
+    fused pipeline rows/sec; detail carries the unfused number, the
+    speedup, the repack/host-sync elisions from the planner stats
+    (strictly positive = the fused path did strictly less boundary
+    work), and the steady-state compile count (must be 0 — the region
+    program is exec-store cached per chain fingerprint x row bucket)."""
+    from h2o_tpu.core.cloud import cloud
+    from h2o_tpu.core.frame import Frame, T_CAT, Vec
+    from h2o_tpu.rapids.interp import Session, rapids_exec
+    from h2o_tpu.rapids.plan import PlanStats
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=rows).astype(np.float32)
+    x[rng.random(rows) < 0.05] = np.nan
+    v = rng.normal(size=rows).astype(np.float32)
+    g = rng.integers(0, 64, size=rows).astype(np.int32)
+    fr = Frame(["x", "v", "g"],
+               [Vec(x), Vec(v),
+                Vec(g, T_CAT, domain=[f"g{i}" for i in range(64)])])
+    fr.key = "bench_rapids_pipe"
+    cloud().dkv.put("bench_rapids_pipe", fr)
+    inner = "(rows bench_rapids_pipe (> (cols bench_rapids_pipe [0]) -2))"
+    sort_expr = f"(sort (na.omit {inner}) [2 1] [1 1])"
+    gb_expr = ("(GB (rows bench_rapids_pipe "
+               "(> (cols bench_rapids_pipe [1]) 0)) [2] "
+               "mean 0 'all' sum 1 'all' nrow 0 'all')")
+    prev_env = os.environ.get("H2O_TPU_RAPIDS_FUSE")
+
+    def run_mode(fuse):
+        os.environ["H2O_TPU_RAPIDS_FUSE"] = "1" if fuse else "0"
+        sess = Session("bench_pipe")
+        rapids_exec(sort_expr, sess)             # warm (compiles)
+        rapids_exec(gb_expr, sess)
+        before = PlanStats.snapshot()
+        c0 = _xla_compiles()
+        t0 = time.time()
+        for _ in range(reps):
+            rapids_exec(sort_expr, sess)
+            rapids_exec(gb_expr, sess)
+        wall = (time.time() - t0) / reps
+        after = PlanStats.snapshot()
+
+        def d(k):
+            return (after[k] - before[k]) // reps
+        return {"wall_s": round(wall, 4),
+                "rows_per_s": round(rows * 5 / wall, 1),
+                "steady_compiles": _xla_compiles() - c0,
+                "regions_fused": d("regions_fused"),
+                "repacks_elided": d("repacks_elided"),
+                "syncs_elided": d("host_syncs_elided"),
+                "unfused_fallbacks": d("fallbacks_unfused")}
+
+    try:
+        fused = run_mode(True)
+        unfused = run_mode(False)
+        return {"value": fused["rows_per_s"],
+                "unit": "pipeline verb rows/sec (fused)", "rows": rows,
+                "reps": reps, "fused": fused, "unfused": unfused,
+                "speedup_fused": round(
+                    fused["rows_per_s"] / unfused["rows_per_s"], 3)
+                if unfused["rows_per_s"] else None}
+    finally:
+        cloud().dkv.remove("bench_rapids_pipe")
+        if prev_env is None:
+            os.environ.pop("H2O_TPU_RAPIDS_FUSE", None)
+        else:
+            os.environ["H2O_TPU_RAPIDS_FUSE"] = prev_env
+
+
 _SCALEOUT_SRC = r"""
 import json, os, sys, time
 import numpy as np
@@ -1195,7 +1268,8 @@ def _main_ladder(detail):
     depth = int(os.environ.get("BENCH_DEPTH", 5))
     configs = os.environ.get(
         "BENCH_CONFIG",
-        "gbm,gbm_ua,gbm_bf16,drf,glm,dl,hist,rapidsgb,scaleout,gbm10m,"
+        "gbm,gbm_ua,gbm_bf16,drf,glm,dl,hist,rapidsgb,rapidspipe,"
+        "scaleout,gbm10m,"
         "cpuref,cpuref10m,deep,coldstart,streamref,leverab,elastic,"
         "auditovh,binspack,tierhbm,servesus"
     ).split(",")
@@ -1243,7 +1317,8 @@ def _main_ladder(detail):
         os.environ.setdefault("BENCH_SCALEOUT_ROWS", "100000")
         configs = [c for c in configs
                    if c in ("gbm", "cpuref", "drf", "glm", "hist",
-                            "rapidsgb", "scaleout", "gbm10m",
+                            "rapidsgb", "rapidspipe", "scaleout",
+                            "gbm10m",
                             "cpuref10m", "coldstart", "leverab",
                             "elastic", "binspack", "tierhbm",
                             "servesus")]
@@ -1269,6 +1344,9 @@ def _main_ladder(detail):
             ("rapidsgb", lambda: bench_rapids_groupby(
                 min(rows, int(os.environ.get("BENCH_RAPIDS_GB_ROWS",
                                              1_000_000))))),
+            ("rapidspipe", lambda: bench_rapids_pipeline(
+                min(rows, int(os.environ.get("BENCH_RAPIDS_PIPE_ROWS",
+                                             500_000))))),
             ("scaleout", bench_rapids_scaleout),
             ("gbm10m", lambda: bench_gbm10m(cols, depth)),
             ("cpuref10m", lambda: bench_cpu_reference_10m(cols, depth)),
@@ -1288,6 +1366,7 @@ def _main_ladder(detail):
              "gbm_ua": "gbm_uniform_adaptive", "gbm_bf16": "gbm_bf16",
              "cpuref10m": "cpu_reference_10m",
              "rapidsgb": "rapids_groupby_throughput",
+             "rapidspipe": "rapids_pipeline",
              "scaleout": "rapids_scaleout",
              "coldstart": "cold_start",
              "streamref": "streaming_refresh",

@@ -1266,12 +1266,20 @@ def dispatch_route(params):
     ``munge`` phase covers the device-resident sort/merge/group-by/
     filter kernels (core/munge.py); ``host_pulls``/``host_pull_bytes``
     count Vec payload device->host materializations per phase — the
-    munge row must stay at zero while the verbs run on device."""
+    munge row must stay at zero while the verbs run on device.
+
+    ``plan`` reports the lazy Rapids planner (rapids/plan.py): regions
+    considered/fused, verbs folded into fused programs, repacks and
+    host count-syncs elided versus the eager per-verb path, OOM
+    degradations to the unfused chain, and the fuse-lever split —
+    the numbers the rapids_pipeline bench gate reads."""
     from h2o_tpu.core.diag import DispatchStats
     from h2o_tpu.core.exec_store import exec_store
+    from h2o_tpu.rapids.plan import PlanStats
     s = exec_store().stats()
     return {"dispatch": DispatchStats.snapshot(),
-            "cache": s, "store": s}
+            "cache": s, "store": s,
+            "plan": PlanStats.snapshot()}
 
 
 @route("GET", r"/3/Recovery")

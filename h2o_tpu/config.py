@@ -45,6 +45,22 @@ Knobs
     matrix would not fit; ``1``/``on`` forces streaming; ``0``/``off``
     disables it even under pressure.
 
+Lazy Rapids planner knobs (``rapids/plan.py`` / ``core/fuse.py``)
+-----------------------------------------------------------------
+
+``H2O_TPU_RAPIDS_FUSE`` — tri-state fusion lever for the lazy Rapids
+    planner.  ``1`` forces every fusable verb chain through the fused
+    single-program path; ``0`` forces the eager per-verb chain (the
+    bitwise parity oracle); unset defers to the ``rapids.fuse``
+    autotuner lever (measured fused-vs-per-verb per chain kind x row
+    bucket on TPU; the per-verb reference elsewhere).  Tests, the
+    bench ladder and the audit gate set ``1`` explicitly — the same
+    convention as ``H2O_TPU_BINS_PACK``.
+
+``H2O_TPU_RAPIDS_FUSE_MAX_VERBS`` — cap on the number of verbs the
+    planner folds into one fused region (default 8).  Longer chains
+    split at the cap; each split region still fuses independently.
+
 Serving-fleet knobs (``serve/replica.py``)
 ------------------------------------------
 
@@ -93,6 +109,7 @@ import os
 __all__ = [
     "hbm_budget", "host_budget", "tier_block_rows", "prefetch_depth",
     "shard_landing_enabled", "tier_stream_mode",
+    "rapids_fuse_mode", "rapids_fuse_max_verbs",
     "serve_replicas", "breaker_soft", "breaker_hard",
     "breaker_open_secs", "breaker_probes", "breaker_interval_ms",
     "breaker_stall_soft", "serve_adaptive_default", "serve_min_batch",
@@ -131,6 +148,22 @@ def shard_landing_enabled() -> bool:
 def tier_stream_mode() -> str:
     """``auto`` | ``on``/``1`` | ``off``/``0`` (normalized, lowercase)."""
     return os.environ.get("H2O_TPU_TIER_STREAM", "auto").lower()
+
+
+def rapids_fuse_mode() -> str:
+    """``auto`` (defer to the lever) | ``on``/``1`` | ``off``/``0``."""
+    v = os.environ.get("H2O_TPU_RAPIDS_FUSE", "").lower()
+    if v in ("1", "on", "true", "yes"):
+        return "on"
+    if v in ("0", "off", "false", "no"):
+        return "off"
+    return "auto"
+
+
+def rapids_fuse_max_verbs() -> int:
+    """Max verbs per fused region (longer chains split at the cap)."""
+    return max(2, int(os.environ.get("H2O_TPU_RAPIDS_FUSE_MAX_VERBS",
+                                     "8") or 8))
 
 
 def serve_replicas() -> int:

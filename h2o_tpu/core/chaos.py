@@ -67,6 +67,10 @@ H2O_TPU_CHAOS_TRANSFER_SLOW /               P/duration of a slow
 H2O_TPU_CHAOS_OOM                           P(synthetic RESOURCE_EXHAUSTED)
 H2O_TPU_CHAOS_OOM_TRANSIENT=N               fail first N attempts at each
                                             dispatch site, then succeed
+H2O_TPU_CHAOS_REGION_OOM_TRANSIENT=N        fail first N fused Rapids
+                                            regions at each region site
+                                            beyond the inner ladder
+                                            (unfused-fallback drill)
 H2O_TPU_CHAOS_STREAM_TRUNCATE               P(chunk read raises truncated)
 H2O_TPU_CHAOS_STREAM_TRUNCATE_TRANSIENT=N   fail first N reads of each
                                             source, then succeed
@@ -156,6 +160,8 @@ class _Chaos:
             e("H2O_TPU_CHAOS_TRANSFER_SLOW_MS", 100) or 100)
         self.oom_p = float(e("H2O_TPU_CHAOS_OOM", 0) or 0)
         self.oom_transient = int(e("H2O_TPU_CHAOS_OOM_TRANSIENT", 0) or 0)
+        self.region_oom_transient = int(
+            e("H2O_TPU_CHAOS_REGION_OOM_TRANSIENT", 0) or 0)
         self.stream_truncate_p = float(
             e("H2O_TPU_CHAOS_STREAM_TRUNCATE", 0) or 0)
         self.stream_truncate_transient = int(
@@ -176,6 +182,7 @@ class _Chaos:
         self._lock = threading.Lock()
         self._transient_seen: Dict[Tuple[str, str], int] = {}
         self._oom_seen: Dict[str, int] = {}
+        self._region_oom_seen: Dict[str, int] = {}
         self._stream_seen: Dict[str, int] = {}
         self._slice_calls: Dict[str, int] = {}
         self.injected = 0
@@ -186,6 +193,7 @@ class _Chaos:
         self.injected_slow_scores = 0
         self.injected_slow_transfers = 0
         self.injected_oom = 0
+        self.injected_region_ooms = 0
         self.injected_stream_truncations = 0
         self.injected_slow_streams = 0
         self.injected_kernel_rejects = 0
@@ -198,7 +206,9 @@ class _Chaos:
                 self.persist_p > 0 or self.persist_transient > 0 or
                 self.stall_p > 0 or self.score_slow_p > 0 or
                 self.transfer_slow_p > 0 or self.oom_p > 0 or
-                self.oom_transient > 0 or self.stream_truncate_p > 0 or
+                self.oom_transient > 0 or
+                self.region_oom_transient > 0 or
+                self.stream_truncate_p > 0 or
                 self.stream_truncate_transient > 0 or
                 self.stream_slow_p > 0 or self.kernel_reject_p > 0 or
                 self.serve_pressure_p > 0 or
@@ -214,7 +224,8 @@ class _Chaos:
                 "injected", "injected_jobs", "injected_device_puts",
                 "injected_persist", "injected_stalls",
                 "injected_slow_scores", "injected_slow_transfers",
-                "injected_oom", "injected_stream_truncations",
+                "injected_oom", "injected_region_ooms",
+                "injected_stream_truncations",
                 "injected_slow_streams", "injected_kernel_rejects",
                 "injected_slice_losses", "injected_serve_pressure")}
 
@@ -269,6 +280,30 @@ class _Chaos:
             raise ChaosOOMError(
                 f"injected device OOM at {site}: RESOURCE_EXHAUSTED "
                 f"(synthetic)")
+
+    def maybe_region_oom(self, site: str) -> None:
+        """Fused-region OOM injector: called by core/oom.fused_fallback
+        once per planner-fused Rapids region, so CI can prove a region
+        that OOMs BEYOND its inner ladder degrades to the eager
+        per-verb chain (the bitwise oracle) instead of failing — the
+        per-verb sites are untouched, exactly the real asymmetry (the
+        fused program's working set is the sum of its stages; the
+        individual verbs still fit)."""
+        if self.region_oom_transient <= 0:
+            return
+        with self._lock:
+            n = self._region_oom_seen.get(site, 0)
+            if n >= self.region_oom_transient:
+                return
+            self._region_oom_seen[site] = n + 1
+            self.injected += 1
+            self.injected_region_ooms += 1
+        log.warning("chaos: transient fused-region OOM %d/%d at %s",
+                    n + 1, self.region_oom_transient, site)
+        raise ChaosOOMError(
+            f"injected fused-region OOM {n + 1}/"
+            f"{self.region_oom_transient} at {site}: RESOURCE_EXHAUSTED "
+            f"(synthetic, beyond the inner ladder)")
 
     def maybe_kernel_reject(self, site: str) -> None:
         """Kernel-rejection injector: called by core/oom.kernel_fallback
@@ -445,6 +480,7 @@ def configure(job_p: float = 0.0, device_put_p: float = 0.0,
               transfer_slow_p: float = 0.0,
               transfer_slow_ms: float = 100.0,
               oom_p: float = 0.0, oom_transient: int = 0,
+              region_oom_transient: int = 0,
               stream_truncate_p: float = 0.0,
               stream_truncate_transient: int = 0,
               stream_slow_p: float = 0.0,
@@ -472,6 +508,7 @@ def configure(job_p: float = 0.0, device_put_p: float = 0.0,
     _instance.transfer_slow_ms = float(transfer_slow_ms)
     _instance.oom_p = float(oom_p)
     _instance.oom_transient = int(oom_transient)
+    _instance.region_oom_transient = int(region_oom_transient)
     _instance.kernel_reject_p = float(kernel_reject_p)
     _instance.serve_pressure_p = float(serve_pressure_p)
     _instance.slice_loss_p = float(slice_loss_p)

@@ -239,10 +239,30 @@ class Vec:
         d = self._data
         return int(d.size * d.dtype.itemsize) if d is not None else 0
 
+    def _valid_nbytes(self) -> int:
+        """Bytes of the device payload holding REAL rows: a ragged
+        column (per-shard valid prefixes) counts only its shard_counts
+        rows, a canonical column counts min(nrows, buffer rows).  The
+        capacity/valid split is what MemoryManager.stats() reports and
+        what pressure() drives off — a heavily-filtered ragged frame
+        must not inflate HBM pressure by its padding."""
+        d = self._data
+        if d is None or not d.ndim:
+            return 0
+        if self.shard_counts is not None:
+            valid = int(self.shard_counts.sum())
+        else:
+            valid = min(int(self.nrows), int(d.shape[0]))
+        per_row = int(d.dtype.itemsize)
+        for s in d.shape[1:]:
+            per_row *= int(s)
+        return max(valid, 0) * per_row
+
     def _account(self) -> None:
         if self._data is not None:
             from h2o_tpu.core.memory import manager
-            manager().register(self, self._device_nbytes())
+            manager().register(self, self._device_nbytes(),
+                               self._valid_nbytes())
 
     def _spill(self) -> bool:
         """Drop the device payload after parking a host copy (called by

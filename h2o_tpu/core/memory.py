@@ -292,6 +292,12 @@ class MemoryManager:
         self._lock = make_rlock("memory.MemoryManager._lock")
         # insertion-ordered dicts of weakref -> nbytes; order = LRU
         self._resident: "dict[weakref.ref, int]" = {}
+        # device-CAPACITY vs VALID bytes: ragged columns (per-shard
+        # valid prefixes) occupy their full padded buffer in HBM but
+        # only shard_counts rows are real — _resident holds capacity
+        # (what eviction frees), _valid holds real-row bytes (what
+        # pressure() drives off)
+        self._valid: "dict[weakref.ref, int]" = {}
         self._host: "dict[weakref.ref, int]" = {}
         self.spill_count = 0
         self.reload_count = 0
@@ -310,6 +316,7 @@ class MemoryManager:
         dead = [r for r in self._resident if r() is None]
         for r in dead:
             self._resident.pop(r, None)
+            self._valid.pop(r, None)
 
     @property
     def resident_bytes(self) -> int:
@@ -317,15 +324,20 @@ class MemoryManager:
             self._prune()
             return sum(self._resident.values())
 
-    def register(self, vec, nbytes: int) -> None:
+    def register(self, vec, nbytes: int,
+                 valid_nbytes: Optional[int] = None) -> None:
         """A Vec's device payload came alive; evict LRU columns if the
         budget is exceeded (Cleaner sweep).  The spill itself runs
-        OUTSIDE the manager lock (see _spill_lru)."""
+        OUTSIDE the manager lock (see _spill_lru).  ``valid_nbytes``
+        is the real-row subset of ``nbytes`` (ragged columns pad to
+        device capacity); defaults to ``nbytes`` for dense payloads."""
         with self._lock:
             self._prune()
             r = weakref.ref(vec)
             vec._mm_ref = r              # O(1) touch/unregister handle
             self._resident[r] = int(nbytes)
+            self._valid[r] = int(nbytes if valid_nbytes is None
+                                 else min(valid_nbytes, nbytes))
             total = sum(self._resident.values())
             if total > self.peak_resident:
                 self.peak_resident = total
@@ -348,6 +360,7 @@ class MemoryManager:
             return
         with self._lock:
             self._resident.pop(r, None)
+            self._valid.pop(r, None)
 
     def _spill_lru(self, need_bytes: int, exclude=None) -> int:
         """Spill the coldest columns until ``need_bytes`` are freed.
@@ -375,6 +388,7 @@ class MemoryManager:
                     if self._resident.pop(r, None) is not None:
                         self.spill_count += 1
                         freed += nb
+                    self._valid.pop(r, None)
         if freed:
             log.info("spilled %d bytes of cold columns to host "
                      "(budget %d)", freed, self.budget)
@@ -391,6 +405,8 @@ class MemoryManager:
         with self._lock:
             if r is not None and self._resident.pop(r, None) is not None:
                 self.spill_count += 1
+            if r is not None:
+                self._valid.pop(r, None)
         return nb
 
     def sweep(self) -> int:
@@ -505,6 +521,7 @@ class MemoryManager:
             self._prune_host()
             sizes = sorted(self._resident.values(), reverse=True)
             hbm = sum(sizes)
+            valid = sum(self._valid.values())
             live = [o for o in (w() for w in self._host) if o is not None]
             host = sum(o.resident_nbytes for o in live)
             persist = sum(o.persisted_nbytes for o in live)
@@ -512,7 +529,12 @@ class MemoryManager:
                 self.peak_resident = hbm
             return {"budget": self.budget,
                     "host_budget": self.host_budget,
+                    # capacity vs valid: resident_bytes is what the
+                    # padded device buffers occupy (what a spill would
+                    # free); valid_bytes counts only real rows — on a
+                    # ragged frame the gap is the padding overhead
                     "resident_bytes": hbm,
+                    "valid_bytes": valid,
                     "resident_vecs": len(sizes),
                     "spills": self.spill_count,
                     "reloads": self.reload_count,
@@ -532,21 +554,26 @@ class MemoryManager:
 
     def pressure(self) -> dict:
         """One memory-pressure sample for the serving circuit breaker
-        (serve/breaker.py): ``hbm_frac`` is resident/budget (0.0 when
-        unbounded — nothing to protect against), plus the CUMULATIVE
-        paging counters the breaker differentiates between samples
-        (demand-page stalls and pages in/out rising between two reads
-        mean the tier store is actively thrashing — the leading
-        indicator that the next big dispatch walks the OOM ladder).
-        Cheap by design: sums the residency table under the lock, no
-        device work, no I/O — safe from the admission path."""
+        (serve/breaker.py): ``hbm_frac`` is VALID/budget (0.0 when
+        unbounded — nothing to protect against) — valid bytes, not
+        padded capacity, because a heavily-filtered ragged frame's
+        padding is reclaimable by one balanced repack and must not
+        trip load-shedding.  Both figures are reported.  Plus the
+        CUMULATIVE paging counters the breaker differentiates between
+        samples (demand-page stalls and pages in/out rising between
+        two reads mean the tier store is actively thrashing — the
+        leading indicator that the next big dispatch walks the OOM
+        ladder).  Cheap by design: sums the residency table under the
+        lock, no device work, no I/O — safe from the admission path."""
         with self._lock:
             self._prune()
             hbm = sum(self._resident.values())
+            valid = sum(self._valid.values())
             return {
-                "hbm_frac": (hbm / self.budget) if self.budget > 0
+                "hbm_frac": (valid / self.budget) if self.budget > 0
                 else 0.0,
                 "resident_bytes": hbm,
+                "valid_bytes": valid,
                 "demand_page_stalls": self.demand_stall_count,
                 "pages_in": self.pages_in,
                 "pages_out": self.pages_out,
@@ -585,6 +612,7 @@ def set_budget(budget_bytes: int,
         new = MemoryManager(int(budget_bytes), host_budget_bytes)
         if _manager is not None:
             new._resident = dict(_manager._resident)
+            new._valid = dict(_manager._valid)
             new._host = dict(_manager._host)
             if host_budget_bytes is None:
                 new.host_budget = _manager.host_budget

@@ -101,32 +101,27 @@ PHASE = "munge"
 # per-shard partials in the shard collective; median and mode need a
 # per-group order statistic / bincount and run via the global
 # factorize + fused segment kernels (device-resident, not yet pure
-# collectives).  mode is device-eligible only for categorical columns
-# whose domain fits the (groups, cardinality) count table
-# (mode_device_eligible); numeric / high-cardinality mode stays a
-# documented host fallback (rapids/interp.py _groupby_host).
+# collectives).  mode is device-eligible for every categorical column:
+# the chunked segment-bincount (quantile.segment_mode) folds the count
+# table in 1024-wide value passes, so domain cardinality is unbounded.
+# Numeric mode stays a documented host fallback (rapids/interp.py
+# _groupby_host) — a float column has no dense code space to bincount.
 DEVICE_AGGS = ("min", "max", "mean", "sum", "sd", "var", "nrow", "count",
                "median", "mode")
 COMBINABLE_AGGS = ("min", "max", "mean", "sum", "sd", "var", "nrow",
                    "count")
 
-# widest categorical domain the segment-bincount mode kernel will
-# one-hot a count table for: (Gb, card) f32 stays a few MiB even at
-# the largest group buckets
-_MODE_MAX_CARD = 1024
-
 
 def mode_device_eligible(fr, aggs) -> bool:
     """True when every ``mode`` agg in the bundle targets a categorical
-    column with a domain small enough for the segment-bincount kernel
-    (cardinality <= 1024).  Numeric or high-cardinality mode columns
-    keep the documented host fallback."""
+    column (any cardinality — the chunked segment-bincount kernel's
+    count table is bounded per pass).  Numeric mode columns keep the
+    documented host fallback."""
     for a, c, _na in aggs:
         if a != "mode":
             continue
         v = fr.vecs[c]
-        if not v.is_categorical or not v.domain or \
-                len(v.domain) > _MODE_MAX_CARD:
+        if not v.is_categorical or not v.domain:
             return False
     return True
 
@@ -916,8 +911,12 @@ def repack_frame(fr: Frame) -> Frame:
             if v.is_categorical:
                 col = jnp.where(jnp.isnan(col), -1.0,
                                 col).astype(jnp.int32)
-            v.data = landing.reshard_rows(col)
+            # clear raggedness BEFORE assigning (the data setter
+            # re-accounts with the memory manager, and stale
+            # shard_counts would record the old ragged valid bytes
+            # for the now-canonical payload)
             v.shard_counts = None
+            v.data = landing.reshard_rows(col)
             v.invalidate()
         return fr
 

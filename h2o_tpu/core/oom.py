@@ -123,6 +123,33 @@ def kernel_fallback(site: str, run: Callable[[bool], object], *,
         return run(False)
 
 
+def fused_fallback(site: str, run_fused: Callable[[], object],
+                   run_unfused: Callable[[], object]):
+    """Run a planner-fused Rapids region; if the region's own OOM
+    ladder exhausts (terminal :class:`OOMError`) or the fused program
+    hits an unrecovered device OOM, record the ``unfused_fallbacks``
+    resilience rung and replay the region as the eager per-verb chain —
+    the ``H2O_TPU_RAPIDS_FUSE=0`` parity oracle, so the degraded result
+    is still bitwise.  Everything else propagates untouched: a fused
+    region must never mask a non-memory failure behind a silent
+    replan.  The chaos injector
+    (``H2O_TPU_CHAOS_REGION_OOM_TRANSIENT``) fires here so CPU CI can
+    walk the degradation path — the region-level OOM that the per-verb
+    chain does not share — without a real allocation failure."""
+    from h2o_tpu.core.chaos import chaos
+    try:
+        chaos().maybe_region_oom(site)
+        return run_fused()
+    except Exception as e:  # noqa: BLE001 — reclassified below
+        if not (isinstance(e, OOMError) or is_device_oom(e)):
+            raise
+        _note(site, "unfused_fallbacks")
+        log.warning("%s: fused region OOMed beyond the ladder (%s); "
+                    "degrading to the unfused per-verb chain", site,
+                    str(e)[:200])
+        return run_unfused()
+
+
 def is_device_oom(exc: BaseException) -> bool:
     """Classify an exception as a recoverable device OOM (XLA
     RESOURCE_EXHAUSTED / jaxlib allocation failure / injected chaos
@@ -177,7 +204,7 @@ def is_device_loss(exc: BaseException) -> bool:
 # -- observability -----------------------------------------------------------
 
 _RUNGS = ("oom_events", "sweeps", "shrinks", "host_fallbacks",
-          "kernel_fallbacks", "terminal")
+          "kernel_fallbacks", "unfused_fallbacks", "terminal")
 
 _stats_lock = threading.Lock()
 _sites: Dict[str, Dict[str, int]] = {}
@@ -198,7 +225,8 @@ def stats() -> dict:
         "oom_events": sum(d["oom_events"] for d in sites.values()),
         "sweeps": sum(d["sweeps"] for d in sites.values()),
         "degradations": sum(d["shrinks"] + d["host_fallbacks"] +
-                            d.get("kernel_fallbacks", 0)
+                            d.get("kernel_fallbacks", 0) +
+                            d.get("unfused_fallbacks", 0)
                             for d in sites.values()),
         "terminal_failures": sum(d["terminal"] for d in sites.values()),
         "sites": sites,

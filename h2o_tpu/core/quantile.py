@@ -108,29 +108,48 @@ def segment_median(vals, ok, inv, B: int, Gb: int):
     return jnp.where(cnt > 0, med, jnp.nan)
 
 
-def segment_mode(vals, ok, inv, Gb: int, card: int):
-    """Per-group MODE of a small-cardinality non-negative integer
-    column (categorical codes) — segment-bincount + argmax (traced
-    helper for core/munge.py's group-by device path, the
-    ``mode``-closing sibling of segment_median above).
+# per-pass value-range width of the chunked mode count table: bounds
+# the live (Gb, width) table regardless of domain cardinality
+_MODE_CHUNK = 1024
 
-    One segment_sum over a combined (group, value) index builds the
-    (Gb, card) count table; argmax over the value axis picks the mode,
-    ties breaking to the SMALLEST value — matching the host oracle's
-    ``np.bincount(seg).argmax()`` (rapids/interp.py _groupby_host).
-    Empty groups (no valid values) return NaN.  ``card`` bounds the
-    count table and is static — high-cardinality columns stay on the
-    documented host fallback (munge.mode_device_eligible)."""
+
+def segment_mode(vals, ok, inv, Gb: int, card: int):
+    """Per-group MODE of a non-negative integer column (categorical
+    codes) — chunked segment-bincount + argmax (traced helper for
+    core/munge.py's group-by device path, the ``mode``-closing sibling
+    of segment_median above).
+
+    The count table is built in value-range chunks of ``_MODE_CHUNK``:
+    each pass segment-sums a (Gb, chunk) table for codes in [lo,
+    lo+chunk) and folds it into a running (best_count, best_value)
+    pair, so HBM holds one chunk table at a time and ``card`` is
+    unbounded — arbitrarily high-cardinality domains stay on device
+    (the host fallback is now only for non-categorical columns).  Ties
+    break to the SMALLEST value, matching the host oracle's
+    ``np.bincount(seg).argmax()`` (rapids/interp.py _groupby_host):
+    within a chunk argmax picks the first maximal index, and across
+    chunks the strictly-greater fold keeps the earlier (smaller-value)
+    winner.  Empty groups (no valid values) return NaN."""
     v = jnp.clip(vals.astype(jnp.int32), 0, card - 1)
-    # invalid rows key out of range; jax segment_sum drops OOB indices
-    idx = jnp.where(ok, inv * card + v, Gb * card)
-    counts = jax.ops.segment_sum(ok.astype(jnp.float32), idx,
-                                 num_segments=Gb * card)
-    mode = jnp.argmax(counts.reshape(Gb, card),
-                      axis=1).astype(jnp.float32)
+    best_cnt = jnp.zeros((Gb,), jnp.float32)
+    best_val = jnp.zeros((Gb,), jnp.float32)
+    for lo in range(0, card, _MODE_CHUNK):
+        width = min(_MODE_CHUNK, card - lo)
+        in_chunk = ok & (v >= lo) & (v < lo + width)
+        # rows outside the chunk key out of range; jax segment_sum
+        # drops OOB indices
+        idx = jnp.where(in_chunk, inv * width + (v - lo), Gb * width)
+        counts = jax.ops.segment_sum(in_chunk.astype(jnp.float32), idx,
+                                     num_segments=Gb * width)
+        table = counts.reshape(Gb, width)
+        c_cnt = jnp.max(table, axis=1)
+        c_val = (jnp.argmax(table, axis=1) + lo).astype(jnp.float32)
+        take = c_cnt > best_cnt
+        best_val = jnp.where(take, c_val, best_val)
+        best_cnt = jnp.where(take, c_cnt, best_cnt)
     n_ok = jax.ops.segment_sum(ok.astype(jnp.float32), inv,
                                num_segments=Gb)
-    return jnp.where(n_ok > 0, mode, jnp.nan)
+    return jnp.where(n_ok > 0, best_val, jnp.nan)
 
 
 def quantile(frame: Frame, probs: Sequence[float],

@@ -27,6 +27,16 @@ home-sharded data plane:
   WHOLE array on one host and forfeits pull accounting, tier telemetry
   and the big-frame ingest path.  Use ``landing.land_rows`` (host data)
   or ``landing.reshard_rows`` (device data).
+- **GL310** fused-region purity (the lazy Rapids planner's contract,
+  rapids/plan.py + core/fuse.py): a planner-emitted region body (any
+  ``_build_fused*`` builder) must stay fully traced — no eager
+  ``.repack()``, no ``.to_numpy``/``device_get`` host gathers, no
+  ``np.asarray`` host count syncs; the whole point of fusing the verb
+  chain is ONE device program with AT MOST one boundary sync.  And
+  every ``ExecStore.dispatch`` in a fused-region module must run under
+  the ``rapids.fuse`` phase (the ``PHASE`` constant) so exec-store
+  caching, AOT persistence and the OOM ladder see the region as one
+  unit.
 """
 
 from __future__ import annotations
@@ -219,6 +229,75 @@ def _is_row_sharding_expr(node) -> bool:
         if isinstance(n, ast.Attribute) and n.attr == "DATA_AXIS":
             return True
     return False
+
+
+# host-sync surfaces banned inside planner-emitted fused region bodies:
+# eager repack (the all-to-all the fusion exists to elide), host
+# gathers, and blocking count syncs
+_FUSED_SYNC_ATTRS = {"repack", "to_numpy", "device_get",
+                     "block_until_ready"}
+
+
+def _fused_builders(mi: ModuleInfo) -> list:
+    return [f for f in mi.functions()
+            if f.name.startswith("_build_fused")]
+
+
+@rule("GL310", "fused-region-purity")
+def check_fused_region(mi: ModuleInfo, ctx):
+    """Planner-emitted fused region bodies (``_build_fused*``) must stay
+    traced — no eager repack / host gather / host count sync — and the
+    module's dispatches must run under the ``rapids.fuse`` phase."""
+    builders = _fused_builders(mi)
+    if not builders and mi.rel != "core/fuse.py":
+        return []
+    out: List[Finding] = []
+    seen = set()
+
+    def flag(node, what, why):
+        key = (mi.scope_of(node), what)
+        if key in seen:
+            return
+        seen.add(key)
+        out.append(Finding(
+            "GL310", "error", mi.rel, node.lineno, mi.scope_of(node),
+            why, detail=f"fused-region:{what}"))
+
+    for func in builders:
+        for node in ast.walk(func):
+            if isinstance(node, ast.Attribute) and \
+                    node.attr in _FUSED_SYNC_ATTRS:
+                flag(node, node.attr,
+                     f".{node.attr} inside fused region body "
+                     f"{func.name}() — planner-emitted regions must stay "
+                     f"one traced program (raggedness flows between "
+                     f"stages; at most ONE boundary sync, and it lives "
+                     f"in the run_fused_* wrapper, not the kernel)")
+            if isinstance(node, ast.Call):
+                chain = classify._attr_chain(node.func)
+                if len(chain) >= 2 and chain[0] in ("np", "numpy") and \
+                        chain[-1] in ("asarray", "array"):
+                    flag(node, "np." + chain[-1],
+                         f"np.{chain[-1]} inside fused region body "
+                         f"{func.name}() — a host count sync mid-region "
+                         f"defeats the fusion (per-verb syncs are what "
+                         f"the planner elides)")
+    for node in ast.walk(mi.tree):
+        if not (isinstance(node, ast.Call) and
+                isinstance(node.func, ast.Attribute) and
+                node.func.attr == "dispatch" and node.args):
+            continue
+        ph = node.args[0]
+        ok = (isinstance(ph, ast.Name) and ph.id == "PHASE") or \
+             (isinstance(ph, ast.Attribute) and ph.attr == "PHASE") or \
+             (isinstance(ph, ast.Constant) and ph.value == "rapids.fuse")
+        if not ok:
+            flag(node, "dispatch-phase",
+                 "ExecStore.dispatch in a fused-region module must run "
+                 "under the rapids.fuse phase (pass the PHASE constant) "
+                 "— exec-store caching, AOT persistence and the OOM "
+                 "ladder treat the fused region as one unit")
+    return out
 
 
 @rule("GL304", "landing-bypass")

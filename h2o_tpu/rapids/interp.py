@@ -403,6 +403,15 @@ _UNOPS = {
 }
 
 
+def _offer_plan(op, node, env):
+    """Offer a fusable terminal verb to the lazy planner
+    (rapids/plan.py) before the eager per-verb handler runs.  Returns
+    the fused region's Frame or None (None = the eager path — which is
+    also the planner's bitwise parity oracle — proceeds untouched)."""
+    from h2o_tpu.rapids.plan import try_plan
+    return try_plan(op, node, env, _eval)
+
+
 def _eval(node, env: _Env):
     s = env.s
     if isinstance(node, float):
@@ -444,6 +453,9 @@ def _eval(node, env: _Env):
         idxs = _col_indices(fr, sel)
         return fr.subframe([fr.names[i] for i in idxs])
     if op in ("rows", "rows_py"):
+        fused = _offer_plan(op, node, env)
+        if fused is not None:
+            return fused
         fr = _as_frame(_eval(node[1], env))
         sel = node[2]
         if isinstance(sel, list):
@@ -639,11 +651,13 @@ def _eval(node, env: _Env):
         name = _lit(node[1])
         return s.assign(name, _as_frame(_eval(node[2], env)))
     if op == "sort":
-        return _sort(node, env)
+        fused = _offer_plan(op, node, env)
+        return fused if fused is not None else _sort(node, env)
     if op == "merge":
         return _merge(node, env)
     if op in ("GB", "groupby"):
-        return _groupby(node, env)
+        fused = _offer_plan(op, node, env)
+        return fused if fused is not None else _groupby(node, env)
     if op == "table":
         return _table(node, env)
     if op in _CUMOPS:
@@ -662,6 +676,9 @@ def _eval(node, env: _Env):
               "second", "week"):
         return _time_part(op, node, env)
     if op == "na.omit":
+        fused = _offer_plan(op, node, env)
+        if fused is not None:
+            return fused
         fr = _as_frame(_eval(node[1], env))
         from h2o_tpu.core.munge import device_munge_enabled
         if device_munge_enabled() and frame_device_ok(fr):

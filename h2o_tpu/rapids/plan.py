@@ -1,0 +1,491 @@
+"""Lazy Rapids planner — recognize fusable verb chains, lower them to
+one fused shard_map program (core/fuse.py).
+
+The reference evaluates whole Rapids trees at once (water/rapids
+AstExec): `(sort (rows fr pred) [0])` is ONE walk.  Our eager
+interpreter (rapids/interp.py) preserved those semantics but dispatched
+one collective per verb — each filter syncing its survivor counts to
+host, each ragged intermediate repacking before the next stage's mask
+evaluation.  This module restores the whole-tree view: `_eval` offers
+every fusable terminal verb (sort, rows/na.omit, GB) to ``try_plan``
+FIRST; the planner walks the expression INWARD collecting the chain of
+predicate stages feeding it, compiles the predicates to a static spec,
+and executes the whole region as one exec-store-cached program.
+
+Laziness contract
+-----------------
+Rapids evaluation is still demand-driven from materialization
+boundaries (`as_matrix`, a REST result fetch, a host pull, a model
+train pulling columns): nothing here defers WHEN a tree runs — the
+deferral is WITHIN the tree.  A chain of k predicate stages feeding a
+sort used to run as k+1 programs with k host count syncs and up to k
+repack all_to_alls; the planner runs it as ONE program whose only host
+sync is the region-boundary row count.  Region boundaries are exactly
+the places eager execution is observable: a frame bound to a session
+temp (`tmp=`) is still materialized eagerly (clients may fetch it), so
+fusion never changes what a client can see — only how many programs
+produced it.
+
+Region shapes (each bitwise-equal to the eager chain by construction —
+see core/fuse.py for the proofs):
+
+- ``[filter/na.omit ...] -> sort``   (one kernel, canonical output)
+- ``[filter/na.omit x>=2]``          (one kernel, eager-identical
+                                      ragged layout)
+- ``[filter/na.omit] -> group-by``   (two kernels sharing the fused
+                                      mask; one G sync)
+
+Anything else — host-path frames, string predicates, env-bound
+predicate subtrees, non-combinable aggregates — declines fusion and
+falls through to the untouched eager handler, which recursively
+re-offers INNER chains to the planner (long mixed chains split into
+fused regions automatically).
+
+The ``rapids.fuse`` autotuner lever picks fused vs per-verb per (row
+bucket x chain kind) with a bitwise parity probe;
+``H2O_TPU_RAPIDS_FUSE`` forces it.  A fused-region OOM that exhausts
+the dispatch ladder degrades to the eager chain via
+``oom.fused_fallback`` (the ``unfused_fallbacks`` resilience rung,
+GET /3/Resilience) — the planner sets a thread-local bypass during the
+replay so the degraded region really runs per-verb.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, Optional
+
+from h2o_tpu import config
+from h2o_tpu.core.frame import Frame, frame_device_ok
+
+log = logging.getLogger("h2o_tpu.rapids.plan")
+
+_FILTER_OPS = ("rows", "rows_py")
+_STAGE_OPS = _FILTER_OPS + ("na.omit",)
+
+
+class PlanStats:
+    """Planner counters, the DispatchStats pattern: process-wide
+    classmethod state, ``snapshot()`` served in the ``plan`` block of
+    GET /3/Dispatch and the ``[plan]`` conftest summary line.
+
+    Elision accounting (computed per region from the chain shape, not
+    sampled): the eager chain syncs survivor counts once per
+    filter/na.omit stage plus one group count; the fused region syncs
+    exactly once.  The eager chain repacks every RAGGED stage input
+    during mask evaluation (interp._dense / na.omit's as_matrix); a
+    fused filter-only region keeps one balanced boundary exchange and
+    sort/group-by regions keep none.
+    """
+
+    _lock = threading.Lock()
+    _counts: Dict[str, int] = {}
+    _kinds: Dict[str, int] = {}
+
+    @classmethod
+    def _bump(cls, key: str, n: int = 1) -> None:
+        with cls._lock:
+            cls._counts[key] = cls._counts.get(key, 0) + n
+
+    @classmethod
+    def note_considered(cls) -> None:
+        cls._bump("regions_considered")
+
+    @classmethod
+    def note_lever(cls, fused: bool) -> None:
+        cls._bump("lever_fused" if fused else "lever_per_verb")
+
+    @classmethod
+    def note_fused(cls, kind: str, verbs: int, repacks_elided: int,
+                   syncs_elided: int) -> None:
+        cls._bump("regions_fused")
+        cls._bump("verbs_fused", verbs)
+        cls._bump("repacks_elided", repacks_elided)
+        cls._bump("host_syncs_elided", syncs_elided)
+        with cls._lock:
+            cls._kinds[kind] = cls._kinds.get(kind, 0) + 1
+
+    @classmethod
+    def note_fallback(cls) -> None:
+        cls._bump("fallbacks_unfused")
+
+    @classmethod
+    def note_error(cls) -> None:
+        cls._bump("planner_errors")
+
+    @classmethod
+    def snapshot(cls) -> Dict[str, Any]:
+        with cls._lock:
+            out = {k: cls._counts.get(k, 0) for k in (
+                "regions_considered", "regions_fused", "verbs_fused",
+                "repacks_elided", "host_syncs_elided",
+                "fallbacks_unfused", "planner_errors",
+                "lever_fused", "lever_per_verb")}
+            out["kinds"] = dict(cls._kinds)
+        return out
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._counts.clear()
+            cls._kinds.clear()
+
+
+# -- planner bypass: the OOM-degrade replay (and nothing else) re-runs
+# the SAME region eagerly on this thread; without the flag the replay
+# would re-enter the planner and re-OOM forever -----------------------------
+
+_tls = threading.local()
+
+
+def _bypassed() -> bool:
+    return getattr(_tls, "bypass", 0) > 0
+
+
+class _bypass:
+    def __enter__(self):
+        _tls.bypass = getattr(_tls, "bypass", 0) + 1
+
+    def __exit__(self, *exc):
+        _tls.bypass -= 1
+
+
+# -- chain extraction (structural, pre-evaluation) --------------------------
+
+
+def _op_of(node) -> Optional[str]:
+    if not isinstance(node, list) or not node:
+        return None
+    head = node[0]
+    return head[1] if isinstance(head, tuple) else head
+
+
+def _stage_of(node):
+    """``(kind, sel_node, input_node)`` when ``node`` is a fusable
+    predicate stage, else None.  A rows node only qualifies when its
+    selector is an expression (a boolean mask tree) — numlist/span row
+    slices are gathers, not predicates."""
+    op = _op_of(node)
+    if op in _FILTER_OPS and len(node) >= 3 and isinstance(node[2], list):
+        return ("filter", node[2], node[1])
+    if op == "na.omit" and len(node) >= 2:
+        return ("naomit", None, node[1])
+    return None
+
+
+def _extract_chain(node, cap: int):
+    """Walk inward collecting consecutive predicate stages.  Returns
+    ``(base_node, stages)`` with stages in APPLICATION order (innermost
+    first) — the conjunction order the fused mask reproduces."""
+    stages = []
+    cur = node
+    while len(stages) < cap:
+        st = _stage_of(cur)
+        if st is None:
+            break
+        stages.append(st)
+        cur = st[2]
+    stages.reverse()
+    return cur, stages
+
+
+def _pred_template(sel, input_node):
+    """Compile a rows-selector expression into a static template, or
+    None when it is not fusable.  Fusable predicates are pointwise
+    trees of the fused op tables over single-column reads of the
+    stage's OWN input (structural node equality — id refs and nested
+    verb nodes both match); anything touching the environment, string
+    literals, other frames or multi-column selectors declines."""
+    from h2o_tpu.core import fuse
+    cols = []
+
+    def walk(nd):
+        if isinstance(nd, float):
+            return ("const", float(nd))
+        if isinstance(nd, int):
+            return ("const", float(nd))
+        if isinstance(nd, tuple):
+            if nd[0] == "id":
+                name = nd[1]
+                if name in ("TRUE", "True", "true"):
+                    return ("const", 1.0)
+                if name in ("FALSE", "False", "false"):
+                    return ("const", 0.0)
+                if name in ("NA", "NaN", "nan"):
+                    return ("const", float("nan"))
+            return None
+        if not isinstance(nd, list) or not nd:
+            return None
+        o = _op_of(nd)
+        if o in ("cols", "cols_py") and len(nd) >= 3:
+            if nd[1] != input_node:
+                return None
+            s = nd[2]
+            if not isinstance(s, (tuple, float)):
+                return None
+            cols.append(s)
+            return ("rawcol", s)
+        if o in fuse._PRED_BINOPS and len(nd) == 3:
+            a, b = walk(nd[1]), walk(nd[2])
+            if a is None or b is None:
+                return None
+            return ("bin", o, a, b)
+        if o in fuse._PRED_UNOPS and len(nd) == 2:
+            a = walk(nd[1])
+            if a is None:
+                return None
+            return ("un", o, a)
+        return None
+
+    t = walk(sel)
+    return t if (t is not None and cols) else None
+
+
+def _compile_stages(stage_nodes):
+    """Structural pass: every stage must compile to a template."""
+    out = []
+    for kind, sel, inner in stage_nodes:
+        if kind == "naomit":
+            out.append(("naomit", None))
+            continue
+        t = _pred_template(sel, inner)
+        if t is None:
+            return None
+        out.append(("filter", t))
+    return out
+
+
+def _resolve_stages(templates, fr: Frame):
+    """Bind templates to the evaluated base frame's schema: raw column
+    selectors become ``("col", j, is_cat)`` reads (single column only —
+    the eager mask path reads ``sel.vecs[0]``, so a multi-column
+    selector has frame-dependent semantics we refuse to guess), and
+    na.omit snapshots the per-column categorical flags.  Returns the
+    hashable stage spec or None."""
+    from h2o_tpu.rapids.interp import _col_indices
+
+    def bind(t):
+        tag = t[0]
+        if tag == "rawcol":
+            try:
+                idxs = _col_indices(fr, t[1])
+            except (TypeError, ValueError, IndexError):
+                return None
+            if len(idxs) != 1 or not 0 <= idxs[0] < fr.ncols:
+                return None
+            j = int(idxs[0])
+            return ("col", j, bool(fr.vecs[j].is_categorical))
+        if tag == "const":
+            return t
+        if tag == "bin":
+            a, b = bind(t[2]), bind(t[3])
+            return None if a is None or b is None else ("bin", t[1], a, b)
+        if tag == "un":
+            a = bind(t[2])
+            return None if a is None else ("un", t[1], a)
+        return None
+
+    cats = tuple(bool(v.is_categorical) for v in fr.vecs)
+    out = []
+    for kind, t in templates:
+        if kind == "naomit":
+            out.append(("filter", ("notna", cats)))
+            continue
+        e = bind(t)
+        if e is None:
+            return None
+        out.append(("filter", e))
+    return tuple(out)
+
+
+# -- region accounting -------------------------------------------------------
+
+
+def _elision(kind: str, k: int, base_ragged: bool):
+    """(verbs, repacks_elided, syncs_elided) for a fused region of
+    ``k`` predicate stages.  Eager repacks = ragged stage inputs
+    (stages 2..k always; stage 1 iff the base is ragged); eager syncs =
+    one count sync per stage (+ the group count).  Fused keeps one sync
+    and — for the filter-only shape — one boundary exchange."""
+    eager_repacks = (k - 1) + (1 if base_ragged else 0)
+    if kind == "filter_sort":
+        return k + 1, eager_repacks, k - 1
+    if kind == "filter_only":
+        return k, max(eager_repacks - 1, 0), k - 1
+    return k + 1, eager_repacks, k   # filter_gb: filter sync + G -> G
+
+
+# -- the planner entry point -------------------------------------------------
+
+
+def try_plan(op: str, node, env, eval_fn) -> Optional[Frame]:
+    """Offer a terminal verb node to the planner.  Returns the fused
+    region's result Frame, or None to decline (the caller's eager
+    handler then runs untouched — and its recursive evaluation of inner
+    nodes re-offers nested chains, which is how long mixed chains split
+    into regions)."""
+    if _bypassed():
+        return None
+    mode = config.rapids_fuse_mode()
+    if mode == "off":
+        return None
+    try:
+        plan = _plan_region(op, node, env, eval_fn)
+    except Exception:  # noqa: BLE001 — planning must never kill a tree
+        PlanStats.note_error()
+        log.warning("rapids planner failed on %r; falling back to the "
+                    "eager path", op, exc_info=True)
+        return None
+    if plan is None:
+        return None
+    kind, fr, run_fused, k = plan
+
+    from h2o_tpu.core.oom import fused_fallback
+    base_ragged = bool(fr.is_ragged)   # the fused run may consume fr
+    fell_back = []
+
+    def run_eager():
+        fell_back.append(True)
+        PlanStats.note_fallback()
+        with _bypass():
+            return eval_fn(node, env)
+
+    out = fused_fallback("rapids.fuse", run_fused, run_eager)
+    if not fell_back:
+        verbs, repacks, syncs = _elision(kind, k, base_ragged)
+        PlanStats.note_fused(kind, verbs, repacks, syncs)
+    return out
+
+
+def _plan_region(op: str, node, env, eval_fn):
+    """Structural extraction + gating.  Returns ``(kind, base_frame,
+    run_fused_thunk, n_pred_stages)`` or None."""
+    from h2o_tpu.core.munge import (COMBINABLE_AGGS, _frame_bucket,
+                                    device_munge_enabled,
+                                    shard_munge_enabled)
+
+    if not (device_munge_enabled() and shard_munge_enabled()):
+        return None
+    cap = config.rapids_fuse_max_verbs()
+
+    if op == "sort":
+        if len(node) < 3 or not (isinstance(node[2], tuple) and
+                                 node[2][0] == "numlist"):
+            return None
+        base_node, stage_nodes = _extract_chain(node[1], cap - 1)
+        if not stage_nodes:
+            return None
+        kind = "filter_sort"
+    elif op in _STAGE_OPS:
+        base_node, stage_nodes = _extract_chain(node, cap)
+        if len(stage_nodes) < 2:
+            return None
+        kind = "filter_only"
+    elif op in ("GB", "groupby"):
+        base_node, stage_nodes = _extract_chain(node[1], 1)
+        if len(stage_nodes) != 1:
+            return None
+        kind = "filter_gb"
+    else:
+        return None
+
+    templates = _compile_stages(stage_nodes)
+    if templates is None:
+        return None
+
+    PlanStats.note_considered()
+
+    # resolve the lever's cheap early-exits BEFORE evaluating the base:
+    # when the decision is forced off / reference-mode per-verb, the
+    # eager handler will evaluate the tree itself, and evaluating it
+    # here first would run every inner verb twice
+    from h2o_tpu.core.autotune import autotune_mode, resolve_flag, \
+        tri_state
+    forced = tri_state("H2O_TPU_RAPIDS_FUSE")
+    if forced is False:
+        PlanStats.note_lever(False)
+        return None
+    if forced is None:
+        from h2o_tpu.core.cloud import backend_is_tpu
+        amode = autotune_mode()
+        if amode == "off" or (amode != "force" and not backend_is_tpu()):
+            PlanStats.note_lever(False)
+            return None
+
+    from h2o_tpu.rapids.interp import _as_frame, _lit
+    with _bypass():
+        fr = _as_frame(eval_fn(base_node, env))
+    if not frame_device_ok(fr):
+        return None
+    if kind == "filter_gb" and fr.is_ragged:
+        # the repack-free eager shape needs a canonical base: a ragged
+        # base repacks during the eager mask eval, and group-by float
+        # accumulation order is shard-layout-dependent
+        return None
+
+    stages = _resolve_stages(templates, fr)
+    if stages is None:
+        return None
+
+    sort_spec = None
+    gcols = aggs = None
+    if kind == "filter_sort":
+        try:
+            idxs = [fr.names.index(x[1]) if isinstance(x, tuple) and
+                    x[0] == "str" else int(x) for x in node[2][1]]
+        except (TypeError, ValueError, IndexError, KeyError):
+            return None
+        asc = [bool(int(x)) for x in node[3][1]] if len(node) > 3 \
+            else [True] * len(idxs)
+        if not idxs or len(asc) != len(idxs):
+            return None
+        sort_spec = tuple(
+            (int(j), bool(a), bool(fr.vecs[j].is_categorical))
+            for j, a in zip(idxs, asc))
+    elif kind == "filter_gb":
+        try:
+            gcols = [int(x) for x in node[2][1]]
+        except (TypeError, ValueError):
+            return None
+        aggs = []
+        i = 3
+        while i < len(node):
+            a = _lit(node[i])
+            if not isinstance(a, str):
+                break
+            if a in ("median", "mode"):
+                # device-able but not shard-combinable: the eager
+                # handler owns these (global fused segment kernels)
+                return None
+            if a not in COMBINABLE_AGGS:
+                break               # trailing non-agg args, eager-style
+            if i + 1 >= len(node):
+                return None
+            col = node[i + 1]
+            try:
+                col_i = int(col) if isinstance(col, float) else \
+                    fr.names.index(_lit(col))
+            except (TypeError, ValueError):
+                return None
+            na = _lit(node[i + 2]) if i + 2 < len(node) else "all"
+            aggs.append((a, col_i, na))
+            i += 3
+        if not gcols:
+            return None
+
+    B = _frame_bucket(fr)
+    fused = True if forced else resolve_flag("rapids.fuse", (B, kind))
+    PlanStats.note_lever(fused)
+    if not fused:
+        return None
+
+    from h2o_tpu.core import fuse
+    if kind == "filter_sort":
+        run = lambda: fuse.run_fused_sort(fr, stages, sort_spec)  # noqa: E731
+    elif kind == "filter_only":
+        run = lambda: fuse.run_fused_filter(fr, stages)           # noqa: E731
+    else:
+        run = lambda: fuse.run_fused_groupby(fr, stages, gcols,   # noqa: E731
+                                             aggs)
+    return kind, fr, run, len(stages)

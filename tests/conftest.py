@@ -114,6 +114,17 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
                 ms["persist_reloads"], hits, misses, rate,
                 ms["demand_page_stalls"], ms["tiers"]["host"],
                 ms["tiers"]["persist"], ms["peak_hbm_bytes"]))
+        from h2o_tpu.rapids.plan import PlanStats
+        ps = PlanStats.snapshot()
+        terminalreporter.write_line(
+            "[plan] considered={} fused={} verbs={} repacks_elided={} "
+            "syncs_elided={} unfused_fallbacks={} errors={} | "
+            "lever fused={} per_verb={}".format(
+                ps["regions_considered"], ps["regions_fused"],
+                ps["verbs_fused"], ps["repacks_elided"],
+                ps["host_syncs_elided"], ps["fallbacks_unfused"],
+                ps["planner_errors"], ps["lever_fused"],
+                ps["lever_per_verb"]))
         from h2o_tpu.lint import last_summary
         ls = last_summary()
         if ls is not None:

@@ -197,6 +197,32 @@ CASES = [
         def place(arr):
             return landing.reshard_rows(arr)
      """, {}),
+    # GL310: planner-emitted fused region bodies must stay traced (no
+    # eager repack / host gather / count sync) and fused-region
+    # dispatches must run under the rapids.fuse phase
+    ("GL310", "core/fuse.py", """
+        import numpy as np
+
+        def _build_fused_sort(B, n):
+            def kern(payload, counts):
+                fr = payload.repack()
+                c = np.asarray(counts)
+                return fr.to_numpy(), c
+            return kern
+
+        def run_region(store, key, build, payload):
+            return store.dispatch("munge", key, build, (payload,))
+     """, """
+        PHASE = "rapids.fuse"
+
+        def _build_fused_sort(B, n):
+            def kern(payload, counts):
+                return payload, counts
+            return kern
+
+        def run_region(store, key, build, payload):
+            return store.dispatch(PHASE, key, build, (payload,))
+     """, {}),
     ("GL401", "core/store.py", """
         import threading
         import jax.numpy as jnp

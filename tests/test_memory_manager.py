@@ -91,6 +91,46 @@ def test_stats_surface(cl, tight_budget, rng):
     assert lh == sorted(lh, reverse=True)
 
 
+def test_ragged_capacity_vs_valid_bytes(cl, rng):
+    """Ragged columns (per-shard valid prefixes) are accounted at BOTH
+    device capacity (resident_bytes — what a spill frees) and valid
+    bytes (valid_bytes — real rows only); pressure() drives hbm_frac
+    off VALID bytes so a heavily-filtered ragged frame's padding
+    cannot trip the serving breaker spuriously."""
+    import gc
+    from h2o_tpu.core.frame import Vec
+    from h2o_tpu.core.memory import manager, set_budget
+    prev = manager().budget
+    try:
+        m = set_budget(1_000_000)
+        gc.collect()
+        base = m.stats()
+        B = 1024                          # capacity rows, 8-shard aligned
+        nsh = cl.n_nodes
+        sc = (rng.integers(0, 8, nsh)).astype(np.int64)
+        sc[0] = 9                         # ensure non-trivial + non-empty
+        v = Vec(np.zeros(B, np.float32), shard_counts=sc)
+        s = m.stats()
+        cap = s["resident_bytes"] - base["resident_bytes"]
+        val = s["valid_bytes"] - base["valid_bytes"]
+        assert cap == v._device_nbytes() >= B * 4
+        assert val == int(sc.sum()) * 4   # only real rows
+        assert val < cap                  # padding gap visible
+        p = m.pressure()
+        assert p["resident_bytes"] == s["resident_bytes"]
+        assert p["valid_bytes"] == s["valid_bytes"]
+        # hbm_frac is valid/budget, NOT capacity/budget
+        assert p["hbm_frac"] == pytest.approx(
+            p["valid_bytes"] / 1_000_000)
+        # dense columns: valid == capacity (no padding beyond alignment)
+        d = Vec(rng.normal(size=B).astype(np.float32))
+        assert d._valid_nbytes() == B * 4 <= d._device_nbytes()
+        s2 = m.stats()
+        assert (s2["valid_bytes"] - s["valid_bytes"]) == B * 4
+    finally:
+        set_budget(prev)
+
+
 def test_emergency_sweep_spills_everything(cl, rng):
     """The OOM ladder's rung (a): sweep() drops EVERY resident device
     payload; reads afterwards are transparent reloads."""

@@ -234,19 +234,52 @@ def test_groupby_mode_device_parity(cl, sess, rng, monkeypatch):
                 "(GB gbmode1 [0] mode 1 'all' nrow 1 'all')")
 
 
-def test_groupby_mode_high_cardinality_host_fallback(cl, sess, rng,
+def test_groupby_mode_high_cardinality_device_parity(cl, sess, rng,
                                                      monkeypatch):
-    """a mode column whose domain exceeds the count-table cap keeps the
-    documented host fallback (and matches it, trivially)."""
-    import h2o_tpu.core.munge as mg
-    from h2o_tpu.core.diag import DispatchStats
-    monkeypatch.setattr(mg, "_MODE_MAX_CARD", 2)
-    monkeypatch.setenv("H2O_TPU_DEVICE_MUNGE", "1")
-    _put("gbmode2", _gb_frame(rng, n=60))
-    snap0 = DispatchStats.host_pulls("munge")
-    out = _exec(sess, "(GB gbmode2 [1] mode 0 'all')")   # 4 levels > 2
-    assert out.nrows >= 1
-    assert DispatchStats.host_pulls("munge") >= snap0
+    """a mode column whose domain exceeds the old 1024-wide count-table
+    cap now stays on device: the chunked segment-bincount folds the
+    table in value-range passes, so the fold crosses chunk boundaries
+    (domain 1500 -> two passes) and must still break count ties to the
+    SMALLEST code, with zero host pulls."""
+    from h2o_tpu.core.frame import Frame, T_CAT, Vec
+    card = 1500
+    n = 240
+    g = rng.integers(0, 6, size=n).astype(np.int32)
+    # codes concentrated at both ends of the domain so both chunks hold
+    # real candidates; -1 NA codes sprinkle in
+    m = np.where(rng.uniform(size=n) < 0.5,
+                 rng.integers(0, 8, size=n),
+                 rng.integers(card - 8, card, size=n)).astype(np.int32)
+    m[rng.uniform(size=n) < 0.1] = -1
+    dom_g = [f"g{i}" for i in range(6)]
+    dom_m = [f"v{i}" for i in range(card)]
+    _put("gbmode2",
+         Frame(["g", "m"], [Vec(g, T_CAT, domain=dom_g),
+                            Vec(m, T_CAT, domain=dom_m)]))
+    _both_modes(sess, monkeypatch,
+                "(GB gbmode2 [0] mode 1 'all' nrow 1 'all')")
+
+
+def test_segment_mode_chunk_fold_tie_semantics(cl):
+    """direct kernel check across a chunk boundary: equal counts in
+    different chunks keep the SMALLER value (np.bincount().argmax()
+    first-max semantics), a strictly greater later-chunk count wins,
+    and an all-invalid group is NaN."""
+    import jax.numpy as jnp
+    from h2o_tpu.core.quantile import _MODE_CHUNK, segment_mode
+    card = _MODE_CHUNK + 10
+    hi = _MODE_CHUNK + 3                       # lives in the 2nd chunk
+    vals = jnp.asarray(np.array(
+        [2, 2, hi, hi,            # group 0: tie 2x2 vs 2xhi -> 2
+         5, hi, hi,               # group 1: 1x5 vs 2xhi -> hi
+         7, 7, 7], np.float32))   # group 2: invalid -> NaN
+    ok = jnp.asarray(np.array([1, 1, 1, 1, 1, 1, 1, 0, 0, 0], bool))
+    inv = jnp.asarray(np.array([0, 0, 0, 0, 1, 1, 1, 2, 2, 2],
+                               np.int32))
+    out = np.asarray(segment_mode(vals, ok, inv, 4, card))
+    assert out[0] == 2.0
+    assert out[1] == float(hi)
+    assert np.isnan(out[2])
 
 
 # ------------------------------------------------------------------ filter

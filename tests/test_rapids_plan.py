@@ -1,0 +1,289 @@
+"""Lazy Rapids planner: fused-region parity, elision accounting,
+degradation, observability.
+
+The ISSUE-17 contract for rapids/plan.py + core/fuse.py:
+
+- every fusable region shape (filter -> sort, na.omit/filter chains ->
+  sort, k>=2 filter-only, filter -> group-by) produces BITWISE the same
+  frame as the ``H2O_TPU_RAPIDS_FUSE=0`` eager per-verb chain, row for
+  row, on mesh shapes {1x1, 2x2, 4x2} over the NA/tie/categorical-NA/
+  duplicate-key torture frame;
+- PlanStats elision accounting matches the ``_elision`` formulas: a
+  k-stage chain elides k-1 host count syncs (plus the group sync for
+  GB) and every intermediate repack except the filter-only boundary
+  exchange;
+- a fused region that OOMs beyond its inner ladder (injected via
+  ``H2O_TPU_CHAOS_REGION_OOM_TRANSIENT``) degrades to the eager chain —
+  still bitwise — and the ``unfused_fallbacks`` rung reaches
+  ``oom.stats()`` and the GET /3/Resilience payload; once the transient
+  clears, the SAME region fuses cleanly again;
+- steady-state reruns of a warmed chain recompile exactly 0 programs
+  (exec-store cache keyed on chain fingerprint x row bucket);
+- decline paths stay eager and correct: a sort with no predicate chain,
+  median/mode aggregates (device-able, not shard-combinable), a
+  predicate reading a DIFFERENT frame than its stage input, and a
+  host-path string frame.
+"""
+
+import numpy as np
+import pytest
+
+from h2o_tpu.core.diag import DispatchStats
+
+MESH_SHAPES = ((1, 1), (2, 2), (4, 2))
+
+_K = "rp_f"
+
+# (tag, expr) — every fusable region shape, all referencing the DKV key
+# directly; nested predicates structurally repeat their stage's input
+_EXPRS = (
+    ("filter_sort",
+     f"(sort (rows {_K} (> (cols {_K} [1]) 2)) [0] [1])"),
+    ("naomit_filter_sort",
+     f"(sort (na.omit (rows {_K} (> (cols {_K} [1]) 0))) [2 0] [0 1])"),
+    ("filter_only",
+     f"(na.omit (rows {_K} (> (cols {_K} [1]) 1)))"),
+    ("filter_gb",
+     f"(GB (rows {_K} (<= (cols {_K} [1]) 3)) [2] mean 0 'all' "
+     "nrow 0 'all' sum 1 'all' sd 0 'all' min 0 'all' max 0 'all')"),
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    """Planner drills assert on cumulative chaos/OOM state — zero it."""
+    from h2o_tpu.core import chaos, oom
+    oom.reset_stats()
+    chaos.reset()
+    yield
+    oom.reset_stats()
+    chaos.reset()
+
+
+@pytest.fixture()
+def reboot():
+    """Boot arbitrary mesh shapes inside a test; restore the ORIGINAL
+    session Cloud INSTANCE afterwards (same contract as
+    test_shard_munge) — later tier-1 modules hold the session ``cl``
+    fixture's handle and its DKV."""
+    from h2o_tpu.core.cloud import Cloud
+    saved = Cloud._instance
+
+    def boot(n, m):
+        return Cloud.boot(nodes=n, model_axis=m)
+
+    yield boot
+    with Cloud._lock:
+        Cloud._instance = saved
+
+
+def _torture(rng, n=203):
+    """NAs in the filter/sort column, heavy duplicate keys/ties, and a
+    categorical with -1 (cat NA) codes — the munge edge-case frame."""
+    from h2o_tpu.core.frame import Frame, T_CAT, Vec
+    x = rng.standard_normal(n).astype(np.float32)
+    x[rng.random(n) < 0.15] = np.nan
+    y = rng.integers(0, 5, n).astype(np.float32)
+    c = rng.integers(-1, 3, n).astype(np.int32)
+    return Frame(["x", "y", "c"],
+                 [Vec(x), Vec(y), Vec(c, T_CAT, domain=["a", "b", "d"])])
+
+
+def _run(expr, fuse, mk, seed=7):
+    """Evaluate ``expr`` against a fresh torture frame bound to the
+    ``rp_f`` key with the planner forced on/off."""
+    from h2o_tpu.core.cloud import cloud
+    from h2o_tpu.rapids.interp import Session, rapids_exec
+    mk.setenv("H2O_TPU_RAPIDS_FUSE", "1" if fuse else "0")
+    fr = _torture(np.random.default_rng(seed))
+    fr.key = _K
+    cloud().dkv.put(_K, fr)
+    try:
+        return rapids_exec(expr, Session("rapids_plan_t"))
+    finally:
+        cloud().dkv.remove(_K)
+
+
+def _assert_equal(dev, host, tag=""):
+    assert dev.names == host.names, tag
+    assert dev.nrows == host.nrows, tag
+    for n in dev.names:
+        vd, vh = dev.vec(n), host.vec(n)
+        assert vd.type == vh.type, (tag, n)
+        assert (vd.domain or None) == (vh.domain or None), (tag, n)
+        a = np.asarray(vd.to_numpy(), np.float64)
+        b = np.asarray(vh.to_numpy(), np.float64)
+        np.testing.assert_array_equal(a, b, err_msg=f"{tag}:{n}")
+
+
+def test_fused_parity_matrix_all_mesh_shapes(cl, reboot, monkeypatch):
+    """Every region shape, bitwise vs the eager oracle, on every tier-1
+    mesh shape — and each fused run really fused (exactly one region)."""
+    from h2o_tpu.rapids.plan import PlanStats
+    for n, m in MESH_SHAPES:
+        reboot(n, m)
+        for seed in (7, 11):
+            for tag, expr in _EXPRS:
+                before = PlanStats.snapshot()["regions_fused"]
+                fused = _run(expr, True, monkeypatch, seed)
+                assert PlanStats.snapshot()["regions_fused"] - before \
+                    == 1, (tag, n, m)
+                eager = _run(expr, False, monkeypatch, seed)
+                _assert_equal(fused, eager, f"{tag}@{n}x{m}")
+
+
+def test_plan_stats_elision_accounting(cl, monkeypatch):
+    """Counter deltas per region match the ``_elision`` formulas for a
+    canonical (non-ragged) base: k-stage chain -> k-1 sync elisions
+    (+1 group sync for GB), repacks = k-1 minus the filter-only
+    boundary exchange."""
+    from h2o_tpu.rapids.plan import PlanStats
+    cases = (
+        (_EXPRS[0], dict(verbs=2, repacks=0, syncs=0)),   # k=1 + sort
+        (_EXPRS[1], dict(verbs=3, repacks=1, syncs=1)),   # k=2 + sort
+        (_EXPRS[2], dict(verbs=2, repacks=0, syncs=1)),   # k=2 filters
+        (_EXPRS[3], dict(verbs=2, repacks=0, syncs=1)),   # k=1 + GB
+    )
+    for (tag, expr), want in cases:
+        b = PlanStats.snapshot()
+        _run(expr, True, monkeypatch)
+        a = PlanStats.snapshot()
+        d = {k: a[k] - b[k] for k in b if k != "kinds"}
+        assert d["regions_considered"] == 1, tag
+        assert d["regions_fused"] == 1, tag
+        assert d["lever_fused"] == 1, tag
+        assert d["verbs_fused"] == want["verbs"], tag
+        assert d["repacks_elided"] == want["repacks"], tag
+        assert d["host_syncs_elided"] == want["syncs"], tag
+    kinds = PlanStats.snapshot()["kinds"]
+    assert {"filter_sort", "filter_only", "filter_gb"} <= set(kinds)
+
+
+def test_zero_steady_state_recompiles(cl, monkeypatch):
+    """Warmed chain fingerprint x row bucket -> exec-store hits only:
+    fresh frames in the same bucket rerun with ZERO backend compiles."""
+    from h2o_tpu.rapids.plan import PlanStats
+    tag, expr = _EXPRS[1]
+    for _ in range(2):
+        _run(expr, True, monkeypatch)
+    c0 = DispatchStats.xla_compiles()
+    b = PlanStats.snapshot()["regions_fused"]
+    for seed in (5, 9, 13):
+        _run(expr, True, monkeypatch, seed=seed)
+    assert DispatchStats.xla_compiles() == c0, \
+        "steady-state fused rerun recompiled"
+    assert PlanStats.snapshot()["regions_fused"] - b == 3
+
+
+def test_oom_degrade_to_unfused_bitwise(cl, monkeypatch):
+    """Injected fused-region OOM beyond the inner ladder: the region
+    degrades to the eager per-verb chain (bitwise), counts the
+    ``unfused_fallbacks`` rung at the rapids.fuse site, surfaces it on
+    GET /3/Resilience, and fuses cleanly once the transient clears."""
+    from h2o_tpu.api.handlers import resilience_stats
+    from h2o_tpu.core import chaos, oom
+    from h2o_tpu.rapids.plan import PlanStats
+    tag, expr = _EXPRS[1]
+    eager = _run(expr, False, monkeypatch)
+
+    chaos.configure(region_oom_transient=1, seed=0)
+    b = PlanStats.snapshot()
+    degraded = _run(expr, True, monkeypatch)
+    _assert_equal(degraded, eager, "degraded")
+    a = PlanStats.snapshot()
+    assert a["fallbacks_unfused"] - b["fallbacks_unfused"] == 1
+    assert a["regions_fused"] == b["regions_fused"]
+
+    st = oom.stats()
+    assert st["sites"]["rapids.fuse"]["unfused_fallbacks"] == 1
+    assert st["degradations"] >= 1
+    payload = resilience_stats({})
+    assert payload["oom"]["sites"]["rapids.fuse"]["unfused_fallbacks"] == 1
+    assert payload["chaos"]["injected_region_ooms"] == 1
+
+    # transient exhausted: the SAME region fuses clean on the next run
+    again = _run(expr, True, monkeypatch)
+    _assert_equal(again, eager, "refused")
+    s2 = PlanStats.snapshot()
+    assert s2["regions_fused"] - a["regions_fused"] == 1
+    assert s2["fallbacks_unfused"] == a["fallbacks_unfused"]
+
+
+def test_decline_sort_without_chain(cl, monkeypatch):
+    """A bare sort has nothing to fuse: not even considered."""
+    from h2o_tpu.rapids.plan import PlanStats
+    b = PlanStats.snapshot()
+    out = _run(f"(sort {_K} [0] [1])", True, monkeypatch)
+    a = PlanStats.snapshot()
+    assert a["regions_considered"] == b["regions_considered"]
+    assert a["regions_fused"] == b["regions_fused"]
+    assert out.nrows == 203
+
+
+def test_decline_noncombinable_aggs(cl, monkeypatch):
+    """median/mode are device-able but not shard-combinable: the region
+    is considered, then declined to the eager fused-segment kernels —
+    and the answer matches the eager oracle."""
+    from h2o_tpu.rapids.plan import PlanStats
+    for agg, col in (("median", 0), ("mode", 2)):
+        expr = (f"(GB (rows {_K} (> (cols {_K} [1]) 0)) [1] "
+                f"{agg} {col} 'all')")
+        b = PlanStats.snapshot()
+        fused = _run(expr, True, monkeypatch)
+        a = PlanStats.snapshot()
+        assert a["regions_fused"] == b["regions_fused"], agg
+        eager = _run(expr, False, monkeypatch)
+        _assert_equal(fused, eager, agg)
+
+
+def test_decline_foreign_frame_predicate(cl, monkeypatch):
+    """A stage predicate reading a DIFFERENT frame than its input has
+    frame-crossing semantics the fused mask can't reproduce: the
+    template compiler declines before the region is even counted."""
+    from h2o_tpu.core.cloud import cloud
+    from h2o_tpu.rapids.interp import Session, rapids_exec
+    from h2o_tpu.rapids.plan import PlanStats
+    monkeypatch.setenv("H2O_TPU_RAPIDS_FUSE", "1")
+    fr = _torture(np.random.default_rng(3))
+    gr = _torture(np.random.default_rng(3))
+    fr.key, gr.key = "rp_f", "rp_g"
+    cloud().dkv.put("rp_f", fr)
+    cloud().dkv.put("rp_g", gr)
+    try:
+        b = PlanStats.snapshot()
+        out = rapids_exec("(na.omit (rows rp_f (> (cols rp_g [1]) 1)))",
+                          Session("rapids_plan_t"))
+        a = PlanStats.snapshot()
+        assert a["regions_considered"] == b["regions_considered"]
+        assert a["regions_fused"] == b["regions_fused"]
+        assert 0 < out.nrows < 203
+    finally:
+        cloud().dkv.remove("rp_f")
+        cloud().dkv.remove("rp_g")
+
+
+def test_decline_host_path_string_frame(cl, monkeypatch):
+    """A frame with a host-tier string column fails frame_device_ok:
+    considered, declined, and the eager host path still answers."""
+    from h2o_tpu.core.cloud import cloud
+    from h2o_tpu.core.frame import Frame, T_STR, Vec
+    from h2o_tpu.rapids.interp import Session, rapids_exec
+    from h2o_tpu.rapids.plan import PlanStats
+    monkeypatch.setenv("H2O_TPU_RAPIDS_FUSE", "1")
+    n = 64
+    fr = Frame(["x", "s"],
+               [Vec(np.arange(n, dtype=np.float32)),
+                Vec([f"r{i}" for i in range(n)], T_STR)])
+    fr.key = "rp_s"
+    cloud().dkv.put("rp_s", fr)
+    try:
+        b = PlanStats.snapshot()["regions_fused"]
+        out = rapids_exec(
+            "(sort (rows rp_s (> (cols rp_s [0]) 9)) [0] [0])",
+            Session("rapids_plan_t"))
+        assert PlanStats.snapshot()["regions_fused"] == b
+        got = np.asarray(out.vec("x").to_numpy(), np.float64)
+        np.testing.assert_array_equal(
+            got, np.arange(n - 1, 9, -1, dtype=np.float64))
+    finally:
+        cloud().dkv.remove("rp_s")

@@ -83,6 +83,35 @@ def _workload() -> None:
     GBM(ntrees=2, max_depth=3, seed=3, nbins=64).train(
         y="y", training_frame=fr)
 
+    # a 4-verb fused Rapids pipeline (filter -> filter -> na.omit ->
+    # sort, then a filter -> group-by region): the lazy planner
+    # (rapids/plan.py) compiles each region into ONE shard_map program
+    # under the rapids.fuse phase, so the GL7xx tier audits the fused
+    # executables and the witness sees the region-site dispatches
+    os.environ["H2O_TPU_RAPIDS_FUSE"] = "1"
+    from h2o_tpu.core.cloud import cloud
+    from h2o_tpu.core.frame import T_CAT
+    from h2o_tpu.rapids.interp import Session, rapids_exec
+
+    # pipeline rows sized so the replicated group tables (bucketed to
+    # the Gb floor) stay well under the frame's global size — GL703
+    # checks exactly that ratio on the fused region's executable
+    Rp = 8192
+    x = rng.normal(size=Rp).astype(np.float32)
+    x[rng.random(Rp) < 0.1] = np.nan
+    g = rng.integers(0, 4, Rp).astype(np.int32)
+    pf = Frame(["x", "g"], [Vec(x), Vec(g, T_CAT,
+                                        domain=["a", "b", "c", "d"])])
+    pf.key = "gate_pipe"
+    cloud().dkv.put("gate_pipe", pf)
+    sess = Session("audit_gate")
+    inner = "(rows gate_pipe (> (cols gate_pipe [0]) -2))"
+    outer = f"(rows {inner} (< (cols {inner} [0]) 2))"
+    rapids_exec(f"(sort (na.omit {outer}) [1 0] [1 1])", sess)
+    rapids_exec("(GB (rows gate_pipe (<= (cols gate_pipe [0]) 1)) [1] "
+                "mean 0 'all' nrow 0 'all')", sess)
+    cloud().dkv.remove("gate_pipe")
+
     from h2o_tpu.core.job import Job
     from h2o_tpu.core.memory import manager
     from h2o_tpu.core.store import DKV
