@@ -431,6 +431,148 @@ def bench_rapids_scaleout():
     return out
 
 
+_MULTICHIP_SRC = r"""
+import hashlib, json, os, sys
+import numpy as np
+p = os.environ.get('BENCH_PLATFORM')
+if p:
+    import jax
+    jax.config.update('jax_platforms', p)
+import jax
+import jax.numpy as jnp
+slices = int(os.environ['MC_SLICES'])
+rows_list = [int(r) for r in os.environ['MC_ROWS'].split(',')]
+from h2o_tpu.core.cloud import Cloud
+Cloud.boot(nodes=8, model_axis=1, slices=slices)
+from h2o_tpu.core.frame import Frame, T_CAT, Vec
+from h2o_tpu.core import munge
+from h2o_tpu.core.diag import DispatchStats
+from h2o_tpu.ops.histogram import histogram_build
+
+def coll():
+    snap = DispatchStats.snapshot().get('collectives', {})
+    out = {}
+    for ph in snap.values():
+        for tag, d in ph.items():
+            c = out.setdefault(tag, [0, 0])
+            c[0] += d['ici_bytes']
+            c[1] += d['dcn_bytes']
+    return out
+
+def diff(a, b):
+    return {t: {'ici_bytes': b[t][0] - a.get(t, [0, 0])[0],
+                'dcn_bytes': b[t][1] - a.get(t, [0, 0])[1]}
+            for t in b if b[t] != a.get(t, [0, 0])}
+
+def hx(*arrays):
+    h = hashlib.sha256()
+    for a in arrays:
+        h.update(np.ascontiguousarray(np.asarray(a)).tobytes())
+    return h.hexdigest()[:16]
+
+res = {}
+for R in rows_list:
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=R).astype(np.float32)
+    g = rng.integers(0, 64, R).astype(np.int32)
+    fr = Frame(['x', 'g'],
+               [Vec(x), Vec(g, T_CAT,
+                            domain=[f'g{i}' for i in range(64)])])
+    c0 = coll()
+    s = munge.sort_frame(fr, [0], [True])
+    c1 = coll()
+    gb = munge.groupby_frame(fr, [1], [('mean', 0, 'all'),
+                                       ('sum', 0, 'all'),
+                                       ('nrow', 0, 'all')])
+    c2 = coll()
+    bins = jnp.asarray(rng.integers(0, 32, size=(R, 4)), jnp.int32)
+    leaf = jnp.asarray(rng.integers(0, 8, size=(R,)), jnp.int32)
+    st = jnp.asarray(rng.normal(size=(R, 4)), jnp.float32)
+    h = histogram_build(bins, leaf, st, n_leaves=8, nbins=32)
+    c3 = coll()
+    res[str(R)] = {
+        'sort': diff(c0, c1), 'groupby': diff(c1, c2),
+        'hist': diff(c2, c3),
+        'hash': {'sort': hx(*[v.data[:s.nrows] for v in s.vecs]),
+                 'groupby': hx(*[v.data[:gb.nrows] for v in gb.vecs]),
+                 'hist': hx(h)}}
+print(json.dumps({'slices': slices, 'per_rows': res}))
+"""
+
+# the combine collectives of each step — the tags whose DCN bytes must
+# be row-count independent on a two-level mesh.  The route all_to_all
+# (sort.route) legitimately moves O(rows) and is reported separately.
+_MC_COMBINE_TAGS = {"sort": ("sort.splitters", "sort.counts"),
+                    "groupby": ("groupby.count", "groupby.partials"),
+                    "hist": ("hist.table",)}
+
+
+def bench_dryrun_multichip():
+    """Two-level-mesh dry run (core/cloud.py hierarchical collectives):
+    sort + group-by + histogram on a simulated 2x4 two-slice mesh
+    (slices=2, 8 data shards) at TWO row counts, plus a flat 1x8 leg,
+    each in a fresh subprocess.  Proves the traffic claim — the
+    cross-slice (DCN) bytes of every combine collective are O(table),
+    independent of row count — and the bitwise claim: flat-mesh and
+    two-slice outputs hash identically per step.  The per-axis byte
+    ledger (DispatchStats.note_collective, recorded at trace time)
+    is the measurement; the route all_to_all's O(rows) exchange is
+    reported separately, never counted as combine traffic."""
+    import subprocess
+    rows = os.environ.get("BENCH_MULTICHIP_ROWS", "48000,192000")
+    out = {"rows": rows,
+           "unit": "DCN combine bytes/step (2-slice mesh)"}
+    per = {}
+    for slices in (1, 2):
+        env = dict(os.environ)
+        env.update({"MC_SLICES": str(slices), "MC_ROWS": rows,
+                    "H2O_TPU_ROW_ALIGN":
+                        env.get("H2O_TPU_ROW_ALIGN", "128")})
+        if env.get("BENCH_PLATFORM", "").startswith("cpu") or \
+                "--xla_force_host_platform_device_count" not in \
+                env.get("XLA_FLAGS", ""):
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                " --xla_force_host_platform_device_"
+                                "count=8")
+        r = subprocess.run([sys.executable, "-c", _MULTICHIP_SRC],
+                           capture_output=True, env=env, timeout=900)
+        if r.returncode != 0:
+            per[f"slices_{slices}"] = {"error": r.stderr.decode()[-300:]}
+            continue
+        per[f"slices_{slices}"] = json.loads(
+            r.stdout.decode().strip().splitlines()[-1])
+    out.update(per)
+    two = per.get("slices_2", {}).get("per_rows", {})
+    flat = per.get("slices_1", {}).get("per_rows", {})
+    # ledger tags are "<kind>:<step tag>" (e.g. "all_gather:
+    # sort.splitters") — match on the suffix so a lowering change of
+    # kind does not silently drop a tag from the claim
+    def _step_dcn(d, step, tags):
+        return sum(v.get("dcn_bytes", 0)
+                   for t, v in d.get(step, {}).items()
+                   if t.split(":", 1)[-1] in tags)
+
+    dcn_per_step = {}
+    for R, d in two.items():
+        dcn_per_step[R] = {
+            step: _step_dcn(d, step, tags)
+            for step, tags in _MC_COMBINE_TAGS.items()}
+    out["dcn_combine_bytes"] = dcn_per_step
+    out["dcn_route_bytes"] = {
+        R: _step_dcn(d, "sort", ("sort.route",))
+        for R, d in two.items()}
+    vals = list(dcn_per_step.values())
+    out["dcn_row_independent"] = bool(
+        len(vals) == 2 and vals[0] == vals[1] and
+        any(v > 0 for v in vals[0].values()))
+    out["bitwise_match_flat"] = bool(
+        two and flat and all(
+            two[R]["hash"] == flat[R]["hash"] for R in two if R in flat))
+    # headline: total combine DCN per step-suite at the larger row count
+    out["value"] = float(sum(vals[-1].values())) if vals else 0.0
+    return out
+
+
 _COLD_START_SRC = r"""
 import json, os, sys, time
 import numpy as np
@@ -1269,7 +1411,7 @@ def _main_ladder(detail):
     configs = os.environ.get(
         "BENCH_CONFIG",
         "gbm,gbm_ua,gbm_bf16,drf,glm,dl,hist,rapidsgb,rapidspipe,"
-        "scaleout,gbm10m,"
+        "scaleout,multichip,gbm10m,"
         "cpuref,cpuref10m,deep,coldstart,streamref,leverab,elastic,"
         "auditovh,binspack,tierhbm,servesus"
     ).split(",")
@@ -1318,7 +1460,7 @@ def _main_ladder(detail):
         configs = [c for c in configs
                    if c in ("gbm", "cpuref", "drf", "glm", "hist",
                             "rapidsgb", "rapidspipe", "scaleout",
-                            "gbm10m",
+                            "multichip", "gbm10m",
                             "cpuref10m", "coldstart", "leverab",
                             "elastic", "binspack", "tierhbm",
                             "servesus")]
@@ -1348,6 +1490,7 @@ def _main_ladder(detail):
                 min(rows, int(os.environ.get("BENCH_RAPIDS_PIPE_ROWS",
                                              500_000))))),
             ("scaleout", bench_rapids_scaleout),
+            ("multichip", bench_dryrun_multichip),
             ("gbm10m", lambda: bench_gbm10m(cols, depth)),
             ("cpuref10m", lambda: bench_cpu_reference_10m(cols, depth)),
             ("deep", lambda: bench_deep(fr, rows)),
@@ -1368,6 +1511,7 @@ def _main_ladder(detail):
              "rapidsgb": "rapids_groupby_throughput",
              "rapidspipe": "rapids_pipeline",
              "scaleout": "rapids_scaleout",
+             "multichip": "dryrun_multichip",
              "coldstart": "cold_start",
              "streamref": "streaming_refresh",
              "leverab": "lever_ab",

@@ -10,11 +10,28 @@ host pods join via ``jax.distributed.initialize`` (the flatfile/multicast
 discovery analog, reference water/init/NetworkInit.java:166-186).
 
 Mesh axes:
+- ``slices`` — the OUTER data-axis level (H2O_TPU_SLICES, default 1): one
+  entry per ICI island of a multi-slice pod, connected to its peers over
+  DCN.  At the default of 1 the axis is omitted entirely and the mesh is
+  byte-identical to the historical flat layout.
 - ``nodes``  — the data axis.  Frame rows shard over it; MRTask reduces psum
   over it.  This is the analog of chunk home-nodes (water/Key.java:91-182).
+  With slices > 1 it becomes the INNER level (``nodes/slices`` entries per
+  slice) and rows shard over the ``(slices, nodes)`` product, which visits
+  devices in exactly the flat order (slice-major), so shard g of the
+  two-level mesh holds the same rows as shard g of the flat mesh.
 - ``model``  — optional second axis for tensor parallelism inside an algorithm
   (e.g. wide GLM Gram blocks, DL layer sharding).  The reference has no model
   parallelism (SURVEY §2.4); this axis defaults to size 1.
+
+Every collective in the data plane goes through the hierarchical helper
+layer at the bottom of this module (hpsum/hall_gather/hall_to_all/
+hshard_index + the slice-scoped hall_gather_inner/hpsum_slices): on the
+flat mesh each helper lowers to exactly the historical flat-axis
+collective; on a two-level mesh the bulk stage stays ICI-local and one
+combine crosses the ``slices`` (DCN) level.  graftlint GL305 bans raw
+flat-axis collectives outside this module so the hierarchy cannot be
+silently bypassed.
 """
 
 from __future__ import annotations
@@ -35,6 +52,7 @@ log = get_logger("cloud")
 
 DATA_AXIS = "nodes"
 MODEL_AXIS = "model"
+SLICE_AXIS = "slices"
 
 _cache_enabled = False
 
@@ -127,13 +145,32 @@ class Cloud:
         devs = list(devices if devices is not None else jax.devices())
         n = args.nodes or (len(devs) // args.model_axis)
         m = args.model_axis
+        s = int(args.slices or 1)
         if n * m > len(devs):
             raise ValueError(
                 f"requested mesh {n}x{m} exceeds {len(devs)} devices")
+        if s < 1 or n % s != 0:
+            raise ValueError(
+                f"slices={s} must evenly divide the {n} data shards")
         devs = devs[: n * m]
-        self.mesh = Mesh(
-            np.asarray(devs).reshape(n, m), (DATA_AXIS, MODEL_AXIS))
+        if s == 1:
+            # flat mesh, byte-identical to the historical layout: same
+            # axes, same device order, same shardings — so every compiled
+            # program, exec-store key and CPU-tier output is unchanged
+            self.mesh = Mesh(
+                np.asarray(devs).reshape(n, m), (DATA_AXIS, MODEL_AXIS))
+        else:
+            # two-level mesh: same flat device list reshaped slice-major,
+            # so P((SLICE_AXIS, DATA_AXIS)) visits devices in the flat
+            # P(DATA_AXIS) order — shard g holds the same rows either way
+            self.mesh = Mesh(
+                np.asarray(devs).reshape(s, n // s, m),
+                (SLICE_AXIS, DATA_AXIS, MODEL_AXIS))
+        # n_nodes stays the TOTAL data-shard count (slices x per-slice
+        # nodes): shard quanta, row padding and every verb's statics are
+        # independent of how the shards are grouped into ICI islands
         self.n_nodes = n
+        self.n_slices = s
         # host control plane
         from h2o_tpu.core.store import DKV
         from h2o_tpu.core.job import JobRegistry
@@ -153,8 +190,9 @@ class Cloud:
             devs[0].platform == "cpu" and len(devs) > 1 and
             os.environ.get("H2O_TPU_DEVICE_GATE", "1").lower()
             not in ("0", "off", "false")) else None
-        log.info("Cloud '%s' of size %d formed (mesh %dx%d, platform=%s)",
-                 args.name, n, n, m, devs[0].platform)
+        log.info("Cloud '%s' of size %d formed (mesh %s%dx%d, platform=%s)",
+                 args.name, n, f"{s}x" if s > 1 else "", n, m,
+                 devs[0].platform)
 
     def device_gate(self):
         """Serialize multi-device collective programs across host threads.
@@ -237,7 +275,8 @@ class Cloud:
                     for v in val.vecs:
                         v._rehome()
                     val._matrix_cache.clear()
-            log.info("Cloud re-formed to mesh %dx%d (%d frames re-homed)",
+            log.info("Cloud re-formed to mesh %s%dx%d (%d frames re-homed)",
+                     f"{newc.n_slices}x" if newc.n_slices > 1 else "",
                      newc.n_nodes, newc.args.model_axis,
                      sum(1 for k in newc.dkv.keys()
                          if isinstance(newc.dkv.get(k), Frame)))
@@ -256,10 +295,20 @@ class Cloud:
 
     # -- sharding helpers ---------------------------------------------------
 
+    def data_pspec(self, *rest) -> P:
+        """The partition spec of the data axis on THIS mesh: ``P("nodes",
+        *rest)`` flat, ``P(("slices", "nodes"), *rest)`` two-level.  Every
+        row-sharded in_spec/out_spec and NamedSharding in the data plane
+        derives from this, so shard g always holds the same rows on either
+        topology (slice-major device order makes the specs equivalent)."""
+        if self.n_slices == 1:
+            return P(DATA_AXIS, *rest)
+        return P((SLICE_AXIS, DATA_AXIS), *rest)
+
     @property
     def row_sharding(self) -> NamedSharding:
         """Rows sharded over the data axis (chunk-homing analog)."""
-        return NamedSharding(self.mesh, P(DATA_AXIS))
+        return NamedSharding(self.mesh, self.data_pspec())
 
     @property
     def replicated(self) -> NamedSharding:
@@ -267,7 +316,7 @@ class Cloud:
 
     def matrix_sharding(self) -> NamedSharding:
         """(rows, cols) matrices: rows over nodes, cols replicated."""
-        return NamedSharding(self.mesh, P(DATA_AXIS, None))
+        return NamedSharding(self.mesh, self.data_pspec(None))
 
     def row_multiple(self) -> int:
         """Row counts are padded to a multiple of this so every device holds
@@ -296,5 +345,158 @@ class Cloud:
 def cloud() -> Cloud:
     """The current cloud (boots a default local one on first use)."""
     return Cloud.get()
+
+
+# -- hierarchical collective helper layer -----------------------------------
+#
+# The one place in the repo allowed to issue raw flat-axis collectives
+# (graftlint GL305 exempts this module).  Each helper reads the cloud at
+# TRACE time — topology is static per compiled program, and the exec
+# store keys entries by input shardings, so flat and two-level programs
+# are automatically distinct cache entries.
+#
+# Bitwise contract (probed on the 8-virtual-device XLA:CPU mesh, and the
+# property the parity matrix in tests/test_two_level_mesh.py gates):
+# every helper's two-level lowering produces BITWISE-identical results
+# to its flat-mesh lowering for the same global operand.
+#
+# - hpsum/hpmin/hpmax reduce over the axis PRODUCT ("slices","nodes") in
+#   slice-major order rather than spelling two nested psums: the product
+#   group enumerates devices in exactly the flat order, so the f32
+#   reduction association is independent of the slice split (an explicit
+#   psum-then-psum is NOT bitwise-stable — measured, not assumed).  XLA
+#   decomposes a cross-DCN all-reduce hierarchically on real topologies
+#   (intra-slice reduce, one DCN combine of the reduced payload per
+#   level), which is what the byte accounting records.
+# - hall_gather gathers the inner level first, then the outer; the
+#   (s, q, ...) -> (n, ...) reshape restores flat order exactly.
+# - hall_to_all stages the route as one cross-slice exchange of whole
+#   per-slice blocks (only the (s-1)/s off-slice fraction moves over
+#   DCN; the self-addressed block never leaves the island) followed by
+#   an ICI-local exchange — same permutation as the flat all_to_all.
+
+
+def _static_nbytes(x) -> int:
+    """Per-participant payload bytes of a collective operand — static
+    shape arithmetic at trace time (x is a tracer)."""
+    import jax.numpy as jnp
+    size = 1
+    for d in jnp.shape(x):
+        size *= int(d)
+    return size * np.dtype(jnp.result_type(x)).itemsize
+
+
+def _note(kind: str, tag: str, ici: int, dcn: int) -> None:
+    from h2o_tpu.core.diag import DispatchStats
+    DispatchStats.note_collective(f"{kind}:{tag}" if tag else kind,
+                                  ici, dcn)
+
+
+def _preduce(op, x, tag: str):
+    c = cloud()
+    nb = _static_nbytes(x)
+    if c.n_slices == 1:
+        _note(op.__name__, tag, ici=nb, dcn=0)
+        return op(x, DATA_AXIS)
+    _note(op.__name__, tag, ici=nb, dcn=nb)
+    return op(x, (SLICE_AXIS, DATA_AXIS))
+
+
+def hpsum(x, tag: str = ""):
+    """Hierarchical psum over all data shards (flat: ``psum(x, "nodes")``).
+    One reduced-payload combine crosses DCN per call on a two-level mesh;
+    bitwise-equal to the flat reduction (product-axis group order)."""
+    return _preduce(jax.lax.psum, x, tag)
+
+
+def hpmin(x, tag: str = ""):
+    """Hierarchical pmin over all data shards (exact — min is associative)."""
+    return _preduce(jax.lax.pmin, x, tag)
+
+
+def hpmax(x, tag: str = ""):
+    """Hierarchical pmax over all data shards (exact — max is associative)."""
+    return _preduce(jax.lax.pmax, x, tag)
+
+
+def hall_gather(x, tag: str = ""):
+    """Gather one per-shard operand from every data shard ->
+    ``(n_nodes, *x.shape)`` in flat shard order.  Two-level lowering:
+    ICI-local gather to ``(q, ...)``, then ONE cross-slice gather of the
+    slice-local block, then a pure reshape — DCN carries ``q * nbytes``
+    per non-local slice, independent of anything but the operand shape."""
+    import jax.numpy as jnp
+    c = cloud()
+    nb = _static_nbytes(x)
+    if c.n_slices == 1:
+        _note("all_gather", tag, ici=nb * (c.n_nodes - 1), dcn=0)
+        return jax.lax.all_gather(x, DATA_AXIS)
+    s = c.n_slices
+    q = c.n_nodes // s
+    _note("all_gather", tag, ici=nb * (q - 1), dcn=nb * q * (s - 1))
+    g = jax.lax.all_gather(x, DATA_AXIS)          # (q, ...)   ICI
+    g = jax.lax.all_gather(g, SLICE_AXIS)         # (s, q, ...) DCN
+    return g.reshape((c.n_nodes,) + tuple(jnp.shape(x)))
+
+
+def hall_to_all(x, tag: str = ""):
+    """Bucket exchange: shard i's row-block ``x[j]`` lands on shard j
+    (flat: ``all_to_all(x, "nodes", 0, 0)``; x has leading dim n_nodes).
+    Two-level lowering routes whole per-slice blocks across DCN first
+    (only off-slice blocks cross — the self block stays on the island),
+    then scatters within each slice over ICI.  Same permutation, bitwise
+    payloads; DCN bytes are the off-slice fraction of the buffer."""
+    import jax.numpy as jnp
+    c = cloud()
+    nb = _static_nbytes(x)
+    n = c.n_nodes
+    if c.n_slices == 1:
+        _note("all_to_all", tag, ici=nb * (n - 1) // n, dcn=0)
+        return jax.lax.all_to_all(x, DATA_AXIS, 0, 0)
+    s = c.n_slices
+    q = n // s
+    _note("all_to_all", tag, ici=nb * (q - 1) // q, dcn=nb * (s - 1) // s)
+    rest = tuple(jnp.shape(x))[1:]
+    b = x.reshape((s, q) + rest)
+    b = jax.lax.all_to_all(b, SLICE_AXIS, 0, 0)   # DCN: per-slice blocks
+    b = jax.lax.all_to_all(b, DATA_AXIS, 1, 1)    # ICI: within-slice scatter
+    return b.reshape((n,) + rest)
+
+
+def hshard_index():
+    """Global data-shard index of the calling program instance, in flat
+    shard order (0..n_nodes-1) on either topology."""
+    c = cloud()
+    if c.n_slices == 1:
+        return jax.lax.axis_index(DATA_AXIS)
+    q = c.n_nodes // c.n_slices
+    return (jax.lax.axis_index(SLICE_AXIS) * q
+            + jax.lax.axis_index(DATA_AXIS))
+
+
+def hall_gather_inner(x, tag: str = ""):
+    """SLICE-LOCAL gather: ``(q, *x.shape)`` from the shards of the
+    calling instance's own ICI island only — never touches DCN.  On the
+    flat mesh the island is the whole cloud (``q == n_nodes``).  Used by
+    two-level kernels that combine a slice-local partial before the one
+    DCN exchange (e.g. the group-by distinct-count upper bound)."""
+    nb = _static_nbytes(x)
+    c = cloud()
+    q = c.n_nodes // c.n_slices
+    _note("all_gather", tag, ici=nb * (q - 1), dcn=0)
+    return jax.lax.all_gather(x, DATA_AXIS)
+
+
+def hpsum_slices(x, tag: str = ""):
+    """Reduce a slice-replicated value across slices only — the one DCN
+    combine of a hierarchical reduction whose inner stage was computed
+    slice-locally.  Identity on the flat mesh (one slice, nothing to
+    combine)."""
+    c = cloud()
+    if c.n_slices == 1:
+        return x
+    nb = _static_nbytes(x)
+    _note("psum", tag, ici=0, dcn=nb)
+    return jax.lax.psum(x, SLICE_AXIS)
 
 

@@ -161,6 +161,16 @@ class OptArgs:
     ip: str = "127.0.0.1"
     # data-axis size override: number of mesh "nodes" (None = all local devices)
     nodes: Optional[int] = None
+    # outer data-axis level: number of ICI islands ("slices") the data
+    # shards are grouped into.  1 (default) = today's flat mesh with
+    # byte-identical programs; >1 grows the mesh to
+    # (slices, nodes/slices, model) and every collective consumer runs
+    # through the core/cloud.py hierarchical helpers (hpsum/hall_gather/
+    # hall_to_all): bulk traffic stays inside an ICI island, one
+    # table-sized combine crosses DCN per level.  ``nodes`` stays the
+    # TOTAL data-shard count, so shard quanta and verb statics are
+    # independent of how the shards are grouped.  H2O_TPU_SLICES env.
+    slices: int = 1
     # second mesh axis for model/tensor parallelism inside an algorithm
     model_axis: int = 1
     # -log_level

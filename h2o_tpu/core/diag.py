@@ -54,6 +54,13 @@ class DispatchStats:
     _transfer_bytes: Dict[str, int] = {}
     _host_pulls: Dict[str, int] = {}
     _host_pull_bytes: Dict[str, int] = {}
+    # per-phase, per-collective-kind byte accounting split by mesh level
+    # (inner ICI "nodes" axis vs outer DCN "slices" axis) — trace-time,
+    # static-shape based: the cloud.py hierarchical helpers note each
+    # collective ONCE PER TRACE, so totals count bytes per compiled
+    # program, not per dispatch (steady-state dispatches replay cached
+    # executables and move the same bytes every call)
+    _collectives: Dict[str, Dict[str, Dict[str, int]]] = {}
     _phase_local = threading.local()
     _xla_compiles = 0
     _listener_installed = False
@@ -132,6 +139,44 @@ class DispatchStats:
         with cls._lock:
             return cls._host_pulls.get(phase, 0)
 
+    # -- per-axis collective byte accounting (two-level mesh) -------------
+
+    @classmethod
+    def note_collective(cls, kind: str, ici_bytes: int, dcn_bytes: int = 0,
+                        phase: Optional[str] = None) -> None:
+        """One hierarchical collective noted at TRACE time by the
+        cloud.py helper layer (hpsum/hall_gather/hall_to_all).
+
+        ``kind`` is "<collective>:<site-tag>" ("all_gather:sort.splitters",
+        "psum:hist.table"...); ``ici_bytes`` is the per-participant payload
+        crossing the inner (intra-slice ICI) level, ``dcn_bytes`` the
+        payload crossing the outer (cross-slice DCN) level — 0 on a flat
+        mesh, where no collective ever leaves the ICI island.  These are
+        static-shape formulas evaluated once per compiled program, which
+        is exactly what the dryrun_multichip rung compares across row
+        counts: a combine whose dcn_bytes grows with rows is the bug the
+        two-level mesh exists to prevent."""
+        p = phase if phase is not None else cls.current_phase()
+        with cls._lock:
+            d = cls._collectives.setdefault(p, {}).setdefault(
+                kind, {"n": 0, "ici_bytes": 0, "dcn_bytes": 0})
+            d["n"] += 1
+            d["ici_bytes"] += int(ici_bytes)
+            d["dcn_bytes"] += int(dcn_bytes)
+
+    @classmethod
+    def collective_bytes(cls, phase: Optional[str] = None) -> Dict[str, int]:
+        """Summed {ici_bytes, dcn_bytes} for one phase (or all phases)."""
+        out = {"ici_bytes": 0, "dcn_bytes": 0}
+        with cls._lock:
+            for p, kinds in cls._collectives.items():
+                if phase is not None and p != phase:
+                    continue
+                for d in kinds.values():
+                    out["ici_bytes"] += d["ici_bytes"]
+                    out["dcn_bytes"] += d["dcn_bytes"]
+        return out
+
     @classmethod
     def install_xla_listener(cls) -> None:
         """Idempotent: register a jax monitoring listener that counts
@@ -166,6 +211,8 @@ class DispatchStats:
                     "transfer_bytes": dict(cls._transfer_bytes),
                     "host_pulls": dict(cls._host_pulls),
                     "host_pull_bytes": dict(cls._host_pull_bytes),
+                    "collectives": {p: {k: dict(v) for k, v in kinds.items()}
+                                    for p, kinds in cls._collectives.items()},
                     "xla_compiles": cls._xla_compiles,
                     "xla_listener": cls._listener_installed}
 
@@ -182,6 +229,7 @@ class DispatchStats:
             cls._transfer_bytes.clear()
             cls._host_pulls.clear()
             cls._host_pull_bytes.clear()
+            cls._collectives.clear()
 
 
 class TimeLine:

@@ -401,9 +401,12 @@ class Vec:
         if d is None:
             return False
         try:
-            from h2o_tpu.core.cloud import DATA_AXIS, cloud
+            from h2o_tpu.core.cloud import DATA_AXIS, SLICE_AXIS, cloud
             spec = d.sharding.spec
-            if not spec or spec[0] != DATA_AXIS:
+            # flat mesh rows shard over "nodes"; two-level over the
+            # ("slices", "nodes") product — both are row-sharded
+            if not spec or spec[0] not in (DATA_AXIS,
+                                           (SLICE_AXIS, DATA_AXIS)):
                 return False
             return d.sharding.mesh.devices.ravel()[0] in set(
                 cloud().mesh.devices.ravel())

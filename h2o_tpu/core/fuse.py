@@ -63,10 +63,11 @@ from typing import Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from h2o_tpu.core.cloud import DATA_AXIS, cloud, shard_map_compat
+from h2o_tpu.core.cloud import (cloud, hall_gather, hall_gather_inner,
+                                hpsum_slices, hshard_index,
+                                shard_map_compat)
 from h2o_tpu.core.diag import DispatchStats
 from h2o_tpu.core.exec_store import (aval_key, code_fingerprint,
                                      exec_store)
@@ -206,7 +207,7 @@ def _build_fused_sort(B: int, Pc: int, n: int, S: int, spec):
     def kern(payload, valid):
         keep = _keep_mask(payload, valid, stages)
         keys = _fused_sort_keys(payload, sort_spec)
-        i = lax.axis_index(DATA_AXIS)
+        i = hshard_index()
         gidx = i * L + jnp.arange(L, dtype=jnp.int32)
         inval = ~keep
         order = _local_lexsort(keys, gidx, inval, K)
@@ -217,9 +218,9 @@ def _build_fused_sort(B: int, Pc: int, n: int, S: int, spec):
         samp_k = jnp.take(ks, jnp.clip(pos, 0, L - 1), axis=0)
         samp_g = jnp.take(gs, jnp.clip(pos, 0, L - 1))
         samp_ok = (cnt > 0) & (pos < cnt)
-        all_k = lax.all_gather(samp_k, DATA_AXIS).reshape(n * S, K)
-        all_g = lax.all_gather(samp_g, DATA_AXIS).reshape(n * S)
-        all_ok = lax.all_gather(samp_ok, DATA_AXIS).reshape(n * S)
+        all_k = hall_gather(samp_k, "sort.splitters").reshape(n * S, K)
+        all_g = hall_gather(samp_g, "sort.splitters").reshape(n * S)
+        all_ok = hall_gather(samp_ok, "sort.splitters").reshape(n * S)
         sorder = _local_lexsort(all_k, all_g, ~all_ok, K)
         sk = jnp.take(all_k, sorder, axis=0)
         sg = jnp.take(all_g, sorder)
@@ -234,25 +235,27 @@ def _build_fused_sort(B: int, Pc: int, n: int, S: int, spec):
                        axis=1)
         dmask = jnp.where(keep, dest, n)
         kp = jnp.concatenate([keys, payload], axis=1)
-        rkp, rg, rv = _route(kp, gidx, dmask, n, L, L)
+        rkp, rg, rv = _route(kp, gidx, dmask, n, L, L, tag="sort.route")
         rk = rkp[:, :K]
         m_order = _local_lexsort(rk, rg, ~rv, K)
         rp = jnp.take(rkp[:, K:], m_order, axis=0)
         c = jnp.sum(rv.astype(jnp.int32))
-        all_c = lax.all_gather(c, DATA_AXIS)
+        all_c = hall_gather(c, "sort.counts")
         base = jnp.sum(jnp.where(jnp.arange(n) < i, all_c, 0))
         gpos = base + jnp.arange(n * L, dtype=jnp.int32)
         v2 = jnp.arange(n * L) < c
         dest2 = jnp.where(v2, jnp.clip(gpos // L, 0, n - 1), n)
-        rp2, rs2, rv2 = _route(rp, gpos % L, dest2, n, n * L, L)
+        rp2, rs2, rv2 = _route(rp, gpos % L, dest2, n, n * L, L,
+                               tag="sort.route")
         out = jnp.full((L + 1, Pc), jnp.nan, payload.dtype)
         out = out.at[jnp.where(rv2, rs2, L)].set(rp2)
         return out[:L], all_c
 
+    dp = cloud().data_pspec
     return shard_map_compat(
         kern, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
-        out_specs=(P(DATA_AXIS, None), P()), check_vma=False)
+        in_specs=(dp(None), dp()),
+        out_specs=(dp(None), P()), check_vma=False)
 
 
 def _build_fused_filter(B: int, Pc: int, n: int, spec):
@@ -277,14 +280,15 @@ def _build_fused_filter(B: int, Pc: int, n: int, spec):
         c_pre = jnp.sum(keep_pre.astype(jnp.int32))
         pay = jnp.take(payload, order, axis=0)
         flag = jnp.take(keep_all, order).astype(jnp.float32)
-        counts_pre = lax.all_gather(c_pre, DATA_AXIS)
-        i = lax.axis_index(DATA_AXIS)
+        counts_pre = hall_gather(c_pre, "filter.counts")
+        i = hshard_index()
         base = jnp.sum(jnp.where(jnp.arange(n) < i, counts_pre, 0))
         gpos = base + jnp.arange(L, dtype=jnp.int32)
         v = jnp.arange(L) < c_pre
         dest = jnp.where(v, jnp.clip(gpos // L, 0, n - 1), n)
         pf = jnp.concatenate([pay, flag[:, None]], axis=1)
-        rp, rs, rv = _route(pf, gpos % L, dest, n, L, L)
+        rp, rs, rv = _route(pf, gpos % L, dest, n, L, L,
+                            tag="filter.route")
         slot = jnp.where(rv, rs, L)
         buf = jnp.full((L + 1, Pc + 1), jnp.nan, payload.dtype)
         buf = buf.at[slot].set(rp)[:L]
@@ -294,12 +298,13 @@ def _build_fused_filter(B: int, Pc: int, n: int, spec):
         c = jnp.sum(keep_k.astype(jnp.int32))
         out = jnp.take(buf[:, :Pc], order2, axis=0)
         out = jnp.where((jnp.arange(L) < c)[:, None], out, jnp.nan)
-        return out, lax.all_gather(c, DATA_AXIS)
+        return out, hall_gather(c, "filter.counts")
 
+    dp = cloud().data_pspec
     return shard_map_compat(
         kern, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
-        out_specs=(P(DATA_AXIS, None), P()), check_vma=False)
+        in_specs=(dp(None), dp()),
+        out_specs=(dp(None), P()), check_vma=False)
 
 
 def _build_fused_group_count(B: int, Pc: int, n: int, spec):
@@ -311,6 +316,7 @@ def _build_fused_group_count(B: int, Pc: int, n: int, spec):
     K = len(gmeta)
     L = B // n
     mesh = cloud().mesh
+    q = n // cloud().n_slices
 
     def kern(payload, valid):
         keep = _keep_mask(payload, valid, stages)
@@ -322,15 +328,21 @@ def _build_fused_group_count(B: int, Pc: int, n: int, spec):
                         jnp.take(order, jnp.clip(bpos, 0, L - 1)),
                         axis=0)
         slot_ok = jnp.arange(L) < g
-        ck = lax.all_gather(jnp.where(slot_ok[:, None], reps, jnp.inf),
-                            DATA_AXIS).reshape(n * L, K)
-        cv = lax.all_gather(slot_ok, DATA_AXIS).reshape(n * L)
-        _i2, _o2, g2 = _factorize_block(ck, cv, n * L, K)
-        return g2
+        # slice-local rep gather + one DCN scalar psum: exact count on
+        # a flat mesh, upper bound on a two-level one (see the munge
+        # twin's docstring — the exact count is recovered from the
+        # combined counts table after the agg pass)
+        ck = hall_gather_inner(
+            jnp.where(slot_ok[:, None], reps, jnp.inf),
+            "groupby.count").reshape(q * L, K)
+        cv = hall_gather_inner(slot_ok, "groupby.count").reshape(q * L)
+        _i2, _o2, g2 = _factorize_block(ck, cv, q * L, K)
+        return hpsum_slices(g2, "groupby.count")
 
+    dp = cloud().data_pspec
     return shard_map_compat(
         kern, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
+        in_specs=(dp(None), dp()),
         out_specs=P(), check_vma=False)
 
 
@@ -346,6 +358,9 @@ def _build_fused_group_aggs(B: int, Pc: int, n: int, Gb: int, spec):
     A = len(ameta)
     L = B // n
     mesh = cloud().mesh
+    # two-level: statically truncate per-shard partials to min(L, Gb)
+    # before the hierarchical gather — see _build_shard_group_aggs
+    Lg = L if cloud().n_slices == 1 else min(L, Gb)
 
     def _partials(keys, valid, vals, size):
         inv, order, g = _factorize_block(keys, valid, size, K)
@@ -380,19 +395,22 @@ def _build_fused_group_aggs(B: int, Pc: int, n: int, Gb: int, spec):
         keys = _fused_factor_keys(payload, gmeta)
         vals = _fused_agg_vals(payload, ameta, L)
         reps, slot_ok, cnt, part = _partials(keys, keep, vals, L)
-        ck = lax.all_gather(jnp.where(slot_ok[:, None], reps, jnp.inf),
-                            DATA_AXIS).reshape(n * L, K)
-        cv = lax.all_gather(slot_ok, DATA_AXIS).reshape(n * L)
-        cc = lax.all_gather(jnp.where(slot_ok, cnt, 0.0),
-                            DATA_AXIS).reshape(n * L)
-        cp = lax.all_gather(jnp.where(slot_ok[:, None, None], part,
-                                      jnp.nan),
-                            DATA_AXIS).reshape(n * L, 5, A)
-        inv2, order2, _g2 = _factorize_block(ck, cv, n * L, K)
+        if Lg != L:                       # two-level: drop pure padding
+            reps, slot_ok = reps[:Lg], slot_ok[:Lg]
+            cnt, part = cnt[:Lg], part[:Lg]
+        ck = hall_gather(jnp.where(slot_ok[:, None], reps, jnp.inf),
+                         "groupby.partials").reshape(n * Lg, K)
+        cv = hall_gather(slot_ok, "groupby.partials").reshape(n * Lg)
+        cc = hall_gather(jnp.where(slot_ok, cnt, 0.0),
+                         "groupby.partials").reshape(n * Lg)
+        cp = hall_gather(jnp.where(slot_ok[:, None, None], part,
+                                   jnp.nan),
+                         "groupby.partials").reshape(n * Lg, 5, A)
+        inv2, order2, _g2 = _factorize_block(ck, cv, n * Lg, K)
         gs2 = jnp.take(inv2, order2)
         bpos2 = jnp.searchsorted(gs2, jnp.arange(Gb))
         keyvals = jnp.take(
-            ck, jnp.take(order2, jnp.clip(bpos2, 0, n * L - 1)),
+            ck, jnp.take(order2, jnp.clip(bpos2, 0, n * Lg - 1)),
             axis=0)[:Gb]
         counts = jax.ops.segment_sum(jnp.where(cv, cc, 0.0), inv2,
                                      num_segments=Gb)
@@ -415,9 +433,10 @@ def _build_fused_group_aggs(B: int, Pc: int, n: int, Gb: int, spec):
             jnp.zeros((Gb, 5, 0), jnp.float32)
         return keyvals, counts, out
 
+    dp = cloud().data_pspec
     return shard_map_compat(
         kern, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
+        in_specs=(dp(None), dp()),
         out_specs=(P(), P(), P()), check_vma=False)
 
 
@@ -495,6 +514,8 @@ def run_fused_groupby(fr: Frame, stages, gcols: Sequence[int],
             "fused_group_count", (B, fr.ncols, n, cspec),
             lambda: _build_fused_group_count(B, fr.ncols, n, cspec),
             payload, valid)
+        # flat mesh: exact group count; two-level: an upper bound big
+        # enough to size the table bucket (munge twin's docstring)
         G = int(g_dev)                                  # boundary sync
         Gb = _bucket_rows(max(_row_pad(G), 1))
         aspec = (tuple(stages), gmeta, ameta)
@@ -502,6 +523,10 @@ def run_fused_groupby(fr: Frame, stages, gcols: Sequence[int],
             "fused_group_aggs", (B, fr.ncols, n, Gb, aspec),
             lambda: _build_fused_group_aggs(B, fr.ncols, n, Gb, aspec),
             payload, valid)
+        if cloud().n_slices > 1:
+            # exact count recovered from the combined counts column:
+            # real groups are a dense prefix with counts >= 1
+            G = int(jnp.sum((counts > 0).astype(jnp.int32)))
         outs = []
         for a, (op, _c, _na) in enumerate(aggs):
             cnt_ok = parts[:, 0, a]

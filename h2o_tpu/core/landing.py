@@ -75,14 +75,19 @@ def reset_stats() -> None:
 
 
 def _row_sharding_for(arr_ndim: int) -> NamedSharding:
-    from h2o_tpu.core.cloud import DATA_AXIS, cloud
+    from h2o_tpu.core.cloud import cloud
     c = cloud()
-    return NamedSharding(c.mesh, P(DATA_AXIS, *([None] * (arr_ndim - 1))))
+    return NamedSharding(c.mesh, c.data_pspec(*([None] * (arr_ndim - 1))))
 
 
 def _place(arr: np.ndarray, sh: NamedSharding) -> jax.Array:
     """Shard-direct placement: one device_put PER SHARD, assembled into
-    the global array — no whole-array staging on any single transfer."""
+    the global array — no whole-array staging on any single transfer.
+
+    On a two-level mesh this is also what keeps ingest SLICE-LOCAL: the
+    sharding's device map sends each shard's rows straight to its home
+    device inside its own ICI island, so DCN never carries raw rows on
+    the way in — the host->device link is per-shard by construction."""
     imap = sh.addressable_devices_indices_map(arr.shape)
     shards = []
     for d, index in imap.items():

@@ -243,10 +243,24 @@ class MembershipMonitor:
         return losses
 
     def _target_shape(self, old_nodes: int, old_model: int,
-                      attempt: int) -> dict:
+                      attempt: int, old_slices: int = 1) -> dict:
         if self.survivor_policy is not None:
             return dict(self.survivor_policy(old_nodes, old_model,
                                              attempt))
+        if old_slices > 1:
+            # two-level mesh: DCN loss takes out a whole ICI island, so
+            # the survivor unit is a SLICE — drop one slice per attempt
+            # (keeping the per-slice node count q intact) until one
+            # slice remains, then fall back to halving within it
+            q = old_nodes // old_slices
+            new_s = old_slices - attempt
+            if new_s >= 1:
+                return {"nodes": q * new_s,
+                        "slices": new_s,
+                        "model_axis": old_model}
+            extra = attempt - old_slices + 1
+            return {"nodes": max(1, q >> extra), "slices": 1,
+                    "model_axis": old_model}
         # default: halve the data axis per attempt — the shape the
         # surviving half-slice can host — and keep the model axis
         return {"nodes": max(1, old_nodes >> attempt),
@@ -265,7 +279,9 @@ class MembershipMonitor:
         try:
             c = cloud()
             old_nodes, old_model = c.n_nodes, c.args.model_axis
-            ev["old_mesh"] = {"nodes": old_nodes, "model": old_model}
+            old_slices = c.n_slices
+            ev["old_mesh"] = {"nodes": old_nodes, "model": old_model,
+                              "slices": old_slices}
             victims = c.jobs.quiesce(
                 cause="slice loss — mesh reform",
                 wait_secs=self.quiesce_wait_secs)
@@ -282,7 +298,7 @@ class MembershipMonitor:
                 attempt += 1
                 ev["attempts"] = attempt
                 target = self._target_shape(old_nodes, old_model,
-                                            attempt)
+                                            attempt, old_slices)
                 try:
                     newc = Cloud.reform(**target)
                     if self.recovery_dir:
@@ -299,7 +315,8 @@ class MembershipMonitor:
                         continue
                     raise
             ev["new_mesh"] = {"nodes": newc.n_nodes,
-                              "model": newc.args.model_axis}
+                              "model": newc.args.model_axis,
+                              "slices": newc.n_slices}
             ev["jobs_resumed"] = len(resumed)
             # link each interrupted job to its replay by destination
             # key (the recovery snapshot's model id)

@@ -39,17 +39,20 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from h2o_tpu.core.cloud import DATA_AXIS, cloud, shard_map_compat
+from h2o_tpu.core.cloud import (cloud, hpmax, hpmin, hpsum,
+                                shard_map_compat)
 from h2o_tpu.core.diag import DispatchStats
 from h2o_tpu.core.exec_store import (aval_key, cached_kernel,  # noqa: F401
                                      code_fingerprint, exec_store,
                                      stable_fn_name)
 from h2o_tpu.core.frame import Frame
 
+# hierarchical reducers: plain flat-axis collectives on a one-slice
+# mesh, ICI-local + one DCN combine on a two-level one (core/cloud.py)
 REDUCERS = {
-    "sum": lambda x: jax.lax.psum(x, DATA_AXIS),
-    "min": lambda x: jax.lax.pmin(x, DATA_AXIS),
-    "max": lambda x: jax.lax.pmax(x, DATA_AXIS),
+    "sum": lambda x: hpsum(x, "mr.reduce"),
+    "min": lambda x: hpmin(x, "mr.reduce"),
+    "max": lambda x: hpmax(x, "mr.reduce"),
 }
 
 
@@ -85,7 +88,7 @@ def map_reduce(map_fn: Callable, *arrays: jax.Array, reduce: str = "sum",
            tuple(aval_key(e) for e in extra_args))
 
     def build():
-        in_specs = tuple(P(DATA_AXIS, *([None] * (a.ndim - 1)))
+        in_specs = tuple(c.data_pspec(*([None] * (a.ndim - 1)))
                          for a in arrays)
         in_specs += tuple(P() for _ in extra_args)
 

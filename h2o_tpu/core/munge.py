@@ -84,11 +84,12 @@ from typing import List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from h2o_tpu.core import landing
-from h2o_tpu.core.cloud import DATA_AXIS, cloud, shard_map_compat
+from h2o_tpu.core.cloud import (cloud, hall_gather, hall_gather_inner,
+                                hall_to_all, hpsum, hpsum_slices,
+                                hshard_index, shard_map_compat)
 from h2o_tpu.core.diag import DispatchStats
 from h2o_tpu.core.frame import (Frame, T_CAT, Vec, _row_pad,
                                 frame_device_ok)
@@ -249,12 +250,17 @@ def _lex_ge(ka, ga, kb, gb, K: int):
     return ge
 
 
-def _route(payload, slots, dest, n: int, L: int, cap: int):
+def _route(payload, slots, dest, n: int, L: int, cap: int,
+           tag: str = "route"):
     """One all_to_all bucket exchange: rows sorted stably by ``dest``
     (invalid rows carry dest >= n) are packed into an (n, cap) send
     buffer — slot [d] holds this shard's rows for shard d — exchanged,
     and returned flattened with per-row validity.  ``slots`` rides
-    along as an int32 side channel (target position / row id)."""
+    along as an int32 side channel (target position / row id).  On a
+    two-level mesh the exchange routes per-slice blocks across DCN
+    first (only off-slice buckets cross), then scatters within each
+    ICI island — rows are the one payload that MUST move in a sort, so
+    route bytes are reported separately from the O(table) combines."""
     o = jnp.argsort(dest, stable=True)
     ds = jnp.take(dest, o)
     starts = jnp.searchsorted(ds, jnp.arange(n)).astype(jnp.int32)
@@ -267,9 +273,9 @@ def _route(payload, slots, dest, n: int, L: int, cap: int):
     send_p = jnp.where(sendv[..., None],
                        jnp.take(payload, src, axis=0), jnp.nan)
     send_s = jnp.where(sendv, jnp.take(slots, src), jnp.int32(1 << 30))
-    recv_p = lax.all_to_all(send_p, DATA_AXIS, 0, 0)
-    recv_s = lax.all_to_all(send_s, DATA_AXIS, 0, 0)
-    recv_v = lax.all_to_all(sendv, DATA_AXIS, 0, 0)
+    recv_p = hall_to_all(send_p, tag=tag)
+    recv_s = hall_to_all(send_s, tag=tag)
+    recv_v = hall_to_all(sendv, tag=tag)
     m = n * cap
     return (recv_p.reshape(m, payload.shape[1]), recv_s.reshape(m),
             recv_v.reshape(m))
@@ -291,7 +297,7 @@ def _build_shard_sort(B: int, K: int, Pc: int, n: int, S: int):
     mesh = cloud().mesh
 
     def kern(keys, payload, valid):
-        i = lax.axis_index(DATA_AXIS)
+        i = hshard_index()
         gidx = i * L + jnp.arange(L, dtype=jnp.int32)
         inval = ~valid
         order = _local_lexsort(keys, gidx, inval, K)
@@ -303,9 +309,9 @@ def _build_shard_sort(B: int, K: int, Pc: int, n: int, S: int):
         samp_k = jnp.take(ks, jnp.clip(pos, 0, L - 1), axis=0)
         samp_g = jnp.take(gs, jnp.clip(pos, 0, L - 1))
         samp_ok = (cnt > 0) & (pos < cnt)
-        all_k = lax.all_gather(samp_k, DATA_AXIS).reshape(n * S, K)
-        all_g = lax.all_gather(samp_g, DATA_AXIS).reshape(n * S)
-        all_ok = lax.all_gather(samp_ok, DATA_AXIS).reshape(n * S)
+        all_k = hall_gather(samp_k, "sort.splitters").reshape(n * S, K)
+        all_g = hall_gather(samp_g, "sort.splitters").reshape(n * S)
+        all_ok = hall_gather(samp_ok, "sort.splitters").reshape(n * S)
         sorder = _local_lexsort(all_k, all_g, ~all_ok, K)
         sk = jnp.take(all_k, sorder, axis=0)
         sg = jnp.take(all_g, sorder)
@@ -321,26 +327,28 @@ def _build_shard_sort(B: int, K: int, Pc: int, n: int, S: int):
                        axis=1)
         dmask = jnp.where(valid, dest, n)
         kp = jnp.concatenate([keys, payload], axis=1)
-        rkp, rg, rv = _route(kp, gidx, dmask, n, L, L)
+        rkp, rg, rv = _route(kp, gidx, dmask, n, L, L, tag="sort.route")
         rk = rkp[:, :K]
         m_order = _local_lexsort(rk, rg, ~rv, K)
         rp = jnp.take(rkp[:, K:], m_order, axis=0)
         c = jnp.sum(rv.astype(jnp.int32))
-        all_c = lax.all_gather(c, DATA_AXIS)
+        all_c = hall_gather(c, "sort.counts")
         base = jnp.sum(jnp.where(jnp.arange(n) < i, all_c, 0))
         # balanced re-exchange: row j of the merged run lands at global
         # position base + j -> shard (pos // L), slot (pos % L)
         gpos = base + jnp.arange(n * L, dtype=jnp.int32)
         v2 = jnp.arange(n * L) < c
         dest2 = jnp.where(v2, jnp.clip(gpos // L, 0, n - 1), n)
-        rp2, rs2, rv2 = _route(rp, gpos % L, dest2, n, n * L, L)
+        rp2, rs2, rv2 = _route(rp, gpos % L, dest2, n, n * L, L,
+                               tag="sort.route")
         out = jnp.full((L + 1, Pc), jnp.nan, payload.dtype)
         out = out.at[jnp.where(rv2, rs2, L)].set(rp2)
         return out[:L]
 
-    in_specs = (P(DATA_AXIS, None), P(DATA_AXIS, None), P(DATA_AXIS))
+    dp = cloud().data_pspec
+    in_specs = (dp(None), dp(None), dp())
     return shard_map_compat(kern, mesh=mesh, in_specs=in_specs,
-                            out_specs=P(DATA_AXIS, None),
+                            out_specs=dp(None),
                             check_vma=False)
 
 
@@ -358,12 +366,13 @@ def _build_shard_filter(B: int, Pc: int, n: int):
         c = jnp.sum(keep.astype(jnp.int32))
         out = jnp.take(payload, order, axis=0)
         out = jnp.where((jnp.arange(L) < c)[:, None], out, jnp.nan)
-        return out, lax.all_gather(c, DATA_AXIS)
+        return out, hall_gather(c, "filter.counts")
 
+    dp = cloud().data_pspec
     return shard_map_compat(
         kern, mesh=mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS, None)),
-        out_specs=(P(DATA_AXIS, None), P()), check_vma=False)
+        in_specs=(dp(), dp(), dp(None)),
+        out_specs=(dp(None), P()), check_vma=False)
 
 
 def _build_shard_repack(B: int, Pc: int, n: int):
@@ -374,28 +383,38 @@ def _build_shard_repack(B: int, Pc: int, n: int):
     mesh = cloud().mesh
 
     def kern(payload, counts):
-        i = lax.axis_index(DATA_AXIS)
+        i = hshard_index()
         c = jnp.take(counts, i)
         base = jnp.sum(jnp.where(jnp.arange(n) < i, counts, 0))
         gpos = base + jnp.arange(L, dtype=jnp.int32)
         v = jnp.arange(L) < c
         dest = jnp.where(v, jnp.clip(gpos // L, 0, n - 1), n)
-        rp, rs, rv = _route(payload, gpos % L, dest, n, L, L)
+        rp, rs, rv = _route(payload, gpos % L, dest, n, L, L,
+                            tag="repack.route")
         out = jnp.full((L + 1, Pc), jnp.nan, payload.dtype)
         out = out.at[jnp.where(rv, rs, L)].set(rp)
         return out[:L]
 
+    dp = cloud().data_pspec
     return shard_map_compat(
-        kern, mesh=mesh, in_specs=(P(DATA_AXIS, None), P()),
-        out_specs=P(DATA_AXIS, None), check_vma=False)
+        kern, mesh=mesh, in_specs=(dp(None), P()),
+        out_specs=dp(None), check_vma=False)
 
 
 def _build_shard_group_count(B: int, K: int, n: int):
-    """Distinct-key count: local factorize, gather the (small) local
-    group-rep tables, factorize the candidates — returns the global
-    group count (the one scalar the host syncs to size the agg pass)."""
+    """Distinct-key count.  Flat mesh: local factorize, gather the
+    (small) local group-rep tables, factorize the candidates — the
+    EXACT global group count (the one scalar the host syncs to size the
+    agg pass).  Two-level mesh: the rep gather stays SLICE-LOCAL and
+    one scalar psum of the per-slice distinct counts crosses DCN — an
+    UPPER BOUND on the global count (groups spanning slices count once
+    per slice), which is all the agg pass needs for its table bucket;
+    the exact count falls out of the combined counts table afterwards.
+    This is what keeps the group-by's cross-slice bytes O(1) instead of
+    O(local table)."""
     L = B // n
     mesh = cloud().mesh
+    q = n // cloud().n_slices
 
     def kern(keys, valid):
         inv, order, g = _factorize_block(keys, valid, L, K)
@@ -404,14 +423,16 @@ def _build_shard_group_count(B: int, K: int, n: int):
         reps = jnp.take(keys,
                         jnp.take(order, jnp.clip(bpos, 0, L - 1)), axis=0)
         slot_ok = jnp.arange(L) < g
-        ck = lax.all_gather(jnp.where(slot_ok[:, None], reps, jnp.inf),
-                            DATA_AXIS).reshape(n * L, K)
-        cv = lax.all_gather(slot_ok, DATA_AXIS).reshape(n * L)
-        _i2, _o2, g2 = _factorize_block(ck, cv, n * L, K)
-        return g2
+        ck = hall_gather_inner(
+            jnp.where(slot_ok[:, None], reps, jnp.inf),
+            "groupby.count").reshape(q * L, K)
+        cv = hall_gather_inner(slot_ok, "groupby.count").reshape(q * L)
+        _i2, _o2, g2 = _factorize_block(ck, cv, q * L, K)
+        return hpsum_slices(g2, "groupby.count")
 
+    dp = cloud().data_pspec
     return shard_map_compat(kern, mesh=mesh,
-                            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
+                            in_specs=(dp(None), dp()),
                             out_specs=P(), check_vma=False)
 
 
@@ -419,9 +440,20 @@ def _build_shard_group_aggs(B: int, K: int, A: int, n: int, Gb: int):
     """Local factorize + fused per-shard partials (cnt_ok/sum/sumsq/min/
     max per agg column), then a cross-shard combine over the per-group
     partial tables.  Only the (Gb,*) group table replicates — rows never
-    leave their shard."""
+    leave their shard.
+
+    Two-level mesh: each shard's partial table is statically truncated
+    to ``min(L, Gb)`` rows before the gather — valid local groups are a
+    prefix and number at most min(L, G) <= min(L, Gb), so truncation
+    drops only padding.  The gather itself is hierarchical (ICI-local,
+    one per-slice block across DCN), which makes the group-by combine's
+    cross-slice bytes O(Gb) — row-count independent — while the final
+    segment combine still sees every shard's partials in flat order,
+    so results stay bitwise-equal to the flat mesh (dropped padding
+    contributes exact +0.0 / +-inf identity elements)."""
     L = B // n
     mesh = cloud().mesh
+    Lg = L if cloud().n_slices == 1 else min(L, Gb)
 
     def _partials(keys, valid, vals, size):
         inv, order, g = _factorize_block(keys, valid, size, K)
@@ -453,19 +485,22 @@ def _build_shard_group_aggs(B: int, K: int, A: int, n: int, Gb: int):
 
     def kern(keys, valid, vals):
         reps, slot_ok, cnt, part = _partials(keys, valid, vals, L)
-        ck = lax.all_gather(jnp.where(slot_ok[:, None], reps, jnp.inf),
-                            DATA_AXIS).reshape(n * L, K)
-        cv = lax.all_gather(slot_ok, DATA_AXIS).reshape(n * L)
-        cc = lax.all_gather(jnp.where(slot_ok, cnt, 0.0),
-                            DATA_AXIS).reshape(n * L)
-        cp = lax.all_gather(jnp.where(slot_ok[:, None, None], part,
-                                      jnp.nan),
-                            DATA_AXIS).reshape(n * L, 5, A)
-        inv2, order2, _g2 = _factorize_block(ck, cv, n * L, K)
+        if Lg != L:                       # two-level: drop pure padding
+            reps, slot_ok = reps[:Lg], slot_ok[:Lg]
+            cnt, part = cnt[:Lg], part[:Lg]
+        ck = hall_gather(jnp.where(slot_ok[:, None], reps, jnp.inf),
+                         "groupby.partials").reshape(n * Lg, K)
+        cv = hall_gather(slot_ok, "groupby.partials").reshape(n * Lg)
+        cc = hall_gather(jnp.where(slot_ok, cnt, 0.0),
+                         "groupby.partials").reshape(n * Lg)
+        cp = hall_gather(jnp.where(slot_ok[:, None, None], part,
+                                   jnp.nan),
+                         "groupby.partials").reshape(n * Lg, 5, A)
+        inv2, order2, _g2 = _factorize_block(ck, cv, n * Lg, K)
         gs2 = jnp.take(inv2, order2)
         bpos2 = jnp.searchsorted(gs2, jnp.arange(Gb))
         keyvals = jnp.take(
-            ck, jnp.take(order2, jnp.clip(bpos2, 0, n * L - 1)),
+            ck, jnp.take(order2, jnp.clip(bpos2, 0, n * Lg - 1)),
             axis=0)[:Gb]
         counts = jax.ops.segment_sum(jnp.where(cv, cc, 0.0), inv2,
                                      num_segments=Gb)
@@ -488,9 +523,10 @@ def _build_shard_group_aggs(B: int, K: int, A: int, n: int, Gb: int):
             jnp.zeros((Gb, 5, 0), jnp.float32)
         return keyvals, counts, out
 
+    dp = cloud().data_pspec
     return shard_map_compat(
         kern, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS, None)),
+        in_specs=(dp(None), dp(), dp(None)),
         out_specs=(P(), P(), P()), check_vma=False)
 
 
@@ -523,8 +559,8 @@ def _build_shard_merge_match(BL: int, BR: int, K: int, n: int,
         l_sorted = jnp.sort(lc)
         plo = jnp.searchsorted(l_sorted, rc, side="left")
         phi = jnp.searchsorted(l_sorted, rc, side="right")
-        matched = lax.psum((rvalid & (phi > plo)).astype(jnp.int32),
-                           DATA_AXIS) > 0
+        matched = hpsum((rvalid & (phi > plo)).astype(jnp.int32),
+                        "merge.match") > 0
         unmatched = rvalid & ~matched
         u_cnt = jnp.sum(unmatched.astype(jnp.int32)) if all_y else \
             jnp.int32(0)
@@ -533,13 +569,14 @@ def _build_shard_merge_match(BL: int, BR: int, K: int, n: int,
                                      BIG), stable=True)
         return (counts.astype(jnp.int32), offsets.astype(jnp.int32),
                 lo.astype(jnp.int32), r_order.astype(jnp.int32),
-                uord.astype(jnp.int32), lax.all_gather(p, DATA_AXIS),
+                uord.astype(jnp.int32), hall_gather(p, "merge.counts"),
                 u_cnt)
 
+    dp = cloud().data_pspec
     return shard_map_compat(
         kern, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(), P()),
-        out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P(),
+        in_specs=(dp(None), dp(), P(), P()),
+        out_specs=(dp(), dp(), dp(), P(), P(),
                    P(), P()),
         check_vma=False)
 
@@ -556,7 +593,7 @@ def _build_shard_merge_emit(BL: int, BR: int, PL: int, PR: int, n: int,
 
     def kern(counts, offsets, lo, r_order, uord, all_p, u_cnt,
              lpay, rpay):
-        i = lax.axis_index(DATA_AXIS)
+        i = hshard_index()
         p = jnp.take(all_p, i)
         j = jnp.arange(NBl)
         row = jnp.searchsorted(offsets, j, side="right")
@@ -579,14 +616,15 @@ def _build_shard_merge_emit(BL: int, BR: int, PL: int, PR: int, n: int,
         rg = jnp.take(rpay, jnp.clip(ri, 0, BR - 1), axis=0)
         rcols = jnp.where((ri >= 0)[:, None], rg, jnp.nan)
         cnt_out = p + jnp.where(is_last, u_cnt, 0)
-        return li, ri, lcols, rcols, lax.all_gather(cnt_out, DATA_AXIS)
+        return li, ri, lcols, rcols, hall_gather(cnt_out, "merge.counts")
 
+    dp = cloud().data_pspec
     return shard_map_compat(
         kern, mesh=mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P(),
-                  P(), P(), P(DATA_AXIS, None), P()),
-        out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS, None),
-                   P(DATA_AXIS, None), P()),
+        in_specs=(dp(), dp(), dp(), P(), P(),
+                  P(), P(), dp(None), P()),
+        out_specs=(dp(), dp(), dp(None),
+                   dp(None), P()),
         check_vma=False)
 
 
@@ -969,7 +1007,11 @@ def _shard_groupby(fr: Frame, gcols: Sequence[int],
             "shard_group_count", (B, K, n),
             lambda: _build_shard_group_count(B, K, n),
             keys, valid, site="munge.groupby")
-        G = int(g_dev)                           # the one host sync
+        # flat mesh: the exact group count (the one host sync).
+        # two-level: an upper bound (per-slice distinct counts summed
+        # over DCN) — big enough to size the table bucket; the exact
+        # count is recovered below from the combined counts column.
+        G = int(g_dev)
         Gb = _bucket_rows(max(_row_pad(G), 1))
         acols = [fr.vecs[c].as_float() for _a, c, _na in aggs]
         A = len(acols)
@@ -979,6 +1021,11 @@ def _shard_groupby(fr: Frame, gcols: Sequence[int],
             "shard_group_aggs", (B, K, A, n, Gb),
             lambda: _build_shard_group_aggs(B, K, A, n, Gb),
             keys, valid, vals, site="munge.groupby")
+        if cloud().n_slices > 1:
+            # real groups occupy a dense prefix of the combined table
+            # with per-group row counts >= 1 (exact small integers in
+            # f32); everything past them is zero-count padding
+            G = int(jnp.sum((counts > 0).astype(jnp.int32)))
         outs = []
         for a, (op, _c, _na) in enumerate(aggs):
             cnt_ok = parts[:, 0, a]

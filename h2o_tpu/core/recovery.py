@@ -161,13 +161,20 @@ class Recovery:
 
 def _mesh_info() -> Optional[Dict]:
     """Shape of the mesh the snapshot was written under — discovery uses
-    it to refuse snapshots from a BIGGER cloud than this process can
-    host (a shared recovery dir between differently-sized pods)."""
+    it to refuse snapshots this process cannot host (a shared recovery
+    dir between differently-shaped pods).  ``data_shards`` and
+    ``row_quantum`` are what resume compatibility actually hinges on:
+    checkpoints re-pad across mesh SHAPES, so a 2x2x2 two-slice stamp
+    resumes fine on a flat 1x4 — same four row shards, same row quantum
+    — and only a genuinely different shard geometry refuses."""
     from h2o_tpu.core.cloud import Cloud
     c = Cloud._instance
     if c is None:
         return None
     return {"nodes": c.n_nodes, "model": c.args.model_axis,
+            "slices": c.n_slices,
+            "data_shards": c.n_nodes,
+            "row_quantum": c.row_multiple(),
             "devices": c.n_nodes * c.args.model_axis}
 
 
@@ -206,19 +213,35 @@ def pending_recoveries(recovery_dir: str) -> List[Dict]:
             log.warning("skipping malformed recovery snapshot %s", info_p)
             continue
         mesh = info.get("mesh")
-        if isinstance(mesh, dict) and mesh.get("devices"):
+        if isinstance(mesh, dict) and (mesh.get("data_shards")
+                                       or mesh.get("devices")):
             import jax
             avail = jax.device_count()
-            if int(mesh["devices"]) > avail:
-                # checkpoints re-pad across mesh SHAPES (PR 8), but a
-                # snapshot stamped by a cloud with more devices than
-                # this process can see came from a different/bigger pod
-                # sharing the recovery dir — resuming it here would
-                # silently claim another cloud's work
+            # checkpoints re-pad across mesh SHAPES (PR 8), so the gate
+            # is the DATA geometry, not the axis names: a snapshot from
+            # a two-level 2x2x2 mesh (8 devices, 4 row shards) resumes
+            # on a flat 1x4 process — same shard quanta — while one
+            # needing more row shards than this process has devices
+            # came from a bigger pod sharing the recovery dir, and
+            # resuming it here would silently claim that cloud's work.
+            # Old stamps without data_shards fall back to devices.
+            shards = int(mesh.get("data_shards", mesh.get("devices", 0)))
+            if shards > avail:
                 log.warning(
-                    "skipping recovery snapshot %s: written by a "
-                    "%d-device mesh but only %d devices are available",
-                    info_p, int(mesh["devices"]), avail)
+                    "skipping recovery snapshot %s: written with %d row "
+                    "shards but only %d devices are available",
+                    info_p, shards, avail)
+                continue
+            from h2o_tpu.core.cloud import Cloud
+            c = Cloud._instance
+            if (c is not None and mesh.get("row_quantum")
+                    and int(mesh["row_quantum"]) % c.args.row_align):
+                # shard quanta genuinely differ: the snapshot's padded
+                # rows cannot re-pad onto this mesh's row alignment
+                log.warning(
+                    "skipping recovery snapshot %s: row quantum %d is "
+                    "incompatible with the local row alignment %d",
+                    info_p, int(mesh["row_quantum"]), c.args.row_align)
                 continue
         if not info.get("done"):
             info["dir"] = os.path.join(recovery_dir, d)

@@ -25,6 +25,11 @@ one HLO-text scan AT COMPILE TIME, nothing per dispatch):
 - **GL703** sharding blowup: a kernel with ``nodes``-sharded inputs
   produced a fully-REPLICATED output at least as large as the sharded
   input's global size — the all-gather-the-frame miscompile class.
+  On a two-level ``slices x nodes`` mesh the same rule also fires on a
+  PER-SLICE replica: an output partitioned over the inner ``nodes``
+  axis but NOT over ``slices`` holds a full copy of the row data in
+  every slice — the cross-DCN variant of the same blowup (each slice's
+  copy crossed the slow interconnect to get there).
 - **GL704** recompile churn: one store site compiled more than
   ``H2O_TPU_AUDIT_CHURN`` (default 8) distinct argument-aval keys this
   session — a bucketing regression caught as a lint finding instead of
@@ -116,6 +121,33 @@ def note_compile(site: str, aval_digest: str) -> None:
         rec["overflow"] += 1
 
 
+# axis-name literals mirrored from core/cloud.py (DATA_AXIS/SLICE_AXIS);
+# the lint tier records and matches names, it never builds a mesh
+_DATA_AXIS = "nodes"
+_SLICE_AXIS = "slices"
+
+
+def _axes_info(sh):
+    """(spec_axes, mesh_axes) for a NamedSharding: the flattened mesh
+    axis names its PartitionSpec uses, and the full mesh's axis->size
+    map.  (None, {}) for GSPMD/opaque shardings — the slices branch of
+    GL703 then stays silent rather than guessing."""
+    try:
+        names = []
+        for part in tuple(sh.spec):
+            if part is None:
+                continue
+            if isinstance(part, (tuple, list)):
+                names.extend(part)
+            else:
+                names.append(part)
+        mesh_axes = {str(k): int(v) for k, v in
+                     zip(sh.mesh.axis_names, sh.mesh.devices.shape)}
+        return [str(n) for n in names], mesh_axes
+    except Exception:  # noqa: BLE001 — non-named shardings
+        return None, {}
+
+
 def _arr_info(x) -> Optional[dict]:
     import jax
     import numpy as np
@@ -125,11 +157,14 @@ def _arr_info(x) -> Optional[dict]:
         sh = x.sharding
         replicated = bool(sh.is_fully_replicated)
     except Exception:  # noqa: BLE001 — deleted/donated arrays
+        sh = None
         replicated = True
+    spec_axes, mesh_axes = _axes_info(sh) if sh is not None else (None, {})
     nbytes = int(np.prod(x.shape)) * x.dtype.itemsize if x.shape else \
         x.dtype.itemsize
     return {"shape": tuple(x.shape), "dtype": str(x.dtype),
-            "sharded": not replicated, "global_nbytes": nbytes}
+            "sharded": not replicated, "global_nbytes": nbytes,
+            "spec_axes": spec_axes, "mesh_axes": mesh_axes}
 
 
 def _out_info(lowered, compiled) -> List[dict]:
@@ -155,10 +190,13 @@ def _out_info(lowered, compiled) -> List[dict]:
                 else True
         except Exception:  # noqa: BLE001
             replicated = True
+        spec_axes, mesh_axes = _axes_info(sh) if sh is not None \
+            else (None, {})
         infos.append({"shape": shape, "dtype": str(dtype),
                       "replicated": replicated,
                       "nbytes": int(np.prod(shape)) * itemsize
-                      if shape else itemsize})
+                      if shape else itemsize,
+                      "spec_axes": spec_axes, "mesh_axes": mesh_axes})
     return infos
 
 
@@ -246,6 +284,25 @@ def ir_findings(evs: Optional[List[dict]] = None,
                          f"the frame instead of keeping it shard-"
                          f"resident",
                          detail=f"replicated-blowup:{site}")
+                    break
+            for o in ev["outputs"]:
+                axes = o.get("spec_axes")
+                maxes = o.get("mesh_axes") or {}
+                if axes is None or maxes.get(_SLICE_AXIS, 1) <= 1:
+                    continue
+                if _DATA_AXIS in axes and _SLICE_AXIS not in axes and \
+                        o["nbytes"] >= biggest > 0:
+                    emit("GL703", site,
+                         f"shard kernel at {site} produced an output of "
+                         f"{o['nbytes']} bytes partitioned over "
+                         f"'{_DATA_AXIS}' but NOT over '{_SLICE_AXIS}' "
+                         f"on a two-level mesh — every slice holds a "
+                         f"full copy of row data >= its sharded input's "
+                         f"global size ({biggest} bytes), and each "
+                         f"copy crossed the DCN to get there; shard "
+                         f"row outputs over ('{_SLICE_AXIS}', "
+                         f"'{_DATA_AXIS}') (Cloud.data_pspec)",
+                         detail=f"slices-replicated:{site}")
                     break
     thresh = churn_threshold()
     for site, rec in counts.items():

@@ -27,6 +27,14 @@ home-sharded data plane:
   WHOLE array on one host and forfeits pull accounting, tier telemetry
   and the big-frame ingest path.  Use ``landing.land_rows`` (host data)
   or ``landing.reshard_rows`` (device data).
+- **GL305** no flat-axis collectives on the data axis outside
+  core/cloud.py: a bare ``lax.psum(x, DATA_AXIS)`` (or all_gather /
+  all_to_all / pmin / pmax / pmean / axis_index) compiles and runs on a
+  two-level ``slices x nodes`` mesh but only reduces WITHIN the local
+  slice — silently wrong results the flat-mesh CI never sees.  Use the
+  hierarchical helpers (``hpsum`` / ``hall_gather`` / ``hall_to_all`` /
+  ``hshard_index`` …), which lower to the identical flat collective on
+  a one-slice mesh and add the one DCN combine on a two-level one.
 - **GL310** fused-region purity (the lazy Rapids planner's contract,
   rapids/plan.py + core/fuse.py): a planner-emitted region body (any
   ``_build_fused*`` builder) must stay fully traced — no eager
@@ -171,6 +179,59 @@ def check_axes(mi: ModuleInfo, ctx):
                 f"dispatch time on a real multi-device mesh; use the "
                 f"core/cloud.py *_AXIS constants",
                 detail=f"axis:{name}:{b}"))
+    return out
+
+
+# the one module allowed to touch the data axis with raw lax
+# collectives: the hierarchical helper layer itself
+_FLAT_AXIS_EXEMPT = {"core/cloud.py"}
+
+# collectives with an h-helper twin; a raw call on the data axis is
+# slice-local on a two-level mesh (wrong results, not an error)
+_FLAT_AXIS_COLLECTIVES = {"psum", "pmean", "pmin", "pmax", "all_gather",
+                          "all_to_all", "axis_index"}
+
+_HELPER_FOR = {"psum": "hpsum", "pmin": "hpmin", "pmax": "hpmax",
+               "pmean": "hpsum", "all_gather": "hall_gather",
+               "all_to_all": "hall_to_all", "axis_index": "hshard_index"}
+
+
+def _references_data_axis(axis) -> bool:
+    """Does a collective's axis expression name the data axis?  Matches
+    the DATA_AXIS constant (Name or Attribute), the literal "nodes"
+    string, and tuples/lists containing either."""
+    if axis is None:
+        return False
+    for n in ast.walk(axis):
+        if isinstance(n, ast.Name) and n.id == "DATA_AXIS":
+            return True
+        if isinstance(n, ast.Attribute) and n.attr == "DATA_AXIS":
+            return True
+        if isinstance(n, ast.Constant) and n.value == "nodes":
+            return True
+    return False
+
+
+@rule("GL305", "flat-axis-collective")
+def check_flat_axis_collective(mi: ModuleInfo, ctx):
+    """Raw lax collective over DATA_AXIS outside the helper layer."""
+    if mi.rel in _FLAT_AXIS_EXEMPT:
+        return []
+    out: List[Finding] = []
+    for node, name, axis in classify.collective_calls(mi):
+        if name not in _FLAT_AXIS_COLLECTIVES:
+            continue
+        if not _references_data_axis(axis):
+            continue
+        helper = _HELPER_FOR.get(name, "the h-helpers")
+        out.append(Finding(
+            "GL305", "error", mi.rel, node.lineno, mi.scope_of(node),
+            f"lax.{name} over the flat data axis — on a two-level "
+            f"slices x nodes mesh this stays SLICE-LOCAL and silently "
+            f"computes wrong results; use core/cloud.py {helper}() "
+            f"(identical program on a flat mesh, hierarchical with one "
+            f"DCN combine on a two-level one)",
+            detail=f"flat-axis:{name}"))
     return out
 
 

@@ -39,7 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from h2o_tpu.core.cloud import DATA_AXIS, cloud, shard_map_compat
+from h2o_tpu.core.cloud import cloud, shard_map_compat
 from h2o_tpu.core.frame import Frame
 from h2o_tpu.models.model import DataInfo, Model, ModelBuilder
 from h2o_tpu.ops.binpack import (bins_bucket, bins_pack_enabled, cast_bins,
@@ -276,10 +276,11 @@ def _build_window_scatter():
         return jax.lax.dynamic_update_slice_in_dim(buf, blk, start,
                                                    axis=0)
 
+    dp = cloud().data_pspec
     return shard_map_compat(
         body, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P()),
-        out_specs=P(DATA_AXIS, None), check_vma=False)
+        in_specs=(dp(None), dp(None), P()),
+        out_specs=dp(None), check_vma=False)
 
 
 def _scatter_window(buf, blk, w0: int):

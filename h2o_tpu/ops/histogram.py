@@ -19,8 +19,10 @@ accumulated over row blocks with ``lax.scan`` to bound the one-hot footprint.
 Stats are (w, w*g, w*g^2, w*h): enough for variance-reduction split scoring
 AND Newton leaf values — the reference needs a second MRTask (GammaPass,
 gbm/GBM.java:464-528) for leaf values; here both come from one kernel.  The
-cross-node reduce is an ICI ``psum`` of the fixed-shape (L, C, B+1, S)
-tensor, replacing the reference's software binomial tree (MRTask.java:94-117).
+cross-node reduce is a ``hpsum`` of the fixed-shape (L, C, B+1, S)
+tensor — ICI on a flat mesh, one DCN combine per step on a two-level
+mesh — replacing the reference's software binomial tree
+(MRTask.java:94-117); the DCN cost is O(table), never O(rows).
 
 The NA bucket is bin index B (DHistogram INT_NA analog), so split finding can
 try NA-left vs NA-right.  The sibling-subtraction optimization (histogram the
@@ -40,7 +42,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from h2o_tpu.core.cloud import DATA_AXIS, cloud, shard_map_compat
+from h2o_tpu.core.cloud import cloud, hpsum, shard_map_compat
 from h2o_tpu.ops.binpack import widen_bins
 
 # stats slots
@@ -200,9 +202,10 @@ def histogram_build_traced(bins, leaf, stats, n_leaves: int, nbins: int,
     use_pallas = _pallas_eligible(C, B1, n_leaves, S, fine_map,
                                   allowed=pallas)
 
+    dp = cloud().data_pspec
     @functools.partial(shard_map_compat, mesh=mesh,
-                       in_specs=(P(DATA_AXIS, None), P(DATA_AXIS),
-                                 P(DATA_AXIS, None)) + extra_specs,
+                       in_specs=(dp(None), dp(),
+                                 dp(None)) + extra_specs,
                        out_specs=P(), check_vma=False)
     def run(b_sh, l_sh, s_sh, *rep):
         if use_pallas:
@@ -215,7 +218,7 @@ def histogram_build_traced(bins, leaf, stats, n_leaves: int, nbins: int,
                 acc = hist_pallas_adaptive(
                     b_sh, l_sh, s_sh, rep[0], rep[1], rep[2],
                     rep[3], n_leaves, nbins, fine_na, bf16=bf16)
-            return jax.lax.psum(acc, DATA_AXIS)
+            return hpsum(acc, "hist.table")
         R = b_sh.shape[0]
         blk = min(block_rows, R)
         nblk = R // blk
@@ -243,7 +246,7 @@ def histogram_build_traced(bins, leaf, stats, n_leaves: int, nbins: int,
             acc = acc + _block_hist(
                 bucketize(b_sh[nblk * blk:], l_sh[nblk * blk:]),
                 l_sh[nblk * blk:], s_sh[nblk * blk:], n_leaves, nbins, mmd)
-        return jax.lax.psum(acc, DATA_AXIS)
+        return hpsum(acc, "hist.table")
 
     h = run(bins, leaf, stats, *extra)              # (C*B1, L*S)
     return (h.reshape(C, B1, n_leaves, S)

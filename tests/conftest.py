@@ -125,6 +125,20 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
                 ps["host_syncs_elided"], ps["fallbacks_unfused"],
                 ps["planner_errors"], ps["lever_fused"],
                 ps["lever_per_verb"]))
+        coll = snap.get("collectives", {})
+        ici = sum(d["ici_bytes"] for ph in coll.values()
+                  for d in ph.values())
+        dcn = sum(d["dcn_bytes"] for ph in coll.values()
+                  for d in ph.values())
+        per_phase = " ".join(
+            "{}={}/{}".format(
+                p,
+                sum(d["ici_bytes"] for d in coll[p].values()),
+                sum(d["dcn_bytes"] for d in coll[p].values()))
+            for p in ("munge", "rapids.fuse", "tree") if p in coll)
+        terminalreporter.write_line(
+            "[collectives] ici_bytes={} dcn_bytes={}{}".format(
+                ici, dcn, (" | " + per_phase) if per_phase else ""))
         from h2o_tpu.lint import last_summary
         ls = last_summary()
         if ls is not None:

@@ -158,6 +158,34 @@ def test_gl703_small_replicated_scalar_ok(ir_audit):
     assert not [f for f in audit.ir_findings() if f.rule == "GL703"]
 
 
+def test_gl703_slices_replicated_output(ir_audit):
+    """Two-level-mesh variant: an output partitioned over the inner
+    ``nodes`` axis but NOT over ``slices`` replicates the row data once
+    per slice — each copy crossed the DCN.  The negative twin shards
+    over the product axis (Cloud.data_pspec's two-level spec) and must
+    stay clean."""
+    st = ExecStore()
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4),
+                ("slices", "nodes"))
+    xs = jax.device_put(jnp.arange(4096.0),
+                        NamedSharding(mesh, P(("slices", "nodes"))))
+    # planted: drops the slices axis → full per-slice replica
+    _compile(st, "munge", "gl703s_bad",
+             lambda: jax.jit(lambda a: a + 1.0,
+                             out_shardings=NamedSharding(mesh,
+                                                         P("nodes"))), xs)
+    # negative twin: keeps the two-level row sharding
+    _compile(st, "munge", "gl703s_ok",
+             lambda: jax.jit(
+                 lambda a: a + 1.0,
+                 out_shardings=NamedSharding(
+                     mesh, P(("slices", "nodes")))), xs)
+    found = [f for f in audit.ir_findings() if f.rule == "GL703"]
+    assert len(found) == 1, [f.render() for f in found]
+    assert found[0].detail == "slices-replicated:munge:gl703s_bad"
+    assert "slices" in found[0].message
+
+
 # -- GL704: recompile churn --------------------------------------------------
 
 def test_gl704_recompile_churn(ir_audit, monkeypatch):

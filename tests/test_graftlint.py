@@ -197,6 +197,27 @@ CASES = [
         def place(arr):
             return landing.reshard_rows(arr)
      """, {}),
+    # GL305: raw lax collective on the flat data axis outside the
+    # core/cloud.py helper layer — slice-local (silently wrong) on a
+    # two-level mesh; use the hierarchical h-helpers
+    ("GL305", "core/fx.py", """
+        from jax import lax
+        from h2o_tpu.core.cloud import DATA_AXIS
+
+        def total(x):
+            return lax.psum(x, DATA_AXIS)
+
+        def gathered(x):
+            return lax.all_gather(x, "nodes")
+     """, """
+        from h2o_tpu.core.cloud import hall_gather, hpsum
+
+        def total(x):
+            return hpsum(x, "fx.total")
+
+        def gathered(x):
+            return hall_gather(x, "fx.gather")
+     """, {}),
     # GL310: planner-emitted fused region bodies must stay traced (no
     # eager repack / host gather / count sync) and fused-region
     # dispatches must run under the rapids.fuse phase

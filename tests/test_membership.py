@@ -169,8 +169,8 @@ def test_slice_loss_mid_forest_reforms_and_resumes_bitwise(
         assert mon.wait_stable(60)
         ev = mon.events()[-1]
         assert ev["ok"], ev
-        assert ev["old_mesh"] == {"nodes": 4, "model": 2}
-        assert ev["new_mesh"] == {"nodes": 2, "model": 2}
+        assert ev["old_mesh"] == {"nodes": 4, "model": 2, "slices": 1}
+        assert ev["new_mesh"] == {"nodes": 2, "model": 2, "slices": 1}
         assert len(ev["jobs_interrupted"]) == 1
         assert ev["jobs_resumed"] == 1
         assert ev["causes"], "loss report never reached the event"
@@ -301,7 +301,7 @@ def test_reentrant_loss_during_reform_shrinks_further(
     assert ev["attempts"] == 2
     assert len(ev["reentrant_losses"]) == 1
     # attempt 1 targeted 4>>1=2 nodes and died; attempt 2 landed 4>>2=1
-    assert ev["new_mesh"] == {"nodes": 1, "model": 1}
+    assert ev["new_mesh"] == {"nodes": 1, "model": 1, "slices": 1}
     assert not mon.reforming
 
 
@@ -320,7 +320,7 @@ def test_loss_with_zero_inflight_jobs_still_reforms(
     assert ev["ok"], ev
     assert ev["jobs_interrupted"] == []
     assert ev["jobs_resumed"] == 0
-    assert ev["new_mesh"] == {"nodes": 1, "model": 1}
+    assert ev["new_mesh"] == {"nodes": 1, "model": 1, "slices": 1}
     mon.check_serving()                      # admission reopened
 
 

@@ -433,7 +433,7 @@ def test_slice_loss_during_tiered_train_reforms_and_resumes_bitwise(
     assert mon.wait_stable(60)
     ev = mon.events()[-1]
     assert ev["ok"], ev
-    assert ev["new_mesh"] == {"nodes": 2, "model": 2}
+    assert ev["new_mesh"] == {"nodes": 2, "model": 2, "slices": 1}
     assert ev["jobs_resumed"] == 1
 
     assert len(mon.last_results) == 1

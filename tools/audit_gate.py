@@ -112,6 +112,31 @@ def _workload() -> None:
                 "mean 0 'all' nrow 0 'all')", sess)
     cloud().dkv.remove("gate_pipe")
 
+    # two-level-mesh leg: the same fused pipeline + GBM block on a
+    # simulated 2x2x2 mesh (2 slices x 2 nodes x 2 model on the 8
+    # forced-host devices).  The audit's _EVENTS deque survives the
+    # reform, so GL703's slices branch checks that no compiled program
+    # replicates a row-sharded operand across ``slices`` — every row
+    # output must carry the ('slices', 'nodes') product spec
+    from h2o_tpu.core.cloud import Cloud
+    Cloud.reform(slices=2, nodes=4, model_axis=2)
+    pf2 = Frame(["x", "g"], [Vec(x), Vec(g, T_CAT,
+                                         domain=["a", "b", "c", "d"])])
+    pf2.key = "gate_pipe2"
+    cloud().dkv.put("gate_pipe2", pf2)
+    inner2 = "(rows gate_pipe2 (> (cols gate_pipe2 [0]) -2))"
+    outer2 = f"(rows {inner2} (< (cols {inner2} [0]) 2))"
+    rapids_exec(f"(sort (na.omit {outer2}) [1 0] [1 1])", sess)
+    rapids_exec("(GB (rows gate_pipe2 (<= (cols gate_pipe2 [0]) 1)) [1] "
+                "mean 0 'all' nrow 0 'all')", sess)
+    cloud().dkv.remove("gate_pipe2")
+    fr2 = Frame(["x0", "x1", "y"],
+                [Vec(rng.normal(size=R).astype(np.float32)),
+                 Vec(rng.normal(size=R).astype(np.float32)),
+                 Vec(rng.normal(size=R).astype(np.float32))])
+    GBM(ntrees=2, max_depth=3, seed=3, nbins=64).train(
+        y="y", training_frame=fr2)
+
     from h2o_tpu.core.job import Job
     from h2o_tpu.core.memory import manager
     from h2o_tpu.core.store import DKV
