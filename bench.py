@@ -1078,6 +1078,98 @@ def bench_bins_pack(fr, rows, depth):
     return out
 
 
+def bench_stats_pack(fr, rows, depth):
+    """Quantized vs f32 gradient-stat A/B (ops/statpack.py, the
+    ``tree.stats_dtype`` lever): the histogram hot path's HBM bytes
+    under each carrier (stats operand + one-hot matmul operands + the
+    accumulated table), the per-level ``hist.table`` collective bytes
+    from the PR 18 two-level ledger (int32 tables cross the wire when
+    quantized), the steady-state train-throughput delta with the lever
+    forced each way, and the forest-metric deviation the tolerance
+    gate consumes.  The acceptance bar is >= 2x table+stats byte
+    reduction at carrier itemsize <= 2 — int16 gives it by
+    construction (every operand narrows 4 -> 2 bytes; the int32
+    accumulator stays 4, but is O(table), not O(rows))."""
+    import jax.numpy as jnp
+    from h2o_tpu.core.diag import DispatchStats
+    from h2o_tpu.models.tree.gbm import GBM
+    from h2o_tpu.ops import statpack
+    from h2o_tpu.ops.histogram import N_STATS
+
+    trees = int(os.environ.get("BENCH_PACK_TREES", 5))
+    prev = os.environ.get("H2O_TPU_STATS_DTYPE")
+    walls, out, metrics, coll = {}, {}, {}, {}
+
+    def _hist_table_bytes():
+        snap = DispatchStats.snapshot().get("collectives", {})
+        tot = {"n": 0, "ici_bytes": 0, "dcn_bytes": 0}
+        for ph in snap.values():
+            for tag, d in ph.items():
+                if "hist.table" in tag:
+                    for k in tot:
+                        tot[k] += d[k]
+        return tot
+
+    try:
+        for mode, flag in (("quantized", "1"), ("f32", "0")):
+            os.environ["H2O_TPU_STATS_DTYPE"] = flag
+            c0 = _hist_table_bytes()
+            m, wall, wall_c, sc = _timed_train(
+                lambda: GBM(ntrees=trees, max_depth=depth,
+                            learn_rate=0.1, seed=1, nbins=64,
+                            histogram_type="QuantilesGlobal"), fr)
+            c1 = _hist_table_bytes()
+            walls[mode] = wall
+            tm = m.output.get("training_metrics") or {}
+            metrics[mode] = {k: float(tm[k]) for k in
+                             ("logloss", "auc", "mean_residual_deviance")
+                             if tm.get(k) is not None}
+            coll[mode] = {k: c1[k] - c0[k] for k in c1}
+            out[mode] = {"rows_trees_per_s": round(rows * trees / wall,
+                                                   1),
+                         "wall_s": round(wall, 2),
+                         "steady_compiles": sc,
+                         "hist_table_collective": coll[mode]}
+        C = len(m.output["x"])
+        B1, S, L = 64 + 1, N_STATS, 1 << depth
+        itemsize = statpack.stats_itemsize("int16")
+        # per-level hot-path bytes: the stats operand, both matmul
+        # operands (binhot and leafhot (x) stats — each at the stats
+        # carrier dtype in the integer dot), plus the accumulated
+        # table (int32 quantized, f32 reference: 4 bytes either way)
+        table = L * C * B1 * S * 4
+        ops_f32 = rows * (S + C * B1 + L * S) * 4
+        ops_q = rows * (S + C * B1 + L * S) * itemsize
+        out.update({
+            "stats_dtype": "int16",
+            "stats_bytes_f32": rows * S * 4,
+            "stats_bytes_packed": rows * S * itemsize,
+            "hot_path_bytes_f32": ops_f32 + table,
+            "hot_path_bytes_packed": ops_q + table,
+            # headline: the O(rows) traffic — stats + matmul operands,
+            # every term narrowed 4 -> itemsize bytes.  The int32
+            # accumulator table is row-count independent and 4 bytes
+            # under BOTH carriers; the _with_table figure includes it
+            "bytes_reduction": round(ops_f32 / ops_q, 2),
+            "bytes_reduction_with_table": round((ops_f32 + table)
+                                                / (ops_q + table), 2),
+            "metrics": metrics,
+            "metric_delta": {
+                k: round(abs(metrics["quantized"][k]
+                             - metrics["f32"][k]), 6)
+                for k in metrics.get("f32", {})
+                if k in metrics.get("quantized", {})},
+            "metric_tol": statpack.METRIC_TOL})
+    finally:
+        if prev is None:
+            os.environ.pop("H2O_TPU_STATS_DTYPE", None)
+        else:
+            os.environ["H2O_TPU_STATS_DTYPE"] = prev
+    out["value"] = round(walls["f32"] / walls["quantized"], 4)
+    out["unit"] = "quantized/f32 speedup (train steady-state)"
+    return out
+
+
 def bench_ingest_bigger_than_hbm(rows, cols, depth):
     """Train on a frame BIGGER than the configured HBM budget — the
     tiered-column-store rung (core/landing.py + core/memory.py):
@@ -1413,7 +1505,7 @@ def _main_ladder(detail):
         "gbm,gbm_ua,gbm_bf16,drf,glm,dl,hist,rapidsgb,rapidspipe,"
         "scaleout,multichip,gbm10m,"
         "cpuref,cpuref10m,deep,coldstart,streamref,leverab,elastic,"
-        "auditovh,binspack,tierhbm,servesus"
+        "auditovh,binspack,statspack,tierhbm,servesus"
     ).split(",")
 
     detail.update({"rows": rows, "cols": cols})
@@ -1462,8 +1554,8 @@ def _main_ladder(detail):
                             "rapidsgb", "rapidspipe", "scaleout",
                             "multichip", "gbm10m",
                             "cpuref10m", "coldstart", "leverab",
-                            "elastic", "binspack", "tierhbm",
-                            "servesus")]
+                            "elastic", "binspack", "statspack",
+                            "tierhbm", "servesus")]
         detail["rows"] = rows
     detail["platform"] = platform
 
@@ -1500,6 +1592,7 @@ def _main_ladder(detail):
             ("elastic", bench_elastic_resume),
             ("auditovh", bench_audit_overhead),
             ("binspack", lambda: bench_bins_pack(fr, rows, depth)),
+            ("statspack", lambda: bench_stats_pack(fr, rows, depth)),
             ("tierhbm", lambda: bench_ingest_bigger_than_hbm(
                 min(rows, int(os.environ.get("BENCH_TIER_ROWS",
                                              rows))), cols, depth)),
@@ -1518,6 +1611,7 @@ def _main_ladder(detail):
              "elastic": "elastic_resume",
              "auditovh": "audit_overhead",
              "binspack": "bins_pack",
+             "statspack": "stats_pack",
              "tierhbm": "ingest_bigger_than_hbm",
              "servesus": "serving_sustained"}
     for cfg, fn in runs:

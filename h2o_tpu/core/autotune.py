@@ -104,6 +104,23 @@ def tri_state(var: str) -> Optional[bool]:
     return None
 
 
+def stats_dtype_forced() -> Optional[str]:
+    """H2O_TPU_STATS_DTYPE named spellings (the tri-state 1/0 pair plus
+    explicit carrier names): 1/on/int16 -> "int16", int8 -> "int8",
+    0/off/f32/float32 -> "f32", auto/unset/other -> None (defer to the
+    ``tree.stats_dtype`` measured decision).  Consumers go through
+    ``ops.statpack.resolve_stats_dtype`` — a forced name wins with zero
+    probes, exactly like the 1/0 fast path in ``resolve_flag``."""
+    v = _env_value("H2O_TPU_STATS_DTYPE")
+    if v in _TRUE or v == "int16":
+        return "int16"
+    if v == "int8":
+        return "int8"
+    if v in _FALSE or v in ("f32", "float32"):
+        return "f32"
+    return None
+
+
 def autotune_mode() -> str:
     """H2O_TPU_AUTOTUNE: ``off`` (0) = reference variants everywhere,
     ``force`` = probe on any backend, default ``auto`` = probe on TPU
@@ -741,4 +758,66 @@ register_lever(Lever(
     # bin values under both carriers, so the histograms — and therefore
     # whole forests — must match bitwise, not approximately
     tol=(0.0, 0.0),
+))
+
+
+def _stats_workload(bucket: Tuple) -> dict:
+    from h2o_tpu.ops import statpack
+    R, C, B = bucket                    # (rows, C, nbins)
+    R = _probe_rows(R)
+    kb, kl, ks, kq = jax.random.split(jax.random.PRNGKey(29), 4)
+    L = 32
+    # signed stats (gradients change sign) so stochastic rounding is
+    # exercised on both sides of zero.  Quantization happens ONCE per
+    # tree in production against per-LEVEL histogram builds, so the
+    # probe pre-quantizes in the workload and times the hist alone —
+    # the same amortization the training loop gets.
+    stats_ = jax.random.uniform(ks, (R, N_STATS), jnp.float32,
+                                -1.0, 1.0)
+    qmax = statpack.stats_qmax(R, "int16")
+    q, inv = statpack.quantize_stats(stats_, kq, "int16", qmax)
+    return {
+        "bins": jax.random.randint(kb, (R, C), 0, B + 1, jnp.int32),
+        "leaf": jax.random.randint(kl, (R,), 0, L, jnp.int32),
+        "stats": stats_, "qstats": q, "inv_scale": inv,
+        "B": B, "L": L,
+    }
+
+
+def _stats_run(v: str, w: dict):
+    from h2o_tpu.ops import statpack
+    if v == "f32":
+        return _hist_plain(w["bins"], w["leaf"], w["stats"],
+                           n_leaves=w["L"], nbins=w["B"], pallas=False)
+    t = _hist_plain(w["bins"], w["leaf"], w["qstats"],
+                    n_leaves=w["L"], nbins=w["B"], pallas=False)
+    return statpack.dequant_table(t, w["inv_scale"])
+
+
+def _stats_fp() -> str:
+    from h2o_tpu.models.tree import jit_engine as je
+    from h2o_tpu.ops import histogram as hg
+    from h2o_tpu.ops import statpack as sp
+    return ",".join(code_fingerprint(f) for f in (
+        sp.quantize_stats, sp.dequant_table, sp.stats_qmax,
+        hg._block_hist, hg.histogram_build_traced,
+        je._hist_level_with_sibling))
+
+
+register_lever(Lever(
+    site="tree.stats_dtype",
+    env_var="H2O_TPU_STATS_DTYPE",
+    variants=("f32", "int16"),
+    true_variants=frozenset({"int16"}),
+    default_bucket=(1 << 16, 32, 64),           # (rows, C, nbins)
+    make_workload=_stats_workload,
+    run_variant=_stats_run,
+    fingerprint=_stats_fp,
+    # NOT bitwise: stochastic rounding perturbs each table entry by
+    # < max|f|/qmax per row.  The band is ops/statpack.py TABLE_TOL;
+    # whole-forest metric drift is additionally pinned to
+    # statpack.METRIC_TOL by tests/test_stats_pack.py and the
+    # stats_pack bench rung.  A candidate outside the band — or not
+    # beating f32 by probe_margin() — is disqualified.
+    tol=(0.02, 0.05),
 ))

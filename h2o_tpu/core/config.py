@@ -89,7 +89,20 @@ owning modules, like the chaos flags, so they work before a cloud boots):
   iff the NA sentinel F <= 255, int16 iff F <= 32767 — vs the int32
   reference; ops/binpack.py owns the decode contract, kernels widen
   in-register per tile, and the parity gate is BITWISE, tol (0, 0),
-  since packing must not change a single forest bit) each accept ``1``
+  since packing must not change a single forest bit) and
+  ``H2O_TPU_STATS_DTYPE`` (tree.stats_dtype: gradient/hessian stats
+  quantized per tree to an integer carrier with stochastic rounding
+  keyed off the per-tree fold_in key, histogram tables accumulated in
+  exact int32 and dequantized once per level at the table;
+  ops/statpack.py owns the decode contract and graftlint GL631 bans
+  f32 re-widening of the carrier anywhere else.  Also accepts the
+  carrier names ``int16``/``int8``/``f32`` directly; ``1`` means
+  int16.  Unlike bins packing the gate is NOT bitwise — each table
+  entry moves by < max|f|/qmax per row — so the lever's tolerance band
+  is (0.02, 0.05) at the table and tests/bench pin whole-forest
+  metrics to statpack.METRIC_TOL.  Unset on CPU resolves to the f32
+  reference with zero probes and stays bitwise-identical to the
+  pre-quantization engine) each accept ``1``
   (force on, no probe), ``0`` (force off, no probe) or unset/``auto``
   (defer to the autotuner's parity-gated, persisted decision).  A
   candidate that fails the parity gate against its reference output is

@@ -202,6 +202,10 @@ class DispatchStats:
 
     @classmethod
     def snapshot(cls) -> Dict[str, Any]:
+        # stats-pack counters live in ops/statpack.py (the module owns
+        # its own quantization telemetry); surfaced here so one snapshot
+        # carries the whole dispatch/traffic/quantization picture
+        from h2o_tpu.ops import statpack
         with cls._lock:
             return {"compiles": dict(cls._compiles),
                     "dispatches": dict(cls._dispatches),
@@ -213,6 +217,7 @@ class DispatchStats:
                     "host_pull_bytes": dict(cls._host_pull_bytes),
                     "collectives": {p: {k: dict(v) for k, v in kinds.items()}
                                     for p, kinds in cls._collectives.items()},
+                    "stats_pack": statpack.stats(),
                     "xla_compiles": cls._xla_compiles,
                     "xla_listener": cls._listener_installed}
 

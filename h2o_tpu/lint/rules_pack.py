@@ -1,4 +1,4 @@
-"""GL630 — packed-bin dtype discipline.
+"""GL630/GL631 — packed-carrier dtype discipline.
 
 ops/binpack.py is the ONE sanctioned place that chooses the binned
 matrix's carrier dtype (uint8/int16/int32 by fine bin count) and the
@@ -15,6 +15,17 @@ Scope is deliberately name-based and receiver-narrow (plain names and
 attribute chains only, never call results): ``jnp.sum(...).astype(
 jnp.int32)`` reductions over bins are new int32 values, not re-widened
 matrices, and stay legal.
+
+GL631 is the VALUE-side twin: ops/statpack.py is the one sanctioned
+place that quantizes gradient/hessian stats to a narrow carrier and
+the one place allowed to decode them back to float32
+(``dequant_table`` — once per level, at the TABLE).  A stray
+``stats.astype(jnp.float32)`` outside it either re-materializes the
+wide stats HBM copy quantization exists to avoid, or — worse —
+dequantizes per ROW and silently changes the arithmetic the exactness
+proofs (integer sibling subtraction, mesh parity) depend on.  Same
+receiver-narrow, name-based scope: int32 TABLE reductions and
+call-result converts stay legal.
 """
 
 from __future__ import annotations
@@ -107,4 +118,77 @@ def check_bin_rewiden(mi: ModuleInfo, ctx):
             recv = _terminal_name(node.args[0])
             if _names_a_bin(recv):
                 flag(node, recv, "convert_element_type(..., int32)")
+    return out
+
+
+#: modules allowed to decode quantized stat carriers: the stats
+#: quantization layer itself (``dequant_table`` lives there)
+_STAT_SANCTIONED = {"ops/statpack.py"}
+
+_STAT_TOKENS = {"stat", "stats", "qstat", "qstats"}
+
+
+def _names_a_stat(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    return any(t in _STAT_TOKENS for t in name.lower().split("_"))
+
+
+def _is_float32_dtype(node) -> bool:
+    if isinstance(node, ast.Constant):
+        return node.value == "float32"
+    chain = classify._attr_chain(node)
+    return (len(chain) >= 2 and chain[0] in _NUMPY_ROOTS
+            and chain[-1] == "float32")
+
+
+@rule("GL631", "quantized-stat-rewiden")
+def check_stat_rewiden(mi: ModuleInfo, ctx):
+    """float32 widening of a stat-named value outside ops/statpack.py."""
+    if mi.rel in _STAT_SANCTIONED:
+        return []
+    out: List[Finding] = []
+
+    def flag(node, receiver: str, form: str):
+        out.append(Finding(
+            "GL631", "error", mi.rel, node.lineno, mi.scope_of(node),
+            f"{form} re-widens the quantized stats carrier {receiver!r} "
+            f"to float32 outside the sanctioned quantization layer — "
+            f"decode happens ONCE per level at the table via "
+            f"ops.statpack.dequant_table; a stray float32 convert "
+            f"re-materializes the wide stats copy or silently breaks "
+            f"the integer-exactness contract (sibling subtraction, "
+            f"mesh parity)",
+            detail=f"rewiden:{mi.scope_of(node)}:{receiver}"))
+
+    for node in ast.walk(mi.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # form 1: <stats>.astype(jnp.float32)
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "astype" and node.args and \
+                _is_float32_dtype(node.args[0]):
+            recv = _terminal_name(node.func.value)
+            if _names_a_stat(recv):
+                flag(node, recv, ".astype(float32)")
+            continue
+        chain = classify._attr_chain(node.func)
+        if not chain or chain[0] not in _NUMPY_ROOTS:
+            continue
+        # form 2: jnp.asarray/array(<stats>, jnp.float32)
+        if chain[-1] in ("asarray", "array", "ascontiguousarray"):
+            dt = classify._kw(node, "dtype")
+            if dt is None and len(node.args) > 1:
+                dt = node.args[1]
+            if dt is not None and _is_float32_dtype(dt) and node.args:
+                recv = _terminal_name(node.args[0])
+                if _names_a_stat(recv):
+                    flag(node, recv, f"{chain[-1]}(..., float32)")
+            continue
+        # form 3: lax.convert_element_type(<stats>, jnp.float32)
+        if chain[-1] == "convert_element_type" and len(node.args) > 1 \
+                and _is_float32_dtype(node.args[1]):
+            recv = _terminal_name(node.args[0])
+            if _names_a_stat(recv):
+                flag(node, recv, "convert_element_type(..., float32)")
     return out

@@ -206,6 +206,11 @@ def run_tree_driver(job, p: Dict, train_kwargs: Dict, F0, key,
     # the same (possibly autotuner-probed) executable, and a probe only
     # ever runs before the first block, never mid-forest
     train_kwargs = resolve_train_levers(dict(train_kwargs))
+    # surface the resolved stats carrier on the job (clients see which
+    # numeric contract — f32 reference vs quantized int — trained the
+    # forest, same visibility rule as effective_max_depth)
+    if train_kwargs.get("stats_dtype"):
+        p["effective_stats_dtype"] = train_kwargs["stats_dtype"]
 
     # tiered column store: once binning is done, the RAW frame columns
     # are dead weight for the whole forest — under an HBM budget, demote

@@ -48,6 +48,7 @@ import jax.numpy as jnp
 
 from h2o_tpu.models.distributions import get_distribution
 from h2o_tpu.models.tree.shared_tree import find_splits
+from h2o_tpu.ops import statpack
 from h2o_tpu.ops.histogram import histogram_build_traced as _shard_histogram
 
 EPS = 1e-10
@@ -285,24 +286,36 @@ def _hist_level_with_sibling(bins, slot, stats, L: int, B: int, cfg,
     interleaved layout on subtraction-eligible levels).  Histograms are
     built for the L/2 LEFT children only; each right child is its
     parent's histogram minus the left sibling (masked to split parents —
-    unsplit parents' children have no rows and must stay zero)."""
+    unsplit parents' children have no rows and must stay zero).
+
+    With quantized stats (ops/statpack.py) both tables are exact int32
+    and the subtraction happens in INTEGER space — bitwise equal to the
+    unsubtracted build (tests/test_stats_pack.py proves it), a claim
+    the f32 path cannot make.  The weak ``0`` below keeps the table
+    dtype either way."""
     half = L // 2
     left_slot = jnp.where((slot >= 0) & (slot % 2 == 0), slot // 2, -1)
     left = _shard_histogram(bins, left_slot, stats, half, B,
                             cfg["block_rows"], cfg["bf16"],
                             pallas=cfg.get("pallas"))
     right = jnp.where(parent_split[:, None, None, None],
-                      parent_hist - left, 0.0)
+                      parent_hist - left, 0)
     return jnp.stack([left, right], axis=1).reshape(L, *left.shape[1:])
 
 
 def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
-                      tree_col_mask=None, mono=None):
+                      tree_col_mask=None, mono=None, inv_scale=None):
     """Traceable single-tree build.  Returns (split_col, bitset, value,
     varimp), shapes (H,), (H, B+1), (H,), (C,) with H = 2^(D+1)-1.
     varimp accumulates each split's SE-reduction gain into its column —
     the reference's relative-importance convention (SharedTreeModel
-    varimp from squared-error improvements)."""
+    varimp from squared-error improvements).
+
+    ``inv_scale`` non-None means ``stats`` is the quantized integer
+    carrier (ops/statpack.py): tables come back exact int32 and are
+    dequantized ONCE per level at the table before split finding —
+    never per row; ``prev_hist`` stays integer so sibling subtraction
+    is exact."""
     D = cfg["max_depth"]
     B = cfg["nbins"]
     C = bins.shape[1]
@@ -358,6 +371,11 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
             hist = _shard_histogram(bins, leaf, stats, L, B,
                                     cfg["block_rows"], cfg["bf16"],
                                     pallas=cfg.get("pallas"))
+        # the ONE integer->f32 crossing per level: split finding and
+        # range refinement read the dequantized table, sibling
+        # subtraction keeps the exact integer one
+        hist_f = hist if inv_scale is None else \
+            statpack.dequant_table(hist, inv_scale)
         if k_cols < C:
             key, sub = jax.random.split(key)
             r = jax.random.uniform(sub, (L, C))
@@ -367,7 +385,7 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
             col_allowed = jnp.ones((L, C), bool)
         if tree_col_mask is not None:
             col_allowed = col_allowed & tree_col_mask[None, :]
-        s = find_splits(hist, is_cat, col_allowed,
+        s = find_splits(hist_f, is_cat, col_allowed,
                         min_rows=cfg["min_rows"],
                         min_split_improvement=cfg["min_split_improvement"],
                         mono=mono, use_mono=use_mono, newton=newton,
@@ -463,7 +481,7 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
         leaf = jnp.where(active & do_lf, child,
                          jnp.where(active, -1, leaf))
         if adaptive and d + 1 < D:
-            new_lo, new_hi = _refine_ranges(hist, rlo, rhi, roff, Bd)
+            new_lo, new_hi = _refine_ranges(hist_f, rlo, rhi, roff, Bd)
             rlo, rhi = _child_ranges(new_lo, new_hi, s, thr_leaf,
                                      is_cat, do_split)
         prev_hist, prev_do = hist, do_split
@@ -472,7 +490,7 @@ def build_tree_traced(bins, stats, leaf0, key, is_cat, cfg: Dict,
 
 
 def build_tree_frontier(bins, stats, slot0, key, is_cat, cfg: Dict,
-                        tree_col_mask=None, mono=None):
+                        tree_col_mask=None, mono=None, inv_scale=None):
     """Traceable single-tree build with a CAPPED live frontier.
 
     Like ``build_tree_traced`` but the per-level leaf set is bounded by
@@ -546,6 +564,9 @@ def build_tree_frontier(bins, stats, slot0, key, is_cat, cfg: Dict,
             hist = _shard_histogram(bins, slot, stats, L, B,
                                     cfg["block_rows"], cfg["bf16"],
                                     pallas=cfg.get("pallas"))
+        # dequantize once per level at the table (see build_tree_traced)
+        hist_f = hist if inv_scale is None else \
+            statpack.dequant_table(hist, inv_scale)
         if k_cols < C:
             key, sub = jax.random.split(key)
             r = jax.random.uniform(sub, (L, C))
@@ -555,7 +576,7 @@ def build_tree_frontier(bins, stats, slot0, key, is_cat, cfg: Dict,
             col_allowed = jnp.ones((L, C), bool)
         if tree_col_mask is not None:
             col_allowed = col_allowed & tree_col_mask[None, :]
-        s = find_splits(hist, is_cat, col_allowed,
+        s = find_splits(hist_f, is_cat, col_allowed,
                         min_rows=cfg["min_rows"],
                         min_split_improvement=cfg["min_split_improvement"],
                         mono=mono, use_mono=use_mono, newton=newton,
@@ -671,7 +692,8 @@ def build_tree_frontier(bins, stats, slot0, key, is_cat, cfg: Dict,
                 lo_b = jnp.take(lo_c, sel)
                 hi_b = jnp.take(hi_c, sel)
             if adaptive:
-                new_lo, new_hi = _refine_ranges(hist, rlo, rhi, roff, Bd)
+                new_lo, new_hi = _refine_ranges(hist_f, rlo, rhi, roff,
+                                                Bd)
                 clo, chi = _child_ranges(new_lo, new_hi, s, thr_leaf,
                                          is_cat, do_split)
                 rlo = jnp.take(clo, sel, axis=0)
@@ -764,8 +786,20 @@ def _hist_bucket(args, kwargs):
     return hist_bucket(int(R), int(C), int(kwargs.get("nbins", 64)), L)
 
 
+def _stats_bucket(args, kwargs):
+    """Shape bucket for the tree.stats_dtype lever from a train_forest
+    call: (pow2 rows, pow2 cols, nbins).  None (→ the lever's default
+    bucket) when the bins matrix isn't identifiable."""
+    bins = kwargs.get("bins", args[0] if args else None)
+    if bins is None or getattr(bins, "ndim", 0) != 2:
+        return None
+    R, C = bins.shape
+    return statpack.stats_bucket(int(R), int(C),
+                                 int(kwargs.get("nbins", 64)))
+
+
 def resolve_train_levers(train_kwargs: dict) -> dict:
-    """Resolve the three tunable-lever flags ONCE (driver entry) so a
+    """Resolve the tunable-lever flags ONCE (driver entry) so a
     multi-block training run — and its recovery/speculative re-
     dispatches — uses one stable, already-probed decision per lever
     instead of re-resolving at every block boundary.  Flags the caller
@@ -778,6 +812,9 @@ def resolve_train_levers(train_kwargs: dict) -> dict:
             _hist_bucket((), train_kwargs))
     if train_kwargs.get("mm_route") is None:
         train_kwargs["mm_route"] = matmul_route_enabled()
+    if train_kwargs.get("stats_dtype") is None:
+        train_kwargs["stats_dtype"] = statpack.resolve_stats_dtype(
+            _stats_bucket((), train_kwargs))
     return train_kwargs
 
 
@@ -830,10 +867,19 @@ def train_forest(*args, sibling: Optional[bool] = None,
         hist_pallas = pallas_env_enabled(_hist_bucket(args, kwargs))
     if "mm_route" not in kwargs or kwargs["mm_route"] is None:
         kwargs["mm_route"] = matmul_route_enabled()
+    if "stats_dtype" not in kwargs or kwargs["stats_dtype"] is None:
+        kwargs["stats_dtype"] = statpack.resolve_stats_dtype(
+            _stats_bucket(args, kwargs))
     from h2o_tpu.core.diag import DispatchStats
     from h2o_tpu.core.exec_store import exec_store
     from h2o_tpu.core.oom import kernel_fallback
     DispatchStats.note_dispatch("tree_block")
+    bins_arg = kwargs.get("bins", args[0] if args else None)
+    if bins_arg is not None and getattr(bins_arg, "ndim", 0) == 2:
+        from h2o_tpu.ops.histogram import N_STATS
+        statpack.note_train(kwargs["stats_dtype"],
+                            int(bins_arg.shape[0]), N_STATS,
+                            int(kwargs.get("ntrees", 1)))
 
     # the traced body bakes cloud().mesh into its shard_map (the
     # histogram collective), and jit's TRACE cache keys on shapes only —
@@ -864,7 +910,7 @@ _TF_STATIC = ("dist_name", "K", "ntrees", "max_depth", "nbins",
               "col_sample_rate_per_tree", "use_mono",
               "kleaves", "custom_dist", "sibling",
               "adaptive", "fine_nbins", "hist_random",
-              "hist_pallas", "mm_route", "mesh_fp")
+              "hist_pallas", "mm_route", "stats_dtype", "mesh_fp")
 
 
 def _train_forest_impl(bins, yv, w, active, F0, is_cat, key, *,
@@ -886,6 +932,7 @@ def _train_forest_impl(bins, yv, w, active, F0, is_cat, key, *,
                  hist_random: bool = False,
                  hist_pallas: bool = False,
                  mm_route: bool = False,
+                 stats_dtype: str = "f32",
                  mesh_fp=None) -> TrainedForest:
     """The WHOLE forest training loop as one XLA program.
 
@@ -903,6 +950,12 @@ def _train_forest_impl(bins, yv, w, active, F0, is_cat, key, *,
     kleaves=0: dense heap engine; >0: sparse-frontier engine with that
     live-leaf cap (module docstring).  ``sibling`` (static; resolved by
     the train_forest wrapper) enables histogram sibling subtraction.
+    ``stats_dtype`` (static; resolved outside the trace like the other
+    levers) selects the per-tree stats carrier: "f32" is the bitwise
+    pre-lever reference (no quantization noise is even DRAWN, so the
+    program is identical), "int16"/"int8" quantize each tree's stats
+    with stochastic rounding (ops/statpack.py) and run the whole level
+    loop on exact int32 tables.
     """
     cfg = dict(max_depth=max_depth, nbins=nbins, k_cols=k_cols,
                newton=newton, min_rows=min_rows,
@@ -941,6 +994,10 @@ def _train_forest_impl(bins, yv, w, active, F0, is_cat, key, *,
         return jnp.stack([wa, wa * g, wa * g * g, wa * h], axis=1)
 
     C = bins.shape[1]
+    # static quantization ceiling: R is the padded row count, a Python
+    # int at trace time, so the int32-overflow bound is baked in
+    qmax = (statpack.stats_qmax(R, stats_dtype)
+            if stats_dtype != "f32" else 0)
 
     def tree_step(F, xs):
         t_idx, key_t = xs
@@ -966,14 +1023,23 @@ def _train_forest_impl(bins, yv, w, active, F0, is_cat, key, *,
         for kcls in range(K):                    # static unroll over classes
             kc, kk = jax.random.split(kc)
             stats = stats_for(kcls, F)
+            if stats_dtype != "f32":
+                # quantize ONCE per (tree, class) against the per-class
+                # key kk — which descends from the absolute-tree-index
+                # fold_in below, so any block partition and any mesh
+                # shape draws the identical rounding noise
+                stats, inv_sc = statpack.quantize_stats(
+                    stats, kk, stats_dtype, qmax)
+            else:
+                inv_sc = None
             if kleaves > 0:
                 sc, bs, vl, ch, vi, gn, nw, th, na = build_tree_frontier(
                     bins, stats, leaf0, kk, is_cat, cfg, tree_cols,
-                    mono=mono)
+                    mono=mono, inv_scale=inv_sc)
             else:
                 sc, bs, vl, vi, gn, nw, th, na = build_tree_traced(
                     bins, stats, leaf0, kk, is_cat, cfg, tree_cols,
-                    mono=mono)
+                    mono=mono, inv_scale=inv_sc)
                 ch = None
             vl = vl * scale
             scs.append(sc)

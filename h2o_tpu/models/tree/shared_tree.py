@@ -393,7 +393,17 @@ def find_splits(hist, is_cat, col_allowed, min_rows: float = 10.0,
     direction are rejected; the builder additionally clamps child values
     to parent bounds (the XGBoost two-part scheme this engine's
     force_newton path matches).
+
+    ``hist`` must be f32: a quantized build (ops/statpack.py) must
+    dequantize ONCE per level at the table — never per row and never
+    implicitly here, where an integer table would silently promote
+    through every ratio below.  The guard fires at trace time.
     """
+    if jnp.issubdtype(jnp.asarray(hist).dtype, jnp.integer):
+        raise TypeError(
+            "find_splits received an integer (quantized) histogram "
+            "table — dequantize once per level at the table with "
+            "ops/statpack.dequant_table before split finding")
     L, C, B1, _ = hist.shape
     B = B1 - 1
     w, wg, wgg, wh = (hist[..., k] for k in range(4))

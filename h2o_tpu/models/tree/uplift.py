@@ -131,11 +131,13 @@ def _find_uplift_splits(hist, col_allowed, metric: str, min_rows: float):
 @functools.partial(
     jax.jit,
     static_argnames=("ntrees", "max_depth", "nbins", "k_cols", "metric",
-                     "sample_rate", "min_rows", "kleaves", "hist_pallas"))
+                     "sample_rate", "min_rows", "kleaves", "hist_pallas",
+                     "stats_dtype"))
 def _train_uplift_forest(bins, treat, yv, w, active, key, *, ntrees: int,
                          max_depth: int, nbins: int, k_cols: int,
                          metric: str, sample_rate: float, min_rows: float,
-                         kleaves: int = 4096, hist_pallas: bool = False):
+                         kleaves: int = 4096, hist_pallas: bool = False,
+                         stats_dtype: str = "f32"):
     """Whole uplift forest as one XLA program — the sparse-frontier
     pool engine (jit_engine.build_tree_frontier pattern): live leaves
     capped at ``kleaves`` per level with best-first selection by node
@@ -143,10 +145,13 @@ def _train_uplift_forest(bins, treat, yv, w, active, key, *, ntrees: int,
     pointers.  Child rates come from the split's own cumsums, so no
     extra final-level histogram pass is needed."""
     from h2o_tpu.models.tree.jit_engine import frontier_plan
+    from h2o_tpu.ops import statpack
     R, C = bins.shape
     D, B = max_depth, nbins
     widths = frontier_plan(D, kleaves)
     N = 1 + 2 * sum(widths)
+    qmax = (statpack.stats_qmax(R, stats_dtype)
+            if stats_dtype != "f32" else 0)
 
     def one_tree(carry, key_t):
         ks, kc = jax.random.split(key_t)
@@ -154,6 +159,14 @@ def _train_uplift_forest(bins, treat, yv, w, active, key, *, ntrees: int,
         wa = jnp.where(samp, w, 0.0)
         stats = jnp.stack([wa * treat, wa * treat * yv,
                            wa * (1 - treat), wa * (1 - treat) * yv], axis=1)
+        if stats_dtype != "f32":
+            # quantized carrier (ops/statpack.py): per-tree stochastic
+            # rounding off this tree's own key, exact int32 tables,
+            # dequantized once per level below
+            stats, inv_sc = statpack.quantize_stats(
+                stats, key_t, stats_dtype, qmax)
+        else:
+            inv_sc = None
         split_col = jnp.full((N + 1,), -1, jnp.int32)   # +1 trash slot
         bitset = jnp.zeros((N + 1, B + 1), bool)
         val_t = jnp.zeros((N + 1,), jnp.float32)
@@ -166,6 +179,8 @@ def _train_uplift_forest(bins, treat, yv, w, active, key, *, ntrees: int,
             L = widths[d]
             hist = histogram_build_traced(bins, slot, stats, L, B, 8192,
                                           False, pallas=hist_pallas)
+            if inv_sc is not None:
+                hist = statpack.dequant_table(hist, inv_sc)
             kc, kcol = jax.random.split(kc)
             if k_cols < C:
                 r = jax.random.uniform(kcol, (L, C))
@@ -323,7 +338,13 @@ class UpliftDRF(ModelBuilder):
         T = int(p["ntrees"])
         job.update(0.1, f"training {T} uplift trees")
         from h2o_tpu.core.oom import kernel_fallback
+        from h2o_tpu.ops import statpack
         key0 = self.rng_key()
+        # stats carrier resolved OUTSIDE the trace (static jit arg),
+        # same once-per-forest discipline as the GBM/DRF driver
+        sdt = statpack.resolve_stats_dtype(statpack.stats_bucket(
+            binned.bins.shape[0], binned.bins.shape[1], binned.nbins))
+        statpack.note_train(sdt, int(binned.bins.shape[0]), 4, T)
         sc, bs, vt, vc, ch = kernel_fallback(
             "tree.block",
             lambda pallas: _train_uplift_forest(
@@ -333,7 +354,8 @@ class UpliftDRF(ModelBuilder):
                 metric=(p["uplift_metric"] or "KL").lower(),
                 sample_rate=float(p["sample_rate"]),
                 min_rows=float(p["min_rows"]),
-                kleaves=max_live_leaves(), hist_pallas=pallas),
+                kleaves=max_live_leaves(), hist_pallas=pallas,
+                stats_dtype=sdt),
             # autotuned/forced Pallas decision for the uplift hist
             # shapes, resolved OUTSIDE the trace (static jit arg)
             pallas=pallas_env_enabled(hist_bucket(

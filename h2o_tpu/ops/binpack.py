@@ -32,6 +32,12 @@ int32 reference before a packed candidate can win, and scoring is
 dtype-agnostic either way (bin VALUES are identical under both
 representations, so a checkpoint trained packed resumes bitwise under
 int32 and vice versa).
+
+This module packs the histogram matmul's INDEX side; its VALUE-side
+twin is ``ops/statpack.py`` (quantized gradient/hessian stats, the
+``tree.stats_dtype`` lever, GL631).  The two compose: with both levers
+on, the one-hot contraction runs narrow-carrier × narrow-carrier into
+an exact int32 table.
 """
 
 from __future__ import annotations

@@ -54,7 +54,7 @@ _VMEM_WORKSET_BYTES = 12 * 2 ** 20
 
 
 def plan_tile_rows(C: int, B1: int, L: int, S: int, mm_dtype,
-                   bins_itemsize: int = 4):
+                   bins_itemsize: int = 4, stats_itemsize: int = 4):
     """Row-tile height (512-multiple, capped at 4096) whose combined
     working set fits ``_VMEM_WORKSET_BYTES``, or None when even the
     512-row minimum tile cannot — the caller must reject the fused
@@ -63,13 +63,18 @@ def plan_tile_rows(C: int, B1: int, L: int, S: int, mm_dtype,
     ``bins_itemsize`` is the PACKED bins dtype's width (ops/binpack.py):
     a uint8 matrix costs the tile a quarter of the int32 cost, so
     packed callers plan TALLER tiles from the same budget — the
-    narrower working set is the point of packing."""
+    narrower working set is the point of packing.  ``stats_itemsize``
+    is the stats carrier's width (ops/statpack.py): quantized int16
+    stats also shrink the one-hot + A temporaries, because the
+    integer-dot path casts the one-hot to the SAME carrier — callers
+    pass the carrier dtype as ``mm_dtype`` then, and the accumulator
+    block stays 4 bytes (int32, same as f32)."""
     itemsize = jnp.dtype(mm_dtype).itemsize
-    acc = C * B1 * L * S * 4                       # f32 accumulator block
+    acc = C * B1 * L * S * 4                  # f32/int32 accumulator block
     per_row = ((C * B1 + L * S) * itemsize        # one-hot + A temporary
                + L * 4                            # leaf-hot
                + C * bins_itemsize                # packed bins tile
-               + (S + 1) * 4)                     # stats/leaf tiles
+               + S * stats_itemsize + 4)          # stats/leaf tiles
     avail = _VMEM_WORKSET_BYTES - acc
     if avail < per_row * 512:
         return None
@@ -78,11 +83,11 @@ def plan_tile_rows(C: int, B1: int, L: int, S: int, mm_dtype,
 
 def min_tile_fits(C: int, B1: int, L: int = 1, S: int = 4) -> bool:
     """True when the minimum (512-row) tile's combined working set fits
-    the VMEM budget at the widest (f32 matmul, int32 bins) dtypes —
-    eligibility gate for wide-feature AND wide-frontier shapes
+    the VMEM budget at the widest (f32 matmul, int32 bins, f32 stats)
+    dtypes — eligibility gate for wide-feature AND wide-frontier shapes
     (ops/histogram.py falls back to the XLA path otherwise).  Packed
-    bins only shrink the working set, so worst-case eligibility here
-    stays valid for every packed dtype."""
+    bins and quantized stats only shrink the working set, so worst-case
+    eligibility here stays valid for every narrow carrier."""
     return plan_tile_rows(C, B1, L, S, jnp.float32) is not None
 
 
@@ -95,9 +100,10 @@ class VMEMGateError(ValueError):
 
 
 def _tile_rows(C: int, B1: int, L: int, S: int, mm_dtype,
-               bins_itemsize: int = 4) -> int:
+               bins_itemsize: int = 4, stats_itemsize: int = 4) -> int:
     """Working-set-bounded tile height; asserts eligibility was gated."""
-    t = plan_tile_rows(C, B1, L, S, mm_dtype, bins_itemsize)
+    t = plan_tile_rows(C, B1, L, S, mm_dtype, bins_itemsize,
+                       stats_itemsize)
     if t is None:
         raise VMEMGateError(
             f"hist_pallas working set exceeds VMEM at the minimum tile "
@@ -122,18 +128,27 @@ def _hist_kernel(bins_ref, leaf_ref, stats_ref, out_ref, *,
     leafhot = (leaf[:, None] ==
                lax.broadcasted_iota(jnp.int32, (TR, L), 1))
     # zero stats of inactive rows BEFORE the product (padded rows carry
-    # NaN payloads; 0 * NaN would poison the accumulator)
-    stats = jnp.where(leaf[:, None] >= 0, stats_ref[:], 0.0)
+    # NaN payloads; 0 * NaN would poison the accumulator; the weak 0
+    # keeps a quantized carrier's dtype)
+    stats = jnp.where(leaf[:, None] >= 0, stats_ref[:], 0)
     a = (leafhot[:, :, None] * stats[:, None, :]).reshape(TR, L * S)
     # in-tile widen of the packed bins tile (ops/binpack.py): the
     # compare needs int32 operands, the widened values never leave VMEM
     binhot = (widen_bins(bins_ref[:])[:, :, None] ==
               lax.broadcasted_iota(jnp.int32, (TR, C, B1), 2)
               ).reshape(TR, C * B1)
-    out_ref[:] += lax.dot_general(
-        binhot.astype(mm_dtype), a.astype(mm_dtype),
-        dimension_numbers=(((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)                  # (C*B1, L*S)
+    if jnp.issubdtype(stats.dtype, jnp.integer):
+        # quantized stats (ops/statpack.py): integer dot with an int32
+        # accumulator block — exact by the statpack qmax row bound
+        out_ref[:] += lax.dot_general(
+            binhot.astype(stats.dtype), a,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)                # (C*B1, L*S)
+    else:
+        out_ref[:] += lax.dot_general(
+            binhot.astype(mm_dtype), a.astype(mm_dtype),
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (C*B1, L*S)
 
 
 def _adaptive_kernel(bins_ref, leaf_ref, stats_ref, lo_ref, hi_ref,
@@ -182,16 +197,22 @@ def _adaptive_kernel(bins_ref, leaf_ref, stats_ref, lo_ref, hi_ref,
                     jnp.minimum(bins_blk, nbins), nb)
     bucket = jnp.where(bins_blk == fine_na, nbins, out)
 
-    stats = jnp.where(leaf[:, None] >= 0, stats_ref[:], 0.0)
+    stats = jnp.where(leaf[:, None] >= 0, stats_ref[:], 0)
     a = (leafhot[:, :, None] * stats[:, None, :]).reshape(
         TR, L * stats.shape[1])
     binhot = (bucket[:, :, None] ==
               lax.broadcasted_iota(jnp.int32, (TR, Cg, B1), 2)
               ).reshape(TR, Cg * B1)
-    out_ref[:] += lax.dot_general(
-        binhot.astype(mm_dtype), a.astype(mm_dtype),
-        dimension_numbers=(((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+    if jnp.issubdtype(stats.dtype, jnp.integer):
+        out_ref[:] += lax.dot_general(
+            binhot.astype(stats.dtype), a,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+    else:
+        out_ref[:] += lax.dot_general(
+            binhot.astype(mm_dtype), a.astype(mm_dtype),
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -209,7 +230,12 @@ def hist_pallas_adaptive(bins, leaf, stats, lo, hi, off, is_cat,
     R, C = bins.shape
     S = stats.shape[1]
     B1 = nbins + 1
-    mm_dtype = jnp.bfloat16 if bf16 else jnp.float32
+    quantized = jnp.issubdtype(stats.dtype, jnp.integer)
+    # quantized stats carry their own matmul dtype (the integer dot
+    # casts the one-hot to the carrier), so the tile plan sees the
+    # narrow itemsize on the one-hot + A temporaries too
+    mm_dtype = (stats.dtype if quantized
+                else (jnp.bfloat16 if bf16 else jnp.float32))
     itemsize = jnp.dtype(mm_dtype).itemsize
     # pick (col group, tile rows): group as wide as keeps BOTH a 512-row
     # one-hot AND the (Cg*B1, L*S) accumulator block within budget,
@@ -220,11 +246,13 @@ def hist_pallas_adaptive(bins, leaf, stats, lo, hi, off, is_cat,
     # shrink the group until the COMBINED working set (incl. the
     # (TR, L*S) A temporary, unbounded in the old gate) admits a tile
     while Cg > 1 and plan_tile_rows(Cg, B1, n_leaves, S, mm_dtype,
-                                    bins.dtype.itemsize) is None:
+                                    bins.dtype.itemsize,
+                                    stats.dtype.itemsize) is None:
         Cg = max(1, Cg // 2)
     ncg = -(-C // Cg)
     cpad = ncg * Cg - C
-    TR = _tile_rows(Cg, B1, n_leaves, S, mm_dtype, bins.dtype.itemsize)
+    TR = _tile_rows(Cg, B1, n_leaves, S, mm_dtype, bins.dtype.itemsize,
+                    stats.dtype.itemsize)
     pad = (-R) % TR
     if cpad:
         # padded columns carry the fine_na sentinel, so every row maps
@@ -266,8 +294,9 @@ def hist_pallas_adaptive(bins, leaf, stats, lo, hi, off, is_cat,
         out_specs=pl.BlockSpec((Cg * B1, n_leaves * S),
                                lambda j, i: (j, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((ncg * Cg * B1, n_leaves * S),
-                                       jnp.float32),
+        out_shape=jax.ShapeDtypeStruct(
+            (ncg * Cg * B1, n_leaves * S),
+            jnp.int32 if quantized else jnp.float32),
         interpret=interpret,
     )(bins, leaf.reshape(-1, 1), stats, lo, hi, off,
       is_cat.astype(jnp.int32).reshape(1, -1))
@@ -287,8 +316,11 @@ def hist_pallas(bins, leaf, stats, n_leaves: int, nbins: int,
     R, C = bins.shape
     S = stats.shape[1]
     B1 = nbins + 1
-    mm_dtype = jnp.bfloat16 if bf16 else jnp.float32
-    TR = _tile_rows(C, B1, n_leaves, S, mm_dtype, bins.dtype.itemsize)
+    quantized = jnp.issubdtype(stats.dtype, jnp.integer)
+    mm_dtype = (stats.dtype if quantized
+                else (jnp.bfloat16 if bf16 else jnp.float32))
+    TR = _tile_rows(C, B1, n_leaves, S, mm_dtype, bins.dtype.itemsize,
+                    stats.dtype.itemsize)
     pad = (-R) % TR
     if pad:
         bins = jnp.pad(bins, ((0, pad), (0, 0)))
@@ -311,7 +343,8 @@ def hist_pallas(bins, leaf, stats, n_leaves: int, nbins: int,
         ],
         out_specs=pl.BlockSpec((C * B1, n_leaves * S), lambda i: (0, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((C * B1, n_leaves * S),
-                                       jnp.float32),
+        out_shape=jax.ShapeDtypeStruct(
+            (C * B1, n_leaves * S),
+            jnp.int32 if quantized else jnp.float32),
         interpret=interpret,
     )(bins, leaf.reshape(-1, 1), stats)

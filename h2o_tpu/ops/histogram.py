@@ -111,22 +111,38 @@ def _block_hist(bins_blk, leaf_blk, stats_blk, n_leaves: int, nbins: int,
                int32 iota in-register, so packed bins feed the MXU with
                no widened copy of the block
     leaf_blk:  (R,)  int32 in [0, L); negative = row inactive this pass
-    stats_blk: (R, S) f32
-    mm_dtype:  matmul input dtype; bf16 doubles MXU throughput at the cost
-               of ~3 mantissa digits on the per-row stats (the one-hot side
-               is exact either way).
+    stats_blk: (R, S) f32, OR a quantized integer carrier (int16/int8,
+               ops/statpack.py) — integer stats flip the contraction to
+               an integer dot_general with int32 accumulation: both
+               operands at the carrier itemsize, the (C*B1, L*S) table
+               exact by the statpack qmax row bound
+    mm_dtype:  matmul input dtype (f32 path only); bf16 doubles MXU
+               throughput at the cost of ~3 mantissa digits on the
+               per-row stats (the one-hot side is exact either way).
     """
     B1 = nbins + 1
     C = bins_blk.shape[1]
     S = stats_blk.shape[1]
+    quantized = jnp.issubdtype(stats_blk.dtype, jnp.integer)
     leafhot = (leaf_blk[:, None] == jnp.arange(n_leaves)[None, :])
     # zero stats of inactive rows BEFORE the product: padded rows carry NaN
-    # payloads and 0 * NaN would poison the accumulator
-    stats_blk = jnp.where(leaf_blk[:, None] >= 0, stats_blk, 0.0)
+    # payloads and 0 * NaN would poison the accumulator (the quantized
+    # carrier has no NaN, but padded rows still must not count; the weak
+    # 0 keeps the carrier dtype)
+    stats_blk = jnp.where(leaf_blk[:, None] >= 0, stats_blk, 0)
     a = (leafhot[:, :, None] * stats_blk[:, None, :]).reshape(
         -1, n_leaves * S)                                     # (R, L*S)
     binhot = (bins_blk[:, :, None] ==
               jnp.arange(B1)[None, None, :]).reshape(-1, C * B1)  # (R, C*B1)
+    if quantized:
+        # integer MXU path: one-hot cast to the SAME narrow carrier
+        # in-register (values are 0/1 — exact), int32 accumulator.
+        # Overflow-free by construction: statpack.stats_qmax bounds
+        # |q| * rows below 2**31.
+        return jax.lax.dot_general(
+            binhot.astype(stats_blk.dtype), a,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)                 # (C*B1, L*S)
     return jax.lax.dot_general(
         binhot.astype(mm_dtype), a.astype(mm_dtype),
         dimension_numbers=(((0,), (0,)), ((), ())),
@@ -172,7 +188,12 @@ def histogram_build_traced(bins, leaf, stats, n_leaves: int, nbins: int,
     bins:  (padded_rows, C) packed int (uint8/int16/int32), row-sharded
            — pre-binned features at the dtype the bin count permits
     leaf:  (padded_rows,)  int32, row-sharded — leaf assignment, <0 inactive
-    stats: (padded_rows, S) f32, row-sharded — (w, wg, wgg, wh)
+    stats: (padded_rows, S) f32, row-sharded — (w, wg, wgg, wh); OR the
+           quantized int16/int8 carrier (ops/statpack.py), which flips
+           the whole build — block matmuls, scan accumulator, and the
+           hist.table cross-node reduce — to exact int32, so the table
+           is identical under any block partition or mesh shape and the
+           combine ships integer bytes (PR 18 ledger)
     fine_map: None for direct (global-grid) binning, else
     (lo, hi, off, is_cat, fine_na) enabling per-node adaptive bucket
     placement (map_buckets) fused into each row block.
@@ -239,7 +260,10 @@ def histogram_build_traced(bins, leaf, stats, n_leaves: int, nbins: int,
             return acc + _block_hist(bucketize(bb, lb), lb, sb, n_leaves,
                                      nbins, mmd), None
 
-        init = jnp.zeros((C * B1, n_leaves * S), jnp.float32)
+        acc_dtype = (jnp.int32
+                     if jnp.issubdtype(s_sh.dtype, jnp.integer)
+                     else jnp.float32)
+        init = jnp.zeros((C * B1, n_leaves * S), acc_dtype)
         acc, _ = jax.lax.scan(body, init, (b3, l3, s3))
         rem = R - nblk * blk
         if rem:

@@ -139,6 +139,13 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
         terminalreporter.write_line(
             "[collectives] ici_bytes={} dcn_bytes={}{}".format(
                 ici, dcn, (" | " + per_phase) if per_phase else ""))
+        from h2o_tpu.ops import statpack
+        sps = statpack.stats()
+        terminalreporter.write_line(
+            "[stats-pack] quantized_trains={} f32_trains={} "
+            "bytes_saved_est={}".format(
+                sps["quantized_trains"], sps["f32_trains"],
+                sps["bytes_saved_est"]))
         from h2o_tpu.lint import last_summary
         ls = last_summary()
         if ls is not None:

@@ -569,6 +569,21 @@ CASES = [
             counts = jnp.sum(bins == 0, axis=0).astype(jnp.int32)
             return wide[leaf], counts
      """, {}),
+    ("GL631", "ops/fx.py", """
+        import jax.numpy as jnp
+
+        def level(qstats, leaf):
+            wide = qstats.astype(jnp.float32)
+            return wide[leaf]
+     """, """
+        import jax.numpy as jnp
+        from h2o_tpu.ops.statpack import dequant_table
+
+        def level(hist, qstats, inv_scale):
+            table = dequant_table(hist, inv_scale)
+            total = jnp.sum(qstats, axis=0).astype(jnp.float32)
+            return table, total
+     """, {}),
 ]
 
 IDS = [c[0] for c in CASES]

@@ -71,6 +71,11 @@ def _workload() -> None:
     # materialization of the binned matrix (GL702's HBM-copy check)
     # shows up here, not on silicon
     os.environ["H2O_TPU_BINS_PACK"] = "1"
+    # ... and quantized int16 gradient stats (ops/statpack.py): the
+    # audited tree executables carry integer histogram accumulation,
+    # so an accidental f32 re-widening of the stats operand or an
+    # O(rows) dequantize would surface in this tier's checks
+    os.environ["H2O_TPU_STATS_DTYPE"] = "1"
     from h2o_tpu.core.frame import Frame, Vec
     from h2o_tpu.models.tree.gbm import GBM
 
