@@ -990,6 +990,126 @@ def bench_serving_sustained():
     return out
 
 
+def bench_automl_e2e():
+    """End-to-end AutoML wall clock: one budgeted AutoML build (the
+    grid + ensemble pipeline a tenant actually submits) on a HIGGS-like
+    frame.  Reports models/min (headline), leaderboard depth, the
+    leader's sort metric and total wall — the number that moves when
+    admission, the job pool, or the builder hot path regress."""
+    from h2o_tpu.automl.automl import AutoML
+
+    rows = int(os.environ.get("BENCH_AUTOML_ROWS", 20_000))
+    max_models = int(os.environ.get("BENCH_AUTOML_MODELS", 4))
+    nfolds = int(os.environ.get("BENCH_AUTOML_NFOLDS", 2))
+    X, y = _make_data(rows, 8, seed=29)
+    fr = _frame(X, y)
+    t0 = time.monotonic()
+    aml = AutoML(max_models=max_models, seed=29, nfolds=nfolds,
+                 include_algos=["GBM", "GLM", "DRF"],
+                 project_name="bench_automl_e2e")
+    aml.train(y="y", training_frame=fr)
+    wall = time.monotonic() - t0
+    n_models = len(aml.leaderboard.models)
+    return {"value": round(n_models / wall * 60.0, 2),
+            "unit": "models/min",
+            "wall_s": round(wall, 2), "rows": rows,
+            "models": n_models, "nfolds": nfolds,
+            "leader": str(getattr(aml.leader, "key", aml.leader))
+            if aml.leader is not None else None}
+
+
+def bench_multitenant_soak():
+    """Shortened in-process multi-tenant isolation rung (the full leg
+    lives in tools/soak.py --multitenant): three weighted tenants each
+    push a burst of small GBM jobs through fair-share admission while a
+    serve hammer scores a shared alias per tenant.  Reports admitted
+    jobs/sec (headline), the fairness spread (served/weight ratio
+    max/min over tenants — 1.0 is perfect), classified-refusal counts,
+    per-tenant serve p99, and the isolation invariant
+    ``cross_tenant_evictions`` below the high-water mark (must be 0)."""
+    import threading
+    from h2o_tpu.core.cloud import cloud
+    from h2o_tpu.core.memory import manager
+    from h2o_tpu.core.tenant import (create_tenant, delete_tenant,
+                                     tenant_context)
+    from h2o_tpu.models.tree.gbm import GBM
+    from h2o_tpu.serve import ServingConfig
+    from h2o_tpu.serve.registry import registry
+
+    jobs_per = int(os.environ.get("BENCH_MT_JOBS", 4))
+    weights = {"mt_a": 3.0, "mt_b": 2.0, "mt_c": 1.0}
+    for name, w in weights.items():
+        create_tenant(name, weight=w, hbm_share=0.3)
+    Xt, yt = _make_data(4096, 6, seed=31)
+    fr = _frame(Xt, yt)
+    m = GBM(ntrees=3, max_depth=3, seed=31, nbins=16).train(
+        y="y", training_frame=fr)
+    alias = "bench_mt_soak"
+    registry().deploy(alias, m, ServingConfig(max_batch=32,
+                                              max_delay_ms=1.0,
+                                              queue_cap=128))
+    lat = {t: [] for t in weights}
+    lock = threading.Lock()
+    stop = threading.Event()
+    probe = [{f"x{j}": 0.1 for j in range(6)}]
+
+    def hammer(tname):
+        while not stop.is_set():
+            h0 = time.monotonic()
+            try:
+                registry().score_rows(alias, probe, tenant=tname)
+                with lock:
+                    lat[tname].append((time.monotonic() - h0) * 1000.0)
+            except Exception:  # noqa: BLE001 — sheds are the protocol
+                pass
+            time.sleep(0.005)
+
+    hammers = [threading.Thread(target=hammer, args=(t,), daemon=True)
+               for t in weights]
+    for h in hammers:
+        h.start()
+    t0 = time.monotonic()
+    jobs = []
+    for name in weights:
+        with tenant_context(name):
+            for i in range(jobs_per):
+                jobs.append(GBM(ntrees=2, max_depth=3, seed=31 + i,
+                                nbins=16).train_async(
+                    y="y", training_frame=fr))
+    for j in jobs:
+        j.join(timeout=600)
+    wall = time.monotonic() - t0
+    stop.set()
+    for h in hammers:
+        h.join(timeout=5)
+    adm = cloud().jobs.admission.stats()
+    mem = manager().stats()
+    served = {t: adm["tenants"].get(t, {}).get("served", 0.0)
+              for t in weights}
+    ratios = [served[t] / weights[t] for t in weights if served[t]]
+    fairness = (round(max(ratios) / min(ratios), 3)
+                if len(ratios) == len(weights) else 0.0)
+    done = sum(1 for j in jobs if j.status == "DONE")
+    out = {"value": round(done / wall, 2), "unit": "tenant jobs/sec",
+           "wall_s": round(wall, 2), "tenants": len(weights),
+           "jobs": len(jobs), "done": done,
+           "admitted": adm["admitted"], "rejected": adm["rejected"],
+           "rejects_by_reason": adm["rejects_by_reason"],
+           "fairness_spread": fairness,
+           "cross_tenant_evictions": mem["cross_tenant_evictions"],
+           "cross_tenant_below_highwater":
+               mem["cross_tenant_below_highwater"],
+           "serve_p99_ms": {t: round(float(np.percentile(v, 99)), 2)
+                            for t, v in lat.items() if v}}
+    try:
+        registry().undeploy(alias, drain_secs=2.0)
+    except KeyError:
+        pass
+    for name in weights:
+        delete_tenant(name)
+    return out
+
+
 def bench_lever_ab():
     """Per-lever A/B deltas (core/autotune.py): force-probe every
     registered lever's candidates on the live backend — parity gate +
@@ -1505,7 +1625,7 @@ def _main_ladder(detail):
         "gbm,gbm_ua,gbm_bf16,drf,glm,dl,hist,rapidsgb,rapidspipe,"
         "scaleout,multichip,gbm10m,"
         "cpuref,cpuref10m,deep,coldstart,streamref,leverab,elastic,"
-        "auditovh,binspack,statspack,tierhbm,servesus"
+        "auditovh,binspack,statspack,tierhbm,servesus,automl,mtsoak"
     ).split(",")
 
     detail.update({"rows": rows, "cols": cols})
@@ -1555,7 +1675,8 @@ def _main_ladder(detail):
                             "multichip", "gbm10m",
                             "cpuref10m", "coldstart", "leverab",
                             "elastic", "binspack", "statspack",
-                            "tierhbm", "servesus")]
+                            "tierhbm", "servesus", "automl",
+                            "mtsoak")]
         detail["rows"] = rows
     detail["platform"] = platform
 
@@ -1596,7 +1717,9 @@ def _main_ladder(detail):
             ("tierhbm", lambda: bench_ingest_bigger_than_hbm(
                 min(rows, int(os.environ.get("BENCH_TIER_ROWS",
                                              rows))), cols, depth)),
-            ("servesus", bench_serving_sustained)]
+            ("servesus", bench_serving_sustained),
+            ("automl", bench_automl_e2e),
+            ("mtsoak", bench_multitenant_soak)]
     names = {"hist": "hist_kernel", "gbm10m": "gbm_10m",
              "cpuref": "cpu_reference", "deep": "drf_deep20",
              "gbm_ua": "gbm_uniform_adaptive", "gbm_bf16": "gbm_bf16",
@@ -1613,7 +1736,9 @@ def _main_ladder(detail):
              "binspack": "bins_pack",
              "statspack": "stats_pack",
              "tierhbm": "ingest_bigger_than_hbm",
-             "servesus": "serving_sustained"}
+             "servesus": "serving_sustained",
+             "automl": "automl_e2e",
+             "mtsoak": "multitenant_soak"}
     for cfg, fn in runs:
         if cfg not in configs:
             continue

@@ -686,8 +686,19 @@ def build_model(params, algo):
     if params.get("ignored_columns") and fr is not None:
         ign = _coerce(params["ignored_columns"], [])
         x = [c for c in fr.names if c not in ign and c != y]
-    job = b.train_async(x=x, y=y, training_frame=fr,
-                        validation_frame=valid)
+    from h2o_tpu.core.tenant import AdmissionRejected, tenant_context
+    tenant = params.get("tenant")
+    try:
+        with tenant_context(str(tenant) if tenant else None):
+            job = b.train_async(x=x, y=y, training_frame=fr,
+                                validation_frame=valid)
+    except AdmissionRejected as e:
+        # classified refusal from the fair-share admission queue —
+        # the multi-tenant analog of the breaker's 429: the client
+        # backs off, the cluster never wedges on an unbounded queue
+        raise H2OError(429, f"admission rejected ({e.reason}): {e}",
+                       headers={"Retry-After": str(max(1, int(round(
+                           e.retry_after_s))))})
     return {"job": job.to_dict(),
             "messages": [], "error_count": 0,
             "parameters": {k: v for k, v in b.params.items()
@@ -1336,16 +1347,28 @@ def resilience_stats(params):
     from h2o_tpu.core.chaos import chaos
     from h2o_tpu.core.membership import monitor
     from h2o_tpu.core.memory import manager
+    from h2o_tpu.core.tenant import list_tenants
     from h2o_tpu.serve.registry import serving_stats
     jr = cloud().jobs
     c = chaos()
+    mem = manager().stats()
+    # join the per-tag residency the manager published (it never reads
+    # the DKV under its own lock) with each tenant's configured share
+    tenants = {t.name: t.to_dict() for t in list_tenants()}
+    for tag, row in (mem.get("tenants") or {}).items():
+        if tag in tenants:
+            tenants[tag]["memory"] = row
+    admission = (jr._admission.stats() if jr._admission is not None
+                 else None)
     return {
         "retry": resilience.stats(),
         "chaos": dict(enabled=c.enabled, **c.counters()),
         "oom": oom.stats(),
-        "memory": manager().stats(),
+        "memory": mem,
         "membership": monitor().payload(),
         "serving": serving_stats(),
+        "tenants": tenants,
+        "admission": admission,
         "watchdog": {"expired_jobs": jr.expired_count,
                      "evicted_jobs": jr.evicted_count,
                      "default_deadline_secs": jr.default_deadline_secs,
@@ -1503,6 +1526,7 @@ from h2o_tpu.api import handlers_ext  # noqa: E402,F401
 from h2o_tpu.api import handlers_models  # noqa: E402,F401
 from h2o_tpu.api import handlers_serving  # noqa: E402,F401
 from h2o_tpu.api import handlers_stream  # noqa: E402,F401
+from h2o_tpu.api import handlers_tenant  # noqa: E402,F401
 from h2o_tpu.api import handlers_transforms  # noqa: E402,F401
 from h2o_tpu.api import handlers_analysis  # noqa: E402,F401
 from h2o_tpu.api import flow_ui  # noqa: E402

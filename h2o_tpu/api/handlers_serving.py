@@ -232,9 +232,12 @@ def serving_score(params, name):
         raise H2OError(400, 'body must be JSON {"rows": [{...}, ...]}')
     deadline_ms = params.get("deadline_ms")
     deadline_ms = float(deadline_ms) if deadline_ms is not None else None
+    tenant = params.get("tenant")
+    tenant = str(tenant) if tenant else None
     fl = fleet()
     try:
-        raw, ver = fl.score_rows(name, rows, deadline_ms=deadline_ms)
+        raw, ver = fl.score_rows(name, rows, deadline_ms=deadline_ms,
+                                 tenant=tenant)
     except MeshReforming as e:
         # the membership layer is re-forming the mesh after a slice
         # loss: fail fast with an explicit retry window — never hang

@@ -27,15 +27,32 @@ def _int(params, key, default):
     return int(v) if v is not None else default
 
 
+def _bool(params, key, default=False):
+    v = params.get(key)
+    if v is None:
+        return default
+    if isinstance(v, bool):
+        return v
+    return str(v).strip().lower() in ("1", "true", "yes", "on")
+
+
 @route("POST", r"/3/Stream")
 def stream_start(params):
-    """Start a streaming pipeline.  Required: ``source`` (path/URI) and
-    ``y``.  Optional: ``algo`` (gbm/drf/xgboost/glm, default gbm), ``x``
+    """Start a streaming pipeline.  Required: ``source`` (path/URI, or a
+    comma list of sources round-robined into one frame) and ``y``.
+    Optional: ``algo`` (gbm/drf/xgboost/glm, default gbm), ``x``
     (comma list), ``alias`` (serve deployment to hot-swap), ``chunk_rows``,
     ``refresh_chunks``, ``trees_per_refresh``, ``lag_bound``,
     ``recovery_dir`` (mid-block checkpoint/resume of refreshes),
     ``dest_frame``, ``max_chunks``, ``params`` (JSON dict of model
-    params, e.g. {"max_depth": 3, "seed": 7})."""
+    params, e.g. {"max_depth": 3, "seed": 7}), ``follow`` (tail -f an
+    unbounded source; EOF means "no data yet"), ``poll_ms`` (follow poll
+    cadence), ``holdout_frac`` (per-chunk validation holdout for the
+    swap gate), ``resume`` (restore the durable per-source byte cursor
+    from ``recovery_dir`` — exactly-once re-attach after a crash), and
+    ``tenant`` (run the pipeline's job under that tenant's fair-share
+    admission + HBM quota)."""
+    from h2o_tpu.core.tenant import tenant_context
     from h2o_tpu.stream import ChunkReader, start_pipeline
     source = params.get("source")
     y = params.get("y") or params.get("response_column")
@@ -59,23 +76,37 @@ def stream_start(params):
             max_delay_ms=float(params.get("max_delay_ms", 2.0)),
             queue_cap=_int(params, "queue_cap", 64),
             deadline_ms=float(params.get("deadline_ms", 0.0)))
+    follow = _bool(params, "follow")
+    poll_ms = params.get("poll_ms")
+    sources = [s.strip() for s in str(source).split(",") if s.strip()] \
+        if isinstance(source, str) else list(source)
+    holdout = params.get("holdout_frac")
+    tenant = params.get("tenant")
     try:
-        reader = ChunkReader(
-            source,
+        readers = [ChunkReader(
+            src,
             chunk_rows=_int(params, "chunk_rows", None),
-            deadline_secs=float(params.get("deadline_secs", 0.0)))
-        pipe = start_pipeline(
-            pid, reader, y, x=x,
-            algo=params.get("algo", "gbm"),
-            model_params=model_params,
-            refresh_chunks=_int(params, "refresh_chunks", None),
-            trees_per_refresh=_int(params, "trees_per_refresh", 10),
-            alias=params.get("alias"),
-            dest_frame=params.get("dest_frame"),
-            recovery_dir=params.get("recovery_dir"),
-            lag_bound=_int(params, "lag_bound", None),
-            serve_config=cfg,
-            max_chunks=_int(params, "max_chunks", None))
+            deadline_secs=float(params.get("deadline_secs", 0.0)),
+            follow=follow,
+            poll_ms=float(poll_ms) if poll_ms is not None else None,
+            emit_partial=_bool(params, "emit_partial", True))
+            for src in sources]
+        with tenant_context(str(tenant) if tenant else None):
+            pipe = start_pipeline(
+                pid, readers if len(readers) > 1 else readers[0], y, x=x,
+                algo=params.get("algo", "gbm"),
+                model_params=model_params,
+                refresh_chunks=_int(params, "refresh_chunks", None),
+                trees_per_refresh=_int(params, "trees_per_refresh", 10),
+                alias=params.get("alias"),
+                dest_frame=params.get("dest_frame"),
+                recovery_dir=params.get("recovery_dir"),
+                lag_bound=_int(params, "lag_bound", None),
+                serve_config=cfg,
+                max_chunks=_int(params, "max_chunks", None),
+                holdout_frac=float(holdout) if holdout is not None
+                else None,
+                resume=_bool(params, "resume"))
     except ValueError as e:
         raise H2OError(400, str(e))
     except FileNotFoundError as e:
@@ -95,6 +126,20 @@ def stream_get(params, pid):
     p = get_pipeline(pid)
     if p is None:
         raise H2OError(404, f"no stream pipeline named {pid}")
+    return {"pipeline": p.status()}
+
+
+@route("POST", r"/3/Stream/(?P<pid>[^/]+)/finish")
+def stream_finish(params, pid):
+    """Gracefully END an unbounded follow pipeline: stop the sources so
+    they drain their buffers, run the final refresh, and complete DONE —
+    the tail -f analog of closing the file (contrast ``/stop``, which
+    cancels)."""
+    from h2o_tpu.stream import get_pipeline
+    p = get_pipeline(pid)
+    if p is None:
+        raise H2OError(404, f"no stream pipeline named {pid}")
+    p.finish()
     return {"pipeline": p.status()}
 
 

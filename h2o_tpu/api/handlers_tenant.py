@@ -1,0 +1,86 @@
+"""Multi-tenant REST surface: /3/Tenants.
+
+- ``POST   /3/Tenants``            register/update a tenant
+  (``name`` required; ``weight``, ``max_concurrent``, ``hbm_share``,
+  ``max_queue`` optional) — upsert, so quota changes land mid-flight
+- ``GET    /3/Tenants``            list tenants + live admission stats
+- ``GET    /3/Tenants/<name>``     one tenant: config, admission row,
+  HBM residency/spill accounting
+- ``DELETE /3/Tenants/<name>``     unregister; the tenant's QUEUED jobs
+  fail with a classified ``tenant_deleted`` refusal (running jobs keep
+  their slots — deletion is not a kill switch)
+
+The registry is DKV-backed (``tenant.<name>`` keys), so tenant rows
+survive the same recovery path as frames and models.  Per-tenant fair
+share (weighted deficit), HBM quota enforcement and the classified 429
+refusals live in core/tenant.py + core/memory.py; this module is only
+the wire surface.
+
+NOTE: no ``jax.jit`` may appear in api/handlers*.py (lint-enforced).
+"""
+
+from __future__ import annotations
+
+from h2o_tpu.api.server import H2OError, route
+from h2o_tpu.core.cloud import cloud
+
+
+def _admission_stats():
+    jr = cloud().jobs
+    return jr._admission.stats() if jr._admission is not None else None
+
+
+@route("POST", r"/3/Tenants")
+def tenant_create(params):
+    """Register (or update — upsert) a tenant.  ``weight`` drives the
+    fair-share stride, ``max_concurrent`` caps the tenant's in-flight
+    jobs (0 = no cap), ``hbm_share`` [0,1] is the HBM fraction past
+    which the tenant's own cold blocks spill first, ``max_queue``
+    bounds the tenant's admission queue (0 = global default)."""
+    from h2o_tpu.core.tenant import create_tenant
+    name = params.get("name")
+    if not name:
+        raise H2OError(400, "name is required")
+    try:
+        t = create_tenant(
+            str(name),
+            weight=float(params.get("weight", 1.0)),
+            max_concurrent=int(params.get("max_concurrent", 0)),
+            hbm_share=float(params.get("hbm_share", 0.0)),
+            max_queue=int(params.get("max_queue", 0)))
+    except ValueError as e:
+        raise H2OError(400, str(e))
+    return {"tenant": t.to_dict()}
+
+
+@route("GET", r"/3/Tenants")
+def tenant_list(params):
+    from h2o_tpu.core.tenant import list_tenants
+    return {"tenants": [t.to_dict() for t in list_tenants()],
+            "admission": _admission_stats()}
+
+
+@route("GET", r"/3/Tenants/(?P<name>[^/]+)")
+def tenant_get(params, name):
+    from h2o_tpu.core.memory import manager
+    from h2o_tpu.core.tenant import get_tenant
+    t = get_tenant(name)
+    if t is None:
+        raise H2OError(404, f"no tenant named {name}")
+    out = t.to_dict()
+    adm = _admission_stats()
+    if adm is not None:
+        out["admission"] = adm["tenants"].get(name)
+    out["memory"] = (manager().stats().get("tenants") or {}).get(name)
+    return {"tenant": out}
+
+
+@route("DELETE", r"/3/Tenants/(?P<name>[^/]+)")
+def tenant_delete(params, name):
+    from h2o_tpu.core.tenant import delete_tenant, get_tenant
+    t = get_tenant(name)
+    if t is None:
+        raise H2OError(404, f"no tenant named {name}")
+    dropped = delete_tenant(name)
+    return {"tenant": t.to_dict(),
+            "dropped_queued_jobs": max(0, dropped)}

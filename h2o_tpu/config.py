@@ -102,6 +102,38 @@ pow2 so adaptation never leaves the engine's compiled bucket set:
 ``H2O_TPU_SERVE_MIN_BATCH`` / ``H2O_TPU_SERVE_MAX_BATCH`` — inclusive
     pow2 bounds the tuner may move ``max_batch`` within (defaults 1 and
     128; non-pow2 values are rounded up to the next bucket).
+
+Multi-tenant knobs (``core/tenant.py`` / ``core/memory.py``)
+------------------------------------------------------------
+
+``H2O_TPU_TENANT_SLOTS`` — concurrent admissions the fair-share queue
+    dispatches onto the user pool (default 0 = the pool's worker
+    count).  Set to 1 in tests to force strict stride ordering.
+
+``H2O_TPU_TENANT_QUEUE`` — default per-tenant admission-queue bound
+    (default 16); a tenant's own ``max_queue`` overrides it.  A full
+    queue refuses with a classified 429 ``AdmissionRejected``.
+
+``H2O_TPU_TENANT_HIGHWATER`` — global HBM residency fraction (default
+    0.9) below which eviction pressure from tenant A may ONLY spill
+    A's own (or untagged) cold blocks.  Past it, survival beats
+    isolation: other tenants' blocks become eligible and each such
+    spill is counted as a ``cross_tenant_eviction`` — the soak's
+    invariant metric (must be 0 below high-water).
+
+Streaming follow-mode knobs (``stream/ingest.py`` / ``refresh.py``)
+-------------------------------------------------------------------
+
+``H2O_TPU_STREAM_POLL_MS`` — milliseconds a ``ChunkReader(follow=True)``
+    sleeps between re-polls of a source that returned no new bytes
+    (default 50).
+
+``H2O_TPU_STREAM_HOLDOUT`` — default per-chunk row fraction a
+    ``StreamPipeline`` holds out of training for the swap gate's
+    validation split (default 0.0 = judge on training rows, the
+    pre-PR-20 behavior).  The holdout is deterministic per chunk
+    (seeded from the pipeline id + chunk index), so replays carve the
+    same rows.
 """
 
 import os
@@ -114,6 +146,8 @@ __all__ = [
     "breaker_open_secs", "breaker_probes", "breaker_interval_ms",
     "breaker_stall_soft", "serve_adaptive_default", "serve_min_batch",
     "serve_max_batch",
+    "tenant_slots", "tenant_queue_bound", "tenant_highwater",
+    "stream_poll_ms", "stream_holdout",
 ]
 
 
@@ -217,3 +251,30 @@ def serve_max_batch() -> int:
     """Upper pow2 bound for the adaptive tuner's ``max_batch``."""
     return max(1, int(os.environ.get("H2O_TPU_SERVE_MAX_BATCH", "128")
                       or 128))
+
+
+def tenant_slots() -> int:
+    """Concurrent fair-share admissions (0 = user-pool worker count)."""
+    return max(0, int(os.environ.get("H2O_TPU_TENANT_SLOTS", "0") or 0))
+
+
+def tenant_queue_bound() -> int:
+    """Default per-tenant admission-queue bound (0 = unbounded)."""
+    return max(0, int(os.environ.get("H2O_TPU_TENANT_QUEUE", "16") or 16))
+
+
+def tenant_highwater() -> float:
+    """Global HBM fraction above which cross-tenant spills are legal."""
+    return float(os.environ.get("H2O_TPU_TENANT_HIGHWATER", "0.9")
+                 or 0.9)
+
+
+def stream_poll_ms() -> float:
+    """Follow-mode re-poll interval for a quiet stream source (ms)."""
+    return float(os.environ.get("H2O_TPU_STREAM_POLL_MS", "50") or 50.0)
+
+
+def stream_holdout() -> float:
+    """Default per-chunk validation-holdout row fraction (0 = off)."""
+    return min(0.9, max(0.0, float(
+        os.environ.get("H2O_TPU_STREAM_HOLDOUT", "0") or 0.0)))

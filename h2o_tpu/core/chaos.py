@@ -84,6 +84,9 @@ H2O_TPU_CHAOS_SLICE_LOSS                    P(synthetic device-unavailable
 H2O_TPU_CHAOS_SLICE_LOSS_AT_BLOCK=N         lose the slice exactly once,
                                             at the Nth dispatch of each
                                             site (deterministic)
+H2O_TPU_CHAOS_ADMISSION_REJECT              P(fair-share admission refuses
+                                            a tenant job with a classified
+                                            429 AdmissionRejected)
 =========================================== ===========================
 
 COUNTER DISCIPLINE (lint-enforced, graftlint GL612/GL613):
@@ -176,6 +179,8 @@ class _Chaos:
         self.slice_loss_p = float(e("H2O_TPU_CHAOS_SLICE_LOSS", 0) or 0)
         self.slice_loss_at_block = int(
             e("H2O_TPU_CHAOS_SLICE_LOSS_AT_BLOCK", 0) or 0)
+        self.admission_reject_p = float(
+            e("H2O_TPU_CHAOS_ADMISSION_REJECT", 0) or 0)
         seed = e("H2O_TPU_CHAOS_SEED")
         self._rng = np.random.default_rng(
             int(seed) if seed is not None else None)
@@ -199,6 +204,7 @@ class _Chaos:
         self.injected_kernel_rejects = 0
         self.injected_slice_losses = 0
         self.injected_serve_pressure = 0
+        self.injected_admission_rejects = 0
 
     @property
     def enabled(self) -> bool:
@@ -212,7 +218,8 @@ class _Chaos:
                 self.stream_truncate_transient > 0 or
                 self.stream_slow_p > 0 or self.kernel_reject_p > 0 or
                 self.serve_pressure_p > 0 or
-                self.slice_loss_p > 0 or self.slice_loss_at_block > 0)
+                self.slice_loss_p > 0 or self.slice_loss_at_block > 0 or
+                self.admission_reject_p > 0)
 
     def counters(self) -> Dict[str, int]:
         """All injected-fault counters (the /3/Resilience chaos block).
@@ -227,7 +234,8 @@ class _Chaos:
                 "injected_oom", "injected_region_ooms",
                 "injected_stream_truncations",
                 "injected_slow_streams", "injected_kernel_rejects",
-                "injected_slice_losses", "injected_serve_pressure")}
+                "injected_slice_losses", "injected_serve_pressure",
+                "injected_admission_rejects")}
 
     def _roll(self, p: float) -> bool:
         if p <= 0:
@@ -364,6 +372,22 @@ class _Chaos:
                 f"injected slice loss at {site}: device unavailable — "
                 f"slice preempted (synthetic)")
 
+    def maybe_reject_admission(self, tenant: str) -> bool:
+        """Admission-rejection injector: called by the fair-share queue
+        (core/tenant.py FairShareAdmission.submit) before a tenant job
+        enqueues.  Returns True when the admission must refuse with a
+        classified ``AdmissionRejected(reason="injected")`` — a 429, not
+        a crash — so soaks prove every refusal under chaos stays typed
+        and the submitter's retry path is exercised.  Like
+        ``maybe_serve_pressure`` this biases a decision rather than
+        raising: the admission layer owns the exception."""
+        if self._roll(self.admission_reject_p):
+            with self._lock:
+                self.injected_admission_rejects += 1
+            log.warning("chaos: rejecting admission for tenant %s", tenant)
+            return True
+        return False
+
     def maybe_truncate_stream(self, source: str) -> None:
         """Streaming-ingest truncation injector: a chunk read raises as
         if the source was cut off mid-record — retried by the stream
@@ -488,7 +512,8 @@ def configure(job_p: float = 0.0, device_put_p: float = 0.0,
               kernel_reject_p: float = 0.0,
               serve_pressure_p: float = 0.0,
               slice_loss_p: float = 0.0,
-              slice_loss_at_block: int = 0) -> _Chaos:
+              slice_loss_at_block: int = 0,
+              admission_reject_p: float = 0.0) -> _Chaos:
     """Programmatic enable (tests); returns the active instance."""
     global _instance
     _instance = _Chaos()
@@ -513,6 +538,7 @@ def configure(job_p: float = 0.0, device_put_p: float = 0.0,
     _instance.serve_pressure_p = float(serve_pressure_p)
     _instance.slice_loss_p = float(slice_loss_p)
     _instance.slice_loss_at_block = int(slice_loss_at_block)
+    _instance.admission_reject_p = float(admission_reject_p)
     if seed is not None:
         _instance._rng = np.random.default_rng(seed)
     return _instance

@@ -20,10 +20,10 @@ Resilience (core/resilience.py):
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 import traceback
-from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, Optional
 
 from h2o_tpu.core.lockwitness import make_lock
@@ -69,8 +69,19 @@ class Job:
                  dest_type: str = "Key<Frame>",
                  priority: int = USER_PRIORITY,
                  deadline_secs: Optional[float] = None,
-                 stall_secs: Optional[float] = None):
+                 stall_secs: Optional[float] = None,
+                 tenant: Optional[str] = None):
+        from h2o_tpu.core.tenant import current_tenant
         self.priority = int(priority)
+        # inherit the submitting thread's tenant context so everything a
+        # tenant-tagged body spawns (grid members, AutoML builds, stream
+        # refreshes) stays attributed to the same tenant
+        self.tenant = tenant if tenant is not None else current_tenant()
+        # True while the job waits in the fair-share admission queue —
+        # it holds no mesh state yet, so quiesce() skips it and it
+        # admits on the survivor mesh after a reform
+        self._admission_queued = False
+        self._admission_slot = False
         self.key = Key.make("job")
         self.dest = Key(dest) if dest else Key.make("result")
         self.dest_type = dest_type
@@ -212,30 +223,102 @@ class Job:
             "stall_secs": self.stall_secs,
             "last_progress": ms(self.last_progress),
             "timed_out": self._timed_out,
+            "tenant": self.tenant,
+            "admission_queued": self._admission_queued,
         }
 
 
-def _grow_pool(pool: ThreadPoolExecutor) -> bool:
-    """Add one worker slot (CPython internals; a watchdog-expired job's
-    thread may still be wedged in its body, so the registry compensates
-    to keep the configured concurrency available)."""
-    try:
-        with pool._shutdown_lock:
-            pool._max_workers += 1
-            pool._adjust_thread_count()
+#: retire token — a worker that dequeues it exits iff the pool is over
+#: its target size (a concurrent grow() simply makes the token a no-op)
+_RETIRE = object()
+
+
+class ResizablePool:
+    """An OWNED daemon-thread work pool with first-class grow/shrink.
+
+    Replaces the previous approach of reaching into
+    ``ThreadPoolExecutor`` privates (``_shutdown_lock`` /
+    ``_max_workers`` / ``_adjust_thread_count``) for watchdog slot
+    compensation, which any CPython point release could silently break.
+    Semantics the registry depends on:
+
+    - ``submit`` never blocks: tasks queue and lazily spawn workers up
+      to ``_max_workers`` (same ramp-up as the stdlib executor);
+    - ``grow`` adds one slot AND spawns its worker immediately — the
+      compensation path runs while the expired job's thread is still
+      wedged in its body, so capacity must not wait for the next
+      submit;
+    - ``shrink`` lowers the target (floor 1) and enqueues a retire
+      token; whichever worker dequeues it exits only if the pool is
+      STILL over target, so grow/shrink races settle at the target
+      size instead of deadlocking or leaking threads.
+
+    ``_max_workers`` stays a public-in-practice attribute name because
+    the soak asserts slot conservation through it.
+    """
+
+    def __init__(self, max_workers: int, thread_name_prefix: str = "h2o-pool"):
+        self._tasks: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._size_lock = threading.Lock()
+        self._max_workers = max(1, int(max_workers))
+        self._live = 0
+        self._spawned = 0
+        self._prefix = thread_name_prefix
+
+    @property
+    def max_workers(self) -> int:
+        return self._max_workers
+
+    @property
+    def live_workers(self) -> int:
+        return self._live
+
+    def submit(self, fn: Callable[..., Any], *args: Any) -> None:
+        self._tasks.put((fn, args))
+        self._ensure_worker()
+
+    def grow(self) -> bool:
+        """Add one worker slot, effective immediately (compensation for
+        a wedged thread that still occupies one of the old slots)."""
+        with self._size_lock:
+            self._max_workers += 1
+        self._ensure_worker()
         return True
-    except Exception:  # noqa: BLE001 — best-effort on non-CPython
-        return False
 
+    def shrink(self) -> None:
+        """Give back a compensated slot once the wedged thread exits."""
+        with self._size_lock:
+            if self._max_workers <= 1:
+                return
+            self._max_workers -= 1
+        self._tasks.put(_RETIRE)
 
-def _shrink_pool(pool: ThreadPoolExecutor) -> None:
-    """Give back a compensated slot once the wedged thread finally exits."""
-    try:
-        with pool._shutdown_lock:
-            if pool._max_workers > 1:
-                pool._max_workers -= 1
-    except Exception:  # noqa: BLE001
-        pass
+    def _ensure_worker(self) -> None:
+        with self._size_lock:
+            if self._live >= self._max_workers:
+                return
+            self._live += 1
+            self._spawned += 1
+            n = self._spawned
+        t = threading.Thread(target=self._worker, daemon=True,
+                             name=f"{self._prefix}-{n}")
+        t.start()
+
+    def _worker(self) -> None:
+        while True:
+            item = self._tasks.get()
+            if item is _RETIRE:
+                with self._size_lock:
+                    if self._live > self._max_workers:
+                        self._live -= 1
+                        return
+                continue  # grow() raced the token — stay alive, drop it
+            fn, args = item
+            try:
+                fn(*args)
+            except BaseException:  # noqa: BLE001 — job bodies report their
+                # own outcomes; a leak to here must not kill the worker
+                log.exception("pool worker: task leaked an exception")
 
 
 class JobRegistry:
@@ -258,11 +341,12 @@ class JobRegistry:
                  watchdog_interval: float = 0.5,
                  jobs_cap: int = 512):
         self._jobs: Dict[Key, Job] = {}
-        self._pool = ThreadPoolExecutor(max_workers=max_workers,
-                                        thread_name_prefix="h2o-job")
-        self._sys_pool = ThreadPoolExecutor(
-            max_workers=system_workers, thread_name_prefix="h2o-sysjob")
+        self._pool = ResizablePool(max_workers,
+                                   thread_name_prefix="h2o-job")
+        self._sys_pool = ResizablePool(system_workers,
+                                       thread_name_prefix="h2o-sysjob")
         self._lock = make_lock("job.JobRegistry._lock")
+        self._admission = None
         self.default_deadline_secs = float(default_deadline_secs)
         self.default_stall_secs = float(default_stall_secs)
         self.watchdog_interval = float(watchdog_interval)
@@ -270,6 +354,17 @@ class JobRegistry:
         self.expired_count = 0
         self.evicted_count = 0
         self._watchdog: Optional[threading.Thread] = None
+
+    @property
+    def admission(self):
+        """The fair-share admission queue (created on first touch; a
+        cluster that never registers a Tenant never pays for it)."""
+        if self._admission is None:
+            from h2o_tpu.core.tenant import FairShareAdmission
+            with self._lock:
+                if self._admission is None:
+                    self._admission = FairShareAdmission(self)
+        return self._admission
 
     # -- watchdog -----------------------------------------------------------
 
@@ -320,7 +415,7 @@ class JobRegistry:
             job._done.set()
         pool = self._sys_pool if job.priority >= Job.SYSTEM_PRIORITY \
             else self._pool
-        if _grow_pool(pool):
+        if pool.grow():
             job._compensated_pool = pool
 
     # -- registry bound -----------------------------------------------------
@@ -342,18 +437,44 @@ class JobRegistry:
     # -- scheduling ---------------------------------------------------------
 
     def start(self, job: Job, body: Callable[[Job], Any]) -> Job:
+        """Register and schedule a job.  Tenant-tagged user jobs on a
+        cluster with registered tenants pass the fair-share admission
+        queue first (which may raise a classified
+        ``AdmissionRejected`` — the 429 path); everything else (system
+        band, untagged, or nested submissions from a body that already
+        holds an admission slot) dispatches directly, so a grid/AutoML
+        run costs exactly ONE logical admission."""
+        from h2o_tpu.core.tenant import needs_admission
         with self._lock:
             self._jobs[job.key] = job
         self._evict_terminal()
         self._ensure_watchdog()
+        runner = self._runner(job, body)
+        if needs_admission(job):
+            self.admission.submit(job, runner)
+        else:
+            self._dispatch(job, runner)
+        return job
 
+    def _dispatch(self, job: Job, runner: Callable[[], None]) -> None:
+        pool = self._sys_pool if job.priority >= Job.SYSTEM_PRIORITY \
+            else self._pool
+        pool.submit(runner)
+
+    def _runner(self, job: Job,
+                body: Callable[[Job], Any]) -> Callable[[], None]:
         def run():
             from h2o_tpu.core.diag import TimeLine
+            from h2o_tpu.core import tenant as tenantmod
             TimeLine.record("job", "start", key=str(job.key),
                             description=job.description)
             job.status = RUNNING
             job.start_time = time.time()
             job.last_progress = job.start_time
+            # pool worker threads are reused, so the body establishes
+            # its own tenant context unconditionally (and restores the
+            # previous one in finally)
+            ctx_token = tenantmod._enter_job(job.tenant)
             try:
                 from h2o_tpu.core.chaos import chaos
                 if chaos().enabled:
@@ -400,20 +521,20 @@ class JobRegistry:
                     log.error("job %s failed: %s\n%s", job.key, e,
                               traceback.format_exc())
             finally:
+                tenantmod._exit_job(ctx_token)
                 with job._state_lock:
                     if not job._timed_out:
                         job.end_time = time.time()
                 pool = getattr(job, "_compensated_pool", None)
                 if pool is not None:
-                    _shrink_pool(pool)
+                    pool.shrink()
+                if self._admission is not None:
+                    self._admission.release(job)
                 TimeLine.record("job", "end", key=str(job.key),
                                 status=job.status)
                 job._done.set()
 
-        pool = self._sys_pool if job.priority >= Job.SYSTEM_PRIORITY \
-            else self._pool
-        pool.submit(run)
-        return job
+        return run
 
     def run_sync(self, job: Job, body: Callable[[Job], Any]) -> Any:
         self.start(job, body)
@@ -431,6 +552,11 @@ class JobRegistry:
         victims = []
         for job in self.list():
             if str(job.key) in exclude:
+                continue
+            # fair-share-queued jobs hold no mesh state yet — they ride
+            # out the reform in their queue and admit on the survivor
+            # mesh, so interrupting them would only destroy queued work
+            if job._admission_queued:
                 continue
             if job.status in (CREATED, RUNNING):
                 job.interrupt(cause)

@@ -60,7 +60,23 @@ log = get_logger("memory")
 
 
 # -- tier knobs (defaults + docs live in h2o_tpu/config.py) ----------------
-from h2o_tpu.config import prefetch_depth, tier_block_rows  # noqa: F401
+from h2o_tpu.config import (prefetch_depth, tenant_highwater,  # noqa: F401
+                            tier_block_rows)
+
+
+def _tenant_share(name: Optional[str]) -> float:
+    """The tenant's reserved HBM fraction (0 when unknown/unreserved).
+    Read OUTSIDE the manager lock — the tenant registry lives in the
+    DKV, and the manager lock must never nest inside a DKV read."""
+    if not name:
+        return 0.0
+    try:
+        from h2o_tpu.core.tenant import get_tenant
+        t = get_tenant(name)
+        return float(t.hbm_share) if t is not None else 0.0
+    except Exception:  # noqa: BLE001 — quota lookup must never fail an
+        # allocation; an unresolvable tenant just has no reservation
+        return 0.0
 
 
 def _tier_dir() -> str:
@@ -299,6 +315,17 @@ class MemoryManager:
         # pressure() drives off)
         self._valid: "dict[weakref.ref, int]" = {}
         self._host: "dict[weakref.ref, int]" = {}
+        # tenant ISOLATION: each registration is tagged with the tenant
+        # context of the allocating thread (None = unowned/system).
+        # Eviction pressure from tenant A selects A's own (or unowned)
+        # cold blocks first; another tenant's blocks become eligible
+        # only past the global high-water mark, and every such spill is
+        # counted — cross_tenant_below_highwater is the soak's
+        # must-be-zero invariant.
+        self._tenant_of: "dict[weakref.ref, Optional[str]]" = {}
+        self._tenant_spills: "dict[str, int]" = {}
+        self.cross_tenant_evictions = 0
+        self.cross_tenant_below_highwater = 0
         self.spill_count = 0
         self.reload_count = 0
         self.pages_in = 0
@@ -317,6 +344,7 @@ class MemoryManager:
         for r in dead:
             self._resident.pop(r, None)
             self._valid.pop(r, None)
+            self._tenant_of.pop(r, None)
 
     @property
     def resident_bytes(self) -> int:
@@ -330,7 +358,16 @@ class MemoryManager:
         budget is exceeded (Cleaner sweep).  The spill itself runs
         OUTSIDE the manager lock (see _spill_lru).  ``valid_nbytes``
         is the real-row subset of ``nbytes`` (ragged columns pad to
-        device capacity); defaults to ``nbytes`` for dense payloads."""
+        device capacity); defaults to ``nbytes`` for dense payloads.
+
+        The payload is tagged with the allocating thread's TENANT; a
+        tenant with a reserved ``hbm_share`` that exceeds it spills its
+        OWN cold blocks first (strict), then the global budget is
+        enforced with the two-pass isolation policy (own/unowned
+        first; cross-tenant only past high-water)."""
+        from h2o_tpu.core.tenant import current_tenant
+        tenant = current_tenant()
+        share = _tenant_share(tenant)
         with self._lock:
             self._prune()
             r = weakref.ref(vec)
@@ -338,12 +375,21 @@ class MemoryManager:
             self._resident[r] = int(nbytes)
             self._valid[r] = int(nbytes if valid_nbytes is None
                                  else min(valid_nbytes, nbytes))
+            self._tenant_of[r] = tenant
             total = sum(self._resident.values())
             if total > self.peak_resident:
                 self.peak_resident = total
             need = (total - self.budget) if self.budget > 0 else 0
+            own_need = 0
+            if share > 0 and self.budget > 0:
+                mine = sum(nb for rr, nb in self._resident.items()
+                           if self._tenant_of.get(rr) == tenant)
+                own_need = mine - int(share * self.budget)
+        if own_need > 0:
+            self._spill_lru(own_need, exclude=vec, tenant=tenant,
+                            own_only=True)
         if need > 0:
-            self._spill_lru(need, exclude=vec)
+            self._spill_lru(need, exclude=vec, tenant=tenant)
 
     def touch(self, vec) -> None:
         """Mark recently used (moves to the MRU end)."""
@@ -361,34 +407,87 @@ class MemoryManager:
         with self._lock:
             self._resident.pop(r, None)
             self._valid.pop(r, None)
+            self._tenant_of.pop(r, None)
 
-    def _spill_lru(self, need_bytes: int, exclude=None) -> int:
+    def _spill_lru(self, need_bytes: int, exclude=None,
+                   tenant: Optional[str] = None, own_only: bool = False,
+                   ignore_tenants: bool = False) -> int:
         """Spill the coldest columns until ``need_bytes`` are freed.
 
         Two-phase: candidates are COLLECTED under the manager lock, but
         each ``v._spill()`` (the device-array drop, which takes the
         Vec's own spill lock and may re-enter manager accounting) runs
         OUTSIDE it — a Vec whose spill/reload path touches the manager
-        can never deadlock against a concurrent sweep."""
+        can never deadlock against a concurrent sweep.
+
+        Tenant isolation (two-pass victim selection, LRU within each):
+
+        1. blocks owned by the requesting ``tenant`` or by nobody
+           (``own_only`` restricts to the tenant's own — the
+           share-reservation path, where unowned spills wouldn't lower
+           the tenant's usage anyway);
+        2. ONLY when global residency is past
+           ``H2O_TPU_TENANT_HIGHWATER × budget`` (survival beats
+           isolation): other tenants' blocks, each successful spill
+           counted as a ``cross_tenant_eviction``.
+
+        ``ignore_tenants`` (the OOM-ladder emergency sweep) restores
+        flat LRU: a RESOURCE_EXHAUSTED dispatch outranks isolation and
+        its spills are not cross-tenant accounting events.
+        """
         with self._lock:
+            total = sum(self._resident.values())
+            tagged = any(t is not None for t in self._tenant_of.values())
+            flat = ignore_tenants or not tagged
+            allow_cross = (not flat and not own_only and self.budget > 0
+                           and total > tenant_highwater() * self.budget)
             cands = []
             planned = 0
-            for r in list(self._resident):      # LRU order
-                if planned >= need_bytes:
-                    break
-                v = r()
-                if v is None or v is exclude:
-                    continue
-                cands.append((r, v, self._resident[r]))
-                planned += self._resident[r]
+            seen = set()
+
+            def _collect(pred, cross: bool) -> None:
+                nonlocal planned
+                for r in list(self._resident):  # LRU order
+                    if planned >= need_bytes:
+                        return
+                    if r in seen:
+                        continue
+                    v = r()
+                    if v is None or v is exclude:
+                        continue
+                    tag = self._tenant_of.get(r)
+                    if not pred(tag):
+                        continue
+                    seen.add(r)
+                    cands.append((r, v, self._resident[r], tag, cross))
+                    planned += self._resident[r]
+
+            if flat:
+                _collect(lambda tag: True, cross=False)
+            else:
+                if own_only:
+                    _collect(lambda tag: tag == tenant, cross=False)
+                else:
+                    _collect(lambda tag: tag == tenant or tag is None,
+                             cross=False)
+                if allow_cross and planned < need_bytes:
+                    _collect(lambda tag: True, cross=True)
         freed = 0
-        for r, v, nb in cands:
+        for r, v, nb, tag, cross in cands:
             if v._spill():                      # drops the device array
                 with self._lock:
                     if self._resident.pop(r, None) is not None:
                         self.spill_count += 1
                         freed += nb
+                        if tag is not None:
+                            self._tenant_spills[tag] = \
+                                self._tenant_spills.get(tag, 0) + 1
+                        if cross:
+                            self.cross_tenant_evictions += 1
+                            if not allow_cross:  # defensive: impossible
+                                self.cross_tenant_below_highwater += 1
                     self._valid.pop(r, None)
+                    self._tenant_of.pop(r, None)
         if freed:
             log.info("spilled %d bytes of cold columns to host "
                      "(budget %d)", freed, self.budget)
@@ -407,13 +506,16 @@ class MemoryManager:
                 self.spill_count += 1
             if r is not None:
                 self._valid.pop(r, None)
+                self._tenant_of.pop(r, None)
         return nb
 
     def sweep(self) -> int:
         """Emergency Cleaner sweep (OOM-ladder rung (a), core/oom.py):
         spill EVERY resident column, returning the bytes freed — the
-        user-mode-swap answer to a RESOURCE_EXHAUSTED dispatch."""
-        return self._spill_lru(1 << 62)
+        user-mode-swap answer to a RESOURCE_EXHAUSTED dispatch.
+        Bypasses tenant isolation: survival outranks fairness, and an
+        emergency sweep is not a cross-tenant accounting event."""
+        return self._spill_lru(1 << 62, ignore_tenants=True)
 
     def note_reload(self) -> None:
         self.reload_count += 1
@@ -548,9 +650,36 @@ class MemoryManager:
                     "prefetch_hits": self.prefetch_hit_count,
                     "prefetch_misses": self.prefetch_miss_count,
                     "demand_page_stalls": self.demand_stall_count,
+                    # tenant isolation surface: per-tenant residency +
+                    # spill attribution, and the cross-tenant counters
+                    # the soak asserts (below-highwater must stay 0)
+                    "cross_tenant_evictions": self.cross_tenant_evictions,
+                    "cross_tenant_below_highwater":
+                        self.cross_tenant_below_highwater,
+                    "highwater_frac": tenant_highwater(),
+                    "tenants": self._tenant_stats_locked(),
                     # who is holding HBM (top allocations) — the OOM
                     # terminal diagnostic names these
                     "largest_holders": sizes[:5]}
+
+    def _tenant_stats_locked(self) -> dict:
+        """Per-tenant residency/spill block (caller holds the lock).
+        Shares are NOT read here — that would nest a DKV get inside the
+        manager lock; the REST layer joins shares from the registry."""
+        per: dict = {}
+        for r, nb in self._resident.items():
+            tag = self._tenant_of.get(r)
+            if tag is None:
+                continue
+            d = per.setdefault(tag, {"resident_bytes": 0,
+                                     "resident_vecs": 0, "spills": 0})
+            d["resident_bytes"] += nb
+            d["resident_vecs"] += 1
+        for tag, n in self._tenant_spills.items():
+            per.setdefault(tag, {"resident_bytes": 0,
+                                 "resident_vecs": 0,
+                                 "spills": 0})["spills"] = n
+        return per
 
     def pressure(self) -> dict:
         """One memory-pressure sample for the serving circuit breaker
@@ -586,7 +715,8 @@ _manager_lock = make_lock("memory._manager_lock")
 
 _COUNTERS = ("spill_count", "reload_count", "pages_in", "pages_out",
              "persist_count", "persist_reloads", "prefetch_hit_count",
-             "prefetch_miss_count", "demand_stall_count", "peak_resident")
+             "prefetch_miss_count", "demand_stall_count", "peak_resident",
+             "cross_tenant_evictions", "cross_tenant_below_highwater")
 
 
 def manager() -> MemoryManager:
@@ -614,6 +744,8 @@ def set_budget(budget_bytes: int,
             new._resident = dict(_manager._resident)
             new._valid = dict(_manager._valid)
             new._host = dict(_manager._host)
+            new._tenant_of = dict(_manager._tenant_of)
+            new._tenant_spills = dict(_manager._tenant_spills)
             if host_budget_bytes is None:
                 new.host_budget = _manager.host_budget
             for k in _COUNTERS:

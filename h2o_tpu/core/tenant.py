@@ -1,0 +1,447 @@
+"""Multi-tenant control plane: tenants, fair-share admission, context.
+
+Reference: H2O-3 runs as a SHARED cluster — many users' parse/munge/
+train jobs land on one leveled ForkJoin pool (water/H2O.java:1470-1560
+FJPS priority bands) and the platform keeps them from destroying each
+other.  The TPU rebuild's two-band scheduler (core/job.py) had bands
+but no fairness: one tenant's 200-model grid could monopolize every
+slot and its working set could evict another tenant's frames through
+the PR 15 tier manager.  This module is the missing control plane:
+
+- :class:`Tenant` — a DKV-backed record (``tenant.<name>`` keys, REST
+  ``POST/GET /3/Tenants``) carrying the tenant's priority ``weight``,
+  ``max_concurrent`` job cap, ``hbm_share`` of the device budget, and
+  a per-tenant admission-queue bound;
+- :class:`FairShareAdmission` — the admission queue in front of the
+  job pools.  Jobs submitted with a ``tenant=`` tag wait in per-tenant
+  bounded queues and are dispatched by WEIGHTED-DEFICIT (stride)
+  scheduling: the tenant with the smallest ``served / weight`` virtual
+  time admits next, so a tenant with weight 2 gets twice the slots of
+  a weight-1 tenant under contention — not FIFO, not starvation.
+  A full queue, an unknown/deleted tenant, or a zero-weight tenant
+  refuses with a CLASSIFIED :class:`AdmissionRejected` (HTTP 429 +
+  ``Retry-After`` at the REST edge, a terminal FAILED with the typed
+  exception on the job);
+- tenant CONTEXT — a thread-local that tags everything a job body
+  allocates (``MemoryManager.register`` reads it, the breaker sheds by
+  it) and marks nested job submissions as part of ONE logical
+  admission: a grid/AutoML job admits once, and the model builds it
+  spawns inside its body bypass the queue (they already hold the
+  slot), so a 200-model grid costs one admission, exactly like the
+  reference's one-job-per-user-action accounting.
+
+Queued-but-undispatched jobs hold NO mesh state, so the membership
+quiesce (``JobRegistry.quiesce``) skips them: they survive a slice-loss
+reform sitting in their queue and admit on the survivor mesh.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+from h2o_tpu.core.lockwitness import make_lock
+from h2o_tpu.core.log import get_logger
+
+log = get_logger("tenant")
+
+#: DKV key prefix for tenant records
+TENANT_PREFIX = "tenant."
+
+
+class AdmissionRejected(RuntimeError):
+    """A classified admission refusal — HTTP 429 + ``Retry-After``.
+
+    ``reason`` is one of the closed set the soak asserts against:
+    ``queue_full`` | ``unknown_tenant`` | ``zero_weight`` |
+    ``tenant_deleted`` | ``injected``.  Deliberately NOT an OOMError or
+    a crash: a refused admission is the fairness control *working*.
+    """
+
+    REASONS = ("queue_full", "unknown_tenant", "zero_weight",
+               "tenant_deleted", "injected")
+
+    def __init__(self, msg: str, reason: str = "queue_full",
+                 tenant: Optional[str] = None,
+                 retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.reason = reason
+        self.tenant = tenant
+        self.retry_after_s = retry_after_s
+
+
+class Tenant:
+    """One tenant's share contract (DKV-backed, ``tenant.<name>``)."""
+
+    def __init__(self, name: str, weight: float = 1.0,
+                 max_concurrent: int = 0, hbm_share: float = 0.0,
+                 max_queue: int = 0):
+        if not name:
+            raise ValueError("tenant name is required")
+        if weight < 0:
+            raise ValueError(f"tenant weight must be >= 0, got {weight}")
+        if not 0.0 <= hbm_share <= 1.0:
+            raise ValueError(f"hbm_share must be in [0, 1], got "
+                             f"{hbm_share}")
+        self.name = str(name)
+        self.weight = float(weight)
+        self.max_concurrent = int(max_concurrent)   # 0 = unbounded
+        self.hbm_share = float(hbm_share)           # 0 = no reservation
+        self.max_queue = int(max_queue)             # 0 = config default
+        self.created = time.time()
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "weight": self.weight,
+                "max_concurrent": self.max_concurrent,
+                "hbm_share": self.hbm_share,
+                "max_queue": self.max_queue,
+                "created": self.created}
+
+
+# -- registry (DKV-backed) ---------------------------------------------------
+
+def _dkv_or_none():
+    """The booted cloud's DKV, or None — registry READS must never boot
+    a cloud as a side effect (MemoryManager.register consults the
+    tenant share on every Vec registration)."""
+    from h2o_tpu.core.cloud import Cloud
+    inst = Cloud._instance
+    return None if inst is None else inst.dkv
+
+
+def create_tenant(name: str, weight: float = 1.0,
+                  max_concurrent: int = 0, hbm_share: float = 0.0,
+                  max_queue: int = 0) -> Tenant:
+    """Create or update a tenant record (idempotent upsert — quota
+    changes mid-flight apply at the next admission/enforcement pass)."""
+    from h2o_tpu.core.cloud import cloud
+    t = Tenant(name, weight, max_concurrent, hbm_share, max_queue)
+    cloud().dkv.put(TENANT_PREFIX + t.name, t)
+    log.info("tenant %s: weight=%g max_concurrent=%d hbm_share=%g",
+             t.name, t.weight, t.max_concurrent, t.hbm_share)
+    return t
+
+
+def get_tenant(name: Optional[str]) -> Optional[Tenant]:
+    if not name:
+        return None
+    dkv = _dkv_or_none()
+    if dkv is None:
+        return None
+    return dkv.get(TENANT_PREFIX + str(name))
+
+
+def list_tenants() -> List[Tenant]:
+    dkv = _dkv_or_none()
+    if dkv is None:
+        return []
+    out = [dkv.get(k) for k in dkv.keys(TENANT_PREFIX + "*")]
+    return sorted((t for t in out if isinstance(t, Tenant)),
+                  key=lambda t: t.name)
+
+
+def has_tenants() -> bool:
+    dkv = _dkv_or_none()
+    return bool(dkv is not None and dkv.keys(TENANT_PREFIX + "*"))
+
+
+def delete_tenant(name: str) -> int:
+    """Delete a tenant.  Jobs still QUEUED under it fail with a
+    classified ``tenant_deleted`` rejection (they can never admit);
+    jobs already RUNNING keep their slot and finish normally.  Returns
+    the number of queued jobs dropped (-1 if the tenant didn't exist)."""
+    dkv = _dkv_or_none()
+    if dkv is None or TENANT_PREFIX + name not in dkv:
+        return -1
+    dkv.remove(TENANT_PREFIX + name)
+    from h2o_tpu.core.cloud import Cloud
+    inst = Cloud._instance
+    dropped = 0
+    if inst is not None:
+        dropped = inst.jobs.admission.drop_tenant(
+            name, reason="tenant_deleted",
+            msg=f"tenant {name} was deleted with this job still queued")
+    return dropped
+
+
+# -- tenant context (thread-local) -------------------------------------------
+
+class _Ctx(threading.local):
+    tenant: Optional[str] = None
+    admitted: bool = False
+
+
+_ctx = _Ctx()
+
+
+def current_tenant() -> Optional[str]:
+    """The tenant the CURRENT thread is working for (set by a
+    ``tenant_context`` caller or by a job body's dispatch)."""
+    return _ctx.tenant
+
+
+def in_admitted_job() -> bool:
+    """True inside a job body that already holds an admission slot —
+    nested submissions (grid members, AutoML builds, stream refreshes)
+    ride the parent's admission instead of queueing again."""
+    return _ctx.admitted
+
+
+class tenant_context:
+    """``with tenant_context("acme"): ...`` — tags jobs created and
+    memory registered on this thread with the tenant."""
+
+    def __init__(self, name: Optional[str]):
+        self.name = name
+
+    def __enter__(self):
+        self._prev = _ctx.tenant
+        _ctx.tenant = self.name
+        return self
+
+    def __exit__(self, *exc):
+        _ctx.tenant = self._prev
+        return None
+
+
+def _enter_job(tenant: Optional[str]) -> Tuple[Optional[str], bool]:
+    """Job-body dispatch hook (core/job.py run()): pool worker threads
+    are REUSED, so the body must establish its own context — and clear
+    a predecessor's — unconditionally.  Returns the token for
+    :func:`_exit_job`."""
+    token = (_ctx.tenant, _ctx.admitted)
+    _ctx.tenant = tenant
+    _ctx.admitted = bool(tenant)
+    return token
+
+
+def _exit_job(token: Tuple[Optional[str], bool]) -> None:
+    _ctx.tenant, _ctx.admitted = token
+
+
+# -- fair-share admission ----------------------------------------------------
+
+class FairShareAdmission:
+    """Weighted-deficit (stride) admission queue in front of the user
+    job pool.
+
+    Jobs enter bounded per-tenant queues and dispatch in order of the
+    smallest ``served / weight`` virtual time among tenants with
+    queued work (respecting each tenant's ``max_concurrent``), onto at
+    most ``slots`` concurrent admissions — ``H2O_TPU_TENANT_SLOTS``,
+    defaulting to the user pool's worker count.  Every refusal is a
+    classified :class:`AdmissionRejected`; the refused job is marked
+    FAILED carrying the typed exception so ``/3/Jobs`` shows the 429
+    verdict.  GL404-style lock discipline: ``_admission_lock`` guards
+    only the queue/counter state — job state transitions and pool
+    submissions run OUTSIDE it.
+    """
+
+    def __init__(self, registry):
+        self._registry = registry
+        self._admission_lock = make_lock(
+            "tenant.FairShareAdmission._admission_lock")
+        self._queues: Dict[str, Deque[Tuple[Any, Callable]]] = {}
+        self._served: Dict[str, float] = {}
+        self._running: Dict[str, int] = {}
+        self._inflight = 0
+        self.admitted_total = 0
+        self.rejected_total = 0
+        self.rejects_by_reason: Dict[str, int] = {}
+        self.queued_peak = 0
+
+    # -- capacity ------------------------------------------------------------
+
+    def _slots(self) -> int:
+        from h2o_tpu.config import tenant_slots
+        n = tenant_slots()
+        return n if n > 0 else self._registry._pool._max_workers
+
+    # -- submit / reject -----------------------------------------------------
+
+    def submit(self, job, runner: Callable[[], Any]) -> None:
+        """Queue ``job`` under its tenant tag (or reject, classified)."""
+        from h2o_tpu.config import tenant_queue_bound
+        from h2o_tpu.core.chaos import chaos
+        name = job.tenant
+        c = chaos()
+        if c.enabled and c.maybe_reject_admission(name or "?"):
+            self._reject(job, "injected",
+                         f"admission rejected by chaos injection "
+                         f"(tenant {name})")
+        t = get_tenant(name)
+        if t is None:
+            self._reject(job, "unknown_tenant",
+                         f"job tagged with unknown tenant {name!r}; "
+                         f"create it via POST /3/Tenants first")
+        if t.weight <= 0:
+            self._reject(job, "zero_weight",
+                         f"tenant {name} has weight 0 and can never "
+                         f"be scheduled under contention")
+        cap = t.max_queue or tenant_queue_bound()
+        with self._admission_lock:
+            q = self._queues.setdefault(name, deque())
+            if 0 < cap <= len(q):
+                full = len(q)
+            else:
+                full = 0
+                job._admission_queued = True
+                q.append((job, runner))
+                depth = sum(len(qq) for qq in self._queues.values())
+                self.queued_peak = max(self.queued_peak, depth)
+        if full:
+            self._reject(job, "queue_full",
+                         f"tenant {name} admission queue is full "
+                         f"({full}/{cap}); retry after running jobs "
+                         f"drain")
+        self._pump()
+
+    def _reject(self, job, reason: str, msg: str) -> None:
+        """Mark the job FAILED with the classified refusal and raise it
+        to the submitter (the 429 path, not a crash path)."""
+        with self._admission_lock:
+            self.rejected_total += 1
+            self.rejects_by_reason[reason] = \
+                self.rejects_by_reason.get(reason, 0) + 1
+        exc = AdmissionRejected(msg, reason=reason, tenant=job.tenant)
+        self._fail_queued(job, exc)
+        raise exc
+
+    @staticmethod
+    def _fail_queued(job, exc: AdmissionRejected) -> None:
+        from h2o_tpu.core import job as jobmod
+        with job._state_lock:
+            if job.status in jobmod.TERMINAL:
+                return
+            job._admission_queued = False
+            job.exception = exc
+            job.status = jobmod.FAILED
+            job.end_time = time.time()
+            job._done.set()
+
+    # -- dispatch (the stride scheduler) -------------------------------------
+
+    def _pump(self) -> None:
+        """Dispatch queued jobs while slots are free, smallest
+        ``served/weight`` first.  Tenants deleted or zeroed while jobs
+        sat queued drain as classified rejections."""
+        to_run: List[Tuple[Any, Callable]] = []
+        to_drop: List[Tuple[Any, str, str]] = []
+        with self._admission_lock:
+            while self._inflight < self._slots():
+                pick = None
+                best = 0.0
+                for name in list(self._queues):
+                    q = self._queues[name]
+                    if not q:
+                        continue
+                    t = get_tenant(name)
+                    if t is None or t.weight <= 0:
+                        reason = ("tenant_deleted" if t is None
+                                  else "zero_weight")
+                        while q:
+                            j, _ = q.popleft()
+                            to_drop.append((j, reason, name))
+                        continue
+                    if t.max_concurrent and \
+                            self._running.get(name, 0) >= t.max_concurrent:
+                        continue
+                    passes = self._served.get(name, 0.0) / t.weight
+                    if pick is None or passes < best:
+                        pick, best = name, passes
+                if pick is None:
+                    break
+                job, runner = self._queues[pick].popleft()
+                self._served[pick] = self._served.get(pick, 0.0) + 1.0
+                self._running[pick] = self._running.get(pick, 0) + 1
+                self._inflight += 1
+                self.admitted_total += 1
+                job._admission_queued = False
+                job._admission_slot = True
+                to_run.append((job, runner))
+            for _j, reason, _n in to_drop:
+                self.rejected_total += 1
+                self.rejects_by_reason[reason] = \
+                    self.rejects_by_reason.get(reason, 0) + 1
+        for j, reason, name in to_drop:
+            self._fail_queued(j, AdmissionRejected(
+                f"tenant {name} was {'deleted' if reason == 'tenant_deleted' else 'zero-weighted'} "
+                f"with this job still queued", reason=reason, tenant=name))
+        for job, runner in to_run:
+            log.info("admission: dispatching %s for tenant %s",
+                     job.key, job.tenant)
+            self._registry._dispatch(job, runner)
+
+    def release(self, job) -> None:
+        """A dispatched admission finished — free its slot and pump."""
+        with self._admission_lock:
+            if not getattr(job, "_admission_slot", False):
+                return
+            job._admission_slot = False
+            self._inflight = max(0, self._inflight - 1)
+            n = self._running.get(job.tenant, 0)
+            self._running[job.tenant] = max(0, n - 1)
+        self._pump()
+
+    def drop_tenant(self, name: str, reason: str = "tenant_deleted",
+                    msg: str = "") -> int:
+        """Fail every QUEUED job of ``name`` with a classified
+        rejection (delete-tenant path); running jobs are untouched."""
+        with self._admission_lock:
+            q = self._queues.pop(name, None)
+            victims = [j for j, _ in q] if q else []
+            for _ in victims:
+                self.rejected_total += 1
+                self.rejects_by_reason[reason] = \
+                    self.rejects_by_reason.get(reason, 0) + 1
+        for j in victims:
+            self._fail_queued(j, AdmissionRejected(
+                msg or f"tenant {name} removed with job queued",
+                reason=reason, tenant=name))
+        if victims:
+            self._pump()
+        return len(victims)
+
+    # -- introspection -------------------------------------------------------
+
+    def queued(self, name: Optional[str] = None) -> int:
+        with self._admission_lock:
+            if name is not None:
+                return len(self._queues.get(name, ()))
+            return sum(len(q) for q in self._queues.values())
+
+    def stats(self) -> Dict[str, Any]:
+        """The ``admission`` block of ``GET /3/Resilience``."""
+        with self._admission_lock:
+            tenants = {}
+            for name in set(self._queues) | set(self._running) | \
+                    set(self._served):
+                tenants[name] = {
+                    "queued": len(self._queues.get(name, ())),
+                    "running": self._running.get(name, 0),
+                    "served": self._served.get(name, 0.0),
+                }
+            return {"slots": self._slots(),
+                    "inflight": self._inflight,
+                    "admitted": self.admitted_total,
+                    "rejected": self.rejected_total,
+                    "rejects_by_reason": dict(self.rejects_by_reason),
+                    "queued_peak": self.queued_peak,
+                    "tenants": tenants}
+
+
+def needs_admission(job) -> bool:
+    """Whether this job must pass the fair-share queue: tenant-tagged
+    USER work, from a thread that does not already hold an admission
+    slot, on a cluster where tenants actually exist (a tag with no
+    tenant registry anywhere stays inert — zero behavior change for
+    single-tenant deployments)."""
+    from h2o_tpu.core.job import Job
+    tenant = getattr(job, "tenant", None)
+    if not tenant or job.priority >= Job.SYSTEM_PRIORITY:
+        return False
+    if in_admitted_job():
+        return False
+    return has_tenants()

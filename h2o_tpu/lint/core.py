@@ -266,7 +266,8 @@ def _load_passes() -> None:
     effect)."""
     from h2o_tpu.lint import (audit, rules_donation,  # noqa: F401
                               rules_legacy, rules_locks, rules_pack,
-                              rules_persist, rules_purity, rules_shard)
+                              rules_persist, rules_purity, rules_shard,
+                              rules_tenant)
 
 
 _last_summary: Optional[dict] = None

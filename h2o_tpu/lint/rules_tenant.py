@@ -1,0 +1,96 @@
+"""GL640 — tenant-quota bypass: direct MemoryManager budget mutation
+or eviction outside the quota layer.
+
+PR 20 partitions HBM by tenant share: ``register()`` spills the
+registering tenant's OWN cold blocks first and only crosses tenant
+lines past the global high-water mark, counting every crossing
+(``cross_tenant_evictions`` — the isolation soak's invariant).  That
+accounting only holds if eviction and budget changes flow THROUGH the
+manager's quota-aware entry points from the sanctioned layers:
+
+- ``core/memory.py`` — the manager itself;
+- ``core/oom.py`` — the degradation ladder's emergency sweep (the one
+  caller allowed to ignore tenant lines, explicitly);
+- ``core/cloud.py`` — boot-time budget wiring;
+- ``core/tenant.py`` — the quota layer.
+
+Anywhere else, calling ``sweep()``/``persist_sweep()`` (or worse, the
+private ``_spill_lru``/``_persist_lru``) on a manager, calling
+``set_budget()``, or assigning ``.budget``/``.host_budget`` silently
+evicts blocks the per-tenant ledger still counts as resident — tenant
+A's "isolation" then depends on which module got there first.
+``demote()`` stays legal everywhere: demoting YOUR OWN vec is the
+cooperative-citizen API, not an eviction of someone else's.
+
+The receiver heuristic is deliberately narrow (a ``manager()`` call or
+a manager-ish local name) so unrelated objects with a ``sweep`` method
+don't trip it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from h2o_tpu.lint.core import Finding, ModuleInfo, rule
+
+_SANCTIONED = {"core/memory.py", "core/oom.py", "core/cloud.py",
+               "core/tenant.py"}
+_EVICT = {"sweep", "persist_sweep", "_spill_lru", "_persist_lru",
+          "set_budget"}
+_RECV_NAMES = {"manager", "mm", "mgr", "_mgr", "mem", "memory"}
+_BUDGET_ATTRS = {"budget", "host_budget"}
+
+
+def _manager_ish(node) -> bool:
+    """True for ``manager()`` / ``manager`` / a manager-ish local."""
+    if isinstance(node, ast.Call):
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None)
+        return name == "manager"
+    if isinstance(node, ast.Name):
+        return node.id in _RECV_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in _RECV_NAMES
+    return False
+
+
+@rule("GL640", "tenant-quota-bypass")
+def check(mi: ModuleInfo, ctx):
+    if mi.rel in _SANCTIONED:
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(mi.tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _EVICT and \
+                _manager_ish(node.func.value):
+            out.append(Finding(
+                "GL640", "error", mi.rel, node.lineno, mi.scope_of(node),
+                f"direct MemoryManager.{node.func.attr}() outside the "
+                f"quota layer — evicts blocks the per-tenant ledger "
+                f"still counts resident, so tenant isolation (the "
+                f"cross_tenant_evictions invariant) silently breaks; "
+                f"route through core/oom.py's ladder or demote() your "
+                f"own vecs",
+                detail=f"quota-bypass:{node.func.attr}:"
+                       f"{mi.scope_of(node)}"))
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        t.attr in _BUDGET_ATTRS and \
+                        _manager_ish(t.value):
+                    out.append(Finding(
+                        "GL640", "error", mi.rel, node.lineno,
+                        mi.scope_of(node),
+                        f"direct assignment to MemoryManager."
+                        f"{t.attr} outside the quota layer — budget "
+                        f"changes must go through set_budget() in a "
+                        f"sanctioned module so per-tenant shares "
+                        f"re-partition atomically",
+                        detail=f"quota-bypass:{t.attr}:"
+                               f"{mi.scope_of(node)}"))
+    return out

@@ -73,6 +73,7 @@ OPEN = "open"
 HALF_OPEN = "half_open"
 
 _EVENT_RING = 64
+_TENANT_WINDOW = 256
 
 
 class ShedLoad(RuntimeError):
@@ -166,6 +167,16 @@ class LoadBreaker:
         self._probe_fail = False
         self._probe_ok = 0
         self._events: List[Dict[str, Any]] = []
+        # per-tenant fairness (multi-tenant clusters): a rolling window
+        # of who the admitted traffic belongs to.  In SHEDDING, a tenant
+        # whose observed traffic share exceeds 1.5x its fair weight
+        # share is shed FIRST — the noisy tenant pays for the pressure
+        # it creates, quiet tenants keep flowing.  Counts halve when the
+        # window total reaches _TENANT_WINDOW so the signal tracks
+        # recent traffic, not all-time.
+        self._tenant_seen: Dict[str, int] = {}
+        self._seen_total = 0
+        self._tenant_sheds: Dict[str, int] = {}
 
     # -- telemetry ----------------------------------------------------------
 
@@ -291,14 +302,45 @@ class LoadBreaker:
 
     # -- admission ----------------------------------------------------------
 
+    def _note_tenant_locked(self, tenant: str) -> None:
+        """Record one observed request for ``tenant`` (lock HELD)."""
+        self._tenant_seen[tenant] = self._tenant_seen.get(tenant, 0) + 1
+        self._seen_total += 1
+        if self._seen_total >= _TENANT_WINDOW:
+            for k in list(self._tenant_seen):
+                self._tenant_seen[k] //= 2
+            self._seen_total = sum(self._tenant_seen.values())
+
+    @staticmethod
+    def _weight_share(tenant: str) -> float:
+        """Fair traffic share for ``tenant`` (weight over total weight).
+        Reads the tenant registry (DKV) — callers hold NO breaker lock
+        (GL404).  1.0 when no tenants are registered (single-tenant
+        clusters never look noisy)."""
+        try:
+            from h2o_tpu.core.tenant import get_tenant, list_tenants
+            ts = list_tenants()
+            if not ts:
+                return 1.0
+            total = sum(max(0.0, t.weight) for t in ts) or 1.0
+            t = get_tenant(tenant)
+            return (max(0.0, t.weight) / total) if t else 0.0
+        except Exception:
+            return 1.0
+
     def admit(self, queue_depth: int, queue_cap: int,
-              p99_ms: float = 0.0) -> None:
+              p99_ms: float = 0.0,
+              tenant: Optional[str] = None) -> None:
         """Admission check for one request: returns normally or raises
-        :class:`ShedLoad` (429) / :class:`BreakerOpen` (503)."""
+        :class:`ShedLoad` (429) / :class:`BreakerOpen` (503).  When
+        ``tenant`` is given, SHEDDING sheds a tenant running past 1.5x
+        its fair weight share before touching anyone else."""
         self._evaluate(queue_depth, queue_cap, p99_ms)
         with self._breaker_lock:
             state = self.state
             score = self.score
+            if tenant is not None:
+                self._note_tenant_locked(tenant)
         if state == CLOSED:
             return
         if state == OPEN:
@@ -322,8 +364,28 @@ class LoadBreaker:
                 f"serving breaker for {self.name} is half-open and its "
                 f"probe window is full; retry shortly",
                 retry_after_s=1.0)
-        # SHEDDING: refuse a deterministic fraction proportional to how
-        # far past soft the score sits (at least 1-in-10, at most 9-in-10)
+        # SHEDDING: a tenant whose observed traffic share runs past
+        # 1.5x its fair weight share is shed outright — it is the one
+        # creating the pressure.  Share lookup hits the DKV, so it runs
+        # OUTSIDE the breaker lock (GL404).
+        if tenant is not None:
+            share = self._weight_share(tenant)
+            with self._breaker_lock:
+                seen = self._tenant_seen.get(tenant, 0)
+                tot = self._seen_total
+            if tot >= 16 and seen / tot > 1.5 * max(share, 1e-9):
+                with self._breaker_lock:
+                    self.sheds += 1
+                    self._tenant_sheds[tenant] = \
+                        self._tenant_sheds.get(tenant, 0) + 1
+                _bump("breaker_sheds")
+                raise ShedLoad(
+                    f"serving breaker for {self.name} is shedding "
+                    f"tenant {tenant} (observed share {seen / tot:.2f} "
+                    f"> 1.5x fair share {share:.2f} under pressure "
+                    f"{score:.2f})", retry_after_s=0.5)
+        # everyone else: refuse a deterministic fraction proportional to
+        # how far past soft the score sits (1-in-10 up to 9-in-10)
         frac = (score - self.soft) / max(1e-9, self.hard - self.soft)
         shed_in_10 = min(9, max(1, int(round(frac * 10))))
         with self._breaker_lock:
@@ -331,6 +393,9 @@ class LoadBreaker:
             shed = (self._admitted % 10) < shed_in_10
             if shed:
                 self.sheds += 1
+                if tenant is not None:
+                    self._tenant_sheds[tenant] = \
+                        self._tenant_sheds.get(tenant, 0) + 1
         if shed:
             _bump("breaker_sheds")
             raise ShedLoad(
@@ -370,6 +435,7 @@ class LoadBreaker:
                                 for k, v in self.signals.items()},
                     "trips": self.trips,
                     "sheds": self.sheds,
+                    "tenant_sheds": dict(self._tenant_sheds),
                     "soft": self.soft, "hard": self.hard,
                     "exit": self.exit,
                     "open_secs": self.open_secs,

@@ -318,7 +318,8 @@ class ServingRegistry:
     # -- scoring -------------------------------------------------------------
 
     def score_rows(self, name: str, rows: Sequence[dict],
-                   deadline_ms: Optional[float] = None):
+                   deadline_ms: Optional[float] = None,
+                   tenant: Optional[str] = None):
         """Encode+score ``rows`` through the deployment's micro-batcher.
 
         Raises ``KeyError`` (unknown/draining alias), :class:`QueueFull`
@@ -347,7 +348,8 @@ class ServingRegistry:
             p99 = (st.p99_ms() if dep.breaker.p99_slo_ms > 0 else 0.0)
             try:
                 dep.breaker.admit(dep.batcher.pending,
-                                  dep.batcher.queue_cap, p99)
+                                  dep.batcher.queue_cap, p99,
+                                  tenant=tenant)
             except (ShedLoad, BreakerOpen):
                 with st.lock:
                     st.rejected += 1

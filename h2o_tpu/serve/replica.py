@@ -311,14 +311,16 @@ class ReplicaFleet:
     # -- scoring -------------------------------------------------------------
 
     def score_rows(self, name: str, rows: Sequence[dict],
-                   deadline_ms: Optional[float] = None):
+                   deadline_ms: Optional[float] = None,
+                   tenant: Optional[str] = None):
         """Route one request to a healthy replica.  A replica that
         turns out to be dead (killed mid-flight) is health-gated out
         and the request retries ONCE on another replica; every other
         error propagates with its own protocol (429/503/408/404)."""
         rep = self._pick()
         try:
-            out = rep.registry.score_rows(name, rows, deadline_ms)
+            out = rep.registry.score_rows(name, rows, deadline_ms,
+                                          tenant=tenant)
             rep.served += 1
             return out
         except KeyError as e:
@@ -336,7 +338,8 @@ class ReplicaFleet:
             rep2 = self._pick(exclude=rep)
             TimeLine.record("serve", "replica_retry", deployment=name,
                             from_replica=rep.rid, to_replica=rep2.rid)
-            out = rep2.registry.score_rows(name, rows, deadline_ms)
+            out = rep2.registry.score_rows(name, rows, deadline_ms,
+                                           tenant=tenant)
             rep2.served += 1
             return out
 

@@ -24,11 +24,26 @@ injectors live (``H2O_TPU_CHAOS_STREAM_TRUNCATE[_TRANSIENT]`` raises a
 retryable truncation, ``H2O_TPU_CHAOS_STREAM_SLOW[_MS]`` stalls the
 read), so a flaky tail -f-style source degrades to retries instead of
 killing the pipeline.
+
+FOLLOW MODE (unbounded sources): ``ChunkReader(follow=True)`` treats an
+empty read as "no new data YET", re-polling the growing source every
+``H2O_TPU_STREAM_POLL_MS`` instead of terminating — the actual tail -f.
+``stop()`` ends the follow: the reader drains what is buffered and then
+reports exhaustion.  The reader tracks its exact BYTE CURSOR
+(``offset`` = bytes of the source fully consumed into emitted chunks;
+the carry tail is not yet consumed), and ``restore_cursor(offset)``
+re-attaches a new reader at that cursor — the durable-resume primitive
+the stream pipeline persists through the recovery layer, giving
+no-duplicate/no-drop chunk replay after a crash.  ``emit_partial``
+(default True) emits buffered complete records when the source goes
+quiet — tail-f liveness; bitwise-replay harnesses set it False so
+chunk boundaries depend only on byte content, never on poll timing.
 """
 
 from __future__ import annotations
 
 import os
+import threading
 from typing import Dict, Iterator, Optional
 
 import numpy as np
@@ -80,19 +95,37 @@ class ChunkReader:
     of byte blocks (the test harness's split-sweep source).  ``setup``
     defaults to ``parse_setup`` inference on the source's head sample.
     ``deadline_secs`` bounds the TOTAL ingest wall clock (0 = unbounded).
+
+    ``follow=True`` re-polls a source that returned no bytes (see the
+    module docstring) every ``poll_ms`` until :meth:`stop`;
+    ``emit_partial=False`` suppresses timing-dependent partial-chunk
+    emission for bitwise replays.
     """
 
     def __init__(self, source, setup: Optional[ParseSetupResult] = None,
                  chunk_rows: Optional[int] = None,
                  chunk_bytes: Optional[int] = None,
                  use_native: bool = True,
-                 deadline_secs: float = 0.0):
+                 deadline_secs: float = 0.0,
+                 follow: bool = False,
+                 poll_ms: Optional[float] = None,
+                 emit_partial: bool = True):
+        from h2o_tpu.config import stream_poll_ms
         self.use_native = use_native
+        self.follow = bool(follow)
+        self.emit_partial = bool(emit_partial)
+        self._poll_s = (poll_ms if poll_ms is not None
+                        else stream_poll_ms()) / 1000.0
+        self._stop = threading.Event()
         self._carry = b""
         self._eof = False
         self._first = True
         self.chunks_read = 0
         self.rows_read = 0
+        # byte cursor: _read_pos counts every byte pulled off the
+        # source; offset (== _read_pos - len(_carry)) is the resume
+        # point — everything before it has been emitted in a chunk
+        self._read_pos = 0
         self.deadline = Deadline(deadline_secs)
         self._iter: Optional[Iterator[bytes]] = None
         self._fobj = None
@@ -169,14 +202,62 @@ class ChunkReader:
             attempt, what=f"stream read {self.name}",
             deadline=self.deadline if self.deadline.seconds else None)
         if not data:
-            self._eof = True
+            # follow mode: an empty read means "no new data YET", not
+            # end-of-stream — unless the follow was stopped, which
+            # turns the next empty read into the drain signal
+            if not self.follow or self._stop.is_set():
+                self._eof = True
+        else:
+            self._read_pos += len(data)
         return data or b""
+
+    # -- follow-mode cursor API ----------------------------------------------
+
+    @property
+    def offset(self) -> int:
+        """Byte offset of the first UNEMITTED record — the durable
+        resume cursor (everything before it landed in a chunk)."""
+        return self._read_pos - len(self._carry)
+
+    @property
+    def exhausted(self) -> bool:
+        """True once the source ended (or the follow was stopped) AND
+        the buffered tail has drained."""
+        return self._eof and not self._carry
+
+    def stop(self) -> None:
+        """End a follow: the next empty read becomes end-of-stream, the
+        buffered records drain, and any poll sleep wakes immediately."""
+        self._stop.set()
+
+    def restore_cursor(self, offset: int, chunks_read: int = 0,
+                       rows_read: int = 0) -> None:
+        """Re-attach at a persisted byte cursor (seekable sources only):
+        drop the buffered carry, seek, and restore the counters — the
+        recovery half of the durable-cursor contract.  A mid-file
+        cursor implies the header row was already consumed."""
+        if self._fobj is None or not hasattr(self._fobj, "seek"):
+            raise ValueError(
+                f"cursor restore requires a seekable source ({self.name})")
+        self._fobj.seek(int(offset))
+        self._carry = b""
+        self._read_pos = int(offset)
+        self._eof = False
+        self._first = offset == 0
+        self.chunks_read = int(chunks_read)
+        self.rows_read = int(rows_read)
 
     # -- chunk iteration -----------------------------------------------------
 
-    def next_chunk(self) -> Optional[Dict[str, object]]:
+    def next_chunk(self, wait: bool = True) -> Optional[Dict[str, object]]:
         """The next chunk of COMPLETE records as host column payloads
-        (``Frame.append_rows`` shape), or None at end of stream."""
+        (``Frame.append_rows`` shape), or None at end of stream.
+
+        Follow mode: with ``wait=True`` (default) a quiet source blocks,
+        re-polling until data arrives or :meth:`stop`; ``wait=False``
+        returns None immediately when nothing is buffered (check
+        :attr:`exhausted` to distinguish "idle" from "ended" — the
+        multi-source pipeline round-robins this way)."""
         self.deadline.check(f"stream ingest {self.name}")
         records = b""
         while True:
@@ -198,7 +279,23 @@ class ChunkReader:
                 # torn tail: the final record may lack its newline
                 records, self._carry = self._carry, b""
                 break
-            self._carry += self._read_block(self.chunk_bytes)
+            block = self._read_block(self.chunk_bytes)
+            if block:
+                self._carry += block
+                continue
+            if self._eof:
+                continue                 # drain what is buffered
+            # follow mode, source quiet: emit buffered complete records
+            # (tail-f liveness) unless the replay harness opted out
+            if self.emit_partial and self._carry:
+                end = last_record_end(self._carry)
+                if end > 0:
+                    records = self._carry[:end]
+                    self._carry = self._carry[end:]
+                    break
+            if not wait:
+                return None
+            self._stop.wait(self._poll_s)
         if not records.strip():
             return None
         header = self._first and self.setup.header
@@ -220,6 +317,7 @@ class ChunkReader:
             yield c
 
     def close(self) -> None:
+        self._stop.set()
         if self._fobj is not None:
             try:
                 self._fobj.close()

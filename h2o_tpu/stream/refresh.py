@@ -33,14 +33,36 @@ Lag accounting: ``lag = chunks_landed - chunks_trained`` is reported at
 ``GET /3/Stream``; ``H2O_TPU_STREAM_LAG_BOUND`` (0 = unbounded) flags
 the pipeline ``lagging`` and attaches a job warning when exceeded
 (e.g. when refreshes keep failing while ingest continues).
+
+MULTI-SOURCE + UNBOUNDED (PR 20): a pipeline may take a LIST of
+readers (e.g. several follow-mode tails); the loop round-robins
+``next_chunk(wait=False)`` across the non-exhausted sources with
+per-source chunk/row/lag accounting in ``status()["sources"]``.  With a
+``recovery_dir`` set, the pipeline persists a DURABLE CURSOR (atomic
+tmp+rename JSON: per-source byte offsets + train-state counters +
+model/frame keys) after every landed chunk and every refresh, so a
+pipeline killed mid-soak resumes (``resume=True``) at the exact byte
+offset with no duplicated or dropped chunks — combined with the tree
+checkpoint-resume path the resumed model is bitwise-identical to an
+uninterrupted replay.
+
+VALIDATION HOLDOUT (PR 7 follow-up): ``holdout_frac`` (default
+``H2O_TPU_STREAM_HOLDOUT``) carves a DETERMINISTIC per-chunk row
+fraction (seeded from the pipeline id + chunk index — replays carve
+the same rows) into a side holdout frame the swap gate's default
+validator scores each refresh on: metric-on-UNSEEN-rows, not training
+rows.  The rollback contract is unchanged — a refresh that fails
+validation is simply not deployed.
 """
 
 from __future__ import annotations
 
+import json
 import math
 import os
 import threading
 import time
+import zlib
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -86,7 +108,7 @@ class StreamPipeline:
     tracked as a core/job.py job (cancellable, watchdogged, observable
     at GET /3/Stream)."""
 
-    def __init__(self, pipeline_id: str, reader: ChunkReader, y: str,
+    def __init__(self, pipeline_id: str, reader, y: str,
                  x: Optional[List[str]] = None, algo: str = "gbm",
                  model_params: Optional[Dict[str, Any]] = None,
                  refresh_chunks: Optional[int] = None,
@@ -97,9 +119,19 @@ class StreamPipeline:
                  lag_bound: Optional[int] = None,
                  validate_fn: Optional[Callable[[Any], bool]] = None,
                  serve_config=None,
-                 max_chunks: Optional[int] = None):
+                 max_chunks: Optional[int] = None,
+                 holdout_frac: Optional[float] = None,
+                 resume: bool = False):
+        from h2o_tpu.config import stream_holdout
         self.id = pipeline_id
-        self.reader = reader
+        # one reader or a list of sources (round-robined); self.reader
+        # stays the first for single-source back-compat
+        self.readers: List[ChunkReader] = (
+            list(reader) if isinstance(reader, (list, tuple))
+            else [reader])
+        if not self.readers:
+            raise ValueError("stream pipeline needs at least one source")
+        self.reader = self.readers[0]
         self.y = y
         self.x = x
         self.algo = algo.lower()
@@ -112,14 +144,21 @@ class StreamPipeline:
         self.recovery_dir = recovery_dir
         self.lag_bound = stream_lag_bound() if lag_bound is None \
             else int(lag_bound)
-        self.validate_fn = validate_fn or _default_validate
+        self.holdout_frac = (stream_holdout() if holdout_frac is None
+                             else min(0.9, max(0.0, float(holdout_frac))))
+        self.validate_fn = validate_fn or (
+            self._validate_on_holdout if self.holdout_frac > 0
+            else _default_validate)
         self.serve_config = serve_config
         self.max_chunks = max_chunks
+        self._resume = bool(resume)
 
         self.frame = None
+        self.holdout_frame = None
         self.model = None
         self.chunks_landed = 0
         self.rows_landed = 0
+        self.rows_held_out = 0
         self.chunks_trained = 0
         self.refreshes = 0
         self.failed_refreshes = 0
@@ -129,6 +168,12 @@ class StreamPipeline:
         self.swap_ms: List[float] = []
         self.lagging = False
         self.job: Optional[Job] = None
+        # per-source accounting (parallel to self.readers): chunks/rows
+        # landed from each source, and the landed mark at the last
+        # successful refresh (per-source lag = landed - trained mark)
+        self._source_landed = [0] * len(self.readers)
+        self._source_rows = [0] * len(self.readers)
+        self._source_trained = [0] * len(self.readers)
         self._lock = make_lock("refresh.StreamPipeline._lock")
 
     # -- lifecycle -----------------------------------------------------------
@@ -143,22 +188,55 @@ class StreamPipeline:
         return job
 
     def stop(self) -> None:
+        """Abort: cancel the job (the body exits at its next heartbeat)
+        and wake any follow-source poll."""
+        for r in self.readers:
+            r.stop()
         if self.job is not None:
             self.job.cancel()
+
+    def finish(self) -> None:
+        """GRACEFUL end of an unbounded pipeline: stop the follow
+        sources (they drain their buffers and report exhaustion) so the
+        loop runs its final refresh and the job completes DONE — the
+        tail -f analog of closing the file."""
+        for r in self.readers:
+            r.stop()
 
     # -- the loop ------------------------------------------------------------
 
     def _run(self, job: Job):
         try:
-            for cols in self.reader:
-                self._land(job, cols)
+            if self._resume:
+                self._restore(job)
+            while True:
+                progressed = False
+                for i, r in enumerate(self.readers):
+                    if r.exhausted:
+                        continue
+                    cols = r.next_chunk(wait=False)
+                    if cols is None:
+                        continue
+                    progressed = True
+                    self._land(job, cols, source=i)
+                    if self.max_chunks and self.chunks_landed >= \
+                            self.max_chunks:
+                        break
+                    if self.chunks_landed - self.chunks_trained >= \
+                            self.refresh_chunks:
+                        self._refresh(job)
+                    self._check_lag(job)
                 if self.max_chunks and self.chunks_landed >= \
                         self.max_chunks:
                     break
-                if self.chunks_landed - self.chunks_trained >= \
-                        self.refresh_chunks:
-                    self._refresh(job)
-                self._check_lag(job)
+                if all(r.exhausted for r in self.readers):
+                    break
+                if not progressed:
+                    # every live source is quiet: heartbeat (the cancel
+                    # point while idle) and re-poll shortly
+                    job.update(job.progress)
+                    time.sleep(min(0.05, max(
+                        r._poll_s for r in self.readers)))
             # drain: one final refresh over any untrained tail
             if self.frame is not None and \
                     self.chunks_trained < self.chunks_landed:
@@ -167,27 +245,85 @@ class StreamPipeline:
                             f"{self.refreshes} refreshes")
             return self.frame
         finally:
-            self.reader.close()
+            for r in self.readers:
+                r.close()
 
-    def _land(self, job: Job, cols) -> None:
+    def _land(self, job: Job, cols, source: int = 0) -> None:
         """Chunk landing: append the tokenized columns onto the growing
         device frame (pow2-bucketed block writes — zero host pulls of
-        the accumulated payload, zero steady-state recompiles)."""
+        the accumulated payload, zero steady-state recompiles).  With a
+        holdout fraction set, a deterministic row subset of each chunk
+        is diverted to the side holdout frame instead (the swap gate's
+        unseen rows)."""
         from h2o_tpu.core.cloud import cloud
-        if self.frame is None:
-            self.frame = frame_from_chunk(cols, self.reader.setup,
-                                          key=self.dest_frame)
-            cloud().dkv.put(self.frame.key, self.frame)
-        else:
-            self.frame.append_rows(cols)
+        reader = self.readers[source]
+        chunk_index = self.chunks_landed
+        train_cols, hold_cols = self._split_chunk(cols, chunk_index)
+        if train_cols is not None:
+            if self.frame is None:
+                self.frame = frame_from_chunk(train_cols, reader.setup,
+                                              key=self.dest_frame)
+                cloud().dkv.put(self.frame.key, self.frame)
+            else:
+                self.frame.append_rows(train_cols)
+        if hold_cols is not None:
+            if self.holdout_frame is None:
+                self.holdout_frame = frame_from_chunk(
+                    hold_cols, reader.setup,
+                    key=f"{self.dest_frame}_holdout")
+                cloud().dkv.put(self.holdout_frame.key,
+                                self.holdout_frame)
+            else:
+                self.holdout_frame.append_rows(hold_cols)
+            self.rows_held_out = self.holdout_frame.nrows
         self.chunks_landed += 1
-        self.rows_landed = self.frame.nrows
+        self._source_landed[source] += 1
+        self._source_rows[source] = reader.rows_read
+        self.rows_landed = self.frame.nrows if self.frame is not None \
+            else 0
         TimeLine.record("stream", "chunk_landed", pipeline=self.id,
-                        chunk=self.chunks_landed, rows=self.frame.nrows)
+                        chunk=self.chunks_landed, rows=self.rows_landed,
+                        source=reader.name)
+        self._save_cursor()
         job.update(min(0.95, 0.9 * self.chunks_trained /
                        max(self.chunks_landed, 1)),
-                   f"{self.chunks_landed} chunks / {self.frame.nrows} "
+                   f"{self.chunks_landed} chunks / {self.rows_landed} "
                    f"rows landed, lag {self.lag}")
+
+    def _split_chunk(self, cols, chunk_index: int):
+        """Deterministic per-chunk holdout split: the mask depends only
+        on (pipeline id, chunk index) — crc32, not ``hash()``, which is
+        salted per process — so a resumed or replayed pipeline carves
+        exactly the same rows.  Returns (train_cols, holdout_cols);
+        either may be None when the fraction rounds to nothing."""
+        if self.holdout_frac <= 0:
+            return cols, None
+        n = 0
+        for payload in cols.values():
+            vals = payload[0] if isinstance(payload, tuple) else payload
+            n = len(vals)
+            break
+        if n == 0:
+            return cols, None
+        rng = np.random.default_rng(
+            [zlib.crc32(self.id.encode()), chunk_index])
+        mask = rng.random(n) < self.holdout_frac
+        if mask.all():                  # never starve training entirely
+            mask[0] = False
+        if not mask.any():
+            return cols, None
+
+        def take(payload, m):
+            if isinstance(payload, tuple):      # categorical: (codes, dom)
+                codes, domain = payload
+                return np.asarray(codes)[m], domain
+            if isinstance(payload, list):       # T_STR
+                return [v for v, keep in zip(payload, m) if keep]
+            return np.asarray(payload)[m]
+
+        train = {k: take(v, ~mask) for k, v in cols.items()}
+        hold = {k: take(v, mask) for k, v in cols.items()}
+        return train, hold
 
     # -- refresh -------------------------------------------------------------
 
@@ -258,18 +394,141 @@ class StreamPipeline:
             self.model = model
             self.refreshes = version
             self.chunks_trained = target
+            self._source_trained = list(self._source_landed)
             self.versions.append(
                 {"version": version, "model_id": model_id,
                  "rows": int(self.frame.nrows),
                  "ntrees": model.output.get("ntrees_actual"),
                  "train_s": round(train_s, 3)})
         self.last_error = None
+        self._save_cursor()
         TimeLine.record("stream", "hot_swap", pipeline=self.id,
                         version=version, alias=self.alias,
                         rows=int(self.frame.nrows))
         log.info("stream %s: v%d live (%d rows, %.2fs train%s)",
                  self.id, version, self.frame.nrows, train_s,
                  f", alias {self.alias}" if self.alias else "")
+
+    # -- holdout swap gate ---------------------------------------------------
+
+    def _validate_on_holdout(self, model) -> bool:
+        """Default swap gate when a holdout fraction is set: score the
+        refreshed model on the UNSEEN holdout rows and require a finite
+        metric (MSE for regression, misclassification for
+        classification).  Falls back to the training-metrics gate while
+        the holdout is still empty (first chunks)."""
+        hf = self.holdout_frame
+        if hf is None or hf.nrows == 0:
+            return _default_validate(model)
+        try:
+            pred = model.predict(hf)
+            yhat = np.asarray(pred.vec("predict").to_numpy(),
+                              np.float64)[: hf.nrows]
+            actual = np.asarray(hf.vec(self.y).to_numpy(),
+                                np.float64)[: hf.nrows]
+            if model.output.get("response_domain"):
+                metric = float(np.mean(yhat != actual))   # misclass rate
+            else:
+                metric = float(np.mean((yhat - actual) ** 2))  # MSE
+            ok = math.isfinite(metric)
+            TimeLine.record("stream", "holdout_validate",
+                            pipeline=self.id, rows=int(hf.nrows),
+                            metric=metric, ok=ok)
+            return ok
+        except Exception as e:  # noqa: BLE001 — a gate that cannot
+            # score must not deploy a model it cannot judge
+            log.warning("stream %s: holdout validation errored (%s) — "
+                        "refusing the swap", self.id, e)
+            return False
+
+    # -- durable cursor (recovery-layer persistence) -------------------------
+
+    def _cursor_path(self) -> Optional[str]:
+        if not self.recovery_dir:
+            return None
+        return os.path.join(self.recovery_dir,
+                            f"stream_{self.id}.cursor.json")
+
+    def _save_cursor(self) -> None:
+        """Persist the resume cursor ATOMICALLY (tmp + rename, the
+        recovery layer's convention): per-source byte offsets plus the
+        train-state counters, written after every landed chunk and
+        every refresh — the crash window never spans a chunk."""
+        path = self._cursor_path()
+        if path is None:
+            return
+        cur = {
+            "pipeline": self.id,
+            "sources": [{"name": r.name, "offset": int(r.offset),
+                         "chunks_read": int(r.chunks_read),
+                         "rows_read": int(r.rows_read)}
+                        for r in self.readers],
+            "chunks_landed": self.chunks_landed,
+            "rows_landed": int(self.rows_landed),
+            "rows_held_out": int(self.rows_held_out),
+            "chunks_trained": self.chunks_trained,
+            "refreshes": self.refreshes,
+            "source_landed": list(self._source_landed),
+            "source_trained": list(self._source_trained),
+            "frame_key": str(self.frame.key)
+            if self.frame is not None else None,
+            "holdout_key": str(self.holdout_frame.key)
+            if self.holdout_frame is not None else None,
+            "model_key": str(self.model.key)
+            if self.model is not None else None,
+        }
+        os.makedirs(self.recovery_dir, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(cur, f)
+        os.replace(tmp, path)
+
+    def load_cursor(self) -> Optional[Dict[str, Any]]:
+        path = self._cursor_path()
+        if path is None or not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
+
+    def _restore(self, job: Job) -> None:
+        """Resume from the persisted cursor: re-attach every source at
+        its exact byte offset and restore the frame/model/counters from
+        the DKV — no chunk is re-landed (no duplicates) and none is
+        skipped (no drops), so the continued run is byte-for-byte the
+        uninterrupted one."""
+        from h2o_tpu.core.cloud import cloud
+        cur = self.load_cursor()
+        if cur is None:
+            log.info("stream %s: resume requested but no cursor on "
+                     "disk — starting fresh", self.id)
+            return
+        dkv = cloud().dkv
+        for r, src in zip(self.readers, cur.get("sources", ())):
+            r.restore_cursor(src["offset"],
+                             chunks_read=src["chunks_read"],
+                             rows_read=src["rows_read"])
+        self.chunks_landed = int(cur["chunks_landed"])
+        self.rows_landed = int(cur["rows_landed"])
+        self.rows_held_out = int(cur.get("rows_held_out", 0))
+        self.chunks_trained = int(cur["chunks_trained"])
+        self.refreshes = int(cur["refreshes"])
+        n = len(self.readers)
+        self._source_landed = list(cur.get("source_landed",
+                                           [0] * n))[:n]
+        self._source_trained = list(cur.get("source_trained",
+                                            [0] * n))[:n]
+        if cur.get("frame_key"):
+            self.frame = dkv.get(cur["frame_key"])
+        if cur.get("holdout_key"):
+            self.holdout_frame = dkv.get(cur["holdout_key"])
+        if cur.get("model_key"):
+            self.model = dkv.get(cur["model_key"])
+        job.update(job.progress,
+                   f"resumed at chunk {self.chunks_landed} "
+                   f"(v{self.refreshes})")
+        log.info("stream %s: resumed from cursor — %d chunks landed, "
+                 "%d trained, model %s", self.id, self.chunks_landed,
+                 self.chunks_trained, cur.get("model_key"))
 
     def _check_lag(self, job: Job) -> None:
         lag = self.lag
@@ -314,6 +573,18 @@ class StreamPipeline:
             "swap_ms": [round(s, 2) for s in self.swap_ms],
             "refresh_chunks": self.refresh_chunks,
             "job": str(job.key) if job is not None else None,
+            "holdout_frac": self.holdout_frac,
+            "rows_held_out": int(self.rows_held_out),
+            # per-source follow/lag surface (multi-source pipelines)
+            "sources": [
+                {"name": r.name,
+                 "follow": r.follow,
+                 "offset": int(r.offset),
+                 "chunks_landed": self._source_landed[i],
+                 "rows_read": int(r.rows_read),
+                 "exhausted": r.exhausted,
+                 "lag": self._source_landed[i] - self._source_trained[i]}
+                for i, r in enumerate(self.readers)],
         }
 
 

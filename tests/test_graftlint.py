@@ -584,6 +584,23 @@ CASES = [
             total = jnp.sum(qstats, axis=0).astype(jnp.float32)
             return table, total
      """, {}),
+    ("GL640", "serve/registry.py", """
+        from h2o_tpu.core.memory import manager
+
+        def relieve_pressure():
+            manager().sweep()
+
+        def resize(mm, n):
+            mm.set_budget(n)
+     """, """
+        from h2o_tpu.core.memory import manager
+
+        def relieve_pressure(vec):
+            manager().demote(vec)
+
+        def inspect(mm):
+            return mm.stats()
+     """, {}),
 ]
 
 IDS = [c[0] for c in CASES]

@@ -1,0 +1,348 @@
+"""Unbounded streams (PR 20): follow-mode tail liveness, the durable
+per-source byte cursor (restore with no duplicated or dropped rows),
+multi-source pipelines with per-source lag, the deterministic per-chunk
+validation holdout, graceful finish of an unbounded pipeline, and the
+kill/resume bitwise drill against an uninterrupted replay.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+
+def _call(srv, method, path, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}", data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _write_csv(path, n, seed, header=True):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        if header:
+            f.write("x0,x1,y\n")
+        _append_rows_fh(f, rng, n)
+    return str(path)
+
+
+def _append_rows_fh(f, rng, n):
+    X = rng.normal(size=(n, 2))
+    y = np.where(X[:, 0] + 0.5 * X[:, 1] > 0, "s", "b")
+    for i in range(n):
+        f.write(f"{X[i, 0]:.6f},{X[i, 1]:.6f},{y[i]}\n")
+
+
+def _append_rows(path, rng, n):
+    with open(path, "a") as f:
+        _append_rows_fh(f, rng, n)
+
+
+def _wait(pred, timeout=60.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    pytest.fail(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# follow-mode reader: tail -f liveness + cursor restore
+# ---------------------------------------------------------------------------
+
+def test_follow_reader_tails_appends(cl, tmp_path):
+    """EOF means "no data yet": the reader emits what is buffered, then
+    picks up rows appended after it caught up; stop() drains and ends."""
+    from h2o_tpu.stream import ChunkReader
+    path = _write_csv(tmp_path / "tail.csv", 50, seed=1)
+    rd = ChunkReader(path, chunk_rows=200, follow=True, poll_ms=10)
+    try:
+        c1 = rd.next_chunk()                 # liveness: partial emit
+        assert c1 is not None
+        n1 = len(np.asarray(c1["x0"]))
+        assert n1 == 50
+        got = {"chunk": None}
+        t = threading.Thread(
+            target=lambda: got.__setitem__("chunk", rd.next_chunk()),
+            daemon=True)
+        t.start()
+        time.sleep(0.1)                      # reader is parked polling
+        _append_rows(path, np.random.default_rng(2), 30)
+        t.join(timeout=30)
+        assert got["chunk"] is not None, "appended rows never surfaced"
+        assert len(np.asarray(got["chunk"]["x0"])) == 30
+        assert rd.rows_read == 80 and not rd.exhausted
+        # the cursor sits exactly at the bytes emitted so far
+        assert rd.offset == os.path.getsize(path)
+        rd.stop()
+        assert rd.next_chunk() is None       # drained
+        assert rd.exhausted
+    finally:
+        rd.close()
+
+
+def test_cursor_restore_no_dup_no_drop(cl, tmp_path):
+    """Kill a reader mid-stream, restore a fresh one at the persisted
+    byte offset: the concatenation equals the whole file — nothing
+    replayed twice, nothing skipped."""
+    from h2o_tpu.core.parse import parse_file
+    from h2o_tpu.stream import ChunkReader
+    from h2o_tpu.stream.ingest import frame_from_chunk
+    path = _write_csv(tmp_path / "cursor.csv", 120, seed=3)
+    rd1 = ChunkReader(path, chunk_rows=32)
+    fr = None
+    for _ in range(2):
+        cols = rd1.next_chunk()
+        fr = frame_from_chunk(cols, rd1.setup) if fr is None \
+            else fr.append_rows(cols)
+    cursor = dict(offset=rd1.offset, chunks_read=rd1.chunks_read,
+                  rows_read=rd1.rows_read)
+    rd1.close()                              # the "crash"
+    _append_rows(path, np.random.default_rng(4), 40)
+    rd2 = ChunkReader(path, chunk_rows=32)
+    rd2.restore_cursor(**cursor)
+    assert rd2.offset == cursor["offset"]
+    for cols in rd2:
+        fr = fr.append_rows(cols)
+    whole = parse_file(path)
+    assert fr.nrows == whole.nrows == 160
+    np.testing.assert_array_equal(fr.vec("x0").to_numpy(),
+                                  whole.vec("x0").to_numpy())
+    a, b = fr.to_pandas(), whole.to_pandas()
+    assert (a["y"].astype(str) == b["y"].astype(str)).all()
+
+
+def test_cursor_restore_requires_seekable_source(cl):
+    from h2o_tpu.stream import ChunkReader
+    rd = ChunkReader(iter([b"x,y\n1,2\n"]), chunk_bytes=64)
+    with pytest.raises(ValueError, match="seekable"):
+        rd.restore_cursor(4)
+
+
+# ---------------------------------------------------------------------------
+# multi-source pipeline: round-robin + per-source accounting
+# ---------------------------------------------------------------------------
+
+def test_multi_source_pipeline_round_robin(cl, tmp_path):
+    from h2o_tpu.stream import ChunkReader, start_pipeline, stop_pipeline
+    pa = _write_csv(tmp_path / "src_a.csv", 96, seed=5)
+    pb = _write_csv(tmp_path / "src_b.csv", 64, seed=6)
+    pipe = start_pipeline(
+        "multi_src",
+        [ChunkReader(pa, chunk_rows=32), ChunkReader(pb, chunk_rows=32)],
+        "y", algo="gbm",
+        model_params=dict(max_depth=2, seed=5, nbins=8),
+        refresh_chunks=3, trees_per_refresh=2)
+    try:
+        pipe.job.join(timeout=300)
+        st = pipe.status()
+        assert st["status"] == "DONE", st
+        srcs = st["sources"]
+        assert len(srcs) == 2
+        assert {os.path.basename(s["name"]) for s in srcs} == \
+            {"src_a.csv", "src_b.csv"}
+        for s in srcs:
+            assert s["chunks_landed"] > 0 and s["exhausted"]
+            assert s["lag"] == 0, st         # final refresh drained all
+        assert sum(s["rows_read"] for s in srcs) == 160
+        assert pipe.frame.nrows == 160
+        assert st["lag"] == 0 and st["refreshes"] >= 2
+    finally:
+        stop_pipeline("multi_src", remove=True)
+
+
+# ---------------------------------------------------------------------------
+# deterministic per-chunk validation holdout
+# ---------------------------------------------------------------------------
+
+def test_holdout_split_is_deterministic(cl, tmp_path):
+    """The carve depends only on (pipeline id, chunk index): two
+    pipeline instances agree row-for-row; different chunks differ."""
+    from h2o_tpu.stream import ChunkReader
+    from h2o_tpu.stream.refresh import StreamPipeline
+    path = _write_csv(tmp_path / "hd.csv", 16, seed=7)
+
+    def mk():
+        return StreamPipeline("hd_pipe", ChunkReader(path, chunk_rows=8),
+                              "y", holdout_frac=0.3)
+
+    cols = {"x": np.arange(100, dtype=np.float32),
+            "g": (np.arange(100) % 3, ["a", "b", "c"]),
+            "s": [f"r{i}" for i in range(100)]}
+    p1, p2 = mk(), mk()
+    t1, h1 = p1._split_chunk(cols, 4)
+    t2, h2 = p2._split_chunk(cols, 4)
+    np.testing.assert_array_equal(t1["x"], t2["x"])
+    np.testing.assert_array_equal(h1["x"], h2["x"])
+    np.testing.assert_array_equal(h1["g"][0], h2["g"][0])
+    assert h1["s"] == h2["s"]
+    # partition: every row lands on exactly one side
+    assert len(t1["x"]) + len(h1["x"]) == 100
+    assert sorted(np.concatenate([t1["x"], h1["x"]]).tolist()) == \
+        sorted(cols["x"].tolist())
+    # a different chunk index carves a different mask
+    _t3, h3 = p1._split_chunk(cols, 5)
+    assert not np.array_equal(h1["x"], h3["x"])
+
+
+def test_holdout_gate_scores_unseen_rows(cl, tmp_path):
+    """With holdout_frac set, the pipeline diverts rows to a side frame
+    and the default swap gate scores refreshes on it."""
+    from h2o_tpu.core.diag import TimeLine
+    from h2o_tpu.stream import ChunkReader, start_pipeline, stop_pipeline
+    path = _write_csv(tmp_path / "gate.csv", 160, seed=8)
+    pipe = start_pipeline(
+        "hd_gate", ChunkReader(path, chunk_rows=40), "y", algo="gbm",
+        model_params=dict(max_depth=2, seed=9, nbins=8),
+        refresh_chunks=2, trees_per_refresh=2, holdout_frac=0.25)
+    try:
+        pipe.job.join(timeout=300)
+        st = pipe.status()
+        assert st["status"] == "DONE", st
+        assert st["holdout_frac"] == 0.25
+        assert 0 < st["rows_held_out"] < 160
+        assert pipe.holdout_frame.nrows == st["rows_held_out"]
+        assert pipe.frame.nrows + pipe.holdout_frame.nrows == 160
+        assert st["refreshes"] >= 2 and st["skipped_swaps"] == 0
+        gates = [e for e in TimeLine.snapshot()
+                 if e.get("what") == "holdout_validate" and
+                 e.get("pipeline") == "hd_gate"]
+        assert gates and all(e["ok"] for e in gates)
+        assert gates[-1]["rows"] == st["rows_held_out"]
+    finally:
+        stop_pipeline("hd_gate", remove=True)
+
+
+# ---------------------------------------------------------------------------
+# kill mid-follow + resume from the durable cursor: bitwise vs replay
+# ---------------------------------------------------------------------------
+
+def test_follow_kill_resume_bitwise(cl, tmp_path):
+    """Kill a follow pipeline mid-soak, resume from the persisted
+    cursor, finish — the resumed frame and forest are bitwise-equal to
+    an uninterrupted replay over the same bytes."""
+    from h2o_tpu.core.cloud import cloud
+    from h2o_tpu.stream import ChunkReader, start_pipeline, stop_pipeline
+    rec = str(tmp_path / "rec")
+    path = _write_csv(tmp_path / "kr.csv", 128, seed=11)
+
+    def mk_reader():
+        return ChunkReader(path, chunk_rows=32, follow=True, poll_ms=20,
+                           emit_partial=False)
+
+    common = dict(algo="gbm",
+                  model_params=dict(max_depth=2, seed=11, nbins=8),
+                  refresh_chunks=10 ** 6,      # train only at the drain
+                  trees_per_refresh=2, recovery_dir=rec,
+                  dest_frame="kr_frame")
+    pipe = start_pipeline("kr_pipe", mk_reader(), "y", **common)
+    try:
+        _wait(lambda: pipe.chunks_landed >= 2, msg="2 chunks landed")
+        pipe.stop()                              # the KILL
+        try:
+            pipe.job.join(timeout=60)
+        except Exception:  # noqa: BLE001 — cancellation is the drill
+            pass
+        cur = pipe.load_cursor()
+        assert cur is not None and cur["chunks_landed"] >= 2
+        _append_rows(path, np.random.default_rng(12), 64)
+        pipe2 = start_pipeline("kr_pipe", mk_reader(), "y",
+                               resume=True, **common)
+        # the live follow catches up past the cursor (full chunks only
+        # with emit_partial=False); finish() drains the sub-chunk tail
+        _wait(lambda: pipe2.status()["rows_landed"] >= 150,
+              msg="resumed source to catch up")
+        pipe2.finish()
+        pipe2.job.join(timeout=300)
+        st = pipe2.status()
+        assert st["status"] == "DONE" and st["lag"] == 0, st
+        # no dup, no drop: resumed counters cover every row exactly once
+        assert pipe2.frame.nrows == 192
+        # uninterrupted replay over the final bytes
+        replay = start_pipeline(
+            "kr_replay", ChunkReader(path, chunk_rows=32), "y",
+            algo="gbm", model_params=dict(max_depth=2, seed=11, nbins=8),
+            refresh_chunks=10 ** 6, trees_per_refresh=2,
+            dest_frame="kr_replay_frame")
+        replay.job.join(timeout=300)
+        a = cloud().dkv.get("kr_frame")
+        b = cloud().dkv.get("kr_replay_frame")
+        assert a.nrows == b.nrows == 192
+        for c in ("x0", "x1"):
+            np.testing.assert_array_equal(a.vec(c).to_numpy(),
+                                          b.vec(c).to_numpy())
+        # and the forests agree bitwise (checkpoint-resume + cursor)
+        for k in ("split_col", "bitset", "value"):
+            np.testing.assert_array_equal(
+                np.asarray(pipe2.model.output[k]),
+                np.asarray(replay.model.output[k]),
+                err_msg=f"resumed forest differs from replay at {k}")
+    finally:
+        stop_pipeline("kr_pipe", remove=True)
+        stop_pipeline("kr_replay", remove=True)
+
+
+# ---------------------------------------------------------------------------
+# REST: multi-source follow + graceful finish
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def srv(cl):
+    from h2o_tpu.api.server import RestServer
+    server = RestServer(port=0).start()
+    yield server
+    server.stop()
+
+
+def test_rest_follow_multi_source_finish(cl, srv, tmp_path):
+    pa = _write_csv(tmp_path / "ra.csv", 60, seed=13)
+    pb = _write_csv(tmp_path / "rb.csv", 60, seed=14)
+    st, out = _call(srv, "POST", "/3/Stream", {
+        "source": f"{pa},{pb}", "y": "y", "id": "rest_follow",
+        "algo": "gbm", "chunk_rows": 30, "refresh_chunks": 2,
+        "trees_per_refresh": 2, "follow": True, "poll_ms": 20,
+        "params": {"max_depth": 2, "seed": 15, "nbins": 8}})
+    assert st == 200, out
+    try:
+        def landed():
+            _s, o = _call(srv, "GET", "/3/Stream/rest_follow")
+            return o["pipeline"]["chunks_landed"] >= 4
+        _wait(landed, msg="both sources to land")
+        _append_rows(pa, np.random.default_rng(16), 30)
+
+        def tailed():
+            _s, o = _call(srv, "GET", "/3/Stream/rest_follow")
+            return o["pipeline"]["rows_landed"] >= 150
+        _wait(tailed, msg="appended rows to land")
+        # a follow pipeline never ends on its own — finish drains it
+        st, out = _call(srv, "GET", "/3/Stream/rest_follow")
+        assert out["pipeline"]["status"] == "RUNNING"
+        assert len(out["pipeline"]["sources"]) == 2
+        assert all(s["follow"] for s in out["pipeline"]["sources"])
+        st, _ = _call(srv, "POST", "/3/Stream/rest_follow/finish")
+        assert st == 200
+
+        def done():
+            _s, o = _call(srv, "GET", "/3/Stream/rest_follow")
+            return o["pipeline"]["status"] == "DONE"
+        _wait(done, msg="pipeline to drain DONE")
+        st, out = _call(srv, "GET", "/3/Stream/rest_follow")
+        p = out["pipeline"]
+        assert p["rows_landed"] == 150 and p["lag"] == 0, p
+        assert st == 200
+        st, _ = _call(srv, "POST", "/3/Stream/nope/finish")
+        assert st == 404
+    finally:
+        _call(srv, "DELETE", "/3/Stream/rest_follow")

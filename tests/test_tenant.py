@@ -1,0 +1,492 @@
+"""Multi-tenant control plane: tenant registry CRUD, fair-share
+admission (weighted-deficit dispatch, classified refusals, one logical
+admission per grid), quota changes mid-flight, the reform interaction
+(queued jobs survive a quiesce), per-tenant breaker shedding, tenant-
+isolated HBM eviction, and the ResizablePool grow/shrink race
+regression (PR 20).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+
+def _call(srv, method, path, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}", data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+@pytest.fixture()
+def tenants(cl):
+    """Tracked tenant creation with guaranteed teardown (tenant records
+    live in the DKV; a leaked one would flip every later
+    ``needs_admission`` check)."""
+    from h2o_tpu.core.tenant import create_tenant, delete_tenant
+    made = []
+
+    def make(name, **kw):
+        made.append(name)
+        return create_tenant(name, **kw)
+
+    make.track = made.append           # adopt an externally created one
+    yield make
+    for name in made:
+        delete_tenant(name)
+
+
+def _tenant_job(tenant, body, description="tenant job"):
+    from h2o_tpu.core.cloud import cloud
+    from h2o_tpu.core.job import Job
+    j = Job(description=description, tenant=tenant)
+    cloud().jobs.start(j, body)
+    return j
+
+
+def _wait(pred, timeout=15.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    pytest.fail(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# registry CRUD (Python + REST)
+# ---------------------------------------------------------------------------
+
+def test_tenant_record_validation(cl):
+    from h2o_tpu.core.tenant import Tenant
+    with pytest.raises(ValueError):
+        Tenant("")
+    with pytest.raises(ValueError):
+        Tenant("x", weight=-1.0)
+    with pytest.raises(ValueError):
+        Tenant("x", hbm_share=1.5)
+    t = Tenant("x", weight=2.0, max_concurrent=3, hbm_share=0.25)
+    d = t.to_dict()
+    assert d["weight"] == 2.0 and d["max_concurrent"] == 3
+    assert d["hbm_share"] == 0.25
+
+
+def test_tenant_crud_python(cl, tenants):
+    from h2o_tpu.core.tenant import (delete_tenant, get_tenant,
+                                     has_tenants, list_tenants)
+    tenants("crud_a", weight=2.0, hbm_share=0.4)
+    tenants("crud_b")
+    assert has_tenants()
+    names = [t.name for t in list_tenants()]
+    assert "crud_a" in names and "crud_b" in names
+    assert get_tenant("crud_a").hbm_share == 0.4
+    # upsert updates in place
+    tenants("crud_a", weight=5.0)
+    assert get_tenant("crud_a").weight == 5.0
+    assert delete_tenant("crud_b") >= 0
+    assert get_tenant("crud_b") is None
+    assert delete_tenant("nope_never_existed") == -1
+
+
+@pytest.fixture()
+def srv(cl):
+    from h2o_tpu.api.server import RestServer
+    server = RestServer(port=0).start()
+    yield server
+    server.stop()
+
+
+def test_tenant_rest_crud(cl, srv, tenants):
+    st, out, _ = _call(srv, "POST", "/3/Tenants",
+                       {"name": "rest_t", "weight": 3.0,
+                        "hbm_share": 0.2, "max_concurrent": 2})
+    tenants.track("rest_t")                # adopt for teardown
+    assert st == 200 and out["tenant"]["weight"] == 3.0
+    st, out, _ = _call(srv, "GET", "/3/Tenants")
+    assert st == 200
+    assert any(t["name"] == "rest_t" for t in out["tenants"])
+    assert "admission" in out
+    st, out, _ = _call(srv, "GET", "/3/Tenants/rest_t")
+    assert st == 200 and out["tenant"]["max_concurrent"] == 2
+    st, out, _ = _call(srv, "POST", "/3/Tenants",
+                       {"name": "bad", "hbm_share": 7})
+    assert st == 400
+    st, out, _ = _call(srv, "DELETE", "/3/Tenants/rest_t")
+    assert st == 200 and out["dropped_queued_jobs"] == 0
+    st, _, _ = _call(srv, "GET", "/3/Tenants/rest_t")
+    assert st == 404
+    st, _, _ = _call(srv, "DELETE", "/3/Tenants/rest_t")
+    assert st == 404
+
+
+# ---------------------------------------------------------------------------
+# fair-share admission
+# ---------------------------------------------------------------------------
+
+def test_untagged_jobs_bypass_admission(cl, tenants):
+    """A job with no tenant tag never touches the queue even when
+    tenants exist (single-tenant deployments see zero change)."""
+    from h2o_tpu.core.cloud import cloud
+    tenants("bypass_t")
+    before = cloud().jobs.admission.stats()["admitted"]
+    j = _tenant_job(None, lambda job: "ok")
+    assert j.join(timeout=30) == "ok"
+    assert cloud().jobs.admission.stats()["admitted"] == before
+
+
+def test_weighted_deficit_dispatch_order(cl, tenants, monkeypatch):
+    """One admission slot, weights 3:1 — the stride scheduler gives the
+    heavy tenant three dispatches per light one, not FIFO."""
+    from h2o_tpu.core.cloud import cloud
+    monkeypatch.setenv("H2O_TPU_TENANT_SLOTS", "1")
+    tenants("fs_blk", weight=1.0)
+    tenants("fs_hi", weight=3.0)
+    tenants("fs_lo", weight=1.0)
+    gate = threading.Event()
+    order = []
+    olock = threading.Lock()
+
+    def blocker(job):
+        gate.wait(30)
+
+    def tagged(name):
+        def body(job):
+            with olock:
+                order.append(name)
+        return body
+
+    blk = _tenant_job("fs_blk", blocker, "slot blocker")
+    _wait(lambda: blk.status == "RUNNING", msg="blocker running")
+    jobs = []
+    for i in range(6):
+        jobs.append(_tenant_job("fs_hi", tagged("hi"), f"hi {i}"))
+    for i in range(6):
+        jobs.append(_tenant_job("fs_lo", tagged("lo"), f"lo {i}"))
+    assert cloud().jobs.admission.queued("fs_hi") == 6
+    gate.set()
+    blk.join(timeout=30)
+    for j in jobs:
+        j.join(timeout=60)
+    assert len(order) == 12
+    # weighted dominance regardless of tie-break: hi exhausts its 6
+    # jobs within the first 8 dispatches at weight 3:1
+    assert order[:8].count("hi") >= 5, order
+    adm = cloud().jobs.admission.stats()["tenants"]
+    assert adm["fs_hi"]["served"] == 6.0
+    assert adm["fs_lo"]["served"] == 6.0
+
+
+def test_admission_rejects_are_classified(cl, tenants, monkeypatch):
+    """queue_full / unknown_tenant / zero_weight each raise the typed
+    AdmissionRejected AND leave the job FAILED carrying it."""
+    from h2o_tpu.core.cloud import cloud
+    from h2o_tpu.core.tenant import AdmissionRejected
+    monkeypatch.setenv("H2O_TPU_TENANT_SLOTS", "1")
+    tenants("rj_blk", weight=1.0)
+    tenants("rj_full", weight=1.0, max_queue=1)
+    tenants("rj_zero", weight=0.0)
+    gate = threading.Event()
+    blk = _tenant_job("rj_blk", lambda job: gate.wait(30), "blocker")
+    _wait(lambda: blk.status == "RUNNING", msg="blocker running")
+    try:
+        q1 = _tenant_job("rj_full", lambda job: None)     # queues
+        assert q1._admission_queued
+        with pytest.raises(AdmissionRejected) as ei:
+            _tenant_job("rj_full", lambda job: None)      # over bound
+        assert ei.value.reason == "queue_full"
+        assert ei.value.retry_after_s > 0
+        with pytest.raises(AdmissionRejected) as ei:
+            _tenant_job("rj_ghost", lambda job: None)
+        assert ei.value.reason == "unknown_tenant"
+        with pytest.raises(AdmissionRejected) as ei:
+            _tenant_job("rj_zero", lambda job: None)
+        assert ei.value.reason == "zero_weight"
+    finally:
+        gate.set()
+        blk.join(timeout=30)
+    q1.join(timeout=30)
+    stats = cloud().jobs.admission.stats()
+    by = stats["rejects_by_reason"]
+    for reason in ("queue_full", "unknown_tenant", "zero_weight"):
+        assert by.get(reason, 0) >= 1, by
+    assert stats["rejected"] == sum(by.values())
+    assert set(by) <= set(AdmissionRejected.REASONS)
+
+
+def test_tenant_deleted_with_queued_jobs(cl, tenants, monkeypatch):
+    """Deleting a tenant fails its QUEUED jobs with a classified
+    tenant_deleted refusal; a RUNNING job keeps its slot."""
+    from h2o_tpu.core.tenant import AdmissionRejected, delete_tenant
+    monkeypatch.setenv("H2O_TPU_TENANT_SLOTS", "1")
+    tenants("del_blk", weight=1.0)
+    tenants("del_doomed", weight=1.0)
+    gate = threading.Event()
+
+    def blocker(job):
+        gate.wait(30)
+        return "ok"
+
+    blk = _tenant_job("del_blk", blocker, "blocker")
+    _wait(lambda: blk.status == "RUNNING", msg="blocker running")
+    queued = [_tenant_job("del_doomed", lambda job: None)
+              for _ in range(2)]
+    assert all(j._admission_queued for j in queued)
+    assert delete_tenant("del_doomed") == 2
+    for j in queued:
+        assert j.status == "FAILED"
+        assert isinstance(j.exception, AdmissionRejected)
+        assert j.exception.reason == "tenant_deleted"
+    # the running blocker was untouched by the delete
+    assert blk.status == "RUNNING"
+    gate.set()
+    assert blk.join(timeout=30) == "ok"
+
+
+def test_nested_submissions_ride_one_admission(cl, tenants):
+    """A parent job's body spawning children (the grid/AutoML shape)
+    costs exactly ONE logical admission."""
+    from h2o_tpu.core.cloud import cloud
+    tenants("nest_t", weight=1.0)
+    before = cloud().jobs.admission.stats()["admitted"]
+    ran = []
+
+    def parent(job):
+        kids = [_tenant_job(None, lambda j, i=i: ran.append(i),
+                            f"child {i}") for i in range(3)]
+        for k in kids:
+            k.join(timeout=30)
+        # children inherited the tenant tag but bypassed the queue
+        assert all(k.tenant == "nest_t" for k in kids)
+        return len(ran)
+
+    j = _tenant_job("nest_t", parent, "grid parent")
+    assert j.join(timeout=60) == 3
+    assert cloud().jobs.admission.stats()["admitted"] == before + 1
+
+
+def test_quota_change_applies_mid_flight(cl, tenants):
+    """Raising max_concurrent while a job waits queued lets it dispatch
+    at the next pump without restarting anything."""
+    from h2o_tpu.core.cloud import cloud
+    tenants("qc_t", weight=1.0, max_concurrent=1)
+    gate = threading.Event()
+    j1 = _tenant_job("qc_t", lambda job: gate.wait(30), "long 1")
+    _wait(lambda: j1.status == "RUNNING", msg="first job running")
+    j2 = _tenant_job("qc_t", lambda job: gate.wait(30), "long 2")
+    time.sleep(0.1)
+    assert j2._admission_queued, "cap=1 should hold the second job"
+    tenants("qc_t", weight=1.0, max_concurrent=2)   # upsert mid-flight
+    cloud().jobs.admission._pump()
+    _wait(lambda: j2.status == "RUNNING", msg="second job after raise")
+    assert j1.status == "RUNNING"                   # both concurrent now
+    gate.set()
+    j1.join(timeout=30)
+    j2.join(timeout=30)
+
+
+def test_quiesce_skips_queued_admission_jobs(cl, tenants, monkeypatch):
+    """A slice-loss reform interrupts RUNNING jobs; fair-share-QUEUED
+    jobs hold no mesh state, survive in their queue, and complete on
+    the survivor mesh."""
+    monkeypatch.setenv("H2O_TPU_TENANT_SLOTS", "1")
+    from h2o_tpu.core.cloud import cloud
+    tenants("qz_t", weight=1.0)
+    gate = threading.Event()
+
+    def interruptible(job):
+        while not gate.wait(0.02):
+            job.update(0.5)          # the interrupt lands here
+
+    blk = _tenant_job("qz_t", interruptible, "running victim")
+    _wait(lambda: blk.status == "RUNNING", msg="victim running")
+    queued = _tenant_job("qz_t", lambda job: "survived")
+    assert queued._admission_queued
+    victims = cloud().jobs.quiesce(cause="test reform", wait_secs=15.0)
+    assert blk in victims
+    assert queued not in victims
+    assert blk.status == "INTERRUPTED"
+    # the queued job admits once the slot frees and completes normally
+    assert queued.join(timeout=30) == "survived"
+    gate.set()
+
+
+# ---------------------------------------------------------------------------
+# ResizablePool grow/shrink race regression
+# ---------------------------------------------------------------------------
+
+def test_resizable_pool_grow_shrink_race():
+    """Concurrent grow/shrink churn with work in flight settles at the
+    original target: no deadlock, no thread leak, every task runs."""
+    from h2o_tpu.core.job import ResizablePool
+    pool = ResizablePool(2, thread_name_prefix="race-pool")
+    ran = []
+    rlock = threading.Lock()
+
+    def task(i):
+        with rlock:
+            ran.append(i)
+
+    def churn():
+        for _ in range(100):
+            pool.grow()
+            pool.shrink()
+
+    threads = [threading.Thread(target=churn) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for i in range(200):
+        pool.submit(task, i)
+    for t in threads:
+        t.join(timeout=30)
+    _wait(lambda: len(ran) == 200, msg="all pool tasks")
+    # every grow was paired with a shrink: back at the initial target
+    assert pool.max_workers == 2
+    # retire tokens drain: live workers settle at/below the target
+    _wait(lambda: pool.live_workers <= pool.max_workers,
+          msg="workers to settle")
+    assert 1 <= pool.live_workers <= 2
+    # the pool still works after the churn
+    done = threading.Event()
+    pool.submit(lambda: done.set())
+    assert done.wait(10)
+
+
+# ---------------------------------------------------------------------------
+# per-tenant breaker shedding
+# ---------------------------------------------------------------------------
+
+def test_breaker_sheds_hot_tenant_first(cl, tenants):
+    """In SHEDDING, the tenant whose observed traffic share runs past
+    1.5x its fair weight share is refused outright; the quiet tenant
+    keeps flowing (modulo the small proportional shed)."""
+    from h2o_tpu.serve.breaker import LoadBreaker, ShedLoad
+    tenants("hog", weight=1.0)
+    tenants("quiet", weight=1.0)
+    br = LoadBreaker("shed_test", soft=0.5, hard=2.0, interval_ms=0)
+    # queue component 0.6 sits between soft and hard -> SHEDDING
+    depth, cap = 6, 10
+    hog_shed = quiet_shed = 0
+    for _ in range(40):
+        try:
+            br.admit(depth, cap, tenant="hog")
+        except ShedLoad:
+            hog_shed += 1
+    for _ in range(40):
+        try:
+            br.admit(depth, cap, tenant="quiet")
+        except ShedLoad:
+            quiet_shed += 1
+    assert br.state == "shedding"
+    # the hog (observed share -> 1.0 > 1.5 * 0.5) is shed hard once the
+    # window has signal; the quiet tenant only sees the 1-in-10 shed
+    assert hog_shed >= 15, (hog_shed, quiet_shed)
+    assert quiet_shed <= 10, (hog_shed, quiet_shed)
+    st = br.stats()
+    assert st["tenant_sheds"].get("hog", 0) >= 15
+    assert st["tenant_sheds"].get("hog", 0) > \
+        st["tenant_sheds"].get("quiet", 0)
+
+
+# ---------------------------------------------------------------------------
+# tenant-isolated HBM eviction
+# ---------------------------------------------------------------------------
+
+def test_tenant_pressure_spills_own_blocks_first(cl, rng):
+    """Tenant B's resident columns survive tenant A blowing through the
+    budget: A's own cold blocks are the victims, and the cross-tenant
+    counter below high-water stays zero."""
+    from h2o_tpu.core.frame import Frame, Vec
+    from h2o_tpu.core.memory import manager, set_budget
+    from h2o_tpu.core.tenant import tenant_context
+    prev = manager().budget
+    m = set_budget(600_000)
+    try:
+        n = 20_000                              # ~80 KB per f32 column
+        with tenant_context("mem_b"):
+            fb = Frame(["b0", "b1"],
+                       [Vec(rng.normal(size=n).astype(np.float32)),
+                        Vec(rng.normal(size=n).astype(np.float32))])
+        base = m.stats()
+        b_resident = base["tenants"]["mem_b"]["resident_vecs"]
+        assert b_resident == 2
+        with tenant_context("mem_a"):
+            fa = Frame([f"a{j}" for j in range(8)],
+                       [Vec(rng.normal(size=n).astype(np.float32))
+                        for _ in range(8)])
+        s = m.stats()
+        # A overflowed the budget -> A spilled, B untouched
+        assert s["tenants"]["mem_a"]["spills"] > 0, s["tenants"]
+        assert s["tenants"].get("mem_b", {}).get("spills", 0) == 0
+        assert s["tenants"]["mem_b"]["resident_vecs"] == 2
+        assert s["cross_tenant_below_highwater"] == 0
+        # data still reads back for both tenants (spill is transparent)
+        for fr in (fa, fb):
+            for v in fr.vecs:
+                assert np.isfinite(np.asarray(v.to_numpy())).all()
+    finally:
+        set_budget(prev)
+
+
+def test_hbm_share_reservation_spills_under_global_budget(cl, rng):
+    """A tenant with a reserved hbm_share sheds its OWN cold blocks as
+    soon as it exceeds the reservation, even while the cluster as a
+    whole is under budget."""
+    from h2o_tpu.core.frame import Frame, Vec
+    from h2o_tpu.core.memory import manager, set_budget
+    from h2o_tpu.core.tenant import (create_tenant, delete_tenant,
+                                     tenant_context)
+    prev = manager().budget
+    m = set_budget(1_000_000)
+    create_tenant("mem_shared", hbm_share=0.2)  # 200 KB reservation
+    try:
+        spills0 = m.stats()["tenants"].get(
+            "mem_shared", {}).get("spills", 0)
+        n = 20_000                              # ~80 KB per column
+        with tenant_context("mem_shared"):
+            Frame([f"s{j}" for j in range(4)],   # ~320 KB > 200 KB share
+                  [Vec(rng.normal(size=n).astype(np.float32))
+                   for _ in range(4)])
+        s = m.stats()
+        # well under the global budget, yet the share was enforced
+        assert s["resident_bytes"] < s["budget"]
+        assert s["tenants"]["mem_shared"]["spills"] > spills0
+        assert s["cross_tenant_evictions"] == 0 or \
+            s["cross_tenant_below_highwater"] == 0
+    finally:
+        delete_tenant("mem_shared")
+        set_budget(prev)
+
+
+# ---------------------------------------------------------------------------
+# REST integration: 429 + Retry-After on a refused build
+# ---------------------------------------------------------------------------
+
+def test_rest_build_maps_admission_reject_to_429(cl, srv, tenants):
+    from h2o_tpu.core.cloud import cloud
+    from h2o_tpu.core.frame import Frame, Vec
+    tenants("rest429", weight=0.0)              # zero weight -> refused
+    fr = Frame(["x", "y"],
+               [Vec(np.arange(64, dtype=np.float32)),
+                Vec((np.arange(64) % 2).astype(np.float32))])
+    fr.key = "rest429_frame"
+    cloud().dkv.put(fr.key, fr)
+    try:
+        st, out, hdrs = _call(
+            srv, "POST", "/3/ModelBuilders/gbm",
+            {"training_frame": "rest429_frame", "response_column": "y",
+             "tenant": "rest429", "ntrees": 1, "max_depth": 2})
+        assert st == 429, out
+        assert "zero_weight" in out["msg"]
+        assert int(hdrs.get("Retry-After", 0)) >= 1
+    finally:
+        cloud().dkv.remove("rest429_frame")

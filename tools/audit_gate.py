@@ -153,6 +153,31 @@ def _workload() -> None:
     manager().stats()
     Job(description="audit gate").to_dict()
 
+    # two-tenant leg: two registered tenants train one small GBM each
+    # under fair-share admission, so the audited run exercises the
+    # tenant-tagged memory path, the admission queue (classified
+    # refusals wired but not tripped here), and the per-tenant stats
+    # block — then tears the tenants down so the gate leaves no state
+    from h2o_tpu.core.tenant import (create_tenant, delete_tenant,
+                                     tenant_context)
+    create_tenant("gate_a", weight=2.0, hbm_share=0.5)
+    create_tenant("gate_b", weight=1.0, hbm_share=0.3)
+    for tname in ("gate_a", "gate_b"):
+        with tenant_context(tname):
+            frt = Frame(["x0", "x1", "y"],
+                        [Vec(rng.normal(size=R).astype(np.float32)),
+                         Vec(rng.normal(size=R).astype(np.float32)),
+                         Vec(rng.normal(size=R).astype(np.float32))])
+            GBM(ntrees=1, max_depth=2, seed=5, nbins=32).train(
+                y="y", training_frame=frt)
+    adm = cloud().jobs.admission.stats()
+    assert adm["admitted"] >= 2, f"tenant jobs not admitted: {adm}"
+    mstats = manager().stats()
+    assert mstats["cross_tenant_below_highwater"] == 0, \
+        f"cross-tenant eviction below high-water in the gate: {mstats}"
+    delete_tenant("gate_a")
+    delete_tenant("gate_b")
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])

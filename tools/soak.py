@@ -61,7 +61,8 @@ FAULTS = dict(job_p=0.15, persist_p=0.15, stall_p=0.10, stall_secs=1.0,
 # OOMError/BreakerOpen/MeshReforming/NoHealthyReplica -> 503 — all
 # retryable; anything else is a serve_contract failure.
 SERVE_RETRYABLE = ("QueueFull", "ShedLoad", "TimeoutError", "OOMError",
-                   "BreakerOpen", "MeshReforming", "NoHealthyReplica")
+                   "BreakerOpen", "MeshReforming", "NoHealthyReplica",
+                   "AdmissionRejected")
 
 
 def _poll_rest(port: int, timeout: float = 5.0) -> dict:
@@ -334,15 +335,515 @@ def run_soak(seed: int = 7, duration: float = 60.0,
     return report
 
 
+def _append_rows(path: str, rng, n: int) -> None:
+    """Append ``n`` CSV rows to a follow-mode source (one flush, like a
+    producer's atomic append)."""
+    import numpy as np
+    xs = rng.normal(size=n).astype(np.float32)
+    ys = np.where(xs > 0, "p", "n")
+    with open(path, "a") as fobj:
+        for v, lab in zip(xs, ys):
+            fobj.write(f"{v:.6f},{lab}\n")
+
+
+def _follow_kill_resume_check(cl, seed: int, rec_root: str,
+                              fail) -> dict:
+    """The exactly-once cursor drill: a follow pipeline is KILLED
+    mid-stream (cancel), resumed from its durable per-source byte
+    cursor, and the landed frame must be BITWISE what an uninterrupted
+    replay of the same final file lands — no duplicated rows, no
+    dropped rows.  emit_partial=False pins chunk boundaries to record
+    boundaries so the comparison is content-only."""
+    import numpy as np
+    from h2o_tpu.stream import ChunkReader, start_pipeline, stop_pipeline
+
+    rng = np.random.default_rng(seed + 100)
+    path = os.path.join(rec_root, f"mt_follow_{seed}.csv")
+    os.makedirs(rec_root, exist_ok=True)
+    with open(path, "w") as fobj:
+        fobj.write("x,y\n")
+    _append_rows(path, rng, 200)
+
+    def mk_reader():
+        return ChunkReader(path, chunk_rows=64, follow=True,
+                           poll_ms=20, emit_partial=False)
+
+    common = dict(algo="gbm",
+                  model_params=dict(max_depth=2, seed=seed, nbins=16,
+                                    ntrees=0),
+                  refresh_chunks=10 ** 6, trees_per_refresh=2,
+                  recovery_dir=rec_root, dest_frame="mt_follow_frame")
+    pipe = start_pipeline("mt_follow", mk_reader(), "y", **common)
+    deadline = time.monotonic() + 30
+    while pipe.chunks_landed < 2 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    pipe.stop()                               # KILL mid-stream
+    try:
+        pipe.job.join(timeout=30)
+    except Exception:  # noqa: BLE001 — cancellation IS the drill
+        pass
+    killed_at = pipe.chunks_landed
+    # producer keeps writing while the pipeline is down
+    for _ in range(6):
+        _append_rows(path, rng, 100)
+    # resume: a NEW pipeline restores the byte cursor and re-attaches
+    pipe2 = start_pipeline("mt_follow", mk_reader(), "y",
+                           resume=True, **common)
+    # wait for the live follow to catch up to within one chunk of the
+    # appended tail (emit_partial=False only lands FULL chunks while
+    # the source is live; finish() drains the sub-chunk remainder)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if pipe2.status()["rows_landed"] >= 700:
+            break
+        time.sleep(0.05)
+    pipe2.finish()                            # graceful drain -> DONE
+    pipe2.job.join(timeout=60)
+    # uninterrupted replay of the SAME final file
+    replay = start_pipeline(
+        "mt_follow_replay",
+        ChunkReader(path, chunk_rows=64, emit_partial=False), "y",
+        algo="gbm",
+        model_params=dict(max_depth=2, seed=seed, nbins=16, ntrees=0),
+        refresh_chunks=10 ** 6, trees_per_refresh=2,
+        dest_frame="mt_follow_replay_frame")
+    replay.job.join(timeout=120)
+    fa = cl.dkv.get("mt_follow_frame")
+    fb = cl.dkv.get("mt_follow_replay_frame")
+    ok = fa is not None and fb is not None and fa.nrows == fb.nrows
+    if ok:
+        for col in ("x", "y"):
+            a = fa.vec(col).to_numpy()[:fa.nrows]
+            b = fb.vec(col).to_numpy()[:fb.nrows]
+            if not np.array_equal(a, b):
+                ok = False
+                break
+    if not ok:
+        fail("follow_no_dup_drop",
+             f"resumed frame != uninterrupted replay "
+             f"(rows {getattr(fa, 'nrows', None)} vs "
+             f"{getattr(fb, 'nrows', None)})")
+    out = {"killed_after_chunks": killed_at,
+           "resumed_rows": getattr(fa, "nrows", 0),
+           "replay_rows": getattr(fb, "nrows", 0), "bitwise": ok}
+    stop_pipeline("mt_follow", remove=True)
+    stop_pipeline("mt_follow_replay", remove=True)
+    return out
+
+
+def run_multitenant_soak(seed: int = 7, duration: float = 60.0,
+                         verbose: bool = False) -> dict:
+    """The isolation soak (PR 20): three weighted tenants each run
+    AutoML + a follow-mode streaming refresh + serve traffic while the
+    chaos layer injects admission rejections, serve pressure, and a
+    GUARANTEED mid-soak slice loss.  Asserts, after the clock runs out:
+
+    - every job (AutoML parents, stream pipelines, singles) reached a
+      terminal state;
+    - ZERO cross-tenant evictions below the high-water mark — tenant
+      A's pressure never evicted tenant B's resident blocks while the
+      pool had headroom;
+    - every admission refusal is CLASSIFIED (rejected total == the sum
+      over AdmissionRejected.REASONS buckets; injected rejects
+      reconcile with the chaos counter);
+    - per-tenant serve p99 stays under the bound and every tenant got
+      successful scores THROUGH the storm;
+    - models trained under the storm are BITWISE identical to the
+      fault-free baseline of the same seed (slice-loss recovery
+      included);
+    - a follow pipeline killed mid-stream resumes from its durable
+      cursor with no duplicated and no dropped rows (bitwise vs an
+      uninterrupted replay);
+    - both job pools return to their configured width.
+    """
+    import threading
+    import numpy as np
+
+    from h2o_tpu.api.server import RestServer
+    from h2o_tpu.core import chaos, oom, resilience
+    from h2o_tpu.core.cloud import Cloud
+    from h2o_tpu.core.frame import Frame, T_CAT, Vec
+    from h2o_tpu.core.memory import manager
+    from h2o_tpu.core.tenant import (AdmissionRejected, create_tenant,
+                                     delete_tenant, tenant_context)
+    from h2o_tpu.models.tree.gbm import GBM
+    from h2o_tpu.serve import ServingConfig
+    from h2o_tpu.serve.registry import registry
+    from h2o_tpu.stream import ChunkReader, start_pipeline, stop_pipeline
+
+    cl = Cloud.boot()
+    report = {"seed": seed, "duration": duration, "rounds": 0,
+              "rest_polls": 0, "rest_max_latency": 0.0,
+              "stream_restarts": 0, "failures": [], "invariants": {}}
+    p99_bound_ms = float(os.environ.get("MT_SOAK_P99_MS", 2000.0))
+
+    def fail(inv: str, msg: str) -> None:
+        report["failures"].append(f"{inv}: {msg}")
+
+    chaos.reset()
+    oom.reset_stats()
+    resilience.reset_stats()
+    keys_before = set(map(str, cl.dkv.keys()))
+    pool_workers = cl.jobs._pool._max_workers
+    sys_workers = cl.jobs._sys_pool._max_workers
+    rec_root = os.path.join(cl.args.ice_root, f"mt_soak_{seed}")
+    os.makedirs(rec_root, exist_ok=True)
+
+    tenants = {"acme": 3.0, "globex": 2.0, "initech": 1.0}
+    for name, w in tenants.items():
+        create_tenant(name, weight=w, hbm_share=0.25, max_queue=4)
+
+    # per-tenant fixed datasets + fault-free bitwise baselines
+    tdata = {}
+    for i, name in enumerate(tenants):
+        trng = np.random.default_rng(seed + i)
+        tx = trng.normal(size=400).astype(np.float32)
+        ty = (tx + trng.normal(size=400) * 0.3 > 0).astype(np.int32)
+        tdata[name] = (tx, ty)
+
+    def frame_of(name):
+        tx, ty = tdata[name]
+        return Frame(["x", "y"],
+                     [Vec(tx), Vec(ty, T_CAT, domain=["n", "p"])])
+
+    pred_ref = {name: _train_reference(lambda n=name: frame_of(n), seed)
+                for name in tenants}
+
+    # ---- phase 0 (chaos OFF): follow kill/resume exactly-once -------
+    report["follow_drill"] = _follow_kill_resume_check(
+        cl, seed, rec_root, fail)
+
+    # serve alias for the storm's per-tenant traffic
+    m0 = GBM(ntrees=2, max_depth=2, seed=seed).train(
+        y="y", training_frame=frame_of("acme"))
+    alias = "mt_serve"
+    registry().deploy(alias, m0, ServingConfig(queue_cap=128))
+
+    srv = RestServer(port=0).start()
+    storm = dict(admission_reject_p=0.10, serve_pressure_p=0.10,
+                 slice_loss_p=0.02)
+    chaos.configure(seed=seed, **storm)
+    counters_accum = {}
+
+    def _accumulate(c):
+        for k, v in c.items():
+            counters_accum[k] = counters_accum.get(k, 0) + v
+
+    # per-tenant follow streams: a feeder appends rows, the pipeline
+    # refreshes on cadence; a pipeline interrupted by the slice-loss
+    # reform is RESTARTED with resume=True (the cursor re-attach path)
+    streams, feeders_stop = {}, threading.Event()
+
+    def stream_common(name):
+        return dict(algo="gbm",
+                    model_params=dict(max_depth=2, seed=seed, nbins=16,
+                                      ntrees=0),
+                    refresh_chunks=3, trees_per_refresh=2,
+                    recovery_dir=rec_root,
+                    dest_frame=f"mt_stream_{name}_frame")
+
+    def start_stream(name, resume=False):
+        path = os.path.join(rec_root, f"mt_stream_{name}.csv")
+        if not resume:
+            with open(path, "w") as fobj:
+                fobj.write("x,y\n")
+            _append_rows(path, np.random.default_rng(seed), 120)
+        with tenant_context(name):
+            return start_pipeline(
+                f"mt_stream_{name}",
+                ChunkReader(path, chunk_rows=60, follow=True,
+                            poll_ms=50, emit_partial=False),
+                "y", resume=resume, **stream_common(name))
+
+    def feeder():
+        frng = np.random.default_rng(seed + 50)
+        while not feeders_stop.is_set():
+            for name in tenants:
+                _append_rows(os.path.join(
+                    rec_root, f"mt_stream_{name}.csv"), frng, 60)
+            feeders_stop.wait(1.0)
+
+    for name in tenants:
+        for _ in range(12):    # chaos may refuse the pipeline job
+            try:
+                streams[name] = start_stream(name)
+                break
+            except AdmissionRejected as e:
+                if e.reason not in AdmissionRejected.REASONS:
+                    fail("refusals_classified",
+                         f"unclassified stream reject: {e.reason}")
+                time.sleep(0.05)
+        else:
+            fail("stream_resume",
+                 f"tenant {name}: stream launch refused 12 times")
+    feeder_t = threading.Thread(target=feeder, daemon=True)
+    feeder_t.start()
+
+    # one AutoML per tenant — ONE logical admission each; inner builds
+    # ride the parent's slot
+    aml_jobs = {}
+    for name in tenants:
+        from h2o_tpu.automl.automl import AutoML
+        with tenant_context(name):
+            # chaos may refuse the launch itself — that's a classified
+            # refusal (the FAILED job stays on the books), so retry
+            # under a fresh project until one admission sticks
+            for attempt in range(12):
+                try:
+                    aml_jobs[name] = AutoML(
+                        max_models=2, nfolds=2, seed=seed,
+                        include_algos=["GBM", "GLM"],
+                        project_name=f"mt_aml_{name}_{attempt}",
+                        ).train_async(
+                        y="y", training_frame=frame_of(name))
+                    break
+                except AdmissionRejected as e:
+                    if e.reason not in AdmissionRejected.REASONS:
+                        fail("refusals_classified",
+                             f"unclassified launch reject: {e.reason}")
+                    time.sleep(0.05)
+            else:
+                fail("jobs_terminal",
+                     f"AutoML launch for {name} refused 12 times")
+
+    # per-tenant serve hammers
+    lat = {name: [] for name in tenants}
+    lat_lock = threading.Lock()
+    hammer_stop = threading.Event()
+
+    def hammer(name):
+        probe = [{"x": 0.1}]
+        while not hammer_stop.is_set():
+            h0 = time.monotonic()
+            try:
+                registry().score_rows(alias, probe, deadline_ms=2000,
+                                      tenant=name)
+                with lat_lock:
+                    lat[name].append((time.monotonic() - h0) * 1000.0)
+            except Exception as e:  # noqa: BLE001 — contract statuses
+                if type(e).__name__ not in SERVE_RETRYABLE and \
+                        not isinstance(e, KeyError):
+                    fail("serve_contract", f"tenant {name}: "
+                                           f"unexpected {e!r}")
+            time.sleep(0.01)
+
+    hammers = [threading.Thread(target=hammer, args=(n,), daemon=True)
+               for n in tenants]
+    for h in hammers:
+        h.start()
+
+    burst_jobs, burst_rejects = [], 0
+    t_end = time.monotonic() + duration
+    drill_fired = False
+    try:
+        # run until the clock runs out AND the slice-loss drill has had
+        # one full round to fire — short durations must not skip it
+        while time.monotonic() < t_end or not drill_fired:
+            r = report["rounds"]
+            report["rounds"] += 1
+            try:
+                p = _poll_rest(srv.port)
+                report["rest_polls"] += 1
+                report["rest_max_latency"] = max(
+                    report["rest_max_latency"], p["latency"])
+            except Exception as e:  # noqa: BLE001
+                fail("rest_responsive", repr(e))
+            # guaranteed slice loss once past half time: the at-block
+            # drill fires deterministically at the next tree dispatch
+            if not drill_fired and \
+                    time.monotonic() > t_end - duration / 2:
+                _accumulate(chaos.chaos().counters())
+                chaos.configure(seed=seed + 1, slice_loss_at_block=2,
+                                **{k: v for k, v in storm.items()
+                                   if k != "slice_loss_p"})
+                drill_fired = True
+            # bitwise train per tenant under the storm (admission
+            # rejects and slice-loss interrupts retried inside)
+            for name in tenants:
+                try:
+                    with tenant_context(name):
+                        pred = _train_with_recovery(
+                            lambda n=name: frame_of(n), seed,
+                            os.path.join(rec_root, f"{name}_r{r}"))
+                    if not np.array_equal(pred_ref[name], pred):
+                        fail("model_bitwise",
+                             f"tenant {name} round {r} diverged")
+                except Exception as e:  # noqa: BLE001
+                    fail("train_completes",
+                         f"tenant {name} round {r}: {e!r}")
+            # queue-bound burst: initech floods its bounded queue; the
+            # overflow MUST come back as classified queue_full rejects
+            if r == 0:
+                with tenant_context("initech"):
+                    for i in range(8):
+                        try:
+                            burst_jobs.append(
+                                GBM(ntrees=1, max_depth=2,
+                                    seed=seed + i).train_async(
+                                    y="y",
+                                    training_frame=frame_of("initech")))
+                        except AdmissionRejected as e:
+                            burst_rejects += 1
+                            if e.reason not in AdmissionRejected.REASONS:
+                                fail("refusals_classified",
+                                     f"unclassified reason {e.reason}")
+            # restart any stream pipeline the reform interrupted —
+            # the durable cursor makes the re-attach exactly-once
+            for name, pipe in list(streams.items()):
+                if pipe.job is not None and \
+                        pipe.job.status in TERMINAL:
+                    try:
+                        streams[name] = start_stream(name, resume=True)
+                        report["stream_restarts"] += 1
+                    except AdmissionRejected as e:
+                        # classified refusal — retry next round
+                        if e.reason not in AdmissionRejected.REASONS:
+                            fail("refusals_classified",
+                                 f"unclassified stream reject: "
+                                 f"{e.reason}")
+                    except Exception as e:  # noqa: BLE001
+                        fail("stream_resume",
+                             f"tenant {name}: {e!r}")
+            if verbose:
+                print(f"[mt-soak] round {r} done, "
+                      f"{t_end - time.monotonic():.0f}s left",
+                      file=sys.stderr)
+    finally:
+        _accumulate(chaos.chaos().counters())
+        oom_stats = oom.stats()
+        chaos.reset()                 # faults OFF before teardown
+        hammer_stop.set()
+        feeders_stop.set()
+        feeder_t.join(timeout=5)
+        for h in hammers:
+            h.join(timeout=5)
+        for name, pipe in streams.items():
+            try:
+                pipe.finish()
+                if pipe.job is not None:
+                    pipe.job.join(timeout=120)
+            except Exception:  # noqa: BLE001
+                pass
+            stop_pipeline(f"mt_stream_{name}", remove=True)
+        stop_pipeline("mt_follow", remove=True)
+        stop_pipeline("mt_follow_replay", remove=True)
+        for name, job in aml_jobs.items():
+            try:
+                job.join(timeout=300)
+            except Exception:  # noqa: BLE001 — terminal-state check below
+                pass
+        for j in burst_jobs:
+            try:
+                j.join(timeout=120)
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            registry().undeploy(alias, drain_secs=1.0)
+        except KeyError:
+            pass
+        srv.stop()
+
+    # ---- invariants -------------------------------------------------
+    inv = report["invariants"]
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        live = [j for j in cl.jobs.list() if j.status not in TERMINAL]
+        if not live:
+            break
+        time.sleep(0.2)
+    live = [f"{j.key}:{j.status}" for j in cl.jobs.list()
+            if j.status not in TERMINAL]
+    inv["jobs_terminal"] = not live
+    if live:
+        fail("jobs_terminal", f"non-terminal jobs: {live[:5]}")
+
+    adm = cl.jobs.admission.stats()
+    report["admission"] = adm
+    classified = sum(adm["rejects_by_reason"].values())
+    unknown = set(adm["rejects_by_reason"]) - set(
+        AdmissionRejected.REASONS)
+    inv["refusals_classified"] = (
+        adm["rejected"] == classified and not unknown)
+    if not inv["refusals_classified"]:
+        fail("refusals_classified",
+             f"rejected={adm['rejected']} classified={classified} "
+             f"unknown_reasons={sorted(unknown)}")
+    injected_rejects = counters_accum.get("injected_admission_rejects",
+                                          0)
+    inv["injected_rejects_accounted"] = (
+        adm["rejects_by_reason"].get("injected", 0) == injected_rejects)
+    if not inv["injected_rejects_accounted"]:
+        fail("injected_rejects_accounted",
+             f"admission saw "
+             f"{adm['rejects_by_reason'].get('injected', 0)} != "
+             f"chaos {injected_rejects}")
+    report["burst_rejects"] = burst_rejects
+
+    mem = manager().stats()
+    inv["tenant_isolation"] = mem["cross_tenant_below_highwater"] == 0
+    if not inv["tenant_isolation"]:
+        fail("tenant_isolation",
+             f"{mem['cross_tenant_below_highwater']} cross-tenant "
+             f"evictions below the high-water mark")
+    report["memory_tenants"] = mem.get("tenants")
+    report["cross_tenant_evictions"] = mem["cross_tenant_evictions"]
+
+    inv["slice_loss_fired"] = \
+        counters_accum.get("injected_slice_losses", 0) >= 1
+    if not inv["slice_loss_fired"]:
+        fail("slice_loss_fired", "no slice loss fired mid-soak")
+
+    p99s = {}
+    for name, vals in lat.items():
+        if not vals:
+            fail("serve_per_tenant",
+                 f"tenant {name} got zero successful scores")
+            continue
+        p99s[name] = float(np.percentile(vals, 99))
+        if p99s[name] > p99_bound_ms:
+            fail("serve_per_tenant",
+                 f"tenant {name} p99 {p99s[name]:.0f}ms > bound "
+                 f"{p99_bound_ms:.0f}ms")
+    inv["serve_per_tenant"] = not any(
+        f.startswith("serve_per_tenant") for f in report["failures"])
+    report["serve_p99_ms"] = {k: round(v, 2) for k, v in p99s.items()}
+
+    pw, sw = cl.jobs._pool._max_workers, cl.jobs._sys_pool._max_workers
+    inv["pool_slots"] = (pw == pool_workers and sw == sys_workers)
+    if not inv["pool_slots"]:
+        fail("pool_slots", f"user {pool_workers}->{pw}, "
+                           f"system {sys_workers}->{sw}")
+
+    for name in tenants:
+        delete_tenant(name)
+    for k in list(map(str, cl.dkv.keys())):
+        if k not in keys_before:
+            cl.dkv.remove(k, force=True)
+    leaked = set(map(str, cl.dkv.keys())) ^ keys_before
+    inv["dkv_clean"] = not leaked
+    if leaked:
+        fail("dkv_clean", f"key-set drift: {sorted(leaked)[:10]}")
+
+    report["chaos"] = counters_accum
+    report["oom"] = oom_stats
+    report["retry"] = resilience.stats()
+    report["ok"] = not report["failures"]
+    return report
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--duration", type=float, default=60.0,
                     help="soak wall-clock seconds (default 60)")
+    ap.add_argument("--multitenant", action="store_true",
+                    help="run the multi-tenant isolation soak instead "
+                         "of the single-tenant chaos storm")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
-    report = run_soak(seed=args.seed, duration=args.duration,
-                      verbose=args.verbose)
+    runner = run_multitenant_soak if args.multitenant else run_soak
+    report = runner(seed=args.seed, duration=args.duration,
+                    verbose=args.verbose)
     print(json.dumps(report, indent=2, default=str))
     return 0 if report["ok"] else 1
 
